@@ -1,0 +1,42 @@
+"""photon-ml-tpu: a TPU-native framework with the capabilities of LinkedIn Photon ML.
+
+Trains and scores Generalized Linear Models (linear / logistic / Poisson regression and
+smoothed-hinge linear SVM) and GLMix / GAME mixed-effect models (a fixed-effect GLM plus
+per-entity random-effect GLMs, fit by block coordinate descent) — re-designed TPU-first:
+
+- jitted ``lax.while_loop`` optimizers (LBFGS / OWLQN / LBFGSB / TRON), batched-first so
+  ``vmap`` yields the per-entity version for free;
+- dense / sparse-COO design matrices whose matvec & rmatvec map onto the MXU and
+  segment ops instead of Spark treeAggregate;
+- ``jax.sharding`` mesh parallelism (data-parallel fixed effect, entity-sharded random
+  effects) instead of broadcast / shuffle;
+- score exchange between coordinates as elementwise ops over a global sample axis
+  instead of RDD joins.
+
+Reference behavior catalogued in /root/repo/SURVEY.md; parity targets cite
+reference files as ``photon-lib/.../File.scala:line``.
+"""
+
+from photon_ml_tpu.types import (
+    TaskType,
+    OptimizerType,
+    RegularizationType,
+    NormalizationType,
+    VarianceComputationType,
+    ConvergenceReason,
+)
+from photon_ml_tpu.normalization import NormalizationContext, FeatureDataStatistics
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "TaskType",
+    "OptimizerType",
+    "RegularizationType",
+    "NormalizationType",
+    "VarianceComputationType",
+    "ConvergenceReason",
+    "NormalizationContext",
+    "FeatureDataStatistics",
+    "__version__",
+]

@@ -1,0 +1,10 @@
+from photon_ml_tpu.data.matrix import DenseDesignMatrix, SparseDesignMatrix, DesignMatrix
+from photon_ml_tpu.data.dataset import LabeledData, FixedEffectDataset
+
+__all__ = [
+    "DenseDesignMatrix",
+    "SparseDesignMatrix",
+    "DesignMatrix",
+    "LabeledData",
+    "FixedEffectDataset",
+]

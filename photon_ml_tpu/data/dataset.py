@@ -1,0 +1,90 @@
+"""Labeled data containers.
+
+``LabeledData`` is the device-side replacement for ``RDD[(UniqueSampleId, LabeledPoint)]``
+(photon-lib data/LabeledPoint.scala:1-106): a struct-of-arrays pytree with labels,
+offsets, weights and a design matrix. Padded rows carry weight 0 AND zeroed
+features/labels/offsets, so every weighted reduction ignores them without masking.
+
+``FixedEffectDataset`` mirrors photon-api data/FixedEffectDataset.scala:31-152 — one
+global feature shard; "addScoresToOffsets" is an elementwise add over the global sample
+axis (no joins: scores are dense arrays indexed by position).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.matrix import DesignMatrix, as_design_matrix
+
+Array = jnp.ndarray
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LabeledData:
+    """Batched labeled samples (label, features, offset, weight)."""
+
+    X: DesignMatrix
+    labels: Array  # [N]
+    offsets: Array  # [N]
+    weights: Array  # [N]
+
+    @property
+    def n(self) -> int:
+        return self.labels.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.X.n_cols
+
+    def margins(self, coef: Array) -> Array:
+        """computeMargin: x.w + offset (LabeledPoint.scala:53-59)."""
+        return self.X.matvec(coef) + self.offsets
+
+    def with_offsets(self, offsets: Array) -> "LabeledData":
+        return dataclasses.replace(self, offsets=offsets)
+
+    def add_scores_to_offsets(self, scores: Array) -> "LabeledData":
+        """FixedEffectDataset.addScoresToOffsets — elementwise, not a join."""
+        return dataclasses.replace(self, offsets=self.offsets + scores)
+
+    @staticmethod
+    def build(X, labels, offsets=None, weights=None, dtype=None) -> "LabeledData":
+        Xm = as_design_matrix(X, dtype=dtype)
+        labels = jnp.asarray(labels, dtype=dtype)
+        n = labels.shape[0]
+        if offsets is None:
+            offsets = jnp.zeros(n, dtype=labels.dtype)
+        else:
+            offsets = jnp.asarray(offsets, dtype=labels.dtype)
+        if weights is None:
+            weights = jnp.ones(n, dtype=labels.dtype)
+        else:
+            weights = jnp.asarray(weights, dtype=labels.dtype)
+        return LabeledData(X=Xm, labels=labels, offsets=offsets, weights=weights)
+
+
+@dataclasses.dataclass
+class FixedEffectDataset:
+    """One global feature shard of the GAME dataset.
+
+    Rows are positionally aligned with the global sample axis: coordinate scores are
+    dense [N] arrays exchanged by position (replaces the reference's uniqueId joins).
+    """
+
+    data: LabeledData
+    feature_shard_id: str = "global"
+
+    @property
+    def n(self) -> int:
+        return self.data.n
+
+    @property
+    def dim(self) -> int:
+        return self.data.dim
+
+    def with_extra_offsets(self, scores: Array) -> "FixedEffectDataset":
+        return dataclasses.replace(self, data=self.data.add_scores_to_offsets(scores))

@@ -1,0 +1,19 @@
+from photon_ml_tpu.function.losses import (
+    PointwiseLoss,
+    logistic_loss,
+    squared_loss,
+    poisson_loss,
+    smoothed_hinge_loss,
+    loss_for_task,
+)
+from photon_ml_tpu.function.objective import GLMObjective
+
+__all__ = [
+    "PointwiseLoss",
+    "logistic_loss",
+    "squared_loss",
+    "poisson_loss",
+    "smoothed_hinge_loss",
+    "loss_for_task",
+    "GLMObjective",
+]

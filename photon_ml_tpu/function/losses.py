@@ -1,0 +1,130 @@
+"""Pointwise GLM losses: l(z, y) with first and second derivatives in the margin z.
+
+TPU-first contract: each loss exposes vectorized ``loss_and_dz(z, y) -> (l, dz)`` and
+``dzz(z, y)`` over whole margin arrays, so the objective computes all per-sample
+quantities in one fused elementwise pass that XLA folds into the matvec epilogue.
+
+Semantics match the reference exactly:
+- logistic: photon-api function/glm/LogisticLossFunction.scala (log1p-exp stable form)
+- squared: photon-api function/glm/SquaredLossFunction.scala (1/2 (z-y)^2)
+- poisson: photon-api function/glm/PoissonLossFunction.scala (exp(z) - y z)
+- smoothed hinge: photon-api function/svm/SmoothedHingeLossFunction.scala:33-112
+  (Rennie's smoothed hinge; piecewise quadratic; labels mapped {< 0.5 -> -1, else +1})
+- the positive-response threshold 0.5 comes from MathConst.POSITIVE_RESPONSE_THRESHOLD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.types import TaskType
+
+Array = jnp.ndarray
+
+POSITIVE_RESPONSE_THRESHOLD = 0.5
+
+
+def _log1p_exp(x: Array) -> Array:
+    # Numerically stable log(1 + exp(x)) == logaddexp(0, x).
+    return jnp.logaddexp(0.0, x)
+
+
+def _sigmoid(x: Array) -> Array:
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss l(z, y) with dz and dzz (photon-lib PointwiseLossFunction.scala:36-54).
+
+    ``has_hessian`` gates TwiceDiff-only optimizers (TRON): the smoothed hinge has no
+    second derivative in the reference (DiffFunction only), so TRON rejects it.
+    """
+
+    name: str
+    loss_and_dz: Callable[[Array, Array], tuple[Array, Array]]
+    dzz: Callable[[Array, Array], Array]
+    has_hessian: bool = True
+
+    def loss(self, z: Array, y: Array) -> Array:
+        return self.loss_and_dz(z, y)[0]
+
+
+def _logistic_loss_and_dz(z: Array, y: Array) -> tuple[Array, Array]:
+    pos = y > POSITIVE_RESPONSE_THRESHOLD
+    # positive: log1pExp(-z), dz = -sigmoid(-z);  negative: log1pExp(z), dz = sigmoid(z)
+    loss = jnp.where(pos, _log1p_exp(-z), _log1p_exp(z))
+    dz = jnp.where(pos, -_sigmoid(-z), _sigmoid(z))
+    return loss, dz
+
+
+def _logistic_dzz(z: Array, y: Array) -> Array:
+    s = _sigmoid(z)
+    return s * (1.0 - s)
+
+
+def _squared_loss_and_dz(z: Array, y: Array) -> tuple[Array, Array]:
+    delta = z - y
+    return delta * delta / 2.0, delta
+
+
+def _squared_dzz(z: Array, y: Array) -> Array:
+    return jnp.ones_like(z)
+
+
+def _poisson_loss_and_dz(z: Array, y: Array) -> tuple[Array, Array]:
+    pred = jnp.exp(z)
+    return pred - z * y, pred - y
+
+
+def _poisson_dzz(z: Array, y: Array) -> Array:
+    return jnp.exp(z)
+
+
+def _smoothed_hinge_loss_and_dz(z: Array, y: Array) -> tuple[Array, Array]:
+    mod_label = jnp.where(y < POSITIVE_RESPONSE_THRESHOLD, -1.0, 1.0)
+    zy = mod_label * z
+    loss = jnp.where(zy <= 0.0, 0.5 - zy, jnp.where(zy < 1.0, 0.5 * (1.0 - zy) ** 2, 0.0))
+    deriv = jnp.where(zy < 0.0, -1.0, jnp.where(zy < 1.0, zy - 1.0, 0.0))
+    return loss, deriv * mod_label
+
+
+def _smoothed_hinge_dzz(z: Array, y: Array) -> Array:
+    # Not defined in the reference (DiffFunction only). Provide the a.e. second
+    # derivative (1 on the quadratic segment) for optional quasi-Newton use.
+    mod_label = jnp.where(y < POSITIVE_RESPONSE_THRESHOLD, -1.0, 1.0)
+    zy = mod_label * z
+    return jnp.where((zy >= 0.0) & (zy < 1.0), 1.0, 0.0)
+
+
+logistic_loss = PointwiseLoss("logistic", _logistic_loss_and_dz, _logistic_dzz)
+squared_loss = PointwiseLoss("squared", _squared_loss_and_dz, _squared_dzz)
+poisson_loss = PointwiseLoss("poisson", _poisson_loss_and_dz, _poisson_dzz)
+smoothed_hinge_loss = PointwiseLoss(
+    "smoothed_hinge", _smoothed_hinge_loss_and_dz, _smoothed_hinge_dzz, has_hessian=False
+)
+
+_TASK_LOSSES = {
+    TaskType.LOGISTIC_REGRESSION: logistic_loss,
+    TaskType.LINEAR_REGRESSION: squared_loss,
+    TaskType.POISSON_REGRESSION: poisson_loss,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: smoothed_hinge_loss,
+}
+
+
+def loss_for_task(task: TaskType) -> PointwiseLoss:
+    """Task dispatch (reference ObjectiveFunctionHelper.buildFactory:39-44)."""
+    return _TASK_LOSSES[TaskType(task)]
+
+
+def mean_function_for_task(task: TaskType) -> Callable[[Array], Array]:
+    """Link-inverse used for predictions (reference GLM model classes, supervised/)."""
+    task = TaskType(task)
+    if task == TaskType.LOGISTIC_REGRESSION:
+        return _sigmoid
+    if task == TaskType.POISSON_REGRESSION:
+        return jnp.exp
+    return lambda z: z

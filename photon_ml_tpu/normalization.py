@@ -1,0 +1,178 @@
+"""Feature normalization as affine algebra: x' = (x - shift) * factor.
+
+Semantics from photon-lib normalization/NormalizationContext.scala:37-215 and
+stat/FeatureDataStatistics.scala. The TPU design never materializes normalized data:
+objectives fold the shift/factor into an effective coefficient vector
+(ValueAndGradientAggregator.scala:34-80 documents the algebra), so normalization is a
+pair of O(D) vector ops per optimizer iteration instead of a rewritten dataset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.types import NormalizationType
+
+Array = jnp.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureDataStatistics:
+    """Per-feature one-pass summary (photon-lib stat/FeatureDataStatistics.scala:1-139).
+
+    All fields are length-D numpy arrays; computed host-side at ingest (a single pass,
+    which on TPU is a handful of weighted segment reductions, see compute()).
+    """
+
+    count: int
+    mean: np.ndarray
+    variance: np.ndarray
+    min: np.ndarray
+    max: np.ndarray
+    num_nonzeros: np.ndarray
+    mean_abs: np.ndarray
+    intercept_index: Optional[int] = None
+
+    @staticmethod
+    def compute(X, intercept_index: Optional[int] = None) -> "FeatureDataStatistics":
+        """Compute from a dense [N, D] host array (sparse path: data/ingest.py)."""
+        X = np.asarray(X)
+        n = X.shape[0]
+        if n == 0:
+            raise ValueError("Cannot compute feature statistics over zero samples")
+        return FeatureDataStatistics(
+            count=n,
+            mean=X.mean(axis=0),
+            # Reference uses MultivariateOnlineSummarizer = sample variance (n-1).
+            variance=X.var(axis=0, ddof=1) if n > 1 else np.zeros(X.shape[1]),
+            min=X.min(axis=0) if n else np.zeros(X.shape[1]),
+            max=X.max(axis=0) if n else np.zeros(X.shape[1]),
+            num_nonzeros=(X != 0).sum(axis=0).astype(np.float64),
+            mean_abs=np.abs(X).mean(axis=0),
+            intercept_index=intercept_index,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """Affine transform x' = (x - shift) * factor; None means identity on that part.
+
+    The coefficient-space conversions keep margins invariant
+    (NormalizationContext.scala:73-124):
+      original <- transformed:  w = w' .* factor;  b -= w_dot_shift
+      transformed <- original:  b += w^T shift;    w' = w ./ factor
+    If shifts are present an intercept index is required, with shift 0 / factor 1 there.
+    """
+
+    factors: Optional[np.ndarray] = None
+    shifts: Optional[np.ndarray] = None
+    intercept_index: Optional[int] = None
+
+    def __post_init__(self):
+        if self.shifts is not None and self.intercept_index is None:
+            raise ValueError("Shift normalization requires an intercept index")
+        if self.factors is not None and self.shifts is not None:
+            if len(self.factors) != len(self.shifts):
+                raise ValueError("Factors and shifts must have the same size")
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    @property
+    def size(self) -> int:
+        if self.factors is not None:
+            return len(self.factors)
+        if self.shifts is not None:
+            return len(self.shifts)
+        return 0
+
+    # -- coefficient-space conversions (host-side; numpy) ---------------------------
+
+    def model_to_original_space(self, coef: np.ndarray) -> np.ndarray:
+        if self.is_identity:
+            return coef
+        out = np.array(coef, dtype=np.float64, copy=True)
+        if self.factors is not None:
+            out = out * np.asarray(self.factors)
+        if self.shifts is not None:
+            out[self.intercept_index] -= out.dot(np.asarray(self.shifts))
+        return out
+
+    def model_to_transformed_space(self, coef: np.ndarray) -> np.ndarray:
+        if self.is_identity:
+            return coef
+        out = np.array(coef, dtype=np.float64, copy=True)
+        if self.shifts is not None:
+            out[self.intercept_index] += out.dot(np.asarray(self.shifts))
+        if self.factors is not None:
+            out = out / np.asarray(self.factors)
+        return out
+
+    # -- device-side effective-coefficient algebra ----------------------------------
+
+    def effective_coefficients(self, coef: Array) -> tuple[Array, Array]:
+        """(effective_coef, margin_shift) such that margin over RAW features equals
+        the margin over normalized features:
+          z = x'.w = x.(factor*w) - (factor*w).shift = x.eff + margin_shift
+        (ValueAndGradientAggregator.init, reference :90-120)."""
+        eff = coef if self.factors is None else coef * jnp.asarray(self.factors, dtype=coef.dtype)
+        if self.shifts is None:
+            shift = jnp.zeros((), dtype=coef.dtype)
+        else:
+            shift = -jnp.dot(eff, jnp.asarray(self.shifts, dtype=coef.dtype))
+        return eff, shift
+
+    def apply_to_gradient(self, vector_sum: Array, prefactor_sum: Array) -> Array:
+        """grad_j = factor_j * (vector_sum_j - shift_j * prefactor_sum)
+        — the gradient-space version of the same algebra (reference :55-75)."""
+        g = vector_sum
+        if self.shifts is not None:
+            g = g - jnp.asarray(self.shifts, dtype=g.dtype) * prefactor_sum
+        if self.factors is not None:
+            g = g * jnp.asarray(self.factors, dtype=g.dtype)
+        return g
+
+    # -- factory (NormalizationContext.apply, reference :126-190) -------------------
+
+    @staticmethod
+    def build(
+        normalization_type: NormalizationType,
+        summary: Optional[FeatureDataStatistics] = None,
+    ) -> "NormalizationContext":
+        normalization_type = NormalizationType(normalization_type)
+        if normalization_type == NormalizationType.NONE:
+            return NormalizationContext()
+        if summary is None:
+            raise ValueError(f"{normalization_type} requires feature statistics")
+
+        if normalization_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+            magnitude = np.maximum(np.abs(summary.max), np.abs(summary.min))
+            factors = 1.0 / np.where(magnitude == 0.0, 1.0, magnitude)
+            return NormalizationContext(factors=factors)
+
+        std = np.sqrt(summary.variance)
+        factors = 1.0 / np.where(std == 0.0, 1.0, std)
+
+        if normalization_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+            return NormalizationContext(factors=factors)
+
+        if normalization_type == NormalizationType.STANDARDIZATION:
+            if summary.intercept_index is None:
+                raise ValueError("STANDARDIZATION requires an intercept")
+            shifts = np.array(summary.mean, copy=True)
+            shifts[summary.intercept_index] = 0.0
+            factors = np.array(factors, copy=True)
+            factors[summary.intercept_index] = 1.0
+            return NormalizationContext(
+                factors=factors, shifts=shifts, intercept_index=summary.intercept_index
+            )
+
+        raise ValueError(f"NormalizationType {normalization_type} not recognized")
+
+
+NO_NORMALIZATION = NormalizationContext()

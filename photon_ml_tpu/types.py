@@ -1,0 +1,125 @@
+"""Domain types and enums.
+
+Mirrors the reference's type vocabulary (photon-lib Types.scala:21-44,
+TaskType.scala:25, optimization/OptimizerType.scala:23,
+optimization/RegularizationType + RegularizationContext.scala:38-134,
+normalization/NormalizationType.scala:42, optimization/VarianceComputationType.scala:25,
+optimization/ConvergenceReason.scala, HyperparameterTuningMode.scala).
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Type aliases (reference: photon-lib Types.scala).
+UniqueSampleId = int
+CoordinateId = str
+REType = str  # random-effect type, e.g. "userId"
+REId = str  # a concrete entity id
+FeatureShardId = str
+
+
+class TaskType(str, enum.Enum):
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+    @property
+    def is_classification(self) -> bool:
+        return self in (
+            TaskType.LOGISTIC_REGRESSION,
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        )
+
+
+class OptimizerType(str, enum.Enum):
+    LBFGS = "LBFGS"
+    OWLQN = "OWLQN"
+    LBFGSB = "LBFGSB"
+    TRON = "TRON"
+
+
+class RegularizationType(str, enum.Enum):
+    NONE = "NONE"
+    L1 = "L1"
+    L2 = "L2"
+    ELASTIC_NET = "ELASTIC_NET"
+
+
+class NormalizationType(str, enum.Enum):
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+class VarianceComputationType(str, enum.Enum):
+    NONE = "NONE"
+    SIMPLE = "SIMPLE"  # 1 / diag(Hessian)
+    FULL = "FULL"  # diag(Hessian^-1) via Cholesky
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Why an optimizer stopped (photon-lib optimization/Optimizer.scala:135-149).
+
+    Encoded as an IntEnum so per-entity convergence reasons can live in device arrays
+    (the vmap-ed random-effect solves return one code per entity).
+    """
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    OBJECTIVE_NOT_IMPROVING = 2
+    FUNCTION_VALUES_CONVERGED = 3
+    GRADIENT_CONVERGED = 4
+
+
+class HyperparameterTuningMode(str, enum.Enum):
+    NONE = "NONE"
+    RANDOM = "RANDOM"
+    BAYESIAN = "BAYESIAN"
+
+
+class ModelType(str, enum.Enum):
+    """DatumScoringModel taxonomy (photon-lib model/DatumScoringModel.scala)."""
+
+    FIXED_EFFECT = "FIXED_EFFECT"
+    RANDOM_EFFECT = "RANDOM_EFFECT"
+    GAME = "GAME"
+
+
+# Column-name vocabulary for tabular inputs (photon-api data/InputColumnsNames.scala:106).
+class InputColumnsNames:
+    UID = "uid"
+    RESPONSE = "response"
+    OFFSET = "offset"
+    WEIGHT = "weight"
+    META_DATA_MAP = "metadataMap"
+
+    def __init__(self, overrides: dict | None = None):
+        self._names = {
+            "uid": self.UID,
+            "response": self.RESPONSE,
+            "offset": self.OFFSET,
+            "weight": self.WEIGHT,
+            "metadataMap": self.META_DATA_MAP,
+        }
+        if overrides:
+            self._names.update(overrides)
+
+    def __getitem__(self, key: str) -> str:
+        return self._names[key]
+
+    def all(self) -> dict:
+        return dict(self._names)
+
+    INTERCEPT_NAME = "(INTERCEPT)"
+    INTERCEPT_TERM = ""
+
+
+def intercept_key() -> str:
+    """Canonical feature key of the intercept column (reference Constants.scala)."""
+    return f"{InputColumnsNames.INTERCEPT_NAME}\x01{InputColumnsNames.INTERCEPT_TERM}"
+
+
+DELIMITER = "\x01"  # name/term join delimiter (reference Constants.scala)

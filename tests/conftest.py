@@ -1,0 +1,40 @@
+"""Test harness: force an 8-device CPU platform + float64.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference exercises
+"distributed" behavior on a multi-core local[*] Spark; we exercise sharded jit /
+shard_map code on a simulated 8-device CPU mesh via
+--xla_force_host_platform_device_count. float64 gives numerical parity headroom for
+optimizer convergence assertions (TPU production runs use f32/bf16).
+"""
+
+import os
+
+# Force CPU: the ambient environment pins JAX_PLATFORMS=axon (the real TPU tunnel);
+# unit tests must run on the simulated 8-device CPU platform regardless. jax may
+# already be imported by a pytest plugin before this conftest, so set it through
+# jax.config (effective until backends initialize) as well as the environment.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(271828)
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs[:8]

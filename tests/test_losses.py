@@ -1,0 +1,86 @@
+"""Pointwise loss semantics vs closed forms and autodiff.
+
+Verification style follows the reference's unit tests for function/glm losses
+(photon-lib src/test): check values at known points and derivatives dz/dzz against
+finite differences / jax.grad.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.function.losses import (
+    logistic_loss,
+    poisson_loss,
+    smoothed_hinge_loss,
+    squared_loss,
+)
+
+ALL_LOSSES = [logistic_loss, squared_loss, poisson_loss, smoothed_hinge_loss]
+
+
+def test_logistic_values():
+    z = jnp.array([0.0, 10.0, -10.0])
+    l_pos, _ = logistic_loss.loss_and_dz(z, jnp.ones(3))
+    l_neg, _ = logistic_loss.loss_and_dz(z, jnp.zeros(3))
+    np.testing.assert_allclose(l_pos, [np.log(2.0), np.log1p(np.exp(-10.0)), np.log1p(np.exp(10.0))], rtol=1e-12)
+    np.testing.assert_allclose(l_neg, [np.log(2.0), np.log1p(np.exp(10.0)), np.log1p(np.exp(-10.0))], rtol=1e-12)
+
+
+def test_logistic_extreme_margins_stable():
+    z = jnp.array([1000.0, -1000.0])
+    l, dz = logistic_loss.loss_and_dz(z, jnp.array([1.0, 1.0]))
+    assert np.isfinite(np.asarray(l)).all() and np.isfinite(np.asarray(dz)).all()
+    np.testing.assert_allclose(l, [0.0, 1000.0], atol=1e-12)
+
+
+def test_squared_loss_values():
+    l, dz = squared_loss.loss_and_dz(jnp.array([3.0]), jnp.array([1.0]))
+    np.testing.assert_allclose(l, [2.0])
+    np.testing.assert_allclose(dz, [2.0])
+    np.testing.assert_allclose(squared_loss.dzz(jnp.array([3.0]), jnp.array([1.0])), [1.0])
+
+
+def test_poisson_loss_values():
+    z, y = jnp.array([0.5]), jnp.array([2.0])
+    l, dz = poisson_loss.loss_and_dz(z, y)
+    np.testing.assert_allclose(l, np.exp(0.5) - 0.5 * 2.0, rtol=1e-12)
+    np.testing.assert_allclose(dz, np.exp(0.5) - 2.0, rtol=1e-12)
+    np.testing.assert_allclose(poisson_loss.dzz(z, y), np.exp(0.5), rtol=1e-12)
+
+
+def test_smoothed_hinge_piecewise():
+    # positive label: z<=0 -> 0.5 - z; 0<z<1 -> quadratic; z>=1 -> 0
+    y = jnp.ones(4)
+    z = jnp.array([-1.0, 0.5, 1.0, 2.0])
+    l, dz = smoothed_hinge_loss.loss_and_dz(z, y)
+    np.testing.assert_allclose(l, [1.5, 0.125, 0.0, 0.0], atol=1e-12)
+    np.testing.assert_allclose(dz, [-1.0, -0.5, 0.0, 0.0], atol=1e-12)
+    # negative label mirrors
+    l2, dz2 = smoothed_hinge_loss.loss_and_dz(-z, jnp.zeros(4))
+    np.testing.assert_allclose(l2, l, atol=1e-12)
+    np.testing.assert_allclose(dz2, -dz, atol=1e-12)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda loss: loss.name)
+@pytest.mark.parametrize("label", [0.0, 1.0, 3.0])
+def test_dz_matches_autodiff(loss, label):
+    if loss.name in ("logistic", "smoothed_hinge") and label > 1:
+        pytest.skip("classification labels")
+    zs = np.linspace(-2.0, 2.0, 21)
+    # avoid the hinge's non-differentiable knots
+    zs = zs[np.abs(np.abs(zs) - 1.0) > 1e-6]
+    for z in zs:
+        got = loss.loss_and_dz(jnp.array(z), jnp.array(label))[1]
+        want = jax.grad(lambda zz: loss.loss_and_dz(zz, jnp.array(label))[0])(jnp.array(z))
+        np.testing.assert_allclose(got, want, rtol=1e-8, err_msg=f"{loss.name} z={z}")
+
+
+@pytest.mark.parametrize("loss", [logistic_loss, squared_loss, poisson_loss], ids=lambda loss: loss.name)
+def test_dzz_matches_autodiff(loss):
+    for z in np.linspace(-2.0, 2.0, 9):
+        for label in (0.0, 1.0):
+            got = loss.dzz(jnp.array(z), jnp.array(label))
+            want = jax.grad(jax.grad(lambda zz: loss.loss_and_dz(zz, jnp.array(label))[0]))(jnp.array(z))
+            np.testing.assert_allclose(got, want, rtol=1e-8)
