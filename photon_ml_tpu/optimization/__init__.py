@@ -1,0 +1,16 @@
+from photon_ml_tpu.optimization.common import OptimizerConfig, OptResult
+from photon_ml_tpu.optimization.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optimization.owlqn import minimize_owlqn
+from photon_ml_tpu.optimization.lbfgsb import minimize_lbfgsb
+from photon_ml_tpu.optimization.tron import minimize_tron
+from photon_ml_tpu.optimization.factory import build_minimizer
+
+__all__ = [
+    "OptimizerConfig",
+    "OptResult",
+    "minimize_lbfgs",
+    "minimize_owlqn",
+    "minimize_lbfgsb",
+    "minimize_tron",
+    "build_minimizer",
+]

@@ -1,0 +1,103 @@
+"""Optimizer dispatch (reference optimization/OptimizerFactory.scala:37-80).
+
+``build_minimizer`` maps an OptimizerConfig + regularization split to a uniform
+callable ``minimize(value_and_grad, x0, l1_weight=0.0, hvp=None, ...) -> OptResult``.
+The L1/L2 split follows RegularizationContext (RegularizationContext.scala:38-134):
+L2 is folded into the smooth objective by the caller; L1 routes to OWLQN.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.optimization.common import OptimizerConfig, OptResult
+from photon_ml_tpu.optimization.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optimization.lbfgsb import minimize_lbfgsb
+from photon_ml_tpu.optimization.owlqn import minimize_owlqn
+from photon_ml_tpu.optimization.tron import minimize_tron
+from photon_ml_tpu.types import OptimizerType
+
+Array = jnp.ndarray
+
+
+def build_minimizer(config: OptimizerConfig):
+    """Returns minimize(value_and_grad, x0, *, l1_weight, hvp, lower/upper_bounds)."""
+
+    opt = OptimizerType(config.optimizer_type)
+
+    def minimize(
+        value_and_grad: Callable[[Array], tuple[Array, Array]],
+        x0: Array,
+        *,
+        l1_weight=0.0,
+        hvp: Optional[Callable[[Array, Array], Array]] = None,
+        lower_bounds: Optional[Array] = None,
+        upper_bounds: Optional[Array] = None,
+    ) -> OptResult:
+        has_l1 = not (isinstance(l1_weight, (int, float)) and l1_weight == 0.0)
+        if has_l1 and opt != OptimizerType.OWLQN:
+            raise ValueError(
+                f"L1 regularization requires OWLQN; {opt.value} would silently ignore it"
+            )
+        has_bounds = lower_bounds is not None or upper_bounds is not None
+        if has_bounds and opt == OptimizerType.OWLQN:
+            raise ValueError("OWLQN does not support box constraints")
+        if opt == OptimizerType.OWLQN:
+            return minimize_owlqn(
+                value_and_grad,
+                x0,
+                l1_weight,
+                max_iterations=config.max_iterations,
+                tolerance=config.tolerance,
+                history_length=config.history_length,
+                max_line_search_iterations=config.max_line_search_iterations,
+                track_states=config.track_states,
+            )
+        if opt == OptimizerType.TRON:
+            if hvp is None:
+                raise ValueError("TRON requires a Hessian-vector-product callable")
+            return minimize_tron(
+                value_and_grad,
+                hvp,
+                x0,
+                max_iterations=config.max_iterations,
+                tolerance=config.tolerance,
+                max_cg_iterations=config.max_cg_iterations,
+                max_improvement_failures=config.max_improvement_failures,
+                lower_bounds=lower_bounds,
+                upper_bounds=upper_bounds,
+                track_states=config.track_states,
+            )
+        if opt == OptimizerType.LBFGSB:
+            if lower_bounds is None and upper_bounds is None:
+                raise ValueError("LBFGSB requires box bounds")
+            big = jnp.inf
+            lo = lower_bounds if lower_bounds is not None else -big
+            hi = upper_bounds if upper_bounds is not None else big
+            return minimize_lbfgsb(
+                value_and_grad,
+                x0,
+                lo,
+                hi,
+                max_iterations=config.max_iterations,
+                tolerance=config.tolerance,
+                history_length=config.history_length,
+                max_line_search_iterations=config.max_line_search_iterations,
+                track_states=config.track_states,
+            )
+        # LBFGS (optionally with post-step projection constraints)
+        return minimize_lbfgs(
+            value_and_grad,
+            x0,
+            max_iterations=config.max_iterations,
+            tolerance=config.tolerance,
+            history_length=config.history_length,
+            max_line_search_iterations=config.max_line_search_iterations,
+            lower_bounds=lower_bounds,
+            upper_bounds=upper_bounds,
+            track_states=config.track_states,
+        )
+
+    return minimize

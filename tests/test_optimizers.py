@@ -1,0 +1,324 @@
+"""Optimizer verification in the reference's style (SURVEY.md §4): convergence to
+known minima on closed-form objectives, GLM fits cross-checked against scipy, vmap
+batching equivalence (the per-entity random-effect mechanism), convergence reasons.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.function.losses import logistic_loss, poisson_loss
+from photon_ml_tpu.function.objective import GLMObjective, make_value_and_grad
+from photon_ml_tpu.optimization import (
+    OptimizerConfig,
+    build_minimizer,
+    minimize_lbfgs,
+    minimize_lbfgsb,
+    minimize_owlqn,
+    minimize_tron,
+)
+from photon_ml_tpu.types import ConvergenceReason, OptimizerType
+
+
+def quadratic(center, scales):
+    """f(x) = 1/2 sum scales (x - center)^2 — the IntegTestObjective pattern."""
+    center = jnp.asarray(center)
+    scales = jnp.asarray(scales)
+
+    def vg(x):
+        d = x - center
+        return 0.5 * jnp.sum(scales * d * d), scales * d
+
+    def hvp(x, v):
+        return scales * v
+
+    return vg, hvp
+
+
+def rosenbrock(x):
+    v = jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2)
+    return v, jax.grad(lambda z: jnp.sum(100.0 * (z[1:] - z[:-1] ** 2) ** 2 + (1.0 - z[:-1]) ** 2))(x)
+
+
+# ---------------------------------------------------------------- LBFGS
+
+
+def test_lbfgs_quadratic_exact():
+    vg, _ = quadratic([1.0, -2.0, 3.0], [1.0, 10.0, 0.1])
+    res = minimize_lbfgs(vg, jnp.zeros(3), tolerance=1e-12, max_iterations=100)
+    np.testing.assert_allclose(res.coefficients, [1.0, -2.0, 3.0], atol=1e-6)
+    assert int(res.convergence_reason) in (
+        ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+        ConvergenceReason.GRADIENT_CONVERGED,
+    )
+
+
+def test_lbfgs_rosenbrock():
+    res = minimize_lbfgs(rosenbrock, jnp.zeros(4), tolerance=1e-14, max_iterations=500)
+    np.testing.assert_allclose(res.coefficients, np.ones(4), atol=1e-4)
+
+
+def test_lbfgs_jit_and_iterations():
+    vg, _ = quadratic([2.0], [1.0])
+    res = jax.jit(lambda x0: minimize_lbfgs(vg, x0, max_iterations=50))(jnp.zeros(1))
+    np.testing.assert_allclose(res.coefficients, [2.0], atol=1e-6)
+    assert int(res.iterations) <= 3
+
+
+def test_lbfgs_max_iterations_reason():
+    res = minimize_lbfgs(rosenbrock, jnp.zeros(6), tolerance=1e-30, max_iterations=3)
+    assert int(res.convergence_reason) == ConvergenceReason.MAX_ITERATIONS
+    assert int(res.iterations) == 3
+
+
+def test_lbfgs_logistic_matches_scipy(rng):
+    X = rng.normal(size=(120, 6))
+    X[:, -1] = 1.0
+    w_true = rng.normal(size=6)
+    y = (X @ w_true + 0.5 * rng.normal(size=120) > 0).astype(float)
+    data = LabeledData.build(X, y)
+    obj = GLMObjective(logistic_loss)
+    vg = make_value_and_grad(obj, data, l2_weight=1.0)
+    res = minimize_lbfgs(vg, jnp.zeros(6), tolerance=1e-12, max_iterations=200)
+
+    ref = scipy.optimize.minimize(
+        lambda w: np.asarray(vg(jnp.asarray(w))[0], dtype=float),
+        np.zeros(6),
+        jac=lambda w: np.asarray(vg(jnp.asarray(w))[1], dtype=float),
+        method="L-BFGS-B",
+        tol=1e-14,
+    )
+    np.testing.assert_allclose(res.coefficients, ref.x, atol=2e-4)
+    assert float(res.value) <= ref.fun + 1e-6
+
+
+def test_lbfgs_vmap_batched(rng):
+    """vmap over independent problems == solving them one by one (random-effect core)."""
+    centers = jnp.asarray(rng.normal(size=(5, 4)))
+
+    def solve(center):
+        vg = lambda x: (0.5 * jnp.sum((x - center) ** 2), x - center)
+        return minimize_lbfgs(vg, jnp.zeros(4), max_iterations=50)
+
+    batched = jax.vmap(solve)(centers)
+    np.testing.assert_allclose(batched.coefficients, centers, atol=1e-6)
+    assert batched.coefficients.shape == (5, 4)
+    for i in range(5):
+        single = solve(centers[i])
+        np.testing.assert_allclose(batched.coefficients[i], single.coefficients, atol=1e-8)
+
+
+def test_lbfgs_tracking():
+    vg, _ = quadratic([1.0, 1.0], [1.0, 1.0])
+    res = minimize_lbfgs(vg, jnp.zeros(2), max_iterations=50, track_states=True)
+    vals = np.asarray(res.tracked_values)
+    vals = vals[~np.isnan(vals)]
+    assert len(vals) >= 2 and vals[0] >= vals[-1]
+    assert np.all(np.diff(vals) <= 1e-12)  # monotone non-increasing
+
+
+# ---------------------------------------------------------------- OWLQN
+
+
+def test_owlqn_lasso_soft_threshold():
+    """min 1/2||x - b||^2 + l1 ||x||_1 has the closed-form soft-threshold solution."""
+    b = jnp.asarray([3.0, -0.5, 0.2, -4.0])
+    l1 = 1.0
+    vg = lambda x: (0.5 * jnp.sum((x - b) ** 2), x - b)
+    res = minimize_owlqn(vg, jnp.zeros(4), l1, tolerance=1e-12, max_iterations=200)
+    expected = np.sign(np.asarray(b)) * np.maximum(np.abs(np.asarray(b)) - l1, 0.0)
+    np.testing.assert_allclose(res.coefficients, expected, atol=1e-6)
+
+
+def test_owlqn_produces_sparsity(rng):
+    X = rng.normal(size=(100, 10))
+    w_true = np.zeros(10)
+    w_true[:3] = [2.0, -3.0, 1.5]
+    y = (X @ w_true + 0.1 * rng.normal(size=100) > 0).astype(float)
+    data = LabeledData.build(X, y)
+    obj = GLMObjective(logistic_loss)
+    vg = make_value_and_grad(obj, data)
+    res = minimize_owlqn(vg, jnp.zeros(10), 5.0, max_iterations=200)
+    coefs = np.asarray(res.coefficients)
+    assert (np.abs(coefs) < 1e-8).sum() >= 4, coefs
+    assert np.abs(coefs).max() > 0  # not everything killed
+
+
+def test_owlqn_zero_l1_matches_lbfgs(rng):
+    X = rng.normal(size=(60, 5))
+    y = (rng.uniform(size=60) > 0.5).astype(float)
+    data = LabeledData.build(X, y)
+    vg = make_value_and_grad(GLMObjective(logistic_loss), data, l2_weight=0.5)
+    r1 = minimize_owlqn(vg, jnp.zeros(5), 0.0, tolerance=1e-12, max_iterations=300)
+    r2 = minimize_lbfgs(vg, jnp.zeros(5), tolerance=1e-12, max_iterations=300)
+    np.testing.assert_allclose(r1.coefficients, r2.coefficients, atol=1e-4)
+
+
+# ---------------------------------------------------------------- LBFGSB
+
+
+def test_lbfgsb_box_constrained_quadratic():
+    vg, _ = quadratic([2.0, -3.0], [1.0, 1.0])
+    res = minimize_lbfgsb(vg, jnp.zeros(2), jnp.asarray([-1.0, -1.0]), jnp.asarray([1.0, 1.0]), max_iterations=100)
+    np.testing.assert_allclose(res.coefficients, [1.0, -1.0], atol=1e-6)
+
+
+def test_lbfgsb_interior_matches_unconstrained():
+    vg, _ = quadratic([0.3, -0.2], [2.0, 5.0])
+    res = minimize_lbfgsb(vg, jnp.zeros(2), -jnp.ones(2), jnp.ones(2), tolerance=1e-12)
+    np.testing.assert_allclose(res.coefficients, [0.3, -0.2], atol=1e-7)
+
+
+def test_lbfgsb_matches_scipy(rng):
+    X = rng.normal(size=(80, 4))
+    y = (rng.uniform(size=80) > 0.4).astype(float)
+    data = LabeledData.build(X, y)
+    vg = make_value_and_grad(GLMObjective(logistic_loss), data, l2_weight=0.1)
+    lo, hi = -0.2 * np.ones(4), 0.15 * np.ones(4)
+    res = minimize_lbfgsb(vg, jnp.zeros(4), jnp.asarray(lo), jnp.asarray(hi), tolerance=1e-12, max_iterations=300)
+    ref = scipy.optimize.minimize(
+        lambda w: np.asarray(vg(jnp.asarray(w))[0], dtype=float),
+        np.zeros(4),
+        jac=lambda w: np.asarray(vg(jnp.asarray(w))[1], dtype=float),
+        method="L-BFGS-B",
+        bounds=list(zip(lo, hi)),
+        tol=1e-14,
+    )
+    np.testing.assert_allclose(res.coefficients, ref.x, atol=5e-4)
+
+
+# ---------------------------------------------------------------- TRON
+
+
+def test_tron_quadratic_one_iteration():
+    vg, hvp = quadratic([1.0, -1.0, 2.0], [1.0, 2.0, 3.0])
+    res = minimize_tron(vg, hvp, jnp.zeros(3), tolerance=1e-10)
+    np.testing.assert_allclose(res.coefficients, [1.0, -1.0, 2.0], atol=1e-6)
+
+
+def test_tron_logistic_matches_lbfgs(rng):
+    X = rng.normal(size=(150, 5))
+    X[:, -1] = 1.0
+    w_true = rng.normal(size=5)
+    y = (X @ w_true > 0).astype(float)
+    data = LabeledData.build(X, y)
+    obj = GLMObjective(logistic_loss)
+    vg = make_value_and_grad(obj, data, l2_weight=1.0)
+    hvp = lambda x, v: obj.hessian_vector(data, x, v, 1.0)
+    r_tron = minimize_tron(vg, hvp, jnp.zeros(5), tolerance=1e-10, max_iterations=50)
+    r_lbfgs = minimize_lbfgs(vg, jnp.zeros(5), tolerance=1e-12, max_iterations=300)
+    np.testing.assert_allclose(r_tron.coefficients, r_lbfgs.coefficients, atol=1e-4)
+
+
+def test_tron_poisson(rng):
+    X = rng.normal(size=(200, 4)) * 0.5
+    X[:, -1] = 1.0
+    w_true = np.asarray([0.5, -0.3, 0.2, 0.1])
+    lam = np.exp(X @ w_true)
+    y = rng.poisson(lam).astype(float)
+    data = LabeledData.build(X, y)
+    obj = GLMObjective(poisson_loss)
+    vg = make_value_and_grad(obj, data, l2_weight=1e-3)
+    hvp = lambda x, v: obj.hessian_vector(data, x, v, 1e-3)
+    res = minimize_tron(vg, hvp, jnp.zeros(4), tolerance=1e-10, max_iterations=100)
+    # gradient at the solution should be ~0
+    g = np.asarray(vg(res.coefficients)[1])
+    assert np.linalg.norm(g) < 1e-4 * max(1.0, np.linalg.norm(np.asarray(vg(jnp.zeros(4))[1])))
+
+
+def test_tron_vmap(rng):
+    centers = jnp.asarray(rng.normal(size=(4, 3)))
+
+    def solve(center):
+        vg = lambda x: (0.5 * jnp.sum((x - center) ** 2), x - center)
+        hvp = lambda x, v: v
+        return minimize_tron(vg, hvp, jnp.zeros(3), max_iterations=30)
+
+    batched = jax.vmap(solve)(centers)
+    np.testing.assert_allclose(batched.coefficients, centers, atol=1e-6)
+
+
+# ---------------------------------------------------------------- factory
+
+
+@pytest.mark.parametrize("opt_type", list(OptimizerType))
+def test_factory_dispatch(rng, opt_type):
+    X = rng.normal(size=(50, 3))
+    y = (rng.uniform(size=50) > 0.5).astype(float)
+    data = LabeledData.build(X, y)
+    obj = GLMObjective(logistic_loss)
+    vg = make_value_and_grad(obj, data, l2_weight=0.5)
+    cfg = OptimizerConfig(optimizer_type=opt_type, max_iterations=100, tolerance=1e-10)
+    minimize = build_minimizer(cfg)
+    kwargs = {}
+    if opt_type == OptimizerType.TRON:
+        kwargs["hvp"] = lambda x, v: obj.hessian_vector(data, x, v, 0.5)
+    if opt_type == OptimizerType.LBFGSB:
+        kwargs["lower_bounds"] = -jnp.ones(3)
+        kwargs["upper_bounds"] = jnp.ones(3)
+    if opt_type == OptimizerType.OWLQN:
+        kwargs["l1_weight"] = 0.01
+    res = minimize(vg, jnp.zeros(3), **kwargs)
+    assert res.converged
+    g = np.asarray(res.gradient)
+    assert np.isfinite(np.asarray(res.value)) and np.isfinite(g).all()
+
+
+# ------------------------------------------------- regression: review findings
+
+
+def test_tron_with_bounds_value_matches_coefficients():
+    """f/g must be evaluated at the projected iterate (not the unprojected trial)."""
+    vg, hvp = quadratic([2.0, -3.0], [1.0, 1.0])
+    lo, hi = -jnp.ones(2), jnp.ones(2)
+    res = minimize_tron(vg, hvp, jnp.zeros(2), lower_bounds=lo, upper_bounds=hi, max_iterations=50)
+    f_at_x = float(vg(res.coefficients)[0])
+    np.testing.assert_allclose(float(res.value), f_at_x, rtol=1e-10)
+    assert np.all(np.asarray(res.coefficients) >= -1.0 - 1e-12)
+    assert np.all(np.asarray(res.coefficients) <= 1.0 + 1e-12)
+
+
+@pytest.mark.parametrize("opt_type", list(OptimizerType))
+def test_warm_start_at_optimum_converges_immediately(opt_type):
+    """Starting at an exact stationary point must report GRADIENT_CONVERGED, 0 iters."""
+    center = jnp.asarray([1.0, -2.0])
+    vg = lambda x: (0.5 * jnp.sum((x - center) ** 2), x - center)
+    kwargs = {}
+    if opt_type == OptimizerType.TRON:
+        res = minimize_tron(vg, lambda x, v: v, center)
+    elif opt_type == OptimizerType.LBFGSB:
+        res = minimize_lbfgsb(vg, center, -5 * jnp.ones(2), 5 * jnp.ones(2))
+    elif opt_type == OptimizerType.OWLQN:
+        res = minimize_owlqn(vg, center, 0.0)
+    else:
+        res = minimize_lbfgs(vg, center)
+    assert int(res.convergence_reason) == ConvergenceReason.GRADIENT_CONVERGED
+    assert int(res.iterations) == 0
+    np.testing.assert_allclose(res.coefficients, center)
+
+
+def test_factory_rejects_silent_drops(rng):
+    vg = lambda x: (0.5 * jnp.sum(x**2), x)
+    with pytest.raises(ValueError, match="OWLQN"):
+        build_minimizer(OptimizerConfig(optimizer_type=OptimizerType.LBFGS))(vg, jnp.zeros(2), l1_weight=0.5)
+    with pytest.raises(ValueError, match="box"):
+        build_minimizer(OptimizerConfig(optimizer_type=OptimizerType.OWLQN))(
+            vg, jnp.zeros(2), l1_weight=0.1, lower_bounds=-jnp.ones(2)
+        )
+
+
+def test_lbfgsb_skipped_pairs_keep_history_consistent():
+    """Projection steps that yield s.y <= 0 must not desynchronize the (s, y) slots.
+
+    Optimum far outside the box: iterates pin to the corner quickly (zero steps ->
+    skipped pairs), then the solver must still terminate at the corner.
+    """
+    vg, _ = quadratic([10.0, 10.0, -10.0], [1.0, 2.0, 3.0])
+    res = minimize_lbfgsb(
+        vg, jnp.zeros(3), -jnp.ones(3), jnp.ones(3), max_iterations=60, history_length=3
+    )
+    np.testing.assert_allclose(res.coefficients, [1.0, 1.0, -1.0], atol=1e-8)
+    assert res.converged
