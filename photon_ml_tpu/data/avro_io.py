@@ -1,0 +1,433 @@
+"""Pure-Python Avro object-container codec + the Photon data contracts.
+
+The reference ships 8 Avro schemas (photon-avro-schemas/src/main/avro/, compiled to
+Java) and reads/writes them through Spark + avro-mapred (photon-client
+data/avro/AvroDataReader.scala, AvroUtils.scala, ModelProcessingUtils.scala). This
+environment has no avro library, so this module implements the Avro 1.x binary
+encoding and object-container file format directly (spec: zigzag varints, IEEE
+doubles, block-structured arrays/maps, union index prefix, 'Obj\\x01' container with
+deflate/null codecs) — giving byte-compatible data and model files so models can be
+exchanged with the reference.
+
+Schemas below are re-declared from the reference's .avsc contracts
+(photon-avro-schemas/src/main/avro/*.avsc; see SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Iterable, Iterator
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+DEFAULT_SYNC = b"\x8a\x14\x1b\x90photon-tpu!!"  # 16 bytes, arbitrary but fixed
+assert len(DEFAULT_SYNC) == SYNC_SIZE
+
+
+# --------------------------------------------------------------------- encoding
+
+
+def _zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_long(buf: io.BytesIO, n: int) -> None:
+    n = _zigzag_encode(n)
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def read_long(buf) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        byte = buf.read(1)
+        if not byte:
+            raise EOFError("unexpected EOF in varint")
+        b = byte[0]
+        acc |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return _zigzag_decode(acc)
+        shift += 7
+
+
+def write_bytes(buf, data: bytes) -> None:
+    write_long(buf, len(data))
+    buf.write(data)
+
+
+def read_bytes(buf) -> bytes:
+    n = read_long(buf)
+    return buf.read(n)
+
+
+# --------------------------------------------------------------------- schema
+
+
+class Schema:
+    """Parsed Avro schema with a named-type registry (handles schema references)."""
+
+    def __init__(self, schema_json):
+        self.names: dict[str, Any] = {}
+        self.root = self._resolve(schema_json)
+
+    def _resolve(self, s):
+        if isinstance(s, str):
+            if s in ("null", "boolean", "int", "long", "float", "double", "bytes", "string"):
+                return s
+            full = s if "." in s else s
+            for key in (full, f"com.linkedin.photon.avro.generated.{s}"):
+                if key in self.names:
+                    return self.names[key]
+            raise ValueError(f"Unknown Avro type reference: {s}")
+        if isinstance(s, list):  # union
+            return ["union"] + [self._resolve(x) for x in s]
+        if isinstance(s, dict):
+            t = s["type"]
+            if t == "record":
+                namespace = s.get("namespace", "")
+                fullname = f"{namespace}.{s['name']}" if namespace else s["name"]
+                rec = {"type": "record", "name": s["name"], "fullname": fullname, "fields": []}
+                self.names[fullname] = rec
+                self.names[s["name"]] = rec
+                for f in s["fields"]:
+                    rec["fields"].append(
+                        {"name": f["name"], "type": self._resolve(f["type"]), "default": f.get("default")}
+                    )
+                return rec
+            if t == "array":
+                return {"type": "array", "items": self._resolve(s["items"])}
+            if t == "map":
+                return {"type": "map", "values": self._resolve(s["values"])}
+            if t in ("null", "boolean", "int", "long", "float", "double", "bytes", "string"):
+                return t
+            raise ValueError(f"Unsupported Avro type: {t}")
+        raise ValueError(f"Bad schema node: {s!r}")
+
+
+def _union_branch_index(branches, value):
+    """Pick the union branch for a Python value (null/record/primitive heuristics)."""
+    for i, b in enumerate(branches):
+        if b == "null" and value is None:
+            return i
+    for i, b in enumerate(branches):
+        if b == "null":
+            continue
+        if isinstance(b, dict) and b["type"] == "record" and isinstance(value, dict):
+            return i
+        if isinstance(b, dict) and b["type"] == "array" and isinstance(value, (list, tuple)):
+            return i
+        if isinstance(b, dict) and b["type"] == "map" and isinstance(value, dict):
+            return i
+        if b == "string" and isinstance(value, str):
+            return i
+        if b in ("double", "float") and isinstance(value, (int, float)):
+            return i
+        if b in ("int", "long") and isinstance(value, int):
+            return i
+        if b == "boolean" and isinstance(value, bool):
+            return i
+        if b == "bytes" and isinstance(value, bytes):
+            return i
+    raise ValueError(f"No union branch for {value!r} among {branches}")
+
+
+def encode(buf, schema, value) -> None:
+    if isinstance(schema, str):
+        if schema == "null":
+            return
+        if schema == "boolean":
+            buf.write(b"\x01" if value else b"\x00")
+        elif schema in ("int", "long"):
+            write_long(buf, int(value))
+        elif schema == "float":
+            buf.write(struct.pack("<f", float(value)))
+        elif schema == "double":
+            buf.write(struct.pack("<d", float(value)))
+        elif schema == "string":
+            write_bytes(buf, value.encode("utf-8"))
+        elif schema == "bytes":
+            write_bytes(buf, value)
+        else:
+            raise ValueError(schema)
+        return
+    if isinstance(schema, list):  # union
+        branches = schema[1:]
+        idx = _union_branch_index(branches, value)
+        write_long(buf, idx)
+        encode(buf, branches[idx], value)
+        return
+    t = schema["type"]
+    if t == "record":
+        for f in schema["fields"]:
+            fv = value.get(f["name"], f.get("default"))
+            encode(buf, f["type"], fv)
+    elif t == "array":
+        if value:
+            write_long(buf, len(value))
+            for item in value:
+                encode(buf, schema["items"], item)
+        write_long(buf, 0)
+    elif t == "map":
+        if value:
+            write_long(buf, len(value))
+            for k, v in value.items():
+                write_bytes(buf, k.encode("utf-8"))
+                encode(buf, schema["values"], v)
+        write_long(buf, 0)
+    else:
+        raise ValueError(t)
+
+
+def decode(buf, schema):
+    if isinstance(schema, str):
+        if schema == "null":
+            return None
+        if schema == "boolean":
+            return buf.read(1) == b"\x01"
+        if schema in ("int", "long"):
+            return read_long(buf)
+        if schema == "float":
+            return struct.unpack("<f", buf.read(4))[0]
+        if schema == "double":
+            return struct.unpack("<d", buf.read(8))[0]
+        if schema == "string":
+            return read_bytes(buf).decode("utf-8")
+        if schema == "bytes":
+            return read_bytes(buf)
+        raise ValueError(schema)
+    if isinstance(schema, list):
+        idx = read_long(buf)
+        return decode(buf, schema[1 + idx])
+    t = schema["type"]
+    if t == "record":
+        return {f["name"]: decode(buf, f["type"]) for f in schema["fields"]}
+    if t == "array":
+        out = []
+        while True:
+            count = read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:
+                read_long(buf)  # block byte size, unused
+                count = -count
+            for _ in range(count):
+                out.append(decode(buf, schema["items"]))
+    if t == "map":
+        out = {}
+        while True:
+            count = read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:
+                read_long(buf)
+                count = -count
+            for _ in range(count):
+                k = read_bytes(buf).decode("utf-8")
+                out[k] = decode(buf, schema["values"])
+    raise ValueError(t)
+
+
+# ------------------------------------------------------------ container files
+
+
+def write_container(path: str, schema_json, records: Iterable[dict], codec: str = "deflate",
+                    block_count: int = 4096) -> None:
+    """Write an Avro object-container file (one or more blocks)."""
+    schema = Schema(schema_json)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        meta_buf = io.BytesIO()
+        meta = {
+            "avro.schema": json.dumps(schema_json, separators=(",", ":")).encode(),
+            "avro.codec": codec.encode(),
+        }
+        write_long(meta_buf, len(meta))
+        for k, v in meta.items():
+            write_bytes(meta_buf, k.encode())
+            write_bytes(meta_buf, v)
+        write_long(meta_buf, 0)
+        f.write(meta_buf.getvalue())
+        f.write(DEFAULT_SYNC)
+
+        block: list[dict] = []
+
+        def flush():
+            if not block:
+                return
+            data_buf = io.BytesIO()
+            for rec in block:
+                encode(data_buf, schema.root, rec)
+            payload = data_buf.getvalue()
+            if codec == "deflate":
+                payload = zlib.compress(payload)[2:-4]  # raw deflate (avro strips wrapper)
+            head = io.BytesIO()
+            write_long(head, len(block))
+            write_long(head, len(payload))
+            f.write(head.getvalue())
+            f.write(payload)
+            f.write(DEFAULT_SYNC)
+            block.clear()
+
+        for rec in records:
+            block.append(rec)
+            if len(block) >= block_count:
+                flush()
+        flush()
+
+
+def read_container(path: str) -> Iterator[dict]:
+    """Stream records from an Avro object-container file."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        meta = {}
+        while True:
+            count = read_long(f)
+            if count == 0:
+                break
+            if count < 0:
+                read_long(f)
+                count = -count
+            for _ in range(count):
+                k = read_bytes(f).decode()
+                meta[k] = read_bytes(f)
+        schema_json = json.loads(meta["avro.schema"].decode())
+        codec = meta.get("avro.codec", b"null").decode()
+        schema = Schema(schema_json)
+        sync = f.read(SYNC_SIZE)
+        while True:
+            try:
+                n_records = read_long(f)
+            except EOFError:
+                return
+            payload_len = read_long(f)
+            payload = f.read(payload_len)
+            if codec == "deflate":
+                payload = zlib.decompress(payload, -15)
+            elif codec != "null":
+                raise ValueError(f"Unsupported avro codec: {codec}")
+            buf = io.BytesIO(payload)
+            for _ in range(n_records):
+                yield decode(buf, schema.root)
+            block_sync = f.read(SYNC_SIZE)
+            if block_sync != sync:
+                raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
+
+
+def read_container_dir(path: str) -> Iterator[dict]:
+    """Read all .avro files under a directory (the reference's part-file layout)."""
+    if os.path.isfile(path):
+        yield from read_container(path)
+        return
+    for name in sorted(os.listdir(path)):
+        if name.endswith(".avro"):
+            yield from read_container(os.path.join(path, name))
+
+
+# ------------------------------------------------------- Photon data contracts
+# Re-declared from the reference's photon-avro-schemas/src/main/avro/*.avsc.
+
+NAME_TERM_VALUE_SCHEMA = {
+    "name": "NameTermValueAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+FEATURE_SCHEMA = {
+    "name": "FeatureAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "term", "type": "string"},
+        {"name": "value", "type": "double"},
+    ],
+}
+
+TRAINING_EXAMPLE_SCHEMA = {
+    "name": "TrainingExampleAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "label", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": FEATURE_SCHEMA}},
+        {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+    ],
+}
+
+BAYESIAN_LINEAR_MODEL_SCHEMA = {
+    "name": "BayesianLinearModelAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "modelId", "type": "string"},
+        {"name": "modelClass", "type": ["null", "string"], "default": None},
+        {"name": "means", "type": {"type": "array", "items": NAME_TERM_VALUE_SCHEMA}},
+        {
+            "name": "variances",
+            "type": ["null", {"type": "array", "items": "NameTermValueAvro"}],
+            "default": None,
+        },
+        {"name": "lossFunction", "type": ["null", "string"], "default": None},
+    ],
+}
+
+SCORING_RESULT_SCHEMA = {
+    "name": "ScoringResultAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "label", "type": ["null", "double"], "default": None},
+        {"name": "modelId", "type": "string"},
+        {"name": "predictionScore", "type": "double"},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}], "default": None},
+    ],
+}
+
+RESPONSE_PREDICTION_SCHEMA = {
+    "name": "SimplifiedResponsePrediction",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "response", "type": "double"},
+        {"name": "features", "type": {"type": "array", "items": FEATURE_SCHEMA}},
+        {"name": "weight", "type": "double", "default": 1.0},
+        {"name": "offset", "type": "double", "default": 0.0},
+    ],
+}
+
+FEATURE_SUMMARIZATION_SCHEMA = {
+    "name": "FeatureSummarizationResultAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "featureName", "type": "string"},
+        {"name": "featureTerm", "type": "string"},
+        {"name": "metrics", "type": {"type": "map", "values": "double"}},
+    ],
+}

@@ -55,6 +55,10 @@ class LabeledData:
     def build(X, labels, offsets=None, weights=None, dtype=None) -> "LabeledData":
         Xm = as_design_matrix(X, dtype=dtype)
         labels = jnp.asarray(labels, dtype=dtype)
+        if not jnp.issubdtype(labels.dtype, jnp.floating):
+            # Integer 0/1 labels are common; the solvers' while_loop carries require
+            # a consistent float dtype, so coerce to the feature dtype.
+            labels = labels.astype(Xm.dtype)
         n = labels.shape[0]
         if offsets is None:
             offsets = jnp.zeros(n, dtype=labels.dtype)

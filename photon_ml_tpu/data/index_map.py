@@ -1,0 +1,79 @@
+"""Feature index maps: (name, term) <-> dense column index.
+
+Replaces the reference's IndexMap stack (photon-api index/DefaultIndexMap.scala:98,
+PalDBIndexMap.scala:43-278). The reference needs off-heap PalDB stores because JVM
+heaps choke on billions of feature names; here the map lives host-side only (device
+code sees dense column ids), stored as a sorted name array + offsets in an .npz —
+O(1) array lookup by id, binary search / dict by name. Feature hashing is available
+as an alternative for extreme cardinalities.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+import numpy as np
+
+from photon_ml_tpu.types import DELIMITER, intercept_key
+
+
+def feature_key(name: str, term: str = "") -> str:
+    """Canonical feature key: name + \\x01 + term (reference Constants / AvroUtils)."""
+    return f"{name}{DELIMITER}{term}"
+
+
+class IndexMap:
+    """Bidirectional (feature key <-> index) map for one feature shard."""
+
+    def __init__(self, names: list[str], add_intercept: bool = False):
+        if add_intercept and intercept_key() not in names:
+            names = list(names) + [intercept_key()]
+        self._names = list(names)
+        self._index = {n: i for i, n in enumerate(self._names)}
+        if len(self._index) != len(self._names):
+            raise ValueError("Duplicate feature keys in index map")
+
+    @property
+    def size(self) -> int:
+        return len(self._names)
+
+    @property
+    def intercept_index(self) -> Optional[int]:
+        return self._index.get(intercept_key())
+
+    def get_index(self, key: str) -> int:
+        """-1 for unseen features (reference IndexMap.NULL_KEY semantics)."""
+        return self._index.get(key, -1)
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        return self._names[index] if 0 <= index < len(self._names) else None
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._index
+
+    def __len__(self) -> int:
+        return self.size
+
+    def keys(self):
+        return list(self._names)
+
+    # -- construction ----------------------------------------------------------
+
+    @staticmethod
+    def build(feature_keys: Iterable[str], add_intercept: bool = True) -> "IndexMap":
+        """Build from observed keys, sorted for determinism (FeatureIndexingDriver
+        semantics: distinct (name, term) per shard -> stable indices)."""
+        distinct = sorted(set(feature_keys))
+        return IndexMap(distinct, add_intercept=add_intercept)
+
+    # -- persistence -----------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez_compressed(path, names=np.array(self._names, dtype=object))
+
+    @staticmethod
+    def load(path: str) -> "IndexMap":
+        with np.load(path if path.endswith(".npz") else path + ".npz", allow_pickle=True) as z:
+            return IndexMap([str(n) for n in z["names"]])

@@ -32,6 +32,10 @@ class DenseDesignMatrix:
     values: Array  # [N, D]
 
     @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
     def n_rows(self) -> int:
         return self.values.shape[0]
 
@@ -72,6 +76,10 @@ class SparseDesignMatrix:
     vals: Array  # [nnz] float
     n_rows: int = dataclasses.field(metadata=dict(static=True))
     n_cols: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
 
     def matvec(self, w: Array) -> Array:
         contrib = self.vals * jnp.take(w, self.cols, mode="clip")

@@ -1,0 +1,175 @@
+"""Host-side data readers: Avro training records and LIBSVM text -> array batches.
+
+Replaces the Spark ingest path (photon-client data/avro/AvroDataReader.scala:54-490,
+io/deprecated/GLMSuite + LibSVMInputDataFormat). TPU-first: ingest happens once on
+the host into columnar numpy (then device arrays); there is no lazy RDD layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data import avro_io
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.types import intercept_key
+
+
+@dataclasses.dataclass
+class RawDataset:
+    """Columnar host dataset for one feature shard + response columns.
+
+    ``X`` is scipy CSR (sparse ingest); id_columns carries entity-id strings per
+    sample (the GameDatum idTagToValueMap, reference data/GameDatum.scala:1-74).
+    """
+
+    X: sp.csr_matrix
+    labels: np.ndarray
+    offsets: np.ndarray
+    weights: np.ndarray
+    uids: Optional[np.ndarray] = None
+    id_columns: Optional[dict[str, np.ndarray]] = None
+
+    @property
+    def n(self) -> int:
+        return self.labels.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.X.shape[1]
+
+
+def _records_to_dataset(
+    records,
+    index_map: Optional[IndexMap],
+    add_intercept: bool,
+    id_tags: Sequence[str] = (),
+) -> tuple[RawDataset, IndexMap]:
+    labels, weights, offsets, uids = [], [], [], []
+    rows, cols, vals = [], [], []
+    id_cols: dict[str, list] = {tag: [] for tag in id_tags}
+    all_keys: list[str] = []
+
+    cached = list(records)
+    if index_map is None:
+        for rec in cached:
+            for f in rec["features"]:
+                all_keys.append(feature_key(f["name"], f["term"]))
+        index_map = IndexMap.build(all_keys, add_intercept=add_intercept)
+
+    icpt = index_map.intercept_index
+    for i, rec in enumerate(cached):
+        labels.append(rec.get("label", rec.get("response", 0.0)))
+        w = rec.get("weight")
+        weights.append(1.0 if w is None else w)
+        o = rec.get("offset")
+        offsets.append(0.0 if o is None else o)
+        uids.append(rec.get("uid") or str(i))
+        meta = rec.get("metadataMap") or {}
+        for tag in id_tags:
+            if tag not in meta:
+                raise ValueError(f"Sample {i} missing id tag {tag!r} in metadataMap")
+            id_cols[tag].append(meta[tag])
+        has_explicit_intercept = False
+        for f in rec["features"]:
+            j = index_map.get_index(feature_key(f["name"], f["term"]))
+            if j >= 0:
+                if j == icpt:
+                    has_explicit_intercept = True
+                rows.append(i)
+                cols.append(j)
+                vals.append(f["value"])
+        if icpt is not None and not has_explicit_intercept:
+            rows.append(i)
+            cols.append(icpt)
+            vals.append(1.0)
+
+    n = len(labels)
+    X = sp.csr_matrix(
+        (np.asarray(vals, dtype=np.float64), (rows, cols)), shape=(n, index_map.size)
+    )
+    ds = RawDataset(
+        X=X,
+        labels=np.asarray(labels, dtype=np.float64),
+        offsets=np.asarray(offsets, dtype=np.float64),
+        weights=np.asarray(weights, dtype=np.float64),
+        uids=np.asarray(uids, dtype=object),
+        id_columns={k: np.asarray(v, dtype=object) for k, v in id_cols.items()} or None,
+    )
+    return ds, index_map
+
+
+def read_avro(
+    path: str,
+    index_map: Optional[IndexMap] = None,
+    add_intercept: bool = True,
+    id_tags: Sequence[str] = (),
+) -> tuple[RawDataset, IndexMap]:
+    """Read TrainingExampleAvro / ResponsePredictionAvro files or directories."""
+    return _records_to_dataset(
+        avro_io.read_container_dir(path), index_map, add_intercept, id_tags
+    )
+
+
+def write_training_avro(path: str, dataset_records) -> None:
+    """Write TrainingExampleAvro records (AvroDataWriter equivalent)."""
+    avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, dataset_records)
+
+
+def read_libsvm(
+    path: str,
+    index_map: Optional[IndexMap] = None,
+    add_intercept: bool = True,
+) -> tuple[RawDataset, IndexMap]:
+    """Read LIBSVM text (the a1a tutorial format, README.md:240-305).
+
+    Feature j becomes key ("j", ""); labels <= 0 map to 0.0 (binary convention).
+    """
+    labels = []
+    feats: list[list[tuple[str, float]]] = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split()
+            if not parts:
+                continue
+            y = float(parts[0])
+            labels.append(1.0 if y > 0 else 0.0)
+            row = []
+            for tok in parts[1:]:
+                idx, val = tok.split(":")
+                row.append((feature_key(idx), float(val)))
+            feats.append(row)
+
+    if index_map is None:
+        index_map = IndexMap.build(
+            (k for row in feats for k, _ in row), add_intercept=add_intercept
+        )
+    icpt = index_map.intercept_index
+    rows, cols, vals = [], [], []
+    for i, row in enumerate(feats):
+        has_explicit_intercept = False
+        for k, v in row:
+            j = index_map.get_index(k)
+            if j >= 0:
+                if j == icpt:
+                    has_explicit_intercept = True
+                rows.append(i)
+                cols.append(j)
+                vals.append(v)
+        if icpt is not None and not has_explicit_intercept:
+            rows.append(i)
+            cols.append(icpt)
+            vals.append(1.0)
+    n = len(labels)
+    X = sp.csr_matrix((np.asarray(vals), (rows, cols)), shape=(n, index_map.size))
+    ds = RawDataset(
+        X=X,
+        labels=np.asarray(labels, dtype=np.float64),
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        uids=np.asarray([str(i) for i in range(n)], dtype=object),
+    )
+    return ds, index_map

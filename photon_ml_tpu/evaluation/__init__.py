@@ -1,0 +1,21 @@
+from photon_ml_tpu.evaluation.evaluators import (
+    Evaluator,
+    EvaluatorType,
+    MultiEvaluator,
+    EvaluationSuite,
+    auc_roc,
+    auc_pr,
+    rmse,
+    evaluator_for_type,
+)
+
+__all__ = [
+    "Evaluator",
+    "EvaluatorType",
+    "MultiEvaluator",
+    "EvaluationSuite",
+    "auc_roc",
+    "auc_pr",
+    "rmse",
+    "evaluator_for_type",
+]

@@ -1,0 +1,3 @@
+from photon_ml_tpu.io.model_io import save_game_model, load_game_model, save_glm_model, load_glm_model
+
+__all__ = ["save_game_model", "load_game_model", "save_glm_model", "load_glm_model"]

@@ -1,0 +1,285 @@
+"""GAME model checkpoint I/O in the reference's on-disk layout.
+
+Re-creates ModelProcessingUtils (photon-client data/avro/ModelProcessingUtils.scala:
+59-625) without Spark/HDFS:
+
+  <dir>/model-metadata.json
+  <dir>/fixed-effect/<coordinate>/id-info
+  <dir>/fixed-effect/<coordinate>/coefficients/part-00000.avro   (1 record)
+  <dir>/random-effect/<coordinate>/id-info
+  <dir>/random-effect/<coordinate>/coefficients/part-*.avro      (1 record / entity)
+
+Coefficient records are BayesianLinearModelAvro (means + optional variances as
+name-term-value lists), so checkpoints are byte-compatible with reference tooling.
+Near-zero coefficients can be pruned at save (modelSparsityThreshold,
+GameTrainingDriver.scala:165-168).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data import avro_io
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import (
+    Coefficients,
+    GeneralizedLinearModel,
+    REFERENCE_CLASS_NAMES,
+    task_for_reference_class,
+)
+from photon_ml_tpu.types import DELIMITER, TaskType
+
+FIXED_EFFECT = "fixed-effect"
+RANDOM_EFFECT = "random-effect"
+ID_INFO = "id-info"
+COEFFICIENTS = "coefficients"
+METADATA_FILE = "model-metadata.json"
+
+
+def _split_key(key: str) -> tuple[str, str]:
+    if DELIMITER in key:
+        name, term = key.split(DELIMITER, 1)
+        return name, term
+    return key, ""
+
+
+def _coeffs_to_ntv(means, index_map: IndexMap, sparsity_threshold: float):
+    out = []
+    means = np.asarray(means)
+    for j in np.flatnonzero(np.abs(means) > sparsity_threshold):
+        name, term = _split_key(index_map.get_feature_name(int(j)) or str(int(j)))
+        out.append({"name": name, "term": term, "value": float(means[j])})
+    return out
+
+
+def _ntv_to_coeffs(items, index_map: IndexMap) -> np.ndarray:
+    vec = np.zeros(index_map.size)
+    for it in items:
+        j = index_map.get_index(f"{it['name']}{DELIMITER}{it['term']}")
+        if j >= 0:
+            vec[j] = it["value"]
+    return vec
+
+
+def _glm_record(
+    model_id: str,
+    means,
+    variances,
+    index_map: IndexMap,
+    task: TaskType,
+    sparsity_threshold: float,
+) -> dict:
+    rec = {
+        "modelId": model_id,
+        "modelClass": REFERENCE_CLASS_NAMES[TaskType(task)],
+        "means": _coeffs_to_ntv(means, index_map, sparsity_threshold),
+        "variances": None,
+        "lossFunction": None,
+    }
+    if variances is not None:
+        rec["variances"] = _coeffs_to_ntv(variances, index_map, 0.0)
+    return rec
+
+
+def save_glm_model(
+    path: str,
+    model: GeneralizedLinearModel,
+    index_map: IndexMap,
+    model_id: str = "",
+    sparsity_threshold: float = 0.0,
+) -> None:
+    """Single GLM -> one BayesianLinearModelAvro container file."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    variances = model.coefficients.variances
+    rec = _glm_record(
+        model_id, model.coefficients.means, variances, index_map, model.task, sparsity_threshold
+    )
+    avro_io.write_container(path, avro_io.BAYESIAN_LINEAR_MODEL_SCHEMA, [rec])
+
+
+def load_glm_model(path: str, index_map: IndexMap, dtype=jnp.float32) -> GeneralizedLinearModel:
+    recs = list(avro_io.read_container_dir(path))
+    if len(recs) != 1:
+        raise ValueError(f"{path}: expected 1 model record, found {len(recs)}")
+    rec = recs[0]
+    task = task_for_reference_class(rec.get("modelClass") or "") or TaskType.LINEAR_REGRESSION
+    means = jnp.asarray(_ntv_to_coeffs(rec["means"], index_map), dtype=dtype)
+    variances = rec.get("variances")
+    var = jnp.asarray(_ntv_to_coeffs(variances, index_map), dtype=dtype) if variances else None
+    return GeneralizedLinearModel(Coefficients(means, var), task)
+
+
+def save_game_model(
+    output_dir: str,
+    game_model: GameModel,
+    index_maps: dict[str, IndexMap],
+    sparsity_threshold: float = 0.0,
+    extra_metadata: Optional[dict] = None,
+) -> None:
+    os.makedirs(output_dir, exist_ok=True)
+    n_re = sum(1 for _, m in game_model if isinstance(m, RandomEffectModel))
+    model_type = "RANDOM_EFFECT" if n_re == len(game_model) else (
+        "FIXED_EFFECT" if n_re == 0 else "GAME"
+    )
+    meta = {"modelType": model_type, "coordinates": game_model.coordinate_ids}
+    if extra_metadata:
+        meta.update(extra_metadata)
+    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
+        json.dump(meta, f, indent=2)
+
+    for coord_id, model in game_model:
+        index_map = index_maps[coord_id]
+        if isinstance(model, FixedEffectModel):
+            base = os.path.join(output_dir, FIXED_EFFECT, coord_id)
+            os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(base, ID_INFO), "w") as f:
+                json.dump({"featureShardId": model.feature_shard_id}, f)
+            glm = model.model
+            rec = _glm_record(
+                coord_id, glm.coefficients.means, glm.coefficients.variances,
+                index_map, glm.task, sparsity_threshold,
+            )
+            avro_io.write_container(
+                os.path.join(base, COEFFICIENTS, "part-00000.avro"),
+                avro_io.BAYESIAN_LINEAR_MODEL_SCHEMA,
+                [rec],
+            )
+        elif isinstance(model, RandomEffectModel):
+            base = os.path.join(output_dir, RANDOM_EFFECT, coord_id)
+            os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(base, ID_INFO), "w") as f:
+                json.dump(
+                    {"randomEffectType": model.re_type, "featureShardId": model.feature_shard_id},
+                    f,
+                )
+
+            coeffs = np.asarray(model.coeffs)
+            variances = None if model.variances is None else np.asarray(model.variances)
+            proj = np.asarray(model.proj_indices)
+
+            def entity_records():
+                for row, entity_id in enumerate(model.entity_ids):
+                    means, var_list = [], []
+                    for k in range(proj.shape[1]):
+                        j = int(proj[row, k])
+                        # variances stay aligned with the surviving means (reference
+                        # prunes both together at save)
+                        if j < 0 or abs(coeffs[row, k]) <= sparsity_threshold:
+                            continue
+                        name, term = _split_key(index_map.get_feature_name(j) or str(j))
+                        means.append({"name": name, "term": term, "value": float(coeffs[row, k])})
+                        if variances is not None:
+                            var_list.append(
+                                {"name": name, "term": term, "value": float(variances[row, k])}
+                            )
+                    yield {
+                        "modelId": str(entity_id),
+                        "modelClass": REFERENCE_CLASS_NAMES[TaskType(model.task)],
+                        "means": means,
+                        "variances": var_list if variances is not None else None,
+                        "lossFunction": None,
+                    }
+
+            avro_io.write_container(
+                os.path.join(base, COEFFICIENTS, "part-00000.avro"),
+                avro_io.BAYESIAN_LINEAR_MODEL_SCHEMA,
+                entity_records(),
+            )
+        else:
+            raise TypeError(f"Unknown model type for coordinate {coord_id}: {type(model)}")
+
+
+def load_game_model(
+    input_dir: str,
+    index_maps: dict[str, IndexMap],
+    dtype=jnp.float32,
+) -> GameModel:
+    """Load a GAME model saved by save_game_model (or the reference's layout).
+
+    Random-effect coordinates are rebuilt with per-entity index projections over the
+    union of each entity's non-zero features.
+    """
+    models: dict[str, object] = {}
+
+    fe_dir = os.path.join(input_dir, FIXED_EFFECT)
+    if os.path.isdir(fe_dir):
+        for coord_id in sorted(os.listdir(fe_dir)):
+            base = os.path.join(fe_dir, coord_id)
+            index_map = index_maps[coord_id]
+            with open(os.path.join(base, ID_INFO)) as f:
+                id_info = json.load(f)
+            glm = load_glm_model(os.path.join(base, COEFFICIENTS), index_map, dtype)
+            models[coord_id] = FixedEffectModel(glm, id_info.get("featureShardId", "global"))
+
+    re_dir = os.path.join(input_dir, RANDOM_EFFECT)
+    if os.path.isdir(re_dir):
+        for coord_id in sorted(os.listdir(re_dir)):
+            base = os.path.join(re_dir, coord_id)
+            index_map = index_maps[coord_id]
+            with open(os.path.join(base, ID_INFO)) as f:
+                id_info = json.load(f)
+            recs = list(avro_io.read_container_dir(os.path.join(base, COEFFICIENTS)))
+            entity_ids, rows, var_rows, proj_rows = [], [], [], []
+            task = TaskType.LINEAR_REGRESSION
+            max_k = 1
+            parsed = []
+            for rec in recs:
+                task = task_for_reference_class(rec.get("modelClass") or "") or task
+                cols = [
+                    index_map.get_index(f"{m['name']}{DELIMITER}{m['term']}")
+                    for m in rec["means"]
+                ]
+                vals = [m["value"] for m in rec["means"]]
+                keep = [(c, v) for c, v in zip(cols, vals) if c >= 0]
+                var_by_col = {}
+                for m in rec.get("variances") or []:
+                    c = index_map.get_index(f"{m['name']}{DELIMITER}{m['term']}")
+                    if c >= 0:
+                        var_by_col[c] = m["value"]
+                parsed.append((rec["modelId"], keep, var_by_col))
+                max_k = max(max_k, len(keep))
+            for entity_id, keep, var_by_col in parsed:
+                entity_ids.append(entity_id)
+                coeff_row = np.zeros(max_k)
+                proj_row = np.full(max_k, -1, dtype=np.int32)
+                var_row = np.zeros(max_k)
+                for k, (c, v) in enumerate(keep):
+                    coeff_row[k] = v
+                    proj_row[k] = c
+                    var_row[k] = var_by_col.get(c, 0.0)
+                rows.append(coeff_row)
+                proj_rows.append(proj_row)
+                var_rows.append(var_row)
+            has_vars = any(v for _, _, v in parsed)
+            models[coord_id] = RandomEffectModel(
+                re_type=id_info.get("randomEffectType", coord_id),
+                feature_shard_id=id_info.get("featureShardId", "global"),
+                task=task,
+                entity_ids=tuple(entity_ids),
+                coeffs=jnp.asarray(np.stack(rows) if rows else np.zeros((0, 1)), dtype=dtype),
+                proj_indices=jnp.asarray(
+                    np.stack(proj_rows) if proj_rows else np.full((0, 1), -1, np.int32)
+                ),
+                variances=(
+                    jnp.asarray(np.stack(var_rows), dtype=dtype) if has_vars and var_rows else None
+                ),
+            )
+
+    # Preserve metadata coordinate order when available.
+    meta_path = os.path.join(input_dir, METADATA_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            order = json.load(f).get("coordinates", [])
+        ordered = {c: models[c] for c in order if c in models}
+        for c, m in models.items():
+            if c not in ordered:
+                ordered[c] = m
+        models = ordered
+
+    return GameModel(models=models)

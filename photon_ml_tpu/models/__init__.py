@@ -1,0 +1,23 @@
+from photon_ml_tpu.models.glm import (
+    Coefficients,
+    GeneralizedLinearModel,
+    LogisticRegressionModel,
+    LinearRegressionModel,
+    PoissonRegressionModel,
+    SmoothedHingeLossLinearSVMModel,
+    model_class_for_task,
+)
+from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel, GameModel
+
+__all__ = [
+    "Coefficients",
+    "GeneralizedLinearModel",
+    "LogisticRegressionModel",
+    "LinearRegressionModel",
+    "PoissonRegressionModel",
+    "SmoothedHingeLossLinearSVMModel",
+    "model_class_for_task",
+    "FixedEffectModel",
+    "RandomEffectModel",
+    "GameModel",
+]

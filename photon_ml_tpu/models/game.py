@@ -1,0 +1,141 @@
+"""GAME model containers: fixed-effect, random-effect, and the combined GameModel.
+
+Mirrors photon-lib model/GameModel.scala:32-168, photon-api model/FixedEffectModel.scala
+and model/RandomEffectModel.scala:36-304, re-shaped for TPU:
+
+- FixedEffectModel: one GLM per feature shard (the reference broadcasts it; here the
+  coefficients are just a replicated device array).
+- RandomEffectModel: per-entity coefficient rows in a dense [E, K] matrix in each
+  entity's PROJECTED feature space, plus [E, K] global-column ids (the projection).
+  The reference keeps an RDD[(REId, GLM)] and scores via joins; here scoring is a
+  gather + batched dot over the sample axis.
+- GameModel: ordered coordinate -> model map; total score = sum of coordinate scores
+  over the global sample axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.types import ModelType, TaskType
+
+Array = jnp.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """Global GLM for one feature shard (FixedEffectModel.scala:146)."""
+
+    model: GeneralizedLinearModel
+    feature_shard_id: str = "global"
+
+    @property
+    def model_type(self) -> ModelType:
+        return ModelType.FIXED_EFFECT
+
+    @property
+    def task(self) -> TaskType:
+        return self.model.task
+
+    def score_dataset(self, dataset) -> Array:
+        """Score a FixedEffectDataset (margins WITHOUT its offsets: coordinate scores
+        exclude offsets so they can be summed across coordinates)."""
+        return dataset.data.X.matvec(self.model.coefficients.means)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity GLMs as one dense coefficient matrix (RandomEffectModel.scala:36-304).
+
+    coeffs[e] are entity e's coefficients in its projected space; proj_indices[e, k]
+    is the global column id of local slot k (-1 = padding). Unseen entities score 0
+    (the reference's behavior for entities without a model).
+    """
+
+    re_type: str  # entity id column, e.g. "userId"
+    feature_shard_id: str
+    task: TaskType
+    entity_ids: tuple  # length E, position = row in coeffs
+    coeffs: Array  # [E, K]
+    proj_indices: Array  # [E, K] int32 global col ids, -1 pad
+    variances: Optional[Array] = None  # [E, K]
+
+    def __post_init__(self):
+        object.__setattr__(self, "_row_by_entity", {e: i for i, e in enumerate(self.entity_ids)})
+
+    @property
+    def model_type(self) -> ModelType:
+        return ModelType.RANDOM_EFFECT
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.entity_ids)
+
+    def row_for_entity(self, entity_id) -> int:
+        """-1 if the entity has no model."""
+        return self._row_by_entity.get(entity_id, -1)
+
+    def coefficients_for_entity(self, entity_id) -> Optional[np.ndarray]:
+        row = self.row_for_entity(entity_id)
+        return None if row < 0 else np.asarray(self.coeffs[row])
+
+    def score_dataset(self, dataset) -> Array:
+        """Score a RandomEffectDataset-like object exposing per-sample projected
+        features: ``scoring_view(self)`` -> (entity_rows [N], local_cols [N, nnz],
+        vals [N, nnz]) where local_cols index into this model's K axis (-1 = pad)."""
+        entity_rows, local_cols, vals = dataset.scoring_view(self)
+        has_model = entity_rows >= 0
+        safe_rows = jnp.maximum(entity_rows, 0)
+        w = self.coeffs[safe_rows]  # [N, K]
+        safe_cols = jnp.maximum(local_cols, 0)
+        gathered = jnp.take_along_axis(w, safe_cols, axis=1)  # [N, nnz]
+        gathered = jnp.where(local_cols >= 0, gathered, 0.0)
+        scores = jnp.sum(gathered * vals, axis=1)
+        return jnp.where(has_model, scores, 0.0)
+
+    def update_entities(self, new_coeffs: Array, variances: Optional[Array] = None) -> "RandomEffectModel":
+        return dataclasses.replace(self, coeffs=new_coeffs, variances=variances)
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Ordered coordinateId -> model (GameModel.scala:32-168)."""
+
+    models: Mapping[str, object]  # str -> FixedEffectModel | RandomEffectModel
+
+    def get_model(self, coordinate_id: str):
+        return self.models.get(coordinate_id)
+
+    def update_model(self, coordinate_id: str, model) -> "GameModel":
+        if coordinate_id not in self.models:
+            raise KeyError(f"Unknown coordinate {coordinate_id}")
+        old = self.models[coordinate_id]
+        if type(old) is not type(model):
+            raise TypeError(
+                f"Coordinate {coordinate_id}: cannot replace {type(old).__name__} "
+                f"with {type(model).__name__} (GameModel type-consistency check)"
+            )
+        new = dict(self.models)
+        new[coordinate_id] = model
+        return GameModel(models=new)
+
+    @property
+    def coordinate_ids(self) -> list[str]:
+        return list(self.models.keys())
+
+    @property
+    def task(self) -> TaskType:
+        for m in self.models.values():
+            return m.task
+        raise ValueError("Empty GAME model")
+
+    def __iter__(self):
+        return iter(self.models.items())
+
+    def __len__(self):
+        return len(self.models)

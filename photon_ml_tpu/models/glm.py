@@ -1,0 +1,125 @@
+"""GLM model classes: Coefficients + per-task models.
+
+Mirrors the reference's model hierarchy — Coefficients (photon-lib
+model/Coefficients.scala:31-141), GeneralizedLinearModel and its four task
+subclasses (photon-api supervised/**, e.g. LogisticRegressionModel.scala:154) —
+as thin pytree wrappers around jnp arrays. Scoring is a design-matrix matvec;
+``predict`` applies the task's mean function (link inverse).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.data.matrix import DesignMatrix
+from photon_ml_tpu.function.losses import mean_function_for_task
+from photon_ml_tpu.types import TaskType
+
+Array = jnp.ndarray
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """Model coefficients: means + optional variances (Coefficients.scala:31-141)."""
+
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def compute_score(self, X: DesignMatrix) -> Array:
+        """Dot-product scores for a batch (computeScore, Coefficients.scala:53-59)."""
+        return X.matvec(self.means)
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros((dim,), dtype=dtype))
+
+
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """A trained GLM for one task (GeneralizedLinearModel.scala:168)."""
+
+    coefficients: Coefficients
+    task: TaskType
+
+    def score(self, data: LabeledData) -> Array:
+        """Raw margin including offsets (scoring contract for coordinate descent)."""
+        return data.X.matvec(self.coefficients.means) + data.offsets
+
+    def score_features(self, X: DesignMatrix) -> Array:
+        return self.coefficients.compute_score(X)
+
+    def predict(self, X: DesignMatrix, offsets: Optional[Array] = None) -> Array:
+        """Mean response: link-inverse of margin (sigmoid / identity / exp)."""
+        z = self.coefficients.compute_score(X)
+        if offsets is not None:
+            z = z + offsets
+        return mean_function_for_task(self.task)(z)
+
+    def classify(self, X: DesignMatrix, threshold: float = 0.5) -> Array:
+        if not TaskType(self.task).is_classification:
+            raise ValueError(f"{self.task} is not a classification task")
+        return (self.predict(X) > threshold).astype(jnp.int32)
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.coefficients.means)
+
+
+class LogisticRegressionModel(GeneralizedLinearModel):
+    def __init__(self, coefficients: Coefficients):
+        super().__init__(coefficients, TaskType.LOGISTIC_REGRESSION)
+
+
+class LinearRegressionModel(GeneralizedLinearModel):
+    def __init__(self, coefficients: Coefficients):
+        super().__init__(coefficients, TaskType.LINEAR_REGRESSION)
+
+
+class PoissonRegressionModel(GeneralizedLinearModel):
+    def __init__(self, coefficients: Coefficients):
+        super().__init__(coefficients, TaskType.POISSON_REGRESSION)
+
+
+class SmoothedHingeLossLinearSVMModel(GeneralizedLinearModel):
+    def __init__(self, coefficients: Coefficients):
+        super().__init__(coefficients, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)
+
+
+_MODEL_CLASSES = {
+    TaskType.LOGISTIC_REGRESSION: LogisticRegressionModel,
+    TaskType.LINEAR_REGRESSION: LinearRegressionModel,
+    TaskType.POISSON_REGRESSION: PoissonRegressionModel,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SmoothedHingeLossLinearSVMModel,
+}
+
+# Reference fully-qualified class names, used in BayesianLinearModelAvro.modelClass
+# for cross-framework model exchange (ModelProcessingUtils semantics).
+REFERENCE_CLASS_NAMES = {
+    TaskType.LOGISTIC_REGRESSION: "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION: "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION: "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_TASK_BY_CLASS_NAME = {v: k for k, v in REFERENCE_CLASS_NAMES.items()}
+
+
+def model_class_for_task(task: TaskType):
+    return _MODEL_CLASSES[TaskType(task)]
+
+
+def task_for_reference_class(class_name: str) -> Optional[TaskType]:
+    return _TASK_BY_CLASS_NAME.get(class_name)

@@ -1,0 +1,88 @@
+"""Optimization configuration objects.
+
+Mirrors the reference config stack: RegularizationContext (photon-lib
+optimization/RegularizationContext.scala:38-134 — the alpha split of lambda for
+elastic net), GLMOptimizationConfiguration / FixedEffect- / RandomEffect-
+OptimizationConfiguration (photon-api optimization/game/
+CoordinateOptimizationConfiguration.scala:34-99), VarianceComputationType
+(VarianceComputationType.scala:25).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.types import RegularizationType, VarianceComputationType
+
+
+@dataclasses.dataclass(frozen=True)
+class RegularizationContext:
+    """L1/L2 weight split: for ELASTIC_NET with mixing alpha,
+    l1 = alpha * lambda, l2 = (1 - alpha) * lambda (RegularizationContext.scala:59-88)."""
+
+    regularization_type: RegularizationType = RegularizationType.NONE
+    elastic_net_alpha: Optional[float] = None
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "regularization_type", RegularizationType(self.regularization_type)
+        )
+        t, a = self.regularization_type, self.elastic_net_alpha
+        if t == RegularizationType.ELASTIC_NET:
+            if a is None or not (0.0 <= a <= 1.0):
+                raise ValueError(f"ELASTIC_NET requires alpha in [0, 1], got {a}")
+        elif a is not None:
+            raise ValueError(f"alpha is only valid for ELASTIC_NET, not {t}")
+
+    def l1_weight(self, reg_weight: float) -> float:
+        t = self.regularization_type
+        if t == RegularizationType.L1:
+            return reg_weight
+        if t == RegularizationType.ELASTIC_NET:
+            return self.elastic_net_alpha * reg_weight
+        return 0.0
+
+    def l2_weight(self, reg_weight: float) -> float:
+        t = self.regularization_type
+        if t == RegularizationType.L2:
+            return reg_weight
+        if t == RegularizationType.ELASTIC_NET:
+            return (1.0 - self.elastic_net_alpha) * reg_weight
+        return 0.0
+
+
+NO_REGULARIZATION = RegularizationContext()
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMOptimizationConfiguration:
+    """Optimizer + regularization + weight for one coordinate solve."""
+
+    optimizer_config: OptimizerConfig = OptimizerConfig()
+    regularization_context: RegularizationContext = NO_REGULARIZATION
+    regularization_weight: float = 0.0
+
+    def with_weight(self, w: float) -> "GLMOptimizationConfiguration":
+        return dataclasses.replace(self, regularization_weight=w)
+
+    @property
+    def l1_weight(self) -> float:
+        return self.regularization_context.l1_weight(self.regularization_weight)
+
+    @property
+    def l2_weight(self) -> float:
+        return self.regularization_context.l2_weight(self.regularization_weight)
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectOptimizationConfiguration(GLMOptimizationConfiguration):
+    """+ negative down-sampling rate (CoordinateOptimizationConfiguration.scala:55-72)."""
+
+    down_sampling_rate: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectOptimizationConfiguration(GLMOptimizationConfiguration):
+    pass

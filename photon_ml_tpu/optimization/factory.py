@@ -36,7 +36,10 @@ def build_minimizer(config: OptimizerConfig):
         lower_bounds: Optional[Array] = None,
         upper_bounds: Optional[Array] = None,
     ) -> OptResult:
-        has_l1 = not (isinstance(l1_weight, (int, float)) and l1_weight == 0.0)
+        try:
+            has_l1 = float(l1_weight) != 0.0
+        except TypeError:  # traced/abstract value: assume an L1 term is intended
+            has_l1 = True
         if has_l1 and opt != OptimizerType.OWLQN:
             raise ValueError(
                 f"L1 regularization requires OWLQN; {opt.value} would silently ignore it"

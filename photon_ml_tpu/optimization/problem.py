@@ -1,0 +1,122 @@
+"""GLM optimization problems: config + objective -> trained Coefficients.
+
+Replaces GeneralizedLinearOptimizationProblem / DistributedOptimizationProblem /
+SingleNodeOptimizationProblem (photon-api optimization/*.scala). The distributed/
+single-node split disappears: the same jitted solve handles both — sharding of the
+input arrays decides where it runs. Variance computation follows
+DistributedOptimizationProblem.computeVariances:84-108: SIMPLE = 1/diag(H),
+FULL = diag(H^-1) via Cholesky (util/Linalg.choleskyInverse equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.function.losses import loss_for_task
+from photon_ml_tpu.function.objective import GLMObjective
+from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.normalization import NO_NORMALIZATION, NormalizationContext
+from photon_ml_tpu.optimization.common import OptResult
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optimization.factory import build_minimizer
+from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
+
+Array = jnp.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMOptimizationProblem:
+    """One (task, optimizer, regularization, normalization) problem specification."""
+
+    task: TaskType
+    configuration: GLMOptimizationConfiguration
+    normalization: NormalizationContext = NO_NORMALIZATION
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE
+
+    def __post_init__(self):
+        object.__setattr__(self, "task", TaskType(self.task))
+        object.__setattr__(
+            self, "variance_computation", VarianceComputationType(self.variance_computation)
+        )
+        loss = loss_for_task(self.task)
+        opt_type = OptimizerType(self.configuration.optimizer_config.optimizer_type)
+        if opt_type == OptimizerType.TRON and not loss.has_hessian:
+            raise ValueError(
+                f"TRON requires a twice-differentiable loss; {self.task} is not "
+                "(reference: smoothed hinge is DiffFunction-only)"
+            )
+
+    @property
+    def objective(self) -> GLMObjective:
+        return GLMObjective(loss_for_task(self.task), self.normalization)
+
+    def create_model(self, coefficients: Coefficients) -> GeneralizedLinearModel:
+        return GeneralizedLinearModel(coefficients, self.task)
+
+    def initialize_zero_model(self, dim: int, dtype=jnp.float32) -> GeneralizedLinearModel:
+        return self.create_model(Coefficients.zeros(dim, dtype))
+
+    # -- solving ---------------------------------------------------------------
+
+    def run(
+        self,
+        data: LabeledData,
+        initial_model: Optional[GeneralizedLinearModel] = None,
+        lower_bounds: Optional[Array] = None,
+        upper_bounds: Optional[Array] = None,
+    ) -> tuple[GeneralizedLinearModel, OptResult]:
+        """Train on one LabeledData batch (jit-compiled end to end)."""
+        cfg = self.configuration
+        obj = self.objective
+        l2 = cfg.l2_weight
+        x0 = (
+            initial_model.coefficients.means
+            if initial_model is not None
+            else jnp.zeros((data.dim,), dtype=data.X.dtype)
+        )
+        minimize = build_minimizer(cfg.optimizer_config)
+
+        def vg(w):
+            return obj.value_and_gradient(data, w, l2)
+
+        kwargs = {}
+        if OptimizerType(cfg.optimizer_config.optimizer_type) == OptimizerType.TRON:
+            kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
+        if cfg.l1_weight:
+            kwargs["l1_weight"] = cfg.l1_weight
+        if lower_bounds is not None:
+            kwargs["lower_bounds"] = lower_bounds
+        if upper_bounds is not None:
+            kwargs["upper_bounds"] = upper_bounds
+
+        result = minimize(vg, x0, **kwargs)
+        variances = self.compute_variances(data, result.coefficients)
+        model = self.create_model(Coefficients(result.coefficients, variances))
+        return model, result
+
+    def compute_variances(self, data: LabeledData, coef: Array) -> Optional[Array]:
+        """SIMPLE: 1/diag(H); FULL: diag(H^-1) via Cholesky
+        (DistributedOptimizationProblem.computeVariances:84-108)."""
+        vtype = self.variance_computation
+        obj = self.objective
+        l2 = self.configuration.l2_weight
+        if vtype == VarianceComputationType.SIMPLE:
+            diag = obj.hessian_diagonal(data, coef, l2)
+            return 1.0 / jnp.where(diag == 0.0, jnp.inf, diag)
+        if vtype == VarianceComputationType.FULL:
+            H = obj.hessian_matrix(data, coef, l2)
+            return jnp.diag(cholesky_inverse(H))
+        return None
+
+
+def cholesky_inverse(H: Array) -> Array:
+    """H^-1 through the Cholesky factor (photon-lib util/Linalg.choleskyInverse:104)."""
+    L = jnp.linalg.cholesky(H)
+    eye = jnp.eye(H.shape[0], dtype=H.dtype)
+    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return Linv.T @ Linv

@@ -1,0 +1,326 @@
+"""End-to-end slice (SURVEY.md §7 step 3): synthetic Avro -> index map -> fixed-effect
+training -> evaluators -> Avro model save/load round-trip.
+
+Mirrors the reference's driver integration tests (GameTrainingDriverIntegTest:
+full runs asserting AUC and saved-model equivalence).
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import avro_io
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.data.matrix import SparseDesignMatrix, as_design_matrix
+from photon_ml_tpu.data.readers import read_avro, read_libsvm, write_training_avro
+from photon_ml_tpu.evaluation import EvaluatorType, evaluator_for_type
+from photon_ml_tpu.evaluation.evaluators import MultiEvaluator, auc_roc, auc_pr, rmse
+from photon_ml_tpu.io import load_glm_model, save_glm_model
+from photon_ml_tpu.models import Coefficients, GeneralizedLinearModel
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+from photon_ml_tpu.types import (
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+    VarianceComputationType,
+)
+
+
+def synthetic_records(rng, n=400, d=6):
+    """TrainingExampleAvro-shaped records with a known generating model."""
+    w = rng.normal(size=d)
+    recs = []
+    X = np.zeros((n, d))
+    for i in range(n):
+        nz = rng.choice(d, size=rng.integers(2, d + 1), replace=False)
+        feats = []
+        for j in nz:
+            v = float(rng.normal())
+            X[i, j] = v
+            feats.append({"name": f"f{j}", "term": "", "value": v})
+        z = X[i] @ w + 0.5 * rng.normal()
+        recs.append(
+            {
+                "uid": str(i),
+                "label": float(z > 0),
+                "features": feats,
+                "metadataMap": {"userId": f"u{i % 7}"},
+                "weight": 1.0,
+                "offset": None,
+            }
+        )
+    return recs, w
+
+
+# ------------------------------------------------------------------ avro codec
+
+
+def test_avro_container_roundtrip(rng, tmp_path):
+    recs, _ = synthetic_records(rng, n=50)
+    path = str(tmp_path / "data.avro")
+    write_training_avro(path, recs)
+    back = list(avro_io.read_container(path))
+    assert len(back) == 50
+    assert back[0]["uid"] == "0"
+    assert back[3]["features"] == recs[3]["features"]
+    assert back[7]["metadataMap"] == recs[7]["metadataMap"]
+    # weight survives the union encoding
+    assert back[11]["weight"] == 1.0
+
+
+def test_avro_null_codec_roundtrip(rng, tmp_path):
+    recs, _ = synthetic_records(rng, n=5)
+    path = str(tmp_path / "data.avro")
+    avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, recs, codec="null")
+    assert list(avro_io.read_container(path))[2]["label"] == recs[2]["label"]
+
+
+def test_avro_multiblock(rng, tmp_path):
+    recs, _ = synthetic_records(rng, n=100)
+    path = str(tmp_path / "data.avro")
+    avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, recs, block_count=7)
+    assert len(list(avro_io.read_container(path))) == 100
+
+
+# ------------------------------------------------------------------ index map
+
+
+def test_index_map_roundtrip(tmp_path):
+    im = IndexMap.build([feature_key("b"), feature_key("a", "t1"), feature_key("b")])
+    assert im.size == 3  # 2 distinct + intercept
+    assert im.intercept_index is not None
+    assert im.get_index(feature_key("zzz")) == -1
+    p = str(tmp_path / "imap.npz")
+    im.save(p)
+    im2 = IndexMap.load(p)
+    assert im2.keys() == im.keys()
+    assert im2.intercept_index == im.intercept_index
+
+
+# ------------------------------------------------------------------ readers
+
+
+def test_read_avro_builds_matrix(rng, tmp_path):
+    recs, _ = synthetic_records(rng, n=30)
+    path = str(tmp_path / "train.avro")
+    write_training_avro(path, recs)
+    ds, imap = read_avro(path, id_tags=["userId"])
+    assert ds.n == 30 and ds.dim == imap.size
+    assert imap.intercept_index is not None
+    np.testing.assert_array_equal(
+        np.asarray(ds.X[:, imap.intercept_index].todense()).ravel(), np.ones(30)
+    )
+    assert ds.id_columns["userId"][0] == "u0"
+    # feature values land in the right columns
+    j = imap.get_index(feature_key("f0"))
+    rec_vals = {int(r["uid"]): {f["name"]: f["value"] for f in r["features"]} for r in recs}
+    for i in range(30):
+        expect = rec_vals[i].get("f0", 0.0)
+        assert ds.X[i, j] == pytest.approx(expect)
+
+
+def test_read_libsvm(tmp_path):
+    p = tmp_path / "a1a.txt"
+    p.write_text("+1 3:1 11:0.5\n-1 3:1 4:2\n+1 11:1\n")
+    ds, imap = read_libsvm(str(p))
+    assert ds.n == 3
+    np.testing.assert_array_equal(ds.labels, [1.0, 0.0, 1.0])
+    j = imap.get_index(feature_key("3"))
+    assert ds.X[0, j] == 1.0 and ds.X[1, j] == 1.0 and ds.X[2, j] == 0.0
+
+
+# ------------------------------------------------------------------ evaluators
+
+
+def test_auc_known_value():
+    scores = [0.1, 0.4, 0.35, 0.8]
+    labels = [0, 0, 1, 1]
+    # pairs: (0.35 vs 0.1 ok), (0.35 vs 0.4 bad), (0.8 vs both ok) -> 3/4
+    assert auc_roc(scores, labels) == pytest.approx(0.75)
+    assert auc_roc([1.0, 1.0], [1, 1]) != auc_roc([1.0, 1.0], [1, 1])  # nan
+
+
+def test_auc_ties():
+    assert auc_roc([0.5, 0.5, 0.5, 0.5], [1, 0, 1, 0]) == pytest.approx(0.5)
+
+
+def test_rmse_and_aupr():
+    assert rmse([1.0, 2.0], [0.0, 4.0]) == pytest.approx(np.sqrt((1 + 4) / 2))
+    assert auc_pr([0.9, 0.1], [1, 0]) == pytest.approx(1.0)
+
+
+def test_multi_evaluator_groups():
+    ev = MultiEvaluator(evaluator_for_type(EvaluatorType.AUC), "userId")
+    scores = [0.9, 0.1, 0.8, 0.2, 0.5]
+    labels = [1, 0, 0, 1, 1]
+    groups = ["a", "a", "b", "b", "c"]  # a: auc 1.0, b: auc 0.0, c: single-class -> nan
+    v = ev.evaluate_grouped(scores, labels, None, groups)
+    assert v == pytest.approx(0.5)
+
+
+# ------------------------------------------------------------------ training E2E
+
+
+@pytest.mark.parametrize(
+    "task,opt",
+    [
+        (TaskType.LOGISTIC_REGRESSION, OptimizerType.LBFGS),
+        (TaskType.LOGISTIC_REGRESSION, OptimizerType.TRON),
+        (TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM, OptimizerType.LBFGS),
+    ],
+)
+def test_train_evaluate_save_load(rng, tmp_path, task, opt):
+    recs, _ = synthetic_records(rng, n=400)
+    train_path = str(tmp_path / "train.avro")
+    write_training_avro(train_path, recs)
+    ds, imap = read_avro(train_path)
+
+    data = LabeledData.build(
+        SparseDesignMatrix.from_scipy(ds.X, dtype=jnp.float64),
+        ds.labels, ds.offsets, ds.weights,
+    )
+    problem = GLMOptimizationProblem(
+        task=task,
+        configuration=GLMOptimizationConfiguration(
+            optimizer_config=OptimizerConfig(optimizer_type=opt, max_iterations=100, tolerance=1e-9),
+            regularization_context=RegularizationContext(RegularizationType.L2),
+            regularization_weight=1.0,
+        ),
+    )
+    model, result = problem.run(data)
+    assert bool(result.converged)
+
+    scores = np.asarray(model.score(data))
+    auc = auc_roc(scores, ds.labels)
+    assert auc > 0.85, f"AUC too low: {auc}"
+
+    # save / load round-trip preserves predictions
+    mpath = str(tmp_path / "model" / "part-00000.avro")
+    save_glm_model(mpath, model, imap, model_id="global")
+    loaded = load_glm_model(mpath, imap, dtype=jnp.float64)
+    assert loaded.task == TaskType(task)
+    np.testing.assert_allclose(
+        np.asarray(loaded.score(data)), scores, atol=1e-12
+    )
+
+
+def test_elastic_net_owlqn_end_to_end(rng, tmp_path):
+    recs, _ = synthetic_records(rng, n=300)
+    ds, imap = _records_dataset(rng, recs, tmp_path)
+    data = LabeledData.build(
+        SparseDesignMatrix.from_scipy(ds.X, dtype=jnp.float64), ds.labels, ds.offsets, ds.weights
+    )
+    problem = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        configuration=GLMOptimizationConfiguration(
+            optimizer_config=OptimizerConfig(optimizer_type=OptimizerType.OWLQN, max_iterations=200),
+            regularization_context=RegularizationContext(RegularizationType.ELASTIC_NET, 0.5),
+            regularization_weight=2.0,
+        ),
+    )
+    model, result = problem.run(data)
+    scores = np.asarray(model.score(data))
+    assert auc_roc(scores, ds.labels) > 0.8
+
+
+def _records_dataset(rng, recs, tmp_path):
+    path = str(tmp_path / "t.avro")
+    write_training_avro(path, recs)
+    return read_avro(path)
+
+
+def test_variance_computation_matches_closed_form(rng):
+    """SIMPLE/FULL variances vs the analytic Gaussian (linear regression):
+    the reference checks Hessian-based variances against closed form
+    (DistributedOptimizationProblemIntegTest)."""
+    n, d = 200, 4
+    X = rng.normal(size=(n, d))
+    y = X @ np.array([1.0, -1.0, 0.5, 2.0]) + 0.1 * rng.normal(size=n)
+    data = LabeledData.build(X, y)
+    for vtype in (VarianceComputationType.SIMPLE, VarianceComputationType.FULL):
+        problem = GLMOptimizationProblem(
+            task=TaskType.LINEAR_REGRESSION,
+            configuration=GLMOptimizationConfiguration(
+                optimizer_config=OptimizerConfig(max_iterations=200, tolerance=1e-12)
+            ),
+            variance_computation=vtype,
+        )
+        model, _ = problem.run(data)
+        H = np.asarray(X.T @ X)
+        if vtype == VarianceComputationType.SIMPLE:
+            expect = 1.0 / np.diag(H)
+        else:
+            expect = np.diag(np.linalg.inv(H))
+        np.testing.assert_allclose(
+            np.asarray(model.coefficients.variances), expect, rtol=1e-6
+        )
+
+
+def test_tron_rejects_hinge():
+    with pytest.raises(ValueError, match="twice-differentiable"):
+        GLMOptimizationProblem(
+            task=TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+            configuration=GLMOptimizationConfiguration(
+                optimizer_config=OptimizerConfig(optimizer_type=OptimizerType.TRON)
+            ),
+        )
+
+
+# ------------------------------------------------- regression: review findings
+
+
+def test_int_labels_train_cleanly(rng):
+    X = rng.normal(size=(60, 3))
+    y = (X @ np.array([1.0, -1.0, 0.5]) > 0).astype(int)  # int labels
+    data = LabeledData.build(X, y)
+    problem = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        configuration=GLMOptimizationConfiguration(
+            optimizer_config=OptimizerConfig(max_iterations=50),
+            regularization_context=RegularizationContext(RegularizationType.L2),
+            regularization_weight=0.5,
+        ),
+    )
+    model, result = problem.run(data)
+    assert bool(result.converged)
+
+
+def test_explicit_intercept_not_double_counted(rng, tmp_path):
+    from photon_ml_tpu.types import InputColumnsNames
+
+    recs = [
+        {
+            "uid": "0",
+            "label": 1.0,
+            "features": [
+                {"name": InputColumnsNames.INTERCEPT_NAME, "term": "", "value": 1.0},
+                {"name": "f0", "term": "", "value": 2.0},
+            ],
+            "metadataMap": None,
+            "weight": None,
+            "offset": None,
+        }
+    ]
+    path = str(tmp_path / "i.avro")
+    write_training_avro(path, recs)
+    ds, imap = read_avro(path)
+    assert ds.X[0, imap.intercept_index] == 1.0  # not 2.0
+
+
+def test_weighted_auc():
+    scores = [0.9, 0.8, 0.2, 0.1]
+    labels = [1, 0, 1, 0]
+    # unweighted: pairs (s_p, s_n): (0.9>0.8), (0.9>0.1), (0.2<0.8), (0.2>0.1) -> 3/4
+    assert auc_roc(scores, labels) == pytest.approx(0.75)
+    # zero weight on the bad positive removes its pairs -> perfect ranking
+    assert auc_roc(scores, labels, [1.0, 1.0, 0.0, 1.0]) == pytest.approx(1.0)
+    # weighted ties
+    assert auc_roc([0.5, 0.5], [1, 0], [3.0, 7.0]) == pytest.approx(0.5)
