@@ -1,0 +1,3 @@
+from photon_ml_tpu.algorithm.random_effect import train_random_effect, RandomEffectTracker
+
+__all__ = ["train_random_effect", "RandomEffectTracker"]

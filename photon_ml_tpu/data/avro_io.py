@@ -70,9 +70,18 @@ def write_bytes(buf, data: bytes) -> None:
     buf.write(data)
 
 
+def _read_exact(buf, n: int) -> bytes:
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError(f"truncated Avro data: wanted {n} bytes, got {len(data)}")
+    return data
+
+
 def read_bytes(buf) -> bytes:
     n = read_long(buf)
-    return buf.read(n)
+    if n < 0:
+        raise ValueError(f"negative Avro byte-length {n} (corrupt stream)")
+    return _read_exact(buf, n)
 
 
 # --------------------------------------------------------------------- schema
@@ -89,8 +98,7 @@ class Schema:
         if isinstance(s, str):
             if s in ("null", "boolean", "int", "long", "float", "double", "bytes", "string"):
                 return s
-            full = s if "." in s else s
-            for key in (full, f"com.linkedin.photon.avro.generated.{s}"):
+            for key in (s, f"com.linkedin.photon.avro.generated.{s}"):
                 if key in self.names:
                     return self.names[key]
             raise ValueError(f"Unknown Avro type reference: {s}")
@@ -198,13 +206,13 @@ def decode(buf, schema):
         if schema == "null":
             return None
         if schema == "boolean":
-            return buf.read(1) == b"\x01"
+            return _read_exact(buf, 1) == b"\x01"
         if schema in ("int", "long"):
             return read_long(buf)
         if schema == "float":
-            return struct.unpack("<f", buf.read(4))[0]
+            return struct.unpack("<f", _read_exact(buf, 4))[0]
         if schema == "double":
-            return struct.unpack("<d", buf.read(8))[0]
+            return struct.unpack("<d", _read_exact(buf, 8))[0]
         if schema == "string":
             return read_bytes(buf).decode("utf-8")
         if schema == "bytes":
@@ -316,7 +324,11 @@ def read_container(path: str) -> Iterator[dict]:
             except EOFError:
                 return
             payload_len = read_long(f)
+            if payload_len < 0:
+                raise ValueError(f"{path}: negative block size (corrupt file)")
             payload = f.read(payload_len)
+            if len(payload) != payload_len:
+                raise EOFError(f"{path}: truncated block ({len(payload)}/{payload_len} bytes)")
             if codec == "deflate":
                 payload = zlib.decompress(payload, -15)
             elif codec != "null":

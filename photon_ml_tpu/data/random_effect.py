@@ -1,0 +1,318 @@
+"""Random-effect datasets: ragged per-entity data on a dense SPMD machine.
+
+Re-designs photon-api data/RandomEffectDataset.scala:46-508 + LocalDataset.scala:35-251
++ RandomEffectDatasetPartitioner for TPU. The reference keeps RDD[(REId, LocalDataset)]
+and solves per entity inside mapValues; here:
+
+- host ingest groups samples by entity ONCE (replacing the groupBy shuffle),
+  with the reference's semantics: deterministic reservoir-sampling cap on active
+  data with weight rescale count/cap (generateActiveData:293-342,
+  groupDataByKeyAndSample:358-420), lower-bound filtering (:433-478 neighborhood),
+  per-entity Pearson-correlation feature selection
+  (LocalDataset.filterFeaturesByPearsonCorrelationScore:110-138),
+  per-entity index-map projection (projector/IndexMapProjectorRDD.scala:36-274);
+- entities are BUCKETED by (padded sample count, padded feature count) into dense
+  [E_b, S, K] blocks so a vmap-ed optimizer solves a whole bucket as one XLA
+  program; padding rows carry weight 0 (inert by construction);
+- samples beyond the active cap become passive data (score-only), exactly the
+  reference's active/passive split;
+- a per-sample gathered view over the FULL dataset supports O(1) scoring and the
+  coordinate-descent score exchange without joins.
+
+The partitioner disappears: bucket leading axes are sharded over the device mesh
+(parallel/), which replaces the greedy bin-packing of
+RandomEffectDatasetPartitioner.scala:1-171.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.normalization import NormalizationContext
+from photon_ml_tpu.types import intercept_key
+
+Array = jnp.ndarray
+
+
+def _entity_seed(entity_id: str, base_seed: int) -> int:
+    """Deterministic per-entity seed (the reference uses byteswap64-mixed keys so
+    reservoir sampling is reproducible on recomputation, RandomEffectDataset.scala:
+    394-402; a stable hash gives the same property)."""
+    h = hashlib.blake2b(f"{base_seed}:{entity_id}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "little")
+
+
+def _next_pow2(n: int, minimum: int) -> int:
+    p = minimum
+    while p < n:
+        p *= 2
+    return p
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EntityBucket:
+    """One padded block of entities with similar shapes.
+
+    X is [E, S, K] in each entity's local (projected) space; sample_ids are global
+    sample-axis positions (-1 padding) used to gather offsets/partial scores and to
+    scatter this coordinate's scores back.
+    """
+
+    entity_rows: Array  # [E] int32 — row into the dataset-wide entity table
+    X: Array  # [E, S, K]
+    labels: Array  # [E, S]
+    weights: Array  # [E, S] (0 = padding)
+    sample_ids: Array  # [E, S] int32 (-1 padding)
+
+    @property
+    def n_entities(self) -> int:
+        return self.X.shape[0]
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return self.X.shape[1], self.X.shape[2]
+
+
+@dataclasses.dataclass
+class RandomEffectDataset:
+    """All per-entity training blocks + the per-sample scoring view for one
+    (random-effect type, feature shard) coordinate."""
+
+    re_type: str
+    feature_shard_id: str
+    entity_ids: tuple  # entities WITH active data (training targets), row order
+    buckets: list[EntityBucket]
+    # dataset-wide per-entity projection table, [E, K_max] global col ids (-1 pad)
+    proj_indices: Array
+    # per-sample scoring view over the FULL sample axis:
+    sample_entity_rows: Array  # [N] int32, -1 = entity has no model
+    sample_local_cols: Array  # [N, nnz] int32 into the entity's K axis, -1 pad
+    sample_vals: Array  # [N, nnz]
+    n_samples: int
+    # passive-sample bookkeeping (reference passiveData): ids not in active blocks
+    n_active_samples: int = 0
+    n_passive_samples: int = 0
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.entity_ids)
+
+    @property
+    def max_k(self) -> int:
+        return self.proj_indices.shape[1]
+
+    def scoring_view(self, model=None):
+        """(entity_rows [N], local_cols [N, nnz], vals [N, nnz]) for
+        RandomEffectModel.score_dataset."""
+        return self.sample_entity_rows, self.sample_local_cols, self.sample_vals
+
+
+def build_random_effect_dataset(
+    X: sp.spmatrix,
+    entity_ids_per_sample: Sequence,
+    re_type: str,
+    feature_shard_id: str = "global",
+    *,
+    active_data_upper_bound: Optional[int] = None,
+    active_data_lower_bound: int = 1,
+    features_max: Optional[int] = None,
+    labels: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    intercept_index: Optional[int] = None,
+    normalization: Optional[NormalizationContext] = None,
+    seed: int = 0,
+    dtype=jnp.float32,
+    min_samples_pad: int = 8,
+    min_features_pad: int = 4,
+) -> RandomEffectDataset:
+    """Host-side construction of the bucketed random-effect dataset.
+
+    - ``active_data_upper_bound``: reservoir cap; kept samples get weight * n/cap
+      (RandomEffectDataset.scala:358-420). Overflow samples become passive.
+    - ``active_data_lower_bound``: entities with fewer active samples train no model
+      (their samples score 0), reference lower-bound filtering.
+    - ``features_max``: per-entity Pearson feature selection cap (needs ``labels``).
+    - ``normalization``: applied to the materialized blocks (x' = (x-shift)*factor);
+      models are converted back to original space after the solve, so scoring and
+      model export always live in the original space.
+    """
+    X = X.tocsr()
+    n, d = X.shape
+    base_weights = np.ones(n) if weights is None else np.asarray(weights, dtype=np.float64)
+    ent = np.asarray(entity_ids_per_sample)
+    if len(ent) != n:
+        raise ValueError("entity ids and sample count mismatch")
+
+    # ---- group samples by entity (the one-time 'shuffle') -----------------------
+    order = np.argsort(ent, kind="mergesort")
+    sorted_ent = ent[order]
+    boundaries = np.flatnonzero(sorted_ent[1:] != sorted_ent[:-1]) + 1
+    starts = np.concatenate([[0], boundaries])
+    stops = np.concatenate([boundaries, [n]])
+
+    active_rows: dict = {}
+    weights_scale: dict = {}
+    passive_count = 0
+    for a, b in zip(starts, stops):
+        e_id = sorted_ent[a]
+        rows = order[a:b]
+        count = len(rows)
+        if active_data_upper_bound is not None and count > active_data_upper_bound:
+            rng = np.random.default_rng(_entity_seed(str(e_id), seed))
+            keys = rng.random(count)
+            keep = rows[np.argsort(keys, kind="mergesort")[: active_data_upper_bound]]
+            active_rows[e_id] = np.sort(keep)
+            weights_scale[e_id] = count / active_data_upper_bound
+            passive_count += count - active_data_upper_bound
+        else:
+            active_rows[e_id] = np.sort(rows)
+            weights_scale[e_id] = 1.0
+
+    # lower-bound filter: entities below the threshold train no model
+    entities = [e for e, rows in active_rows.items() if len(rows) >= active_data_lower_bound]
+    entities.sort()
+    row_of_entity = {e: i for i, e in enumerate(entities)}
+
+    # ---- per-entity projection (+ optional Pearson selection) -------------------
+    col_of: list[np.ndarray] = []  # global col ids per entity, local order
+    labels_arr = None if labels is None else np.asarray(labels, dtype=np.float64)
+    for e in entities:
+        rows = active_rows[e]
+        sub = X[rows]  # csr [s, d]
+        observed = np.unique(sub.indices) if sub.nnz else np.array([], dtype=np.int32)
+        if features_max is not None and len(observed) > features_max:
+            if labels_arr is None:
+                raise ValueError("features_max (Pearson selection) requires labels")
+            scores = _pearson_scores(sub, observed, labels_arr[rows])
+            keep_order = np.argsort(-scores, kind="mergesort")
+            kept = set(observed[keep_order[:features_max]].tolist())
+            if intercept_index is not None:
+                kept.add(intercept_index)
+            observed = np.asarray(sorted(kept), dtype=observed.dtype)
+        col_of.append(observed.astype(np.int32))
+
+    # ---- bucketing by (padded sample count, padded feature count) ---------------
+    norm_factors = None if normalization is None or normalization.factors is None else np.asarray(normalization.factors)
+    norm_shifts = None if normalization is None or normalization.shifts is None else np.asarray(normalization.shifts)
+
+    bucket_members: dict[tuple[int, int], list[int]] = {}
+    for i, e in enumerate(entities):
+        s_pad = _next_pow2(len(active_rows[e]), min_samples_pad)
+        k_pad = _next_pow2(max(len(col_of[i]), 1), min_features_pad)
+        bucket_members.setdefault((s_pad, k_pad), []).append(i)
+
+    # Dataset-wide projection table is as wide as the widest PADDED bucket so that
+    # bucket slices coeffs_global[:, :K_bucket] always fit.
+    max_k_all = max((k for _, k in bucket_members), default=min_features_pad)
+    proj_table = np.full((len(entities), max_k_all), -1, dtype=np.int32)
+    for i, cols in enumerate(col_of):
+        proj_table[i, : len(cols)] = cols
+
+    buckets: list[EntityBucket] = []
+    for (s_pad, k_pad), members in sorted(bucket_members.items()):
+        eb = len(members)
+        Xb = np.zeros((eb, s_pad, k_pad), dtype=np.float64)
+        yb = np.zeros((eb, s_pad), dtype=np.float64)
+        wb = np.zeros((eb, s_pad), dtype=np.float64)
+        sb = np.full((eb, s_pad), -1, dtype=np.int32)
+        for bi, i in enumerate(members):
+            e = entities[i]
+            rows = active_rows[e]
+            cols = col_of[i]
+            sub = X[rows][:, cols].toarray() if len(cols) else np.zeros((len(rows), 0))
+            if norm_shifts is not None and len(cols):
+                sub = sub - norm_shifts[cols][None, :]
+            if norm_factors is not None and len(cols):
+                sub = sub * norm_factors[cols][None, :]
+            Xb[bi, : len(rows), : len(cols)] = sub
+            if labels_arr is not None:
+                yb[bi, : len(rows)] = labels_arr[rows]
+            wb[bi, : len(rows)] = base_weights[rows] * weights_scale[e]
+            sb[bi, : len(rows)] = rows
+        buckets.append(
+            EntityBucket(
+                entity_rows=jnp.asarray(np.asarray(members, dtype=np.int32)),
+                X=jnp.asarray(Xb, dtype=dtype),
+                labels=jnp.asarray(yb, dtype=dtype),
+                weights=jnp.asarray(wb, dtype=dtype),
+                sample_ids=jnp.asarray(sb),
+            )
+        )
+
+    # ---- per-sample scoring view over the FULL sample axis ----------------------
+    # local col = position of the global col in the entity's projection row.
+    # Vectorized over all nnz: a dense [E, D] lookup when it fits, else per-entity
+    # dict fallback (huge-D regimes).
+    nnz_max = max(int(np.diff(X.indptr).max()) if n else 1, 1)
+    # map each sample's entity to its row id (vectorized: entities is sorted)
+    s_ent_rows = np.full(n, -1, dtype=np.int32)
+    uniq = np.asarray(entities)
+    if len(uniq):
+        pos = np.searchsorted(uniq, ent)
+        pos_clipped = np.clip(pos, 0, len(uniq) - 1)
+        hit = uniq[pos_clipped] == ent
+        s_ent_rows = np.where(hit, pos_clipped, -1).astype(np.int32)
+
+    s_cols = np.full((n, nnz_max), -1, dtype=np.int32)
+    s_vals = np.zeros((n, nnz_max), dtype=np.float64)
+    if n and X.nnz:
+        counts = np.diff(X.indptr)
+        rows_per_nnz = np.repeat(np.arange(n), counts)
+        slot_per_nnz = np.arange(X.nnz) - np.repeat(X.indptr[:-1], counts)
+        ent_per_nnz = s_ent_rows[rows_per_nnz]
+        valid = ent_per_nnz >= 0
+        if len(entities) * d <= 50_000_000:
+            lookup = np.full((max(len(entities), 1), d), -1, dtype=np.int32)
+            for i, cols in enumerate(col_of):
+                lookup[i, cols] = np.arange(len(cols), dtype=np.int32)
+            local = np.full(X.nnz, -1, dtype=np.int32)
+            local[valid] = lookup[ent_per_nnz[valid], X.indices[valid]]
+        else:
+            local_of = [{int(c): k for k, c in enumerate(cols)} for cols in col_of]
+            local = np.full(X.nnz, -1, dtype=np.int32)
+            idx_valid = np.flatnonzero(valid)
+            for t in idx_valid:
+                local[t] = local_of[ent_per_nnz[t]].get(int(X.indices[t]), -1)
+        keep = local >= 0
+        s_cols[rows_per_nnz[keep], slot_per_nnz[keep]] = local[keep]
+        s_vals[rows_per_nnz[keep], slot_per_nnz[keep]] = X.data[keep]
+
+    n_active = sum(len(active_rows[e]) for e in entities)
+    return RandomEffectDataset(
+        re_type=re_type,
+        feature_shard_id=feature_shard_id,
+        entity_ids=tuple(entities),
+        buckets=buckets,
+        proj_indices=jnp.asarray(proj_table),
+        sample_entity_rows=jnp.asarray(s_ent_rows),
+        sample_local_cols=jnp.asarray(s_cols),
+        sample_vals=jnp.asarray(s_vals, dtype=dtype),
+        n_samples=n,
+        n_active_samples=n_active,
+        n_passive_samples=passive_count,
+    )
+
+
+def _pearson_scores(sub: sp.csr_matrix, observed: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """|Pearson correlation| of each observed column with the label
+    (LocalDataset.computePearsonCorrelationScore semantics; constant columns,
+    e.g. the intercept, get score ~1 so they are always kept — reference gives the
+    intercept a pass-through score)."""
+    dense = np.asarray(sub[:, observed].todense(), dtype=np.float64)
+    s = len(y)
+    if s <= 1:
+        return np.ones(len(observed))
+    xm = dense - dense.mean(axis=0, keepdims=True)
+    ym = y - y.mean()
+    denom = np.sqrt((xm**2).sum(axis=0) * (ym**2).sum())
+    num = xm.T @ ym
+    corr = np.where(denom > 0, np.abs(num / np.where(denom > 0, denom, 1.0)), 1.0)
+    return corr

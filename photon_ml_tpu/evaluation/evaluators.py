@@ -60,17 +60,13 @@ def auc_roc(scores, labels, weights=None) -> float:
     order = np.argsort(scores, kind="mergesort")  # ascending
     s, l, ww = scores[order], labels[order], w[order]
     # group by distinct score: for each tie group, positives beat all lighter
-    # negatives fully and tied negatives half.
+    # negatives fully and tied negatives half (vectorized via reduceat).
     boundaries = np.flatnonzero(np.diff(s) != 0) + 1
     starts = np.concatenate([[0], boundaries])
-    stops = np.concatenate([boundaries, [len(s)]])
-    cum_neg = 0.0
-    num = 0.0
-    for a, b in zip(starts, stops):
-        grp_pos = float(ww[a:b][l[a:b]].sum())
-        grp_neg = float(ww[a:b][~l[a:b]].sum())
-        num += grp_pos * (cum_neg + 0.5 * grp_neg)
-        cum_neg += grp_neg
+    grp_pos = np.add.reduceat(ww * l, starts)
+    grp_neg = np.add.reduceat(ww * ~l, starts)
+    cum_neg_below = np.concatenate([[0.0], np.cumsum(grp_neg)[:-1]])
+    num = float(np.sum(grp_pos * (cum_neg_below + 0.5 * grp_neg)))
     return float(num / (w_pos_total * w_neg_total))
 
 

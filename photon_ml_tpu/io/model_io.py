@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data import avro_io
-from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
 from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.models.glm import (
     Coefficients,
@@ -61,7 +61,7 @@ def _coeffs_to_ntv(means, index_map: IndexMap, sparsity_threshold: float):
 def _ntv_to_coeffs(items, index_map: IndexMap) -> np.ndarray:
     vec = np.zeros(index_map.size)
     for it in items:
-        j = index_map.get_index(f"{it['name']}{DELIMITER}{it['term']}")
+        j = index_map.get_index(feature_key(it['name'], it['term']))
         if j >= 0:
             vec[j] = it["value"]
     return vec
@@ -232,14 +232,14 @@ def load_game_model(
             for rec in recs:
                 task = task_for_reference_class(rec.get("modelClass") or "") or task
                 cols = [
-                    index_map.get_index(f"{m['name']}{DELIMITER}{m['term']}")
+                    index_map.get_index(feature_key(m["name"], m["term"]))
                     for m in rec["means"]
                 ]
                 vals = [m["value"] for m in rec["means"]]
                 keep = [(c, v) for c, v in zip(cols, vals) if c >= 0]
                 var_by_col = {}
                 for m in rec.get("variances") or []:
-                    c = index_map.get_index(f"{m['name']}{DELIMITER}{m['term']}")
+                    c = index_map.get_index(feature_key(m["name"], m["term"]))
                     if c >= 0:
                         var_by_col[c] = m["value"]
                 parsed.append((rec["modelId"], keep, var_by_col))

@@ -84,14 +84,52 @@ class RandomEffectModel:
         row = self.row_for_entity(entity_id)
         return None if row < 0 else np.asarray(self.coeffs[row])
 
+    def aligned_to(self, dataset) -> "RandomEffectModel":
+        """Re-layout this model's coefficients into ``dataset``'s entity-row and
+        projection-slot order. Needed when the model was loaded from disk (slot
+        order = surviving means order) or trained on a different dataset build —
+        without this, gathers through the dataset's local columns would read the
+        wrong slots."""
+        if self.entity_ids == tuple(dataset.entity_ids) and np.array_equal(
+            np.asarray(self.proj_indices), np.asarray(dataset.proj_indices)
+        ):
+            return self
+        src_proj = np.asarray(self.proj_indices)
+        dst_proj = np.asarray(dataset.proj_indices)
+        src = np.asarray(self.coeffs)
+        src_var = None if self.variances is None else np.asarray(self.variances)
+        E, K = dst_proj.shape
+        out = np.zeros((E, K), dtype=src.dtype)
+        out_var = None if src_var is None else np.zeros((E, K), dtype=src_var.dtype)
+        for i, e in enumerate(dataset.entity_ids):
+            r = self.row_for_entity(e)
+            if r < 0:
+                continue
+            col_val = {int(c): k for k, c in enumerate(src_proj[r]) if c >= 0}
+            for k, c in enumerate(dst_proj[i]):
+                kk = col_val.get(int(c), -1) if c >= 0 else -1
+                if kk >= 0:
+                    out[i, k] = src[r, kk]
+                    if out_var is not None:
+                        out_var[i, k] = src_var[r, kk]
+        return dataclasses.replace(
+            self,
+            entity_ids=tuple(dataset.entity_ids),
+            coeffs=jnp.asarray(out),
+            proj_indices=jnp.asarray(dst_proj),
+            variances=None if out_var is None else jnp.asarray(out_var),
+        )
+
     def score_dataset(self, dataset) -> Array:
         """Score a RandomEffectDataset-like object exposing per-sample projected
-        features: ``scoring_view(self)`` -> (entity_rows [N], local_cols [N, nnz],
-        vals [N, nnz]) where local_cols index into this model's K axis (-1 = pad)."""
-        entity_rows, local_cols, vals = dataset.scoring_view(self)
+        features: ``scoring_view()`` -> (entity_rows [N], local_cols [N, nnz],
+        vals [N, nnz]) where local_cols index into the DATASET's slot layout; the
+        model is aligned to that layout first."""
+        model = self.aligned_to(dataset)
+        entity_rows, local_cols, vals = dataset.scoring_view(model)
         has_model = entity_rows >= 0
         safe_rows = jnp.maximum(entity_rows, 0)
-        w = self.coeffs[safe_rows]  # [N, K]
+        w = model.coeffs[safe_rows]  # [N, K]
         safe_cols = jnp.maximum(local_cols, 0)
         gathered = jnp.take_along_axis(w, safe_cols, axis=1)  # [N, nnz]
         gathered = jnp.where(local_cols >= 0, gathered, 0.0)
