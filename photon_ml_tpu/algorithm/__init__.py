@@ -1,3 +1,26 @@
-from photon_ml_tpu.algorithm.random_effect import train_random_effect, RandomEffectTracker
+from photon_ml_tpu.algorithm.coordinate import (
+    Coordinate,
+    FixedEffectCoordinate,
+    FixedEffectOptimizationTracker,
+    ModelCoordinate,
+    RandomEffectCoordinate,
+    score_model_on_dataset,
+)
+from photon_ml_tpu.algorithm.coordinate_descent import (
+    CoordinateDescentResult,
+    run_coordinate_descent,
+)
+from photon_ml_tpu.algorithm.random_effect import RandomEffectTracker, train_random_effect
 
-__all__ = ["train_random_effect", "RandomEffectTracker"]
+__all__ = [
+    "Coordinate",
+    "CoordinateDescentResult",
+    "FixedEffectCoordinate",
+    "FixedEffectOptimizationTracker",
+    "ModelCoordinate",
+    "RandomEffectCoordinate",
+    "RandomEffectTracker",
+    "run_coordinate_descent",
+    "score_model_on_dataset",
+    "train_random_effect",
+]

@@ -1,0 +1,218 @@
+"""GAME coordinates: one optimization sub-problem per (effect, feature shard).
+
+Re-designs photon-lib algorithm/Coordinate.scala:28-81 and the concrete photon-api
+coordinates (FixedEffectCoordinate.scala:35-166, RandomEffectCoordinate.scala:39-232,
+FixedEffectModelCoordinate.scala:44, RandomEffectModelCoordinate.scala:44) for TPU.
+
+The reference's ``updateModel(model, partialScore)`` joins scores back into the
+dataset (`dataset.addScoresToOffsets`); here every coordinate's score is a dense
+``[N]`` array over the global sample axis, so "adding scores to offsets" is an
+elementwise add and the shuffle joins disappear entirely. Training happens in a
+jitted solve: one sharded LBFGS/TRON run for the fixed effect, one vmap-ed bucket
+solve per shape class for random effects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.algorithm.random_effect import RandomEffectTracker, train_random_effect
+from photon_ml_tpu.data.dataset import FixedEffectDataset
+from photon_ml_tpu.data.random_effect import RandomEffectDataset
+from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.normalization import NO_NORMALIZATION, NormalizationContext
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+from photon_ml_tpu.sampling.down_sampler import DownSampler
+from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+Array = jnp.ndarray
+
+
+@dataclasses.dataclass
+class FixedEffectOptimizationTracker:
+    """Wraps the single OptResult of a fixed-effect solve
+    (FixedEffectOptimizationTracker.scala:31)."""
+
+    convergence_reason: str
+    iterations: int
+    final_value: float
+
+    def summary(self) -> str:
+        return (
+            f"reason={self.convergence_reason} iters={self.iterations} "
+            f"value={self.final_value:.6g}"
+        )
+
+
+class Coordinate:
+    """Abstract GAME coordinate (Coordinate.scala:28-81).
+
+    ``update_model(initial, partial_scores)`` trains against offsets + the other
+    coordinates' scores; ``score(model)`` returns this coordinate's [N] score
+    (margins WITHOUT base offsets, so scores sum across coordinates).
+    """
+
+    coordinate_id: str
+
+    @property
+    def is_locked(self) -> bool:
+        return False
+
+    def update_model(self, initial_model, partial_scores: Array):
+        raise NotImplementedError
+
+    def score(self, model) -> Array:
+        raise NotImplementedError
+
+    def initialize_model(self):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class FixedEffectCoordinate(Coordinate):
+    """Global GLM over one feature shard (FixedEffectCoordinate.scala:35-166).
+
+    The reference broadcasts coefficients and treeAggregates gradients each
+    iteration; here the solve is one jitted optimizer run whose input arrays may be
+    batch-sharded over the mesh (psum inside — see parallel/).
+    """
+
+    coordinate_id: str
+    dataset: FixedEffectDataset
+    task: TaskType
+    configuration: GLMOptimizationConfiguration
+    normalization: NormalizationContext = NO_NORMALIZATION
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE
+    down_sampler: Optional[DownSampler] = None
+
+    def __post_init__(self):
+        self.task = TaskType(self.task)
+        self._problem = GLMOptimizationProblem(
+            task=self.task,
+            configuration=self.configuration,
+            normalization=self.normalization,
+            variance_computation=VarianceComputationType(self.variance_computation),
+        )
+
+    def initialize_model(self) -> FixedEffectModel:
+        model = self._problem.initialize_zero_model(
+            self.dataset.dim, dtype=self.dataset.data.X.dtype
+        )
+        return FixedEffectModel(model=model, feature_shard_id=self.dataset.feature_shard_id)
+
+    def update_model(
+        self, initial_model: Optional[FixedEffectModel], partial_scores: Array
+    ) -> tuple[FixedEffectModel, FixedEffectOptimizationTracker]:
+        """Train with offsets := base offsets + other coordinates' scores
+        (Coordinate.scala:60-63 / FixedEffectCoordinate.updateModel:91-147)."""
+        data = self.dataset.data.add_scores_to_offsets(partial_scores)
+        if self.down_sampler is not None:
+            data = self.down_sampler.down_sample(data)
+        glm, result = self._problem.run(
+            data, initial_model.model if initial_model is not None else None
+        )
+        tracker = FixedEffectOptimizationTracker(
+            convergence_reason=result.reason_name(),
+            iterations=int(result.iterations),
+            final_value=float(result.value),
+        )
+        return (
+            FixedEffectModel(model=glm, feature_shard_id=self.dataset.feature_shard_id),
+            tracker,
+        )
+
+    def score(self, model: FixedEffectModel) -> Array:
+        return model.score_dataset(self.dataset)
+
+
+@dataclasses.dataclass
+class RandomEffectCoordinate(Coordinate):
+    """Per-entity GLMs (RandomEffectCoordinate.scala:39-232). The reference's
+    activeData.join(problems).leftOuterJoin(models) -> mapValues(local solve)
+    becomes vmap-ed bucket solves with zero comm during the solve."""
+
+    coordinate_id: str
+    dataset: RandomEffectDataset
+    task: TaskType
+    configuration: GLMOptimizationConfiguration
+    base_offsets: Array  # [N] global base offsets (gathered per bucket at solve time)
+    normalization: Optional[NormalizationContext] = None
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE
+
+    def __post_init__(self):
+        self.task = TaskType(self.task)
+
+    def initialize_model(self) -> RandomEffectModel:
+        E, K = self.dataset.n_entities, self.dataset.max_k
+        dtype = self.dataset.sample_vals.dtype
+        return RandomEffectModel(
+            re_type=self.dataset.re_type,
+            feature_shard_id=self.dataset.feature_shard_id,
+            task=self.task,
+            entity_ids=self.dataset.entity_ids,
+            coeffs=jnp.zeros((E, K), dtype=dtype),
+            proj_indices=self.dataset.proj_indices,
+        )
+
+    def update_model(
+        self, initial_model: Optional[RandomEffectModel], partial_scores: Array
+    ) -> tuple[RandomEffectModel, RandomEffectTracker]:
+        offsets_plus_scores = self.base_offsets + partial_scores
+        return train_random_effect(
+            self.dataset,
+            self.task,
+            self.configuration,
+            offsets_plus_scores,
+            initial_model=initial_model,
+            normalization=self.normalization,
+            variance_computation=self.variance_computation,
+        )
+
+    def score(self, model: RandomEffectModel) -> Array:
+        return model.score_dataset(self.dataset)
+
+
+@dataclasses.dataclass
+class ModelCoordinate(Coordinate):
+    """Locked, score-only coordinate for partial retraining: never re-optimized
+    (FixedEffectModelCoordinate.scala:44, RandomEffectModelCoordinate.scala:44,
+    CoordinateDescent.scala:45)."""
+
+    coordinate_id: str
+    dataset: object  # FixedEffectDataset | RandomEffectDataset
+    model: object  # FixedEffectModel | RandomEffectModel
+
+    @property
+    def is_locked(self) -> bool:
+        return True
+
+    def initialize_model(self):
+        return self.model
+
+    def update_model(self, initial_model, partial_scores: Array):
+        raise RuntimeError(
+            f"Coordinate {self.coordinate_id} is locked (partial retrain); "
+            "updateModel must never be called on a ModelCoordinate"
+        )
+
+    def score(self, model=None) -> Array:
+        return (model if model is not None else self.model).score_dataset(self.dataset)
+
+
+def score_model_on_dataset(model, dataset) -> Array:
+    """Generic scoring dispatch used for validation data
+    (DatumScoringModel.scoreForCoordinateDescent)."""
+    if isinstance(model, FixedEffectModel):
+        if not isinstance(dataset, FixedEffectDataset):
+            raise TypeError("FixedEffectModel requires a FixedEffectDataset")
+        return model.score_dataset(dataset)
+    if isinstance(model, RandomEffectModel):
+        if not isinstance(dataset, RandomEffectDataset):
+            raise TypeError("RandomEffectModel requires a RandomEffectDataset")
+        return model.score_dataset(dataset)
+    raise TypeError(f"Cannot score model of type {type(model).__name__}")

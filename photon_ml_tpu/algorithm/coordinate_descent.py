@@ -1,0 +1,152 @@
+"""Block coordinate descent over GAME coordinates.
+
+Re-designs photon-lib algorithm/CoordinateDescent.scala:38-347 for TPU. The
+reference exchanges scores between coordinates through full-outer-join RDD ops
+(DataScores.scala:37-53) and persist/unpersist choreography; here every
+coordinate's score is a dense [N] array over the global sample axis, so
+
+- the residual trick ``partialScore = fullTrainingScore - ownScore``
+  (CoordinateDescent.scala:197-204) is elementwise subtraction,
+- ``addScoresToOffsets`` is elementwise addition (done inside each coordinate),
+- there is no persistence choreography: arrays live on device, XLA manages memory.
+
+Best-model selection on the primary validation evaluator follows
+CoordinateDescent.scala:292-325: after every coordinate update the full validation
+score is re-evaluated and the best GAME model snapshot kept. Locked coordinates
+(partial retrain) contribute scores but are never updated (CoordinateDescent.scala:45).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Mapping, Optional
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.algorithm.coordinate import Coordinate, score_model_on_dataset
+from photon_ml_tpu.evaluation.evaluators import EvaluationSuite
+from photon_ml_tpu.models.game import GameModel
+
+Array = jnp.ndarray
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class CoordinateDescentResult:
+    """Outcome of one descent run."""
+
+    model: GameModel  # model after the final iteration
+    best_model: GameModel  # best by primary validation metric (== model if no validation)
+    best_metric: Optional[float]
+    metrics_history: list  # [(iteration, coordinate_id, {metric: value})]
+    trackers: dict  # coordinate_id -> [tracker per update]
+    training_scores: dict  # coordinate_id -> final [N] score array
+
+    @property
+    def has_validation(self) -> bool:
+        return self.best_metric is not None
+
+
+def run_coordinate_descent(
+    coordinates: Mapping[str, Coordinate],
+    n_iterations: int,
+    initial_models: Optional[Mapping[str, object]] = None,
+    validation_datasets: Optional[Mapping[str, object]] = None,
+    evaluation_suite: Optional[EvaluationSuite] = None,
+) -> CoordinateDescentResult:
+    """Run block coordinate descent (CoordinateDescent.run/descend:93-346).
+
+    ``coordinates`` is ordered — iteration order is the update sequence. Locked
+    coordinates are scored, never updated. ``validation_datasets`` must cover every
+    coordinate id when ``evaluation_suite`` is given; validation scores are summed
+    across coordinates and handed to the suite after each update.
+    """
+    if n_iterations < 1:
+        raise ValueError(f"n_iterations must be >= 1, got {n_iterations}")
+    coordinate_ids = list(coordinates.keys())
+    if not coordinate_ids:
+        raise ValueError("No coordinates to descend over")
+    validate = evaluation_suite is not None
+    if validate:
+        if validation_datasets is None:
+            raise ValueError(
+                "evaluation_suite requires validation_datasets covering every coordinate"
+            )
+        missing = [c for c in coordinate_ids if c not in validation_datasets]
+        if missing:
+            raise ValueError(f"Missing validation datasets for coordinates {missing}")
+
+    # --- initialize models and their training/validation scores -----------------
+    models: dict[str, object] = {}
+    train_scores: dict[str, Array] = {}
+    val_scores: dict[str, Array] = {}
+    for cid, coord in coordinates.items():
+        init = None if initial_models is None else initial_models.get(cid)
+        model = init if init is not None else coord.initialize_model()
+        models[cid] = model
+        train_scores[cid] = coord.score(model)
+        if validate:
+            val_scores[cid] = score_model_on_dataset(model, validation_datasets[cid])
+
+    n = {int(s.shape[0]) for s in train_scores.values()}
+    if len(n) != 1:
+        raise ValueError(f"Coordinate datasets disagree on sample count: {sorted(n)}")
+
+    trackers: dict[str, list] = {cid: [] for cid in coordinate_ids}
+    metrics_history: list = []
+    best_model: Optional[GameModel] = None
+    best_metric: Optional[float] = None
+    primary = evaluation_suite.primary if validate else None
+
+    updatable = [cid for cid in coordinate_ids if not coordinates[cid].is_locked]
+    if not updatable:
+        raise ValueError("All coordinates are locked; nothing to train")
+
+    full_train_score = sum(train_scores.values())
+
+    for iteration in range(n_iterations):
+        for cid in updatable:
+            coord = coordinates[cid]
+            t0 = time.perf_counter()
+            # Residual trick (CoordinateDescent.scala:197-204)
+            partial = full_train_score - train_scores[cid]
+            model, tracker = coord.update_model(models[cid], partial)
+            models[cid] = model
+            trackers[cid].append(tracker)
+            new_score = coord.score(model)
+            train_scores[cid] = new_score
+            full_train_score = partial + new_score
+            elapsed = time.perf_counter() - t0
+            logger.info(
+                "iter %d coordinate %s: %s (%.2fs)",
+                iteration,
+                cid,
+                tracker.summary(),
+                elapsed,
+            )
+
+            if validate:
+                val_scores[cid] = score_model_on_dataset(model, validation_datasets[cid])
+                total_val = sum(val_scores.values())
+                metrics = evaluation_suite.evaluate(total_val)
+                metrics_history.append((iteration, cid, metrics))
+                metric = metrics[primary.name]
+                logger.info("iter %d coordinate %s: validation %s", iteration, cid, metrics)
+                if primary.better_than(metric, best_metric):
+                    best_metric = metric
+                    best_model = GameModel(models=dict(models))
+
+    final_model = GameModel(models=dict(models))
+    if best_model is None:
+        best_model = final_model
+    return CoordinateDescentResult(
+        model=final_model,
+        best_model=best_model,
+        best_metric=best_metric,
+        metrics_history=metrics_history,
+        trackers=trackers,
+        training_scores=dict(train_scores),
+    )

@@ -95,6 +95,23 @@ def _gather_norm_vectors(
     icpt_mask = None
     if normalization.intercept_index is not None:
         icpt_mask = (proj == normalization.intercept_index).astype(dtype)
+    if shifts is not None:
+        # The shift correction routes through the intercept slot (b' = b + w.shift);
+        # an entity whose projection lacks the intercept column would be silently
+        # mis-converted back to original space, so fail loudly instead.
+        if icpt_mask is None:
+            raise ValueError(
+                "Normalization with shifts requires intercept_index so per-entity "
+                "coefficients can be converted between spaces"
+            )
+        missing = np.flatnonzero(~np.asarray(icpt_mask.any(axis=-1)))
+        if len(missing):
+            raise ValueError(
+                f"{len(missing)} entities lack the intercept column in their "
+                "projection; cannot apply shift normalization (ensure the intercept "
+                "survives feature selection, e.g. pass intercept_index to the "
+                "dataset builder)"
+            )
     return factors, shifts, icpt_mask
 
 
@@ -152,21 +169,11 @@ def train_random_effect(
         dtype = dataset.sample_vals.dtype
     coeffs_global = jnp.zeros((E, K_all), dtype=dtype)
 
-    # Warm start: map the initial model's per-entity rows into this dataset's rows.
+    # Warm start: re-layout the initial model into this dataset's entity-row and
+    # slot order (aligned_to is a no-op when layouts already match — the common
+    # case inside coordinate descent).
     if initial_model is not None:
-        init_np = np.zeros((E, K_all))
-        src = np.asarray(initial_model.coeffs)
-        src_proj = np.asarray(initial_model.proj_indices)
-        dst_proj = np.asarray(dataset.proj_indices)
-        for i, e in enumerate(dataset.entity_ids):
-            r = initial_model.row_for_entity(e)
-            if r < 0:
-                continue
-            col_val = {int(c): src[r, k] for k, c in enumerate(src_proj[r]) if c >= 0}
-            for k, c in enumerate(dst_proj[i]):
-                if c >= 0 and int(c) in col_val:
-                    init_np[i, k] = col_val[int(c)]
-        coeffs_global = jnp.asarray(init_np, dtype=dtype)
+        coeffs_global = initial_model.aligned_to(dataset).coeffs.astype(dtype)
 
     variances_global = (
         jnp.zeros((E, K_all), dtype=dtype)
@@ -222,6 +229,11 @@ def train_random_effect(
 
         if normalization is not None and not normalization.is_identity:
             w_b = _to_original(w_b, factors, shifts, icpt_mask)
+            if variances_global is not None and factors is not None:
+                # w = w' * factor  =>  Var(w) = Var(w') * factor^2 (diagonal
+                # approximation: the intercept's shift cross-covariances are not
+                # tracked, matching the reference's diagonal variance output).
+                var_b = var_b * factors**2
 
         coeffs_global = coeffs_global.at[bucket.entity_rows, :K].set(w_b)
         if variances_global is not None:

@@ -67,6 +67,10 @@ class OptResult(NamedTuple):
     def converged(self) -> Array:
         return self.convergence_reason != ConvergenceReason.NOT_CONVERGED
 
+    def reason_name(self) -> str:
+        """Human-readable convergence reason (scalar results only)."""
+        return ConvergenceReason(int(self.convergence_reason)).name
+
 
 def convergence_check(
     *,

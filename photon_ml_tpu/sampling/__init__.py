@@ -1,0 +1,13 @@
+from photon_ml_tpu.sampling.down_sampler import (
+    BinaryClassificationDownSampler,
+    DefaultDownSampler,
+    DownSampler,
+    down_sampler_for_task,
+)
+
+__all__ = [
+    "BinaryClassificationDownSampler",
+    "DefaultDownSampler",
+    "DownSampler",
+    "down_sampler_for_task",
+]

@@ -1,0 +1,103 @@
+"""Down-sampling for fixed-effect training.
+
+Re-designs photon-lib sampling/ (DownSampler.scala:68,
+BinaryClassificationDownSampler.scala:31-69, DefaultDownSampler.scala:41) for static
+shapes: the reference filters RDD rows; dropping rows on TPU would make shapes
+dynamic, so we MASK instead — dropped samples get weight 0 (inert in every weighted
+reduction by construction), kept negatives get their weight re-scaled by 1/rate so
+the loss stays an unbiased estimate (the reference's re-weighting, :46-68).
+
+Determinism mirrors the reference's byteswap64-mixed per-partition seeds
+(BinaryClassificationDownSampler.scala:52): a fixed integer seed makes every
+down-sampled pass reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.types import TaskType
+
+Array = jnp.ndarray
+
+
+def is_valid_down_sampling_rate(rate: float) -> bool:
+    """DownSampler.isValidDownSamplingRate: strictly inside (0, 1)."""
+    return 0.0 < rate < 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class DownSampler:
+    """Base down-sampler: subclasses implement ``down_sample``.
+
+    Each call draws a FRESH mask (the reference redraws its seed per downSample
+    call, DownSampler.getSeed): a per-instance call counter is folded into the
+    PRNG key, so repeated passes over the same data resample while a fixed
+    ``seed`` keeps the whole sequence reproducible.
+    """
+
+    down_sampling_rate: float
+    seed: int = 0
+
+    def __post_init__(self):
+        if not is_valid_down_sampling_rate(self.down_sampling_rate):
+            raise ValueError(
+                f"Down-sampling rate must be in (0, 1), got {self.down_sampling_rate}"
+            )
+        object.__setattr__(self, "_calls", 0)
+
+    def _next_key(self):
+        k = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)
+        object.__setattr__(self, "_calls", self._calls + 1)
+        return k
+
+    def down_sample(self, data: LabeledData) -> LabeledData:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class DefaultDownSampler(DownSampler):
+    """Uniform sampling of all points with probability ``rate``
+    (DefaultDownSampler.scala:41). Kept weights are NOT re-scaled (matches the
+    reference's plain RDD.sample)."""
+
+    def down_sample(self, data: LabeledData) -> LabeledData:
+        key = self._next_key()
+        keep = jax.random.uniform(key, data.weights.shape) < self.down_sampling_rate
+        return dataclasses.replace(
+            data, weights=jnp.where(keep, data.weights, 0.0)
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryClassificationDownSampler(DownSampler):
+    """Negative down-sampling for binary classification
+    (BinaryClassificationDownSampler.scala:46-68): positives all kept; negatives kept
+    with probability rate and re-weighted by 1/rate."""
+
+    def down_sample(self, data: LabeledData) -> LabeledData:
+        key = self._next_key()
+        rate = self.down_sampling_rate
+        is_positive = data.labels > 0.5
+        keep_draw = jax.random.uniform(key, data.weights.shape) < rate
+        new_weights = jnp.where(
+            is_positive,
+            data.weights,
+            jnp.where(keep_draw, data.weights / rate, 0.0),
+        )
+        return dataclasses.replace(data, weights=new_weights)
+
+
+def down_sampler_for_task(
+    task: TaskType, rate: float, seed: int = 0
+) -> DownSampler:
+    """DownSamplerHelper (photon-api util/DownSamplerHelper.scala:41): classification
+    tasks get negative down-sampling, regression gets uniform."""
+    task = TaskType(task)
+    if task.is_classification:
+        return BinaryClassificationDownSampler(rate, seed)
+    return DefaultDownSampler(rate, seed)

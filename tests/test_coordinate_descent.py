@@ -1,0 +1,269 @@
+"""GAME coordinate-descent tests: residual-score bookkeeping, fixed+random effect
+GLMix training, locked coordinates (partial retrain), best-model tracking,
+down-samplers. Mirrors the reference's CoordinateDescent + coordinate integ tests
+(photon-lib algorithm/, photon-api src/integTest/.../algorithm/)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.algorithm import (
+    FixedEffectCoordinate,
+    ModelCoordinate,
+    RandomEffectCoordinate,
+    run_coordinate_descent,
+)
+from photon_ml_tpu.data.dataset import FixedEffectDataset, LabeledData
+from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+from photon_ml_tpu.evaluation import EvaluatorType, evaluator_for_type
+from photon_ml_tpu.evaluation.evaluators import EvaluationSuite
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+from photon_ml_tpu.sampling import (
+    BinaryClassificationDownSampler,
+    DefaultDownSampler,
+    down_sampler_for_task,
+)
+from photon_ml_tpu.types import RegularizationType, TaskType
+
+CFG = GLMOptimizationConfiguration(
+    optimizer_config=OptimizerConfig(max_iterations=80, tolerance=1e-9),
+    regularization_context=RegularizationContext(RegularizationType.L2),
+    regularization_weight=1.0,
+)
+
+
+def glmix_data(rng, n=900, d=4, n_users=10, user_scale=2.0):
+    """Global GLM + per-user intercept/slope: the canonical GLMix generating model."""
+    w_global = rng.normal(size=d)
+    user_bias = rng.normal(size=n_users) * user_scale
+    user_slope = rng.normal(size=n_users)
+    X = rng.normal(size=(n, d))
+    users = rng.integers(0, n_users, size=n)
+    x_re = rng.normal(size=n)  # the per-user feature
+    z = X @ w_global + user_bias[users] + user_slope[users] * x_re
+    y = (z + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    # random-effect shard: column 0 = intercept, column 1 = x_re
+    X_re = sp.csr_matrix(np.stack([np.ones(n), x_re], axis=1))
+    user_ids = np.asarray([f"u{u}" for u in users], dtype=object)
+    return X, X_re, user_ids, y
+
+
+def build_coordinates(X, X_re, user_ids, y, task=TaskType.LOGISTIC_REGRESSION):
+    n = len(y)
+    fe_ds = FixedEffectDataset(LabeledData.build(X, y), feature_shard_id="global")
+    re_ds = build_random_effect_dataset(
+        X_re, user_ids, "userId", feature_shard_id="per-user", labels=y
+    )
+    coords = {
+        "fixed": FixedEffectCoordinate(
+            coordinate_id="fixed", dataset=fe_ds, task=task, configuration=CFG
+        ),
+        "per-user": RandomEffectCoordinate(
+            coordinate_id="per-user",
+            dataset=re_ds,
+            task=task,
+            configuration=CFG,
+            base_offsets=jnp.zeros(n),
+        ),
+    }
+    return coords, fe_ds, re_ds
+
+
+def test_single_coordinate_equals_direct_solve(rng):
+    X, _, _, y = glmix_data(rng)
+    fe_ds = FixedEffectDataset(LabeledData.build(X, y))
+    coord = FixedEffectCoordinate(
+        coordinate_id="fixed",
+        dataset=fe_ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        configuration=CFG,
+    )
+    result = run_coordinate_descent({"fixed": coord}, n_iterations=1)
+    problem = GLMOptimizationProblem(task=TaskType.LOGISTIC_REGRESSION, configuration=CFG)
+    direct, _ = problem.run(fe_ds.data)
+    trained = result.model.get_model("fixed").model
+    np.testing.assert_allclose(
+        np.asarray(trained.coefficients.means),
+        np.asarray(direct.coefficients.means),
+        rtol=1e-6,
+        atol=1e-8,
+    )
+
+
+def test_glmix_beats_fixed_effect_alone(rng):
+    X, X_re, users, y = glmix_data(rng)
+    n = len(y)
+    split = 600
+    tr = slice(0, split)
+    va = slice(split, n)
+
+    coords, _, _ = build_coordinates(X[tr], X_re[tr], users[tr], y[tr])
+    fe_val = FixedEffectDataset(LabeledData.build(X[va], y[va]), feature_shard_id="global")
+    re_val = build_random_effect_dataset(
+        X_re[va], users[va], "userId", feature_shard_id="per-user"
+    )
+    suite = EvaluationSuite(
+        evaluators=[evaluator_for_type(EvaluatorType.AUC)],
+        labels=y[va],
+        offsets=np.zeros(n - split),
+        weights=np.ones(n - split),
+    )
+    val_ds = {"fixed": fe_val, "per-user": re_val}
+
+    full = run_coordinate_descent(
+        coords, n_iterations=3, validation_datasets=val_ds, evaluation_suite=suite
+    )
+    fixed_only = run_coordinate_descent(
+        {"fixed": coords["fixed"]},
+        n_iterations=1,
+        validation_datasets={"fixed": fe_val},
+        evaluation_suite=suite,
+    )
+    assert full.best_metric > fixed_only.best_metric + 0.02
+    assert full.best_metric > 0.75
+    # history records one entry per (iteration, coordinate)
+    assert len(full.metrics_history) == 3 * 2
+    # best metric must equal the max AUC seen in history
+    best_seen = max(m["AUC"] for _, _, m in full.metrics_history)
+    assert full.best_metric == pytest.approx(best_seen)
+
+
+def test_training_scores_match_model_scores(rng):
+    X, X_re, users, y = glmix_data(rng, n=400)
+    coords, fe_ds, re_ds = build_coordinates(X, X_re, users, y)
+    result = run_coordinate_descent(coords, n_iterations=2)
+    fe_score = result.model.get_model("fixed").score_dataset(fe_ds)
+    re_score = result.model.get_model("per-user").score_dataset(re_ds)
+    np.testing.assert_allclose(
+        np.asarray(result.training_scores["fixed"]), np.asarray(fe_score), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(result.training_scores["per-user"]), np.asarray(re_score), rtol=1e-6
+    )
+
+
+def test_locked_coordinate_partial_retrain(rng):
+    """Locked fixed effect: model unchanged, random effect trains against its scores
+    (CoordinateDescent.scala:45, GameEstimator partial retrain)."""
+    X, X_re, users, y = glmix_data(rng, n=500)
+    n = len(y)
+    coords, fe_ds, re_ds = build_coordinates(X, X_re, users, y)
+
+    pre = run_coordinate_descent({"fixed": coords["fixed"]}, n_iterations=1)
+    locked_model = pre.model.get_model("fixed")
+
+    locked = ModelCoordinate(coordinate_id="fixed", dataset=fe_ds, model=locked_model)
+    result = run_coordinate_descent(
+        {"fixed": locked, "per-user": coords["per-user"]}, n_iterations=2
+    )
+    after = result.model.get_model("fixed")
+    np.testing.assert_array_equal(
+        np.asarray(after.model.coefficients.means),
+        np.asarray(locked_model.model.coefficients.means),
+    )
+    # the random effect actually learned something non-trivial
+    re_coef = np.asarray(result.model.get_model("per-user").coeffs)
+    assert np.abs(re_coef).max() > 0.1
+
+
+def test_all_locked_raises(rng):
+    X, _, _, y = glmix_data(rng, n=120)
+    fe_ds = FixedEffectDataset(LabeledData.build(X, y))
+    coord = FixedEffectCoordinate(
+        coordinate_id="fixed", dataset=fe_ds, task=TaskType.LOGISTIC_REGRESSION, configuration=CFG
+    )
+    model = coord.initialize_model()
+    locked = ModelCoordinate(coordinate_id="fixed", dataset=fe_ds, model=model)
+    with pytest.raises(ValueError, match="locked"):
+        run_coordinate_descent({"fixed": locked}, n_iterations=1)
+
+
+def test_residual_trick_consistency(rng):
+    """After every update the stored full score equals the sum of per-coordinate
+    scores (CoordinateDescent residual bookkeeping :197-204)."""
+    X, X_re, users, y = glmix_data(rng, n=300)
+    coords, _, _ = build_coordinates(X, X_re, users, y)
+    result = run_coordinate_descent(coords, n_iterations=2)
+    total = sum(result.training_scores.values())
+    recomputed = sum(
+        coords[cid].score(result.model.get_model(cid)) for cid in coords
+    )
+    np.testing.assert_allclose(np.asarray(total), np.asarray(recomputed), rtol=1e-6)
+
+
+# ------------------------------------------------------------- down-sampling
+
+
+def test_binary_down_sampler_keeps_positives(rng):
+    y = (rng.uniform(size=2000) < 0.3).astype(np.float64)
+    X = rng.normal(size=(2000, 3))
+    data = LabeledData.build(X, y)
+    ds = BinaryClassificationDownSampler(down_sampling_rate=0.25, seed=7)
+    out = ds.down_sample(data)
+    w = np.asarray(out.weights)
+    # every positive keeps weight 1
+    assert np.all(w[y == 1.0] == 1.0)
+    neg = w[y == 0.0]
+    kept = neg > 0
+    # kept negatives re-weighted by 1/rate
+    np.testing.assert_allclose(neg[kept], 4.0)
+    # keep fraction near the rate
+    assert 0.15 < kept.mean() < 0.35
+    # total negative weight is an unbiased estimate of the original
+    assert abs(neg.sum() - (y == 0).sum()) / (y == 0).sum() < 0.15
+    # successive calls RESAMPLE (the reference redraws per pass) ...
+    out2 = ds.down_sample(data)
+    assert not np.array_equal(w, np.asarray(out2.weights))
+    # ... but a fresh sampler with the same seed reproduces the same sequence
+    ds2 = BinaryClassificationDownSampler(down_sampling_rate=0.25, seed=7)
+    np.testing.assert_array_equal(w, np.asarray(ds2.down_sample(data).weights))
+
+
+def test_default_down_sampler_uniform(rng):
+    y = rng.normal(size=1000)
+    X = rng.normal(size=(1000, 3))
+    data = LabeledData.build(X, y)
+    out = DefaultDownSampler(down_sampling_rate=0.5, seed=3).down_sample(data)
+    w = np.asarray(out.weights)
+    assert set(np.unique(w)) <= {0.0, 1.0}
+    assert 0.4 < w.mean() < 0.6
+
+
+def test_down_sampler_factory():
+    assert isinstance(
+        down_sampler_for_task(TaskType.LOGISTIC_REGRESSION, 0.5),
+        BinaryClassificationDownSampler,
+    )
+    assert isinstance(
+        down_sampler_for_task(TaskType.LINEAR_REGRESSION, 0.5), DefaultDownSampler
+    )
+    with pytest.raises(ValueError):
+        down_sampler_for_task(TaskType.LINEAR_REGRESSION, 1.5)
+
+
+def test_fixed_effect_coordinate_with_down_sampling(rng):
+    X, _, _, y = glmix_data(rng, n=800)
+    fe_ds = FixedEffectDataset(LabeledData.build(X, y))
+    coord = FixedEffectCoordinate(
+        coordinate_id="fixed",
+        dataset=fe_ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        configuration=CFG,
+        down_sampler=BinaryClassificationDownSampler(down_sampling_rate=0.5, seed=11),
+    )
+    result = run_coordinate_descent({"fixed": coord}, n_iterations=1)
+    coef = np.asarray(result.model.get_model("fixed").model.coefficients.means)
+    # down-sampled solve still recovers a usable model
+    problem = GLMOptimizationProblem(task=TaskType.LOGISTIC_REGRESSION, configuration=CFG)
+    direct, _ = problem.run(fe_ds.data)
+    ref = np.asarray(direct.coefficients.means)
+    cos = coef @ ref / (np.linalg.norm(coef) * np.linalg.norm(ref))
+    assert cos > 0.97
