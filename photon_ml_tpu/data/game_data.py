@@ -1,0 +1,131 @@
+"""GameInput: the host-side tabular input to GAME training/scoring.
+
+Replaces the reference's DataFrame -> RDD[(UniqueSampleId, GameDatum)] conversion
+(photon-api data/GameConverters.scala:28-173, data/GameDatum.scala:1-74). A GameDatum
+held (response, offset, weight, feature-shard map, id tags) per row; GameInput holds
+the same content as struct-of-arrays: per-shard feature matrices aligned on one
+global sample axis, plus id columns for random-effect grouping and per-group
+evaluation. The uniqueId join key disappears — position on the sample axis IS the id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+
+@dataclasses.dataclass
+class GameInput:
+    """One table of samples for GAME training or scoring.
+
+    features: feature_shard_id -> [N, D_shard] matrix (scipy sparse or ndarray)
+    id_columns: id tag (e.g. "userId") -> [N] entity ids (used both for
+        random-effect grouping and MultiEvaluator grouping)
+    """
+
+    features: Mapping[str, object]
+    labels: Optional[np.ndarray] = None
+    offsets: Optional[np.ndarray] = None
+    weights: Optional[np.ndarray] = None
+    id_columns: Mapping[str, np.ndarray] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        ns = {shard: m.shape[0] for shard, m in self.features.items()}
+        if len(set(ns.values())) > 1:
+            raise ValueError(f"Feature shards disagree on sample count: {ns}")
+        n = self.n
+        if self.labels is not None and len(self.labels) != n:
+            raise ValueError(f"labels length {len(self.labels)} != {n}")
+        if self.offsets is None:
+            self.offsets = np.zeros(n)
+        elif len(self.offsets) != n:
+            raise ValueError(f"offsets length {len(self.offsets)} != {n}")
+        if self.weights is None:
+            self.weights = np.ones(n)
+        elif len(self.weights) != n:
+            raise ValueError(f"weights length {len(self.weights)} != {n}")
+        for tag, col in self.id_columns.items():
+            if len(col) != n:
+                raise ValueError(f"id column {tag!r} length {len(col)} != {n}")
+
+    @property
+    def n(self) -> int:
+        if not self.features:
+            raise ValueError("GameInput needs at least one feature shard")
+        return next(iter(self.features.values())).shape[0]
+
+    @property
+    def has_labels(self) -> bool:
+        return self.labels is not None
+
+    def shard(self, feature_shard_id: str):
+        try:
+            return self.features[feature_shard_id]
+        except KeyError:
+            raise KeyError(
+                f"Unknown feature shard {feature_shard_id!r}; have {list(self.features)}"
+            ) from None
+
+    def ids(self, tag: str) -> np.ndarray:
+        try:
+            return self.id_columns[tag]
+        except KeyError:
+            raise KeyError(
+                f"Unknown id column {tag!r}; have {list(self.id_columns)}"
+            ) from None
+
+    def select(self, idx: np.ndarray) -> "GameInput":
+        """Row subset (bootstrap resamples, train/validation splits)."""
+        feats = {
+            s: (m[idx] if sp.issparse(m) else np.asarray(m)[idx])
+            for s, m in self.features.items()
+        }
+        return GameInput(
+            features=feats,
+            labels=None if self.labels is None else np.asarray(self.labels)[idx],
+            offsets=np.asarray(self.offsets)[idx],
+            weights=np.asarray(self.weights)[idx],
+            id_columns={t: np.asarray(c)[idx] for t, c in self.id_columns.items()},
+        )
+
+
+def as_csr(m) -> sp.csr_matrix:
+    return m.tocsr() if sp.issparse(m) else sp.csr_matrix(np.asarray(m))
+
+
+def build_fixed_effect_scoring_dataset(data: GameInput, feature_shard_id: str, dtype=None):
+    """Label-free-tolerant FixedEffectDataset for validation / transform scoring
+    (shared by GameEstimator.prepare_scoring_datasets and GameTransformer)."""
+    from photon_ml_tpu.data.dataset import FixedEffectDataset, LabeledData
+
+    labels = data.labels if data.has_labels else np.zeros(data.n)
+    return FixedEffectDataset(
+        LabeledData.build(
+            data.shard(feature_shard_id),
+            labels,
+            offsets=data.offsets,
+            weights=data.weights,
+            dtype=dtype,
+        ),
+        feature_shard_id=feature_shard_id,
+    )
+
+
+def build_random_effect_scoring_dataset(
+    data: GameInput, random_effect_type: str, feature_shard_id: str, dtype=None
+):
+    """Scoring-view-only RandomEffectDataset (no training buckets materialized)."""
+    from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+
+    kwargs = {} if dtype is None else {"dtype": dtype}
+    return build_random_effect_dataset(
+        as_csr(data.shard(feature_shard_id)),
+        data.ids(random_effect_type),
+        random_effect_type,
+        feature_shard_id=feature_shard_id,
+        scoring_only=True,
+        **kwargs,
+    )

@@ -132,6 +132,7 @@ def build_random_effect_dataset(
     dtype=jnp.float32,
     min_samples_pad: int = 8,
     min_features_pad: int = 4,
+    scoring_only: bool = False,
 ) -> RandomEffectDataset:
     """Host-side construction of the bucketed random-effect dataset.
 
@@ -143,7 +144,14 @@ def build_random_effect_dataset(
     - ``normalization``: applied to the materialized blocks (x' = (x-shift)*factor);
       models are converted back to original space after the solve, so scoring and
       model export always live in the original space.
+    - ``scoring_only``: skip training-bucket materialization entirely (validation /
+      transform datasets only need the per-sample scoring view); caps, lower-bound
+      filtering and Pearson selection don't apply to scoring data.
     """
+    if scoring_only:
+        active_data_upper_bound = None
+        active_data_lower_bound = 1
+        features_max = None
     X = X.tocsr()
     n, d = X.shape
     base_weights = np.ones(n) if weights is None else np.asarray(weights, dtype=np.float64)
@@ -217,6 +225,8 @@ def build_random_effect_dataset(
         proj_table[i, : len(cols)] = cols
 
     buckets: list[EntityBucket] = []
+    if scoring_only:
+        bucket_members = {}
     for (s_pad, k_pad), members in sorted(bucket_members.items()):
         eb = len(members)
         Xb = np.zeros((eb, s_pad, k_pad), dtype=np.float64)

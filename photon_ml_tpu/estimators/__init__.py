@@ -1,0 +1,23 @@
+from photon_ml_tpu.estimators.config import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    RandomEffectDataConfiguration,
+    expand_game_configurations,
+)
+from photon_ml_tpu.estimators.game_estimator import (
+    GameEstimator,
+    GameResult,
+    default_evaluator_type,
+    resolve_evaluator,
+)
+
+__all__ = [
+    "CoordinateConfiguration",
+    "FixedEffectDataConfiguration",
+    "GameEstimator",
+    "GameResult",
+    "RandomEffectDataConfiguration",
+    "default_evaluator_type",
+    "expand_game_configurations",
+    "resolve_evaluator",
+]

@@ -1,0 +1,74 @@
+"""Coordinate configurations: data shape + optimization settings + reg-weight grids.
+
+Mirrors photon-client io/CoordinateConfiguration.scala:22-164 (grid expansion
+``expandOptimizationConfigurations``) and photon-api data configurations
+(FixedEffectDataConfiguration / RandomEffectDataConfiguration). The estimator
+expands every coordinate's reg-weight set into the cartesian product of full GAME
+configurations and trains them sequentially with warm start
+(GameEstimator.fit:344-360, GameTrainingDriver.prepareGameOptConfigs:624-633).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Mapping, Optional, Sequence
+
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataConfiguration:
+    """Which feature shard feeds a fixed-effect coordinate."""
+
+    feature_shard_id: str = "global"
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfiguration:
+    """Entity grouping + active-data policy for a random-effect coordinate
+    (reference RandomEffectDataConfiguration: type, shard, active-data bounds,
+    features-to-samples ratio, projector)."""
+
+    random_effect_type: str
+    feature_shard_id: str
+    active_data_lower_bound: int = 1
+    active_data_upper_bound: Optional[int] = None
+    features_max: Optional[int] = None  # per-entity Pearson cap
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateConfiguration:
+    """One coordinate's data config + base optimization config + reg-weight grid.
+
+    ``expand()`` returns one optimization config per regularization weight, sorted
+    DESCENDING (strong -> weak regularization: each solve warm-starts from a more
+    regularized model, the stable direction of a glmnet-style path; the reference
+    sorts its weight set and chains warm starts the same way)."""
+
+    data_config: object  # FixedEffectDataConfiguration | RandomEffectDataConfiguration
+    optimization_config: GLMOptimizationConfiguration
+    reg_weights: Sequence[float] = ()
+    down_sampling_rate: float = 1.0  # fixed-effect only
+
+    @property
+    def is_random_effect(self) -> bool:
+        return isinstance(self.data_config, RandomEffectDataConfiguration)
+
+    def expand(self) -> list[GLMOptimizationConfiguration]:
+        if not self.reg_weights:
+            return [self.optimization_config]
+        return [
+            self.optimization_config.with_weight(w)
+            for w in sorted(set(self.reg_weights), reverse=True)
+        ]
+
+
+def expand_game_configurations(
+    configurations: Mapping[str, CoordinateConfiguration],
+) -> list[dict[str, GLMOptimizationConfiguration]]:
+    """Cartesian product over coordinates of each coordinate's expanded configs
+    (GameTrainingDriver.prepareGameOptConfigs:624-633)."""
+    ids = list(configurations.keys())
+    per_coord = [configurations[c].expand() for c in ids]
+    return [dict(zip(ids, combo)) for combo in itertools.product(*per_coord)]

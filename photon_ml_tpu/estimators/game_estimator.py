@@ -1,0 +1,342 @@
+"""GameEstimator: the "fit like Spark ML" GAME training API.
+
+Re-designs photon-api estimators/GameEstimator.scala:55-801 for TPU. The reference
+pipeline (DataFrame -> GameDatum RDD -> per-coordinate datasets -> CoordinateFactory
+-> CoordinateDescent per optimization configuration, warm-started) becomes:
+
+- GameInput (host arrays) -> per-coordinate device datasets, built ONCE and shared
+  across every configuration in the sweep (prepareTrainingDatasets:454-557);
+- per-config coordinates assembled by ``build_coordinate`` (CoordinateFactory.build,
+  photon-api algorithm/CoordinateFactory.scala:51-115);
+- one ``run_coordinate_descent`` per expanded configuration, each warm-started from
+  the previous configuration's model (GameEstimator.fit:344-360);
+- validation datasets + EvaluationSuite prepared once
+  (prepareValidationDatasetAndEvaluators:568-595).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Mapping, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.algorithm.coordinate import (
+    Coordinate,
+    FixedEffectCoordinate,
+    ModelCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.algorithm.coordinate_descent import (
+    CoordinateDescentResult,
+    run_coordinate_descent,
+)
+from photon_ml_tpu.data.dataset import FixedEffectDataset, LabeledData
+from photon_ml_tpu.data.game_data import (
+    GameInput,
+    as_csr,
+    build_fixed_effect_scoring_dataset,
+    build_random_effect_scoring_dataset,
+)
+from photon_ml_tpu.data.random_effect import RandomEffectDataset, build_random_effect_dataset
+from photon_ml_tpu.estimators.config import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    RandomEffectDataConfiguration,
+    expand_game_configurations,
+)
+from photon_ml_tpu.evaluation.evaluators import (
+    EvaluationSuite,
+    Evaluator,
+    EvaluatorType,
+    MultiEvaluator,
+    evaluator_for_type,
+)
+from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.normalization import NO_NORMALIZATION, NormalizationContext
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.sampling.down_sampler import down_sampler_for_task
+from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+logger = logging.getLogger(__name__)
+
+
+def default_evaluator_type(task: TaskType) -> EvaluatorType:
+    """Task -> default validation evaluator (GameEstimator defaultEvaluator)."""
+    task = TaskType(task)
+    return {
+        TaskType.LOGISTIC_REGRESSION: EvaluatorType.AUC,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: EvaluatorType.AUC,
+        TaskType.LINEAR_REGRESSION: EvaluatorType.RMSE,
+        TaskType.POISSON_REGRESSION: EvaluatorType.POISSON_LOSS,
+    }[task]
+
+
+def resolve_evaluator(spec):
+    """Accept EvaluatorType | Evaluator | MultiEvaluator | (EvaluatorType, id_tag)."""
+    if isinstance(spec, (Evaluator, MultiEvaluator)):
+        return spec
+    if isinstance(spec, tuple):
+        base, id_tag = spec
+        return MultiEvaluator(evaluator_for_type(EvaluatorType(base)), id_tag)
+    return evaluator_for_type(EvaluatorType(spec))
+
+
+@dataclasses.dataclass
+class GameResult:
+    """One trained configuration (reference GameResult: model, evaluations, configs)."""
+
+    model: GameModel
+    best_model: GameModel
+    configuration: dict[str, GLMOptimizationConfiguration]
+    evaluations: Optional[dict[str, float]]  # metrics of best_model
+    best_metric: Optional[float]
+    descent: CoordinateDescentResult
+
+
+@dataclasses.dataclass
+class GameEstimator:
+    """GAME training over an ordered set of coordinates.
+
+    ``coordinate_configurations`` order IS the coordinate update sequence
+    (GameEstimator coordinateUpdateSequence param).
+    """
+
+    task: TaskType
+    coordinate_configurations: Mapping[str, CoordinateConfiguration]
+    n_iterations: int = 1
+    normalization_contexts: Optional[Mapping[str, NormalizationContext]] = None
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE
+    validation_evaluators: Sequence = ()
+    partial_retrain_locked_coordinates: Sequence[str] = ()
+    down_sampling_seed: int = 0
+    dtype: object = jnp.float32
+
+    def __post_init__(self):
+        self.task = TaskType(self.task)
+        self.variance_computation = VarianceComputationType(self.variance_computation)
+        locked = set(self.partial_retrain_locked_coordinates)
+        unknown = locked - set(self.coordinate_configurations)
+        if unknown:
+            raise ValueError(f"Locked coordinates not in configurations: {sorted(unknown)}")
+        if locked == set(self.coordinate_configurations) and locked:
+            raise ValueError("All coordinates locked; nothing to train")
+
+    # ------------------------------------------------------------- data prep
+
+    def _normalization_for(self, shard: str) -> NormalizationContext:
+        if not self.normalization_contexts:
+            return NO_NORMALIZATION
+        return self.normalization_contexts.get(shard, NO_NORMALIZATION)
+
+    def prepare_training_datasets(self, data: GameInput) -> dict[str, object]:
+        """GameInput -> per-coordinate device datasets
+        (GameEstimator.prepareTrainingDatasets:454-557). Built once per fit."""
+        if not data.has_labels:
+            raise ValueError("Training data must carry labels")
+        datasets: dict[str, object] = {}
+        for cid, cfg in self.coordinate_configurations.items():
+            dc = cfg.data_config
+            if isinstance(dc, FixedEffectDataConfiguration):
+                datasets[cid] = FixedEffectDataset(
+                    LabeledData.build(
+                        data.shard(dc.feature_shard_id),
+                        data.labels,
+                        offsets=data.offsets,
+                        weights=data.weights,
+                        dtype=self.dtype,
+                    ),
+                    feature_shard_id=dc.feature_shard_id,
+                )
+            elif isinstance(dc, RandomEffectDataConfiguration):
+                norm = self._normalization_for(dc.feature_shard_id)
+                datasets[cid] = build_random_effect_dataset(
+                    as_csr(data.shard(dc.feature_shard_id)),
+                    data.ids(dc.random_effect_type),
+                    dc.random_effect_type,
+                    feature_shard_id=dc.feature_shard_id,
+                    active_data_upper_bound=dc.active_data_upper_bound,
+                    active_data_lower_bound=dc.active_data_lower_bound,
+                    features_max=dc.features_max,
+                    labels=data.labels,
+                    weights=data.weights,
+                    intercept_index=norm.intercept_index if not norm.is_identity else None,
+                    normalization=None if norm.is_identity else norm,
+                    dtype=self.dtype,
+                )
+            else:
+                raise TypeError(f"Unknown data configuration {type(dc).__name__}")
+        return datasets
+
+    def prepare_scoring_datasets(self, data: GameInput) -> dict[str, object]:
+        """Validation/scoring datasets: same shapes, no caps/selection, no training
+        buckets (the reference scores validation data without active-data policies)."""
+        datasets: dict[str, object] = {}
+        for cid, cfg in self.coordinate_configurations.items():
+            dc = cfg.data_config
+            if isinstance(dc, FixedEffectDataConfiguration):
+                datasets[cid] = build_fixed_effect_scoring_dataset(
+                    data, dc.feature_shard_id, dtype=self.dtype
+                )
+            else:
+                datasets[cid] = build_random_effect_scoring_dataset(
+                    data, dc.random_effect_type, dc.feature_shard_id, dtype=self.dtype
+                )
+        return datasets
+
+    def prepare_evaluation_suite(self, validation: GameInput) -> EvaluationSuite:
+        """prepareValidationDatasetAndEvaluators:568-595: default task evaluator
+        first unless the caller supplied evaluators (first = primary)."""
+        if not validation.has_labels:
+            raise ValueError("Validation data must carry labels")
+        specs = list(self.validation_evaluators) or [default_evaluator_type(self.task)]
+        evaluators = [resolve_evaluator(s) for s in specs]
+        return EvaluationSuite(
+            evaluators=evaluators,
+            labels=np.asarray(validation.labels, dtype=np.float64),
+            offsets=np.asarray(validation.offsets, dtype=np.float64),
+            weights=np.asarray(validation.weights, dtype=np.float64),
+            id_columns={t: np.asarray(c) for t, c in validation.id_columns.items()},
+        )
+
+    # ------------------------------------------------------------ coordinates
+
+    def build_coordinate(
+        self,
+        cid: str,
+        dataset,
+        opt_config: GLMOptimizationConfiguration,
+        base_offsets,
+        initial_model=None,
+    ) -> Coordinate:
+        """CoordinateFactory.build (photon-api algorithm/CoordinateFactory.scala:51-115)."""
+        cfg = self.coordinate_configurations[cid]
+        if cid in set(self.partial_retrain_locked_coordinates):
+            if initial_model is None:
+                raise ValueError(
+                    f"Locked coordinate {cid!r} needs a model from initial_model"
+                )
+            return ModelCoordinate(coordinate_id=cid, dataset=dataset, model=initial_model)
+        dc = cfg.data_config
+        if isinstance(dc, FixedEffectDataConfiguration):
+            sampler = None
+            if 0.0 < cfg.down_sampling_rate < 1.0:
+                sampler = down_sampler_for_task(
+                    self.task, cfg.down_sampling_rate, self.down_sampling_seed
+                )
+            return FixedEffectCoordinate(
+                coordinate_id=cid,
+                dataset=dataset,
+                task=self.task,
+                configuration=opt_config,
+                normalization=self._normalization_for(dc.feature_shard_id),
+                variance_computation=self.variance_computation,
+                down_sampler=sampler,
+            )
+        norm = self._normalization_for(dc.feature_shard_id)
+        return RandomEffectCoordinate(
+            coordinate_id=cid,
+            dataset=dataset,
+            task=self.task,
+            configuration=opt_config,
+            base_offsets=base_offsets,
+            normalization=None if norm.is_identity else norm,
+            variance_computation=self.variance_computation,
+        )
+
+    # ---------------------------------------------------------------- fit
+
+    def fit(
+        self,
+        data: GameInput,
+        validation_data: Optional[GameInput] = None,
+        initial_model: Optional[GameModel] = None,
+    ) -> list[GameResult]:
+        """Train one GAME model per expanded optimization configuration, chaining
+        warm starts (GameEstimator.fit:299-380). Returns results in sweep order."""
+        locked = set(self.partial_retrain_locked_coordinates)
+        if locked and initial_model is None:
+            raise ValueError("partial retrain requires initial_model")
+
+        datasets = self.prepare_training_datasets(data)
+        base_offsets = jnp.asarray(np.asarray(data.offsets), dtype=self.dtype)
+
+        validation_datasets = None
+        suite = None
+        if validation_data is not None:
+            validation_datasets = self.prepare_scoring_datasets(validation_data)
+            suite = self.prepare_evaluation_suite(validation_data)
+
+        sweep = expand_game_configurations(self.coordinate_configurations)
+        logger.info(
+            "GAME sweep: %d configurations x %d coordinates",
+            len(sweep),
+            len(self.coordinate_configurations),
+        )
+
+        results: list[GameResult] = []
+        warm: Optional[GameModel] = initial_model
+        for i, opt_configs in enumerate(sweep):
+            coordinates: dict[str, Coordinate] = {}
+            init_models: dict[str, object] = {}
+            for cid in self.coordinate_configurations:
+                init = warm.get_model(cid) if warm is not None else None
+                coordinates[cid] = self.build_coordinate(
+                    cid, datasets[cid], opt_configs[cid], base_offsets, initial_model=init
+                )
+                if init is not None:
+                    init_models[cid] = (
+                        init.aligned_to(datasets[cid])
+                        if isinstance(datasets[cid], RandomEffectDataset)
+                        and hasattr(init, "aligned_to")
+                        else init
+                    )
+            descent = run_coordinate_descent(
+                coordinates,
+                n_iterations=self.n_iterations,
+                initial_models=init_models or None,
+                validation_datasets=validation_datasets,
+                evaluation_suite=suite,
+            )
+            evaluations = None
+            if suite is not None and descent.metrics_history:
+                # metrics of the best snapshot = the history row that set best_metric
+                evaluations = _metrics_of_best(descent, suite)
+            results.append(
+                GameResult(
+                    model=descent.model,
+                    best_model=descent.best_model,
+                    configuration=opt_configs,
+                    evaluations=evaluations,
+                    best_metric=descent.best_metric,
+                    descent=descent,
+                )
+            )
+            warm = descent.best_model  # chain warm starts across the sweep
+        return results
+
+    def select_best_model(self, results: Sequence[GameResult]) -> GameResult:
+        """Best result by primary validation metric (GameTrainingDriver
+        selectBestModel:683-748); without validation, the last result."""
+        with_metric = [r for r in results if r.best_metric is not None]
+        if not with_metric:
+            return results[-1]
+        primary = resolve_evaluator(
+            (list(self.validation_evaluators) or [default_evaluator_type(self.task)])[0]
+        )
+        best = with_metric[0]
+        for r in with_metric[1:]:
+            if primary.better_than(r.best_metric, best.best_metric):
+                best = r
+        return best
+
+
+def _metrics_of_best(descent: CoordinateDescentResult, suite: EvaluationSuite):
+    primary = suite.primary
+    for _, _, metrics in descent.metrics_history:
+        if metrics[primary.name] == descent.best_metric:
+            return metrics
+    return descent.metrics_history[-1][2]
+

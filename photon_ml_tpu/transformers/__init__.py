@@ -1,0 +1,3 @@
+from photon_ml_tpu.transformers.game_transformer import GameTransformer
+
+__all__ = ["GameTransformer"]

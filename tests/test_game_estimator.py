@@ -1,0 +1,202 @@
+"""GameEstimator / GameTransformer tests: config grid expansion, warm-started
+sweeps, partial retrain, scoring round trips. Mirrors GameEstimatorIntegTest /
+GameTransformerIntegTest in the reference."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.estimators import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    GameEstimator,
+    RandomEffectDataConfiguration,
+    expand_game_configurations,
+)
+from photon_ml_tpu.evaluation import EvaluatorType, evaluator_for_type
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.transformers import GameTransformer
+from photon_ml_tpu.types import RegularizationType, TaskType
+
+OPT = GLMOptimizationConfiguration(
+    optimizer_config=OptimizerConfig(max_iterations=60, tolerance=1e-8),
+    regularization_context=RegularizationContext(RegularizationType.L2),
+    regularization_weight=1.0,
+)
+
+
+def make_input(rng, n=800, d=4, n_users=8):
+    w = rng.normal(size=d)
+    bias = rng.normal(size=n_users) * 1.5
+    X = rng.normal(size=(n, d))
+    users = rng.integers(0, n_users, size=n)
+    z = X @ w + bias[users]
+    y = (z + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    uid = np.asarray([f"u{u}" for u in users], dtype=object)
+    return GameInput(
+        features={
+            "global": X,
+            "per-user": sp.csr_matrix(np.ones((n, 1))),
+        },
+        labels=y,
+        id_columns={"userId": uid},
+    )
+
+
+def make_configs(reg_weights=()):
+    return {
+        "fixed": CoordinateConfiguration(
+            data_config=FixedEffectDataConfiguration("global"),
+            optimization_config=OPT,
+            reg_weights=reg_weights,
+        ),
+        "per-user": CoordinateConfiguration(
+            data_config=RandomEffectDataConfiguration("userId", "per-user"),
+            optimization_config=OPT,
+        ),
+    }
+
+
+def test_expand_game_configurations():
+    configs = {
+        "a": CoordinateConfiguration(
+            data_config=FixedEffectDataConfiguration(),
+            optimization_config=OPT,
+            reg_weights=(0.1, 10.0, 1.0),
+        ),
+        "b": CoordinateConfiguration(
+            data_config=FixedEffectDataConfiguration(),
+            optimization_config=OPT,
+            reg_weights=(2.0, 0.5),
+        ),
+    }
+    sweep = expand_game_configurations(configs)
+    assert len(sweep) == 6
+    # strong -> weak regularization within each coordinate
+    assert [c["a"].regularization_weight for c in sweep] == [10.0, 10.0, 1.0, 1.0, 0.1, 0.1]
+    assert [c["b"].regularization_weight for c in sweep[:2]] == [2.0, 0.5]
+
+
+def test_fit_and_select_best(rng):
+    data = make_input(rng)
+    train, val = data.select(np.arange(0, 550)), data.select(np.arange(550, 800))
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=make_configs(reg_weights=(10.0, 0.5)),
+        n_iterations=2,
+    )
+    results = est.fit(train, validation_data=val)
+    assert len(results) == 2  # two reg weights on the fixed coordinate
+    assert [r.configuration["fixed"].regularization_weight for r in results] == [10.0, 0.5]
+    for r in results:
+        assert r.best_metric is not None and r.best_metric > 0.8
+        assert r.evaluations is not None and "AUC" in r.evaluations
+    best = est.select_best_model(results)
+    assert best.best_metric == max(r.best_metric for r in results)
+
+
+def test_fit_without_validation(rng):
+    data = make_input(rng, n=300)
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=make_configs(),
+        n_iterations=1,
+    )
+    results = est.fit(data)
+    assert len(results) == 1
+    assert results[0].best_metric is None
+    assert est.select_best_model(results) is results[0]
+
+
+def test_transformer_scores_and_metrics(rng):
+    data = make_input(rng)
+    train, test = data.select(np.arange(0, 600)), data.select(np.arange(600, 800))
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=make_configs(),
+        n_iterations=2,
+    )
+    model = est.fit(train)[0].model
+    transformer = GameTransformer(
+        model=model, evaluators=[evaluator_for_type(EvaluatorType.AUC)]
+    )
+    scores, metrics = transformer.transform(test)
+    assert scores.shape == (200,)
+    assert metrics["AUC"] > 0.8
+    # per-coordinate decomposition sums to the total (minus offsets here: zero)
+    per = transformer.score_per_coordinate(test)
+    np.testing.assert_allclose(per["fixed"] + per["per-user"], scores, rtol=1e-5)
+
+
+def test_transformer_unseen_entities_score_fixed_only(rng):
+    data = make_input(rng, n=400)
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=make_configs(),
+        n_iterations=1,
+    )
+    model = est.fit(data)[0].model
+    n_new = 50
+    X_new = rng.normal(size=(n_new, 4))
+    new_input = GameInput(
+        features={"global": X_new, "per-user": sp.csr_matrix(np.ones((n_new, 1)))},
+        id_columns={"userId": np.asarray(["stranger"] * n_new, dtype=object)},
+    )
+    per = GameTransformer(model=model).score_per_coordinate(new_input)
+    np.testing.assert_array_equal(per["per-user"], np.zeros(n_new))
+    assert np.abs(per["fixed"]).max() > 0
+
+
+def test_partial_retrain_locks_coordinate(rng):
+    data = make_input(rng, n=500)
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=make_configs(),
+        n_iterations=1,
+    )
+    first = est.fit(data)[0].model
+
+    est2 = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=make_configs(),
+        n_iterations=2,
+        partial_retrain_locked_coordinates=["fixed"],
+    )
+    results = est2.fit(data, initial_model=first)
+    after = results[0].model.get_model("fixed")
+    np.testing.assert_array_equal(
+        np.asarray(after.model.coefficients.means),
+        np.asarray(first.get_model("fixed").model.coefficients.means),
+    )
+
+
+def test_partial_retrain_requires_initial_model(rng):
+    data = make_input(rng, n=200)
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=make_configs(),
+        partial_retrain_locked_coordinates=["fixed"],
+    )
+    with pytest.raises(ValueError, match="initial_model"):
+        est.fit(data)
+
+
+def test_warm_start_chain_improves_or_matches(rng):
+    """Sweep results should all be sane — the warm-start chain must not poison
+    later configs (GameEstimator.fit:344-360 semantics)."""
+    data = make_input(rng)
+    train, val = data.select(np.arange(0, 550)), data.select(np.arange(550, 800))
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=make_configs(reg_weights=(100.0, 1.0, 0.01)),
+        n_iterations=1,
+    )
+    results = est.fit(train, validation_data=val)
+    assert len(results) == 3
+    aucs = [r.best_metric for r in results]
+    assert all(a > 0.75 for a in aucs)
