@@ -1,0 +1,146 @@
+"""Flagship benchmark: GLMix coordinate-descent pass throughput.
+
+Workload = the BASELINE.json north-star shape (config #3): 3-coordinate GLMix
+logistic — one dense fixed effect + per-user + per-item random effects — trained
+by the single-jit SPMD coordinate-descent pass (photon_ml_tpu.parallel.game).
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` compares
+against the same workload run on this machine's CPU backend (recorded once in
+bench_baseline.json; regenerate with ``python bench.py --record-cpu-baseline``) —
+the stand-in for the Spark-CPU node until a real Spark baseline can be measured.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+
+N_SAMPLES = 100_000
+N_FEATURES = 64
+N_USERS = 2_000
+N_ITEMS = 500
+N_PASSES = 3
+FE_ITERS = 50
+RE_ITERS = 30
+
+
+def _build_workload(dtype):
+    import jax.numpy as jnp
+    import numpy as np
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+
+    rng = np.random.default_rng(42)
+    fe_X = rng.normal(size=(N_SAMPLES, N_FEATURES)).astype(np.float32)
+    users = rng.integers(0, N_USERS, size=N_SAMPLES)
+    items = rng.integers(0, N_ITEMS, size=N_SAMPLES)
+    w = rng.normal(size=N_FEATURES) * 0.3
+    z = fe_X @ w + 0.4 * rng.normal(size=N_USERS)[users] + 0.4 * rng.normal(size=N_ITEMS)[items]
+    y = (rng.random(N_SAMPLES) < 1.0 / (1.0 + np.exp(-z))).astype(np.float64)
+    re_feat = sp.csr_matrix(
+        np.concatenate([np.ones((N_SAMPLES, 1), dtype=np.float32), fe_X[:, :7]], axis=1)
+    )
+    ds_u = build_random_effect_dataset(
+        re_feat, users, "userId", labels=y, intercept_index=0, dtype=dtype
+    )
+    ds_i = build_random_effect_dataset(
+        re_feat, items, "itemId", labels=y, intercept_index=0, dtype=dtype
+    )
+    return fe_X, y, ds_u, ds_i
+
+
+def run_benchmark() -> float:
+    """Returns samples/sec through full GLMix coordinate-descent passes."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.optimization.common import OptimizerConfig
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.parallel import build_sharded_game_data, make_mesh, make_jitted_game_step
+    from photon_ml_tpu.parallel.game import init_game_params
+    from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+    fe_X, y, ds_u, ds_i = _build_workload(jnp.float32)
+    mesh = make_mesh(len(jax.devices()))
+    data = build_sharded_game_data(fe_X, y, [ds_u, ds_i], mesh, dtype=jnp.float32)
+
+    fe_cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS, max_iterations=FE_ITERS
+        ),
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    re_cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS, max_iterations=RE_ITERS
+        ),
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    step = make_jitted_game_step(data, TaskType.LOGISTIC_REGRESSION, fe_cfg, [re_cfg, re_cfg], mesh)
+
+    params = init_game_params(data, mesh)
+    params, diag = step(params)  # compile + warm-up pass
+    jax.block_until_ready(params)
+
+    t0 = time.perf_counter()
+    for _ in range(N_PASSES):
+        params, diag = step(params)
+    jax.block_until_ready(params)
+    elapsed = time.perf_counter() - t0
+
+    assert float(diag["fe_value"]) > 0.0
+    return N_SAMPLES * N_PASSES / elapsed
+
+
+def main():
+    if "--record-cpu-baseline" in sys.argv:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        value = run_benchmark()
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(
+                {
+                    "metric": "glmix_cd_pass_samples_per_sec",
+                    "value": value,
+                    "backend": "cpu",
+                    "note": "same workload on this machine's CPU JAX backend "
+                    "(stand-in for the Spark-CPU baseline node)",
+                },
+                f,
+            )
+        print(json.dumps({"recorded_cpu_baseline": value}))
+        return
+
+    value = run_benchmark()
+    baseline = None
+    if os.path.exists(BASELINE_PATH):
+        with open(BASELINE_PATH) as f:
+            baseline = json.load(f).get("value")
+    vs = value / baseline if baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "glmix_cd_pass_samples_per_sec",
+                "value": round(value, 2),
+                "unit": "samples/sec",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

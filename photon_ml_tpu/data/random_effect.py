@@ -152,6 +152,11 @@ def build_random_effect_dataset(
         active_data_upper_bound = None
         active_data_lower_bound = 1
         features_max = None
+    elif labels is None:
+        raise ValueError(
+            "labels are required to build training buckets; pass scoring_only=True "
+            "for validation/transform datasets that only need the scoring view"
+        )
     X = X.tocsr()
     n, d = X.shape
     base_weights = np.ones(n) if weights is None else np.asarray(weights, dtype=np.float64)

@@ -1,0 +1,139 @@
+"""Hyperparameter evaluation function over GameEstimator fits.
+
+Parity target: photon-client estimators/GameEstimatorEvaluationFunction.scala:1-244 —
+candidate vectors in [0, 1]^d map (through per-coordinate ranges, natural-log scale
+for regularization weights, linear for elastic-net alpha) to a full GAME
+optimization configuration; each evaluation is a complete fit + validation, and the
+primary metric (sign-flipped for maximize-metrics) is the search value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.types import RegularizationType
+
+DEFAULT_REG_WEIGHT_RANGE = (1e-4, 1e4)
+DEFAULT_REG_ALPHA_RANGE = (0.0, 1.0)
+
+
+@dataclasses.dataclass
+class GameEstimatorEvaluationFunction:
+    """EvaluationFunction over full GAME training runs.
+
+    ``base_configs`` maps coordinate id -> base GLMOptimizationConfiguration; each
+    non-ELASTIC_NET coordinate contributes one dimension (ln reg weight), each
+    ELASTIC_NET coordinate two (ln weight, alpha). Lower evaluation value is
+    better: metric values are negated when the primary evaluator maximizes.
+    """
+
+    estimator: object  # GameEstimator
+    base_configs: dict[str, GLMOptimizationConfiguration]
+    data: object  # GameInput
+    validation_data: object  # GameInput
+    is_opt_max: bool
+    reg_weight_range: tuple[float, float] = DEFAULT_REG_WEIGHT_RANGE
+    alpha_range: tuple[float, float] = DEFAULT_REG_ALPHA_RANGE
+
+    def __post_init__(self):
+        self._coord_ids = sorted(self.base_configs)
+        ranges = []
+        for cid in self._coord_ids:
+            cfg = self.base_configs[cid]
+            wr = getattr(cfg, "regularization_weight_range", None) or self.reg_weight_range
+            ranges.append((math.log(wr[0]), math.log(wr[1])))
+            if cfg.regularization_context.regularization_type == RegularizationType.ELASTIC_NET:
+                ar = getattr(cfg, "elastic_net_alpha_range", None) or self.alpha_range
+                ranges.append(tuple(ar))
+        self.ranges = ranges
+        self.num_params = len(ranges)
+
+    # -- candidate <-> configuration ----------------------------------------------
+
+    def vector_to_configuration(
+        self, scaled: np.ndarray
+    ) -> dict[str, GLMOptimizationConfiguration]:
+        """Vector in RANGE space (ln weights) -> per-coordinate configs."""
+        if len(scaled) != self.num_params:
+            raise ValueError(f"dimension mismatch: {len(scaled)} != {self.num_params}")
+        out = {}
+        i = 0
+        for cid in self._coord_ids:
+            cfg = self.base_configs[cid]
+            weight = math.exp(scaled[i])
+            i += 1
+            if cfg.regularization_context.regularization_type == RegularizationType.ELASTIC_NET:
+                alpha = float(np.clip(scaled[i], 0.0, 1.0))
+                i += 1
+                ctx = dataclasses.replace(cfg.regularization_context, elastic_net_alpha=alpha)
+                out[cid] = dataclasses.replace(
+                    cfg, regularization_context=ctx, regularization_weight=weight
+                )
+            else:
+                out[cid] = cfg.with_weight(weight)
+        return out
+
+    def configuration_to_vector(
+        self, configuration: dict[str, GLMOptimizationConfiguration]
+    ) -> np.ndarray:
+        if set(configuration) != set(self.base_configs):
+            raise ValueError("configuration coordinates do not match the base configuration")
+        vals = []
+        for cid in self._coord_ids:
+            cfg = configuration[cid]
+            vals.append(math.log(cfg.regularization_weight))
+            if cfg.regularization_context.regularization_type == RegularizationType.ELASTIC_NET:
+                vals.append(cfg.regularization_context.elastic_net_alpha)
+        return np.asarray(vals, dtype=np.float64)
+
+    def _scale_backward(self, candidate: np.ndarray) -> np.ndarray:
+        lo = np.array([r[0] for r in self.ranges])
+        hi = np.array([r[1] for r in self.ranges])
+        return np.asarray(candidate, dtype=np.float64) * (hi - lo) + lo
+
+    def _scale_forward(self, vec: np.ndarray) -> np.ndarray:
+        lo = np.array([r[0] for r in self.ranges])
+        hi = np.array([r[1] for r in self.ranges])
+        return (np.asarray(vec, dtype=np.float64) - lo) / (hi - lo)
+
+    # -- EvaluationFunction interface ----------------------------------------------
+
+    def __call__(self, candidate: np.ndarray) -> tuple[float, object]:
+        configs = self.vector_to_configuration(self._scale_backward(candidate))
+        result = self._fit_with(configs)
+        return self.get_evaluation_value(result), result
+
+    def _fit_with(self, configs) -> object:
+        est = self.estimator
+        # re-point each coordinate's optimization config at the candidate's values
+        old = est.coordinate_configurations
+        new = {
+            cid: dataclasses.replace(
+                c, optimization_config=configs.get(cid, c.optimization_config), reg_weights=()
+            )
+            for cid, c in old.items()
+        }
+        est = dataclasses.replace(est, coordinate_configurations=new)
+        results = est.fit(self.data, validation_data=self.validation_data)
+        return results[0]
+
+    def convert_observations(self, results: Sequence) -> list[tuple[np.ndarray, float]]:
+        out = []
+        for r in results:
+            point = self._scale_forward(self.vectorize_params(r))
+            out.append((point, self.get_evaluation_value(r)))
+        return out
+
+    def vectorize_params(self, result) -> np.ndarray:
+        return self.configuration_to_vector(result.configuration)
+
+    def get_evaluation_value(self, result) -> float:
+        if result.best_metric is None:
+            raise ValueError("GAME result has no validation evaluations")
+        direction = -1.0 if self.is_opt_max else 1.0
+        return direction * float(result.best_metric)

@@ -53,6 +53,7 @@ from photon_ml_tpu.evaluation.evaluators import (
     EvaluatorType,
     MultiEvaluator,
     evaluator_for_type,
+    resolve_evaluator,
 )
 from photon_ml_tpu.models.game import GameModel
 from photon_ml_tpu.normalization import NO_NORMALIZATION, NormalizationContext
@@ -74,14 +75,6 @@ def default_evaluator_type(task: TaskType) -> EvaluatorType:
     }[task]
 
 
-def resolve_evaluator(spec):
-    """Accept EvaluatorType | Evaluator | MultiEvaluator | (EvaluatorType, id_tag)."""
-    if isinstance(spec, (Evaluator, MultiEvaluator)):
-        return spec
-    if isinstance(spec, tuple):
-        base, id_tag = spec
-        return MultiEvaluator(evaluator_for_type(EvaluatorType(base)), id_tag)
-    return evaluator_for_type(EvaluatorType(spec))
 
 
 @dataclasses.dataclass

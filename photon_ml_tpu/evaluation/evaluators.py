@@ -193,6 +193,16 @@ class MultiEvaluator:
         return float(np.mean(vals)) if vals else float("nan")
 
 
+def resolve_evaluator(spec):
+    """Accept EvaluatorType | Evaluator | MultiEvaluator | (EvaluatorType, id_tag)."""
+    if isinstance(spec, (Evaluator, MultiEvaluator)):
+        return spec
+    if isinstance(spec, tuple):
+        base, id_tag = spec
+        return MultiEvaluator(evaluator_for_type(EvaluatorType(base)), id_tag)
+    return evaluator_for_type(EvaluatorType(spec))
+
+
 def evaluator_for_type(etype: EvaluatorType, k: int = 10) -> Evaluator:
     """EvaluatorFactory (photon-api evaluation/EvaluatorFactory.scala:65)."""
     etype = EvaluatorType(etype)

@@ -1,0 +1,52 @@
+"""Hyperparameter auto-tuning: Sobol random search + Bayesian GP search.
+
+Re-designs the reference's hyperparameter stack (photon-lib hyperparameter/*,
+photon-api hyperparameter/tuner/*; SURVEY §2.1 "Hyperparameter search math",
+§3.4 call stack) in numpy/scipy: kernels, slice-sampled GP ensembles, EI/CB
+acquisition, vector rescaling, JSON config/prior serialization, tuner dispatch.
+"""
+
+from photon_ml_tpu.hyperparameter.kernels import RBF, Matern52, StationaryKernel
+from photon_ml_tpu.hyperparameter.slice_sampler import SliceSampler
+from photon_ml_tpu.hyperparameter.criteria import (
+    ConfidenceBound,
+    ExpectedImprovement,
+    PredictionTransformation,
+)
+from photon_ml_tpu.hyperparameter.estimators import (
+    GaussianProcessEstimator,
+    GaussianProcessModel,
+)
+from photon_ml_tpu.hyperparameter.search import GaussianProcessSearch, RandomSearch
+from photon_ml_tpu.hyperparameter.evaluation import EvaluationFunction
+from photon_ml_tpu.hyperparameter import rescaling
+from photon_ml_tpu.hyperparameter.serialization import (
+    HyperparameterConfig,
+    config_from_json,
+    config_to_json,
+    prior_from_json,
+)
+from photon_ml_tpu.hyperparameter.tuner import AtlasTuner, DummyTuner, build_tuner
+
+__all__ = [
+    "RBF",
+    "Matern52",
+    "StationaryKernel",
+    "SliceSampler",
+    "ConfidenceBound",
+    "ExpectedImprovement",
+    "PredictionTransformation",
+    "GaussianProcessEstimator",
+    "GaussianProcessModel",
+    "GaussianProcessSearch",
+    "RandomSearch",
+    "EvaluationFunction",
+    "rescaling",
+    "HyperparameterConfig",
+    "config_from_json",
+    "config_to_json",
+    "prior_from_json",
+    "AtlasTuner",
+    "DummyTuner",
+    "build_tuner",
+]

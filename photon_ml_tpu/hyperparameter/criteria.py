@@ -1,0 +1,47 @@
+"""Acquisition criteria for Bayesian hyperparameter search.
+
+Parity targets: photon-lib hyperparameter/criteria/ExpectedImprovement.scala
+(PBO eqs. 1-2; maximized) and ConfidenceBound.scala (PBO eq. 3; minimized).
+Evaluation metrics are arranged so LOWER is better (the search negates
+maximize-metrics), hence EI of improvement BELOW best_evaluation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+from scipy.stats import norm
+
+
+class PredictionTransformation:
+    is_max_opt: bool = True
+
+    def __call__(self, means: np.ndarray, variances: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class ExpectedImprovement(PredictionTransformation):
+    """EI over the current best (lowest) observed evaluation; maximized."""
+
+    best_evaluation: float
+    is_max_opt: bool = dataclasses.field(default=True, init=False)
+
+    def __call__(self, means: np.ndarray, variances: np.ndarray) -> np.ndarray:
+        std = np.sqrt(np.maximum(np.asarray(variances, dtype=np.float64), 0.0))
+        std = np.where(std > 0, std, 1e-12)
+        gamma = -(np.asarray(means, dtype=np.float64) - self.best_evaluation) / std
+        return std * (gamma * norm.cdf(gamma) + norm.pdf(gamma))
+
+
+@dataclasses.dataclass
+class ConfidenceBound(PredictionTransformation):
+    """Lower confidence bound mean - k*std; minimized."""
+
+    exploration_factor: float = 2.0
+    is_max_opt: bool = dataclasses.field(default=False, init=False)
+
+    def __call__(self, means: np.ndarray, variances: np.ndarray) -> np.ndarray:
+        std = np.sqrt(np.maximum(np.asarray(variances, dtype=np.float64), 0.0))
+        return np.asarray(means, dtype=np.float64) - self.exploration_factor * std

@@ -1,0 +1,29 @@
+"""EvaluationFunction contract for hyperparameter search.
+
+Parity target: photon-lib hyperparameter/EvaluationFunction.scala — a callable
+from a candidate vector in [0, 1]^d to (evaluation value, result object), plus
+observation-conversion helpers used to seed searches from past results. LOWER
+evaluation values are better (maximize-metrics are negated by the caller).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+import numpy as np
+
+
+class EvaluationFunction(Protocol):
+    def __call__(self, hyperparameters: np.ndarray) -> tuple[float, object]:
+        """Evaluate one candidate: returns (value, result)."""
+        ...
+
+    def convert_observations(self, results: Sequence) -> list[tuple[np.ndarray, float]]:
+        """Past results -> (vectorized point, evaluation value) pairs."""
+        ...
+
+    def vectorize_params(self, result) -> np.ndarray:
+        ...
+
+    def get_evaluation_value(self, result) -> float:
+        ...

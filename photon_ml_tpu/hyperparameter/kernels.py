@@ -1,0 +1,133 @@
+"""Stationary GP covariance kernels: RBF and Matern 5/2.
+
+Parity targets: photon-lib hyperparameter/estimators/kernels/StationaryKernel.scala
+(squared-distance form, amplitude/noise/length-scale parameterization, log-marginal
+likelihood with lognormal amplitude prior + horseshoe noise prior + tophat
+length-scale prior), RBF.scala, Matern52.scala. The reference's O(n^2) scalar
+distance loops become vectorized numpy; the GP sizes here (tens of observations)
+don't warrant the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+DEFAULT_NOISE = 1e-4
+
+
+@dataclasses.dataclass(frozen=True)
+class StationaryKernel:
+    """theta = [amplitude, noise, *length_scale] (StationaryKernel.getParams)."""
+
+    amplitude: float = 1.0
+    noise: float = DEFAULT_NOISE
+    length_scale: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.array([1.0])
+    )
+
+    # priors (StationaryKernel.scala: amplitudeScale / noiseScale / lengthScaleMax)
+    amplitude_scale: float = 1.0
+    noise_scale: float = 0.1
+    length_scale_max: float = 2.0
+
+    def _from_sq_distances(self, d2: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _ls(self, n_cols: int) -> np.ndarray:
+        ls = np.asarray(self.length_scale, dtype=np.float64).ravel()
+        if ls.size == 1:
+            return np.full(n_cols, ls[0])
+        if ls.size != n_cols:
+            raise ValueError(f"length_scale has {ls.size} entries for {n_cols} features")
+        return ls
+
+    def gram(self, x: np.ndarray) -> np.ndarray:
+        """K(x, x) + noise * I."""
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        xs = x / self._ls(x.shape[1])
+        d2 = _sq_dists(xs, xs)
+        return self.amplitude * self._from_sq_distances(d2) + self.noise * np.eye(len(x))
+
+    def cross(self, x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
+        """K(x1, x2) (no noise)."""
+        x1 = np.atleast_2d(np.asarray(x1, dtype=np.float64))
+        x2 = np.atleast_2d(np.asarray(x2, dtype=np.float64))
+        ls = self._ls(x1.shape[1])
+        return self.amplitude * self._from_sq_distances(_sq_dists(x1 / ls, x2 / ls))
+
+    @property
+    def params(self) -> np.ndarray:
+        return np.concatenate(
+            [[self.amplitude, self.noise], np.asarray(self.length_scale).ravel()]
+        )
+
+    def with_params(self, theta: np.ndarray) -> "StationaryKernel":
+        theta = np.asarray(theta, dtype=np.float64).ravel()
+        return dataclasses.replace(
+            self, amplitude=float(theta[0]), noise=float(theta[1]), length_scale=theta[2:]
+        )
+
+    def initial_kernel(self, x: np.ndarray, y: np.ndarray) -> "StationaryKernel":
+        """amplitude = stddev(y) (Matern52.getInitialKernel / RBF.getInitialKernel)."""
+        amp = float(np.std(np.asarray(y), ddof=1)) if len(y) > 1 else 1.0
+        return dataclasses.replace(self, amplitude=amp if amp > 0 else 1.0)
+
+    def log_likelihood(self, x: np.ndarray, y: np.ndarray) -> float:
+        """GP log-marginal likelihood (GPML alg. 2.1) + parameter priors
+        (StationaryKernel.logLikelihood)."""
+        ls = np.asarray(self.length_scale, dtype=np.float64).ravel()
+        if self.amplitude < 0.0 or self.noise < 0.0 or np.any(ls < 0.0):
+            return -np.inf
+        if np.any(ls > self.length_scale_max):  # tophat prior
+            return -np.inf
+        k = self.gram(x)
+        y = np.asarray(y, dtype=np.float64).ravel()
+        try:
+            L = np.linalg.cholesky(k)
+        except np.linalg.LinAlgError:
+            return -np.inf
+        alpha = _cholesky_solve(L, y)
+        ll = (
+            -0.5 * float(y @ alpha)
+            - float(np.sum(np.log(np.diag(L))))
+            - len(y) / 2.0 * np.log(2 * np.pi)
+        )
+        # lognormal amplitude prior + horseshoe noise prior
+        ll += -0.5 * np.log(np.sqrt(self.amplitude / self.amplitude_scale)) ** 2
+        if self.noise > 0:
+            ll += np.log(np.log(1.0 + (self.noise_scale / self.noise) ** 2))
+        return ll
+
+
+def _sq_dists(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    d2 = (
+        np.sum(a * a, axis=1)[:, None]
+        + np.sum(b * b, axis=1)[None, :]
+        - 2.0 * (a @ b.T)
+    )
+    return np.maximum(d2, 0.0)
+
+
+def _cholesky_solve(L: np.ndarray, b: np.ndarray) -> np.ndarray:
+    from scipy.linalg import solve_triangular
+
+    return solve_triangular(L.T, solve_triangular(L, b, lower=True), lower=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class RBF(StationaryKernel):
+    """K = amplitude * exp(-d^2 / 2) (RBF.scala)."""
+
+    def _from_sq_distances(self, d2: np.ndarray) -> np.ndarray:
+        return np.exp(-0.5 * d2)
+
+
+@dataclasses.dataclass(frozen=True)
+class Matern52(StationaryKernel):
+    """K = amplitude * (1 + sqrt(5 d^2) + 5/3 d^2) exp(-sqrt(5 d^2)) (Matern52.scala)."""
+
+    def _from_sq_distances(self, d2: np.ndarray) -> np.ndarray:
+        f = np.sqrt(5.0 * d2)
+        return (f + 5.0 / 3.0 * d2 + 1.0) * np.exp(-f)

@@ -1,0 +1,64 @@
+"""Candidate-vector rescaling between [0, 1]^d and hyperparameter ranges.
+
+Parity target: photon-lib hyperparameter/VectorRescaling.scala — LOG (base-10) and
+SQRT transforms by index, forward/backward range scaling with the +1 adjustment on
+discrete dimensions (so the rounded grid covers max inclusively).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence, Set
+
+import numpy as np
+
+LOG_TRANSFORM = "LOG"
+SQRT_TRANSFORM = "SQRT"
+
+
+def transform_forward(vector: np.ndarray, transform_map: Mapping[int, str]) -> np.ndarray:
+    out = np.array(vector, dtype=np.float64)
+    for index, transform in transform_map.items():
+        if transform == LOG_TRANSFORM:
+            out[index] = np.log10(out[index])
+        elif transform == SQRT_TRANSFORM:
+            out[index] = np.sqrt(out[index])
+        else:
+            raise ValueError(f"Unknown transformation: {transform}")
+    return out
+
+
+def transform_backward(vector: np.ndarray, transform_map: Mapping[int, str]) -> np.ndarray:
+    out = np.array(vector, dtype=np.float64)
+    for index, transform in transform_map.items():
+        if transform == LOG_TRANSFORM:
+            out[index] = 10.0 ** out[index]
+        elif transform == SQRT_TRANSFORM:
+            out[index] = out[index] ** 2
+        else:
+            raise ValueError(f"Unknown transformation: {transform}")
+    return out
+
+
+def _range_arrays(ranges: Sequence[tuple[float, float]], discrete: Set[int]):
+    start = np.array([r[0] for r in ranges], dtype=np.float64)
+    end = np.array([r[1] for r in ranges], dtype=np.float64)
+    adj = np.array([1.0 if i in discrete else 0.0 for i in range(len(ranges))])
+    return start, end, adj
+
+
+def scale_forward(
+    vector: np.ndarray,
+    ranges: Sequence[tuple[float, float]],
+    discrete_index_set: Set[int] = frozenset(),
+) -> np.ndarray:
+    start, end, adj = _range_arrays(ranges, discrete_index_set)
+    return (np.asarray(vector, dtype=np.float64) - start) / (end - start + adj)
+
+
+def scale_backward(
+    vector: np.ndarray,
+    ranges: Sequence[tuple[float, float]],
+    discrete_index_set: Set[int] = frozenset(),
+) -> np.ndarray:
+    start, end, adj = _range_arrays(ranges, discrete_index_set)
+    return np.asarray(vector, dtype=np.float64) * (end - start + adj) + start

@@ -1,0 +1,184 @@
+"""Hyperparameter search: Sobol quasi-random + Bayesian GP search.
+
+Parity targets: photon-lib hyperparameter/search/RandomSearch.scala:34-183 (Sobol
+draws in [0,1]^d, seed-skipped generator, discretization of discrete dims,
+findWithPriors warm-start protocol) and GaussianProcessSearch.scala:52-197
+(fit GP to mean-centered observations + prior observations, pick the candidate
+maximizing Expected Improvement from a Sobol candidate pool; fall back to uniform
+search until #observations > #params).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+from scipy.stats import qmc
+
+from photon_ml_tpu.hyperparameter.criteria import ExpectedImprovement, PredictionTransformation
+from photon_ml_tpu.hyperparameter.estimators import GaussianProcessEstimator, GaussianProcessModel
+from photon_ml_tpu.hyperparameter.evaluation import EvaluationFunction
+from photon_ml_tpu.hyperparameter.kernels import Matern52, StationaryKernel
+
+
+class RandomSearch:
+    """Quasi-random (Sobol) search over [0, 1]^num_params."""
+
+    def __init__(
+        self,
+        num_params: int,
+        evaluation_function: EvaluationFunction,
+        discrete_params: Optional[Mapping[int, int]] = None,
+        kernel: Optional[StationaryKernel] = None,
+        seed: int = 0,
+    ):
+        if num_params <= 0:
+            raise ValueError("num_params must be positive")
+        self.num_params = num_params
+        self.evaluation_function = evaluation_function
+        self.discrete_params = dict(discrete_params or {})
+        self.kernel = kernel if kernel is not None else Matern52()
+        self.seed = seed
+        self._sobol = qmc.Sobol(d=num_params, scramble=False)
+        # the reference skips the generator forward by the seed to decorrelate runs
+        # (scipy's generator is capped at 2**30 points and rejects a 0 skip)
+        skip = seed % (2**20)
+        if skip:
+            self._sobol.fast_forward(skip)
+
+    # -- public API (find / findWithPriorObservations / findWithPriors) -----------
+
+    def find(self, n: int) -> list:
+        return self.find_with_prior_observations(n, [])
+
+    def find_with_prior_observations(self, n: int, prior_observations: Sequence) -> list:
+        if n <= 0:
+            raise ValueError("n must be positive")
+        candidate = self._discretize(self.draw_candidates(1)[0])
+        _, result = self.evaluation_function(candidate)
+        if n == 1:
+            return [result]
+        observations = self.evaluation_function.convert_observations([result])
+        return [result] + self.find_with_priors(n - 1, observations, prior_observations)
+
+    def find_with_priors(
+        self,
+        n: int,
+        observations: Sequence[tuple[np.ndarray, float]],
+        prior_observations: Sequence[tuple[np.ndarray, float]] = (),
+    ) -> list:
+        """Observations are (point, value) with LOWER value better; prior
+        observations are mean-centered values from past datasets."""
+        if n <= 0:
+            raise ValueError("n must be positive")
+        if not observations:
+            raise ValueError("at least one observation is required")
+        for point, value in list(observations)[:-1]:
+            self.on_observation(np.asarray(point, dtype=np.float64), float(value))
+        for point, value in prior_observations:
+            self.on_prior_observation(np.asarray(point, dtype=np.float64), float(value))
+
+        results = []
+        last_candidate, last_observation = observations[-1]
+        last_candidate = np.asarray(last_candidate, dtype=np.float64)
+        for _ in range(n):
+            candidate = self._discretize(self.next(last_candidate, float(last_observation)))
+            observation, result = self.evaluation_function(candidate)
+            results.append(result)
+            last_candidate, last_observation = candidate, observation
+        return results
+
+    # -- extension points ----------------------------------------------------------
+
+    def next(self, last_candidate: np.ndarray, last_observation: float) -> np.ndarray:
+        return self.draw_candidates(1)[0]
+
+    def on_observation(self, point: np.ndarray, value: float) -> None:
+        pass
+
+    def on_prior_observation(self, point: np.ndarray, value: float) -> None:
+        pass
+
+    # -- helpers -------------------------------------------------------------------
+
+    def draw_candidates(self, n: int) -> np.ndarray:
+        return self._sobol.random(n)
+
+    def _discretize(self, candidate: np.ndarray) -> np.ndarray:
+        out = np.array(candidate, dtype=np.float64)
+        for index, num_values in self.discrete_params.items():
+            out[index] = np.floor(out[index] * num_values) / num_values
+        return out
+
+
+class GaussianProcessSearch(RandomSearch):
+    """Bayesian search: GP posterior + Expected Improvement over a candidate pool."""
+
+    def __init__(
+        self,
+        num_params: int,
+        evaluation_function: EvaluationFunction,
+        discrete_params: Optional[Mapping[int, int]] = None,
+        kernel: Optional[StationaryKernel] = None,
+        candidate_pool_size: int = 250,
+        noisy_target: bool = True,
+        seed: int = 0,
+    ):
+        super().__init__(num_params, evaluation_function, discrete_params, kernel, seed)
+        self.candidate_pool_size = candidate_pool_size
+        self.noisy_target = noisy_target
+        self._points: list[np.ndarray] = []
+        self._evals: list[float] = []
+        self._best_eval = np.inf
+        self._prior_points: list[np.ndarray] = []
+        self._prior_evals: list[float] = []
+        self._prior_best_eval = np.inf
+        self.last_model: Optional[GaussianProcessModel] = None
+
+    def next(self, last_candidate: np.ndarray, last_observation: float) -> np.ndarray:
+        self.on_observation(last_candidate, last_observation)
+        # under-determined until #observations > #params: fall back to uniform
+        if len(self._points) <= self.num_params:
+            return super().next(last_candidate, last_observation)
+
+        candidates = self.draw_candidates(self.candidate_pool_size)
+        evals = np.asarray(self._evals)
+        current_mean = float(np.mean(evals))
+        overall_best = min(self._prior_best_eval, self._best_eval - current_mean)
+        transformation = ExpectedImprovement(overall_best)
+
+        points = np.vstack(self._points)
+        centered = evals - current_mean
+        if self._prior_points:
+            points = np.vstack([points, np.vstack(self._prior_points)])
+            centered = np.concatenate([centered, np.asarray(self._prior_evals)])
+
+        estimator = GaussianProcessEstimator(
+            kernel=self.kernel,
+            normalize_labels=False,
+            noisy_target=self.noisy_target,
+            prediction_transformation=transformation,
+            seed=self.seed,
+        )
+        self.last_model = estimator.fit(points, centered)
+        predictions = self.last_model.predict_transformed(candidates)
+        return self._select_best_candidate(candidates, predictions, transformation)
+
+    def on_observation(self, point: np.ndarray, value: float) -> None:
+        self._points.append(np.asarray(point, dtype=np.float64))
+        self._evals.append(float(value))
+        self._best_eval = min(self._best_eval, float(value))
+
+    def on_prior_observation(self, point: np.ndarray, value: float) -> None:
+        self._prior_points.append(np.asarray(point, dtype=np.float64))
+        self._prior_evals.append(float(value))
+        self._prior_best_eval = min(self._prior_best_eval, float(value))
+
+    @staticmethod
+    def _select_best_candidate(
+        candidates: np.ndarray,
+        predictions: np.ndarray,
+        transformation: PredictionTransformation,
+    ) -> np.ndarray:
+        idx = np.argmax(predictions) if transformation.is_max_opt else np.argmin(predictions)
+        return candidates[idx]

@@ -1,0 +1,97 @@
+"""Hyperparameter tuning config + prior-observation JSON (de)serialization.
+
+Parity target: photon-lib hyperparameter/HyperparameterSerialization.scala and
+HyperparameterConfig.scala. JSON layout:
+
+    {"tuning_mode": "BAYESIAN" | "RANDOM",
+     "variables": {"<name>": {"type": "DOUBLE" | "INT", "min": ..., "max": ...,
+                              "transform": "LOG" | "SQRT" (optional)}, ...}}
+
+Priors: {"records": [{"<param>": "<value>", ..., "evaluationValue": "<v>"}, ...]}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.types import HyperparameterTuningMode
+
+LOG_TRANSFORM = "LOG"
+SQRT_TRANSFORM = "SQRT"
+
+
+@dataclasses.dataclass(frozen=True)
+class HyperparameterConfig:
+    tuning_mode: HyperparameterTuningMode
+    names: tuple
+    ranges: tuple  # of (min, max)
+    discrete_params: Mapping[int, int]
+    transform_map: Mapping[int, str]
+
+
+def config_from_json(json_config: str) -> HyperparameterConfig:
+    data = json.loads(json_config)
+    mode_str = data["tuning_mode"]
+    mode = (
+        HyperparameterTuningMode.BAYESIAN
+        if mode_str == "BAYESIAN"
+        else HyperparameterTuningMode.RANDOM
+        if mode_str == "RANDOM"
+        else HyperparameterTuningMode.NONE
+    )
+    variables = data["variables"]
+    names, ranges, discrete, transforms = [], [], {}, {}
+    for index, (name, spec) in enumerate(variables.items()):
+        names.append(name)
+        lo, hi = float(spec["min"]), float(spec["max"])
+        ranges.append((lo, hi))
+        if spec["type"] == "INT":
+            discrete[index] = int(hi - lo) + 1
+        transform = spec.get("transform")
+        if transform is not None:
+            if transform not in (LOG_TRANSFORM, SQRT_TRANSFORM):
+                raise ValueError(f"The transformation is not valid: {transform}")
+            transforms[index] = transform
+    return HyperparameterConfig(
+        tuning_mode=mode,
+        names=tuple(names),
+        ranges=tuple(ranges),
+        discrete_params=discrete,
+        transform_map=transforms,
+    )
+
+
+def prior_from_json(
+    prior_data_json: str,
+    prior_default: Mapping[str, str],
+    hyperparameter_list: Sequence[str],
+) -> list[tuple[np.ndarray, float]]:
+    data = json.loads(prior_data_json)
+    out = []
+    for record in data["records"]:
+        value = float(record["evaluationValue"])
+        point = np.array(
+            [float(record.get(name, prior_default[name])) for name in hyperparameter_list]
+        )
+        out.append((point, value))
+    return out
+
+
+def config_to_json(config: HyperparameterConfig) -> str:
+    variables = {}
+    for i, name in enumerate(config.names):
+        spec: dict = {
+            "type": "INT" if i in config.discrete_params else "DOUBLE",
+            "min": config.ranges[i][0],
+            "max": config.ranges[i][1],
+        }
+        if i in config.transform_map:
+            spec["transform"] = config.transform_map[i]
+        variables[name] = spec
+    return json.dumps(
+        {"tuning_mode": config.tuning_mode.value, "variables": variables}, indent=2
+    )

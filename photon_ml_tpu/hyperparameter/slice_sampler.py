@@ -1,0 +1,67 @@
+"""Slice sampler with stepping-out and shrinkage (Neal 2003).
+
+Parity target: photon-lib hyperparameter/SliceSampler.scala:1-216 — random-direction
+draw, dimension-wise draw over a shuffled axis order, step-out width doubling capped
+at max_steps_out, slice shrinkage on rejection.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+LogP = Callable[[np.ndarray], float]
+
+
+class SliceSampler:
+    def __init__(self, step_size: float = 1.0, max_steps_out: int = 1000, seed: int = 0):
+        self.step_size = step_size
+        self.max_steps_out = max_steps_out
+        self.rng = np.random.default_rng(seed)
+
+    def draw(self, x: np.ndarray, logp: LogP) -> np.ndarray:
+        """One draw along a uniformly random direction."""
+        x = np.asarray(x, dtype=np.float64)
+        direction = self.rng.normal(size=x.shape)
+        direction = direction / np.linalg.norm(direction)
+        return self._draw_along(x, logp, direction)
+
+    def draw_dimension_wise(self, x: np.ndarray, logp: LogP) -> np.ndarray:
+        """One draw per coordinate axis, axes visited in shuffled order."""
+        x = np.asarray(x, dtype=np.float64)
+        order = self.rng.permutation(len(x))
+        for i in order:
+            e = np.zeros_like(x)
+            e[i] = 1.0
+            x = self._draw_along(x, logp, e)
+        return x
+
+    def _draw_along(self, x: np.ndarray, logp: LogP, direction: np.ndarray) -> np.ndarray:
+        y = np.log(self.rng.random()) + logp(x)
+        lower, upper = self._step_out(x, y, logp, direction)
+        while True:
+            new_x = lower + self.rng.random() * (upper - lower)
+            if logp(new_x) > y:
+                return new_x
+            # shrink toward x
+            if new_x @ direction < x @ direction:
+                lower = new_x
+            elif new_x @ direction > x @ direction:
+                upper = new_x
+            else:
+                # degenerate slice: no room left to move
+                return x
+
+    def _step_out(self, x, y, logp, direction):
+        lower = x - direction * self.rng.random() * self.step_size
+        upper = lower + direction * self.step_size
+        steps = 0
+        while logp(lower) > y and steps < self.max_steps_out:
+            lower = lower - direction * self.step_size
+            steps += 1
+        steps = 0
+        while logp(upper) > y and steps < self.max_steps_out:
+            upper = upper + direction * self.step_size
+            steps += 1
+        return lower, upper

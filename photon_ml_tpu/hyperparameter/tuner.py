@@ -1,0 +1,79 @@
+"""Hyperparameter tuner dispatch.
+
+Parity targets: photon-api hyperparameter/tuner/HyperparameterTuner.scala (:47),
+HyperparameterTunerFactory.scala (DUMMY -> no-op, ATLAS -> reflection-loaded
+tuner) and AtlasTuner.scala:41-60 (RANDOM -> RandomSearch, BAYESIAN ->
+GaussianProcessSearch). No reflection needed here; the "Atlas" tuner is in-repo.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.hyperparameter.evaluation import EvaluationFunction  # noqa: F401
+from photon_ml_tpu.hyperparameter.search import GaussianProcessSearch, RandomSearch
+from photon_ml_tpu.types import HyperparameterTuningMode
+
+
+class HyperparameterTuner:
+    """search(n, dimension, mode, evaluation_function, observations, ...) -> results."""
+
+    def search(
+        self,
+        n: int,
+        dimension: int,
+        mode: HyperparameterTuningMode,
+        evaluation_function: EvaluationFunction,
+        observations: Sequence[tuple[np.ndarray, float]],
+        prior_observations: Sequence[tuple[np.ndarray, float]] = (),
+        discrete_params: Optional[dict] = None,
+        seed: int = 0,
+    ) -> list:
+        raise NotImplementedError
+
+
+class DummyTuner(HyperparameterTuner):
+    """No-op tuner (HyperparameterTunerFactory DUMMY): returns no results."""
+
+    def search(self, n, dimension, mode, evaluation_function, observations,
+               prior_observations=(), discrete_params=None, seed=0) -> list:
+        return []
+
+
+class AtlasTuner(HyperparameterTuner):
+    """Dispatches RANDOM / BAYESIAN search (AtlasTuner.scala:41-60)."""
+
+    def search(self, n, dimension, mode, evaluation_function, observations,
+               prior_observations=(), discrete_params=None, seed=0) -> list:
+        mode = HyperparameterTuningMode(mode)
+        if mode == HyperparameterTuningMode.NONE or n <= 0:
+            return []
+        cls = (
+            GaussianProcessSearch
+            if mode == HyperparameterTuningMode.BAYESIAN
+            else RandomSearch
+        )
+        searcher = cls(dimension, evaluation_function, discrete_params=discrete_params, seed=seed)
+        # The search contract expects PRIOR observations mean-centered (they are
+        # combined with this dataset's mean-centered evals and compared against a
+        # centered incumbent in GaussianProcessSearch.next); raw values come out of
+        # prior_from_json, so center them here.
+        priors = list(prior_observations)
+        if priors:
+            prior_mean = float(np.mean([v for _, v in priors]))
+            priors = [(p, v - prior_mean) for p, v in priors]
+        if observations:
+            return searcher.find_with_priors(n, list(observations), priors)
+        return searcher.find_with_prior_observations(n, priors)
+
+
+def build_tuner(name: str = "ATLAS") -> HyperparameterTuner:
+    """DUMMY -> DummyTuner, ATLAS -> AtlasTuner (HyperparameterTunerFactory)."""
+    name = name.upper()
+    if name == "DUMMY":
+        return DummyTuner()
+    if name == "ATLAS":
+        return AtlasTuner()
+    raise ValueError(f"unknown tuner: {name}")

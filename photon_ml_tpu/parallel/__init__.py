@@ -1,0 +1,48 @@
+"""Mesh parallelism: the TPU-native replacement for the reference's Spark backend.
+
+The reference distributes via broadcast + treeAggregate + shuffle joins (SURVEY §2.8,
+photon-api function/DistributedObjectiveFunction.scala, ValueAndGradientAggregator
+.scala:240-255). Here the whole backend is `jax.sharding`: pick a 1-D device mesh,
+annotate array shardings, and let XLA insert the collectives —
+
+- fixed effects: samples sharded over the mesh ("data parallel"); the gradient
+  reduction X^T g becomes a psum over ICI (the treeAggregate equivalent; tree depth
+  disappears because the ICI all-reduce is hardware);
+- random effects: entity blocks sharded over the same axis ("expert parallel"-like);
+  zero communication during the vmap-ed per-entity solves, exactly like the
+  reference's executor-local mapValues solves;
+- score exchange between coordinates: elementwise ops over a sample-sharded global
+  score axis (the reference's full-outer-join DataScores.+/- disappears);
+- coefficient "broadcast" each iteration: replicated sharding, handled by the
+  compiler.
+
+Multi-host: the same code runs under `jax.distributed` initialization with a mesh
+spanning hosts; collectives ride ICI within a slice and DCN across slices.
+"""
+
+from photon_ml_tpu.parallel.mesh import (
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+    pad_axis_to_multiple,
+)
+from photon_ml_tpu.parallel.glm import shard_labeled_data, train_glm_sharded
+from photon_ml_tpu.parallel.game import (
+    ShardedGameData,
+    build_sharded_game_data,
+    game_train_step,
+    make_jitted_game_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "pad_axis_to_multiple",
+    "shard_labeled_data",
+    "train_glm_sharded",
+    "ShardedGameData",
+    "build_sharded_game_data",
+    "game_train_step",
+    "make_jitted_game_step",
+]

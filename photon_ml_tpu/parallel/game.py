@@ -1,0 +1,273 @@
+"""One full GLMix coordinate-descent pass as a single jitted SPMD program.
+
+This is the multi-chip production path for the flagship model (fixed effect +
+per-entity random effects, BASELINE.json config #3). The reference runs the same
+pass as a driver-orchestrated sequence of Spark jobs (CoordinateDescent.scala:
+119-346: per-coordinate broadcast/treeAggregate solves + score-exchange joins).
+Here the ENTIRE pass — fixed-effect L-BFGS solve, per-entity vmap-ed solves for
+every random-effect coordinate, and the residual score exchange — is one XLA
+program over a device mesh:
+
+- fixed-effect samples: sharded over the mesh axis (data parallel; gradient psum);
+- random-effect entity blocks: sharded over the same axis (expert-parallel-like;
+  zero comm inside the vmap-ed solves);
+- the [N] score axis: sharded; `partial = total - own` residual updates
+  (CoordinateDescent.scala:197-204) are elementwise, not joins.
+
+Padding discipline: padded samples carry weight 0; padded bucket entities scatter
+into a junk coefficient row (index E) that no scoring gather ever reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.data.matrix import DenseDesignMatrix
+from photon_ml_tpu.data.random_effect import RandomEffectDataset
+from photon_ml_tpu.function.losses import loss_for_task
+from photon_ml_tpu.function.objective import GLMObjective
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optimization.factory import build_minimizer
+from photon_ml_tpu.parallel.mesh import batch_sharding, pad_axis_to_multiple, replicated_sharding
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+Array = jnp.ndarray
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardedREBucket:
+    """One padded entity block, leading (entity) axis sharded over the mesh."""
+
+    entity_rows: Array  # [E_b] int32 into the coordinate's [E+1] coeff table (E = junk)
+    X: Array  # [E_b, S, K]
+    labels: Array  # [E_b, S]
+    weights: Array  # [E_b, S] (0 = padding)
+    sample_ids: Array  # [E_b, S] int32 global sample ids, -1 pad
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardedRECoordinate:
+    """One random-effect coordinate: training buckets + per-sample scoring view."""
+
+    buckets: tuple  # tuple[ShardedREBucket, ...]
+    sample_entity_rows: Array  # [N] int32, -1 = no model
+    sample_local_cols: Array  # [N, nnz] int32, -1 pad
+    sample_vals: Array  # [N, nnz]
+    n_entities: int = dataclasses.field(metadata=dict(static=True))
+    max_k: int = dataclasses.field(metadata=dict(static=True))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShardedGameData:
+    """Flagship GLMix training data placed on a mesh: dense fixed-effect design
+    matrix (samples sharded) + one ShardedRECoordinate per random effect."""
+
+    fe_X: Array  # [N, D] sharded on axis 0
+    labels: Array  # [N]
+    offsets: Array  # [N]
+    weights: Array  # [N] (0 = sample padding)
+    re: tuple  # tuple[ShardedRECoordinate, ...]
+
+    @property
+    def n(self) -> int:
+        return self.labels.shape[0]
+
+
+def build_sharded_game_data(
+    fe_X: np.ndarray,
+    labels: np.ndarray,
+    re_datasets: Sequence[RandomEffectDataset],
+    mesh,
+    *,
+    offsets: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    dtype=jnp.float32,
+) -> ShardedGameData:
+    """Host-side placement: pad the sample axis and every bucket's entity axis to
+    the mesh size, then device_put with batch/entity sharding."""
+    m = mesh.devices.size
+    bs1, bs2, bs3 = (batch_sharding(mesh, ndim=k) for k in (1, 2, 3))
+    n = fe_X.shape[0]
+    offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
+    weights = np.ones(n) if weights is None else np.asarray(weights)
+
+    fe_Xp, _ = pad_axis_to_multiple(np.asarray(fe_X), m)
+    yp, _ = pad_axis_to_multiple(np.asarray(labels), m)
+    op, _ = pad_axis_to_multiple(offsets, m)
+    wp, _ = pad_axis_to_multiple(weights, m)
+
+    coords = []
+    for ds in re_datasets:
+        E = ds.n_entities
+        buckets = []
+        for b in ds.buckets:
+            rows, _ = pad_axis_to_multiple(np.asarray(b.entity_rows), m, fill=E)
+            Xb, _ = pad_axis_to_multiple(np.asarray(b.X), m)
+            yb, _ = pad_axis_to_multiple(np.asarray(b.labels), m)
+            wb, _ = pad_axis_to_multiple(np.asarray(b.weights), m)
+            sb, _ = pad_axis_to_multiple(np.asarray(b.sample_ids), m, fill=-1)
+            buckets.append(
+                ShardedREBucket(
+                    entity_rows=jax.device_put(jnp.asarray(rows), bs1),
+                    X=jax.device_put(jnp.asarray(Xb, dtype=dtype), bs3),
+                    labels=jax.device_put(jnp.asarray(yb, dtype=dtype), bs2),
+                    weights=jax.device_put(jnp.asarray(wb, dtype=dtype), bs2),
+                    sample_ids=jax.device_put(jnp.asarray(sb), bs2),
+                )
+            )
+        ser, _ = pad_axis_to_multiple(np.asarray(ds.sample_entity_rows), m, fill=-1)
+        slc, _ = pad_axis_to_multiple(np.asarray(ds.sample_local_cols), m, fill=-1)
+        sv, _ = pad_axis_to_multiple(np.asarray(ds.sample_vals), m)
+        coords.append(
+            ShardedRECoordinate(
+                buckets=tuple(buckets),
+                sample_entity_rows=jax.device_put(jnp.asarray(ser), bs1),
+                sample_local_cols=jax.device_put(jnp.asarray(slc), bs2),
+                sample_vals=jax.device_put(jnp.asarray(sv, dtype=dtype), bs2),
+                n_entities=E,
+                max_k=ds.max_k,
+            )
+        )
+
+    return ShardedGameData(
+        fe_X=jax.device_put(jnp.asarray(fe_Xp, dtype=dtype), bs2),
+        labels=jax.device_put(jnp.asarray(yp, dtype=dtype), bs1),
+        offsets=jax.device_put(jnp.asarray(op, dtype=dtype), bs1),
+        weights=jax.device_put(jnp.asarray(wp, dtype=dtype), bs1),
+        re=tuple(coords),
+    )
+
+
+def init_game_params(data: ShardedGameData, mesh) -> dict:
+    """Zero-initialized flagship parameters: replicated fixed-effect coefficients +
+    one [E+1, K] entity-sharded-scatter-target table per random effect (row E is
+    the junk row for bucket padding)."""
+    rep = replicated_sharding(mesh)
+    dtype = data.fe_X.dtype
+    fe = jax.device_put(jnp.zeros((data.fe_X.shape[1],), dtype=dtype), rep)
+    re = tuple(
+        jax.device_put(jnp.zeros((rc.n_entities + 1, rc.max_k), dtype=dtype), rep)
+        for rc in data.re
+    )
+    return {"fixed": fe, "re": re}
+
+
+def _re_score(rc: ShardedRECoordinate, coeffs: Array) -> Array:
+    """[N] scores via the per-sample gathered view (RandomEffectModel.score
+    semantics: entities without a model score 0)."""
+    has_model = rc.sample_entity_rows >= 0
+    w = coeffs[jnp.maximum(rc.sample_entity_rows, 0)]  # [N, K]
+    gathered = jnp.take_along_axis(w, jnp.maximum(rc.sample_local_cols, 0), axis=1)
+    gathered = jnp.where(rc.sample_local_cols >= 0, gathered, 0.0)
+    return jnp.where(has_model, jnp.sum(gathered * rc.sample_vals, axis=1), 0.0)
+
+
+def game_train_step(
+    data: ShardedGameData,
+    params: dict,
+    task: TaskType,
+    fe_config: GLMOptimizationConfiguration,
+    re_configs: Sequence[GLMOptimizationConfiguration],
+) -> tuple[dict, dict]:
+    """One pure (jittable) coordinate-descent pass over [fixed, re_0, re_1, ...].
+
+    Returns (new params, diagnostics {fe_value, fe_iterations, total_scores}).
+    """
+    task = TaskType(task)
+    objective = GLMObjective(loss_for_task(task))
+    fe_min = build_minimizer(fe_config.optimizer_config)
+    fe_opt = OptimizerType(fe_config.optimizer_config.optimizer_type)
+
+    fe_coef = params["fixed"]
+    re_coeffs = list(params["re"])
+
+    fe_score = data.fe_X @ fe_coef
+    re_scores = [_re_score(rc, w) for rc, w in zip(data.re, re_coeffs)]
+    total = fe_score + sum(re_scores) if re_scores else fe_score
+
+    # ---- fixed-effect coordinate (partial = total - own) ------------------------
+    d = LabeledData(
+        X=DenseDesignMatrix(data.fe_X),
+        labels=data.labels,
+        offsets=data.offsets + (total - fe_score),
+        weights=data.weights,
+    )
+
+    def fe_vg(w):
+        return objective.value_and_gradient(d, w, fe_config.l2_weight)
+
+    kwargs = {}
+    if fe_opt == OptimizerType.TRON:
+        kwargs["hvp"] = lambda w, v: objective.hessian_vector(d, w, v, fe_config.l2_weight)
+    if fe_config.l1_weight:
+        kwargs["l1_weight"] = fe_config.l1_weight
+    fe_res = fe_min(fe_vg, fe_coef, **kwargs)
+    fe_coef = fe_res.coefficients
+    fe_score = data.fe_X @ fe_coef
+    total = fe_score + sum(re_scores) if re_scores else fe_score
+
+    # ---- random-effect coordinates ----------------------------------------------
+    for i, (rc, cfg) in enumerate(zip(data.re, re_configs)):
+        re_min = build_minimizer(cfg.optimizer_config)
+        re_opt = OptimizerType(cfg.optimizer_config.optimizer_type)
+        offsets_plus = data.offsets + (total - re_scores[i])
+        coeffs = re_coeffs[i]
+        for b in rc.buckets:
+            K = b.X.shape[2]
+            off_b = jnp.take(offsets_plus, jnp.maximum(b.sample_ids, 0), axis=0)
+            off_b = jnp.where(b.sample_ids >= 0, off_b, 0.0)
+            w0_b = coeffs[b.entity_rows, :K]
+
+            def solve_one(Xe, ye, we, oe, w0):
+                de = LabeledData(X=DenseDesignMatrix(Xe), labels=ye, offsets=oe, weights=we)
+
+                def vg(w):
+                    return objective.value_and_gradient(de, w, cfg.l2_weight)
+
+                kw = {}
+                if re_opt == OptimizerType.TRON:
+                    kw["hvp"] = lambda w, v: objective.hessian_vector(de, w, v, cfg.l2_weight)
+                if cfg.l1_weight:
+                    kw["l1_weight"] = cfg.l1_weight
+                return re_min(vg, w0, **kw).coefficients
+
+            w_b = jax.vmap(solve_one)(b.X, b.labels, b.weights, off_b, w0_b)
+            coeffs = coeffs.at[b.entity_rows, :K].set(w_b)
+        # the junk row must stay zero: bucket padding scattered garbage into it
+        coeffs = coeffs.at[rc.n_entities].set(0.0)
+        re_coeffs[i] = coeffs
+        re_scores[i] = _re_score(rc, coeffs)
+        total = fe_score + sum(re_scores)
+
+    new_params = {"fixed": fe_coef, "re": tuple(re_coeffs)}
+    diagnostics = {
+        "fe_value": fe_res.value,
+        "fe_iterations": fe_res.iterations,
+        "total_scores": total,
+    }
+    return new_params, diagnostics
+
+
+def make_jitted_game_step(
+    data: ShardedGameData,
+    task: TaskType,
+    fe_config: GLMOptimizationConfiguration,
+    re_configs: Sequence[GLMOptimizationConfiguration],
+    mesh,
+):
+    """jit(game_train_step) with data closed over and params donated — call as
+    ``step(params) -> (params, diagnostics)``. One compiled XLA program per pass."""
+
+    def step(params):
+        return game_train_step(data, params, task, fe_config, tuple(re_configs))
+
+    return jax.jit(step, donate_argnums=(0,))

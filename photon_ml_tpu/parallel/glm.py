@@ -1,0 +1,116 @@
+"""Data-parallel fixed-effect GLM training over a device mesh.
+
+TPU-native replacement for the reference's distributed fixed-effect path
+(photon-api function/DistributedObjectiveFunction.scala:34-76 +
+DistributedGLMLossFunction.scala:91-112 + ValueAndGradientAggregator.scala:240-255):
+coefficients were broadcast and gradients treeAggregate-d each L-BFGS/TRON
+iteration; here samples are sharded over the mesh, coefficients are replicated, and
+the whole `lax.while_loop` solve is one jitted program — XLA turns the X^T g
+reduction into a psum over ICI, so the per-iteration driver⇄executor round-trip
+disappears entirely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.data.matrix import DenseDesignMatrix, SparseDesignMatrix
+from photon_ml_tpu.function.losses import loss_for_task
+from photon_ml_tpu.function.objective import GLMObjective
+from photon_ml_tpu.optimization.common import OptResult
+from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optimization.factory import build_minimizer
+from photon_ml_tpu.parallel.mesh import batch_sharding, pad_axis_to_multiple, replicated_sharding
+from photon_ml_tpu.types import OptimizerType, TaskType
+
+Array = jnp.ndarray
+
+
+def shard_labeled_data(data: LabeledData, mesh) -> tuple[LabeledData, int]:
+    """Place a LabeledData on the mesh, sample axis sharded.
+
+    The sample axis is padded to a multiple of the mesh size with weight-0 rows
+    (inert in every weighted reduction). Sparse matrices shard their COO nnz axis;
+    padding triples are (row 0, col 0, val 0), inert under scatter-add.
+    Returns (sharded data, original sample count).
+    """
+    m = mesh.devices.size
+    bs1 = batch_sharding(mesh, ndim=1)
+
+    labels, n = pad_axis_to_multiple(np.asarray(data.labels), m)
+    offsets, _ = pad_axis_to_multiple(np.asarray(data.offsets), m)
+    weights, _ = pad_axis_to_multiple(np.asarray(data.weights), m)
+
+    if isinstance(data.X, DenseDesignMatrix):
+        vals, _ = pad_axis_to_multiple(np.asarray(data.X.values), m)
+        X = DenseDesignMatrix(jax.device_put(jnp.asarray(vals), batch_sharding(mesh, ndim=2)))
+    elif isinstance(data.X, SparseDesignMatrix):
+        rows, _ = pad_axis_to_multiple(np.asarray(data.X.rows), m)
+        cols, _ = pad_axis_to_multiple(np.asarray(data.X.cols), m)
+        nz, _ = pad_axis_to_multiple(np.asarray(data.X.vals), m)
+        X = SparseDesignMatrix(
+            rows=jax.device_put(jnp.asarray(rows), bs1),
+            cols=jax.device_put(jnp.asarray(cols), bs1),
+            vals=jax.device_put(jnp.asarray(nz), bs1),
+            n_rows=labels.shape[0],
+            n_cols=data.X.n_cols,
+        )
+    else:
+        raise TypeError(f"unsupported design matrix type {type(data.X).__name__}")
+
+    sharded = LabeledData(
+        X=X,
+        labels=jax.device_put(jnp.asarray(labels, dtype=data.labels.dtype), bs1),
+        offsets=jax.device_put(jnp.asarray(offsets, dtype=data.offsets.dtype), bs1),
+        weights=jax.device_put(jnp.asarray(weights, dtype=data.weights.dtype), bs1),
+    )
+    return sharded, n
+
+
+def train_glm_sharded(
+    data: LabeledData,
+    task: TaskType,
+    configuration: GLMOptimizationConfiguration,
+    mesh,
+    *,
+    initial_coefficients: Optional[Array] = None,
+) -> tuple[Array, OptResult]:
+    """One fixed-effect GLM solve, samples sharded over ``mesh``.
+
+    ``data`` should already be placed via :func:`shard_labeled_data` (un-placed
+    arrays work too — jit will shard them to match the replicated-coefficient
+    program, at the cost of an initial transfer).
+    """
+    task = TaskType(task)
+    objective = GLMObjective(loss_for_task(task))
+    cfg = configuration
+    minimize = build_minimizer(cfg.optimizer_config)
+    opt_type = OptimizerType(cfg.optimizer_config.optimizer_type)
+    rep = replicated_sharding(mesh)
+
+    x0 = (
+        jnp.zeros((data.dim,), dtype=data.X.dtype)
+        if initial_coefficients is None
+        else jnp.asarray(initial_coefficients, dtype=data.X.dtype)
+    )
+    x0 = jax.device_put(x0, rep)
+
+    def solve(d: LabeledData, w0: Array) -> OptResult:
+        def vg(w):
+            return objective.value_and_gradient(d, w, cfg.l2_weight)
+
+        kwargs = {}
+        if opt_type == OptimizerType.TRON:
+            kwargs["hvp"] = lambda w, v: objective.hessian_vector(d, w, v, cfg.l2_weight)
+        if cfg.l1_weight:
+            kwargs["l1_weight"] = cfg.l1_weight
+        return minimize(vg, w0, **kwargs)
+
+    result = jax.jit(solve, out_shardings=rep)(data, x0)
+    return result.coefficients, result

@@ -1,0 +1,55 @@
+"""1-D device mesh + sharding helpers.
+
+The reference's parallelism axes are #samples (data parallel via treeAggregate) and
+#entities (independent per-entity solves) — SURVEY §2.7. Both map onto ONE mesh
+axis: samples shard over it for fixed-effect solves, entity blocks shard over it
+for random-effect solves. A 1-D mesh also matches the physical ICI ring of a v5e-8.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_name: str = DATA_AXIS,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the 1-D mesh over the first ``n_devices`` available devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"requested {n_devices} devices, only {len(devices)} present")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard axis 0 over the mesh; remaining axes replicated."""
+    axis = mesh.axis_names[0]
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_axis_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0, fill=0):
+    """Pad ``arr`` along ``axis`` to a multiple of ``multiple``. Returns
+    (padded, n_orig). Padding must be inert downstream — callers give padded
+    samples weight 0 and padded entities an empty projection."""
+    n = arr.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return arr, n
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, target - n)
+    return np.pad(arr, widths, constant_values=fill), n

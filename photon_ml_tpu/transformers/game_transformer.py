@@ -19,7 +19,7 @@ from photon_ml_tpu.data.game_data import (
     build_fixed_effect_scoring_dataset,
     build_random_effect_scoring_dataset,
 )
-from photon_ml_tpu.evaluation.evaluators import EvaluationSuite
+from photon_ml_tpu.evaluation.evaluators import EvaluationSuite, resolve_evaluator
 from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
 
 
@@ -55,7 +55,7 @@ class GameTransformer:
         metrics = None
         if self.evaluators and data.has_labels:
             suite = EvaluationSuite(
-                evaluators=list(self.evaluators),
+                evaluators=[resolve_evaluator(s) for s in self.evaluators],
                 labels=np.asarray(data.labels, dtype=np.float64),
                 offsets=np.asarray(data.offsets, dtype=np.float64),
                 weights=np.asarray(data.weights, dtype=np.float64),

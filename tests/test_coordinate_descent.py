@@ -108,7 +108,7 @@ def test_glmix_beats_fixed_effect_alone(rng):
     coords, _, _ = build_coordinates(X[tr], X_re[tr], users[tr], y[tr])
     fe_val = FixedEffectDataset(LabeledData.build(X[va], y[va]), feature_shard_id="global")
     re_val = build_random_effect_dataset(
-        X_re[va], users[va], "userId", feature_shard_id="per-user"
+        X_re[va], users[va], "userId", feature_shard_id="per-user", scoring_only=True
     )
     suite = EvaluationSuite(
         evaluators=[evaluator_for_type(EvaluatorType.AUC)],

@@ -1,0 +1,120 @@
+"""GameEstimatorEvaluationFunction + end-to-end Bayesian tuning over GAME fits
+(reference GameEstimatorEvaluationFunctionTest + runHyperparameterTuning path,
+GameTrainingDriver.scala:643-674)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.estimators.config import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+)
+from photon_ml_tpu.estimators.evaluation_function import GameEstimatorEvaluationFunction
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+from photon_ml_tpu.hyperparameter import GaussianProcessSearch, RandomSearch
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+
+def _data(rng, n=400, d=6):
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    p = 1 / (1 + np.exp(-(X @ w)))
+    y = (rng.random(n) < p).astype(np.float64)
+    return GameInput(features={"global": X}, labels=y)
+
+
+def _estimator(reg_type=RegularizationType.L2, alpha=None):
+    cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS, max_iterations=60
+        ),
+        regularization_context=RegularizationContext(reg_type, alpha),
+        regularization_weight=1.0,
+    )
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations={
+            "global": CoordinateConfiguration(FixedEffectDataConfiguration("global"), cfg)
+        },
+        validation_evaluators=[EvaluatorType.AUC],
+        dtype=jnp.float64,
+    )
+
+
+def test_vector_round_trip(rng):
+    est = _estimator()
+    fn = GameEstimatorEvaluationFunction(
+        est,
+        {c: est.coordinate_configurations[c].optimization_config for c in est.coordinate_configurations},
+        None,
+        None,
+        is_opt_max=True,
+    )
+    assert fn.num_params == 1
+    configs = fn.vector_to_configuration(np.array([np.log(10.0)]))
+    assert configs["global"].regularization_weight == pytest.approx(10.0)
+    vec = fn.configuration_to_vector(configs)
+    np.testing.assert_allclose(vec, [np.log(10.0)])
+
+
+def test_elastic_net_two_dims():
+    est = _estimator(RegularizationType.ELASTIC_NET, alpha=0.5)
+    fn = GameEstimatorEvaluationFunction(
+        est,
+        {c: est.coordinate_configurations[c].optimization_config for c in est.coordinate_configurations},
+        None,
+        None,
+        is_opt_max=True,
+    )
+    assert fn.num_params == 2
+    configs = fn.vector_to_configuration(np.array([np.log(2.0), 0.25]))
+    assert configs["global"].regularization_weight == pytest.approx(2.0)
+    assert configs["global"].regularization_context.elastic_net_alpha == 0.25
+    assert configs["global"].l1_weight == pytest.approx(0.25 * 2.0)
+
+
+def test_evaluation_runs_fit_and_negates_max_metric(rng):
+    train = _data(rng)
+    val = _data(rng)
+    est = _estimator()
+    fn = GameEstimatorEvaluationFunction(
+        est,
+        {c: est.coordinate_configurations[c].optimization_config for c in est.coordinate_configurations},
+        train,
+        val,
+        is_opt_max=True,  # AUC maximizes
+    )
+    value, result = fn(np.array([0.5]))
+    assert value < 0  # negated AUC; AUC of a real model on separable-ish data > 0
+    assert -value == pytest.approx(result.best_metric)
+    obs = fn.convert_observations([result])
+    assert len(obs) == 1
+    assert 0.0 <= obs[0][0][0] <= 1.0
+
+
+def test_random_search_over_game(rng):
+    train = _data(rng, n=300)
+    val = _data(rng, n=300)
+    est = _estimator()
+    fn = GameEstimatorEvaluationFunction(
+        est,
+        {c: est.coordinate_configurations[c].optimization_config for c in est.coordinate_configurations},
+        train,
+        val,
+        is_opt_max=True,
+    )
+    rs = RandomSearch(fn.num_params, fn, seed=11)
+    results = rs.find(3)
+    assert len(results) == 3
+    aucs = [r.best_metric for r in results]
+    assert all(0.4 < a <= 1.0 for a in aucs)
