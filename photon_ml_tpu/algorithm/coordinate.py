@@ -157,6 +157,7 @@ class RandomEffectCoordinate(Coordinate):
             entity_ids=self.dataset.entity_ids,
             coeffs=jnp.zeros((E, K), dtype=dtype),
             proj_indices=self.dataset.proj_indices,
+            projector=self.dataset.projector,
         )
 
     def update_model(

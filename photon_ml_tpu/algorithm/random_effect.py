@@ -253,5 +253,6 @@ def train_random_effect(
         coeffs=coeffs_global,
         proj_indices=dataset.proj_indices,
         variances=variances_global,
+        projector=dataset.projector,
     )
     return model, tracker

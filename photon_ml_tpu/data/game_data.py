@@ -115,9 +115,12 @@ def build_fixed_effect_scoring_dataset(data: GameInput, feature_shard_id: str, d
 
 
 def build_random_effect_scoring_dataset(
-    data: GameInput, random_effect_type: str, feature_shard_id: str, dtype=None
+    data: GameInput, random_effect_type: str, feature_shard_id: str, dtype=None,
+    projector=None,
 ):
-    """Scoring-view-only RandomEffectDataset (no training buckets materialized)."""
+    """Scoring-view-only RandomEffectDataset (no training buckets materialized).
+    ``projector`` must be the SAME RandomProjector the model was trained under so
+    projected-space coefficients line up."""
     from photon_ml_tpu.data.random_effect import build_random_effect_dataset
 
     kwargs = {} if dtype is None else {"dtype": dtype}
@@ -127,5 +130,6 @@ def build_random_effect_scoring_dataset(
         random_effect_type,
         feature_shard_id=feature_shard_id,
         scoring_only=True,
+        projector=projector,
         **kwargs,
     )

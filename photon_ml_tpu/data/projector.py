@@ -1,0 +1,197 @@
+"""Feature-space projectors for random-effect coordinates.
+
+TPU-native re-design of photon-api projector/ (ProjectorType.scala:30,
+ProjectionMatrix.scala:32-127, ProjectionMatrixBroadcast.scala,
+IndexMapProjectorRDD.scala:36-274, IdentityProjector.scala):
+
+- INDEX_MAP_PROJECTION — per-entity exact remap to the entity's observed feature
+  set. Already the *native* representation of ``build_random_effect_dataset``
+  (data/random_effect.py builds the [E, K] observed-column gather table); the
+  projector here is just the dispatch marker.
+- RANDOM_PROJECTION(dim) — one shared Gaussian Johnson–Lindenstrauss matrix for
+  all entities. On TPU this becomes a single dense [d, k] matmul at ingest (an
+  MXU-friendly op) instead of the reference's broadcast matrix multiplied inside
+  every executor; the projected dataset then flows through the SAME bucketed
+  builder, where every entity observes all k projected columns.
+- IDENTITY_PROJECTION — no-op (entities keep global feature ids).
+
+A RandomProjector optionally carries the coordinate's NormalizationContext: the
+affine transform x' = (x-shift)*factor folds into the projection matrix
+(IndexMapProjectorRDD.projectNormalizationRDD semantics), so inputs stay sparse
+and training/scoring/export all see one consistent space. Models trained under
+RANDOM_PROJECTION live in (normalized-)projected space; scoring uses the
+projected per-sample view directly (margins are invariant), while model *export*
+back-projects coefficients via ``P @ w`` and then un-does the normalization with
+``NormalizationContext.model_to_original_space``
+(RandomEffectModelInProjectedSpace.scala:151 semantics: models are projected back
+for anything that needs name-space coefficients).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.normalization import NormalizationContext
+
+
+class ProjectorType(str, enum.Enum):
+    """projector/ProjectorType.scala:30 — INDEX_MAP / RANDOM / IDENTITY."""
+
+    INDEX_MAP_PROJECTION = "INDEX_MAP_PROJECTION"
+    RANDOM_PROJECTION = "RANDOM_PROJECTION"
+    IDENTITY_PROJECTION = "IDENTITY_PROJECTION"
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectorConfig:
+    projector_type: ProjectorType = ProjectorType.INDEX_MAP_PROJECTION
+    projected_dim: Optional[int] = None  # required for RANDOM_PROJECTION
+    seed: int = 0
+    # intercept column of the shard, exempted from projection (pass-through);
+    # falls back to the normalization context's intercept when unset
+    intercept_index: Optional[int] = None
+
+    def __post_init__(self):
+        if (
+            self.projector_type is ProjectorType.RANDOM_PROJECTION
+            and not self.projected_dim
+        ):
+            raise ValueError("RANDOM_PROJECTION requires projected_dim > 0")
+
+
+def build_gaussian_projection_matrix(
+    original_dim: int, projected_dim: int, seed: int = 0
+) -> np.ndarray:
+    """[d, k] i.i.d. N(0, 1/k) Johnson–Lindenstrauss matrix
+    (ProjectionMatrix.buildGaussianRandomProjectionMatrix:99-126 — Gaussian
+    entries scaled so projected inner products are unbiased)."""
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(original_dim, projected_dim)) / np.sqrt(projected_dim)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomProjector:
+    """Shared Gaussian projection for one random-effect coordinate.
+
+    ``matrix`` maps original (non-intercept) features into projected space; the
+    intercept column, when present, passes through untouched as the LAST
+    projected column (the reference keeps the intercept out of the projection —
+    ProjectionMatrixBroadcast builds the matrix over non-intercept features).
+
+    ``normalization``, when set, is folded into every ``project_features`` call
+    and un-done by ``project_coefficients_back`` — the single source of truth for
+    the normalized-projected space the models live in.
+    """
+
+    matrix: np.ndarray  # [d, k]
+    intercept_index: Optional[int] = None
+    normalization: Optional[NormalizationContext] = None
+
+    def __post_init__(self):
+        norm = self.normalization
+        if norm is not None and norm.is_identity:
+            object.__setattr__(self, "normalization", None)
+
+    @property
+    def original_dim(self) -> int:
+        return self.matrix.shape[0]
+
+    @property
+    def projected_dim(self) -> int:
+        # +1 for the pass-through intercept slot
+        return self.matrix.shape[1] + (1 if self.intercept_index is not None else 0)
+
+    def _feature_mask(self) -> np.ndarray:
+        mask = np.ones(self.original_dim, dtype=bool)
+        if self.intercept_index is not None:
+            mask[self.intercept_index] = False
+        return mask
+
+    def project_features(self, X: sp.spmatrix) -> sp.csr_matrix:
+        """[n, d] sparse → [n, k(+1)] projected design matrix (CSR so it feeds
+        straight into build_random_effect_dataset).
+
+        Any carried normalization x' = (x-shift)*factor folds into the matmul:
+        (x' @ P) = (x*factor) @ P - (shift*factor) @ P, so X stays sparse. The
+        intercept column must carry factor 1 / shift 0 (NormalizationContext
+        invariant) and passes through untouched.
+        """
+        X = X.tocsr()
+        if X.shape[1] != self.original_dim:
+            raise ValueError(
+                f"X has {X.shape[1]} columns, projector expects {self.original_dim}"
+            )
+        mask = self._feature_mask()
+        factors = None if self.normalization is None else self.normalization.factors
+        shifts = None if self.normalization is None else self.normalization.shifts
+        P = self.matrix[mask]
+        if factors is not None:
+            P = P * np.asarray(factors)[mask][:, None]
+        body = np.asarray(X[:, mask] @ P)
+        if shifts is not None:
+            eff_shift = np.asarray(shifts)
+            if factors is not None:
+                eff_shift = eff_shift * np.asarray(factors)
+            body = body - (eff_shift[mask] @ self.matrix[mask])[None, :]
+        if self.intercept_index is not None:
+            icept = np.asarray(X[:, [self.intercept_index]].todense())
+            dense = np.concatenate([body, icept], axis=1)
+        else:
+            dense = body
+        return sp.csr_matrix(dense)
+
+    def project_coefficients_back(self, w_projected: np.ndarray) -> np.ndarray:
+        """Projected-space coefficients → original name-space coefficients.
+
+        [kp] → [d], or batched [E, kp] → [E, d]. Two steps: (1) P @ w lands in
+        the (possibly normalized) original feature space — margin-invariant:
+        x_proj · w = (x P) · w = x · (P w); (2) any carried normalization is
+        un-done via model_to_original_space, so the result always scores raw
+        features correctly.
+        """
+        w = np.atleast_2d(np.asarray(w_projected))  # [E, kp]
+        if self.intercept_index is not None:
+            body, icept = w[:, :-1], w[:, -1]
+        else:
+            body, icept = w, None
+        mask = self._feature_mask()
+        out = np.zeros((w.shape[0], self.original_dim), dtype=w.dtype)
+        out[:, mask] = body @ self.matrix[mask].T
+        if icept is not None:
+            out[:, self.intercept_index] = icept
+        if self.normalization is not None:
+            # batched model_to_original_space: w_orig = factor*w;
+            # w_orig[icept] -= w_orig . shift (normalization.py:96-104)
+            norm = self.normalization
+            if norm.factors is not None:
+                out = out * np.asarray(norm.factors)[None, :]
+            if norm.shifts is not None:
+                out[:, norm.intercept_index] -= out @ np.asarray(norm.shifts)
+        return out if np.ndim(w_projected) == 2 else out[0]
+
+
+def make_projector(
+    config: ProjectorConfig,
+    original_dim: int,
+    intercept_index: Optional[int] = None,
+    normalization: Optional[NormalizationContext] = None,
+) -> Optional[RandomProjector]:
+    """ProjectorType dispatch: only RANDOM_PROJECTION materializes an object;
+    INDEX_MAP is native to the dataset builder and IDENTITY is a no-op."""
+    if config.projector_type is ProjectorType.RANDOM_PROJECTION:
+        icept = config.intercept_index if config.intercept_index is not None else intercept_index
+        if icept is None and normalization is not None:
+            icept = normalization.intercept_index
+        return RandomProjector(
+            matrix=build_gaussian_projection_matrix(
+                original_dim, int(config.projected_dim), config.seed
+            ),
+            intercept_index=icept,
+            normalization=normalization,
+        )
+    return None

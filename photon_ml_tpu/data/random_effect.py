@@ -100,6 +100,9 @@ class RandomEffectDataset:
     # passive-sample bookkeeping (reference passiveData): ids not in active blocks
     n_active_samples: int = 0
     n_passive_samples: int = 0
+    # RandomProjector when the dataset lives in a shared projected space
+    # (projector/ProjectionMatrixBroadcast semantics); None for index-map/identity
+    projector: Optional[object] = None
 
     @property
     def n_entities(self) -> int:
@@ -133,6 +136,7 @@ def build_random_effect_dataset(
     min_samples_pad: int = 8,
     min_features_pad: int = 4,
     scoring_only: bool = False,
+    projector: Optional[object] = None,
 ) -> RandomEffectDataset:
     """Host-side construction of the bucketed random-effect dataset.
 
@@ -147,7 +151,27 @@ def build_random_effect_dataset(
     - ``scoring_only``: skip training-bucket materialization entirely (validation /
       transform datasets only need the per-sample scoring view); caps, lower-bound
       filtering and Pearson selection don't apply to scoring data.
+    - ``projector``: a data.projector.RandomProjector. Features — and the
+      projector's OWN carried normalization — are folded into the shared
+      projected space up-front; the dataset then lives entirely in that space
+      (every entity observes the same k(+1) projected columns), matching
+      RandomEffectCoordinateInProjectedSpace. Pass normalization via the
+      projector (make_projector(..., normalization=...)), not this function's
+      ``normalization`` argument, so scoring datasets (which never see the
+      training normalization) stay consistent.
     """
+    if projector is not None:
+        if normalization is not None and projector.normalization is None:
+            raise ValueError(
+                "normalization must be carried BY the projector "
+                "(make_projector(..., normalization=...)) so training and scoring "
+                "datasets agree on the projected space"
+            )
+        X = projector.project_features(X)
+        normalization = None
+        intercept_index = (
+            projector.projected_dim - 1 if projector.intercept_index is not None else None
+        )
     if scoring_only:
         active_data_upper_bound = None
         active_data_lower_bound = 1
@@ -313,6 +337,7 @@ def build_random_effect_dataset(
         n_samples=n,
         n_active_samples=n_active,
         n_passive_samples=passive_count,
+        projector=projector,
     )
 
 

@@ -14,6 +14,7 @@ import dataclasses
 import itertools
 from typing import Mapping, Optional, Sequence
 
+from photon_ml_tpu.data.projector import ProjectorConfig
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
 
 
@@ -35,6 +36,7 @@ class RandomEffectDataConfiguration:
     active_data_lower_bound: int = 1
     active_data_upper_bound: Optional[int] = None
     features_max: Optional[int] = None  # per-entity Pearson cap
+    projector: Optional[ProjectorConfig] = None  # None -> index-map (native)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -15,6 +15,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.hyperparameter import rescaling
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
 from photon_ml_tpu.types import RegularizationType
 
@@ -92,14 +93,10 @@ class GameEstimatorEvaluationFunction:
         return np.asarray(vals, dtype=np.float64)
 
     def _scale_backward(self, candidate: np.ndarray) -> np.ndarray:
-        lo = np.array([r[0] for r in self.ranges])
-        hi = np.array([r[1] for r in self.ranges])
-        return np.asarray(candidate, dtype=np.float64) * (hi - lo) + lo
+        return rescaling.scale_backward(candidate, self.ranges)
 
     def _scale_forward(self, vec: np.ndarray) -> np.ndarray:
-        lo = np.array([r[0] for r in self.ranges])
-        hi = np.array([r[1] for r in self.ranges])
-        return (np.asarray(vec, dtype=np.float64) - lo) / (hi - lo)
+        return rescaling.scale_forward(vec, self.ranges)
 
     # -- EvaluationFunction interface ----------------------------------------------
 

@@ -40,6 +40,7 @@ from photon_ml_tpu.data.game_data import (
     build_fixed_effect_scoring_dataset,
     build_random_effect_scoring_dataset,
 )
+from photon_ml_tpu.data.projector import make_projector
 from photon_ml_tpu.data.random_effect import RandomEffectDataset, build_random_effect_dataset
 from photon_ml_tpu.estimators.config import (
     CoordinateConfiguration,
@@ -145,8 +146,10 @@ class GameEstimator:
                 )
             elif isinstance(dc, RandomEffectDataConfiguration):
                 norm = self._normalization_for(dc.feature_shard_id)
+                X = as_csr(data.shard(dc.feature_shard_id))
+                projector = self._projector_for(dc, X.shape[1], norm)
                 datasets[cid] = build_random_effect_dataset(
-                    as_csr(data.shard(dc.feature_shard_id)),
+                    X,
                     data.ids(dc.random_effect_type),
                     dc.random_effect_type,
                     feature_shard_id=dc.feature_shard_id,
@@ -156,8 +159,12 @@ class GameEstimator:
                     labels=data.labels,
                     weights=data.weights,
                     intercept_index=norm.intercept_index if not norm.is_identity else None,
-                    normalization=None if norm.is_identity else norm,
+                    # with a projector, normalization rides ON the projector
+                    normalization=(
+                        None if norm.is_identity or projector is not None else norm
+                    ),
                     dtype=self.dtype,
+                    projector=projector,
                 )
             else:
                 raise TypeError(f"Unknown data configuration {type(dc).__name__}")
@@ -174,10 +181,28 @@ class GameEstimator:
                     data, dc.feature_shard_id, dtype=self.dtype
                 )
             else:
+                norm = self._normalization_for(dc.feature_shard_id)
                 datasets[cid] = build_random_effect_scoring_dataset(
-                    data, dc.random_effect_type, dc.feature_shard_id, dtype=self.dtype
+                    data, dc.random_effect_type, dc.feature_shard_id, dtype=self.dtype,
+                    projector=self._projector_for(
+                        dc, data.shard(dc.feature_shard_id).shape[1], norm
+                    ),
                 )
         return datasets
+
+    def _projector_for(self, dc, original_dim: int, norm: NormalizationContext):
+        """RandomProjector for a RANDOM_PROJECTION coordinate, else None. Built
+        deterministically from (config seed, dim) so training and scoring datasets
+        share the same matrix without threading state; any non-identity
+        normalization rides on the projector so every consumer folds it."""
+        if dc.projector is None:
+            return None
+        return make_projector(
+            dc.projector,
+            original_dim,
+            intercept_index=norm.intercept_index if not norm.is_identity else None,
+            normalization=None if norm.is_identity else norm,
+        )
 
     def prepare_evaluation_suite(self, validation: GameInput) -> EvaluationSuite:
         """prepareValidationDatasetAndEvaluators:568-595: default task evaluator

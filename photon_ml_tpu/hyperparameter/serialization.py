@@ -18,10 +18,8 @@ from typing import Mapping, Optional, Sequence
 
 import numpy as np
 
+from photon_ml_tpu.hyperparameter.rescaling import LOG_TRANSFORM, SQRT_TRANSFORM
 from photon_ml_tpu.types import HyperparameterTuningMode
-
-LOG_TRANSFORM = "LOG"
-SQRT_TRANSFORM = "SQRT"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,14 +33,13 @@ class HyperparameterConfig:
 
 def config_from_json(json_config: str) -> HyperparameterConfig:
     data = json.loads(json_config)
-    mode_str = data["tuning_mode"]
-    mode = (
-        HyperparameterTuningMode.BAYESIAN
-        if mode_str == "BAYESIAN"
-        else HyperparameterTuningMode.RANDOM
-        if mode_str == "RANDOM"
-        else HyperparameterTuningMode.NONE
-    )
+    try:
+        mode = HyperparameterTuningMode(data["tuning_mode"])
+    except ValueError as e:
+        raise ValueError(
+            f"Invalid tuning_mode {data['tuning_mode']!r}; expected one of "
+            f"{[m.value for m in HyperparameterTuningMode]}"
+        ) from e
     variables = data["variables"]
     names, ranges, discrete, transforms = [], [], {}, {}
     for index, (name, spec) in enumerate(variables.items()):

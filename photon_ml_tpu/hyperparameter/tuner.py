@@ -13,7 +13,9 @@ from typing import Optional, Sequence
 import numpy as np
 
 from photon_ml_tpu.hyperparameter.evaluation import EvaluationFunction  # noqa: F401
+from photon_ml_tpu.hyperparameter.rescaling import scale_forward, transform_forward
 from photon_ml_tpu.hyperparameter.search import GaussianProcessSearch, RandomSearch
+from photon_ml_tpu.hyperparameter.serialization import HyperparameterConfig
 from photon_ml_tpu.types import HyperparameterTuningMode
 
 
@@ -30,6 +32,7 @@ class HyperparameterTuner:
         prior_observations: Sequence[tuple[np.ndarray, float]] = (),
         discrete_params: Optional[dict] = None,
         seed: int = 0,
+        config: Optional[HyperparameterConfig] = None,
     ) -> list:
         raise NotImplementedError
 
@@ -38,7 +41,7 @@ class DummyTuner(HyperparameterTuner):
     """No-op tuner (HyperparameterTunerFactory DUMMY): returns no results."""
 
     def search(self, n, dimension, mode, evaluation_function, observations,
-               prior_observations=(), discrete_params=None, seed=0) -> list:
+               prior_observations=(), discrete_params=None, seed=0, config=None) -> list:
         return []
 
 
@@ -46,7 +49,7 @@ class AtlasTuner(HyperparameterTuner):
     """Dispatches RANDOM / BAYESIAN search (AtlasTuner.scala:41-60)."""
 
     def search(self, n, dimension, mode, evaluation_function, observations,
-               prior_observations=(), discrete_params=None, seed=0) -> list:
+               prior_observations=(), discrete_params=None, seed=0, config=None) -> list:
         mode = HyperparameterTuningMode(mode)
         if mode == HyperparameterTuningMode.NONE or n <= 0:
             return []
@@ -56,12 +59,33 @@ class AtlasTuner(HyperparameterTuner):
             else RandomSearch
         )
         searcher = cls(dimension, evaluation_function, discrete_params=discrete_params, seed=seed)
-        # The search contract expects PRIOR observations mean-centered (they are
-        # combined with this dataset's mean-centered evals and compared against a
-        # centered incumbent in GaussianProcessSearch.next); raw values come out of
-        # prior_from_json, so center them here.
+        # Prior observations come out of prior_from_json in RAW hyperparameter
+        # space; the search operates in transformed-[0,1]^d space, so prior POINTS
+        # must go through the same transform+scale the observations did
+        # (reference: GameTrainingDriver maps priors through VectorRescaling
+        # before the search). The VALUES are mean-centered, matching how
+        # GaussianProcessSearch.next compares them with this dataset's
+        # centered evals.
         priors = list(prior_observations)
         if priors:
+            if config is None:
+                raise ValueError(
+                    "prior_observations are in raw hyperparameter space; pass "
+                    "config=HyperparameterConfig so they can be rescaled into "
+                    "the search's [0,1]^d space"
+                )
+            discrete_set = set(config.discrete_params)
+            priors = [
+                (
+                    scale_forward(
+                        transform_forward(p, config.transform_map),
+                        config.ranges,
+                        discrete_set,
+                    ),
+                    v,
+                )
+                for p, v in priors
+            ]
             prior_mean = float(np.mean([v for _, v in priors]))
             priors = [(p, v - prior_mean) for p, v in priors]
         if observations:

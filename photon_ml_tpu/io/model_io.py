@@ -151,6 +151,9 @@ def save_game_model(
                 [rec],
             )
         elif isinstance(model, RandomEffectModel):
+            # random-projection models are stored in name space: back-project first
+            # (the projected space is a runtime trick, not a storage format)
+            model = model.to_original_space()
             base = os.path.join(output_dir, RANDOM_EFFECT, coord_id)
             os.makedirs(os.path.join(base, COEFFICIENTS), exist_ok=True)
             with open(os.path.join(base, ID_INFO), "w") as f:

@@ -27,6 +27,32 @@ from photon_ml_tpu.types import ModelType, TaskType
 Array = jnp.ndarray
 
 
+def _projectors_compatible(a, b) -> bool:
+    """True when two RandomProjectors define the same projected space. Full
+    matrix equality is O(d*k) host work on potentially huge matrices, so after
+    the cheap structural checks we compare a deterministic sample of entries
+    (a Gaussian matrix differing anywhere differs almost surely everywhere)."""
+    if a is b:
+        return True
+    if a.matrix.shape != b.matrix.shape or a.intercept_index != b.intercept_index:
+        return False
+    d, k = a.matrix.shape
+    rows = np.unique(np.linspace(0, d - 1, num=min(d, 16), dtype=np.int64))
+    cols = np.unique(np.linspace(0, k - 1, num=min(k, 4), dtype=np.int64))
+    if not np.array_equal(a.matrix[np.ix_(rows, cols)], b.matrix[np.ix_(rows, cols)]):
+        return False
+    na, nb = a.normalization, b.normalization
+    if (na is None) != (nb is None):
+        return False
+    if na is not None:
+        for fa, fb in ((na.factors, nb.factors), (na.shifts, nb.shifts)):
+            if (fa is None) != (fb is None):
+                return False
+            if fa is not None and not np.array_equal(np.asarray(fa), np.asarray(fb)):
+                return False
+    return True
+
+
 @dataclasses.dataclass(frozen=True)
 class FixedEffectModel:
     """Global GLM for one feature shard (FixedEffectModel.scala:146)."""
@@ -64,6 +90,10 @@ class RandomEffectModel:
     coeffs: Array  # [E, K]
     proj_indices: Array  # [E, K] int32 global col ids, -1 pad
     variances: Optional[Array] = None  # [E, K]
+    # set when coeffs live in a shared random-projection space (data/projector.py);
+    # proj_indices then index PROJECTED columns, and export goes through
+    # to_original_space() (RandomEffectModelInProjectedSpace.scala:151 semantics)
+    projector: Optional[object] = None
 
     def __post_init__(self):
         object.__setattr__(self, "_row_by_entity", {e: i for i, e in enumerate(self.entity_ids)})
@@ -125,6 +155,35 @@ class RandomEffectModel:
         features: ``scoring_view()`` -> (entity_rows [N], local_cols [N, nnz],
         vals [N, nnz]) where local_cols index into the DATASET's slot layout; the
         model is aligned to that layout first."""
+        ds_projector = getattr(dataset, "projector", None)
+        if self.projector is not None and ds_projector is None:
+            # projected model vs original-space dataset: score via back-projection
+            return self.to_original_space().score_dataset(dataset)
+        if (
+            self.projector is not None
+            and ds_projector is not None
+            and not _projectors_compatible(self.projector, ds_projector)
+        ):
+            # two DIFFERENT projections: shapes may even match, but coefficients
+            # in one random basis dotted with features in another are garbage
+            raise ValueError(
+                "Model and dataset were built with different RandomProjectors "
+                "(matrix/normalization mismatch); rebuild the scoring dataset "
+                "with the model's projector (GameTransformer does this "
+                "automatically)"
+            )
+        if self.projector is None and ds_projector is not None:
+            # original-space model vs projected dataset: proj_indices would be
+            # interpreted as projected slot ids — silently garbage. There is no
+            # exact original->projected coefficient transport (P is not square),
+            # so refuse (e.g. a loaded/back-projected model warm-starting a
+            # RANDOM_PROJECTION coordinate: rebuild datasets without the
+            # projector, or refit from scratch).
+            raise ValueError(
+                "Cannot score an original-space RandomEffectModel against a "
+                "random-projection dataset; drop the coordinate's projector "
+                "config or retrain the model in projected space"
+            )
         model = self.aligned_to(dataset)
         entity_rows, local_cols, vals = dataset.scoring_view(model)
         has_model = entity_rows >= 0
@@ -138,6 +197,49 @@ class RandomEffectModel:
 
     def update_entities(self, new_coeffs: Array, variances: Optional[Array] = None) -> "RandomEffectModel":
         return dataclasses.replace(self, coeffs=new_coeffs, variances=variances)
+
+    def to_original_space(self) -> "RandomEffectModel":
+        """Back-project a random-projection model into the original feature space
+        (coef_orig = P @ w, margin-invariant). Per-entity coefficients become the
+        entity's non-zero back-projected columns under an index-map layout, so the
+        result saves/scores like any other RandomEffectModel. No-op without a
+        projector. Variances don't survive (no exact linear transport through P);
+        the reference likewise drops them for projected models."""
+        if self.projector is None:
+            return self
+        E = self.n_entities
+        kp = self.projector.projected_dim
+        d_orig = self.projector.original_dim
+        if E == 0:
+            return dataclasses.replace(
+                self,
+                coeffs=jnp.zeros((0, 1), dtype=np.asarray(self.coeffs).dtype),
+                proj_indices=jnp.full((0, 1), -1, dtype=jnp.int32),
+                variances=None,
+                projector=None,
+            )
+        proj_tbl = np.asarray(self.proj_indices)
+        coeffs_src = np.asarray(self.coeffs)
+        # un-pad with one vectorized scatter: slot k holds projected column
+        # proj_tbl[i, k]
+        W_proj = np.zeros((E, kp), dtype=coeffs_src.dtype)
+        rows_idx, slots = np.nonzero(proj_tbl >= 0)
+        W_proj[rows_idx, proj_tbl[rows_idx, slots]] = coeffs_src[rows_idx, slots]
+        dense = self.projector.project_coefficients_back(W_proj)  # [E, d] batched
+        nz = [np.flatnonzero(dense[i]) for i in range(E)]
+        K = max((len(c) for c in nz), default=1) or 1
+        coeffs = np.zeros((E, K), dtype=dense.dtype)
+        proj = np.full((E, K), -1, dtype=np.int32)
+        for i, cols in enumerate(nz):
+            coeffs[i, : len(cols)] = dense[i, cols]
+            proj[i, : len(cols)] = cols
+        return dataclasses.replace(
+            self,
+            coeffs=jnp.asarray(coeffs),
+            proj_indices=jnp.asarray(proj),
+            variances=None,
+            projector=None,
+        )
 
 
 @dataclasses.dataclass(frozen=True)

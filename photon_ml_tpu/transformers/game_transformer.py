@@ -70,6 +70,7 @@ class GameTransformer:
             return build_fixed_effect_scoring_dataset(data, model.feature_shard_id)
         if isinstance(model, RandomEffectModel):
             return build_random_effect_scoring_dataset(
-                data, model.re_type, model.feature_shard_id
+                data, model.re_type, model.feature_shard_id,
+                projector=model.projector,
             )
         raise TypeError(f"Cannot build scoring dataset for {type(model).__name__}")

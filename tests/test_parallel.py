@@ -13,8 +13,6 @@ import scipy.sparse as sp
 
 from photon_ml_tpu.data.dataset import LabeledData
 from photon_ml_tpu.data.random_effect import build_random_effect_dataset
-from photon_ml_tpu.function.losses import loss_for_task
-from photon_ml_tpu.function.objective import GLMObjective
 from photon_ml_tpu.optimization.config import (
     GLMOptimizationConfiguration,
     RegularizationContext,
@@ -127,13 +125,6 @@ class TestShardedGameStep:
         )
         params = init_game_params(data, mesh)
         params, diag = step(params)
-        obj = GLMObjective(loss_for_task(TaskType.LOGISTIC_REGRESSION))
-        d1 = LabeledData(
-            X=jax.tree_util.tree_map(lambda x: x, data).fe_X,
-            labels=data.labels,
-            offsets=data.offsets,
-            weights=data.weights,
-        )
         # total log-loss with the trained scores beats the zero model
         total = np.asarray(diag["total_scores"])
         yv = np.asarray(data.labels)

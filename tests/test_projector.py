@@ -1,0 +1,353 @@
+"""Projector tests: Gaussian JL matrix, margin invariance of back-projection,
+dataset building under RANDOM_PROJECTION, end-to-end estimator fit + transform,
+and save/load through name space. Mirrors the reference's projector integ tests
+(photon-api src/integTest projector/ — ProjectionMatrixIntegTest,
+IndexMapProjectorRDDIntegTest semantics).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.data.projector import (
+    ProjectorConfig,
+    ProjectorType,
+    RandomProjector,
+    build_gaussian_projection_matrix,
+    make_projector,
+)
+from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+from photon_ml_tpu.estimators.config import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.transformers.game_transformer import GameTransformer
+from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+OPT = GLMOptimizationConfiguration(
+    optimizer_config=OptimizerConfig(optimizer_type=OptimizerType.LBFGS, max_iterations=60),
+    regularization_context=RegularizationContext(RegularizationType.L2),
+    regularization_weight=0.5,
+)
+
+
+def test_gaussian_matrix_is_deterministic_and_scaled():
+    P1 = build_gaussian_projection_matrix(200, 20, seed=7)
+    P2 = build_gaussian_projection_matrix(200, 20, seed=7)
+    P3 = build_gaussian_projection_matrix(200, 20, seed=8)
+    assert np.array_equal(P1, P2)
+    assert not np.array_equal(P1, P3)
+    # N(0, 1/k) entries: projected squared norms are unbiased estimates
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=200)
+    projected = x @ P1
+    assert np.linalg.norm(projected) ** 2 == pytest.approx(
+        np.linalg.norm(x) ** 2, rel=0.5
+    )
+
+
+def test_projector_config_validation():
+    with pytest.raises(ValueError):
+        ProjectorConfig(ProjectorType.RANDOM_PROJECTION)
+    assert make_projector(ProjectorConfig(), 10) is None
+    assert make_projector(ProjectorConfig(ProjectorType.IDENTITY_PROJECTION), 10) is None
+    proj = make_projector(
+        ProjectorConfig(ProjectorType.RANDOM_PROJECTION, projected_dim=4), 10
+    )
+    assert proj.matrix.shape == (10, 4)
+    assert proj.projected_dim == 4
+
+
+def test_back_projection_margin_invariance():
+    """x_proj . w == x . (P w): back-projected coefficients reproduce projected
+    margins exactly (the identity RandomEffectModelInProjectedSpace relies on)."""
+    rng = np.random.default_rng(3)
+    d, k, n = 30, 6, 50
+    proj = RandomProjector(matrix=build_gaussian_projection_matrix(d, k, 1))
+    X = sp.csr_matrix(rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.3))
+    Xp = np.asarray(proj.project_features(X).todense())
+    w_proj = rng.normal(size=k)
+    margins_projected = Xp @ w_proj
+    margins_back = X @ proj.project_coefficients_back(w_proj)
+    np.testing.assert_allclose(margins_back, margins_projected, rtol=1e-10)
+
+
+def test_intercept_passthrough():
+    rng = np.random.default_rng(4)
+    d, k, n = 12, 3, 20
+    icept = 5
+    proj = RandomProjector(
+        matrix=build_gaussian_projection_matrix(d, k, 2), intercept_index=icept
+    )
+    X = np.zeros((n, d))
+    X[:, icept] = 1.0  # intercept column
+    X[:, 0] = rng.normal(size=n)
+    Xp = np.asarray(proj.project_features(sp.csr_matrix(X)).todense())
+    assert proj.projected_dim == k + 1
+    # last projected column IS the intercept, untouched
+    np.testing.assert_allclose(Xp[:, -1], 1.0)
+    # margins invariant including the intercept slot
+    w_proj = rng.normal(size=k + 1)
+    np.testing.assert_allclose(
+        X @ proj.project_coefficients_back(w_proj), Xp @ w_proj, rtol=1e-10
+    )
+
+
+def test_normalization_folding():
+    """A projector carrying a NormalizationContext projects normalize(X), and
+    project_coefficients_back un-does the normalization (margin invariance over
+    RAW features)."""
+    from photon_ml_tpu.normalization import NormalizationContext
+
+    rng = np.random.default_rng(5)
+    d, k, n = 10, 4, 25
+    icept = 0
+    X = rng.normal(size=(n, d)) + 2.0
+    X[:, icept] = 1.0
+    factors = rng.random(d) + 0.5
+    shifts = rng.normal(size=d)
+    factors[icept], shifts[icept] = 1.0, 0.0
+    norm = NormalizationContext(factors=factors, shifts=shifts, intercept_index=icept)
+    P = build_gaussian_projection_matrix(d, k, 3)
+    proj_n = RandomProjector(matrix=P, intercept_index=icept, normalization=norm)
+    proj_raw = RandomProjector(matrix=P, intercept_index=icept)
+    folded = np.asarray(proj_n.project_features(sp.csr_matrix(X)).todense())
+    explicit = np.asarray(
+        proj_raw.project_features(sp.csr_matrix((X - shifts) * factors)).todense()
+    )
+    np.testing.assert_allclose(folded, explicit, rtol=1e-9, atol=1e-12)
+    # back-projection: margins over RAW features == margins in normalized-projected
+    # space (the property training/scoring/export consistency rests on)
+    w_proj = rng.normal(size=k + 1)
+    w_orig = proj_n.project_coefficients_back(w_proj)
+    np.testing.assert_allclose(X @ w_orig, folded @ w_proj, rtol=1e-9)
+    # batched == per-row
+    W = rng.normal(size=(3, k + 1))
+    batched = proj_n.project_coefficients_back(W)
+    for i in range(3):
+        np.testing.assert_allclose(
+            batched[i], proj_n.project_coefficients_back(W[i]), rtol=1e-12
+        )
+
+
+def test_original_space_model_refuses_projected_dataset():
+    """Silent misalignment guard: an original-space model cannot score a
+    projected dataset (no exact original->projected transport)."""
+    rng = np.random.default_rng(9)
+    n, d, k = 60, 20, 4
+    ents = rng.integers(0, 3, size=n)
+    X = sp.csr_matrix(rng.normal(size=(n, d)))
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    proj = make_projector(
+        ProjectorConfig(ProjectorType.RANDOM_PROJECTION, projected_dim=k, seed=4), d
+    )
+    ds_proj = build_random_effect_dataset(X, ents, "e", labels=y, projector=proj)
+    ds_orig = build_random_effect_dataset(X, ents, "e", labels=y)
+    from photon_ml_tpu.algorithm.coordinate import RandomEffectCoordinate
+    import jax.numpy as jnp
+
+    coord = RandomEffectCoordinate(
+        coordinate_id="e", dataset=ds_orig, task=TaskType.LOGISTIC_REGRESSION,
+        configuration=OPT, base_offsets=jnp.zeros(n),
+    )
+    model_orig = coord.initialize_model()
+    with pytest.raises(ValueError, match="original-space"):
+        model_orig.score_dataset(ds_proj)
+
+
+def test_different_projectors_refused():
+    """Two different random projections must not silently score each other."""
+    rng = np.random.default_rng(11)
+    n, d, k = 40, 15, 4
+    ents = rng.integers(0, 3, size=n)
+    X = sp.csr_matrix(rng.normal(size=(n, d)))
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    cfg = dict(projected_dim=k)
+    p1 = make_projector(ProjectorConfig(ProjectorType.RANDOM_PROJECTION, seed=1, **cfg), d)
+    p2 = make_projector(ProjectorConfig(ProjectorType.RANDOM_PROJECTION, seed=2, **cfg), d)
+    ds1 = build_random_effect_dataset(X, ents, "e", labels=y, projector=p1)
+    ds2 = build_random_effect_dataset(X, ents, "e", labels=y, projector=p2)
+    from photon_ml_tpu.algorithm.coordinate import RandomEffectCoordinate
+    import jax.numpy as jnp
+
+    coord = RandomEffectCoordinate(
+        coordinate_id="e", dataset=ds1, task=TaskType.LOGISTIC_REGRESSION,
+        configuration=OPT, base_offsets=jnp.zeros(n),
+    )
+    model = coord.initialize_model()
+    assert np.all(np.isfinite(np.asarray(model.score_dataset(ds1))))  # same proj ok
+    with pytest.raises(ValueError, match="different RandomProjectors"):
+        model.score_dataset(ds2)
+
+
+def test_normalized_projection_scoring_consistency():
+    """Training under normalization + RANDOM_PROJECTION must score raw
+    validation features correctly (regression test: the projector carries the
+    normalization so scoring datasets fold it too)."""
+    from photon_ml_tpu.normalization import FeatureDataStatistics, NormalizationContext
+    from photon_ml_tpu.types import NormalizationType
+
+    rng = np.random.default_rng(10)
+    data = _glmix_input(rng, n=500, d=30, n_users=6)
+    # shift/scale the per-user shard so normalization is material
+    per_user = data.features["per-user"].toarray()
+    per_user[:, 1:] = per_user[:, 1:] * 5.0 + 1.0 * (per_user[:, 1:] != 0)
+    data = GameInput(
+        features={"global": data.features["global"], "per-user": sp.csr_matrix(per_user)},
+        labels=data.labels,
+        id_columns=data.id_columns,
+    )
+    stats = FeatureDataStatistics.compute(per_user, intercept_index=0)
+    norm = NormalizationContext.build(NormalizationType.STANDARDIZATION, stats)
+    configs = {
+        "per-user": CoordinateConfiguration(
+            data_config=RandomEffectDataConfiguration(
+                "userId", "per-user",
+                projector=ProjectorConfig(
+                    ProjectorType.RANDOM_PROJECTION, projected_dim=10, seed=5,
+                    intercept_index=0,
+                ),
+            ),
+            optimization_config=OPT,
+        ),
+    }
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=configs,
+        normalization_contexts={"per-user": norm},
+    )
+    model = est.fit(data)[0].model
+    re_model = model.get_model("per-user")
+    assert re_model.projector is not None and re_model.projector.normalization is not None
+    # transform scores (raw features in, projector folds normalization)
+    scores = GameTransformer(model=model).score(data, include_offsets=False)
+    pos, neg = scores[data.labels == 1], scores[data.labels == 0]
+    assert (pos[:, None] > neg[None, :]).mean() > 0.7
+    # export path: back-projected original-space model reproduces the scores
+    # over RAW features
+    back = re_model.to_original_space()
+    scores_back = GameTransformer(
+        model=model.update_model("per-user", back)
+    ).score(data, include_offsets=False)
+    np.testing.assert_allclose(scores_back, scores, rtol=1e-3, atol=1e-4)
+
+
+def test_dataset_built_in_projected_space():
+    rng = np.random.default_rng(6)
+    n, d, k = 120, 40, 8
+    ents = rng.integers(0, 6, size=n)
+    X = sp.csr_matrix(rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.25))
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    proj = make_projector(
+        ProjectorConfig(ProjectorType.RANDOM_PROJECTION, projected_dim=k, seed=1), d
+    )
+    ds = build_random_effect_dataset(
+        X, ents, "e", labels=y, projector=proj
+    )
+    # every entity observes all k projected columns
+    assert ds.max_k == k
+    assert ds.projector is proj
+    pt = np.asarray(ds.proj_indices)
+    for row in pt:
+        np.testing.assert_array_equal(np.sort(row[row >= 0]), np.arange(k))
+
+
+def _glmix_input(rng, n=600, d=40, n_users=7):
+    w = rng.normal(size=d) * 0.6
+    bias = rng.normal(size=n_users) * 1.2
+    X = (rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.4)).astype(np.float64)
+    users = rng.integers(0, n_users, size=n)
+    z = X @ w + bias[users]
+    y = (z + 0.2 * rng.normal(size=n) > 0).astype(np.float64)
+    uid = np.asarray([f"u{u}" for u in users], dtype=object)
+    # per-user shard: intercept + the global features (high-dim, worth projecting)
+    per_user = sp.hstack([sp.csr_matrix(np.ones((n, 1))), sp.csr_matrix(X)]).tocsr()
+    return GameInput(
+        features={"global": X, "per-user": per_user},
+        labels=y,
+        id_columns={"userId": uid},
+    )
+
+
+def test_estimator_end_to_end_with_random_projection():
+    rng = np.random.default_rng(7)
+    data = _glmix_input(rng)
+    configs = {
+        "fixed": CoordinateConfiguration(
+            data_config=FixedEffectDataConfiguration("global"),
+            optimization_config=OPT,
+        ),
+        "per-user": CoordinateConfiguration(
+            data_config=RandomEffectDataConfiguration(
+                "userId",
+                "per-user",
+                projector=ProjectorConfig(
+                    ProjectorType.RANDOM_PROJECTION, projected_dim=8, seed=2,
+                    intercept_index=0,
+                ),
+            ),
+            optimization_config=OPT,
+        ),
+    }
+    est = GameEstimator(task=TaskType.LOGISTIC_REGRESSION, coordinate_configurations=configs)
+    result = est.fit(data)[0]
+    model = result.model
+    re_model = model.get_model("per-user")
+    assert re_model.projector is not None
+    # 9 valid slots (8 projected + intercept); width may be pow2-padded beyond that
+    proj = np.asarray(re_model.proj_indices)
+    assert int((proj[0] >= 0).sum()) == 9
+
+    # transform end-to-end: model carries the projector, scores are finite and
+    # discriminative (AUC over train data comfortably above chance)
+    scores = GameTransformer(model=model).score(data, include_offsets=False)
+    assert np.all(np.isfinite(scores))
+    pos, neg = scores[data.labels == 1], scores[data.labels == 0]
+    auc = (pos[:, None] > neg[None, :]).mean()
+    assert auc > 0.75
+
+    # back-projection to original space preserves the model's scores
+    back = re_model.to_original_space()
+    assert back.projector is None
+    game2 = model.update_model("per-user", back)
+    scores2 = GameTransformer(model=game2).score(data, include_offsets=False)
+    # f32 round-off: back-projection reorders the accumulation
+    np.testing.assert_allclose(scores2, scores, rtol=1e-4, atol=1e-6)
+
+
+def test_projected_model_save_load_roundtrip(tmp_path):
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+
+    rng = np.random.default_rng(8)
+    data = _glmix_input(rng, n=300, d=20, n_users=4)
+    configs = {
+        "per-user": CoordinateConfiguration(
+            data_config=RandomEffectDataConfiguration(
+                "userId",
+                "per-user",
+                projector=ProjectorConfig(
+                    ProjectorType.RANDOM_PROJECTION, projected_dim=6, seed=3,
+                    intercept_index=0,
+                ),
+            ),
+            optimization_config=OPT,
+        ),
+    }
+    est = GameEstimator(task=TaskType.LOGISTIC_REGRESSION, coordinate_configurations=configs)
+    model = est.fit(data)[0].model
+    index_maps = {"per-user": IndexMap([f"f{i}\x01" for i in range(21)])}
+    out = str(tmp_path / "model")
+    save_game_model(out, model, index_maps)
+    loaded = load_game_model(out, index_maps)
+    scores = GameTransformer(model=model).score(data, include_offsets=False)
+    scores_loaded = GameTransformer(model=loaded).score(data, include_offsets=False)
+    np.testing.assert_allclose(scores_loaded, scores, rtol=1e-4, atol=1e-6)
