@@ -1,0 +1,16 @@
+"""Command-line drivers (the photon-client layer).
+
+- ``python -m photon_ml_tpu.cli.game_training_driver`` — GAME training
+  (GameTrainingDriver.scala:55-855 equivalent)
+- ``python -m photon_ml_tpu.cli.game_scoring_driver`` — GAME scoring
+  (GameScoringDriver.scala:39-284 equivalent)
+- ``python -m photon_ml_tpu.cli.feature_indexing_driver`` — offline feature
+  index building (FeatureIndexingDriver.scala:41-320 equivalent)
+- ``python -m photon_ml_tpu.cli.name_and_term_bags_driver`` — distinct
+  (name, term) extraction per bag (NameAndTermFeatureBagsDriver equivalent)
+
+Flag names and composite-argument grammar mirror the reference's scopt parsers
+(io/scopt/*), so reference invocations translate 1:1:
+``--coordinate-configurations "name=global,feature.shard=shardA,min.partitions=1,
+optimizer=LBFGS,max.iter=50,tolerance=1e-7,regularization=L2,reg.weights=0.1|1|10"``.
+"""

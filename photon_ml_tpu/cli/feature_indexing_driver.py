@@ -1,0 +1,61 @@
+"""Offline feature-index building.
+
+Parity target: photon-client index/FeatureIndexingDriver.scala:41-320 — read
+Avro data, collect the distinct (name, term) set per feature shard, and write
+index stores consumed at train/score time (the reference writes partitioned
+PalDB files read per-executor off-heap; here one compact .npz per shard, loaded
+via data/index_map.IndexMap.load, or the mmap store in data/offheap_index.py
+for very large feature spaces).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from photon_ml_tpu.cli.parsers import parse_feature_shard_configuration
+from photon_ml_tpu.data import avro_io
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="feature-indexing-driver",
+        description="Build per-shard feature index maps from Avro data.",
+    )
+    p.add_argument("--input-data-directories", required=True)
+    p.add_argument("--output-directory", required=True)
+    p.add_argument("--feature-shard-configurations", action="append", required=True)
+    p.add_argument("--num-partitions", type=int, default=1, help=argparse.SUPPRESS)
+    return p
+
+
+def run(args: argparse.Namespace) -> dict:
+    shard_configs = dict(
+        parse_feature_shard_configuration(a) for a in args.feature_shard_configurations
+    )
+    keys: dict[str, set] = {s: set() for s in shard_configs}
+    for rec in avro_io.read_container_dir(args.input_data_directories):
+        for shard, cfg in shard_configs.items():
+            for bag in cfg.feature_bags:
+                for f in rec.get(bag) or ():
+                    keys[shard].add(feature_key(f["name"], f["term"]))
+    os.makedirs(args.output_directory, exist_ok=True)
+    sizes = {}
+    for shard, cfg in shard_configs.items():
+        imap = IndexMap.build(keys[shard], add_intercept=cfg.has_intercept)
+        imap.save(os.path.join(args.output_directory, shard))
+        sizes[shard] = imap.size
+    return {"sizes": sizes, "output_directory": args.output_directory}
+
+
+def main(argv=None) -> int:
+    result = run(build_arg_parser().parse_args(argv))
+    for shard, size in result["sizes"].items():
+        print(f"{shard}: {size} features")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

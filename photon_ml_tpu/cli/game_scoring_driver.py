@@ -1,0 +1,168 @@
+"""GAME scoring CLI driver.
+
+Parity target: photon-client cli/game/scoring/GameScoringDriver.scala:39-284 —
+read data, load a saved GAME model, score through GameTransformer, write
+ScoringResultAvro files, optionally evaluate when the data has labels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.cli.game_training_driver import _load_index_maps
+from photon_ml_tpu.cli.parsers import (
+    parse_evaluator_spec,
+    parse_feature_shard_configuration,
+)
+from photon_ml_tpu.data import avro_io
+from photon_ml_tpu.data.readers import read_merged_avro
+from photon_ml_tpu.io.model_io import load_game_model
+from photon_ml_tpu.models.game import RandomEffectModel
+from photon_ml_tpu.transformers.game_transformer import GameTransformer
+from photon_ml_tpu.util import PhotonLogger, Timed
+
+SCORES_DIR = "scores"
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="game-scoring-driver", description="Score data with a saved GAME model."
+    )
+    p.add_argument("--input-data-directories", required=True)
+    p.add_argument("--model-input-directory", required=True)
+    p.add_argument("--root-output-directory", required=True)
+    p.add_argument("--override-output-directory", action="store_true")
+    p.add_argument("--feature-shard-configurations", action="append", required=True)
+    p.add_argument("--off-heap-index-map-directory", default=None)
+    p.add_argument("--evaluators", default=None)
+    p.add_argument("--model-id", default=None, help="ID to tag scores with")
+    p.add_argument("--log-data-and-model-stats", action="store_true")
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--application-name", default="game-scoring")
+    # Spark-isms, accepted and ignored
+    p.add_argument("--spill-scores-to-disk", action="store_true", help=argparse.SUPPRESS)
+    return p
+
+
+def run(args: argparse.Namespace) -> dict:
+    root = args.root_output_directory
+    if os.path.exists(root):
+        if args.override_output_directory:
+            shutil.rmtree(root)
+        elif os.listdir(root):
+            raise FileExistsError(
+                f"Output directory {root!r} exists; pass --override-output-directory"
+            )
+    os.makedirs(root, exist_ok=True)
+    logger = PhotonLogger(os.path.join(root, "logs", "photon.log"), level=args.log_level)
+    try:
+        shard_configs = dict(
+            parse_feature_shard_configuration(a) for a in args.feature_shard_configurations
+        )
+        # prefer index maps saved next to the model (training driver layout),
+        # then the explicit off-heap dir
+        index_maps = _load_index_maps(
+            os.path.join(args.model_input_directory, "..", "index-maps"), shard_configs
+        )
+        index_maps.update(
+            _load_index_maps(args.off_heap_index_map_directory, shard_configs) or {}
+        )
+        maps_for_load = dict(index_maps)
+
+        # model first: its coordinates define the id tags the data needs.
+        # load_game_model keys index maps by COORDINATE id; model dirs carry
+        # the shard id in id-info, so map via an initial listing pass.
+        coord_shards = _coordinate_shards(args.model_input_directory)
+        missing = sorted({s for s in coord_shards.values() if s not in maps_for_load})
+        if missing:
+            raise FileNotFoundError(
+                f"No saved index maps found for shard(s) {missing}; expected "
+                f"<model-dir>/../index-maps/<shard>.npz (training driver output) "
+                f"or --off-heap-index-map-directory"
+            )
+        with Timed("load model", logger):
+            model = load_game_model(
+                args.model_input_directory,
+                {cid: maps_for_load[shard] for cid, shard in coord_shards.items()},
+            )
+        id_tags = sorted(
+            {m.re_type for _, m in model if isinstance(m, RandomEffectModel)}
+        )
+
+        with Timed("read data", logger):
+            data, index_maps, uids = read_merged_avro(
+                args.input_data_directories, shard_configs, index_maps, id_tags
+            )
+        logger.info("scoring %d samples", data.n)
+
+        evaluator_specs = (
+            [parse_evaluator_spec(e) for e in args.evaluators.split(",") if e]
+            if args.evaluators
+            else []
+        )
+        transformer = GameTransformer(model=model, evaluators=evaluator_specs)
+        with Timed("score", logger):
+            scores, metrics = transformer.transform(data)
+        if metrics:
+            for name, value in metrics.items():
+                logger.info("metric %s = %.6f", name, value)
+
+        with Timed("write scores", logger):
+            _write_scores(
+                os.path.join(root, SCORES_DIR, "part-00000.avro"),
+                uids, scores, data, args.model_id or "",
+            )
+        return {"scores": scores, "metrics": metrics, "output_directory": root}
+    finally:
+        logger.close()
+
+
+def _coordinate_shards(model_dir: str) -> dict[str, str]:
+    """coordinate id -> feature shard id from the saved model's id-info files."""
+    import json
+
+    out: dict[str, str] = {}
+    for section in ("fixed-effect", "random-effect"):
+        base = os.path.join(model_dir, section)
+        if not os.path.isdir(base):
+            continue
+        for cid in os.listdir(base):
+            info = os.path.join(base, cid, "id-info")  # model_io.ID_INFO
+            if os.path.exists(info):
+                with open(info) as f:
+                    out[cid] = json.load(f).get("featureShardId", "global")
+    return out
+
+
+def _write_scores(path, uids, scores, data, model_id: str) -> None:
+    """ScoringResultAvro records (GameScoringDriver.saveScoresToHDFS:229-256)."""
+    has_labels = data.has_labels
+
+    def records():
+        for i in range(len(scores)):
+            yield {
+                "uid": str(uids[i]) if uids is not None else str(i),
+                "label": float(data.labels[i]) if has_labels else None,
+                "modelId": model_id,
+                "predictionScore": float(scores[i]),
+                "weight": float(data.weights[i]),
+                "metadataMap": None,
+            }
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    avro_io.write_container(path, avro_io.SCORING_RESULT_SCHEMA, records())
+
+
+def main(argv=None) -> int:
+    run(build_arg_parser().parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

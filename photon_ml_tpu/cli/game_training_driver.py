@@ -1,0 +1,397 @@
+"""GAME training CLI driver.
+
+Parity target: photon-client cli/game/training/GameTrainingDriver.scala:55-855 —
+the end-to-end training pipeline: feature maps -> Avro read -> validation ->
+stats/normalization -> coordinate-config grid -> GameEstimator.fit (warm-started
+sweep) -> hyperparameter tuning -> model selection -> model + metadata save.
+Flag names mirror the reference's scopt parser (param name with spaces ->
+dashes), so reference invocations translate 1:1; Spark-only flags
+(min.partitions, tree aggregate depth) are accepted and ignored.
+
+Output layout (GameTrainingDriver.scala:71-73, 768-825):
+    <root>/best/...            best model by validation metric (or last config)
+    <root>/models/<i>/...      one dir per trained configuration (OUTPUT mode ALL)
+    each model dir: model files (model_io layout) + model-spec.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.cli.parsers import (
+    ModelOutputMode,
+    coordinate_configuration_to_string,
+    parse_coordinate_configuration,
+    parse_evaluator_spec,
+    parse_feature_shard_configuration,
+)
+from photon_ml_tpu.data import avro_io
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.readers import read_merged_avro
+from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
+from photon_ml_tpu.estimators.config import RandomEffectDataConfiguration
+from photon_ml_tpu.estimators.evaluation_function import GameEstimatorEvaluationFunction
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.hyperparameter.tuner import build_tuner
+from photon_ml_tpu.io.model_io import load_game_model, save_game_model
+from photon_ml_tpu.normalization import FeatureDataStatistics, NormalizationContext
+from photon_ml_tpu.types import (
+    HyperparameterTuningMode,
+    NormalizationType,
+    TaskType,
+    VarianceComputationType,
+)
+from photon_ml_tpu.util import Event, EventEmitter, PhotonLogger, Timed
+
+BEST_DIR = "best"
+MODELS_DIR = "models"
+MODEL_SPEC_FILE = "model-spec.json"
+SUMMARY_FILE = "feature-summary.avro"
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="game-training-driver",
+        description="Train a GAME (GLMix) model on TPU.",
+    )
+    # GameDriver shared params (GameDriver.scala:56-131)
+    p.add_argument("--input-data-directories", required=True,
+                   help="Comma-separated training data paths (Avro files/dirs)")
+    p.add_argument("--validation-data-directories", default=None)
+    p.add_argument("--off-heap-index-map-directory", default=None,
+                   help="Directory of per-shard saved index maps (<shard>.npz)")
+    p.add_argument("--model-input-directory", default=None,
+                   help="Warm-start / partial-retrain model directory")
+    p.add_argument("--evaluators", default=None,
+                   help="Comma-separated evaluators, e.g. AUC,RMSE,PRECISION@5:userId")
+    p.add_argument("--root-output-directory", required=True)
+    p.add_argument("--override-output-directory", action="store_true")
+    p.add_argument("--feature-shard-configurations", action="append", required=True,
+                   help='e.g. "name=shardA,feature.bags=features,intercept=true"')
+    p.add_argument("--data-validation", default="VALIDATE_DISABLED",
+                   choices=[m.value for m in DataValidationType])
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--application-name", default="game-training")
+    # GameTrainingDriver params (GameTrainingDriver.scala:82-173)
+    p.add_argument("--training-task", required=True,
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--coordinate-configurations", action="append", required=True)
+    p.add_argument("--coordinate-update-sequence", required=True,
+                   help="Comma-separated coordinate names, update order")
+    p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument("--partial-retrain-locked-coordinates", default=None)
+    p.add_argument("--normalization", default="NONE",
+                   choices=[n.value for n in NormalizationType])
+    p.add_argument("--data-summary-directory", default=None)
+    p.add_argument("--output-mode", default="BEST",
+                   choices=[m.value for m in ModelOutputMode])
+    p.add_argument("--hyper-parameter-tuner", default="ATLAS")
+    p.add_argument("--hyper-parameter-tuning", default="NONE",
+                   choices=[m.value for m in HyperparameterTuningMode])
+    p.add_argument("--hyper-parameter-tuning-iterations", type=int, default=10)
+    p.add_argument("--variance-computation-type", default="NONE",
+                   choices=[v.value for v in VarianceComputationType])
+    p.add_argument("--model-sparsity-threshold", type=float, default=0.0)
+    p.add_argument("--ignore-threshold-for-new-models", action="store_true")
+    # Spark-isms accepted for 1:1 invocation compatibility (no-ops here)
+    p.add_argument("--min-validation-partitions", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--tree-aggregate-depth", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--timezone", default=None, help=argparse.SUPPRESS)
+    return p
+
+
+def _load_index_maps(directory: Optional[str], shard_ids) -> dict:
+    """Per-shard saved index maps (<dir>/<shard>.npz), the PalDB off-heap
+    equivalent (GameDriver.prepareFeatureMapsDefault:185-205)."""
+    if directory is None:
+        return {}
+    out = {}
+    for shard in shard_ids:
+        path = os.path.join(directory, f"{shard}.npz")
+        if os.path.exists(path):
+            out[shard] = IndexMap.load(path)
+    return out
+
+
+def _write_feature_summary(path: str, shard_id: str, imap: IndexMap,
+                           stats: FeatureDataStatistics) -> None:
+    """FeatureSummarizationResultAvro records per feature
+    (ModelProcessingUtils.writeBasicStatistics:516-606)."""
+    from photon_ml_tpu.io.model_io import _split_key
+
+    def records():
+        for j in range(len(stats.mean)):
+            name, term = _split_key(imap.get_feature_name(j) or str(j))
+            yield {
+                "featureName": name,
+                "featureTerm": term,
+                "metrics": {
+                    "count": float(stats.count),
+                    "mean": float(stats.mean[j]),
+                    "variance": float(stats.variance[j]),
+                    "min": float(stats.min[j]),
+                    "max": float(stats.max[j]),
+                    "numNonzeros": float(stats.num_nonzeros[j]),
+                    "meanAbs": float(stats.mean_abs[j]),
+                },
+            }
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    avro_io.write_container(path, avro_io.FEATURE_SUMMARIZATION_SCHEMA, records())
+
+
+def _save_result(out_dir: str, result, index_maps_by_coord, sparsity_threshold, logger):
+    os.makedirs(out_dir, exist_ok=True)
+    save_game_model(
+        out_dir,
+        result.best_model,
+        index_maps_by_coord,
+        sparsity_threshold=sparsity_threshold,
+        extra_metadata={
+            "evaluations": result.evaluations,
+            "bestMetric": result.best_metric,
+        },
+    )
+    spec = {
+        cid: coordinate_configuration_to_string(
+            cid,
+            # model-spec records the EXPANDED config actually trained
+            _cfg_with(result.configuration[cid]),
+        )
+        for cid in result.configuration
+    }
+    with open(os.path.join(out_dir, MODEL_SPEC_FILE), "w") as f:
+        json.dump(spec, f, indent=2)
+    logger.info("saved model to %s", out_dir)
+
+
+def _cfg_with(opt_config):
+    from photon_ml_tpu.estimators.config import CoordinateConfiguration, FixedEffectDataConfiguration
+
+    return CoordinateConfiguration(
+        data_config=FixedEffectDataConfiguration(),
+        optimization_config=opt_config,
+        reg_weights=(opt_config.regularization_weight,)
+        if opt_config.regularization_weight
+        else (),
+    )
+
+
+def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dict:
+    """Full training pipeline (GameTrainingDriver.run:346-482). Returns a summary
+    dict {"results": [...], "best_index": i, "output_directory": ...}."""
+    emitter = emitter or EventEmitter()
+    root = args.root_output_directory
+    if os.path.exists(root):
+        if args.override_output_directory:
+            shutil.rmtree(root)
+        elif os.listdir(root):
+            raise FileExistsError(
+                f"Output directory {root!r} exists; pass --override-output-directory"
+            )
+    os.makedirs(root, exist_ok=True)
+    logger = PhotonLogger(os.path.join(root, "logs", "photon.log"), level=args.log_level)
+    emitter.send_event(Event("PhotonSetupEvent", {"applicationName": args.application_name}))
+
+    try:
+        task = TaskType(args.training_task)
+
+        shard_configs = dict(
+            parse_feature_shard_configuration(a) for a in args.feature_shard_configurations
+        )
+        coord_configs = dict(
+            parse_coordinate_configuration(a) for a in args.coordinate_configurations
+        )
+        update_sequence = [c for c in args.coordinate_update_sequence.split(",") if c]
+        unknown = set(update_sequence) - set(coord_configs)
+        if unknown:
+            raise ValueError(f"Update sequence references unknown coordinates: {sorted(unknown)}")
+        # estimator trains in coordinate_configurations insertion order = sequence
+        coord_configs = {c: coord_configs[c] for c in update_sequence}
+        id_tags = sorted(
+            {
+                cfg.data_config.random_effect_type
+                for cfg in coord_configs.values()
+                if isinstance(cfg.data_config, RandomEffectDataConfiguration)
+            }
+        )
+
+        index_maps = _load_index_maps(args.off_heap_index_map_directory, shard_configs)
+
+        with Timed("read training data", logger):
+            train_input, index_maps, _uids = read_merged_avro(
+                args.input_data_directories, shard_configs, index_maps, id_tags
+            )
+        logger.info("training data: %d samples, shards %s",
+                    train_input.n, {s: m.shape[1] for s, m in train_input.features.items()})
+
+        validation_input = None
+        if args.validation_data_directories:
+            with Timed("read validation data", logger):
+                validation_input, _, _ = read_merged_avro(
+                    args.validation_data_directories, shard_configs, index_maps, id_tags
+                )
+
+        with Timed("data validation", logger):
+            sanity_check_data(
+                task,
+                train_input.labels,
+                offsets=train_input.offsets,
+                weights=train_input.weights,
+                feature_shards=train_input.features,
+                validation_type=DataValidationType(args.data_validation),
+            )
+
+        # -- statistics + normalization (GameTrainingDriver.run:430-436) --------
+        normalization_contexts = None
+        norm_type = NormalizationType(args.normalization)
+        if norm_type != NormalizationType.NONE or args.data_summary_directory:
+            normalization_contexts = {}
+            for shard, X in train_input.features.items():
+                icpt = index_maps[shard].intercept_index
+                with Timed(f"feature statistics [{shard}]", logger):
+                    stats = FeatureDataStatistics.compute(X, intercept_index=icpt)
+                if args.data_summary_directory:
+                    _write_feature_summary(
+                        os.path.join(args.data_summary_directory, f"{shard}-{SUMMARY_FILE}"),
+                        shard, index_maps[shard], stats,
+                    )
+                if norm_type != NormalizationType.NONE:
+                    normalization_contexts[shard] = NormalizationContext.build(norm_type, stats)
+            if norm_type == NormalizationType.NONE:
+                normalization_contexts = None
+
+        # -- warm start / partial retrain (GameTrainingDriver.scala:370-409) ----
+        initial_model = None
+        index_maps_by_coord = {
+            cid: index_maps[cfg.data_config.feature_shard_id]
+            for cid, cfg in coord_configs.items()
+        }
+        if args.model_input_directory:
+            with Timed("load initial model", logger):
+                initial_model = load_game_model(args.model_input_directory, index_maps_by_coord)
+        locked = (
+            [c for c in args.partial_retrain_locked_coordinates.split(",") if c]
+            if args.partial_retrain_locked_coordinates
+            else []
+        )
+
+        evaluator_specs = (
+            [parse_evaluator_spec(e) for e in args.evaluators.split(",") if e]
+            if args.evaluators
+            else []
+        )
+
+        estimator = GameEstimator(
+            task=task,
+            coordinate_configurations=coord_configs,
+            n_iterations=args.coordinate_descent_iterations,
+            normalization_contexts=normalization_contexts,
+            variance_computation=VarianceComputationType(args.variance_computation_type),
+            validation_evaluators=evaluator_specs,
+            partial_retrain_locked_coordinates=locked,
+        )
+
+        emitter.send_event(Event("TrainingStartEvent"))
+        with Timed("train", logger):
+            results = estimator.fit(
+                train_input, validation_data=validation_input, initial_model=initial_model
+            )
+
+        # -- hyperparameter tuning (GameTrainingDriver.runHyperparameterTuning) --
+        tuning_mode = HyperparameterTuningMode(args.hyper_parameter_tuning)
+        tuned_results = []
+        if tuning_mode != HyperparameterTuningMode.NONE:
+            if validation_input is None:
+                raise ValueError("Hyperparameter tuning requires validation data")
+            base_configs = results[-1].configuration
+            primary = estimator.prepare_evaluation_suite(validation_input).evaluators[0]
+            is_max = getattr(primary, "larger_is_better", True)
+            fn = GameEstimatorEvaluationFunction(
+                estimator=estimator,
+                base_configs=base_configs,
+                data=train_input,
+                validation_data=validation_input,
+                is_opt_max=is_max,
+            )
+            observations = fn.convert_observations(results)
+            tuner = build_tuner(args.hyper_parameter_tuner)
+            with Timed("hyperparameter tuning", logger):
+                tuned_results = tuner.search(
+                    args.hyper_parameter_tuning_iterations,
+                    fn.num_params,
+                    tuning_mode,
+                    fn,
+                    observations,
+                )
+            results = results + list(tuned_results)
+
+        # -- model selection (GameTrainingDriver.selectBestModel:683-748) -------
+        def metric_key(r):
+            return r.best_metric if r.best_metric is not None else float("-inf")
+
+        have_metrics = any(r.best_metric is not None for r in results)
+        if have_metrics:
+            primary = estimator.prepare_evaluation_suite(validation_input).evaluators[0]
+            bigger_better = getattr(primary, "larger_is_better", True)
+            best_index = int(
+                max(
+                    range(len(results)),
+                    key=lambda i: metric_key(results[i]) * (1 if bigger_better else -1),
+                )
+            )
+        else:
+            best_index = len(results) - 1  # no validation: last trained config
+        logger.info("selected model %d of %d", best_index, len(results))
+
+        # -- save (GameTrainingDriver.scala:759-826) -----------------------------
+        output_mode = ModelOutputMode(args.output_mode)
+        if output_mode != ModelOutputMode.NONE:
+            _save_result(
+                os.path.join(root, BEST_DIR), results[best_index], index_maps_by_coord,
+                args.model_sparsity_threshold, logger,
+            )
+            if output_mode in (ModelOutputMode.ALL, ModelOutputMode.EXPLICIT, ModelOutputMode.TUNED):
+                to_save = (
+                    range(len(results))
+                    if output_mode == ModelOutputMode.ALL
+                    else range(len(results) - len(tuned_results), len(results))
+                    if output_mode == ModelOutputMode.TUNED
+                    else range(len(results) - len(tuned_results))
+                )
+                for i in to_save:
+                    _save_result(
+                        os.path.join(root, MODELS_DIR, str(i)), results[i],
+                        index_maps_by_coord, args.model_sparsity_threshold, logger,
+                    )
+            # persist index maps next to the models for scoring-time reuse
+            for shard, imap in index_maps.items():
+                imap.save(os.path.join(root, "index-maps", shard))
+
+        emitter.send_event(Event("TrainingFinishEvent", {"bestIndex": best_index}))
+        return {
+            "results": results,
+            "best_index": best_index,
+            "output_directory": root,
+        }
+    finally:
+        logger.close()
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    run(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

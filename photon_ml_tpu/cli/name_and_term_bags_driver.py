@@ -1,0 +1,56 @@
+"""Distinct (name, term) extraction per feature bag, written as text.
+
+Parity target: photon-client data/avro/NameAndTermFeatureBagsDriver.scala:1-219 —
+for each configured feature bag, collect the distinct (name, term) pairs in the
+data and write them one-per-line ("name<TAB>term") for downstream feature-map
+building from feature-bag text files (GameDriver.prepareFeatureMapsDefault).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from photon_ml_tpu.data import avro_io
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="name-and-term-bags-driver",
+        description="Extract distinct (name, term) feature sets per bag.",
+    )
+    p.add_argument("--input-data-directories", required=True)
+    p.add_argument("--output-directory", required=True)
+    p.add_argument("--feature-bags", required=True,
+                   help="Comma-separated record fields holding FeatureAvro arrays")
+    return p
+
+
+def run(args: argparse.Namespace) -> dict:
+    bags = [b for b in args.feature_bags.split(",") if b]
+    distinct: dict[str, set] = {b: set() for b in bags}
+    for rec in avro_io.read_container_dir(args.input_data_directories):
+        for bag in bags:
+            for f in rec.get(bag) or ():
+                distinct[bag].add((f["name"], f["term"]))
+    os.makedirs(args.output_directory, exist_ok=True)
+    counts = {}
+    for bag, pairs in distinct.items():
+        path = os.path.join(args.output_directory, bag)
+        with open(path, "w") as f:
+            for name, term in sorted(pairs):
+                f.write(f"{name}\t{term}\n")
+        counts[bag] = len(pairs)
+    return {"counts": counts, "output_directory": args.output_directory}
+
+
+def main(argv=None) -> int:
+    result = run(build_arg_parser().parse_args(argv))
+    for bag, count in result["counts"].items():
+        print(f"{bag}: {count} features")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

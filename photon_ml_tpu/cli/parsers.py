@@ -1,0 +1,289 @@
+"""Composite-argument grammar shared by the CLI drivers.
+
+Parity target: photon-client io/scopt/ScoptParserHelpers.scala:1-495 — the
+``key=value`` list grammar with "," as the list delimiter, "|" as the secondary
+(in-value) list delimiter, and "-" as the range delimiter, used by
+``--feature-shard-configurations`` and ``--coordinate-configurations``; plus
+exact round-trip printing (parseFromCommandLine / printForCommandLine). Key
+names match the reference constants (ScoptParserHelpers.scala:47-98) so
+reference command lines work unchanged.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Mapping, Optional
+
+from photon_ml_tpu.data.projector import ProjectorConfig, ProjectorType
+from photon_ml_tpu.estimators.config import (
+    CoordinateConfiguration,
+    FeatureShardConfiguration,
+    FixedEffectDataConfiguration,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.types import OptimizerType, RegularizationType
+
+KV_DELIMITER = "="
+LIST_DELIMITER = ","
+SECONDARY_LIST_DELIMITER = "|"
+RANGE_DELIMITER = "-"
+
+# Feature shard configuration keys (ScoptParserHelpers.scala:47-55)
+FEATURE_SHARD_CONFIG_NAME = "name"
+FEATURE_SHARD_CONFIG_FEATURE_BAGS = "feature.bags"
+FEATURE_SHARD_CONFIG_INTERCEPT = "intercept"
+
+# Coordinate configuration keys (ScoptParserHelpers.scala:57-98)
+COORDINATE_CONFIG_NAME = "name"
+COORDINATE_DATA_CONFIG_RANDOM_EFFECT_TYPE = "random.effect.type"
+COORDINATE_DATA_CONFIG_FEATURE_SHARD = "feature.shard"
+COORDINATE_DATA_CONFIG_MIN_PARTITIONS = "min.partitions"
+COORDINATE_DATA_CONFIG_ACTIVE_DATA_LOWER_BOUND = "active.data.lower.bound"
+COORDINATE_DATA_CONFIG_ACTIVE_DATA_UPPER_BOUND = "active.data.upper.bound"
+COORDINATE_DATA_CONFIG_PASSIVE_DATA_BOUND = "passive.data.bound"
+COORDINATE_DATA_CONFIG_FEATURES_TO_SAMPLES_RATIO = "features.to.samples.ratio"
+# TPU-build extension: shared Gaussian random projection per coordinate
+COORDINATE_DATA_CONFIG_PROJECTED_DIM = "projected.dim"
+COORDINATE_DATA_CONFIG_PROJECTION_SEED = "projection.seed"
+
+COORDINATE_OPT_CONFIG_OPTIMIZER = "optimizer"
+COORDINATE_OPT_CONFIG_MAX_ITER = "max.iter"
+COORDINATE_OPT_CONFIG_TOLERANCE = "tolerance"
+COORDINATE_OPT_CONFIG_REGULARIZATION = "regularization"
+COORDINATE_OPT_CONFIG_REG_ALPHA = "reg.alpha"
+COORDINATE_OPT_CONFIG_REG_WEIGHTS = "reg.weights"
+COORDINATE_OPT_CONFIG_DOWN_SAMPLING_RATE = "down.sampling.rate"
+
+
+class ModelOutputMode(str, enum.Enum):
+    """io/ModelOutputMode.scala:20-46."""
+
+    NONE = "NONE"
+    BEST = "BEST"
+    EXPLICIT = "EXPLICIT"
+    TUNED = "TUNED"
+    ALL = "ALL"
+
+
+def parse_kv_args(arg: str) -> dict[str, str]:
+    """"k1=v1,k2=v2" -> {k1: v1, k2: v2} (duplicate keys rejected)."""
+    out: dict[str, str] = {}
+    for part in arg.split(LIST_DELIMITER):
+        part = part.strip()
+        if not part:
+            continue
+        if KV_DELIMITER not in part:
+            raise ValueError(f"Malformed key=value token {part!r} in {arg!r}")
+        k, _, v = part.partition(KV_DELIMITER)
+        k, v = k.strip(), v.strip()
+        if k in out:
+            raise ValueError(f"Duplicate key {k!r} in {arg!r}")
+        out[k] = v
+    return out
+
+
+def _pop(kv: dict, key: str, required: bool = False, default=None):
+    if key in kv:
+        return kv.pop(key)
+    if required:
+        raise ValueError(f"Missing required key {key!r}")
+    return default
+
+
+def parse_feature_shard_configuration(arg: str) -> tuple[str, FeatureShardConfiguration]:
+    """"name=shardA,feature.bags=bag1|bag2[,intercept=true]"
+    (ScoptParserHelpers.parseFeatureShardConfiguration)."""
+    kv = parse_kv_args(arg)
+    name = _pop(kv, FEATURE_SHARD_CONFIG_NAME, required=True)
+    bags = tuple(
+        b for b in _pop(kv, FEATURE_SHARD_CONFIG_FEATURE_BAGS, required=True).split(
+            SECONDARY_LIST_DELIMITER
+        )
+        if b
+    )
+    if not bags:
+        raise ValueError(f"Feature shard {name!r} has no feature bags")
+    intercept = _pop(kv, FEATURE_SHARD_CONFIG_INTERCEPT, default="true").lower() == "true"
+    if kv:
+        raise ValueError(f"Unknown feature shard config keys: {sorted(kv)}")
+    return name, FeatureShardConfiguration(feature_bags=bags, has_intercept=intercept)
+
+
+def parse_coordinate_configuration(arg: str) -> tuple[str, CoordinateConfiguration]:
+    """One "--coordinate-configurations" composite value -> (coordinate id, config)
+    (ScoptParserHelpers.parseCoordinateConfiguration). Keys per
+    ScoptParserHelpers.scala:77-98; presence of random.effect.type selects the
+    random-effect shape and validates fixed-only/random-only keys."""
+    kv = parse_kv_args(arg)
+    name = _pop(kv, COORDINATE_CONFIG_NAME, required=True)
+    shard = _pop(kv, COORDINATE_DATA_CONFIG_FEATURE_SHARD, required=True)
+    _pop(kv, COORDINATE_DATA_CONFIG_MIN_PARTITIONS)  # Spark-ism: accepted, unused
+
+    optimizer = OptimizerType(_pop(kv, COORDINATE_OPT_CONFIG_OPTIMIZER, required=True).upper())
+    max_iter = int(_pop(kv, COORDINATE_OPT_CONFIG_MAX_ITER, required=True))
+    tolerance = float(_pop(kv, COORDINATE_OPT_CONFIG_TOLERANCE, required=True))
+
+    reg_type = RegularizationType(
+        _pop(kv, COORDINATE_OPT_CONFIG_REGULARIZATION, default="NONE").upper()
+    )
+    alpha = _pop(kv, COORDINATE_OPT_CONFIG_REG_ALPHA)
+    reg_ctx = (
+        RegularizationContext(reg_type, elastic_net_alpha=float(alpha))
+        if alpha is not None
+        else RegularizationContext(reg_type)
+    )
+    weights_raw = _pop(kv, COORDINATE_OPT_CONFIG_REG_WEIGHTS)
+    reg_weights = (
+        tuple(float(w) for w in weights_raw.split(SECONDARY_LIST_DELIMITER) if w)
+        if weights_raw
+        else ()
+    )
+
+    re_type = _pop(kv, COORDINATE_DATA_CONFIG_RANDOM_EFFECT_TYPE)
+    down_sampling = float(_pop(kv, COORDINATE_OPT_CONFIG_DOWN_SAMPLING_RATE, default="1.0"))
+    if re_type is None:
+        for key in (
+            COORDINATE_DATA_CONFIG_ACTIVE_DATA_LOWER_BOUND,
+            COORDINATE_DATA_CONFIG_ACTIVE_DATA_UPPER_BOUND,
+            COORDINATE_DATA_CONFIG_PASSIVE_DATA_BOUND,
+            COORDINATE_DATA_CONFIG_FEATURES_TO_SAMPLES_RATIO,
+            COORDINATE_DATA_CONFIG_PROJECTED_DIM,
+        ):
+            if key in kv:
+                raise ValueError(f"{key!r} is only valid for random-effect coordinates")
+        data_config = FixedEffectDataConfiguration(feature_shard_id=shard)
+    else:
+        if down_sampling != 1.0:
+            raise ValueError("down.sampling.rate is only valid for fixed-effect coordinates")
+        lower = int(_pop(kv, COORDINATE_DATA_CONFIG_ACTIVE_DATA_LOWER_BOUND, default="1"))
+        upper_raw = _pop(kv, COORDINATE_DATA_CONFIG_ACTIVE_DATA_UPPER_BOUND)
+        _pop(kv, COORDINATE_DATA_CONFIG_PASSIVE_DATA_BOUND)  # implied by upper bound
+        ratio_raw = _pop(kv, COORDINATE_DATA_CONFIG_FEATURES_TO_SAMPLES_RATIO)
+        proj_dim_raw = _pop(kv, COORDINATE_DATA_CONFIG_PROJECTED_DIM)
+        proj_seed = int(_pop(kv, COORDINATE_DATA_CONFIG_PROJECTION_SEED, default="0"))
+        projector = (
+            ProjectorConfig(
+                ProjectorType.RANDOM_PROJECTION,
+                projected_dim=int(proj_dim_raw),
+                seed=proj_seed,
+            )
+            if proj_dim_raw is not None
+            else None
+        )
+        data_config = RandomEffectDataConfiguration(
+            random_effect_type=re_type,
+            feature_shard_id=shard,
+            active_data_lower_bound=lower,
+            active_data_upper_bound=int(upper_raw) if upper_raw is not None else None,
+            # features.to.samples.ratio caps per-entity features relative to its
+            # sample count; resolved against actual counts at dataset build via
+            # features_max — we conservatively map ratio r to features_max only
+            # when an upper bound exists (r * bound), the reference's effective cap
+            features_max=(
+                int(float(ratio_raw) * int(upper_raw))
+                if ratio_raw is not None and upper_raw is not None
+                else None
+            ),
+            projector=projector,
+        )
+
+    if kv:
+        raise ValueError(f"Unknown coordinate config keys: {sorted(kv)}")
+
+    return name, CoordinateConfiguration(
+        data_config=data_config,
+        optimization_config=GLMOptimizationConfiguration(
+            optimizer_config=OptimizerConfig(
+                optimizer_type=optimizer, max_iterations=max_iter, tolerance=tolerance
+            ),
+            regularization_context=reg_ctx,
+            regularization_weight=reg_weights[0] if reg_weights else 0.0,
+        ),
+        reg_weights=reg_weights,
+        down_sampling_rate=down_sampling,
+    )
+
+
+def coordinate_configuration_to_string(name: str, cfg: CoordinateConfiguration) -> str:
+    """Round-trip printer (ScoptParserHelpers.coordinateConfigsToStrings)."""
+    oc = cfg.optimization_config
+    parts = [
+        f"{COORDINATE_CONFIG_NAME}{KV_DELIMITER}{name}",
+        f"{COORDINATE_DATA_CONFIG_FEATURE_SHARD}{KV_DELIMITER}{cfg.data_config.feature_shard_id}",
+        f"{COORDINATE_OPT_CONFIG_OPTIMIZER}{KV_DELIMITER}{oc.optimizer_config.optimizer_type.value}",
+        f"{COORDINATE_OPT_CONFIG_MAX_ITER}{KV_DELIMITER}{oc.optimizer_config.max_iterations}",
+        f"{COORDINATE_OPT_CONFIG_TOLERANCE}{KV_DELIMITER}{oc.optimizer_config.tolerance}",
+    ]
+    reg = oc.regularization_context
+    if reg.regularization_type != RegularizationType.NONE:
+        parts.append(
+            f"{COORDINATE_OPT_CONFIG_REGULARIZATION}{KV_DELIMITER}{reg.regularization_type.value}"
+        )
+        if reg.regularization_type == RegularizationType.ELASTIC_NET:
+            parts.append(f"{COORDINATE_OPT_CONFIG_REG_ALPHA}{KV_DELIMITER}{reg.elastic_net_alpha}")
+    if cfg.reg_weights:
+        weights = SECONDARY_LIST_DELIMITER.join(str(w) for w in cfg.reg_weights)
+        parts.append(f"{COORDINATE_OPT_CONFIG_REG_WEIGHTS}{KV_DELIMITER}{weights}")
+    dc = cfg.data_config
+    if isinstance(dc, RandomEffectDataConfiguration):
+        parts.insert(
+            2, f"{COORDINATE_DATA_CONFIG_RANDOM_EFFECT_TYPE}{KV_DELIMITER}{dc.random_effect_type}"
+        )
+        if dc.active_data_lower_bound != 1:
+            parts.append(
+                f"{COORDINATE_DATA_CONFIG_ACTIVE_DATA_LOWER_BOUND}{KV_DELIMITER}{dc.active_data_lower_bound}"
+            )
+        if dc.active_data_upper_bound is not None:
+            parts.append(
+                f"{COORDINATE_DATA_CONFIG_ACTIVE_DATA_UPPER_BOUND}{KV_DELIMITER}{dc.active_data_upper_bound}"
+            )
+        if dc.projector is not None and dc.projector.projected_dim:
+            parts.append(
+                f"{COORDINATE_DATA_CONFIG_PROJECTED_DIM}{KV_DELIMITER}{dc.projector.projected_dim}"
+            )
+    elif cfg.down_sampling_rate != 1.0:
+        parts.append(
+            f"{COORDINATE_OPT_CONFIG_DOWN_SAMPLING_RATE}{KV_DELIMITER}{cfg.down_sampling_rate}"
+        )
+    return LIST_DELIMITER.join(parts)
+
+
+def feature_shard_configuration_to_string(name: str, cfg: FeatureShardConfiguration) -> str:
+    parts = [
+        f"{FEATURE_SHARD_CONFIG_NAME}{KV_DELIMITER}{name}",
+        f"{FEATURE_SHARD_CONFIG_FEATURE_BAGS}{KV_DELIMITER}"
+        + SECONDARY_LIST_DELIMITER.join(cfg.feature_bags),
+    ]
+    if not cfg.has_intercept:
+        parts.append(f"{FEATURE_SHARD_CONFIG_INTERCEPT}{KV_DELIMITER}false")
+    return LIST_DELIMITER.join(parts)
+
+
+def parse_evaluator_spec(spec: str):
+    """"AUC" -> EvaluatorType.AUC; "AUC:userId" -> per-group multi evaluator;
+    "PRECISION@5:userId" -> parameterized multi evaluator (the reference's
+    MultiEvaluatorType grammar, e.g. PRECISION@K with an id column)."""
+    from photon_ml_tpu.evaluation.evaluators import (
+        EvaluatorType,
+        MultiEvaluator,
+        evaluator_for_type,
+    )
+
+    spec = spec.strip()
+    id_tag: Optional[str] = None
+    if ":" in spec:
+        spec, _, id_tag = spec.partition(":")
+    k = None
+    if "@" in spec:
+        spec, _, k_raw = spec.partition("@")
+        k = int(k_raw)
+    etype = EvaluatorType(spec.upper().replace("PRECISION", "PRECISION_AT_K") if k else spec.upper())
+    base = evaluator_for_type(etype, k=k) if k else evaluator_for_type(etype)
+    if id_tag:
+        return MultiEvaluator(base, id_tag)
+    return base

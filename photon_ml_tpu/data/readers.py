@@ -119,6 +119,105 @@ def write_training_avro(path: str, dataset_records) -> None:
     avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, dataset_records)
 
 
+def read_merged_avro(
+    path: str,
+    shard_configs,
+    index_maps: Optional[dict] = None,
+    id_tags: Sequence[str] = (),
+):
+    """Avro records -> one GameInput with per-SHARD feature matrices.
+
+    The reference's AvroDataReader.readMerged (photon-client
+    data/avro/AvroDataReader.scala:85-221): each feature SHARD is the union of
+    one or more feature BAGS (record fields holding FeatureAvro arrays); when
+    the same (name, term) appears in several bags of one sample, the first
+    occurrence's VALUE wins; an intercept column is added when the shard config
+    asks for one. Index positions come from the shard's IndexMap (sorted-key
+    order when built here). Entity ids for random effects come from
+    ``metadataMap`` (GameConverters' id-tag extraction); response/offset/weight
+    from the standard TrainingExampleAvro fields.
+
+    shard_configs: {shard_id: FeatureShardConfiguration}. index_maps: existing
+    {shard_id: IndexMap} (e.g. from the feature-indexing driver); missing maps
+    are built from the data (AvroDataReader builds index maps if absent).
+    Returns (GameInput, {shard_id: IndexMap}, uids ndarray).
+    """
+    from photon_ml_tpu.data.game_data import GameInput
+
+    records = list(avro_io.read_container_dir(path))
+    n = len(records)
+    index_maps = dict(index_maps or {})
+
+    # build missing index maps: first-occurrence order over the shard's bags
+    for shard_id, cfg in shard_configs.items():
+        if shard_id in index_maps:
+            continue
+        keys: list[str] = []
+        for rec in records:
+            for bag in cfg.feature_bags:
+                for f in rec.get(bag) or ():
+                    keys.append(feature_key(f["name"], f["term"]))
+        index_maps[shard_id] = IndexMap.build(keys, add_intercept=cfg.has_intercept)
+
+    labels = np.zeros(n)
+    offsets = np.zeros(n)
+    weights = np.ones(n)
+    uids = np.empty(n, dtype=object)
+    has_labels = False
+    id_cols: dict[str, list] = {tag: [] for tag in id_tags}
+    shard_rows: dict[str, list] = {s: [] for s in shard_configs}
+    shard_cols: dict[str, list] = {s: [] for s in shard_configs}
+    shard_vals: dict[str, list] = {s: [] for s in shard_configs}
+
+    for i, rec in enumerate(records):
+        label = rec.get("label", rec.get("response"))
+        if label is not None:
+            labels[i] = label
+            has_labels = True
+        if rec.get("offset") is not None:
+            offsets[i] = rec["offset"]
+        if rec.get("weight") is not None:
+            weights[i] = rec["weight"]
+        uids[i] = rec.get("uid") or str(i)
+        meta = rec.get("metadataMap") or {}
+        for tag in id_tags:
+            if tag not in meta:
+                raise ValueError(f"Sample {i} missing id tag {tag!r} in metadataMap")
+            id_cols[tag].append(meta[tag])
+        for shard_id, cfg in shard_configs.items():
+            imap = index_maps[shard_id]
+            icpt = imap.intercept_index
+            seen: set[int] = set()
+            for bag in cfg.feature_bags:
+                for f in rec.get(bag) or ():
+                    j = imap.get_index(feature_key(f["name"], f["term"]))
+                    if j >= 0 and j not in seen:  # first occurrence wins
+                        seen.add(j)
+                        shard_rows[shard_id].append(i)
+                        shard_cols[shard_id].append(j)
+                        shard_vals[shard_id].append(f["value"])
+            if icpt is not None and icpt not in seen:
+                shard_rows[shard_id].append(i)
+                shard_cols[shard_id].append(icpt)
+                shard_vals[shard_id].append(1.0)
+
+    features = {
+        s: sp.csr_matrix(
+            (np.asarray(shard_vals[s], dtype=np.float64), (shard_rows[s], shard_cols[s])),
+            shape=(n, index_maps[s].size),
+        )
+        for s in shard_configs
+    }
+    game_input = GameInput(
+        features=features,
+        labels=labels if has_labels else None,
+        offsets=offsets,
+        weights=weights,
+        id_columns={k: np.asarray(v, dtype=object) for k, v in id_cols.items()},
+    )
+    return game_input, index_maps, uids
+
+
 def read_libsvm(
     path: str,
     index_map: Optional[IndexMap] = None,

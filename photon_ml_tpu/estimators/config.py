@@ -19,6 +19,16 @@ from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
 
 
 @dataclasses.dataclass(frozen=True)
+class FeatureShardConfiguration:
+    """One feature shard = a union of named feature bags (+ optional intercept)
+    (photon-client io/FeatureShardConfiguration.scala:26: featureBags,
+    hasIntercept)."""
+
+    feature_bags: tuple
+    has_intercept: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class FixedEffectDataConfiguration:
     """Which feature shard feeds a fixed-effect coordinate."""
 

@@ -75,11 +75,23 @@ class AtlasTuner(HyperparameterTuner):
                     "the search's [0,1]^d space"
                 )
             discrete_set = set(config.discrete_params)
+            # config.ranges are RAW (config_from_json keeps min/max untransformed);
+            # the [0,1] scaling must happen in TRANSFORMED space, so transform the
+            # range endpoints with the same map as the points
+            lo_t = transform_forward(
+                np.array([r[0] for r in config.ranges], dtype=np.float64),
+                config.transform_map,
+            )
+            hi_t = transform_forward(
+                np.array([r[1] for r in config.ranges], dtype=np.float64),
+                config.transform_map,
+            )
+            ranges_t = list(zip(lo_t, hi_t))
             priors = [
                 (
                     scale_forward(
                         transform_forward(p, config.transform_map),
-                        config.ranges,
+                        ranges_t,
                         discrete_set,
                     ),
                     v,

@@ -39,7 +39,13 @@ class FeatureDataStatistics:
 
     @staticmethod
     def compute(X, intercept_index: Optional[int] = None) -> "FeatureDataStatistics":
-        """Compute from a dense [N, D] host array (sparse path: data/ingest.py)."""
+        """Compute from a [N, D] host matrix (dense ndarray or scipy sparse; the
+        sparse path never densifies — zeros contribute implicitly, matching the
+        reference's MultivariateOnlineSummarizer semantics)."""
+        import scipy.sparse as _sp
+
+        if _sp.issparse(X):
+            return FeatureDataStatistics._compute_sparse(X.tocsc(), intercept_index)
         X = np.asarray(X)
         n = X.shape[0]
         if n == 0:
@@ -53,6 +59,48 @@ class FeatureDataStatistics:
             max=X.max(axis=0) if n else np.zeros(X.shape[1]),
             num_nonzeros=(X != 0).sum(axis=0).astype(np.float64),
             mean_abs=np.abs(X).mean(axis=0),
+            intercept_index=intercept_index,
+        )
+
+    @staticmethod
+    def _compute_sparse(X, intercept_index: Optional[int]) -> "FeatureDataStatistics":
+        n, d = X.shape
+        if n == 0:
+            raise ValueError("Cannot compute feature statistics over zero samples")
+        nnz = np.diff(X.indptr).astype(np.float64)  # per column (csc)
+        s1 = np.asarray(X.sum(axis=0)).ravel()
+        s2 = np.asarray(X.multiply(X).sum(axis=0)).ravel()
+        mean = s1 / n
+        var = (
+            (s2 - n * mean**2) / (n - 1) if n > 1 else np.zeros(d)
+        )
+        var = np.maximum(var, 0.0)  # guard tiny negative round-off
+        # vectorized per-column min/max over stored values (reduceat needs a
+        # guard for empty columns: their indptr slot would reduce the NEXT
+        # column's first element, so mask them out afterwards)
+        mins = np.zeros(d)
+        maxs = np.zeros(d)
+        nonempty = nnz > 0
+        if X.nnz:
+            starts = X.indptr[:-1]
+            safe_starts = np.minimum(starts, X.nnz - 1)
+            col_min = np.minimum.reduceat(X.data, safe_starts)
+            col_max = np.maximum.reduceat(X.data, safe_starts)
+            mins[nonempty] = col_min[nonempty]
+            maxs[nonempty] = col_max[nonempty]
+        # columns with implicit zeros include 0 in their range
+        has_implicit_zero = nnz < n
+        mins = np.where(has_implicit_zero, np.minimum(mins, 0.0), mins)
+        maxs = np.where(has_implicit_zero, np.maximum(maxs, 0.0), maxs)
+        mean_abs = np.asarray(np.abs(X).sum(axis=0)).ravel() / n
+        return FeatureDataStatistics(
+            count=n,
+            mean=mean,
+            variance=var,
+            min=mins,
+            max=maxs,
+            num_nonzeros=nnz,
+            mean_abs=mean_abs,
             intercept_index=intercept_index,
         )
 

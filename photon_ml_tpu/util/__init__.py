@@ -1,0 +1,18 @@
+"""Utility subsystem: logging, section timing, events.
+
+Parity targets: photon-lib util/PhotonLogger.scala:34-553, util/Timed.scala:34-77,
+photon-client event/*.scala (EventEmitter:24-73).
+"""
+
+from photon_ml_tpu.util.events import Event, EventEmitter, EventListener
+from photon_ml_tpu.util.photon_logger import PhotonLogger
+from photon_ml_tpu.util.timed import Timed, timed
+
+__all__ = [
+    "Event",
+    "EventEmitter",
+    "EventListener",
+    "PhotonLogger",
+    "Timed",
+    "timed",
+]

@@ -1,0 +1,66 @@
+"""Pub-sub event system for driver lifecycle hooks.
+
+Parity target: photon-client event/*.scala — ``EventEmitter`` (register/send
+listeners under a lock, EventEmitter.scala:24-73), ``Event``/``EventListener``,
+and the driver-emitted events (PhotonSetupEvent, TrainingStartEvent,
+TrainingFinishEvent, Event.scala:64). Deployers plug listeners by class path in
+the reference; here listeners are registered programmatically or by dotted path
+via ``register_listener_class``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import threading
+import time
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Base event: a name plus arbitrary payload. Standard driver events use the
+    reference's names (PhotonSetupEvent, TrainingStartEvent, ...)."""
+
+    name: str
+    payload: Optional[dict] = None
+    timestamp: float = dataclasses.field(default_factory=time.time)
+
+
+class EventListener:
+    def on_event(self, event: Event) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class EventEmitter:
+    """Thread-safe listener registry + dispatch (EventEmitter.scala:24-73)."""
+
+    def __init__(self):
+        self._listeners: list[EventListener] = []
+        self._lock = threading.Lock()
+
+    def register_listener(self, listener: EventListener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+    def register_listener_class(self, dotted_path: str, **kwargs: Any) -> None:
+        """Instantiate a listener from "package.module.ClassName" (the
+        reference's class-name-in-config pattern, Driver.scala:95-110)."""
+        module_name, _, cls_name = dotted_path.rpartition(".")
+        cls = getattr(importlib.import_module(module_name), cls_name)
+        self.register_listener(cls(**kwargs))
+
+    def send_event(self, event: Event) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for listener in listeners:
+            listener.on_event(event)
+
+    def clear_listeners(self) -> None:
+        with self._lock:
+            listeners, self._listeners = self._listeners, []
+        for listener in listeners:
+            listener.close()

@@ -1,0 +1,88 @@
+"""Driver logger writing to a run-local log file with its own level filter.
+
+Parity target: photon-lib util/PhotonLogger.scala:34-553 — an SLF4J facade that
+writes driver logs to an HDFS file with per-level filtering, created once per
+driver run (GameTrainingDriver.scala:840). Here: a thin stdlib-logging wrapper
+that tees to a file and (optionally) the console, with the same level surface
+(debug/info/warn/error) and explicit close().
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_LEVELS = {
+    "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO,
+    "WARN": logging.WARNING,
+    "WARNING": logging.WARNING,
+    "ERROR": logging.ERROR,
+}
+
+
+class PhotonLogger:
+    """File-backed run logger with level filtering.
+
+    ``level`` accepts the reference's int levels (logging module ints) or the
+    names DEBUG/INFO/WARN/ERROR.
+    """
+
+    def __init__(
+        self,
+        log_path: Optional[str] = None,
+        level: int | str = "INFO",
+        echo: bool = True,
+        name: str = "photon",
+    ):
+        if isinstance(level, str):
+            level = _LEVELS[level.upper()]
+        self._logger = logging.getLogger(f"{name}.{id(self):x}")
+        self._logger.setLevel(level)
+        self._logger.propagate = False
+        self._handlers = []
+        fmt = logging.Formatter("%(asctime)s [%(levelname)s] %(message)s")
+        if log_path is not None:
+            os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
+            fh = logging.FileHandler(log_path)
+            fh.setFormatter(fmt)
+            self._logger.addHandler(fh)
+            self._handlers.append(fh)
+        if echo:
+            sh = logging.StreamHandler(sys.stderr)
+            sh.setFormatter(fmt)
+            self._logger.addHandler(sh)
+            self._handlers.append(sh)
+
+    def debug(self, msg: str, *args) -> None:
+        self._logger.debug(msg, *args)
+
+    def info(self, msg: str, *args) -> None:
+        self._logger.info(msg, *args)
+
+    def warning(self, msg: str, *args) -> None:
+        self._logger.warning(msg, *args)
+
+    warn = warning
+
+    def error(self, msg: str, *args) -> None:
+        self._logger.error(msg, *args)
+
+    def set_level(self, level: int | str) -> None:
+        if isinstance(level, str):
+            level = _LEVELS[level.upper()]
+        self._logger.setLevel(level)
+
+    def close(self) -> None:
+        for h in self._handlers:
+            self._logger.removeHandler(h)
+            h.close()
+        self._handlers.clear()
+
+    def __enter__(self) -> "PhotonLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

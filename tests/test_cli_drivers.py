@@ -1,0 +1,311 @@
+"""CLI driver integration tests: full train -> score pipeline over generated
+Avro fixtures, parser round-trips, validators, feature indexing. Mirrors the
+reference's GameTrainingDriverIntegTest / GameScoringDriverIntegTest /
+FeatureIndexingDriverIntegTest pattern (photon-client src/integTest) on the
+simulated CPU platform.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli import game_scoring_driver, game_training_driver
+from photon_ml_tpu.cli import feature_indexing_driver, name_and_term_bags_driver
+from photon_ml_tpu.cli.parsers import (
+    coordinate_configuration_to_string,
+    parse_coordinate_configuration,
+    parse_evaluator_spec,
+    parse_feature_shard_configuration,
+)
+from photon_ml_tpu.data import avro_io
+from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
+from photon_ml_tpu.estimators.config import (
+    FixedEffectDataConfiguration,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.evaluation.evaluators import Evaluator, MultiEvaluator
+from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+
+# --------------------------------------------------------------- fixtures
+
+
+def write_glmix_avro(path, rng, n=500, d=5, n_users=8, w=None, bias=None):
+    """TrainingExampleAvro files with a global bag + per-user ids in metadataMap.
+    Pass w/bias to share the ground truth across train/validation splits."""
+    w = rng.normal(size=d) if w is None else w
+    bias = rng.normal(size=n_users) * 1.5 if bias is None else bias
+    X = rng.normal(size=(n, d))
+    users = rng.integers(0, n_users, size=n)
+    z = X @ w + bias[users]
+    y = (z + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+
+    def records():
+        for i in range(n):
+            yield {
+                "uid": f"s{i}",
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(X[i, j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {"userId": f"u{users[i]}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, records())
+    return X, y, users, w, bias
+
+
+FE_COORD = (
+    "name=global,feature.shard=shardA,min.partitions=1,optimizer=LBFGS,"
+    "max.iter=50,tolerance=1e-8,regularization=L2,reg.weights=1.0"
+)
+RE_COORD = (
+    "name=per-user,random.effect.type=userId,feature.shard=shardA,"
+    "min.partitions=1,optimizer=LBFGS,max.iter=50,tolerance=1e-8,"
+    "regularization=L2,reg.weights=1.0"
+)
+
+
+# --------------------------------------------------------------- parsers
+
+
+class TestParsers:
+    def test_feature_shard_configuration(self):
+        name, cfg = parse_feature_shard_configuration(
+            "name=shardA,feature.bags=features|userFeatures,intercept=false"
+        )
+        assert name == "shardA"
+        assert cfg.feature_bags == ("features", "userFeatures")
+        assert not cfg.has_intercept
+
+    def test_feature_shard_rejects_unknown_keys(self):
+        with pytest.raises(ValueError, match="Unknown"):
+            parse_feature_shard_configuration("name=a,feature.bags=f,bogus=1")
+
+    def test_fixed_effect_coordinate(self):
+        name, cfg = parse_coordinate_configuration(FE_COORD)
+        assert name == "global"
+        assert isinstance(cfg.data_config, FixedEffectDataConfiguration)
+        oc = cfg.optimization_config
+        assert oc.optimizer_config.optimizer_type == OptimizerType.LBFGS
+        assert oc.optimizer_config.max_iterations == 50
+        assert oc.regularization_context.regularization_type == RegularizationType.L2
+        assert cfg.reg_weights == (1.0,)
+
+    def test_random_effect_coordinate(self):
+        arg = (
+            "name=per-user,random.effect.type=userId,feature.shard=shardA,"
+            "min.partitions=4,optimizer=TRON,max.iter=30,tolerance=1e-6,"
+            "active.data.lower.bound=2,active.data.upper.bound=100,"
+            "reg.weights=0.1|1|10"
+        )
+        name, cfg = parse_coordinate_configuration(arg)
+        dc = cfg.data_config
+        assert isinstance(dc, RandomEffectDataConfiguration)
+        assert dc.random_effect_type == "userId"
+        assert dc.active_data_lower_bound == 2
+        assert dc.active_data_upper_bound == 100
+        assert cfg.reg_weights == (0.1, 1.0, 10.0)
+
+    def test_random_only_keys_rejected_for_fixed(self):
+        with pytest.raises(ValueError, match="random-effect"):
+            parse_coordinate_configuration(
+                "name=a,feature.shard=s,optimizer=LBFGS,max.iter=5,tolerance=1e-3,"
+                "active.data.upper.bound=10"
+            )
+
+    def test_down_sampling_rejected_for_random(self):
+        with pytest.raises(ValueError, match="fixed-effect"):
+            parse_coordinate_configuration(
+                "name=a,random.effect.type=u,feature.shard=s,optimizer=LBFGS,"
+                "max.iter=5,tolerance=1e-3,down.sampling.rate=0.5"
+            )
+
+    def test_round_trip(self):
+        for arg in (FE_COORD, RE_COORD):
+            name, cfg = parse_coordinate_configuration(arg)
+            printed = coordinate_configuration_to_string(name, cfg)
+            name2, cfg2 = parse_coordinate_configuration(printed)
+            assert name2 == name
+            assert cfg2 == cfg
+
+    def test_projected_dim_extension(self):
+        _, cfg = parse_coordinate_configuration(
+            "name=a,random.effect.type=u,feature.shard=s,optimizer=LBFGS,"
+            "max.iter=5,tolerance=1e-3,projected.dim=16,projection.seed=3"
+        )
+        assert cfg.data_config.projector.projected_dim == 16
+        assert cfg.data_config.projector.seed == 3
+
+    def test_evaluator_specs(self):
+        e = parse_evaluator_spec("AUC")
+        assert isinstance(e, Evaluator) and e.name == "AUC"
+        m = parse_evaluator_spec("AUC:userId")
+        assert isinstance(m, MultiEvaluator)
+        p = parse_evaluator_spec("PRECISION@5:userId")
+        assert isinstance(p, MultiEvaluator) and "5" in p.base.name
+
+
+# --------------------------------------------------------------- validators
+
+
+class TestValidators:
+    def test_passes_clean_data(self):
+        sanity_check_data(
+            TaskType.LOGISTIC_REGRESSION,
+            labels=np.array([0.0, 1.0, 1.0]),
+            offsets=np.zeros(3),
+            weights=np.ones(3),
+            feature_shards={"s": np.ones((3, 2))},
+        )
+
+    def test_rejects_non_binary_labels_for_logistic(self):
+        with pytest.raises(ValueError, match="non-binary"):
+            sanity_check_data(TaskType.LOGISTIC_REGRESSION, labels=np.array([0.0, 2.0]))
+
+    def test_rejects_negative_labels_for_poisson(self):
+        with pytest.raises(ValueError, match="negative"):
+            sanity_check_data(TaskType.POISSON_REGRESSION, labels=np.array([1.0, -2.0]))
+
+    def test_rejects_nan_features(self):
+        with pytest.raises(ValueError, match="non-finite feature"):
+            sanity_check_data(
+                TaskType.LINEAR_REGRESSION,
+                labels=np.array([0.5, 1.5]),
+                feature_shards={"s": np.array([[1.0, np.nan], [0.0, 1.0]])},
+            )
+
+    def test_disabled_mode_skips(self):
+        sanity_check_data(
+            TaskType.LOGISTIC_REGRESSION,
+            labels=np.array([5.0]),  # invalid, but skipped
+            validation_type=DataValidationType.VALIDATE_DISABLED,
+        )
+
+
+# --------------------------------------------------------------- drivers
+
+
+class TestTrainScorePipeline:
+    @pytest.fixture(scope="class")
+    def fixture_dir(self, tmp_path_factory):
+        base = tmp_path_factory.mktemp("cli")
+        rng = np.random.default_rng(0)
+        _, _, _, w, bias = write_glmix_avro(str(base / "train.avro"), rng)
+        write_glmix_avro(str(base / "validate.avro"), rng, n=300, w=w, bias=bias)
+        return base
+
+    @pytest.fixture(scope="class")
+    def trained(self, fixture_dir):
+        out = fixture_dir / "output"
+        rc = game_training_driver.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", str(fixture_dir / "train.avro"),
+            "--validation-data-directories", str(fixture_dir / "validate.avro"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--coordinate-configurations", FE_COORD,
+            "--coordinate-configurations", RE_COORD,
+            "--coordinate-update-sequence", "global,per-user",
+            "--coordinate-descent-iterations", "2",
+            "--evaluators", "AUC",
+            "--data-validation", "VALIDATE_FULL",
+            "--output-mode", "ALL",
+        ])
+        assert rc == 0
+        return out
+
+    def test_training_outputs(self, trained):
+        assert (trained / "best" / "model-metadata.json").exists()
+        assert (trained / "best" / "model-spec.json").exists()
+        assert (trained / "best" / "fixed-effect" / "global").is_dir()
+        assert (trained / "best" / "random-effect" / "per-user").is_dir()
+        assert (trained / "models" / "0").is_dir()
+        assert (trained / "index-maps" / "shardA.npz").exists()
+        assert (trained / "logs" / "photon.log").exists()
+        meta = json.loads((trained / "best" / "model-metadata.json").read_text())
+        assert meta["bestMetric"] is not None and meta["bestMetric"] > 0.7  # AUC
+
+    def test_scoring_pipeline(self, fixture_dir, trained):
+        out = fixture_dir / "scores-out"
+        rc = game_scoring_driver.main([
+            "--input-data-directories", str(fixture_dir / "validate.avro"),
+            "--model-input-directory", str(trained / "best"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--evaluators", "AUC",
+        ])
+        assert rc == 0
+        recs = list(avro_io.read_container_dir(str(out / "scores")))
+        assert len(recs) == 300
+        scores = np.array([r["predictionScore"] for r in recs])
+        labels = np.array([r["label"] for r in recs])
+        pos, neg = scores[labels == 1], scores[labels == 0]
+        auc = (pos[:, None] > neg[None, :]).mean()
+        assert auc > 0.7
+
+    def test_warm_start_retrain(self, fixture_dir, trained):
+        out = fixture_dir / "warm-out"
+        rc = game_training_driver.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", str(fixture_dir / "train.avro"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--coordinate-configurations", FE_COORD,
+            "--coordinate-configurations", RE_COORD,
+            "--coordinate-update-sequence", "global,per-user",
+            "--model-input-directory", str(trained / "best"),
+            "--off-heap-index-map-directory", str(trained / "index-maps"),
+            "--partial-retrain-locked-coordinates", "global",
+        ])
+        assert rc == 0
+        # locked coordinate carried over unchanged from the input model
+        spec = json.loads((out / "best" / "model-spec.json").read_text())
+        assert set(spec) == {"global", "per-user"}
+
+    def test_output_dir_collision(self, fixture_dir, trained):
+        with pytest.raises(FileExistsError):
+            game_training_driver.main([
+                "--training-task", "LOGISTIC_REGRESSION",
+                "--input-data-directories", str(fixture_dir / "train.avro"),
+                "--root-output-directory", str(trained),
+                "--feature-shard-configurations", "name=shardA,feature.bags=features",
+                "--coordinate-configurations", FE_COORD,
+                "--coordinate-update-sequence", "global",
+            ])
+
+
+class TestIndexingDrivers:
+    def test_feature_indexing_driver(self, tmp_path):
+        rng = np.random.default_rng(1)
+        write_glmix_avro(str(tmp_path / "data.avro"), rng, n=50, d=4)
+        out = tmp_path / "maps"
+        rc = feature_indexing_driver.main([
+            "--input-data-directories", str(tmp_path / "data.avro"),
+            "--output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+        ])
+        assert rc == 0
+        from photon_ml_tpu.data.index_map import IndexMap
+
+        imap = IndexMap.load(str(out / "shardA"))
+        assert imap.size == 5  # 4 features + intercept
+
+    def test_name_and_term_bags_driver(self, tmp_path):
+        rng = np.random.default_rng(2)
+        write_glmix_avro(str(tmp_path / "data.avro"), rng, n=30, d=3)
+        out = tmp_path / "bags"
+        rc = name_and_term_bags_driver.main([
+            "--input-data-directories", str(tmp_path / "data.avro"),
+            "--output-directory", str(out),
+            "--feature-bags", "features",
+        ])
+        assert rc == 0
+        lines = (out / "features").read_text().strip().split("\n")
+        assert len(lines) == 3
+        assert lines[0].split("\t")[0] == "f0"

@@ -234,3 +234,60 @@ class TestTuner:
     def test_atlas_none_mode(self):
         assert AtlasTuner().search(3, 2, HyperparameterTuningMode.NONE,
                                    QuadraticEvaluationFunction(), []) == []
+
+    def test_prior_observations_require_config(self):
+        fn = QuadraticEvaluationFunction()
+        with pytest.raises(ValueError, match="config"):
+            AtlasTuner().search(
+                2, 1, HyperparameterTuningMode.RANDOM, fn, [],
+                prior_observations=[(np.array([10.0]), 0.5)],
+            )
+
+    def test_prior_points_rescaled_into_transformed_unit_cube(self):
+        """Raw prior points must land at the transformed-range [0,1] coordinates:
+        with range (0.01, 100) under LOG, a prior at 100 is 1.0, at 1.0 is 0.5
+        (regression: scaling against RAW ranges put log10(100)=2 near 0.02)."""
+        from photon_ml_tpu.hyperparameter.serialization import HyperparameterConfig
+
+        config = HyperparameterConfig(
+            tuning_mode=HyperparameterTuningMode.RANDOM,
+            names=("w",),
+            ranges=((0.01, 100.0),),
+            discrete_params={},
+            transform_map={0: "LOG"},
+        )
+
+        captured = {}
+
+        class SpyTuner(AtlasTuner):
+            pass
+
+        import photon_ml_tpu.hyperparameter.tuner as tuner_mod
+
+        class SpySearch:
+            def __init__(self, dim, fn, discrete_params=None, seed=0):
+                pass
+
+            def find_with_prior_observations(self, n, priors):
+                captured["priors"] = priors
+                return []
+
+            def find_with_priors(self, n, obs, priors):
+                captured["priors"] = priors
+                return []
+
+        orig = tuner_mod.RandomSearch
+        tuner_mod.RandomSearch = SpySearch
+        try:
+            AtlasTuner().search(
+                1, 1, HyperparameterTuningMode.RANDOM, QuadraticEvaluationFunction(), [],
+                prior_observations=[(np.array([100.0]), 0.7), (np.array([1.0]), 0.3)],
+                config=config,
+            )
+        finally:
+            tuner_mod.RandomSearch = orig
+        pts = np.array([p for p, _ in captured["priors"]]).ravel()
+        np.testing.assert_allclose(pts, [1.0, 0.5], atol=1e-3)
+        # values are mean-centered
+        vals = [v for _, v in captured["priors"]]
+        assert abs(sum(vals)) < 1e-12

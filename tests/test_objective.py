@@ -164,3 +164,23 @@ def test_padded_rows_are_inert(rng):
     v2, g2 = obj.value_and_gradient(padded, coef, 0.1)
     np.testing.assert_allclose(v1, v2, rtol=1e-12)
     np.testing.assert_allclose(g1, g2, rtol=1e-12)
+
+
+def test_sparse_feature_statistics_match_dense():
+    """Sparse FeatureDataStatistics must equal the dense computation, including
+    implicit-zero min/max handling and empty columns."""
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(17)
+    n, d = 60, 9
+    X = rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.3)
+    X[:, 3] = 0.0  # empty column
+    X[:, 4] = 2.0  # fully dense positive column (no implicit zero)
+    dense = FeatureDataStatistics.compute(X, intercept_index=4)
+    sparse = FeatureDataStatistics.compute(sp.csr_matrix(X), intercept_index=4)
+    for field in ("mean", "variance", "min", "max", "num_nonzeros", "mean_abs"):
+        np.testing.assert_allclose(
+            getattr(sparse, field), getattr(dense, field), atol=1e-12, err_msg=field
+        )
+    assert sparse.count == dense.count == n
+    assert sparse.min[4] == 2.0  # fully dense column keeps its true min (not 0)

@@ -1,0 +1,80 @@
+"""Util subsystem tests: PhotonLogger file output + level filter, Timed sections,
+EventEmitter dispatch (photon-lib util/PhotonLogger, util/Timed, client event/*)."""
+
+import logging
+
+from photon_ml_tpu.util import Event, EventEmitter, EventListener, PhotonLogger, Timed, timed
+
+
+def test_photon_logger_writes_file_with_level_filter(tmp_path):
+    path = tmp_path / "run.log"
+    with PhotonLogger(str(path), level="WARN", echo=False) as log:
+        log.debug("hidden-debug")
+        log.info("hidden-info")
+        log.warning("shown-warning")
+        log.error("shown-error")
+    text = path.read_text()
+    assert "shown-warning" in text and "shown-error" in text
+    assert "hidden-debug" not in text and "hidden-info" not in text
+
+
+def test_photon_logger_set_level(tmp_path):
+    path = tmp_path / "run.log"
+    with PhotonLogger(str(path), level="ERROR", echo=False) as log:
+        log.info("first-hidden")
+        log.set_level("DEBUG")
+        log.debug("now-shown")
+    text = path.read_text()
+    assert "first-hidden" not in text and "now-shown" in text
+
+
+def test_timed_records_elapsed(tmp_path):
+    with Timed("phase") as t:
+        sum(range(1000))
+    assert t.seconds is not None and t.seconds >= 0
+
+
+def test_timed_decorator_logs(caplog):
+    @timed("compute", logger=logging.getLogger("photon.timed"))
+    def fn():
+        return 42
+
+    with caplog.at_level(logging.INFO, logger="photon.timed"):
+        assert fn() == 42
+    assert any("compute took" in r.message for r in caplog.records)
+
+
+def test_event_emitter_dispatch_and_clear():
+    seen = []
+
+    class Collector(EventListener):
+        def on_event(self, event):
+            seen.append(event.name)
+
+    emitter = EventEmitter()
+    emitter.register_listener(Collector())
+    emitter.send_event(Event("TrainingStartEvent"))
+    emitter.send_event(Event("TrainingFinishEvent", {"k": 1}))
+    assert seen == ["TrainingStartEvent", "TrainingFinishEvent"]
+    emitter.clear_listeners()
+    emitter.send_event(Event("IgnoredEvent"))
+    assert len(seen) == 2
+
+
+def test_event_emitter_class_path_registration():
+    import importlib
+
+    emitter = EventEmitter()
+    emitter.register_listener_class("tests.test_util.RecordingListener")
+    emitter.send_event(Event("PhotonSetupEvent"))
+    # the dotted path may resolve to a distinct module object under pytest's
+    # import scheme; assert against the class the emitter actually instantiated
+    cls = importlib.import_module("tests.test_util").RecordingListener
+    assert cls.events == ["PhotonSetupEvent"]
+
+
+class RecordingListener(EventListener):
+    events: list = []
+
+    def on_event(self, event):
+        RecordingListener.events.append(event.name)
