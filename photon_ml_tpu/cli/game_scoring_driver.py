@@ -65,11 +65,17 @@ def run(args: argparse.Namespace) -> dict:
         shard_configs = dict(
             parse_feature_shard_configuration(a) for a in args.feature_shard_configurations
         )
-        # prefer index maps saved next to the model (training driver layout),
-        # then the explicit off-heap dir
-        index_maps = _load_index_maps(
-            os.path.join(args.model_input_directory, "..", "index-maps"), shard_configs
-        )
+        # prefer index maps saved by the training driver at <root>/index-maps —
+        # the model may live at <root>/best (one level up) or <root>/models/<i>
+        # (two levels up) — then the explicit off-heap dir
+        index_maps = {}
+        for rel in ("..", os.path.join("..", "..")):
+            index_maps.update(
+                _load_index_maps(
+                    os.path.join(args.model_input_directory, rel, "index-maps"),
+                    shard_configs,
+                )
+            )
         index_maps.update(
             _load_index_maps(args.off_heap_index_map_directory, shard_configs) or {}
         )

@@ -149,7 +149,10 @@ def _write_feature_summary(path: str, shard_id: str, imap: IndexMap,
     avro_io.write_container(path, avro_io.FEATURE_SUMMARIZATION_SCHEMA, records())
 
 
-def _save_result(out_dir: str, result, index_maps_by_coord, sparsity_threshold, logger):
+def _save_result(out_dir: str, result, index_maps_by_coord, coord_configs,
+                 sparsity_threshold, logger):
+    import dataclasses as _dc
+
     os.makedirs(out_dir, exist_ok=True)
     save_game_model(
         out_dir,
@@ -161,29 +164,25 @@ def _save_result(out_dir: str, result, index_maps_by_coord, sparsity_threshold, 
             "bestMetric": result.best_metric,
         },
     )
+    # model-spec records the EXPANDED config actually trained, keeping each
+    # coordinate's REAL data configuration (shard, random-effect type, bounds)
+    # so the recorded spec round-trips through the parser
     spec = {
         cid: coordinate_configuration_to_string(
             cid,
-            # model-spec records the EXPANDED config actually trained
-            _cfg_with(result.configuration[cid]),
+            _dc.replace(
+                coord_configs[cid],
+                optimization_config=result.configuration[cid],
+                reg_weights=(result.configuration[cid].regularization_weight,)
+                if result.configuration[cid].regularization_weight
+                else (),
+            ),
         )
         for cid in result.configuration
     }
     with open(os.path.join(out_dir, MODEL_SPEC_FILE), "w") as f:
         json.dump(spec, f, indent=2)
     logger.info("saved model to %s", out_dir)
-
-
-def _cfg_with(opt_config):
-    from photon_ml_tpu.estimators.config import CoordinateConfiguration, FixedEffectDataConfiguration
-
-    return CoordinateConfiguration(
-        data_config=FixedEffectDataConfiguration(),
-        optimization_config=opt_config,
-        reg_weights=(opt_config.regularization_weight,)
-        if opt_config.regularization_weight
-        else (),
-    )
 
 
 def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dict:
@@ -336,19 +335,12 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             results = results + list(tuned_results)
 
         # -- model selection (GameTrainingDriver.selectBestModel:683-748) -------
-        def metric_key(r):
-            return r.best_metric if r.best_metric is not None else float("-inf")
-
-        have_metrics = any(r.best_metric is not None for r in results)
-        if have_metrics:
+        evaluated = [i for i, r in enumerate(results) if r.best_metric is not None]
+        if evaluated:
             primary = estimator.prepare_evaluation_suite(validation_input).evaluators[0]
             bigger_better = getattr(primary, "larger_is_better", True)
-            best_index = int(
-                max(
-                    range(len(results)),
-                    key=lambda i: metric_key(results[i]) * (1 if bigger_better else -1),
-                )
-            )
+            pick = max if bigger_better else min
+            best_index = int(pick(evaluated, key=lambda i: results[i].best_metric))
         else:
             best_index = len(results) - 1  # no validation: last trained config
         logger.info("selected model %d of %d", best_index, len(results))
@@ -358,7 +350,7 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
         if output_mode != ModelOutputMode.NONE:
             _save_result(
                 os.path.join(root, BEST_DIR), results[best_index], index_maps_by_coord,
-                args.model_sparsity_threshold, logger,
+                coord_configs, args.model_sparsity_threshold, logger,
             )
             if output_mode in (ModelOutputMode.ALL, ModelOutputMode.EXPLICIT, ModelOutputMode.TUNED):
                 to_save = (
@@ -371,7 +363,8 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
                 for i in to_save:
                     _save_result(
                         os.path.join(root, MODELS_DIR, str(i)), results[i],
-                        index_maps_by_coord, args.model_sparsity_threshold, logger,
+                        index_maps_by_coord, coord_configs,
+                        args.model_sparsity_threshold, logger,
                     )
             # persist index maps next to the models for scoring-time reuse
             for shard, imap in index_maps.items():

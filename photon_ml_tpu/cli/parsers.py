@@ -246,6 +246,10 @@ def coordinate_configuration_to_string(name: str, cfg: CoordinateConfiguration) 
             parts.append(
                 f"{COORDINATE_DATA_CONFIG_PROJECTED_DIM}{KV_DELIMITER}{dc.projector.projected_dim}"
             )
+            if dc.projector.seed:
+                parts.append(
+                    f"{COORDINATE_DATA_CONFIG_PROJECTION_SEED}{KV_DELIMITER}{dc.projector.seed}"
+                )
     elif cfg.down_sampling_rate != 1.0:
         parts.append(
             f"{COORDINATE_OPT_CONFIG_DOWN_SAMPLING_RATE}{KV_DELIMITER}{cfg.down_sampling_rate}"

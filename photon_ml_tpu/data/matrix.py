@@ -61,6 +61,10 @@ class DenseDesignMatrix:
     def to_dense(self) -> Array:
         return self.values
 
+    def take_rows(self, idx) -> "DenseDesignMatrix":
+        """Host-side row subset (diagnostics / split helpers — not jit-traced)."""
+        return DenseDesignMatrix(values=self.values[jnp.asarray(idx)])
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -100,6 +104,35 @@ class SparseDesignMatrix:
     def to_dense(self) -> Array:
         out = jnp.zeros((self.n_rows, self.n_cols), dtype=self.vals.dtype)
         return out.at[self.rows, self.cols].add(self.vals)
+
+    def take_rows(self, idx) -> "SparseDesignMatrix":
+        """Host-side row subset (diagnostics / split helpers — not jit-traced).
+        Output row k holds source row idx[k]'s entries; duplicate indices in
+        ``idx`` duplicate the row (matching dense fancy indexing)."""
+        idx = np.asarray(idx)
+        rows = np.asarray(self.rows)
+        cols = np.asarray(self.cols)
+        vals = np.asarray(self.vals)
+        real = vals != 0  # drop padding entries
+        rows, cols, vals = rows[real], cols[real], vals[real]
+        order = np.argsort(rows, kind="stable")
+        sorted_rows = rows[order]
+        starts = np.searchsorted(sorted_rows, idx, side="left")
+        stops = np.searchsorted(sorted_rows, idx, side="right")
+        counts = stops - starts
+        total = int(counts.sum())
+        # flatten [order[starts[k]:stops[k]] for k] without a Python loop
+        out_rows = np.repeat(np.arange(len(idx), dtype=np.int32), counts)
+        base = np.repeat(starts, counts)
+        within = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
+        sel = order[base + within]
+        return SparseDesignMatrix(
+            rows=jnp.asarray(out_rows),
+            cols=jnp.asarray(cols[sel]),
+            vals=jnp.asarray(vals[sel]),
+            n_rows=int(len(idx)),
+            n_cols=self.n_cols,
+        )
 
     @staticmethod
     def from_scipy(mat, dtype=jnp.float32, pad_nnz: int | None = None) -> "SparseDesignMatrix":

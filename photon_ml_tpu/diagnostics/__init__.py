@@ -1,0 +1,85 @@
+"""Offline model diagnostics (the photon-diagnostics module).
+
+Parity targets (all under /root/reference/photon-diagnostics/src/main):
+- BootstrapTraining.scala:30-181 + bootstrap/BootstrapTrainingDiagnostic.scala —
+  per-coefficient confidence intervals from bootstrap resamples (diagnostics/bootstrap.py)
+- fitting/FittingDiagnostic.scala:30-131 — learning curves vs training fraction
+  (diagnostics/fitting.py)
+- hl/*.scala — Hosmer-Lemeshow calibration test for logistic models
+  (diagnostics/hosmer_lemeshow.py)
+- featureimportance/*.scala — expected-magnitude and variance feature importance
+  (diagnostics/feature_importance.py)
+- independence/KendallTauAnalysis.scala:131 — prediction-error independence
+  (diagnostics/independence.py)
+- reporting/**/*.scala — logical -> physical report tree rendered to HTML/text
+  (diagnostics/reporting.py)
+"""
+
+from photon_ml_tpu.diagnostics.bootstrap import (
+    BootstrapReport,
+    CoefficientSummary,
+    bootstrap_training,
+)
+from photon_ml_tpu.diagnostics.feature_importance import (
+    FeatureImportanceReport,
+    expected_magnitude_importance,
+    variance_importance,
+)
+from photon_ml_tpu.diagnostics.fitting import FittingReport, fitting_diagnostic
+from photon_ml_tpu.diagnostics.hosmer_lemeshow import (
+    HosmerLemeshowReport,
+    hosmer_lemeshow_test,
+)
+from photon_ml_tpu.diagnostics.independence import (
+    KendallTauReport,
+    kendall_tau_analysis,
+    prediction_error_independence,
+)
+from photon_ml_tpu.diagnostics.reporting import (
+    BulletedList,
+    Chapter,
+    Document,
+    LineChart,
+    Section,
+    SimpleText,
+    Table,
+    render_html,
+    render_text,
+)
+from photon_ml_tpu.diagnostics.transformers import (
+    bootstrap_section,
+    feature_importance_section,
+    fitting_section,
+    hosmer_lemeshow_section,
+    independence_section,
+)
+
+__all__ = [
+    "BootstrapReport",
+    "BulletedList",
+    "Chapter",
+    "CoefficientSummary",
+    "Document",
+    "FeatureImportanceReport",
+    "FittingReport",
+    "HosmerLemeshowReport",
+    "KendallTauReport",
+    "LineChart",
+    "Section",
+    "SimpleText",
+    "Table",
+    "bootstrap_section",
+    "bootstrap_training",
+    "expected_magnitude_importance",
+    "feature_importance_section",
+    "fitting_diagnostic",
+    "fitting_section",
+    "hosmer_lemeshow_section",
+    "hosmer_lemeshow_test",
+    "independence_section",
+    "kendall_tau_analysis",
+    "prediction_error_independence",
+    "render_html",
+    "render_text",
+    "variance_importance",
+]

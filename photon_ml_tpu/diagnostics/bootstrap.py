@@ -1,0 +1,152 @@
+"""Bootstrap training: coefficient confidence intervals + metric CIs.
+
+Parity target: photon-diagnostics BootstrapTraining.scala:30-181 and
+bootstrap/BootstrapTrainingDiagnostic.scala:152. The reference trains k models
+on bootstrap resamples (RDD.sample per resample) and folds per-coefficient
+streaming summaries.
+
+TPU-first design: a bootstrap resample IS a multinomial reweighting of the
+sample axis — instead of materializing k resampled datasets, draw a [k, n]
+matrix of multinomial counts, multiply into the base weights, and ``vmap`` the
+jitted L-BFGS solve over the k axis. One XLA program trains ALL bootstrap
+models simultaneously on the MXU; no data movement, no per-resample shuffles.
+Non-smooth configs (L1/elastic net via OWLQN, TRON trust region) fall back to a
+sequential loop over the same reweighted problems.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.function.objective import make_value_and_grad
+from photon_ml_tpu.optimization.lbfgs import minimize_lbfgs
+from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+from photon_ml_tpu.types import OptimizerType, RegularizationType
+
+Array = jnp.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class CoefficientSummary:
+    """Per-coefficient bootstrap distribution summary
+    (BootstrapTraining.aggregateCoefficientConfidenceIntervals: the reference
+    streams min/max/mean/var; with all k models resident we report exact
+    quantiles as well)."""
+
+    mean: float
+    std: float
+    min: float
+    max: float
+    lower_ci: float  # 2.5%
+    median: float
+    upper_ci: float  # 97.5%
+
+    def interval_contains_zero(self) -> bool:
+        return self.lower_ci <= 0.0 <= self.upper_ci
+
+
+@dataclasses.dataclass(frozen=True)
+class BootstrapReport:
+    """bootstrap/BootstrapReport.scala: per-coefficient summaries + per-metric
+    distributions over the bootstrap models."""
+
+    coefficient_summaries: list  # [d] CoefficientSummary
+    metric_distributions: dict  # metric name -> CoefficientSummary over k values
+    num_models: int
+    coefficients: np.ndarray  # [k, d] raw bootstrap coefficients
+
+
+def _summary(values: np.ndarray) -> CoefficientSummary:
+    lo, med, hi = np.percentile(values, [2.5, 50.0, 97.5])
+    return CoefficientSummary(
+        mean=float(values.mean()),
+        std=float(values.std(ddof=1)) if len(values) > 1 else 0.0,
+        min=float(values.min()),
+        max=float(values.max()),
+        lower_ci=float(lo),
+        median=float(med),
+        upper_ci=float(hi),
+    )
+
+
+def bootstrap_training(
+    problem: GLMOptimizationProblem,
+    data: LabeledData,
+    num_bootstraps: int = 10,
+    seed: int = 0,
+    metrics: Optional[dict[str, Callable]] = None,
+) -> BootstrapReport:
+    """Train ``num_bootstraps`` models on multinomial-reweighted resamples.
+
+    metrics: {name: fn(scores, labels, weights) -> float} evaluated per model on
+    the FULL dataset (the reference evaluates each bootstrap model with its
+    metric map and aggregates).
+    """
+    if num_bootstraps < 2:
+        raise ValueError("need at least 2 bootstrap resamples")
+    n = data.n
+    rng = np.random.default_rng(seed)
+    counts = rng.multinomial(n, np.full(n, 1.0 / n), size=num_bootstraps)  # [k, n]
+    base_w = np.asarray(data.weights)
+    weight_matrix = jnp.asarray(counts * base_w[None, :], dtype=data.weights.dtype)
+
+    cfg = problem.configuration
+    opt_type = OptimizerType(cfg.optimizer_config.optimizer_type)
+    reg_type = cfg.regularization_context.regularization_type
+    smooth = opt_type == OptimizerType.LBFGS and reg_type in (
+        RegularizationType.NONE,
+        RegularizationType.L2,
+    )
+
+    if smooth:
+        obj = problem.objective
+        l2 = cfg.l2_weight
+
+        def solve(weights: Array) -> Array:
+            d = dataclasses.replace(data, weights=weights)
+            vg = make_value_and_grad(obj, d, l2)
+            return minimize_lbfgs(
+                vg,
+                jnp.zeros(data.dim, dtype=weight_matrix.dtype),
+                max_iterations=cfg.optimizer_config.max_iterations,
+                tolerance=cfg.optimizer_config.tolerance,
+                history_length=cfg.optimizer_config.history_length,
+            ).coefficients
+
+        coeffs = np.asarray(jax.jit(jax.vmap(solve))(weight_matrix))  # [k, d]
+    else:
+        rows = []
+        for k in range(num_bootstraps):
+            d = dataclasses.replace(data, weights=weight_matrix[k])
+            glm, _ = problem.run(d)
+            rows.append(np.asarray(glm.coefficients.means))
+        coeffs = np.stack(rows)
+
+    summaries = [_summary(coeffs[:, j]) for j in range(coeffs.shape[1])]
+
+    metric_dists: dict[str, CoefficientSummary] = {}
+    if metrics:
+        labels = np.asarray(data.labels)
+        weights = np.asarray(data.weights)
+        offsets = np.asarray(data.offsets)
+        scores = np.stack(
+            [np.asarray(data.X.matvec(jnp.asarray(coeffs[k]))) for k in range(num_bootstraps)]
+        )  # [k, n]
+        for name, fn in metrics.items():
+            vals = np.array(
+                [fn(scores[k] + offsets, labels, weights) for k in range(num_bootstraps)]
+            )
+            metric_dists[name] = _summary(vals)
+
+    return BootstrapReport(
+        coefficient_summaries=summaries,
+        metric_distributions=metric_dists,
+        num_models=num_bootstraps,
+        coefficients=coeffs,
+    )

@@ -1,0 +1,76 @@
+"""Per-feature importance diagnostics.
+
+Parity target: photon-diagnostics featureimportance/*.scala —
+- ExpectedMagnitudeFeatureImportanceDiagnostic.scala:25-60: importance =
+  |coefficient * E|x|| (falls back to |coefficient| without summary)
+- VarianceFeatureImportanceDiagnostic.scala: importance = |coefficient| *
+  sqrt(Var[x]) (contribution to score variance)
+- FeatureImportanceReport: ranked features + an importance histogram.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.normalization import FeatureDataStatistics
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureImportanceReport:
+    """featureimportance/FeatureImportanceReport.scala: importance type +
+    description + ranked (feature key, index, importance)."""
+
+    importance_type: str
+    importance_description: str
+    ranked: list  # [(feature key, index, importance)] descending importance
+
+    def top(self, k: int) -> list:
+        return self.ranked[:k]
+
+
+def _rank(importances: np.ndarray, index_map: Optional[IndexMap]) -> list:
+    order = np.argsort(-importances, kind="mergesort")
+    out = []
+    for j in order:
+        key = index_map.get_feature_name(int(j)) if index_map is not None else str(int(j))
+        out.append((key, int(j), float(importances[j])))
+    return out
+
+
+def expected_magnitude_importance(
+    coefficients: np.ndarray,
+    summary: Optional[FeatureDataStatistics] = None,
+    index_map: Optional[IndexMap] = None,
+) -> FeatureImportanceReport:
+    """|w_j * E|x_j||, the expected magnitude of the feature's score contribution."""
+    coefficients = np.asarray(coefficients, dtype=np.float64)
+    if summary is not None:
+        importances = np.abs(coefficients * np.asarray(summary.mean_abs))
+        desc = "Expected magnitude of inner product contribution"
+    else:
+        importances = np.abs(coefficients)
+        desc = "Magnitude of feature coefficient"
+    return FeatureImportanceReport(
+        importance_type="Inner product expectation",
+        importance_description=desc,
+        ranked=_rank(importances, index_map),
+    )
+
+
+def variance_importance(
+    coefficients: np.ndarray,
+    summary: FeatureDataStatistics,
+    index_map: Optional[IndexMap] = None,
+) -> FeatureImportanceReport:
+    """|w_j| * std(x_j): the feature's contribution to score variance."""
+    coefficients = np.asarray(coefficients, dtype=np.float64)
+    importances = np.abs(coefficients) * np.sqrt(np.asarray(summary.variance))
+    return FeatureImportanceReport(
+        importance_type="Variance contribution",
+        importance_description="Contribution of the feature to the score variance",
+        ranked=_rank(importances, index_map),
+    )

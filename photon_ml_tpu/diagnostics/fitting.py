@@ -1,0 +1,108 @@
+"""Fitting diagnostic: learning curves (metric vs training-set fraction).
+
+Parity target: photon-diagnostics fitting/FittingDiagnostic.scala:30-131 — tag
+samples into NUM_TRAINING_PARTITIONS random partitions, hold the last out,
+train on growing prefixes (1/8, 2/8, ... 7/8) with warm start carried between
+portions, and record each metric on both the training prefix and the holdout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping, Optional
+
+import numpy as np
+
+from photon_ml_tpu.data.dataset import LabeledData
+
+NUM_TRAINING_PARTITIONS = 8
+MIN_SAMPLES_PER_PARTITION_PER_DIMENSION = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class FittingReport:
+    """fitting/FittingReport.scala: per-metric learning curves.
+
+    metrics: {metric name: (portions [%], train values, holdout values)}
+    """
+
+    metrics: dict
+    message: str = ""
+
+
+def fitting_diagnostic(
+    data: LabeledData,
+    model_factory: Callable,
+    metrics: Mapping[str, Callable],
+    seed: int = 0,
+    num_partitions: int = NUM_TRAINING_PARTITIONS,
+) -> FittingReport:
+    """model_factory(subset: LabeledData, warm_start) -> (model, warm_start');
+    metrics: {name: fn(scores, labels, weights) -> float}. The returned model
+    must expose .score(LabeledData) -> margins (GeneralizedLinearModel API).
+
+    Returns an empty report when the dataset is too small for stable curves
+    (FittingDiagnostic returns an empty map below dimension *
+    MIN_SAMPLES_PER_PARTITION_PER_DIMENSION samples)."""
+    n = data.n
+    min_samples = data.dim * MIN_SAMPLES_PER_PARTITION_PER_DIMENSION
+    if n <= min_samples:
+        return FittingReport(
+            metrics={},
+            message=(
+                f"insufficient data for learning curves: {n} samples <= "
+                f"{min_samples} (dim * {MIN_SAMPLES_PER_PARTITION_PER_DIMENSION})"
+            ),
+        )
+
+    rng = np.random.default_rng(seed)
+    tags = rng.integers(0, num_partitions, size=n)
+    holdout_idx = np.flatnonzero(tags == num_partitions - 1)
+    holdout = _subset(data, holdout_idx)
+
+    portions: list[float] = []
+    train_vals: dict[str, list[float]] = {m: [] for m in metrics}
+    test_vals: dict[str, list[float]] = {m: [] for m in metrics}
+    warm = None
+    for max_tag in range(num_partitions - 1):
+        idx = np.flatnonzero(tags <= max_tag)
+        subset = _subset(data, idx)
+        portions.append(100.0 * len(idx) / n)
+        model, warm = model_factory(subset, warm)
+        train_scores = np.asarray(model.score(subset))
+        test_scores = np.asarray(model.score(holdout))
+        for name, fn in metrics.items():
+            train_vals[name].append(
+                float(fn(train_scores, np.asarray(subset.labels), np.asarray(subset.weights)))
+            )
+            test_vals[name].append(
+                float(fn(test_scores, np.asarray(holdout.labels), np.asarray(holdout.weights)))
+            )
+
+    return FittingReport(
+        metrics={
+            name: (portions, train_vals[name], test_vals[name]) for name in metrics
+        }
+    )
+
+
+def _subset(data: LabeledData, idx: np.ndarray) -> LabeledData:
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.dataset import LabeledData as LD
+
+    X = data.X
+    # DesignMatrix variants: use the underlying host matrix when available
+    take = getattr(X, "take_rows", None)
+    if take is not None:
+        sub_X = take(idx)
+    else:
+        raise TypeError(
+            f"{type(X).__name__} does not support row subsetting (take_rows)"
+        )
+    return LD(
+        X=sub_X,
+        labels=jnp.asarray(np.asarray(data.labels)[idx]),
+        offsets=jnp.asarray(np.asarray(data.offsets)[idx]),
+        weights=jnp.asarray(np.asarray(data.weights)[idx]),
+    )

@@ -1,0 +1,133 @@
+"""Hosmer-Lemeshow calibration test for logistic models.
+
+Parity target: photon-diagnostics hl/*.scala —
+- bin count heuristic: min(numDimensions + 2, 0.9*sqrt(n) + 0.9*log1p(n))
+  (DefaultPredictedProbabilityVersusObservedFrequencyBinner.scala:55-61; both
+  heuristic terms use factor A = 0.9, matching the reference's code as written)
+- uniform probability bins over [0, 1); each bin counts observed positives /
+  negatives; expected positives = ceil(total * bin midpoint probability)
+  (PredictedProbabilityVersusObservedFrequencyHistogramBin.scala:51-64)
+- chi^2 = sum over bins of (obs-exp)^2/exp for pos and neg sides, d.o.f. =
+  bins - 2, plus cumulative probability and standard confidence cutoffs
+  (HosmerLemeshowDiagnostic.scala:47-95).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+from scipy import stats
+
+STANDARD_CONFIDENCE_LEVELS = (
+    0.000001, 0.01, 0.05, 0.1, 0.2, 0.3, 0.4, 0.5,
+    0.6, 0.7, 0.8, 0.9, 0.95, 0.99, 0.999999,
+)
+MINIMUM_EXPECTED_IN_BUCKET = 5
+DATA_HEURISTIC_FACTOR_A = 0.9
+
+
+@dataclasses.dataclass(frozen=True)
+class HistogramBin:
+    lower_bound: float
+    upper_bound: float
+    observed_pos: int
+    observed_neg: int
+
+    @property
+    def total(self) -> int:
+        return self.observed_pos + self.observed_neg
+
+    @property
+    def expected_pos(self) -> int:
+        mid = (self.lower_bound + self.upper_bound) / 2.0
+        return int(math.ceil(self.total * mid))
+
+    @property
+    def expected_neg(self) -> int:
+        return self.total - self.expected_pos
+
+
+@dataclasses.dataclass(frozen=True)
+class HosmerLemeshowReport:
+    """hl/HosmerLemeshowReport.scala."""
+
+    bins: list
+    chi_squared: float
+    degrees_of_freedom: int
+    chi_squared_prob: float  # P(X^2 <= observed) — high means poor calibration
+    cutoffs: list  # (confidence level, chi^2 cutoff)
+    warnings: list
+
+    @property
+    def p_value(self) -> float:
+        """P(X^2 >= observed) under H0 (well calibrated)."""
+        return 1.0 - self.chi_squared_prob
+
+
+def default_bin_count(num_samples: int, num_dimensions: int) -> int:
+    from_dims = num_dimensions + 2
+    from_data = int(
+        DATA_HEURISTIC_FACTOR_A * math.sqrt(num_samples)
+        + DATA_HEURISTIC_FACTOR_A * math.log1p(num_samples)
+    )
+    return max(3, min(from_data, from_dims))
+
+
+def hosmer_lemeshow_test(
+    predicted_probabilities: np.ndarray,
+    labels: np.ndarray,
+    num_bins: Optional[int] = None,
+    num_dimensions: Optional[int] = None,
+) -> HosmerLemeshowReport:
+    """Run the HL test on predicted P(y=1) vs binary labels."""
+    p = np.asarray(predicted_probabilities, dtype=np.float64)
+    y = np.asarray(labels, dtype=np.float64) > 0.5
+    if np.any((p < 0) | (p > 1)):
+        raise ValueError("predicted probabilities must be in [0, 1]")
+    n = len(p)
+    if num_bins is None:
+        num_bins = default_bin_count(n, num_dimensions if num_dimensions is not None else 1)
+
+    edges = np.linspace(0.0, 1.0, num_bins + 1)
+    # values == 1.0 belong to the last bin (upper bounds exclusive elsewhere)
+    idx = np.minimum(np.digitize(p, edges[1:-1], right=False), num_bins - 1)
+    bins = []
+    warnings = []
+    chi2 = 0.0
+    for b in range(num_bins):
+        mask = idx == b
+        hb = HistogramBin(
+            lower_bound=float(edges[b]),
+            upper_bound=float(edges[b + 1]),
+            observed_pos=int(y[mask].sum()),
+            observed_neg=int((~y[mask]).sum()),
+        )
+        bins.append(hb)
+        if hb.expected_pos > 0:
+            chi2 += (hb.observed_pos - hb.expected_pos) ** 2 / hb.expected_pos
+        if hb.expected_pos and hb.expected_pos < MINIMUM_EXPECTED_IN_BUCKET:
+            warnings.append(
+                f"bin [{hb.lower_bound:.3f}, {hb.upper_bound:.3f}): expected positive "
+                f"count {hb.expected_pos} too small for a sound chi^2 estimate"
+            )
+        if hb.expected_neg > 0:
+            chi2 += (hb.observed_neg - hb.expected_neg) ** 2 / hb.expected_neg
+        if hb.expected_neg and hb.expected_neg < MINIMUM_EXPECTED_IN_BUCKET:
+            warnings.append(
+                f"bin [{hb.lower_bound:.3f}, {hb.upper_bound:.3f}): expected negative "
+                f"count {hb.expected_neg} too small for a sound chi^2 estimate"
+            )
+
+    dof = max(1, num_bins - 2)
+    dist = stats.chi2(dof)
+    return HosmerLemeshowReport(
+        bins=bins,
+        chi_squared=float(chi2),
+        degrees_of_freedom=dof,
+        chi_squared_prob=float(dist.cdf(chi2)),
+        cutoffs=[(lvl, float(dist.ppf(lvl))) for lvl in STANDARD_CONFIDENCE_LEVELS],
+        warnings=warnings,
+    )

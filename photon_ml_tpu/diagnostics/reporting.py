@@ -1,0 +1,196 @@
+"""Report tree: chapters/sections/content rendered to HTML or text.
+
+Parity target: photon-diagnostics reporting/**/*.scala — the logical->physical
+report pipeline (DocumentPhysicalReport / ChapterPhysicalReport /
+SectionPhysicalReport / SimpleTextPhysicalReport / BulletedListPhysicalReport,
+rendered by html/HTMLRenderStrategy.scala:72 with numbering via
+NumberingContext). The reference renders plots through xchart+batik; here
+learning-curve style data renders as inline SVG line charts — no plotting
+dependency needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import html as _html
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class SimpleText:
+    text: str
+
+
+@dataclasses.dataclass(frozen=True)
+class BulletedList:
+    items: Sequence[str]
+
+
+@dataclasses.dataclass(frozen=True)
+class Table:
+    header: Sequence[str]
+    rows: Sequence[Sequence]
+    caption: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class LineChart:
+    """Inline-SVG line chart (PlotPhysicalReport equivalent). Each series is
+    (label, xs, ys)."""
+
+    title: str
+    x_label: str
+    y_label: str
+    series: Sequence[tuple]
+
+    def to_svg(self, width: int = 640, height: int = 360) -> str:
+        pad = 48
+        xs_all = [x for _, xs, _ in self.series for x in xs]
+        ys_all = [y for _, _, ys in self.series for y in ys]
+        if not xs_all:
+            return "<svg/>"
+        x0, x1 = min(xs_all), max(xs_all)
+        y0, y1 = min(ys_all), max(ys_all)
+        if x1 == x0:
+            x1 = x0 + 1.0
+        if y1 == y0:
+            y1 = y0 + 1.0
+
+        def sx(x):
+            return pad + (x - x0) / (x1 - x0) * (width - 2 * pad)
+
+        def sy(y):
+            return height - pad - (y - y0) / (y1 - y0) * (height - 2 * pad)
+
+        colors = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd", "#8c564b"]
+        parts = [
+            f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">',
+            f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'
+            f"{_html.escape(self.title)}</text>",
+            f'<line x1="{pad}" y1="{height-pad}" x2="{width-pad}" y2="{height-pad}" stroke="#333"/>',
+            f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{height-pad}" stroke="#333"/>',
+            f'<text x="{width/2:.0f}" y="{height-8}" text-anchor="middle" font-size="12">'
+            f"{_html.escape(self.x_label)}</text>",
+            f'<text x="14" y="{height/2:.0f}" text-anchor="middle" font-size="12" '
+            f'transform="rotate(-90 14 {height/2:.0f})">{_html.escape(self.y_label)}</text>',
+            # axis extremes
+            f'<text x="{pad}" y="{height-pad+14}" font-size="10">{x0:.3g}</text>',
+            f'<text x="{width-pad}" y="{height-pad+14}" font-size="10" text-anchor="end">{x1:.3g}</text>',
+            f'<text x="{pad-4}" y="{height-pad}" font-size="10" text-anchor="end">{y0:.3g}</text>',
+            f'<text x="{pad-4}" y="{pad+4}" font-size="10" text-anchor="end">{y1:.3g}</text>',
+        ]
+        for i, (label, xs, ys) in enumerate(self.series):
+            color = colors[i % len(colors)]
+            pts = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in zip(xs, ys))
+            parts.append(
+                f'<polyline fill="none" stroke="{color}" stroke-width="2" points="{pts}"/>'
+            )
+            parts.append(
+                f'<text x="{width-pad+4}" y="{pad + 16*i}" font-size="11" fill="{color}">'
+                f"{_html.escape(str(label))}</text>"
+            )
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class Section:
+    title: str
+    contents: Sequence  # SimpleText | BulletedList | Table | LineChart | Section
+
+
+@dataclasses.dataclass(frozen=True)
+class Chapter:
+    title: str
+    sections: Sequence[Section]
+
+
+@dataclasses.dataclass(frozen=True)
+class Document:
+    title: str
+    chapters: Sequence[Chapter]
+
+
+# ------------------------------------------------------------------ rendering
+
+
+def render_text(doc: Document) -> str:
+    """Plain-text rendering with hierarchical numbering (NumberingContext)."""
+    lines = [doc.title, "=" * len(doc.title), ""]
+    for ci, chapter in enumerate(doc.chapters, 1):
+        lines += [f"{ci}. {chapter.title}", "-" * (len(chapter.title) + 4), ""]
+        for si, section in enumerate(chapter.sections, 1):
+            lines += _render_section_text(section, f"{ci}.{si}")
+    return "\n".join(lines)
+
+
+def _render_section_text(section: Section, number: str) -> list:
+    lines = [f"{number} {section.title}", ""]
+    sub = 0
+    for item in section.contents:
+        if isinstance(item, SimpleText):
+            lines += [item.text, ""]
+        elif isinstance(item, BulletedList):
+            lines += [f"  * {x}" for x in item.items] + [""]
+        elif isinstance(item, Table):
+            widths = [
+                max(len(str(h)), *(len(str(r[i])) for r in item.rows)) if item.rows else len(str(h))
+                for i, h in enumerate(item.header)
+            ]
+            fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+            lines.append(fmt.format(*[str(h) for h in item.header]))
+            lines += [fmt.format(*[str(c) for c in row]) for row in item.rows]
+            if item.caption:
+                lines.append(f"({item.caption})")
+            lines.append("")
+        elif isinstance(item, LineChart):
+            lines += [f"[chart: {item.title}]", ""]
+        elif isinstance(item, Section):
+            sub += 1
+            lines += _render_section_text(item, f"{number}.{sub}")
+    return lines
+
+
+def render_html(doc: Document) -> str:
+    """HTML rendering (html/HTMLRenderStrategy.scala equivalent; charts inline SVG)."""
+    out = [
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>",
+        f"<title>{_html.escape(doc.title)}</title>",
+        "<style>body{font-family:sans-serif;margin:2em;max-width:60em}"
+        "table{border-collapse:collapse}td,th{border:1px solid #999;"
+        "padding:4px 8px}th{background:#eee}</style></head><body>",
+        f"<h1>{_html.escape(doc.title)}</h1>",
+    ]
+    for ci, chapter in enumerate(doc.chapters, 1):
+        out.append(f"<h2>{ci}. {_html.escape(chapter.title)}</h2>")
+        for si, section in enumerate(chapter.sections, 1):
+            out.append(_render_section_html(section, f"{ci}.{si}", level=3))
+    out.append("</body></html>")
+    return "".join(out)
+
+
+def _render_section_html(section: Section, number: str, level: int) -> str:
+    h = min(level, 6)
+    out = [f"<h{h}>{number} {_html.escape(section.title)}</h{h}>"]
+    sub = 0
+    for item in section.contents:
+        if isinstance(item, SimpleText):
+            out.append(f"<p>{_html.escape(item.text)}</p>")
+        elif isinstance(item, BulletedList):
+            out.append(
+                "<ul>" + "".join(f"<li>{_html.escape(str(x))}</li>" for x in item.items) + "</ul>"
+            )
+        elif isinstance(item, Table):
+            rows = "".join(
+                "<tr>" + "".join(f"<td>{_html.escape(str(c))}</td>" for c in row) + "</tr>"
+                for row in item.rows
+            )
+            head = "".join(f"<th>{_html.escape(str(h_))}</th>" for h_ in item.header)
+            cap = f"<caption>{_html.escape(item.caption)}</caption>" if item.caption else ""
+            out.append(f"<table>{cap}<tr>{head}</tr>{rows}</table>")
+        elif isinstance(item, LineChart):
+            out.append(item.to_svg())
+        elif isinstance(item, Section):
+            sub += 1
+            out.append(_render_section_html(item, f"{number}.{sub}", level + 1))
+    return "".join(out)

@@ -1,0 +1,123 @@
+"""Report -> Section transformers (the reference's *ToPhysicalReportTransformer
+classes: BootstrapToPhysicalReportTransformer,
+FeatureImportanceToPhysicalReportTransformer, FittingToPhysicalReportTransformer,
+NaiveHosmerLemeshowToPhysicalReportTransformer,
+PredictionErrorIndependencePhysicalReportTransformer)."""
+
+from __future__ import annotations
+
+from photon_ml_tpu.diagnostics.bootstrap import BootstrapReport
+from photon_ml_tpu.diagnostics.feature_importance import FeatureImportanceReport
+from photon_ml_tpu.diagnostics.fitting import FittingReport
+from photon_ml_tpu.diagnostics.hosmer_lemeshow import HosmerLemeshowReport
+from photon_ml_tpu.diagnostics.independence import KendallTauReport
+from photon_ml_tpu.diagnostics.reporting import (
+    BulletedList,
+    LineChart,
+    Section,
+    SimpleText,
+    Table,
+)
+
+
+def bootstrap_section(report: BootstrapReport, index_map=None, top_k: int = 20) -> Section:
+    def key(j):
+        return index_map.get_feature_name(j) if index_map is not None else str(j)
+
+    import numpy as np
+
+    order = np.argsort(
+        [-abs(s.median) for s in report.coefficient_summaries]
+    )[:top_k]
+    rows = [
+        (
+            key(int(j)),
+            f"{report.coefficient_summaries[j].lower_ci:.4g}",
+            f"{report.coefficient_summaries[j].median:.4g}",
+            f"{report.coefficient_summaries[j].upper_ci:.4g}",
+            "yes" if report.coefficient_summaries[j].interval_contains_zero() else "no",
+        )
+        for j in order
+    ]
+    metric_rows = [
+        (name, f"{s.lower_ci:.4g}", f"{s.median:.4g}", f"{s.upper_ci:.4g}")
+        for name, s in report.metric_distributions.items()
+    ]
+    contents = [
+        SimpleText(f"Bootstrap over {report.num_models} resampled models."),
+        Table(("feature", "2.5%", "median", "97.5%", "CI contains 0"), rows,
+              caption=f"top {len(rows)} coefficients by |median|"),
+    ]
+    if metric_rows:
+        contents.append(Table(("metric", "2.5%", "median", "97.5%"), metric_rows))
+    return Section("Bootstrap confidence intervals", contents)
+
+
+def feature_importance_section(report: FeatureImportanceReport, top_k: int = 20) -> Section:
+    rows = [(k, str(i), f"{v:.4g}") for k, i, v in report.top(top_k)]
+    return Section(
+        f"Feature importance ({report.importance_type})",
+        [
+            SimpleText(report.importance_description),
+            Table(("feature", "index", "importance"), rows),
+        ],
+    )
+
+
+def fitting_section(report: FittingReport) -> Section:
+    contents = []
+    if report.message:
+        contents.append(SimpleText(report.message))
+    for metric, (portions, train_vals, test_vals) in report.metrics.items():
+        contents.append(
+            LineChart(
+                title=f"{metric} vs training set size",
+                x_label="% of training data",
+                y_label=metric,
+                series=[("train", portions, train_vals), ("holdout", portions, test_vals)],
+            )
+        )
+    return Section("Learning curves", contents)
+
+
+def hosmer_lemeshow_section(report: HosmerLemeshowReport) -> Section:
+    rows = [
+        (
+            f"[{b.lower_bound:.3f}, {b.upper_bound:.3f})",
+            str(b.observed_pos),
+            str(b.expected_pos),
+            str(b.observed_neg),
+            str(b.expected_neg),
+        )
+        for b in report.bins
+    ]
+    contents = [
+        SimpleText(
+            f"chi^2 = {report.chi_squared:.4f} with {report.degrees_of_freedom} d.o.f.; "
+            f"P(chi^2 >= observed | well-calibrated) = {report.p_value:.4g}"
+        ),
+        Table(("probability bin", "obs +", "exp +", "obs -", "exp -"), rows),
+    ]
+    if report.warnings:
+        contents.append(BulletedList(report.warnings))
+    return Section("Hosmer-Lemeshow calibration", contents)
+
+
+def independence_section(report: KendallTauReport) -> Section:
+    return Section(
+        "Prediction-error independence (Kendall tau)",
+        [
+            Table(
+                ("statistic", "value"),
+                [
+                    ("items (sampled)", str(report.num_items)),
+                    ("concordant pairs", str(report.num_concordant)),
+                    ("discordant pairs", str(report.num_discordant)),
+                    ("tau alpha", f"{report.tau_alpha:.4f}"),
+                    ("tau beta", f"{report.tau_beta:.4f}"),
+                    ("z score", f"{report.z_score:.4f}"),
+                    ("p value (H0: independent)", f"{report.p_value:.4g}"),
+                ],
+            )
+        ],
+    )

@@ -309,3 +309,89 @@ class TestIndexingDrivers:
         lines = (out / "features").read_text().strip().split("\n")
         assert len(lines) == 3
         assert lines[0].split("\t")[0] == "f0"
+
+
+class TestReviewRegressions:
+    def test_model_spec_preserves_data_config(self, tmp_path):
+        """model-spec.json must record the coordinate's REAL data configuration
+        (random-effect type, shard) so the recorded spec round-trips."""
+        rng = np.random.default_rng(3)
+        write_glmix_avro(str(tmp_path / "train.avro"), rng, n=200)
+        out = tmp_path / "out"
+        game_training_driver.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", str(tmp_path / "train.avro"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--coordinate-configurations", RE_COORD,
+            "--coordinate-update-sequence", "per-user",
+        ])
+        spec = json.loads((out / "best" / "model-spec.json").read_text())
+        name, cfg = parse_coordinate_configuration(spec["per-user"])
+        assert name == "per-user"
+        assert isinstance(cfg.data_config, RandomEffectDataConfiguration)
+        assert cfg.data_config.random_effect_type == "userId"
+        assert cfg.data_config.feature_shard_id == "shardA"
+
+    def test_scoring_from_models_subdir(self, tmp_path):
+        """Index maps at <root>/index-maps must be found when scoring
+        <root>/models/<i>, not just <root>/best."""
+        rng = np.random.default_rng(4)
+        _, _, _, w, bias = write_glmix_avro(str(tmp_path / "train.avro"), rng, n=200)
+        out = tmp_path / "out"
+        game_training_driver.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", str(tmp_path / "train.avro"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--coordinate-configurations", FE_COORD,
+            "--coordinate-update-sequence", "global",
+            "--output-mode", "ALL",
+        ])
+        rc = game_scoring_driver.main([
+            "--input-data-directories", str(tmp_path / "train.avro"),
+            "--model-input-directory", str(out / "models" / "0"),
+            "--root-output-directory", str(tmp_path / "scores"),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+        ])
+        assert rc == 0
+
+    def test_sparse_take_rows_duplicates(self):
+        import jax.numpy as jnp
+        import scipy.sparse as sp
+
+        from photon_ml_tpu.data.matrix import DenseDesignMatrix, SparseDesignMatrix
+
+        rng = np.random.default_rng(5)
+        M = rng.normal(size=(6, 4)) * (rng.random((6, 4)) < 0.5)
+        sparse = SparseDesignMatrix.from_scipy(sp.csr_matrix(M), dtype=jnp.float64,
+                                               pad_nnz=40)
+        dense = DenseDesignMatrix(values=jnp.asarray(M))
+        idx = np.array([3, 3, 0, 5, 3])
+        np.testing.assert_allclose(
+            np.asarray(sparse.take_rows(idx).to_dense()),
+            np.asarray(dense.take_rows(idx).to_dense()),
+        )
+
+    def test_best_model_selection_smaller_is_better(self, tmp_path):
+        """With an RMSE primary evaluator (smaller is better), the lowest-RMSE
+        config must win, and unevaluated results must never be selected."""
+        rng = np.random.default_rng(6)
+        _, _, _, w, bias = write_glmix_avro(str(tmp_path / "train.avro"), rng, n=300)
+        write_glmix_avro(str(tmp_path / "val.avro"), rng, n=200, w=w, bias=bias)
+        out = tmp_path / "out"
+        result = game_training_driver.run(game_training_driver.build_arg_parser().parse_args([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", str(tmp_path / "train.avro"),
+            "--validation-data-directories", str(tmp_path / "val.avro"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--coordinate-configurations",
+            "name=global,feature.shard=shardA,optimizer=LBFGS,max.iter=40,"
+            "tolerance=1e-8,regularization=L2,reg.weights=0.01|100.0",
+            "--coordinate-update-sequence", "global",
+            "--evaluators", "RMSE",
+        ]))
+        results = result["results"]
+        metrics = [r.best_metric for r in results]
+        assert result["best_index"] == int(np.argmin(metrics))

@@ -1,0 +1,246 @@
+"""Diagnostics tests: bootstrap CIs vs analytic variance, Hosmer-Lemeshow on
+calibrated vs miscalibrated models, Kendall tau on independent vs dependent
+series, learning curves, feature importance ranking, report rendering. Mirrors
+the reference's BootstrapTrainingIntegTest / HosmerLemeshowDiagnosticTest /
+KendallTauAnalysisTest verification style."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.diagnostics import (
+    Chapter,
+    Document,
+    bootstrap_section,
+    bootstrap_training,
+    expected_magnitude_importance,
+    feature_importance_section,
+    fitting_diagnostic,
+    fitting_section,
+    hosmer_lemeshow_section,
+    hosmer_lemeshow_test,
+    independence_section,
+    kendall_tau_analysis,
+    prediction_error_independence,
+    render_html,
+    render_text,
+    variance_importance,
+)
+from photon_ml_tpu.evaluation.evaluators import auc_roc, rmse
+from photon_ml_tpu.normalization import FeatureDataStatistics
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+import jax.numpy as jnp
+
+
+def _config(opt=OptimizerType.LBFGS, reg=RegularizationType.L2, w=1.0, iters=60):
+    return GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(optimizer_type=opt, max_iterations=iters),
+        regularization_context=RegularizationContext(reg),
+        regularization_weight=w,
+    )
+
+
+def _linear_data(rng, n=400, d=4, noise=0.5):
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = X @ w + noise * rng.normal(size=n)
+    return LabeledData.build(X, y, dtype=jnp.float64), w
+
+
+class TestBootstrap:
+    def test_coefficient_cis_cover_truth(self, rng):
+        data, w_true = _linear_data(rng, n=600)
+        problem = GLMOptimizationProblem(
+            task=TaskType.LINEAR_REGRESSION, configuration=_config(w=1e-6)
+        )
+        report = bootstrap_training(problem, data, num_bootstraps=16, seed=1)
+        assert report.coefficients.shape == (16, 4)
+        # CI should cover the true coefficients (up to tiny-reg shrinkage)
+        for j, s in enumerate(report.coefficient_summaries):
+            assert s.lower_ci - 0.1 <= w_true[j] <= s.upper_ci + 0.1
+            assert s.std > 0
+
+    def test_vmapped_matches_sequential(self, rng):
+        """The vmapped LBFGS fast path must agree with per-resample solves."""
+        data, _ = _linear_data(rng, n=200)
+        smooth_problem = GLMOptimizationProblem(
+            task=TaskType.LINEAR_REGRESSION, configuration=_config(w=1.0)
+        )
+        tron_problem = GLMOptimizationProblem(
+            task=TaskType.LINEAR_REGRESSION,
+            configuration=_config(opt=OptimizerType.TRON, w=1.0),
+        )
+        fast = bootstrap_training(smooth_problem, data, num_bootstraps=4, seed=7)
+        slow = bootstrap_training(tron_problem, data, num_bootstraps=4, seed=7)
+        np.testing.assert_allclose(fast.coefficients, slow.coefficients, atol=1e-4)
+
+    def test_metric_distributions(self, rng):
+        data, _ = _linear_data(rng)
+        problem = GLMOptimizationProblem(
+            task=TaskType.LINEAR_REGRESSION, configuration=_config()
+        )
+        report = bootstrap_training(
+            problem, data, num_bootstraps=8, seed=2, metrics={"RMSE": rmse}
+        )
+        s = report.metric_distributions["RMSE"]
+        assert 0 < s.lower_ci <= s.median <= s.upper_ci
+
+
+class TestHosmerLemeshow:
+    def test_calibrated_passes_miscalibrated_fails(self, rng):
+        n = 20000
+        p = rng.uniform(0.05, 0.95, size=n)
+        y_cal = (rng.random(n) < p).astype(float)
+        good = hosmer_lemeshow_test(p, y_cal, num_bins=10)
+        # miscalibrated: labels drawn from sharpened probabilities
+        p_sharp = np.clip(p**3, 0, 1)
+        y_mis = (rng.random(n) < p_sharp).astype(float)
+        bad = hosmer_lemeshow_test(p, y_mis, num_bins=10)
+        assert bad.chi_squared > good.chi_squared * 3
+        assert bad.p_value < 0.01
+        assert good.degrees_of_freedom == 8
+        assert len(good.cutoffs) == 15
+
+    def test_bin_counts_partition_data(self, rng):
+        n = 500
+        p = rng.random(n)
+        y = (rng.random(n) < p).astype(float)
+        report = hosmer_lemeshow_test(p, y, num_bins=7)
+        assert sum(b.total for b in report.bins) == n
+        assert all(b.expected_pos + b.expected_neg == b.total for b in report.bins)
+
+    def test_default_bin_count_heuristic(self):
+        from photon_ml_tpu.diagnostics.hosmer_lemeshow import default_bin_count
+
+        # dimension-limited: d+2
+        assert default_bin_count(100000, 8) == 10
+        # data-limited for small n
+        assert default_bin_count(25, 100) == int(0.9 * 5 + 0.9 * np.log1p(25))
+
+
+class TestKendallTau:
+    def test_independent_series_high_p(self, rng):
+        a = rng.normal(size=2000)
+        b = rng.normal(size=2000)
+        report = kendall_tau_analysis(a, b, max_items=400, seed=3)
+        assert abs(report.tau_beta) < 0.1
+        assert report.p_value > 0.01
+
+    def test_dependent_series_low_p(self, rng):
+        a = rng.normal(size=2000)
+        b = a * 2.0 + 0.01 * rng.normal(size=2000)
+        report = kendall_tau_analysis(a, b, max_items=400, seed=3)
+        assert report.tau_beta > 0.9
+        assert report.p_value < 1e-6
+
+    def test_counts_consistent(self, rng):
+        a = rng.normal(size=50)
+        b = rng.normal(size=50)
+        r = kendall_tau_analysis(a, b, max_items=50)
+        pairs = 50 * 49 // 2
+        assert r.num_concordant + r.num_discordant + (
+            r.num_ties_a + r.num_ties_b
+        ) >= pairs  # ties can overlap both sides
+        assert r.num_items == 50
+
+    def test_prediction_error_wrapper(self, rng):
+        preds = rng.random(500)
+        labels = (rng.random(500) < preds).astype(float)
+        report = prediction_error_independence(preds, labels, max_items=200)
+        assert np.isfinite(report.tau_beta)
+
+
+class TestFitting:
+    def test_learning_curves_improve_with_data(self, rng):
+        data, _ = _linear_data(rng, n=1200, d=3, noise=1.0)
+        problem = GLMOptimizationProblem(
+            task=TaskType.LINEAR_REGRESSION, configuration=_config(w=0.01)
+        )
+
+        def factory(subset, warm):
+            glm, _ = problem.run(subset, warm)
+            return glm, glm
+
+        def rmse_metric(scores, labels, weights):
+            return rmse(scores, labels, weights)
+
+        report = fitting_diagnostic(data, factory, {"RMSE": rmse_metric}, seed=4)
+        portions, train_vals, test_vals = report.metrics["RMSE"]
+        assert len(portions) == 7
+        assert portions[0] < portions[-1]
+        # holdout RMSE at the largest portion beats the smallest portion
+        assert test_vals[-1] <= test_vals[0] + 0.05
+
+    def test_too_small_returns_empty(self, rng):
+        data, _ = _linear_data(rng, n=20, d=4)
+        report = fitting_diagnostic(data, lambda s, w: (None, None), {"RMSE": rmse})
+        assert report.metrics == {}
+        assert "insufficient" in report.message
+
+
+class TestFeatureImportance:
+    def test_expected_magnitude_ranking(self):
+        coefs = np.array([0.1, -5.0, 1.0])
+        X = np.array([[1.0, 0.1, 2.0]] * 10)
+        stats = FeatureDataStatistics.compute(X)
+        report = expected_magnitude_importance(coefs, stats)
+        keys = [k for k, _, _ in report.ranked]
+        # importances: |0.1*1|=0.1, |-5*0.1|=0.5, |1*2|=2.0
+        assert keys == ["2", "1", "0"]
+
+    def test_variance_importance(self, rng):
+        X = rng.normal(size=(200, 3)) * np.array([1.0, 10.0, 0.1])
+        stats = FeatureDataStatistics.compute(X)
+        report = variance_importance(np.array([1.0, 1.0, 1.0]), stats)
+        assert report.ranked[0][1] == 1  # highest-variance feature first
+
+
+class TestReporting:
+    def test_full_document_renders(self, rng):
+        data, _ = _linear_data(rng, n=400)
+        problem = GLMOptimizationProblem(
+            task=TaskType.LINEAR_REGRESSION, configuration=_config()
+        )
+        boot = bootstrap_training(problem, data, num_bootstraps=4, seed=5,
+                                  metrics={"RMSE": rmse})
+        p = rng.random(500)
+        y = (rng.random(500) < p).astype(float)
+        hl = hosmer_lemeshow_test(p, y, num_bins=6)
+        kt = kendall_tau_analysis(rng.normal(size=300), rng.normal(size=300))
+        stats = FeatureDataStatistics.compute(np.asarray(data.X.to_dense()))
+        fi = expected_magnitude_importance(np.ones(4), stats)
+
+        def factory(subset, warm):
+            glm, _ = problem.run(subset, warm)
+            return glm, glm
+
+        fit = fitting_diagnostic(data, factory, {"RMSE": rmse}, seed=6)
+
+        doc = Document(
+            "Model diagnostics",
+            [
+                Chapter("Model", [
+                    bootstrap_section(boot),
+                    feature_importance_section(fi),
+                    fitting_section(fit),
+                ]),
+                Chapter("Calibration", [
+                    hosmer_lemeshow_section(hl),
+                    independence_section(kt),
+                ]),
+            ],
+        )
+        text = render_text(doc)
+        html = render_html(doc)
+        assert "Bootstrap confidence intervals" in text
+        assert "Hosmer-Lemeshow" in text
+        assert "<table>" in html and "<svg" in html
+        assert "RMSE vs training set size" in html
