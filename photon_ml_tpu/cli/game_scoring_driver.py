@@ -68,8 +68,9 @@ def run(args: argparse.Namespace) -> dict:
         # prefer index maps saved by the training driver at <root>/index-maps —
         # the model may live at <root>/best (one level up) or <root>/models/<i>
         # (two levels up) — then the explicit off-heap dir
+        # farthest first so the NEAREST directory wins the dict.update
         index_maps = {}
-        for rel in ("..", os.path.join("..", "..")):
+        for rel in (os.path.join("..", ".."), ".."):
             index_maps.update(
                 _load_index_maps(
                     os.path.join(args.model_input_directory, rel, "index-maps"),

@@ -299,7 +299,21 @@ def write_container(path: str, schema_json, records: Iterable[dict], codec: str 
 
 
 def read_container(path: str) -> Iterator[dict]:
-    """Stream records from an Avro object-container file."""
+    """Stream records from an Avro object-container file (framing shared with
+    the native columnar path via iter_raw_blocks)."""
+    schema = None
+    for schema_json, payload, n_records in iter_raw_blocks(path):
+        if schema is None:
+            schema = Schema(schema_json)
+        buf = io.BytesIO(payload)
+        for _ in range(n_records):
+            yield decode(buf, schema.root)
+
+
+def iter_raw_blocks(path: str):
+    """Yield (schema_json, payload: bytes, n_records) per container block with
+    the codec already removed — the framing half of read_container, shared with
+    the native columnar decoder (data/native_avro.py)."""
     with open(path, "rb") as f:
         if f.read(4) != MAGIC:
             raise ValueError(f"{path}: not an Avro container file")
@@ -316,7 +330,6 @@ def read_container(path: str) -> Iterator[dict]:
                 meta[k] = read_bytes(f)
         schema_json = json.loads(meta["avro.schema"].decode())
         codec = meta.get("avro.codec", b"null").decode()
-        schema = Schema(schema_json)
         sync = f.read(SYNC_SIZE)
         while True:
             try:
@@ -333,22 +346,27 @@ def read_container(path: str) -> Iterator[dict]:
                 payload = zlib.decompress(payload, -15)
             elif codec != "null":
                 raise ValueError(f"Unsupported avro codec: {codec}")
-            buf = io.BytesIO(payload)
-            for _ in range(n_records):
-                yield decode(buf, schema.root)
+            yield schema_json, payload, n_records
             block_sync = f.read(SYNC_SIZE)
             if block_sync != sync:
                 raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
 
 
+def container_files(path: str) -> list:
+    """All .avro part files under path (or [path] when it is a file)."""
+    if os.path.isfile(path):
+        return [path]
+    return [
+        os.path.join(path, name)
+        for name in sorted(os.listdir(path))
+        if name.endswith(".avro")
+    ]
+
+
 def read_container_dir(path: str) -> Iterator[dict]:
     """Read all .avro files under a directory (the reference's part-file layout)."""
-    if os.path.isfile(path):
-        yield from read_container(path)
-        return
-    for name in sorted(os.listdir(path)):
-        if name.endswith(".avro"):
-            yield from read_container(os.path.join(path, name))
+    for file_path in container_files(path):
+        yield from read_container(file_path)
 
 
 # ------------------------------------------------------- Photon data contracts

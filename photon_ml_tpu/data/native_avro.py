@@ -1,0 +1,232 @@
+"""ctypes bridge to the native Avro block decoder (native/avro_block_decoder.cpp).
+
+Container framing (magic, metadata, codec, sync markers) and zlib inflate stay
+in Python — both already run at C speed — while the per-record varint walk,
+which dominates pure-Python ingest, runs native. The shared object is compiled
+on demand with g++ and cached next to the source; when no compiler is
+available every entry point degrades to ``available() == False`` and callers
+fall back to the pure-Python decoder in data/avro_io.py.
+
+Supported record layouts: every field must be one of
+  double | ["null","double"] | ["null","string"] |
+  array<FeatureAvro{name,term,value}> | ["null", map<string>]
+which covers TrainingExampleAvro, ResponsePredictionAvro and custom multi-bag
+training schemas. Schemas outside this set simply use the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+F_DOUBLE = 0
+F_NULLABLE_DOUBLE = 1
+F_NULLABLE_STRING = 2
+F_FEATURE_ARRAY = 3
+F_NULLABLE_MAP_STRING = 4
+
+_SOURCE = os.path.join(os.path.dirname(__file__), "..", "..", "native", "avro_block_decoder.cpp")
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", "_native_build")
+
+_lib = None
+_lib_error: Optional[str] = None
+_lock = threading.Lock()
+
+
+def _build_library() -> Optional[str]:
+    source = os.path.abspath(_SOURCE)
+    if not os.path.exists(source):
+        return None
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    so_path = os.path.join(_CACHE_DIR, "libphoton_avro.so")
+    if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(source):
+        return so_path
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, source]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(tmp, so_path)
+    return so_path
+
+
+def _load():
+    global _lib, _lib_error
+    with _lock:
+        if _lib is not None or _lib_error is not None:
+            return _lib
+        so_path = _build_library()
+        if so_path is None:
+            _lib_error = "native decoder unavailable (no source or compiler)"
+            return None
+        lib = ctypes.CDLL(so_path)
+        lib.photon_avro_decode.restype = ctypes.c_void_p
+        lib.photon_avro_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ]
+        lib.photon_avro_error.restype = ctypes.c_char_p
+        lib.photon_avro_error.argtypes = [ctypes.c_void_p]
+        lib.photon_avro_count.restype = ctypes.c_int64
+        lib.photon_avro_count.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+        lib.photon_avro_doubles.argtypes = [ctypes.c_void_p, ctypes.c_int32, f64p]
+        lib.photon_avro_strings.argtypes = [ctypes.c_void_p, ctypes.c_int32, i64p, i64p]
+        lib.photon_avro_features.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, i64p, i64p, i64p, i64p, i64p, f64p,
+        ]
+        lib.photon_avro_map.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, i64p, i64p, i64p, i64p, i64p,
+        ]
+        lib.photon_avro_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def field_types_for_schema(fields: list) -> Optional[list[int]]:
+    """Map an Avro record schema's fields to decoder field types; None when any
+    field falls outside the supported set (callers then use the Python path)."""
+    out = []
+    for f in fields:
+        t = f.get("type")
+        if t == "double":
+            out.append(F_DOUBLE)
+        elif t == ["null", "double"]:
+            out.append(F_NULLABLE_DOUBLE)
+        elif t == ["null", "string"]:
+            out.append(F_NULLABLE_STRING)
+        elif (
+            isinstance(t, dict)
+            and t.get("type") == "array"
+            and _is_feature_record(t.get("items"))
+        ):
+            out.append(F_FEATURE_ARRAY)
+        elif (
+            isinstance(t, list)
+            and len(t) == 2
+            and t[0] == "null"
+            and isinstance(t[1], dict)
+            and t[1].get("type") == "map"
+            and t[1].get("values") == "string"
+        ):
+            out.append(F_NULLABLE_MAP_STRING)
+        else:
+            return None
+    return out
+
+
+def _is_feature_record(items) -> bool:
+    if isinstance(items, str):  # named-type reference, e.g. "FeatureAvro"
+        return items.rsplit(".", 1)[-1] == "FeatureAvro"
+    if not isinstance(items, dict) or items.get("type") != "record":
+        return False
+    names = [f.get("name") for f in items.get("fields", ())]
+    types = [f.get("type") for f in items.get("fields", ())]
+    return names == ["name", "term", "value"] and types[:2] == ["string", "string"]
+
+
+class DecodedBlock:
+    """Columnar view over one decoded block. String columns come back as
+    (offsets, lengths) into ``payload``; ``strings_at`` materializes them."""
+
+    def __init__(self, payload: bytes, handle: int, lib, n_fields: int):
+        self._payload = payload
+        self._view = np.frombuffer(payload, dtype=np.uint8)
+        self._handle = handle
+        self._lib = lib
+        self._n_fields = n_fields
+
+    def count(self, field: int) -> int:
+        return int(self._lib.photon_avro_count(self._handle, field))
+
+    def doubles(self, field: int) -> np.ndarray:
+        n = self.count(field)
+        out = np.empty(n, dtype=np.float64)
+        self._lib.photon_avro_doubles(self._handle, field, out)
+        return out
+
+    def strings(self, field: int) -> tuple[np.ndarray, np.ndarray]:
+        n = self.count(field)
+        offs = np.empty(n, dtype=np.int64)
+        lens = np.empty(n, dtype=np.int64)
+        self._lib.photon_avro_strings(self._handle, field, offs, lens)
+        return offs, lens
+
+    def features(self, field: int):
+        """(rows, name_offs, name_lens, term_offs, term_lens, values)."""
+        n = self.count(field)
+        rows = np.empty(n, dtype=np.int64)
+        no = np.empty(n, dtype=np.int64)
+        nl = np.empty(n, dtype=np.int64)
+        to = np.empty(n, dtype=np.int64)
+        tl = np.empty(n, dtype=np.int64)
+        vals = np.empty(n, dtype=np.float64)
+        self._lib.photon_avro_features(self._handle, field, rows, no, nl, to, tl, vals)
+        return rows, no, nl, to, tl, vals
+
+    def map_entries(self, field: int):
+        """(rows, key_offs, key_lens, val_offs, val_lens)."""
+        n = self.count(field)
+        rows = np.empty(n, dtype=np.int64)
+        ko = np.empty(n, dtype=np.int64)
+        kl = np.empty(n, dtype=np.int64)
+        vo = np.empty(n, dtype=np.int64)
+        vl = np.empty(n, dtype=np.int64)
+        self._lib.photon_avro_map(self._handle, field, rows, ko, kl, vo, vl)
+        return rows, ko, kl, vo, vl
+
+    def string_at(self, off: int, length: int) -> str:
+        if off < 0:
+            return ""
+        return self._payload[off : off + length].decode()
+
+    def strings_at(self, offs: np.ndarray, lens: np.ndarray) -> list:
+        payload = self._payload
+        return [
+            payload[o : o + l].decode() if o >= 0 else None
+            for o, l in zip(offs.tolist(), lens.tolist())
+        ]
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.photon_avro_free(self._handle)
+            self._handle = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        self.close()
+
+
+def decode_block(payload: bytes, n_records: int, field_types: list[int]) -> DecodedBlock:
+    """Decode one decompressed Avro block; raises ValueError on malformed data."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(_lib_error or "native decoder unavailable")
+    ftypes = (ctypes.c_int32 * len(field_types))(*field_types)
+    handle = lib.photon_avro_decode(
+        payload, len(payload), n_records, ftypes, len(field_types)
+    )
+    if not handle:
+        raise MemoryError("native avro decoder allocation failed")
+    err = lib.photon_avro_error(handle)
+    if err:
+        msg = err.decode()
+        lib.photon_avro_free(handle)
+        raise ValueError(f"native avro decode failed: {msg}")
+    return DecodedBlock(payload, handle, lib, len(field_types))

@@ -1,0 +1,235 @@
+"""Off-heap (memory-mapped) feature index store.
+
+Parity target: photon-api index/PalDBIndexMap.scala:43-278 +
+PalDBIndexMapLoader.scala:111 + PalDBIndexMapBuilder.scala:98 — the reference
+stores feature name<->index maps for billions of features in PalDB files,
+partitioned by key hash, memory-mapped per executor so the map never lives on
+the JVM heap.
+
+This build's equivalent: one binary file per partition containing
+  header | open-addressing hash table | reverse (index -> slot) table | key blob
+memory-mapped via numpy. Lookups probe the hash table directly against the
+mmap — nothing is materialized in RAM beyond touched pages, so a store with
+hundreds of millions of keys costs only page cache. Forward (key -> index) is
+O(1); reverse (index -> key) is a binary search over the partition's reverse
+table. Global indices are contiguous ordinals over the sorted key set (unlike
+the reference's local*P+partition interleave, which leaves gaps when hash
+partitions are uneven — contiguous ids keep design-matrix widths == key count).
+
+Partition file layout (little endian):
+  [0:8)    magic "PHOFIDX1"
+  [8:16)   n_keys (u64)
+  [16:24)  table_slots (u64)  — open addressing, power of two, load <= 0.5
+  [24:32)  blob_offset (u64)
+  [32:a)   hash table: table_slots x (hash u64, key_off u64, key_len u32, index u64)
+  [a:blob_offset)  reverse table: n_keys x (index u64, slot u64), sorted by index
+  [blob_offset:)   key blob: concatenated utf-8 keys
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+import numpy as np
+
+MAGIC = b"PHOFIDX1"
+_HEADER = 32
+_SLOT_DTYPE = np.dtype(
+    [("hash", "<u8"), ("key_off", "<u8"), ("key_len", "<u4"), ("index", "<u8")]
+)
+_REV_DTYPE = np.dtype([("index", "<u8"), ("slot", "<u8")])
+_EMPTY = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _fnv1a(data: bytes) -> int:
+    """64-bit FNV-1a — stable across processes (unlike Python's salted hash)."""
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _fnv1a_batch(keys) -> np.ndarray:
+    return np.fromiter((_fnv1a(k.encode()) for k in keys), dtype=np.uint64, count=len(keys))
+
+
+class OffHeapIndexMapBuilder:
+    """PalDBIndexMapBuilder equivalent: accumulates keys, partitions by hash,
+    writes one store file per partition."""
+
+    def __init__(self, output_dir: str, num_partitions: int = 1):
+        if num_partitions < 1:
+            raise ValueError("num_partitions must be >= 1")
+        self.output_dir = output_dir
+        self.num_partitions = num_partitions
+        self._seen: set[str] = set()
+
+    def put(self, key: str) -> "OffHeapIndexMapBuilder":
+        self._seen.add(key)
+        return self
+
+    def put_all(self, keys: Iterable[str]) -> "OffHeapIndexMapBuilder":
+        self._seen.update(keys)
+        return self
+
+    def build(self) -> "OffHeapIndexMap":
+        os.makedirs(self.output_dir, exist_ok=True)
+        keys = sorted(self._seen)  # deterministic ordinal assignment
+        hashes = _fnv1a_batch(keys) if keys else np.zeros(0, dtype=np.uint64)
+        parts = (
+            (hashes % np.uint64(self.num_partitions)).astype(np.int64)
+            if len(keys)
+            else np.zeros(0, dtype=np.int64)
+        )
+        for p in range(self.num_partitions):
+            idx = np.flatnonzero(parts == p)
+            _write_partition(
+                os.path.join(self.output_dir, f"part-{p:05d}.bin"),
+                [keys[i] for i in idx],
+                hashes[idx],
+                idx.astype(np.uint64),  # contiguous global ordinals
+            )
+        with open(os.path.join(self.output_dir, "meta"), "w") as f:
+            f.write(f"{self.num_partitions}\n{len(keys)}\n")
+        return OffHeapIndexMap(self.output_dir)
+
+
+def _write_partition(path: str, keys: list, hashes: np.ndarray, indices: np.ndarray) -> None:
+    n = len(keys)
+    slots = 16
+    while slots < 2 * max(n, 1):
+        slots *= 2
+    table = np.zeros(slots, dtype=_SLOT_DTYPE)
+    table["hash"][:] = _EMPTY
+    slot_of = np.zeros(n, dtype=np.uint64)
+    blob_parts: list[bytes] = []
+    off = 0
+    mask = slots - 1
+    for i, key in enumerate(keys):
+        data = key.encode()
+        h = int(hashes[i])
+        s = h & mask
+        while table["hash"][s] != _EMPTY:
+            s = (s + 1) & mask
+        table["hash"][s] = h
+        table["key_off"][s] = off
+        table["key_len"][s] = len(data)
+        table["index"][s] = indices[i]
+        slot_of[i] = s
+        blob_parts.append(data)
+        off += len(data)
+    rev = np.zeros(n, dtype=_REV_DTYPE)
+    rev["index"] = indices
+    rev["slot"] = slot_of
+    rev = rev[np.argsort(rev["index"], kind="stable")]
+    blob = b"".join(blob_parts)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(np.uint64(n).tobytes())
+        f.write(np.uint64(slots).tobytes())
+        f.write(np.uint64(_HEADER + table.nbytes + rev.nbytes).tobytes())
+        f.write(table.tobytes())
+        f.write(rev.tobytes())
+        f.write(blob)
+
+
+class _Partition:
+    def __init__(self, path: str):
+        raw = np.memmap(path, dtype=np.uint8, mode="r")
+        if bytes(raw[:8]) != MAGIC:
+            raise ValueError(f"{path}: not an off-heap index store")
+        header = raw[8:_HEADER].view("<u8")
+        self.n_keys = int(header[0])
+        self.slots = int(header[1])
+        blob_offset = int(header[2])
+        table_end = _HEADER + self.slots * _SLOT_DTYPE.itemsize
+        self.table = raw[_HEADER:table_end].view(_SLOT_DTYPE)
+        self.rev = raw[table_end:blob_offset].view(_REV_DTYPE)
+        self.blob = raw[blob_offset:]
+        self.mask = self.slots - 1
+
+    def _key_at_slot(self, s: int) -> str:
+        off = int(self.table["key_off"][s])
+        ln = int(self.table["key_len"][s])
+        return bytes(self.blob[off : off + ln]).decode()
+
+    def get(self, key: str, h: int) -> int:
+        s = h & self.mask
+        table = self.table
+        while True:
+            slot_hash = int(table["hash"][s])
+            if slot_hash == int(_EMPTY):
+                return -1
+            if slot_hash == h and self._key_at_slot(s) == key:
+                return int(table["index"][s])
+            s = (s + 1) & self.mask
+
+    def key_for_index(self, index: int) -> Optional[str]:
+        pos = int(np.searchsorted(self.rev["index"], np.uint64(index)))
+        if pos >= self.n_keys or int(self.rev["index"][pos]) != index:
+            return None
+        return self._key_at_slot(int(self.rev["slot"][pos]))
+
+
+class OffHeapIndexMap:
+    """Read side (PalDBIndexMap): mmap partitions, O(1) forward lookup, binary-
+    search reverse lookup.
+
+    Implements the same surface as data.index_map.IndexMap so shard configs,
+    readers and model IO accept either implementation.
+    """
+
+    def __init__(self, directory: str):
+        with open(os.path.join(directory, "meta")) as f:
+            self.num_partitions = int(f.readline())
+            self._size = int(f.readline())
+        self.directory = directory
+        self._parts = [
+            _Partition(os.path.join(directory, f"part-{p:05d}.bin"))
+            for p in range(self.num_partitions)
+        ]
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def __len__(self) -> int:
+        return self._size
+
+    @property
+    def intercept_index(self) -> Optional[int]:
+        from photon_ml_tpu.types import intercept_key
+
+        idx = self.get_index(intercept_key())
+        return idx if idx >= 0 else None
+
+    def get_index(self, key: str) -> int:
+        h = _fnv1a(key.encode())
+        return self._parts[h % self.num_partitions].get(key, h)
+
+    def get_indices(self, keys) -> np.ndarray:
+        """Batch lookup (hashes vectorized; probes per key)."""
+        hashes = _fnv1a_batch(keys)
+        out = np.empty(len(keys), dtype=np.int64)
+        for i, key in enumerate(keys):
+            h = int(hashes[i])
+            out[i] = self._parts[h % self.num_partitions].get(key, h)
+        return out
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        if not (0 <= index < self._size):
+            return None
+        for part in self._parts:
+            key = part.key_for_index(index)
+            if key is not None:
+                return key
+        return None
+
+    def __contains__(self, key: str) -> bool:
+        return self.get_index(key) >= 0
+
+    def keys(self):
+        for index in range(self._size):
+            yield self.get_feature_name(index)

@@ -15,7 +15,7 @@ import scipy.sparse as sp
 
 from photon_ml_tpu.data import avro_io
 from photon_ml_tpu.data.index_map import IndexMap, feature_key
-from photon_ml_tpu.types import intercept_key
+from photon_ml_tpu.types import DELIMITER, intercept_key
 
 
 @dataclasses.dataclass
@@ -124,6 +124,7 @@ def read_merged_avro(
     shard_configs,
     index_maps: Optional[dict] = None,
     id_tags: Sequence[str] = (),
+    use_native: bool = True,
 ):
     """Avro records -> one GameInput with per-SHARD feature matrices.
 
@@ -143,6 +144,11 @@ def read_merged_avro(
     Returns (GameInput, {shard_id: IndexMap}, uids ndarray).
     """
     from photon_ml_tpu.data.game_data import GameInput
+
+    if use_native:
+        native = _read_merged_native(path, shard_configs, index_maps, id_tags)
+        if native is not None:
+            return native
 
     records = list(avro_io.read_container_dir(path))
     n = len(records)
@@ -272,3 +278,171 @@ def read_libsvm(
         uids=np.asarray([str(i) for i in range(n)], dtype=object),
     )
     return ds, index_map
+
+
+def _read_merged_native(path, shard_configs, index_maps, id_tags):
+    """Native columnar fast path for read_merged_avro: container framing +
+    inflate in Python, record decoding in C++ (data/native_avro.py), shard
+    assembly vectorized. Returns None when the decoder or schema is
+    unsupported — callers fall back to the record-at-a-time Python path, which
+    this function matches result-for-result (tests assert equality)."""
+    from photon_ml_tpu.data import native_avro
+    from photon_ml_tpu.data.game_data import GameInput
+
+    if not native_avro.available():
+        return None
+    files = avro_io.container_files(path)
+
+    # ---- pass 1: decode every block, keep columnar views -----------------------
+    decoded = []  # (block, row_base, positions dict, bag positions dict)
+    n_total = 0
+    for file_path in files:
+        for schema_json, payload, n_records in avro_io.iter_raw_blocks(file_path):
+            fields = schema_json.get("fields", [])
+            ftypes = native_avro.field_types_for_schema(fields)
+            if ftypes is None:
+                return None  # unsupported layout -> pure-Python path
+            pos = {f["name"]: i for i, f in enumerate(fields)}
+            label_pos = pos.get("label", pos.get("response"))
+            if label_pos is None:
+                return None
+            bag_pos = {
+                bag: pos[bag]
+                for cfg in shard_configs.values()
+                for bag in cfg.feature_bags
+                if bag in pos
+            }
+            try:
+                block = native_avro.decode_block(payload, n_records, ftypes)
+            except ValueError:
+                return None  # malformed for the fast path; let Python report it
+            decoded.append((block, n_total, pos, bag_pos, ftypes, label_pos))
+            n_total += n_records
+
+    labels = np.zeros(n_total)
+    offsets = np.zeros(n_total)
+    weights = np.ones(n_total)
+    uids = np.empty(n_total, dtype=object)
+    has_labels = False
+    id_cols: dict[str, list] = {tag: [None] * n_total for tag in id_tags}
+    # per shard: entry arrays accumulated across blocks, in bag order per block
+    ent_rows: dict[str, list] = {s: [] for s in shard_configs}
+    ent_keys: dict[str, list] = {s: [] for s in shard_configs}
+    ent_vals: dict[str, list] = {s: [] for s in shard_configs}
+
+    DOUBLES = (native_avro.F_DOUBLE, native_avro.F_NULLABLE_DOUBLE)
+    for block, base, pos, bag_pos, ftypes, label_pos in decoded:
+        # nullable doubles decode nulls as NaN; match the Python path's
+        # defaults (label 0, offset 0, weight 1) and its has_labels semantics
+        # (true only when some label is present)
+        lab = block.doubles(label_pos)
+        if ftypes[label_pos] == native_avro.F_NULLABLE_DOUBLE:
+            if np.any(~np.isnan(lab)):
+                has_labels = True
+            lab = np.where(np.isnan(lab), 0.0, lab)
+        elif len(lab):
+            has_labels = True
+        labels[base : base + len(lab)] = lab
+        if "offset" in pos and ftypes[pos["offset"]] in DOUBLES:
+            off = block.doubles(pos["offset"])
+            offsets[base : base + len(off)] = np.where(np.isnan(off), 0.0, off)
+        if "weight" in pos and ftypes[pos["weight"]] in DOUBLES:
+            w = block.doubles(pos["weight"])
+            weights[base : base + len(w)] = np.where(np.isnan(w), 1.0, w)
+        if "uid" in pos and ftypes[pos["uid"]] == native_avro.F_NULLABLE_STRING:
+            offs, lens = block.strings(pos["uid"])
+            vals = block.strings_at(offs, lens)
+            for i, v in enumerate(vals):
+                uids[base + i] = v if v is not None else str(base + i)
+        else:
+            for i in range(block.count(label_pos)):
+                uids[base + i] = str(base + i)
+        if id_tags:
+            if "metadataMap" not in pos:
+                raise ValueError(f"id tags {list(id_tags)} need a metadataMap field")
+            rows, ko, kl, vo, vl = block.map_entries(pos["metadataMap"])
+            keys = block.strings_at(ko, kl)
+            vals = block.strings_at(vo, vl)
+            for r, k, v in zip(rows.tolist(), keys, vals):
+                if k in id_cols:
+                    id_cols[k][base + r] = v
+        for shard_id, cfg in shard_configs.items():
+            for bag in cfg.feature_bags:
+                if bag not in bag_pos:
+                    continue
+                rows, no, nl, to, tl, vals = block.features(bag_pos[bag])
+                if not len(rows):
+                    continue
+                payload = block._payload
+                keys = [
+                    payload[o : o + l].decode() + DELIMITER + payload[o2 : o2 + l2].decode()
+                    for o, l, o2, l2 in zip(
+                        no.tolist(), nl.tolist(), to.tolist(), tl.tolist()
+                    )
+                ]
+                ent_rows[shard_id].append(rows + base)
+                ent_keys[shard_id].append(keys)
+                ent_vals[shard_id].append(vals)
+
+    for tag in id_tags:
+        missing = [i for i, v in enumerate(id_cols[tag]) if v is None]
+        if missing:
+            raise ValueError(
+                f"Sample {missing[0]} missing id tag {tag!r} in metadataMap"
+            )
+
+    # ---- index maps (built from data when absent) ------------------------------
+    index_maps = dict(index_maps or {})
+    for shard_id, cfg in shard_configs.items():
+        if shard_id not in index_maps:
+            all_keys: list[str] = []
+            for chunk in ent_keys[shard_id]:
+                all_keys.extend(chunk)
+            index_maps[shard_id] = IndexMap.build(all_keys, add_intercept=cfg.has_intercept)
+
+    # ---- shard assembly: map keys -> cols, dedupe first occurrence, intercept --
+    features = {}
+    for shard_id, cfg in shard_configs.items():
+        imap = index_maps[shard_id]
+        if ent_rows[shard_id]:
+            rows = np.concatenate(ent_rows[shard_id])
+            vals = np.concatenate(ent_vals[shard_id])
+            get_index = imap.get_index
+            cols = np.fromiter(
+                (get_index(k) for chunk in ent_keys[shard_id] for k in chunk),
+                dtype=np.int64,
+                count=len(rows),
+            )
+            keep = cols >= 0
+            rows, cols, vals = rows[keep], cols[keep], vals[keep]
+            # first occurrence wins for duplicate (row, col) — np.unique returns
+            # the smallest input index per unique value
+            _, first = np.unique(rows * np.int64(imap.size) + cols, return_index=True)
+            rows, cols, vals = rows[first], cols[first], vals[first]
+        else:
+            rows = np.zeros(0, dtype=np.int64)
+            cols = np.zeros(0, dtype=np.int64)
+            vals = np.zeros(0, dtype=np.float64)
+        icpt = imap.intercept_index
+        if icpt is not None:
+            has_icpt = np.zeros(n_total, dtype=bool)
+            has_icpt[rows[cols == icpt]] = True
+            add = np.flatnonzero(~has_icpt)
+            rows = np.concatenate([rows, add])
+            cols = np.concatenate([cols, np.full(len(add), icpt, dtype=np.int64)])
+            vals = np.concatenate([vals, np.ones(len(add))])
+        features[shard_id] = sp.csr_matrix(
+            (vals, (rows, cols)), shape=(n_total, imap.size)
+        )
+
+    for block, *_ in decoded:
+        block.close()
+
+    game_input = GameInput(
+        features=features,
+        labels=labels if has_labels else None,
+        offsets=offsets,
+        weights=weights,
+        id_columns={k: np.asarray(v, dtype=object) for k, v in id_cols.items()},
+    )
+    return game_input, index_maps, uids

@@ -1,0 +1,218 @@
+"""Native Avro decoder tests: result parity with the pure-Python path over every
+supported field shape, fallback behavior, and an ingest speedup smoke check."""
+
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import avro_io, native_avro
+from photon_ml_tpu.data.readers import read_merged_avro
+from photon_ml_tpu.estimators.config import FeatureShardConfiguration
+
+pytestmark = pytest.mark.skipif(
+    not native_avro.available(), reason="native decoder unavailable (no g++)"
+)
+
+
+def write_fixture(path, rng, n=400, d=6, with_nulls=True):
+    def records():
+        for i in range(n):
+            yield {
+                "uid": None if (with_nulls and i % 7 == 0) else f"s{i}",
+                "label": float(i % 2),
+                "features": [
+                    {"name": f"f{j}", "term": f"t{j % 2}", "value": float(rng.normal())}
+                    for j in range(int(rng.integers(0, d)))
+                ],
+                "metadataMap": {"userId": f"u{i % 5}", "extra": "x"},
+                "weight": None if (with_nulls and i % 5 == 0) else 2.0,
+                "offset": None if (with_nulls and i % 3 == 0) else 0.25,
+            }
+
+    avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, records())
+
+
+SHARDS = {"shardA": FeatureShardConfiguration(feature_bags=("features",))}
+
+
+class TestNativeParity:
+    def test_matches_python_path(self, tmp_path, rng):
+        path = str(tmp_path / "data.avro")
+        write_fixture(path, rng)
+        nat, nat_maps, nat_uids = read_merged_avro(path, SHARDS, id_tags=["userId"])
+        py, py_maps, py_uids = read_merged_avro(
+            path, SHARDS, id_tags=["userId"], use_native=False
+        )
+        assert nat_maps["shardA"].size == py_maps["shardA"].size
+        np.testing.assert_array_equal(np.asarray(nat.labels), np.asarray(py.labels))
+        np.testing.assert_array_equal(nat.offsets, py.offsets)
+        np.testing.assert_array_equal(nat.weights, py.weights)
+        np.testing.assert_array_equal(
+            nat.id_columns["userId"], py.id_columns["userId"]
+        )
+        np.testing.assert_allclose(
+            nat.features["shardA"].toarray(), py.features["shardA"].toarray()
+        )
+        # null uids default to the row ordinal on both paths
+        assert list(nat_uids) == list(py_uids)
+
+    def test_existing_index_map_respected(self, tmp_path, rng):
+        path = str(tmp_path / "data.avro")
+        write_fixture(path, rng)
+        _, maps, _ = read_merged_avro(path, SHARDS)
+        nat, _, _ = read_merged_avro(path, SHARDS, index_maps=maps)
+        py, _, _ = read_merged_avro(path, SHARDS, index_maps=maps, use_native=False)
+        np.testing.assert_allclose(
+            nat.features["shardA"].toarray(), py.features["shardA"].toarray()
+        )
+
+    def test_unlabeled_schema_parity(self, tmp_path):
+        """ResponsePredictionAvro-shaped records (response field name)."""
+        schema = {
+            "name": "SimplifiedResponsePrediction",
+            "type": "record",
+            "fields": [
+                {"name": "response", "type": "double"},
+                {"name": "features", "type": {"type": "array",
+                                              "items": avro_io.FEATURE_SCHEMA}},
+            ],
+        }
+        path = str(tmp_path / "r.avro")
+        avro_io.write_container(path, schema, [
+            {"response": 1.0, "features": [{"name": "a", "term": "", "value": 3.0}]},
+            {"response": 0.0, "features": []},
+        ])
+        nat, _, _ = read_merged_avro(path, SHARDS)
+        py, _, _ = read_merged_avro(path, SHARDS, use_native=False)
+        np.testing.assert_array_equal(np.asarray(nat.labels), np.asarray(py.labels))
+        np.testing.assert_allclose(
+            nat.features["shardA"].toarray(), py.features["shardA"].toarray()
+        )
+
+    def test_unsupported_schema_falls_back(self, tmp_path):
+        """A schema with an int field is outside the native set; read_merged_avro
+        must still work via the Python path."""
+        schema = {
+            "name": "Weird",
+            "type": "record",
+            "fields": [
+                {"name": "label", "type": "double"},
+                {"name": "features", "type": {"type": "array",
+                                              "items": avro_io.FEATURE_SCHEMA}},
+                {"name": "count", "type": "long"},
+            ],
+        }
+        path = str(tmp_path / "w.avro")
+        avro_io.write_container(path, schema, [
+            {"label": 1.0, "features": [], "count": 3},
+        ])
+        assert native_avro.field_types_for_schema(schema["fields"]) is None
+        data, _, _ = read_merged_avro(path, SHARDS)
+        assert data.n == 1
+
+
+class TestDecoderPrimitives:
+    def test_decode_block_roundtrip(self):
+        import io as _io
+
+        buf = _io.BytesIO()
+        schema = avro_io.Schema(avro_io.TRAINING_EXAMPLE_SCHEMA)
+        recs = [
+            {
+                "uid": "u1", "label": 2.5,
+                "features": [{"name": "n", "term": "t", "value": 7.0}],
+                "metadataMap": {"k": "v"}, "weight": 3.0, "offset": None,
+            }
+        ]
+        for r in recs:
+            avro_io.encode(buf, schema.root, r)
+        ftypes = native_avro.field_types_for_schema(
+            avro_io.TRAINING_EXAMPLE_SCHEMA["fields"]
+        )
+        with native_avro.decode_block(buf.getvalue(), 1, ftypes) as block:
+            assert block.doubles(1)[0] == 2.5
+            assert np.isnan(block.doubles(5)[0])  # null offset -> NaN
+            assert block.doubles(4)[0] == 3.0
+            rows, no, nl, to, tl, vals = block.features(2)
+            assert vals[0] == 7.0
+            assert block.string_at(no[0], nl[0]) == "n"
+            assert block.string_at(to[0], tl[0]) == "t"
+            r_, ko, kl, vo, vl = block.map_entries(3)
+            assert block.string_at(ko[0], kl[0]) == "k"
+            assert block.string_at(vo[0], vl[0]) == "v"
+
+    def test_malformed_block_raises(self):
+        ftypes = [native_avro.F_DOUBLE]
+        with pytest.raises(ValueError, match="malformed|trailing"):
+            native_avro.decode_block(b"\x01\x02", 1, ftypes)
+
+    def test_trailing_bytes_raises(self):
+        payload = np.float64(1.0).tobytes() + b"extra"
+        with pytest.raises(ValueError, match="trailing"):
+            native_avro.decode_block(payload, 1, [native_avro.F_DOUBLE])
+
+
+def test_native_ingest_speedup(tmp_path, rng):
+    """The native path should beat pure Python comfortably on a larger file."""
+    path = str(tmp_path / "big.avro")
+    write_fixture(path, rng, n=8000, d=12, with_nulls=False)
+    t0 = time.perf_counter()
+    read_merged_avro(path, SHARDS)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    read_merged_avro(path, SHARDS, use_native=False)
+    t_python = time.perf_counter() - t0
+    print(f"native={t_native:.3f}s python={t_python:.3f}s speedup={t_python/t_native:.1f}x")
+    assert t_native < t_python
+
+
+class TestReviewRegressions:
+    def test_non_nullable_weight_offset_parity(self, tmp_path):
+        """ResponsePredictionAvro declares weight/offset as plain doubles; the
+        native path must read them, not silently default to 1/0."""
+        path = str(tmp_path / "rp.avro")
+        avro_io.write_container(path, avro_io.RESPONSE_PREDICTION_SCHEMA, [
+            {"uid": "a", "response": 1.0, "offset": 0.25, "weight": 2.0,
+             "features": [{"name": "x", "term": "", "value": 1.0}]},
+            {"uid": "b", "response": 0.0, "offset": -0.5, "weight": 3.0,
+             "features": []},
+        ])
+        nat, _, _ = read_merged_avro(path, SHARDS)
+        py, _, _ = read_merged_avro(path, SHARDS, use_native=False)
+        np.testing.assert_array_equal(nat.weights, py.weights)
+        np.testing.assert_array_equal(nat.offsets, py.offsets)
+        np.testing.assert_array_equal(nat.weights, [2.0, 3.0])
+        np.testing.assert_array_equal(nat.offsets, [0.25, -0.5])
+
+    def test_null_labels_parity(self, tmp_path):
+        """Nullable labels: nulls default to 0.0 (never NaN), and an all-null
+        label column means has_labels is False — matching the Python path."""
+        schema = {
+            "name": "NullableLabel",
+            "type": "record",
+            "fields": [
+                {"name": "label", "type": ["null", "double"], "default": None},
+                {"name": "features", "type": {"type": "array",
+                                              "items": avro_io.FEATURE_SCHEMA}},
+            ],
+        }
+        path = str(tmp_path / "nl.avro")
+        avro_io.write_container(path, schema, [
+            {"label": None, "features": []},
+            {"label": 1.0, "features": []},
+        ])
+        nat, _, _ = read_merged_avro(path, SHARDS)
+        py, _, _ = read_merged_avro(path, SHARDS, use_native=False)
+        assert nat.has_labels and py.has_labels
+        np.testing.assert_array_equal(np.asarray(nat.labels), np.asarray(py.labels))
+        assert not np.any(np.isnan(np.asarray(nat.labels)))
+
+        path2 = str(tmp_path / "allnull.avro")
+        avro_io.write_container(path2, schema, [
+            {"label": None, "features": []},
+            {"label": None, "features": []},
+        ])
+        nat2, _, _ = read_merged_avro(path2, SHARDS)
+        py2, _, _ = read_merged_avro(path2, SHARDS, use_native=False)
+        assert nat2.has_labels == py2.has_labels == False  # noqa: E712
