@@ -352,19 +352,32 @@ def iter_raw_blocks(path: str):
                 raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
 
 
-def container_files(path: str) -> list:
-    """All .avro part files under path (or [path] when it is a file)."""
-    if os.path.isfile(path):
-        return [path]
-    return [
-        os.path.join(path, name)
-        for name in sorted(os.listdir(path))
-        if name.endswith(".avro")
-    ]
+def container_files(path) -> list:
+    """All .avro part files under ``path``: a file, a directory of part files, a
+    comma-separated string of either, or a list/tuple of paths (the reference's
+    multi-path inputDataDirectories contract — part files concatenate across
+    paths in the order given)."""
+    if isinstance(path, (list, tuple)):
+        # explicit list: items are taken verbatim (a path may contain a comma)
+        paths = [str(p) for p in path if str(p)]
+    else:
+        paths = [p for p in str(path).split(",") if p]
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        else:
+            out.extend(
+                os.path.join(p, name)
+                for name in sorted(os.listdir(p))
+                if name.endswith(".avro")
+            )
+    return out
 
 
-def read_container_dir(path: str) -> Iterator[dict]:
-    """Read all .avro files under a directory (the reference's part-file layout)."""
+def read_container_dir(path) -> Iterator[dict]:
+    """Read all .avro files under one or more directories (the reference's
+    part-file layout; accepts the same multi-path forms as container_files)."""
     for file_path in container_files(path):
         yield from read_container(file_path)
 

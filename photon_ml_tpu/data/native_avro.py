@@ -65,29 +65,50 @@ def _load():
         if so_path is None:
             _lib_error = "native decoder unavailable (no source or compiler)"
             return None
-        lib = ctypes.CDLL(so_path)
-        lib.photon_avro_decode.restype = ctypes.c_void_p
-        lib.photon_avro_decode.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
-        ]
-        lib.photon_avro_error.restype = ctypes.c_char_p
-        lib.photon_avro_error.argtypes = [ctypes.c_void_p]
-        lib.photon_avro_count.restype = ctypes.c_int64
-        lib.photon_avro_count.argtypes = [ctypes.c_void_p, ctypes.c_int32]
-        i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
-        f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
-        lib.photon_avro_doubles.argtypes = [ctypes.c_void_p, ctypes.c_int32, f64p]
-        lib.photon_avro_strings.argtypes = [ctypes.c_void_p, ctypes.c_int32, i64p, i64p]
-        lib.photon_avro_features.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32, i64p, i64p, i64p, i64p, i64p, f64p,
-        ]
-        lib.photon_avro_map.argtypes = [
-            ctypes.c_void_p, ctypes.c_int32, i64p, i64p, i64p, i64p, i64p,
-        ]
-        lib.photon_avro_free.argtypes = [ctypes.c_void_p]
+        try:
+            lib = _bind(ctypes.CDLL(so_path))
+        except (OSError, AttributeError):
+            # Stale/incompatible cached .so (wrong arch/ABI, corrupt, or an old
+            # build missing symbols): drop it and rebuild from source once,
+            # degrading to the pure-Python decoder if that fails too.
+            try:
+                os.remove(so_path)
+            except OSError:
+                pass
+            so_path = _build_library()
+            try:
+                lib = _bind(ctypes.CDLL(so_path)) if so_path else None
+            except (OSError, AttributeError):
+                lib = None
+            if lib is None:
+                _lib_error = "native decoder .so failed to load; using Python path"
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(lib):
+    lib.photon_avro_decode.restype = ctypes.c_void_p
+    lib.photon_avro_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+    ]
+    lib.photon_avro_error.restype = ctypes.c_char_p
+    lib.photon_avro_error.argtypes = [ctypes.c_void_p]
+    lib.photon_avro_count.restype = ctypes.c_int64
+    lib.photon_avro_count.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+    lib.photon_avro_doubles.argtypes = [ctypes.c_void_p, ctypes.c_int32, f64p]
+    lib.photon_avro_strings.argtypes = [ctypes.c_void_p, ctypes.c_int32, i64p, i64p]
+    lib.photon_avro_features.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i64p, i64p, i64p, i64p, i64p, f64p,
+    ]
+    lib.photon_avro_map.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, i64p, i64p, i64p, i64p, i64p,
+    ]
+    lib.photon_avro_free.argtypes = [ctypes.c_void_p]
+    return lib
 
 
 def available() -> bool:
@@ -133,7 +154,9 @@ def _is_feature_record(items) -> bool:
         return False
     names = [f.get("name") for f in items.get("fields", ())]
     types = [f.get("type") for f in items.get("fields", ())]
-    return names == ["name", "term", "value"] and types[:2] == ["string", "string"]
+    # value must be exactly "double": the native decoder reads 8 fixed bytes per
+    # value, so a float/nullable value schema must take the pure-Python path.
+    return names == ["name", "term", "value"] and types == ["string", "string", "double"]
 
 
 class DecodedBlock:

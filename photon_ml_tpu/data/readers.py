@@ -353,7 +353,9 @@ def _read_merged_native(path, shard_configs, index_maps, id_tags):
             offs, lens = block.strings(pos["uid"])
             vals = block.strings_at(offs, lens)
             for i, v in enumerate(vals):
-                uids[base + i] = v if v is not None else str(base + i)
+                # `v if v else ...`: empty-string uids fall back to the row
+                # ordinal exactly like the Python path's `rec.get("uid") or str(i)`
+                uids[base + i] = v if v else str(base + i)
         else:
             for i in range(block.count(label_pos)):
                 uids[base + i] = str(base + i)

@@ -81,12 +81,18 @@ def bootstrap_training(
     num_bootstraps: int = 10,
     seed: int = 0,
     metrics: Optional[dict[str, Callable]] = None,
+    use_vmap: Optional[bool] = None,
 ) -> BootstrapReport:
     """Train ``num_bootstraps`` models on multinomial-reweighted resamples.
 
     metrics: {name: fn(scores, labels, weights) -> float} evaluated per model on
     the FULL dataset (the reference evaluates each bootstrap model with its
     metric map and aggregates).
+
+    use_vmap: None (default) auto-selects the vmapped L-BFGS fast path for
+    smooth configs; True forces it (error if the config is non-smooth); False
+    forces the sequential per-resample loop — same resample weights, so the two
+    paths are directly comparable.
     """
     if num_bootstraps < 2:
         raise ValueError("need at least 2 bootstrap resamples")
@@ -103,6 +109,12 @@ def bootstrap_training(
         RegularizationType.NONE,
         RegularizationType.L2,
     )
+    if use_vmap and not smooth:
+        raise ValueError(
+            "use_vmap=True requires a smooth config (LBFGS with NONE/L2 reg)"
+        )
+    if use_vmap is not None:
+        smooth = use_vmap
 
     if smooth:
         obj = problem.objective

@@ -69,18 +69,33 @@ class TestBootstrap:
             assert s.std > 0
 
     def test_vmapped_matches_sequential(self, rng):
-        """The vmapped LBFGS fast path must agree with per-resample solves."""
+        """The vmapped LBFGS fast path must agree with per-resample solves of
+        the SAME problem (identical resample weights via the shared seed)."""
         data, _ = _linear_data(rng, n=200)
         smooth_problem = GLMOptimizationProblem(
             task=TaskType.LINEAR_REGRESSION, configuration=_config(w=1.0)
         )
+        fast = bootstrap_training(
+            smooth_problem, data, num_bootstraps=4, seed=7, use_vmap=True
+        )
+        slow = bootstrap_training(
+            smooth_problem, data, num_bootstraps=4, seed=7, use_vmap=False
+        )
+        np.testing.assert_allclose(fast.coefficients, slow.coefficients, atol=1e-4)
+
+    def test_tron_reaches_same_optimum(self, rng):
+        """TRON and L-BFGS converge to the same strongly-convex optimum."""
+        data, _ = _linear_data(rng, n=200)
+        lbfgs_problem = GLMOptimizationProblem(
+            task=TaskType.LINEAR_REGRESSION, configuration=_config(w=1.0, iters=200)
+        )
         tron_problem = GLMOptimizationProblem(
             task=TaskType.LINEAR_REGRESSION,
-            configuration=_config(opt=OptimizerType.TRON, w=1.0),
+            configuration=_config(opt=OptimizerType.TRON, w=1.0, iters=200),
         )
-        fast = bootstrap_training(smooth_problem, data, num_bootstraps=4, seed=7)
+        fast = bootstrap_training(lbfgs_problem, data, num_bootstraps=4, seed=7)
         slow = bootstrap_training(tron_problem, data, num_bootstraps=4, seed=7)
-        np.testing.assert_allclose(fast.coefficients, slow.coefficients, atol=1e-4)
+        np.testing.assert_allclose(fast.coefficients, slow.coefficients, atol=1e-3)
 
     def test_metric_distributions(self, rng):
         data, _ = _linear_data(rng)

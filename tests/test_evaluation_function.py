@@ -25,12 +25,15 @@ from photon_ml_tpu.optimization.config import (
 from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
 
 
-def _data(rng, n=400, d=6):
+def _data(rng, n=400, d=6, w=None):
+    # Train/val pairs must share the SAME true coefficient vector w — with a
+    # fresh w per split, validation AUC of a model fit on train is arbitrary.
+    if w is None:
+        w = rng.normal(size=d)
     X = rng.normal(size=(n, d))
-    w = rng.normal(size=d)
     p = 1 / (1 + np.exp(-(X @ w)))
     y = (rng.random(n) < p).astype(np.float64)
-    return GameInput(features={"global": X}, labels=y)
+    return GameInput(features={"global": X}, labels=y), w
 
 
 def _estimator(reg_type=RegularizationType.L2, alpha=None):
@@ -84,8 +87,8 @@ def test_elastic_net_two_dims():
 
 
 def test_evaluation_runs_fit_and_negates_max_metric(rng):
-    train = _data(rng)
-    val = _data(rng)
+    train, w = _data(rng)
+    val, _ = _data(rng, w=w)
     est = _estimator()
     fn = GameEstimatorEvaluationFunction(
         est,
@@ -103,8 +106,8 @@ def test_evaluation_runs_fit_and_negates_max_metric(rng):
 
 
 def test_random_search_over_game(rng):
-    train = _data(rng, n=300)
-    val = _data(rng, n=300)
+    train, w = _data(rng, n=300)
+    val, _ = _data(rng, n=300, w=w)
     est = _estimator()
     fn = GameEstimatorEvaluationFunction(
         est,

@@ -216,3 +216,58 @@ class TestReviewRegressions:
         nat2, _, _ = read_merged_avro(path2, SHARDS)
         py2, _, _ = read_merged_avro(path2, SHARDS, use_native=False)
         assert nat2.has_labels == py2.has_labels == False  # noqa: E712
+
+    def test_empty_uid_parity(self, tmp_path):
+        """Empty-string uids fall back to the row ordinal on BOTH paths (the
+        Python path's `rec.get('uid') or str(i)` treats '' as missing)."""
+        path = str(tmp_path / "uid.avro")
+        avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, [
+            {"uid": "", "label": 1.0, "features": [], "metadataMap": {},
+             "weight": 1.0, "offset": 0.0},
+            {"uid": "real", "label": 0.0, "features": [], "metadataMap": {},
+             "weight": 1.0, "offset": 0.0},
+        ])
+        _, _, nat_uids = read_merged_avro(path, SHARDS)
+        _, _, py_uids = read_merged_avro(path, SHARDS, use_native=False)
+        assert list(nat_uids) == list(py_uids) == ["0", "real"]
+
+    def test_comma_separated_multi_path(self, tmp_path, rng):
+        """--input-data-directories is comma-separated (reference
+        inputDataDirectories contract); part files concatenate across paths."""
+        d1, d2 = tmp_path / "day1", tmp_path / "day2"
+        d1.mkdir(), d2.mkdir()
+        write_fixture(str(d1 / "part-0.avro"), rng, n=30, with_nulls=False)
+        write_fixture(str(d2 / "part-0.avro"), rng, n=20, with_nulls=False)
+        joined = f"{d1},{d2}"
+        nat, _, _ = read_merged_avro(joined, SHARDS)
+        py, _, _ = read_merged_avro(joined, SHARDS, use_native=False)
+        assert nat.n == py.n == 50
+        np.testing.assert_allclose(
+            nat.features["shardA"].toarray(), py.features["shardA"].toarray()
+        )
+        as_list, _, _ = read_merged_avro([str(d1), str(d2)], SHARDS)
+        assert as_list.n == 50
+
+    def test_corrupt_cached_so_rebuilds(self, tmp_path, monkeypatch):
+        """A corrupt/incompatible cached .so must not crash the default
+        use_native path: _load drops it and rebuilds from source."""
+        import shutil
+
+        cache = tmp_path / "build"
+        cache.mkdir()
+        bad = cache / "libphoton_avro.so"
+        bad.write_bytes(b"not an elf file")
+        src = native_avro._SOURCE
+        monkeypatch.setattr(native_avro, "_CACHE_DIR", str(cache))
+        monkeypatch.setattr(native_avro, "_lib", None)
+        monkeypatch.setattr(native_avro, "_lib_error", None)
+        # make the bad artifact look fresher than the source (committed files
+        # lose their mtimes on checkout)
+        import os as _os
+        st = _os.stat(src)
+        _os.utime(bad, (st.st_atime + 10, st.st_mtime + 10))
+        try:
+            assert native_avro.available()
+        finally:
+            monkeypatch.undo()
+            shutil.rmtree(cache, ignore_errors=True)
