@@ -89,9 +89,19 @@ class FixedEffectCoordinate(Coordinate):
     normalization: NormalizationContext = NO_NORMALIZATION
     variance_computation: VarianceComputationType = VarianceComputationType.NONE
     down_sampler: Optional[DownSampler] = None
+    # (lower[D], upper[D]) per-feature box bounds (constraint maps); enforced
+    # natively by the optimizers (LBFGS projection / LBFGSB / TRON)
+    box_constraints: Optional[tuple] = None
 
     def __post_init__(self):
         self.task = TaskType(self.task)
+        if self.box_constraints is not None and not self.normalization.is_identity:
+            # the reference rejects this combination outright (Params.scala:211-214):
+            # bounds are specified in original feature space, solves run in
+            # normalized space, and the clamp cannot be guaranteed in both
+            raise ValueError(
+                "Box constraints and normalization cannot be combined"
+            )
         self._problem = GLMOptimizationProblem(
             task=self.task,
             configuration=self.configuration,
@@ -113,8 +123,14 @@ class FixedEffectCoordinate(Coordinate):
         data = self.dataset.data.add_scores_to_offsets(partial_scores)
         if self.down_sampler is not None:
             data = self.down_sampler.down_sample(data)
+        lower = upper = None
+        if self.box_constraints is not None:
+            lower, upper = self.box_constraints
         glm, result = self._problem.run(
-            data, initial_model.model if initial_model is not None else None
+            data,
+            initial_model.model if initial_model is not None else None,
+            lower_bounds=lower,
+            upper_bounds=upper,
         )
         tracker = FixedEffectOptimizationTracker(
             convergence_reason=result.reason_name(),
@@ -150,12 +166,19 @@ class RandomEffectCoordinate(Coordinate):
     def initialize_model(self) -> RandomEffectModel:
         E, K = self.dataset.n_entities, self.dataset.max_k
         dtype = self.dataset.sample_vals.dtype
+        rows = getattr(self.dataset, "coeffs_rows", None) or E
+        coeffs = jnp.zeros((rows, K), dtype=dtype)
+        sharding = getattr(self.dataset, "coeffs_sharding", None)
+        if sharding is not None:
+            import jax
+
+            coeffs = jax.device_put(coeffs, sharding)
         return RandomEffectModel(
             re_type=self.dataset.re_type,
             feature_shard_id=self.dataset.feature_shard_id,
             task=self.task,
             entity_ids=self.dataset.entity_ids,
-            coeffs=jnp.zeros((E, K), dtype=dtype),
+            coeffs=coeffs,
             proj_indices=self.dataset.proj_indices,
             projector=self.dataset.projector,
         )

@@ -27,15 +27,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.data.dataset import LabeledData
-from photon_ml_tpu.data.matrix import DenseDesignMatrix
 from photon_ml_tpu.data.random_effect import EntityBucket, RandomEffectDataset
 from photon_ml_tpu.function.losses import loss_for_task
-from photon_ml_tpu.function.objective import GLMObjective
 from photon_ml_tpu.models.game import RandomEffectModel
 from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
-from photon_ml_tpu.optimization.factory import build_minimizer
+from photon_ml_tpu.optimization.solver_cache import re_bucket_solver
 from photon_ml_tpu.types import (
     ConvergenceReason,
     OptimizerType,
@@ -158,8 +155,6 @@ def train_random_effect(
     opt_type = OptimizerType(configuration.optimizer_config.optimizer_type)
     if opt_type == OptimizerType.TRON and not loss.has_hessian:
         raise ValueError("TRON requires a twice-differentiable loss")
-    objective = GLMObjective(loss)  # normalization folded into the blocks already
-    minimize = build_minimizer(configuration.optimizer_config)
     l2 = configuration.l2_weight
     l1 = configuration.l1_weight
     variance_computation = VarianceComputationType(variance_computation)
@@ -167,16 +162,31 @@ def train_random_effect(
     E, K_all = dataset.n_entities, dataset.max_k
     if dtype is None:
         dtype = dataset.sample_vals.dtype
-    coeffs_global = jnp.zeros((E, K_all), dtype=dtype)
+    coeffs_sharding = getattr(dataset, "coeffs_sharding", None)
+    # mesh backend: the per-entity coefficient table lives entity-sharded (the
+    # reference never collects RandomEffectModel either, RandomEffectModel.scala:
+    # 36-304); its height is padded to the mesh multiple with always-zero rows
+    table_rows = getattr(dataset, "coeffs_rows", None) or E
+
+    def _place(table):
+        if table.shape[0] < table_rows:
+            table = jnp.concatenate(
+                [table, jnp.zeros((table_rows - table.shape[0], K_all), dtype=table.dtype)]
+            )
+        if coeffs_sharding is not None:
+            table = jax.device_put(table, coeffs_sharding)
+        return table
+
+    coeffs_global = _place(jnp.zeros((E, K_all), dtype=dtype))
 
     # Warm start: re-layout the initial model into this dataset's entity-row and
     # slot order (aligned_to is a no-op when layouts already match — the common
     # case inside coordinate descent).
     if initial_model is not None:
-        coeffs_global = initial_model.aligned_to(dataset).coeffs.astype(dtype)
+        coeffs_global = _place(initial_model.aligned_to(dataset).coeffs.astype(dtype))
 
     variances_global = (
-        jnp.zeros((E, K_all), dtype=dtype)
+        _place(jnp.zeros((E, K_all), dtype=dtype))
         if variance_computation != VarianceComputationType.NONE
         else None
     )
@@ -195,36 +205,17 @@ def train_random_effect(
         if normalization is not None and not normalization.is_identity:
             init_b = _to_transformed(init_b, factors, shifts, icpt_mask)
 
-        def solve_one(Xe, ye, we, oe, w0):
-            data = LabeledData(X=DenseDesignMatrix(Xe), labels=ye, offsets=oe, weights=we)
-
-            def vg(w):
-                return objective.value_and_gradient(data, w, l2)
-
-            kwargs = {}
-            if opt_type == OptimizerType.TRON:
-                kwargs["hvp"] = lambda w, v: objective.hessian_vector(data, w, v, l2)
-            if l1:
-                kwargs["l1_weight"] = l1
-            res = minimize(vg, w0, **kwargs)
-            if variance_computation == VarianceComputationType.SIMPLE:
-                diag = objective.hessian_diagonal(data, res.coefficients, l2)
-                var = 1.0 / jnp.where(diag == 0.0, jnp.inf, diag)
-            elif variance_computation == VarianceComputationType.FULL:
-                H = objective.hessian_matrix(data, res.coefficients, l2)
-                # guard padding slots: unit diagonal keeps the Cholesky well-posed
-                H = H + jnp.diag((jnp.diag(H) == 0.0).astype(H.dtype))
-                L = jnp.linalg.cholesky(H)
-                eye = jnp.eye(K, dtype=H.dtype)
-                Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
-                var = jnp.diag(Linv.T @ Linv)
-            else:
-                var = jnp.zeros((0,), dtype=dtype)
-            return res.coefficients, res.convergence_reason, res.iterations, var
-
-        solve = jax.jit(jax.vmap(solve_one))
+        solve = re_bucket_solver(
+            task, configuration.optimizer_config, bool(l1), variance_computation
+        )
         w_b, reasons_b, iters_b, var_b = solve(
-            bucket.X, bucket.labels, bucket.weights, off_b, init_b
+            bucket.X,
+            bucket.labels,
+            bucket.weights,
+            off_b,
+            init_b,
+            jnp.asarray(l2, dtype=dtype),
+            jnp.asarray(l1 or 0.0, dtype=dtype),
         )
 
         if normalization is not None and not normalization.is_identity:
@@ -235,11 +226,26 @@ def train_random_effect(
                 # tracked, matching the reference's diagonal variance output).
                 var_b = var_b * factors**2
 
+        # mesh-placed buckets pad the entity axis with rows == E: their scatters
+        # are dropped by XLA's out-of-bounds-update semantics and they are
+        # excluded from the tracker below
         coeffs_global = coeffs_global.at[bucket.entity_rows, :K].set(w_b)
         if variances_global is not None:
             variances_global = variances_global.at[bucket.entity_rows, :K].set(var_b)
-        reasons_parts.append(np.asarray(reasons_b))
-        iters_parts.append(np.asarray(iters_b))
+        real = np.asarray(bucket.entity_rows) < E
+        reasons_parts.append(np.asarray(reasons_b)[real])
+        iters_parts.append(np.asarray(iters_b)[real])
+
+    if table_rows > E:
+        # bucket padding targets row E, which is in-bounds when the table height
+        # is padded — keep every padding row identically zero
+        coeffs_global = coeffs_global.at[E:].set(0.0)
+        if variances_global is not None:
+            variances_global = variances_global.at[E:].set(0.0)
+    if coeffs_sharding is not None:
+        coeffs_global = jax.device_put(coeffs_global, coeffs_sharding)
+        if variances_global is not None:
+            variances_global = jax.device_put(variances_global, coeffs_sharding)
 
     tracker = RandomEffectTracker.from_arrays(
         np.concatenate(reasons_parts) if reasons_parts else np.zeros(0, np.int32),

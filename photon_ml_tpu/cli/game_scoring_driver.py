@@ -26,6 +26,7 @@ from photon_ml_tpu.io.model_io import load_game_model
 from photon_ml_tpu.models.game import RandomEffectModel
 from photon_ml_tpu.transformers.game_transformer import GameTransformer
 from photon_ml_tpu.util import PhotonLogger, Timed
+from photon_ml_tpu.util.date_range import resolve_input_paths
 
 SCORES_DIR = "scores"
 
@@ -35,6 +36,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="game-scoring-driver", description="Score data with a saved GAME model."
     )
     p.add_argument("--input-data-directories", required=True)
+    p.add_argument("--input-data-date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd inclusive; expands each input dir to "
+                        "its <dir>/yyyy/MM/dd day partitions")
+    p.add_argument("--input-data-days-range", default=None,
+                   help="START-END in days ago (START >= END), e.g. 90-1")
     p.add_argument("--model-input-directory", required=True)
     p.add_argument("--root-output-directory", required=True)
     p.add_argument("--override-output-directory", action="store_true")
@@ -102,9 +108,14 @@ def run(args: argparse.Namespace) -> dict:
             {m.re_type for _, m in model if isinstance(m, RandomEffectModel)}
         )
 
+        input_paths = resolve_input_paths(
+            args.input_data_directories,
+            getattr(args, "input_data_date_range", None),
+            getattr(args, "input_data_days_range", None),
+        )
         with Timed("read data", logger):
             data, index_maps, uids = read_merged_avro(
-                args.input_data_directories, shard_configs, index_maps, id_tags
+                input_paths, shard_configs, index_maps, id_tags
             )
         logger.info("scoring %d samples", data.n)
 

@@ -49,6 +49,7 @@ from photon_ml_tpu.types import (
     VarianceComputationType,
 )
 from photon_ml_tpu.util import Event, EventEmitter, PhotonLogger, Timed
+from photon_ml_tpu.util.date_range import resolve_input_paths
 
 BEST_DIR = "best"
 MODELS_DIR = "models"
@@ -65,6 +66,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--input-data-directories", required=True,
                    help="Comma-separated training data paths (Avro files/dirs)")
     p.add_argument("--validation-data-directories", default=None)
+    p.add_argument("--input-data-date-range", default=None,
+                   help="yyyyMMdd-yyyyMMdd inclusive; expands each input dir to "
+                        "its <dir>/yyyy/MM/dd day partitions")
+    p.add_argument("--input-data-days-range", default=None,
+                   help="START-END in days ago (START >= END), e.g. 90-1")
+    p.add_argument("--validation-data-date-range", default=None)
+    p.add_argument("--validation-data-days-range", default=None)
     p.add_argument("--off-heap-index-map-directory", default=None,
                    help="Directory of per-shard saved index maps (<shard>.npz)")
     p.add_argument("--model-input-directory", default=None,
@@ -100,6 +108,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    choices=[v.value for v in VarianceComputationType])
     p.add_argument("--model-sparsity-threshold", type=float, default=0.0)
     p.add_argument("--ignore-threshold-for-new-models", action="store_true")
+    p.add_argument("--coefficient-box-constraints", default=None,
+                   help='JSON array of {"name","term","lowerBound","upperBound"} '
+                        "maps; wildcard '*' in term (or name+term) supported. "
+                        "Applies to fixed-effect coordinates.")
+    p.add_argument("--compute-backend", default="host", choices=["host", "mesh"],
+                   help="'mesh' places datasets/models over a jax.sharding.Mesh "
+                        "so the coordinate-descent pass runs as sharded SPMD "
+                        "programs (the reference's distributed path)")
+    p.add_argument("--mesh-devices", type=int, default=None,
+                   help="Device count for --compute-backend=mesh (default: all)")
     # Spark-isms accepted for 1:1 invocation compatibility (no-ops here)
     p.add_argument("--min-validation-partitions", type=int, default=None,
                    help=argparse.SUPPRESS)
@@ -226,18 +244,31 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
 
         index_maps = _load_index_maps(args.off_heap_index_map_directory, shard_configs)
 
+        # date-partitioned inputs (GameDriver inputDataDateRange/DaysRange params;
+        # IOUtils.getInputPathsWithinDateRange path expansion)
+        train_paths = resolve_input_paths(
+            args.input_data_directories,
+            getattr(args, "input_data_date_range", None),
+            getattr(args, "input_data_days_range", None),
+        )
+
         with Timed("read training data", logger):
             train_input, index_maps, _uids = read_merged_avro(
-                args.input_data_directories, shard_configs, index_maps, id_tags
+                train_paths, shard_configs, index_maps, id_tags
             )
         logger.info("training data: %d samples, shards %s",
                     train_input.n, {s: m.shape[1] for s, m in train_input.features.items()})
 
         validation_input = None
         if args.validation_data_directories:
+            validation_paths = resolve_input_paths(
+                args.validation_data_directories,
+                getattr(args, "validation_data_date_range", None),
+                getattr(args, "validation_data_days_range", None),
+            )
             with Timed("read validation data", logger):
                 validation_input, _, _ = read_merged_avro(
-                    args.validation_data_directories, shard_configs, index_maps, id_tags
+                    validation_paths, shard_configs, index_maps, id_tags
                 )
 
         with Timed("data validation", logger):
@@ -269,6 +300,29 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             if norm_type == NormalizationType.NONE:
                 normalization_contexts = None
 
+        # -- per-feature box constraints (COEFFICIENT_BOX_CONSTRAINTS param;
+        # GLMSuite.createConstraintFeatureMap -> optimizer-native bounds) -------
+        if args.coefficient_box_constraints:
+            import dataclasses as _dc
+
+            from photon_ml_tpu.estimators.config import FixedEffectDataConfiguration
+            from photon_ml_tpu.optimization.constraints import build_bound_vectors
+
+            coord_configs = {
+                cid: (
+                    _dc.replace(
+                        cfg,
+                        box_constraints=build_bound_vectors(
+                            args.coefficient_box_constraints,
+                            index_maps[cfg.data_config.feature_shard_id],
+                        ),
+                    )
+                    if isinstance(cfg.data_config, FixedEffectDataConfiguration)
+                    else cfg
+                )
+                for cid, cfg in coord_configs.items()
+            }
+
         # -- warm start / partial retrain (GameTrainingDriver.scala:370-409) ----
         initial_model = None
         index_maps_by_coord = {
@@ -290,6 +344,12 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             else []
         )
 
+        mesh = None
+        if getattr(args, "compute_backend", "host") == "mesh":
+            from photon_ml_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(args.mesh_devices)
+
         estimator = GameEstimator(
             task=task,
             coordinate_configurations=coord_configs,
@@ -298,6 +358,7 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             variance_computation=VarianceComputationType(args.variance_computation_type),
             validation_evaluators=evaluator_specs,
             partial_retrain_locked_coordinates=locked,
+            mesh=mesh,
         )
 
         emitter.send_event(Event("TrainingStartEvent"))

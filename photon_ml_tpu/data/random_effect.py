@@ -103,6 +103,12 @@ class RandomEffectDataset:
     # RandomProjector when the dataset lives in a shared projected space
     # (projector/ProjectionMatrixBroadcast semantics); None for index-map/identity
     projector: Optional[object] = None
+    # set by parallel.placement: NamedSharding for the coefficient tables
+    # (entity axis sharded over the mesh) and their padded row count (next
+    # multiple of the mesh size >= n_entities; device_put requires divisibility).
+    # None on the host backend. Rows >= n_entities are always-zero padding.
+    coeffs_sharding: Optional[object] = None
+    coeffs_rows: Optional[int] = None
 
     @property
     def n_entities(self) -> int:

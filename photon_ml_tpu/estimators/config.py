@@ -62,6 +62,9 @@ class CoordinateConfiguration:
     optimization_config: GLMOptimizationConfiguration
     reg_weights: Sequence[float] = ()
     down_sampling_rate: float = 1.0  # fixed-effect only
+    # per-feature (lower[D], upper[D]) box bounds over the coordinate's shard
+    # (constraint maps, GLMSuite.scala:190-260); fixed-effect only
+    box_constraints: Optional[tuple] = None
 
     @property
     def is_random_effect(self) -> bool:

@@ -107,6 +107,13 @@ class GameEstimator:
     partial_retrain_locked_coordinates: Sequence[str] = ()
     down_sampling_seed: int = 0
     dtype: object = jnp.float32
+    # SPMD backend: a jax.sharding.Mesh places every dataset/score/model array
+    # over the device mesh and the SAME coordinate-descent implementation runs
+    # as sharded XLA programs (psum gradient reductions, entity-sharded
+    # random-effect solves and coefficient tables). None = single-device host
+    # placement. Matches GameEstimator.fit:299-380 driving the distributed
+    # coordinates in the reference — here distribution is array placement.
+    mesh: Optional[object] = None
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -252,6 +259,7 @@ class GameEstimator:
                 normalization=self._normalization_for(dc.feature_shard_id),
                 variance_computation=self.variance_computation,
                 down_sampler=sampler,
+                box_constraints=cfg.box_constraints,
             )
         norm = self._normalization_for(dc.feature_shard_id)
         return RandomEffectCoordinate(
@@ -280,11 +288,25 @@ class GameEstimator:
 
         datasets = self.prepare_training_datasets(data)
         base_offsets = jnp.asarray(np.asarray(data.offsets), dtype=self.dtype)
+        if self.mesh is not None:
+            from photon_ml_tpu.parallel.placement import (
+                pad_and_shard_vector,
+                place_game_datasets,
+            )
+
+            datasets = place_game_datasets(datasets, self.mesh)
+            base_offsets = pad_and_shard_vector(
+                np.asarray(data.offsets), self.mesh, dtype=self.dtype
+            )
 
         validation_datasets = None
         suite = None
         if validation_data is not None:
             validation_datasets = self.prepare_scoring_datasets(validation_data)
+            if self.mesh is not None:
+                from photon_ml_tpu.parallel.placement import place_game_datasets
+
+                validation_datasets = place_game_datasets(validation_datasets, self.mesh)
             suite = self.prepare_evaluation_suite(validation_data)
 
         sweep = expand_game_configurations(self.coordinate_configurations)

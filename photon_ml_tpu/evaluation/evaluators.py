@@ -239,8 +239,11 @@ class EvaluationSuite:
 
     def evaluate(self, raw_scores) -> dict[str, float]:
         """raw_scores are coordinate-score sums; offsets are added before metrics
-        (reference: scores + offsets, EvaluationSuite.evaluate:56-81)."""
-        total = np.asarray(raw_scores) + self.offsets
+        (reference: scores + offsets, EvaluationSuite.evaluate:56-81).
+
+        Scores longer than the label array are sliced: mesh placement pads the
+        sample axis to the device count and padded rows are metric-inert."""
+        total = np.asarray(raw_scores)[: len(self.labels)] + self.offsets
         results: dict[str, float] = {}
         for ev in self.evaluators:
             if isinstance(ev, MultiEvaluator):

@@ -27,6 +27,11 @@ from photon_ml_tpu.hyperparameter.serialization import (
     prior_from_json,
 )
 from photon_ml_tpu.hyperparameter.tuner import AtlasTuner, DummyTuner, build_tuner
+from photon_ml_tpu.hyperparameter.shrink_search_range import (
+    CONFIG_DEFAULT,
+    PRIOR_DEFAULT,
+    get_bounds,
+)
 
 __all__ = [
     "RBF",
@@ -49,4 +54,7 @@ __all__ = [
     "AtlasTuner",
     "DummyTuner",
     "build_tuner",
+    "CONFIG_DEFAULT",
+    "PRIOR_DEFAULT",
+    "get_bounds",
 ]

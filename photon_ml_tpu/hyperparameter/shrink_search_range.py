@@ -1,0 +1,105 @@
+"""Shrink the hyperparameter search range around the prior optimum.
+
+Parity target: photon-client hyperparameter/ShrinkSearchRange.scala:28-147 —
+fit a Matern52 GP to prior (hyperparameter, evaluation) observations rescaled
+to [0,1]^d, draw a Sobol candidate pool, pick the candidate with the best
+predicted value, and return ``best ± radius`` mapped back to the original
+ranges (discrete dimensions snapped to their grid, bounds clamped to the
+declared ranges). Used to warm-shrink tuning ranges across retraining runs.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+from scipy.stats import qmc
+
+from photon_ml_tpu.hyperparameter.estimators import GaussianProcessEstimator
+from photon_ml_tpu.hyperparameter.kernels import Matern52
+from photon_ml_tpu.hyperparameter.rescaling import (
+    scale_backward,
+    scale_forward,
+    transform_forward,
+)
+from photon_ml_tpu.hyperparameter.serialization import (
+    HyperparameterConfig,
+    prior_from_json,
+)
+
+# GAME hyperparameter defaults (GameHyperparameterDefaults.scala:20-51)
+PRIOR_DEFAULT: Mapping[str, str] = {
+    "global_regularizer": "0.0",
+    "member_regularizer": "0.0",
+    "item_regularizer": "0.0",
+}
+
+CONFIG_DEFAULT: str = """
+{ "tuning_mode" : "BAYESIAN",
+  "variables" : {
+    "global_regularizer" : { "type" : "FLOAT", "transform" : "LOG",
+                             "min" : -3, "max" : 3 },
+    "member_regularizer" : { "type" : "FLOAT", "transform" : "LOG",
+                             "min" : -3, "max" : 3 },
+    "item_regularizer" : { "type" : "FLOAT", "transform" : "LOG",
+                           "min" : -3, "max" : 3 }
+  }
+}
+"""
+
+
+def _discretize(candidate: np.ndarray, discrete_params: Mapping[int, int]) -> np.ndarray:
+    """Snap [0,1] coordinates of discrete dims onto their value grid
+    (ShrinkSearchRange.discretizeCandidate:131-145)."""
+    out = np.array(candidate, dtype=np.float64)
+    for index, num_values in discrete_params.items():
+        out[index] = np.floor(out[index] * num_values) / num_values
+    return out
+
+
+def get_bounds(
+    hyper_params: HyperparameterConfig,
+    prior_json: str,
+    prior_default: Mapping[str, str],
+    radius: float,
+    candidate_pool_size: int = 1000,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(lower, upper) bounds of the shrunk range, one entry per hyperparameter
+    (ShrinkSearchRange.getBounds:40-103)."""
+    names = hyper_params.names
+    ranges = hyper_params.ranges
+    discrete = hyper_params.discrete_params
+    n_params = len(ranges)
+
+    priors = prior_from_json(prior_json, prior_default, names)
+    if not priors:
+        raise ValueError("Cannot shrink a search range from zero prior observations")
+
+    points = np.stack([
+        scale_forward(
+            transform_forward(p, hyper_params.transform_map), ranges, set(discrete)
+        )
+        for p, _ in priors
+    ])
+    evals = np.array([v for _, v in priors], dtype=np.float64)
+
+    model = GaussianProcessEstimator(kernel=Matern52()).fit(points, evals)
+
+    sobol = qmc.Sobol(d=n_params, scramble=False, seed=seed)
+    # skipTo(seed % 2^31) analog: a deterministic offset makes runs reproducible
+    sobol.fast_forward(int(seed) % 1024 + 1)
+    candidates = sobol.random(candidate_pool_size)
+
+    means, _ = model.predict(candidates)
+    best = candidates[int(np.argmax(means))]
+
+    upper = scale_backward(
+        _discretize(best + radius, discrete), ranges, set(discrete)
+    )
+    lower = scale_backward(
+        _discretize(best - radius, discrete), ranges, set(discrete)
+    )
+    starts = np.array([r[0] for r in ranges])
+    ends = np.array([r[1] for r in ranges])
+    return np.maximum(lower, starts), np.minimum(upper, ends)

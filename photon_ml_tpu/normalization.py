@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -105,6 +106,7 @@ class FeatureDataStatistics:
         )
 
 
+@jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class NormalizationContext:
     """Affine transform x' = (x - shift) * factor; None means identity on that part.
@@ -114,11 +116,18 @@ class NormalizationContext:
       original <- transformed:  w = w' .* factor;  b -= w_dot_shift
       transformed <- original:  b += w^T shift;    w' = w ./ factor
     If shifts are present an intercept index is required, with shift 0 / factor 1 there.
+
+    Registered as a pytree (factors/shifts are leaves) so it can be passed as a
+    TRACED argument into cached jitted solvers: one compiled program serves every
+    normalization of the same structure, mirroring how the traced l2_weight lets
+    regularization sweeps share a program.
     """
 
-    factors: Optional[np.ndarray] = None
-    shifts: Optional[np.ndarray] = None
-    intercept_index: Optional[int] = None
+    factors: Optional[np.ndarray] = dataclasses.field(default=None)
+    shifts: Optional[np.ndarray] = dataclasses.field(default=None)
+    intercept_index: Optional[int] = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
 
     def __post_init__(self):
         if self.shifts is not None and self.intercept_index is None:

@@ -23,7 +23,6 @@ from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_ml_tpu.normalization import NO_NORMALIZATION, NormalizationContext
 from photon_ml_tpu.optimization.common import OptResult
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
-from photon_ml_tpu.optimization.factory import build_minimizer
 from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
 
 Array = jnp.ndarray
@@ -70,48 +69,62 @@ class GLMOptimizationProblem:
         lower_bounds: Optional[Array] = None,
         upper_bounds: Optional[Array] = None,
     ) -> tuple[GeneralizedLinearModel, OptResult]:
-        """Train on one LabeledData batch (jit-compiled end to end)."""
+        """Train on one LabeledData batch.
+
+        The solve runs through the module-level solver cache
+        (optimization/solver_cache.py): one compiled program per static
+        configuration, with data, start point, reg weights, bounds and
+        normalization all traced — so coordinate-descent iterations, warm-started
+        sweeps and repeated fits share XLA programs.
+        """
+        from photon_ml_tpu.optimization.solver_cache import glm_solver
+
         cfg = self.configuration
-        obj = self.objective
-        l2 = cfg.l2_weight
+        dtype = data.X.dtype
         x0 = (
             initial_model.coefficients.means
             if initial_model is not None
-            else jnp.zeros((data.dim,), dtype=data.X.dtype)
+            else jnp.zeros((data.dim,), dtype=dtype)
         )
-        minimize = build_minimizer(cfg.optimizer_config)
-
-        def vg(w):
-            return obj.value_and_gradient(data, w, l2)
-
-        kwargs = {}
-        if OptimizerType(cfg.optimizer_config.optimizer_type) == OptimizerType.TRON:
-            kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
-        if cfg.l1_weight:
-            kwargs["l1_weight"] = cfg.l1_weight
-        if lower_bounds is not None:
-            kwargs["lower_bounds"] = lower_bounds
-        if upper_bounds is not None:
-            kwargs["upper_bounds"] = upper_bounds
-
-        result = minimize(vg, x0, **kwargs)
-        variances = self.compute_variances(data, result.coefficients)
+        empty = jnp.zeros((0,), dtype=dtype)
+        solve = glm_solver(
+            self.task,
+            cfg.optimizer_config,
+            bool(cfg.l1_weight),
+            lower_bounds is not None,
+            upper_bounds is not None,
+            self.variance_computation,
+        )
+        result, variances = solve(
+            data,
+            x0,
+            jnp.asarray(cfg.l2_weight, dtype=dtype),
+            jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
+            empty if lower_bounds is None else jnp.asarray(lower_bounds, dtype=dtype),
+            empty if upper_bounds is None else jnp.asarray(upper_bounds, dtype=dtype),
+            self.normalization,
+        )
+        if self.variance_computation == VarianceComputationType.NONE:
+            variances = None
         model = self.create_model(Coefficients(result.coefficients, variances))
         return model, result
 
     def compute_variances(self, data: LabeledData, coef: Array) -> Optional[Array]:
         """SIMPLE: 1/diag(H); FULL: diag(H^-1) via Cholesky
-        (DistributedOptimizationProblem.computeVariances:84-108)."""
-        vtype = self.variance_computation
-        obj = self.objective
-        l2 = self.configuration.l2_weight
-        if vtype == VarianceComputationType.SIMPLE:
-            diag = obj.hessian_diagonal(data, coef, l2)
-            return 1.0 / jnp.where(diag == 0.0, jnp.inf, diag)
-        if vtype == VarianceComputationType.FULL:
-            H = obj.hessian_matrix(data, coef, l2)
-            return jnp.diag(cholesky_inverse(H))
-        return None
+        (DistributedOptimizationProblem.computeVariances:84-108). Delegates to
+        the single shared implementation in solver_cache."""
+        from photon_ml_tpu.optimization.solver_cache import compute_variances
+
+        if self.variance_computation == VarianceComputationType.NONE:
+            return None
+        return compute_variances(
+            self.objective,
+            data,
+            coef,
+            self.configuration.l2_weight,
+            self.variance_computation,
+            jnp.asarray(coef).dtype,
+        )
 
 
 def cholesky_inverse(H: Array) -> Array:

@@ -1,0 +1,177 @@
+"""Cross-call jit cache for GLM solves.
+
+The reference re-uses one physical plan per optimization problem and mutates the
+regularization weight across sweep configurations
+(DistributedOptimizationProblem.updateRegularizationWeight:64-75). The XLA
+analog: compile ONE program per *static* solver configuration — (task,
+OptimizerConfig, which optional terms exist, variance type) — and pass
+everything that varies across coordinate-descent iterations, sweep
+configurations and tests as traced arguments (data, x0, l2/l1 weights, bounds,
+normalization vectors). Without this cache every `minimize` call re-traces its
+`lax.while_loop` from a fresh closure, which dominated both training wall-clock
+and the test suite.
+
+Solvers are cached at module level with `functools.lru_cache`; jax.jit then
+adds its own per-input-shape cache underneath, so the combined key is
+(static config) x (array shapes/dtypes/shardings) — exactly the reuse surface.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.function.losses import loss_for_task
+from photon_ml_tpu.function.objective import GLMObjective
+from photon_ml_tpu.optimization.common import OptimizerConfig, OptResult
+from photon_ml_tpu.optimization.factory import build_minimizer
+from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
+
+Array = jnp.ndarray
+
+
+def compute_variances(obj: GLMObjective, data, coef, l2, variance, dtype):
+    """SIMPLE: 1/diag(H); FULL: diag(H^-1) via Cholesky
+    (DistributedOptimizationProblem.computeVariances:84-108). The single shared
+    implementation behind glm_solver, re_bucket_solver and
+    GLMOptimizationProblem.compute_variances. The unit-diagonal guard keeps the
+    Cholesky well-posed for all-zero padding slots (vmapped entity buckets)."""
+    variance = VarianceComputationType(variance)
+    if variance == VarianceComputationType.SIMPLE:
+        diag = obj.hessian_diagonal(data, coef, l2)
+        return 1.0 / jnp.where(diag == 0.0, jnp.inf, diag)
+    if variance == VarianceComputationType.FULL:
+        H = obj.hessian_matrix(data, coef, l2)
+        H = H + jnp.diag((jnp.diag(H) == 0.0).astype(H.dtype))
+        L = jnp.linalg.cholesky(H)
+        eye = jnp.eye(H.shape[0], dtype=H.dtype)
+        Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+        return jnp.diag(Linv.T @ Linv)
+    return jnp.zeros((0,), dtype=dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def glm_solver(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    has_lower: bool,
+    has_upper: bool,
+    variance: VarianceComputationType,
+):
+    """Jitted ``solve(data, x0, l2, l1, lower, upper, norm) -> (OptResult, variances)``.
+
+    Absent optional terms (decided by the static flags) still occupy an argument
+    slot with a dummy zeros array — jit signatures are fixed; dead arguments are
+    eliminated by XLA.
+    """
+    task = TaskType(task)
+    loss = loss_for_task(task)
+    minimize = build_minimizer(opt_config)
+    use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    variance = VarianceComputationType(variance)
+
+    def solve(data, x0, l2, l1, lower, upper, norm):
+        obj = GLMObjective(loss, norm)
+
+        def vg(w):
+            return obj.value_and_gradient(data, w, l2)
+
+        kwargs = {}
+        if use_hvp:
+            kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
+        if has_l1:
+            kwargs["l1_weight"] = l1
+        if has_lower:
+            kwargs["lower_bounds"] = lower
+        if has_upper:
+            kwargs["upper_bounds"] = upper
+        result = minimize(vg, x0, **kwargs)
+        variances = compute_variances(
+            obj, data, result.coefficients, l2, variance, x0.dtype
+        )
+        return result, variances
+
+    return jax.jit(solve)
+
+
+@functools.lru_cache(maxsize=None)
+def re_bucket_solver(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    variance: VarianceComputationType,
+):
+    """Jitted vmapped per-entity bucket solve:
+    ``solve(X, y, w, offsets, w0, l2, l1) -> (coefs, reasons, iters, variances)``
+    with X [E, S, K] and l2/l1 broadcast — the executor-local random-effect hot
+    loop of RandomEffectCoordinate.scala:109-127 as one XLA program per bucket
+    shape class."""
+    task = TaskType(task)
+    loss = loss_for_task(task)
+    minimize = build_minimizer(opt_config)
+    use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    variance = VarianceComputationType(variance)
+
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+
+    def solve_one(Xe, ye, we, oe, w0, l2, l1):
+        data = LabeledData(X=DenseDesignMatrix(Xe), labels=ye, offsets=oe, weights=we)
+        obj = GLMObjective(loss)
+
+        def vg(w):
+            return obj.value_and_gradient(data, w, l2)
+
+        kwargs = {}
+        if use_hvp:
+            kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
+        if has_l1:
+            kwargs["l1_weight"] = l1
+        res = minimize(vg, w0, **kwargs)
+        var = compute_variances(obj, data, res.coefficients, l2, variance, w0.dtype)
+        return res.coefficients, res.convergence_reason, res.iterations, var
+
+    return jax.jit(jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, None, None)))
+
+
+@functools.lru_cache(maxsize=None)
+def sharded_glm_solver(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    mesh,
+):
+    """glm_solver variant with replicated output shardings over ``mesh``
+    (coefficients replicated, gradient reductions psum'd by XLA — the
+    treeAggregate analog of ValueAndGradientAggregator.scala:240-255)."""
+    from photon_ml_tpu.parallel.mesh import replicated_sharding
+
+    task = TaskType(task)
+    loss = loss_for_task(task)
+    minimize = build_minimizer(opt_config)
+    use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+
+    def solve(data, x0, l2, l1):
+        obj = GLMObjective(loss)
+
+        def vg(w):
+            return obj.value_and_gradient(data, w, l2)
+
+        kwargs = {}
+        if use_hvp:
+            kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
+        if has_l1:
+            kwargs["l1_weight"] = l1
+        return minimize(vg, x0, **kwargs)
+
+    return jax.jit(solve, out_shardings=replicated_sharding(mesh))
+
+
+def clear():
+    """Drop all cached solvers (tests / long-running sweeps with many configs)."""
+    glm_solver.cache_clear()
+    re_bucket_solver.cache_clear()
+    sharded_glm_solver.cache_clear()

@@ -28,14 +28,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_ml_tpu.data.dataset import LabeledData
-from photon_ml_tpu.data.matrix import DenseDesignMatrix
 from photon_ml_tpu.data.random_effect import RandomEffectDataset
-from photon_ml_tpu.function.losses import loss_for_task
-from photon_ml_tpu.function.objective import GLMObjective
+from photon_ml_tpu.normalization import NO_NORMALIZATION
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
-from photon_ml_tpu.optimization.factory import build_minimizer
 from photon_ml_tpu.parallel.mesh import batch_sharding, pad_axis_to_multiple, replicated_sharding
-from photon_ml_tpu.types import OptimizerType, TaskType
+from photon_ml_tpu.types import TaskType
 
 Array = jnp.ndarray
 
@@ -68,10 +65,12 @@ class ShardedRECoordinate:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class ShardedGameData:
-    """Flagship GLMix training data placed on a mesh: dense fixed-effect design
-    matrix (samples sharded) + one ShardedRECoordinate per random effect."""
+    """Flagship GLMix training data placed on a mesh: fixed-effect design matrix
+    (dense [N, D] blocks samples-sharded, or padded-COO sparse with the nnz axis
+    sharded — the billion-feature regime) + one ShardedRECoordinate per random
+    effect."""
 
-    fe_X: Array  # [N, D] sharded on axis 0
+    fe_X: object  # DenseDesignMatrix | SparseDesignMatrix, samples/nnz sharded
     labels: Array  # [N]
     offsets: Array  # [N]
     weights: Array  # [N] (0 = sample padding)
@@ -83,7 +82,7 @@ class ShardedGameData:
 
 
 def build_sharded_game_data(
-    fe_X: np.ndarray,
+    fe_X,
     labels: np.ndarray,
     re_datasets: Sequence[RandomEffectDataset],
     mesh,
@@ -93,17 +92,28 @@ def build_sharded_game_data(
     dtype=jnp.float32,
 ) -> ShardedGameData:
     """Host-side placement: pad the sample axis and every bucket's entity axis to
-    the mesh size, then device_put with batch/entity sharding."""
+    the mesh size, then device_put with batch/entity sharding.
+
+    ``fe_X`` may be a dense [N, D] array (samples sharded as [N', D] blocks) or a
+    scipy sparse / SparseDesignMatrix (COO nnz axis sharded; scatter-adds psum —
+    the sparse billion-feature path of parallel/glm.py)."""
+    from photon_ml_tpu.data.matrix import as_design_matrix
+    from photon_ml_tpu.parallel.glm import shard_labeled_data
+
     m = mesh.devices.size
     bs1, bs2, bs3 = (batch_sharding(mesh, ndim=k) for k in (1, 2, 3))
-    n = fe_X.shape[0]
+    n = np.asarray(labels).shape[0]
     offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
     weights = np.ones(n) if weights is None else np.asarray(weights)
 
-    fe_Xp, _ = pad_axis_to_multiple(np.asarray(fe_X), m)
-    yp, _ = pad_axis_to_multiple(np.asarray(labels), m)
-    op, _ = pad_axis_to_multiple(offsets, m)
-    wp, _ = pad_axis_to_multiple(weights, m)
+    fe_data, _ = shard_labeled_data(
+        LabeledData.build(
+            as_design_matrix(fe_X, dtype=dtype), labels, offsets=offsets,
+            weights=weights, dtype=dtype,
+        ),
+        mesh,
+    )
+    yp, op, wp = fe_data.labels, fe_data.offsets, fe_data.weights
 
     coords = []
     for ds in re_datasets:
@@ -139,23 +149,28 @@ def build_sharded_game_data(
         )
 
     return ShardedGameData(
-        fe_X=jax.device_put(jnp.asarray(fe_Xp, dtype=dtype), bs2),
-        labels=jax.device_put(jnp.asarray(yp, dtype=dtype), bs1),
-        offsets=jax.device_put(jnp.asarray(op, dtype=dtype), bs1),
-        weights=jax.device_put(jnp.asarray(wp, dtype=dtype), bs1),
+        fe_X=fe_data.X,
+        labels=yp,
+        offsets=op,
+        weights=wp,
         re=tuple(coords),
     )
 
 
 def init_game_params(data: ShardedGameData, mesh) -> dict:
     """Zero-initialized flagship parameters: replicated fixed-effect coefficients +
-    one [E+1, K] entity-sharded-scatter-target table per random effect (row E is
-    the junk row for bucket padding)."""
+    one [E_pad+pad, K] ENTITY-SHARDED table per random effect. The table height is
+    padded to a mesh multiple past E+1 (row E is the junk row for bucket padding;
+    rows above are sharding padding, both kept zero by game_train_step)."""
+    m = mesh.devices.size
     rep = replicated_sharding(mesh)
+    es = batch_sharding(mesh, ndim=2)
     dtype = data.fe_X.dtype
-    fe = jax.device_put(jnp.zeros((data.fe_X.shape[1],), dtype=dtype), rep)
+    fe = jax.device_put(jnp.zeros((data.fe_X.n_cols,), dtype=dtype), rep)
     re = tuple(
-        jax.device_put(jnp.zeros((rc.n_entities + 1, rc.max_k), dtype=dtype), rep)
+        jax.device_put(
+            jnp.zeros((-(-(rc.n_entities + 1) // m) * m, rc.max_k), dtype=dtype), es
+        )
         for rc in data.re
     )
     return {"fixed": fe, "re": re}
@@ -182,43 +197,49 @@ def game_train_step(
 
     Returns (new params, diagnostics {fe_value, fe_iterations, total_scores}).
     """
+    from photon_ml_tpu.optimization.solver_cache import glm_solver, re_bucket_solver
+    from photon_ml_tpu.types import VarianceComputationType
+
     task = TaskType(task)
-    objective = GLMObjective(loss_for_task(task))
-    fe_min = build_minimizer(fe_config.optimizer_config)
-    fe_opt = OptimizerType(fe_config.optimizer_config.optimizer_type)
+    no_var = VarianceComputationType.NONE
 
     fe_coef = params["fixed"]
     re_coeffs = list(params["re"])
+    dtype = fe_coef.dtype
 
-    fe_score = data.fe_X @ fe_coef
+    fe_score = data.fe_X.matvec(fe_coef)
     re_scores = [_re_score(rc, w) for rc, w in zip(data.re, re_coeffs)]
     total = fe_score + sum(re_scores) if re_scores else fe_score
 
     # ---- fixed-effect coordinate (partial = total - own) ------------------------
+    # Shares the cached solver with GLMOptimizationProblem.run: one update logic,
+    # two drivers (this fused pass and the host coordinate-descent loop).
     d = LabeledData(
-        X=DenseDesignMatrix(data.fe_X),
+        X=data.fe_X,
         labels=data.labels,
         offsets=data.offsets + (total - fe_score),
         weights=data.weights,
     )
-
-    def fe_vg(w):
-        return objective.value_and_gradient(d, w, fe_config.l2_weight)
-
-    kwargs = {}
-    if fe_opt == OptimizerType.TRON:
-        kwargs["hvp"] = lambda w, v: objective.hessian_vector(d, w, v, fe_config.l2_weight)
-    if fe_config.l1_weight:
-        kwargs["l1_weight"] = fe_config.l1_weight
-    fe_res = fe_min(fe_vg, fe_coef, **kwargs)
+    empty = jnp.zeros((0,), dtype=dtype)
+    fe_solve = glm_solver(
+        task, fe_config.optimizer_config, bool(fe_config.l1_weight), False, False, no_var
+    )
+    fe_res, _ = fe_solve(
+        d,
+        fe_coef,
+        jnp.asarray(fe_config.l2_weight, dtype=dtype),
+        jnp.asarray(fe_config.l1_weight or 0.0, dtype=dtype),
+        empty,
+        empty,
+        NO_NORMALIZATION,
+    )
     fe_coef = fe_res.coefficients
-    fe_score = data.fe_X @ fe_coef
+    fe_score = data.fe_X.matvec(fe_coef)
     total = fe_score + sum(re_scores) if re_scores else fe_score
 
     # ---- random-effect coordinates ----------------------------------------------
     for i, (rc, cfg) in enumerate(zip(data.re, re_configs)):
-        re_min = build_minimizer(cfg.optimizer_config)
-        re_opt = OptimizerType(cfg.optimizer_config.optimizer_type)
+        solve = re_bucket_solver(task, cfg.optimizer_config, bool(cfg.l1_weight), no_var)
         offsets_plus = data.offsets + (total - re_scores[i])
         coeffs = re_coeffs[i]
         for b in rc.buckets:
@@ -226,24 +247,19 @@ def game_train_step(
             off_b = jnp.take(offsets_plus, jnp.maximum(b.sample_ids, 0), axis=0)
             off_b = jnp.where(b.sample_ids >= 0, off_b, 0.0)
             w0_b = coeffs[b.entity_rows, :K]
-
-            def solve_one(Xe, ye, we, oe, w0):
-                de = LabeledData(X=DenseDesignMatrix(Xe), labels=ye, offsets=oe, weights=we)
-
-                def vg(w):
-                    return objective.value_and_gradient(de, w, cfg.l2_weight)
-
-                kw = {}
-                if re_opt == OptimizerType.TRON:
-                    kw["hvp"] = lambda w, v: objective.hessian_vector(de, w, v, cfg.l2_weight)
-                if cfg.l1_weight:
-                    kw["l1_weight"] = cfg.l1_weight
-                return re_min(vg, w0, **kw).coefficients
-
-            w_b = jax.vmap(solve_one)(b.X, b.labels, b.weights, off_b, w0_b)
+            w_b, _, _, _ = solve(
+                b.X,
+                b.labels,
+                b.weights,
+                off_b,
+                w0_b,
+                jnp.asarray(cfg.l2_weight, dtype=dtype),
+                jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
+            )
             coeffs = coeffs.at[b.entity_rows, :K].set(w_b)
-        # the junk row must stay zero: bucket padding scattered garbage into it
-        coeffs = coeffs.at[rc.n_entities].set(0.0)
+        # junk + sharding-padding rows must stay zero: bucket padding scattered
+        # garbage into row E (rows above are device_put padding)
+        coeffs = coeffs.at[rc.n_entities :].set(0.0)
         re_coeffs[i] = coeffs
         re_scores[i] = _re_score(rc, coeffs)
         total = fe_score + sum(re_scores)

@@ -21,13 +21,11 @@ import numpy as np
 
 from photon_ml_tpu.data.dataset import LabeledData
 from photon_ml_tpu.data.matrix import DenseDesignMatrix, SparseDesignMatrix
-from photon_ml_tpu.function.losses import loss_for_task
-from photon_ml_tpu.function.objective import GLMObjective
 from photon_ml_tpu.optimization.common import OptResult
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
-from photon_ml_tpu.optimization.factory import build_minimizer
+from photon_ml_tpu.optimization.solver_cache import sharded_glm_solver
 from photon_ml_tpu.parallel.mesh import batch_sharding, pad_axis_to_multiple, replicated_sharding
-from photon_ml_tpu.types import OptimizerType, TaskType
+from photon_ml_tpu.types import TaskType
 
 Array = jnp.ndarray
 
@@ -88,29 +86,22 @@ def train_glm_sharded(
     program, at the cost of an initial transfer).
     """
     task = TaskType(task)
-    objective = GLMObjective(loss_for_task(task))
     cfg = configuration
-    minimize = build_minimizer(cfg.optimizer_config)
-    opt_type = OptimizerType(cfg.optimizer_config.optimizer_type)
     rep = replicated_sharding(mesh)
+    dtype = data.X.dtype
 
     x0 = (
-        jnp.zeros((data.dim,), dtype=data.X.dtype)
+        jnp.zeros((data.dim,), dtype=dtype)
         if initial_coefficients is None
-        else jnp.asarray(initial_coefficients, dtype=data.X.dtype)
+        else jnp.asarray(initial_coefficients, dtype=dtype)
     )
     x0 = jax.device_put(x0, rep)
 
-    def solve(d: LabeledData, w0: Array) -> OptResult:
-        def vg(w):
-            return objective.value_and_gradient(d, w, cfg.l2_weight)
-
-        kwargs = {}
-        if opt_type == OptimizerType.TRON:
-            kwargs["hvp"] = lambda w, v: objective.hessian_vector(d, w, v, cfg.l2_weight)
-        if cfg.l1_weight:
-            kwargs["l1_weight"] = cfg.l1_weight
-        return minimize(vg, w0, **kwargs)
-
-    result = jax.jit(solve, out_shardings=rep)(data, x0)
+    solve = sharded_glm_solver(task, cfg.optimizer_config, bool(cfg.l1_weight), mesh)
+    result = solve(
+        data,
+        x0,
+        jnp.asarray(cfg.l2_weight, dtype=dtype),
+        jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
+    )
     return result.coefficients, result

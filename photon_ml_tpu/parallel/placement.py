@@ -1,0 +1,117 @@
+"""Mesh placement: turn host-built GAME datasets into SPMD datasets.
+
+The coordinate-descent implementation (algorithm/coordinate_descent.py) is
+backend-agnostic: every solve it triggers is a jitted XLA program over whatever
+shardings its input arrays carry. Placement is therefore the whole "mesh
+backend": pad the global sample axis (weight-0 rows, inert in every weighted
+reduction) and each bucket's entity axis (junk rows whose scatters drop), then
+``device_put`` every array with batch/entity shardings over the 1-D mesh. XLA
+then inserts the psum for the fixed-effect gradient reduction — the
+``treeAggregate`` analog (ValueAndGradientAggregator.scala:240-255) — and keeps
+the vmapped per-entity random-effect solves communication-free, matching the
+executor-local solves of RandomEffectCoordinate.scala:109-127.
+
+Random-effect coefficient tables are sharded over the entity axis (the
+reference never collects RandomEffectModel RDDs either, RandomEffectModel.scala:
+36-304): placement stamps ``coeffs_sharding`` on the dataset and the solvers
+place/update the [E, K] tables under it, so per-device model memory scales as
+~1/n_devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import FixedEffectDataset
+from photon_ml_tpu.data.random_effect import EntityBucket, RandomEffectDataset
+from photon_ml_tpu.parallel.glm import shard_labeled_data
+from photon_ml_tpu.parallel.mesh import (
+    batch_sharding,
+    pad_axis_to_multiple,
+    replicated_sharding,
+)
+
+Array = jnp.ndarray
+
+
+def pad_and_shard_vector(arr, mesh, fill=0.0, dtype=None) -> Array:
+    """Pad a [N] host/device vector to the mesh multiple and batch-shard it."""
+    arr = np.asarray(arr)
+    padded, _ = pad_axis_to_multiple(arr, mesh.devices.size, fill=fill)
+    out = jnp.asarray(padded, dtype=dtype) if dtype is not None else jnp.asarray(padded)
+    return jax.device_put(out, batch_sharding(mesh, ndim=1))
+
+
+def place_fixed_effect_dataset(ds: FixedEffectDataset, mesh) -> FixedEffectDataset:
+    """Samples sharded over the mesh; dense [N, D] blocks or sparse COO nnz axis
+    (billion-feature regime — the PalDBIndexMap.scala:43-278 scale story rides
+    the sparse path + offheap_index)."""
+    sharded, _ = shard_labeled_data(ds.data, mesh)
+    return dataclasses.replace(ds, data=sharded)
+
+
+def place_random_effect_dataset(ds: RandomEffectDataset, mesh) -> RandomEffectDataset:
+    """Entity-shard the training buckets, batch-shard the per-sample scoring
+    view, and stamp the coefficient-table sharding.
+
+    Bucket padding discipline: padded entities get ``entity_rows == n_entities``
+    (one past the [E, K] coefficient table) — their gathers clamp harmlessly and
+    their scatters are dropped by XLA's out-of-bounds-update semantics; their
+    weights are all zero so the padded solves converge instantly to the L2 prox.
+    """
+    m = mesh.devices.size
+    bs1, bs2, bs3 = (batch_sharding(mesh, ndim=k) for k in (1, 2, 3))
+    rep = replicated_sharding(mesh)
+    E = ds.n_entities
+
+    buckets = []
+    for b in ds.buckets:
+        rows, _ = pad_axis_to_multiple(np.asarray(b.entity_rows), m, fill=E)
+        Xb, _ = pad_axis_to_multiple(np.asarray(b.X), m)
+        yb, _ = pad_axis_to_multiple(np.asarray(b.labels), m)
+        wb, _ = pad_axis_to_multiple(np.asarray(b.weights), m)
+        sb, _ = pad_axis_to_multiple(np.asarray(b.sample_ids), m, fill=-1)
+        buckets.append(
+            EntityBucket(
+                entity_rows=jax.device_put(jnp.asarray(rows), bs1),
+                X=jax.device_put(jnp.asarray(Xb, dtype=b.X.dtype), bs3),
+                labels=jax.device_put(jnp.asarray(yb, dtype=b.labels.dtype), bs2),
+                weights=jax.device_put(jnp.asarray(wb, dtype=b.weights.dtype), bs2),
+                sample_ids=jax.device_put(jnp.asarray(sb), bs2),
+            )
+        )
+
+    ser, _ = pad_axis_to_multiple(np.asarray(ds.sample_entity_rows), m, fill=-1)
+    slc, _ = pad_axis_to_multiple(np.asarray(ds.sample_local_cols), m, fill=-1)
+    sv, _ = pad_axis_to_multiple(np.asarray(ds.sample_vals), m)
+
+    return dataclasses.replace(
+        ds,
+        buckets=buckets,
+        proj_indices=jax.device_put(ds.proj_indices, rep),
+        sample_entity_rows=jax.device_put(jnp.asarray(ser), bs1),
+        sample_local_cols=jax.device_put(jnp.asarray(slc), bs2),
+        sample_vals=jax.device_put(jnp.asarray(sv, dtype=ds.sample_vals.dtype), bs2),
+        coeffs_sharding=batch_sharding(mesh, ndim=2),
+        # device_put needs the sharded axis divisible by the mesh size, so the
+        # table gets always-zero padding rows; row E (the bucket-padding target)
+        # falls in this range and is re-zeroed after every update
+        coeffs_rows=-(-max(E, 1) // m) * m,
+    )
+
+
+def place_game_datasets(datasets: dict, mesh) -> dict:
+    """Place every per-coordinate dataset of a GAME fit on the mesh."""
+    out = {}
+    for cid, ds in datasets.items():
+        if isinstance(ds, FixedEffectDataset):
+            out[cid] = place_fixed_effect_dataset(ds, mesh)
+        elif isinstance(ds, RandomEffectDataset):
+            out[cid] = place_random_effect_dataset(ds, mesh)
+        else:
+            raise TypeError(f"Cannot place dataset of type {type(ds).__name__}")
+    return out

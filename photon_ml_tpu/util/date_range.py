@@ -1,0 +1,152 @@
+"""Date-partitioned input-path handling.
+
+Semantic parity with photon-client util/DateRange.scala:30-107,
+util/DaysRange.scala:25-80 and IOUtils.getInputPathsWithinDateRange
+(util/IOUtils.scala:113-152): ranges are inclusive ``yyyyMMdd-yyyyMMdd``
+strings (or day-offset pairs ``start-end`` counting days ago, start >= end),
+and production Avro inputs live under per-day directories ``<base>/yyyy/MM/dd``.
+The Hadoop filesystem walk is replaced by plain os.path checks — ingest here is
+host-local (or fuse-mounted), not HDFS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import os
+from typing import Optional, Sequence
+
+DATE_FORMAT = "%Y%m%d"  # yyyyMMdd
+RANGE_DELIMITER = "-"
+
+
+@dataclasses.dataclass(frozen=True)
+class DateRange:
+    """Inclusive [start, end] calendar-date range."""
+
+    start: datetime.date
+    end: datetime.date
+
+    def __post_init__(self):
+        if self.start > self.end:
+            raise ValueError(
+                f"Invalid range: start date {self.start} comes after end date {self.end}"
+            )
+
+    @staticmethod
+    def parse(text: str) -> "DateRange":
+        """Parse ``yyyyMMdd-yyyyMMdd`` (DateRange.fromDateString semantics)."""
+        parts = text.split(RANGE_DELIMITER)
+        if len(parts) != 2:
+            raise ValueError(
+                f"Couldn't parse the range {text!r} using delimiter {RANGE_DELIMITER!r}"
+            )
+        try:
+            start = datetime.datetime.strptime(parts[0], DATE_FORMAT).date()
+            end = datetime.datetime.strptime(parts[1], DATE_FORMAT).date()
+        except ValueError as e:
+            raise ValueError(f"Couldn't parse the date range: {text}") from e
+        return DateRange(start, end)
+
+    def dates(self) -> list:
+        """Every date in the range, inclusive."""
+        n = (self.end - self.start).days
+        return [self.start + datetime.timedelta(days=d) for d in range(n + 1)]
+
+    def __str__(self) -> str:
+        return (
+            f"{self.start.strftime(DATE_FORMAT)}{RANGE_DELIMITER}"
+            f"{self.end.strftime(DATE_FORMAT)}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class DaysRange:
+    """Range expressed in whole days ago: ``start_days`` ago .. ``end_days`` ago
+    (start >= end >= 0 — '90-1' = from 90 days ago until yesterday)."""
+
+    start_days: int
+    end_days: int
+
+    def __post_init__(self):
+        if self.start_days < 0 or self.end_days < 0:
+            raise ValueError(f"Invalid range: negative day offsets in {self}")
+        if self.start_days < self.end_days:
+            raise ValueError(
+                f"Invalid range: start of range {self.start_days} is fewer days ago "
+                f"than end of range {self.end_days}"
+            )
+
+    @staticmethod
+    def parse(text: str) -> "DaysRange":
+        parts = text.split(RANGE_DELIMITER)
+        if len(parts) != 2:
+            raise ValueError(f"Couldn't parse the days range {text!r}")
+        return DaysRange(int(parts[0]), int(parts[1]))
+
+    def to_date_range(self, today: Optional[datetime.date] = None) -> DateRange:
+        today = today or datetime.date.today()
+        return DateRange(
+            today - datetime.timedelta(days=self.start_days),
+            today - datetime.timedelta(days=self.end_days),
+        )
+
+    def __str__(self) -> str:
+        return f"{self.start_days}{RANGE_DELIMITER}{self.end_days}"
+
+
+def resolve_range(
+    date_range: Optional[str],
+    days_range: Optional[str],
+    today: Optional[datetime.date] = None,
+) -> Optional[DateRange]:
+    """Driver-flag resolution: at most one of --*-date-range / --*-days-range."""
+    if date_range and days_range:
+        raise ValueError("Specify a date range or a days range, not both")
+    if date_range:
+        return DateRange.parse(date_range)
+    if days_range:
+        return DaysRange.parse(days_range).to_date_range(today)
+    return None
+
+
+def resolve_input_paths(
+    paths,
+    date_range: Optional[str],
+    days_range: Optional[str],
+    today: Optional[datetime.date] = None,
+):
+    """Driver helper: expand ``paths`` to their day partitions when a
+    --*-date-range / --*-days-range flag was given; pass through otherwise."""
+    rng = resolve_range(date_range, days_range, today)
+    if rng is None:
+        return paths
+    return input_paths_within_date_range(paths, rng)
+
+
+def input_paths_within_date_range(
+    base_dirs,
+    date_range: DateRange,
+    error_on_missing: bool = False,
+) -> list[str]:
+    """Expand base dirs to existing ``<base>/yyyy/MM/dd`` day directories
+    (IOUtils.getInputPathsWithinDateRange:113-152). Missing days are skipped
+    unless ``error_on_missing``; an entirely empty expansion raises."""
+    if isinstance(base_dirs, str):
+        base_dirs = [p for p in base_dirs.split(",") if p]
+    out: list[str] = []
+    for base in base_dirs:
+        found = []
+        for day in date_range.dates():
+            path = os.path.join(base, day.strftime("%Y"), day.strftime("%m"), day.strftime("%d"))
+            if os.path.isdir(path):
+                found.append(path)
+            elif error_on_missing:
+                raise FileNotFoundError(f"Path {path} does not exist")
+        if not found:
+            raise FileNotFoundError(
+                f"No data folder found between {date_range.start} and "
+                f"{date_range.end} in {base}"
+            )
+        out.extend(found)
+    return out

@@ -1,0 +1,199 @@
+"""Constraint maps: JSON parsing, wildcard/overlap rules, and end-to-end
+constrained training with active bounds verified at the optimum
+(GLMSuite.createConstraintFeatureMap:190-260 +
+OptimizationUtils.projectCoefficientsToSubspace:56-70)."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.optimization.constraints import (
+    build_bound_vectors,
+    parse_constraint_entries,
+    project_coefficients,
+)
+from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+
+def _imap():
+    keys = [feature_key("age", ""), feature_key("income", "usd"),
+            feature_key("income", "eur"), feature_key("height", "cm")]
+    return IndexMap.build(keys, add_intercept=True)
+
+
+class TestParsing:
+    def test_explicit_bounds(self):
+        imap = _imap()
+        text = json.dumps([
+            {"name": "age", "term": "", "lowerBound": -1.0, "upperBound": 1.0},
+            {"name": "income", "term": "usd", "upperBound": 0.5},
+        ])
+        lower, upper = build_bound_vectors(text, imap)
+        i_age = imap.get_index(feature_key("age", ""))
+        i_usd = imap.get_index(feature_key("income", "usd"))
+        assert (lower[i_age], upper[i_age]) == (-1.0, 1.0)
+        assert lower[i_usd] == -np.inf and upper[i_usd] == 0.5
+        # unconstrained features stay unbounded
+        i_cm = imap.get_index(feature_key("height", "cm"))
+        assert lower[i_cm] == -np.inf and upper[i_cm] == np.inf
+
+    def test_term_wildcard(self):
+        imap = _imap()
+        text = json.dumps([{"name": "income", "term": "*", "lowerBound": 0.0}])
+        lower, _ = build_bound_vectors(text, imap)
+        for term in ("usd", "eur"):
+            assert lower[imap.get_index(feature_key("income", term))] == 0.0
+        assert lower[imap.get_index(feature_key("age", ""))] == -np.inf
+
+    def test_all_wildcard_excludes_intercept(self):
+        imap = _imap()
+        text = json.dumps([{"name": "*", "term": "*", "lowerBound": -2.0,
+                            "upperBound": 2.0}])
+        lower, upper = build_bound_vectors(text, imap)
+        assert lower[imap.intercept_index] == -np.inf
+        assert upper[imap.intercept_index] == np.inf
+        mask = np.ones(imap.size, bool)
+        mask[imap.intercept_index] = False
+        assert np.all(lower[mask] == -2.0) and np.all(upper[mask] == 2.0)
+
+    def test_validation_errors(self):
+        imap = _imap()
+        with pytest.raises(ValueError, match="name.*term|term.*name"):
+            parse_constraint_entries(json.dumps([{"name": "a"}]))
+        with pytest.raises(ValueError, match="below upper"):
+            parse_constraint_entries(
+                json.dumps([{"name": "a", "term": "", "lowerBound": 2, "upperBound": 1}])
+            )
+        with pytest.raises(ValueError, match="wildcard"):
+            parse_constraint_entries(json.dumps([{"name": "*", "term": "t",
+                                                  "lowerBound": 0}]))
+        with pytest.raises(ValueError, match="not a constraint"):
+            parse_constraint_entries(json.dumps([{"name": "a", "term": ""}]))
+        # overlap: explicit + term-wildcard on the same feature
+        with pytest.raises(ValueError, match="[Cc]onflict"):
+            build_bound_vectors(
+                json.dumps([
+                    {"name": "income", "term": "usd", "upperBound": 1.0},
+                    {"name": "income", "term": "*", "lowerBound": 0.0},
+                ]),
+                imap,
+            )
+        # all-wildcard must be alone
+        with pytest.raises(ValueError, match="only entry"):
+            build_bound_vectors(
+                json.dumps([
+                    {"name": "*", "term": "*", "upperBound": 1.0},
+                    {"name": "age", "term": "", "lowerBound": 0.0},
+                ]),
+                imap,
+            )
+
+    def test_project_coefficients(self):
+        bounds = (np.array([-1.0, -np.inf]), np.array([1.0, 0.0]))
+        out = project_coefficients(np.array([2.0, 0.5]), bounds)
+        np.testing.assert_array_equal(out, [1.0, 0.0])
+        np.testing.assert_array_equal(
+            project_coefficients(np.array([2.0, 0.5]), None), [2.0, 0.5]
+        )
+
+
+class TestConstrainedTraining:
+    @pytest.mark.parametrize("opt", [OptimizerType.LBFGS, OptimizerType.LBFGSB,
+                                     OptimizerType.TRON])
+    def test_active_bounds_hold_at_optimum(self, rng, opt):
+        """Train linear regression whose unconstrained optimum violates the box;
+        the constrained solution must sit ON the bound and satisfy projected
+        stationarity (clamping the unconstrained gradient step cannot improve)."""
+        n, d = 300, 3
+        X = rng.normal(size=(n, d))
+        w_true = np.array([2.0, -1.5, 0.3])
+        y = X @ w_true + 0.01 * rng.normal(size=n)
+        data = LabeledData.build(X, y, dtype=jnp.float64)
+        lower = np.array([-0.5, -0.5, -0.5])
+        upper = np.array([0.5, 0.5, 0.5])
+        problem = GLMOptimizationProblem(
+            task=TaskType.LINEAR_REGRESSION,
+            configuration=GLMOptimizationConfiguration(
+                optimizer_config=OptimizerConfig(optimizer_type=opt, max_iterations=200),
+                regularization_context=RegularizationContext(RegularizationType.L2),
+                regularization_weight=1e-6,
+            ),
+        )
+        glm, res = problem.run(data, lower_bounds=lower, upper_bounds=upper)
+        w = np.asarray(glm.coefficients.means)
+        assert np.all(w >= lower - 1e-9) and np.all(w <= upper + 1e-9)
+        # true coefficients 2.0/-1.5 exceed the box: their slots must be active
+        assert w[0] == pytest.approx(0.5, abs=1e-6)
+        assert w[1] == pytest.approx(-0.5, abs=1e-6)
+        # interior coordinate reaches the unconstrained optimum neighborhood
+        assert abs(w[2] - w_true[2]) < 0.1
+
+    def test_estimator_applies_constraints(self, rng):
+        """GameEstimator end-to-end with box constraints on the fixed effect."""
+        from photon_ml_tpu.data.game_data import GameInput
+        from photon_ml_tpu.estimators.config import (
+            CoordinateConfiguration,
+            FixedEffectDataConfiguration,
+        )
+        from photon_ml_tpu.estimators.game_estimator import GameEstimator
+
+        n, d = 200, 3
+        X = rng.normal(size=(n, d))
+        y = X @ np.array([3.0, -3.0, 0.1]) + 0.01 * rng.normal(size=n)
+        cfg = GLMOptimizationConfiguration(
+            optimizer_config=OptimizerConfig(
+                optimizer_type=OptimizerType.LBFGS, max_iterations=100
+            ),
+            regularization_context=RegularizationContext(RegularizationType.L2),
+            regularization_weight=1e-6,
+        )
+        est = GameEstimator(
+            task=TaskType.LINEAR_REGRESSION,
+            coordinate_configurations={
+                "global": CoordinateConfiguration(
+                    FixedEffectDataConfiguration("global"),
+                    cfg,
+                    box_constraints=(np.full(d, -1.0), np.full(d, 1.0)),
+                )
+            },
+            dtype=jnp.float64,
+        )
+        results = est.fit(GameInput(features={"global": X}, labels=y))
+        w = np.asarray(
+            results[0].model.get_model("global").model.coefficients.means
+        )
+        assert np.all(np.abs(w) <= 1.0 + 1e-9)
+        assert w[0] == pytest.approx(1.0, abs=1e-6)
+        assert w[1] == pytest.approx(-1.0, abs=1e-6)
+
+    def test_constraints_reject_normalization(self, rng):
+        from photon_ml_tpu.algorithm.coordinate import FixedEffectCoordinate
+        from photon_ml_tpu.data.dataset import FixedEffectDataset
+        from photon_ml_tpu.normalization import NormalizationContext
+
+        X = rng.normal(size=(20, 2))
+        y = rng.normal(size=20)
+        ds = FixedEffectDataset(LabeledData.build(X, y, dtype=jnp.float64))
+        with pytest.raises(ValueError, match="cannot be combined"):
+            FixedEffectCoordinate(
+                coordinate_id="global",
+                dataset=ds,
+                task=TaskType.LINEAR_REGRESSION,
+                configuration=GLMOptimizationConfiguration(
+                    optimizer_config=OptimizerConfig(),
+                    regularization_context=RegularizationContext(RegularizationType.L2),
+                    regularization_weight=1.0,
+                ),
+                normalization=NormalizationContext(factors=np.ones(2) * 2.0),
+                box_constraints=(np.full(2, -1.0), np.full(2, 1.0)),
+            )

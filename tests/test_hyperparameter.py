@@ -291,3 +291,78 @@ class TestTuner:
         # values are mean-centered
         vals = [v for _, v in captured["priors"]]
         assert abs(sum(vals)) < 1e-12
+
+
+class TestShrinkSearchRange:
+    """ShrinkSearchRange.getBounds (reference :40-103): GP fit on priors ->
+    Sobol candidate pool -> best +/- radius, clamped and back-scaled."""
+
+    def _config(self):
+        from photon_ml_tpu.hyperparameter.serialization import config_from_json
+        from photon_ml_tpu.hyperparameter.shrink_search_range import CONFIG_DEFAULT
+
+        return config_from_json(CONFIG_DEFAULT)
+
+    def test_bounds_bracket_prior_optimum(self):
+        import json
+
+        from photon_ml_tpu.hyperparameter.shrink_search_range import (
+            PRIOR_DEFAULT,
+            get_bounds,
+        )
+
+        cfg = self._config()
+        # best prior at log10 weights (1, -1, 0); evaluation larger = better
+        records = []
+        for g, m, i, v in [(1.0, -1.0, 0.0, 0.9), (2.5, 2.0, 2.0, 0.2),
+                           (-2.0, -2.5, -2.0, 0.1), (0.5, -0.5, 0.5, 0.7)]:
+            records.append({
+                "global_regularizer": str(10.0 ** g),
+                "member_regularizer": str(10.0 ** m),
+                "item_regularizer": str(10.0 ** i),
+                "evaluationValue": str(v),
+            })
+        lower, upper = get_bounds(
+            cfg, json.dumps({"records": records}), PRIOR_DEFAULT,
+            radius=0.15, candidate_pool_size=256, seed=5,
+        )
+        assert lower.shape == upper.shape == (3,)
+        assert (lower <= upper).all()
+        # clamped inside the declared ranges
+        assert (lower >= -3 - 1e-12).all() and (upper <= 3 + 1e-12).all()
+        # the shrunk box must be strictly smaller than the full range ...
+        assert ((upper - lower) < 6.0).all()
+        # ... and contain the best observed point (log10 space)
+        best = np.array([1.0, -1.0, 0.0])
+        assert (lower <= best + 1.0).all() and (upper >= best - 1.0).all()
+
+    def test_missing_params_use_defaults(self):
+        import json
+
+        from photon_ml_tpu.hyperparameter.shrink_search_range import (
+            PRIOR_DEFAULT,
+            get_bounds,
+        )
+
+        cfg = self._config()
+        records = [{"global_regularizer": "1.0", "evaluationValue": "0.5"},
+                   {"global_regularizer": "10.0", "evaluationValue": "0.8"}]
+        with pytest.raises(ValueError):
+            # member/item default "0.0" -> log10(0) = -inf -> GP must reject,
+            # matching the reference's behavior of requiring usable priors
+            lower, upper = get_bounds(
+                cfg, json.dumps({"records": records}), PRIOR_DEFAULT, radius=0.1,
+                candidate_pool_size=64,
+            )
+
+    def test_no_priors_raises(self):
+        import json
+
+        from photon_ml_tpu.hyperparameter.shrink_search_range import (
+            PRIOR_DEFAULT,
+            get_bounds,
+        )
+
+        with pytest.raises(ValueError, match="zero prior"):
+            get_bounds(self._config(), json.dumps({"records": []}),
+                       PRIOR_DEFAULT, radius=0.1)

@@ -1,0 +1,208 @@
+"""Mesh execution backend: the SAME coordinate-descent implementation runs as
+sharded SPMD programs when GameEstimator places datasets on a jax.sharding.Mesh
+(VERDICT round-1 items 2/5/6). Mirrors the reference's pattern of exercising the
+distributed path on a multi-core local backend (SparkTestUtils.sparkTest,
+SURVEY.md §4) on the simulated 8-device CPU mesh."""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.estimators.config import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.evaluation.evaluators import EvaluatorType
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+N, D, U = 200, 4, 11  # U deliberately not divisible by 8 (uneven entity axis)
+
+
+def _glmix_data(rng, n=N):
+    w = rng.normal(size=D)
+    u_eff = 0.7 * rng.normal(size=U)
+    X = rng.normal(size=(n, D))
+    users = rng.integers(0, U, size=n)
+    z = X @ w + u_eff[users]
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(float)
+    return X, users, y
+
+
+def _cfg(iters=40):
+    return GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS, max_iterations=iters
+        ),
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+
+
+def _estimator(mesh=None, locked=(), sparse_shard=False):
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations={
+            "global": CoordinateConfiguration(FixedEffectDataConfiguration("global"), _cfg()),
+            "per-user": CoordinateConfiguration(
+                RandomEffectDataConfiguration("userId", "global"), _cfg()
+            ),
+        },
+        validation_evaluators=[EvaluatorType.AUC],
+        partial_retrain_locked_coordinates=locked,
+        dtype=jnp.float64,
+        mesh=mesh,
+    )
+
+
+def _inputs(rng, sparse=False):
+    X, users, y = _glmix_data(rng)
+    Xv, uv, yv = _glmix_data(rng)
+    feat = (lambda a: sp.csr_matrix(a)) if sparse else (lambda a: a)
+    train = GameInput(features={"global": feat(X)}, labels=y, id_columns={"userId": users})
+    val = GameInput(features={"global": feat(Xv)}, labels=yv, id_columns={"userId": uv})
+    return train, val
+
+
+class TestMeshBackend:
+    def test_mesh_fit_matches_host(self, rng, eight_devices):
+        """Identical data through the host and mesh backends must agree: same
+        coordinate-descent implementation, two placements."""
+        train, val = _inputs(rng)
+        host = _estimator().fit(train, validation_data=val)
+        mesh = make_mesh(8)
+        sharded = _estimator(mesh=mesh).fit(train, validation_data=val)
+        assert host[0].best_metric == pytest.approx(sharded[0].best_metric, abs=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(host[0].best_model.get_model("global").model.coefficients.means),
+            np.asarray(sharded[0].best_model.get_model("global").model.coefficients.means),
+            atol=1e-6,
+        )
+        h_re = np.asarray(host[0].best_model.get_model("per-user").coeffs)
+        m_re = np.asarray(sharded[0].best_model.get_model("per-user").coeffs)
+        np.testing.assert_allclose(h_re, m_re[: h_re.shape[0]], atol=1e-6)
+        # table padding rows (mesh divisibility) must be exactly zero
+        assert np.all(m_re[h_re.shape[0] :] == 0.0)
+
+    def test_sparse_fixed_effect_parity_on_mesh(self, rng, eight_devices):
+        """SparseDesignMatrix rides the COO-sharded path (billion-feature story:
+        PalDBIndexMap.scala:43-278 + sparse vectors); results match dense."""
+        mesh = make_mesh(8)
+        rng2 = np.random.default_rng(rng.integers(1 << 31))
+        train_d, val_d = _inputs(rng2)
+        rng3 = np.random.default_rng(0)
+        # same underlying arrays, sparse container
+        train_s = GameInput(
+            features={"global": sp.csr_matrix(train_d.features["global"])},
+            labels=train_d.labels,
+            id_columns=train_d.id_columns,
+        )
+        val_s = GameInput(
+            features={"global": sp.csr_matrix(val_d.features["global"])},
+            labels=val_d.labels,
+            id_columns=val_d.id_columns,
+        )
+        dense = _estimator(mesh=mesh).fit(train_d, validation_data=val_d)
+        sparse = _estimator(mesh=mesh).fit(train_s, validation_data=val_s)
+        assert dense[0].best_metric == pytest.approx(sparse[0].best_metric, abs=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(dense[0].model.get_model("global").model.coefficients.means),
+            np.asarray(sparse[0].model.get_model("global").model.coefficients.means),
+            atol=1e-6,
+        )
+
+    def test_re_tables_entity_sharded(self, rng, eight_devices):
+        """Per-device memory for random-effect coefficient tables scales
+        ~1/n_devices (VERDICT item 6): the [E_pad, K] table is sharded over the
+        entity axis, never replicated."""
+        mesh = make_mesh(8)
+        train, val = _inputs(rng)
+        res = _estimator(mesh=mesh).fit(train, validation_data=val)
+        coeffs = res[0].model.get_model("per-user").coeffs
+        E_pad = coeffs.shape[0]
+        assert E_pad % 8 == 0 and E_pad >= U
+        shard_rows = {s.data.shape[0] for s in coeffs.addressable_shards}
+        assert shard_rows == {E_pad // 8}, shard_rows
+        # 8 distinct device shards -> not replicated
+        devices = {s.device for s in coeffs.addressable_shards}
+        assert len(devices) == 8
+
+    def test_mesh_partial_retrain_and_best_model(self, rng, eight_devices):
+        """Locked coordinates + validation best-model tracking work unchanged on
+        the mesh backend (feature parity with the host loop, VERDICT item 2)."""
+        mesh = make_mesh(8)
+        train, val = _inputs(rng)
+        base = _estimator(mesh=mesh).fit(train, validation_data=val)
+        warm = base[0].best_model
+        retrain = _estimator(mesh=mesh, locked=("global",)).fit(
+            train, validation_data=val, initial_model=warm
+        )
+        assert retrain[0].best_metric is not None
+        np.testing.assert_allclose(
+            np.asarray(retrain[0].model.get_model("global").model.coefficients.means),
+            np.asarray(warm.get_model("global").model.coefficients.means),
+        )
+        # the unlocked random effect did retrain
+        assert retrain[0].descent.trackers["per-user"]
+
+    def test_training_driver_mesh_backend_cli(self, rng, tmp_path):
+        """A CLI invocation trains the GLMix on an 8-device CPU mesh end to end
+        (VERDICT item 2 'done' criterion)."""
+        from photon_ml_tpu.data import avro_io
+
+        X, users, y = _glmix_data(rng, n=120)
+        indir = tmp_path / "in"
+        indir.mkdir()
+
+        def records():
+            for i in range(len(y)):
+                yield {
+                    "uid": f"s{i}",
+                    "label": float(y[i]),
+                    "features": [
+                        {"name": f"f{j}", "term": "", "value": float(X[i, j])}
+                        for j in range(D)
+                    ],
+                    "metadataMap": {"userId": f"u{users[i]}"},
+                    "weight": 1.0,
+                    "offset": 0.0,
+                }
+
+        avro_io.write_container(
+            str(indir / "part-0.avro"), avro_io.TRAINING_EXAMPLE_SCHEMA, records()
+        )
+        out = tmp_path / "out"
+        from photon_ml_tpu.cli.game_training_driver import main
+
+        rc = main([
+            "--input-data-directories", str(indir),
+            "--validation-data-directories", str(indir),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=global,feature.bags=features",
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=30,"
+            "tolerance=1e-7,regularization=L2,reg.weights=1.0",
+            "--coordinate-configurations",
+            "name=per-user,feature.shard=global,random.effect.type=userId,"
+            "optimizer=LBFGS,max.iter=30,tolerance=1e-7,regularization=L2,reg.weights=1.0",
+            "--coordinate-update-sequence", "global,per-user",
+            "--evaluators", "AUC",
+            "--compute-backend", "mesh",
+            "--mesh-devices", "8",
+        ])
+        assert rc == 0
+        assert (out / "best" / "fixed-effect").exists()

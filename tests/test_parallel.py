@@ -150,3 +150,21 @@ class TestShardedGameStep:
             )
             out[nd] = np.asarray(params["fixed"])
         np.testing.assert_allclose(out[1], out[8], atol=1e-6)
+
+    def test_game_step_sparse_fixed_effect_parity(self, rng):
+        """A scipy-sparse fixed-effect design rides the COO-sharded path
+        (parallel/glm.py) through the fused pass; results match dense on the
+        8-device mesh (VERDICT item 5: PalDBIndexMap billion-feature regime)."""
+        fe_X, y, ds_u, ds_i = self._tiny_glmix(rng, n=200, n_users=13, n_items=7)
+        cfg = _config(max_iterations=40)
+        mesh = make_mesh(8)
+        out = {}
+        for kind in ("dense", "sparse"):
+            X = sp.csr_matrix(fe_X) if kind == "sparse" else fe_X
+            data = build_sharded_game_data(X, y, [ds_u, ds_i], mesh, dtype=jnp.float64)
+            params = init_game_params(data, mesh)
+            params, _ = game_train_step(
+                data, params, TaskType.LOGISTIC_REGRESSION, cfg, [cfg, cfg]
+            )
+            out[kind] = np.asarray(params["fixed"])
+        np.testing.assert_allclose(out["dense"], out["sparse"], atol=1e-6)
