@@ -1,0 +1,473 @@
+"""Legacy single-GLM training driver (staged workflow).
+
+Parity target: photon-client Driver.scala:59-543 + DriverStage.scala:45-50 +
+PhotonMLCmdLineParser.scala — the deprecated pre-GAME CLI: read name-term-value
+Avro training data, summarize features, train one GLM per regularization weight
+(warm-started sweep via the ModelTraining facade), compute the per-model metric
+map on validation data, select the best model per task metric, and write models
+in the legacy TEXT format. The diagnostics tier (bootstrap CIs, fitting curves,
+Hosmer-Lemeshow calibration, feature importance, prediction-error independence)
+renders into one ``model-diagnostic.html`` (Driver.REPORT_FILE:504).
+
+Stages (DriverStage.scala): INIT -> PREPROCESSED -> TRAINED -> VALIDATED, with
+the same assert-and-advance bookkeeping so downstream tooling can introspect
+how far a run progressed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import enum
+import json
+import os
+import shutil
+import sys
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.data.readers import read_avro
+from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
+from photon_ml_tpu.estimators.model_training import train_generalized_linear_model
+from photon_ml_tpu.evaluation.metric_map import (
+    SELECTION_METRIC,
+    evaluate_model,
+    select_best_model,
+)
+from photon_ml_tpu.io.model_io import write_models_in_text
+from photon_ml_tpu.normalization import (
+    NO_NORMALIZATION,
+    FeatureDataStatistics,
+    NormalizationContext,
+)
+from photon_ml_tpu.optimization.config import RegularizationContext
+from photon_ml_tpu.optimization.constraints import build_bound_vectors
+from photon_ml_tpu.types import (
+    NormalizationType,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+)
+from photon_ml_tpu.util import Event, EventEmitter, PhotonLogger, Timed
+
+LEARNED_MODELS_TEXT = "learned-models-text"
+BEST_MODEL_TEXT = "best-model-text"
+REPORT_FILE = "model-diagnostic.html"
+SUMMARY_FILE = "feature-summary.avro"
+
+
+class DriverStage(enum.IntEnum):
+    """DriverStage.scala:45-50 — ordered pipeline stages."""
+
+    INIT = 0
+    PREPROCESSED = 1
+    TRAINED = 2
+    VALIDATED = 3
+
+
+class DiagnosticMode(str, enum.Enum):
+    NONE = "NONE"
+    TRAIN = "TRAIN"
+    VALIDATE = "VALIDATE"
+    ALL = "ALL"
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon-ml-legacy-driver",
+        description="Deprecated single-GLM staged training driver.",
+    )
+    p.add_argument("--training-data-directory", required=True)
+    p.add_argument("--validating-data-directory", default=None)
+    p.add_argument("--output-directory", required=True)
+    p.add_argument("--delete-output-dirs-if-exist", action="store_true")
+    p.add_argument("--training-task", required=True,
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--optimizer", default="LBFGS",
+                   choices=[o.value for o in OptimizerType])
+    p.add_argument("--regularization-type", default="L2",
+                   choices=[r.value for r in RegularizationType])
+    p.add_argument("--regularization-weights", default="0.1,1,10,100",
+                   help="Comma-separated lambda sweep (warm-started)")
+    p.add_argument("--elastic-net-alpha", type=float, default=0.5)
+    p.add_argument("--max-number-iterations", type=int, default=100)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--normalization-type", default="NONE",
+                   choices=[n.value for n in NormalizationType])
+    p.add_argument("--summarization-output-dir", default=None)
+    p.add_argument("--coefficient-box-constraints", default=None,
+                   help="JSON constraint-map array (GLMSuite format)")
+    p.add_argument("--selected-features-file", default=None,
+                   help="Text file of 'name<TAB>term' lines restricting features")
+    p.add_argument("--intercept", dest="intercept", action="store_true",
+                   default=True)
+    p.add_argument("--no-intercept", dest="intercept", action="store_false")
+    p.add_argument("--use-warm-start", dest="warm_start", action="store_true",
+                   default=True)
+    p.add_argument("--no-warm-start", dest="warm_start", action="store_false")
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.value for v in DataValidationType])
+    p.add_argument("--diagnostic-mode", default="NONE",
+                   choices=[m.value for m in DiagnosticMode])
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def _selected_features_map(path: str, intercept: bool) -> IndexMap:
+    keys = []
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            name, _, term = line.partition("\t")
+            keys.append(feature_key(name, term))
+    if not keys:
+        raise ValueError(f"Selected-features file {path!r} lists no features")
+    return IndexMap.build(keys, add_intercept=intercept)
+
+
+class LegacyDriver:
+    """The staged workflow object (Driver.scala:59-543)."""
+
+    def __init__(self, args: argparse.Namespace, logger, emitter: EventEmitter):
+        self.args = args
+        self.logger = logger
+        self.emitter = emitter
+        self.stage = DriverStage.INIT
+        self.stage_history: list[DriverStage] = []
+        self.task = TaskType(args.training_task)
+        self.regularization_context = RegularizationContext(
+            RegularizationType(args.regularization_type),
+            args.elastic_net_alpha
+            if RegularizationType(args.regularization_type)
+            == RegularizationType.ELASTIC_NET
+            else None,
+        )
+        self.reg_weights = [float(w) for w in args.regularization_weights.split(",") if w]
+        self.index_map: Optional[IndexMap] = None
+        self.train_data: Optional[LabeledData] = None
+        self.validation_data: Optional[LabeledData] = None
+        self.summary: Optional[FeatureDataStatistics] = None
+        self.normalization = NO_NORMALIZATION
+        self.constraint_bounds = None
+        self.lambda_models: list = []
+        self.lambda_trackers: list = []
+        self.per_model_metrics: dict = {}
+        self.best: Optional[tuple] = None
+
+    # -- stage bookkeeping (assertDriverStage/updateStage) ---------------------
+
+    def _assert_stage(self, expected: DriverStage):
+        if self.stage != expected:
+            raise RuntimeError(
+                f"Expected driver stage {expected.name} but it is {self.stage.name}"
+            )
+
+    def _update_stage(self, new: DriverStage):
+        self.stage_history.append(self.stage)
+        self.stage = new
+
+    # -- stages ----------------------------------------------------------------
+
+    def preprocess(self):
+        args = self.args
+        selected = (
+            _selected_features_map(args.selected_features_file, args.intercept)
+            if args.selected_features_file
+            else None
+        )
+        raw, self.index_map = read_avro(
+            args.training_data_directory, index_map=selected,
+            add_intercept=args.intercept,
+        )
+        if raw.n == 0:
+            raise ValueError("No training data found")
+        self.train_data = LabeledData.build(
+            raw.X, raw.labels, offsets=raw.offsets, weights=raw.weights,
+            dtype=jnp.float64,
+        )
+        self.logger.info(
+            "training data: %d samples, %d features (incl. intercept)",
+            raw.n, self.index_map.size,
+        )
+        sanity_check_data(
+            self.task, raw.labels, offsets=raw.offsets, weights=raw.weights,
+            feature_shards={"global": raw.X},
+            validation_type=DataValidationType(args.data_validation),
+        )
+
+        if args.validating_data_directory:
+            vraw, _ = read_avro(
+                args.validating_data_directory, index_map=self.index_map,
+                add_intercept=args.intercept,
+            )
+            if vraw.n == 0:
+                raise ValueError("No validation data found")
+            self.validation_data = LabeledData.build(
+                vraw.X, vraw.labels, offsets=vraw.offsets, weights=vraw.weights,
+                dtype=jnp.float64,
+            )
+            sanity_check_data(
+                self.task, vraw.labels, offsets=vraw.offsets, weights=vraw.weights,
+                feature_shards={"global": vraw.X},
+                validation_type=DataValidationType(args.data_validation),
+            )
+
+        norm_type = NormalizationType(args.normalization_type)
+        if args.summarization_output_dir or norm_type != NormalizationType.NONE:
+            self.summary = FeatureDataStatistics.compute(
+                np.asarray(self.train_data.X.to_dense()),
+                intercept_index=self.index_map.intercept_index,
+            )
+            if args.summarization_output_dir:
+                self._write_summary(args.summarization_output_dir)
+            if norm_type != NormalizationType.NONE:
+                self.normalization = NormalizationContext.build(norm_type, self.summary)
+
+        if args.coefficient_box_constraints:
+            if not self.normalization.is_identity:
+                raise ValueError(
+                    "Normalization and box constraints should not be used together"
+                )
+            self.constraint_bounds = build_bound_vectors(
+                args.coefficient_box_constraints, self.index_map
+            )
+
+    def _write_summary(self, out_dir: str):
+        from photon_ml_tpu.data import avro_io
+        from photon_ml_tpu.io.model_io import _split_key
+
+        os.makedirs(out_dir, exist_ok=True)
+        s = self.summary
+
+        def records():
+            for j in range(self.index_map.size):
+                key = self.index_map.get_feature_name(j)
+                if key is None:
+                    continue
+                name, term = _split_key(key)
+                yield {
+                    "featureName": name,
+                    "featureTerm": term,
+                    "metrics": {
+                        "mean": float(s.mean[j]),
+                        "variance": float(s.variance[j]),
+                        "min": float(s.min[j]),
+                        "max": float(s.max[j]),
+                        "numNonzeros": float(s.num_nonzeros[j]),
+                    },
+                }
+
+        avro_io.write_container(
+            os.path.join(out_dir, SUMMARY_FILE),
+            avro_io.FEATURE_SUMMARIZATION_SCHEMA,
+            records(),
+        )
+
+    def train(self):
+        self.emitter.send_event(Event("TrainingStartEvent"))
+        self.lambda_models, self.lambda_trackers = train_generalized_linear_model(
+            self.train_data,
+            self.task,
+            OptimizerType(self.args.optimizer),
+            self.regularization_context,
+            self.reg_weights,
+            normalization=self.normalization,
+            max_iterations=self.args.max_number_iterations,
+            tolerance=self.args.tolerance,
+            constraint_bounds=self.constraint_bounds,
+            use_warm_start=self.args.warm_start,
+        )
+        for lam, result in self.lambda_trackers:
+            self.logger.info(
+                "lambda=%g: %s in %d iterations (final value %.6g)",
+                lam, result.reason_name(), int(result.iterations),
+                float(result.value),
+            )
+
+    def validate(self):
+        raw = self.validation_data
+        for lam, model in self.lambda_models:
+            metrics = evaluate_model(
+                model, raw.X, np.asarray(raw.labels), np.asarray(raw.offsets)
+            )
+            self.per_model_metrics[lam] = metrics
+            for name in sorted(metrics):
+                self.logger.info("lambda=%g metric [%s] = %.6g", lam, name,
+                                 metrics[name])
+        self.best = select_best_model(
+            self.task, self.lambda_models, self.per_model_metrics
+        )
+        self.logger.info(
+            "best model: lambda=%g by %s", self.best[0], SELECTION_METRIC[self.task]
+        )
+
+    def diagnose(self, out_path: str):
+        """Drive the diagnostics tier into one HTML report (REPORT_FILE)."""
+        from photon_ml_tpu.diagnostics import (
+            Chapter,
+            Document,
+            bootstrap_section,
+            bootstrap_training,
+            expected_magnitude_importance,
+            feature_importance_section,
+            fitting_diagnostic,
+            fitting_section,
+            hosmer_lemeshow_section,
+            hosmer_lemeshow_test,
+            independence_section,
+            prediction_error_independence,
+            render_html,
+        )
+        from photon_ml_tpu.evaluation.evaluators import rmse
+        from photon_ml_tpu.optimization.common import OptimizerConfig
+        from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
+        from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+
+        mode = DiagnosticMode(self.args.diagnostic_mode)
+        best_lambda, best_model = (
+            self.best if self.best is not None else self.lambda_models[-1]
+        )
+        problem = GLMOptimizationProblem(
+            task=self.task,
+            configuration=GLMOptimizationConfiguration(
+                optimizer_config=OptimizerConfig(
+                    optimizer_type=OptimizerType(self.args.optimizer),
+                    max_iterations=self.args.max_number_iterations,
+                    tolerance=self.args.tolerance,
+                ),
+                regularization_context=self.regularization_context,
+                regularization_weight=best_lambda,
+            ),
+            normalization=self.normalization,
+        )
+
+        chapters = []
+        if mode in (DiagnosticMode.TRAIN, DiagnosticMode.ALL):
+            sections = []
+            boot = bootstrap_training(problem, self.train_data, num_bootstraps=8,
+                                      seed=7)
+            sections.append(bootstrap_section(boot))
+            if self.summary is not None:
+                fi = expected_magnitude_importance(
+                    np.asarray(best_model.coefficients.means), self.summary,
+                )
+                sections.append(feature_importance_section(fi))
+
+            def factory(subset, warm):
+                glm, _ = problem.run(subset, warm)
+                return glm, glm
+
+            fit = fitting_diagnostic(
+                self.train_data, factory, {"RMSE": rmse}, seed=11
+            )
+            sections.append(fitting_section(fit))
+            chapters.append(Chapter("Training diagnostics", sections))
+
+        if (
+            mode in (DiagnosticMode.VALIDATE, DiagnosticMode.ALL)
+            and self.validation_data is not None
+        ):
+            sections = []
+            v = self.validation_data
+            means = np.asarray(
+                best_model.predict(v.X, np.asarray(v.offsets, dtype=np.float64))
+            )
+            labels = np.asarray(v.labels, dtype=np.float64)
+            if self.task == TaskType.LOGISTIC_REGRESSION:
+                hl = hosmer_lemeshow_test(means, labels)
+                sections.append(hosmer_lemeshow_section(hl))
+            kt = prediction_error_independence(means, labels)
+            sections.append(independence_section(kt))
+            chapters.append(Chapter("Validation diagnostics", sections))
+
+        doc = Document(
+            f"Model diagnostics (best lambda = {best_lambda:g})", chapters
+        )
+        with open(out_path, "w") as f:
+            f.write(render_html(doc))
+        self.logger.info("diagnostic report written to %s", out_path)
+
+    # -- orchestration (Driver.run:145-196) ------------------------------------
+
+    def run(self):
+        args = self.args
+        out = args.output_directory
+        self._assert_stage(DriverStage.INIT)
+        with Timed("preprocess", self.logger):
+            self.preprocess()
+        self._update_stage(DriverStage.PREPROCESSED)
+
+        self._assert_stage(DriverStage.PREPROCESSED)
+        with Timed("train", self.logger):
+            self.train()
+        self._update_stage(DriverStage.TRAINED)
+
+        if args.validating_data_directory:
+            self._assert_stage(DriverStage.TRAINED)
+            with Timed("validate", self.logger):
+                self.validate()
+            self._update_stage(DriverStage.VALIDATED)
+
+        write_models_in_text(
+            self.lambda_models, os.path.join(out, LEARNED_MODELS_TEXT), self.index_map
+        )
+        if self.best is not None:
+            write_models_in_text(
+                [self.best], os.path.join(out, BEST_MODEL_TEXT), self.index_map
+            )
+
+        if DiagnosticMode(args.diagnostic_mode) != DiagnosticMode.NONE:
+            with Timed("diagnose", self.logger):
+                self.diagnose(os.path.join(out, REPORT_FILE))
+
+        with open(os.path.join(out, "stage-history.json"), "w") as f:
+            json.dump(
+                [s.name for s in self.stage_history + [self.stage]], f
+            )
+        self.emitter.send_event(Event("TrainingFinishEvent"))
+
+
+def run(args: argparse.Namespace) -> dict:
+    # process the output dir upfront and fail early (Driver.run:152-154)
+    out = args.output_directory
+    if os.path.exists(out):
+        if args.delete_output_dirs_if_exist:
+            shutil.rmtree(out)
+        elif os.listdir(out):
+            raise FileExistsError(
+                f"Output directory {out!r} exists; pass --delete-output-dirs-if-exist"
+            )
+    os.makedirs(out, exist_ok=True)
+
+    logger = PhotonLogger(
+        os.path.join(args.output_directory, "logs", "photon.log"),
+        level=args.log_level,
+    )
+    emitter = EventEmitter()
+    emitter.send_event(Event("PhotonSetupEvent"))
+    driver = LegacyDriver(args, logger, emitter)
+    driver.run()
+    return {
+        "stage": driver.stage.name,
+        "models": len(driver.lambda_models),
+        "best_lambda": None if driver.best is None else driver.best[0],
+    }
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    try:
+        run(args)
+    except Exception as e:  # pragma: no cover - CLI surface
+        print(f"legacy-driver: error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

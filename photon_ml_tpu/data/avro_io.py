@@ -474,3 +474,15 @@ FEATURE_SUMMARIZATION_SCHEMA = {
         {"name": "metrics", "type": {"type": "map", "values": "double"}},
     ],
 }
+
+# LatentFactorAvro.avsc — matrix-factorization latent factors keyed by effect id
+# (kept for wire-format completeness with the reference's 8 schemas)
+LATENT_FACTOR_SCHEMA = {
+    "name": "LatentFactorAvro",
+    "namespace": "com.linkedin.photon.avro.generated",
+    "type": "record",
+    "fields": [
+        {"name": "effectId", "type": "string"},
+        {"name": "latentFactor", "type": {"type": "array", "items": "double"}},
+    ],
+}

@@ -1,0 +1,92 @@
+"""Legacy single-GLM training facade.
+
+Parity target: photon-api ModelTraining.trainGeneralizedLinearModel
+(ModelTraining.scala:34-229) — one fixed-effect GLM per regularization weight,
+weights sorted ascending with each solve warm-started from the previous one,
+returning ``[(lambda, model), ...]`` in the caller's weight order plus optional
+per-model optimization trackers. Consumed by the legacy Driver
+(Driver.scala:310-345) and its stage workflow.
+
+The Spark treeAggregate machinery is gone: every solve is one jitted program
+through the shared solver cache (sharding of the input arrays decides where it
+runs), and the warm-started sweep reuses a single compiled program because the
+regularization weight is a traced argument.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.normalization import NO_NORMALIZATION, NormalizationContext
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
+
+
+def train_generalized_linear_model(
+    data: LabeledData,
+    task: TaskType,
+    optimizer_type: OptimizerType,
+    regularization_context: RegularizationContext,
+    regularization_weights: Sequence[float],
+    *,
+    normalization: NormalizationContext = NO_NORMALIZATION,
+    max_iterations: int = 100,
+    tolerance: float = 1e-7,
+    constraint_bounds: Optional[tuple] = None,
+    use_warm_start: bool = True,
+    track_states: bool = False,
+    variance_computation: VarianceComputationType = VarianceComputationType.NONE,
+) -> tuple[list[tuple[float, GeneralizedLinearModel]], list[tuple[float, object]]]:
+    """Returns ([(lambda, model)] in input weight order, [(lambda, OptResult)]).
+
+    Solves iterate over DESCENDING weights with warm start (ModelTraining.scala:
+    175 sorts ``_ >= _``: strong -> weak regularization, each model starting
+    from the previous optimum); with ``use_warm_start=False`` every solve
+    starts from zero.
+    """
+    if not regularization_weights:
+        raise ValueError("At least one regularization weight is required")
+    task = TaskType(task)
+    lower, upper = (None, None) if constraint_bounds is None else constraint_bounds
+
+    models: dict[float, GeneralizedLinearModel] = {}
+    trackers: list[tuple[float, object]] = []
+    warm: Optional[GeneralizedLinearModel] = None
+    for weight in sorted(set(float(w) for w in regularization_weights), reverse=True):
+        problem = GLMOptimizationProblem(
+            task=task,
+            configuration=GLMOptimizationConfiguration(
+                optimizer_config=OptimizerConfig(
+                    optimizer_type=OptimizerType(optimizer_type),
+                    max_iterations=max_iterations,
+                    tolerance=tolerance,
+                    track_states=track_states,
+                ),
+                regularization_context=regularization_context,
+                regularization_weight=weight,
+            ),
+            normalization=normalization,
+            variance_computation=variance_computation,
+        )
+        model, result = problem.run(
+            data,
+            warm if use_warm_start else None,
+            lower_bounds=lower,
+            upper_bounds=upper,
+        )
+        models[weight] = model
+        trackers.append((weight, result))
+        warm = model
+
+    ordered = [(float(w), models[float(w)]) for w in regularization_weights]
+    return ordered, trackers

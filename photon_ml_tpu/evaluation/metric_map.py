@@ -1,0 +1,136 @@
+"""Driver-side metric maps + model selection for the legacy single-GLM path.
+
+Parity targets: photon-client evaluation/Evaluation.scala:43-196 (metric map per
+task facet: regression MAE/MSE/RMSE, binary-classifier AUPR/AUROC/peak-F1,
+Poisson/logistic per-sample log-likelihood, AIC with small-sample correction)
+and ModelSelection.scala:30-92 (best model per task's selection metric). Scores
+are MEAN-function outputs (link inverse applied), exactly like
+``computeMeanFunctionWithOffset`` in the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from photon_ml_tpu.evaluation.evaluators import auc_pr, auc_roc
+from photon_ml_tpu.types import TaskType
+
+MEAN_ABSOLUTE_ERROR = "MEAN_ABSOLUTE_ERROR"
+MEAN_SQUARE_ERROR = "MEAN_SQUARE_ERROR"
+ROOT_MEAN_SQUARE_ERROR = "ROOT_MEAN_SQUARE_ERROR"
+AREA_UNDER_PRECISION_RECALL = "AREA_UNDER_PRECISION_RECALL"
+AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS = "AREA_UNDER_ROC"
+PEAK_F1_SCORE = "PEAK_F1_SCORE"
+DATA_LOG_LIKELIHOOD = "DATA_LOG_LIKELIHOOD"
+AKAIKE_INFORMATION_CRITERION = "AKAIKE_INFORMATION_CRITERION"
+
+# metric -> larger_is_better (Evaluation.metricMetadata ordering)
+LARGER_IS_BETTER: Mapping[str, bool] = {
+    MEAN_ABSOLUTE_ERROR: False,
+    MEAN_SQUARE_ERROR: False,
+    ROOT_MEAN_SQUARE_ERROR: False,
+    AREA_UNDER_PRECISION_RECALL: True,
+    AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS: True,
+    PEAK_F1_SCORE: True,
+    DATA_LOG_LIKELIHOOD: True,
+    AKAIKE_INFORMATION_CRITERION: False,
+}
+
+_REGRESSION_TASKS = (TaskType.LINEAR_REGRESSION, TaskType.POISSON_REGRESSION)
+_CLASSIFIER_TASKS = (
+    TaskType.LOGISTIC_REGRESSION,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+)
+
+# ModelSelection.scala:30-92 — the per-task selection metric
+SELECTION_METRIC: Mapping[TaskType, str] = {
+    TaskType.LOGISTIC_REGRESSION: AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS,
+    TaskType.LINEAR_REGRESSION: ROOT_MEAN_SQUARE_ERROR,
+    TaskType.POISSON_REGRESSION: DATA_LOG_LIKELIHOOD,
+}
+
+
+def _peak_f1(scores: np.ndarray, labels: np.ndarray) -> float:
+    """max_t F1(t) over all score thresholds (BinaryClassificationMetrics
+    fMeasureByThreshold analog, computed exactly by sorting)."""
+    order = np.argsort(-scores, kind="mergesort")
+    y = labels[order]
+    tp = np.cumsum(y)
+    fp = np.cumsum(1.0 - y)
+    pos = y.sum()
+    precision = tp / np.maximum(tp + fp, 1e-12)
+    recall = tp / max(pos, 1e-12)
+    f1 = 2.0 * precision * recall / np.maximum(precision + recall, 1e-12)
+    return float(f1.max()) if len(f1) else float("nan")
+
+
+def evaluate_model(model, X, labels, offsets=None) -> dict[str, float]:
+    """Metric map for one GLM on one dataset (Evaluation.evaluate:55-130)."""
+    labels = np.asarray(labels, dtype=np.float64)
+    n = len(labels)
+    offsets = np.zeros(n) if offsets is None else np.asarray(offsets, dtype=np.float64)
+    from photon_ml_tpu.data.matrix import as_design_matrix
+
+    Xm = as_design_matrix(X, dtype=np.asarray(model.coefficients.means).dtype)
+    means = np.asarray(model.predict(Xm, offsets), dtype=np.float64)
+
+    task = TaskType(model.task)
+    metrics: dict[str, float] = {}
+
+    if task in _REGRESSION_TASKS:
+        err = means - labels
+        metrics[MEAN_ABSOLUTE_ERROR] = float(np.abs(err).mean())
+        metrics[MEAN_SQUARE_ERROR] = float((err**2).mean())
+        metrics[ROOT_MEAN_SQUARE_ERROR] = float(np.sqrt((err**2).mean()))
+
+    if task in _CLASSIFIER_TASKS:
+        metrics[AREA_UNDER_PRECISION_RECALL] = auc_pr(means, labels)
+        metrics[AREA_UNDER_RECEIVER_OPERATOR_CHARACTERISTICS] = auc_roc(means, labels)
+        metrics[PEAK_F1_SCORE] = _peak_f1(means, labels)
+
+    if task == TaskType.POISSON_REGRESSION:
+        # mean log-likelihood: y*log(mu) - mu - log(y!)
+        mu = np.maximum(means, 1e-12)
+        ll = labels * np.log(mu) - mu - np.array([math.lgamma(y + 1.0) for y in labels])
+        metrics[DATA_LOG_LIKELIHOOD] = float(ll.mean())
+    elif task == TaskType.LOGISTIC_REGRESSION:
+        p = np.clip(means, 1e-12, 1.0 - 1e-12)
+        ll = labels * np.log(p) + (1.0 - labels) * np.log(1.0 - p)
+        metrics[DATA_LOG_LIKELIHOOD] = float(ll.mean())
+
+    if DATA_LOG_LIKELIHOOD in metrics:
+        log_likelihood = n * metrics[DATA_LOG_LIKELIHOOD]
+        k = int(np.sum(np.abs(np.asarray(model.coefficients.means)) > 1e-9))
+        base_aic = 2.0 * (k - log_likelihood)
+        denom = n - k - 1.0
+        if denom > 0:
+            metrics[AKAIKE_INFORMATION_CRITERION] = (
+                base_aic + 2.0 * k * (k + 1) / denom
+            )
+        else:
+            metrics[AKAIKE_INFORMATION_CRITERION] = base_aic
+
+    return metrics
+
+
+def select_best_model(
+    task: TaskType,
+    lambda_models: Sequence[tuple[float, object]],
+    per_model_metrics: Mapping[float, Mapping[str, float]],
+) -> tuple[float, object]:
+    """Best (lambda, model) by the task's selection metric
+    (ModelSelection.selectModelByKey:75-92)."""
+    metric = SELECTION_METRIC[TaskType(task)]
+    larger = LARGER_IS_BETTER[metric]
+    best = None
+    for lam, model in lambda_models:
+        v = per_model_metrics[lam][metric]
+        if best is None or (v > best[0] if larger else v < best[0]):
+            best = (v, lam, model)
+    if best is None:
+        raise ValueError("No models to select from")
+    return best[1], best[2]

@@ -286,3 +286,48 @@ def load_game_model(
         models = ordered
 
     return GameModel(models=models)
+
+
+def write_models_in_text(
+    lambda_models,
+    model_dir: str,
+    index_map: IndexMap,
+) -> None:
+    """Legacy text model output (IOUtils.writeModelsInText:241-280): one part
+    file per (regWeight, model), rows sorted by coefficient value DESCENDING,
+    tab-separated ``name\\tterm\\tvalue\\tregWeight``."""
+    os.makedirs(model_dir, exist_ok=True)
+    for part, (reg_weight, model) in enumerate(lambda_models):
+        means = np.asarray(model.coefficients.means)
+        order = np.argsort(-means, kind="mergesort")
+        lines = []
+        for j in order:
+            key = index_map.get_feature_name(int(j))
+            if key is None:
+                continue
+            name, term = _split_key(key)
+            lines.append(f"{name}\t{term}\t{float(means[j])}\t{float(reg_weight)}")
+        with open(os.path.join(model_dir, f"part-{part:05d}.txt"), "w") as f:
+            f.write("\n".join(lines) + ("\n" if lines else ""))
+
+
+def read_models_from_text(model_dir: str, index_map: IndexMap):
+    """Inverse of write_models_in_text: [(reg_weight, coefficient vector)]."""
+    out = []
+    for fname in sorted(os.listdir(model_dir)):
+        if not fname.endswith(".txt"):
+            continue
+        vec = np.zeros(index_map.size)
+        weight = None
+        with open(os.path.join(model_dir, fname)) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                name, term, value, reg = line.rstrip("\n").split("\t")
+                weight = float(reg)
+                j = index_map.get_index(feature_key(name, term))
+                if j >= 0:
+                    vec[j] = float(value)
+        if weight is not None:
+            out.append((weight, vec))
+    return out

@@ -1,0 +1,167 @@
+"""Legacy single-GLM staged driver (Driver.scala:59-543): stage progression,
+warm-started lambda sweep, metric map + model selection, text model output,
+and the one-file HTML diagnostic report."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.legacy_driver import main
+from photon_ml_tpu.data import avro_io
+from photon_ml_tpu.data.index_map import IndexMap, feature_key
+from photon_ml_tpu.io.model_io import read_models_from_text
+
+D = 4
+
+
+def _write_avro(path, rng, n=300, w=None, task="logistic"):
+    if w is None:
+        w = rng.normal(size=D)
+    X = rng.normal(size=(n, D))
+    z = X @ w
+    if task == "logistic":
+        y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(float)
+    else:
+        y = z + 0.1 * rng.normal(size=n)
+
+    def records():
+        for i in range(n):
+            yield {
+                "uid": f"s{i}",
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"f{j}", "term": "t", "value": float(X[i, j])}
+                    for j in range(D)
+                ],
+                "metadataMap": {},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, records())
+    return w
+
+
+class TestLegacyDriver:
+    def _run(self, tmp_path, rng, extra=(), validate=True, task="LOGISTIC_REGRESSION"):
+        train = tmp_path / "train"
+        train.mkdir()
+        kind = "logistic" if task == "LOGISTIC_REGRESSION" else "linear"
+        w = _write_avro(str(train / "part-0.avro"), rng, task=kind)
+        args = [
+            "--training-data-directory", str(train),
+            "--output-directory", str(tmp_path / "out"),
+            "--training-task", task,
+            "--regularization-weights", "0.1,10",
+            "--max-number-iterations", "50",
+        ]
+        if validate:
+            val = tmp_path / "val"
+            val.mkdir()
+            _write_avro(str(val / "part-0.avro"), rng, w=w, task=kind)
+            args += ["--validating-data-directory", str(val)]
+        rc = main(args + list(extra))
+        return rc, tmp_path / "out", w
+
+    def test_full_staged_run(self, rng, tmp_path):
+        rc, out, _ = self._run(tmp_path, rng)
+        assert rc == 0
+        stages = json.loads((out / "stage-history.json").read_text())
+        assert stages == ["INIT", "PREPROCESSED", "TRAINED", "VALIDATED"]
+        # one text part file per lambda + a best-model dir
+        parts = sorted(os.listdir(out / "learned-models-text"))
+        assert len(parts) == 2
+        assert os.listdir(out / "best-model-text")
+
+    def test_text_models_round_trip(self, rng, tmp_path):
+        rc, out, _ = self._run(tmp_path, rng)
+        assert rc == 0
+        imap = IndexMap.build(
+            [feature_key(f"f{j}", "t") for j in range(D)], add_intercept=True
+        )
+        models = read_models_from_text(str(out / "learned-models-text"), imap)
+        assert {lam for lam, _ in models} == {0.1, 10.0}
+        for _, vec in models:
+            assert np.abs(vec).max() > 0
+        # stronger regularization -> smaller coefficients
+        by_lam = dict(models)
+        icpt = imap.intercept_index
+        mask = np.ones(imap.size, bool)
+        mask[icpt] = False
+        assert np.abs(by_lam[10.0][mask]).sum() < np.abs(by_lam[0.1][mask]).sum()
+
+    def test_validation_free_run_stops_at_trained(self, rng, tmp_path):
+        rc, out, _ = self._run(tmp_path, rng, validate=False)
+        assert rc == 0
+        stages = json.loads((out / "stage-history.json").read_text())
+        assert stages[-1] == "TRAINED"
+        assert not (out / "best-model-text").exists()
+
+    def test_diagnostic_report(self, rng, tmp_path):
+        rc, out, _ = self._run(tmp_path, rng, extra=["--diagnostic-mode", "ALL"])
+        assert rc == 0
+        html = (out / "model-diagnostic.html").read_text()
+        assert "Bootstrap confidence intervals" in html
+        assert "Hosmer-Lemeshow" in html
+        assert "<svg" in html
+
+    def test_linear_task_with_constraints(self, rng, tmp_path):
+        constraints = json.dumps(
+            [{"name": "*", "term": "*", "lowerBound": -0.25, "upperBound": 0.25}]
+        )
+        rc, out, _ = self._run(
+            tmp_path, rng, task="LINEAR_REGRESSION",
+            extra=["--coefficient-box-constraints", constraints],
+        )
+        assert rc == 0
+        imap = IndexMap.build(
+            [feature_key(f"f{j}", "t") for j in range(D)], add_intercept=True
+        )
+        models = read_models_from_text(str(out / "learned-models-text"), imap)
+        mask = np.ones(imap.size, bool)
+        mask[imap.intercept_index] = False
+        for _, vec in models:
+            assert np.all(np.abs(vec[mask]) <= 0.25 + 1e-8)
+
+    def test_selected_features_file(self, rng, tmp_path):
+        sel = tmp_path / "selected.tsv"
+        sel.write_text("f0\tt\nf1\tt\n")
+        rc, out, _ = self._run(
+            tmp_path, rng, extra=["--selected-features-file", str(sel)]
+        )
+        assert rc == 0
+        lines = []
+        for p in os.listdir(out / "learned-models-text"):
+            lines += (out / "learned-models-text" / p).read_text().splitlines()
+        names = {line.split("\t")[0] for line in lines if line}
+        assert names <= {"f0", "f1", "(INTERCEPT)"}
+
+    def test_summarization_output(self, rng, tmp_path):
+        rc, out, _ = self._run(
+            tmp_path, rng,
+            extra=["--summarization-output-dir", str(tmp_path / "summary")],
+        )
+        assert rc == 0
+        recs = list(
+            avro_io.read_container_dir(str(tmp_path / "summary"))
+        )
+        assert len(recs) == D + 1  # features + intercept
+        assert {"mean", "variance", "min", "max", "numNonzeros"} <= set(
+            recs[0]["metrics"]
+        )
+
+    def test_existing_output_dir_fails_early(self, rng, tmp_path):
+        out = tmp_path / "out"
+        out.mkdir()
+        (out / "junk").write_text("x")
+        train = tmp_path / "train"
+        train.mkdir()
+        _write_avro(str(train / "part-0.avro"), rng)
+        rc = main([
+            "--training-data-directory", str(train),
+            "--output-directory", str(out),
+            "--training-task", "LOGISTIC_REGRESSION",
+        ])
+        assert rc == 1
