@@ -44,6 +44,9 @@ class CoordinateDescentResult:
     metrics_history: list  # [(iteration, coordinate_id, {metric: value})]
     trackers: dict  # coordinate_id -> [tracker per update]
     training_scores: dict  # coordinate_id -> final [N] score array
+    # full metrics dict of the best snapshot (survives checkpoint resume, where
+    # the row that set best_metric may predate the resumed metrics_history)
+    best_metrics: Optional[dict] = None
 
     @property
     def has_validation(self) -> bool:
@@ -56,6 +59,7 @@ def run_coordinate_descent(
     initial_models: Optional[Mapping[str, object]] = None,
     validation_datasets: Optional[Mapping[str, object]] = None,
     evaluation_suite: Optional[EvaluationSuite] = None,
+    checkpointer: Optional[object] = None,
 ) -> CoordinateDescentResult:
     """Run block coordinate descent (CoordinateDescent.run/descend:93-346).
 
@@ -63,6 +67,12 @@ def run_coordinate_descent(
     coordinates are scored, never updated. ``validation_datasets`` must cover every
     coordinate id when ``evaluation_suite`` is given; validation scores are summed
     across coordinates and handed to the suite after each update.
+
+    ``checkpointer`` (io/checkpoint.CoordinateDescentCheckpointer) enables
+    iteration-level failure recovery: after each completed iteration the models +
+    best-model snapshot are saved atomically, and a rerun with the same
+    checkpointer resumes from the last completed iteration (training scores are
+    recomputed from the restored models — they are pure functions of them).
     """
     if n_iterations < 1:
         raise ValueError(f"n_iterations must be >= 1, got {n_iterations}")
@@ -79,12 +89,56 @@ def run_coordinate_descent(
         if missing:
             raise ValueError(f"Missing validation datasets for coordinates {missing}")
 
+    # --- resume from checkpoint (overrides initial_models) -----------------------
+    start_iteration = 0
+    restored_best_models = None
+    restored_best_metric = None
+    restored_best_metrics = None
+    if checkpointer is not None:
+        restored = checkpointer.restore()
+        if restored is not None and set(restored["models"]) != set(coordinate_ids):
+            logger.warning(
+                "Ignoring checkpoint: coordinates %s do not match this run's %s",
+                sorted(restored["models"]),
+                sorted(coordinate_ids),
+            )
+            restored = None
+        if restored is not None:
+            start_iteration = restored["completed_iterations"]
+            initial_models = restored["models"]
+            restored_best_models = restored["best_models"]
+            restored_best_metric = restored["best_metric"]
+            restored_best_metrics = restored.get("best_metrics")
+            if start_iteration > n_iterations:
+                logger.warning(
+                    "Checkpoint has %d completed iterations but only %d were "
+                    "requested; returning the checkpointed state unchanged "
+                    "(clear the checkpoint directory to retrain from scratch)",
+                    start_iteration,
+                    n_iterations,
+                )
+            else:
+                logger.info(
+                    "Resuming coordinate descent from checkpoint: %d/%d iterations done",
+                    start_iteration,
+                    n_iterations,
+                )
+
     # --- initialize models and their training/validation scores -----------------
     models: dict[str, object] = {}
     train_scores: dict[str, Array] = {}
     val_scores: dict[str, Array] = {}
     for cid, coord in coordinates.items():
         init = None if initial_models is None else initial_models.get(cid)
+        if (
+            start_iteration > 0
+            and init is not None
+            and hasattr(init, "aligned_to")
+            and hasattr(coord, "dataset")
+            and hasattr(coord.dataset, "entity_ids")
+        ):
+            # restored RE models re-align to the (rebuilt) dataset's entity rows
+            init = init.aligned_to(coord.dataset)
         model = init if init is not None else coord.initialize_model()
         models[cid] = model
         train_scores[cid] = coord.score(model)
@@ -99,15 +153,23 @@ def run_coordinate_descent(
     metrics_history: list = []
     best_model: Optional[GameModel] = None
     best_metric: Optional[float] = None
+    best_metrics: Optional[dict] = None
+    if restored_best_models is not None:
+        best_model = GameModel(models=restored_best_models)
+        best_metric = restored_best_metric
+        best_metrics = restored_best_metrics
     primary = evaluation_suite.primary if validate else None
 
     updatable = [cid for cid in coordinate_ids if not coordinates[cid].is_locked]
     if not updatable:
         raise ValueError("All coordinates are locked; nothing to train")
 
-    full_train_score = sum(train_scores.values())
-
-    for iteration in range(n_iterations):
+    for iteration in range(start_iteration, n_iterations):
+        # Recompute (not accumulate) the total at each iteration boundary: the
+        # state is then a pure function of the models dict, which makes a
+        # checkpoint-resumed run BIT-identical to an uninterrupted one (resume
+        # restores models and recomputes scores the same way).
+        full_train_score = sum(train_scores.values())
         for cid in updatable:
             coord = coordinates[cid]
             t0 = time.perf_counter()
@@ -137,7 +199,18 @@ def run_coordinate_descent(
                 logger.info("iter %d coordinate %s: validation %s", iteration, cid, metrics)
                 if primary.better_than(metric, best_metric):
                     best_metric = metric
+                    best_metrics = metrics
                     best_model = GameModel(models=dict(models))
+
+        if checkpointer is not None:
+            checkpointer.maybe_save(
+                iteration + 1,
+                dict(models),
+                None if best_model is None else dict(best_model.models),
+                best_metric,
+                best_metrics,
+                force=(iteration + 1 == n_iterations),
+            )
 
     final_model = GameModel(models=dict(models))
     if best_model is None:
@@ -149,4 +222,5 @@ def run_coordinate_descent(
         metrics_history=metrics_history,
         trackers=trackers,
         training_scores=dict(train_scores),
+        best_metrics=best_metrics,
     )

@@ -118,6 +118,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "programs (the reference's distributed path)")
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="Device count for --compute-backend=mesh (default: all)")
+    p.add_argument("--checkpoint-directory", default=None,
+                   help="Enable iteration-level checkpoint/resume: coordinate "
+                        "descent saves models here after each iteration and a "
+                        "rerun with the same directory resumes from the last "
+                        "completed iteration")
+    p.add_argument("--checkpoint-interval", type=int, default=1,
+                   help="Save every k-th coordinate-descent iteration")
     # Spark-isms accepted for 1:1 invocation compatibility (no-ops here)
     p.add_argument("--min-validation-partitions", type=int, default=None,
                    help=argparse.SUPPRESS)
@@ -359,6 +366,8 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             validation_evaluators=evaluator_specs,
             partial_retrain_locked_coordinates=locked,
             mesh=mesh,
+            checkpoint_directory=args.checkpoint_directory,
+            checkpoint_interval=args.checkpoint_interval,
         )
 
         emitter.send_event(Event("TrainingStartEvent"))

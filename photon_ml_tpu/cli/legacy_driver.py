@@ -219,9 +219,10 @@ class LegacyDriver:
 
         norm_type = NormalizationType(args.normalization_type)
         if args.summarization_output_dir or norm_type != NormalizationType.NONE:
+            # summarize from the host-side matrix as read (sparse stays sparse
+            # — FeatureDataStatistics has a never-densify CSC path)
             self.summary = FeatureDataStatistics.compute(
-                np.asarray(self.train_data.X.to_dense()),
-                intercept_index=self.index_map.intercept_index,
+                raw.X, intercept_index=self.index_map.intercept_index
             )
             if args.summarization_output_dir:
                 self._write_summary(args.summarization_output_dir)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from typing import Mapping, Optional, Sequence
 
 import jax.numpy as jnp
@@ -114,6 +115,13 @@ class GameEstimator:
     # placement. Matches GameEstimator.fit:299-380 driving the distributed
     # coordinates in the reference — here distribution is array placement.
     mesh: Optional[object] = None
+    # Iteration-level failure recovery (io/checkpoint.py): per sweep config i,
+    # coordinate descent saves models after every checkpoint_interval-th
+    # iteration under <checkpoint_directory>/config_<i> and a rerun resumes
+    # from the last completed iteration. The reference has no equivalent — it
+    # leans on Spark lineage recomputation (CoordinateDescent.scala:130-160).
+    checkpoint_directory: Optional[str] = None
+    checkpoint_interval: int = 1
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -333,17 +341,41 @@ class GameEstimator:
                         and hasattr(init, "aligned_to")
                         else init
                     )
+            checkpointer = None
+            if self.checkpoint_directory is not None:
+                from photon_ml_tpu.io.checkpoint import CoordinateDescentCheckpointer
+
+                # fingerprint ties the checkpoint to (task, this config, data
+                # size): a rerun with changed hyperparameters or data rejects
+                # the stale checkpoint instead of silently resuming from it
+                fp_parts = [
+                    str(TaskType(self.task).value),
+                    str(data.n),
+                    # validation identity: best_metric restored from a
+                    # checkpoint must be comparable to metrics of this run
+                    f"val={validation_data.n if validation_data is not None else 0}",
+                    f"evals={[str(e) for e in self.validation_evaluators]}",
+                ]
+                for cid in sorted(self.coordinate_configurations):
+                    fp_parts.append(f"{cid}={opt_configs[cid]!r}")
+                checkpointer = CoordinateDescentCheckpointer(
+                    os.path.join(self.checkpoint_directory, f"config_{i}"),
+                    interval=self.checkpoint_interval,
+                    dtype=self.dtype,
+                    fingerprint="|".join(fp_parts),
+                )
             descent = run_coordinate_descent(
                 coordinates,
                 n_iterations=self.n_iterations,
                 initial_models=init_models or None,
                 validation_datasets=validation_datasets,
                 evaluation_suite=suite,
+                checkpointer=checkpointer,
             )
             evaluations = None
-            if suite is not None and descent.metrics_history:
+            if suite is not None and (descent.metrics_history or descent.best_metrics):
                 # metrics of the best snapshot = the history row that set best_metric
-                evaluations = _metrics_of_best(descent, suite)
+                evaluations = _metrics_of_best(descent)
             results.append(
                 GameResult(
                     model=descent.model,
@@ -373,10 +405,10 @@ class GameEstimator:
         return best
 
 
-def _metrics_of_best(descent: CoordinateDescentResult, suite: EvaluationSuite):
-    primary = suite.primary
-    for _, _, metrics in descent.metrics_history:
-        if metrics[primary.name] == descent.best_metric:
-            return metrics
-    return descent.metrics_history[-1][2]
+def _metrics_of_best(descent: CoordinateDescentResult):
+    # best_metrics is recorded whenever best_metric is set; the fallback covers
+    # only the degenerate no-best case (all metrics non-comparable)
+    if descent.best_metrics is not None:
+        return descent.best_metrics
+    return descent.metrics_history[-1][2] if descent.metrics_history else None
 

@@ -1,0 +1,311 @@
+"""Iteration-level checkpoint/resume for coordinate descent.
+
+The reference delegates failure recovery to Spark (RDD lineage recomputation +
+DISK_ONLY persistence, CoordinateDescent.scala:130-160); it checkpoints models
+only at the end of a full run (ModelProcessingUtils.saveGameModelToHDFS:77-141).
+A single-controller JAX program has no lineage to replay, so recovery is explicit:
+after every completed coordinate-descent iteration the full GAME model state —
+current models, best-model snapshot, best metric — is written atomically to disk,
+and a restarted run resumes from the last completed iteration. Training scores
+are pure functions of the models, so nothing else needs saving: resume
+reinitializes from the checkpointed models and recomputes scores exactly.
+
+Format: one ``.npz`` per coordinate (raw arrays, no pickling) plus a
+``state.json`` manifest; writes go to a temp directory renamed into place so a
+crash mid-write can never corrupt the latest checkpoint. This is the *internal*
+fast format — final model export still uses the reference-compatible
+BayesianLinearModelAvro layout (io/model_io.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients, model_class_for_task
+from photon_ml_tpu.types import TaskType
+
+STATE_FILE = "state.json"
+BEST_DIR = "best"
+_TMP_SUFFIX = ".tmp"
+
+
+# ------------------------------------------------------------- model <-> arrays
+
+
+def _model_to_arrays(model) -> tuple[dict, dict]:
+    """(json-metadata, arrays) for one coordinate model."""
+    if isinstance(model, FixedEffectModel):
+        glm = model.model
+        meta = {
+            "kind": "fixed",
+            "feature_shard_id": model.feature_shard_id,
+            "task": TaskType(glm.task).value,
+        }
+        arrays = {"means": np.asarray(glm.coefficients.means)}
+        if glm.coefficients.variances is not None:
+            arrays["variances"] = np.asarray(glm.coefficients.variances)
+        return meta, arrays
+
+    if isinstance(model, RandomEffectModel):
+        entity_ids = list(model.entity_ids)
+        ids_are_int = all(isinstance(e, (int, np.integer)) for e in entity_ids)
+        meta = {
+            "kind": "random",
+            "re_type": model.re_type,
+            "feature_shard_id": model.feature_shard_id,
+            "task": TaskType(model.task).value,
+            "entity_ids_int": ids_are_int,
+        }
+        arrays = {
+            "coeffs": np.asarray(model.coeffs),
+            "proj_indices": np.asarray(model.proj_indices),
+            "entity_ids": (
+                np.asarray(entity_ids, dtype=np.int64)
+                if ids_are_int
+                else np.asarray([str(e) for e in entity_ids])
+            ),
+        }
+        if model.variances is not None:
+            arrays["variances"] = np.asarray(model.variances)
+        proj = model.projector
+        if proj is not None:
+            from photon_ml_tpu.data.projector import RandomProjector
+
+            if not isinstance(proj, RandomProjector):
+                raise TypeError(
+                    f"Cannot checkpoint projector of type {type(proj).__name__}"
+                )
+            arrays["projector_matrix"] = np.asarray(proj.matrix)
+            meta["projector_intercept_index"] = proj.intercept_index
+            norm = proj.normalization
+            if norm is not None:
+                meta["projector_norm_intercept_index"] = norm.intercept_index
+                if norm.factors is not None:
+                    arrays["projector_norm_factors"] = np.asarray(norm.factors)
+                if norm.shifts is not None:
+                    arrays["projector_norm_shifts"] = np.asarray(norm.shifts)
+        return meta, arrays
+
+    raise TypeError(f"Unknown model type: {type(model).__name__}")
+
+
+def _model_from_arrays(meta: dict, arrays, dtype) -> object:
+    task = TaskType(meta["task"])
+    if meta["kind"] == "fixed":
+        variances = arrays.get("variances")
+        coeffs = Coefficients(
+            means=jnp.asarray(arrays["means"], dtype=dtype),
+            variances=None if variances is None else jnp.asarray(variances, dtype=dtype),
+        )
+        return FixedEffectModel(
+            model=model_class_for_task(task)(coeffs),
+            feature_shard_id=meta["feature_shard_id"],
+        )
+
+    entity_ids = arrays["entity_ids"]
+    ids = (
+        tuple(int(e) for e in entity_ids)
+        if meta["entity_ids_int"]
+        else tuple(str(e) for e in entity_ids)
+    )
+    projector = None
+    if "projector_matrix" in arrays:
+        from photon_ml_tpu.data.projector import RandomProjector
+        from photon_ml_tpu.normalization import NormalizationContext
+
+        norm = None
+        if "projector_norm_factors" in arrays or "projector_norm_shifts" in arrays:
+            norm = NormalizationContext(
+                factors=arrays.get("projector_norm_factors"),
+                shifts=arrays.get("projector_norm_shifts"),
+                intercept_index=meta.get("projector_norm_intercept_index"),
+            )
+        projector = RandomProjector(
+            matrix=arrays["projector_matrix"],
+            intercept_index=meta.get("projector_intercept_index"),
+            normalization=norm,
+        )
+    variances = arrays.get("variances")
+    return RandomEffectModel(
+        re_type=meta["re_type"],
+        feature_shard_id=meta["feature_shard_id"],
+        task=task,
+        entity_ids=ids,
+        coeffs=jnp.asarray(arrays["coeffs"], dtype=dtype),
+        proj_indices=jnp.asarray(arrays["proj_indices"], dtype=jnp.int32),
+        variances=None if variances is None else jnp.asarray(variances, dtype=dtype),
+        projector=projector,
+    )
+
+
+# ------------------------------------------------------------------ save / load
+
+
+def _write_models(directory: str, models: dict, manifest: dict) -> None:
+    for cid, model in models.items():
+        meta, arrays = _model_to_arrays(model)
+        manifest[cid] = meta
+        np.savez(os.path.join(directory, f"{cid}.npz"), **arrays)
+
+
+def _read_models(directory: str, manifest: dict, dtype) -> dict:
+    models = {}
+    for cid, meta in manifest.items():
+        with np.load(os.path.join(directory, f"{cid}.npz"), allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        models[cid] = _model_from_arrays(meta, arrays, dtype)
+    return models
+
+
+def save_checkpoint(
+    directory: str,
+    models: dict,
+    completed_iterations: int,
+    best_models: Optional[dict] = None,
+    best_metric: Optional[float] = None,
+    best_metrics: Optional[dict] = None,
+    fingerprint: Optional[str] = None,
+) -> None:
+    """Atomically write a coordinate-descent checkpoint (tmp dir + rename).
+
+    ``fingerprint`` identifies the run configuration; ``load_checkpoint`` with a
+    different fingerprint refuses the checkpoint, so a rerun with changed
+    hyperparameters/data cannot silently reuse stale trained state."""
+    parent = os.path.dirname(os.path.abspath(directory)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.abspath(directory) + _TMP_SUFFIX
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    state = {
+        "completed_iterations": int(completed_iterations),
+        "fingerprint": fingerprint,
+        "best_metric": None if best_metric is None else float(best_metric),
+        "best_metrics": (
+            None
+            if best_metrics is None
+            else {k: float(v) for k, v in best_metrics.items()}
+        ),
+        "models": {},
+        "best_models": None,
+    }
+    _write_models(tmp, models, state["models"])
+    if best_models is not None:
+        best_dir = os.path.join(tmp, BEST_DIR)
+        os.makedirs(best_dir)
+        state["best_models"] = {}
+        _write_models(best_dir, best_models, state["best_models"])
+
+    with open(os.path.join(tmp, STATE_FILE), "w") as f:
+        json.dump(state, f)
+
+    final = os.path.abspath(directory)
+    if os.path.exists(final):
+        old = final + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.rename(final, old)
+        os.rename(tmp, final)
+        shutil.rmtree(old)
+    else:
+        os.rename(tmp, final)
+
+
+def load_checkpoint(
+    directory: str, dtype=jnp.float32, fingerprint: Optional[str] = None
+) -> Optional[dict]:
+    """Returns {completed_iterations, models, best_models, best_metric} or None
+    when no (complete) checkpoint exists. A leftover ``.tmp`` dir from a crash
+    mid-write is ignored; a ``.old`` dir left by a crash *between* the two
+    overwrite renames is recovered as the latest complete checkpoint. A saved
+    ``fingerprint`` differing from the requested one rejects the checkpoint."""
+    directory = os.path.abspath(directory)
+    state_path = os.path.join(directory, STATE_FILE)
+    if not os.path.exists(state_path):
+        # crash window in save_checkpoint: final was renamed to .old but .tmp
+        # was not yet promoted — the .old dir is the last complete checkpoint
+        old = directory + ".old"
+        if os.path.exists(os.path.join(old, STATE_FILE)):
+            directory, state_path = old, os.path.join(old, STATE_FILE)
+        else:
+            return None
+    with open(state_path) as f:
+        state = json.load(f)
+    if fingerprint is not None and state.get("fingerprint") not in (None, fingerprint):
+        return None
+    models = _read_models(directory, state["models"], dtype)
+    best_models = None
+    if state.get("best_models") is not None:
+        best_models = _read_models(
+            os.path.join(directory, BEST_DIR), state["best_models"], dtype
+        )
+    return {
+        "completed_iterations": state["completed_iterations"],
+        "best_metric": state["best_metric"],
+        "best_metrics": state.get("best_metrics"),
+        "models": models,
+        "best_models": best_models,
+    }
+
+
+class CoordinateDescentCheckpointer:
+    """Save/restore hook handed to ``run_coordinate_descent``.
+
+    ``interval`` saves every k-th completed iteration; the descent loop passes
+    ``force=True`` on the final iteration so the completed state is always
+    saved regardless of the interval. ``fingerprint`` (optional) ties the
+    checkpoint to a run configuration: restore returns None when it differs.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        interval: int = 1,
+        dtype=jnp.float32,
+        fingerprint: Optional[str] = None,
+    ):
+        if interval < 1:
+            raise ValueError(f"checkpoint interval must be >= 1, got {interval}")
+        self.directory = directory
+        self.interval = int(interval)
+        self.dtype = dtype
+        self.fingerprint = fingerprint
+
+    def maybe_save(
+        self,
+        completed_iterations: int,
+        models: dict,
+        best_models: Optional[dict],
+        best_metric: Optional[float],
+        best_metrics: Optional[dict] = None,
+        force: bool = False,
+    ) -> bool:
+        if not force and completed_iterations % self.interval != 0:
+            return False
+        save_checkpoint(
+            self.directory,
+            models,
+            completed_iterations,
+            best_models,
+            best_metric,
+            best_metrics,
+            fingerprint=self.fingerprint,
+        )
+        return True
+
+    def restore(self) -> Optional[dict]:
+        return load_checkpoint(
+            self.directory, dtype=self.dtype, fingerprint=self.fingerprint
+        )
+
+    def clear(self) -> None:
+        if os.path.exists(self.directory):
+            shutil.rmtree(self.directory)

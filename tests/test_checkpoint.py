@@ -1,0 +1,252 @@
+"""Iteration-level checkpoint/resume (io/checkpoint.py).
+
+The reference recovers through Spark lineage recomputation (SURVEY.md §5.3);
+the single-controller build recovers by saving coordinate-descent state each
+iteration and resuming. Tests: model round trips (fixed + random effect,
+variances, projectors, int/str entity ids), atomic overwrite, and the key
+property — an interrupted run resumed from its checkpoint produces the SAME
+models and best-metric trajectory as an uninterrupted run.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.estimators import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    GameEstimator,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.io.checkpoint import (
+    CoordinateDescentCheckpointer,
+    load_checkpoint,
+    save_checkpoint,
+)
+from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.types import RegularizationType, TaskType
+
+import jax.numpy as jnp
+
+OPT = GLMOptimizationConfiguration(
+    optimizer_config=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+    regularization_context=RegularizationContext(RegularizationType.L2),
+    regularization_weight=1.0,
+)
+
+
+def _fixed_model(rng, d=5, with_variances=False):
+    means = jnp.asarray(rng.normal(size=d))
+    variances = jnp.asarray(np.abs(rng.normal(size=d))) if with_variances else None
+    return FixedEffectModel(
+        model=LogisticRegressionModel(Coefficients(means=means, variances=variances)),
+        feature_shard_id="global",
+    )
+
+
+def _re_model(rng, entity_ids, k=3, projector=None):
+    E = len(entity_ids)
+    return RandomEffectModel(
+        re_type="userId",
+        feature_shard_id="per-user",
+        task=TaskType.LOGISTIC_REGRESSION,
+        entity_ids=tuple(entity_ids),
+        coeffs=jnp.asarray(rng.normal(size=(E, k))),
+        proj_indices=jnp.asarray(rng.integers(-1, 10, size=(E, k)), dtype=jnp.int32),
+        projector=projector,
+    )
+
+
+class TestRoundTrip:
+    def test_fixed_and_random_effect(self, rng, tmp_path):
+        models = {
+            "fixed": _fixed_model(rng, with_variances=True),
+            "per-user": _re_model(rng, ["u1", "u2", "u3"]),
+        }
+        save_checkpoint(str(tmp_path / "ckpt"), models, 3, best_metric=0.91)
+        restored = load_checkpoint(str(tmp_path / "ckpt"), dtype=jnp.float64)
+        assert restored["completed_iterations"] == 3
+        assert restored["best_metric"] == pytest.approx(0.91)
+        assert restored["best_models"] is None
+
+        fe = restored["models"]["fixed"]
+        np.testing.assert_allclose(
+            np.asarray(fe.model.coefficients.means),
+            np.asarray(models["fixed"].model.coefficients.means),
+        )
+        np.testing.assert_allclose(
+            np.asarray(fe.model.coefficients.variances),
+            np.asarray(models["fixed"].model.coefficients.variances),
+        )
+        assert fe.model.task == TaskType.LOGISTIC_REGRESSION
+
+        re = restored["models"]["per-user"]
+        assert re.entity_ids == ("u1", "u2", "u3")
+        assert re.re_type == "userId"
+        np.testing.assert_allclose(np.asarray(re.coeffs), np.asarray(models["per-user"].coeffs))
+        np.testing.assert_array_equal(
+            np.asarray(re.proj_indices), np.asarray(models["per-user"].proj_indices)
+        )
+
+    def test_int_entity_ids_stay_int(self, rng, tmp_path):
+        models = {"re": _re_model(rng, [7, 11, 13])}
+        save_checkpoint(str(tmp_path / "c"), models, 1)
+        restored = load_checkpoint(str(tmp_path / "c"))
+        assert restored["models"]["re"].entity_ids == (7, 11, 13)
+        assert all(isinstance(e, int) for e in restored["models"]["re"].entity_ids)
+
+    def test_random_projector_round_trip(self, rng, tmp_path):
+        from photon_ml_tpu.data.projector import RandomProjector
+
+        proj = RandomProjector(matrix=rng.normal(size=(9, 4)), intercept_index=0)
+        models = {"re": _re_model(rng, ["a", "b"], k=5, projector=proj)}
+        save_checkpoint(str(tmp_path / "c"), models, 2)
+        restored = load_checkpoint(str(tmp_path / "c"))
+        rp = restored["models"]["re"].projector
+        assert rp is not None and rp.intercept_index == 0
+        np.testing.assert_allclose(rp.matrix, proj.matrix)
+
+    def test_best_models_saved_separately(self, rng, tmp_path):
+        cur = {"fixed": _fixed_model(rng)}
+        best = {"fixed": _fixed_model(rng)}
+        save_checkpoint(str(tmp_path / "c"), cur, 2, best_models=best, best_metric=0.8)
+        restored = load_checkpoint(str(tmp_path / "c"))
+        np.testing.assert_allclose(
+            np.asarray(restored["best_models"]["fixed"].model.coefficients.means),
+            np.asarray(best["fixed"].model.coefficients.means),
+        )
+
+    def test_overwrite_is_atomic_and_latest_wins(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1)
+        second = {"fixed": _fixed_model(rng)}
+        save_checkpoint(path, second, 2)
+        restored = load_checkpoint(path)
+        assert restored["completed_iterations"] == 2
+        np.testing.assert_allclose(
+            np.asarray(restored["models"]["fixed"].model.coefficients.means),
+            np.asarray(second["fixed"].model.coefficients.means),
+        )
+        assert not os.path.exists(path + ".tmp")
+        assert not os.path.exists(path + ".old")
+
+    def test_missing_checkpoint_returns_none(self, tmp_path):
+        assert load_checkpoint(str(tmp_path / "nope")) is None
+
+    def test_interval_skips_off_cycle_saves(self, rng, tmp_path):
+        ck = CoordinateDescentCheckpointer(str(tmp_path / "c"), interval=2)
+        assert not ck.maybe_save(1, {"fixed": _fixed_model(rng)}, None, None)
+        assert ck.restore() is None
+        assert ck.maybe_save(2, {"fixed": _fixed_model(rng)}, None, None)
+        assert ck.restore()["completed_iterations"] == 2
+        # force=True overrides the interval (the descent loop's final iteration)
+        assert ck.maybe_save(3, {"fixed": _fixed_model(rng)}, None, None, force=True)
+        assert ck.restore()["completed_iterations"] == 3
+
+    def test_fingerprint_mismatch_rejects_checkpoint(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        a = CoordinateDescentCheckpointer(path, fingerprint="cfg-A")
+        a.maybe_save(1, {"fixed": _fixed_model(rng)}, None, None)
+        assert a.restore() is not None
+        b = CoordinateDescentCheckpointer(path, fingerprint="cfg-B")
+        assert b.restore() is None
+
+    def test_old_dir_recovered_after_crash_between_renames(self, rng, tmp_path):
+        # simulate a crash between rename(final, old) and rename(tmp, final):
+        # only the .old directory exists
+        path = str(tmp_path / "c")
+        model = _fixed_model(rng)
+        save_checkpoint(path, {"fixed": model}, 4)
+        os.rename(path, path + ".old")
+        restored = load_checkpoint(path)
+        assert restored is not None and restored["completed_iterations"] == 4
+        np.testing.assert_allclose(
+            np.asarray(restored["models"]["fixed"].model.coefficients.means),
+            np.asarray(model.model.coefficients.means),
+        )
+
+
+def _game_input(rng, n=600, d=4, n_users=6):
+    w = rng.normal(size=d)
+    bias = rng.normal(size=n_users) * 1.5
+    X = rng.normal(size=(n, d))
+    users = rng.integers(0, n_users, size=n)
+    z = X @ w + bias[users]
+    y = (z + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    uid = np.asarray([f"u{u}" for u in users], dtype=object)
+    return GameInput(
+        features={"global": X, "per-user": sp.csr_matrix(np.ones((n, 1)))},
+        labels=y,
+        id_columns={"userId": uid},
+    )
+
+
+def _estimator(n_iterations, ckpt_dir=None):
+    # resume is BIT-identical (coordinate descent recomputes the score total at
+    # every iteration boundary, so state is a pure function of the models),
+    # asserted exactly below even in the default f32
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations={
+            "fixed": CoordinateConfiguration(
+                data_config=FixedEffectDataConfiguration("global"),
+                optimization_config=OPT,
+            ),
+            "per-user": CoordinateConfiguration(
+                data_config=RandomEffectDataConfiguration("userId", "per-user"),
+                optimization_config=OPT,
+            ),
+        },
+        n_iterations=n_iterations,
+        checkpoint_directory=ckpt_dir,
+    )
+
+
+class TestResume:
+    def test_interrupted_run_resumes_to_identical_result(self, rng, tmp_path):
+        data = _game_input(rng)
+        train = data.select(np.arange(0, 450))
+        val = data.select(np.arange(450, 600))
+
+        # uninterrupted 3-iteration reference run
+        full = _estimator(3).fit(train, validation_data=val)[0]
+
+        # "crash" after 2 iterations (checkpoint saved each iteration) ...
+        ckpt = str(tmp_path / "ck")
+        _estimator(2, ckpt_dir=ckpt).fit(train, validation_data=val)
+        assert load_checkpoint(os.path.join(ckpt, "config_0")) is not None
+
+        # ... then a rerun asking for 3 iterations resumes from iteration 2
+        resumed = _estimator(3, ckpt_dir=ckpt).fit(train, validation_data=val)[0]
+
+        np.testing.assert_array_equal(
+            np.asarray(resumed.model.get_model("fixed").model.coefficients.means),
+            np.asarray(full.model.get_model("fixed").model.coefficients.means),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(resumed.model.get_model("per-user").coeffs),
+            np.asarray(full.model.get_model("per-user").coeffs),
+        )
+        assert resumed.best_metric == full.best_metric
+
+    def test_completed_checkpoint_short_circuits(self, rng, tmp_path):
+        data = _game_input(rng)
+        train = data.select(np.arange(0, 450))
+        val = data.select(np.arange(450, 600))
+        ckpt = str(tmp_path / "ck")
+        first = _estimator(2, ckpt_dir=ckpt).fit(train, validation_data=val)[0]
+        again = _estimator(2, ckpt_dir=ckpt).fit(train, validation_data=val)[0]
+        np.testing.assert_array_equal(
+            np.asarray(again.model.get_model("fixed").model.coefficients.means),
+            np.asarray(first.model.get_model("fixed").model.coefficients.means),
+        )
+        assert again.best_metric == first.best_metric
