@@ -4,12 +4,19 @@ Parity target: photon-diagnostics fitting/FittingDiagnostic.scala:30-131 — tag
 samples into NUM_TRAINING_PARTITIONS random partitions, hold the last out,
 train on growing prefixes (1/8, 2/8, ... 7/8) with warm start carried between
 portions, and record each metric on both the training prefix and the holdout.
+
+TPU-first shape discipline: the reference trains on physically growing RDD
+subsets; here every portion trains on the SAME full-shape arrays with the
+excluded rows' weights zeroed. The weighted GLM objective is indifferent to
+weight-0 rows, so the result is identical — but every portion (and every other
+same-shaped solve in the process) reuses ONE compiled XLA program instead of
+recompiling per subset shape.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Mapping, Optional
+from typing import Callable, Mapping
 
 import numpy as np
 
@@ -41,9 +48,15 @@ def fitting_diagnostic(
     metrics: {name: fn(scores, labels, weights) -> float}. The returned model
     must expose .score(LabeledData) -> margins (GeneralizedLinearModel API).
 
+    The ``subset`` handed to the factory is the full-shape dataset with
+    excluded rows' weights set to 0 (weighted training ignores them); metric
+    values are computed on the genuinely-included rows only.
+
     Returns an empty report when the dataset is too small for stable curves
     (FittingDiagnostic returns an empty map below dimension *
     MIN_SAMPLES_PER_PARTITION_PER_DIMENSION samples)."""
+    import jax.numpy as jnp
+
     n = data.n
     min_samples = data.dim * MIN_SAMPLES_PER_PARTITION_PER_DIMENSION
     if n <= min_samples:
@@ -58,51 +71,43 @@ def fitting_diagnostic(
     rng = np.random.default_rng(seed)
     tags = rng.integers(0, num_partitions, size=n)
     holdout_idx = np.flatnonzero(tags == num_partitions - 1)
-    holdout = _subset(data, holdout_idx)
+    labels_np = np.asarray(data.labels)
+    weights_np = np.asarray(data.weights)
 
     portions: list[float] = []
     train_vals: dict[str, list[float]] = {m: [] for m in metrics}
     test_vals: dict[str, list[float]] = {m: [] for m in metrics}
     warm = None
     for max_tag in range(num_partitions - 1):
-        idx = np.flatnonzero(tags <= max_tag)
-        subset = _subset(data, idx)
+        mask = tags <= max_tag
+        idx = np.flatnonzero(mask)
         portions.append(100.0 * len(idx) / n)
-        model, warm = model_factory(subset, warm)
-        train_scores = np.asarray(model.score(subset))
-        test_scores = np.asarray(model.score(holdout))
+        masked = LabeledData(
+            X=data.X,
+            labels=data.labels,
+            offsets=data.offsets,
+            weights=jnp.asarray(
+                np.where(mask, weights_np, 0.0), dtype=data.weights.dtype
+            ),
+        )
+        model, warm = model_factory(masked, warm)
+        scores = np.asarray(model.score(data))  # full shape: one compiled matvec
         for name, fn in metrics.items():
             train_vals[name].append(
-                float(fn(train_scores, np.asarray(subset.labels), np.asarray(subset.weights)))
+                float(fn(scores[idx], labels_np[idx], weights_np[idx]))
             )
             test_vals[name].append(
-                float(fn(test_scores, np.asarray(holdout.labels), np.asarray(holdout.weights)))
+                float(
+                    fn(
+                        scores[holdout_idx],
+                        labels_np[holdout_idx],
+                        weights_np[holdout_idx],
+                    )
+                )
             )
 
     return FittingReport(
         metrics={
             name: (portions, train_vals[name], test_vals[name]) for name in metrics
         }
-    )
-
-
-def _subset(data: LabeledData, idx: np.ndarray) -> LabeledData:
-    import jax.numpy as jnp
-
-    from photon_ml_tpu.data.dataset import LabeledData as LD
-
-    X = data.X
-    # DesignMatrix variants: use the underlying host matrix when available
-    take = getattr(X, "take_rows", None)
-    if take is not None:
-        sub_X = take(idx)
-    else:
-        raise TypeError(
-            f"{type(X).__name__} does not support row subsetting (take_rows)"
-        )
-    return LD(
-        X=sub_X,
-        labels=jnp.asarray(np.asarray(data.labels)[idx]),
-        offsets=jnp.asarray(np.asarray(data.offsets)[idx]),
-        weights=jnp.asarray(np.asarray(data.weights)[idx]),
     )

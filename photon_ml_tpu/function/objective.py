@@ -54,20 +54,29 @@ class GLMObjective:
     def _l2_value(self, coef: Array, l2_weight) -> Array:
         return 0.5 * l2_weight * jnp.dot(coef, coef)
 
+    @staticmethod
+    def _weighted(weights: Array, x: Array) -> Array:
+        """weights * x with weight-0 rows EXCLUDED rather than multiplied:
+        0 * inf = NaN would otherwise let an excluded/padded row whose margin
+        overflows the pointwise loss (e.g. exp in Poisson at f32) poison the
+        whole reduction. Weight-0 rows appear everywhere by design: down-sampled
+        negatives, padded entity buckets, weight-masked learning-curve subsets."""
+        return jnp.where(weights != 0, weights * x, jnp.zeros((), dtype=x.dtype))
+
     # -- public API ------------------------------------------------------------------
 
     def value(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:
         z = self._margins(data, coef)
         l = self.loss.loss(z, data.labels)
-        return jnp.sum(data.weights * l) + self._l2_value(coef, l2_weight)
+        return jnp.sum(self._weighted(data.weights, l)) + self._l2_value(coef, l2_weight)
 
     def value_and_gradient(
         self, data: LabeledData, coef: Array, l2_weight=0.0
     ) -> tuple[Array, Array]:
         z = self._margins(data, coef)
         l, dz = self.loss.loss_and_dz(z, data.labels)
-        wdz = data.weights * dz
-        value = jnp.sum(data.weights * l) + self._l2_value(coef, l2_weight)
+        wdz = self._weighted(data.weights, dz)
+        value = jnp.sum(self._weighted(data.weights, l)) + self._l2_value(coef, l2_weight)
         vector_sum = data.X.rmatvec(wdz)
         grad = self.normalization.apply_to_gradient(vector_sum, jnp.sum(wdz))
         return value, grad + l2_weight * coef
@@ -83,7 +92,7 @@ class GLMObjective:
         dzz = self.loss.dzz(z, data.labels)
         eff_v, shift_v = self.normalization.effective_coefficients(vector)
         dv = data.X.matvec(eff_v) + shift_v  # normalized-space directional margins
-        u = data.weights * dzz * dv
+        u = self._weighted(data.weights, dzz * dv)
         vector_sum = data.X.rmatvec(u)
         hv = self.normalization.apply_to_gradient(vector_sum, jnp.sum(u))
         return hv + l2_weight * vector
@@ -91,7 +100,7 @@ class GLMObjective:
     def hessian_diagonal(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:
         """diag(H) for SIMPLE variance (HessianDiagonalAggregator semantics)."""
         z = self._margins(data, coef)
-        d = data.weights * self.loss.dzz(z, data.labels)
+        d = self._weighted(data.weights, self.loss.dzz(z, data.labels))
         sq = data.X.rmatvec_sq(d)  # sum_i d_i x_ij^2
         norm = self.normalization
         if norm.shifts is not None:
@@ -110,7 +119,7 @@ class GLMObjective:
         same restriction as the reference's FULL variance option.
         """
         z = self._margins(data, coef)
-        d = data.weights * self.loss.dzz(z, data.labels)
+        d = self._weighted(data.weights, self.loss.dzz(z, data.labels))
         A = data.X.to_dense()
         norm = self.normalization
         if norm.shifts is not None:

@@ -307,5 +307,8 @@ class CoordinateDescentCheckpointer:
         )
 
     def clear(self) -> None:
-        if os.path.exists(self.directory):
-            shutil.rmtree(self.directory)
+        # also drop the .old/.tmp siblings: load_checkpoint falls back to .old,
+        # so leaving it would resurrect the state the caller tried to discard
+        for path in (self.directory, self.directory + ".old", self.directory + _TMP_SUFFIX):
+            if os.path.exists(path):
+                shutil.rmtree(path)

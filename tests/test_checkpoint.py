@@ -160,6 +160,15 @@ class TestRoundTrip:
         b = CoordinateDescentCheckpointer(path, fingerprint="cfg-B")
         assert b.restore() is None
 
+    def test_clear_removes_old_and_tmp_siblings(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        ck = CoordinateDescentCheckpointer(path)
+        ck.maybe_save(1, {"fixed": _fixed_model(rng)}, None, None)
+        os.rename(path, path + ".old")  # crash between the overwrite renames
+        assert ck.restore() is not None  # .old fallback works...
+        ck.clear()
+        assert ck.restore() is None  # ...but clear() must not resurrect it
+
     def test_old_dir_recovered_after_crash_between_renames(self, rng, tmp_path):
         # simulate a crash between rename(final, old) and rename(tmp, final):
         # only the .old directory exists
@@ -179,7 +188,8 @@ def _game_input(rng, n=600, d=4, n_users=6):
     w = rng.normal(size=d)
     bias = rng.normal(size=n_users) * 1.5
     X = rng.normal(size=(n, d))
-    users = rng.integers(0, n_users, size=n)
+    # deterministic round-robin entities: stable bucket shapes -> shared compiles
+    users = np.arange(n) % n_users
     z = X @ w + bias[users]
     y = (z + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
     uid = np.asarray([f"u{u}" for u in users], dtype=object)

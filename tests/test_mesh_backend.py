@@ -36,7 +36,8 @@ def _glmix_data(rng, n=N):
     w = rng.normal(size=D)
     u_eff = 0.7 * rng.normal(size=U)
     X = rng.normal(size=(n, D))
-    users = rng.integers(0, U, size=n)
+    # deterministic round-robin entities: stable bucket shapes -> shared compiles
+    users = np.arange(n) % U
     z = X @ w + u_eff[users]
     y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(float)
     return X, users, y

@@ -184,3 +184,28 @@ def test_sparse_feature_statistics_match_dense():
         )
     assert sparse.count == dense.count == n
     assert sparse.min[4] == 2.0  # fully dense column keeps its true min (not 0)
+
+
+def test_weight_zero_rows_never_poison_even_when_loss_overflows(rng):
+    """A weight-0 row whose margin overflows the pointwise loss (exp in Poisson
+    at f32) must be EXCLUDED, not multiplied (0 * inf = NaN): weight-0 rows are
+    routine — down-sampled negatives, padded entity buckets, weight-masked
+    learning-curve subsets (diagnostics/fitting.py)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.function.losses import poisson_loss
+    from photon_ml_tpu.function.objective import GLMObjective
+
+    X = np.asarray([[1.0], [200.0]])  # second row: exp(200) overflows even f64
+    y = np.asarray([1.0, 1.0])
+    w = np.asarray([0.0, 1.0])  # overflowing row carries weight 0
+    data = LabeledData.build(X, y, weights=w, dtype=jnp.float64)
+    obj = GLMObjective(poisson_loss)
+    coef = jnp.asarray([1.0], dtype=jnp.float64)
+    value, grad = obj.value_and_gradient(data, coef)
+    assert np.isfinite(float(value))
+    assert np.all(np.isfinite(np.asarray(grad)))
+    hv = obj.hessian_vector(data, coef, jnp.asarray([1.0], dtype=jnp.float64))
+    assert np.all(np.isfinite(np.asarray(hv)))
+    assert np.all(np.isfinite(np.asarray(obj.hessian_diagonal(data, coef))))

@@ -93,7 +93,13 @@ class TestShardedGLM:
 
 
 class TestShardedGameStep:
-    def _tiny_glmix(self, rng, n=400, d=8, n_users=37, n_items=11):
+    # ONE workload (fixed seed, class-scoped) shared by every test in the class:
+    # identical array shapes + identical static solver configs mean the fused
+    # GAME program compiles once and the jit/solver caches serve the rest.
+    @pytest.fixture(scope="class")
+    def glmix(self):
+        rng = np.random.default_rng(271828)
+        n, d, n_users, n_items = 200, 8, 13, 7
         fe_X = rng.normal(size=(n, d))
         users = rng.integers(0, n_users, size=n)
         items = rng.integers(0, n_items, size=n)
@@ -115,11 +121,11 @@ class TestShardedGameStep:
         )
         return fe_X, y, ds_u, ds_i
 
-    def test_game_step_runs_and_improves(self, rng):
-        fe_X, y, ds_u, ds_i = self._tiny_glmix(rng)
+    def test_game_step_runs_and_improves(self, glmix):
+        fe_X, y, ds_u, ds_i = glmix
         mesh = make_mesh(8)
         data = build_sharded_game_data(fe_X, y, [ds_u, ds_i], mesh, dtype=jnp.float64)
-        cfg = _config(max_iterations=50)
+        cfg = _config(max_iterations=40)
         step = make_jitted_game_step(
             data, TaskType.LOGISTIC_REGRESSION, cfg, [cfg, cfg], mesh
         )
@@ -137,8 +143,8 @@ class TestShardedGameStep:
         for rc, coeffs in zip(data.re, params["re"]):
             assert float(jnp.abs(coeffs[rc.n_entities]).max()) == 0.0
 
-    def test_game_step_matches_unsharded(self, rng):
-        fe_X, y, ds_u, ds_i = self._tiny_glmix(rng, n=200, n_users=13, n_items=7)
+    def test_game_step_matches_unsharded(self, glmix):
+        fe_X, y, ds_u, ds_i = glmix
         cfg = _config(max_iterations=40)
         out = {}
         for nd in (1, 8):
@@ -151,11 +157,11 @@ class TestShardedGameStep:
             out[nd] = np.asarray(params["fixed"])
         np.testing.assert_allclose(out[1], out[8], atol=1e-6)
 
-    def test_game_step_sparse_fixed_effect_parity(self, rng):
+    def test_game_step_sparse_fixed_effect_parity(self, glmix):
         """A scipy-sparse fixed-effect design rides the COO-sharded path
         (parallel/glm.py) through the fused pass; results match dense on the
         8-device mesh (VERDICT item 5: PalDBIndexMap billion-feature regime)."""
-        fe_X, y, ds_u, ds_i = self._tiny_glmix(rng, n=200, n_users=13, n_items=7)
+        fe_X, y, ds_u, ds_i = glmix
         cfg = _config(max_iterations=40)
         mesh = make_mesh(8)
         out = {}

@@ -264,7 +264,8 @@ def _glmix_input(rng, n=600, d=40, n_users=7):
     w = rng.normal(size=d) * 0.6
     bias = rng.normal(size=n_users) * 1.2
     X = (rng.normal(size=(n, d)) * (rng.random((n, d)) < 0.4)).astype(np.float64)
-    users = rng.integers(0, n_users, size=n)
+    # deterministic round-robin entities: stable bucket shapes -> shared compiles
+    users = np.arange(n) % n_users
     z = X @ w + bias[users]
     y = (z + 0.2 * rng.normal(size=n) > 0).astype(np.float64)
     uid = np.asarray([f"u{u}" for u in users], dtype=object)

@@ -31,7 +31,13 @@ from photon_ml_tpu.types import (
 
 
 def make_re_data(rng, n_entities=12, d=10, min_s=3, max_s=40):
-    """Per-entity logistic data with entity-specific true coefficients."""
+    """Per-entity logistic data with entity-specific true coefficients.
+
+    Entity sizes are a DETERMINISTIC spread over [min_s, max_s) (values stay
+    rng-driven): tests with the same (n_entities, d, min_s, max_s) then produce
+    identical bucket shapes, so the vmapped solvers compile once per shape for
+    the whole suite instead of once per test."""
+    sizes = np.linspace(min_s, max(min_s, max_s - 1), n_entities).astype(int)
     rows = []
     ents = []
     labels = []
@@ -39,7 +45,7 @@ def make_re_data(rng, n_entities=12, d=10, min_s=3, max_s=40):
     for e in range(n_entities):
         w = rng.normal(size=d) * 0.8
         true_w[f"e{e}"] = w
-        s = int(rng.integers(min_s, max_s))
+        s = int(sizes[e])
         for _ in range(s):
             x = rng.normal(size=d) * (rng.uniform(size=d) < 0.5)
             x[0] = 1.0  # intercept-ish column, always observed
@@ -69,17 +75,31 @@ def test_bucketed_solve_matches_independent(rng):
     )
     assert tracker.n_entities == ds.n_entities
 
+    # Reference solves all share ONE compiled shape: full feature width (unseen
+    # columns are all-zero for the entity, so L2 pins their coefficients at 0
+    # without changing the others) and zero-weight row padding to a fixed S.
     obj = GLMObjective(logistic_loss)
+    S = int(max(np.sum(ents == e) for e in ds.entity_ids))
+    d = X.shape[1]
     for e_id in ds.entity_ids:
         mask = ents == e_id
-        cols = np.asarray(ds.proj_indices[ds.entity_ids.index(e_id)])
-        cols = cols[cols >= 0]
-        Xe = np.asarray(X[mask][:, cols].todense())
-        data = LabeledData.build(Xe, labels[mask])
+        s = int(mask.sum())
+        Xe = np.zeros((S, d))
+        Xe[:s] = np.asarray(X[mask].todense())
+        ye = np.zeros(S)
+        ye[:s] = labels[mask]
+        we = np.zeros(S)
+        we[:s] = 1.0
+        data = LabeledData.build(Xe, ye, weights=we)
         vg = make_value_and_grad(obj, data, l2_weight=0.5)
-        ref = minimize_lbfgs(vg, jnp.zeros(len(cols), dtype=jnp.float64), tolerance=1e-10, max_iterations=100)
+        ref = minimize_lbfgs(vg, jnp.zeros(d, dtype=jnp.float64), tolerance=1e-10, max_iterations=100)
+        row = ds.entity_ids.index(e_id)
+        cols = np.asarray(ds.proj_indices[row])
+        cols = cols[cols >= 0]
         got = model.coefficients_for_entity(e_id)[: len(cols)]
-        np.testing.assert_allclose(got, ref.coefficients, atol=5e-5, err_msg=str(e_id))
+        np.testing.assert_allclose(
+            got, np.asarray(ref.coefficients)[cols], atol=5e-5, err_msg=str(e_id)
+        )
 
 
 def test_scoring_view_matches_manual(rng):
