@@ -15,13 +15,41 @@ point at row 0 / col 0 with value 0 and contribute nothing).
 from __future__ import annotations
 
 import dataclasses
-from typing import Union
+from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 Array = jnp.ndarray
+
+# Column-reduction strategy for SparseDesignMatrix.rmatvec: "scatter" uses an
+# unsorted scatter-add (fast on XLA:CPU), "sorted" a pre-sorted segment_sum
+# (scatters serialize on TPU; the sorted segment reduction vectorizes).
+# "auto" picks by backend at trace time — each backend compiles its own
+# program anyway, so the choice is stable per process.
+COL_REDUCE_MODE = "auto"  # "auto" | "sorted" | "scatter"
+
+
+def _use_sorted_col_reduce() -> bool:
+    if COL_REDUCE_MODE == "sorted":
+        return True
+    if COL_REDUCE_MODE == "scatter":
+        return False
+    return jax.default_backend() not in ("cpu",)
+
+
+def _mxu_dot(a: Array, b: Array, out_dtype) -> Array:
+    """MXU-native mixed-precision product: when ``a`` is stored in bfloat16 the
+    other operand is cast down so the MXU reads bf16 (half the HBM traffic of
+    f32 — the usual bottleneck for GEMV-shaped GLM solves), while accumulation
+    stays f32 via preferred_element_type. Full precision otherwise."""
+    if a.dtype == jnp.bfloat16:
+        acc = jnp.float32 if out_dtype in (jnp.bfloat16, jnp.float32) else out_dtype
+        return jax.lax.dot(a, b.astype(jnp.bfloat16), preferred_element_type=acc).astype(
+            out_dtype
+        )
+    return a @ b
 
 
 @jax.tree_util.register_dataclass
@@ -44,19 +72,25 @@ class DenseDesignMatrix:
         return self.values.shape[1]
 
     def matvec(self, w: Array) -> Array:
-        return self.values @ w
+        return _mxu_dot(self.values, w, w.dtype)
 
     def rmatvec(self, v: Array) -> Array:
-        return self.values.T @ v
+        return _mxu_dot(self.values.T, v, v.dtype)
+
+    def _sq(self, ref: Array) -> Array:
+        # squares are computed at the reduction dtype: squaring in bf16 first
+        # would double the rounding error of an already-rare (variance) path
+        x = self.values
+        return (x * x) if x.dtype != jnp.bfloat16 else (x.astype(ref.dtype) ** 2)
 
     def row_sq_dot(self, d: Array) -> Array:
         """sum_j x_ij^2 * d_j per row — Hessian-diagonal helper
         (HessianDiagonalAggregator semantics)."""
-        return (self.values * self.values) @ d
+        return self._sq(d) @ d
 
     def rmatvec_sq(self, v: Array) -> Array:
         """sum_i x_ij^2 * v_i per column (Hessian diagonal principal term)."""
-        return (self.values * self.values).T @ v
+        return self._sq(v).T @ v
 
     def to_dense(self) -> Array:
         return self.values
@@ -73,6 +107,15 @@ class SparseDesignMatrix:
 
     rows/cols/vals are [nnz_padded]; padding entries have val == 0 so they are inert
     under segment_sum / scatter-add. Static n_rows/n_cols keep shapes compile-time.
+
+    ``col_order``/``cols_sorted`` (optional, built by from_scipy) hold the
+    column-sorting permutation: with them, rmatvec lowers to a SORTED
+    segment_sum instead of an unsorted scatter-add — the scatter is the slow
+    path on TPU (serialized updates), the sorted segment reduction vectorizes.
+    The mesh-sharded constructor leaves them None: a global column sort would
+    gather across the sharded nnz axis. ``rows_sorted`` marks row-major entry
+    order (true for CSR-derived matrices) so matvec's segment_sum can also
+    skip the unsorted path.
     """
 
     rows: Array  # [nnz] int32
@@ -80,6 +123,9 @@ class SparseDesignMatrix:
     vals: Array  # [nnz] float
     n_rows: int = dataclasses.field(metadata=dict(static=True))
     n_cols: int = dataclasses.field(metadata=dict(static=True))
+    col_order: Optional[Array] = None  # [nnz] int32 permutation sorting by column
+    cols_sorted: Optional[Array] = None  # [nnz] int32 == cols[col_order]
+    rows_sorted: bool = dataclasses.field(default=False, metadata=dict(static=True))
 
     @property
     def dtype(self):
@@ -87,19 +133,35 @@ class SparseDesignMatrix:
 
     def matvec(self, w: Array) -> Array:
         contrib = self.vals * jnp.take(w, self.cols, mode="clip")
-        return jax.ops.segment_sum(contrib, self.rows, num_segments=self.n_rows)
+        return jax.ops.segment_sum(
+            contrib, self.rows, num_segments=self.n_rows,
+            indices_are_sorted=self.rows_sorted,
+        )
 
     def rmatvec(self, v: Array) -> Array:
         contrib = self.vals * jnp.take(v, self.rows, mode="clip")
-        return jnp.zeros((self.n_cols,), dtype=v.dtype).at[self.cols].add(contrib)
+        return self._col_reduce(contrib, v.dtype)
+
+    def _col_reduce(self, contrib: Array, dtype) -> Array:
+        if self.col_order is not None and _use_sorted_col_reduce():
+            return jax.ops.segment_sum(
+                jnp.take(contrib, self.col_order),
+                self.cols_sorted,
+                num_segments=self.n_cols,
+                indices_are_sorted=True,
+            )
+        return jnp.zeros((self.n_cols,), dtype=dtype).at[self.cols].add(contrib)
 
     def row_sq_dot(self, d: Array) -> Array:
         contrib = self.vals * self.vals * jnp.take(d, self.cols, mode="clip")
-        return jax.ops.segment_sum(contrib, self.rows, num_segments=self.n_rows)
+        return jax.ops.segment_sum(
+            contrib, self.rows, num_segments=self.n_rows,
+            indices_are_sorted=self.rows_sorted,
+        )
 
     def rmatvec_sq(self, v: Array) -> Array:
         contrib = self.vals * self.vals * jnp.take(v, self.rows, mode="clip")
-        return jnp.zeros((self.n_cols,), dtype=v.dtype).at[self.cols].add(contrib)
+        return self._col_reduce(contrib, v.dtype)
 
     def to_dense(self) -> Array:
         out = jnp.zeros((self.n_rows, self.n_cols), dtype=self.vals.dtype)
@@ -126,12 +188,21 @@ class SparseDesignMatrix:
         base = np.repeat(starts, counts)
         within = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
         sel = order[base + within]
+        out_cols = cols[sel]
+        col_order = cols_sorted = None
+        if _use_sorted_col_reduce():
+            co = np.argsort(out_cols, kind="stable").astype(np.int32)
+            col_order = jnp.asarray(co)
+            cols_sorted = jnp.asarray(out_cols[co])
         return SparseDesignMatrix(
             rows=jnp.asarray(out_rows),
-            cols=jnp.asarray(cols[sel]),
+            cols=jnp.asarray(out_cols),
             vals=jnp.asarray(vals[sel]),
             n_rows=int(len(idx)),
             n_cols=self.n_cols,
+            col_order=col_order,
+            cols_sorted=cols_sorted,
+            rows_sorted=True,  # out_rows are emitted in nondecreasing order
         )
 
     @staticmethod
@@ -147,12 +218,22 @@ class SparseDesignMatrix:
         rows[:nnz] = coo.row
         cols[:nnz] = coo.col
         vals[:nnz] = coo.data
+        # the sorted layout costs an O(nnz log nnz) host sort + two nnz-length
+        # device arrays — only pay for it where the sorted path can run
+        col_order = cols_sorted = None
+        if _use_sorted_col_reduce():
+            order = np.argsort(cols, kind="stable").astype(np.int32)
+            col_order = jnp.asarray(order)
+            cols_sorted = jnp.asarray(cols[order])
         return SparseDesignMatrix(
             rows=jnp.asarray(rows),
             cols=jnp.asarray(cols),
             vals=jnp.asarray(vals, dtype=dtype),
             n_rows=int(mat.shape[0]),
             n_cols=int(mat.shape[1]),
+            col_order=col_order,
+            cols_sorted=cols_sorted,
+            rows_sorted=bool(np.all(np.diff(rows) >= 0)),
         )
 
 

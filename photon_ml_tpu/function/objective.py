@@ -121,6 +121,10 @@ class GLMObjective:
         z = self._margins(data, coef)
         d = self._weighted(data.weights, self.loss.dzz(z, data.labels))
         A = data.X.to_dense()
+        if A.dtype == jnp.bfloat16:
+            # variance math runs at the reduction dtype: applying shifts/factors
+            # in bf16 would double the rounding error (cf. DenseDesignMatrix._sq)
+            A = A.astype(d.dtype)
         norm = self.normalization
         if norm.shifts is not None:
             A = A - jnp.asarray(norm.shifts, dtype=A.dtype)[None, :]

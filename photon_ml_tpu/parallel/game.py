@@ -90,14 +90,20 @@ def build_sharded_game_data(
     offsets: Optional[np.ndarray] = None,
     weights: Optional[np.ndarray] = None,
     dtype=jnp.float32,
+    fe_storage_dtype=None,
 ) -> ShardedGameData:
     """Host-side placement: pad the sample axis and every bucket's entity axis to
     the mesh size, then device_put with batch/entity sharding.
 
     ``fe_X`` may be a dense [N, D] array (samples sharded as [N', D] blocks) or a
     scipy sparse / SparseDesignMatrix (COO nnz axis sharded; scatter-adds psum —
-    the sparse billion-feature path of parallel/glm.py)."""
-    from photon_ml_tpu.data.matrix import as_design_matrix
+    the sparse billion-feature path of parallel/glm.py).
+
+    ``fe_storage_dtype=jnp.bfloat16`` stores the dense fixed-effect design
+    matrix in bf16 (matvecs read half the HBM bytes and hit the MXU natively;
+    accumulation stays f32 — see DenseDesignMatrix._mxu_dot). Labels, weights,
+    scores and coefficients keep ``dtype``."""
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix, as_design_matrix
     from photon_ml_tpu.parallel.glm import shard_labeled_data
 
     m = mesh.devices.size
@@ -114,6 +120,10 @@ def build_sharded_game_data(
         mesh,
     )
     yp, op, wp = fe_data.labels, fe_data.offsets, fe_data.weights
+    fe_built = fe_data.X
+    if fe_storage_dtype is not None and isinstance(fe_built, DenseDesignMatrix):
+        fe_built = DenseDesignMatrix(values=fe_built.values.astype(fe_storage_dtype))
+        fe_data = dataclasses.replace(fe_data, X=fe_built)
 
     coords = []
     for ds in re_datasets:
@@ -165,7 +175,8 @@ def init_game_params(data: ShardedGameData, mesh) -> dict:
     m = mesh.devices.size
     rep = replicated_sharding(mesh)
     es = batch_sharding(mesh, ndim=2)
-    dtype = data.fe_X.dtype
+    # labels carry the COMPUTE dtype; fe_X may hold a lower STORAGE dtype (bf16)
+    dtype = data.labels.dtype
     fe = jax.device_put(jnp.zeros((data.fe_X.n_cols,), dtype=dtype), rep)
     re = tuple(
         jax.device_put(

@@ -336,6 +336,7 @@ class TestShrinkSearchRange:
         best = np.array([1.0, -1.0, 0.0])
         assert (lower <= best + 1.0).all() and (upper >= best - 1.0).all()
 
+    @pytest.mark.filterwarnings("ignore::RuntimeWarning")  # log10(0) en route to the expected raise
     def test_missing_params_use_defaults(self):
         import json
 

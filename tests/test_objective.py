@@ -209,3 +209,56 @@ def test_weight_zero_rows_never_poison_even_when_loss_overflows(rng):
     hv = obj.hessian_vector(data, coef, jnp.asarray([1.0], dtype=jnp.float64))
     assert np.all(np.isfinite(np.asarray(hv)))
     assert np.all(np.isfinite(np.asarray(obj.hessian_diagonal(data, coef))))
+
+
+def test_bf16_feature_storage_matches_f32_loosely(rng):
+    """bf16-stored dense design matrices (DenseDesignMatrix._mxu_dot: half the
+    HBM bytes, f32 accumulation) agree with f32 storage to bf16 rounding, and
+    always return the compute dtype."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+
+    X = rng.normal(size=(64, 16)).astype(np.float32)
+    w = jnp.asarray(rng.normal(size=16).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=64).astype(np.float32))
+    m32 = DenseDesignMatrix(values=jnp.asarray(X))
+    mbf = DenseDesignMatrix(values=jnp.asarray(X, dtype=jnp.bfloat16))
+    assert mbf.matvec(w).dtype == w.dtype
+    assert mbf.rmatvec(v).dtype == v.dtype
+    np.testing.assert_allclose(
+        np.asarray(mbf.matvec(w)), np.asarray(m32.matvec(w)), rtol=0, atol=0.05
+    )
+    np.testing.assert_allclose(
+        np.asarray(mbf.rmatvec(v)), np.asarray(m32.rmatvec(v)), rtol=0, atol=0.1
+    )
+    np.testing.assert_allclose(
+        np.asarray(mbf.row_sq_dot(w)), np.asarray(m32.row_sq_dot(w)), rtol=0.02, atol=0.05
+    )
+
+
+def test_sparse_sorted_col_reduce_matches_scatter(rng, monkeypatch):
+    """The TPU-side sorted segment_sum column reduction (data/matrix.py
+    COL_REDUCE_MODE) produces the same rmatvec as the CPU scatter-add path."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data import matrix as matrix_mod
+    from photon_ml_tpu.data.matrix import SparseDesignMatrix
+
+    X = sp.random(300, 50, density=0.1, random_state=np.random.RandomState(3))
+    # build under "sorted" so from_scipy materializes the sorted-layout
+    # metadata (on the CPU backend "auto" skips it to save the sort)
+    monkeypatch.setattr(matrix_mod, "COL_REDUCE_MODE", "sorted")
+    m = SparseDesignMatrix.from_scipy(X.tocsr(), dtype=jnp.float64)
+    assert m.col_order is not None
+    v = jnp.asarray(rng.normal(size=300))
+    sorted_ = np.asarray(m.rmatvec(v))
+    monkeypatch.setattr(matrix_mod, "COL_REDUCE_MODE", "scatter")
+    scatter = np.asarray(m.rmatvec(v))
+    np.testing.assert_allclose(sorted_, scatter, rtol=1e-12)
+    np.testing.assert_allclose(scatter, np.asarray(X.T @ np.asarray(v)), rtol=1e-9)
+    # sharded construction leaves the metadata off -> scatter path regardless
+    import dataclasses as dc
+
+    bare = dc.replace(m, col_order=None, cols_sorted=None)
+    np.testing.assert_allclose(np.asarray(bare.rmatvec(v)), scatter, rtol=1e-12)
