@@ -117,6 +117,10 @@ def run_benchmark() -> tuple:
     tp_f32, val_f32 = measure(None)
     info = {"storage": "f32", "f32_samples_per_sec": round(tp_f32, 2)}
     best = tp_f32
+    if jax.default_backend() == "cpu":
+        # bf16 matmul is emulated (slower) on XLA:CPU and can outlast the
+        # parent's subprocess timeout, discarding the finished f32 number
+        return best, info
     try:
         tp_bf16, val_bf16 = measure(jnp.bfloat16)
         info["bf16_samples_per_sec"] = round(tp_bf16, 2)

@@ -218,6 +218,11 @@ class SparseDesignMatrix:
         rows[:nnz] = coo.row
         cols[:nnz] = coo.col
         vals[:nnz] = coo.data
+        if nnz and pad > nnz:
+            # pad with the LAST row id (vals stay 0, so still inert): row-0
+            # padding would break the nondecreasing-rows invariant and silently
+            # disable the sorted matvec fast path
+            rows[nnz:] = rows[nnz - 1]
         # the sorted layout costs an O(nnz log nnz) host sort + two nnz-length
         # device arrays — only pay for it where the sorted path can run
         col_order = cols_sorted = None

@@ -26,6 +26,11 @@ from photon_ml_tpu.parallel.mesh import (
     replicated_sharding,
     pad_axis_to_multiple,
 )
+from photon_ml_tpu.parallel.feature_sharded import (
+    make_mesh2,
+    shard_labeled_data_2d,
+    train_glm_feature_sharded,
+)
 from photon_ml_tpu.parallel.glm import shard_labeled_data, train_glm_sharded
 from photon_ml_tpu.parallel.game import (
     ShardedGameData,
@@ -41,6 +46,9 @@ __all__ = [
     "pad_axis_to_multiple",
     "shard_labeled_data",
     "train_glm_sharded",
+    "make_mesh2",
+    "shard_labeled_data_2d",
+    "train_glm_feature_sharded",
     "ShardedGameData",
     "build_sharded_game_data",
     "game_train_step",

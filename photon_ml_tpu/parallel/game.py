@@ -112,18 +112,18 @@ def build_sharded_game_data(
     offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
     weights = np.ones(n) if weights is None else np.asarray(weights)
 
+    fe_mat = as_design_matrix(fe_X, dtype=dtype)
+    if fe_storage_dtype is not None and isinstance(fe_mat, DenseDesignMatrix):
+        # cast BEFORE placement: only the storage-dtype bytes are transferred
+        # and resident — at bf16-motivating scale the f32 copy may not even fit
+        fe_mat = DenseDesignMatrix(values=fe_mat.values.astype(fe_storage_dtype))
     fe_data, _ = shard_labeled_data(
         LabeledData.build(
-            as_design_matrix(fe_X, dtype=dtype), labels, offsets=offsets,
-            weights=weights, dtype=dtype,
+            fe_mat, labels, offsets=offsets, weights=weights, dtype=dtype,
         ),
         mesh,
     )
     yp, op, wp = fe_data.labels, fe_data.offsets, fe_data.weights
-    fe_built = fe_data.X
-    if fe_storage_dtype is not None and isinstance(fe_built, DenseDesignMatrix):
-        fe_built = DenseDesignMatrix(values=fe_built.values.astype(fe_storage_dtype))
-        fe_data = dataclasses.replace(fe_data, X=fe_built)
 
     coords = []
     for ds in re_datasets:
