@@ -72,6 +72,34 @@ class Coordinate:
     def initialize_model(self):
         raise NotImplementedError
 
+    def prepare_initial_model(self, model):
+        """Adapt an externally supplied warm-start model to this coordinate's
+        (possibly mesh-placed) dataset. Default: unchanged."""
+        return model
+
+
+def pad_fixed_effect_model(model, dataset):
+    """Pad a fixed-effect model's [D] coefficients to a feature-padded dataset's
+    dim and place them under the dataset's coefficient sharding (the 2-D mesh
+    backend, parallel/feature_sharded.py). No-op without a coef_sharding."""
+    sharding = getattr(dataset, "coef_sharding", None)
+    if sharding is None:
+        return model
+    import jax
+
+    from photon_ml_tpu.models.glm import Coefficients
+
+    means = model.model.coefficients.means
+    if means.shape[0] < dataset.dim:
+        means = jnp.concatenate(
+            [means, jnp.zeros((dataset.dim - means.shape[0],), dtype=means.dtype)]
+        )
+    means = jax.device_put(means, sharding)
+    from photon_ml_tpu.models.glm import model_class_for_task
+
+    glm = model_class_for_task(model.model.task)(Coefficients(means=means))
+    return dataclasses.replace(model, model=glm)
+
 
 @dataclasses.dataclass
 class FixedEffectCoordinate(Coordinate):
@@ -111,9 +139,14 @@ class FixedEffectCoordinate(Coordinate):
 
     def initialize_model(self) -> FixedEffectModel:
         model = self._problem.initialize_zero_model(
-            self.dataset.dim, dtype=self.dataset.data.X.dtype
+            self.dataset.dim, dtype=self.dataset.data.labels.dtype
         )
-        return FixedEffectModel(model=model, feature_shard_id=self.dataset.feature_shard_id)
+        return self.prepare_initial_model(
+            FixedEffectModel(model=model, feature_shard_id=self.dataset.feature_shard_id)
+        )
+
+    def prepare_initial_model(self, model: FixedEffectModel) -> FixedEffectModel:
+        return pad_fixed_effect_model(model, self.dataset)
 
     def update_model(
         self, initial_model: Optional[FixedEffectModel], partial_scores: Array
@@ -128,7 +161,9 @@ class FixedEffectCoordinate(Coordinate):
             lower, upper = self.box_constraints
         glm, result = self._problem.run(
             data,
-            initial_model.model if initial_model is not None else None,
+            self.prepare_initial_model(initial_model).model
+            if initial_model is not None
+            else None,
             lower_bounds=lower,
             upper_bounds=upper,
         )
@@ -183,6 +218,11 @@ class RandomEffectCoordinate(Coordinate):
             projector=self.dataset.projector,
         )
 
+    def prepare_initial_model(self, model: RandomEffectModel) -> RandomEffectModel:
+        # re-align entity rows to this dataset (warm start across rebuilt or
+        # differently ordered datasets)
+        return model.aligned_to(self.dataset) if hasattr(model, "aligned_to") else model
+
     def update_model(
         self, initial_model: Optional[RandomEffectModel], partial_scores: Array
     ) -> tuple[RandomEffectModel, RandomEffectTracker]:
@@ -214,6 +254,13 @@ class ModelCoordinate(Coordinate):
     @property
     def is_locked(self) -> bool:
         return True
+
+    def prepare_initial_model(self, model):
+        if isinstance(model, FixedEffectModel):
+            return pad_fixed_effect_model(model, self.dataset)
+        if hasattr(model, "aligned_to") and hasattr(self.dataset, "entity_ids"):
+            return model.aligned_to(self.dataset)
+        return model
 
     def initialize_model(self):
         return self.model

@@ -130,15 +130,11 @@ def run_coordinate_descent(
     val_scores: dict[str, Array] = {}
     for cid, coord in coordinates.items():
         init = None if initial_models is None else initial_models.get(cid)
-        if (
-            start_iteration > 0
-            and init is not None
-            and hasattr(init, "aligned_to")
-            and hasattr(coord, "dataset")
-            and hasattr(coord.dataset, "entity_ids")
-        ):
-            # restored RE models re-align to the (rebuilt) dataset's entity rows
-            init = init.aligned_to(coord.dataset)
+        if init is not None:
+            # adapt external/restored models to the coordinate's dataset:
+            # RE models re-align entity rows, FE models pad + place
+            # coefficients for feature-sharded datasets
+            init = coord.prepare_initial_model(init)
         model = init if init is not None else coord.initialize_model()
         models[cid] = model
         train_scores[cid] = coord.score(model)

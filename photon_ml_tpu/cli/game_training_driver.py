@@ -118,6 +118,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "programs (the reference's distributed path)")
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="Device count for --compute-backend=mesh (default: all)")
+    p.add_argument("--mesh-model-devices", type=int, default=1,
+                   help="Shard the dense fixed-effect FEATURE axis over this many "
+                        "devices (2-D data x model mesh; coefficients and optimizer "
+                        "state live distributed). 1 = pure data/entity parallelism")
     p.add_argument("--checkpoint-directory", default=None,
                    help="Enable iteration-level checkpoint/resume: coordinate "
                         "descent saves models here after each iteration and a "
@@ -353,9 +357,23 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
 
         mesh = None
         if getattr(args, "compute_backend", "host") == "mesh":
-            from photon_ml_tpu.parallel.mesh import make_mesh
+            n_model = getattr(args, "mesh_model_devices", 1) or 1
+            if n_model > 1:
+                import jax
 
-            mesh = make_mesh(args.mesh_devices)
+                from photon_ml_tpu.parallel import make_mesh2
+
+                total = args.mesh_devices or len(jax.devices())
+                if total % n_model:
+                    raise ValueError(
+                        f"--mesh-model-devices={n_model} must divide the device "
+                        f"count {total}"
+                    )
+                mesh = make_mesh2(total // n_model, n_model)
+            else:
+                from photon_ml_tpu.parallel.mesh import make_mesh
+
+                mesh = make_mesh(args.mesh_devices)
 
         estimator = GameEstimator(
             task=task,

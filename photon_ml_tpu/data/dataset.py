@@ -81,6 +81,10 @@ class FixedEffectDataset:
 
     data: LabeledData
     feature_shard_id: str = "global"
+    # set by 2-D mesh placement (parallel/placement.py): coefficient vectors
+    # and optimizer state live sharded over the model axis (feature-axis model
+    # parallelism — per-device model memory ~ 1/n_model)
+    coef_sharding: object = None
 
     @property
     def n(self) -> int:

@@ -251,6 +251,12 @@ class GameEstimator:
                 raise ValueError(
                     f"Locked coordinate {cid!r} needs a model from initial_model"
                 )
+            from photon_ml_tpu.algorithm.coordinate import pad_fixed_effect_model
+            from photon_ml_tpu.models.game import FixedEffectModel
+
+            if isinstance(initial_model, FixedEffectModel):
+                # feature-sharded datasets pad D; the locked model must match
+                initial_model = pad_fixed_effect_model(initial_model, dataset)
             return ModelCoordinate(coordinate_id=cid, dataset=dataset, model=initial_model)
         dc = cfg.data_config
         if isinstance(dc, FixedEffectDataConfiguration):
@@ -302,6 +308,22 @@ class GameEstimator:
                 place_game_datasets,
             )
 
+            if len(getattr(self.mesh, "axis_names", ())) == 2:
+                # feature-axis sharding pads D; [D]-shaped normalization vectors
+                # and box bounds would need the same padding — not wired yet
+                for cid, cfg in self.coordinate_configurations.items():
+                    if isinstance(cfg.data_config, FixedEffectDataConfiguration):
+                        shard = cfg.data_config.feature_shard_id
+                        if not self._normalization_for(shard).is_identity:
+                            raise ValueError(
+                                "2-D (feature-sharded) mesh requires identity "
+                                f"normalization; shard {shard!r} has one"
+                            )
+                        if getattr(cfg, "box_constraints", None):
+                            raise ValueError(
+                                "2-D (feature-sharded) mesh does not support "
+                                f"box constraints yet (coordinate {cid!r})"
+                            )
             datasets = place_game_datasets(datasets, self.mesh)
             base_offsets = pad_and_shard_vector(
                 np.asarray(data.offsets), self.mesh, dtype=self.dtype

@@ -69,25 +69,32 @@ def sample_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_labeled_data_2d(
-    data: LabeledData, mesh: Mesh
+    data: LabeledData, mesh: Mesh, sample_multiple: Optional[int] = None
 ) -> tuple[LabeledData, int, int]:
     """Place a dense LabeledData on the 2-D mesh: samples padded (weight-0) to
-    the data-axis multiple, features padded (all-zero columns, inert: their
-    gradient is exactly the L2 term so their coefficients stay 0) to the
-    model-axis multiple. Returns (sharded data, n_samples, n_features)."""
+    the data-axis multiple (or ``sample_multiple`` when the global sample axis
+    must line up with other coordinates' padding), features padded (all-zero
+    columns, inert: their gradient is exactly the L2 term so their coefficients
+    stay 0) to the model-axis multiple. Returns (sharded data, n_samples,
+    n_features)."""
     if not isinstance(data.X, DenseDesignMatrix):
         raise TypeError(
             "feature-axis sharding currently covers dense design matrices; "
             "sparse COO shards its nnz axis on the 1-D mesh (parallel/glm.py)"
         )
     n_data, n_model = (mesh.shape[DATA_AXIS], mesh.shape[MODEL_AXIS])
+    sm = sample_multiple or n_data
+    if sm % n_data:
+        raise ValueError(
+            f"sample_multiple={sm} must be a multiple of the data axis ({n_data})"
+        )
 
     vals = np.asarray(data.X.values)
-    vals, n = pad_axis_to_multiple(vals, n_data, axis=0)
+    vals, n = pad_axis_to_multiple(vals, sm, axis=0)
     vals, d = pad_axis_to_multiple(vals, n_model, axis=1)
-    labels, _ = pad_axis_to_multiple(np.asarray(data.labels), n_data)
-    offsets, _ = pad_axis_to_multiple(np.asarray(data.offsets), n_data)
-    weights, _ = pad_axis_to_multiple(np.asarray(data.weights), n_data)
+    labels, _ = pad_axis_to_multiple(np.asarray(data.labels), sm)
+    offsets, _ = pad_axis_to_multiple(np.asarray(data.offsets), sm)
+    weights, _ = pad_axis_to_multiple(np.asarray(data.weights), sm)
 
     ss = sample_sharding(mesh)
     sharded = LabeledData(

@@ -49,7 +49,27 @@ def pad_and_shard_vector(arr, mesh, fill=0.0, dtype=None) -> Array:
 def place_fixed_effect_dataset(ds: FixedEffectDataset, mesh) -> FixedEffectDataset:
     """Samples sharded over the mesh; dense [N, D] blocks or sparse COO nnz axis
     (billion-feature regime — the PalDBIndexMap.scala:43-278 scale story rides
-    the sparse path + offheap_index)."""
+    the sparse path + offheap_index).
+
+    On a 2-D ("data", "model") mesh a DENSE design matrix additionally shards
+    its FEATURE axis over "model" and stamps ``coef_sharding`` so coefficient
+    vectors and optimizer state live distributed (parallel/feature_sharded.py);
+    sparse matrices keep 1-D nnz sharding over the data axis."""
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+    from photon_ml_tpu.parallel.feature_sharded import (
+        feature_sharding,
+        shard_labeled_data_2d,
+    )
+
+    if len(mesh.axis_names) == 2 and isinstance(ds.data.X, DenseDesignMatrix):
+        # sample padding to the TOTAL device count keeps the global score axis
+        # consistent with the 1-D-placed random-effect coordinates
+        sharded2, _, _ = shard_labeled_data_2d(
+            ds.data, mesh, sample_multiple=mesh.devices.size
+        )
+        return dataclasses.replace(
+            ds, data=sharded2, coef_sharding=feature_sharding(mesh)
+        )
     sharded, _ = shard_labeled_data(ds.data, mesh)
     return dataclasses.replace(ds, data=sharded)
 
