@@ -24,6 +24,16 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: the suite is compile-dominated (hundreds of
+# lax.while_loop optimizer programs), and programs are identical across runs —
+# the second and later suite runs skip nearly all compiles. Safe to share: the
+# cache key includes program, flags, and compiler version.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("PHOTON_XLA_CACHE", os.path.expanduser("~/.cache/photon_xla")),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

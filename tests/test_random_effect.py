@@ -65,7 +65,9 @@ CFG = GLMOptimizationConfiguration(
 
 
 def test_bucketed_solve_matches_independent(rng):
-    X, ents, labels, _ = make_re_data(rng)
+    # 8 entities: enough for >= 2 bucket shape classes, and the per-entity
+    # reference solves (one compile, shared padded shape) stay cheap
+    X, ents, labels, _ = make_re_data(rng, n_entities=8, max_s=32)
     ds = build_random_effect_dataset(
         X, ents, "entity", labels=labels, dtype=jnp.float64
     )
