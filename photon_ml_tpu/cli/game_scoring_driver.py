@@ -48,6 +48,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--off-heap-index-map-directory", default=None)
     p.add_argument("--evaluators", default=None)
     p.add_argument("--model-id", default=None, help="ID to tag scores with")
+    p.add_argument("--compute-backend", default="host", choices=["host", "mesh"],
+                   help="'mesh' scores with datasets sharded over the device mesh")
+    p.add_argument("--mesh-devices", type=int, default=None,
+                   help="Device count for --compute-backend=mesh (default: all)")
     p.add_argument("--log-data-and-model-stats", action="store_true")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--application-name", default="game-scoring")
@@ -124,7 +128,14 @@ def run(args: argparse.Namespace) -> dict:
             if args.evaluators
             else []
         )
-        transformer = GameTransformer(model=model, evaluators=evaluator_specs)
+        mesh = None
+        if getattr(args, "compute_backend", "host") == "mesh":
+            from photon_ml_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(getattr(args, "mesh_devices", None))
+        transformer = GameTransformer(
+            model=model, evaluators=evaluator_specs, mesh=mesh
+        )
         with Timed("score", logger):
             scores, metrics = transformer.transform(data)
         if metrics:

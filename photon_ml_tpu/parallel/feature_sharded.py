@@ -44,6 +44,8 @@ def make_mesh2(
     (the hotter direction) over the shorter ICI hops."""
     if devices is None:
         devices = jax.devices()
+    if n_data < 1 or n_model < 1:
+        raise ValueError(f"mesh axes must be >= 1, got ({n_data}, {n_model})")
     need = n_data * n_model
     if need > len(devices):
         raise ValueError(f"requested {need} devices, only {len(devices)} present")

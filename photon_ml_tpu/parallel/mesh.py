@@ -26,6 +26,8 @@ def make_mesh(
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
+        if n_devices < 1:
+            raise ValueError(f"n_devices must be >= 1, got {n_devices}")
         if n_devices > len(devices):
             raise ValueError(f"requested {n_devices} devices, only {len(devices)} present")
         devices = devices[:n_devices]

@@ -31,6 +31,11 @@ class GameTransformer:
     model: GameModel
     evaluators: Sequence = ()
     log_scores_per_coordinate: bool = False
+    # SPMD scoring: place each scoring dataset over a jax.sharding.Mesh
+    # (samples sharded) so the per-coordinate matvecs/gathers run distributed,
+    # mirroring the reference's executor-parallel scoring
+    # (GameTransformer.transform:150+, RandomEffectModel.score:83-101)
+    mesh: object = None
 
     def score(self, data: GameInput, include_offsets: bool = True) -> np.ndarray:
         """Total score per sample: sum of coordinate scores (+ offsets, matching the
@@ -43,9 +48,23 @@ class GameTransformer:
 
     def score_per_coordinate(self, data: GameInput) -> dict[str, np.ndarray]:
         scores: dict[str, np.ndarray] = {}
+        n = data.n
         for cid, model in self.model:
             dataset = self._scoring_dataset(model, data)
-            scores[cid] = np.asarray(score_model_on_dataset(model, dataset))
+            if self.mesh is not None:
+                from photon_ml_tpu.parallel.placement import place_game_datasets
+
+                dataset = place_game_datasets({cid: dataset}, self.mesh)[cid]
+                # (RandomEffectModel.score_dataset re-aligns internally)
+                if isinstance(model, FixedEffectModel):
+                    from photon_ml_tpu.algorithm.coordinate import (
+                        pad_fixed_effect_model,
+                    )
+
+                    # 2-D meshes pad the feature axis; coefficients follow
+                    model = pad_fixed_effect_model(model, dataset)
+            # mesh placement pads the sample axis; trim back to the true N
+            scores[cid] = np.asarray(score_model_on_dataset(model, dataset))[:n]
         return scores
 
     def transform(self, data: GameInput) -> tuple[np.ndarray, Optional[dict]]:

@@ -338,3 +338,20 @@ class TestFeatureShardedBackend:
         }
         with pytest.raises(ValueError, match="identity normalization"):
             est.fit(train, validation_data=val)
+
+
+class TestMeshScoring:
+    def test_transformer_mesh_scoring_matches_host(self, rng, eight_devices):
+        from photon_ml_tpu.parallel.mesh import make_mesh
+        from photon_ml_tpu.transformers import GameTransformer
+
+        train, val = _inputs(rng)
+        model = _estimator().fit(train, validation_data=val)[0].best_model
+        host_scores, host_metrics = GameTransformer(
+            model=model, evaluators=["AUC"]
+        ).transform(val)
+        mesh_scores, mesh_metrics = GameTransformer(
+            model=model, evaluators=["AUC"], mesh=make_mesh(8)
+        ).transform(val)
+        np.testing.assert_allclose(mesh_scores, host_scores, atol=1e-10)
+        assert mesh_metrics["AUC"] == pytest.approx(host_metrics["AUC"], abs=1e-12)
