@@ -118,6 +118,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "programs (the reference's distributed path)")
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="Device count for --compute-backend=mesh (default: all)")
+    p.add_argument("--distributed-coordinator", default=None,
+                   help="host:port of process 0 for multi-host training "
+                        "(jax.distributed), or 'auto' for orchestrated TPU pod "
+                        "environments; omit on single-host")
+    p.add_argument("--distributed-num-processes", type=int, default=None)
+    p.add_argument("--distributed-process-id", type=int, default=None)
     p.add_argument("--mesh-model-devices", type=int, default=1,
                    help="Shard the dense fixed-effect FEATURE axis over this many "
                         "devices (2-D data x model mesh; coefficients and optimizer "
@@ -217,6 +223,19 @@ def _save_result(out_dir: str, result, index_maps_by_coord, coord_configs,
 def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dict:
     """Full training pipeline (GameTrainingDriver.run:346-482). Returns a summary
     dict {"results": [...], "best_index": i, "output_directory": ...}."""
+    # Multi-host init must precede EVERY other JAX touch (model loading,
+    # data placement): jax.distributed.initialize after backend init either
+    # errors or silently leaves the "global" mesh host-local.
+    coordinator = getattr(args, "distributed_coordinator", None)
+    if coordinator is not None:
+        from photon_ml_tpu.parallel import initialize_multi_host
+
+        initialize_multi_host(
+            coordinator_address=None if coordinator == "auto" else coordinator,
+            num_processes=getattr(args, "distributed_num_processes", None),
+            process_id=getattr(args, "distributed_process_id", None),
+            auto=coordinator == "auto",
+        )
     emitter = emitter or EventEmitter()
     root = args.root_output_directory
     if os.path.exists(root):

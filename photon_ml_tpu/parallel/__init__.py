@@ -26,6 +26,11 @@ from photon_ml_tpu.parallel.mesh import (
     replicated_sharding,
     pad_axis_to_multiple,
 )
+from photon_ml_tpu.parallel.distributed import (
+    host_local_to_global,
+    initialize_multi_host,
+    process_slice,
+)
 from photon_ml_tpu.parallel.feature_sharded import (
     make_mesh2,
     shard_labeled_data_2d,
@@ -46,6 +51,9 @@ __all__ = [
     "pad_axis_to_multiple",
     "shard_labeled_data",
     "train_glm_sharded",
+    "initialize_multi_host",
+    "host_local_to_global",
+    "process_slice",
     "make_mesh2",
     "shard_labeled_data_2d",
     "train_glm_feature_sharded",

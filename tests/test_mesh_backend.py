@@ -345,7 +345,13 @@ class TestMeshScoring:
         from photon_ml_tpu.parallel.mesh import make_mesh
         from photon_ml_tpu.transformers import GameTransformer
 
-        train, val = _inputs(rng)
+        train, _ = _inputs(rng)
+        # n=197 is NOT divisible by 8: mesh placement pads the sample axis and
+        # the [:n] trim in score_per_coordinate is genuinely exercised
+        Xv, uv, yv = _glmix_data(rng, n=197)
+        val = GameInput(
+            features={"global": Xv}, labels=yv, id_columns={"userId": uv}
+        )
         model = _estimator().fit(train, validation_data=val)[0].best_model
         host_scores, host_metrics = GameTransformer(
             model=model, evaluators=["AUC"]
