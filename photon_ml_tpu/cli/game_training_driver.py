@@ -135,6 +135,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "completed iteration")
     p.add_argument("--checkpoint-interval", type=int, default=1,
                    help="Save every k-th coordinate-descent iteration")
+    p.add_argument("--profile-output-directory", default=None,
+                   help="Capture an XLA/TPU profiler trace of the training "
+                        "phase (open with TensorBoard or xprof) — the "
+                        "TPU-native analog of the reference's Timed sections")
     # Spark-isms accepted for 1:1 invocation compatibility (no-ops here)
     p.add_argument("--min-validation-partitions", type=int, default=None,
                    help=argparse.SUPPRESS)
@@ -227,15 +231,34 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
     # data placement): jax.distributed.initialize after backend init either
     # errors or silently leaves the "global" mesh host-local.
     coordinator = getattr(args, "distributed_coordinator", None)
+    if coordinator is None and (
+        getattr(args, "distributed_num_processes", None) is not None
+        or getattr(args, "distributed_process_id", None) is not None
+    ):
+        raise ValueError(
+            "--distributed-num-processes/--distributed-process-id require "
+            "--distributed-coordinator (or --distributed-coordinator=auto)"
+        )
     if coordinator is not None:
         from photon_ml_tpu.parallel import initialize_multi_host
 
-        initialize_multi_host(
+        world = initialize_multi_host(
             coordinator_address=None if coordinator == "auto" else coordinator,
             num_processes=getattr(args, "distributed_num_processes", None),
             process_id=getattr(args, "distributed_process_id", None),
             auto=coordinator == "auto",
         )
+        if world["num_processes"] > 1:
+            # per-process ingestion (process_slice + host_local_to_global) is
+            # a library-level building block; the CLI reader still ingests
+            # full host-local arrays, which cannot place onto a multi-host
+            # mesh. Fail loudly instead of training N independent copies.
+            raise NotImplementedError(
+                "multi-process CLI ingestion is not wired yet: use the "
+                "library API (parallel.process_slice + "
+                "parallel.host_local_to_global) to build global sharded "
+                "inputs per process"
+            )
     emitter = emitter or EventEmitter()
     root = args.root_output_directory
     if os.path.exists(root):
@@ -408,10 +431,20 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
         )
 
         emitter.send_event(Event("TrainingStartEvent"))
-        with Timed("train", logger):
-            results = estimator.fit(
-                train_input, validation_data=validation_input, initial_model=initial_model
-            )
+        import contextlib
+
+        profile_dir = getattr(args, "profile_output_directory", None)
+        if profile_dir:
+            import jax
+
+            profiler_cm = jax.profiler.trace(profile_dir)
+        else:
+            profiler_cm = contextlib.nullcontext()
+        with profiler_cm:
+            with Timed("train", logger):
+                results = estimator.fit(
+                    train_input, validation_data=validation_input, initial_model=initial_model
+                )
 
         # -- hyperparameter tuning (GameTrainingDriver.runHyperparameterTuning) --
         tuning_mode = HyperparameterTuningMode(args.hyper_parameter_tuning)

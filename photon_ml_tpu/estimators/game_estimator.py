@@ -265,15 +265,29 @@ class GameEstimator:
                 sampler = down_sampler_for_task(
                     self.task, cfg.down_sampling_rate, self.down_sampling_seed
                 )
+            norm = self._normalization_for(dc.feature_shard_id)
+            bounds = cfg.box_constraints
+            if getattr(dataset, "coef_sharding", None) is not None:
+                # feature-axis sharding padded D with all-zero columns: extend
+                # [D]-shaped normalization (identity entries) and box bounds
+                # (unbounded entries) to match
+                norm = norm.padded_to(dataset.dim)
+                if bounds is not None:
+                    lo, hi = bounds
+                    extra = dataset.dim - len(lo)
+                    if extra > 0:
+                        lo = np.concatenate([np.asarray(lo), np.full(extra, -np.inf)])
+                        hi = np.concatenate([np.asarray(hi), np.full(extra, np.inf)])
+                        bounds = (lo, hi)
             return FixedEffectCoordinate(
                 coordinate_id=cid,
                 dataset=dataset,
                 task=self.task,
                 configuration=opt_config,
-                normalization=self._normalization_for(dc.feature_shard_id),
+                normalization=norm,
                 variance_computation=self.variance_computation,
                 down_sampler=sampler,
-                box_constraints=cfg.box_constraints,
+                box_constraints=bounds,
             )
         norm = self._normalization_for(dc.feature_shard_id)
         return RandomEffectCoordinate(
@@ -308,22 +322,6 @@ class GameEstimator:
                 place_game_datasets,
             )
 
-            if len(getattr(self.mesh, "axis_names", ())) == 2:
-                # feature-axis sharding pads D; [D]-shaped normalization vectors
-                # and box bounds would need the same padding — not wired yet
-                for cid, cfg in self.coordinate_configurations.items():
-                    if isinstance(cfg.data_config, FixedEffectDataConfiguration):
-                        shard = cfg.data_config.feature_shard_id
-                        if not self._normalization_for(shard).is_identity:
-                            raise ValueError(
-                                "2-D (feature-sharded) mesh requires identity "
-                                f"normalization; shard {shard!r} has one"
-                            )
-                        if getattr(cfg, "box_constraints", None):
-                            raise ValueError(
-                                "2-D (feature-sharded) mesh does not support "
-                                f"box constraints yet (coordinate {cid!r})"
-                            )
             datasets = place_game_datasets(datasets, self.mesh)
             base_offsets = pad_and_shard_vector(
                 np.asarray(data.offsets), self.mesh, dtype=self.dtype

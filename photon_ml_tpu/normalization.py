@@ -148,6 +148,27 @@ class NormalizationContext:
             return len(self.shifts)
         return 0
 
+    def padded_to(self, dim: int) -> "NormalizationContext":
+        """Extend to ``dim`` features with identity entries (factor 1, shift 0)
+        — used when feature-axis sharding pads the design matrix's D axis with
+        all-zero columns (parallel/feature_sharded.py)."""
+        if self.is_identity or self.size >= dim:
+            return self
+        extra = dim - self.size
+        factors = (
+            None
+            if self.factors is None
+            else np.concatenate([np.asarray(self.factors), np.ones(extra)])
+        )
+        shifts = (
+            None
+            if self.shifts is None
+            else np.concatenate([np.asarray(self.shifts), np.zeros(extra)])
+        )
+        return NormalizationContext(
+            factors=factors, shifts=shifts, intercept_index=self.intercept_index
+        )
+
     # -- coefficient-space conversions (host-side; numpy) ---------------------------
 
     def model_to_original_space(self, coef: np.ndarray) -> np.ndarray:

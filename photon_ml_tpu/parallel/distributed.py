@@ -100,11 +100,14 @@ def host_local_to_global(
     )
 
 
-def process_slice(n_total: int) -> slice:
-    """Contiguous row range this process should read/ingest: splits n_total as
-    evenly as possible over process_count() in process order (the analog of
-    Spark executors claiming HDFS splits)."""
-    p, k = jax.process_index(), jax.process_count()
+def split_range(p: int, k: int, n_total: int) -> slice:
+    """Contiguous block p of n_total rows split as evenly as possible over k."""
     base, extra = divmod(n_total, k)
     start = p * base + min(p, extra)
     return slice(start, start + base + (1 if p < extra else 0))
+
+
+def process_slice(n_total: int) -> slice:
+    """Contiguous row range this process should read/ingest (the analog of
+    Spark executors claiming HDFS splits)."""
+    return split_range(jax.process_index(), jax.process_count(), n_total)

@@ -395,3 +395,52 @@ class TestReviewRegressions:
         results = result["results"]
         metrics = [r.best_metric for r in results]
         assert result["best_index"] == int(np.argmin(metrics))
+
+
+def test_training_driver_profiler_trace(rng, tmp_path):
+    """--profile-output-directory captures an XLA profiler trace during the
+    training phase (SURVEY §5.1: the TPU-native tracing story)."""
+    import os
+
+    from photon_ml_tpu.cli.game_training_driver import main
+    from photon_ml_tpu.data import avro_io
+
+    n, d = 120, 3
+    X = rng.normal(size=(n, d))
+    y = (X @ rng.normal(size=d) > 0).astype(float)
+    indir = tmp_path / "in"
+    indir.mkdir()
+    avro_io.write_container(
+        str(indir / "p.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA,
+        (
+            {
+                "uid": str(i), "label": float(y[i]), "weight": 1.0, "offset": 0.0,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(X[i, j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {},
+            }
+            for i in range(n)
+        ),
+    )
+    prof = tmp_path / "prof"
+    rc = main([
+        "--input-data-directories", str(indir),
+        "--root-output-directory", str(tmp_path / "out"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=10,"
+        "tolerance=1e-6,regularization=L2,reg.weights=1.0",
+        "--coordinate-update-sequence", "global",
+        "--profile-output-directory", str(prof),
+    ])
+    assert rc == 0
+    traces = [
+        os.path.join(base, f)
+        for base, _, files in os.walk(prof)
+        for f in files
+    ]
+    assert traces, "no profiler trace files written"

@@ -35,17 +35,14 @@ def test_process_slice_covers_everything():
     assert s == slice(0, 17)  # single process owns the whole range
 
 
-def test_process_slice_split_math():
-    # simulate the pure splitting math for k processes
-    import photon_ml_tpu.parallel.distributed as dist
+def test_split_range_covers_everything():
+    from photon_ml_tpu.parallel.distributed import split_range
 
-    n, k = 17, 4
-    slices = []
-    for p in range(k):
-        base, extra = divmod(n, k)
-        start = p * base + min(p, extra)
-        slices.append(slice(start, start + base + (1 if p < extra else 0)))
-    covered = sorted((s.start, s.stop) for s in slices)
-    assert covered[0][0] == 0 and covered[-1][1] == n
-    for (a, b), (c, d) in zip(covered, covered[1:]):
-        assert b == c  # contiguous, non-overlapping
+    for n, k in ((17, 4), (8, 8), (3, 5), (100, 7)):
+        slices = [split_range(p, k, n) for p in range(k)]
+        covered = sorted((s.start, s.stop) for s in slices)
+        assert covered[0][0] == 0 and covered[-1][1] == n
+        for (a, b), (c, d) in zip(covered, covered[1:]):
+            assert b == c  # contiguous, non-overlapping
+        sizes = [s.stop - s.start for s in slices]
+        assert max(sizes) - min(sizes) <= 1  # balanced

@@ -327,17 +327,6 @@ class TestFeatureShardedBackend:
         assert rc == 0
         assert (out / "best" / "fixed-effect").exists()
 
-    def test_2d_mesh_rejects_normalization(self, rng, eight_devices):
-        from photon_ml_tpu.normalization import NormalizationContext
-        from photon_ml_tpu.parallel import make_mesh2
-
-        train, val = _inputs(rng)
-        est = _estimator(mesh=make_mesh2(4, 2))
-        est.normalization_contexts = {
-            "global": NormalizationContext(factors=np.ones(D) * 2.0)
-        }
-        with pytest.raises(ValueError, match="identity normalization"):
-            est.fit(train, validation_data=val)
 
 
 class TestMeshScoring:
@@ -361,3 +350,72 @@ class TestMeshScoring:
         ).transform(val)
         np.testing.assert_allclose(mesh_scores, host_scores, atol=1e-10)
         assert mesh_metrics["AUC"] == pytest.approx(host_metrics["AUC"], abs=1e-12)
+
+
+def test_2d_mesh_with_normalization_matches_host(rng, eight_devices):
+    """Feature-sharded mesh + standardization: the [D] normalization vectors
+    are padded with identity entries to the padded feature axis and results
+    match the host backend."""
+    from photon_ml_tpu.normalization import FeatureDataStatistics, NormalizationContext
+    from photon_ml_tpu.parallel import make_mesh2
+    from photon_ml_tpu.types import NormalizationType
+
+    X, users, y = _glmix_data(rng)
+    Xn = np.concatenate([np.ones((N, 1)), X], axis=1)  # intercept col 0
+    train = GameInput(features={"global": Xn}, labels=y, id_columns={"userId": users})
+    Xv, uv, yv = _glmix_data(rng)
+    val = GameInput(
+        features={"global": np.concatenate([np.ones((N, 1)), Xv], axis=1)},
+        labels=yv, id_columns={"userId": uv},
+    )
+    stats = FeatureDataStatistics.compute(Xn, intercept_index=0)
+    norm = NormalizationContext.build(NormalizationType.STANDARDIZATION, stats)
+
+    def est(mesh=None):
+        e = _estimator(mesh=mesh)
+        e.normalization_contexts = {"global": norm}
+        return e
+
+    host = est().fit(train, validation_data=val)[0]
+    sharded = est(make_mesh2(2, 3)).fit(train, validation_data=val)[0]
+    assert sharded.best_metric == pytest.approx(host.best_metric, abs=1e-6)
+    h = np.asarray(host.best_model.get_model("global").model.coefficients.means)
+    s = np.asarray(sharded.best_model.get_model("global").model.coefficients.means)
+    assert s.shape[0] > h.shape[0]  # feature padding happened
+    np.testing.assert_allclose(s[: h.shape[0]], h, atol=1e-6)
+    assert np.all(s[h.shape[0] :] == 0.0)
+
+
+def test_2d_mesh_box_constraints_match_host(rng, eight_devices):
+    """Box constraints on the feature-sharded backend: bounds padded with
+    +/-inf for the padded columns; active constraints match the host solve."""
+    from photon_ml_tpu.parallel import make_mesh2
+
+    train, val = _inputs(rng)
+    bounds = (np.full(D, -0.1), np.full(D, 0.1))  # tight: definitely active
+
+    def est(mesh=None):
+        return GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configurations={
+                "global": CoordinateConfiguration(
+                    FixedEffectDataConfiguration("global"), _cfg(),
+                    box_constraints=bounds,
+                ),
+                "per-user": CoordinateConfiguration(
+                    RandomEffectDataConfiguration("userId", "global"), _cfg()
+                ),
+            },
+            validation_evaluators=[EvaluatorType.AUC],
+            dtype=jnp.float64,
+            mesh=mesh,
+        )
+
+    host = est().fit(train, validation_data=val)[0]
+    sharded = est(make_mesh2(2, 3)).fit(train, validation_data=val)[0]
+    h = np.asarray(host.model.get_model("global").model.coefficients.means)
+    s = np.asarray(sharded.model.get_model("global").model.coefficients.means)
+    assert np.all(np.abs(h) <= 0.1 + 1e-9) and np.any(np.abs(h) > 0.0999)
+    np.testing.assert_allclose(s[: h.shape[0]], h, atol=1e-6)
+    assert np.all(np.abs(s[: h.shape[0]]) <= 0.1 + 1e-9)
+    assert np.all(s[h.shape[0] :] == 0.0)
