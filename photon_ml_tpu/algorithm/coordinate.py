@@ -194,6 +194,9 @@ class RandomEffectCoordinate(Coordinate):
     base_offsets: Array  # [N] global base offsets (gathered per bucket at solve time)
     normalization: Optional[NormalizationContext] = None
     variance_computation: VarianceComputationType = VarianceComputationType.NONE
+    # {entity_id: l2} or [E] array: per-entity L2 overrides (the reference's
+    # envisioned per-entity regularization, RandomEffectOptimizationProblem:34-37)
+    per_entity_reg_weights: Optional[object] = None
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -235,6 +238,7 @@ class RandomEffectCoordinate(Coordinate):
             initial_model=initial_model,
             normalization=self.normalization,
             variance_computation=self.variance_computation,
+            per_entity_reg_weights=self.per_entity_reg_weights,
         )
 
     def score(self, model: RandomEffectModel) -> Array:

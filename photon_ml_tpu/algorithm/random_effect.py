@@ -143,12 +143,19 @@ def train_random_effect(
     normalization: Optional[NormalizationContext] = None,
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     dtype=None,
+    per_entity_reg_weights=None,
 ) -> tuple[RandomEffectModel, RandomEffectTracker]:
     """Fit one GLM per entity over all buckets.
 
     ``offsets_plus_scores`` is the [N] global array of base offsets plus the other
     coordinates' partial scores (the reference's addScoresToOffsets join becomes a
     gather through bucket.sample_ids).
+
+    ``per_entity_reg_weights`` ({entity_id: l2} or [E] array aligned with
+    ``dataset.entity_ids``) overrides the configuration's L2 weight per entity
+    — the per-entity regularization the reference envisioned
+    (RandomEffectOptimizationProblem.scala:34-37). Entities absent from a dict
+    keep the configuration weight.
     """
     task = TaskType(task)
     loss = loss_for_task(task)
@@ -191,6 +198,26 @@ def train_random_effect(
         else None
     )
 
+    # per-entity L2 table, row-aligned with the coefficient table; padded
+    # entity rows (mesh placement) gather the base weight harmlessly
+    l2_table = np.full(max(table_rows, E + 1), float(l2))
+    if per_entity_reg_weights is not None:
+        if isinstance(per_entity_reg_weights, dict):
+            row_by_entity = {e: i for i, e in enumerate(dataset.entity_ids)}
+            for e_id, w_e in per_entity_reg_weights.items():
+                row = row_by_entity.get(e_id, -1)
+                if row >= 0:
+                    l2_table[row] = float(w_e)
+        else:
+            arr = np.asarray(per_entity_reg_weights, dtype=np.float64)
+            if arr.shape[0] != E:
+                raise ValueError(
+                    f"per_entity_reg_weights has {arr.shape[0]} entries for "
+                    f"{E} entities"
+                )
+            l2_table[:E] = arr
+    l2_rows = jnp.asarray(l2_table, dtype=dtype)
+
     reasons_parts, iters_parts = [], []
 
     for bucket in dataset.buckets:
@@ -214,7 +241,7 @@ def train_random_effect(
             bucket.weights,
             off_b,
             init_b,
-            jnp.asarray(l2, dtype=dtype),
+            jnp.take(l2_rows, jnp.minimum(bucket.entity_rows, l2_rows.shape[0] - 1)),
             jnp.asarray(l1 or 0.0, dtype=dtype),
         )
 

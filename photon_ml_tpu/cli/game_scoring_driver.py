@@ -48,6 +48,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--off-heap-index-map-directory", default=None)
     p.add_argument("--evaluators", default=None)
     p.add_argument("--model-id", default=None, help="ID to tag scores with")
+    p.add_argument("--compilation-cache-directory", default=None,
+                   help="Persistent XLA compilation cache: repeated runs skip "
+                        "recompiling the optimizer programs (jit warm start "
+                        "across processes)")
     p.add_argument("--compute-backend", default="host", choices=["host", "mesh"],
                    help="'mesh' scores with datasets sharded over the device mesh")
     p.add_argument("--mesh-devices", type=int, default=None,
@@ -61,6 +65,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def run(args: argparse.Namespace) -> dict:
+    from photon_ml_tpu.cli.runtime import configure_compilation_cache
+
+    configure_compilation_cache(args)
     root = args.root_output_directory
     if os.path.exists(root):
         if args.override_output_directory:

@@ -135,6 +135,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "completed iteration")
     p.add_argument("--checkpoint-interval", type=int, default=1,
                    help="Save every k-th coordinate-descent iteration")
+    p.add_argument("--compilation-cache-directory", default=None,
+                   help="Persistent XLA compilation cache: repeated runs skip "
+                        "recompiling the optimizer programs (jit warm start "
+                        "across processes)")
     p.add_argument("--profile-output-directory", default=None,
                    help="Capture an XLA/TPU profiler trace of the training "
                         "phase (open with TensorBoard or xprof) — the "
@@ -259,6 +263,9 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
                 "parallel.host_local_to_global) to build global sharded "
                 "inputs per process"
             )
+    from photon_ml_tpu.cli.runtime import configure_compilation_cache
+
+    configure_compilation_cache(args)
     emitter = emitter or EventEmitter()
     root = args.root_output_directory
     if os.path.exists(root):

@@ -1,0 +1,16 @@
+"""Shared runtime configuration for the CLI drivers."""
+
+from __future__ import annotations
+
+
+def configure_compilation_cache(args) -> None:
+    """Point JAX at a persistent on-disk compilation cache when the driver was
+    given --compilation-cache-directory: repeated runs skip recompiling the
+    optimizer programs (jit warm start across processes)."""
+    cache_dir = getattr(args, "compilation_cache_directory", None)
+    if not cache_dir:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

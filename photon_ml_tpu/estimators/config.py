@@ -65,6 +65,10 @@ class CoordinateConfiguration:
     # per-feature (lower[D], upper[D]) box bounds over the coordinate's shard
     # (constraint maps, GLMSuite.scala:190-260); fixed-effect only
     box_constraints: Optional[tuple] = None
+    # {entity_id: l2} or [E] array of per-entity L2 overrides; random-effect
+    # only (the reference envisioned but never implemented these,
+    # RandomEffectOptimizationProblem.scala:34-37)
+    per_entity_reg_weights: Optional[object] = None
 
     @property
     def is_random_effect(self) -> bool:

@@ -298,6 +298,7 @@ class GameEstimator:
             base_offsets=base_offsets,
             normalization=None if norm.is_identity else norm,
             variance_computation=self.variance_computation,
+            per_entity_reg_weights=cfg.per_entity_reg_weights,
         )
 
     # ---------------------------------------------------------------- fit

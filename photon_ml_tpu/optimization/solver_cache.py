@@ -106,9 +106,11 @@ def re_bucket_solver(
 ):
     """Jitted vmapped per-entity bucket solve:
     ``solve(X, y, w, offsets, w0, l2, l1) -> (coefs, reasons, iters, variances)``
-    with X [E, S, K] and l2/l1 broadcast — the executor-local random-effect hot
-    loop of RandomEffectCoordinate.scala:109-127 as one XLA program per bucket
-    shape class."""
+    with X [E, S, K], l2 a PER-ENTITY [E] vector (the reference only envisioned
+    per-entity regularization weights, RandomEffectOptimizationProblem.scala:
+    34-37 — here each entity's solve traces its own weight) and l1 broadcast —
+    the executor-local random-effect hot loop of RandomEffectCoordinate.scala:
+    109-127 as one XLA program per bucket shape class."""
     task = TaskType(task)
     loss = loss_for_task(task)
     minimize = build_minimizer(opt_config)
@@ -134,7 +136,7 @@ def re_bucket_solver(
         var = compute_variances(obj, data, res.coefficients, l2, variance, w0.dtype)
         return res.coefficients, res.convergence_reason, res.iterations, var
 
-    return jax.jit(jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, None, None)))
+    return jax.jit(jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None)))
 
 
 @functools.lru_cache(maxsize=None)

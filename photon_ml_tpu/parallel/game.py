@@ -264,7 +264,7 @@ def game_train_step(
                 b.weights,
                 off_b,
                 w0_b,
-                jnp.asarray(cfg.l2_weight, dtype=dtype),
+                jnp.full((b.entity_rows.shape[0],), cfg.l2_weight, dtype=dtype),
                 jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
             )
             coeffs = coeffs.at[b.entity_rows, :K].set(w_b)

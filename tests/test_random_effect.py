@@ -301,3 +301,47 @@ def test_truncated_avro_raises(rng, tmp_path):
     open(p, "wb").write(blob[: len(blob) - 25])
     with pytest.raises((EOFError, ValueError, Exception)):
         list(avro_io.read_container(p))
+
+
+def test_per_entity_reg_weights(rng):
+    """Per-entity L2 overrides (the reference only envisioned these,
+    RandomEffectOptimizationProblem.scala:34-37): a heavily regularized entity
+    shrinks toward zero while the others match the uniform-weight solve."""
+    X, ents, labels, _ = make_re_data(rng, n_entities=4, min_s=25, max_s=40)
+    ds = build_random_effect_dataset(X, ents, "entity", labels=labels, dtype=jnp.float64)
+    base, _ = train_random_effect(
+        ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(X.shape[0])
+    )
+    heavy_id = ds.entity_ids[1]
+    model, _ = train_random_effect(
+        ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(X.shape[0]),
+        per_entity_reg_weights={heavy_id: 1e4},
+    )
+    for e_id in ds.entity_ids:
+        got = model.coefficients_for_entity(e_id)
+        ref = base.coefficients_for_entity(e_id)
+        if e_id == heavy_id:
+            # crushed toward zero by the 2e4x larger L2
+            assert np.linalg.norm(got) < 0.05 * max(np.linalg.norm(ref), 1e-9)
+        else:
+            np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_per_entity_reg_weights_array_form(rng):
+    X, ents, labels, _ = make_re_data(rng, n_entities=3, min_s=20, max_s=30)
+    ds = build_random_effect_dataset(X, ents, "entity", labels=labels, dtype=jnp.float64)
+    uniform, _ = train_random_effect(
+        ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(X.shape[0]),
+        per_entity_reg_weights=np.full(3, CFG.l2_weight),
+    )
+    plain, _ = train_random_effect(
+        ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(X.shape[0])
+    )
+    np.testing.assert_allclose(
+        np.asarray(uniform.coeffs), np.asarray(plain.coeffs), atol=1e-9
+    )
+    with pytest.raises(ValueError, match="entries for"):
+        train_random_effect(
+            ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(X.shape[0]),
+            per_entity_reg_weights=np.ones(7),
+        )
