@@ -283,3 +283,87 @@ void photon_avro_map(DecodedColumns* h, int32_t field, int64_t* rows,
 void photon_avro_free(DecodedColumns* h) { delete h; }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Score-block encoder — the scoring driver's output hot path.
+//
+// Encodes n ScoringResultAvro records (the reference's score output contract,
+// ScoringResultAvro.avsc) into one Avro block payload:
+//   uid:             union [null, string]  (always branch 1 here)
+//   label:           union [null, double]  (branch by has_labels)
+//   modelId:         string (shared by every record)
+//   predictionScore: double
+//   weight:          union [null, double]  (always branch 1)
+//   metadataMap:     union [null, map]     (always null)
+// The container framing (header, deflate, sync) stays in Python, mirroring
+// the decoder's split. Returns bytes written, or -1 if out_cap is too small.
+
+namespace {
+
+struct Writer {
+  uint8_t* p;
+  uint8_t* end;
+
+  bool put_long(int64_t v) {
+    uint64_t z = (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+    while (true) {
+      if (p >= end) return false;
+      if (z < 0x80) {
+        *p++ = static_cast<uint8_t>(z);
+        return true;
+      }
+      *p++ = static_cast<uint8_t>((z & 0x7F) | 0x80);
+      z >>= 7;
+    }
+  }
+
+  bool put_double(double v) {
+    if (p + 8 > end) return false;
+    std::memcpy(p, &v, 8);
+    p += 8;
+    return true;
+  }
+
+  bool put_bytes(const uint8_t* src, int64_t len) {
+    if (!put_long(len)) return false;
+    if (p + len > end) return false;
+    std::memcpy(p, src, static_cast<size_t>(len));
+    p += len;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int64_t photon_encode_scores(const uint8_t* uid_buf, const int64_t* uid_offsets,
+                             const double* labels, int32_t has_labels,
+                             const uint8_t* model_id, int64_t model_id_len,
+                             const double* scores, const double* weights,
+                             int64_t n, uint8_t* out, int64_t out_cap) {
+  Writer w{out, out + out_cap};
+  for (int64_t i = 0; i < n; ++i) {
+    // uid: [null, string] branch 1
+    if (!w.put_long(1)) return -1;
+    if (!w.put_bytes(uid_buf + uid_offsets[i], uid_offsets[i + 1] - uid_offsets[i]))
+      return -1;
+    // label: [null, double]
+    if (has_labels) {
+      if (!w.put_long(1) || !w.put_double(labels[i])) return -1;
+    } else {
+      if (!w.put_long(0)) return -1;
+    }
+    // modelId: string
+    if (!w.put_bytes(model_id, model_id_len)) return -1;
+    // predictionScore
+    if (!w.put_double(scores[i])) return -1;
+    // weight: [null, double] branch 1
+    if (!w.put_long(1) || !w.put_double(weights[i])) return -1;
+    // metadataMap: null branch
+    if (!w.put_long(0)) return -1;
+  }
+  return w.p - out;
+}
+
+}  // extern "C"

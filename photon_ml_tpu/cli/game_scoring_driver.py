@@ -176,12 +176,51 @@ def _coordinate_shards(model_dir: str) -> dict[str, str]:
     return out
 
 
-def _write_scores(path, uids, scores, data, model_id: str) -> None:
-    """ScoringResultAvro records (GameScoringDriver.saveScoresToHDFS:229-256)."""
+def _write_scores(path, uids, scores, data, model_id: str, use_native: bool = True) -> None:
+    """ScoringResultAvro records (GameScoringDriver.saveScoresToHDFS:229-256).
+
+    The record payloads are encoded natively (native/avro_block_decoder.cpp
+    photon_encode_scores — the output analog of the ingest decoder) when the
+    library is available, falling back to the pure-Python encoder otherwise;
+    both produce the same records (block boundaries differ: 65536 records per
+    native block vs write_container's 4096)."""
+    import numpy as np
+
     has_labels = data.has_labels
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    n = len(scores)
+    from photon_ml_tpu.data import native_avro
+
+    if use_native and native_avro.available():
+        labels = np.asarray(data.labels, dtype=np.float64) if has_labels else None
+        weights = np.asarray(data.weights, dtype=np.float64)
+        scores_arr = np.asarray(scores, dtype=np.float64)
+
+        def blocks(block_count=65536):
+            for start in range(0, n, block_count):
+                stop = min(start + block_count, n)
+                uid_slice = (
+                    uids[start:stop]
+                    if uids is not None
+                    else (str(i) for i in range(start, stop))
+                )
+                payload = native_avro.encode_scores(
+                    uid_slice,
+                    None if labels is None else labels[start:stop],
+                    model_id,
+                    scores_arr[start:stop],
+                    weights[start:stop],
+                )
+                if payload is None:  # lib vanished mid-write: surface loudly
+                    raise RuntimeError("native encoder failed mid-write")
+                yield stop - start, payload
+
+        avro_io.write_container_raw(path, avro_io.SCORING_RESULT_SCHEMA, blocks())
+        return
 
     def records():
-        for i in range(len(scores)):
+        for i in range(n):
             yield {
                 "uid": str(uids[i]) if uids is not None else str(i),
                 "label": float(data.labels[i]) if has_labels else None,
@@ -191,7 +230,6 @@ def _write_scores(path, uids, scores, data, model_id: str) -> None:
                 "metadataMap": None,
             }
 
-    os.makedirs(os.path.dirname(path), exist_ok=True)
     avro_io.write_container(path, avro_io.SCORING_RESULT_SCHEMA, records())
 
 

@@ -253,24 +253,52 @@ def decode(buf, schema):
 # ------------------------------------------------------------ container files
 
 
+def _write_container_header(f, schema_json, codec: str) -> None:
+    f.write(MAGIC)
+    meta_buf = io.BytesIO()
+    meta = {
+        "avro.schema": json.dumps(schema_json, separators=(",", ":")).encode(),
+        "avro.codec": codec.encode(),
+    }
+    write_long(meta_buf, len(meta))
+    for k, v in meta.items():
+        write_bytes(meta_buf, k.encode())
+        write_bytes(meta_buf, v)
+    write_long(meta_buf, 0)
+    f.write(meta_buf.getvalue())
+    f.write(DEFAULT_SYNC)
+
+
+def _write_block(f, count: int, payload: bytes, codec: str) -> None:
+    if codec == "deflate":
+        payload = zlib.compress(payload)[2:-4]  # raw deflate (avro strips wrapper)
+    head = io.BytesIO()
+    write_long(head, count)
+    write_long(head, len(payload))
+    f.write(head.getvalue())
+    f.write(payload)
+    f.write(DEFAULT_SYNC)
+
+
+def write_container_raw(path: str, schema_json, blocks, codec: str = "deflate") -> None:
+    """Write an Avro object-container file from PRE-ENCODED record payloads.
+
+    ``blocks`` yields (record_count, payload_bytes) pairs — the native score
+    encoder's output path (native_avro.encode_scores); framing/compression is
+    the same code write_container uses."""
+    with open(path, "wb") as f:
+        _write_container_header(f, schema_json, codec)
+        for count, payload in blocks:
+            if count:
+                _write_block(f, count, payload, codec)
+
+
 def write_container(path: str, schema_json, records: Iterable[dict], codec: str = "deflate",
                     block_count: int = 4096) -> None:
     """Write an Avro object-container file (one or more blocks)."""
     schema = Schema(schema_json)
     with open(path, "wb") as f:
-        f.write(MAGIC)
-        meta_buf = io.BytesIO()
-        meta = {
-            "avro.schema": json.dumps(schema_json, separators=(",", ":")).encode(),
-            "avro.codec": codec.encode(),
-        }
-        write_long(meta_buf, len(meta))
-        for k, v in meta.items():
-            write_bytes(meta_buf, k.encode())
-            write_bytes(meta_buf, v)
-        write_long(meta_buf, 0)
-        f.write(meta_buf.getvalue())
-        f.write(DEFAULT_SYNC)
+        _write_container_header(f, schema_json, codec)
 
         block: list[dict] = []
 
@@ -280,15 +308,7 @@ def write_container(path: str, schema_json, records: Iterable[dict], codec: str 
             data_buf = io.BytesIO()
             for rec in block:
                 encode(data_buf, schema.root, rec)
-            payload = data_buf.getvalue()
-            if codec == "deflate":
-                payload = zlib.compress(payload)[2:-4]  # raw deflate (avro strips wrapper)
-            head = io.BytesIO()
-            write_long(head, len(block))
-            write_long(head, len(payload))
-            f.write(head.getvalue())
-            f.write(payload)
-            f.write(DEFAULT_SYNC)
+            _write_block(f, len(block), data_buf.getvalue(), codec)
             block.clear()
 
         for rec in records:

@@ -108,6 +108,12 @@ def _bind(lib):
         ctypes.c_void_p, ctypes.c_int32, i64p, i64p, i64p, i64p, i64p,
     ]
     lib.photon_avro_free.argtypes = [ctypes.c_void_p]
+    u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+    lib.photon_encode_scores.restype = ctypes.c_int64
+    lib.photon_encode_scores.argtypes = [
+        u8p, i64p, f64p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
+        f64p, f64p, ctypes.c_int64, u8p, ctypes.c_int64,
+    ]
     return lib
 
 
@@ -253,3 +259,40 @@ def decode_block(payload: bytes, n_records: int, field_types: list[int]) -> Deco
         lib.photon_avro_free(handle)
         raise ValueError(f"native avro decode failed: {msg}")
     return DecodedBlock(payload, handle, lib, len(field_types))
+
+
+def encode_scores(uids, labels, model_id: str, scores, weights):
+    """Encode ScoringResultAvro record payloads natively (one block's bytes).
+
+    ``uids`` is a sequence of strings; ``labels`` is None or a float array.
+    Returns bytes, or None when the native library is unavailable (caller
+    falls back to the pure-Python record encoder)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(scores)
+    uid_bytes = [str(u).encode() for u in uids]
+    if len(uid_bytes) != n:
+        raise ValueError(f"{len(uid_bytes)} uids for {n} scores")
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i, b in enumerate(uid_bytes):
+        offsets[i + 1] = offsets[i] + len(b)
+    uid_buf = np.frombuffer(b"".join(uid_bytes), dtype=np.uint8) if n else np.zeros(0, np.uint8)
+    uid_buf = np.ascontiguousarray(uid_buf)
+    has_labels = labels is not None
+    labels_arr = np.ascontiguousarray(
+        np.asarray(labels, dtype=np.float64) if has_labels else np.zeros(n)
+    )
+    scores_arr = np.ascontiguousarray(np.asarray(scores, dtype=np.float64))
+    weights_arr = np.ascontiguousarray(np.asarray(weights, dtype=np.float64))
+    mid = str(model_id).encode()
+    # per record: uid varint+bytes, unions (<=5 varints ~5B), modelId, 2 doubles
+    cap = int(offsets[-1]) + n * (40 + len(mid)) + 64
+    out = np.zeros(cap, dtype=np.uint8)
+    written = lib.photon_encode_scores(
+        uid_buf, offsets, labels_arr, 1 if has_labels else 0,
+        mid, len(mid), scores_arr, weights_arr, n, out, cap,
+    )
+    if written < 0:
+        return None
+    return out[:written].tobytes()

@@ -271,3 +271,61 @@ class TestReviewRegressions:
         finally:
             monkeypatch.undo()
             shutil.rmtree(cache, ignore_errors=True)
+
+
+class TestNativeScoreEncoder:
+    def _write_both(self, tmp_path, n=500, with_labels=True, with_uids=True):
+        import types
+
+        from photon_ml_tpu.cli.game_scoring_driver import _write_scores
+
+        rng = np.random.default_rng(12)
+        scores = rng.normal(size=n)
+        data = types.SimpleNamespace(
+            has_labels=with_labels,
+            labels=rng.random(n) if with_labels else None,
+            weights=np.abs(rng.normal(size=n)) + 0.1,
+        )
+        uids = [f"uid-{i}" for i in range(n)] if with_uids else None
+        p_native = str(tmp_path / "native.avro")
+        p_python = str(tmp_path / "python.avro")
+        _write_scores(p_native, uids, scores, data, "m1", use_native=True)
+        _write_scores(p_python, uids, scores, data, "m1", use_native=False)
+        return p_native, p_python
+
+    @pytest.mark.parametrize("with_labels", [True, False])
+    def test_native_matches_python_encoder(self, tmp_path, with_labels):
+        from photon_ml_tpu.data import native_avro
+
+        if not native_avro.available():
+            pytest.skip("native library unavailable")
+        p_native, p_python = self._write_both(tmp_path, with_labels=with_labels)
+        a = list(avro_io.read_container(p_native))
+        b = list(avro_io.read_container(p_python))
+        assert a == b
+        assert len(a) == 500
+        assert a[3]["uid"] == "uid-3" and a[3]["modelId"] == "m1"
+        # identical bytes while both paths fit one block (n <= 4096, the
+        # Python writer's block size; larger outputs differ only in block
+        # boundaries)
+        assert open(p_native, "rb").read() == open(p_python, "rb").read()
+
+    def test_multi_block_split(self, tmp_path):
+        import types
+
+        from photon_ml_tpu.cli.game_scoring_driver import _write_scores
+        from photon_ml_tpu.data import native_avro
+
+        if not native_avro.available():
+            pytest.skip("native library unavailable")
+        n = 70000  # > one 65536-record block
+        scores = np.arange(n, dtype=np.float64)
+        data = types.SimpleNamespace(
+            has_labels=False, labels=None, weights=np.ones(n)
+        )
+        path = str(tmp_path / "big.avro")
+        _write_scores(path, None, scores, data, "", use_native=True)
+        recs = list(avro_io.read_container(path))
+        assert len(recs) == n
+        assert recs[-1]["predictionScore"] == float(n - 1)
+        assert recs[12345]["uid"] == "12345"
