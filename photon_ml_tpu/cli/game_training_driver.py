@@ -139,6 +139,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Persistent XLA compilation cache: repeated runs skip "
                         "recompiling the optimizer programs (jit warm start "
                         "across processes)")
+    p.add_argument("--fe-storage-dtype", default=None, choices=["bf16"],
+                   help="Store dense fixed-effect features in bfloat16 (half "
+                        "the HBM traffic; f32 accumulation on the MXU). "
+                        "Validate metric parity for your workload first")
     p.add_argument("--profile-output-directory", default=None,
                    help="Capture an XLA/TPU profiler trace of the training "
                         "phase (open with TensorBoard or xprof) — the "
@@ -404,6 +408,12 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             else []
         )
 
+        fe_storage_dtype = None
+        if getattr(args, "fe_storage_dtype", None) == "bf16":
+            import jax.numpy as jnp
+
+            fe_storage_dtype = jnp.bfloat16
+
         mesh = None
         if getattr(args, "compute_backend", "host") == "mesh":
             n_model = getattr(args, "mesh_model_devices", 1) or 1
@@ -435,6 +445,7 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             mesh=mesh,
             checkpoint_directory=args.checkpoint_directory,
             checkpoint_interval=args.checkpoint_interval,
+            fe_storage_dtype=fe_storage_dtype,
         )
 
         emitter.send_event(Event("TrainingStartEvent"))

@@ -245,6 +245,22 @@ class SparseDesignMatrix:
 DesignMatrix = Union[DenseDesignMatrix, SparseDesignMatrix]
 
 
+def as_design_matrix_with_storage(X, storage_dtype, compute_dtype) -> "DesignMatrix":
+    """as_design_matrix with an optional lower STORAGE dtype for dense inputs.
+
+    Raw dense arrays cast at creation (only storage-dtype bytes are ever
+    transferred/resident — the bf16 point); existing DenseDesignMatrix values
+    are downcast; sparse inputs build once at the compute dtype (their values
+    ride the elementwise VPU path, not the MXU)."""
+    if storage_dtype is None:
+        return as_design_matrix(X, dtype=compute_dtype)
+    if isinstance(X, DenseDesignMatrix):
+        return DenseDesignMatrix(values=X.values.astype(storage_dtype))
+    if not isinstance(X, SparseDesignMatrix) and not hasattr(X, "tocoo"):
+        return as_design_matrix(X, dtype=storage_dtype)  # raw dense array
+    return as_design_matrix(X, dtype=compute_dtype)
+
+
 def as_design_matrix(X, dtype=None) -> DesignMatrix:
     """Coerce numpy / jax arrays or scipy sparse matrices to a DesignMatrix."""
     if isinstance(X, (DenseDesignMatrix, SparseDesignMatrix)):

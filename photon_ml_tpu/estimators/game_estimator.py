@@ -122,6 +122,12 @@ class GameEstimator:
     # leans on Spark lineage recomputation (CoordinateDescent.scala:130-160).
     checkpoint_directory: Optional[str] = None
     checkpoint_interval: int = 1
+    # Store dense fixed-effect design matrices in a lower dtype (bfloat16):
+    # matvecs read half the HBM bytes and hit the MXU natively while labels,
+    # scores, coefficients and accumulation keep `dtype`
+    # (DenseDesignMatrix._mxu_dot). Validate quality before relying on it —
+    # bench.py gates its bf16 variant on 1% objective parity.
+    fe_storage_dtype: Optional[object] = None
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -149,9 +155,16 @@ class GameEstimator:
         for cid, cfg in self.coordinate_configurations.items():
             dc = cfg.data_config
             if isinstance(dc, FixedEffectDataConfiguration):
+                from photon_ml_tpu.data.matrix import as_design_matrix_with_storage
+
+                X = as_design_matrix_with_storage(
+                    data.shard(dc.feature_shard_id),
+                    self.fe_storage_dtype,
+                    self.dtype,
+                )
                 datasets[cid] = FixedEffectDataset(
                     LabeledData.build(
-                        data.shard(dc.feature_shard_id),
+                        X,
                         data.labels,
                         offsets=data.offsets,
                         weights=data.weights,

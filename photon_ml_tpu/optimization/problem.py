@@ -80,7 +80,9 @@ class GLMOptimizationProblem:
         from photon_ml_tpu.optimization.solver_cache import glm_solver
 
         cfg = self.configuration
-        dtype = data.X.dtype
+        # labels carry the COMPUTE dtype; X may hold a lower STORAGE dtype
+        # (bf16) that must not quantize reg weights or box bounds
+        dtype = data.labels.dtype
         x0 = (
             initial_model.coefficients.means
             if initial_model is not None

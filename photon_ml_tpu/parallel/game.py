@@ -103,7 +103,7 @@ def build_sharded_game_data(
     matrix in bf16 (matvecs read half the HBM bytes and hit the MXU natively;
     accumulation stays f32 — see DenseDesignMatrix._mxu_dot). Labels, weights,
     scores and coefficients keep ``dtype``."""
-    from photon_ml_tpu.data.matrix import DenseDesignMatrix, as_design_matrix
+    from photon_ml_tpu.data.matrix import as_design_matrix_with_storage
     from photon_ml_tpu.parallel.glm import shard_labeled_data
 
     m = mesh.devices.size
@@ -112,11 +112,7 @@ def build_sharded_game_data(
     offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
     weights = np.ones(n) if weights is None else np.asarray(weights)
 
-    fe_mat = as_design_matrix(fe_X, dtype=dtype)
-    if fe_storage_dtype is not None and isinstance(fe_mat, DenseDesignMatrix):
-        # cast BEFORE placement: only the storage-dtype bytes are transferred
-        # and resident — at bf16-motivating scale the f32 copy may not even fit
-        fe_mat = DenseDesignMatrix(values=fe_mat.values.astype(fe_storage_dtype))
+    fe_mat = as_design_matrix_with_storage(fe_X, fe_storage_dtype, dtype)
     fe_data, _ = shard_labeled_data(
         LabeledData.build(
             fe_mat, labels, offsets=offsets, weights=weights, dtype=dtype,

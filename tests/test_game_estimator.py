@@ -201,3 +201,27 @@ def test_warm_start_chain_improves_or_matches(rng):
     assert len(results) == 3
     aucs = [r.best_metric for r in results]
     assert all(a > 0.75 for a in aucs)
+
+
+def test_fe_storage_dtype_bf16_close_to_f32(rng):
+    """Estimator-level bf16 feature storage: coefficients/metrics stay f32 and
+    land near the full-precision fit (DenseDesignMatrix._mxu_dot)."""
+    data = make_input(rng)
+    train, val = data.select(np.arange(0, 550)), data.select(np.arange(550, 800))
+
+    def fit(storage):
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configurations=make_configs(),
+            n_iterations=2,
+            fe_storage_dtype=storage,
+        )
+        return est.fit(train, validation_data=val)[0]
+
+    import jax.numpy as jnp
+
+    f32 = fit(None)
+    bf16 = fit(jnp.bfloat16)
+    coef = bf16.model.get_model("fixed").model.coefficients.means
+    assert coef.dtype == jnp.float32
+    assert bf16.best_metric == pytest.approx(f32.best_metric, abs=0.01)
