@@ -29,6 +29,43 @@ from photon_ml_tpu.normalization import NormalizationContext, FeatureDataStatist
 
 __version__ = "0.1.0"
 
+# Lazy top-level conveniences: the whole quick-start in one import. (Laziness
+# here avoids importing the heavier subpackages — estimators, parallel, io —
+# eagerly; jax itself is already imported above via normalization.)
+_LAZY = {
+    "GameEstimator": "photon_ml_tpu.estimators.game_estimator",
+    "GameResult": "photon_ml_tpu.estimators.game_estimator",
+    "GameTransformer": "photon_ml_tpu.transformers.game_transformer",
+    "GameInput": "photon_ml_tpu.data.game_data",
+    "CoordinateConfiguration": "photon_ml_tpu.estimators.config",
+    "FixedEffectDataConfiguration": "photon_ml_tpu.estimators.config",
+    "RandomEffectDataConfiguration": "photon_ml_tpu.estimators.config",
+    "GLMOptimizationConfiguration": "photon_ml_tpu.optimization.config",
+    "RegularizationContext": "photon_ml_tpu.optimization.config",
+    "OptimizerConfig": "photon_ml_tpu.optimization.common",
+    "EvaluatorType": "photon_ml_tpu.evaluation.evaluators",
+    "make_mesh": "photon_ml_tpu.parallel.mesh",
+    "make_mesh2": "photon_ml_tpu.parallel.feature_sharded",
+    "save_game_model": "photon_ml_tpu.io.model_io",
+    "load_game_model": "photon_ml_tpu.io.model_io",
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value  # cache: later accesses are plain dict lookups
+    return value
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))
+
+
 __all__ = [
     "TaskType",
     "OptimizerType",
@@ -39,4 +76,5 @@ __all__ = [
     "NormalizationContext",
     "FeatureDataStatistics",
     "__version__",
+    *sorted(_LAZY),
 ]
