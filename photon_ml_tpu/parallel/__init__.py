@@ -37,6 +37,7 @@ from photon_ml_tpu.parallel.feature_sharded import (
     train_glm_feature_sharded,
 )
 from photon_ml_tpu.parallel.glm import shard_labeled_data, train_glm_sharded
+from photon_ml_tpu.parallel.sweep import train_glm_reg_sweep
 from photon_ml_tpu.parallel.game import (
     ShardedGameData,
     build_sharded_game_data,
@@ -57,6 +58,7 @@ __all__ = [
     "make_mesh2",
     "shard_labeled_data_2d",
     "train_glm_feature_sharded",
+    "train_glm_reg_sweep",
     "ShardedGameData",
     "build_sharded_game_data",
     "game_train_step",

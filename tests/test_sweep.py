@@ -1,0 +1,114 @@
+"""Batched regularization sweeps (parallel/sweep.py): one vmapped program
+trains every candidate — the TPU answer to the reference's sequential grid
+(GameEstimator.fit:344-360, SURVEY §2.7)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.dataset import LabeledData
+from photon_ml_tpu.normalization import NO_NORMALIZATION
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.optimization.solver_cache import glm_solver
+from photon_ml_tpu.parallel import train_glm_reg_sweep
+from photon_ml_tpu.types import (
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+    VarianceComputationType,
+)
+
+
+def _cfg(opt=OptimizerType.LBFGS, reg=RegularizationType.L2):
+    return GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(
+            optimizer_type=opt, max_iterations=80, tolerance=1e-10
+        ),
+        regularization_context=RegularizationContext(reg),
+        regularization_weight=1.0,
+    )
+
+
+def _data(rng, n=500, d=6):
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ w)))).astype(float)
+    return LabeledData.build(X, y, dtype=jnp.float64)
+
+
+def _sequential(data, cfg, l2, task=TaskType.LOGISTIC_REGRESSION):
+    solve = glm_solver(
+        task, cfg.optimizer_config, False, False, False, VarianceComputationType.NONE
+    )
+    res, _ = solve(
+        data,
+        jnp.zeros(data.dim, dtype=jnp.float64),
+        jnp.asarray(l2, dtype=jnp.float64),
+        jnp.asarray(0.0, dtype=jnp.float64),
+        jnp.zeros((0,), dtype=jnp.float64),
+        jnp.zeros((0,), dtype=jnp.float64),
+        NO_NORMALIZATION,
+    )
+    return np.asarray(res.coefficients)
+
+
+@pytest.mark.parametrize("opt", [OptimizerType.LBFGS, OptimizerType.TRON])
+def test_batched_sweep_matches_sequential(rng, opt):
+    data = _data(rng)
+    cfg = _cfg(opt)
+    weights = [0.1, 1.0, 10.0, 100.0]
+    coefs, values, iters, reasons = train_glm_reg_sweep(
+        data, TaskType.LOGISTIC_REGRESSION, cfg, weights
+    )
+    assert coefs.shape == (4, data.dim)
+    for k, l2 in enumerate(weights):
+        ref = _sequential(data, cfg, l2)
+        np.testing.assert_allclose(np.asarray(coefs[k]), ref, atol=1e-6, err_msg=str(l2))
+    # stronger regularization -> smaller coefficients, for EVERY adjacent pair
+    norms = np.linalg.norm(np.asarray(coefs), axis=1)
+    by_weight_desc = norms[np.argsort(weights)[::-1]]
+    assert np.all(np.diff(by_weight_desc) >= -1e-9), by_weight_desc
+    assert np.asarray(reasons).shape == (4,)
+
+
+def test_shared_warm_start(rng):
+    data = _data(rng)
+    cfg = _cfg()
+    warm = _sequential(data, cfg, 1.0)
+    coefs, _, iters, _ = train_glm_reg_sweep(
+        data, TaskType.LOGISTIC_REGRESSION, cfg, [1.0, 2.0],
+        initial_coefficients=warm,
+    )
+    # candidate 0 restarts at its own optimum: few iterations
+    assert int(iters[0]) <= 5
+    np.testing.assert_allclose(np.asarray(coefs[0]), warm, atol=1e-5)
+
+
+def test_l1_rejected(rng):
+    data = _data(rng)
+    cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(optimizer_type=OptimizerType.OWLQN),
+        regularization_context=RegularizationContext(RegularizationType.L1),
+        regularization_weight=1.0,
+    )
+    with pytest.raises(ValueError, match="L1"):
+        train_glm_reg_sweep(data, TaskType.LOGISTIC_REGRESSION, cfg, [0.1, 1.0])
+
+
+def test_repeated_sweeps_share_one_program(rng):
+    """Second sweep with the same static config must reuse the compiled
+    program (reg_sweep_solver is lru_cached with traced data/x0/weights)."""
+    from photon_ml_tpu.parallel.sweep import reg_sweep_solver
+
+    data = _data(rng)
+    cfg = _cfg()
+    before = reg_sweep_solver.cache_info().currsize
+    train_glm_reg_sweep(data, TaskType.LOGISTIC_REGRESSION, cfg, [0.5, 5.0])
+    train_glm_reg_sweep(data, TaskType.LOGISTIC_REGRESSION, cfg, [0.7, 7.0])
+    after = reg_sweep_solver.cache_info()
+    assert after.currsize <= before + 1  # one solver object for both calls
+    assert after.hits >= 1
