@@ -160,8 +160,8 @@ def train_random_effect(
     task = TaskType(task)
     loss = loss_for_task(task)
     opt_type = OptimizerType(configuration.optimizer_config.optimizer_type)
-    if opt_type == OptimizerType.TRON and not loss.has_hessian:
-        raise ValueError("TRON requires a twice-differentiable loss")
+    if opt_type in (OptimizerType.TRON, OptimizerType.NEWTON) and not loss.has_hessian:
+        raise ValueError(f"{opt_type.value} requires a twice-differentiable loss")
     l2 = configuration.l2_weight
     l1 = configuration.l1_weight
     variance_computation = VarianceComputationType(variance_computation)

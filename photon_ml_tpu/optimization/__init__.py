@@ -3,6 +3,7 @@ from photon_ml_tpu.optimization.lbfgs import minimize_lbfgs
 from photon_ml_tpu.optimization.owlqn import minimize_owlqn
 from photon_ml_tpu.optimization.lbfgsb import minimize_lbfgsb
 from photon_ml_tpu.optimization.tron import minimize_tron
+from photon_ml_tpu.optimization.newton import minimize_newton
 from photon_ml_tpu.optimization.factory import build_minimizer
 
 __all__ = [
@@ -12,5 +13,6 @@ __all__ = [
     "minimize_owlqn",
     "minimize_lbfgsb",
     "minimize_tron",
+    "minimize_newton",
     "build_minimizer",
 ]

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from photon_ml_tpu.optimization.common import OptimizerConfig, OptResult
 from photon_ml_tpu.optimization.lbfgs import minimize_lbfgs
 from photon_ml_tpu.optimization.lbfgsb import minimize_lbfgsb
+from photon_ml_tpu.optimization.newton import minimize_newton
 from photon_ml_tpu.optimization.owlqn import minimize_owlqn
 from photon_ml_tpu.optimization.tron import minimize_tron
 from photon_ml_tpu.types import OptimizerType
@@ -33,6 +34,7 @@ def build_minimizer(config: OptimizerConfig):
         *,
         l1_weight=0.0,
         hvp: Optional[Callable[[Array, Array], Array]] = None,
+        hess: Optional[Callable[[Array], Array]] = None,
         lower_bounds: Optional[Array] = None,
         upper_bounds: Optional[Array] = None,
     ) -> OptResult:
@@ -56,6 +58,20 @@ def build_minimizer(config: OptimizerConfig):
                 tolerance=config.tolerance,
                 history_length=config.history_length,
                 max_line_search_iterations=config.max_line_search_iterations,
+                track_states=config.track_states,
+            )
+        if opt == OptimizerType.NEWTON:
+            if hess is None:
+                raise ValueError("NEWTON requires a full-Hessian callable")
+            return minimize_newton(
+                value_and_grad,
+                hess,
+                x0,
+                max_iterations=config.max_iterations,
+                tolerance=config.tolerance,
+                max_line_search_iterations=config.max_line_search_iterations,
+                lower_bounds=lower_bounds,
+                upper_bounds=upper_bounds,
                 track_states=config.track_states,
             )
         if opt == OptimizerType.TRON:

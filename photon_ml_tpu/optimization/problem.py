@@ -44,10 +44,10 @@ class GLMOptimizationProblem:
         )
         loss = loss_for_task(self.task)
         opt_type = OptimizerType(self.configuration.optimizer_config.optimizer_type)
-        if opt_type == OptimizerType.TRON and not loss.has_hessian:
+        if opt_type in (OptimizerType.TRON, OptimizerType.NEWTON) and not loss.has_hessian:
             raise ValueError(
-                f"TRON requires a twice-differentiable loss; {self.task} is not "
-                "(reference: smoothed hinge is DiffFunction-only)"
+                f"{opt_type.value} requires a twice-differentiable loss; {self.task} "
+                "is not (reference: smoothed hinge is DiffFunction-only)"
             )
 
     @property

@@ -71,6 +71,7 @@ def glm_solver(
     loss = loss_for_task(task)
     minimize = build_minimizer(opt_config)
     use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
     variance = VarianceComputationType(variance)
 
     def solve(data, x0, l2, l1, lower, upper, norm):
@@ -82,6 +83,8 @@ def glm_solver(
         kwargs = {}
         if use_hvp:
             kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
+        if use_hess:
+            kwargs["hess"] = lambda w: obj.hessian_matrix(data, w, l2)
         if has_l1:
             kwargs["l1_weight"] = l1
         if has_lower:
@@ -115,6 +118,7 @@ def re_bucket_solver(
     loss = loss_for_task(task)
     minimize = build_minimizer(opt_config)
     use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
     variance = VarianceComputationType(variance)
 
     from photon_ml_tpu.data.dataset import LabeledData
@@ -130,6 +134,8 @@ def re_bucket_solver(
         kwargs = {}
         if use_hvp:
             kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
+        if use_hess:
+            kwargs["hess"] = lambda w: obj.hessian_matrix(data, w, l2)
         if has_l1:
             kwargs["l1_weight"] = l1
         res = minimize(vg, w0, **kwargs)
@@ -155,6 +161,7 @@ def sharded_glm_solver(
     loss = loss_for_task(task)
     minimize = build_minimizer(opt_config)
     use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
 
     def solve(data, x0, l2, l1):
         obj = GLMObjective(loss)
@@ -165,6 +172,8 @@ def sharded_glm_solver(
         kwargs = {}
         if use_hvp:
             kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
+        if use_hess:
+            kwargs["hess"] = lambda w: obj.hessian_matrix(data, w, l2)
         if has_l1:
             kwargs["l1_weight"] = l1
         return minimize(vg, x0, **kwargs)

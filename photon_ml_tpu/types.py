@@ -38,6 +38,11 @@ class OptimizerType(str, enum.Enum):
     OWLQN = "OWLQN"
     LBFGSB = "LBFGSB"
     TRON = "TRON"
+    # TPU-first extension (no reference counterpart): direct damped
+    # Newton-Cholesky for small-dimension solves — the random-effect inner
+    # problems (optimization/newton.py). Needs a materializable Hessian, so the
+    # same TwiceDiff gate as TRON applies (no smoothed hinge, no L1).
+    NEWTON = "NEWTON"
 
 
 class RegularizationType(str, enum.Enum):

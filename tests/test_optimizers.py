@@ -17,6 +17,7 @@ from photon_ml_tpu.optimization import (
     build_minimizer,
     minimize_lbfgs,
     minimize_lbfgsb,
+    minimize_newton,
     minimize_owlqn,
     minimize_tron,
 )
@@ -256,6 +257,8 @@ def test_factory_dispatch(rng, opt_type):
     kwargs = {}
     if opt_type == OptimizerType.TRON:
         kwargs["hvp"] = lambda x, v: obj.hessian_vector(data, x, v, 0.5)
+    if opt_type == OptimizerType.NEWTON:
+        kwargs["hess"] = lambda x: obj.hessian_matrix(data, x, 0.5)
     if opt_type == OptimizerType.LBFGSB:
         kwargs["lower_bounds"] = -jnp.ones(3)
         kwargs["upper_bounds"] = jnp.ones(3)
@@ -289,6 +292,8 @@ def test_warm_start_at_optimum_converges_immediately(opt_type):
     kwargs = {}
     if opt_type == OptimizerType.TRON:
         res = minimize_tron(vg, lambda x, v: v, center)
+    elif opt_type == OptimizerType.NEWTON:
+        res = minimize_newton(vg, lambda x: jnp.eye(2), center)
     elif opt_type == OptimizerType.LBFGSB:
         res = minimize_lbfgsb(vg, center, -5 * jnp.ones(2), 5 * jnp.ones(2))
     elif opt_type == OptimizerType.OWLQN:
@@ -322,3 +327,105 @@ def test_lbfgsb_skipped_pairs_keep_history_consistent():
     )
     np.testing.assert_allclose(res.coefficients, [1.0, 1.0, -1.0], atol=1e-8)
     assert res.converged
+
+
+# ---------------------------------------------------------------- NEWTON
+
+
+def test_newton_quadratic_one_step():
+    """A Newton step on a quadratic is exact: converges in <= 2 iterations."""
+    vg, _ = quadratic([1.0, -2.0, 3.0], [1.0, 10.0, 0.1])
+    hess = lambda x: jnp.diag(jnp.asarray([1.0, 10.0, 0.1]))
+    res = minimize_newton(vg, hess, jnp.zeros(3), tolerance=1e-12)
+    np.testing.assert_allclose(res.coefficients, [1.0, -2.0, 3.0], atol=1e-8)
+    assert int(res.iterations) <= 2
+
+
+def test_newton_logistic_matches_lbfgs(rng):
+    """Same optimum as L-BFGS on a regularized logistic problem, far fewer iterations."""
+    X = rng.normal(size=(150, 8))
+    X[:, -1] = 1.0
+    y = (X @ rng.normal(size=8) + 0.3 * rng.normal(size=150) > 0).astype(float)
+    data = LabeledData.build(X, y)
+    obj = GLMObjective(logistic_loss)
+    vg = make_value_and_grad(obj, data, l2_weight=1.0)
+    hess = lambda w: obj.hessian_matrix(data, w, 1.0)
+    newton = minimize_newton(vg, hess, jnp.zeros(8), tolerance=1e-12, max_iterations=50)
+    lbfgs = minimize_lbfgs(vg, jnp.zeros(8), tolerance=1e-12, max_iterations=200)
+    np.testing.assert_allclose(newton.coefficients, lbfgs.coefficients, atol=1e-5)
+    assert newton.converged
+    assert int(newton.iterations) < int(lbfgs.iterations)
+    assert int(newton.iterations) <= 10
+
+
+def test_newton_poisson(rng):
+    X = rng.normal(size=(100, 4)) * 0.5
+    lam = np.exp(X @ rng.normal(size=4) * 0.3)
+    y = rng.poisson(lam).astype(float)
+    data = LabeledData.build(X, y)
+    obj = GLMObjective(poisson_loss)
+    vg = make_value_and_grad(obj, data, l2_weight=0.1)
+    hess = lambda w: obj.hessian_matrix(data, w, 0.1)
+    res = minimize_newton(vg, hess, jnp.zeros(4), tolerance=1e-12)
+    assert res.converged
+    np.testing.assert_allclose(np.asarray(res.gradient), 0.0, atol=1e-5)
+
+
+def test_newton_singular_hessian_damps():
+    """Rank-deficient Hessian (no L2): the damping ladder still yields progress."""
+    # f(x) = 1/2 (x0 + x1 - 1)^2 — flat along x0 - x1; H is singular.
+    def vg(x):
+        r = x[0] + x[1] - 1.0
+        return 0.5 * r * r, jnp.asarray([r, r])
+
+    hess = lambda x: jnp.ones((2, 2))
+    res = minimize_newton(vg, hess, jnp.zeros(2), tolerance=1e-10, max_iterations=50)
+    assert float(res.value) < 1e-10
+
+
+def test_newton_vmap_batched(rng):
+    """vmapped Newton == per-problem Newton (the RE bucket regime)."""
+    centers = jnp.asarray(rng.normal(size=(6, 3)))
+
+    def solve(center):
+        vg = lambda x: (0.5 * jnp.sum((x - center) ** 2), x - center)
+        return minimize_newton(vg, lambda x: jnp.eye(3), jnp.zeros(3), max_iterations=20)
+
+    batched = jax.vmap(solve)(centers)
+    np.testing.assert_allclose(batched.coefficients, centers, atol=1e-7)
+
+
+def test_newton_with_bounds():
+    vg, _ = quadratic([2.0, -3.0], [1.0, 1.0])
+    res = minimize_newton(
+        vg, lambda x: jnp.eye(2), jnp.zeros(2),
+        lower_bounds=-jnp.ones(2), upper_bounds=jnp.ones(2), max_iterations=50,
+    )
+    np.testing.assert_allclose(res.coefficients, [1.0, -1.0], atol=1e-6)
+    f_at_x = float(vg(res.coefficients)[0])
+    np.testing.assert_allclose(float(res.value), f_at_x, rtol=1e-10)
+
+
+def test_newton_factory_requires_hessian():
+    vg = lambda x: (0.5 * jnp.sum(x**2), x)
+    with pytest.raises(ValueError, match="Hessian"):
+        build_minimizer(OptimizerConfig(optimizer_type=OptimizerType.NEWTON))(vg, jnp.zeros(2))
+
+
+def test_newton_rejected_for_smoothed_hinge(rng):
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(optimizer_type=OptimizerType.NEWTON),
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    with pytest.raises(ValueError, match="twice-differentiable"):
+        GLMOptimizationProblem(
+            task=TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM, configuration=cfg
+        )
