@@ -117,7 +117,7 @@ def bootstrap_training(
         smooth = use_vmap
 
     if smooth:
-        obj = problem.objective
+        obj = dataclasses.replace(problem.objective, allow_fused=False)  # vmapped
         l2 = cfg.l2_weight
 
         def solve(weights: Array) -> Array:

@@ -44,6 +44,11 @@ class GLMObjective:
 
     loss: PointwiseLoss
     normalization: NormalizationContext = NO_NORMALIZATION
+    # Callers that vmap the objective (per-entity buckets, batched sweeps,
+    # bootstrap) must disable the Pallas fast path: pallas_call has no batching
+    # rule for this kernel, and those inner problems are the wrong regime for
+    # it anyway (small D, batch axis provides the parallelism).
+    allow_fused: bool = True
 
     # -- internals -------------------------------------------------------------------
 
@@ -73,12 +78,48 @@ class GLMObjective:
     def value_and_gradient(
         self, data: LabeledData, coef: Array, l2_weight=0.0
     ) -> tuple[Array, Array]:
+        fused = self._fused_value_and_gradient(data, coef, l2_weight)
+        if fused is not None:
+            return fused
         z = self._margins(data, coef)
         l, dz = self.loss.loss_and_dz(z, data.labels)
         wdz = self._weighted(data.weights, dz)
         value = jnp.sum(self._weighted(data.weights, l)) + self._l2_value(coef, l2_weight)
         vector_sum = data.X.rmatvec(wdz)
         grad = self.normalization.apply_to_gradient(vector_sum, jnp.sum(wdz))
+        return value, grad + l2_weight * coef
+
+    def _fused_value_and_gradient(self, data: LabeledData, coef: Array, l2_weight):
+        """Opt-in Pallas fast path (ops/pallas_glm.py): the two-matmul XLA
+        lowering reads X from HBM twice per evaluation; the fused kernel reads
+        it once. Engages only for dense f32/bf16 single-device problems with
+        the kernel switch on (returns None otherwise = stock path)."""
+        from photon_ml_tpu.data.matrix import DenseDesignMatrix
+        from photon_ml_tpu.ops import pallas_glm
+
+        X = data.X
+        if (
+            not self.allow_fused
+            or not isinstance(X, DenseDesignMatrix)
+            or X.values.ndim != 2
+            or X.dtype not in (jnp.float32, jnp.bfloat16)
+            or coef.dtype != jnp.float32
+            or not pallas_glm.should_fuse(X.n_cols)
+        ):
+            return None
+        eff, margin_shift = self.normalization.effective_coefficients(coef)
+        val, vec, wsum = pallas_glm.fused_loss_grad_sums(
+            X.values,
+            data.labels,
+            data.offsets,
+            data.weights,
+            eff,
+            jnp.broadcast_to(jnp.asarray(margin_shift, jnp.float32), ()),
+            loss_and_dz=self.loss.loss_and_dz,
+            interpret=pallas_glm.interpret_mode(),
+        )
+        value = val + self._l2_value(coef, l2_weight)
+        grad = self.normalization.apply_to_gradient(vec, wsum)
         return value, grad + l2_weight * coef
 
     def gradient(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:

@@ -1,0 +1,15 @@
+"""TPU kernels (Pallas) for the hot GLM ops, with gated integration.
+
+The compute path of the framework is plain XLA by default; these kernels are
+opt-in fusions for ops where XLA's automatic fusion cannot remove HBM traffic
+(see pallas_glm.py). Enable with ``photon_ml_tpu.ops.enable_pallas(True)`` or
+``PHOTON_PALLAS=1``.
+"""
+
+from photon_ml_tpu.ops.pallas_glm import (
+    enable_pallas,
+    fused_loss_grad_sums,
+    pallas_enabled,
+)
+
+__all__ = ["enable_pallas", "fused_loss_grad_sums", "pallas_enabled"]

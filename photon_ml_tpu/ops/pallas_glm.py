@@ -1,0 +1,220 @@
+"""Fused GLM loss+gradient Pallas kernel — one HBM pass over the design matrix.
+
+This is the framework's #1 compute kernel (the reference's
+ValueAndGradientAggregator.scala:34-280: one streaming pass accumulating
+``sum w*l(z, y)`` and ``X^T (w * dl/dz)``). The stock XLA lowering runs it as
+two matmuls — ``z = X @ w`` then ``g = X^T d`` — so the design matrix is read
+from HBM twice per optimizer evaluation. On TPU the op is bandwidth-bound for
+any realistically large ``N x D`` block, so this kernel tiles X over row blocks
+and computes BOTH contractions per block while it is resident in VMEM:
+
+    per block i:  z_i = X_i @ w + offsets_i          (MXU)
+                  l_i, dz_i = pointwise loss          (VPU)
+                  val  += sum(wgt_i * l_i)            (VPU, masked weights)
+                  grad += X_i^T (wgt_i * dz_i)        (MXU)
+                  wsum += sum(wgt_i * dz_i)
+
+halving X's HBM traffic and collapsing the elementwise chain into the same
+kernel. The TPU grid is sequential, so the VMEM accumulators carry across grid
+steps (initialized at block 0) — the standard reduction pattern.
+
+The kernel returns raw sums (loss sum, gradient vector sum, weighted-dz sum);
+the caller applies the normalization shift/factor algebra and the L2 term
+exactly as GLMObjective does, so the fused path is a drop-in replacement for
+any normalization context.
+
+Weight-0 rows are EXCLUDED (masked, not multiplied) to match
+GLMObjective._weighted: padding rows and down-sampled rows must stay inert
+even when their margins overflow the pointwise loss.
+
+Gating: OFF by default. Enable with ``enable_pallas(True)`` or
+``PHOTON_PALLAS=1``. The fused path only engages on the TPU backend for dense
+float inputs with D <= MAX_FUSED_DIM (the whole coefficient vector and an
+[BN, D] block must fit VMEM); everything else falls back to the XLA path.
+CPU tests run the same kernel in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+# [BLOCK_ROWS, D] f32 block + [D, 1] coefficients + [D, 1] accumulator must fit
+# in ~16 MB VMEM with headroom for double buffering: 512 x 4096 f32 = 8 MB.
+BLOCK_ROWS = 512
+MAX_FUSED_DIM = 4096
+
+_enabled: bool | None = None
+
+
+def enable_pallas(on: bool | None) -> None:
+    """Process-wide switch for the fused kernels (overrides PHOTON_PALLAS;
+    ``None`` reverts to the environment variable).
+
+    The fuse decision is baked in at trace time, and the solver caches
+    (optimization/solver_cache.py) hold traced programs — toggling must drop
+    them or already-compiled solvers would keep their old lowering.
+    """
+    global _enabled
+    new = None if on is None else bool(on)
+    if new == _enabled:
+        return
+    _enabled = new
+    from photon_ml_tpu.optimization import solver_cache
+
+    solver_cache.clear()
+
+
+def pallas_enabled() -> bool:
+    if _enabled is not None:
+        return _enabled
+    return os.environ.get("PHOTON_PALLAS", "") not in ("", "0")
+
+
+def interpret_mode() -> bool:
+    """CPU test hook: PHOTON_PALLAS_INTERPRET=1 runs the kernel interpreted,
+    letting the integration path be exercised without a TPU."""
+    return os.environ.get("PHOTON_PALLAS_INTERPRET", "") not in ("", "0")
+
+
+def should_fuse(n_cols: int) -> bool:
+    """True when the fused kernel should replace the two-matmul XLA path.
+
+    Trace-time decision: backend is the default backend of the process. The
+    kernel is compiled for single-device execution — under a >1-device mesh
+    GSPMD cannot partition an opaque pallas_call, so the mesh paths keep the
+    XLA lowering (its psum'd matmuls are already the right collective form).
+    """
+    if not pallas_enabled():
+        return False
+    if n_cols > MAX_FUSED_DIM:
+        return False
+    if interpret_mode():
+        return True
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+        return len(jax.devices()) == 1
+    except Exception:
+        return False
+
+
+def _kernel(loss_and_dz, n_valid, x_ref, y_ref, off_ref, wgt_ref, coef_ref,
+            val_ref, grad_ref, wsum_ref):
+    """One grid step: fused contractions for rows [i*BN, (i+1)*BN)."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    f32 = jnp.float32
+    # Row mask: rows past n_valid (the ragged last grid block — X is NOT padded
+    # host-side; out-of-bounds tile reads are garbage) and weight-0 rows are
+    # excluded, not multiplied — 0 * inf = NaN would poison both the sums and
+    # the matmuls (GLMObjective._weighted contract).
+    x = x_ref[...]
+    w = wgt_ref[...]
+    rows = jax.lax.broadcasted_iota(jnp.int32, w.shape, 0) + i * x.shape[0]
+    live = (w != 0.0) & (rows < n_valid)
+    x = jnp.where(live, x, jnp.zeros((), x.dtype))
+    # bf16 storage: feed the MXU bf16 x bf16 with f32 accumulation, matching
+    # data/matrix._mxu_dot's mixed-precision contract.
+    coef = coef_ref[...]
+    if x.dtype == jnp.bfloat16:
+        coef = coef.astype(jnp.bfloat16)
+    z = jnp.dot(x, coef, preferred_element_type=f32)  # [BN, 1]
+    z = z + off_ref[...]
+    l, dz = loss_and_dz(z, y_ref[...])
+    wl = jnp.where(live, w * l, 0.0)
+    wdz = jnp.where(live, w * dz, 0.0)
+
+    part_val = jnp.sum(wl)
+    part_wsum = jnp.sum(wdz)
+    d_col = wdz.astype(jnp.bfloat16 if x.dtype == jnp.bfloat16 else f32)
+    part_grad = jnp.dot(x.T, d_col, preferred_element_type=f32)  # [D, 1]
+
+    @pl.when(i == 0)
+    def _init():
+        val_ref[0, 0] = part_val
+        wsum_ref[0, 0] = part_wsum
+        grad_ref[...] = part_grad
+
+    @pl.when(i != 0)
+    def _acc():
+        val_ref[0, 0] += part_val
+        wsum_ref[0, 0] += part_wsum
+        grad_ref[...] += part_grad
+
+
+@functools.partial(
+    jax.jit, static_argnames=("loss_and_dz", "interpret", "block_rows")
+)
+def fused_loss_grad_sums(
+    X: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    eff_coef: Array,
+    margin_shift: Array,
+    *,
+    loss_and_dz,
+    interpret: bool = False,
+    block_rows: int = BLOCK_ROWS,
+) -> tuple[Array, Array, Array]:
+    """(loss_sum, gradient_vector_sum [D], weighted_dz_sum) in one X pass.
+
+    ``eff_coef``/``margin_shift`` are the normalization-effective coefficients
+    and margin shift (NormalizationContext.effective_coefficients) — pass the
+    raw coefficients and 0.0 when unnormalized. The caller applies
+    ``normalization.apply_to_gradient`` and the L2 term to the returned sums.
+    """
+    from jax.experimental import pallas as pl
+
+    n, d = X.shape
+    bn = block_rows
+    f32 = jnp.float32
+
+    # X is passed through un-padded: an X-sized pad copy per evaluation would
+    # cost the very HBM pass this kernel removes. The ragged last block is
+    # handled by the in-kernel row mask; only the [N]-vectors (4 bytes/row)
+    # are padded so their BlockSpecs tile evenly.
+    n_pad = -(-n // bn) * bn
+
+    def pad(v, fill=0.0):
+        return jnp.pad(v.astype(f32), (0, n_pad - n), constant_values=fill)[:, None]
+
+    # margin_shift rides the offsets (scalar + [N] broadcast done host-of-kernel)
+    off = pad(offsets + margin_shift)
+    y = pad(labels)
+    w = pad(weights)
+    coef = eff_coef.astype(f32)[:, None]  # [D, 1]
+
+    grid = n_pad // bn
+    kernel = functools.partial(_kernel, loss_and_dz, n)
+    val, grad, wsum = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), f32),
+            jax.ShapeDtypeStruct((d, 1), f32),
+            jax.ShapeDtypeStruct((1, 1), f32),
+        ],
+        interpret=interpret,
+    )(X, y, off, w, coef)
+    return val[0, 0], grad[:, 0], wsum[0, 0]

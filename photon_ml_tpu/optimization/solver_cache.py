@@ -60,6 +60,7 @@ def glm_solver(
     has_lower: bool,
     has_upper: bool,
     variance: VarianceComputationType,
+    allow_fused: bool = True,
 ):
     """Jitted ``solve(data, x0, l2, l1, lower, upper, norm) -> (OptResult, variances)``.
 
@@ -75,7 +76,7 @@ def glm_solver(
     variance = VarianceComputationType(variance)
 
     def solve(data, x0, l2, l1, lower, upper, norm):
-        obj = GLMObjective(loss, norm)
+        obj = GLMObjective(loss, norm, allow_fused=allow_fused)
 
         def vg(w):
             return obj.value_and_gradient(data, w, l2)
@@ -126,7 +127,7 @@ def re_bucket_solver(
 
     def solve_one(Xe, ye, we, oe, w0, l2, l1):
         data = LabeledData(X=DenseDesignMatrix(Xe), labels=ye, offsets=oe, weights=we)
-        obj = GLMObjective(loss)
+        obj = GLMObjective(loss, allow_fused=False)  # vmapped: no pallas path
 
         def vg(w):
             return obj.value_and_gradient(data, w, l2)
@@ -164,7 +165,9 @@ def sharded_glm_solver(
     use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
 
     def solve(data, x0, l2, l1):
-        obj = GLMObjective(loss)
+        # Multi-device mesh path: GSPMD cannot partition an opaque pallas_call,
+        # so the fused kernel stays off here regardless of the global switch.
+        obj = GLMObjective(loss, allow_fused=False)
 
         def vg(w):
             return obj.value_and_gradient(data, w, l2)

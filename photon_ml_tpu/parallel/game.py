@@ -199,6 +199,7 @@ def game_train_step(
     task: TaskType,
     fe_config: GLMOptimizationConfiguration,
     re_configs: Sequence[GLMOptimizationConfiguration],
+    fuse_fe: bool = False,
 ) -> tuple[dict, dict]:
     """One pure (jittable) coordinate-descent pass over [fixed, re_0, re_1, ...].
 
@@ -228,8 +229,11 @@ def game_train_step(
         weights=data.weights,
     )
     empty = jnp.zeros((0,), dtype=dtype)
+    # fuse_fe: the opt-in Pallas value+gradient kernel is only partitionable on
+    # a single-device mesh; make_jitted_game_step sets this from the mesh size.
     fe_solve = glm_solver(
-        task, fe_config.optimizer_config, bool(fe_config.l1_weight), False, False, no_var
+        task, fe_config.optimizer_config, bool(fe_config.l1_weight), False, False,
+        no_var, allow_fused=fuse_fe,
     )
     fe_res, _ = fe_solve(
         d,
@@ -290,7 +294,11 @@ def make_jitted_game_step(
     """jit(game_train_step) with data closed over and params donated — call as
     ``step(params) -> (params, diagnostics)``. One compiled XLA program per pass."""
 
+    fuse_fe = mesh.devices.size == 1
+
     def step(params):
-        return game_train_step(data, params, task, fe_config, tuple(re_configs))
+        return game_train_step(
+            data, params, task, fe_config, tuple(re_configs), fuse_fe=fuse_fe
+        )
 
     return jax.jit(step, donate_argnums=(0,))

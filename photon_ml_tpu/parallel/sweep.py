@@ -46,7 +46,7 @@ def reg_sweep_solver(task: TaskType, opt_config):
     use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
 
     def solve_one(data, w0, l2, norm):
-        obj = GLMObjective(loss, norm)
+        obj = GLMObjective(loss, norm, allow_fused=False)  # vmapped: no pallas path
 
         def vg(w):
             return obj.value_and_gradient(data, w, l2)
