@@ -89,23 +89,31 @@ class GLMObjective:
         grad = self.normalization.apply_to_gradient(vector_sum, jnp.sum(wdz))
         return value, grad + l2_weight * coef
 
-    def _fused_value_and_gradient(self, data: LabeledData, coef: Array, l2_weight):
-        """Opt-in Pallas fast path (ops/pallas_glm.py): the two-matmul XLA
-        lowering reads X from HBM twice per evaluation; the fused kernel reads
-        it once. Engages only for dense f32/bf16 single-device problems with
-        the kernel switch on (returns None otherwise = stock path)."""
+    def _fused_eligible(self, X, coef) -> bool:
+        """Shared eligibility gate for the Pallas fast paths: opt-in switch on,
+        dense f32/bf16 single-device problem, f32 coefficients. Both the
+        value+gradient and HVP evaluations of one solve must take the same
+        lowering, so they share this single decision."""
         from photon_ml_tpu.data.matrix import DenseDesignMatrix
         from photon_ml_tpu.ops import pallas_glm
 
+        return (
+            self.allow_fused
+            and isinstance(X, DenseDesignMatrix)
+            and X.values.ndim == 2
+            and X.dtype in (jnp.float32, jnp.bfloat16)
+            and coef.dtype == jnp.float32
+            and pallas_glm.should_fuse(X.n_cols)
+        )
+
+    def _fused_value_and_gradient(self, data: LabeledData, coef: Array, l2_weight):
+        """Opt-in Pallas fast path (ops/pallas_glm.py): the two-matmul XLA
+        lowering reads X from HBM twice per evaluation; the fused kernel reads
+        it once. Returns None when ineligible (= stock path)."""
+        from photon_ml_tpu.ops import pallas_glm
+
         X = data.X
-        if (
-            not self.allow_fused
-            or not isinstance(X, DenseDesignMatrix)
-            or X.values.ndim != 2
-            or X.dtype not in (jnp.float32, jnp.bfloat16)
-            or coef.dtype != jnp.float32
-            or not pallas_glm.should_fuse(X.n_cols)
-        ):
+        if not self._fused_eligible(X, coef):
             return None
         eff, margin_shift = self.normalization.effective_coefficients(coef)
         val, vec, wsum = pallas_glm.fused_loss_grad_sums(
@@ -122,6 +130,32 @@ class GLMObjective:
         grad = self.normalization.apply_to_gradient(vec, wsum)
         return value, grad + l2_weight * coef
 
+    def _fused_hessian_vector(self, data: LabeledData, coef, vector, l2_weight):
+        """Pallas fast path for the HVP (one X pass instead of three); same
+        gating as _fused_value_and_gradient. TRON runs one HVP per CG step, so
+        this is the hottest op of a TRON solve."""
+        from photon_ml_tpu.ops import pallas_glm
+
+        X = data.X
+        if not self._fused_eligible(X, coef):
+            return None
+        eff, margin_shift = self.normalization.effective_coefficients(coef)
+        eff_v, shift_v = self.normalization.effective_coefficients(vector)
+        vec, usum = pallas_glm.fused_hessian_vector_sums(
+            X.values,
+            data.labels,
+            data.offsets,
+            data.weights,
+            eff,
+            jnp.asarray(margin_shift, jnp.float32),
+            eff_v,
+            jnp.asarray(shift_v, jnp.float32),
+            dzz=self.loss.dzz,
+            interpret=pallas_glm.interpret_mode(),
+        )
+        hv = self.normalization.apply_to_gradient(vec, usum)
+        return hv + l2_weight * vector
+
     def gradient(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:
         return self.value_and_gradient(data, coef, l2_weight)[1]
 
@@ -129,6 +163,9 @@ class GLMObjective:
         self, data: LabeledData, coef: Array, vector: Array, l2_weight=0.0
     ) -> Array:
         """Gauss-Newton/true Hessian-vector product (TRON inner loop)."""
+        fused = self._fused_hessian_vector(data, coef, vector, l2_weight)
+        if fused is not None:
+            return fused
         z = self._margins(data, coef)
         dzz = self.loss.dzz(z, data.labels)
         eff_v, shift_v = self.normalization.effective_coefficients(vector)

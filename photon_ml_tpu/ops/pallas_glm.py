@@ -104,29 +104,39 @@ def should_fuse(n_cols: int) -> bool:
         return False
 
 
+
+def _block_prologue(i, x_ref, wgt_ref, n_valid):
+    """Shared per-block prologue: row mask + garbage zeroing.
+
+    Rows past n_valid (the ragged last grid block — X is NOT padded host-side,
+    so out-of-bounds tile reads are garbage) and weight-0 rows are EXCLUDED,
+    not multiplied: 0 * inf = NaN would poison both the sums and the matmuls
+    (GLMObjective._weighted contract)."""
+    from jax.experimental import pallas as pl  # noqa: F401
+
+    x = x_ref[...]
+    w = wgt_ref[...]
+    rows = jax.lax.broadcasted_iota(jnp.int32, w.shape, 0) + i * x.shape[0]
+    live = (w != 0.0) & (rows < n_valid)
+    x = jnp.where(live, x, jnp.zeros((), x.dtype))
+    return x, w, live
+
+
+def _mxu_dtype(x, v):
+    """bf16 storage feeds the MXU bf16 x bf16 with f32 accumulation, matching
+    data/matrix._mxu_dot's mixed-precision contract."""
+    return v.astype(jnp.bfloat16) if x.dtype == jnp.bfloat16 else v
+
+
 def _kernel(loss_and_dz, n_valid, x_ref, y_ref, off_ref, wgt_ref, coef_ref,
             val_ref, grad_ref, wsum_ref):
     """One grid step: fused contractions for rows [i*BN, (i+1)*BN)."""
     from jax.experimental import pallas as pl
 
     i = pl.program_id(0)
-
     f32 = jnp.float32
-    # Row mask: rows past n_valid (the ragged last grid block — X is NOT padded
-    # host-side; out-of-bounds tile reads are garbage) and weight-0 rows are
-    # excluded, not multiplied — 0 * inf = NaN would poison both the sums and
-    # the matmuls (GLMObjective._weighted contract).
-    x = x_ref[...]
-    w = wgt_ref[...]
-    rows = jax.lax.broadcasted_iota(jnp.int32, w.shape, 0) + i * x.shape[0]
-    live = (w != 0.0) & (rows < n_valid)
-    x = jnp.where(live, x, jnp.zeros((), x.dtype))
-    # bf16 storage: feed the MXU bf16 x bf16 with f32 accumulation, matching
-    # data/matrix._mxu_dot's mixed-precision contract.
-    coef = coef_ref[...]
-    if x.dtype == jnp.bfloat16:
-        coef = coef.astype(jnp.bfloat16)
-    z = jnp.dot(x, coef, preferred_element_type=f32)  # [BN, 1]
+    x, w, live = _block_prologue(i, x_ref, wgt_ref, n_valid)
+    z = jnp.dot(x, _mxu_dtype(x, coef_ref[...]), preferred_element_type=f32)  # [BN, 1]
     z = z + off_ref[...]
     l, dz = loss_and_dz(z, y_ref[...])
     wl = jnp.where(live, w * l, 0.0)
@@ -134,8 +144,9 @@ def _kernel(loss_and_dz, n_valid, x_ref, y_ref, off_ref, wgt_ref, coef_ref,
 
     part_val = jnp.sum(wl)
     part_wsum = jnp.sum(wdz)
-    d_col = wdz.astype(jnp.bfloat16 if x.dtype == jnp.bfloat16 else f32)
-    part_grad = jnp.dot(x.T, d_col, preferred_element_type=f32)  # [D, 1]
+    part_grad = jnp.dot(
+        x.T, _mxu_dtype(x, wdz.astype(f32)), preferred_element_type=f32
+    )  # [D, 1]
 
     @pl.when(i == 0)
     def _init():
@@ -148,6 +159,30 @@ def _kernel(loss_and_dz, n_valid, x_ref, y_ref, off_ref, wgt_ref, coef_ref,
         val_ref[0, 0] += part_val
         wsum_ref[0, 0] += part_wsum
         grad_ref[...] += part_grad
+
+
+
+def _tiled_row_inputs(labels, offsets, margin_shift, weights, n, bn):
+    """Pad the [N]-vectors (4 bytes/row — X itself is NOT padded; see the
+    ragged-last-block mask) to the block multiple and lift them to [N_pad, 1]
+    columns. margin_shift rides the offsets (it shifts z)."""
+    f32 = jnp.float32
+    n_pad = -(-n // bn) * bn
+
+    def pad(v):
+        return jnp.pad(v.astype(f32), (0, n_pad - n))[:, None]
+
+    return pad(offsets + margin_shift), pad(labels), pad(weights), n_pad // bn
+
+
+def _row_block_specs(pl, bn, d):
+    """BlockSpecs for (X, y, off, w): X tiled over rows, vectors alongside."""
+    return [
+        pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+    ]
 
 
 @functools.partial(
@@ -177,32 +212,14 @@ def fused_loss_grad_sums(
     n, d = X.shape
     bn = block_rows
     f32 = jnp.float32
-
-    # X is passed through un-padded: an X-sized pad copy per evaluation would
-    # cost the very HBM pass this kernel removes. The ragged last block is
-    # handled by the in-kernel row mask; only the [N]-vectors (4 bytes/row)
-    # are padded so their BlockSpecs tile evenly.
-    n_pad = -(-n // bn) * bn
-
-    def pad(v, fill=0.0):
-        return jnp.pad(v.astype(f32), (0, n_pad - n), constant_values=fill)[:, None]
-
-    # margin_shift rides the offsets (scalar + [N] broadcast done host-of-kernel)
-    off = pad(offsets + margin_shift)
-    y = pad(labels)
-    w = pad(weights)
+    off, y, w, grid = _tiled_row_inputs(labels, offsets, margin_shift, weights, n, bn)
     coef = eff_coef.astype(f32)[:, None]  # [D, 1]
 
-    grid = n_pad // bn
     kernel = functools.partial(_kernel, loss_and_dz, n)
     val, grad, wsum = pl.pallas_call(
         kernel,
         grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((bn, d), lambda i: (i, 0)),
-            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
-            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
-            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+        in_specs=_row_block_specs(pl, bn, d) + [
             pl.BlockSpec((d, 1), lambda i: (0, 0)),
         ],
         out_specs=[
@@ -218,3 +235,90 @@ def fused_loss_grad_sums(
         interpret=interpret,
     )(X, y, off, w, coef)
     return val[0, 0], grad[:, 0], wsum[0, 0]
+
+
+def _hvp_kernel(dzz, n_valid, x_ref, y_ref, off_ref, wgt_ref,
+                coef_ref, v_ref, sv_ref, vec_ref, usum_ref):
+    """One grid step of the fused Gauss-Newton HVP: the X block is read from
+    HBM once and used for all three contractions (z, dv, X^T u). The stock
+    lowering reads X three times per HVP, and TRON evaluates one HVP per CG
+    step (TRON.scala:278-338), making this the hottest op of a TRON solve."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    f32 = jnp.float32
+    x, w, live = _block_prologue(i, x_ref, wgt_ref, n_valid)
+    z = jnp.dot(x, _mxu_dtype(x, coef_ref[...]), preferred_element_type=f32)
+    z = z + off_ref[...]  # [BN, 1]
+    dv = jnp.dot(x, _mxu_dtype(x, v_ref[...]), preferred_element_type=f32)
+    dv = dv + sv_ref[0, 0]  # directional margins
+    u = jnp.where(live, w * dzz(z, y_ref[...]) * dv, 0.0)
+    part_vec = jnp.dot(
+        x.T, _mxu_dtype(x, u.astype(f32)), preferred_element_type=f32
+    )  # [D, 1]
+    part_usum = jnp.sum(u)
+
+    @pl.when(i == 0)
+    def _init():
+        vec_ref[...] = part_vec
+        usum_ref[0, 0] = part_usum
+
+    @pl.when(i != 0)
+    def _acc():
+        vec_ref[...] += part_vec
+        usum_ref[0, 0] += part_usum
+
+
+@functools.partial(jax.jit, static_argnames=("dzz", "interpret", "block_rows"))
+def fused_hessian_vector_sums(
+    X: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    eff_coef: Array,
+    margin_shift: Array,
+    eff_v: Array,
+    shift_v: Array,
+    *,
+    dzz,
+    interpret: bool = False,
+    block_rows: int = BLOCK_ROWS,
+) -> tuple[Array, Array]:
+    """(vector_sum [D], u_sum) for the Gauss-Newton HVP in one X pass.
+
+    Computes u = w * dzz(z, y) * (X @ eff_v + shift_v) with
+    z = X @ eff_coef + margin_shift + offsets, returning (X^T u, sum u); the
+    caller applies ``normalization.apply_to_gradient`` and the l2 term exactly
+    as GLMObjective.hessian_vector does. ``shift_v`` is dv's own margin shift
+    (it must NOT ride the offsets — those shift z, not dv).
+    """
+    from jax.experimental import pallas as pl
+
+    n, d = X.shape
+    bn = block_rows
+    f32 = jnp.float32
+    off, y, w, grid = _tiled_row_inputs(labels, offsets, margin_shift, weights, n, bn)
+    coef = eff_coef.astype(f32)[:, None]
+    v = eff_v.astype(f32)[:, None]
+
+    kernel = functools.partial(_hvp_kernel, dzz, n)
+    sv = jnp.reshape(jnp.asarray(shift_v, f32), (1, 1))
+    vec, usum = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=_row_block_specs(pl, bn, d) + [
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((d, 1), f32),
+            jax.ShapeDtypeStruct((1, 1), f32),
+        ],
+        interpret=interpret,
+    )(X, y, off, w, coef, v, sv)
+    return vec[:, 0], usum[0, 0]

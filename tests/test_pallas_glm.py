@@ -165,3 +165,82 @@ def test_solver_convergence_through_fused_path(rng):
     np.testing.assert_allclose(
         np.asarray(fused.coefficients), np.asarray(stock.coefficients), atol=5e-4
     )
+
+
+@pytest.mark.parametrize("loss", [logistic_loss, squared_loss, poisson_loss], ids=lambda l: l.name)
+def test_fused_hvp_matches_reference(rng, loss):
+    X, y, off, w, coef = _problem(rng, n=pallas_glm.BLOCK_ROWS + 51, d=6)
+    w[::7] = 0.0
+    v = rng.normal(size=6).astype(np.float32)
+    vec, usum = pallas_glm.fused_hessian_vector_sums(
+        jnp.asarray(X), jnp.asarray(y), jnp.asarray(off), jnp.asarray(w),
+        jnp.asarray(coef), jnp.float32(0.0), jnp.asarray(v), jnp.float32(0.0),
+        dzz=loss.dzz, interpret=True,
+    )
+    z = X.astype(np.float64) @ coef.astype(np.float64) + off
+    d2 = np.asarray(loss.dzz(jnp.asarray(z), jnp.asarray(y.astype(np.float64))))
+    dv = X.astype(np.float64) @ v.astype(np.float64)
+    u = np.where(w != 0, w * d2 * dv, 0.0)
+    np.testing.assert_allclose(np.asarray(vec), X.T.astype(np.float64) @ u, rtol=2e-4, atol=1e-3)
+    np.testing.assert_allclose(float(usum), u.sum(), rtol=2e-4, atol=1e-4)
+
+
+def test_tron_solve_through_fused_hvp(rng):
+    """A TRON solve with fused evaluations (value+grad AND HVP) matches stock."""
+    from photon_ml_tpu.function.objective import make_value_and_grad
+    from photon_ml_tpu.optimization import minimize_tron
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+
+    X, y, off, w, coef = _problem(rng, n=400, d=5)
+    data = LabeledData(
+        X=DenseDesignMatrix(jnp.asarray(X)), labels=jnp.asarray(y),
+        offsets=jnp.asarray(off), weights=jnp.asarray(w),
+    )
+    obj = GLMObjective(logistic_loss)
+    vg = make_value_and_grad(obj, data, l2_weight=0.5)
+    hvp = lambda x, v: obj.hessian_vector(data, x, v, 0.5)
+    stock = minimize_tron(vg, hvp, jnp.zeros(5, jnp.float32), tolerance=1e-10, max_iterations=60)
+
+    pallas_glm.enable_pallas(True)
+    os.environ["PHOTON_PALLAS_INTERPRET"] = "1"
+    try:
+        assert obj._fused_hessian_vector(
+            data, jnp.zeros(5, jnp.float32), jnp.ones(5, jnp.float32), 0.5
+        ) is not None
+        fused = minimize_tron(
+            vg, hvp, jnp.zeros(5, jnp.float32), tolerance=1e-10, max_iterations=60
+        )
+    finally:
+        pallas_glm.enable_pallas(False)
+        del os.environ["PHOTON_PALLAS_INTERPRET"]
+    np.testing.assert_allclose(
+        np.asarray(fused.coefficients), np.asarray(stock.coefficients), atol=5e-4
+    )
+
+
+def test_fused_hvp_with_normalization(rng):
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+
+    X, y, off, w, coef = _problem(rng, n=250, d=4)
+    X[:, -1] = 1.0
+    shifts = rng.normal(size=4) * 0.1
+    shifts[-1] = 0.0
+    norm = NormalizationContext(
+        factors=np.abs(rng.normal(size=4)) + 0.5, shifts=shifts, intercept_index=3
+    )
+    data = LabeledData(
+        X=DenseDesignMatrix(jnp.asarray(X)), labels=jnp.asarray(y),
+        offsets=jnp.asarray(off), weights=jnp.asarray(w),
+    )
+    obj = GLMObjective(logistic_loss, norm)
+    v = jnp.asarray(rng.normal(size=4).astype(np.float32))
+    stock = obj.hessian_vector(data, jnp.asarray(coef), v, 0.3)
+
+    pallas_glm.enable_pallas(True)
+    os.environ["PHOTON_PALLAS_INTERPRET"] = "1"
+    try:
+        fused = obj.hessian_vector(data, jnp.asarray(coef), v, 0.3)
+    finally:
+        pallas_glm.enable_pallas(False)
+        del os.environ["PHOTON_PALLAS_INTERPRET"]
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(stock), rtol=2e-4, atol=1e-4)
