@@ -208,3 +208,31 @@ def test_bf16_fe_storage_game_step_close_to_f32(rng):
         assert params["fixed"].dtype == jnp.float32
         vals[storage] = float(diag["fe_value"])
     assert abs(vals[jnp.bfloat16] - vals[None]) <= 0.01 * abs(vals[None])
+
+
+def test_scale_bench_tiny_smoke(capsys):
+    """benchmarks/scale_bench.py --tiny runs both configs end to end and
+    reports ~1/m per-device shard scaling."""
+    import json
+    import os
+    import sys
+
+    bench_dir = os.path.join(os.path.dirname(__file__), "..", "benchmarks")
+    sys.path.insert(0, bench_dir)
+    try:
+        import scale_bench
+    finally:
+        sys.path.remove(bench_dir)
+    assert scale_bench.main(["--tiny"]) == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    by_config = {rec["config"]: rec for rec in lines}
+    sparse = by_config["sparse_fixed_effect"]
+    assert sparse["devices"] >= 8
+    # nnz shards within one padding row of nnz / m
+    assert max(sparse["per_device_nnz_shards"]) <= sparse["nnz"] // sparse["devices"] + 1
+    entity = by_config["entity_scale"]
+    # table height = ceil((E+1)/m)*m entity-sharded -> at most E//m + 1 rows/device
+    assert len(entity["per_device_table_rows"]) == entity["devices"]
+    assert max(entity["per_device_table_rows"]) <= (
+        entity["n_entities"] // entity["devices"] + 1
+    )
