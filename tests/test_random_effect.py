@@ -345,3 +345,23 @@ def test_per_entity_reg_weights_array_form(rng):
             ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(X.shape[0]),
             per_entity_reg_weights=np.ones(7),
         )
+
+
+def test_all_entities_filtered_returns_empty_dataset():
+    """Lower bound above every entity's count: valid empty dataset, no crash
+    (regression: the vectorized observed-column path raised IndexError)."""
+    import scipy.sparse as sp
+
+    X = sp.csr_matrix(np.ones((4, 3)))
+    ents = np.asarray(["a", "a", "b", "c"])
+    y = np.asarray([0.0, 1.0, 1.0, 0.0])
+    ds = build_random_effect_dataset(
+        X, ents, "e", labels=y, active_data_lower_bound=10
+    )
+    assert ds.n_entities == 0 and ds.buckets == []
+    assert np.all(np.asarray(ds.sample_entity_rows) == -1)
+
+    empty = build_random_effect_dataset(
+        sp.csr_matrix((0, 3)), np.asarray([], dtype=object), "e", scoring_only=True
+    )
+    assert empty.n_entities == 0 and empty.n_samples == 0
