@@ -244,3 +244,32 @@ def test_fused_hvp_with_normalization(rng):
         pallas_glm.enable_pallas(False)
         del os.environ["PHOTON_PALLAS_INTERPRET"]
     np.testing.assert_allclose(np.asarray(fused), np.asarray(stock), rtol=2e-4, atol=1e-4)
+
+
+def test_fused_kernels_bf16_storage(rng):
+    """bf16 design-matrix storage: both kernels run the bf16 MXU branch and
+    stay within bf16 rounding of the f64 reference (the _mxu_dot contract)."""
+    X, y, off, w, coef = _problem(rng, n=300, d=4)
+    Xb = jnp.asarray(X, dtype=jnp.bfloat16)
+    val, grad, wsum = pallas_glm.fused_loss_grad_sums(
+        Xb, jnp.asarray(y), jnp.asarray(off), jnp.asarray(w),
+        jnp.asarray(coef), jnp.float32(0.0),
+        loss_and_dz=logistic_loss.loss_and_dz, interpret=True,
+    )
+    Xr = np.asarray(Xb).astype(np.float64)  # the rounded values ARE the data
+    ref_val, ref_grad, ref_wsum = _reference_sums(logistic_loss, Xr, y, off, w, coef)
+    np.testing.assert_allclose(float(val), ref_val, rtol=3e-2)
+    np.testing.assert_allclose(np.asarray(grad), ref_grad, rtol=4e-2, atol=0.5)
+    np.testing.assert_allclose(float(wsum), ref_wsum, rtol=4e-2, atol=0.1)
+    zr = Xr @ np.asarray(coef, np.float64) + off
+
+    v = rng.normal(size=4).astype(np.float32)
+    vec, usum = pallas_glm.fused_hessian_vector_sums(
+        Xb, jnp.asarray(y), jnp.asarray(off), jnp.asarray(w),
+        jnp.asarray(coef), jnp.float32(0.0), jnp.asarray(v), jnp.float32(0.0),
+        dzz=logistic_loss.dzz, interpret=True,
+    )
+    d2 = np.asarray(logistic_loss.dzz(jnp.asarray(zr), jnp.asarray(y.astype(np.float64))))
+    u = w * d2 * (Xr @ v.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(vec), Xr.T @ u, rtol=4e-2, atol=0.5)
+    np.testing.assert_allclose(float(usum), u.sum(), rtol=4e-2, atol=0.1)
