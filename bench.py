@@ -283,9 +283,11 @@ def main():
             break
         errors.append(f"probe: {info}")
     if probe_ok:
-        # The accelerator child measures up to 4 variants (anchor + newton_f32
-        # + newton_bf16 [+ lbfgs_bf16]): budget ~4 compile+measure cycles.
-        value, rec = _spawn_child({}, timeout_s=1800)
+        # The accelerator child measures up to 5 variants (anchor, newton f32/
+        # bf16, maybe lbfgs_bf16, winner+pallas). 1500s covers ~5 compile+
+        # measure cycles while leaving the CPU fallback its full window even if
+        # the TPU tunnel wedges mid-run (probes 240s + 1500s + 1800s < 1h).
+        value, rec = _spawn_child({}, timeout_s=1500)
         if value is not None:
             platform = rec.pop("platform", None)
             rec.pop("child_value", None)

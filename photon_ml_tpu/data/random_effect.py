@@ -124,6 +124,74 @@ class RandomEffectDataset:
         return self.sample_entity_rows, self.sample_local_cols, self.sample_vals
 
 
+def _consolidate_buckets(
+    bucket_members: dict, n_ent: int, merge_fraction: float
+) -> dict:
+    """Merge rare bucket shape classes into nearby larger shapes.
+
+    Every bucket is a separate sequential vmapped-solver program per
+    coordinate-descent pass — on TPU that is pure latency, so shape classes
+    holding fewer than ``merge_fraction`` of the entities are folded into the
+    partner bucket that wastes the fewest padded cells. Padding is inert by
+    construction (weight-0 rows; zero columns keep their coefficients at 0
+    under L2), so only shapes change, never results. A merge is only taken
+    when its added padding stays below the current total cell count, which
+    blocks pathological merges (e.g. one huge entity inflating everyone's
+    sample axis).
+    """
+    if merge_fraction <= 0 or len(bucket_members) <= 1:
+        return bucket_members
+    merged = dict(bucket_members)
+    # Cumulative padding growth is capped against the PRE-consolidation total
+    # (a per-step budget would ratchet: each merge inflates the base the next
+    # merge is judged against). At 1.0x the padded cell count can at most
+    # double — a deliberate memory-for-latency trade: every removed bucket is
+    # one fewer sequential solver program per coordinate-descent pass, and the
+    # blocks are small relative to HBM.
+    budget = 1.0 * sum(len(m) * s * k for (s, k), m in merged.items())
+    added_total = 0.0
+    skip: set = set()  # shapes whose every merge exceeds the budget
+    while True:
+        candidates = sorted(
+            (len(m), key) for key, m in merged.items() if key not in skip
+        )
+        progressed = False
+        for cnt, (s1, k1) in candidates:
+            if cnt >= merge_fraction * n_ent:
+                break  # candidates are sorted: nothing rarer remains
+            m1 = merged[(s1, k1)]
+            best = None
+            for (s2, k2), m2 in merged.items():
+                if (s2, k2) == (s1, k1):
+                    continue
+                S, K = max(s1, s2), max(k1, k2)
+                added = (
+                    (len(m1) + len(m2)) * S * K
+                    - len(m1) * s1 * k1
+                    - len(m2) * s2 * k2
+                )
+                if added_total + added <= budget and (best is None or added < best[0]):
+                    best = (added, (s2, k2))
+            if best is None:
+                skip.add((s1, k1))  # unmergeable; keep trying the others
+                continue
+            added, (s2, k2) = best
+            m2 = merged.pop((s2, k2))
+            merged.pop((s1, k1))
+            key = (max(s1, s2), max(k1, k2))
+            combined = np.sort(np.concatenate([m1, m2]))
+            if key in merged:
+                combined = np.sort(np.concatenate([merged[key], combined]))
+            merged[key] = combined
+            added_total += added
+            skip.clear()  # a merge changes the partner landscape
+            progressed = True
+            break  # re-sort candidates against the new bucket set
+        if not progressed:
+            break
+    return merged
+
+
 def build_random_effect_dataset(
     X: sp.spmatrix,
     entity_ids_per_sample: Sequence,
@@ -141,6 +209,7 @@ def build_random_effect_dataset(
     dtype=jnp.float32,
     min_samples_pad: int = 8,
     min_features_pad: int = 4,
+    bucket_merge_fraction: float = 0.05,
     scoring_only: bool = False,
     projector: Optional[object] = None,
 ) -> RandomEffectDataset:
@@ -330,6 +399,10 @@ def build_random_effect_dataset(
         for key in np.unique(pad_keys):
             members = np.flatnonzero(pad_keys == key)
             bucket_members[(int(key >> 32), int(key & (2 ** 32 - 1)))] = members
+        if not scoring_only:  # scoring datasets discard the buckets entirely
+            bucket_members = _consolidate_buckets(
+                bucket_members, n_ent, bucket_merge_fraction
+            )
 
     # Dataset-wide projection table is as wide as the widest PADDED bucket so that
     # bucket slices coeffs_global[:, :K_bucket] always fit.

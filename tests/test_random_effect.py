@@ -365,3 +365,37 @@ def test_all_entities_filtered_returns_empty_dataset():
         sp.csr_matrix((0, 3)), np.asarray([], dtype=object), "e", scoring_only=True
     )
     assert empty.n_entities == 0 and empty.n_samples == 0
+
+
+def test_bucket_consolidation_parity_and_guard(rng):
+    """Rare shape classes merge into larger buckets without changing results;
+    a pathological huge entity must NOT inflate everyone's sample axis."""
+    X, ents, labels, _ = make_re_data(rng, n_entities=40, min_s=4, max_s=9)
+    # one rare large entity (its own shape class, 1/41 < 5%)
+    extra_n = 200
+    Xe = sp.vstack([X, sp.csr_matrix(np.ones((extra_n, X.shape[1])))]).tocsr()
+    ents_e = np.concatenate([ents, np.asarray(["big"] * extra_n, dtype=object)])
+    labels_e = np.concatenate([labels, (np.arange(extra_n) % 2).astype(np.float64)])
+
+    merged = build_random_effect_dataset(
+        Xe, ents_e, "entity", labels=labels_e, dtype=jnp.float64
+    )
+    unmerged = build_random_effect_dataset(
+        Xe, ents_e, "entity", labels=labels_e, dtype=jnp.float64,
+        bucket_merge_fraction=0.0,
+    )
+    assert len(merged.buckets) < len(unmerged.buckets)  # a merge DID happen
+    # guard: the big entity's 256-row shape class must not swallow the small
+    # buckets' sample axis (added padding would exceed total cells)
+    small_s = [b.X.shape[1] for b in merged.buckets if b.n_entities > 1]
+    assert small_s and max(small_s) <= 64
+
+    m1, _ = train_random_effect(
+        merged, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(Xe.shape[0])
+    )
+    m0, _ = train_random_effect(
+        unmerged, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(Xe.shape[0])
+    )
+    np.testing.assert_allclose(
+        np.asarray(m1.coeffs), np.asarray(m0.coeffs), atol=1e-6
+    )
