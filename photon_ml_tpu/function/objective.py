@@ -91,9 +91,14 @@ class GLMObjective:
 
     def _fused_eligible(self, X, coef) -> bool:
         """Shared eligibility gate for the Pallas fast paths: opt-in switch on,
-        dense f32/bf16 single-device problem, f32 coefficients. Both the
-        value+gradient and HVP evaluations of one solve must take the same
-        lowering, so they share this single decision."""
+        dense f32/bf16 single-device problem, f32 coefficients. The
+        value+gradient and HVP evaluations share exactly this decision; the
+        full-Hessian path adds a tighter dimension cap on top
+        (pallas_glm.MAX_HESS_DIM — its [D, D] VMEM accumulator is the binding
+        constraint), so a wide NEWTON solve may fuse its gradient evaluations
+        while building the Hessian through the stock lowering. That mix is
+        numerically fine — every path computes the same math — the shared gate
+        exists so eligibility rules evolve in one place."""
         from photon_ml_tpu.data.matrix import DenseDesignMatrix
         from photon_ml_tpu.ops import pallas_glm
 
@@ -191,11 +196,15 @@ class GLMObjective:
         return sq + l2_weight
 
     def hessian_matrix(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:
-        """Full d x d Hessian for FULL variance (HessianMatrixAggregator.scala:31-129).
+        """Full d x d Hessian for FULL variance (HessianMatrixAggregator.scala:31-129)
+        and the NEWTON solver's per-iteration build.
 
         Materializes the dense design matrix — only sensible for modest feature dims,
         same restriction as the reference's FULL variance option.
         """
+        fused = self._fused_hessian_matrix(data, coef, l2_weight)
+        if fused is not None:
+            return fused
         z = self._margins(data, coef)
         d = self._weighted(data.weights, self.loss.dzz(z, data.labels))
         A = data.X.to_dense()
@@ -210,6 +219,45 @@ class GLMObjective:
             A = A * jnp.asarray(norm.factors, dtype=A.dtype)[None, :]
         H = A.T @ (A * d[:, None])
         return H + l2_weight * jnp.eye(H.shape[0], dtype=H.dtype)
+
+    def _fused_hessian_matrix(self, data: LabeledData, coef, l2_weight):
+        """Pallas fast path for the full Hessian (the NEWTON per-iteration hot
+        op): one X pass, normalized rows built in VMEM instead of
+        materializing the normalized design in HBM."""
+        from photon_ml_tpu.ops import pallas_glm
+
+        X = data.X
+        if (
+            not self._fused_eligible(X, coef)
+            or X.n_cols > pallas_glm.MAX_HESS_DIM
+        ):
+            return None
+        eff, margin_shift = self.normalization.effective_coefficients(coef)
+        d = X.n_cols
+        norm = self.normalization
+        shifts = (
+            jnp.zeros((d,), jnp.float32)
+            if norm.shifts is None
+            else jnp.asarray(norm.shifts, jnp.float32)
+        )
+        factors = (
+            jnp.ones((d,), jnp.float32)
+            if norm.factors is None
+            else jnp.asarray(norm.factors, jnp.float32)
+        )
+        H = pallas_glm.fused_hessian_matrix(
+            X.values,
+            data.labels,
+            data.offsets,
+            data.weights,
+            eff,
+            jnp.asarray(margin_shift, jnp.float32),
+            shifts,
+            factors,
+            dzz=self.loss.dzz,
+            interpret=pallas_glm.interpret_mode(),
+        )
+        return H + l2_weight * jnp.eye(d, dtype=H.dtype)
 
     # -- scoring ---------------------------------------------------------------------
 

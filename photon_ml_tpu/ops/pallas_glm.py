@@ -322,3 +322,88 @@ def fused_hessian_vector_sums(
         interpret=interpret,
     )(X, y, off, w, coef, v, sv)
     return vec[:, 0], usum[0, 0]
+
+
+# The Hessian kernel holds an [BN, D] block, its normalized copy, and the
+# [D, D] accumulator in VMEM at once: cap D and use a smaller row block.
+HESS_BLOCK_ROWS = 256
+MAX_HESS_DIM = 512
+
+
+def _hess_kernel(dzz, n_valid, x_ref, y_ref, off_ref, wgt_ref, coef_ref,
+                 shift_ref, factor_ref, h_ref):
+    """One grid step of the fused Hessian build: H += A_i^T diag(d_i) A_i with
+    A_i = (X_i - shift) * factor computed in VMEM — the stock lowering
+    materializes the full normalized design in HBM and reads it twice
+    (HessianMatrixAggregator semantics, objective.hessian_matrix). This is the
+    per-iteration hot op of the NEWTON solver."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    f32 = jnp.float32
+    x, w, live = _block_prologue(i, x_ref, wgt_ref, n_valid)
+    z = jnp.dot(x, _mxu_dtype(x, coef_ref[...]), preferred_element_type=f32)
+    z = z + off_ref[...]  # [BN, 1]
+    d = jnp.where(live, w * dzz(z, y_ref[...]), 0.0)  # [BN, 1]
+    # variance/Hessian math runs at f32 even for bf16 storage (the stock
+    # path's "reduction dtype" contract): upcast the block, THEN normalize.
+    a = x.astype(f32)
+    a = (a - shift_ref[...]) * factor_ref[...]  # [BN, D], shift/factor [1, D]
+    a = jnp.where(live, a, 0.0)  # masked rows contribute nothing even if inf
+    part = jnp.dot(a.T, a * d, preferred_element_type=f32)  # [D, D]
+
+    @pl.when(i == 0)
+    def _init():
+        h_ref[...] = part
+
+    @pl.when(i != 0)
+    def _acc():
+        h_ref[...] += part
+
+
+@functools.partial(jax.jit, static_argnames=("dzz", "interpret", "block_rows"))
+def fused_hessian_matrix(
+    X: Array,
+    labels: Array,
+    offsets: Array,
+    weights: Array,
+    eff_coef: Array,
+    margin_shift: Array,
+    shifts: Array,
+    factors: Array,
+    *,
+    dzz,
+    interpret: bool = False,
+    block_rows: int = HESS_BLOCK_ROWS,
+) -> Array:
+    """Full [D, D] Gauss-Newton Hessian (no l2 term) in one X pass.
+
+    ``eff_coef``/``margin_shift`` produce the margins exactly as
+    GLMObjective._margins; ``shifts``/``factors`` are the normalization
+    vectors applied to the design rows (pass zeros/ones when unnormalized).
+    The caller adds the l2 diagonal.
+    """
+    from jax.experimental import pallas as pl
+
+    n, d = X.shape
+    bn = block_rows
+    f32 = jnp.float32
+    off, y, w, grid = _tiled_row_inputs(labels, offsets, margin_shift, weights, n, bn)
+    coef = eff_coef.astype(f32)[:, None]
+    sh = shifts.astype(f32)[None, :]
+    fc = factors.astype(f32)[None, :]
+
+    kernel = functools.partial(_hess_kernel, dzz, n)
+    H = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=_row_block_specs(pl, bn, d) + [
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((d, d), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((d, d), f32),
+        interpret=interpret,
+    )(X, y, off, w, coef, sh, fc)
+    return H

@@ -5,6 +5,7 @@ its numerics are validated without a TPU; the integration gate is exercised
 through GLMObjective with the PHOTON_PALLAS_INTERPRET test hook.
 """
 
+import contextlib
 import os
 
 import jax.numpy as jnp
@@ -23,6 +24,22 @@ from photon_ml_tpu.normalization import NormalizationContext
 from photon_ml_tpu.ops import pallas_glm
 
 LOSSES = [logistic_loss, squared_loss, poisson_loss, smoothed_hinge_loss]
+
+
+@contextlib.contextmanager
+def pallas_interpret():
+    """Enable the fused kernels in interpret mode, restoring prior state."""
+    prev_env = os.environ.get("PHOTON_PALLAS_INTERPRET")
+    pallas_glm.enable_pallas(True)
+    os.environ["PHOTON_PALLAS_INTERPRET"] = "1"
+    try:
+        yield
+    finally:
+        pallas_glm.enable_pallas(None)
+        if prev_env is None:
+            del os.environ["PHOTON_PALLAS_INTERPRET"]
+        else:
+            os.environ["PHOTON_PALLAS_INTERPRET"] = prev_env
 
 
 def _problem(rng, n=700, d=5, weights=None):
@@ -96,14 +113,9 @@ def test_objective_integration_matches_stock_path(rng):
     obj = GLMObjective(logistic_loss, norm)
     stock_v, stock_g = obj.value_and_gradient(data, jnp.asarray(coef), 0.7)
 
-    pallas_glm.enable_pallas(True)
-    os.environ["PHOTON_PALLAS_INTERPRET"] = "1"
-    try:
+    with pallas_interpret():
         assert obj._fused_value_and_gradient(data, jnp.asarray(coef), 0.7) is not None
         fused_v, fused_g = obj.value_and_gradient(data, jnp.asarray(coef), 0.7)
-    finally:
-        pallas_glm.enable_pallas(False)
-        del os.environ["PHOTON_PALLAS_INTERPRET"]
     np.testing.assert_allclose(float(fused_v), float(stock_v), rtol=2e-5)
     np.testing.assert_allclose(np.asarray(fused_g), np.asarray(stock_g), rtol=2e-4, atol=1e-4)
 
@@ -119,9 +131,7 @@ def test_gate_closed_by_default_and_for_wrong_dtypes(rng):
     obj = GLMObjective(logistic_loss)
     assert obj._fused_value_and_gradient(data, jnp.asarray(coef), 0.0) is None  # off
 
-    pallas_glm.enable_pallas(True)
-    os.environ["PHOTON_PALLAS_INTERPRET"] = "1"
-    try:
+    with pallas_interpret():
         # f64 coefficients: precision contract keeps the stock path
         data64 = LabeledData(
             X=DenseDesignMatrix(jnp.asarray(X, dtype=jnp.float64)),
@@ -134,9 +144,6 @@ def test_gate_closed_by_default_and_for_wrong_dtypes(rng):
         # vmapped-construction objects opt out
         no_fuse = GLMObjective(logistic_loss, allow_fused=False)
         assert no_fuse._fused_value_and_gradient(data, jnp.asarray(coef), 0.0) is None
-    finally:
-        pallas_glm.enable_pallas(False)
-        del os.environ["PHOTON_PALLAS_INTERPRET"]
 
 
 def test_solver_convergence_through_fused_path(rng):
@@ -154,15 +161,10 @@ def test_solver_convergence_through_fused_path(rng):
     vg = make_value_and_grad(obj, data, l2_weight=1.0)
     stock = minimize_lbfgs(vg, jnp.zeros(6, jnp.float32), tolerance=1e-10, max_iterations=100)
 
-    pallas_glm.enable_pallas(True)
-    os.environ["PHOTON_PALLAS_INTERPRET"] = "1"
-    try:
+    with pallas_interpret():
         fused = minimize_lbfgs(
             vg, jnp.zeros(6, jnp.float32), tolerance=1e-10, max_iterations=100
         )
-    finally:
-        pallas_glm.enable_pallas(False)
-        del os.environ["PHOTON_PALLAS_INTERPRET"]
     np.testing.assert_allclose(
         np.asarray(fused.coefficients), np.asarray(stock.coefficients), atol=5e-4
     )
@@ -202,18 +204,13 @@ def test_tron_solve_through_fused_hvp(rng):
     hvp = lambda x, v: obj.hessian_vector(data, x, v, 0.5)
     stock = minimize_tron(vg, hvp, jnp.zeros(5, jnp.float32), tolerance=1e-10, max_iterations=60)
 
-    pallas_glm.enable_pallas(True)
-    os.environ["PHOTON_PALLAS_INTERPRET"] = "1"
-    try:
+    with pallas_interpret():
         assert obj._fused_hessian_vector(
             data, jnp.zeros(5, jnp.float32), jnp.ones(5, jnp.float32), 0.5
         ) is not None
         fused = minimize_tron(
             vg, hvp, jnp.zeros(5, jnp.float32), tolerance=1e-10, max_iterations=60
         )
-    finally:
-        pallas_glm.enable_pallas(False)
-        del os.environ["PHOTON_PALLAS_INTERPRET"]
     np.testing.assert_allclose(
         np.asarray(fused.coefficients), np.asarray(stock.coefficients), atol=5e-4
     )
@@ -237,13 +234,8 @@ def test_fused_hvp_with_normalization(rng):
     v = jnp.asarray(rng.normal(size=4).astype(np.float32))
     stock = obj.hessian_vector(data, jnp.asarray(coef), v, 0.3)
 
-    pallas_glm.enable_pallas(True)
-    os.environ["PHOTON_PALLAS_INTERPRET"] = "1"
-    try:
+    with pallas_interpret():
         fused = obj.hessian_vector(data, jnp.asarray(coef), v, 0.3)
-    finally:
-        pallas_glm.enable_pallas(False)
-        del os.environ["PHOTON_PALLAS_INTERPRET"]
     np.testing.assert_allclose(np.asarray(fused), np.asarray(stock), rtol=2e-4, atol=1e-4)
 
 
@@ -274,3 +266,84 @@ def test_fused_kernels_bf16_storage(rng):
     u = w * d2 * (Xr @ v.astype(np.float64))
     np.testing.assert_allclose(np.asarray(vec), Xr.T @ u, rtol=4e-2, atol=0.5)
     np.testing.assert_allclose(float(usum), u.sum(), rtol=4e-2, atol=0.1)
+
+
+@pytest.mark.parametrize("loss", [logistic_loss, squared_loss, poisson_loss], ids=lambda l: l.name)
+def test_fused_hessian_matrix_matches_reference(rng, loss):
+    X, y, off, w, coef = _problem(rng, n=pallas_glm.HESS_BLOCK_ROWS + 33, d=5)
+    w[::6] = 0.0
+    H = pallas_glm.fused_hessian_matrix(
+        jnp.asarray(X), jnp.asarray(y), jnp.asarray(off), jnp.asarray(w),
+        jnp.asarray(coef), jnp.float32(0.0),
+        jnp.zeros(5, jnp.float32), jnp.ones(5, jnp.float32),
+        dzz=loss.dzz, interpret=True,
+    )
+    z = X.astype(np.float64) @ coef.astype(np.float64) + off
+    d2 = np.where(w != 0, w * np.asarray(
+        loss.dzz(jnp.asarray(z), jnp.asarray(y.astype(np.float64)))
+    ), 0.0)
+    ref = X.T.astype(np.float64) @ (X.astype(np.float64) * d2[:, None])
+    np.testing.assert_allclose(np.asarray(H), ref, rtol=2e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(H), np.asarray(H).T, atol=1e-5)  # symmetric
+
+
+def test_fused_hessian_matrix_bf16_storage(rng):
+    """bf16 storage upcasts the block to f32 BEFORE normalization (the stock
+    path's reduction-dtype contract)."""
+    X, y, off, w, coef = _problem(rng, n=200, d=4)
+    Xb = jnp.asarray(X, dtype=jnp.bfloat16)
+    H = pallas_glm.fused_hessian_matrix(
+        Xb, jnp.asarray(y), jnp.asarray(off), jnp.asarray(w),
+        jnp.asarray(coef), jnp.float32(0.0),
+        jnp.zeros(4, jnp.float32), jnp.ones(4, jnp.float32),
+        dzz=logistic_loss.dzz, interpret=True,
+    )
+    Xr = np.asarray(Xb).astype(np.float64)  # the rounded values ARE the data
+    z = Xr @ np.asarray(coef, np.float64) + off
+    d2 = w * np.asarray(logistic_loss.dzz(jnp.asarray(z), jnp.asarray(y.astype(np.float64))))
+    ref = Xr.T @ (Xr * d2[:, None])
+    np.testing.assert_allclose(np.asarray(H), ref, rtol=4e-2, atol=0.5)
+
+
+def test_fused_hessian_matrix_through_objective_with_normalization(rng):
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+
+    X, y, off, w, coef = _problem(rng, n=200, d=4)
+    X[:, -1] = 1.0
+    shifts = rng.normal(size=4) * 0.1
+    shifts[-1] = 0.0
+    norm = NormalizationContext(
+        factors=np.abs(rng.normal(size=4)) + 0.5, shifts=shifts, intercept_index=3
+    )
+    data = LabeledData(
+        X=DenseDesignMatrix(jnp.asarray(X)), labels=jnp.asarray(y),
+        offsets=jnp.asarray(off), weights=jnp.asarray(w),
+    )
+    obj = GLMObjective(logistic_loss, norm)
+    stock = obj.hessian_matrix(data, jnp.asarray(coef), 0.4)
+    with pallas_interpret():
+        assert obj._fused_hessian_matrix(data, jnp.asarray(coef), 0.4) is not None
+        fused = obj.hessian_matrix(data, jnp.asarray(coef), 0.4)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(stock), rtol=2e-4, atol=1e-4)
+
+
+def test_newton_solve_through_fused_hessian(rng):
+    """A NEWTON solve with all three fused kernels matches the stock optimum."""
+    from photon_ml_tpu.optimization import minimize_newton
+    from photon_ml_tpu.function.objective import make_value_and_grad
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+
+    X, y, off, w, coef = _problem(rng, n=400, d=5)
+    data = LabeledData(
+        X=DenseDesignMatrix(jnp.asarray(X)), labels=jnp.asarray(y),
+        offsets=jnp.asarray(off), weights=jnp.asarray(w),
+    )
+    obj = GLMObjective(logistic_loss)
+    vg = make_value_and_grad(obj, data, l2_weight=0.8)
+    hess = lambda x: obj.hessian_matrix(data, x, 0.8)
+    stock = minimize_newton(vg, hess, jnp.zeros(5, jnp.float32), tolerance=1e-10)
+    with pallas_interpret():
+        fused = minimize_newton(vg, hess, jnp.zeros(5, jnp.float32), tolerance=1e-10)
+    np.testing.assert_allclose(
+        np.asarray(fused.coefficients), np.asarray(stock.coefficients), atol=5e-4
+    )
