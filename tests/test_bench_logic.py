@@ -1,0 +1,126 @@
+"""Unit tests for bench.py's tuned-variant selection — the logic that decides
+the headline number the driver records. Measurement is stubbed; only the
+selection/gating behavior is under test."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import bench  # noqa: E402
+from photon_ml_tpu.types import OptimizerType  # noqa: E402
+
+BF16 = "bf16-token"  # the sweep only forwards this to measure()
+
+
+def make_measure(table, anchor_value=100.0):
+    """table: {(opt_type, storage): (throughput, value)} — missing keys raise."""
+
+    def measure(opt_type, storage):
+        key = (OptimizerType(opt_type), storage)
+        if key not in table:
+            raise RuntimeError(f"variant {key} exploded")
+        tp, val = table[key]
+        return tp, val if val is not None else anchor_value
+
+    return measure
+
+
+def test_cpu_backend_measures_anchor_only():
+    calls = []
+
+    def measure(opt, storage):
+        calls.append((opt, storage))
+        return 1000.0, 5.0
+
+    best, info = bench.run_variant_sweep(
+        measure, cpu_backend=True, pallas_capable=False, bf16=BF16
+    )
+    assert best == 1000.0
+    assert info["variant"] == "lbfgs_f32"
+    assert calls == [(OptimizerType.LBFGS, None)]
+
+
+def test_fastest_gated_variant_wins():
+    measure = make_measure({
+        (OptimizerType.LBFGS, None): (1000.0, 100.0),
+        (OptimizerType.NEWTON, None): (1500.0, 100.2),   # within 1%
+        (OptimizerType.NEWTON, BF16): (2000.0, 100.5),   # within 1%, fastest
+    })
+    best, info = bench.run_variant_sweep(
+        measure, cpu_backend=False, pallas_capable=False, bf16=BF16
+    )
+    assert best == 2000.0
+    assert info["variant"] == "newton_bf16"
+    assert info["newton_f32_quality_gate"] and info["newton_bf16_quality_gate"]
+    assert "lbfgs_bf16_samples_per_sec" not in info  # newton won: not measured
+
+
+def test_quality_gate_rejects_fast_but_wrong():
+    measure = make_measure({
+        (OptimizerType.LBFGS, None): (1000.0, 100.0),
+        (OptimizerType.NEWTON, None): (9999.0, 110.0),   # 10% off: rejected
+        (OptimizerType.NEWTON, BF16): (9999.0, 98.0),    # 2% off: rejected
+        (OptimizerType.LBFGS, BF16): (1200.0, 100.9),    # within 1%: wins
+    })
+    best, info = bench.run_variant_sweep(
+        measure, cpu_backend=False, pallas_capable=False, bf16=BF16
+    )
+    assert best == 1200.0
+    assert info["variant"] == "lbfgs_bf16"
+    assert info["newton_f32_quality_gate"] is False
+    assert info["newton_bf16_quality_gate"] is False
+
+
+def test_variant_failure_never_raises_and_anchor_survives():
+    measure = make_measure({
+        (OptimizerType.LBFGS, None): (1000.0, 100.0),
+        # every tuned variant explodes (missing from the table)
+    })
+    best, info = bench.run_variant_sweep(
+        measure, cpu_backend=False, pallas_capable=False, bf16=BF16
+    )
+    assert best == 1000.0
+    assert info["variant"] == "lbfgs_f32"
+    assert "newton_f32_error" in info and "exploded" in info["newton_f32_error"]
+
+
+def test_pallas_variant_runs_on_winner_when_capable(monkeypatch):
+    from photon_ml_tpu.ops import pallas_glm
+
+    monkeypatch.delenv("PHOTON_PALLAS", raising=False)
+    pallas_states = []
+    table = {
+        (OptimizerType.LBFGS, None): (1000.0, 100.0),
+        (OptimizerType.NEWTON, None): (1500.0, 100.0),
+        (OptimizerType.NEWTON, BF16): (1400.0, 100.0),
+    }
+    base = make_measure(table)
+
+    def measure(opt, storage):
+        pallas_states.append(pallas_glm.pallas_enabled())
+        if pallas_states[-1]:  # the pallas re-measure of the winner
+            assert (OptimizerType(opt), storage) == (OptimizerType.NEWTON, None)
+            return 1800.0, 100.0
+        return base(opt, storage)
+
+    prev = pallas_glm._enabled
+    best, info = bench.run_variant_sweep(
+        measure, cpu_backend=False, pallas_capable=True, bf16=BF16
+    )
+    assert best == 1800.0
+    assert info["variant"] == "newton_f32_pallas"
+    assert pallas_glm._enabled == prev  # state restored after the sweep
+    assert pallas_states[-1] is True and not any(pallas_states[:-1])
+
+
+def test_pallas_skipped_when_not_capable():
+    measure = make_measure({
+        (OptimizerType.LBFGS, None): (1000.0, 100.0),
+        (OptimizerType.NEWTON, None): (1500.0, 100.0),
+        (OptimizerType.NEWTON, BF16): (1400.0, 100.0),
+    })
+    best, info = bench.run_variant_sweep(
+        measure, cpu_backend=False, pallas_capable=False, bf16=BF16
+    )
+    assert info["variant"] == "newton_f32"
+    assert not any(k.endswith("_pallas_samples_per_sec") for k in info)
