@@ -137,16 +137,20 @@ def run_variant_sweep(measure, *, cpu_backend, pallas_capable, bf16):
     prev_pallas = pallas_glm._enabled  # restored after the sweep
     pallas_glm.enable_pallas(False)
     try:
-        tp_anchor, val_anchor = measure(OptimizerType.LBFGS, None)
-    except BaseException:
+        return _variant_sweep_body(
+            measure, cpu_backend, pallas_capable, bf16, OptimizerType, pallas_glm
+        )
+    finally:
         pallas_glm.enable_pallas(prev_pallas)
-        raise
+
+
+def _variant_sweep_body(measure, cpu_backend, pallas_capable, bf16, OptimizerType, pallas_glm):
+    tp_anchor, val_anchor = measure(OptimizerType.LBFGS, None)
     info = {"variant": "lbfgs_f32", "lbfgs_f32_samples_per_sec": round(tp_anchor, 2)}
     best = tp_anchor
     if cpu_backend:
         # Keep the CPU baseline the reference-parity configuration (and bf16
         # matmul is emulated/slower on XLA:CPU, risking the parent's timeout).
-        pallas_glm.enable_pallas(prev_pallas)
         return best, info
 
     configs = {"lbfgs_f32": (OptimizerType.LBFGS, None)}
@@ -169,21 +173,18 @@ def run_variant_sweep(measure, *, cpu_backend, pallas_capable, bf16):
             info[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
             print(f"{name} variant failed: {e}", file=sys.stderr)
 
-    try:
-        try_variant("newton_f32", OptimizerType.NEWTON, None)
-        try_variant("newton_bf16", OptimizerType.NEWTON, bf16)
-        if info["variant"] == "lbfgs_f32":
-            # Newton didn't win or didn't gate: still try the storage win alone.
-            try_variant("lbfgs_bf16", OptimizerType.LBFGS, bf16)
-        # Fused Pallas value+gradient kernel on top of the winning configuration.
-        # Only meaningful where the kernel can actually engage (single TPU chip);
-        # elsewhere it would re-measure the identical XLA program and could
-        # "win" on noise under a mislabeled variant name.
-        if pallas_capable:
-            win_opt, win_storage = configs[info["variant"]]
-            try_variant(f"{info['variant']}_pallas", win_opt, win_storage, pallas=True)
-    finally:
-        pallas_glm.enable_pallas(prev_pallas)
+    try_variant("newton_f32", OptimizerType.NEWTON, None)
+    try_variant("newton_bf16", OptimizerType.NEWTON, bf16)
+    if info["variant"] == "lbfgs_f32":
+        # Newton didn't win or didn't gate: still try the storage win alone.
+        try_variant("lbfgs_bf16", OptimizerType.LBFGS, bf16)
+    # Fused Pallas value+gradient kernel on top of the winning configuration.
+    # Only meaningful where the kernel can actually engage (single TPU chip);
+    # elsewhere it would re-measure the identical XLA program and could
+    # "win" on noise under a mislabeled variant name.
+    if pallas_capable:
+        win_opt, win_storage = configs[info["variant"]]
+        try_variant(f"{info['variant']}_pallas", win_opt, win_storage, pallas=True)
     return best, info
 
 

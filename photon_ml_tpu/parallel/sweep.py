@@ -44,6 +44,7 @@ def reg_sweep_solver(task: TaskType, opt_config):
     loss = loss_for_task(task)
     minimize = build_minimizer(opt_config)
     use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
 
     def solve_one(data, w0, l2, norm):
         obj = GLMObjective(loss, norm, allow_fused=False)  # vmapped: no pallas path
@@ -54,6 +55,8 @@ def reg_sweep_solver(task: TaskType, opt_config):
         kwargs = {}
         if use_hvp:
             kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
+        if use_hess:
+            kwargs["hess"] = lambda w: obj.hessian_matrix(data, w, l2)
         res = minimize(vg, w0, **kwargs)
         return res.coefficients, res.value, res.iterations, res.convergence_reason
 

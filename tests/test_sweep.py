@@ -56,7 +56,9 @@ def _sequential(data, cfg, l2, task=TaskType.LOGISTIC_REGRESSION):
     return np.asarray(res.coefficients)
 
 
-@pytest.mark.parametrize("opt", [OptimizerType.LBFGS, OptimizerType.TRON])
+@pytest.mark.parametrize(
+    "opt", [OptimizerType.LBFGS, OptimizerType.TRON, OptimizerType.NEWTON]
+)
 def test_batched_sweep_matches_sequential(rng, opt):
     data = _data(rng)
     cfg = _cfg(opt)
