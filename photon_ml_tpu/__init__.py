@@ -48,6 +48,7 @@ _LAZY = {
     "make_mesh2": "photon_ml_tpu.parallel.feature_sharded",
     "save_game_model": "photon_ml_tpu.io.model_io",
     "load_game_model": "photon_ml_tpu.io.model_io",
+    "enable_pallas": "photon_ml_tpu.ops",
 }
 
 
