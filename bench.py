@@ -203,10 +203,22 @@ def _child_main():
 
     Invoked as a subprocess by main() so that a hung/broken backend init can be
     bounded by a timeout and killed without losing the parent orchestrator.
+
+    ``--profile <dir>`` additionally captures a jax.profiler trace of the
+    measured passes (open with xprof/tensorboard) — the tool for attributing
+    the pass's latency floor op by op on real hardware.
     """
     import jax
 
-    value, info = run_benchmark()
+    trace_dir = None
+    if "--profile" in sys.argv:
+        trace_dir = sys.argv[sys.argv.index("--profile") + 1]
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            value, info = run_benchmark()
+        info["trace_dir"] = trace_dir
+    else:
+        value, info = run_benchmark()
     platform = jax.devices()[0].platform
     print(json.dumps({"child_value": value, "platform": platform, **info}))
 
