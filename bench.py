@@ -212,7 +212,11 @@ def _child_main():
 
     trace_dir = None
     if "--profile" in sys.argv:
-        trace_dir = sys.argv[sys.argv.index("--profile") + 1]
+        idx = sys.argv.index("--profile") + 1
+        if idx >= len(sys.argv):
+            print("--profile requires a trace directory argument", file=sys.stderr)
+            sys.exit(2)
+        trace_dir = sys.argv[idx]
     if trace_dir:
         with jax.profiler.trace(trace_dir):
             value, info = run_benchmark()

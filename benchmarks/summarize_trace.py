@@ -1,0 +1,78 @@
+"""Summarize a jax.profiler trace (bench.py --child --profile <dir>) into a
+top-op table — the tool for attributing a pass's latency floor op by op.
+
+Parses the raw .xplane.pb with TensorFlow's xplane proto directly (the
+tensorboard_plugin_profile converter in this image is incompatible with the
+installed TF), aggregating event durations per plane/line/op name.
+
+Usage:
+  python benchmarks/summarize_trace.py <trace_dir> [--top 30] [--line XLA]
+
+``--line`` filters to lines whose name contains the substring (e.g. "XLA Ops"
+on TPU traces); default summarizes every line with >= 100 events.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import os
+import sys
+
+# Must be set before any protobuf import: the generated xplane_pb2 in this
+# image predates the installed protobuf's C++ fastpath requirements.
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def summarize(path: str, top: int, line_filter: str | None):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+
+    for plane in xs.planes:
+        if not plane.lines:
+            continue
+        meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+        for ln in plane.lines:
+            if line_filter and line_filter.lower() not in ln.name.lower():
+                continue
+            if not line_filter and len(ln.events) < 100:
+                continue
+            agg = collections.defaultdict(lambda: [0, 0])  # name -> [ps, count]
+            total_ps = 0
+            for ev in ln.events:
+                name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                agg[name][0] += ev.duration_ps
+                agg[name][1] += 1
+                total_ps += ev.duration_ps
+            print(f"\n== plane {plane.name!r} line {ln.name!r}: "
+                  f"{len(ln.events)} events, {total_ps / 1e9:.3f} ms total ==")
+            rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
+            for name, (ps, count) in rows:
+                print(f"  {ps / 1e9:10.3f} ms  x{count:<7d} {name[:90]}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=30)
+    ap.add_argument("--line", default=None,
+                    help="only lines whose name contains this substring")
+    args = ap.parse_args(argv)
+    paths = sorted(glob.glob(
+        os.path.join(args.trace_dir, "**", "*.xplane.pb"), recursive=True
+    ))
+    if not paths:
+        print(f"no .xplane.pb under {args.trace_dir}", file=sys.stderr)
+        return 1
+    for p in paths:
+        print(f"### {p}")
+        summarize(p, args.top, args.line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

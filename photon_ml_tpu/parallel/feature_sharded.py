@@ -152,6 +152,9 @@ def train_glm_feature_sharded(
         False,
         False,
         variance,
+        # 2-D mesh path: GSPMD cannot partition an opaque pallas_call, so the
+        # fused kernels stay off here regardless of the global switch.
+        allow_fused=False,
     )
     result, variances = solve(
         data,
