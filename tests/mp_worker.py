@@ -1,0 +1,101 @@
+"""Worker for test_multiprocess.py: one process of a 2-process distributed
+GLM solve. Each process joins the JAX distributed runtime, ingests ONLY its
+half of the dataset (host-local shard), and runs the sharded solver — the
+gradient reductions cross processes as real collectives (Gloo on CPU; the
+DCN analog of the production multi-host path).
+
+Run as: python mp_worker.py <pid> <nproc> <port> <outdir>
+"""
+
+import json
+import os
+import sys
+
+
+def make_dataset():
+    """Deterministic dataset shared by the workers AND the in-test single-
+    process reference solve — defined once so the copies cannot drift."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    N, D = 512, 6
+    X = rng.normal(size=(N, D))
+    y = ((X @ rng.normal(size=D)) > 0).astype(np.float64)
+    return X, y
+
+
+def make_config():
+    from photon_ml_tpu.optimization.common import OptimizerConfig
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.types import OptimizerType, RegularizationType
+
+    return GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS, max_iterations=100, tolerance=1e-10
+        ),
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+
+
+def main():
+    pid, nproc, port, outdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from photon_ml_tpu.parallel.distributed import (
+        host_local_to_global,
+        initialize_multi_host,
+        process_slice,
+    )
+
+    info = initialize_multi_host(f"localhost:{port}", nproc, pid)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+    from photon_ml_tpu.optimization.common import OptimizerConfig
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.parallel import make_mesh, train_glm_sharded
+    from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+    # Same deterministic dataset on every process; each ingests only its slice.
+    X, y = make_dataset()
+    N = X.shape[0]
+    sl = process_slice(N)
+
+    mesh = make_mesh(len(jax.devices()))
+    n_local = sl.stop - sl.start
+    Xg = host_local_to_global(jnp.asarray(X[sl], jnp.float32), mesh, global_rows=N)
+    yg = host_local_to_global(jnp.asarray(y[sl], jnp.float32), mesh, global_rows=N)
+    og = host_local_to_global(jnp.zeros((n_local,), jnp.float32), mesh, global_rows=N)
+    wg = host_local_to_global(jnp.ones((n_local,), jnp.float32), mesh, global_rows=N)
+    data = LabeledData(X=DenseDesignMatrix(Xg), labels=yg, offsets=og, weights=wg)
+
+    w, res = train_glm_sharded(data, TaskType.LOGISTIC_REGRESSION, make_config(), mesh)
+    out = {
+        "pid": pid,
+        "num_processes": info["num_processes"],
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "coef": np.asarray(w).tolist(),
+        "value": float(res.value),
+    }
+    with open(os.path.join(outdir, f"proc{pid}.json"), "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()
