@@ -397,6 +397,17 @@ def test_full_game_step_with_fused_fe(rng):
 
     stock_coef, stock_val = run()
     with pallas_interpret():
+        # guard: the fused path must actually be eligible for this setup,
+        # otherwise the parity below silently compares stock against stock
+        assert pallas_glm.should_fuse(d)
+        from photon_ml_tpu.data.matrix import DenseDesignMatrix
+        from photon_ml_tpu.function.objective import GLMObjective
+        from photon_ml_tpu.function.losses import logistic_loss
+
+        assert GLMObjective(logistic_loss)._fused_eligible(
+            data.fe_X if isinstance(data.fe_X, DenseDesignMatrix) else None,
+            jnp.zeros((d,), jnp.float32),
+        )
         fused_coef, fused_val = run()
     np.testing.assert_allclose(fused_coef, stock_coef, atol=5e-4)
     np.testing.assert_allclose(fused_val, stock_val, rtol=1e-4)
