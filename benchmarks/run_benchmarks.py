@@ -140,17 +140,16 @@ def config1_a1a_avro_lbfgs_l2():
 
         avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, records())
 
-    tmp = tempfile.mkdtemp(prefix="bench_a1a_")
-    write(os.path.join(tmp, "train.avro"), Xtr, ytr)
-    write(os.path.join(tmp, "test.avro"), Xte, yte)
     shards = {"global": FeatureShardConfiguration(feature_bags=("features",))}
-
-    t0 = time.perf_counter()
-    train, maps, _ = read_merged_avro(os.path.join(tmp, "train.avro"), shards)
-    test, _, _ = read_merged_avro(
-        os.path.join(tmp, "test.avro"), shards, index_maps=maps
-    )
-    ingest_s = time.perf_counter() - t0
+    with tempfile.TemporaryDirectory(prefix="bench_a1a_") as tmp:
+        write(os.path.join(tmp, "train.avro"), Xtr, ytr)
+        write(os.path.join(tmp, "test.avro"), Xte, yte)
+        t0 = time.perf_counter()
+        train, maps, _ = read_merged_avro(os.path.join(tmp, "train.avro"), shards)
+        test, _, _ = read_merged_avro(
+            os.path.join(tmp, "test.avro"), shards, index_maps=maps
+        )
+        ingest_s = time.perf_counter() - t0
 
     cfg = GLMOptimizationConfiguration(
         optimizer_config=OptimizerConfig(
@@ -469,7 +468,7 @@ CONFIGS = {
 QUALITY_KEYS = ("auc", "best_auc")
 
 
-def main():
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="1,2,3,4,5")
     ap.add_argument("--scale", type=float, default=1.0, help="config 3 size factor")
@@ -480,7 +479,7 @@ def main():
                     help="exit 0 even when a config fails quality parity "
                          "(default: parity failure exits 1 — a speedup only "
                          "counts at matching quality)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     import jax
 

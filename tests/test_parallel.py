@@ -210,19 +210,26 @@ def test_bf16_fe_storage_game_step_close_to_f32(rng):
     assert abs(vals[jnp.bfloat16] - vals[None]) <= 0.01 * abs(vals[None])
 
 
-def test_scale_bench_tiny_smoke(capsys):
-    """benchmarks/scale_bench.py --tiny runs both configs end to end and
-    reports ~1/m per-device shard scaling."""
-    import json
+def _import_bench_module(name):
+    """Import a benchmarks/ script by name (they are not a package)."""
+    import importlib
     import os
     import sys
 
     bench_dir = os.path.join(os.path.dirname(__file__), "..", "benchmarks")
     sys.path.insert(0, bench_dir)
     try:
-        import scale_bench
+        return importlib.import_module(name)
     finally:
         sys.path.remove(bench_dir)
+
+
+def test_scale_bench_tiny_smoke(capsys):
+    """benchmarks/scale_bench.py --tiny runs both configs end to end and
+    reports ~1/m per-device shard scaling."""
+    import json
+
+    scale_bench = _import_bench_module("scale_bench")
     assert scale_bench.main(["--tiny"]) == 0
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     by_config = {rec["config"]: rec for rec in lines}
@@ -236,3 +243,20 @@ def test_scale_bench_tiny_smoke(capsys):
     assert max(entity["per_device_table_rows"]) <= (
         entity["n_entities"] // entity["devices"] + 1
     )
+
+
+def test_run_benchmarks_smoke(capsys):
+    """The five-config benchmark runner's entry point works end to end:
+    config 1 (fixed a1a-sized Avro ingest + sweep — --scale does not apply to
+    it) and config 3 at tiny scale. Checks AUC and parity fields."""
+    import json
+
+    run_benchmarks = _import_bench_module("run_benchmarks")
+    rc = run_benchmarks.main(["--configs", "1,3", "--scale", "0.02", "--no-strict"])
+    assert rc in (0, None)
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    recs = {k: v for rec in lines for k, v in rec.items()}
+    assert recs["a1a_avro_lbfgs_l2"]["auc"] > 0.8
+    assert recs["glmix_movielens_like"]["auc"] > 0.8
+    for rec in recs.values():
+        assert rec["value"] > 0 and rec["platform"] == "cpu"
