@@ -97,8 +97,11 @@ class _GlmixTruth:
 # --------------------------------------------------------------------- configs
 
 
-def config1_a1a_avro_lbfgs_l2():
-    """Fixed-effect logistic via Avro ingest, LBFGS+L2 sweep (config #1)."""
+def config1_a1a_avro_lbfgs_l2(n_train=1605, n_test=30956):
+    """Fixed-effect logistic via Avro ingest, LBFGS+L2 sweep (config #1).
+
+    Size parameters exist for the suite's smoke test; benchmark runs use the
+    a1a defaults."""
     import jax.numpy as jnp
 
     from photon_ml_tpu.data import avro_io
@@ -118,7 +121,7 @@ def config1_a1a_avro_lbfgs_l2():
     from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
 
     rng = np.random.default_rng(1605)
-    (Xtr, ytr), (Xte, yte) = _a1a_like(rng)
+    (Xtr, ytr), (Xte, yte) = _a1a_like(rng, n_train=n_train, n_test=n_test)
 
     def write(path, X, y):
         X = X.tocsr()

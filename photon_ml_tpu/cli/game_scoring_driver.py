@@ -179,7 +179,7 @@ def _coordinate_shards(model_dir: str) -> dict[str, str]:
 def _write_scores(path, uids, scores, data, model_id: str, use_native: bool = True) -> None:
     """ScoringResultAvro records (GameScoringDriver.saveScoresToHDFS:229-256).
 
-    The record payloads are encoded natively (native/avro_block_decoder.cpp
+    The record payloads are encoded natively (photon_ml_tpu/native/avro_block_decoder.cpp
     photon_encode_scores — the output analog of the ingest decoder) when the
     library is available, falling back to the pure-Python encoder otherwise;
     both produce the same records (block boundaries differ: 65536 records per

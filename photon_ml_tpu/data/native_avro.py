@@ -1,4 +1,4 @@
-"""ctypes bridge to the native Avro block decoder (native/avro_block_decoder.cpp).
+"""ctypes bridge to the native Avro block decoder (photon_ml_tpu/native/avro_block_decoder.cpp).
 
 Container framing (magic, metadata, codec, sync markers) and zlib inflate stay
 in Python — both already run at C speed — while the per-record varint walk,
@@ -30,7 +30,7 @@ F_NULLABLE_STRING = 2
 F_FEATURE_ARRAY = 3
 F_NULLABLE_MAP_STRING = 4
 
-_SOURCE = os.path.join(os.path.dirname(__file__), "..", "..", "native", "avro_block_decoder.cpp")
+_SOURCE = os.path.join(os.path.dirname(__file__), "..", "native", "avro_block_decoder.cpp")
 _CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", "_native_build")
 
 _lib = None

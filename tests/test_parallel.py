@@ -246,17 +246,20 @@ def test_scale_bench_tiny_smoke(capsys):
 
 
 def test_run_benchmarks_smoke(capsys):
-    """The five-config benchmark runner's entry point works end to end:
-    config 1 (fixed a1a-sized Avro ingest + sweep — --scale does not apply to
-    it) and config 3 at tiny scale. Checks AUC and parity fields."""
+    """The five-config benchmark runner works end to end: config 3 at tiny
+    scale through the main() entry point (plumbing, JSON shape, parity
+    fields), plus config 1 called directly at reduced sizes (its --scale-less
+    a1a defaults are too heavy for a unit suite)."""
     import json
 
     run_benchmarks = _import_bench_module("run_benchmarks")
-    rc = run_benchmarks.main(["--configs", "1,3", "--scale", "0.02", "--no-strict"])
+    rc = run_benchmarks.main(["--configs", "3", "--scale", "0.02", "--no-strict"])
     assert rc in (0, None)
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     recs = {k: v for rec in lines for k, v in rec.items()}
-    assert recs["a1a_avro_lbfgs_l2"]["auc"] > 0.8
     assert recs["glmix_movielens_like"]["auc"] > 0.8
     for rec in recs.values():
         assert rec["value"] > 0 and rec["platform"] == "cpu"
+
+    small = run_benchmarks.config1_a1a_avro_lbfgs_l2(n_train=400, n_test=800)
+    assert small["auc"] > 0.7 and small["value"] > 0
