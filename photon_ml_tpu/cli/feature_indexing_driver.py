@@ -14,7 +14,7 @@ import argparse
 import os
 import sys
 
-from photon_ml_tpu.cli.parsers import parse_feature_shard_configuration
+from photon_ml_tpu.cli.parsers import add_version_argument, parse_feature_shard_configuration
 from photon_ml_tpu.data import avro_io
 from photon_ml_tpu.data.index_map import IndexMap, feature_key
 
@@ -24,6 +24,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="feature-indexing-driver",
         description="Build per-shard feature index maps from Avro data.",
     )
+    add_version_argument(p)
     p.add_argument("--input-data-directories", required=True)
     p.add_argument("--output-directory", required=True)
     p.add_argument("--feature-shard-configurations", action="append", required=True)

@@ -17,6 +17,7 @@ import numpy as np
 
 from photon_ml_tpu.cli.game_training_driver import _load_index_maps
 from photon_ml_tpu.cli.parsers import (
+    add_version_argument,
     parse_evaluator_spec,
     parse_feature_shard_configuration,
 )
@@ -35,6 +36,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="game-scoring-driver", description="Score data with a saved GAME model."
     )
+    add_version_argument(p)
     p.add_argument("--input-data-directories", required=True)
     p.add_argument("--input-data-date-range", default=None,
                    help="yyyyMMdd-yyyyMMdd inclusive; expands each input dir to "

@@ -26,6 +26,7 @@ from typing import Optional
 import numpy as np
 
 from photon_ml_tpu.cli.parsers import (
+    add_version_argument,
     ModelOutputMode,
     coordinate_configuration_to_string,
     parse_coordinate_configuration,
@@ -62,6 +63,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="game-training-driver",
         description="Train a GAME (GLMix) model on TPU.",
     )
+    add_version_argument(p)
     # GameDriver shared params (GameDriver.scala:56-131)
     p.add_argument("--input-data-directories", required=True,
                    help="Comma-separated training data paths (Avro files/dirs)")

@@ -17,6 +17,7 @@ how far a run progressed.
 from __future__ import annotations
 
 import argparse
+
 import enum
 import json
 import os
@@ -27,6 +28,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
+from photon_ml_tpu.cli.parsers import add_version_argument
 from photon_ml_tpu.data.dataset import LabeledData
 from photon_ml_tpu.data.index_map import IndexMap, feature_key
 from photon_ml_tpu.data.readers import read_avro
@@ -80,6 +82,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="photon-ml-legacy-driver",
         description="Deprecated single-GLM staged training driver.",
     )
+    add_version_argument(p)
     p.add_argument("--training-data-directory", required=True)
     p.add_argument("--validating-data-directory", default=None)
     p.add_argument("--output-directory", required=True)

@@ -12,6 +12,7 @@ import argparse
 import os
 import sys
 
+from photon_ml_tpu.cli.parsers import add_version_argument
 from photon_ml_tpu.data import avro_io
 
 
@@ -20,6 +21,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="name-and-term-bags-driver",
         description="Extract distinct (name, term) feature sets per bag.",
     )
+    add_version_argument(p)
     p.add_argument("--input-data-directories", required=True)
     p.add_argument("--output-directory", required=True)
     p.add_argument("--feature-bags", required=True,

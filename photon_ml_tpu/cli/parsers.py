@@ -291,3 +291,13 @@ def parse_evaluator_spec(spec: str):
     if id_tag:
         return MultiEvaluator(base, id_tag)
     return base
+
+
+def add_version_argument(p):
+    """Uniform --version flag for every driver."""
+    from photon_ml_tpu import __version__
+
+    p.add_argument(
+        "--version", action="version",
+        version=f"photon-ml-tpu {__version__}",
+    )
