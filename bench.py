@@ -134,14 +134,10 @@ def run_variant_sweep(measure, *, cpu_backend, pallas_capable, bf16):
     # Force pallas OFF for the anchor and the non-pallas variants so every
     # throughput comparison runs the same lowering family regardless of an
     # ambient PHOTON_PALLAS=1; the dedicated pallas variant turns it on.
-    prev_pallas = pallas_glm._enabled  # restored after the sweep
-    pallas_glm.enable_pallas(False)
-    try:
+    with pallas_glm.pallas_override(False):
         return _variant_sweep_body(
             measure, cpu_backend, pallas_capable, bf16, OptimizerType, pallas_glm
         )
-    finally:
-        pallas_glm.enable_pallas(prev_pallas)
 
 
 def _variant_sweep_body(measure, cpu_backend, pallas_capable, bf16, OptimizerType, pallas_glm):
@@ -189,13 +185,16 @@ def _variant_sweep_body(measure, cpu_backend, pallas_capable, bf16, OptimizerTyp
 
 
 def _read_baseline():
+    """Returns (value, record). The record's provenance fields (commit,
+    cpu_count) let main() flag a baseline recorded on a different machine."""
     if os.path.exists(BASELINE_PATH):
         try:
             with open(BASELINE_PATH) as f:
-                return json.load(f).get("value")
+                rec = json.load(f)
+            return rec.get("value"), rec
         except Exception:
-            return None
-    return None
+            return None, {}
+    return None, {}
 
 
 def _child_main():
@@ -297,12 +296,37 @@ def main():
         if value is None:
             print(json.dumps({"error": f"cpu baseline run failed: {rec}"}))
             sys.exit(1)
+        import datetime
+        import multiprocessing
+        import subprocess
+
+        repo_dir = os.path.dirname(os.path.abspath(__file__))
+        try:
+            proc = subprocess.run(
+                ["git", "rev-parse", "HEAD"],
+                capture_output=True, text=True, cwd=repo_dir,
+            )
+            commit = proc.stdout.strip() if proc.returncode == 0 else None
+            if commit:
+                dirty = subprocess.run(
+                    ["git", "status", "--porcelain"],
+                    capture_output=True, text=True, cwd=repo_dir,
+                )
+                # a dirty tree means the measured code is NOT the HEAD commit
+                if dirty.returncode == 0 and dirty.stdout.strip():
+                    commit += "-dirty"
+        except Exception:
+            commit = None
         with open(BASELINE_PATH, "w") as f:
             json.dump(
                 {
                     "metric": "glmix_cd_pass_samples_per_sec",
                     "value": value,
                     "backend": "cpu",
+                    "commit": commit,
+                    "recorded_at": datetime.datetime.now(datetime.timezone.utc)
+                    .isoformat(timespec="seconds"),
+                    "cpu_count": multiprocessing.cpu_count(),
                     "note": "same workload on this machine's CPU JAX backend "
                     "(stand-in for the Spark-CPU baseline node)",
                 },
@@ -349,15 +373,36 @@ def main():
         else:
             errors.append(rec)
 
-    baseline = _read_baseline()
+    baseline, baseline_rec = _read_baseline()
+    # vs_baseline is only meaningful as accelerator-vs-CPU-baseline. On the CPU
+    # fallback it would silently become "this commit's CPU speed vs the CPU
+    # speed when the baseline was recorded" — a code-drift artifact that reads
+    # like a perf verdict — so it is reported as null there, with the raw
+    # baseline attached for transparency.
+    on_accelerator = platform is not None and platform != "cpu"
     result = {
         "metric": "glmix_cd_pass_samples_per_sec",
         "value": round(value, 2) if value is not None else None,
         "unit": "samples/sec",
         "vs_baseline": (
-            round(value / baseline, 4) if value is not None and baseline else 1.0
+            round(value / baseline, 4)
+            if value is not None and baseline and on_accelerator
+            else None
         ),
+        "baseline_platform": "cpu" if baseline else None,
     }
+    if value is not None and baseline and not on_accelerator:
+        result["cpu_value_vs_recorded_cpu_baseline"] = round(value / baseline, 4)
+    # a baseline recorded on a different machine shape makes ratios apples-to-
+    # oranges; surface the mismatch rather than silently dividing
+    import multiprocessing
+
+    recorded_cpus = baseline_rec.get("cpu_count")
+    if recorded_cpus is not None and recorded_cpus != multiprocessing.cpu_count():
+        result["baseline_machine_mismatch"] = (
+            f"baseline recorded with cpu_count={recorded_cpus}, "
+            f"current machine has {multiprocessing.cpu_count()}"
+        )
     if tpu_unavailable:
         result["tpu_unavailable"] = True
         result["errors"] = [e[:200] for e in errors]

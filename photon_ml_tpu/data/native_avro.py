@@ -17,6 +17,7 @@ training schemas. Schemas outside this set simply use the Python path.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -31,7 +32,15 @@ F_FEATURE_ARRAY = 3
 F_NULLABLE_MAP_STRING = 4
 
 _SOURCE = os.path.join(os.path.dirname(__file__), "..", "native", "avro_block_decoder.cpp")
-_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", "_native_build")
+# Build cache lives under the user cache dir, NOT the package tree: with a
+# pip-installed (possibly read-only) site-packages, writing next to the source
+# would raise OSError and break ingest instead of degrading to the Python
+# decoder.
+_CACHE_DIR = os.path.join(
+    os.environ.get("XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")),
+    "photon_ml_tpu",
+    "native_build",
+)
 
 _lib = None
 _lib_error: Optional[str] = None
@@ -42,17 +51,25 @@ def _build_library() -> Optional[str]:
     source = os.path.abspath(_SOURCE)
     if not os.path.exists(source):
         return None
-    os.makedirs(_CACHE_DIR, exist_ok=True)
-    so_path = os.path.join(_CACHE_DIR, "libphoton_avro.so")
-    if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(source):
-        return so_path
-    tmp = so_path + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, source]
     try:
+        with open(source, "rb") as f:
+            src_bytes = f.read()
+        # The cache is shared across installs (user cache dir), so the .so is
+        # keyed by source CONTENT, not mtime — pip-installed trees often carry
+        # archive mtimes that would make a stale cross-version .so look fresh.
+        digest = hashlib.sha256(src_bytes).hexdigest()[:16]
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        so_path = os.path.join(_CACHE_DIR, f"libphoton_avro-{digest}.so")
+        if os.path.exists(so_path):
+            return so_path
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, source]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)
     except (OSError, subprocess.SubprocessError):
+        # Unwritable cache dir, missing compiler, or failed build: the pure-
+        # Python decoder handles every input, just slower.
         return None
-    os.replace(tmp, so_path)
     return so_path
 
 

@@ -198,12 +198,44 @@ def save_game_model(
             raise TypeError(f"Unknown model type for coordinate {coord_id}: {type(model)}")
 
 
+def _read_id_info(path: str, *, random_effect: bool) -> dict:
+    """Parse an ``id-info`` file in either on-disk dialect.
+
+    This framework writes JSON; the reference's ModelProcessingUtils writes
+    plain text lines (GameIntegTest fixtures: fixed effect = one line holding
+    the feature shard id; random effect = randomEffectType then featureShardId,
+    one per line — see saveModelToHDFS/loadGameModelFromHDFS in
+    ModelProcessingUtils.scala). Both must load so reference-written model
+    directories warm-start this framework directly.
+    """
+    with open(path) as f:
+        text = f.read()
+    try:
+        info = json.loads(text)
+        if isinstance(info, dict):
+            return info
+    except json.JSONDecodeError:
+        pass
+    lines = [ln.strip() for ln in text.splitlines() if ln.strip()]
+    if random_effect:
+        info = {}
+        if lines:
+            info["randomEffectType"] = lines[0]
+        if len(lines) > 1:
+            info["featureShardId"] = lines[1]
+        return info
+    return {"featureShardId": lines[0]} if lines else {}
+
+
 def load_game_model(
     input_dir: str,
     index_maps: dict[str, IndexMap],
     dtype=jnp.float32,
 ) -> GameModel:
-    """Load a GAME model saved by save_game_model (or the reference's layout).
+    """Load a GAME model saved by save_game_model or by the reference
+    (ModelProcessingUtils.scala layout, including plain-text id-info files,
+    multiple coefficient part files, and coefficient-less random-effect
+    directories, which load as zero-entity models that score 0).
 
     Random-effect coordinates are rebuilt with per-entity index projections over the
     union of each entity's non-zero features.
@@ -215,8 +247,7 @@ def load_game_model(
         for coord_id in sorted(os.listdir(fe_dir)):
             base = os.path.join(fe_dir, coord_id)
             index_map = index_maps[coord_id]
-            with open(os.path.join(base, ID_INFO)) as f:
-                id_info = json.load(f)
+            id_info = _read_id_info(os.path.join(base, ID_INFO), random_effect=False)
             glm = load_glm_model(os.path.join(base, COEFFICIENTS), index_map, dtype)
             models[coord_id] = FixedEffectModel(glm, id_info.get("featureShardId", "global"))
 
@@ -225,9 +256,13 @@ def load_game_model(
         for coord_id in sorted(os.listdir(re_dir)):
             base = os.path.join(re_dir, coord_id)
             index_map = index_maps[coord_id]
-            with open(os.path.join(base, ID_INFO)) as f:
-                id_info = json.load(f)
-            recs = list(avro_io.read_container_dir(os.path.join(base, COEFFICIENTS)))
+            id_info = _read_id_info(os.path.join(base, ID_INFO), random_effect=True)
+            coeff_dir = os.path.join(base, COEFFICIENTS)
+            recs = (
+                list(avro_io.read_container_dir(coeff_dir))
+                if os.path.isdir(coeff_dir)
+                else []
+            )
             entity_ids, rows, var_rows, proj_rows = [], [], [], []
             task = TaskType.LINEAR_REGRESSION
             max_k = 1

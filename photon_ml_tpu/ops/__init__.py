@@ -8,8 +8,16 @@ opt-in fusions for ops where XLA's automatic fusion cannot remove HBM traffic
 
 from photon_ml_tpu.ops.pallas_glm import (
     enable_pallas,
+    enabled_override,
     fused_loss_grad_sums,
     pallas_enabled,
+    pallas_override,
 )
 
-__all__ = ["enable_pallas", "fused_loss_grad_sums", "pallas_enabled"]
+__all__ = [
+    "enable_pallas",
+    "enabled_override",
+    "fused_loss_grad_sums",
+    "pallas_enabled",
+    "pallas_override",
+]

@@ -37,6 +37,7 @@ CPU tests run the same kernel in interpret mode.
 from __future__ import annotations
 
 import functools
+import contextlib
 import os
 
 import jax
@@ -74,6 +75,27 @@ def pallas_enabled() -> bool:
     if _enabled is not None:
         return _enabled
     return os.environ.get("PHOTON_PALLAS", "") not in ("", "0")
+
+
+def enabled_override() -> bool | None:
+    """The current process-wide override (None = deferring to PHOTON_PALLAS).
+
+    Public accessor so callers (e.g. bench sweeps) can save/restore the switch
+    without reaching into module internals; pair with :func:`pallas_override`.
+    """
+    return _enabled
+
+
+@contextlib.contextmanager
+def pallas_override(on: bool | None):
+    """Scoped :func:`enable_pallas`: sets the switch, restores the previous
+    override (and the solver caches' trace-time fuse decision) on exit."""
+    prev = _enabled
+    enable_pallas(on)
+    try:
+        yield
+    finally:
+        enable_pallas(prev)
 
 
 def interpret_mode() -> bool:

@@ -103,13 +103,13 @@ def test_pallas_variant_runs_on_winner_when_capable(monkeypatch):
             return 1800.0, 100.0
         return base(opt, storage)
 
-    prev = pallas_glm._enabled
+    prev = pallas_glm.enabled_override()
     best, info = bench.run_variant_sweep(
         measure, cpu_backend=False, pallas_capable=True, bf16=BF16
     )
     assert best == 1800.0
     assert info["variant"] == "newton_f32_pallas"
-    assert pallas_glm._enabled == prev  # state restored after the sweep
+    assert pallas_glm.enabled_override() == prev  # state restored after the sweep
     assert pallas_states[-1] is True and not any(pallas_states[:-1])
 
 
@@ -124,3 +124,48 @@ def test_pallas_skipped_when_not_capable():
     )
     assert info["variant"] == "newton_f32"
     assert not any(k.endswith("_pallas_samples_per_sec") for k in info)
+
+
+def _run_main_with(monkeypatch, probe_ok, child):
+    """Drive bench.main()'s JSON assembly with stubbed probe/child."""
+    import contextlib
+    import io
+    import json
+
+    monkeypatch.setattr(bench, "_probe_backend", lambda timeout_s: (probe_ok, "x"))
+    monkeypatch.setattr(bench, "_spawn_child", child)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_main_reports_vs_baseline_on_accelerator(monkeypatch):
+    out = _run_main_with(
+        monkeypatch, True,
+        lambda env, timeout_s: (
+            500000.0, {"child_value": 500000.0, "platform": "tpu", "variant": "v"}
+        ),
+    )
+    assert out["platform"] == "tpu"
+    assert out["vs_baseline"] is not None and out["vs_baseline"] > 0
+    assert out["baseline_platform"] == "cpu"
+
+
+def test_main_nulls_vs_baseline_on_cpu_fallback(monkeypatch):
+    """A wedged-TPU round must not emit a number that reads like a perf verdict:
+    CPU-now vs CPU-then is code drift, not speedup (round-2 0.62x confusion)."""
+    calls = []
+
+    def child(env, timeout_s):
+        if not calls:
+            calls.append(1)
+            return None, "rc=1: tunnel wedged"
+        return 200000.0, {"child_value": 200000.0, "platform": "cpu", "variant": "lbfgs_f32"}
+
+    out = _run_main_with(monkeypatch, True, child)
+    assert out["tpu_unavailable"] is True
+    assert out["vs_baseline"] is None
+    assert out["baseline_platform"] == "cpu"
+    assert out["cpu_value_vs_recorded_cpu_baseline"] > 0

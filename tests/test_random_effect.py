@@ -378,7 +378,8 @@ def test_bucket_consolidation_parity_and_guard(rng):
     labels_e = np.concatenate([labels, (np.arange(extra_n) % 2).astype(np.float64)])
 
     merged = build_random_effect_dataset(
-        Xe, ents_e, "entity", labels=labels_e, dtype=jnp.float64
+        Xe, ents_e, "entity", labels=labels_e, dtype=jnp.float64,
+        bucket_merge_fraction=0.05,  # explicit: auto resolves to 0 on CPU
     )
     unmerged = build_random_effect_dataset(
         Xe, ents_e, "entity", labels=labels_e, dtype=jnp.float64,
