@@ -30,6 +30,18 @@ FE_ITERS = 50
 RE_ITERS = 30
 
 
+def _apply_scale(scale: float) -> None:
+    """--scale multiplies the workload shape; --scale 200 is the MovieLens-20M
+    north star (20M samples / 400k users / 100k items — BASELINE.md config #3).
+    At the default toy shape the pass is dispatch-latency-bound and
+    systematically understates an accelerator's advantage; at-scale numbers
+    are the ones that answer the reference's scale claim (README.md:56)."""
+    global N_SAMPLES, N_USERS, N_ITEMS
+    N_SAMPLES = int(N_SAMPLES * scale)
+    N_USERS = max(1, int(N_USERS * scale))
+    N_ITEMS = max(1, int(N_ITEMS * scale))
+
+
 def _build_workload(dtype):
     import jax.numpy as jnp
     import numpy as np
@@ -209,6 +221,12 @@ def _child_main():
     """
     import jax
 
+    if "--scale" in sys.argv:
+        try:
+            _apply_scale(float(sys.argv[sys.argv.index("--scale") + 1]))
+        except (IndexError, ValueError):
+            print("--scale requires a numeric factor", file=sys.stderr)
+            sys.exit(2)
     trace_dir = None
     if "--profile" in sys.argv:
         idx = sys.argv.index("--profile") + 1
@@ -249,7 +267,7 @@ def _probe_backend(timeout_s):
     return True, (proc.stdout or "").strip()
 
 
-def _spawn_child(extra_env, timeout_s):
+def _spawn_child(extra_env, timeout_s, extra_args=()):
     """Run `python bench.py --child` under a timeout. Returns (value, record)
     where record is the child's full JSON dict, or (None, error-string)."""
     import subprocess
@@ -258,7 +276,7 @@ def _spawn_child(extra_env, timeout_s):
     env.update(extra_env)
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
+            [sys.executable, os.path.abspath(__file__), "--child", *extra_args],
             capture_output=True,
             text=True,
             timeout=timeout_s,
@@ -342,6 +360,15 @@ def main():
     errors = []
     value = platform = None
     extras = {}
+    child_args = ()
+    if "--scale" in sys.argv:
+        idx = sys.argv.index("--scale") + 1
+        try:
+            scale = float(sys.argv[idx])
+        except (IndexError, ValueError):
+            print("--scale requires a numeric factor (e.g. --scale 200)", file=sys.stderr)
+            sys.exit(2)
+        child_args = ("--scale", str(scale))
     probe_ok = False
     for _attempt in range(2):
         ok, info = _probe_backend(timeout_s=120)
@@ -354,7 +381,7 @@ def main():
         # bf16, maybe lbfgs_bf16, winner+pallas). 1500s covers ~5 compile+
         # measure cycles while leaving the CPU fallback its full window even if
         # the TPU tunnel wedges mid-run (probes 240s + 1500s + 1800s < 1h).
-        value, rec = _spawn_child({}, timeout_s=1500)
+        value, rec = _spawn_child({}, timeout_s=1500, extra_args=child_args)
         if value is not None:
             platform = rec.pop("platform", None)
             rec.pop("child_value", None)
@@ -365,7 +392,7 @@ def main():
     tpu_unavailable = False
     if value is None:
         tpu_unavailable = True
-        value, rec = _spawn_child(_CPU_CHILD_ENV, timeout_s=1800)
+        value, rec = _spawn_child(_CPU_CHILD_ENV, timeout_s=1800, extra_args=child_args)
         if value is not None:
             platform = rec.pop("platform", None)
             rec.pop("child_value", None)
@@ -380,13 +407,16 @@ def main():
     # like a perf verdict — so it is reported as null there, with the raw
     # baseline attached for transparency.
     on_accelerator = platform is not None and platform != "cpu"
+    # ... and only at the recorded baseline's own (scale-1) workload shape:
+    # a --scale run divided by the toy-shape baseline is apples-to-oranges.
+    comparable = on_accelerator and not child_args
     result = {
         "metric": "glmix_cd_pass_samples_per_sec",
         "value": round(value, 2) if value is not None else None,
         "unit": "samples/sec",
         "vs_baseline": (
             round(value / baseline, 4)
-            if value is not None and baseline and on_accelerator
+            if value is not None and baseline and comparable
             else None
         ),
         "baseline_platform": "cpu" if baseline else None,
@@ -403,6 +433,8 @@ def main():
             f"baseline recorded with cpu_count={recorded_cpus}, "
             f"current machine has {multiprocessing.cpu_count()}"
         )
+    if child_args:
+        result["scale"] = float(child_args[1])  # non-standard shape, labeled
     if tpu_unavailable:
         result["tpu_unavailable"] = True
         result["errors"] = [e[:200] for e in errors]

@@ -42,6 +42,21 @@ class RawDataset:
         return self.X.shape[1]
 
 
+def _id_tag_value(rec: dict, tag: str, i: int) -> str:
+    """Entity-id lookup order of the reference (GameConverters.scala:152-166):
+    a top-level record field named ``tag`` wins, then ``metadataMap[tag]``;
+    values are stringified (random-effect ids are strings by contract)."""
+    v = rec.get(tag)
+    if v is None:
+        v = (rec.get("metadataMap") or {}).get(tag)
+    if v is None:
+        raise ValueError(
+            f"Sample {i}: cannot find id in either record field {tag!r} "
+            f"or in metadataMap with key {tag!r}"
+        )
+    return str(v)
+
+
 def _records_to_dataset(
     records,
     index_map: Optional[IndexMap],
@@ -68,11 +83,8 @@ def _records_to_dataset(
         o = rec.get("offset")
         offsets.append(0.0 if o is None else o)
         uids.append(rec.get("uid") or str(i))
-        meta = rec.get("metadataMap") or {}
         for tag in id_tags:
-            if tag not in meta:
-                raise ValueError(f"Sample {i} missing id tag {tag!r} in metadataMap")
-            id_cols[tag].append(meta[tag])
+            id_cols[tag].append(_id_tag_value(rec, tag, i))
         has_explicit_intercept = False
         for f in rec["features"]:
             j = index_map.get_index(feature_key(f["name"], f["term"]))
@@ -185,11 +197,8 @@ def read_merged_avro(
         if rec.get("weight") is not None:
             weights[i] = rec["weight"]
         uids[i] = rec.get("uid") or str(i)
-        meta = rec.get("metadataMap") or {}
         for tag in id_tags:
-            if tag not in meta:
-                raise ValueError(f"Sample {i} missing id tag {tag!r} in metadataMap")
-            id_cols[tag].append(meta[tag])
+            id_cols[tag].append(_id_tag_value(rec, tag, i))
         for shard_id, cfg in shard_configs.items():
             imap = index_maps[shard_id]
             icpt = imap.intercept_index
@@ -306,6 +315,13 @@ def _read_merged_native(path, shard_configs, index_maps, id_tags):
             label_pos = pos.get("label", pos.get("response"))
             if label_pos is None:
                 return None
+            # reference id lookup is record-field-first (GameConverters.scala:
+            # 152-166); the columnar fast path only implements the common
+            # metadataMap case — top-level id fields take the Python path
+            if id_tags and (
+                any(tag in pos for tag in id_tags) or "metadataMap" not in pos
+            ):
+                return None
             bag_pos = {
                 bag: pos[bag]
                 for cfg in shard_configs.values()
@@ -360,8 +376,6 @@ def _read_merged_native(path, shard_configs, index_maps, id_tags):
             for i in range(block.count(label_pos)):
                 uids[base + i] = str(base + i)
         if id_tags:
-            if "metadataMap" not in pos:
-                raise ValueError(f"id tags {list(id_tags)} need a metadataMap field")
             rows, ko, kl, vo, vl = block.map_entries(pos["metadataMap"])
             keys = block.strings_at(ko, kl)
             vals = block.strings_at(vo, vl)
@@ -390,7 +404,8 @@ def _read_merged_native(path, shard_configs, index_maps, id_tags):
         missing = [i for i, v in enumerate(id_cols[tag]) if v is None]
         if missing:
             raise ValueError(
-                f"Sample {missing[0]} missing id tag {tag!r} in metadataMap"
+                f"Sample {missing[0]}: cannot find id in either record field "
+                f"{tag!r} or in metadataMap with key {tag!r}"
             )
 
     # ---- index maps (built from data when absent) ------------------------------

@@ -264,6 +264,15 @@ class GameModel:
         new[coordinate_id] = model
         return GameModel(models=new)
 
+    def select(self, coordinate_ids) -> "GameModel":
+        """Sub-model over a subset of coordinates, in the given order
+        (the reference slices GAME models per coordinate when scoring
+        sub-problems and locking coordinates for partial retrains)."""
+        missing = [c for c in coordinate_ids if c not in self.models]
+        if missing:
+            raise KeyError(f"Unknown coordinates {missing}")
+        return GameModel(models={c: self.models[c] for c in coordinate_ids})
+
     @property
     def coordinate_ids(self) -> list[str]:
         return list(self.models.keys())

@@ -191,6 +191,34 @@ class NormalizationContext:
             out = out / np.asarray(self.factors)
         return out
 
+    # -- device-side model-space conversions (jnp; no host sync) --------------------
+
+    def to_original_space_device(self, w: Array) -> Array:
+        """``model_to_original_space`` for device arrays, batched over leading
+        axes ([D] or [K, D]); traced jnp ops, so no device->host sync and safe
+        under jit/vmap. Single source for every batched conversion site
+        (problem.run, parallel/sweep.py)."""
+        if self.is_identity:
+            return w
+        if self.factors is not None:
+            w = w * jnp.asarray(np.asarray(self.factors), dtype=w.dtype)
+        if self.shifts is not None:
+            s = jnp.asarray(np.asarray(self.shifts), dtype=w.dtype)
+            w = w.at[..., self.intercept_index].add(-(w @ s))
+        return w
+
+    def to_transformed_space_device(self, w: Array) -> Array:
+        """Inverse of :meth:`to_original_space_device` (warm starts enter the
+        solver's transformed space)."""
+        if self.is_identity:
+            return w
+        if self.shifts is not None:
+            s = jnp.asarray(np.asarray(self.shifts), dtype=w.dtype)
+            w = w.at[..., self.intercept_index].add(w @ s)
+        if self.factors is not None:
+            w = w / jnp.asarray(np.asarray(self.factors), dtype=w.dtype)
+        return w
+
     # -- device-side effective-coefficient algebra ----------------------------------
 
     def effective_coefficients(self, coef: Array) -> tuple[Array, Array]:

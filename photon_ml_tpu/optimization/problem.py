@@ -15,6 +15,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.data.dataset import LabeledData
 from photon_ml_tpu.function.losses import loss_for_task
@@ -83,11 +84,16 @@ class GLMOptimizationProblem:
         # labels carry the COMPUTE dtype; X may hold a lower STORAGE dtype
         # (bf16) that must not quantize reg weights or box bounds
         dtype = data.labels.dtype
+        norm = self.normalization
         x0 = (
             initial_model.coefficients.means
             if initial_model is not None
             else jnp.zeros((data.dim,), dtype=dtype)
         )
+        if initial_model is not None and not norm.is_identity:
+            # warm starts arrive in ORIGINAL space (models always live there);
+            # the solve runs in transformed space (Optimizer.scala:175)
+            x0 = norm.to_transformed_space_device(jnp.asarray(x0, dtype=dtype))
         empty = jnp.zeros((0,), dtype=dtype)
         solve = glm_solver(
             self.task,
@@ -108,7 +114,20 @@ class GLMOptimizationProblem:
         )
         if self.variance_computation == VarianceComputationType.NONE:
             variances = None
-        model = self.create_model(Coefficients(result.coefficients, variances))
+        means = result.coefficients
+        if not norm.is_identity:
+            # the optimum lives in transformed space; the MODEL contract is
+            # original space (GeneralizedLinearOptimizationProblem.scala:89-95
+            # converts at createModel). Variances scale by factor^2 — the
+            # delta-method diagonal (the reference scales variances by the
+            # plain factor, a known quirk; the random-effect path here uses
+            # factor^2 too, algorithm/random_effect.py:248-253).
+            means = norm.to_original_space_device(means)
+            if variances is not None and norm.factors is not None:
+                variances = variances * jnp.asarray(
+                    np.asarray(norm.factors) ** 2, dtype=dtype
+                )
+        model = self.create_model(Coefficients(means, variances))
         return model, result
 
     def compute_variances(self, data: LabeledData, coef: Array) -> Optional[Array]:

@@ -21,6 +21,7 @@ into a junk coefficient row (index E) that no scoring gather ever reads.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Sequence
 
 import jax
@@ -291,14 +292,29 @@ def make_jitted_game_step(
     re_configs: Sequence[GLMOptimizationConfiguration],
     mesh,
 ):
-    """jit(game_train_step) with data closed over and params donated — call as
-    ``step(params) -> (params, diagnostics)``. One compiled XLA program per pass."""
+    """jit(game_train_step) with params donated — call as
+    ``step(params) -> (params, diagnostics)``. One compiled XLA program per pass.
+
+    ``data`` is passed as a jit ARGUMENT, never closed over: closed-over arrays
+    become jaxpr constants whose committed shardings GSPMD ignores (it
+    replicates constants), silently turning the whole pass into per-device
+    full-data recomputation — measured as a clean 1/m throughput collapse on
+    an m-device mesh (benchmarks/device_scaling.py caught it). As an argument,
+    the ShardedGameData pytree's NamedShardings bind the partitioning."""
 
     fuse_fe = mesh.devices.size == 1
 
-    def step(params):
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def _step(d, params):
         return game_train_step(
-            data, params, task, fe_config, tuple(re_configs), fuse_fe=fuse_fe
+            d, params, task, fe_config, tuple(re_configs), fuse_fe=fuse_fe
         )
 
-    return jax.jit(step, donate_argnums=(0,))
+    def step(params):
+        return _step(data, params)
+
+    # the raw jitted (data, params) function, for compile-time inspection
+    # (tests lower it to assert the per-device module is actually partitioned)
+    step.jitted = _step
+    step.data = data
+    return step

@@ -99,5 +99,11 @@ def train_glm_reg_sweep(
         if x0.ndim == 1:
             x0 = jnp.broadcast_to(x0, (K, d))
 
+    if initial_coefficients is not None and not norm.is_identity:
+        x0 = norm.to_transformed_space_device(x0)
     solve = reg_sweep_solver(task, configuration.optimizer_config)
-    return solve(data, x0, weights, norm)
+    coefs, values, iters, reasons = solve(data, x0, weights, norm)
+    # same model-space contract as GLMOptimizationProblem.run: inputs and
+    # outputs are ORIGINAL-space coefficients, the solve is transformed
+    coefs = norm.to_original_space_device(coefs)
+    return coefs, values, iters, reasons

@@ -144,7 +144,7 @@ def _run_main_with(monkeypatch, probe_ok, child):
 def test_main_reports_vs_baseline_on_accelerator(monkeypatch):
     out = _run_main_with(
         monkeypatch, True,
-        lambda env, timeout_s: (
+        lambda env, timeout_s, extra_args=(): (
             500000.0, {"child_value": 500000.0, "platform": "tpu", "variant": "v"}
         ),
     )
@@ -158,7 +158,7 @@ def test_main_nulls_vs_baseline_on_cpu_fallback(monkeypatch):
     CPU-now vs CPU-then is code drift, not speedup (round-2 0.62x confusion)."""
     calls = []
 
-    def child(env, timeout_s):
+    def child(env, timeout_s, extra_args=()):
         if not calls:
             calls.append(1)
             return None, "rc=1: tunnel wedged"

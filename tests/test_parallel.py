@@ -263,3 +263,33 @@ def test_run_benchmarks_smoke(capsys):
 
     small = run_benchmarks.config1_a1a_avro_lbfgs_l2(n_train=400, n_test=800)
     assert small["auc"] > 0.7 and small["value"] > 0
+
+
+def test_game_step_partitions_data_not_replicates():
+    """Compile-time guard for the closure-constant trap: arrays CLOSED OVER by
+    a jitted step become jaxpr constants, and GSPMD replicates constants
+    regardless of their committed sharding — every device then recomputes the
+    FULL pass (a clean 1/m throughput collapse; zero multi-chip scaling).
+    make_jitted_game_step must pass ShardedGameData as a jit argument, so the
+    per-device module works on [N/m]-row blocks of the fixed-effect matrix."""
+    rng = np.random.default_rng(3)
+    n, d = 1024, 16
+    fe_X = rng.normal(size=(n, d)).astype(np.float32)
+    users = rng.integers(0, 32, size=n)
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    re_feat = sp.csr_matrix(np.ones((n, 1), dtype=np.float32))
+    ds_u = build_random_effect_dataset(
+        re_feat, users, "userId", labels=y, intercept_index=0, dtype=jnp.float64
+    )
+    mesh = make_mesh(8)
+    data = build_sharded_game_data(fe_X, y, [ds_u], mesh, dtype=jnp.float64)
+    cfg = _config(max_iterations=3)
+    step = make_jitted_game_step(
+        data, TaskType.LOGISTIC_REGRESSION, cfg, [cfg], mesh
+    )
+    params = init_game_params(data, mesh)
+    txt = step.jitted.lower(data, params).compile().as_text()
+    full = f"{n},{d}"          # unpartitioned fixed-effect block
+    part = f"{n // 8},{d}"     # correctly partitioned per-device block
+    assert txt.count(full) == 0, "fixed-effect matrix is replicated per device"
+    assert txt.count(part) > 0
