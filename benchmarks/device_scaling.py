@@ -118,15 +118,24 @@ def main(argv=None):
 
     results = {}
     for n_dev in [int(x) for x in args.devices.split(",")]:
+        import re
+
+        # strip ANY ambient device-count flag: XLA takes the LAST duplicate,
+        # so an ambient value appended after ours would silently win and run
+        # every child at the same device count (a flat fake curve)
+        ambient = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
         env = dict(os.environ)
         env.update(
             {
                 "JAX_PLATFORMS": "cpu",
                 "PALLAS_AXON_POOL_IPS": "",
-                "XLA_FLAGS": f"--xla_force_host_platform_device_count={n_dev} "
-                + env.get("XLA_FLAGS", "").replace(
-                    "--xla_force_host_platform_device_count=8", ""
-                ),
+                "XLA_FLAGS": (
+                    f"{ambient} --xla_force_host_platform_device_count={n_dev}"
+                ).strip(),
             }
         )
         cmd = [

@@ -31,7 +31,7 @@ sys.path.insert(0, REPO)
 def _time(fn, repeats):
     import jax
 
-    fn()  # compile + warm
+    jax.block_until_ready(fn())  # compile + warm, fully drained before t0
     t0 = time.perf_counter()
     for _ in range(repeats):
         out = fn()

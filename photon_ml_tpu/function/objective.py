@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.data.dataset import LabeledData
@@ -49,6 +50,15 @@ class GLMObjective:
     # rule for this kernel, and those inner problems are the wrong regime for
     # it anyway (small D, batch axis provides the parallelism).
     allow_fused: bool = True
+    # Set when the objective runs INSIDE shard_map over a sample-sharded data
+    # axis: every data reduction (loss sum, gradient vector sum, prefactor
+    # sums, Hessian blocks) is psum'd over this named axis before the
+    # replicated algebra (L2 terms, normalization gradient transform) is
+    # applied. This is what lets the opaque Pallas kernels run per-device on a
+    # multi-chip mesh: each device fuses over its own [N/m, D] block and the
+    # psum plays the role of GSPMD's auto-inserted all-reduce
+    # (ValueAndGradientAggregator.scala:240-255's treeAggregate, made explicit).
+    psum_axis: object = None
 
     # -- internals -------------------------------------------------------------------
 
@@ -58,6 +68,12 @@ class GLMObjective:
 
     def _l2_value(self, coef: Array, l2_weight) -> Array:
         return 0.5 * l2_weight * jnp.dot(coef, coef)
+
+    def _psum(self, x: Array) -> Array:
+        """Cross-device data-reduction sum (identity outside shard_map)."""
+        if self.psum_axis is None:
+            return x
+        return jax.lax.psum(x, self.psum_axis)
 
     @staticmethod
     def _weighted(weights: Array, x: Array) -> Array:
@@ -73,7 +89,8 @@ class GLMObjective:
     def value(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:
         z = self._margins(data, coef)
         l = self.loss.loss(z, data.labels)
-        return jnp.sum(self._weighted(data.weights, l)) + self._l2_value(coef, l2_weight)
+        data_sum = self._psum(jnp.sum(self._weighted(data.weights, l)))
+        return data_sum + self._l2_value(coef, l2_weight)
 
     def value_and_gradient(
         self, data: LabeledData, coef: Array, l2_weight=0.0
@@ -84,9 +101,10 @@ class GLMObjective:
         z = self._margins(data, coef)
         l, dz = self.loss.loss_and_dz(z, data.labels)
         wdz = self._weighted(data.weights, dz)
-        value = jnp.sum(self._weighted(data.weights, l)) + self._l2_value(coef, l2_weight)
-        vector_sum = data.X.rmatvec(wdz)
-        grad = self.normalization.apply_to_gradient(vector_sum, jnp.sum(wdz))
+        value = self._psum(jnp.sum(self._weighted(data.weights, l)))
+        value = value + self._l2_value(coef, l2_weight)
+        vector_sum = self._psum(data.X.rmatvec(wdz))
+        grad = self.normalization.apply_to_gradient(vector_sum, self._psum(jnp.sum(wdz)))
         return value, grad + l2_weight * coef
 
     def _fused_eligible(self, X, coef) -> bool:
@@ -108,7 +126,7 @@ class GLMObjective:
             and X.values.ndim == 2
             and X.dtype in (jnp.float32, jnp.bfloat16)
             and coef.dtype == jnp.float32
-            and pallas_glm.should_fuse(X.n_cols)
+            and pallas_glm.should_fuse(X.n_cols, per_device=self.psum_axis is not None)
         )
 
     def _fused_value_and_gradient(self, data: LabeledData, coef: Array, l2_weight):
@@ -131,8 +149,8 @@ class GLMObjective:
             loss_and_dz=self.loss.loss_and_dz,
             interpret=pallas_glm.interpret_mode(),
         )
-        value = val + self._l2_value(coef, l2_weight)
-        grad = self.normalization.apply_to_gradient(vec, wsum)
+        value = self._psum(val) + self._l2_value(coef, l2_weight)
+        grad = self.normalization.apply_to_gradient(self._psum(vec), self._psum(wsum))
         return value, grad + l2_weight * coef
 
     def _fused_hessian_vector(self, data: LabeledData, coef, vector, l2_weight):
@@ -158,7 +176,7 @@ class GLMObjective:
             dzz=self.loss.dzz,
             interpret=pallas_glm.interpret_mode(),
         )
-        hv = self.normalization.apply_to_gradient(vec, usum)
+        hv = self.normalization.apply_to_gradient(self._psum(vec), self._psum(usum))
         return hv + l2_weight * vector
 
     def gradient(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:
@@ -176,8 +194,8 @@ class GLMObjective:
         eff_v, shift_v = self.normalization.effective_coefficients(vector)
         dv = data.X.matvec(eff_v) + shift_v  # normalized-space directional margins
         u = self._weighted(data.weights, dzz * dv)
-        vector_sum = data.X.rmatvec(u)
-        hv = self.normalization.apply_to_gradient(vector_sum, jnp.sum(u))
+        vector_sum = self._psum(data.X.rmatvec(u))
+        hv = self.normalization.apply_to_gradient(vector_sum, self._psum(jnp.sum(u)))
         return hv + l2_weight * vector
 
     def hessian_diagonal(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:
@@ -193,7 +211,9 @@ class GLMObjective:
         if norm.factors is not None:
             f = jnp.asarray(norm.factors, dtype=sq.dtype)
             sq = sq * f * f
-        return sq + l2_weight
+        # sq is linear in the per-sample sums, so one psum after the
+        # normalization algebra equals psum-ing each constituent sum
+        return self._psum(sq) + l2_weight
 
     def hessian_matrix(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:
         """Full d x d Hessian for FULL variance (HessianMatrixAggregator.scala:31-129)
@@ -217,7 +237,7 @@ class GLMObjective:
             A = A - jnp.asarray(norm.shifts, dtype=A.dtype)[None, :]
         if norm.factors is not None:
             A = A * jnp.asarray(norm.factors, dtype=A.dtype)[None, :]
-        H = A.T @ (A * d[:, None])
+        H = self._psum(A.T @ (A * d[:, None]))
         return H + l2_weight * jnp.eye(H.shape[0], dtype=H.dtype)
 
     def _fused_hessian_matrix(self, data: LabeledData, coef, l2_weight):
@@ -257,7 +277,7 @@ class GLMObjective:
             dzz=self.loss.dzz,
             interpret=pallas_glm.interpret_mode(),
         )
-        return H + l2_weight * jnp.eye(d, dtype=H.dtype)
+        return self._psum(H) + l2_weight * jnp.eye(d, dtype=H.dtype)
 
     # -- scoring ---------------------------------------------------------------------
 

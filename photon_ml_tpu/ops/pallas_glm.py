@@ -104,13 +104,15 @@ def interpret_mode() -> bool:
     return os.environ.get("PHOTON_PALLAS_INTERPRET", "") not in ("", "0")
 
 
-def should_fuse(n_cols: int) -> bool:
+def should_fuse(n_cols: int, *, per_device: bool = False) -> bool:
     """True when the fused kernel should replace the two-matmul XLA path.
 
     Trace-time decision: backend is the default backend of the process. The
     kernel is compiled for single-device execution — under a >1-device mesh
-    GSPMD cannot partition an opaque pallas_call, so the mesh paths keep the
-    XLA lowering (its psum'd matmuls are already the right collective form).
+    GSPMD cannot partition an opaque pallas_call, so the GSPMD paths keep the
+    XLA lowering UNLESS the caller runs inside shard_map (``per_device=True``:
+    each device fuses over its own block and the objective psums the sums —
+    see GLMObjective.psum_axis), where the kernel is always legal.
     """
     if not pallas_enabled():
         return False
@@ -121,7 +123,7 @@ def should_fuse(n_cols: int) -> bool:
     try:
         if jax.default_backend() != "tpu":
             return False
-        return len(jax.devices()) == 1
+        return per_device or len(jax.devices()) == 1
     except Exception:
         return False
 

@@ -85,6 +85,12 @@ class GLMOptimizationProblem:
         # (bf16) that must not quantize reg weights or box bounds
         dtype = data.labels.dtype
         norm = self.normalization
+        if (lower_bounds is not None or upper_bounds is not None) and not norm.is_identity:
+            # bounds are specified against ORIGINAL-space coefficients but the
+            # solve clamps in transformed space — the combination cannot honor
+            # both contracts, so reject it exactly like the reference
+            # (Params.scala:211-214; FixedEffectCoordinate enforces the same)
+            raise ValueError("Box constraints and normalization cannot be combined")
         x0 = (
             initial_model.coefficients.means
             if initial_model is not None

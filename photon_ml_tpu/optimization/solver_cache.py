@@ -184,8 +184,87 @@ def sharded_glm_solver(
     return jax.jit(solve, out_shardings=replicated_sharding(mesh))
 
 
+@functools.lru_cache(maxsize=None)
+def shard_mapped_glm_solver(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    mesh,
+    axis_name: str = "data",
+):
+    """GLM solve with EXPLICIT SPMD: the whole optimizer loop runs inside
+    ``shard_map`` over the mesh's sample axis, each device evaluating the
+    objective on its own [N/m, D] block with ``lax.psum`` combining the data
+    sums (GLMObjective.psum_axis). Mathematically identical to the GSPMD
+    lowering — the [D]-vector optimizer state is device-invariant because it
+    only ever consumes psum'd quantities.
+
+    This exists because GSPMD cannot partition an opaque ``pallas_call``:
+    inside shard_map each device's block is an ordinary dense array, so the
+    fused Pallas kernels (ops/pallas_glm.py) are legal on a MULTI-chip mesh —
+    lifting the single-chip restriction the round-2 review flagged. With the
+    kernels off it is simply the explicit-collective form of
+    sharded_glm_solver (treeAggregate made explicit,
+    ValueAndGradientAggregator.scala:240-255).
+
+    ``solve(data, x0, l2, l1) -> OptResult`` — dense X, identity
+    normalization, no bounds/variances (the fused GAME-pass regime).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        shard_map = jax.shard_map  # jax >= 0.8
+    except AttributeError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    task = TaskType(task)
+    loss = loss_for_task(task)
+    minimize = build_minimizer(opt_config)
+    use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
+
+    def solve_block(data, x0, l2, l1):
+        obj = GLMObjective(loss, psum_axis=axis_name)
+
+        def vg(w):
+            return obj.value_and_gradient(data, w, l2)
+
+        kwargs = {}
+        if use_hvp:
+            kwargs["hvp"] = lambda w, v: obj.hessian_vector(data, w, v, l2)
+        if use_hess:
+            kwargs["hess"] = lambda w: obj.hessian_matrix(data, w, l2)
+        if has_l1:
+            kwargs["l1_weight"] = l1
+        return minimize(vg, x0, **kwargs)
+
+    def specs_like(tree, sharded: bool):
+        return jax.tree_util.tree_map(
+            lambda a: P(axis_name, *(None,) * (a.ndim - 1)) if sharded else P(),
+            tree,
+        )
+
+    def solve(data, x0, l2, l1):
+        # psum'd sums make every [D] optimizer state device-invariant, but the
+        # while_loop obstructs shard_map's replication inference — disable the
+        # check (named check_vma in jax >= 0.8, check_rep before).
+        kwargs = dict(
+            mesh=mesh,
+            in_specs=(specs_like(data, True), P(), P(), P()),
+            out_specs=P(),
+        )
+        try:
+            mapped = shard_map(solve_block, check_vma=False, **kwargs)
+        except TypeError:  # pragma: no cover - older jax
+            mapped = shard_map(solve_block, check_rep=False, **kwargs)
+        return mapped(data, x0, l2, l1)
+
+    return jax.jit(solve)
+
+
 def clear():
     """Drop all cached solvers (tests / long-running sweeps with many configs)."""
     glm_solver.cache_clear()
     re_bucket_solver.cache_clear()
     sharded_glm_solver.cache_clear()
+    shard_mapped_glm_solver.cache_clear()

@@ -201,12 +201,17 @@ def game_train_step(
     fe_config: GLMOptimizationConfiguration,
     re_configs: Sequence[GLMOptimizationConfiguration],
     fuse_fe: bool = False,
+    shard_mesh=None,
 ) -> tuple[dict, dict]:
     """One pure (jittable) coordinate-descent pass over [fixed, re_0, re_1, ...].
 
     Returns (new params, diagnostics {fe_value, fe_iterations, total_scores}).
     """
-    from photon_ml_tpu.optimization.solver_cache import glm_solver, re_bucket_solver
+    from photon_ml_tpu.optimization.solver_cache import (
+        glm_solver,
+        re_bucket_solver,
+        shard_mapped_glm_solver,
+    )
     from photon_ml_tpu.types import VarianceComputationType
 
     task = TaskType(task)
@@ -230,21 +235,44 @@ def game_train_step(
         weights=data.weights,
     )
     empty = jnp.zeros((0,), dtype=dtype)
-    # fuse_fe: the opt-in Pallas value+gradient kernel is only partitionable on
-    # a single-device mesh; make_jitted_game_step sets this from the mesh size.
-    fe_solve = glm_solver(
-        task, fe_config.optimizer_config, bool(fe_config.l1_weight), False, False,
-        no_var, allow_fused=fuse_fe,
+    # Pallas routing: on a single chip the opt-in fused kernel rides the
+    # stock GSPMD-free solve (fuse_fe). On a MULTI-chip mesh GSPMD cannot
+    # partition an opaque pallas_call, so when the kernels are enabled the
+    # fixed-effect solve switches to the shard_map form — per-device fused
+    # blocks + explicit psum (shard_mapped_glm_solver) — instead of silently
+    # dropping the fusion.
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+    from photon_ml_tpu.ops import pallas_glm
+
+    use_shard_map = (
+        shard_mesh is not None
+        and isinstance(data.fe_X, DenseDesignMatrix)
+        and pallas_glm.pallas_enabled()
     )
-    fe_res, _ = fe_solve(
-        d,
-        fe_coef,
-        jnp.asarray(fe_config.l2_weight, dtype=dtype),
-        jnp.asarray(fe_config.l1_weight or 0.0, dtype=dtype),
-        empty,
-        empty,
-        NO_NORMALIZATION,
-    )
+    if use_shard_map:
+        fe_solve_sm = shard_mapped_glm_solver(
+            task, fe_config.optimizer_config, bool(fe_config.l1_weight), shard_mesh
+        )
+        fe_res = fe_solve_sm(
+            d,
+            fe_coef,
+            jnp.asarray(fe_config.l2_weight, dtype=dtype),
+            jnp.asarray(fe_config.l1_weight or 0.0, dtype=dtype),
+        )
+    else:
+        fe_solve = glm_solver(
+            task, fe_config.optimizer_config, bool(fe_config.l1_weight), False, False,
+            no_var, allow_fused=fuse_fe,
+        )
+        fe_res, _ = fe_solve(
+            d,
+            fe_coef,
+            jnp.asarray(fe_config.l2_weight, dtype=dtype),
+            jnp.asarray(fe_config.l1_weight or 0.0, dtype=dtype),
+            empty,
+            empty,
+            NO_NORMALIZATION,
+        )
     fe_coef = fe_res.coefficients
     fe_score = data.fe_X.matvec(fe_coef)
     total = fe_score + sum(re_scores) if re_scores else fe_score
@@ -303,11 +331,13 @@ def make_jitted_game_step(
     the ShardedGameData pytree's NamedShardings bind the partitioning."""
 
     fuse_fe = mesh.devices.size == 1
+    shard_mesh = mesh if mesh.devices.size > 1 else None
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def _step(d, params):
         return game_train_step(
-            d, params, task, fe_config, tuple(re_configs), fuse_fe=fuse_fe
+            d, params, task, fe_config, tuple(re_configs),
+            fuse_fe=fuse_fe, shard_mesh=shard_mesh,
         )
 
     def step(params):
