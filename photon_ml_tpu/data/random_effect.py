@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -137,8 +138,11 @@ def _resolve_merge_fraction(bucket_merge_fraction: Optional[float]) -> float:
     """
     if bucket_merge_fraction is not None:
         return bucket_merge_fraction
-    import jax
-
+    env = os.environ.get("PHOTON_BUCKET_MERGE")
+    if env:
+        # experimentation override (e.g. bench sweeps: 0 = off, 1.0 = stack
+        # every shape class into one solve per coordinate)
+        return float(env)
     return 0.05 if jax.default_backend() != "cpu" else 0.0
 
 
