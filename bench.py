@@ -317,37 +317,15 @@ def main():
         if value is None:
             print(json.dumps({"error": f"cpu baseline run failed: {rec}"}))
             sys.exit(1)
-        import datetime
-        import multiprocessing
-        import subprocess
+        from photon_ml_tpu.util.provenance import measurement_provenance
 
-        repo_dir = os.path.dirname(os.path.abspath(__file__))
-        try:
-            proc = subprocess.run(
-                ["git", "rev-parse", "HEAD"],
-                capture_output=True, text=True, cwd=repo_dir,
-            )
-            commit = proc.stdout.strip() if proc.returncode == 0 else None
-            if commit:
-                dirty = subprocess.run(
-                    ["git", "status", "--porcelain"],
-                    capture_output=True, text=True, cwd=repo_dir,
-                )
-                # a dirty tree means the measured code is NOT the HEAD commit
-                if dirty.returncode == 0 and dirty.stdout.strip():
-                    commit += "-dirty"
-        except Exception:
-            commit = None
         with open(BASELINE_PATH, "w") as f:
             json.dump(
                 {
                     "metric": "glmix_cd_pass_samples_per_sec",
                     "value": value,
                     "backend": "cpu",
-                    "commit": commit,
-                    "recorded_at": datetime.datetime.now(datetime.timezone.utc)
-                    .isoformat(timespec="seconds"),
-                    "cpu_count": multiprocessing.cpu_count(),
+                    **measurement_provenance(os.path.dirname(os.path.abspath(__file__))),
                     "note": "same workload on this machine's CPU JAX backend "
                     "(stand-in for the Spark-CPU baseline node)",
                 },

@@ -511,32 +511,11 @@ def main(argv=None):
         print(json.dumps({name: res}))
 
     if args.record_baseline:
-        import datetime
-        import multiprocessing
-        import subprocess
+        from photon_ml_tpu.util.provenance import measurement_provenance
 
-        repo_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        try:
-            proc = subprocess.run(
-                ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
-                cwd=repo_dir,
-            )
-            commit = proc.stdout.strip() if proc.returncode == 0 else None
-            if commit:
-                dirty = subprocess.run(
-                    ["git", "status", "--porcelain"],
-                    capture_output=True, text=True, cwd=repo_dir,
-                )
-                if dirty.returncode == 0 and dirty.stdout.strip():
-                    commit += "-dirty"
-        except Exception:
-            commit = None
-        provenance = {
-            "commit": commit,
-            "recorded_at": datetime.datetime.now(datetime.timezone.utc)
-            .isoformat(timespec="seconds"),
-            "cpu_count": multiprocessing.cpu_count(),
-        }
+        provenance = measurement_provenance(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
         for res in results.values():
             res.update(provenance)
         # merge: re-recording a subset must not erase other configs' baselines

@@ -138,11 +138,16 @@ def _resolve_merge_fraction(bucket_merge_fraction: Optional[float]) -> float:
     """
     if bucket_merge_fraction is not None:
         return bucket_merge_fraction
-    env = os.environ.get("PHOTON_BUCKET_MERGE")
+    env = os.environ.get("PHOTON_BUCKET_MERGE", "").strip()
     if env:
-        # experimentation override (e.g. bench sweeps: 0 = off, 1.0 = stack
-        # every shape class into one solve per coordinate)
-        return float(env)
+        # experimentation override (e.g. bench sweeps: 0 = off, 1.0 = merge
+        # every sub-threshold shape class, still under the padding budget)
+        try:
+            return float(env)
+        except ValueError:
+            raise ValueError(
+                f"PHOTON_BUCKET_MERGE must be a number, got {env!r}"
+            ) from None
     return 0.05 if jax.default_backend() != "cpu" else 0.0
 
 

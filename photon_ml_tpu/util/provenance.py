@@ -1,0 +1,39 @@
+"""Measurement provenance shared by the benchmark recorders.
+
+A recorded baseline is only comparable to later runs on the same code and
+machine shape; these fields let any consumer detect (and refuse) stale or
+cross-machine comparisons instead of printing a ratio that reads like a perf
+verdict (bench.py nulls vs_baseline on mismatch).
+"""
+
+from __future__ import annotations
+
+import datetime
+import multiprocessing
+import subprocess
+
+
+def measurement_provenance(repo_dir: str) -> dict:
+    """{commit (with -dirty marker), recorded_at (UTC ISO), cpu_count}."""
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, cwd=repo_dir,
+        )
+        commit = proc.stdout.strip() if proc.returncode == 0 else None
+        if commit:
+            dirty = subprocess.run(
+                ["git", "status", "--porcelain"],
+                capture_output=True, text=True, cwd=repo_dir,
+            )
+            # a dirty tree means the measured code is NOT the HEAD commit
+            if dirty.returncode == 0 and dirty.stdout.strip():
+                commit += "-dirty"
+    except Exception:
+        commit = None
+    return {
+        "commit": commit,
+        "recorded_at": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "cpu_count": multiprocessing.cpu_count(),
+    }
