@@ -325,7 +325,10 @@ def main():
                     "metric": "glmix_cd_pass_samples_per_sec",
                     "value": value,
                     "backend": "cpu",
-                    **measurement_provenance(os.path.dirname(os.path.abspath(__file__))),
+                    **measurement_provenance(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        ignore_paths=("bench_baseline.json",),
+                    ),
                     "note": "same workload on this machine's CPU JAX backend "
                     "(stand-in for the Spark-CPU baseline node)",
                 },

@@ -514,7 +514,8 @@ def main(argv=None):
         from photon_ml_tpu.util.provenance import measurement_provenance
 
         provenance = measurement_provenance(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ignore_paths=("benchmarks/baselines.json",),
         )
         for res in results.values():
             res.update(provenance)

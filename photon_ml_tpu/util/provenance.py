@@ -13,8 +13,12 @@ import multiprocessing
 import subprocess
 
 
-def measurement_provenance(repo_dir: str) -> dict:
-    """{commit (with -dirty marker), recorded_at (UTC ISO), cpu_count}."""
+def measurement_provenance(repo_dir: str, ignore_paths: tuple = ()) -> dict:
+    """{commit (with -dirty marker), recorded_at (UTC ISO), cpu_count}.
+
+    ``ignore_paths``: repo-relative files whose modifications don't count as
+    dirt — the recorder's own output file, which is necessarily modified at
+    recording time, must not mark every recording dirty."""
     try:
         proc = subprocess.run(
             ["git", "rev-parse", "HEAD"],
@@ -27,7 +31,12 @@ def measurement_provenance(repo_dir: str) -> dict:
                 capture_output=True, text=True, cwd=repo_dir,
             )
             # a dirty tree means the measured code is NOT the HEAD commit
-            if dirty.returncode == 0 and dirty.stdout.strip():
+            lines = [
+                ln
+                for ln in (dirty.stdout or "").strip().splitlines()
+                if dirty.returncode == 0 and ln[3:].strip() not in ignore_paths
+            ]
+            if lines:
                 commit += "-dirty"
     except Exception:
         commit = None
