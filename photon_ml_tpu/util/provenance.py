@@ -31,10 +31,15 @@ def measurement_provenance(repo_dir: str, ignore_paths: tuple = ()) -> dict:
                 capture_output=True, text=True, cwd=repo_dir,
             )
             # a dirty tree means the measured code is NOT the HEAD commit
+            # NOTE: no .strip() on the whole output — porcelain status lines
+            # start with a significant space (" M file") and stripping the
+            # first line would shift the path slice
             lines = [
                 ln
-                for ln in (dirty.stdout or "").strip().splitlines()
-                if dirty.returncode == 0 and ln[3:].strip() not in ignore_paths
+                for ln in (dirty.stdout or "").splitlines()
+                if dirty.returncode == 0
+                and ln.strip()
+                and ln[3:].strip() not in ignore_paths
             ]
             if lines:
                 commit += "-dirty"
