@@ -1,0 +1,59 @@
+"""photon_ml_tpu.util.provenance — the fields that make recorded baselines
+comparable (or visibly incomparable) across commits and machines."""
+
+import json
+import multiprocessing
+import os
+import subprocess
+
+from photon_ml_tpu.util.provenance import measurement_provenance
+
+
+def _git(tmp, *args):
+    subprocess.run(["git", *args], cwd=tmp, check=True, capture_output=True)
+
+
+def _repo(tmp_path):
+    tmp = str(tmp_path)
+    _git(tmp, "init", "-q")
+    _git(tmp, "config", "user.email", "t@t")
+    _git(tmp, "config", "user.name", "t")
+    (tmp_path / "f.txt").write_text("x")
+    _git(tmp, "add", "-A")
+    _git(tmp, "commit", "-qm", "init")
+    return tmp
+
+
+def test_clean_tree_has_plain_commit(tmp_path):
+    tmp = _repo(tmp_path)
+    p = measurement_provenance(tmp)
+    assert p["commit"] and not p["commit"].endswith("-dirty")
+    assert p["cpu_count"] == multiprocessing.cpu_count()
+    assert p["recorded_at"].endswith("+00:00")
+
+
+def test_dirty_tree_is_marked(tmp_path):
+    tmp = _repo(tmp_path)
+    (tmp_path / "f.txt").write_text("changed")
+    p = measurement_provenance(tmp)
+    assert p["commit"].endswith("-dirty")
+
+
+def test_recorder_output_file_does_not_count_as_dirt(tmp_path):
+    """The recorder rewrites its own output file at recording time; that one
+    modification must not stamp every recording -dirty. Regression guard for
+    the porcelain leading-space parse (the first line's status space is
+    significant and must survive)."""
+    tmp = _repo(tmp_path)
+    (tmp_path / "baseline.json").write_text("{}")
+    _git(tmp, "add", "-A")
+    _git(tmp, "commit", "-qm", "baseline")
+    (tmp_path / "baseline.json").write_text(json.dumps({"value": 1}))
+    assert measurement_provenance(tmp)["commit"].endswith("-dirty")
+    p = measurement_provenance(tmp, ignore_paths=("baseline.json",))
+    assert not p["commit"].endswith("-dirty")
+
+
+def test_not_a_repo_gives_null_commit(tmp_path):
+    p = measurement_provenance(str(tmp_path))
+    assert p["commit"] is None
