@@ -224,6 +224,13 @@ def _child_main():
     """
     import jax
 
+    if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        # persistent compile cache: the variant sweep compiles ~5 program
+        # families per run; on TPU every skipped recompile is 20-40s of the
+        # measurement session (compiles are excluded from timings either way)
+        from photon_ml_tpu.cli.runtime import enable_compilation_cache
+
+        enable_compilation_cache(os.path.expanduser("~/.cache/photon_xla_bench"))
     if "--scale" in sys.argv:
         try:
             _apply_scale(float(sys.argv[sys.argv.index("--scale") + 1]))

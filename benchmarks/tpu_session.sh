@@ -1,0 +1,45 @@
+#!/bin/bash
+# Run the moment a tunnel probe succeeds. Run via: bash benchmarks/tpu_session.sh
+# STRICTLY SERIAL: one TPU client at a
+# time, /tmp/tpu_busy held throughout. Never kill a running TPU job.
+set -u
+cd /root/repo
+touch /tmp/tpu_busy
+trap 'rm -f /tmp/tpu_busy' EXIT
+TS=$(date -u +%Y%m%dT%H%M%SZ)
+mkdir -p /tmp/tpu_session_$TS
+
+echo "=== 1. flagship bench (variant sweep) ===" >&2
+python bench.py > /tmp/tpu_session_$TS/bench.json 2> /tmp/tpu_session_$TS/bench.err
+cat /tmp/tpu_session_$TS/bench.json
+
+echo "=== 2. profiled pass + trace summary ===" >&2
+python bench.py --child --profile /tmp/tpu_session_$TS/trace \
+  > /tmp/tpu_session_$TS/profile.json 2> /tmp/tpu_session_$TS/profile.err
+PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
+  python benchmarks/summarize_trace.py /tmp/tpu_session_$TS/trace \
+  > /tmp/tpu_session_$TS/trace_summary.txt 2>&1 || true
+
+echo "=== 3. pallas on-chip microbench ===" >&2
+python benchmarks/pallas_microbench.py > /tmp/tpu_session_$TS/pallas.json \
+  2> /tmp/tpu_session_$TS/pallas.err || true
+
+echo "=== 4. north-star scale (MovieLens-20M shape) ===" >&2
+# child directly: the parent's 1500s TPU-child timeout is too tight for the
+# 20M-sample variant sweep (5 variants x ~4 min measure + dataset builds)
+python bench.py --child --scale 200 > /tmp/tpu_session_$TS/bench_scale200.json \
+  2> /tmp/tpu_session_$TS/bench_scale200.err || true
+
+echo "=== 5. five BASELINE configs ===" >&2
+python benchmarks/run_benchmarks.py --output /tmp/tpu_session_$TS/five_configs.json \
+  > /tmp/tpu_session_$TS/five_configs.out 2>&1 || true
+
+echo "=== 6. bucket-consolidation trade-off on chip ===" >&2
+for bm in 0 0.05 1.0; do
+  PHOTON_BUCKET_MERGE=$bm python bench.py --child \
+    > /tmp/tpu_session_$TS/bench_merge_$bm.json \
+    2> /tmp/tpu_session_$TS/bench_merge_$bm.err || true
+done
+
+echo "TPU session artifacts in /tmp/tpu_session_$TS" >&2
+ls /tmp/tpu_session_$TS >&2

@@ -10,7 +10,12 @@ def configure_compilation_cache(args) -> None:
     cache_dir = getattr(args, "compilation_cache_directory", None)
     if not cache_dir:
         return
+    enable_compilation_cache(cache_dir)
+
+
+def enable_compilation_cache(cache_dir: str, min_compile_secs: float = 0.1) -> None:
+    """The one place cache policy lives (CLI drivers, bench, test conftest)."""
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)
