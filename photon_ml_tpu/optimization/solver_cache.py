@@ -245,6 +245,17 @@ def shard_mapped_glm_solver(
         )
 
     def solve(data, x0, l2, l1):
+        from photon_ml_tpu.data.matrix import DenseDesignMatrix
+
+        if not isinstance(data.X, DenseDesignMatrix):
+            # a COO matrix sharded by nnz gives each device PARTIAL margins
+            # for every row — the per-block objective would psum loss sums of
+            # incomplete margins, silently wrong. The sparse path's GSPMD
+            # lowering (parallel/glm.py) psums the margins themselves.
+            raise TypeError(
+                "shard_mapped_glm_solver requires a dense sample-sharded "
+                "design matrix; sparse problems take the GSPMD path"
+            )
         # psum'd sums make every [D] optimizer state device-invariant, but the
         # while_loop obstructs shard_map's replication inference — disable the
         # check (named check_vma in jax >= 0.8, check_rep before).

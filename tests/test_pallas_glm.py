@@ -508,3 +508,76 @@ def test_full_game_step_shard_map_multichip(rng):
         fused_coef, fused_val = run()
     np.testing.assert_allclose(fused_coef, stock_coef, atol=5e-4)
     np.testing.assert_allclose(fused_val, stock_val, rtol=1e-4)
+
+
+@pytest.mark.parametrize("opt", ["TRON", "NEWTON"])
+def test_shard_mapped_solver_second_order_parity(rng, opt):
+    """The psum'd objective must serve the second-order paths too: TRON's
+    per-CG-step HVP and NEWTON's per-iteration full Hessian are data sums
+    with replicated algebra on top — shard_map must reach the stock optimum."""
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+    from photon_ml_tpu.normalization import NO_NORMALIZATION
+    from photon_ml_tpu.optimization.common import OptimizerConfig
+    from photon_ml_tpu.optimization.solver_cache import (
+        glm_solver,
+        shard_mapped_glm_solver,
+    )
+    from photon_ml_tpu.parallel import make_mesh
+    from photon_ml_tpu.parallel.glm import shard_labeled_data
+    from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
+
+    n, d = 512, 6
+    X = rng.normal(size=(n, d))
+    y = ((X @ rng.normal(size=d)) > 0).astype(np.float64)
+    data = LabeledData.build(DenseDesignMatrix(jnp.asarray(X)), y, dtype=jnp.float64)
+    mesh = make_mesh(8)
+    data_m, _ = shard_labeled_data(data, mesh)
+
+    cfg = OptimizerConfig(
+        optimizer_type=OptimizerType[opt], max_iterations=30, tolerance=1e-10
+    )
+    l2 = jnp.asarray(1.0, jnp.float64)
+    l1 = jnp.asarray(0.0, jnp.float64)
+    x0 = jnp.zeros((d,), jnp.float64)
+    empty = jnp.zeros((0,), jnp.float64)
+
+    ref, _ = glm_solver(
+        TaskType.LOGISTIC_REGRESSION, cfg, False, False, False,
+        VarianceComputationType.NONE,
+    )(data, x0, l2, l1, empty, empty, NO_NORMALIZATION)
+    got = shard_mapped_glm_solver(TaskType.LOGISTIC_REGRESSION, cfg, False, mesh)(
+        data_m, x0, l2, l1
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.coefficients), np.asarray(ref.coefficients), atol=1e-7
+    )
+
+
+def test_shard_mapped_solver_rejects_sparse(rng):
+    """nnz-sharded COO inside shard_map would psum partial-margin losses —
+    reject it loudly; sparse problems take the GSPMD lowering."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.data.matrix import as_design_matrix
+    from photon_ml_tpu.optimization.common import OptimizerConfig
+    from photon_ml_tpu.optimization.solver_cache import shard_mapped_glm_solver
+    from photon_ml_tpu.parallel import make_mesh
+    from photon_ml_tpu.types import TaskType
+
+    n, d = 64, 4
+    X = sp.random(n, d, density=0.3, random_state=0, format="csr")
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    data = LabeledData.build(as_design_matrix(X), y, dtype=jnp.float64)
+    mesh = make_mesh(8)
+    solve = shard_mapped_glm_solver(
+        TaskType.LOGISTIC_REGRESSION, OptimizerConfig(max_iterations=5), False, mesh
+    )
+    with pytest.raises(TypeError, match="dense sample-sharded"):
+        solve(
+            data,
+            jnp.zeros((d,), jnp.float64),
+            jnp.asarray(1.0, jnp.float64),
+            jnp.asarray(0.0, jnp.float64),
+        )
