@@ -41,6 +41,10 @@ def summarize(path: str, top: int, line_filter: str | None):
                 continue
             if not line_filter and len(ln.events) < 100:
                 continue
+            if not line_filter and ln.name == "python":
+                # host python-frame events are tracing bookkeeping (compile
+                # included), not device time; ask for them with --line python
+                continue
             agg = collections.defaultdict(lambda: [0, 0])  # name -> [ps, count]
             total_ps = 0
             for ev in ln.events:
