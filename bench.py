@@ -154,6 +154,25 @@ def run_variant_sweep(measure, *, cpu_backend, pallas_capable, bf16):
         )
 
 
+def _emit_partial(best, info):
+    """One flushed JSON line per completed variant, so a tunnel wedge
+    mid-sweep leaves the parent salvageable partial results (_spawn_child
+    parses the dead child's captured output for the last partial line).
+    Emitted on STDERR: the child's stdout contract stays one final JSON line
+    (direct `--child` consumers like tpu_session.sh save stdout as .json)."""
+    try:
+        import jax
+
+        platform = jax.default_backend()
+    except Exception:
+        platform = None
+    print(
+        json.dumps({"partial_value": best, "platform": platform, **info}),
+        file=sys.stderr,
+        flush=True,
+    )
+
+
 def _variant_sweep_body(measure, cpu_backend, pallas_capable, bf16, OptimizerType, pallas_glm):
     tp_anchor, val_anchor = measure(OptimizerType.LBFGS, None)
     info = {"variant": "lbfgs_f32", "lbfgs_f32_samples_per_sec": round(tp_anchor, 2)}
@@ -162,6 +181,7 @@ def _variant_sweep_body(measure, cpu_backend, pallas_capable, bf16, OptimizerTyp
         # Keep the CPU baseline the reference-parity configuration (and bf16
         # matmul is emulated/slower on XLA:CPU, risking the parent's timeout).
         return best, info
+    _emit_partial(best, info)
 
     configs = {"lbfgs_f32": (OptimizerType.LBFGS, None)}
 
@@ -182,6 +202,7 @@ def _variant_sweep_body(measure, cpu_backend, pallas_capable, bf16, OptimizerTyp
         except Exception as e:  # variants are optimizations, never failure modes
             info[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
             print(f"{name} variant failed: {e}", file=sys.stderr)
+        _emit_partial(best, info)
 
     try_variant("newton_f32", OptimizerType.NEWTON, None)
     try_variant("newton_bf16", OptimizerType.NEWTON, bf16)
@@ -284,6 +305,27 @@ def _spawn_child(extra_env, timeout_s, extra_args=()):
 
     env = dict(os.environ)
     env.update(extra_env)
+    def _last_json_with(key, text):
+        for line in reversed((text or "").strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if key in rec:
+                return rec
+        return None
+
+    def _salvage(stderr_text):
+        # the child flushes a partial JSON line to stderr after each completed
+        # variant: a tunnel wedge mid-sweep (hang OR fatal PJRT error) still
+        # banks the variants measured so far
+        partial = _last_json_with("partial_value", stderr_text)
+        if partial is None:
+            return None
+        value = partial.pop("partial_value")
+        partial["incomplete_sweep"] = True
+        return value, {"child_value": value, **partial}
+
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", *extra_args],
@@ -292,18 +334,23 @@ def _spawn_child(extra_env, timeout_s, extra_args=()):
             timeout=timeout_s,
             env=env,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        err = e.stderr
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        salvaged = _salvage(err)
+        if salvaged is not None:
+            return salvaged
         return None, f"timeout after {timeout_s}s (backend init or run hung)"
     if proc.returncode != 0:
+        salvaged = _salvage(proc.stderr)
+        if salvaged is not None:
+            return salvaged
         tail = (proc.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
         return None, f"rc={proc.returncode}: {tail[0][:300]}"
-    for line in reversed((proc.stdout or "").strip().splitlines()):
-        try:
-            rec = json.loads(line)
-            if "child_value" in rec:
-                return rec["child_value"], rec
-        except json.JSONDecodeError:
-            continue
+    rec = _last_json_with("child_value", proc.stdout)
+    if rec is not None:
+        return rec["child_value"], rec
     return None, "child emitted no JSON result line"
 
 

@@ -169,3 +169,97 @@ def test_main_nulls_vs_baseline_on_cpu_fallback(monkeypatch):
     assert out["vs_baseline"] is None
     assert out["baseline_platform"] == "cpu"
     assert out["cpu_value_vs_recorded_cpu_baseline"] > 0
+
+
+def test_sweep_emits_partials_on_accelerator(capsys):
+    """Each completed variant flushes a partial JSON line (the salvage data a
+    mid-sweep tunnel wedge leaves behind); the CPU path emits none."""
+    import json
+
+    table = {
+        (OptimizerType.LBFGS, None): (1000.0, 100.0),
+        (OptimizerType.NEWTON, None): (1500.0, 100.0),
+        (OptimizerType.NEWTON, BF16): (1400.0, 100.0),
+    }
+    bench.run_variant_sweep(
+        make_measure(table), cpu_backend=False, pallas_capable=False, bf16=BF16
+    )
+    partials = [
+        json.loads(l)
+        for l in capsys.readouterr().err.strip().splitlines()
+        if "partial_value" in l
+    ]
+    assert len(partials) == 3  # anchor + newton_f32 + newton_bf16
+    assert partials[0]["variant"] == "lbfgs_f32"
+    assert partials[-1]["partial_value"] == 1500.0
+    assert partials[-1]["variant"] == "newton_f32"
+
+    captured = capsys.readouterr()
+    bench.run_variant_sweep(
+        make_measure(table), cpu_backend=True, pallas_capable=False, bf16=BF16
+    )
+    captured = capsys.readouterr()
+    assert "partial_value" not in captured.err
+    assert "partial_value" not in captured.out  # stdout contract: final line only
+
+
+def test_spawn_child_salvages_partials_on_timeout(monkeypatch):
+    """A child killed mid-sweep still returns the best-so-far measurement,
+    flagged incomplete, instead of losing the whole TPU window."""
+    import json
+    import subprocess
+
+    partial_out = "\n".join([
+        "garbage line",
+        json.dumps({"partial_value": 400000.0, "platform": "tpu",
+                    "variant": "lbfgs_f32", "lbfgs_f32_samples_per_sec": 400000.0}),
+        json.dumps({"partial_value": 520000.0, "platform": "tpu",
+                    "variant": "newton_f32", "newton_f32_samples_per_sec": 520000.0}),
+    ])
+
+    def fake_run(*a, **k):
+        raise subprocess.TimeoutExpired(
+            cmd=a[0], timeout=5, output="", stderr=partial_out
+        )
+
+    import subprocess as sp
+    monkeypatch.setattr(sp, "run", fake_run)
+    value, rec = bench._spawn_child({}, timeout_s=5)
+    assert value == 520000.0
+    assert rec["incomplete_sweep"] is True
+    assert rec["variant"] == "newton_f32"
+    assert rec["platform"] == "tpu"
+
+
+def test_spawn_child_timeout_without_partials(monkeypatch):
+    import subprocess as sp
+
+    def fake_run(*a, **k):
+        raise sp.TimeoutExpired(cmd=a[0], timeout=5, output=None)
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    value, err = bench._spawn_child({}, timeout_s=5)
+    assert value is None and "timeout" in err
+
+
+def test_spawn_child_salvages_partials_on_fatal_error(monkeypatch):
+    """A wedge often surfaces as a fatal PJRT error (rc != 0), not a hang —
+    partials must be salvaged there too instead of falling back to CPU."""
+    import json
+    import subprocess as sp
+    import types
+
+    partial = json.dumps({"partial_value": 430000.0, "platform": "tpu",
+                          "variant": "lbfgs_f32"})
+
+    def fake_run(*a, **k):
+        return types.SimpleNamespace(
+            returncode=134,  # SIGABRT
+            stdout="",
+            stderr=partial + "\nF0000 fatal: PJRT stream executor died\n",
+        )
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    value, rec = bench._spawn_child({}, timeout_s=5)
+    assert value == 430000.0
+    assert rec["incomplete_sweep"] is True and rec["platform"] == "tpu"
