@@ -74,22 +74,38 @@ def _a1a_like(rng, n_train=1605, n_test=30956, d=123, nnz_per_row=14):
 
 
 class _GlmixTruth:
-    """One fixed ground-truth GLMix model; train/validation draws share it."""
+    """One fixed ground-truth GLMix model; train/validation draws share it.
 
-    def __init__(self, rng, n_users, n_items, d=64):
+    The truth is genuinely mixed-effects: per-entity biases AND per-entity
+    coefficients on a few covariates. Without the latter, the random-effect
+    covariate dimensions would have true weight zero for every entity — a pure
+    overfitting surface where training the REs can only HURT validation, which
+    degenerates the benchmark into selecting a fixed-effect-only snapshot."""
+
+    def __init__(self, rng, n_users, n_items, d=64, k_re=3):
         self.rng = rng
         self.d = d
+        self.k_re = k_re
         self.n_users, self.n_items = n_users, n_items
         self.w = rng.normal(size=d) * 0.3
-        self.u_eff = 0.4 * rng.normal(size=n_users)
-        self.i_eff = 0.4 * rng.normal(size=n_items)
+        self.u_eff = 0.6 * rng.normal(size=n_users)
+        self.i_eff = 0.6 * rng.normal(size=n_items)
+        self.u_coef = 0.3 * rng.normal(size=(n_users, k_re))
+        self.i_coef = 0.3 * rng.normal(size=(n_items, k_re))
 
     def draw(self, n):
         rng = self.rng
+        k = self.k_re
         X = rng.normal(size=(n, self.d)).astype(np.float32)
         users = rng.integers(0, self.n_users, size=n)
         items = rng.integers(0, self.n_items, size=n)
-        z = X @ self.w + self.u_eff[users] + self.i_eff[items]
+        z = (
+            X @ self.w
+            + self.u_eff[users]
+            + self.i_eff[items]
+            + np.sum(X[:, :k] * self.u_coef[users], axis=1)
+            + np.sum(X[:, k : 2 * k] * self.i_coef[items], axis=1)
+        )
         y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(float)
         return X, users, items, y
 
@@ -261,12 +277,26 @@ def config3_glmix_movielens_like(scale=1.0):
     )
     from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
 
+    import scipy.sparse as sp
+
     rng = np.random.default_rng(20)
     n = int(100_000 * scale)
     n_users, n_items = int(2_000 * scale), int(500 * scale)
     truth = _GlmixTruth(rng, n_users, n_items)
     X, users, items, y = truth.draw(n)
     Xv, uv, iv, yv = truth.draw(n // 4)
+
+    # Random effects see a SMALL shard (intercept + a few covariates), the
+    # realistic GLMix shape (per-entity bias + limited interactions — the
+    # reference's per-member models are narrow) and the flagship bench's
+    # workload. Giving entities the full 64-dim shard lets ~50-sample
+    # per-entity solves overfit until training the REs HURTS validation AUC,
+    # which degenerates the benchmark into measuring a fixed-effect-only
+    # snapshot.
+    def re_shard(M):
+        return sp.csr_matrix(
+            np.concatenate([np.ones((M.shape[0], 1), np.float32), M[:, :7]], axis=1)
+        )
 
     def cfg(iters):
         return GLMOptimizationConfiguration(
@@ -284,10 +314,10 @@ def config3_glmix_movielens_like(scale=1.0):
                 FixedEffectDataConfiguration("global"), cfg(50)
             ),
             "per-user": CoordinateConfiguration(
-                RandomEffectDataConfiguration("userId", "global"), cfg(30)
+                RandomEffectDataConfiguration("userId", "re"), cfg(30)
             ),
             "per-item": CoordinateConfiguration(
-                RandomEffectDataConfiguration("itemId", "global"), cfg(30)
+                RandomEffectDataConfiguration("itemId", "re"), cfg(30)
             ),
         },
         n_iterations=2,
@@ -295,11 +325,11 @@ def config3_glmix_movielens_like(scale=1.0):
         dtype=jnp.float32,
     )
     train = GameInput(
-        features={"global": X}, labels=y,
+        features={"global": X, "re": re_shard(X)}, labels=y,
         id_columns={"userId": users, "itemId": items},
     )
     val = GameInput(
-        features={"global": Xv}, labels=yv,
+        features={"global": Xv, "re": re_shard(Xv)}, labels=yv,
         id_columns={"userId": uv, "itemId": iv},
     )
     est.fit(train, validation_data=val)  # untimed compile warm-up
@@ -307,7 +337,7 @@ def config3_glmix_movielens_like(scale=1.0):
     results = est.fit(train, validation_data=val)
     best = est.select_best_model(results)
     wall = time.perf_counter() - t0
-    return {
+    rec = {
         "metric": "glmix_movielens_like_wall_clock_to_auc",
         "value": round(wall, 3),
         "unit": "seconds",
@@ -315,6 +345,24 @@ def config3_glmix_movielens_like(scale=1.0):
         "samples": n,
         "samples_per_sec": round(2 * n / wall, 1),
     }
+
+    # Same configuration through the fused single-jit pass (the program
+    # bench.py measures, exposed via GameEstimator(fused_pass=True)): one
+    # dispatch per CD pass instead of one per coordinate update. Reported
+    # alongside — `value` stays the host loop for baseline comparability.
+    import dataclasses as _dc
+
+    fused_est = _dc.replace(est, fused_pass=True)
+    fused_est.fit(train, validation_data=val)  # untimed compile warm-up
+    t0 = time.perf_counter()
+    fused_best = fused_est.select_best_model(
+        fused_est.fit(train, validation_data=val)
+    )
+    fused_wall = time.perf_counter() - t0
+    rec["fused_wall_clock"] = round(fused_wall, 3)
+    rec["fused_auc"] = round(float(fused_best.best_metric), 5)
+    rec["fused_samples_per_sec"] = round(2 * n / fused_wall, 1)
+    return rec
 
 
 def config4_svm_warm_start():

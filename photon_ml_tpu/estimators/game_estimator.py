@@ -128,6 +128,13 @@ class GameEstimator:
     # (DenseDesignMatrix._mxu_dot). Validate quality before relying on it —
     # bench.py gates its bf16 variant on 1% objective parity.
     fe_storage_dtype: Optional[object] = None
+    # Run each coordinate-descent pass as ONE jitted SPMD program
+    # (parallel/game.py — the program bench.py measures) instead of the host
+    # loop's one-dispatch-per-coordinate-update. Eligible configurations only
+    # (estimators/fused_backend.py lists the conditions and raises with
+    # reasons otherwise); validation/best-model tracking happens per PASS,
+    # not per coordinate update.
+    fused_pass: bool = False
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -329,6 +336,8 @@ class GameEstimator:
             raise ValueError("partial retrain requires initial_model")
 
         datasets = self.prepare_training_datasets(data)
+        if self.fused_pass:
+            return self._fit_fused(datasets, validation_data, initial_model)
         base_offsets = jnp.asarray(np.asarray(data.offsets), dtype=self.dtype)
         if self.mesh is not None:
             from photon_ml_tpu.parallel.placement import (
@@ -421,6 +430,88 @@ class GameEstimator:
                 )
             )
             warm = descent.best_model  # chain warm starts across the sweep
+        return results
+
+    def _fit_fused(
+        self,
+        datasets: dict[str, object],
+        validation_data: Optional[GameInput],
+        initial_model: Optional[GameModel],
+    ) -> list[GameResult]:
+        """Sweep through the single-jit fused pass (estimators/fused_backend.py).
+
+        Warm starts chain across sweep configurations as device params (the
+        datasets are identical across configurations, so the previous
+        configuration's final parameters are the next one's starting point —
+        the same strong-to-weak regularization chaining as the host loop)."""
+        from photon_ml_tpu.estimators.fused_backend import (
+            fused_pass_ineligibilities,
+            run_fused_game_descent,
+        )
+
+        if initial_model is not None:
+            raise ValueError(
+                "fused_pass does not support initial_model; use the host backend"
+            )
+        sweep = expand_game_configurations(self.coordinate_configurations)
+        for opt_configs in sweep:
+            reasons = fused_pass_ineligibilities(self, opt_configs)
+            if reasons:
+                raise ValueError(
+                    "configuration not eligible for the fused pass: "
+                    + "; ".join(reasons)
+                    + " (set fused_pass=False for the host backend)"
+                )
+
+        validation_datasets = None
+        suite = None
+        if validation_data is not None:
+            validation_datasets = self.prepare_scoring_datasets(validation_data)
+            suite = self.prepare_evaluation_suite(validation_data)
+
+        # the ShardedGameData is identical across sweep configurations: pad
+        # and device-transfer it ONCE, not once per configuration
+        from photon_ml_tpu.parallel import build_sharded_game_data, make_mesh
+
+        coord_ids = list(self.coordinate_configurations)
+        fe_ds = datasets[coord_ids[0]]
+        mesh = self.mesh if self.mesh is not None else make_mesh(1)
+        sharded = build_sharded_game_data(
+            fe_ds.data.X,
+            np.asarray(fe_ds.data.labels),
+            [datasets[c] for c in coord_ids[1:]],
+            mesh,
+            offsets=np.asarray(fe_ds.data.offsets),
+            weights=np.asarray(fe_ds.data.weights),
+            dtype=self.dtype,
+            fe_storage_dtype=self.fe_storage_dtype,
+        )
+
+        logger.info(
+            "GAME fused-pass sweep: %d configurations x %d coordinates",
+            len(sweep),
+            len(self.coordinate_configurations),
+        )
+        results: list[GameResult] = []
+        warm_params = None
+        for opt_configs in sweep:
+            descent, warm_params = run_fused_game_descent(
+                self, datasets, opt_configs, validation_datasets, suite,
+                sharded, mesh, warm_params,
+            )
+            evaluations = None
+            if suite is not None and (descent.metrics_history or descent.best_metrics):
+                evaluations = _metrics_of_best(descent)
+            results.append(
+                GameResult(
+                    model=descent.model,
+                    best_model=descent.best_model,
+                    configuration=opt_configs,
+                    evaluations=evaluations,
+                    best_metric=descent.best_metric,
+                    descent=descent,
+                )
+            )
         return results
 
     def select_best_model(self, results: Sequence[GameResult]) -> GameResult:

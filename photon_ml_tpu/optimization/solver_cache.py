@@ -273,9 +273,21 @@ def shard_mapped_glm_solver(
     return jax.jit(solve)
 
 
+_extra_caches: list = []
+
+
+def register_cache(cache_clear) -> None:
+    """Register another module's trace cache to be dropped by clear() — e.g.
+    the fused-pass step cache, whose traced programs also bake in the
+    trace-time Pallas fuse decision that enable_pallas() invalidates."""
+    _extra_caches.append(cache_clear)
+
+
 def clear():
     """Drop all cached solvers (tests / long-running sweeps with many configs)."""
     glm_solver.cache_clear()
     re_bucket_solver.cache_clear()
     sharded_glm_solver.cache_clear()
     shard_mapped_glm_solver.cache_clear()
+    for cache_clear in _extra_caches:
+        cache_clear()

@@ -1,0 +1,184 @@
+"""GameEstimator(fused_pass=True): the flagship single-jit pass through the
+user-facing API — must match the host backend's models/metrics on eligible
+configurations and refuse ineligible ones with reasons."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.estimators import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    GameEstimator,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.evaluation import EvaluatorType
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.types import RegularizationType, TaskType
+
+OPT = GLMOptimizationConfiguration(
+    optimizer_config=OptimizerConfig(max_iterations=60, tolerance=1e-9),
+    regularization_context=RegularizationContext(RegularizationType.L2),
+    regularization_weight=1.0,
+)
+
+
+def make_input(rng, n=600, d=5, n_users=9, n_items=4):
+    w = rng.normal(size=d)
+    bias_u = rng.normal(size=n_users)
+    bias_i = rng.normal(size=n_items)
+    X = rng.normal(size=(n, d))
+    users = np.arange(n) % n_users
+    items = (np.arange(n) // 3) % n_items
+    z = X @ w + bias_u[users] + bias_i[items]
+    y = (z + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    return GameInput(
+        features={
+            "global": X,
+            "re": sp.csr_matrix(np.ones((n, 1))),
+        },
+        labels=y,
+        id_columns={
+            "userId": np.asarray([f"u{u}" for u in users], dtype=object),
+            "itemId": np.asarray([f"i{i}" for i in items], dtype=object),
+        },
+    )
+
+
+def make_configs(reg_weights=()):
+    return {
+        "fixed": CoordinateConfiguration(
+            data_config=FixedEffectDataConfiguration("global"),
+            optimization_config=OPT,
+            reg_weights=reg_weights,
+        ),
+        "per-user": CoordinateConfiguration(
+            data_config=RandomEffectDataConfiguration("userId", "re"),
+            optimization_config=OPT,
+        ),
+        "per-item": CoordinateConfiguration(
+            data_config=RandomEffectDataConfiguration("itemId", "re"),
+            optimization_config=OPT,
+        ),
+    }
+
+
+def _est(fused, **kw):
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=kw.pop("configs", make_configs()),
+        n_iterations=kw.pop("n_iterations", 2),
+        fused_pass=fused,
+        **kw,
+    )
+
+
+def test_fused_matches_host_backend(rng):
+    data = make_input(rng)
+    host = _est(False).fit(data)[0].model
+    fused = _est(True).fit(data)[0].model
+
+    h_fe = np.asarray(host.get_model("fixed").model.coefficients.means)
+    f_fe = np.asarray(fused.get_model("fixed").model.coefficients.means)
+    np.testing.assert_allclose(f_fe, h_fe, atol=2e-4)
+
+    for cid in ("per-user", "per-item"):
+        h = host.get_model(cid)
+        f = fused.get_model(cid)
+        assert tuple(f.entity_ids) == tuple(h.entity_ids)
+        np.testing.assert_allclose(
+            np.asarray(f.coeffs), np.asarray(h.coeffs), atol=2e-4
+        )
+
+
+def test_fused_validation_tracks_best_per_pass(rng):
+    data = make_input(rng)
+    train, val = data.select(np.arange(0, 450)), data.select(np.arange(450, 600))
+    res = _est(True, validation_evaluators=[EvaluatorType.AUC]).fit(
+        train, validation_data=val
+    )[0]
+    assert res.best_metric is not None and res.best_metric > 0.75
+    assert res.evaluations is not None and "AUC" in res.evaluations
+    # one metrics row per PASS (fused-pass granularity)
+    assert len(res.descent.metrics_history) == 2
+
+    host = _est(False, validation_evaluators=[EvaluatorType.AUC]).fit(
+        train, validation_data=val
+    )[0]
+    assert res.best_metric == pytest.approx(host.best_metric, abs=0.02)
+
+
+def test_fused_reg_weight_sweep_chains(rng):
+    data = make_input(rng)
+    results = _est(True, configs=make_configs(reg_weights=(10.0, 0.5))).fit(data)
+    assert len(results) == 2
+    assert [r.configuration["fixed"].regularization_weight for r in results] == [10.0, 0.5]
+    w10 = np.asarray(results[0].model.get_model("fixed").model.coefficients.means)
+    w05 = np.asarray(results[1].model.get_model("fixed").model.coefficients.means)
+    assert np.linalg.norm(w05) > np.linalg.norm(w10)  # weaker reg, larger optimum
+
+
+def test_fused_scores_match_host_transformer(rng):
+    from photon_ml_tpu.transformers import GameTransformer
+
+    data = make_input(rng)
+    model = _est(True).fit(data)[0].model
+    scores = GameTransformer(model=model).score(data, include_offsets=False)
+    assert scores.shape == (600,)
+    assert np.isfinite(scores).all()
+    # trained scores separate the labels
+    auc_num = (scores[data.labels > 0][:, None] > scores[data.labels == 0][None, :]).mean()
+    assert auc_num > 0.8
+
+
+def test_fused_rejects_ineligible_with_reasons(rng):
+    data = make_input(rng)
+    cfgs = make_configs()
+    cfgs["fixed"] = CoordinateConfiguration(
+        data_config=FixedEffectDataConfiguration("global"),
+        optimization_config=OPT,
+        down_sampling_rate=0.5,
+        box_constraints=(np.full(5, -1.0), np.full(5, 1.0)),
+    )
+    with pytest.raises(ValueError, match="down-sampling.*box constraints"):
+        _est(True, configs=cfgs).fit(data)
+
+    elastic = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(max_iterations=10),
+        regularization_context=RegularizationContext(
+            RegularizationType.ELASTIC_NET, elastic_net_alpha=0.5
+        ),
+        regularization_weight=1.0,
+    )
+    cfgs2 = make_configs()
+    cfgs2["per-user"] = CoordinateConfiguration(
+        data_config=RandomEffectDataConfiguration("userId", "re"),
+        optimization_config=elastic,
+    )
+    with pytest.raises(ValueError, match="NONE/L2"):
+        _est(True, configs=cfgs2).fit(data)
+
+    model = _est(True).fit(data)[0].model
+    with pytest.raises(ValueError, match="initial_model"):
+        _est(True).fit(data, initial_model=model)
+
+
+def test_fused_requires_fixed_effect_first(rng):
+    data = make_input(rng)
+    cfgs = {
+        "per-user": CoordinateConfiguration(
+            data_config=RandomEffectDataConfiguration("userId", "re"),
+            optimization_config=OPT,
+        ),
+        "fixed": CoordinateConfiguration(
+            data_config=FixedEffectDataConfiguration("global"),
+            optimization_config=OPT,
+        ),
+    }
+    with pytest.raises(ValueError, match="first coordinate"):
+        _est(True, configs=cfgs).fit(data)
