@@ -114,12 +114,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help='JSON array of {"name","term","lowerBound","upperBound"} '
                         "maps; wildcard '*' in term (or name+term) supported. "
                         "Applies to fixed-effect coordinates.")
-    p.add_argument("--compute-backend", default="host", choices=["host", "mesh"],
+    p.add_argument("--compute-backend", default="host",
+                   choices=["host", "mesh", "fused"],
                    help="'mesh' places datasets/models over a jax.sharding.Mesh "
                         "so the coordinate-descent pass runs as sharded SPMD "
-                        "programs (the reference's distributed path)")
+                        "programs (the reference's distributed path); 'fused' "
+                        "runs each coordinate-descent pass as ONE jitted SPMD "
+                        "program (eligible configurations only — L2, no "
+                        "normalization/constraints/down-sampling; validation "
+                        "tracked per pass), optionally over --mesh-devices")
     p.add_argument("--mesh-devices", type=int, default=None,
-                   help="Device count for --compute-backend=mesh (default: all)")
+                   help="Device count for --compute-backend=mesh/fused "
+                        "(default: all)")
     p.add_argument("--distributed-coordinator", default=None,
                    help="host:port of process 0 for multi-host training "
                         "(jax.distributed), or 'auto' for orchestrated TPU pod "
@@ -417,7 +423,8 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             fe_storage_dtype = jnp.bfloat16
 
         mesh = None
-        if getattr(args, "compute_backend", "host") == "mesh":
+        backend = getattr(args, "compute_backend", "host")
+        if backend == "mesh":
             n_model = getattr(args, "mesh_model_devices", 1) or 1
             if n_model > 1:
                 import jax
@@ -436,6 +443,24 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
 
                 mesh = make_mesh(args.mesh_devices)
 
+        if backend == "fused":
+            n_model = getattr(args, "mesh_model_devices", 1) or 1
+            if n_model > 1:
+                # build the 2-D mesh so the fused eligibility check rejects it
+                # with its own reason instead of silently dropping the
+                # feature-axis sharding
+                import jax
+
+                from photon_ml_tpu.parallel import make_mesh2
+
+                total = args.mesh_devices or len(jax.devices())
+                mesh = make_mesh2(total // n_model, n_model)
+            else:
+                from photon_ml_tpu.parallel.mesh import make_mesh
+
+                # default all devices, same as --compute-backend=mesh
+                mesh = make_mesh(args.mesh_devices)
+
         estimator = GameEstimator(
             task=task,
             coordinate_configurations=coord_configs,
@@ -448,6 +473,7 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             checkpoint_directory=args.checkpoint_directory,
             checkpoint_interval=args.checkpoint_interval,
             fe_storage_dtype=fe_storage_dtype,
+            fused_pass=backend == "fused",
         )
 
         emitter.send_event(Event("TrainingStartEvent"))

@@ -105,7 +105,12 @@ def _fused_step(task, fe_config, re_configs: tuple, mesh):
     make_jitted_game_step, which bakes single-device data in as constants):
     estimator fits repeat — warm-up + timed runs, sweeps, notebooks — and
     with argument-form data every fit after the first is a jit-cache hit
-    instead of a full retrace of the pass. Registered with
+    instead of a full retrace of the pass.
+
+    Regularization weights are traced arguments too, and the cache key uses
+    the WEIGHT-STRIPPED configs (``with_weight(0.0)``): a reg-weight sweep or
+    a Bayesian tuning run reuses ONE compiled pass across every candidate —
+    the same reuse surface solver_cache gives the host loop. Registered with
     solver_cache.clear() because the traced program bakes in the trace-time
     Pallas fuse decision."""
     from photon_ml_tpu.parallel.game import game_train_step
@@ -114,10 +119,11 @@ def _fused_step(task, fe_config, re_configs: tuple, mesh):
     shard_mesh = mesh if mesh.devices.size > 1 else None
 
     @functools.partial(jax.jit, donate_argnums=(1,))
-    def _step(d, params):
+    def _step(d, params, fe_l2, re_l2):
         return game_train_step(
             d, params, task, fe_config, re_configs,
             fuse_fe=fuse_fe, shard_mesh=shard_mesh,
+            fe_l2=fe_l2, re_l2=re_l2,
         )
 
     return _step
@@ -164,10 +170,16 @@ def run_fused_game_descent(
     re_ds: list[RandomEffectDataset] = [datasets[c] for c in re_cids]
     task = TaskType(estimator.task)
 
+    dtype = data.labels.dtype
     cached = _fused_step(
-        task, opt_configs[fe_cid], tuple(opt_configs[c] for c in re_cids), mesh
+        task,
+        opt_configs[fe_cid].with_weight(0.0),
+        tuple(opt_configs[c].with_weight(0.0) for c in re_cids),
+        mesh,
     )
-    step = lambda p: cached(data, p)  # noqa: E731
+    fe_l2 = jnp.asarray(opt_configs[fe_cid].l2_weight, dtype=dtype)
+    re_l2 = tuple(jnp.asarray(opt_configs[c].l2_weight, dtype=dtype) for c in re_cids)
+    step = lambda p: cached(data, p, fe_l2, re_l2)  # noqa: E731
     params = warm_params if warm_params is not None else init_game_params(data, mesh)
 
     validate = evaluation_suite is not None

@@ -202,8 +202,16 @@ def game_train_step(
     re_configs: Sequence[GLMOptimizationConfiguration],
     fuse_fe: bool = False,
     shard_mesh=None,
+    fe_l2=None,
+    re_l2=None,
 ) -> tuple[dict, dict]:
     """One pure (jittable) coordinate-descent pass over [fixed, re_0, re_1, ...].
+
+    ``fe_l2``/``re_l2`` (scalar / sequence of scalars) override the configs'
+    L2 weights as TRACED values: a caller sweeping regularization weights can
+    then reuse one compiled program across the whole sweep
+    (estimators/fused_backend.py) instead of baking each weight in as a
+    trace-time constant.
 
     Returns (new params, diagnostics {fe_value, fe_iterations, total_scores}).
     """
@@ -220,6 +228,13 @@ def game_train_step(
     fe_coef = params["fixed"]
     re_coeffs = list(params["re"])
     dtype = fe_coef.dtype
+    fe_l2 = jnp.asarray(
+        fe_config.l2_weight if fe_l2 is None else fe_l2, dtype=dtype
+    )
+    re_l2 = [
+        jnp.asarray(cfg.l2_weight if re_l2 is None else re_l2[i], dtype=dtype)
+        for i, cfg in enumerate(re_configs)
+    ]
 
     fe_score = data.fe_X.matvec(fe_coef)
     re_scores = [_re_score(rc, w) for rc, w in zip(data.re, re_coeffs)]
@@ -256,7 +271,7 @@ def game_train_step(
         fe_res = fe_solve_sm(
             d,
             fe_coef,
-            jnp.asarray(fe_config.l2_weight, dtype=dtype),
+            fe_l2,
             jnp.asarray(fe_config.l1_weight or 0.0, dtype=dtype),
         )
     else:
@@ -267,7 +282,7 @@ def game_train_step(
         fe_res, _ = fe_solve(
             d,
             fe_coef,
-            jnp.asarray(fe_config.l2_weight, dtype=dtype),
+            fe_l2,
             jnp.asarray(fe_config.l1_weight or 0.0, dtype=dtype),
             empty,
             empty,
@@ -293,7 +308,7 @@ def game_train_step(
                 b.weights,
                 off_b,
                 w0_b,
-                jnp.full((b.entity_rows.shape[0],), cfg.l2_weight, dtype=dtype),
+                jnp.full((b.entity_rows.shape[0],), 1.0, dtype=dtype) * re_l2[i],
                 jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
             )
             coeffs = coeffs.at[b.entity_rows, :K].set(w_b)

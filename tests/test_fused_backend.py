@@ -114,6 +114,9 @@ def test_fused_validation_tracks_best_per_pass(rng):
 
 
 def test_fused_reg_weight_sweep_chains(rng):
+    from photon_ml_tpu.estimators import fused_backend
+
+    fused_backend._fused_step.cache_clear()
     data = make_input(rng)
     results = _est(True, configs=make_configs(reg_weights=(10.0, 0.5))).fit(data)
     assert len(results) == 2
@@ -121,6 +124,8 @@ def test_fused_reg_weight_sweep_chains(rng):
     w10 = np.asarray(results[0].model.get_model("fixed").model.coefficients.means)
     w05 = np.asarray(results[1].model.get_model("fixed").model.coefficients.means)
     assert np.linalg.norm(w05) > np.linalg.norm(w10)  # weaker reg, larger optimum
+    # weights are traced arguments: the whole sweep shares ONE cached program
+    assert fused_backend._fused_step.cache_info().currsize == 1
 
 
 def test_fused_scores_match_host_transformer(rng):
@@ -182,3 +187,57 @@ def test_fused_requires_fixed_effect_first(rng):
     }
     with pytest.raises(ValueError, match="first coordinate"):
         _est(True, configs=cfgs).fit(data)
+
+
+def test_training_driver_fused_backend_cli(rng, tmp_path):
+    """--compute-backend fused trains the GLMix end to end through the CLI
+    driver on an 8-device CPU mesh and writes the standard model layout."""
+    from photon_ml_tpu.data import avro_io
+
+    n, d, n_users = 160, 4, 8
+    X = rng.normal(size=(n, d))
+    users = np.arange(n) % n_users
+    y = ((X @ rng.normal(size=d)) + rng.normal(size=n_users)[users] > 0).astype(float)
+    indir = tmp_path / "in"
+    indir.mkdir()
+
+    def records():
+        for i in range(n):
+            yield {
+                "uid": f"s{i}",
+                "label": float(y[i]),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(X[i, j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {"userId": f"u{users[i]}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    avro_io.write_container(
+        str(indir / "part-0.avro"), avro_io.TRAINING_EXAMPLE_SCHEMA, records()
+    )
+    out = tmp_path / "out"
+    from photon_ml_tpu.cli.game_training_driver import main
+
+    rc = main([
+        "--input-data-directories", str(indir),
+        "--validation-data-directories", str(indir),
+        "--root-output-directory", str(out),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=30,"
+        "tolerance=1e-7,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=global,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=30,tolerance=1e-7,regularization=L2,reg.weights=1.0",
+        "--coordinate-update-sequence", "global,per-user",
+        "--evaluators", "AUC",
+        "--compute-backend", "fused",
+        "--mesh-devices", "8",
+    ])
+    assert rc == 0
+    assert (out / "best" / "fixed-effect").exists()
+    assert (out / "best" / "random-effect" / "per-user").exists()
