@@ -241,3 +241,17 @@ def test_training_driver_fused_backend_cli(rng, tmp_path):
     assert rc == 0
     assert (out / "best" / "fixed-effect").exists()
     assert (out / "best" / "random-effect" / "per-user").exists()
+
+
+def test_fused_with_bf16_storage(rng):
+    """fused_pass composes with bf16 fixed-effect feature storage: same
+    optimum within bf16 rounding."""
+    import jax.numpy as jnp
+
+    data = make_input(rng)
+    f32 = _est(True).fit(data)[0].model
+    bf16 = _est(True, fe_storage_dtype=jnp.bfloat16, dtype=jnp.float32).fit(data)[0].model
+    a = np.asarray(f32.get_model("fixed").model.coefficients.means)
+    b = np.asarray(bf16.get_model("fixed").model.coefficients.means)
+    np.testing.assert_allclose(b, a, atol=5e-2)  # bf16 storage rounding
+    assert np.abs(b - a).mean() < 1e-2
