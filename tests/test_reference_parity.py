@@ -354,3 +354,98 @@ def test_feed_avro_map_fields_parse():
     assert recs[0]["ids"]["activityId"].startswith("urn:li:activity:")
     assert isinstance(recs[0]["labels"], dict)
     assert {f["name"] for f in recs[0]["xgboost_click"]} >= {"featureA", "featureB"}
+
+
+# -------------------------------------- hyperparameter math (reference vectors)
+# The reference ships exact numeric expectations for its Bayesian-tuning math
+# (generated from scikit-learn). These are copied from its test data providers
+# — passing them means the GP machinery here IS the reference's math.
+
+
+def test_expected_improvement_matches_reference_vectors():
+    """ExpectedImprovementTest.scala:32-37 (best candidate 0.0; the reference's
+    'sigma' argument is the predictive VARIANCE)."""
+    from photon_ml_tpu.hyperparameter.criteria import ExpectedImprovement
+
+    ei = ExpectedImprovement(best_evaluation=0.0)
+    np.testing.assert_allclose(
+        ei(np.array([1.0, 2.0, 3.0]), np.array([1.0, 2.0, 3.0])),
+        [0.0833, 0.0503, 0.0292],
+        atol=1e-3,
+    )
+    np.testing.assert_allclose(
+        ei(np.array([-4.0, 5.0, -6.0]), np.array([3.0, 2.0, 1.0])),
+        [4.0062, 0.0000, 6.0000],
+        atol=1e-3,
+    )
+
+
+def test_confidence_bound_matches_reference_vectors():
+    """ConfidenceBoundTest.scala:30-55."""
+    from photon_ml_tpu.hyperparameter.criteria import ConfidenceBound
+
+    cb = ConfidenceBound()
+    np.testing.assert_allclose(
+        cb(np.array([1.0, 2.0, 3.0]), np.array([1.0, 2.0, 3.0])),
+        [-1.0000, -0.8284, -0.4641],
+        atol=1e-3,
+    )
+    np.testing.assert_allclose(
+        cb(np.array([-4.0, 5.0, -6.0]), np.array([3.0, 2.0, 1.0])),
+        [-7.4641, 2.1716, -8.0000],
+        atol=1e-3,
+    )
+
+
+_M52_X1 = np.array([
+    [0.32817291, -0.62739075, -0.15141223],
+    [-0.33697839, -0.49970007, -0.30290632],
+    [-0.49786383, 0.34232845, 0.11775675],
+    [-0.86069848, -0.60832783, 0.13357631],
+])
+_M52_X2 = np.array([
+    [-0.40944433, 0.39704702, -0.48894766],
+    [1.03282411, -1.0380654, 0.65404646],
+    [1.21080337, 0.5587334, 0.59055366],
+    [1.33081, 1.20478412, 0.8560233],
+])
+
+
+def test_matern52_gram_matches_reference_vectors():
+    """Matern52Test.scala kernelSourceProvider (scikit-learn ground truth)."""
+    from photon_ml_tpu.hyperparameter.kernels import Matern52
+
+    k = Matern52(noise=0.0)
+    x = np.array([
+        [1.16629448, 2.06716533, -0.92010277],
+        [0.32491615, -0.50086458, 0.15349931],
+        [-1.29952204, 1.22238724, -0.0238411],
+    ])
+    expected = np.array([
+        [1.0, 0.03239932, 0.04173912],
+        [0.03239932, 1.0, 0.07761498],
+        [0.04173912, 0.07761498, 1.0],
+    ])
+    np.testing.assert_allclose(k.gram(x), expected, atol=1e-7)
+
+    expected2 = np.array([
+        [1.0, 0.71067495, 0.36649838, 0.40439812],
+        [0.71067495, 1.0, 0.55029418, 0.71297005],
+        [0.36649838, 0.55029418, 1.0, 0.51385965],
+        [0.40439812, 0.71297005, 0.51385965, 1.0],
+    ])
+    np.testing.assert_allclose(k.gram(_M52_X1), expected2, atol=1e-7)
+
+
+def test_matern52_cross_matches_reference_vectors():
+    """Matern52Test.scala kernelTwoSourceProvider."""
+    from photon_ml_tpu.hyperparameter.kernels import Matern52
+
+    k = Matern52(noise=0.0)
+    expected = np.array([
+        [0.36431909, 0.44333958, 0.22917335, 0.08481237],
+        [0.57182815, 0.19854279, 0.12340393, 0.04963231],
+        [0.75944682, 0.11384187, 0.19003345, 0.10995123],
+        [0.38353084, 0.13654483, 0.07208932, 0.03096713],
+    ])
+    np.testing.assert_allclose(k.cross(_M52_X1, _M52_X2), expected, atol=1e-7)
