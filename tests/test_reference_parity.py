@@ -449,3 +449,60 @@ def test_matern52_cross_matches_reference_vectors():
         [0.38353084, 0.13654483, 0.07208932, 0.03096713],
     ])
     np.testing.assert_allclose(k.cross(_M52_X1, _M52_X2), expected, atol=1e-7)
+
+
+def test_gaussian_process_posterior_matches_reference_vectors():
+    """GaussianProcessModelTest.scala predictionProvider (scikit-learn ground
+    truth): posterior means and standard deviations of an RBF GP, exact."""
+    from photon_ml_tpu.hyperparameter.estimators import GaussianProcessModel
+    from photon_ml_tpu.hyperparameter.kernels import RBF
+
+    cases = [
+        (
+            [[0.00773725, -0.31298875, 0.27183008],
+             [-0.68440447, -0.8561772, -0.78500855],
+             [-0.02330709, -1.92979733, 0.43287544],
+             [-0.85140297, -1.49877559, -1.63778668]],
+            [-0.34459489, -0.0485107, -1.29375589, 1.11622403],
+            [[-0.31800735, 1.34422005, -1.55408361],
+             [-0.60237846, -1.00816597, -0.09440482],
+             [0.31517342, -1.11984756, -0.9466699],
+             [0.11024813, -1.43619905, 0.67390101]],
+            [-0.01325603, -0.66403465, -0.10878228, -1.10488029],
+            [0.99747502, 0.44726687, 0.79425794, 0.44201904],
+        ),
+        (
+            [[0.69567278, -0.41581942, 0.85500744],
+             [0.98204282, -0.29115782, -0.22831259],
+             [-0.46622083, -0.68199927, -0.09467517],
+             [0.12449017, -0.37616456, -0.27992044]],
+            [-0.11453575, 0.95807664, -0.7181996, -0.29513717],
+            [[1.21362357, 0.18562891, -1.62395987],
+             [-0.75193848, 0.48940236, -0.98794203],
+             [-0.43582962, 1.83947234, 0.0808053],
+             [-0.73004528, -1.83643245, -0.33303083]],
+            [0.46723757, -0.34857392, -0.05126064, -0.24301167],
+            [0.92967279, 0.91067249, 0.99688996, 0.83459746],
+        ),
+        (
+            [[-0.46055067, 0.93364116, -1.09573962],
+             [-1.20787535, 0.33594068, -1.95753059],
+             [-0.84306614, -0.6812687, -0.74283257],
+             [-0.95882761, 0.51132399, -0.13720216]],
+            [-0.98494485, 0.186753, -0.65985498, 0.52334382],
+            [[-1.00757146, 0.78187748, -0.78197457],
+             [1.52226612, 0.43348454, -1.31427541],
+             [0.21296738, -0.77575617, 1.46077293],
+             [0.35616412, -0.01987576, -1.05690365]],
+            [-0.16836956, -0.22862767, 0.04165401, -0.77207482],
+            [0.3791334, 0.99059374, 0.99728549, 0.83955005],
+        ),
+    ]
+    for x_train, y_train, x_test, exp_mean, exp_std in cases:
+        model = GaussianProcessModel(
+            np.asarray(x_train), np.asarray(y_train), 0.0,
+            [RBF(noise=0.0, length_scale=np.array([1.0]))],
+        )
+        mean, var = model.predict(np.asarray(x_test))
+        np.testing.assert_allclose(mean, exp_mean, atol=1e-7)
+        np.testing.assert_allclose(np.sqrt(var), exp_std, atol=1e-7)
