@@ -506,3 +506,58 @@ def test_gaussian_process_posterior_matches_reference_vectors():
         mean, var = model.predict(np.asarray(x_test))
         np.testing.assert_allclose(mean, exp_mean, atol=1e-7)
         np.testing.assert_allclose(np.sqrt(var), exp_std, atol=1e-7)
+
+
+def test_vector_rescaling_matches_reference_vectors():
+    """VectorRescalingTest.scala: LOG/SQRT transforms and discrete-adjusted
+    range scaling, exact expectations."""
+    from photon_ml_tpu.hyperparameter.rescaling import (
+        scale_backward,
+        scale_forward,
+        transform_backward,
+        transform_forward,
+    )
+
+    tmap = {0: "LOG", 1: "LOG", 3: "SQRT"}
+    np.testing.assert_allclose(
+        transform_forward(np.array([1000.0, 0.001, 8.0, 4.0]), tmap),
+        [3.0, -3.0, 8.0, 2.0],
+    )
+    np.testing.assert_allclose(
+        transform_backward(np.array([3.0, -3.0, 8.0, 2.0]), tmap),
+        [1000.0, 0.001, 8.0, 4.0],
+    )
+    ranges = [(4.0, 11.0), (0.01, 0.99), (-2.0, 2.0), (-3.0, 3.0)]
+    np.testing.assert_allclose(
+        scale_forward(np.array([5.0, 0.5, -1.0, 10.23]), ranges, {0}),
+        [0.125, 0.5, 0.25, 2.205],
+    )
+    np.testing.assert_allclose(
+        scale_backward(np.array([0.125, 0.5, 0.25, 2.205]), ranges, {0}),
+        [5.0, 0.5, -1.0, 10.23],
+    )
+
+
+def test_rbf_gram_matches_reference_vectors():
+    """RBFTest.scala kernelSourceProvider (scikit-learn ground truth)."""
+    from photon_ml_tpu.hyperparameter.kernels import RBF
+
+    k = RBF(noise=0.0)
+    x = np.array([
+        [1.16629448, 2.06716533, -0.92010277],
+        [0.32491615, -0.50086458, 0.15349931],
+        [-1.29952204, 1.22238724, -0.0238411],
+    ])
+    expected = np.array([
+        [1.0, 0.01458651, 0.02240227],
+        [0.01458651, 1.0, 0.05961054],
+        [0.02240227, 0.05961054, 1.0],
+    ])
+    np.testing.assert_allclose(k.gram(x), expected, atol=1e-7)
+    expected2 = np.array([
+        [1.0, 0.78596674, 0.42845397, 0.47354965],
+        [0.78596674, 1.0, 0.63386024, 0.78796634],
+        [0.42845397, 0.63386024, 1.0, 0.59581605],
+        [0.47354965, 0.78796634, 0.59581605, 1.0],
+    ])
+    np.testing.assert_allclose(k.gram(_M52_X1), expected2, atol=1e-7)
