@@ -561,3 +561,23 @@ def test_rbf_gram_matches_reference_vectors():
         [0.47354965, 0.78796634, 0.59581605, 1.0],
     ])
     np.testing.assert_allclose(k.gram(_M52_X1), expected2, atol=1e-7)
+
+
+def test_pearson_scores_match_reference_vectors():
+    """LocalDatasetTest.scala testPearsonCorrelationScore: the per-feature
+    scores the RE feature filter ranks by, including the all-zero-column ->
+    1.0 convention (intercept pass-through)."""
+    from photon_ml_tpu.data.random_effect import _pearson_scores
+
+    X = sp.csr_matrix(np.array([
+        [0.0, 0.0, 2.0],
+        [5.0, 0.0, -3.0],
+        [7.0, 0.0, -8.0],
+        [0.0, 0.0, -1.0],
+    ]))
+    y = np.array([1.0, 4.0, 6.0, 9.0])
+    np.testing.assert_allclose(
+        _pearson_scores(X, np.array([0, 1, 2]), y),
+        [0.05564149, 1.0, 0.40047142],  # |corr|; filter ranks by magnitude
+        atol=1e-8,
+    )
