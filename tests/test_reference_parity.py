@@ -581,3 +581,52 @@ def test_pearson_scores_match_reference_vectors():
         [0.05564149, 1.0, 0.40047142],  # |corr|; filter ranks by magnitude
         atol=1e-8,
     )
+
+
+def test_lbfgsb_bounds_match_reference_vectors():
+    """LBFGSBTest.scala dataProvider: minimize (x - 4)^2 (TestObjective,
+    CENTROID = 4.0) under each box; the constrained optimum and value must be
+    exact."""
+    from photon_ml_tpu.optimization.lbfgsb import minimize_lbfgsb
+
+    def vg(x):
+        d = x - 4.0
+        return jnp.sum(d * d), 2.0 * d
+
+    cases = [
+        (-10.0, 10.0, 4.0, 0.0),
+        (-5.0, 5.0, 4.0, 0.0),
+        (-10.0, 3.0, 3.0, 1.0),
+        (5.0, 10.0, 5.0, 1.0),
+    ]
+    for lo, hi, x_exp, f_exp in cases:
+        res = minimize_lbfgsb(
+            vg, jnp.asarray([(lo + hi) / 2.0]), jnp.asarray([lo]), jnp.asarray([hi]),
+            tolerance=1e-10,
+        )
+        assert float(res.coefficients[0]) == pytest.approx(x_exp, abs=1e-6)
+        assert float(res.value) == pytest.approx(f_exp, abs=1e-6)
+
+
+def test_owlqn_shrinkage_matches_reference_vectors():
+    """OWLQNTest.scala dataProvider: minimize sum_i (x_i - 4)^2 + w * ||x||_1;
+    the shrunk optima (3.5, 3.0, hard zero at w=8) and objective values are
+    analytic and must be hit exactly."""
+    from photon_ml_tpu.optimization.owlqn import minimize_owlqn
+
+    def vg(x):
+        d = x - 4.0
+        return jnp.sum(d * d), 2.0 * d
+
+    cases = [
+        (1.0, [3.5, 3.5], 7.5),
+        (2.0, [3.0, 3.0], 14.0),
+        (8.0, [0.0, 0.0], 32.0),
+    ]
+    for w, x_exp, f_exp in cases:
+        res = minimize_owlqn(
+            vg, jnp.zeros(2), jnp.asarray(w), tolerance=1e-10, max_iterations=200
+        )
+        np.testing.assert_allclose(np.asarray(res.coefficients), x_exp, atol=1e-6)
+        # res.value is the TOTAL objective incl. the L1 term, like the reference
+        assert float(res.value) == pytest.approx(f_exp, abs=1e-6)
