@@ -58,6 +58,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="'mesh' scores with datasets sharded over the device mesh")
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="Device count for --compute-backend=mesh (default: all)")
+    from photon_ml_tpu.cli.runtime import add_distributed_arguments
+
+    add_distributed_arguments(
+        p,
+        "multi-process scoring: each process scores its round-robin slice of "
+        "the input part files and writes its own output part file (the "
+        "executor-parallel form of GameScoringDriver)",
+    )
     p.add_argument("--log-data-and-model-stats", action="store_true")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--application-name", default="game-scoring")
@@ -67,19 +75,32 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def run(args: argparse.Namespace) -> dict:
+    from photon_ml_tpu.cli.runtime import initialize_distributed_from_args
+
+    rank, nproc = initialize_distributed_from_args(args)
+    if nproc > 1:
+        if args.evaluators:
+            raise ValueError(
+                "evaluators need globally sorted scores; run them single-process "
+                "on the written score files instead of multi-process scoring"
+            )
+        if getattr(args, "compute_backend", "host") == "mesh":
+            raise ValueError(
+                "--compute-backend=mesh and multi-process scoring are exclusive: "
+                "each process already scores its own input slice host-locally"
+            )
+
     from photon_ml_tpu.cli.runtime import configure_compilation_cache
 
     configure_compilation_cache(args)
     root = args.root_output_directory
-    if os.path.exists(root):
-        if args.override_output_directory:
-            shutil.rmtree(root)
-        elif os.listdir(root):
-            raise FileExistsError(
-                f"Output directory {root!r} exists; pass --override-output-directory"
-            )
-    os.makedirs(root, exist_ok=True)
-    logger = PhotonLogger(os.path.join(root, "logs", "photon.log"), level=args.log_level)
+    _prepare_output_root(root, args.override_output_directory, rank, nproc)
+    logger = PhotonLogger(
+        os.path.join(
+            root, "logs", "photon.log" if nproc == 1 else f"photon-r{rank}.log"
+        ),
+        level=args.log_level,
+    )
     try:
         shard_configs = dict(
             parse_feature_shard_configuration(a) for a in args.feature_shard_configurations
@@ -126,6 +147,19 @@ def run(args: argparse.Namespace) -> dict:
             getattr(args, "input_data_date_range", None),
             getattr(args, "input_data_days_range", None),
         )
+        if nproc > 1:
+            # file-level round-robin: every process reads and scores only its
+            # slice of the part files (index maps come from the saved training
+            # maps, so processes agree on the feature space by construction)
+            all_files = avro_io.container_files(input_paths)
+            input_paths = all_files[rank::nproc]
+            logger.info(
+                "process %d/%d scoring %d of %d part files",
+                rank, nproc, len(input_paths), len(all_files),
+            )
+            if not input_paths:
+                logger.info("no part files for this process; nothing to score")
+                return {"scores": np.zeros(0), "metrics": {}, "output_directory": root}
         with Timed("read data", logger):
             data, index_maps, uids = read_merged_avro(
                 input_paths, shard_configs, index_maps, id_tags
@@ -153,7 +187,7 @@ def run(args: argparse.Namespace) -> dict:
 
         with Timed("write scores", logger):
             _write_scores(
-                os.path.join(root, SCORES_DIR, "part-00000.avro"),
+                os.path.join(root, SCORES_DIR, f"part-{rank:05d}.avro"),
                 uids, scores, data, args.model_id or "",
             )
         return {"scores": scores, "metrics": metrics, "output_directory": root}
@@ -161,20 +195,47 @@ def run(args: argparse.Namespace) -> dict:
         logger.close()
 
 
+def _prepare_output_root(root: str, override: bool, rank: int, nproc: int) -> None:
+    """Single-writer output-root preparation.
+
+    Process 0 owns the override/exists decision; a REAL barrier from the
+    already-initialized distributed runtime orders it before any other
+    process's first write (no marker files: a stale marker from a previous
+    run would defeat the ordering, and a rank-0 failure would leave peers
+    polling a dead file — the runtime barrier surfaces peer loss instead)."""
+    if rank == 0:
+        if os.path.exists(root):
+            if override:
+                shutil.rmtree(root)
+            elif os.listdir(root):
+                raise FileExistsError(
+                    f"Output directory {root!r} exists; pass --override-output-directory"
+                )
+        os.makedirs(root, exist_ok=True)
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("photon-scoring-output-root")
+        os.makedirs(root, exist_ok=True)  # after the barrier: root is final
+
+
 def _coordinate_shards(model_dir: str) -> dict[str, str]:
-    """coordinate id -> feature shard id from the saved model's id-info files."""
-    import json
+    """coordinate id -> feature shard id from the saved model's id-info files
+    (both this framework's JSON dialect and the reference's plain-text one —
+    model_io._read_id_info)."""
+    from photon_ml_tpu.io.model_io import _read_id_info
 
     out: dict[str, str] = {}
-    for section in ("fixed-effect", "random-effect"):
+    for section, is_re in (("fixed-effect", False), ("random-effect", True)):
         base = os.path.join(model_dir, section)
         if not os.path.isdir(base):
             continue
         for cid in os.listdir(base):
             info = os.path.join(base, cid, "id-info")  # model_io.ID_INFO
             if os.path.exists(info):
-                with open(info) as f:
-                    out[cid] = json.load(f).get("featureShardId", "global")
+                out[cid] = _read_id_info(info, random_effect=is_re).get(
+                    "featureShardId", "global"
+                )
     return out
 
 

@@ -126,12 +126,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="Device count for --compute-backend=mesh/fused "
                         "(default: all)")
-    p.add_argument("--distributed-coordinator", default=None,
-                   help="host:port of process 0 for multi-host training "
-                        "(jax.distributed), or 'auto' for orchestrated TPU pod "
-                        "environments; omit on single-host")
-    p.add_argument("--distributed-num-processes", type=int, default=None)
-    p.add_argument("--distributed-process-id", type=int, default=None)
+    from photon_ml_tpu.cli.runtime import add_distributed_arguments
+
+    add_distributed_arguments(
+        p, "multi-host training (jax.distributed runtime init)"
+    )
     p.add_argument("--mesh-model-devices", type=int, default=1,
                    help="Shard the dense fixed-effect FEATURE axis over this many "
                         "devices (2-D data x model mesh; coefficients and optimizer "
@@ -246,35 +245,20 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
     # Multi-host init must precede EVERY other JAX touch (model loading,
     # data placement): jax.distributed.initialize after backend init either
     # errors or silently leaves the "global" mesh host-local.
-    coordinator = getattr(args, "distributed_coordinator", None)
-    if coordinator is None and (
-        getattr(args, "distributed_num_processes", None) is not None
-        or getattr(args, "distributed_process_id", None) is not None
-    ):
-        raise ValueError(
-            "--distributed-num-processes/--distributed-process-id require "
-            "--distributed-coordinator (or --distributed-coordinator=auto)"
-        )
-    if coordinator is not None:
-        from photon_ml_tpu.parallel import initialize_multi_host
+    from photon_ml_tpu.cli.runtime import initialize_distributed_from_args
 
-        world = initialize_multi_host(
-            coordinator_address=None if coordinator == "auto" else coordinator,
-            num_processes=getattr(args, "distributed_num_processes", None),
-            process_id=getattr(args, "distributed_process_id", None),
-            auto=coordinator == "auto",
+    _rank, nproc = initialize_distributed_from_args(args)
+    if nproc > 1:
+        # per-process ingestion (process_slice + host_local_to_global) is
+        # a library-level building block; the CLI reader still ingests
+        # full host-local arrays, which cannot place onto a multi-host
+        # mesh. Fail loudly instead of training N independent copies.
+        raise NotImplementedError(
+            "multi-process CLI ingestion is not wired yet: use the "
+            "library API (parallel.process_slice + "
+            "parallel.host_local_to_global) to build global sharded "
+            "inputs per process"
         )
-        if world["num_processes"] > 1:
-            # per-process ingestion (process_slice + host_local_to_global) is
-            # a library-level building block; the CLI reader still ingests
-            # full host-local arrays, which cannot place onto a multi-host
-            # mesh. Fail loudly instead of training N independent copies.
-            raise NotImplementedError(
-                "multi-process CLI ingestion is not wired yet: use the "
-                "library API (parallel.process_slice + "
-                "parallel.host_local_to_global) to build global sharded "
-                "inputs per process"
-            )
     from photon_ml_tpu.cli.runtime import configure_compilation_cache
 
     configure_compilation_cache(args)

@@ -19,3 +19,42 @@ def enable_compilation_cache(cache_dir: str, min_compile_secs: float = 0.1) -> N
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)
+
+
+def add_distributed_arguments(parser, purpose: str) -> None:
+    """The shared --distributed-* flag contract of the training and scoring
+    drivers (one definition so the two cannot drift)."""
+    parser.add_argument(
+        "--distributed-coordinator", default=None,
+        help=f"host:port of process 0 (or 'auto') for {purpose}",
+    )
+    parser.add_argument("--distributed-num-processes", type=int, default=None)
+    parser.add_argument("--distributed-process-id", type=int, default=None)
+
+
+def initialize_distributed_from_args(args) -> tuple[int, int]:
+    """Validate the --distributed-* flags and join the JAX distributed runtime.
+
+    MUST run before every other JAX touch (a later ``jax.distributed
+    .initialize`` either errors or silently leaves the mesh host-local).
+    Returns (process_id, num_processes) — (0, 1) for single-process runs."""
+    coordinator = getattr(args, "distributed_coordinator", None)
+    if coordinator is None and (
+        getattr(args, "distributed_num_processes", None) is not None
+        or getattr(args, "distributed_process_id", None) is not None
+    ):
+        raise ValueError(
+            "--distributed-num-processes/--distributed-process-id require "
+            "--distributed-coordinator (or --distributed-coordinator=auto)"
+        )
+    if coordinator is None:
+        return 0, 1
+    from photon_ml_tpu.parallel import initialize_multi_host
+
+    world = initialize_multi_host(
+        coordinator_address=None if coordinator == "auto" else coordinator,
+        num_processes=getattr(args, "distributed_num_processes", None),
+        process_id=getattr(args, "distributed_process_id", None),
+        auto=coordinator == "auto",
+    )
+    return world["process_id"], world["num_processes"]

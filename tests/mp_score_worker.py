@@ -1,0 +1,40 @@
+"""Worker for the two-process distributed SCORING test: one process of a
+2-process `game_scoring_driver --distributed-coordinator` run. Each process
+scores only its round-robin slice of the input part files and writes its own
+output part file (the executor-parallel form of GameScoringDriver).
+
+Run as: python mp_score_worker.py <pid> <nproc> <port> <workdir>
+(<workdir> must contain in/ (part files), model/ and index-maps/ written by
+the test.)
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port, workdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from photon_ml_tpu.cli.game_scoring_driver import build_arg_parser, run
+
+    args = build_arg_parser().parse_args([
+        "--input-data-directories", os.path.join(workdir, "in"),
+        "--model-input-directory", os.path.join(workdir, "model"),
+        "--root-output-directory", os.path.join(workdir, "out"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--off-heap-index-map-directory", os.path.join(workdir, "index-maps"),
+        "--distributed-coordinator", f"localhost:{port}",
+        "--distributed-num-processes", str(nproc),
+        "--distributed-process-id", str(pid),
+    ])
+    run(args)
+
+
+if __name__ == "__main__":
+    main()

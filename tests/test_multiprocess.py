@@ -14,6 +14,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -87,3 +89,134 @@ def test_two_process_solve_matches_single_process(tmp_path):
         make_mesh(1),
     )
     np.testing.assert_allclose(a["coef"], np.asarray(w_ref), atol=5e-4)
+
+
+def test_two_process_scoring_matches_single_process(tmp_path):
+    """game_scoring_driver --distributed-coordinator: two processes score
+    disjoint slices of the input part files and write their own output parts;
+    the union must equal the single-process run exactly (the executor-parallel
+    scoring of GameScoringDriver.scala)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import save_game_model
+    from photon_ml_tpu.models.game import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(9)
+    d, n_users, n = 4, 5, 120
+    keys = [f"f{j}\x01" for j in range(d)]
+    imap = IndexMap.build(keys, add_intercept=True)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+
+    # a hand-built GAME model: fixed effect + per-user biases
+    fe_w = rng.normal(size=imap.size)
+    glm = GeneralizedLinearModel(
+        Coefficients(jnp.asarray(fe_w)), TaskType.LOGISTIC_REGRESSION
+    )
+    users = [f"u{i}" for i in range(n_users)]
+    icpt = imap.intercept_index
+    re_model = RandomEffectModel(
+        re_type="userId",
+        feature_shard_id="global",
+        task=TaskType.LOGISTIC_REGRESSION,
+        entity_ids=tuple(users),
+        coeffs=jnp.asarray(rng.normal(size=(n_users, 1))),
+        proj_indices=jnp.full((n_users, 1), icpt, dtype=jnp.int32),
+    )
+    gm = GameModel(models={
+        "global": FixedEffectModel(model=glm, feature_shard_id="global"),
+        "per-user": re_model,
+    })
+    save_game_model(str(tmp_path / "model"), gm, {"global": imap, "per-user": imap})
+
+    # two input part files with top-level-free metadataMap ids
+    (tmp_path / "in").mkdir()
+
+    def records(lo, hi):
+        for i in range(lo, hi):
+            yield {
+                "uid": f"s{i}",
+                "label": float(i % 2),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(rng.normal())}
+                    for j in range(d)
+                ],
+                "metadataMap": {"userId": users[i % n_users]},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(0, n // 2),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(n // 2, n),
+    )
+
+    def read_scores(scores_dir):
+        out = {}
+        for rec in avro_io.read_container_dir(str(scores_dir)):
+            out[rec["uid"]] = rec["predictionScore"]
+        return out
+
+    # single-process reference run
+    from photon_ml_tpu.cli.game_scoring_driver import build_arg_parser, run
+
+    single_args = build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--model-input-directory", str(tmp_path / "model"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+    ])
+    run(single_args)
+    expected = read_scores(tmp_path / "out-single" / "scores")
+    assert len(expected) == n
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_score_worker.py")
+    logs = [open(tmp_path / f"scorer{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=240)
+            assert rc == 0, (
+                f"scorer {i} failed:\n" + (tmp_path / f"scorer{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    parts = sorted(os.listdir(tmp_path / "out" / "scores"))
+    assert parts == ["part-00000.avro", "part-00001.avro"]
+    got = read_scores(tmp_path / "out" / "scores")
+    assert set(got) == set(expected)
+    for uid, score in expected.items():
+        assert got[uid] == pytest.approx(score, rel=1e-6)
