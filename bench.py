@@ -42,22 +42,30 @@ def _apply_scale(scale: float) -> None:
     N_ITEMS = max(1, int(N_ITEMS * scale))
 
 
-def _build_workload(dtype):
+def _build_workload(dtype, n_samples=None, n_users=None, n_items=None):
+    """THE flagship GLMix workload (BASELINE config #3 shape by default).
+
+    Shape parameters exist so other harnesses measuring the same program
+    (benchmarks/device_scaling.py) share this one definition instead of
+    re-implementing a drift-prone copy."""
     import jax.numpy as jnp
     import numpy as np
     import scipy.sparse as sp
 
     from photon_ml_tpu.data.random_effect import build_random_effect_dataset
 
+    n = N_SAMPLES if n_samples is None else n_samples
+    nu = N_USERS if n_users is None else n_users
+    ni = N_ITEMS if n_items is None else n_items
     rng = np.random.default_rng(42)
-    fe_X = rng.normal(size=(N_SAMPLES, N_FEATURES)).astype(np.float32)
-    users = rng.integers(0, N_USERS, size=N_SAMPLES)
-    items = rng.integers(0, N_ITEMS, size=N_SAMPLES)
+    fe_X = rng.normal(size=(n, N_FEATURES)).astype(np.float32)
+    users = rng.integers(0, nu, size=n)
+    items = rng.integers(0, ni, size=n)
     w = rng.normal(size=N_FEATURES) * 0.3
-    z = fe_X @ w + 0.4 * rng.normal(size=N_USERS)[users] + 0.4 * rng.normal(size=N_ITEMS)[items]
-    y = (rng.random(N_SAMPLES) < 1.0 / (1.0 + np.exp(-z))).astype(np.float64)
+    z = fe_X @ w + 0.4 * rng.normal(size=nu)[users] + 0.4 * rng.normal(size=ni)[items]
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float64)
     re_feat = sp.csr_matrix(
-        np.concatenate([np.ones((N_SAMPLES, 1), dtype=np.float32), fe_X[:, :7]], axis=1)
+        np.concatenate([np.ones((n, 1), dtype=np.float32), fe_X[:, :7]], axis=1)
     )
     ds_u = build_random_effect_dataset(
         re_feat, users, "userId", labels=y, intercept_index=0, dtype=dtype
@@ -367,6 +375,15 @@ def main():
         return
 
     if "--record-cpu-baseline" in sys.argv:
+        if "--scale" in sys.argv:
+            # the baseline file holds ONE record at the standard shape; a
+            # silently scale-recorded value would poison every later ratio
+            print(
+                "--record-cpu-baseline records the standard shape only; "
+                "at-scale denominators are banked in benchmarks/tpu_results.md",
+                file=sys.stderr,
+            )
+            sys.exit(2)
         value, rec = _spawn_child(_CPU_CHILD_ENV, timeout_s=1800)
         if value is None:
             print(json.dumps({"error": f"cpu baseline run failed: {rec}"}))
@@ -459,7 +476,8 @@ def main():
         ),
         "baseline_platform": "cpu" if baseline else None,
     }
-    if value is not None and baseline and not on_accelerator:
+    if value is not None and baseline and not on_accelerator and not child_args:
+        # same-shape CPU drift ratio; meaningless for a --scale run
         result["cpu_value_vs_recorded_cpu_baseline"] = round(value / baseline, 4)
     # a baseline recorded on a different machine shape makes ratios apples-to-
     # oranges; surface the mismatch rather than silently dividing

@@ -31,15 +31,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _child(n_samples: int, n_users: int, n_items: int, passes: int) -> float:
-    """Measure samples/sec of the sharded GAME pass on the ambient mesh."""
+    """Measure samples/sec of the sharded GAME pass on the ambient mesh.
+
+    The workload is bench.py's ``_build_workload`` — the SAME program as the
+    flagship bench, just parameterized by shape, so this curve is scaling
+    evidence for the measured program, not for a drifting copy of it."""
     import time
 
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    import scipy.sparse as sp
 
-    from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+    import bench
     from photon_ml_tpu.optimization.common import OptimizerConfig
     from photon_ml_tpu.optimization.config import (
         GLMOptimizationConfiguration,
@@ -53,22 +55,8 @@ def _child(n_samples: int, n_users: int, n_items: int, passes: int) -> float:
     from photon_ml_tpu.parallel.game import init_game_params
     from photon_ml_tpu.types import RegularizationType, TaskType
 
-    rng = np.random.default_rng(42)
-    d = 64
-    fe_X = rng.normal(size=(n_samples, d)).astype(np.float32)
-    users = rng.integers(0, n_users, size=n_samples)
-    items = rng.integers(0, n_items, size=n_samples)
-    w = rng.normal(size=d) * 0.3
-    z = fe_X @ w + 0.4 * rng.normal(size=n_users)[users]
-    y = (rng.random(n_samples) < 1.0 / (1.0 + np.exp(-z))).astype(np.float64)
-    re_feat = sp.csr_matrix(
-        np.concatenate([np.ones((n_samples, 1), np.float32), fe_X[:, :7]], axis=1)
-    )
-    ds_u = build_random_effect_dataset(
-        re_feat, users, "userId", labels=y, intercept_index=0
-    )
-    ds_i = build_random_effect_dataset(
-        re_feat, items, "itemId", labels=y, intercept_index=0
+    fe_X, y, ds_u, ds_i = bench._build_workload(
+        jnp.float32, n_samples=n_samples, n_users=n_users, n_items=n_items
     )
 
     mesh = make_mesh(len(jax.devices()))

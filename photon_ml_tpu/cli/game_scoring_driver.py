@@ -198,25 +198,41 @@ def run(args: argparse.Namespace) -> dict:
 def _prepare_output_root(root: str, override: bool, rank: int, nproc: int) -> None:
     """Single-writer output-root preparation.
 
-    Process 0 owns the override/exists decision; a REAL barrier from the
-    already-initialized distributed runtime orders it before any other
-    process's first write (no marker files: a stale marker from a previous
-    run would defeat the ordering, and a rank-0 failure would leave peers
-    polling a dead file — the runtime barrier surfaces peer loss instead)."""
+    Process 0 owns the override/exists decision. Multi-process runs exchange
+    a success flag through the distributed runtime (the collective doubles as
+    the ordering barrier before any peer's first write — no marker files,
+    which would go stale across runs), so a rank-0 failure fails EVERY rank
+    promptly instead of leaving peers blocked until the peer-loss timeout."""
+    failure: Optional[Exception] = None
     if rank == 0:
-        if os.path.exists(root):
-            if override:
-                shutil.rmtree(root)
-            elif os.listdir(root):
-                raise FileExistsError(
-                    f"Output directory {root!r} exists; pass --override-output-directory"
-                )
-        os.makedirs(root, exist_ok=True)
+        try:
+            if os.path.exists(root):
+                if override:
+                    shutil.rmtree(root)
+                elif os.listdir(root):
+                    raise FileExistsError(
+                        f"Output directory {root!r} exists; "
+                        f"pass --override-output-directory"
+                    )
+            os.makedirs(root, exist_ok=True)
+        except Exception as e:  # report through the collective before raising
+            failure = e
     if nproc > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("photon-scoring-output-root")
+        flags = multihost_utils.process_allgather(
+            np.asarray([0 if (rank != 0 or failure is None) else 1])
+        )
+        if int(np.asarray(flags).sum()) > 0:
+            if failure is not None:
+                raise failure
+            raise RuntimeError(
+                "process 0 failed to prepare the output root "
+                "(see its error for the cause)"
+            )
         os.makedirs(root, exist_ok=True)  # after the barrier: root is final
+    elif failure is not None:
+        raise failure
 
 
 def _coordinate_shards(model_dir: str) -> dict[str, str]:

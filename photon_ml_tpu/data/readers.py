@@ -8,6 +8,7 @@ the host into columnar numpy (then device arrays); there is no lazy RDD layer.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -162,7 +163,17 @@ def read_merged_avro(
         if native is not None:
             return native
 
-    records = list(avro_io.read_container_dir(path))
+    records = []
+    fallback_uids = []
+    for file_path in avro_io.container_files(path):
+        base = os.path.basename(file_path)
+        for row, rec in enumerate(avro_io.read_container(file_path)):
+            records.append(rec)
+            # synthetic uids are FILE-anchored, not positional: a positional
+            # fallback would depend on which slice of the part files a reader
+            # saw (multi-process scoring splits them round-robin) and collide
+            # across processes
+            fallback_uids.append(f"{base}#{row}")
     n = len(records)
     index_maps = dict(index_maps or {})
 
@@ -196,7 +207,7 @@ def read_merged_avro(
             offsets[i] = rec["offset"]
         if rec.get("weight") is not None:
             weights[i] = rec["weight"]
-        uids[i] = rec.get("uid") or str(i)
+        uids[i] = rec.get("uid") or fallback_uids[i]
         for tag in id_tags:
             id_cols[tag].append(_id_tag_value(rec, tag, i))
         for shard_id, cfg in shard_configs.items():
@@ -303,9 +314,11 @@ def _read_merged_native(path, shard_configs, index_maps, id_tags):
     files = avro_io.container_files(path)
 
     # ---- pass 1: decode every block, keep columnar views -----------------------
-    decoded = []  # (block, row_base, positions dict, bag positions dict)
+    decoded = []  # (block, row_base, positions dict, bag positions dict, ...)
     n_total = 0
     for file_path in files:
+        file_base = os.path.basename(file_path)
+        file_row = 0
         for schema_json, payload, n_records in avro_io.iter_raw_blocks(file_path):
             fields = schema_json.get("fields", [])
             ftypes = native_avro.field_types_for_schema(fields)
@@ -332,8 +345,11 @@ def _read_merged_native(path, shard_configs, index_maps, id_tags):
                 block = native_avro.decode_block(payload, n_records, ftypes)
             except ValueError:
                 return None  # malformed for the fast path; let Python report it
-            decoded.append((block, n_total, pos, bag_pos, ftypes, label_pos))
+            decoded.append(
+                (block, n_total, pos, bag_pos, ftypes, label_pos, file_base, file_row)
+            )
             n_total += n_records
+            file_row += n_records
 
     labels = np.zeros(n_total)
     offsets = np.zeros(n_total)
@@ -347,7 +363,7 @@ def _read_merged_native(path, shard_configs, index_maps, id_tags):
     ent_vals: dict[str, list] = {s: [] for s in shard_configs}
 
     DOUBLES = (native_avro.F_DOUBLE, native_avro.F_NULLABLE_DOUBLE)
-    for block, base, pos, bag_pos, ftypes, label_pos in decoded:
+    for block, base, pos, bag_pos, ftypes, label_pos, file_base, file_row in decoded:
         # nullable doubles decode nulls as NaN; match the Python path's
         # defaults (label 0, offset 0, weight 1) and its has_labels semantics
         # (true only when some label is present)
@@ -365,16 +381,18 @@ def _read_merged_native(path, shard_configs, index_maps, id_tags):
         if "weight" in pos and ftypes[pos["weight"]] in DOUBLES:
             w = block.doubles(pos["weight"])
             weights[base : base + len(w)] = np.where(np.isnan(w), 1.0, w)
+        # synthetic uids are FILE-anchored (<part-file>#<row-in-file>), like
+        # the Python path: a positional fallback would depend on which slice
+        # of the part files this reader saw and collide across the processes
+        # of a multi-process scoring run
         if "uid" in pos and ftypes[pos["uid"]] == native_avro.F_NULLABLE_STRING:
             offs, lens = block.strings(pos["uid"])
             vals = block.strings_at(offs, lens)
             for i, v in enumerate(vals):
-                # `v if v else ...`: empty-string uids fall back to the row
-                # ordinal exactly like the Python path's `rec.get("uid") or str(i)`
-                uids[base + i] = v if v else str(base + i)
+                uids[base + i] = v if v else f"{file_base}#{file_row + i}"
         else:
             for i in range(block.count(label_pos)):
-                uids[base + i] = str(base + i)
+                uids[base + i] = f"{file_base}#{file_row + i}"
         if id_tags:
             rows, ko, kl, vo, vl = block.map_entries(pos["metadataMap"])
             keys = block.strings_at(ko, kl)

@@ -144,7 +144,9 @@ def test_two_process_scoring_matches_single_process(tmp_path):
     def records(lo, hi):
         for i in range(lo, hi):
             yield {
-                "uid": f"s{i}",
+                # some records carry no uid: the file-anchored synthetic
+                # fallback must agree between single- and multi-process runs
+                "uid": None if i % 10 == 0 else f"s{i}",
                 "label": float(i % 2),
                 "features": [
                     {"name": f"f{j}", "term": "", "value": float(rng.normal())}

@@ -218,8 +218,10 @@ class TestReviewRegressions:
         assert nat2.has_labels == py2.has_labels == False  # noqa: E712
 
     def test_empty_uid_parity(self, tmp_path):
-        """Empty-string uids fall back to the row ordinal on BOTH paths (the
-        Python path's `rec.get('uid') or str(i)` treats '' as missing)."""
+        """Empty-string uids fall back to a FILE-anchored synthetic uid
+        (<part-file>#<row-in-file>) on BOTH paths — positional ordinals would
+        depend on which slice of the part files a reader saw and collide
+        across the processes of a multi-process scoring run."""
         path = str(tmp_path / "uid.avro")
         avro_io.write_container(path, avro_io.TRAINING_EXAMPLE_SCHEMA, [
             {"uid": "", "label": 1.0, "features": [], "metadataMap": {},
@@ -229,7 +231,7 @@ class TestReviewRegressions:
         ])
         _, _, nat_uids = read_merged_avro(path, SHARDS)
         _, _, py_uids = read_merged_avro(path, SHARDS, use_native=False)
-        assert list(nat_uids) == list(py_uids) == ["0", "real"]
+        assert list(nat_uids) == list(py_uids) == ["uid.avro#0", "real"]
 
     def test_comma_separated_multi_path(self, tmp_path, rng):
         """--input-data-directories is comma-separated (reference
