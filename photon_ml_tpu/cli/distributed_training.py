@@ -1,0 +1,322 @@
+"""Multi-process FIXED-EFFECT training for the CLI driver.
+
+Each process reads its round-robin slice of the input part files, pads its
+block to the common per-process row count with weight-0 rows, and assembles
+GLOBAL batch-sharded arrays (``host_local_to_global``) over a mesh spanning
+every process's devices — gradient reductions then cross processes as real
+collectives, the reference's executor/treeAggregate topology with XLA
+collectives in place of Spark (ValueAndGradientAggregator.scala:240-255).
+
+Scope: single fixed-effect coordinate, NONE/L2/L1/elastic regularization
+sweep with warm starts, optional validation AUC selection. Random-effect
+coordinates need the cross-process entity exchange designed in
+docs/DISTRIBUTED.md — configurations containing them fail loudly with that
+pointer. The feature space must come from PREBUILT index maps
+(``--off-heap-index-map-directory`` / feature-indexing driver output):
+per-process maps built from data slices would diverge.
+
+The parity bar (enforced by tests/test_multiprocess.py): an N-process run
+must match the single-process driver's model numerically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from photon_ml_tpu.types import NormalizationType, TaskType
+
+MULTIPROC_DESIGN_POINTER = (
+    "multi-process training currently covers a single fixed-effect "
+    "coordinate; random-effect coordinates need the cross-process entity "
+    "exchange designed in docs/DISTRIBUTED.md"
+)
+
+
+def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str]:
+    """Why this configuration cannot train multi-process. Empty = eligible."""
+    from photon_ml_tpu.estimators.config import FixedEffectDataConfiguration
+
+    reasons: list[str] = []
+    if len(coord_configs) != 1:
+        reasons.append(MULTIPROC_DESIGN_POINTER)
+    for cid, cfg in coord_configs.items():
+        if not isinstance(cfg.data_config, FixedEffectDataConfiguration):
+            reasons.append(MULTIPROC_DESIGN_POINTER)
+            break
+        if 0.0 < cfg.down_sampling_rate < 1.0:
+            reasons.append(f"coordinate {cid!r}: down-sampling")
+        if cfg.box_constraints is not None:
+            reasons.append(f"coordinate {cid!r}: box constraints")
+        if cfg.data_config.feature_shard_id not in index_maps:
+            reasons.append(
+                f"shard {cfg.data_config.feature_shard_id!r}: multi-process "
+                "training requires PREBUILT index maps "
+                "(--off-heap-index-map-directory; per-process maps built from "
+                "data slices would diverge)"
+            )
+    if NormalizationType(args.normalization) != NormalizationType.NONE:
+        reasons.append("normalization (needs global feature statistics)")
+    if args.hyper_parameter_tuning not in (None, "NONE"):
+        reasons.append("hyperparameter tuning")
+    if getattr(args, "model_input_directory", None):
+        reasons.append("warm start / partial retrain from a model directory")
+    if getattr(args, "checkpoint_directory", None):
+        reasons.append("iteration checkpointing")
+    if getattr(args, "compute_backend", "host") != "host":
+        reasons.append("--compute-backend (the multi-process mesh is implicit)")
+    if getattr(args, "coefficient_box_constraints", None):
+        reasons.append("--coefficient-box-constraints")
+    if getattr(args, "output_mode", "BEST") != "BEST":
+        reasons.append("--output-mode (only the best model is written)")
+    if getattr(args, "variance_computation_type", "NONE") != "NONE":
+        reasons.append("coefficient variances")
+    if getattr(args, "data_summary_directory", None):
+        reasons.append("--data-summary-directory")
+    evaluators = getattr(args, "evaluators", None)
+    if evaluators and evaluators.strip().upper() != "AUC":
+        reasons.append(
+            "evaluators other than AUC (multi-process model selection "
+            "currently computes the gathered weighted AUC only)"
+        )
+    for shard in {c.data_config.feature_shard_id for c in coord_configs.values()}:
+        if shard in index_maps and index_maps[shard].size > 65536:
+            reasons.append(
+                f"shard {shard!r}: {index_maps[shard].size} features — the "
+                "multi-process assembler materializes dense per-process "
+                "blocks; sparse global assembly is not implemented"
+            )
+    return reasons
+
+
+def run_multiprocess_fixed_effect(
+    args, rank: int, nproc: int, logger, root: str,
+    task, coord_configs, shard_configs, index_maps, evaluator_specs,
+) -> dict:
+    """The multi-process fixed-effect training flow. Returns the driver's
+    summary dict; only process 0 writes output."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.cli.game_training_driver import _save_result
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.readers import read_merged_avro
+    from photon_ml_tpu.estimators.game_estimator import GameResult
+    from photon_ml_tpu.models.game import FixedEffectModel, GameModel
+    from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_ml_tpu.parallel import make_mesh
+    from photon_ml_tpu.util.date_range import resolve_input_paths
+    from photon_ml_tpu.util.timed import Timed
+
+    reasons = multiprocess_fe_ineligibilities(args, coord_configs, index_maps)
+    if reasons:
+        raise NotImplementedError(
+            "configuration not eligible for multi-process training: "
+            + "; ".join(sorted(set(reasons)))
+        )
+    (cid, cfg), = coord_configs.items()
+    shard = cfg.data_config.feature_shard_id
+
+    def read_slice(directories, date_range, days_range, what):
+        paths = resolve_input_paths(directories, date_range, days_range)
+        all_files = avro_io.container_files(paths)
+        mine = all_files[rank::nproc]
+        logger.info(
+            "process %d/%d reading %d of %d %s part files",
+            rank, nproc, len(mine), len(all_files), what,
+        )
+        if not mine:
+            from photon_ml_tpu.data.game_data import GameInput
+            import scipy.sparse as sp
+
+            return GameInput(
+                features={shard: sp.csr_matrix((0, index_maps[shard].size))},
+                labels=np.zeros(0), id_columns={},
+            )
+        data, _, _ = read_merged_avro(mine, shard_configs, index_maps)
+        return data
+
+    with Timed("read training data", logger):
+        train = read_slice(
+            args.input_data_directories,
+            getattr(args, "input_data_date_range", None),
+            getattr(args, "input_data_days_range", None),
+            "training",
+        )
+    from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
+
+    if train.n:  # per-sample checks are slice-local: each process checks its rows
+        with Timed("data validation", logger):
+            sanity_check_data(
+                task,
+                train.labels,
+                offsets=train.offsets,
+                weights=train.weights,
+                feature_shards=train.features,
+                validation_type=DataValidationType(args.data_validation),
+            )
+    val = None
+    if args.validation_data_directories:
+        with Timed("read validation data", logger):
+            val = read_slice(
+                args.validation_data_directories,
+                getattr(args, "validation_data_date_range", None),
+                getattr(args, "validation_data_days_range", None),
+                "validation",
+            )
+
+    mesh = make_mesh(len(jax.devices()))
+    train_data, _ = _assemble_global(train, shard, mesh, logger)
+    val_data = None
+    val_meta = None
+    if val is not None:
+        val_data, val_meta = _assemble_global(val, shard, mesh, logger)
+
+    from photon_ml_tpu.parallel import train_glm_sharded
+
+    results = []
+    warm = None
+    sweep = cfg.expand()
+    for opt_cfg in sweep:
+        with Timed(f"train lambda={opt_cfg.regularization_weight}", logger):
+            coeffs, opt_res = train_glm_sharded(
+                train_data, task, opt_cfg, mesh, initial_coefficients=warm
+            )
+        warm = coeffs
+        auc = None
+        if val_data is not None:
+            auc = _validation_auc(val_data, val_meta, coeffs)
+            logger.info(
+                "lambda=%s validation AUC=%.6f",
+                opt_cfg.regularization_weight, auc,
+            )
+        results.append((opt_cfg, np.asarray(coeffs), auc))
+
+    best_i = (
+        int(np.argmax([r[2] for r in results]))
+        if val_data is not None
+        else len(results) - 1
+    )
+    logger.info("selected model %d of %d", best_i, len(results))
+
+    # NOTE: the multi-process summary carries plain dicts (JSON-serializable,
+    # written to <root>/summary.json), not the single-process path's
+    # GameResult objects — the "multiprocess" key marks the shape
+    summary = {
+        "multiprocess": True,
+        "results": [
+            {"regularization_weight": c.regularization_weight, "auc": a}
+            for c, _, a in results
+        ],
+        "best_index": best_i,
+        "output_directory": root,
+        "num_processes": nproc,
+    }
+    if rank == 0:
+        best_cfg, best_coeffs, best_auc = results[best_i]
+        glm = GeneralizedLinearModel(
+            Coefficients(jnp.asarray(best_coeffs)), TaskType(task)
+        )
+        model = GameModel(
+            models={cid: FixedEffectModel(model=glm, feature_shard_id=shard)}
+        )
+        result = GameResult(
+            model=model,
+            best_model=model,
+            configuration={cid: best_cfg},
+            evaluations={"AUC": best_auc} if best_auc is not None else None,
+            best_metric=best_auc,
+            descent=None,
+        )
+        _save_result(
+            os.path.join(root, "best"), result, {cid: index_maps[shard]},
+            coord_configs, args.model_sparsity_threshold, logger,
+        )
+        os.makedirs(os.path.join(root, "index-maps"), exist_ok=True)
+        index_maps[shard].save(os.path.join(root, "index-maps", f"{shard}.npz"))
+        with open(os.path.join(root, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+    from jax.experimental import multihost_utils
+
+    # rank 0's writes complete before any process exits (a prompt exit would
+    # tear down the distributed runtime under rank 0's collectives)
+    multihost_utils.sync_global_devices("photon-multiproc-train-done")
+    return summary
+
+
+def _assemble_global(data, shard: str, mesh, logger):
+    """Per-process GameInput slice -> global batch-sharded LabeledData.
+
+    Blocks are padded to a common per-process row count with weight-0 rows
+    (inert in every objective reduction) so the global row count divides
+    evenly over the mesh; returns (LabeledData, (n_local_real, pad_rows))."""
+    import jax
+    import jax.numpy as jnp
+
+    from jax.experimental import multihost_utils
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.data.matrix import as_design_matrix
+    from photon_ml_tpu.parallel.distributed import host_local_to_global
+
+    nproc = jax.process_count()
+    X = data.shard(shard)
+    n_local = data.n
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray([n_local]))
+    ).ravel()
+    devices_per_process = max(1, len(jax.local_devices()))
+    per_process = -(-int(counts.max()) // devices_per_process) * devices_per_process
+    pad = per_process - n_local
+    logger.info(
+        "global assembly: local %d rows (+%d pad), %d processes x %d rows",
+        n_local, pad, nproc, per_process,
+    )
+
+    dense = as_design_matrix(X).to_dense()
+    Xp = np.zeros((per_process, dense.shape[1]), dtype=np.float32)
+    Xp[:n_local] = np.asarray(dense, dtype=np.float32)
+    yp = np.zeros(per_process); yp[:n_local] = np.asarray(data.labels if data.has_labels else np.zeros(n_local))
+    op = np.zeros(per_process); op[:n_local] = np.asarray(data.offsets)
+    wp = np.zeros(per_process); wp[:n_local] = np.asarray(data.weights)
+
+    global_rows = per_process * nproc
+    Xg = host_local_to_global(Xp, mesh, global_rows=global_rows)
+    yg = host_local_to_global(yp.astype(np.float32), mesh, global_rows=global_rows)
+    og = host_local_to_global(op.astype(np.float32), mesh, global_rows=global_rows)
+    wg = host_local_to_global(wp.astype(np.float32), mesh, global_rows=global_rows)
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+
+    return (
+        LabeledData(X=DenseDesignMatrix(Xg), labels=yg, offsets=og, weights=wg),
+        (n_local, pad),
+    )
+
+
+def _validation_auc(val_data, val_meta, coeffs) -> float:
+    """Weighted AUC over the global validation set: every process scores its
+    own addressable block and the (score, label, weight) triples are
+    allgathered host-side — pad rows carry weight 0 and drop out of the
+    weighted pair statistic."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from photon_ml_tpu.evaluation.evaluators import auc_roc
+
+    scores = val_data.X.matvec(jnp.asarray(coeffs)) + val_data.offsets
+
+    def local_block(arr):
+        return np.concatenate(
+            [np.asarray(s.data) for s in arr.addressable_shards]
+        )
+
+    local = (
+        local_block(scores),
+        local_block(val_data.labels),
+        local_block(val_data.weights),
+    )
+    s, l, w = (np.asarray(x).reshape(-1) for x in multihost_utils.process_allgather(local))
+    return float(auc_roc(s, l, weights=w))

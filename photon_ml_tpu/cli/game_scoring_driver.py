@@ -9,9 +9,7 @@ from __future__ import annotations
 
 import argparse
 import os
-import shutil
 import sys
-from typing import Optional
 
 import numpy as np
 
@@ -94,7 +92,9 @@ def run(args: argparse.Namespace) -> dict:
 
     configure_compilation_cache(args)
     root = args.root_output_directory
-    _prepare_output_root(root, args.override_output_directory, rank, nproc)
+    from photon_ml_tpu.cli.runtime import prepare_output_root
+
+    prepare_output_root(root, args.override_output_directory, rank, nproc)
     logger = PhotonLogger(
         os.path.join(
             root, "logs", "photon.log" if nproc == 1 else f"photon-r{rank}.log"
@@ -193,46 +193,6 @@ def run(args: argparse.Namespace) -> dict:
         return {"scores": scores, "metrics": metrics, "output_directory": root}
     finally:
         logger.close()
-
-
-def _prepare_output_root(root: str, override: bool, rank: int, nproc: int) -> None:
-    """Single-writer output-root preparation.
-
-    Process 0 owns the override/exists decision. Multi-process runs exchange
-    a success flag through the distributed runtime (the collective doubles as
-    the ordering barrier before any peer's first write — no marker files,
-    which would go stale across runs), so a rank-0 failure fails EVERY rank
-    promptly instead of leaving peers blocked until the peer-loss timeout."""
-    failure: Optional[Exception] = None
-    if rank == 0:
-        try:
-            if os.path.exists(root):
-                if override:
-                    shutil.rmtree(root)
-                elif os.listdir(root):
-                    raise FileExistsError(
-                        f"Output directory {root!r} exists; "
-                        f"pass --override-output-directory"
-                    )
-            os.makedirs(root, exist_ok=True)
-        except Exception as e:  # report through the collective before raising
-            failure = e
-    if nproc > 1:
-        from jax.experimental import multihost_utils
-
-        flags = multihost_utils.process_allgather(
-            np.asarray([0 if (rank != 0 or failure is None) else 1])
-        )
-        if int(np.asarray(flags).sum()) > 0:
-            if failure is not None:
-                raise failure
-            raise RuntimeError(
-                "process 0 failed to prepare the output root "
-                "(see its error for the cause)"
-            )
-        os.makedirs(root, exist_ok=True)  # after the barrier: root is final
-    elif failure is not None:
-        raise failure
 
 
 def _coordinate_shards(model_dir: str) -> dict[str, str]:

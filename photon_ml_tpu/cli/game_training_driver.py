@@ -19,7 +19,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import shutil
 import sys
 from typing import Optional
 
@@ -245,34 +244,23 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
     # Multi-host init must precede EVERY other JAX touch (model loading,
     # data placement): jax.distributed.initialize after backend init either
     # errors or silently leaves the "global" mesh host-local.
-    from photon_ml_tpu.cli.runtime import initialize_distributed_from_args
+    from photon_ml_tpu.cli.runtime import (
+        configure_compilation_cache,
+        initialize_distributed_from_args,
+        prepare_output_root,
+    )
 
-    _rank, nproc = initialize_distributed_from_args(args)
-    if nproc > 1:
-        # per-process ingestion (process_slice + host_local_to_global) is
-        # a library-level building block; the CLI reader still ingests
-        # full host-local arrays, which cannot place onto a multi-host
-        # mesh. Fail loudly instead of training N independent copies.
-        raise NotImplementedError(
-            "multi-process CLI ingestion is not wired yet: use the "
-            "library API (parallel.process_slice + "
-            "parallel.host_local_to_global) to build global sharded "
-            "inputs per process"
-        )
-    from photon_ml_tpu.cli.runtime import configure_compilation_cache
-
+    rank, nproc = initialize_distributed_from_args(args)
     configure_compilation_cache(args)
     emitter = emitter or EventEmitter()
     root = args.root_output_directory
-    if os.path.exists(root):
-        if args.override_output_directory:
-            shutil.rmtree(root)
-        elif os.listdir(root):
-            raise FileExistsError(
-                f"Output directory {root!r} exists; pass --override-output-directory"
-            )
-    os.makedirs(root, exist_ok=True)
-    logger = PhotonLogger(os.path.join(root, "logs", "photon.log"), level=args.log_level)
+    prepare_output_root(root, args.override_output_directory, rank, nproc)
+    logger = PhotonLogger(
+        os.path.join(
+            root, "logs", "photon.log" if nproc == 1 else f"photon-r{rank}.log"
+        ),
+        level=args.log_level,
+    )
     emitter.send_event(Event("PhotonSetupEvent", {"applicationName": args.application_name}))
 
     try:
@@ -299,6 +287,28 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
         )
 
         index_maps = _load_index_maps(args.off_heap_index_map_directory, shard_configs)
+
+        if nproc > 1:
+            # multi-process training: the fixed-effect path runs per-process
+            # sharded ingest + global collectives; anything needing the
+            # cross-process entity exchange fails loudly with the design
+            # pointer (docs/DISTRIBUTED.md)
+            from photon_ml_tpu.cli.distributed_training import (
+                run_multiprocess_fixed_effect,
+            )
+
+            evaluator_specs = (
+                [parse_evaluator_spec(e) for e in args.evaluators.split(",") if e]
+                if args.evaluators
+                else []
+            )
+            emitter.send_event(Event("TrainingStartEvent"))
+            summary = run_multiprocess_fixed_effect(
+                args, rank, nproc, logger, root,
+                task, coord_configs, shard_configs, index_maps, evaluator_specs,
+            )
+            emitter.send_event(Event("TrainingFinishEvent"))
+            return summary
 
         # date-partitioned inputs (GameDriver inputDataDateRange/DaysRange params;
         # IOUtils.getInputPathsWithinDateRange path expansion)

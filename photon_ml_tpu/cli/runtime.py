@@ -32,6 +32,50 @@ def add_distributed_arguments(parser, purpose: str) -> None:
     parser.add_argument("--distributed-process-id", type=int, default=None)
 
 
+def prepare_output_root(root: str, override: bool, rank: int, nproc: int) -> None:
+    """Single-writer output-root preparation shared by the CLI drivers.
+
+    Process 0 owns the override/exists decision. Multi-process runs exchange
+    a success flag through the distributed runtime (the collective doubles as
+    the ordering barrier before any peer's first write — no marker files,
+    which would go stale across runs), so a rank-0 failure fails EVERY rank
+    promptly instead of leaving peers blocked until the peer-loss timeout."""
+    import os
+    import shutil
+
+    failure = None
+    if rank == 0:
+        try:
+            if os.path.exists(root):
+                if override:
+                    shutil.rmtree(root)
+                elif os.listdir(root):
+                    raise FileExistsError(
+                        f"Output directory {root!r} exists; "
+                        f"pass --override-output-directory"
+                    )
+            os.makedirs(root, exist_ok=True)
+        except Exception as e:  # report through the collective before raising
+            failure = e
+    if nproc > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([0 if (rank != 0 or failure is None) else 1])
+        )
+        if int(np.asarray(flags).sum()) > 0:
+            if failure is not None:
+                raise failure
+            raise RuntimeError(
+                "process 0 failed to prepare the output root "
+                "(see its error for the cause)"
+            )
+        os.makedirs(root, exist_ok=True)  # after the barrier: root is final
+    elif failure is not None:
+        raise failure
+
+
 def initialize_distributed_from_args(args) -> tuple[int, int]:
     """Validate the --distributed-* flags and join the JAX distributed runtime.
 

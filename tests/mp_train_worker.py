@@ -1,0 +1,46 @@
+"""Worker for the two-process distributed TRAINING test: one process of a
+2-process `game_training_driver --distributed-coordinator` fixed-effect run.
+Each process ingests its round-robin slice of the input part files; gradient
+reductions cross processes as real collectives.
+
+Run as: python mp_train_worker.py <pid> <nproc> <port> <workdir>
+(<workdir> must contain in/ and val/ part files and index-maps/ written by
+the test.)
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port, workdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+
+    args = build_arg_parser().parse_args([
+        "--input-data-directories", os.path.join(workdir, "in"),
+        "--validation-data-directories", os.path.join(workdir, "val"),
+        "--root-output-directory", os.path.join(workdir, "out"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--off-heap-index-map-directory", os.path.join(workdir, "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
+        "tolerance=1e-9,regularization=L2,reg.weights=0.1|10",
+        "--evaluators", "AUC",
+        "--distributed-coordinator", f"localhost:{port}",
+        "--distributed-num-processes", str(nproc),
+        "--distributed-process-id", str(pid),
+    ])
+    run(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -222,3 +222,119 @@ def test_two_process_scoring_matches_single_process(tmp_path):
     assert set(got) == set(expected)
     for uid, score in expected.items():
         assert got[uid] == pytest.approx(score, rel=1e-6)
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    """game_training_driver --distributed-coordinator (fixed effect): two
+    processes each ingest half the part files, the solve's gradient psums
+    cross processes as real collectives, and the saved best model must match
+    the single-process driver run — same selected reg weight, same
+    coefficients."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap, feature_key
+
+    rng = np.random.default_rng(3)
+    d, n = 4, 400
+    w_true = rng.normal(size=d)
+    imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            y = float((x @ w_true + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "val").mkdir()
+    # UNEVEN part files: exercises the per-process padding path
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(n // 2 + 37, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(n // 2 - 37, seed=2),
+    )
+    avro_io.write_container(
+        str(tmp_path / "val" / "part-0.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=5),
+    )
+
+    def best_coeffs(root):
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        gm = load_game_model(str(root / "best"), {"global": imap})
+        return np.asarray(gm.get_model("global").model.coefficients.means)
+
+    # single-process reference through the standard driver flow
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+
+    single = build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--validation-data-directories", str(tmp_path / "val"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
+        "tolerance=1e-9,regularization=L2,reg.weights=0.1|10",
+        "--evaluators", "AUC",
+    ])
+    run(single)
+    expected = best_coeffs(tmp_path / "out-single")
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_train_worker.py")
+    logs = [open(tmp_path / f"trainer{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=240)
+            assert rc == 0, (
+                f"trainer {i} failed:\n" + (tmp_path / f"trainer{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    got = best_coeffs(tmp_path / "out")
+    np.testing.assert_allclose(got, expected, atol=1e-4)
+    import json
+
+    summary = json.loads((tmp_path / "out" / "summary.json").read_text())
+    assert summary["num_processes"] == 2
+    assert len(summary["results"]) == 2  # two reg weights trained
