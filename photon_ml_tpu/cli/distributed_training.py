@@ -82,19 +82,23 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
             "evaluators other than AUC (multi-process model selection "
             "currently computes the gathered weighted AUC only)"
         )
-    for shard in {c.data_config.feature_shard_id for c in coord_configs.values()}:
-        if shard in index_maps and index_maps[shard].size > 65536:
-            reasons.append(
-                f"shard {shard!r}: {index_maps[shard].size} features — the "
-                "multi-process assembler materializes dense per-process "
-                "blocks; sparse global assembly is not implemented"
-            )
+    if (
+        getattr(args, "validation_data_directories", None)
+        and not TaskType(args.training_task).is_classification
+    ):
+        # the single-process path would select by the task's default metric
+        # (e.g. min RMSE); silently ranking by AUC over continuous labels
+        # would save a different, wrong model
+        reasons.append(
+            "validation-based selection for non-classification tasks "
+            "(multi-process selection computes AUC only)"
+        )
     return reasons
 
 
 def run_multiprocess_fixed_effect(
     args, rank: int, nproc: int, logger, root: str,
-    task, coord_configs, shard_configs, index_maps, evaluator_specs,
+    task, coord_configs, shard_configs, index_maps,
 ) -> dict:
     """The multi-process fixed-effect training flow. Returns the driver's
     summary dict; only process 0 writes output."""
@@ -171,9 +175,8 @@ def run_multiprocess_fixed_effect(
     mesh = make_mesh(len(jax.devices()))
     train_data, _ = _assemble_global(train, shard, mesh, logger)
     val_data = None
-    val_meta = None
     if val is not None:
-        val_data, val_meta = _assemble_global(val, shard, mesh, logger)
+        val_data, _ = _assemble_global(val, shard, mesh, logger)
 
     from photon_ml_tpu.parallel import train_glm_sharded
 
@@ -188,7 +191,7 @@ def run_multiprocess_fixed_effect(
         warm = coeffs
         auc = None
         if val_data is not None:
-            auc = _validation_auc(val_data, val_meta, coeffs)
+            auc = _validation_auc(val_data, coeffs)
             logger.info(
                 "lambda=%s validation AUC=%.6f",
                 opt_cfg.regularization_weight, auc,
@@ -252,13 +255,20 @@ def _assemble_global(data, shard: str, mesh, logger):
 
     Blocks are padded to a common per-process row count with weight-0 rows
     (inert in every objective reduction) so the global row count divides
-    evenly over the mesh; returns (LabeledData, (n_local_real, pad_rows))."""
+    evenly over the mesh. Sparse feature slices stay sparse: the COO triples
+    (row indices rebased to GLOBAL sample ids) are padded per process to a
+    common nnz count with zero-value entries (inert under scatter-add) and
+    sharded over the nnz axis — the billion-feature regime of
+    parallel/glm.py, assembled across processes.
+
+    Returns (LabeledData, (n_local_real, pad_rows))."""
     import jax
     import jax.numpy as jnp
+    import scipy.sparse as sp
 
     from jax.experimental import multihost_utils
     from photon_ml_tpu.data.dataset import LabeledData
-    from photon_ml_tpu.data.matrix import as_design_matrix
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix, SparseDesignMatrix
     from photon_ml_tpu.parallel.distributed import host_local_to_global
 
     nproc = jax.process_count()
@@ -268,34 +278,75 @@ def _assemble_global(data, shard: str, mesh, logger):
         multihost_utils.process_allgather(np.asarray([n_local]))
     ).ravel()
     devices_per_process = max(1, len(jax.local_devices()))
+    dev_counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray([devices_per_process]))
+    ).ravel()
+    if len(set(int(c) for c in dev_counts)) != 1:
+        # the padding target below must be computed identically everywhere;
+        # heterogeneous local device counts would give processes conflicting
+        # global shapes (a hang or shape-mismatch deep in array assembly)
+        raise ValueError(
+            f"multi-process training requires the same local device count on "
+            f"every process, got {dev_counts.tolist()}"
+        )
     per_process = -(-int(counts.max()) // devices_per_process) * devices_per_process
     pad = per_process - n_local
+    global_rows = per_process * nproc
     logger.info(
         "global assembly: local %d rows (+%d pad), %d processes x %d rows",
         n_local, pad, nproc, per_process,
     )
 
-    dense = as_design_matrix(X).to_dense()
-    Xp = np.zeros((per_process, dense.shape[1]), dtype=np.float32)
-    Xp[:n_local] = np.asarray(dense, dtype=np.float32)
-    yp = np.zeros(per_process); yp[:n_local] = np.asarray(data.labels if data.has_labels else np.zeros(n_local))
-    op = np.zeros(per_process); op[:n_local] = np.asarray(data.offsets)
-    wp = np.zeros(per_process); wp[:n_local] = np.asarray(data.weights)
+    def assemble_vec(v, fill=0.0):
+        out = np.full(per_process, fill, dtype=np.float32)
+        out[:n_local] = np.asarray(v, dtype=np.float32)
+        return host_local_to_global(out, mesh, global_rows=global_rows)
 
-    global_rows = per_process * nproc
-    Xg = host_local_to_global(Xp, mesh, global_rows=global_rows)
-    yg = host_local_to_global(yp.astype(np.float32), mesh, global_rows=global_rows)
-    og = host_local_to_global(op.astype(np.float32), mesh, global_rows=global_rows)
-    wg = host_local_to_global(wp.astype(np.float32), mesh, global_rows=global_rows)
-    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+    if sp.issparse(X):
+        coo = X.tocoo()
+        nnz_counts = np.asarray(
+            multihost_utils.process_allgather(np.asarray([coo.nnz]))
+        ).ravel()
+        per_nnz = -(-int(nnz_counts.max()) // devices_per_process) * devices_per_process
+        base = jax.process_index() * per_process
+        rows = np.zeros(per_nnz, dtype=np.int32)
+        cols = np.zeros(per_nnz, dtype=np.int32)
+        vals = np.zeros(per_nnz, dtype=np.float32)
+        rows[: coo.nnz] = coo.row.astype(np.int32) + base
+        cols[: coo.nnz] = coo.col.astype(np.int32)
+        vals[: coo.nnz] = coo.data.astype(np.float32)
+        global_nnz = per_nnz * nproc
+        Xg = SparseDesignMatrix(
+            rows=host_local_to_global(rows, mesh, global_rows=global_nnz),
+            cols=host_local_to_global(cols, mesh, global_rows=global_nnz),
+            vals=host_local_to_global(vals, mesh, global_rows=global_nnz),
+            n_rows=global_rows,
+            n_cols=X.shape[1],
+        )
+        logger.info(
+            "sparse assembly: local nnz %d (+%d pad) over %d columns",
+            coo.nnz, per_nnz - coo.nnz, X.shape[1],
+        )
+    else:
+        dense = np.asarray(X, dtype=np.float32)
+        Xp = np.zeros((per_process, dense.shape[1]), dtype=np.float32)
+        Xp[:n_local] = dense
+        Xg = DenseDesignMatrix(
+            host_local_to_global(Xp, mesh, global_rows=global_rows)
+        )
 
     return (
-        LabeledData(X=DenseDesignMatrix(Xg), labels=yg, offsets=og, weights=wg),
+        LabeledData(
+            X=Xg,
+            labels=assemble_vec(data.labels if data.has_labels else np.zeros(n_local)),
+            offsets=assemble_vec(data.offsets),
+            weights=assemble_vec(data.weights),
+        ),
         (n_local, pad),
     )
 
 
-def _validation_auc(val_data, val_meta, coeffs) -> float:
+def _validation_auc(val_data, coeffs) -> float:
     """Weighted AUC over the global validation set: every process scores its
     own addressable block and the (score, label, weight) triples are
     allgathered host-side — pad rows carry weight 0 and drop out of the

@@ -297,17 +297,14 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
                 run_multiprocess_fixed_effect,
             )
 
-            evaluator_specs = (
-                [parse_evaluator_spec(e) for e in args.evaluators.split(",") if e]
-                if args.evaluators
-                else []
-            )
             emitter.send_event(Event("TrainingStartEvent"))
             summary = run_multiprocess_fixed_effect(
                 args, rank, nproc, logger, root,
-                task, coord_configs, shard_configs, index_maps, evaluator_specs,
+                task, coord_configs, shard_configs, index_maps,
             )
-            emitter.send_event(Event("TrainingFinishEvent"))
+            emitter.send_event(
+                Event("TrainingFinishEvent", {"bestIndex": summary["best_index"]})
+            )
             return summary
 
         # date-partitioned inputs (GameDriver inputDataDateRange/DaysRange params;

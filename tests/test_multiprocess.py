@@ -338,3 +338,120 @@ def test_two_process_training_matches_single_process(tmp_path):
     summary = json.loads((tmp_path / "out" / "summary.json").read_text())
     assert summary["num_processes"] == 2
     assert len(summary["results"]) == 2  # two reg weights trained
+
+
+def test_two_process_training_wide_sparse_shard(tmp_path):
+    """Multi-process training on a WIDE sparse shard (100k features, ~6
+    nnz/row): the global assembly keeps COO triples (rebased to global sample
+    ids, nnz-padded per process) instead of materializing dense blocks — the
+    billion-feature regime of parallel/glm.py, across processes."""
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    d = 100_000
+    rng = np.random.default_rng(17)
+    imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    w_hot = rng.normal(size=32)  # signal lives on 32 hot features
+    hot = rng.choice(d, size=32, replace=False)
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            k = 6
+            js = np.concatenate([r.choice(hot, size=2), r.integers(0, d, size=k - 2)])
+            xs = r.normal(size=k)
+            z = sum(
+                w_hot[np.where(hot == j)[0][0]] * x
+                for j, x in zip(js, xs) if j in hot
+            )
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": float(z + 0.3 * r.normal() > 0),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x)}
+                    for j, x in zip(js, xs)
+                ],
+                "metadataMap": {},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "val").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(90, seed=2),
+    )
+    avro_io.write_container(
+        str(tmp_path / "val" / "part-0.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(80, seed=5),
+    )
+
+    def best_coeffs(root):
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        gm = load_game_model(str(root / "best"), {"global": imap})
+        return np.asarray(gm.get_model("global").model.coefficients.means)
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+
+    single = build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--validation-data-directories", str(tmp_path / "val"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
+        "tolerance=1e-9,regularization=L2,reg.weights=0.1|10",
+        "--evaluators", "AUC",
+    ])
+    run(single)
+    expected = best_coeffs(tmp_path / "out-single")
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_train_worker.py")
+    logs = [open(tmp_path / f"trainer{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=240)
+            assert rc == 0, (
+                f"trainer {i} failed:\n" + (tmp_path / f"trainer{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    got = best_coeffs(tmp_path / "out")
+    assert got.shape == expected.shape == (d + 1,)
+    # f32 summation-order tolerance: the single-process path reduces with a
+    # globally column-sorted segment-sum, the nnz-sharded path scatter-adds
+    # per shard — same math, different accumulation order
+    np.testing.assert_allclose(got, expected, atol=1e-3)
