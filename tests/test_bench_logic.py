@@ -263,3 +263,40 @@ def test_spawn_child_salvages_partials_on_fatal_error(monkeypatch):
     value, rec = bench._spawn_child({}, timeout_s=5)
     assert value == 430000.0
     assert rec["incomplete_sweep"] is True and rec["platform"] == "tpu"
+
+
+def test_main_scale_forwards_and_never_reports_ratios(monkeypatch):
+    """--scale N: forwarded to the child, labeled in the output, and NO ratio
+    against the (standard-shape) baseline is emitted on any platform."""
+    import contextlib
+    import io
+    import json
+
+    seen = {}
+
+    def child(env, timeout_s, extra_args=()):
+        seen["extra_args"] = extra_args
+        return 900000.0, {"child_value": 900000.0, "platform": "tpu", "variant": "v"}
+
+    monkeypatch.setattr(bench, "_probe_backend", lambda timeout_s: (True, "x"))
+    monkeypatch.setattr(bench, "_spawn_child", child)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py", "--scale", "200"])
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert seen["extra_args"] == ("--scale", "200.0")
+    assert out["scale"] == 200.0
+    assert out["vs_baseline"] is None
+    assert "cpu_value_vs_recorded_cpu_baseline" not in out
+
+
+def test_main_rejects_scaled_baseline_recording(monkeypatch):
+    import pytest as _pytest
+
+    monkeypatch.setattr(
+        bench.sys, "argv", ["bench.py", "--record-cpu-baseline", "--scale", "200"]
+    )
+    with _pytest.raises(SystemExit) as e:
+        bench.main()
+    assert e.value.code == 2
