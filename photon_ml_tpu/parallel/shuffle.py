@@ -1,0 +1,148 @@
+"""Cross-process entity exchange — the shuffle analog for distributed ingest.
+
+Random-effect datasets group samples BY ENTITY, and one entity's samples can
+span input files owned by different processes. The reference leans on a Spark
+shuffle (RandomEffectDataset.scala's partitioned groupBy); here the exchange
+rides the shared filesystem the CLI drivers already require for their output:
+each process partitions its rows by the owner of their entity
+(content-hashed, so the partition is independent of file order and process
+count), spills one ``.npz`` per (sender, owner) pair, crosses a runtime
+barrier, and reads back every spill addressed to it.
+
+A filesystem exchange instead of an in-program all-to-all is deliberate:
+row counts per (sender, owner) pair are data-dependent, while XLA
+collectives want static shapes — and ingest runs ONCE per job, so the
+exchange is nowhere near the training hot path (the same reasoning as
+Spark's disk shuffle).
+
+Determinism: rows arrive at the owner sorted by (sender rank, original
+order), so downstream grouping is reproducible for any process count.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Mapping, Sequence
+
+import numpy as np
+
+
+def entity_owner_hash(entity_ids: Sequence) -> np.ndarray:
+    """Stable content hash of entity-id strings -> uint64.
+
+    blake2b-based like the reservoir seeds (data/random_effect.py): the
+    owner assignment must not depend on file order, process count, or Python
+    hash randomization. Hashes each UNIQUE id once and broadcasts — rows
+    vastly outnumber entities at the shapes this serves (20M rows / 140k
+    entities at the north-star scale)."""
+    ids = np.asarray([str(e) for e in entity_ids], dtype=object)
+    uniq, inverse = np.unique(ids, return_inverse=True)
+    hashes = np.empty(len(uniq), dtype=np.uint64)
+    for i, e in enumerate(uniq):
+        digest = hashlib.blake2b(e.encode(), digest_size=8).digest()
+        hashes[i] = np.frombuffer(digest, dtype=np.uint64)[0]
+    return hashes[inverse]
+
+
+def exchange_rows_by_entity(
+    spill_dir: str,
+    tag: str,
+    entity_ids: Sequence,
+    columns: Mapping[str, np.ndarray],
+    rank: int,
+    nproc: int,
+) -> str:
+    """Spill each row toward the process owning its entity; returns the
+    exchange directory (read back with :func:`collect_exchanged_rows` after
+    a barrier).
+
+    ``columns``: named per-row arrays (any dtypes/shapes with a leading row
+    axis) that travel WITH the entity ids. Receivers see rows from every
+    sender concatenated in sender-rank order. ``tag`` namespaces the exchange
+    (one per RE coordinate / purpose) inside ``spill_dir``.
+
+    The caller must hold the processes in step around this call — a runtime
+    barrier AFTER all spills are written and before reads (the function does
+    NOT barrier itself so several exchanges can spill before one barrier).
+    Use ``spill_and_barrier`` for the common single-exchange case.
+    """
+    ids = np.asarray(entity_ids, dtype=object)
+    n = len(ids)
+    for name, col in columns.items():
+        if len(col) != n:
+            raise ValueError(f"column {name!r} has {len(col)} rows, ids have {n}")
+    owners = (entity_owner_hash(ids) % np.uint64(nproc)).astype(np.int64)
+
+    out_dir = os.path.join(spill_dir, tag)
+    os.makedirs(out_dir, exist_ok=True)
+    for owner in range(nproc):
+        take = np.flatnonzero(owners == owner)
+        payload = {"entity_ids": ids[take].astype(str)}
+        for name, col in columns.items():
+            payload[f"col_{name}"] = np.asarray(col)[take]
+        tmp = os.path.join(out_dir, f".from{rank:05d}-to{owner:05d}.npz.tmp")
+        final = os.path.join(out_dir, f"from{rank:05d}-to{owner:05d}.npz")
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, final)  # atomic publish: the barrier sees whole files
+
+    return out_dir
+
+
+def collect_exchanged_rows(
+    out_dir: str, rank: int, nproc: int
+) -> tuple[np.ndarray, dict[str, np.ndarray]]:
+    """Read every spill addressed to this process (after the barrier)."""
+    ids_parts = []
+    col_parts: dict[str, list] = {}
+    col_names = None
+    for sender in range(nproc):
+        path = os.path.join(out_dir, f"from{sender:05d}-to{rank:05d}.npz")
+        with np.load(path, allow_pickle=False) as z:
+            names = sorted(k[4:] for k in z.files if k.startswith("col_"))
+            if col_names is None:
+                col_names = names
+            elif names != col_names:
+                # a disagreeing sender would silently misalign columns with
+                # entity_ids after concatenation — fail at the exchange
+                raise ValueError(
+                    f"sender {sender} spilled columns {names}, expected "
+                    f"{col_names} (all senders must agree)"
+                )
+            n_rows = len(z["entity_ids"])
+            ids_parts.append(z["entity_ids"])
+            for name in names:
+                col = z[f"col_{name}"]
+                if len(col) != n_rows:
+                    raise ValueError(
+                        f"sender {sender} column {name!r}: {len(col)} rows "
+                        f"for {n_rows} entity ids"
+                    )
+                col_parts.setdefault(name, []).append(col)
+    ids = (
+        np.concatenate(ids_parts).astype(object)
+        if ids_parts
+        else np.zeros(0, dtype=object)
+    )
+    cols = {name: np.concatenate(parts) for name, parts in col_parts.items()}
+    return ids, cols
+
+
+def spill_and_barrier(
+    spill_dir: str,
+    tag: str,
+    entity_ids: Sequence,
+    columns: Mapping[str, np.ndarray],
+    rank: int,
+    nproc: int,
+) -> tuple[np.ndarray, dict[str, np.ndarray]]:
+    """exchange_rows_by_entity + runtime barrier + collect, in one call."""
+    out_dir = exchange_rows_by_entity(
+        spill_dir, tag, entity_ids, columns, rank, nproc
+    )
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"photon-shuffle-{tag}")
+    return collect_exchanged_rows(out_dir, rank, nproc)
