@@ -96,6 +96,36 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
     return reasons
 
 
+
+def _read_file_slice(
+    directories, date_range, days_range, what,
+    shard_configs, index_maps, id_tags, rank, nproc, logger,
+):
+    """Round-robin file-slice ingest shared by the multi-process paths."""
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.game_data import GameInput
+    from photon_ml_tpu.data.readers import read_merged_avro
+    from photon_ml_tpu.util.date_range import resolve_input_paths
+    import scipy.sparse as sp
+
+    paths = resolve_input_paths(directories, date_range, days_range)
+    all_files = avro_io.container_files(paths)
+    mine = all_files[rank::nproc]
+    logger.info(
+        "process %d/%d reading %d of %d %s part files",
+        rank, nproc, len(mine), len(all_files), what,
+    )
+    if not mine:
+        shards = {s for s in index_maps}
+        return GameInput(
+            features={s: sp.csr_matrix((0, index_maps[s].size)) for s in shards},
+            labels=np.zeros(0),
+            id_columns={t: np.zeros(0, dtype=object) for t in id_tags},
+        )
+    data, _, _ = read_merged_avro(mine, shard_configs, index_maps, id_tags)
+    return data
+
+
 def run_multiprocess_fixed_effect(
     args, rank: int, nproc: int, logger, root: str,
     task, coord_configs, shard_configs, index_maps,
@@ -106,13 +136,10 @@ def run_multiprocess_fixed_effect(
     import jax.numpy as jnp
 
     from photon_ml_tpu.cli.game_training_driver import _save_result
-    from photon_ml_tpu.data import avro_io
-    from photon_ml_tpu.data.readers import read_merged_avro
     from photon_ml_tpu.estimators.game_estimator import GameResult
     from photon_ml_tpu.models.game import FixedEffectModel, GameModel
     from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
     from photon_ml_tpu.parallel import make_mesh
-    from photon_ml_tpu.util.date_range import resolve_input_paths
     from photon_ml_tpu.util.timed import Timed
 
     reasons = multiprocess_fe_ineligibilities(args, coord_configs, index_maps)
@@ -125,23 +152,10 @@ def run_multiprocess_fixed_effect(
     shard = cfg.data_config.feature_shard_id
 
     def read_slice(directories, date_range, days_range, what):
-        paths = resolve_input_paths(directories, date_range, days_range)
-        all_files = avro_io.container_files(paths)
-        mine = all_files[rank::nproc]
-        logger.info(
-            "process %d/%d reading %d of %d %s part files",
-            rank, nproc, len(mine), len(all_files), what,
+        return _read_file_slice(
+            directories, date_range, days_range, what,
+            shard_configs, index_maps, (), rank, nproc, logger,
         )
-        if not mine:
-            from photon_ml_tpu.data.game_data import GameInput
-            import scipy.sparse as sp
-
-            return GameInput(
-                features={shard: sp.csr_matrix((0, index_maps[shard].size))},
-                labels=np.zeros(0), id_columns={},
-            )
-        data, _, _ = read_merged_avro(mine, shard_configs, index_maps)
-        return data
 
     with Timed("read training data", logger):
         train = read_slice(
@@ -348,14 +362,9 @@ def _assemble_global(data, shard: str, mesh, logger):
 
 def _validation_auc(val_data, coeffs) -> float:
     """Weighted AUC over the global validation set: every process scores its
-    own addressable block and the (score, label, weight) triples are
-    allgathered host-side — pad rows carry weight 0 and drop out of the
+    own addressable block; pad rows carry weight 0 and drop out of the
     weighted pair statistic."""
     import jax.numpy as jnp
-    import numpy as np
-    from jax.experimental import multihost_utils
-
-    from photon_ml_tpu.evaluation.evaluators import auc_roc
 
     scores = val_data.X.matvec(jnp.asarray(coeffs)) + val_data.offsets
 
@@ -364,10 +373,408 @@ def _validation_auc(val_data, coeffs) -> float:
             [np.asarray(s.data) for s in arr.addressable_shards]
         )
 
-    local = (
-        local_block(scores),
-        local_block(val_data.labels),
-        local_block(val_data.weights),
+    return _gathered_auc(
+        local_block(scores), local_block(val_data.labels), local_block(val_data.weights)
     )
-    s, l, w = (np.asarray(x).reshape(-1) for x in multihost_utils.process_allgather(local))
+
+
+def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[str]:
+    """Why this GAME configuration cannot train multi-process. Empty = OK.
+
+    The GAME flow adds random-effect coordinates to the fixed-effect path:
+    samples route to entity OWNER processes through the filesystem shuffle
+    (parallel/shuffle.py), owners solve their entities locally, and residual
+    scores travel home per coordinate update — the reference's per-iteration
+    score-exchange joins (CoordinateDescent.scala:197-204) over the shared
+    filesystem instead of Spark."""
+    from photon_ml_tpu.estimators.config import (
+        FixedEffectDataConfiguration,
+        RandomEffectDataConfiguration,
+    )
+
+    reasons: list[str] = []
+    ids = list(coord_configs)
+    if not ids or not isinstance(
+        coord_configs[ids[0]].data_config, FixedEffectDataConfiguration
+    ):
+        reasons.append("the first coordinate must be the fixed effect")
+    for cid in ids[1:]:
+        dc = coord_configs[cid].data_config
+        if not isinstance(dc, RandomEffectDataConfiguration):
+            reasons.append(f"coordinate {cid!r}: only [fixed, random...] sequences")
+            continue
+        if dc.projector is not None:
+            reasons.append(f"coordinate {cid!r}: random projection")
+        if dc.feature_shard_id in index_maps and (
+            index_maps[dc.feature_shard_id].size > 4096
+        ):
+            reasons.append(
+                f"coordinate {cid!r}: random-effect shard wider than 4096 "
+                "(exchange rows travel dense)"
+            )
+        if coord_configs[cid].per_entity_reg_weights:
+            reasons.append(f"coordinate {cid!r}: per-entity regularization weights")
+    for cid, cfg in coord_configs.items():
+        if 0.0 < cfg.down_sampling_rate < 1.0:
+            reasons.append(f"coordinate {cid!r}: down-sampling")
+        if cfg.box_constraints is not None:
+            reasons.append(f"coordinate {cid!r}: box constraints")
+        if cfg.data_config.feature_shard_id not in index_maps:
+            reasons.append(
+                f"shard {cfg.data_config.feature_shard_id!r}: multi-process "
+                "training requires PREBUILT index maps"
+            )
+    if getattr(args, "validation_data_directories", None):
+        # single-process selection keeps the best PER-UPDATE snapshot
+        # (coordinate_descent.py best-model tracking); evaluating once per
+        # configuration here would silently save a different model
+        reasons.append(
+            "validation-based selection (single-process GAME selection keeps "
+            "per-update best snapshots; train without validation and evaluate "
+            "the saved models with the scoring driver)"
+        )
+    # the flag-level restrictions are identical to the fixed-effect path
+    fe_only = {ids[0]: coord_configs[ids[0]]} if ids else {}
+    for r in multiprocess_fe_ineligibilities(args, fe_only, index_maps):
+        if r not in reasons and r != MULTIPROC_DESIGN_POINTER:
+            reasons.append(r)
+    return reasons
+
+
+def run_multiprocess_game(
+    args, rank: int, nproc: int, logger, root: str,
+    task, coord_configs, shard_configs, index_maps,
+) -> dict:
+    """Multi-process GAME training: sharded fixed-effect solves + owner-local
+    random-effect solves + per-update residual score exchanges."""
+    import jax
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.algorithm.random_effect import train_random_effect
+    from photon_ml_tpu.cli.game_training_driver import _save_result
+    from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+    from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
+    from photon_ml_tpu.estimators.config import RandomEffectDataConfiguration
+    from photon_ml_tpu.estimators.game_estimator import GameResult
+    from photon_ml_tpu.estimators.config import expand_game_configurations
+    from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+    from photon_ml_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_ml_tpu.parallel import make_mesh, train_glm_sharded
+    from photon_ml_tpu.parallel.shuffle import (
+        collect_exchanged_rows,
+        entity_owner_hash,
+        exchange_rows,
+        shuffle_barrier,
+    )
+    from photon_ml_tpu.util.timed import Timed
+
+    reasons = multiprocess_game_ineligibilities(args, coord_configs, index_maps)
+    if reasons:
+        raise NotImplementedError(
+            "configuration not eligible for multi-process GAME training: "
+            + "; ".join(sorted(set(reasons)))
+        )
+    coord_ids = list(coord_configs)
+    fe_cid, re_cids = coord_ids[0], coord_ids[1:]
+    fe_shard = coord_configs[fe_cid].data_config.feature_shard_id
+    id_tags = sorted(
+        {coord_configs[c].data_config.random_effect_type for c in re_cids}
+    )
+    spill = os.path.join(root, "_shuffle")
+
+    def read_slice(directories, date_range, days_range, what):
+        return _read_file_slice(
+            directories, date_range, days_range, what,
+            shard_configs, index_maps, id_tags, rank, nproc, logger,
+        )
+
+    with Timed("read training data", logger):
+        train = read_slice(
+            args.input_data_directories,
+            getattr(args, "input_data_date_range", None),
+            getattr(args, "input_data_days_range", None),
+            "training",
+        )
+    if train.n:
+        with Timed("data validation", logger):
+            sanity_check_data(
+                task, train.labels, offsets=train.offsets, weights=train.weights,
+                feature_shards=train.features,
+                validation_type=DataValidationType(args.data_validation),
+            )
+    # validation-based selection is rejected by eligibility (per-update best
+    # snapshots cannot be reproduced here); selection is the last sweep config
+    mesh = make_mesh(len(jax.devices()))
+    fe_train, layout = _assemble_global(train, fe_shard, mesh, logger)
+    n_local, _pad = layout
+    per_process = fe_train.labels.shape[0] // nproc
+    gid_base = rank * per_process
+    gids_local = np.arange(n_local, dtype=np.int64) + gid_base
+
+    # ---- per-coordinate entity exchange (ingest; once) ------------------------
+    class RECoord:
+        pass
+
+    coords: dict[str, RECoord] = {}
+    for cid in re_cids:
+        dc: RandomEffectDataConfiguration = coord_configs[cid].data_config
+        c = RECoord()
+        c.shard = dc.feature_shard_id
+        c.home_ids = np.asarray(train.ids(dc.random_effect_type), dtype=object)
+        c.owner_of_local = (
+            entity_owner_hash(c.home_ids) % np.uint64(nproc)
+        ).astype(np.int64) if n_local else np.zeros(0, dtype=np.int64)
+        X_re = train.shard(c.shard)
+        dense_rows = (
+            np.asarray(X_re.todense(), dtype=np.float32)
+            if sp.issparse(X_re)
+            else np.asarray(X_re, dtype=np.float32)
+        )
+        exchange_rows(
+            spill, f"{cid}-ingest", c.owner_of_local, c.home_ids,
+            {
+                "gid": gids_local,
+                "label": np.asarray(train.labels, dtype=np.float64) if train.has_labels else np.zeros(n_local),
+                "weight": np.asarray(train.weights, dtype=np.float64),
+                "x": dense_rows,
+            },
+            rank, nproc,
+        )
+        coords[cid] = c
+    shuffle_barrier("ingest")
+
+    for cid, c in coords.items():
+        own_ids, own = collect_exchanged_rows(
+            os.path.join(spill, f"{cid}-ingest"), rank, nproc
+        )
+        c.gids_own = own["gid"].astype(np.int64)
+        dc = coord_configs[cid].data_config
+        with Timed(f"build RE dataset {cid} ({len(own_ids)} rows)", logger):
+            c.ds = build_random_effect_dataset(
+                sp.csr_matrix(own["x"].astype(np.float64)),
+                own_ids,
+                dc.random_effect_type,
+                feature_shard_id=dc.feature_shard_id,
+                active_data_upper_bound=dc.active_data_upper_bound,
+                active_data_lower_bound=dc.active_data_lower_bound,
+                features_max=dc.features_max,
+                labels=own["label"],
+                weights=own["weight"],
+                dtype=jnp.float32,
+            )
+        c.home_of_own = c.gids_own // per_process
+
+    # ---- sweep: warm-started coordinate descent -------------------------------
+    def send_scores(tag, gids, scores, home_of, n_dest_local, dest_base):
+        """Owner -> home score return; gives the home-aligned [n] array."""
+        exchange_rows(
+            spill, tag, home_of, np.zeros(len(gids), dtype=object),
+            {"gid": gids, "s": np.asarray(scores, dtype=np.float64)},
+            rank, nproc,
+        )
+        shuffle_barrier(tag)
+        _, got = collect_exchanged_rows(os.path.join(spill, tag), rank, nproc)
+        out = np.zeros(n_dest_local)
+        out[got["gid"].astype(np.int64) - dest_base] = got["s"]
+        return out
+
+    def send_offsets(tag, c, partial_home):
+        """Home -> owner residual offsets, aligned to the owner's dataset rows."""
+        exchange_rows(
+            spill, tag, c.owner_of_local, c.home_ids,
+            {"gid": gids_local, "o": np.asarray(partial_home, dtype=np.float64)},
+            rank, nproc,
+        )
+        shuffle_barrier(tag)
+        _, got = collect_exchanged_rows(os.path.join(spill, tag), rank, nproc)
+        aligned = np.zeros(len(c.gids_own))
+        order = np.argsort(c.gids_own)
+        pos = order[np.searchsorted(c.gids_own[order], got["gid"].astype(np.int64))]
+        aligned[pos] = got["o"]
+        return aligned
+
+    base_off_home = np.asarray(train.offsets, dtype=np.float64)
+    sweep = expand_game_configurations(coord_configs)
+    n_iter = args.coordinate_descent_iterations
+    fe_coeffs = None
+    re_models = {cid: None for cid in re_cids}
+    re_scores_home = {cid: np.zeros(n_local) for cid in re_cids}
+    per_config = []
+    for i, opt_configs in enumerate(sweep):
+        for p in range(n_iter):
+            # fixed effect: residual = base + sum of RE scores
+            off_home = base_off_home + sum(re_scores_home.values())
+            off_pad = np.zeros(per_process)
+            off_pad[:n_local] = off_home
+            from photon_ml_tpu.parallel.distributed import host_local_to_global
+
+            fe_data = dataclasses_replace_offsets(fe_train, host_local_to_global(
+                off_pad.astype(np.float32), mesh,
+                global_rows=fe_train.labels.shape[0],
+            ))
+            with Timed(f"cfg{i} pass{p} fixed-effect solve", logger):
+                fe_coeffs, _ = train_glm_sharded(
+                    fe_data, task, opt_configs[fe_cid], mesh,
+                    initial_coefficients=fe_coeffs,
+                )
+            fe_home = _local_scores(fe_train, fe_coeffs, n_local)
+            for cid in re_cids:
+                c = coords[cid]
+                partial = base_off_home + fe_home + sum(
+                    s for k, s in re_scores_home.items() if k != cid
+                )
+                off_own = send_offsets(f"c{i}p{p}{cid}-off", c, partial)
+                with Timed(f"cfg{i} pass{p} {cid} solve", logger):
+                    model, _tracker = train_random_effect(
+                        c.ds, task, opt_configs[cid], jnp.asarray(off_own, jnp.float32),
+                        initial_model=re_models[cid], dtype=jnp.float32,
+                    )
+                re_models[cid] = model
+                own_scores = np.asarray(model.score_dataset(c.ds))
+                re_scores_home[cid] = send_scores(
+                    f"c{i}p{p}{cid}-sc", c.gids_own, own_scores,
+                    c.home_of_own, n_local, gid_base,
+                )
+        auc = None
+        per_config.append({
+            "configs": opt_configs,
+            "fe": np.asarray(fe_coeffs),
+            "re": {cid: re_models[cid] for cid in re_cids},
+            "auc": auc,
+        })
+
+    best_i = len(per_config) - 1  # no validation: last (weakest-reg) config
+    logger.info("selected model %d of %d", best_i, len(per_config))
+    summary = {
+        "multiprocess": True,
+        "results": [
+            {
+                "regularization_weight": {
+                    cid: r["configs"][cid].regularization_weight for cid in coord_ids
+                },
+                "auc": r["auc"],
+            }
+            for r in per_config
+        ],
+        "best_index": best_i,
+        "output_directory": root,
+        "num_processes": nproc,
+    }
+
+    # ---- assemble + save the best model (rank 0) ------------------------------
+    best = per_config[best_i]
+    model_dir = os.path.join(spill, "model-parts")
+    os.makedirs(model_dir, exist_ok=True)
+    for cid in re_cids:
+        m = best["re"][cid]
+        np.savez(
+            os.path.join(model_dir, f"{cid}-part{rank:05d}.npz"),
+            entity_ids=np.asarray(m.entity_ids, dtype=str),
+            coeffs=np.asarray(m.coeffs),
+            proj=np.asarray(m.proj_indices),
+        )
+    shuffle_barrier("model-parts")
+    if rank == 0:
+        glm = GeneralizedLinearModel(
+            Coefficients(jnp.asarray(best["fe"])), TaskType(task)
+        )
+        models = {cid: FixedEffectModel(model=glm, feature_shard_id=fe_shard)
+                  for cid in [fe_cid]}
+        for cid in re_cids:
+            parts = []
+            for r in range(nproc):
+                with np.load(
+                    os.path.join(model_dir, f"{cid}-part{r:05d}.npz")
+                ) as z:
+                    parts.append({k: z[k] for k in z.files})
+            k_max = max(int(p["coeffs"].shape[1]) if p["coeffs"].size else 1 for p in parts)
+            ids_all, coeff_rows, proj_rows = [], [], []
+            for part in parts:
+                e = len(part["entity_ids"])
+                ids_all.extend(str(x) for x in part["entity_ids"])
+                cpad = np.zeros((e, k_max), dtype=np.float32)
+                ppad = np.full((e, k_max), -1, dtype=np.int32)
+                if e:
+                    k = part["coeffs"].shape[1]
+                    cpad[:, :k] = part["coeffs"]
+                    ppad[:, :k] = part["proj"]
+                coeff_rows.append(cpad)
+                proj_rows.append(ppad)
+            dc = coord_configs[cid].data_config
+            models[cid] = RandomEffectModel(
+                re_type=dc.random_effect_type,
+                feature_shard_id=dc.feature_shard_id,
+                task=TaskType(task),
+                entity_ids=tuple(ids_all),
+                coeffs=jnp.asarray(np.concatenate(coeff_rows) if ids_all else np.zeros((0, 1))),
+                proj_indices=jnp.asarray(
+                    np.concatenate(proj_rows) if ids_all else np.full((0, 1), -1, np.int32)
+                ),
+            )
+        game_model = GameModel(models={c: models[c] for c in coord_ids})
+        result = GameResult(
+            model=game_model, best_model=game_model,
+            configuration=best["configs"],
+            evaluations={"AUC": best["auc"]} if best["auc"] is not None else None,
+            best_metric=best["auc"], descent=None,
+        )
+        imaps_by_coord = {
+            c: index_maps[coord_configs[c].data_config.feature_shard_id]
+            for c in coord_ids
+        }
+        _save_result(
+            os.path.join(root, "best"), result, imaps_by_coord,
+            coord_configs, args.model_sparsity_threshold, logger,
+        )
+        os.makedirs(os.path.join(root, "index-maps"), exist_ok=True)
+        for shard in {c.data_config.feature_shard_id for c in coord_configs.values()}:
+            index_maps[shard].save(os.path.join(root, "index-maps", f"{shard}.npz"))
+        with open(os.path.join(root, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+    shuffle_barrier("train-done")
+    return summary
+
+
+def dataclasses_replace_offsets(data, offsets):
+    import dataclasses as _dc
+
+    return _dc.replace(data, offsets=offsets)
+
+
+def _local_scores(global_data, coeffs, n_local):
+    """This process's rows of X @ coeffs for a globally sharded LabeledData."""
+    import jax.numpy as jnp
+
+    scores = global_data.X.matvec(jnp.asarray(coeffs))
+
+    def local_block(arr):
+        return np.concatenate([np.asarray(s.data) for s in arr.addressable_shards])
+
+    return local_block(scores)[:n_local].astype(np.float64)
+
+
+def _gathered_auc(scores, labels, weights) -> float:
+    """Weighted AUC over host-gathered per-process blocks (ragged-safe:
+    blocks travel as object lists only when equal shapes are not guaranteed,
+    so gather each array padded with weight-0 rows)."""
+    from jax.experimental import multihost_utils
+
+    from photon_ml_tpu.evaluation.evaluators import auc_roc
+
+    n = np.asarray([len(scores)])
+    counts = np.asarray(multihost_utils.process_allgather(n)).ravel()
+    m = int(counts.max()) if len(counts) else 0
+
+    def pad(v):
+        out = np.zeros(m)
+        out[: len(v)] = v
+        return out
+
+    s, l, w = (
+        np.asarray(x).reshape(-1)
+        for x in multihost_utils.process_allgather(
+            (pad(scores), pad(labels), pad(weights))
+        )
+    )
     return float(auc_roc(s, l, weights=w))

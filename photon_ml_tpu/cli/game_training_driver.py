@@ -289,16 +289,22 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
         index_maps = _load_index_maps(args.off_heap_index_map_directory, shard_configs)
 
         if nproc > 1:
-            # multi-process training: the fixed-effect path runs per-process
-            # sharded ingest + global collectives; anything needing the
-            # cross-process entity exchange fails loudly with the design
-            # pointer (docs/DISTRIBUTED.md)
+            # multi-process training: fixed-effect-only configs run
+            # per-process sharded ingest + global collectives; GAME configs
+            # route through the entity exchange (docs/DISTRIBUTED.md) —
+            # anything either path cannot reproduce fails loudly with reasons
             from photon_ml_tpu.cli.distributed_training import (
                 run_multiprocess_fixed_effect,
+                run_multiprocess_game,
             )
 
+            has_re = any(
+                isinstance(c.data_config, RandomEffectDataConfiguration)
+                for c in coord_configs.values()
+            )
+            runner = run_multiprocess_game if has_re else run_multiprocess_fixed_effect
             emitter.send_event(Event("TrainingStartEvent"))
-            summary = run_multiprocess_fixed_effect(
+            summary = runner(
                 args, rank, nproc, logger, root,
                 task, coord_configs, shard_configs, index_maps,
             )

@@ -45,22 +45,22 @@ def entity_owner_hash(entity_ids: Sequence) -> np.ndarray:
     return hashes[inverse]
 
 
-def exchange_rows_by_entity(
+def exchange_rows(
     spill_dir: str,
     tag: str,
+    dest: np.ndarray,
     entity_ids: Sequence,
     columns: Mapping[str, np.ndarray],
     rank: int,
     nproc: int,
 ) -> str:
-    """Spill each row toward the process owning its entity; returns the
-    exchange directory (read back with :func:`collect_exchanged_rows` after
-    a barrier).
+    """Spill each row toward ``dest[i]``; returns the exchange directory
+    (read back with :func:`collect_exchanged_rows` after a barrier).
 
     ``columns``: named per-row arrays (any dtypes/shapes with a leading row
     axis) that travel WITH the entity ids. Receivers see rows from every
     sender concatenated in sender-rank order. ``tag`` namespaces the exchange
-    (one per RE coordinate / purpose) inside ``spill_dir``.
+    (one per purpose) inside ``spill_dir``.
 
     The caller must hold the processes in step around this call — a runtime
     barrier AFTER all spills are written and before reads (the function does
@@ -69,15 +69,17 @@ def exchange_rows_by_entity(
     """
     ids = np.asarray(entity_ids, dtype=object)
     n = len(ids)
+    dest = np.asarray(dest, dtype=np.int64)
+    if len(dest) != n:
+        raise ValueError(f"dest has {len(dest)} rows, ids have {n}")
     for name, col in columns.items():
         if len(col) != n:
             raise ValueError(f"column {name!r} has {len(col)} rows, ids have {n}")
-    owners = (entity_owner_hash(ids) % np.uint64(nproc)).astype(np.int64)
 
     out_dir = os.path.join(spill_dir, tag)
     os.makedirs(out_dir, exist_ok=True)
     for owner in range(nproc):
-        take = np.flatnonzero(owners == owner)
+        take = np.flatnonzero(dest == owner)
         payload = {"entity_ids": ids[take].astype(str)}
         for name, col in columns.items():
             payload[f"col_{name}"] = np.asarray(col)[take]
@@ -88,6 +90,22 @@ def exchange_rows_by_entity(
         os.replace(tmp, final)  # atomic publish: the barrier sees whole files
 
     return out_dir
+
+
+def exchange_rows_by_entity(
+    spill_dir: str,
+    tag: str,
+    entity_ids: Sequence,
+    columns: Mapping[str, np.ndarray],
+    rank: int,
+    nproc: int,
+) -> str:
+    """:func:`exchange_rows` with destinations = the entity owners
+    (content-hashed — independent of file order and process count)."""
+    owners = (
+        entity_owner_hash(np.asarray(entity_ids, dtype=object)) % np.uint64(nproc)
+    ).astype(np.int64)
+    return exchange_rows(spill_dir, tag, owners, entity_ids, columns, rank, nproc)
 
 
 def collect_exchanged_rows(
@@ -129,6 +147,16 @@ def collect_exchanged_rows(
     return ids, cols
 
 
+def shuffle_barrier(tag: str) -> None:
+    """Runtime barrier between spill and collect (no-op single-process)."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"photon-shuffle-{tag}")
+
+
 def spill_and_barrier(
     spill_dir: str,
     tag: str,
@@ -141,8 +169,5 @@ def spill_and_barrier(
     out_dir = exchange_rows_by_entity(
         spill_dir, tag, entity_ids, columns, rank, nproc
     )
-    if nproc > 1:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(f"photon-shuffle-{tag}")
+    shuffle_barrier(tag)
     return collect_exchanged_rows(out_dir, rank, nproc)

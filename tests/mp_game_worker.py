@@ -1,0 +1,46 @@
+"""Worker for the two-process distributed GAME training test: fixed effect +
+per-user random effect, entity exchange + per-pass score exchanges over the
+shared filesystem.
+
+Run as: python mp_game_worker.py <pid> <nproc> <port> <workdir>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port, workdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+
+    args = build_arg_parser().parse_args([
+        "--input-data-directories", os.path.join(workdir, "in"),
+        "--root-output-directory", os.path.join(workdir, "out"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=reFeatures",
+        "--off-heap-index-map-directory", os.path.join(workdir, "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-descent-iterations", "2",
+        "--distributed-coordinator", f"localhost:{port}",
+        "--distributed-num-processes", str(nproc),
+        "--distributed-process-id", str(pid),
+    ])
+    run(args)
+
+
+if __name__ == "__main__":
+    main()

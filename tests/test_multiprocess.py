@@ -455,3 +455,129 @@ def test_two_process_training_wide_sparse_shard(tmp_path):
     # globally column-sorted segment-sum, the nnz-sharded path scatter-adds
     # per shard — same math, different accumulation order
     np.testing.assert_allclose(got, expected, atol=1e-3)
+
+
+def test_two_process_game_training_matches_single_process(tmp_path):
+    """Distributed GAME training (fixed + per-user random effect): entity
+    exchange routes each user's samples to its owner process, residual score
+    exchanges cross the shared filesystem per coordinate update, and the
+    saved model must match the single-process driver run — fixed-effect
+    coefficients AND every per-entity random-effect row."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(23)
+    d, n_users, n = 4, 11, 360
+    w_true = rng.normal(size=d)
+    u_eff = 1.2 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "reFeatures": [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(200, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(160, seed=2),
+    )
+
+    def load(root):
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        return load_game_model(
+            str(root / "best"), {"global": fe_imap, "per-user": re_imap}
+        )
+
+    common = [
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=reFeatures",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-descent-iterations", "2",
+    ]
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+
+    run(build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        *common,
+    ]))
+    ref = load(tmp_path / "out-single")
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_game_worker.py")
+    logs = [open(tmp_path / f"gamer{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (
+                f"gamer {i} failed:\n" + (tmp_path / f"gamer{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    got = load(tmp_path / "out")
+    fe_ref = np.asarray(ref.get_model("global").model.coefficients.means)
+    fe_got = np.asarray(got.get_model("global").model.coefficients.means)
+    np.testing.assert_allclose(fe_got, fe_ref, atol=2e-4)
+
+    re_ref, re_got = ref.get_model("per-user"), got.get_model("per-user")
+    assert set(re_got.entity_ids) == set(re_ref.entity_ids) and len(
+        re_got.entity_ids
+    ) == n_users
+    for eid in re_ref.entity_ids:
+        a = re_ref.coefficients_for_entity(eid)
+        b = re_got.coefficients_for_entity(eid)
+        np.testing.assert_allclose(b, a, atol=2e-4, err_msg=str(eid))
