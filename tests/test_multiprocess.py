@@ -581,3 +581,107 @@ def test_two_process_game_training_matches_single_process(tmp_path):
         a = re_ref.coefficients_for_entity(eid)
         b = re_got.coefficients_for_entity(eid)
         np.testing.assert_allclose(b, a, atol=2e-4, err_msg=str(eid))
+
+
+def test_two_process_two_device_training(tmp_path):
+    """2 processes x 2 local devices each (the pod shape: several chips per
+    host): the global mesh spans 4 devices, per-process padding targets the
+    local device count, and the trained model still matches single-process."""
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(31)
+    d, n = 4, 320
+    w_true = rng.normal(size=d)
+    imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": float((x @ w_true + 0.3 * r.normal()) > 0),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "val").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(200, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(120, seed=2),
+    )
+    avro_io.write_container(
+        str(tmp_path / "val" / "part-0.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(100, seed=5),
+    )
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    run(build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--validation-data-directories", str(tmp_path / "val"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
+        "tolerance=1e-9,regularization=L2,reg.weights=0.1|10",
+        "--evaluators", "AUC",
+    ]))
+
+    def best_coeffs(root):
+        gm = load_game_model(str(root / "best"), {"global": imap})
+        return np.asarray(gm.get_model("global").model.coefficients.means)
+
+    expected = best_coeffs(tmp_path / "out-single")
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",  # 2 per process
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_train_worker.py")
+    logs = [open(tmp_path / f"pod{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=240)
+            assert rc == 0, (
+                f"pod {i} failed:\n" + (tmp_path / f"pod{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    got = best_coeffs(tmp_path / "out")
+    np.testing.assert_allclose(got, expected, atol=1e-4)
