@@ -733,6 +733,12 @@ def run_multiprocess_game(
         with open(os.path.join(root, "summary.json"), "w") as f:
             json.dump(summary, f, indent=2)
     shuffle_barrier("train-done")
+    if rank == 0:
+        # every rank is past its last read (the barrier above): the spills
+        # are scratch, not output
+        import shutil
+
+        shutil.rmtree(spill, ignore_errors=True)
     return summary
 
 
