@@ -685,3 +685,120 @@ def test_two_process_two_device_training(tmp_path):
 
     got = best_coeffs(tmp_path / "out")
     np.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_two_process_game_training_single_entity(tmp_path):
+    """One entity total: one process owns ALL random-effect work, the other
+    owns none — empty owner datasets, empty model parts and empty score
+    sends must flow through every exchange without deadlock or error."""
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(41)
+    d, n = 3, 140
+    w_true = rng.normal(size=d)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": float((x @ w_true + 0.8 + 0.3 * r.normal()) > 0),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "reFeatures": [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": "the-only-user"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(80, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(60, seed=2),
+    )
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+
+    run(build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=reFeatures",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-descent-iterations", "2",
+    ]))
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_game_worker.py")
+    logs = [open(tmp_path / f"solo{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (
+                f"solo {i} failed:\n" + (tmp_path / f"solo{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    def load(root):
+        return load_game_model(
+            str(root / "best"), {"global": fe_imap, "per-user": re_imap}
+        )
+
+    ref, got = load(tmp_path / "out-single"), load(tmp_path / "out")
+    np.testing.assert_allclose(
+        np.asarray(got.get_model("global").model.coefficients.means),
+        np.asarray(ref.get_model("global").model.coefficients.means),
+        atol=2e-4,
+    )
+    assert tuple(got.get_model("per-user").entity_ids) == ("the-only-user",)
+    # single-entity bias matches single-process exactly (the FE intercept
+    # absorbs the mean shift, so the bias itself may legitimately be ~0)
+    np.testing.assert_allclose(
+        np.asarray(got.get_model("per-user").coefficients_for_entity("the-only-user")),
+        np.asarray(ref.get_model("per-user").coefficients_for_entity("the-only-user")),
+        atol=2e-4,
+    )
