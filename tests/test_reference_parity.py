@@ -630,3 +630,45 @@ def test_owlqn_shrinkage_matches_reference_vectors():
         np.testing.assert_allclose(np.asarray(res.coefficients), x_exp, atol=1e-6)
         # res.value is the TOTAL objective incl. the L1 term, like the reference
         assert float(res.value) == pytest.approx(f_exp, abs=1e-6)
+
+
+def test_hyperparameter_serialization_matches_reference_vectors():
+    """HyperparameterSerializationTest.scala: the exact prior-data JSON
+    (missing fields filled from defaults) and tuning-config JSON the
+    reference parses."""
+    from photon_ml_tpu.hyperparameter.serialization import (
+        config_from_json,
+        prior_from_json,
+    )
+    from photon_ml_tpu.types import HyperparameterTuningMode
+
+    prior_json = """
+    { "records": [
+        {"alpha": "1.0", "lambda": "2.0", "gamma": "3.0", "evaluationValue": "0.01"},
+        {"alpha": "0.5", "evaluationValue": "0.02"}
+    ]}"""
+    prior = prior_from_json(
+        prior_json,
+        {"alpha": "1.0", "lambda": "4.0", "gamma": "8.0"},
+        ["alpha", "lambda", "gamma"],
+    )
+    np.testing.assert_allclose(prior[0][0], [1.0, 2.0, 3.0])
+    assert prior[0][1] == 0.01
+    np.testing.assert_allclose(prior[1][0], [0.5, 4.0, 8.0])
+    assert prior[1][1] == 0.02
+
+    config_json = """
+    { "tuning_mode": "BAYESIAN",
+      "variables": {
+        "global_regularizer": {"type": "FLOAT", "transform": "LOG", "min": -3, "max": 3},
+        "member_regularizer": {"type": "FLOAT", "transform": "LOG", "min": -3, "max": 3},
+        "item_regularizer":   {"type": "FLOAT", "transform": "LOG", "min": -3, "max": 3}
+      }}"""
+    cfg = config_from_json(config_json)
+    assert cfg.tuning_mode == HyperparameterTuningMode.BAYESIAN
+    assert set(cfg.names) == {
+        "global_regularizer", "member_regularizer", "item_regularizer"
+    }
+    assert all(r == (-3.0, 3.0) for r in cfg.ranges)
+    assert not cfg.discrete_params
+    assert set(cfg.transform_map.values()) == {"LOG"}
