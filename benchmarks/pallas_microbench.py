@@ -111,17 +111,47 @@ def main(argv=None):
                 dzz=dzz, interpret=interpret,
             )
 
-        pairs = [
-            ("value_grad", stock_value_grad, fused_value_grad),
-            ("hvp", stock_hvp, fused_hvp),
-        ]
-        for name, stock, fused in pairs:
-            # numerical parity first: the speed question is moot if wrong
-            a, b = stock(), fused()
-            for x_s, x_f in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
-                np.testing.assert_allclose(
-                    np.asarray(x_s), np.asarray(x_f), rtol=2e-4, atol=2e-3
+        # float64 host references: on TPU the STOCK f32 matmul itself runs at
+        # reduced MXU precision (bf16-pass default), so stock-vs-fused
+        # allclose at tight rtol conflates precision-mode differences with
+        # kernel bugs. The honest parity gate: the fused kernel must be at
+        # least as close to the f64 ground truth as the stock lowering.
+        X64 = np.asarray(X, dtype=np.float64)
+        y64, off64, w64 = (np.asarray(v, dtype=np.float64) for v in (y, off, w))
+        coef64, v64 = (np.asarray(v, dtype=np.float64) for v in (coef, v))
+        z64 = X64 @ coef64 + off64
+        ez = np.exp(-np.abs(z64))
+        l64 = np.log1p(ez) + np.maximum(z64, 0.0) - y64 * z64  # logistic loss
+        dz64 = np.where(z64 >= 0, 1.0 / (1.0 + ez), ez / (1.0 + ez)) - y64
+        dzz64 = 1.0 / (2.0 + ez + 1.0 / ez)
+        wdz64 = w64 * dz64
+        ref_vg = (np.sum(w64 * l64), X64.T @ wdz64, np.sum(wdz64))
+        u64 = w64 * dzz64 * (X64 @ v64)
+        ref_hvp = (X64.T @ u64, np.sum(u64))
+
+        def assert_no_less_accurate(name, ref, a_stock, a_fused):
+            for r, x_s, x_f in zip(
+                ref,
+                jax.tree_util.tree_leaves(a_stock),
+                jax.tree_util.tree_leaves(a_fused),
+            ):
+                scale = np.maximum(np.abs(r), 1e-6)
+                err_s = float(np.max(np.abs(np.asarray(x_s, np.float64) - r) / scale))
+                err_f = float(np.max(np.abs(np.asarray(x_f, np.float64) - r) / scale))
+                # floor: sequential per-block accumulation legitimately loses
+                # ~sqrt(n_blocks) f32 ulps vs XLA's tree reduction — a few
+                # 1e-5 relative at these shapes, far below fitting tolerances
+                assert err_f <= max(2.0 * err_s, 5e-4), (
+                    f"{name}: fused rel err {err_f:.2e} vs stock {err_s:.2e}"
                 )
+
+        pairs = [
+            ("value_grad", stock_value_grad, fused_value_grad, ref_vg),
+            ("hvp", stock_hvp, fused_hvp, ref_hvp),
+        ]
+        for name, stock, fused, ref in pairs:
+            # numerical parity first: the speed question is moot if wrong
+            assert_no_less_accurate(name, ref, stock(), fused())
             t_stock = _time(stock, args.repeats)
             t_fused = _time(fused, args.repeats)
             rec = {

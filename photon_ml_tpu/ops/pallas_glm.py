@@ -166,22 +166,25 @@ def _kernel(loss_and_dz, n_valid, x_ref, y_ref, off_ref, wgt_ref, coef_ref,
     wl = jnp.where(live, w * l, 0.0)
     wdz = jnp.where(live, w * dz, 0.0)
 
-    part_val = jnp.sum(wl)
-    part_wsum = jnp.sum(wdz)
+    # (1, 1)-shaped reductions: Mosaic rejects SCALAR stores into VMEM refs
+    # ("Cannot store scalars to VMEM" on real TPU; interpret mode permits
+    # them, which is how the scalar-indexed form survived CPU testing).
+    part_val = jnp.sum(wl, axis=(0, 1), keepdims=True)
+    part_wsum = jnp.sum(wdz, axis=(0, 1), keepdims=True)
     part_grad = jnp.dot(
         x.T, _mxu_dtype(x, wdz.astype(f32)), preferred_element_type=f32
     )  # [D, 1]
 
     @pl.when(i == 0)
     def _init():
-        val_ref[0, 0] = part_val
-        wsum_ref[0, 0] = part_wsum
+        val_ref[...] = part_val
+        wsum_ref[...] = part_wsum
         grad_ref[...] = part_grad
 
     @pl.when(i != 0)
     def _acc():
-        val_ref[0, 0] += part_val
-        wsum_ref[0, 0] += part_wsum
+        val_ref[...] += part_val
+        wsum_ref[...] += part_wsum
         grad_ref[...] += part_grad
 
 
@@ -275,22 +278,23 @@ def _hvp_kernel(dzz, n_valid, x_ref, y_ref, off_ref, wgt_ref,
     z = jnp.dot(x, _mxu_dtype(x, coef_ref[...]), preferred_element_type=f32)
     z = z + off_ref[...]  # [BN, 1]
     dv = jnp.dot(x, _mxu_dtype(x, v_ref[...]), preferred_element_type=f32)
-    dv = dv + sv_ref[0, 0]  # directional margins
+    dv = dv + sv_ref[...]  # directional margin shift, (1, 1) broadcast
     u = jnp.where(live, w * dzz(z, y_ref[...]) * dv, 0.0)
     part_vec = jnp.dot(
         x.T, _mxu_dtype(x, u.astype(f32)), preferred_element_type=f32
     )  # [D, 1]
-    part_usum = jnp.sum(u)
+    # (1, 1) keepdims: scalar VMEM stores are illegal on real TPU (see _kernel)
+    part_usum = jnp.sum(u, axis=(0, 1), keepdims=True)
 
     @pl.when(i == 0)
     def _init():
         vec_ref[...] = part_vec
-        usum_ref[0, 0] = part_usum
+        usum_ref[...] = part_usum
 
     @pl.when(i != 0)
     def _acc():
         vec_ref[...] += part_vec
-        usum_ref[0, 0] += part_usum
+        usum_ref[...] += part_usum
 
 
 @functools.partial(jax.jit, static_argnames=("dzz", "interpret", "block_rows"))
