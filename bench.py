@@ -110,11 +110,28 @@ def run_benchmark() -> tuple:
             regularization_weight=1.0,
         )
 
+    # One device placement per distinct storage dtype, shared across variants:
+    # at --scale 200 the sharded dataset is ~10 GB and the tunnel to the chip
+    # is the bottleneck — rebuilding per variant made transfers dominate the
+    # whole sweep's wall clock (measure timings exclude builds either way).
+    # ... but hold ONE placement at a time: f32+bf16 copies of the at-scale
+    # dataset together would overflow a v5e chip's 16 GB HBM. The sweep
+    # orders same-storage variants adjacently, so single-entry caching still
+    # coalesces lbfgs/newton pairs into one transfer each.
+    built = {}
+
+    def get_data(fe_storage_dtype):
+        key = jnp.dtype(fe_storage_dtype).name if fe_storage_dtype else None
+        if key not in built:
+            built.clear()
+            built[key] = build_sharded_game_data(
+                fe_X, y, [ds_u, ds_i], mesh, dtype=jnp.float32,
+                fe_storage_dtype=fe_storage_dtype,
+            )
+        return built[key]
+
     def measure(opt_type, fe_storage_dtype):
-        data = build_sharded_game_data(
-            fe_X, y, [ds_u, ds_i], mesh, dtype=jnp.float32,
-            fe_storage_dtype=fe_storage_dtype,
-        )
+        data = get_data(fe_storage_dtype)
         fe_cfg = glm_cfg(opt_type, FE_ITERS)
         re_cfg = glm_cfg(opt_type, RE_ITERS)
         step = make_jitted_game_step(

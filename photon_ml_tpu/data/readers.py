@@ -43,13 +43,13 @@ class RawDataset:
         return self.X.shape[1]
 
 
-def _id_tag_value(rec: dict, tag: str, i: int) -> str:
+def _id_tag_value(rec: dict, tag: str, i: int, meta_field: str = "metadataMap") -> str:
     """Entity-id lookup order of the reference (GameConverters.scala:152-166):
     a top-level record field named ``tag`` wins, then ``metadataMap[tag]``;
     values are stringified (random-effect ids are strings by contract)."""
     v = rec.get(tag)
     if v is None:
-        v = (rec.get("metadataMap") or {}).get(tag)
+        v = (rec.get(meta_field) or {}).get(tag)
     if v is None:
         raise ValueError(
             f"Sample {i}: cannot find id in either record field {tag!r} "
@@ -58,16 +58,43 @@ def _id_tag_value(rec: dict, tag: str, i: int) -> str:
     return str(v)
 
 
+def _resolve_columns(columns) -> dict:
+    """Accepts None, an InputColumnsNames, or a plain override dict and returns
+    the concrete field-name map (reference data/InputColumnsNames.scala:106 —
+    deployments rename response/offset/weight/uid/metadataMap record fields).
+    Unknown override keys fail fast: a typo'd key would otherwise silently
+    leave the default field name in place (e.g. every label read as 0.0)."""
+    from photon_ml_tpu.types import InputColumnsNames
+
+    if columns is None:
+        return InputColumnsNames().all()
+    if isinstance(columns, InputColumnsNames):
+        return columns.all()
+    overrides = dict(columns)
+    known = InputColumnsNames().all().keys()
+    unknown = set(overrides) - set(known)
+    if unknown:
+        raise ValueError(
+            f"Unknown input column key(s) {sorted(unknown)}; expected a subset "
+            f"of {sorted(known)}"
+        )
+    return InputColumnsNames(overrides).all()
+
+
 def _records_to_dataset(
     records,
     index_map: Optional[IndexMap],
     add_intercept: bool,
     id_tags: Sequence[str] = (),
+    columns=None,
 ) -> tuple[RawDataset, IndexMap]:
     labels, weights, offsets, uids = [], [], [], []
     rows, cols, vals = [], [], []
     id_cols: dict[str, list] = {tag: [] for tag in id_tags}
     all_keys: list[str] = []
+    cols_map = _resolve_columns(columns)
+    response_f, offset_f = cols_map["response"], cols_map["offset"]
+    weight_f, uid_f, meta_f = cols_map["weight"], cols_map["uid"], cols_map["metadataMap"]
 
     cached = list(records)
     if index_map is None:
@@ -78,14 +105,19 @@ def _records_to_dataset(
 
     icpt = index_map.intercept_index
     for i, rec in enumerate(cached):
-        labels.append(rec.get("label", rec.get("response", 0.0)))
-        w = rec.get("weight")
+        # "label" is TrainingExampleAvro's field; "response" the
+        # ResponsePredictionAvro / renamed-columns one (AvroDataReader.scala)
+        lab = rec.get("label") if response_f == "response" else None
+        if lab is None:
+            lab = rec.get(response_f)
+        labels.append(0.0 if lab is None else lab)
+        w = rec.get(weight_f)
         weights.append(1.0 if w is None else w)
-        o = rec.get("offset")
+        o = rec.get(offset_f)
         offsets.append(0.0 if o is None else o)
-        uids.append(rec.get("uid") or str(i))
+        uids.append(rec.get(uid_f) or str(i))
         for tag in id_tags:
-            id_cols[tag].append(_id_tag_value(rec, tag, i))
+            id_cols[tag].append(_id_tag_value(rec, tag, i, meta_f))
         has_explicit_intercept = False
         for f in rec["features"]:
             j = index_map.get_index(feature_key(f["name"], f["term"]))
@@ -120,10 +152,16 @@ def read_avro(
     index_map: Optional[IndexMap] = None,
     add_intercept: bool = True,
     id_tags: Sequence[str] = (),
+    columns=None,
 ) -> tuple[RawDataset, IndexMap]:
-    """Read TrainingExampleAvro / ResponsePredictionAvro files or directories."""
+    """Read TrainingExampleAvro / ResponsePredictionAvro files or directories.
+
+    ``columns`` renames the response/offset/weight/uid/metadataMap record
+    fields (an InputColumnsNames or a plain override dict — the reference's
+    input-columns-names driver parameter, InputColumnsNames.scala:106)."""
     return _records_to_dataset(
-        avro_io.read_container_dir(path), index_map, add_intercept, id_tags
+        avro_io.read_container_dir(path), index_map, add_intercept, id_tags,
+        columns=columns,
     )
 
 
@@ -138,6 +176,7 @@ def read_merged_avro(
     index_maps: Optional[dict] = None,
     id_tags: Sequence[str] = (),
     use_native: bool = True,
+    columns=None,
 ):
     """Avro records -> one GameInput with per-SHARD feature matrices.
 
@@ -157,6 +196,14 @@ def read_merged_avro(
     Returns (GameInput, {shard_id: IndexMap}, uids ndarray).
     """
     from photon_ml_tpu.data.game_data import GameInput
+
+    cols_map = _resolve_columns(columns)
+    response_f, offset_f = cols_map["response"], cols_map["offset"]
+    weight_f, uid_f, meta_f = cols_map["weight"], cols_map["uid"], cols_map["metadataMap"]
+    if columns is not None and cols_map != _resolve_columns(None):
+        # the C++ block decoder parses the standard TrainingExampleAvro field
+        # names; renamed columns take the pure-Python record path
+        use_native = False
 
     if use_native:
         native = _read_merged_native(path, shard_configs, index_maps, id_tags)
@@ -199,17 +246,19 @@ def read_merged_avro(
     shard_vals: dict[str, list] = {s: [] for s in shard_configs}
 
     for i, rec in enumerate(records):
-        label = rec.get("label", rec.get("response"))
+        label = rec.get("label") if response_f == "response" else None
+        if label is None:
+            label = rec.get(response_f)
         if label is not None:
             labels[i] = label
             has_labels = True
-        if rec.get("offset") is not None:
-            offsets[i] = rec["offset"]
-        if rec.get("weight") is not None:
-            weights[i] = rec["weight"]
-        uids[i] = rec.get("uid") or fallback_uids[i]
+        if rec.get(offset_f) is not None:
+            offsets[i] = rec[offset_f]
+        if rec.get(weight_f) is not None:
+            weights[i] = rec[weight_f]
+        uids[i] = rec.get(uid_f) or fallback_uids[i]
         for tag in id_tags:
-            id_cols[tag].append(_id_tag_value(rec, tag, i))
+            id_cols[tag].append(_id_tag_value(rec, tag, i, meta_f))
         for shard_id, cfg in shard_configs.items():
             imap = index_maps[shard_id]
             icpt = imap.intercept_index

@@ -62,21 +62,32 @@ def _tpu_available() -> bool:
 def test_fused_kernels_compile_and_agree_on_tpu():
     if not _tpu_available():
         pytest.skip("no healthy TPU tunnel (or /tmp/tpu_busy held)")
-    proc = subprocess.run(
-        [
-            sys.executable,
-            os.path.join(REPO, "benchmarks", "pallas_microbench.py"),
-            "--shapes",
-            "20000x64,8192x512",
-            "--repeats",
-            "3",
-        ],
-        capture_output=True,
-        text=True,
-        timeout=900,
-        env=_clean_env(),
-        cwd=REPO,
-    )
+    # hold the serial-measurement lock for the run's duration: a measurement
+    # session starting between the probe and the subprocess would otherwise
+    # share the chip with this test, perturbing both
+    with open(TPU_BUSY_LOCK, "w"):
+        pass
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "benchmarks", "pallas_microbench.py"),
+                "--shapes",
+                "20000x64,8192x512",
+                "--repeats",
+                "3",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=900,
+            env=_clean_env(),
+            cwd=REPO,
+        )
+    finally:
+        try:
+            os.remove(TPU_BUSY_LOCK)
+        except OSError:
+            pass
     assert proc.returncode == 0, f"microbench failed:\n{proc.stderr[-2000:]}"
     records = [
         json.loads(line)

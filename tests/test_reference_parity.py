@@ -343,6 +343,90 @@ def test_yahoo_music_ingest_top_level_ids_and_duplicate_features():
         assert user_X[0, j] == pytest.approx(want)
 
 
+def test_bad_weight_fixtures_fail_full_validation():
+    """bad-weights/{zero,negative}-weights.avro are the heart data with 104/103
+    weights zeroed / negated; the reference's driver rejects both under
+    VALIDATE_FULL (GameTrainingDriverIntegTest.scala:536-562 expects an
+    IllegalArgumentException). Same bytes, same verdict here."""
+    from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
+
+    for name, bad_count in (("zero-weights.avro", 104), ("negative-weights.avro", 103)):
+        data, _ = read_avro(os.path.join(DRIVER_INPUT, "bad-weights", name))
+        assert data.n == 250
+        assert int((data.weights <= 0).sum()) == bad_count
+        with pytest.raises(ValueError, match="weight"):
+            sanity_check_data(
+                TaskType.LOGISTIC_REGRESSION,
+                data.labels,
+                offsets=data.offsets,
+                weights=data.weights,
+                validation_type=DataValidationType.VALIDATE_FULL,
+            )
+
+
+def test_empty_feature_vectors_train_intercept_only():
+    """empty.avro: 250 records whose feature arrays are all empty. The
+    reference still trains on it — the intercept is added and becomes the only
+    feature (DriverTest.scala:195-221 expects 1 feature, 250 samples)."""
+    data, imap = read_avro(os.path.join(DRIVER_INPUT, "empty.avro"))
+    assert data.n == 250
+    assert imap.size == 1 and imap.intercept_index is not None
+    # null weights default to 1.0 (TrainingExampleAvro nullable field contract)
+    assert np.all(data.weights == 1.0)
+
+    from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+    from photon_ml_tpu.data.dataset import LabeledData
+
+    prob = GLMOptimizationProblem(TaskType.LOGISTIC_REGRESSION, _opt_config(50))
+    model, res = prob.run(LabeledData.build(data.X, np.where(data.labels > 0, 1, 0)))
+    # intercept-only logistic optimum: sigmoid(w0) = base rate (l2 shrinks it)
+    rate = float(np.mean(data.labels > 0))
+    w0 = float(np.asarray(model.coefficients.means)[0])
+    assert abs(1.0 / (1.0 + np.exp(-w0)) - rate) < 0.05
+
+
+def test_renamed_columns_fixture_reads_via_input_columns_names():
+    """different-column-names/diff-col-names.avro renames every response
+    column (the_label / w / intercept-as-offset / metadata) — the reference's
+    input-columns-names parameter handles this (InputColumnsNames.scala:106).
+    The renamed read must agree field-for-field with heart.avro read by its
+    default names (the fixture is the heart data re-labelled)."""
+    heart, heart_imap = read_avro(os.path.join(DRIVER_INPUT, "heart.avro"))
+    renamed, imap = read_avro(
+        os.path.join(DRIVER_INPUT, "different-column-names", "diff-col-names.avro"),
+        columns={
+            "response": "the_label",
+            "weight": "w",
+            "offset": "intercept",
+            "metadataMap": "metadata",
+        },
+    )
+    assert renamed.n == heart.n == 250
+    assert imap.size == heart_imap.size
+    np.testing.assert_array_equal(renamed.labels, heart.labels)
+    np.testing.assert_array_equal(renamed.weights, heart.weights)
+    np.testing.assert_array_equal(renamed.offsets, np.zeros(250))
+    assert (renamed.X != heart.X).nnz == 0
+
+    # the GAME (merged, multi-bag) read honors the same renames
+    merged, _, _ = read_merged_avro(
+        os.path.join(DRIVER_INPUT, "different-column-names", "diff-col-names.avro"),
+        {"global": FeatureShardConfiguration(feature_bags=("features",))},
+        columns={"response": "the_label", "weight": "w",
+                 "offset": "intercept", "metadataMap": "metadata"},
+    )
+    assert merged.has_labels
+    np.testing.assert_array_equal(merged.labels, heart.labels)
+    np.testing.assert_array_equal(merged.weights, heart.weights)
+
+    # typo'd override keys fail fast instead of silently reading defaults
+    with pytest.raises(ValueError, match="Unknown input column"):
+        read_avro(
+            os.path.join(DRIVER_INPUT, "heart.avro"),
+            columns={"reponse": "the_label"},
+        )
+
+
 def test_feed_avro_map_fields_parse():
     """avroMap/feed.avro: records with avro map fields (ids, labels,
     updateInfo) and float/long unions — the container codec must decode them
