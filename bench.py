@@ -76,7 +76,129 @@ def _build_workload(dtype, n_samples=None, n_users=None, n_items=None):
     return fe_X, y, ds_u, ds_i
 
 
-def run_benchmark() -> tuple:
+def _build_workload_device(fe_storage_dtype=None):
+    """Device-native at-scale workload: the same generative process as
+    ``_build_workload`` synthesized ON the accelerator with jax.random
+    (threefry is backend-deterministic, so CPU and TPU see identical bytes).
+
+    Exists because the chip is reached through a ~MB/s tunnel on this machine:
+    at --scale 200 the host-built workload ships ~11 GB per storage dtype
+    (hours of transfer for minutes of measurement). Here the only host↔device
+    traffic is the per-entity count vector (~E*8 bytes down) and the bucket
+    membership lists (~E*4 bytes up) — everything else (design matrix, labels,
+    RE blocks) is generated and gathered in HBM.
+
+    The tradeoff: this path does NOT exercise the production ingest
+    (build_random_effect_dataset); the default host builder remains the
+    flagship path. The bench workload's RE features are dense (intercept +
+    7 fe columns), so every entity's projection is the identity [0..7] and the
+    per-sample scoring view is shared between coordinates. Bucketing mirrors
+    production: pow2 sample-axis classes (min 8), rare classes (<5% of
+    entities) folded upward into the next class on accelerators.
+
+    Returns a ShardedGameData (single-device placement; callers needing a
+    multi-device mesh take the host builder)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+    from photon_ml_tpu.parallel.game import (
+        ShardedGameData,
+        ShardedREBucket,
+        ShardedRECoordinate,
+    )
+
+    n, d, nu, ni = N_SAMPLES, N_FEATURES, N_USERS, N_ITEMS
+    f32 = jnp.float32
+    keys = jax.random.split(jax.random.PRNGKey(42), 7)
+
+    @jax.jit
+    def gen():
+        fe_X = jax.random.normal(keys[0], (n, d), f32)
+        users = jax.random.randint(keys[1], (n,), 0, nu)
+        items = jax.random.randint(keys[2], (n,), 0, ni)
+        w = jax.random.normal(keys[3], (d,), f32) * 0.3
+        z = (
+            fe_X @ w
+            + 0.4 * jax.random.normal(keys[4], (nu,), f32)[users]
+            + 0.4 * jax.random.normal(keys[5], (ni,), f32)[items]
+        )
+        y = (jax.random.uniform(keys[6], (n,), f32) < jax.nn.sigmoid(z)).astype(f32)
+        re_vals = jnp.concatenate([jnp.ones((n, 1), f32), fe_X[:, :7]], axis=1)
+        return fe_X, users, items, y, re_vals
+
+    fe_X, users, items, y, re_vals = gen()
+    K = 8
+    local_cols = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (n, K))
+
+    def build_coord(entities, E):
+        counts = jnp.bincount(entities, length=E)
+        order = jnp.argsort(entities).astype(jnp.int32)
+        starts = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+        )
+        counts_h = np.asarray(counts)  # the one device->host hop, [E] int
+        # pow2 shape classes as in data/random_effect._next_pow2(min=8)
+        s_pad = np.maximum(
+            8, 2 ** np.ceil(np.log2(np.maximum(counts_h, 1))).astype(np.int64)
+        )
+        live = counts_h >= 1  # lower-bound filter: empty entities train no model
+        classes, class_of = np.unique(s_pad, return_inverse=True)
+        # rare-class fold, governed by the PRODUCTION consolidation policy
+        # (auto fraction + PHOTON_BUCKET_MERGE override) so the bench workload
+        # tracks the ingest path's bucketing decisions
+        from photon_ml_tpu.data.random_effect import _resolve_merge_fraction
+
+        merge_fraction = _resolve_merge_fraction(None)
+        if merge_fraction > 0 and len(classes) > 1:
+            # classes under the fraction merge into the next larger one
+            n_live = int(live.sum())
+            sizes = np.bincount(class_of[live], minlength=len(classes))
+            for ci in range(len(classes) - 1):
+                if sizes[ci] and sizes[ci] < merge_fraction * n_live:
+                    class_of[class_of == ci] = ci + 1
+                    sizes[ci + 1] += sizes[ci]
+                    sizes[ci] = 0
+        buckets = []
+        for ci in np.unique(class_of[live]):
+            members = np.flatnonzero(live & (class_of == ci))
+            S = int(classes[ci])  # folds only move entities to LARGER classes
+            ents_d = jnp.asarray(members.astype(np.int32))
+            idx = starts[ents_d][:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+            valid = jnp.arange(S)[None, :] < counts[ents_d][:, None]
+            ids = jnp.where(valid, order[jnp.clip(idx, 0, n - 1)], -1)
+            Xb = jnp.where(valid[..., None], re_vals[jnp.clip(ids, 0)], 0.0)
+            yb = jnp.where(valid, y[jnp.clip(ids, 0)], 0.0)
+            buckets.append(
+                ShardedREBucket(
+                    entity_rows=ents_d,
+                    X=Xb,
+                    labels=yb,
+                    weights=valid.astype(f32),
+                    sample_ids=ids,
+                )
+            )
+        return ShardedRECoordinate(
+            buckets=tuple(buckets),
+            sample_entity_rows=entities.astype(jnp.int32),
+            sample_local_cols=local_cols,
+            sample_vals=re_vals,
+            n_entities=E,
+            max_k=K,
+        )
+
+    fe_vals = fe_X if fe_storage_dtype is None else fe_X.astype(fe_storage_dtype)
+    return ShardedGameData(
+        fe_X=DenseDesignMatrix(values=fe_vals),
+        labels=y,
+        offsets=jnp.zeros(n, f32),
+        weights=jnp.ones(n, f32),
+        re=(build_coord(users, nu), build_coord(items, ni)),
+    )
+
+
+def run_benchmark(device_data: bool = False) -> tuple:
     """Returns (samples/sec, variant-info dict) through full GLMix
     coordinate-descent passes.
 
@@ -100,8 +222,19 @@ def run_benchmark() -> tuple:
     from photon_ml_tpu.parallel.game import init_game_params
     from photon_ml_tpu.types import RegularizationType, TaskType
 
-    fe_X, y, ds_u, ds_i = _build_workload(jnp.float32)
     mesh = make_mesh(len(jax.devices()))
+    demoted = False
+    if device_data and mesh.devices.size > 1:
+        # the device builder places single-device arrays; a mesh needs the
+        # host builder's explicit shardings
+        device_data, demoted = False, True
+        print(
+            "--device-data demoted to the host builder: multi-device mesh "
+            "needs explicit shardings (expect full dataset transfers)",
+            file=sys.stderr,
+        )
+    if not device_data:
+        fe_X, y, ds_u, ds_i = _build_workload(jnp.float32)
 
     def glm_cfg(opt, iters):
         return GLMOptimizationConfiguration(
@@ -124,10 +257,13 @@ def run_benchmark() -> tuple:
         key = jnp.dtype(fe_storage_dtype).name if fe_storage_dtype else None
         if key not in built:
             built.clear()
-            built[key] = build_sharded_game_data(
-                fe_X, y, [ds_u, ds_i], mesh, dtype=jnp.float32,
-                fe_storage_dtype=fe_storage_dtype,
-            )
+            if device_data:
+                built[key] = _build_workload_device(fe_storage_dtype)
+            else:
+                built[key] = build_sharded_game_data(
+                    fe_X, y, [ds_u, ds_i], mesh, dtype=jnp.float32,
+                    fe_storage_dtype=fe_storage_dtype,
+                )
         return built[key]
 
     def measure(opt_type, fe_storage_dtype):
@@ -149,7 +285,7 @@ def run_benchmark() -> tuple:
         assert value > 0.0
         return N_SAMPLES * N_PASSES / elapsed, value
 
-    return run_variant_sweep(
+    value, info = run_variant_sweep(
         measure,
         cpu_backend=jax.default_backend() == "cpu",
         # single chip fuses inside the stock solve; multi-chip meshes route the
@@ -157,6 +293,11 @@ def run_benchmark() -> tuple:
         pallas_capable=jax.default_backend() == "tpu",
         bf16=jnp.bfloat16,
     )
+    if device_data:
+        info["data_builder"] = "device"
+    elif demoted:
+        info["data_builder"] = "host (device demoted: multi-device mesh)"
+    return value, info
 
 
 def run_variant_sweep(measure, *, cpu_backend, pallas_capable, bf16):
@@ -290,12 +431,13 @@ def _child_main():
             print("--profile requires a trace directory argument", file=sys.stderr)
             sys.exit(2)
         trace_dir = sys.argv[idx]
+    device_data = "--device-data" in sys.argv
     if trace_dir:
         with jax.profiler.trace(trace_dir):
-            value, info = run_benchmark()
+            value, info = run_benchmark(device_data=device_data)
         info["trace_dir"] = trace_dir
     else:
-        value, info = run_benchmark()
+        value, info = run_benchmark(device_data=device_data)
     platform = jax.devices()[0].platform
     print(json.dumps({"child_value": value, "platform": platform, **info}))
 
@@ -441,6 +583,8 @@ def main():
             print("--scale requires a numeric factor (e.g. --scale 200)", file=sys.stderr)
             sys.exit(2)
         child_args = ("--scale", str(scale))
+    if "--device-data" in sys.argv:
+        child_args = (*child_args, "--device-data")
     probe_ok = False
     for _attempt in range(2):
         ok, info = _probe_backend(timeout_s=120)

@@ -81,6 +81,15 @@ def _resolve_columns(columns) -> dict:
     return InputColumnsNames(overrides).all()
 
 
+def _label_of(rec: dict, response_f: str):
+    """Label lookup shared by both read paths: "label" is
+    TrainingExampleAvro's field, "response" ResponsePredictionAvro's; a
+    RENAMED response column consults only its own name (AvroDataReader
+    schema-inference precedence). Returns None when the record has neither."""
+    lab = rec.get("label") if response_f == "response" else None
+    return rec.get(response_f) if lab is None else lab
+
+
 def _records_to_dataset(
     records,
     index_map: Optional[IndexMap],
@@ -105,11 +114,7 @@ def _records_to_dataset(
 
     icpt = index_map.intercept_index
     for i, rec in enumerate(cached):
-        # "label" is TrainingExampleAvro's field; "response" the
-        # ResponsePredictionAvro / renamed-columns one (AvroDataReader.scala)
-        lab = rec.get("label") if response_f == "response" else None
-        if lab is None:
-            lab = rec.get(response_f)
+        lab = _label_of(rec, response_f)
         labels.append(0.0 if lab is None else lab)
         w = rec.get(weight_f)
         weights.append(1.0 if w is None else w)
@@ -246,9 +251,7 @@ def read_merged_avro(
     shard_vals: dict[str, list] = {s: [] for s in shard_configs}
 
     for i, rec in enumerate(records):
-        label = rec.get("label") if response_f == "response" else None
-        if label is None:
-            label = rec.get(response_f)
+        label = _label_of(rec, response_f)
         if label is not None:
             labels[i] = label
             has_labels = True

@@ -300,3 +300,41 @@ def test_main_rejects_scaled_baseline_recording(monkeypatch):
     with _pytest.raises(SystemExit) as e:
         bench.main()
     assert e.value.code == 2
+
+
+def test_device_workload_builder_structure(monkeypatch):
+    """The device-native builder must produce the same structural invariants
+    the host builder guarantees: every sample appears exactly once in exactly
+    one bucket of its coordinate, padding rows carry weight 0, and the
+    per-sample scoring view references live entity rows."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    monkeypatch.setattr(bench, "N_SAMPLES", 500)
+    monkeypatch.setattr(bench, "N_USERS", 40)
+    monkeypatch.setattr(bench, "N_ITEMS", 10)
+    data = bench._build_workload_device()
+    assert data.labels.shape == (500,)
+    assert set(np.unique(np.asarray(data.labels))) <= {0.0, 1.0}
+    for rc, E in zip(data.re, (40, 10)):
+        assert rc.n_entities == E and rc.max_k == 8
+        rows = np.asarray(rc.sample_entity_rows)
+        assert rows.min() >= 0 and rows.max() < E
+        ids = np.concatenate(
+            [np.asarray(b.sample_ids).ravel() for b in rc.buckets]
+        )
+        ids = ids[ids >= 0]
+        assert len(ids) == 500 and len(np.unique(ids)) == 500
+        for b in rc.buckets:
+            w = np.asarray(b.weights)
+            s = np.asarray(b.sample_ids)
+            assert ((w > 0) == (s >= 0)).all()
+            assert np.asarray(b.X)[s < 0].sum() == 0.0  # padding rows zeroed
+        # scoring view reconstructs each sample's RE margin from re_vals
+        np.testing.assert_array_equal(
+            np.asarray(rc.sample_local_cols[0]), np.arange(8)
+        )
+
+    bf16 = bench._build_workload_device(jnp.bfloat16)
+    assert bf16.fe_X.dtype == jnp.bfloat16
+    assert bf16.labels.dtype == jnp.float32  # compute dtype untouched

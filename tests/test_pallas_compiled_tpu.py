@@ -64,9 +64,12 @@ def test_fused_kernels_compile_and_agree_on_tpu():
         pytest.skip("no healthy TPU tunnel (or /tmp/tpu_busy held)")
     # hold the serial-measurement lock for the run's duration: a measurement
     # session starting between the probe and the subprocess would otherwise
-    # share the chip with this test, perturbing both
-    with open(TPU_BUSY_LOCK, "w"):
-        pass
+    # share the chip with this test, perturbing both. O_EXCL, so a lock that
+    # appeared since the probe is never clobbered (and never deleted below).
+    try:
+        os.close(os.open(TPU_BUSY_LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+    except FileExistsError:
+        pytest.skip("another process acquired /tmp/tpu_busy during the probe")
     try:
         proc = subprocess.run(
             [
