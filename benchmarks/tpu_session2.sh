@@ -1,0 +1,49 @@
+#!/bin/bash
+# Round-3 follow-up measurement session (run AFTER benchmarks/tpu_session.sh
+# finishes and releases /tmp/tpu_busy). STRICTLY SERIAL, one TPU client at a
+# time; never kill a running TPU job.
+#
+# Contents reflect what round 3 learned: the tunnel moves ~1-2 MB/s, so the
+# at-scale run uses the device-native workload builder (bench.py
+# --device-data — host-built 11 GB transfers made the host-path scale run
+# infeasible), and the Pallas microbench runs the POST-fix kernels (the
+# scalar-store Mosaic rejection is fixed; the flagship re-sweep gives the
+# winner+pallas variant a real chance to engage).
+set -u
+cd /root/repo
+# wait for: the serial lock to free, any CPU-denominator run to finish, and
+# the tunnel to actually answer a bounded probe (a dropped tunnel can stay
+# down for hours; launching a child into it just hangs at backend init)
+while true; do
+  while [ -e /tmp/tpu_busy ] || [ -e /tmp/cpu_bench_busy ]; do sleep 60; done
+  if timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+      2>/dev/null; then
+    break
+  fi
+  echo "$(date -u +%H:%M:%SZ) tunnel probe failed; retrying in 5 min" >&2
+  sleep 300
+done
+touch /tmp/tpu_busy
+trap 'rm -f /tmp/tpu_busy' EXIT
+TS=$(date -u +%Y%m%dT%H%M%SZ)
+OUT=/tmp/tpu_session2_$TS
+mkdir -p $OUT
+
+echo "=== 1. north-star scale, device-native data (MovieLens-20M shape) ===" >&2
+python bench.py --child --scale 200 --device-data \
+  > $OUT/bench_scale200_device.json 2> $OUT/bench_scale200_device.err || true
+
+echo "=== 2. pallas on-chip microbench (post-fix kernels) ===" >&2
+python benchmarks/pallas_microbench.py > $OUT/pallas.json \
+  2> $OUT/pallas.err || true
+
+echo "=== 3. flagship re-sweep (pallas variant now compiles) ===" >&2
+python bench.py > $OUT/bench_flagship.json 2> $OUT/bench_flagship.err || true
+
+echo "=== 4. CPU at-scale denominator, device-native data (no tunnel) ===" >&2
+env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+  python bench.py --child --scale 200 --device-data \
+  > $OUT/bench_scale200_device_cpu.json 2> $OUT/bench_scale200_device_cpu.err || true
+
+echo "session2 artifacts in $OUT" >&2
+ls $OUT >&2
