@@ -16,15 +16,19 @@ cd /root/repo
 # down for hours; launching a child into it just hangs at backend init)
 while true; do
   while [ -e /tmp/tpu_busy ] || [ -e /tmp/cpu_bench_busy ]; do sleep 60; done
-  if timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+  if ! timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
       2>/dev/null; then
+    echo "$(date -u +%H:%M:%SZ) tunnel probe failed; retrying in 5 min" >&2
+    sleep 300
+    continue
+  fi
+  # atomic acquisition: mkdir fails if another waiter won the race during
+  # our probe window (two concurrent TPU clients drop the tunnel)
+  if mkdir /tmp/tpu_busy 2>/dev/null; then
     break
   fi
-  echo "$(date -u +%H:%M:%SZ) tunnel probe failed; retrying in 5 min" >&2
-  sleep 300
 done
-touch /tmp/tpu_busy
-trap 'rm -f /tmp/tpu_busy' EXIT
+trap 'rmdir /tmp/tpu_busy 2>/dev/null || rm -f /tmp/tpu_busy' EXIT
 TS=$(date -u +%Y%m%dT%H%M%SZ)
 OUT=/tmp/tpu_session2_$TS
 mkdir -p $OUT
@@ -40,10 +44,18 @@ python benchmarks/pallas_microbench.py > $OUT/pallas.json \
 echo "=== 3. flagship re-sweep (pallas variant now compiles) ===" >&2
 python bench.py > $OUT/bench_flagship.json 2> $OUT/bench_flagship.err || true
 
-echo "=== 4. CPU at-scale denominator, device-native data (no tunnel) ===" >&2
-env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
-  python bench.py --child --scale 200 --device-data \
-  > $OUT/bench_scale200_device_cpu.json 2> $OUT/bench_scale200_device_cpu.err || true
+echo "=== 4. five BASELINE configs ===" >&2
+python benchmarks/run_benchmarks.py --output $OUT/five_configs.json \
+  > $OUT/five_configs.out 2>&1 || true
+
+echo "=== 5. bucket-consolidation trade-off on chip ===" >&2
+for bm in 0 0.05 1.0; do
+  PHOTON_BUCKET_MERGE=$bm python bench.py --child \
+    > $OUT/bench_merge_$bm.json 2> $OUT/bench_merge_$bm.err || true
+done
+
+# CPU at-scale denominator intentionally absent: it runs as its own
+# /tmp/cpu_bench_busy-guarded job (no tunnel needed) — see tpu_results.md.
 
 echo "session2 artifacts in $OUT" >&2
 ls $OUT >&2

@@ -32,7 +32,11 @@ from photon_ml_tpu.data.dataset import LabeledData
 from photon_ml_tpu.data.random_effect import RandomEffectDataset
 from photon_ml_tpu.normalization import NO_NORMALIZATION
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
-from photon_ml_tpu.parallel.mesh import batch_sharding, pad_axis_to_multiple, replicated_sharding
+from photon_ml_tpu.parallel.mesh import (
+    batch_sharding,
+    pad_put as mesh_pad_put,
+    replicated_sharding,
+)
 from photon_ml_tpu.types import TaskType
 
 Array = jnp.ndarray
@@ -113,6 +117,10 @@ def build_sharded_game_data(
     offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
     weights = np.ones(n) if weights is None else np.asarray(weights)
 
+    def pad_put(arr, sharding, *, fill=0, to_dtype=None):
+        placed, _ = mesh_pad_put(arr, m, sharding, fill=fill, to_dtype=to_dtype)
+        return placed
+
     fe_mat = as_design_matrix_with_storage(fe_X, fe_storage_dtype, dtype)
     fe_data, _ = shard_labeled_data(
         LabeledData.build(
@@ -127,29 +135,21 @@ def build_sharded_game_data(
         E = ds.n_entities
         buckets = []
         for b in ds.buckets:
-            rows, _ = pad_axis_to_multiple(np.asarray(b.entity_rows), m, fill=E)
-            Xb, _ = pad_axis_to_multiple(np.asarray(b.X), m)
-            yb, _ = pad_axis_to_multiple(np.asarray(b.labels), m)
-            wb, _ = pad_axis_to_multiple(np.asarray(b.weights), m)
-            sb, _ = pad_axis_to_multiple(np.asarray(b.sample_ids), m, fill=-1)
             buckets.append(
                 ShardedREBucket(
-                    entity_rows=jax.device_put(jnp.asarray(rows), bs1),
-                    X=jax.device_put(jnp.asarray(Xb, dtype=dtype), bs3),
-                    labels=jax.device_put(jnp.asarray(yb, dtype=dtype), bs2),
-                    weights=jax.device_put(jnp.asarray(wb, dtype=dtype), bs2),
-                    sample_ids=jax.device_put(jnp.asarray(sb), bs2),
+                    entity_rows=pad_put(b.entity_rows, bs1, fill=E),
+                    X=pad_put(b.X, bs3, to_dtype=dtype),
+                    labels=pad_put(b.labels, bs2, to_dtype=dtype),
+                    weights=pad_put(b.weights, bs2, to_dtype=dtype),
+                    sample_ids=pad_put(b.sample_ids, bs2, fill=-1),
                 )
             )
-        ser, _ = pad_axis_to_multiple(np.asarray(ds.sample_entity_rows), m, fill=-1)
-        slc, _ = pad_axis_to_multiple(np.asarray(ds.sample_local_cols), m, fill=-1)
-        sv, _ = pad_axis_to_multiple(np.asarray(ds.sample_vals), m)
         coords.append(
             ShardedRECoordinate(
                 buckets=tuple(buckets),
-                sample_entity_rows=jax.device_put(jnp.asarray(ser), bs1),
-                sample_local_cols=jax.device_put(jnp.asarray(slc), bs2),
-                sample_vals=jax.device_put(jnp.asarray(sv, dtype=dtype), bs2),
+                sample_entity_rows=pad_put(ds.sample_entity_rows, bs1, fill=-1),
+                sample_local_cols=pad_put(ds.sample_local_cols, bs2, fill=-1),
+                sample_vals=pad_put(ds.sample_vals, bs2, to_dtype=dtype),
                 n_entities=E,
                 max_k=ds.max_k,
             )

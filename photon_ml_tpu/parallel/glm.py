@@ -24,7 +24,7 @@ from photon_ml_tpu.data.matrix import DenseDesignMatrix, SparseDesignMatrix
 from photon_ml_tpu.optimization.common import OptResult
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
 from photon_ml_tpu.optimization.solver_cache import sharded_glm_solver
-from photon_ml_tpu.parallel.mesh import batch_sharding, pad_axis_to_multiple, replicated_sharding
+from photon_ml_tpu.parallel.mesh import batch_sharding, pad_put, replicated_sharding
 from photon_ml_tpu.types import TaskType
 
 Array = jnp.ndarray
@@ -41,33 +41,30 @@ def shard_labeled_data(data: LabeledData, mesh) -> tuple[LabeledData, int]:
     m = mesh.devices.size
     bs1 = batch_sharding(mesh, ndim=1)
 
-    labels, n = pad_axis_to_multiple(np.asarray(data.labels), m)
-    offsets, _ = pad_axis_to_multiple(np.asarray(data.offsets), m)
-    weights, _ = pad_axis_to_multiple(np.asarray(data.weights), m)
+    # pad_put pads + places without pulling device-resident inputs back to
+    # host (every array here may already live on the accelerator)
+    labels, n = pad_put(data.labels, m, bs1)
+    offsets, _ = pad_put(data.offsets, m, bs1)
+    weights, _ = pad_put(data.weights, m, bs1)
 
     if isinstance(data.X, DenseDesignMatrix):
-        vals, _ = pad_axis_to_multiple(np.asarray(data.X.values), m)
-        X = DenseDesignMatrix(jax.device_put(jnp.asarray(vals), batch_sharding(mesh, ndim=2)))
+        vals, _ = pad_put(data.X.values, m, batch_sharding(mesh, ndim=2))
+        X = DenseDesignMatrix(vals)
     elif isinstance(data.X, SparseDesignMatrix):
-        rows, _ = pad_axis_to_multiple(np.asarray(data.X.rows), m)
-        cols, _ = pad_axis_to_multiple(np.asarray(data.X.cols), m)
-        nz, _ = pad_axis_to_multiple(np.asarray(data.X.vals), m)
+        rows, _ = pad_put(data.X.rows, m, bs1)
+        cols, _ = pad_put(data.X.cols, m, bs1)
+        nz, _ = pad_put(data.X.vals, m, bs1)
         X = SparseDesignMatrix(
-            rows=jax.device_put(jnp.asarray(rows), bs1),
-            cols=jax.device_put(jnp.asarray(cols), bs1),
-            vals=jax.device_put(jnp.asarray(nz), bs1),
+            rows=rows,
+            cols=cols,
+            vals=nz,
             n_rows=labels.shape[0],
             n_cols=data.X.n_cols,
         )
     else:
         raise TypeError(f"unsupported design matrix type {type(data.X).__name__}")
 
-    sharded = LabeledData(
-        X=X,
-        labels=jax.device_put(jnp.asarray(labels, dtype=data.labels.dtype), bs1),
-        offsets=jax.device_put(jnp.asarray(offsets, dtype=data.offsets.dtype), bs1),
-        weights=jax.device_put(jnp.asarray(weights, dtype=data.weights.dtype), bs1),
-    )
+    sharded = LabeledData(X=X, labels=labels, offsets=offsets, weights=weights)
     return sharded, n
 
 

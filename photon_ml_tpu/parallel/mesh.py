@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -55,3 +56,25 @@ def pad_axis_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0, fill=0):
     widths = [(0, 0)] * arr.ndim
     widths[axis] = (0, target - n)
     return np.pad(arr, widths, constant_values=fill), n
+
+
+def pad_put(arr, multiple: int, sharding, *, fill=0, to_dtype=None):
+    """Pad axis 0 to a multiple and place under ``sharding`` WITHOUT a host
+    round trip. Returns (placed array, n_orig).
+
+    Dataset builders (build_random_effect_dataset, LabeledData.build) return
+    device-resident jnp arrays; the np.asarray(...) + np.pad + device_put
+    placement pattern pulled every block device->host->device. Harmless with
+    a local chip, pathological when the accelerator sits behind a slow
+    link (observed live: an at-scale placement spent hours in these
+    transfers). jnp.pad keeps device inputs on device; host numpy inputs
+    make exactly one upload."""
+    a = jnp.asarray(arr)
+    if to_dtype is not None and a.dtype != to_dtype:
+        a = a.astype(to_dtype)
+    n = a.shape[0]
+    pad = (-n) % multiple
+    if pad:
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        a = jnp.pad(a, widths, constant_values=fill)
+    return jax.device_put(a, sharding), n

@@ -24,14 +24,13 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from photon_ml_tpu.data.dataset import FixedEffectDataset
 from photon_ml_tpu.data.random_effect import EntityBucket, RandomEffectDataset
 from photon_ml_tpu.parallel.glm import shard_labeled_data
 from photon_ml_tpu.parallel.mesh import (
     batch_sharding,
-    pad_axis_to_multiple,
+    pad_put,
     replicated_sharding,
 )
 
@@ -39,11 +38,13 @@ Array = jnp.ndarray
 
 
 def pad_and_shard_vector(arr, mesh, fill=0.0, dtype=None) -> Array:
-    """Pad a [N] host/device vector to the mesh multiple and batch-shard it."""
-    arr = np.asarray(arr)
-    padded, _ = pad_axis_to_multiple(arr, mesh.devices.size, fill=fill)
-    out = jnp.asarray(padded, dtype=dtype) if dtype is not None else jnp.asarray(padded)
-    return jax.device_put(out, batch_sharding(mesh, ndim=1))
+    """Pad a [N] host/device vector to the mesh multiple and batch-shard it
+    (device inputs stay on device — see mesh.pad_put)."""
+    placed, _ = pad_put(
+        arr, mesh.devices.size, batch_sharding(mesh, ndim=1), fill=fill,
+        to_dtype=dtype,
+    )
+    return placed
 
 
 def place_fixed_effect_dataset(ds: FixedEffectDataset, mesh) -> FixedEffectDataset:
@@ -88,34 +89,31 @@ def place_random_effect_dataset(ds: RandomEffectDataset, mesh) -> RandomEffectDa
     rep = replicated_sharding(mesh)
     E = ds.n_entities
 
+    def put(arr, sharding, *, fill=0):
+        # pad + place without the device->host->device round trip the old
+        # np.asarray + np.pad pattern made on device-resident bucket arrays
+        placed, _ = pad_put(arr, m, sharding, fill=fill)
+        return placed
+
     buckets = []
     for b in ds.buckets:
-        rows, _ = pad_axis_to_multiple(np.asarray(b.entity_rows), m, fill=E)
-        Xb, _ = pad_axis_to_multiple(np.asarray(b.X), m)
-        yb, _ = pad_axis_to_multiple(np.asarray(b.labels), m)
-        wb, _ = pad_axis_to_multiple(np.asarray(b.weights), m)
-        sb, _ = pad_axis_to_multiple(np.asarray(b.sample_ids), m, fill=-1)
         buckets.append(
             EntityBucket(
-                entity_rows=jax.device_put(jnp.asarray(rows), bs1),
-                X=jax.device_put(jnp.asarray(Xb, dtype=b.X.dtype), bs3),
-                labels=jax.device_put(jnp.asarray(yb, dtype=b.labels.dtype), bs2),
-                weights=jax.device_put(jnp.asarray(wb, dtype=b.weights.dtype), bs2),
-                sample_ids=jax.device_put(jnp.asarray(sb), bs2),
+                entity_rows=put(b.entity_rows, bs1, fill=E),
+                X=put(b.X, bs3),
+                labels=put(b.labels, bs2),
+                weights=put(b.weights, bs2),
+                sample_ids=put(b.sample_ids, bs2, fill=-1),
             )
         )
-
-    ser, _ = pad_axis_to_multiple(np.asarray(ds.sample_entity_rows), m, fill=-1)
-    slc, _ = pad_axis_to_multiple(np.asarray(ds.sample_local_cols), m, fill=-1)
-    sv, _ = pad_axis_to_multiple(np.asarray(ds.sample_vals), m)
 
     return dataclasses.replace(
         ds,
         buckets=buckets,
         proj_indices=jax.device_put(ds.proj_indices, rep),
-        sample_entity_rows=jax.device_put(jnp.asarray(ser), bs1),
-        sample_local_cols=jax.device_put(jnp.asarray(slc), bs2),
-        sample_vals=jax.device_put(jnp.asarray(sv, dtype=ds.sample_vals.dtype), bs2),
+        sample_entity_rows=put(ds.sample_entity_rows, bs1, fill=-1),
+        sample_local_cols=put(ds.sample_local_cols, bs2, fill=-1),
+        sample_vals=put(ds.sample_vals, bs2),
         coeffs_sharding=batch_sharding(mesh, ndim=2),
         # device_put needs the sharded axis divisible by the mesh size, so the
         # table gets always-zero padding rows; row E (the bucket-padding target)
