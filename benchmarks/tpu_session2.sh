@@ -16,17 +16,17 @@ cd /root/repo
 # down for hours; launching a child into it just hangs at backend init)
 while true; do
   while [ -e /tmp/tpu_busy ] || [ -e /tmp/cpu_bench_busy ]; do sleep 60; done
-  if ! timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+  # acquire FIRST (atomic mkdir), probe while holding the lock: the probe is
+  # itself a TPU client, and probing outside the lock could overlap another
+  # waiter's benchmark — two concurrent clients drop the tunnel
+  mkdir /tmp/tpu_busy 2>/dev/null || continue
+  if timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
       2>/dev/null; then
-    echo "$(date -u +%H:%M:%SZ) tunnel probe failed; retrying in 5 min" >&2
-    sleep 300
-    continue
-  fi
-  # atomic acquisition: mkdir fails if another waiter won the race during
-  # our probe window (two concurrent TPU clients drop the tunnel)
-  if mkdir /tmp/tpu_busy 2>/dev/null; then
     break
   fi
+  rmdir /tmp/tpu_busy 2>/dev/null
+  echo "$(date -u +%H:%M:%SZ) tunnel probe failed; retrying in 5 min" >&2
+  sleep 300
 done
 trap 'rmdir /tmp/tpu_busy 2>/dev/null || rm -f /tmp/tpu_busy' EXIT
 TS=$(date -u +%Y%m%dT%H%M%SZ)

@@ -34,7 +34,7 @@ from photon_ml_tpu.normalization import NO_NORMALIZATION
 from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
 from photon_ml_tpu.parallel.mesh import (
     batch_sharding,
-    pad_put as mesh_pad_put,
+    pad_put,
     replicated_sharding,
 )
 from photon_ml_tpu.types import TaskType
@@ -117,8 +117,8 @@ def build_sharded_game_data(
     offsets = np.zeros(n) if offsets is None else np.asarray(offsets)
     weights = np.ones(n) if weights is None else np.asarray(weights)
 
-    def pad_put(arr, sharding, *, fill=0, to_dtype=None):
-        placed, _ = mesh_pad_put(arr, m, sharding, fill=fill, to_dtype=to_dtype)
+    def put(arr, sharding, *, fill=0, to_dtype=None):
+        placed, _ = pad_put(arr, m, sharding, fill=fill, to_dtype=to_dtype)
         return placed
 
     fe_mat = as_design_matrix_with_storage(fe_X, fe_storage_dtype, dtype)
@@ -137,19 +137,19 @@ def build_sharded_game_data(
         for b in ds.buckets:
             buckets.append(
                 ShardedREBucket(
-                    entity_rows=pad_put(b.entity_rows, bs1, fill=E),
-                    X=pad_put(b.X, bs3, to_dtype=dtype),
-                    labels=pad_put(b.labels, bs2, to_dtype=dtype),
-                    weights=pad_put(b.weights, bs2, to_dtype=dtype),
-                    sample_ids=pad_put(b.sample_ids, bs2, fill=-1),
+                    entity_rows=put(b.entity_rows, bs1, fill=E),
+                    X=put(b.X, bs3, to_dtype=dtype),
+                    labels=put(b.labels, bs2, to_dtype=dtype),
+                    weights=put(b.weights, bs2, to_dtype=dtype),
+                    sample_ids=put(b.sample_ids, bs2, fill=-1),
                 )
             )
         coords.append(
             ShardedRECoordinate(
                 buckets=tuple(buckets),
-                sample_entity_rows=pad_put(ds.sample_entity_rows, bs1, fill=-1),
-                sample_local_cols=pad_put(ds.sample_local_cols, bs2, fill=-1),
-                sample_vals=pad_put(ds.sample_vals, bs2, to_dtype=dtype),
+                sample_entity_rows=put(ds.sample_entity_rows, bs1, fill=-1),
+                sample_local_cols=put(ds.sample_local_cols, bs2, fill=-1),
+                sample_vals=put(ds.sample_vals, bs2, to_dtype=dtype),
                 n_entities=E,
                 max_k=ds.max_k,
             )

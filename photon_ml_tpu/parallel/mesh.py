@@ -59,17 +59,26 @@ def pad_axis_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0, fill=0):
 
 
 def pad_put(arr, multiple: int, sharding, *, fill=0, to_dtype=None):
-    """Pad axis 0 to a multiple and place under ``sharding`` WITHOUT a host
-    round trip. Returns (placed array, n_orig).
+    """Pad axis 0 to a multiple and place under ``sharding``. Returns
+    (placed array, n_orig).
 
-    Dataset builders (build_random_effect_dataset, LabeledData.build) return
-    device-resident jnp arrays; the np.asarray(...) + np.pad + device_put
-    placement pattern pulled every block device->host->device. Harmless with
-    a local chip, pathological when the accelerator sits behind a slow
-    link (observed live: an at-scale placement spent hours in these
-    transfers). jnp.pad keeps device inputs on device; host numpy inputs
-    make exactly one upload."""
-    a = jnp.asarray(arr)
+    Device-resident inputs (dataset builders like build_random_effect_dataset
+    return jnp arrays) are padded ON device: the old np.asarray + np.pad +
+    device_put pattern pulled every block device->host->device — harmless
+    with a local chip, pathological behind a slow link (observed live: an
+    at-scale placement spent hours in these transfers).
+
+    Host numpy inputs keep the host-side np.pad + sharded device_put: routing
+    them through jnp first would commit the FULL array to the default device
+    before resharding, OOMing datasets whose 1/m shard fits but whose total
+    does not — exactly the multi-device regime."""
+    if not isinstance(arr, jax.Array):
+        a = np.asarray(arr)
+        if to_dtype is not None and a.dtype != np.dtype(to_dtype):
+            a = a.astype(to_dtype)
+        padded, n = pad_axis_to_multiple(a, multiple, fill=fill)
+        return jax.device_put(jnp.asarray(padded), sharding), n
+    a = arr
     if to_dtype is not None and a.dtype != to_dtype:
         a = a.astype(to_dtype)
     n = a.shape[0]
