@@ -67,7 +67,14 @@ def _newton_direction(H: Array, g: Array) -> Array:
     finite wins. A finite factor alone is not enough: near-singular pivots
     (~1e-19) give a finite L whose solve still explodes, so such levels
     escalate to more damping.
+
+    Small systems (the vmapped random-effect regime) use the trace-time
+    unrolled factorization of ops/small_linalg: the on-chip profile showed
+    XLA's batched Cholesky custom-call costing more than the whole
+    surrounding optimizer loop at K=8 (benchmarks/trace_summary_tpu.md).
     """
+    from photon_ml_tpu.ops import small_linalg
+
     d = H.shape[-1]
     dtype = H.dtype
     eye = jnp.eye(d, dtype=dtype)
@@ -75,14 +82,20 @@ def _newton_direction(H: Array, g: Array) -> Array:
 
     taus = jnp.asarray(_DAMPING_LADDER, dtype)
     Hs = H[None, :, :] + (taus[:, None, None] * scale) * eye[None, :, :]
-    Ls = jnp.linalg.cholesky(Hs)  # [levels, d, d]
+    unroll = d <= small_linalg.MAX_UNROLL_DIM
+    Ls = small_linalg.small_cholesky(Hs) if unroll else jnp.linalg.cholesky(Hs)
     finite_L = jnp.all(jnp.isfinite(Ls), axis=(1, 2))
     Ls_safe = jnp.where(finite_L[:, None, None], Ls, eye[None, :, :])
-    negg = jnp.broadcast_to(-g, (taus.shape[0], d))[..., None]
-    ys = jax.scipy.linalg.solve_triangular(Ls_safe, negg, lower=True)
-    cands = jax.scipy.linalg.solve_triangular(
-        jnp.swapaxes(Ls_safe, -1, -2), ys, lower=False
-    )[..., 0]  # [levels, d]
+    negg = jnp.broadcast_to(-g, (taus.shape[0], d))
+    if unroll:
+        cands = small_linalg.small_solve_upper_t(
+            Ls_safe, small_linalg.small_solve_lower(Ls_safe, negg)
+        )  # [levels, d]
+    else:
+        ys = jax.scipy.linalg.solve_triangular(Ls_safe, negg[..., None], lower=True)
+        cands = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(Ls_safe, -1, -2), ys, lower=False
+        )[..., 0]  # [levels, d]
     good = finite_L & jnp.all(jnp.isfinite(cands), axis=1)
     idx = jnp.argmax(good)  # first usable level
     # Even the max-damped factorization failed (non-finite H): steepest descent.
