@@ -54,6 +54,8 @@ def small_cholesky(H: Array) -> Array:
 def small_solve_lower(L: Array, b: Array) -> Array:
     """Solve L y = b by forward substitution ([..., K, K] @ [..., K])."""
     K = L.shape[-1]
+    if K == 0:  # degenerate zero-coefficient system (empty feature space)
+        return b
     parts = []
     for i in range(K):
         acc = b[..., i]
@@ -68,6 +70,8 @@ def small_solve_lower(L: Array, b: Array) -> Array:
 def small_solve_upper_t(L: Array, y: Array) -> Array:
     """Solve L^T x = y by back substitution (L lower-triangular)."""
     K = L.shape[-1]
+    if K == 0:  # degenerate zero-coefficient system
+        return y
     parts = [None] * K
     for i in range(K - 1, -1, -1):
         acc = y[..., i]
@@ -83,3 +87,37 @@ def small_posdef_solve(H: Array, b: Array) -> Array:
     """x = H^-1 b for PD [..., K, K] systems via the unrolled factorization."""
     L = small_cholesky(H)
     return small_solve_upper_t(L, small_solve_lower(L, b))
+
+
+def _small_solve_lower_matrix(L: Array, B: Array) -> Array:
+    """Forward substitution with matrix RHS: L Y = B ([..., K, M])."""
+    K = L.shape[-1]
+    if K == 0:  # degenerate zero-coefficient system
+        return B
+    rows = []
+    for i in range(K):
+        acc = B[..., i, :]
+        if i:
+            prev = jnp.stack(rows, axis=-2)  # [..., i, M]
+            acc = acc - jnp.einsum(
+                "...k,...km->...m", L[..., i, :i], prev,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        rows.append(acc / L[..., i, i][..., None])
+    return jnp.stack(rows, axis=-2)
+
+
+def small_spd_inverse_diag(H: Array) -> Array:
+    """diag(H^-1) for PD [..., K, K] via the unrolled factorization.
+
+    H^-1 = L^-T L^-1, so diag(H^-1)_j = ||column j of L^-1||^2; L^-1 comes
+    from ONE unrolled forward substitution against the identity (K steps
+    regardless of the K-column RHS). This is the per-entity FULL-variance
+    hot op (DistributedOptimizationProblem.computeVariances semantics) —
+    vmapped over entities it otherwise lowers to the slow batched-Cholesky
+    custom-call (benchmarks/trace_summary_tpu.md)."""
+    K = H.shape[-1]
+    L = small_cholesky(H)
+    eye = jnp.broadcast_to(jnp.eye(K, dtype=H.dtype), H.shape)
+    Linv = _small_solve_lower_matrix(L, eye)  # [..., K, K]
+    return jnp.sum(Linv * Linv, axis=-2)

@@ -43,8 +43,14 @@ def compute_variances(obj: GLMObjective, data, coef, l2, variance, dtype):
         diag = obj.hessian_diagonal(data, coef, l2)
         return 1.0 / jnp.where(diag == 0.0, jnp.inf, diag)
     if variance == VarianceComputationType.FULL:
+        from photon_ml_tpu.ops import small_linalg
+
         H = obj.hessian_matrix(data, coef, l2)
         H = H + jnp.diag((jnp.diag(H) == 0.0).astype(H.dtype))
+        if H.shape[-1] <= small_linalg.MAX_UNROLL_DIM:
+            # per-entity (vmapped) regime: the unrolled factorization avoids
+            # the batched-Cholesky custom-call (trace_summary_tpu.md)
+            return small_linalg.small_spd_inverse_diag(H)
         L = jnp.linalg.cholesky(H)
         eye = jnp.eye(H.shape[0], dtype=H.dtype)
         Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)

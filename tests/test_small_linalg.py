@@ -69,3 +69,25 @@ def test_vmapped_shapes_and_dtypes():
     assert out.shape == (6, 8) and out.dtype == jnp.float32
     ref = jnp.linalg.solve(H, g[..., None])[..., 0]
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_spd_inverse_diag_matches_dense_inverse():
+    from photon_ml_tpu.ops.small_linalg import small_spd_inverse_diag
+
+    rng = np.random.default_rng(3)
+    H = jnp.asarray(_random_spd(rng, (5,), 9))
+    got = np.asarray(small_spd_inverse_diag(H))
+    want = np.stack([np.diag(np.linalg.inv(np.asarray(h))) for h in H])
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-10)
+
+
+def test_zero_dimensional_systems_pass_through():
+    """Empty feature space (K=0): the unrolled routines must return empty
+    arrays at trace time like the jnp.linalg path they replace."""
+    from photon_ml_tpu.ops.small_linalg import small_spd_inverse_diag
+
+    H = jnp.zeros((3, 0, 0))
+    b = jnp.zeros((3, 0))
+    assert small_cholesky(H).shape == (3, 0, 0)
+    assert small_posdef_solve(H, b).shape == (3, 0)
+    assert small_spd_inverse_diag(H).shape == (3, 0)
