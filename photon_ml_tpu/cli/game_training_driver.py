@@ -163,15 +163,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def _load_index_maps(directory: Optional[str], shard_ids) -> dict:
-    """Per-shard saved index maps (<dir>/<shard>.npz), the PalDB off-heap
-    equivalent (GameDriver.prepareFeatureMapsDefault:185-205)."""
+    """Per-shard saved index maps (GameDriver.prepareFeatureMapsDefault:
+    185-205): this framework's <dir>/<shard>.npz stores, or — when a shard
+    has none — reference-built partitioned PalDB stores
+    (paldb-partition-<shard>-<i>.dat), decoded natively by data/paldb.py so
+    reference index directories work unchanged."""
     if directory is None:
         return {}
+    from photon_ml_tpu.data import paldb
+
     out = {}
     for shard in shard_ids:
         path = os.path.join(directory, f"{shard}.npz")
         if os.path.exists(path):
             out[shard] = IndexMap.load(path)
+        else:
+            partitions = paldb.discover_partitions(directory, shard)
+            if partitions:
+                out[shard] = paldb.load_paldb_index_map(directory, shard, partitions)
     return out
 
 
