@@ -129,6 +129,9 @@ def _build_workload_device(fe_storage_dtype=None):
         return fe_X, users, items, y, re_vals
 
     fe_X, users, items, y, re_vals = gen()
+    if fe_storage_dtype is not None:
+        # storage dtype covers the RE arrays too (the profiled hot loops)
+        re_vals = re_vals.astype(fe_storage_dtype)
     K = 8
     local_cols = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (n, K))
 
@@ -260,9 +263,12 @@ def run_benchmark(device_data: bool = False) -> tuple:
             if device_data:
                 built[key] = _build_workload_device(fe_storage_dtype)
             else:
+                # one storage knob drives both: the RE bucket blocks are the
+                # profiled hot loops, so bf16 storage must cover them too
                 built[key] = build_sharded_game_data(
                     fe_X, y, [ds_u, ds_i], mesh, dtype=jnp.float32,
                     fe_storage_dtype=fe_storage_dtype,
+                    re_storage_dtype=fe_storage_dtype,
                 )
         return built[key]
 

@@ -149,6 +149,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Store dense fixed-effect features in bfloat16 (half "
                         "the HBM traffic; f32 accumulation on the MXU). "
                         "Validate metric parity for your workload first")
+    p.add_argument("--re-storage-dtype", default=None, choices=["bf16"],
+                   help="Store random-effect bucket blocks + scoring values "
+                        "in bfloat16 on the fused pass (the profiled hot "
+                        "loops; coefficients and accumulation stay f32)")
     p.add_argument("--profile-output-directory", default=None,
                    help="Capture an XLA/TPU profiler trace of the training "
                         "phase (open with TensorBoard or xprof) — the "
@@ -422,11 +426,15 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             else []
         )
 
-        fe_storage_dtype = None
+        fe_storage_dtype = re_storage_dtype = None
         if getattr(args, "fe_storage_dtype", None) == "bf16":
             import jax.numpy as jnp
 
             fe_storage_dtype = jnp.bfloat16
+        if getattr(args, "re_storage_dtype", None) == "bf16":
+            import jax.numpy as jnp
+
+            re_storage_dtype = jnp.bfloat16
 
         mesh = None
         backend = getattr(args, "compute_backend", "host")
@@ -479,6 +487,7 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             checkpoint_directory=args.checkpoint_directory,
             checkpoint_interval=args.checkpoint_interval,
             fe_storage_dtype=fe_storage_dtype,
+            re_storage_dtype=re_storage_dtype,
             fused_pass=backend == "fused",
         )
 

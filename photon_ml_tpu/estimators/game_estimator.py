@@ -128,6 +128,11 @@ class GameEstimator:
     # (DenseDesignMatrix._mxu_dot). Validate quality before relying on it —
     # bench.py gates its bf16 variant on 1% objective parity.
     fe_storage_dtype: Optional[object] = None
+    # Same for the random-effect bucket blocks + per-sample scoring values on
+    # the fused pass (the on-chip profile's hot loops,
+    # benchmarks/trace_summary_tpu.md) — the configuration bench.py's bf16
+    # variant measures sets BOTH storage dtypes.
+    re_storage_dtype: Optional[object] = None
     # Run each coordinate-descent pass as ONE jitted SPMD program
     # (parallel/game.py — the program bench.py measures) instead of the host
     # loop's one-dispatch-per-coordinate-update. Eligible configurations only
@@ -485,6 +490,7 @@ class GameEstimator:
             weights=np.asarray(fe_ds.data.weights),
             dtype=self.dtype,
             fe_storage_dtype=self.fe_storage_dtype,
+            re_storage_dtype=self.re_storage_dtype,
         )
 
         logger.info(

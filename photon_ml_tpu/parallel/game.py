@@ -96,6 +96,7 @@ def build_sharded_game_data(
     weights: Optional[np.ndarray] = None,
     dtype=jnp.float32,
     fe_storage_dtype=None,
+    re_storage_dtype=None,
 ) -> ShardedGameData:
     """Host-side placement: pad the sample axis and every bucket's entity axis to
     the mesh size, then device_put with batch/entity sharding.
@@ -106,8 +107,11 @@ def build_sharded_game_data(
 
     ``fe_storage_dtype=jnp.bfloat16`` stores the dense fixed-effect design
     matrix in bf16 (matvecs read half the HBM bytes and hit the MXU natively;
-    accumulation stays f32 — see DenseDesignMatrix._mxu_dot). Labels, weights,
-    scores and coefficients keep ``dtype``."""
+    accumulation stays f32 — see DenseDesignMatrix._mxu_dot).
+    ``re_storage_dtype=jnp.bfloat16`` does the same for the random-effect
+    bucket blocks and the per-sample scoring values — the on-chip profile's
+    hot loops (trace_summary_tpu.md) read exactly those arrays every solver
+    iteration. Labels, weights, scores and coefficients keep ``dtype``."""
     from photon_ml_tpu.data.matrix import as_design_matrix_with_storage
     from photon_ml_tpu.parallel.glm import shard_labeled_data
 
@@ -130,6 +134,7 @@ def build_sharded_game_data(
     )
     yp, op, wp = fe_data.labels, fe_data.offsets, fe_data.weights
 
+    re_store = re_storage_dtype or dtype
     coords = []
     for ds in re_datasets:
         E = ds.n_entities
@@ -138,7 +143,7 @@ def build_sharded_game_data(
             buckets.append(
                 ShardedREBucket(
                     entity_rows=put(b.entity_rows, bs1, fill=E),
-                    X=put(b.X, bs3, to_dtype=dtype),
+                    X=put(b.X, bs3, to_dtype=re_store),
                     labels=put(b.labels, bs2, to_dtype=dtype),
                     weights=put(b.weights, bs2, to_dtype=dtype),
                     sample_ids=put(b.sample_ids, bs2, fill=-1),
@@ -149,7 +154,7 @@ def build_sharded_game_data(
                 buckets=tuple(buckets),
                 sample_entity_rows=put(ds.sample_entity_rows, bs1, fill=-1),
                 sample_local_cols=put(ds.sample_local_cols, bs2, fill=-1),
-                sample_vals=put(ds.sample_vals, bs2, to_dtype=dtype),
+                sample_vals=put(ds.sample_vals, bs2, to_dtype=re_store),
                 n_entities=E,
                 max_k=ds.max_k,
             )

@@ -338,3 +338,7 @@ def test_device_workload_builder_structure(monkeypatch):
     bf16 = bench._build_workload_device(jnp.bfloat16)
     assert bf16.fe_X.dtype == jnp.bfloat16
     assert bf16.labels.dtype == jnp.float32  # compute dtype untouched
+    # storage dtype covers the RE hot-loop arrays too
+    assert bf16.re[0].sample_vals.dtype == jnp.bfloat16
+    assert bf16.re[0].buckets[0].X.dtype == jnp.bfloat16
+    assert bf16.re[0].buckets[0].weights.dtype == jnp.float32

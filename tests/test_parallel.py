@@ -210,6 +210,48 @@ def test_bf16_fe_storage_game_step_close_to_f32(rng):
     assert abs(vals[jnp.bfloat16] - vals[None]) <= 0.01 * abs(vals[None])
 
 
+def test_bf16_re_storage_game_step_close_to_f32(rng):
+    """re_storage_dtype=bf16: bucket blocks and scoring values store half the
+    HBM bytes (the profiled hot loops, trace_summary_tpu.md); coefficients
+    and the converged objective stay within the bench quality gate of f32."""
+    from photon_ml_tpu.parallel.game import (
+        build_sharded_game_data,
+        game_train_step,
+        init_game_params,
+    )
+
+    n, d = 256, 8
+    fe_X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(fe_X @ w)))).astype(np.float64)
+    users = np.arange(n) % 9
+    re_feat = sp.csr_matrix(
+        np.concatenate([np.ones((n, 1)), fe_X[:, :3]], axis=1)
+    )
+    ds = build_random_effect_dataset(
+        re_feat, users, "userId", labels=y, intercept_index=0
+    )
+    mesh = make_mesh(8)
+    cfg = _config(max_iterations=40)
+    vals = {}
+    for storage in (None, jnp.bfloat16):
+        data = build_sharded_game_data(
+            fe_X, y, [ds], mesh, dtype=jnp.float32,
+            fe_storage_dtype=storage, re_storage_dtype=storage,
+        )
+        if storage is not None:
+            assert data.re[0].buckets[0].X.dtype == jnp.bfloat16
+            assert data.re[0].sample_vals.dtype == jnp.bfloat16
+        params = init_game_params(data, mesh)
+        params, diag = game_train_step(
+            data, params, TaskType.LOGISTIC_REGRESSION, cfg, [cfg]
+        )
+        assert params["fixed"].dtype == jnp.float32
+        assert params["re"][0].dtype == jnp.float32
+        vals[storage] = float(diag["fe_value"])
+    assert abs(vals[jnp.bfloat16] - vals[None]) <= 0.01 * abs(vals[None])
+
+
 def _import_bench_module(name):
     """Import a benchmarks/ script by name (they are not a package)."""
     import importlib
