@@ -544,10 +544,17 @@ def main(argv=None):
     for key in args.configs.split(","):
         name, fn = CONFIGS[key.strip()]
         kwargs = {"scale": args.scale} if key.strip() == "3" else {}
-        res = fn(**kwargs)
+        try:
+            res = fn(**kwargs)
+        except Exception as e:  # fail-soft: one config's failure (e.g. a
+            # tunnel drop mid-run) must not erase the other configs' numbers
+            res = {"error": f"{type(e).__name__}: {e}"[:300]}
+            results[name] = res
+            print(json.dumps({name: res}), flush=True)
+            continue
         res["platform"] = platform
         base = baselines.get(name)
-        if base and not args.record_baseline:
+        if base and "value" in base and not args.record_baseline:
             res["vs_baseline"] = round(base["value"] / res["value"], 4)  # speedup
             for qk in QUALITY_KEYS:
                 if qk in res and qk in base:
@@ -556,7 +563,7 @@ def main(argv=None):
                     )
                     res["baseline_" + qk] = base[qk]
         results[name] = res
-        print(json.dumps({name: res}))
+        print(json.dumps({name: res}), flush=True)
 
     if args.record_baseline:
         from photon_ml_tpu.util.provenance import measurement_provenance
@@ -568,7 +575,10 @@ def main(argv=None):
         for res in results.values():
             res.update(provenance)
         # merge: re-recording a subset must not erase other configs' baselines
-        baselines.update(results)
+        # (and an errored config must not clobber a good one with its error)
+        baselines.update(
+            {n: r for n, r in results.items() if "error" not in r}
+        )
         with open(BASELINE_PATH, "w") as f:
             json.dump(baselines, f, indent=2)
         print(json.dumps({"recorded_baseline_for": list(results)}))
@@ -576,7 +586,10 @@ def main(argv=None):
         with open(args.output, "w") as f:
             json.dump(results, f, indent=2)
 
-    failed = [n for n, r in results.items() if r.get("quality_parity") is False]
+    failed = [
+        n for n, r in results.items()
+        if r.get("quality_parity") is False or "error" in r
+    ]
     if failed and not args.no_strict:
         print(json.dumps({"quality_parity_failed": failed}))
         sys.exit(1)

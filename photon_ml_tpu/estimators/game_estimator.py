@@ -144,6 +144,13 @@ class GameEstimator:
     def __post_init__(self):
         self.task = TaskType(self.task)
         self.variance_computation = VarianceComputationType(self.variance_computation)
+        if self.re_storage_dtype is not None and not self.fused_pass:
+            # only the fused pass consumes it (build_sharded_game_data);
+            # accepting it elsewhere would be a silent no-op
+            raise ValueError(
+                "re_storage_dtype requires fused_pass=True (the host/mesh "
+                "paths do not consume it)"
+            )
         locked = set(self.partial_retrain_locked_coordinates)
         unknown = locked - set(self.coordinate_configurations)
         if unknown:

@@ -174,6 +174,41 @@ def test_reference_game_model_loads_with_exact_coefficients():
         assert len(re_model.entity_ids) == 0  # no coefficients dir => empty
 
 
+def test_remaining_reference_model_directories_load():
+    """The other three reference-written model layouts: fixedEffectOnlyGAMEModel
+    (no model-spec dir at all), retrainModels/fixedEffectsOnly (no
+    random-effect dir) and retrainModels/randomEffectsOnly (no fixed-effect
+    dir) — every committed model directory in the snapshot must load."""
+    fe_only = os.path.join(GAME, "fixedEffectOnlyGAMEModel")
+    coeff = os.path.join(fe_only, "fixed-effect", "globalShard", "coefficients")
+    imap = _imap_from_model_records(coeff)
+    gm = load_game_model(fe_only, {"globalShard": imap})
+    means = np.asarray(gm.get_model("globalShard").model.coefficients.means)
+    assert means.size == imap.size and means.size > 0
+
+    rt_fe = os.path.join(GAME, "retrainModels", "fixedEffectsOnly")
+    imap_fe = _imap_from_model_records(
+        os.path.join(rt_fe, "fixed-effect", "global", "coefficients")
+    )
+    gm_fe = load_game_model(rt_fe, {"global": imap_fe})
+    assert np.asarray(gm_fe.get_model("global").model.coefficients.means).size > 0
+
+    rt_re = os.path.join(GAME, "retrainModels", "randomEffectsOnly")
+    coords = sorted(os.listdir(os.path.join(rt_re, "random-effect")))
+    imaps = {
+        c: _imap_from_model_records(
+            os.path.join(rt_re, "random-effect", c, "coefficients")
+        )
+        for c in coords
+        if os.path.isdir(os.path.join(rt_re, "random-effect", c, "coefficients"))
+    }
+    for c in coords:
+        imaps.setdefault(c, IndexMap.build([], add_intercept=False))
+    gm_re = load_game_model(rt_re, imaps)
+    loaded_entities = sum(len(gm_re.get_model(c).entity_ids) for c in coords)
+    assert loaded_entities > 0
+
+
 def test_reference_retrain_model_loads_and_scores():
     """retrainModels/mixedEffects: multi-part random-effect coefficient files
     (per-artist has part-00000 AND part-00001) and a coefficient-less
