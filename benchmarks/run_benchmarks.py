@@ -527,7 +527,8 @@ def main(argv=None):
                     help="store results as the CPU baseline")
     ap.add_argument("--output", default=None)
     ap.add_argument("--no-strict", action="store_true",
-                    help="exit 0 even when a config fails quality parity "
+                    help="exit 0 even when a config fails quality parity OR "
+                         "errors outright "
                          "(default: parity failure exits 1 — a speedup only "
                          "counts at matching quality)")
     args = ap.parse_args(argv)
@@ -576,12 +577,11 @@ def main(argv=None):
             res.update(provenance)
         # merge: re-recording a subset must not erase other configs' baselines
         # (and an errored config must not clobber a good one with its error)
-        baselines.update(
-            {n: r for n, r in results.items() if "error" not in r}
-        )
+        recorded = {n: r for n, r in results.items() if "error" not in r}
+        baselines.update(recorded)
         with open(BASELINE_PATH, "w") as f:
             json.dump(baselines, f, indent=2)
-        print(json.dumps({"recorded_baseline_for": list(results)}))
+        print(json.dumps({"recorded_baseline_for": list(recorded)}))
     if args.output:
         with open(args.output, "w") as f:
             json.dump(results, f, indent=2)

@@ -254,6 +254,16 @@ def _save_result(out_dir: str, result, index_maps_by_coord, coord_configs,
 def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dict:
     """Full training pipeline (GameTrainingDriver.run:346-482). Returns a summary
     dict {"results": [...], "best_index": i, "output_directory": ...}."""
+    # Cross-flag validation BEFORE any expensive work (ingest, model load):
+    # only the fused pass consumes the RE storage dtype.
+    if (
+        getattr(args, "re_storage_dtype", None)
+        and getattr(args, "compute_backend", "host") != "fused"
+    ):
+        raise SystemExit(
+            "--re-storage-dtype requires --compute-backend fused "
+            "(the host/mesh paths do not consume it)"
+        )
     # Multi-host init must precede EVERY other JAX touch (model loading,
     # data placement): jax.distributed.initialize after backend init either
     # errors or silently leaves the "global" mesh host-local.

@@ -662,6 +662,34 @@ def test_paldb_index_map_covers_reference_model_features():
         assert sub.size > 0 and sub.intercept_index is not None
 
 
+def test_training_ingest_through_reference_paldb_stores():
+    """End-to-end ingest binding: yahoo-music records read through the
+    reference's OWN PalDB index stores (feature positions fixed by the
+    store, not rebuilt from data), then a fixed-effect fit on the result."""
+    from photon_ml_tpu.data import paldb
+
+    store_dir = os.path.join(GAME, "input", "test-with-uid-feature-indexes")
+    imap = paldb.load_paldb_index_map(store_dir, "globalShard")
+    data, imaps, _ = read_merged_avro(
+        os.path.join(GAME, "input", "duplicateFeatures", "yahoo-music-train.avro"),
+        {"globalShard": FeatureShardConfiguration(
+            feature_bags=("features", "songFeatures", "userFeatures"))},
+        index_maps={"globalShard": imap},
+    )
+    assert imaps["globalShard"] is imap
+    X = data.shard("globalShard")
+    assert X.shape == (6, imap.size)
+    # intercept column filled for every sample at the store's own position
+    assert (X[:, imap.intercept_index].toarray() == 1.0).all()
+
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
+
+    prob = GLMOptimizationProblem(TaskType.LINEAR_REGRESSION, _opt_config(20))
+    model, res = prob.run(LabeledData.build(X, data.labels))
+    assert np.isfinite(float(res.value))
+
+
 def test_feed_avro_map_fields_parse():
     """avroMap/feed.avro: records with avro map fields (ids, labels,
     updateInfo) and float/long unions — the container codec must decode them
