@@ -1,16 +1,17 @@
 """L-BFGS as one jitted ``lax.while_loop`` (batched-first; vmap gives per-entity solves).
 
 Functional re-design of photon-lib optimization/LBFGS.scala:39-157 (which bridges to
-Breeze): two-loop recursion over fixed-size circular (s, y) buffers, strong-Wolfe line
-search, optional box projection after each step (the reference's constraintMap
+Breeze): two-loop recursion over fixed-size newest-first (s, y) buffers, strong-Wolfe
+line search, optional box projection after each step (the reference's constraintMap
 handling, OptimizationUtils.projectCoefficientsToSubspace), and the reference's
 convergence-reason semantics (common.convergence_check).
 
-TPU notes: the history buffers are [m, d] arrays updated with dynamic_update_index;
-the two-loop recursion is two ``lax.fori_loop``s of dot products — all fused by XLA
-into the surrounding while_loop, so one optimizer run is one XLA program with zero
-host round-trips (vs one Spark broadcast + treeAggregate per iteration in the
-reference).
+TPU notes: the [m, d] history buffers are NEWEST-FIRST — ``push_history`` rolls
+them one slot and writes position 0, so the two-loop recursion unrolls over the
+static history length with static slot indices (plain fused vector-op chains;
+a circular buffer would need 2m sequential dynamic slices per iteration). One
+optimizer run is one XLA program with zero host round-trips (vs one Spark
+broadcast + treeAggregate per iteration in the reference).
 """
 
 from __future__ import annotations
@@ -37,60 +38,72 @@ class _LBFGSState(NamedTuple):
     x: Array
     f: Array
     g: Array
-    S: Array  # [m, d] step history
-    Y: Array  # [m, d] gradient-difference history
-    rho: Array  # [m] 1 / (s.y)
+    S: Array  # [m, d] step history, newest first (push_history layout)
+    Y: Array  # [m, d] gradient-difference history, newest first
+    rho: Array  # [m] 1 / (s.y), newest first
     k: Array  # iteration counter
-    n_written: Array  # total (s, y) pairs ever stored (slot cursor)
+    n_written: Array  # total (s, y) pairs ever stored (min(n_written, m) valid)
     reason: Array
     tracked_values: Optional[Array]
     tracked_gnorms: Optional[Array]
 
 
 def two_loop_direction(g: Array, S: Array, Y: Array, rho: Array, n_written: Array) -> Array:
-    """-H.g via the standard two-loop recursion over a circular buffer.
+    """-H.g via the standard two-loop recursion, NEWEST-FIRST layout.
 
-    ``n_written`` counts pairs actually stored (it does NOT advance on skipped
-    updates, so slots never desynchronize): pair i (0 = newest) lives at index
-    (n_written - 1 - i) mod m, and min(n_written, m) pairs are valid.
+    Pair 0 is the newest (``push_history`` rolls the buffers on store);
+    ``n_written`` counts pairs actually stored, so min(n_written, m) leading
+    slots are valid and the rest are masked.
+
+    The recursion is unrolled over the (static) history length with STATIC
+    slot indices: the previous circular-buffer form indexed ``S[j]`` with a
+    traced slot inside ``lax.fori_loop`` — 2m sequential dynamic-slice ops
+    per optimizer iteration, pure latency in the vmapped random-effect
+    regime (the solver while_loops are the pass's measured floor,
+    benchmarks/trace_summary_tpu.md). Static slices fuse into plain vector
+    op chains instead.
     """
     m = S.shape[0]
     dtype = g.dtype
     n_pairs = jnp.minimum(n_written, m)
 
-    def slot(i):
-        return jnp.mod(n_written - 1 - i, m)
-
-    def first_loop(i, carry):
-        q, alphas = carry
-        j = slot(i)
-        valid = i < n_pairs
-        a = rho[j] * jnp.dot(S[j], q)
-        a = jnp.where(valid, a, 0.0)
-        q = q - a * Y[j]
-        return q, alphas.at[i].set(a)
-
-    q0 = g.astype(dtype)
-    q, alphas = lax.fori_loop(0, m, first_loop, (q0, jnp.zeros((m,), dtype)))
+    q = g.astype(dtype)
+    alphas = []
+    for i in range(m):  # newest -> oldest, static index
+        a = rho[i] * jnp.dot(S[i], q)
+        a = jnp.where(i < n_pairs, a, 0.0)
+        q = q - a * Y[i]
+        alphas.append(a)
 
     # Initial Hessian scaling gamma = s.y / y.y from the newest pair.
-    jn = slot(0)
-    ydoty = jnp.dot(Y[jn], Y[jn])
+    ydoty = jnp.dot(Y[0], Y[0])
     gamma = jnp.where(
-        (n_pairs > 0) & (ydoty > 0), jnp.dot(S[jn], Y[jn]) / jnp.where(ydoty > 0, ydoty, 1.0), 1.0
+        (n_pairs > 0) & (ydoty > 0), jnp.dot(S[0], Y[0]) / jnp.where(ydoty > 0, ydoty, 1.0), 1.0
     )
     r = gamma * q
 
-    def second_loop(i, r):
-        idx = m - 1 - i  # oldest -> newest
-        j = slot(idx)
-        valid = idx < n_pairs
-        beta = rho[j] * jnp.dot(Y[j], r)
-        upd = (alphas[idx] - beta) * S[j]
-        return r + jnp.where(valid, 1.0, 0.0) * upd
-
-    r = lax.fori_loop(0, m, second_loop, r)
+    for i in range(m - 1, -1, -1):  # oldest -> newest, static index
+        beta = rho[i] * jnp.dot(Y[i], r)
+        upd = (alphas[i] - beta) * S[i]
+        r = r + jnp.where(i < n_pairs, 1.0, 0.0) * upd
     return -r
+
+
+def push_history(S, Y, rho, n_written, s, y, sy, good_pair):
+    """Store a curvature pair in newest-first order (shared by LBFGS, OWLQN,
+    LBFGSB): roll every buffer one slot and write position 0 — static-index
+    updates, matching two_loop_direction's layout. Skipped pairs leave the
+    buffers AND the valid-pair count untouched (the helper owns both so they
+    cannot desynchronize). Returns (S, Y, rho, n_written)."""
+    S_new = jnp.roll(S, 1, axis=0).at[0].set(s)
+    Y_new = jnp.roll(Y, 1, axis=0).at[0].set(y)
+    rho_new = jnp.roll(rho, 1).at[0].set(1.0 / jnp.where(good_pair, sy, 1.0))
+    return (
+        jnp.where(good_pair, S_new, S),
+        jnp.where(good_pair, Y_new, Y),
+        jnp.where(good_pair, rho_new, rho),
+        n_written + jnp.where(good_pair, 1, 0).astype(n_written.dtype),
+    )
 
 
 def minimize_lbfgs(
@@ -188,11 +201,9 @@ def minimize_lbfgs(
         sy = jnp.dot(s, y)
         # Curvature safeguard (strong Wolfe guarantees sy > 0 on the accepted path).
         good_pair = sy > 1e-10
-        slot = jnp.mod(st.n_written, m)
-        S = jnp.where(good_pair, st.S.at[slot].set(s), st.S)
-        Y = jnp.where(good_pair, st.Y.at[slot].set(y), st.Y)
-        rho = jnp.where(good_pair, st.rho.at[slot].set(1.0 / jnp.where(good_pair, sy, 1.0)), st.rho)
-        n_written = st.n_written + jnp.where(good_pair, 1, 0).astype(jnp.int32)
+        S, Y, rho, n_written = push_history(
+            st.S, st.Y, st.rho, st.n_written, s, y, sy, good_pair
+        )
 
         k_new = st.k + 1
         reason = convergence_check(

@@ -28,7 +28,7 @@ from photon_ml_tpu.optimization.common import (
     init_tracking,
     record_tracking,
 )
-from photon_ml_tpu.optimization.lbfgs import two_loop_direction
+from photon_ml_tpu.optimization.lbfgs import push_history, two_loop_direction
 from photon_ml_tpu.types import ConvergenceReason
 
 Array = jnp.ndarray
@@ -131,11 +131,9 @@ def minimize_lbfgsb(
         y = g_new - st.g
         sy = jnp.dot(s, y)
         good_pair = sy > 1e-10
-        slot = jnp.mod(st.n_written, m)
-        S = jnp.where(good_pair, st.S.at[slot].set(s), st.S)
-        Y = jnp.where(good_pair, st.Y.at[slot].set(y), st.Y)
-        rho = jnp.where(good_pair, st.rho.at[slot].set(1.0 / jnp.where(good_pair, sy, 1.0)), st.rho)
-        n_written = st.n_written + jnp.where(good_pair, 1, 0).astype(jnp.int32)
+        S, Y, rho, n_written = push_history(
+            st.S, st.Y, st.rho, st.n_written, s, y, sy, good_pair
+        )
 
         k_new = st.k + 1
         pg_new = projected_gradient(x_new, g_new, lower, upper)
