@@ -451,10 +451,37 @@ def test_two_process_training_wide_sparse_shard(tmp_path):
 
     got = best_coeffs(tmp_path / "out")
     assert got.shape == expected.shape == (d + 1,)
-    # f32 summation-order tolerance: the single-process path reduces with a
-    # globally column-sorted segment-sum, the nnz-sharded path scatter-adds
-    # per shard — same math, different accumulation order
-    np.testing.assert_allclose(got, expected, atol=1e-3)
+    # Equivalence, not bit-parity: 240 samples over 100k features leaves the
+    # L2 optimum nearly flat along many directions, so coefficient values are
+    # sensitive to f32 accumulation order (globally column-sorted segment-sum
+    # single-process vs per-shard scatter-adds + psum here). Assert a modest
+    # coefficient band plus the TRAINING OBJECTIVE VALUE, which is strictly
+    # convex — both solves must reach the same optimum value even where the
+    # argmin wiggles along flat directions.
+    np.testing.assert_allclose(got, expected, atol=5e-3)
+
+    from photon_ml_tpu.data.readers import read_merged_avro
+    from photon_ml_tpu.estimators.config import FeatureShardConfiguration
+
+    spec_single = json.load(open(tmp_path / "out-single" / "best" / "model-spec.json"))
+    spec_multi = json.load(open(tmp_path / "out" / "best" / "model-spec.json"))
+    assert spec_single == spec_multi  # same selected configuration
+    reg = float(spec_single["global"].rsplit("reg.weights=", 1)[1])
+
+    train_data, _, _ = read_merged_avro(
+        str(tmp_path / "in"),
+        {"global": FeatureShardConfiguration(feature_bags=("features",))},
+        index_maps={"global": imap},
+    )
+    Xt = train_data.shard("global")
+    y_pm = 2.0 * np.asarray(train_data.labels) - 1.0
+
+    def objective(w):
+        return float(
+            np.logaddexp(0.0, -(Xt @ w) * y_pm).sum() + 0.5 * reg * w @ w
+        )
+
+    np.testing.assert_allclose(objective(got), objective(expected), rtol=1e-5)
 
 
 def test_two_process_game_training_matches_single_process(tmp_path):
