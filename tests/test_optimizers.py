@@ -429,3 +429,56 @@ def test_newton_rejected_for_smoothed_hinge(rng):
         GLMOptimizationProblem(
             task=TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM, configuration=cfg
         )
+
+
+def test_two_loop_direction_matches_numpy_reference():
+    """Newest-first unrolled two-loop vs an independent NumPy implementation,
+    across empty, partially-filled, and wrapped (evicting) histories."""
+    import numpy as np
+
+    from photon_ml_tpu.optimization.lbfgs import push_history, two_loop_direction
+
+    rng = np.random.default_rng(9)
+    m, d = 5, 7
+
+    def np_two_loop(g, pairs):
+        # pairs: list of (s, y), newest first
+        q = g.copy()
+        alphas = []
+        for s, y in pairs:
+            a = (1.0 / (s @ y)) * (s @ q)
+            q = q - a * y
+            alphas.append(a)
+        if pairs:
+            s0, y0 = pairs[0]
+            q = (s0 @ y0) / (y0 @ y0) * q
+        for (s, y), a in zip(reversed(pairs), reversed(alphas)):
+            b = (1.0 / (s @ y)) * (y @ q)
+            q = q + (a - b) * s
+        return -q
+
+    S = jnp.zeros((m, d)); Y = jnp.zeros((m, d)); rho = jnp.zeros(m)
+    n_written = jnp.asarray(0, jnp.int32)
+    pairs = []
+    for step in range(8):  # past m: exercises eviction
+        g = rng.normal(size=d)
+        got = np.asarray(two_loop_direction(jnp.asarray(g), S, Y, rho, n_written))
+        want = np_two_loop(g, pairs[:m])
+        np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
+
+        s = rng.normal(size=d)
+        y = s * rng.uniform(0.5, 2.0, size=d)  # guarantees s.y > 0
+        sy = float(s @ y)
+        S, Y, rho, n_written = push_history(
+            S, Y, rho, n_written, jnp.asarray(s), jnp.asarray(y),
+            jnp.asarray(sy), jnp.asarray(True),
+        )
+        pairs.insert(0, (s, y))
+
+    # a skipped pair must change nothing
+    S2, Y2, rho2, n2 = push_history(
+        S, Y, rho, n_written, jnp.ones(d), jnp.ones(d),
+        jnp.asarray(-1.0), jnp.asarray(False),
+    )
+    assert (np.asarray(S2) == np.asarray(S)).all()
+    assert int(n2) == int(n_written)
