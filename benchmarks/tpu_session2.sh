@@ -54,6 +54,11 @@ for bm in 0 0.05 1.0; do
     > $OUT/bench_merge_$bm.json 2> $OUT/bench_merge_$bm.err || true
 done
 
+echo "=== 6. per-op trace of the current flagship pass (latency-floor work) ===" >&2
+python bench.py --child --profile $OUT/trace \
+  > $OUT/bench_traced.json 2> $OUT/bench_traced.err || true
+python benchmarks/summarize_trace.py $OUT/trace > $OUT/trace_summary.md 2>&1 || true
+
 # CPU at-scale denominator intentionally absent: it runs as its own
 # /tmp/cpu_bench_busy-guarded job (no tunnel needed) — see tpu_results.md.
 

@@ -124,11 +124,29 @@ def partition_filename(namespace: str, partition: int) -> str:
 
 
 def discover_partitions(directory: str, namespace: str) -> int:
-    """Count consecutive partition files for ``namespace`` under ``directory``."""
-    n = 0
-    while os.path.exists(os.path.join(directory, partition_filename(namespace, n))):
-        n += 1
-    return n
+    """Count partition files for ``namespace`` under ``directory``.
+
+    Globs every ``paldb-partition-<ns>-*.dat`` and requires the indices to be
+    exactly 0..n-1: a missing middle partition must fail loudly, not silently
+    truncate the index map (which would drop features and shrink the global
+    index space under the trainer)."""
+    prefix = f"paldb-partition-{namespace}-"
+    indices = []
+    for fname in os.listdir(directory) if os.path.isdir(directory) else []:
+        if fname.startswith(prefix) and fname.endswith(".dat"):
+            stem = fname[len(prefix) : -len(".dat")]
+            if stem.isdigit():
+                indices.append(int(stem))
+    if not indices:
+        return 0
+    indices.sort()
+    if indices != list(range(len(indices))):
+        raise ValueError(
+            f"{directory}: partition files for namespace {namespace!r} are not "
+            f"dense 0..{len(indices) - 1} (found {indices}); refusing to load a "
+            "truncated index map"
+        )
+    return len(indices)
 
 
 def load_paldb_index_map(

@@ -77,7 +77,7 @@ def pad_put(arr, multiple: int, sharding, *, fill=0, to_dtype=None):
         if to_dtype is not None and a.dtype != np.dtype(to_dtype):
             a = a.astype(to_dtype)
         padded, n = pad_axis_to_multiple(a, multiple, fill=fill)
-        return jax.device_put(jnp.asarray(padded), sharding), n
+        return jax.device_put(padded, sharding), n
     a = arr
     if to_dtype is not None and a.dtype != to_dtype:
         a = a.astype(to_dtype)

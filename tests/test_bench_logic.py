@@ -342,3 +342,105 @@ def test_device_workload_builder_structure(monkeypatch):
     assert bf16.re[0].sample_vals.dtype == jnp.bfloat16
     assert bf16.re[0].buckets[0].X.dtype == jnp.bfloat16
     assert bf16.re[0].buckets[0].weights.dtype == jnp.float32
+
+
+class _FakeMatrix:
+    def __init__(self, n, d):
+        self.n_rows, self.n_cols = n, d
+
+
+class _FakeBucket:
+    def __init__(self, E, S, K):
+        import numpy as np
+
+        self.X = np.zeros((E, S, K))
+
+
+class _FakeRE:
+    def __init__(self, buckets, n, k):
+        import numpy as np
+
+        self.buckets = buckets
+        self.sample_vals = np.zeros((n, k))
+
+
+class _FakeData:
+    def __init__(self, n=1000, d=64):
+        self.fe_X = _FakeMatrix(n, d)
+        self.re = (_FakeRE([_FakeBucket(10, 16, 8)], n, 8),)
+
+
+def test_analytic_cost_lbfgs_counts_fe_and_re():
+    data = _FakeData(n=1000, d=64)
+    c = bench._analytic_cost(data, fe_iters=10, re_iters=5, newton=False, storage_bytes=4)
+    fe_flops = 10 * 4.0 * 1000 * 64
+    re_flops = 5 * 4.0 * (10 * 16) * 8
+    score_flops = 2.0 * 1000 * 8
+    assert c["flops_per_pass"] == fe_flops + re_flops + score_flops
+    fe_bytes = 10 * 2.0 * 1000 * 64 * 4
+    re_bytes = 5 * 2.0 * (10 * 16) * 8 * 4
+    score_bytes = 1000 * 8 * 4
+    assert c["hbm_bytes_per_pass"] == fe_bytes + re_bytes + score_bytes
+    assert c["fe_iterations_measured"] == 10
+
+
+def test_analytic_cost_newton_adds_hessian_and_bf16_halves_bytes():
+    data = _FakeData(n=1000, d=64)
+    lb = bench._analytic_cost(data, fe_iters=10, re_iters=5, newton=False, storage_bytes=4)
+    nw = bench._analytic_cost(data, fe_iters=10, re_iters=5, newton=True, storage_bytes=4)
+    assert nw["flops_per_pass"] > lb["flops_per_pass"]  # + 2nd^2 + d^3/3 terms
+    assert nw["hbm_bytes_per_pass"] > lb["hbm_bytes_per_pass"]  # extra X pass
+    half = bench._analytic_cost(data, fe_iters=10, re_iters=5, newton=False, storage_bytes=2)
+    # matrix traffic halves; only the bytes model scales with storage width
+    assert half["hbm_bytes_per_pass"] == lb["hbm_bytes_per_pass"] / 2
+    assert half["flops_per_pass"] == lb["flops_per_pass"]
+
+
+def test_roofline_regime_and_utilization(monkeypatch):
+    """MFU/HBM utilization against the chip peak table, regime classification,
+    and the CPU/unknown-chip fallback (peaks_unknown, no invented numbers)."""
+    import types
+
+    fake_dev = types.SimpleNamespace(device_kind="TPU v5 lite")
+    import jax as _jax
+
+    monkeypatch.setattr(_jax, "devices", lambda: [fake_dev])
+    # 100k samples at 1M samples/s -> 0.1 s/pass
+    cost = {"flops_per_pass": 1.97e12, "hbm_bytes_per_pass": 8.19e10}
+    out = bench._roofline(cost, samples_per_sec=1_000_000.0, n_samples=100_000)
+    assert out["mfu"] == round(1.97e13 / 197e12, 5)  # 0.1
+    assert out["hbm_util"] == round(8.19e11 / 819e9, 5)  # 1.0
+    assert out["regime"] == "bandwidth"  # intensity 24 < ridge 240.5
+    # far from both ceilings -> latency-bound
+    tiny = {"flops_per_pass": 1e9, "hbm_bytes_per_pass": 1e8}
+    assert (
+        bench._roofline(tiny, samples_per_sec=1_000_000.0, n_samples=100_000)["regime"]
+        == "latency"
+    )
+    # compute-bound: intensity above the ridge and high MFU
+    hot = {"flops_per_pass": 1.97e13 * 0.8, "hbm_bytes_per_pass": 1.97e13 * 0.8 / 300}
+    assert (
+        bench._roofline(hot, samples_per_sec=1_000_000.0, n_samples=100_000)["regime"]
+        == "compute"
+    )
+    fake_dev.device_kind = "Strange Chip 9000"
+    unk = bench._roofline(cost, samples_per_sec=1_000_000.0, n_samples=100_000)
+    assert unk.get("peaks_unknown") is True and "mfu" not in unk
+
+
+def test_winner_roofline_lookup_decodes_variant_names():
+    costs = {
+        ("LBFGS", None, False): {"flops_per_pass": 1.0, "hbm_bytes_per_pass": 1.0},
+        ("NEWTON", "bfloat16", False): {"flops_per_pass": 2.0, "hbm_bytes_per_pass": 2.0},
+        ("NEWTON", "bfloat16", True): {"flops_per_pass": 3.0, "hbm_bytes_per_pass": 3.0},
+    }
+    out = bench._winner_roofline(
+        {"variant": "newton_bf16_pallas"}, costs, samples_per_sec=1000.0, n_samples=100
+    )
+    assert out["roofline"]["flops_per_pass"] == 3.0
+    out = bench._winner_roofline(
+        {"variant": "lbfgs_f32"}, costs, samples_per_sec=1000.0, n_samples=100
+    )
+    assert out["roofline"]["flops_per_pass"] == 1.0
+    # a variant whose configuration was never measured yields no roofline
+    assert bench._winner_roofline({"variant": "lbfgs_f32"}, {}, 1000.0, 100) == {}
