@@ -2,10 +2,17 @@
 
 Parity target: photon-client index/FeatureIndexingDriver.scala:41-320 — read
 Avro data, collect the distinct (name, term) set per feature shard, and write
-index stores consumed at train/score time (the reference writes partitioned
-PalDB files read per-executor off-heap; here one compact .npz per shard, loaded
-via data/index_map.IndexMap.load, or the mmap store in data/offheap_index.py
-for very large feature spaces).
+index stores consumed at train/score time. Three formats (``--format``):
+
+- ``npz`` (default): this framework's compact store
+  (data/index_map.IndexMap.load);
+- ``paldb``: REAL partitioned PalDB v1 stores under the reference's own
+  partition naming — byte-compatible with the reference's reader
+  (PalDBIndexMapBuilder.scala:98 / PalDBIndexMap.scala:43-278), closing the
+  interop round trip in both directions (data/paldb.py reads reference-built
+  stores; this writes stores reference tooling can read);
+- ``offheap``: the mmap store in data/offheap_index.py for feature spaces too
+  large to materialize.
 """
 
 from __future__ import annotations
@@ -28,7 +35,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--input-data-directories", required=True)
     p.add_argument("--output-directory", required=True)
     p.add_argument("--feature-shard-configurations", action="append", required=True)
-    p.add_argument("--num-partitions", type=int, default=1, help=argparse.SUPPRESS)
+    p.add_argument(
+        "--num-partitions", type=int, default=1,
+        help="partition count for partitioned store formats (paldb/offheap)",
+    )
+    p.add_argument(
+        "--format", choices=("npz", "paldb", "offheap"), default="npz",
+        help="index store format: npz (this framework's compact store), "
+        "paldb (real partitioned PalDB v1 stores, readable by the reference's "
+        "own tooling), offheap (mmap store for very large feature spaces)",
+    )
     return p
 
 
@@ -43,12 +59,29 @@ def run(args: argparse.Namespace) -> dict:
                 for f in rec.get(bag) or ():
                     keys[shard].add(feature_key(f["name"], f["term"]))
     os.makedirs(args.output_directory, exist_ok=True)
+    fmt = getattr(args, "format", "npz")
     sizes = {}
     for shard, cfg in shard_configs.items():
         imap = IndexMap.build(keys[shard], add_intercept=cfg.has_intercept)
-        imap.save(os.path.join(args.output_directory, shard))
+        if fmt == "paldb":
+            # real PalDB v1 stores under the reference's own partition naming
+            # (PalDBIndexMapBuilder.scala:98): reference tooling reads these,
+            # and _load_index_maps picks them up at train/score time.
+            from photon_ml_tpu.data import paldb
+
+            paldb.write_paldb_index_map(
+                args.output_directory, shard, imap.keys(), args.num_partitions
+            )
+        elif fmt == "offheap":
+            from photon_ml_tpu.data.offheap_index import OffHeapIndexMapBuilder
+
+            OffHeapIndexMapBuilder(
+                os.path.join(args.output_directory, shard), args.num_partitions
+            ).put_all(imap.keys()).build()
+        else:
+            imap.save(os.path.join(args.output_directory, shard))
         sizes[shard] = imap.size
-    return {"sizes": sizes, "output_directory": args.output_directory}
+    return {"sizes": sizes, "output_directory": args.output_directory, "format": fmt}
 
 
 def main(argv=None) -> int:

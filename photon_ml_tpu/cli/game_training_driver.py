@@ -168,19 +168,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 def _load_index_maps(directory: Optional[str], shard_ids) -> dict:
     """Per-shard saved index maps (GameDriver.prepareFeatureMapsDefault:
-    185-205): this framework's <dir>/<shard>.npz stores, or — when a shard
-    has none — reference-built partitioned PalDB stores
-    (paldb-partition-<shard>-<i>.dat), decoded natively by data/paldb.py so
-    reference index directories work unchanged."""
+    185-205), trying each store format the feature-indexing driver can emit:
+    this framework's <dir>/<shard>.npz, the mmap off-heap store
+    (<dir>/<shard>/meta, data/offheap_index.py), or partitioned PalDB stores
+    (paldb-partition-<shard>-<i>.dat) — including reference-built ones,
+    decoded natively by data/paldb.py so reference index directories work
+    unchanged."""
     if directory is None:
         return {}
     from photon_ml_tpu.data import paldb
+    from photon_ml_tpu.data.offheap_index import OffHeapIndexMap
 
     out = {}
     for shard in shard_ids:
         path = os.path.join(directory, f"{shard}.npz")
         if os.path.exists(path):
             out[shard] = IndexMap.load(path)
+        elif os.path.exists(os.path.join(directory, shard, "meta")):
+            out[shard] = OffHeapIndexMap(os.path.join(directory, shard))
         else:
             partitions = paldb.discover_partitions(directory, shard)
             if partitions:

@@ -296,6 +296,58 @@ class TestIndexingDrivers:
         imap = IndexMap.load(str(out / "shardA"))
         assert imap.size == 5  # 4 features + intercept
 
+    def test_feature_indexing_driver_paldb_format(self, tmp_path):
+        """--format paldb emits real partitioned PalDB v1 stores under the
+        reference's partition naming (PalDBIndexMapBuilder.scala:98), which
+        the training driver's index-map loader then consumes unchanged."""
+        rng = np.random.default_rng(1)
+        write_glmix_avro(str(tmp_path / "data.avro"), rng, n=50, d=6)
+        out = tmp_path / "maps"
+        rc = feature_indexing_driver.main([
+            "--input-data-directories", str(tmp_path / "data.avro"),
+            "--output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--format", "paldb",
+            "--num-partitions", "3",
+        ])
+        assert rc == 0
+        assert sorted(p.name for p in out.iterdir()) == [
+            f"paldb-partition-shardA-{i}.dat" for i in range(3)
+        ]
+        from photon_ml_tpu.cli.game_training_driver import _load_index_maps
+
+        maps = _load_index_maps(str(out), ["shardA"])
+        imap = maps["shardA"]
+        assert imap.size == 7  # 6 features + intercept
+        names = [imap.get_feature_name(i) for i in range(imap.size)]
+        assert len(set(names)) == 7
+        assert all(imap.get_index(n) == i for i, n in enumerate(names))
+
+    def test_feature_indexing_driver_offheap_format(self, tmp_path):
+        """--format offheap emits the mmap store and the training driver's
+        index-map loader consumes it through the same --off-heap-index-map
+        directory surface as the other formats."""
+        rng = np.random.default_rng(4)
+        write_glmix_avro(str(tmp_path / "data.avro"), rng, n=50, d=5)
+        out = tmp_path / "maps"
+        rc = feature_indexing_driver.main([
+            "--input-data-directories", str(tmp_path / "data.avro"),
+            "--output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--format", "offheap",
+            "--num-partitions", "2",
+        ])
+        assert rc == 0
+        assert (out / "shardA" / "meta").exists()
+        from photon_ml_tpu.cli.game_training_driver import _load_index_maps
+
+        imap = _load_index_maps(str(out), ["shardA"])["shardA"]
+        assert imap.size == 6  # 5 features + intercept
+        assert imap.intercept_index is not None
+        names = [imap.get_feature_name(i) for i in range(imap.size)]
+        assert len(set(names)) == 6
+        assert all(imap.get_index(n) == i for i, n in enumerate(names))
+
     def test_name_and_term_bags_driver(self, tmp_path):
         rng = np.random.default_rng(2)
         write_glmix_avro(str(tmp_path / "data.avro"), rng, n=30, d=3)
