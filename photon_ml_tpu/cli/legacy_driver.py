@@ -221,9 +221,14 @@ class LegacyDriver:
             )
 
         norm_type = NormalizationType(args.normalization_type)
-        if args.summarization_output_dir or norm_type != NormalizationType.NONE:
+        if (
+            args.summarization_output_dir
+            or norm_type != NormalizationType.NONE
+            or DiagnosticMode(args.diagnostic_mode) != DiagnosticMode.NONE
+        ):
             # summarize from the host-side matrix as read (sparse stays sparse
-            # — FeatureDataStatistics has a never-densify CSC path)
+            # — FeatureDataStatistics has a never-densify CSC path); the
+            # diagnostics tier needs the summary for importance reports
             self.summary = FeatureDataStatistics.compute(
                 raw.X, intercept_index=self.index_map.intercept_index
             )
@@ -311,10 +316,21 @@ class LegacyDriver:
         )
 
     def diagnose(self, out_path: str):
-        """Drive the diagnostics tier into one HTML report (REPORT_FILE)."""
+        """Drive the diagnostics tier into one HTML report (REPORT_FILE).
+
+        Document shape mirrors the reference's combined transformer
+        (DiagnosticToPhysicalReportTransformer.scala:36-137): a Summary
+        chapter (best lambda per metric + per-metric charts over the sweep),
+        a System chapter with the actual command-line options (the
+        reference's own parameters section is empty — circular-dependency
+        TODO in its snapshot), and a Detailed Model Diagnostics chapter with
+        one 'Model Analysis: <desc>, lambda=λ' section per swept lambda.
+        Cheap per-model diagnostics (validation metrics, feature importance,
+        Hosmer-Lemeshow, prediction-error independence) run for EVERY
+        lambda; the expensive training diagnostics (bootstrap, fitting
+        curves) run on the selected best lambda."""
         from photon_ml_tpu.diagnostics import (
-            Chapter,
-            Document,
+            assemble_document,
             bootstrap_section,
             bootstrap_training,
             expected_magnitude_importance,
@@ -324,10 +340,13 @@ class LegacyDriver:
             hosmer_lemeshow_section,
             hosmer_lemeshow_test,
             independence_section,
+            model_section,
             prediction_error_independence,
             render_html,
+            variance_importance,
         )
         from photon_ml_tpu.evaluation.evaluators import rmse
+        from photon_ml_tpu.evaluation.metric_map import LARGER_IS_BETTER
         from photon_ml_tpu.optimization.common import OptimizerConfig
         from photon_ml_tpu.optimization.config import GLMOptimizationConfiguration
         from photon_ml_tpu.optimization.problem import GLMOptimizationProblem
@@ -336,61 +355,97 @@ class LegacyDriver:
         best_lambda, best_model = (
             self.best if self.best is not None else self.lambda_models[-1]
         )
-        problem = GLMOptimizationProblem(
-            task=self.task,
-            configuration=GLMOptimizationConfiguration(
-                optimizer_config=OptimizerConfig(
-                    optimizer_type=OptimizerType(self.args.optimizer),
-                    max_iterations=self.args.max_number_iterations,
-                    tolerance=self.args.tolerance,
+
+        def make_problem(lam):
+            return GLMOptimizationProblem(
+                task=self.task,
+                configuration=GLMOptimizationConfiguration(
+                    optimizer_config=OptimizerConfig(
+                        optimizer_type=OptimizerType(self.args.optimizer),
+                        max_iterations=self.args.max_number_iterations,
+                        tolerance=self.args.tolerance,
+                    ),
+                    regularization_context=self.regularization_context,
+                    regularization_weight=lam,
                 ),
-                regularization_context=self.regularization_context,
-                regularization_weight=best_lambda,
-            ),
-            normalization=self.normalization,
-        )
+                normalization=self.normalization,
+            )
 
-        chapters = []
-        if mode in (DiagnosticMode.TRAIN, DiagnosticMode.ALL):
-            sections = []
-            boot = bootstrap_training(problem, self.train_data, num_bootstraps=8,
-                                      seed=7)
-            sections.append(bootstrap_section(boot))
-            if self.summary is not None:
-                fi = expected_magnitude_importance(
-                    np.asarray(best_model.coefficients.means), self.summary,
+        model_desc = f"{self.task.value} ({self.args.optimizer})"
+        model_sections = []
+        for lam, model in sorted(self.lambda_models, key=lambda x: x[0]):
+            subsections = []
+            means = np.asarray(model.coefficients.means)
+            if mode in (DiagnosticMode.VALIDATE, DiagnosticMode.ALL) and (
+                self.validation_data is not None
+            ):
+                v = self.validation_data
+                preds = np.asarray(
+                    model.predict(v.X, np.asarray(v.offsets, dtype=np.float64))
                 )
-                sections.append(feature_importance_section(fi))
+                labels = np.asarray(v.labels, dtype=np.float64)
+                errors = labels - preds
+                kt = prediction_error_independence(preds, labels)
+                subsections.append(independence_section(kt, preds, errors))
+            if self.summary is not None:
+                subsections.append(feature_importance_section(
+                    expected_magnitude_importance(
+                        means, self.summary, index_map=self.index_map
+                    )
+                ))
+                subsections.append(feature_importance_section(
+                    variance_importance(
+                        means, self.summary, index_map=self.index_map
+                    )
+                ))
+            if (
+                mode in (DiagnosticMode.TRAIN, DiagnosticMode.ALL)
+                and lam == best_lambda
+            ):
+                problem = make_problem(lam)
 
-            def factory(subset, warm):
-                glm, _ = problem.run(subset, warm)
-                return glm, glm
+                def factory(subset, warm):
+                    glm, _ = problem.run(subset, warm)
+                    return glm, glm
 
-            fit = fitting_diagnostic(
-                self.train_data, factory, {"RMSE": rmse}, seed=11
-            )
-            sections.append(fitting_section(fit))
-            chapters.append(Chapter("Training diagnostics", sections))
+                fit = fitting_diagnostic(
+                    self.train_data, factory, {"RMSE": rmse}, seed=11
+                )
+                subsections.append(fitting_section(fit))
+                boot_metrics = {"RMSE": rmse}
+                if self.task in (
+                    TaskType.LOGISTIC_REGRESSION,
+                    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+                ):
+                    from photon_ml_tpu.evaluation.evaluators import auc_roc
 
-        if (
-            mode in (DiagnosticMode.VALIDATE, DiagnosticMode.ALL)
-            and self.validation_data is not None
-        ):
-            sections = []
-            v = self.validation_data
-            means = np.asarray(
-                best_model.predict(v.X, np.asarray(v.offsets, dtype=np.float64))
-            )
-            labels = np.asarray(v.labels, dtype=np.float64)
-            if self.task == TaskType.LOGISTIC_REGRESSION:
-                hl = hosmer_lemeshow_test(means, labels)
-                sections.append(hosmer_lemeshow_section(hl))
-            kt = prediction_error_independence(means, labels)
-            sections.append(independence_section(kt))
-            chapters.append(Chapter("Validation diagnostics", sections))
+                    boot_metrics["AUC"] = auc_roc
+                boot = bootstrap_training(
+                    problem, self.train_data, num_bootstraps=8, seed=7,
+                    metrics=boot_metrics,
+                )
+                subsections.append(
+                    bootstrap_section(boot, index_map=self.index_map)
+                )
+            if mode in (DiagnosticMode.VALIDATE, DiagnosticMode.ALL) and (
+                self.validation_data is not None
+                and self.task == TaskType.LOGISTIC_REGRESSION
+            ):
+                hl = hosmer_lemeshow_test(preds, labels)
+                subsections.append(hosmer_lemeshow_section(hl))
+            model_sections.append(model_section(
+                model_desc, lam, self.per_model_metrics.get(lam, {}), subsections
+            ))
 
-        doc = Document(
-            f"Model diagnostics (best lambda = {best_lambda:g})", chapters
+        doc = assemble_document(
+            title=f"Modeling run: {self.task.value} "
+            f"(best lambda = {best_lambda:g})",
+            params={
+                k: v for k, v in vars(self.args).items() if k != "log_level"
+            },
+            metrics_by_lambda=self.per_model_metrics,
+            model_sections=model_sections,
+            best_is_max=dict(LARGER_IS_BETTER),
         )
         with open(out_path, "w") as f:
             f.write(render_html(doc))

@@ -36,10 +36,12 @@ from photon_ml_tpu.diagnostics.independence import (
     prediction_error_independence,
 )
 from photon_ml_tpu.diagnostics.reporting import (
+    BarChart,
     BulletedList,
     Chapter,
     Document,
     LineChart,
+    ScatterChart,
     Section,
     SimpleText,
     Table,
@@ -47,14 +49,19 @@ from photon_ml_tpu.diagnostics.reporting import (
     render_text,
 )
 from photon_ml_tpu.diagnostics.transformers import (
+    assemble_document,
     bootstrap_section,
     feature_importance_section,
     fitting_section,
     hosmer_lemeshow_section,
     independence_section,
+    model_section,
+    parameters_section,
+    summary_section,
 )
 
 __all__ = [
+    "BarChart",
     "BootstrapReport",
     "BulletedList",
     "Chapter",
@@ -65,9 +72,11 @@ __all__ = [
     "HosmerLemeshowReport",
     "KendallTauReport",
     "LineChart",
+    "ScatterChart",
     "Section",
     "SimpleText",
     "Table",
+    "assemble_document",
     "bootstrap_section",
     "bootstrap_training",
     "expected_magnitude_importance",
@@ -78,7 +87,10 @@ __all__ = [
     "hosmer_lemeshow_test",
     "independence_section",
     "kendall_tau_analysis",
+    "model_section",
+    "parameters_section",
     "prediction_error_independence",
+    "summary_section",
     "render_html",
     "render_text",
     "variance_importance",

@@ -94,9 +94,141 @@ class LineChart:
 
 
 @dataclasses.dataclass(frozen=True)
+class BarChart:
+    """Inline-SVG grouped bar chart (the reference renders these through
+    xchart's StyleManager.ChartType.Bar — PlotUtils.scala ranges). Each series
+    is (label, xs, heights); bars are grouped per x position."""
+
+    title: str
+    x_label: str
+    y_label: str
+    series: Sequence[tuple]
+    y_min: Optional[float] = None
+    y_max: Optional[float] = None
+
+    def to_svg(self, width: int = 640, height: int = 360) -> str:
+        pad = 48
+        xs_all = sorted({x for _, xs, _ in self.series for x in xs})
+        ys_all = [y for _, _, ys in self.series for y in ys]
+        if not xs_all:
+            return "<svg/>"
+        # both ends include the bar baseline (0): with all-negative values an
+        # unclamped range would put the baseline off-canvas and render every
+        # bar full-height (e.g. log-likelihood summary charts)
+        y0 = min(0.0, *ys_all) if self.y_min is None else self.y_min
+        y1 = max(0.0, *ys_all) if self.y_max is None else self.y_max
+        if y1 == y0:
+            y1 = y0 + 1.0
+        n_groups = len(xs_all)
+        n_series = max(1, len(self.series))
+        group_w = (width - 2 * pad) / n_groups
+        bar_w = max(1.0, group_w * 0.8 / n_series)
+        x_pos = {x: i for i, x in enumerate(xs_all)}
+
+        def sy(y):
+            return height - pad - (y - y0) / (y1 - y0) * (height - 2 * pad)
+
+        colors = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd", "#8c564b"]
+        parts = [
+            f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">',
+            f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'
+            f"{_html.escape(self.title)}</text>",
+            f'<line x1="{pad}" y1="{sy(0.0):.1f}" x2="{width-pad}" y2="{sy(0.0):.1f}" stroke="#333"/>',
+            f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{height-pad}" stroke="#333"/>',
+            f'<text x="{width/2:.0f}" y="{height-8}" text-anchor="middle" font-size="12">'
+            f"{_html.escape(self.x_label)}</text>",
+            f'<text x="14" y="{height/2:.0f}" text-anchor="middle" font-size="12" '
+            f'transform="rotate(-90 14 {height/2:.0f})">{_html.escape(self.y_label)}</text>',
+            f'<text x="{pad-4}" y="{sy(y0)+4:.1f}" font-size="10" text-anchor="end">{y0:.3g}</text>',
+            f'<text x="{pad-4}" y="{pad+4}" font-size="10" text-anchor="end">{y1:.3g}</text>',
+        ]
+        for gi, x in enumerate(xs_all):
+            gx = pad + gi * group_w + group_w * 0.1
+            parts.append(
+                f'<text x="{gx + group_w*0.4:.1f}" y="{height-pad+14}" font-size="9" '
+                f'text-anchor="middle">{x:.3g}</text>'
+            )
+        for si, (label, xs, ys) in enumerate(self.series):
+            color = colors[si % len(colors)]
+            for x, y in zip(xs, ys):
+                gx = pad + x_pos[x] * group_w + group_w * 0.1 + si * bar_w
+                top, base = sorted((sy(y), sy(max(y0, 0.0))))
+                parts.append(
+                    f'<rect x="{gx:.1f}" y="{top:.1f}" width="{bar_w:.1f}" '
+                    f'height="{max(base-top, 0.5):.1f}" fill="{color}" fill-opacity="0.8"/>'
+                )
+            parts.append(
+                f'<text x="{width-pad+4}" y="{pad + 16*si}" font-size="11" fill="{color}">'
+                f"{_html.escape(str(label))}</text>"
+            )
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScatterChart:
+    """Inline-SVG scatter plot (ChartType.Scatter; e.g. the reference's
+    'Error v. Prediction' plot). Each series is (label, xs, ys)."""
+
+    title: str
+    x_label: str
+    y_label: str
+    series: Sequence[tuple]
+
+    def to_svg(self, width: int = 640, height: int = 360) -> str:
+        pad = 48
+        xs_all = [x for _, xs, _ in self.series for x in xs]
+        ys_all = [y for _, _, ys in self.series for y in ys]
+        if not xs_all:
+            return "<svg/>"
+        x0, x1 = min(xs_all), max(xs_all)
+        y0, y1 = min(ys_all), max(ys_all)
+        if x1 == x0:
+            x1 = x0 + 1.0
+        if y1 == y0:
+            y1 = y0 + 1.0
+
+        def sx(x):
+            return pad + (x - x0) / (x1 - x0) * (width - 2 * pad)
+
+        def sy(y):
+            return height - pad - (y - y0) / (y1 - y0) * (height - 2 * pad)
+
+        colors = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd", "#8c564b"]
+        parts = [
+            f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">',
+            f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'
+            f"{_html.escape(self.title)}</text>",
+            f'<line x1="{pad}" y1="{height-pad}" x2="{width-pad}" y2="{height-pad}" stroke="#333"/>',
+            f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{height-pad}" stroke="#333"/>',
+            f'<text x="{width/2:.0f}" y="{height-8}" text-anchor="middle" font-size="12">'
+            f"{_html.escape(self.x_label)}</text>",
+            f'<text x="14" y="{height/2:.0f}" text-anchor="middle" font-size="12" '
+            f'transform="rotate(-90 14 {height/2:.0f})">{_html.escape(self.y_label)}</text>',
+            f'<text x="{pad}" y="{height-pad+14}" font-size="10">{x0:.3g}</text>',
+            f'<text x="{width-pad}" y="{height-pad+14}" font-size="10" text-anchor="end">{x1:.3g}</text>',
+            f'<text x="{pad-4}" y="{height-pad}" font-size="10" text-anchor="end">{y0:.3g}</text>',
+            f'<text x="{pad-4}" y="{pad+4}" font-size="10" text-anchor="end">{y1:.3g}</text>',
+        ]
+        for i, (label, xs, ys) in enumerate(self.series):
+            color = colors[i % len(colors)]
+            for x, y in zip(xs, ys):
+                parts.append(
+                    f'<circle cx="{sx(x):.1f}" cy="{sy(y):.1f}" r="2.5" '
+                    f'fill="{color}" fill-opacity="0.6"/>'
+                )
+            parts.append(
+                f'<text x="{width-pad+4}" y="{pad + 16*i}" font-size="11" fill="{color}">'
+                f"{_html.escape(str(label))}</text>"
+            )
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
 class Section:
     title: str
-    contents: Sequence  # SimpleText | BulletedList | Table | LineChart | Section
+    contents: Sequence  # SimpleText | BulletedList | Table | charts | Section
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,7 +275,7 @@ def _render_section_text(section: Section, number: str) -> list:
             if item.caption:
                 lines.append(f"({item.caption})")
             lines.append("")
-        elif isinstance(item, LineChart):
+        elif isinstance(item, (LineChart, BarChart, ScatterChart)):
             lines += [f"[chart: {item.title}]", ""]
         elif isinstance(item, Section):
             sub += 1
@@ -188,7 +320,7 @@ def _render_section_html(section: Section, number: str, level: int) -> str:
             head = "".join(f"<th>{_html.escape(str(h_))}</th>" for h_ in item.header)
             cap = f"<caption>{_html.escape(item.caption)}</caption>" if item.caption else ""
             out.append(f"<table>{cap}<tr>{head}</tr>{rows}</table>")
-        elif isinstance(item, LineChart):
+        elif isinstance(item, (LineChart, BarChart, ScatterChart)):
             out.append(item.to_svg())
         elif isinstance(item, Section):
             sub += 1

@@ -255,7 +255,7 @@ class TestReporting:
         )
         text = render_text(doc)
         html = render_html(doc)
-        assert "Bootstrap confidence intervals" in text
+        assert "Bootstrap Analysis" in text
         assert "Hosmer-Lemeshow" in text
         assert "<table>" in html and "<svg" in html
-        assert "RMSE vs training set size" in html
+        assert "Fit Analysis" in html and "Metric Plots" in html

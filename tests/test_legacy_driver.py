@@ -100,12 +100,36 @@ class TestLegacyDriver:
         assert not (out / "best-model-text").exists()
 
     def test_diagnostic_report(self, rng, tmp_path):
+        """The report's chapter/section set mirrors the reference's combined
+        transformer (DiagnosticToPhysicalReportTransformer.scala:36-137 and
+        the per-diagnostic *ToPhysicalReportTransformer section titles)."""
         rc, out, _ = self._run(tmp_path, rng, extra=["--diagnostic-mode", "ALL"])
         assert rc == 0
         html = (out / "model-diagnostic.html").read_text()
-        assert "Bootstrap confidence intervals" in html
-        assert "Hosmer-Lemeshow" in html
-        assert "<svg" in html
+        # document chapters (DiagnosticToPhysicalReportTransformer)
+        assert "Modeling run" in html
+        assert "Summary" in html
+        assert "Command-line options" in html
+        assert "Detailed Model Diagnostics" in html
+        # one Model Analysis section per swept lambda (default sweep used here)
+        assert html.count("Model Analysis:") == 2
+        assert "lambda=0.1" in html and "lambda=10" in html
+        # per-model sections (ModelDiagnosticToPhysicalReportTransformer order)
+        assert "Validation Set Metrics" in html
+        assert "Error / Prediction Independence Analysis" in html
+        assert "Kendall Tau Independence Test" in html
+        assert "Feature importance [Inner product expectation]" in html
+        assert "Feature importance [Variance contribution]" in html
+        assert "Fit Analysis" in html and "Metric Plots" in html
+        assert "Bootstrap Analysis" in html
+        assert "Metrics Distributions" in html
+        assert "Coefficient Analysis for Important Features" in html
+        assert "Features Straddling Zero" in html
+        assert "Hosmer-Lemeshow Goodness-of-Fit Test" in html
+        assert "degrees of freedom" in html
+        # summary chapter content: best lambda per metric + charts
+        assert "best:" in html and "@ lambda" in html
+        assert "<svg" in html and "<table>" in html
 
     def test_linear_task_with_constraints(self, rng, tmp_path):
         constraints = json.dumps(
