@@ -188,9 +188,6 @@ def run_multiprocess_fixed_effect(
 
     mesh = make_mesh(len(jax.devices()))
     train_data, _ = _assemble_global(train, shard, mesh, logger)
-    val_data = None
-    if val is not None:
-        val_data, _ = _assemble_global(val, shard, mesh, logger)
 
     from photon_ml_tpu.parallel import train_glm_sharded
 
@@ -204,8 +201,8 @@ def run_multiprocess_fixed_effect(
             )
         warm = coeffs
         auc = None
-        if val_data is not None:
-            auc = _validation_auc(val_data, coeffs)
+        if val is not None:
+            auc = _validation_auc(val, shard, coeffs)
             logger.info(
                 "lambda=%s validation AUC=%.6f",
                 opt_cfg.regularization_weight, auc,
@@ -214,7 +211,7 @@ def run_multiprocess_fixed_effect(
 
     best_i = (
         int(np.argmax([r[2] for r in results]))
-        if val_data is not None
+        if val is not None
         else len(results) - 1
     )
     logger.info("selected model %d of %d", best_i, len(results))
@@ -360,21 +357,18 @@ def _assemble_global(data, shard: str, mesh, logger):
     )
 
 
-def _validation_auc(val_data, coeffs) -> float:
+def _validation_auc(val_slice, shard: str, coeffs) -> float:
     """Weighted AUC over the global validation set: every process scores its
-    own addressable block; pad rows carry weight 0 and drop out of the
-    weighted pair statistic."""
-    import jax.numpy as jnp
-
-    scores = val_data.X.matvec(jnp.asarray(coeffs)) + val_data.offsets
-
-    def local_block(arr):
-        return np.concatenate(
-            [np.asarray(s.data) for s in arr.addressable_shards]
-        )
-
+    own HOST-SIDE file slice (see _host_scores for why the distributed
+    array's addressable shards must not be sliced for this) and the blocks
+    meet in a host allgather."""
+    scores = _host_scores(val_slice, shard, coeffs) + np.asarray(
+        val_slice.offsets, dtype=np.float64
+    )
     return _gathered_auc(
-        local_block(scores), local_block(val_data.labels), local_block(val_data.weights)
+        scores,
+        np.asarray(val_slice.labels, dtype=np.float64),
+        np.asarray(val_slice.weights, dtype=np.float64),
     )
 
 
@@ -405,13 +399,6 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
             continue
         if dc.projector is not None:
             reasons.append(f"coordinate {cid!r}: random projection")
-        if dc.feature_shard_id in index_maps and (
-            index_maps[dc.feature_shard_id].size > 4096
-        ):
-            reasons.append(
-                f"coordinate {cid!r}: random-effect shard wider than 4096 "
-                "(exchange rows travel dense)"
-            )
         if coord_configs[cid].per_entity_reg_weights:
             reasons.append(f"coordinate {cid!r}: per-entity regularization weights")
     for cid, cfg in coord_configs.items():
@@ -424,21 +411,92 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
                 f"shard {cfg.data_config.feature_shard_id!r}: multi-process "
                 "training requires PREBUILT index maps"
             )
-    if getattr(args, "validation_data_directories", None):
-        # single-process selection keeps the best PER-UPDATE snapshot
-        # (coordinate_descent.py best-model tracking); evaluating once per
-        # configuration here would silently save a different model
-        reasons.append(
-            "validation-based selection (single-process GAME selection keeps "
-            "per-update best snapshots; train without validation and evaluate "
-            "the saved models with the scoring driver)"
-        )
     # the flag-level restrictions are identical to the fixed-effect path
     fe_only = {ids[0]: coord_configs[ids[0]]} if ids else {}
     for r in multiprocess_fe_ineligibilities(args, fe_only, index_maps):
         if r not in reasons and r != MULTIPROC_DESIGN_POINTER:
             reasons.append(r)
     return reasons
+
+
+def _spill_re_rows_sparse(
+    spill, tag, X_re, owner_of_local, home_ids, gids_local, labels, weights,
+    rank, nproc, extra_cols=None,
+):
+    """Spill one coordinate's rows toward their entity owners: per-sample
+    metadata on ``tag`` and the feature matrix as COO triples on ``tag``-x.
+    Exchange volume is O(nnz), independent of shard width."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.parallel.shuffle import exchange_rows
+
+    coo = (X_re if sp.issparse(X_re) else sp.coo_matrix(np.asarray(X_re))).tocoo()
+    n_entries = len(coo.data)
+    entry_owner = (
+        owner_of_local[coo.row] if n_entries else np.zeros(0, dtype=np.int64)
+    )
+    exchange_rows(
+        spill, f"{tag}-x", entry_owner, np.zeros(n_entries, dtype=object),
+        {
+            "gid": gids_local[coo.row] if n_entries else np.zeros(0, np.int64),
+            "col": coo.col.astype(np.int64),
+            "val": coo.data.astype(np.float64),
+        },
+        rank, nproc,
+    )
+    cols = {"gid": gids_local, "label": labels, "weight": weights}
+    cols.update(extra_cols or {})
+    exchange_rows(spill, tag, owner_of_local, home_ids, cols, rank, nproc)
+
+
+def _collect_re_rows_sparse(spill, tag, width, rank, nproc):
+    """Collect both halves of :func:`_spill_re_rows_sparse` (after the
+    barrier): returns (entity_ids, gids, X csr [n, width], metadata cols)."""
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.parallel.shuffle import collect_exchanged_rows
+
+    own_ids, own = collect_exchanged_rows(os.path.join(spill, tag), rank, nproc)
+    _, ent = collect_exchanged_rows(os.path.join(spill, f"{tag}-x"), rank, nproc)
+    gids = own["gid"].astype(np.int64)
+    order = np.argsort(gids, kind="stable")
+    ent_gid = ent["gid"].astype(np.int64)
+    rowpos = (
+        order[np.searchsorted(gids[order], ent_gid)]
+        if len(ent_gid)
+        else np.zeros(0, dtype=np.int64)
+    )
+    X = sp.csr_matrix(
+        (ent["val"], (rowpos, ent["col"].astype(np.int64))),
+        shape=(len(own_ids), width),
+    )
+    return own_ids, gids, X, own
+
+
+def _re_score_rows(model, X_rows, entity_ids) -> np.ndarray:
+    """Score arbitrary CSR rows against a RandomEffectModel on the host:
+    per-entity coefficients scatter into a sparse [E+1, width] matrix (last
+    row = zeros for entities without a model), then score = rowwise
+    elementwise-product sum. O(nnz) — used for per-update validation scoring
+    on entity owners."""
+    import scipy.sparse as sp
+
+    n, width = X_rows.shape
+    if n == 0:
+        return np.zeros(0)
+    coeffs = np.asarray(model.coeffs, dtype=np.float64)
+    proj = np.asarray(model.proj_indices)
+    E = coeffs.shape[0]
+    er, slot = np.nonzero(proj >= 0)
+    M = sp.csr_matrix(
+        (coeffs[er, slot], (er, proj[er, slot].astype(np.int64))),
+        shape=(E + 1, width),
+    )
+    rows_idx = np.asarray(
+        [model.row_for_entity(e) for e in entity_ids], dtype=np.int64
+    )
+    sel = np.where(rows_idx >= 0, rows_idx, E)
+    return np.asarray(X_rows.multiply(M[sel]).sum(axis=1)).ravel()
 
 
 def run_multiprocess_game(
@@ -503,8 +561,6 @@ def run_multiprocess_game(
                 feature_shards=train.features,
                 validation_type=DataValidationType(args.data_validation),
             )
-    # validation-based selection is rejected by eligibility (per-update best
-    # snapshots cannot be reproduced here); selection is the last sweep config
     mesh = make_mesh(len(jax.devices()))
     fe_train, layout = _assemble_global(train, fe_shard, mesh, logger)
     n_local, _pad = layout
@@ -525,34 +581,29 @@ def run_multiprocess_game(
         c.owner_of_local = (
             entity_owner_hash(c.home_ids) % np.uint64(nproc)
         ).astype(np.int64) if n_local else np.zeros(0, dtype=np.int64)
-        X_re = train.shard(c.shard)
-        dense_rows = (
-            np.asarray(X_re.todense(), dtype=np.float32)
-            if sp.issparse(X_re)
-            else np.asarray(X_re, dtype=np.float32)
-        )
-        exchange_rows(
-            spill, f"{cid}-ingest", c.owner_of_local, c.home_ids,
-            {
-                "gid": gids_local,
-                "label": np.asarray(train.labels, dtype=np.float64) if train.has_labels else np.zeros(n_local),
-                "weight": np.asarray(train.weights, dtype=np.float64),
-                "x": dense_rows,
-            },
+        # RE feature rows travel as COO triples, never dense: the exchange
+        # volume is O(nnz) regardless of shard width, so arbitrarily wide
+        # sparse shards work (RandomEffectDataset.scala:46-508's shuffle is
+        # likewise sparse-record-shaped). Triples ride their own exchange tag
+        # keyed by global sample id; the owner reassembles CSR rows.
+        _spill_re_rows_sparse(
+            spill, f"{cid}-ingest", train.shard(c.shard), c.owner_of_local,
+            c.home_ids, gids_local,
+            np.asarray(train.labels, dtype=np.float64) if train.has_labels else np.zeros(n_local),
+            np.asarray(train.weights, dtype=np.float64),
             rank, nproc,
         )
         coords[cid] = c
     shuffle_barrier("ingest")
 
     for cid, c in coords.items():
-        own_ids, own = collect_exchanged_rows(
-            os.path.join(spill, f"{cid}-ingest"), rank, nproc
+        own_ids, c.gids_own, X_own, own = _collect_re_rows_sparse(
+            spill, f"{cid}-ingest", index_maps[c.shard].size, rank, nproc
         )
-        c.gids_own = own["gid"].astype(np.int64)
         dc = coord_configs[cid].data_config
         with Timed(f"build RE dataset {cid} ({len(own_ids)} rows)", logger):
             c.ds = build_random_effect_dataset(
-                sp.csr_matrix(own["x"].astype(np.float64)),
+                X_own,
                 own_ids,
                 dc.random_effect_type,
                 feature_shard_id=dc.feature_shard_id,
@@ -594,14 +645,106 @@ def run_multiprocess_game(
         aligned[pos] = got["o"]
         return aligned
 
+    # ---- validation ingest (per-update selection, CoordinateDescent.scala:256-289)
+    has_val = bool(getattr(args, "validation_data_directories", None))
+    val_coords: dict[str, RECoord] = {}
+    if has_val:
+        with Timed("read validation data", logger):
+            val = read_slice(
+                args.validation_data_directories,
+                getattr(args, "validation_data_date_range", None),
+                getattr(args, "validation_data_days_range", None),
+                "validation",
+            )
+        # validation rows never ride the device mesh here (scoring is
+        # host-side, _host_scores); only the common padded per-process row
+        # count is needed for the gid space
+        from jax.experimental import multihost_utils
+
+        n_val_local = val.n
+        val_counts = np.asarray(
+            multihost_utils.process_allgather(np.asarray([n_val_local]))
+        ).ravel()
+        block = int(val_counts.max())
+        per_process_val = ((block + mesh.devices.size - 1) // mesh.devices.size) * mesh.devices.size
+        vgid_base = rank * per_process_val
+        vgids_local = np.arange(n_val_local, dtype=np.int64) + vgid_base
+        for cid in re_cids:
+            dcv = coord_configs[cid].data_config
+            vc = RECoord()
+            vc.shard = dcv.feature_shard_id
+            vc.home_ids = np.asarray(val.ids(dcv.random_effect_type), dtype=object)
+            vc.owner_of_local = (
+                entity_owner_hash(vc.home_ids) % np.uint64(nproc)
+            ).astype(np.int64) if n_val_local else np.zeros(0, dtype=np.int64)
+            _spill_re_rows_sparse(
+                spill, f"{cid}-val", val.shard(vc.shard), vc.owner_of_local,
+                vc.home_ids, vgids_local,
+                np.zeros(n_val_local), np.zeros(n_val_local), rank, nproc,
+            )
+            val_coords[cid] = vc
+        shuffle_barrier("val-ingest")
+        for cid, vc in val_coords.items():
+            vc.ids_own, vc.gids_own, vc.X_own, _ = _collect_re_rows_sparse(
+                spill, f"{cid}-val", index_maps[vc.shard].size, rank, nproc
+            )
+            vc.home_of_own = vc.gids_own // per_process_val
+        val_base_off = np.asarray(val.offsets, dtype=np.float64)
+        val_labels = np.asarray(val.labels, dtype=np.float64)
+        val_weights = np.asarray(val.weights, dtype=np.float64)
+
     base_off_home = np.asarray(train.offsets, dtype=np.float64)
     sweep = expand_game_configurations(coord_configs)
     n_iter = args.coordinate_descent_iterations
     fe_coeffs = None
     re_models = {cid: None for cid in re_cids}
     re_scores_home = {cid: np.zeros(n_local) for cid in re_cids}
+
+    def _validation_auc_now(tagbase):
+        """Full-model validation AUC with the CURRENT coefficients: fixed
+        effect scored locally on each process's validation block, random
+        effects scored on their entity owners and sent home (unseen entities
+        score 0 — the reference's behavior)."""
+        fe_val_home = _host_scores(val, fe_shard, fe_coeffs)
+        total = val_base_off + fe_val_home
+        for vcid in re_cids:
+            vc = val_coords[vcid]
+            own_scores = (
+                _re_score_rows(re_models[vcid], vc.X_own, vc.ids_own)
+                if re_models[vcid] is not None
+                else np.zeros(len(vc.gids_own))
+            )
+            total = total + send_scores(
+                f"{tagbase}{vcid}-vs", vc.gids_own, own_scores,
+                vc.home_of_own, n_val_local, vgid_base,
+            )
+        return _gathered_auc(total, val_labels, val_weights)
+
     per_config = []
     for i, opt_configs in enumerate(sweep):
+        # per-update best-snapshot tracking within this configuration — the
+        # single-process CoordinateDescent's selection semantics
+        # (CoordinateDescent.scala:256-289): every coordinate update is a
+        # selection candidate, not just the configuration's final state
+        track = {"auc": None, "fe": None, "re": None}
+
+        def _track(tagbase):
+            if not has_val:
+                return
+            if any(re_models[c_] is None for c_ in re_cids):
+                # a snapshot before every coordinate has trained once is not
+                # a saveable GAME model; candidates start at the first update
+                # that completes the coordinate set
+                return
+            auc_now = _validation_auc_now(tagbase)
+            logger.debug("update %s validation AUC=%.6f", tagbase, auc_now)
+            if track["auc"] is None or auc_now > track["auc"]:
+                track.update(
+                    auc=auc_now,
+                    fe=np.asarray(fe_coeffs).copy(),
+                    re={c_: re_models[c_] for c_ in re_cids},
+                )
+
         for p in range(n_iter):
             # fixed effect: residual = base + sum of RE scores
             off_home = base_off_home + sum(re_scores_home.values())
@@ -618,7 +761,8 @@ def run_multiprocess_game(
                     fe_data, task, opt_configs[fe_cid], mesh,
                     initial_coefficients=fe_coeffs,
                 )
-            fe_home = _local_scores(fe_train, fe_coeffs, n_local)
+            _track(f"c{i}p{p}fe-")
+            fe_home = _host_scores(train, fe_shard, fe_coeffs)
             for cid in re_cids:
                 c = coords[cid]
                 partial = base_off_home + fe_home + sum(
@@ -636,15 +780,29 @@ def run_multiprocess_game(
                     f"c{i}p{p}{cid}-sc", c.gids_own, own_scores,
                     c.home_of_own, n_local, gid_base,
                 )
-        auc = None
-        per_config.append({
-            "configs": opt_configs,
-            "fe": np.asarray(fe_coeffs),
-            "re": {cid: re_models[cid] for cid in re_cids},
-            "auc": auc,
-        })
+                _track(f"c{i}p{p}{cid}-")
+        if has_val:
+            logger.info(
+                "cfg%d best per-update validation AUC=%.6f", i, track["auc"]
+            )
+            per_config.append({
+                "configs": opt_configs,
+                "fe": track["fe"],
+                "re": track["re"],
+                "auc": track["auc"],
+            })
+        else:
+            per_config.append({
+                "configs": opt_configs,
+                "fe": np.asarray(fe_coeffs),
+                "re": {cid: re_models[cid] for cid in re_cids},
+                "auc": None,
+            })
 
-    best_i = len(per_config) - 1  # no validation: last (weakest-reg) config
+    if has_val:
+        best_i = int(np.argmax([r["auc"] for r in per_config]))
+    else:
+        best_i = len(per_config) - 1  # no validation: last (weakest-reg) config
     logger.info("selected model %d of %d", best_i, len(per_config))
     summary = {
         "multiprocess": True,
@@ -748,16 +906,19 @@ def dataclasses_replace_offsets(data, offsets):
     return _dc.replace(data, offsets=offsets)
 
 
-def _local_scores(global_data, coeffs, n_local):
-    """This process's rows of X @ coeffs for a globally sharded LabeledData."""
-    import jax.numpy as jnp
+def _host_scores(game_input, shard: str, coeffs) -> np.ndarray:
+    """This process's rows of X @ coeffs, computed HOST-SIDE from its own
+    file slice.
 
-    scores = global_data.X.matvec(jnp.asarray(coeffs))
-
-    def local_block(arr):
-        return np.concatenate([np.asarray(s.data) for s in arr.addressable_shards])
-
-    return local_block(scores)[:n_local].astype(np.float64)
+    Never slice ``addressable_shards`` of a distributed matvec for this: if
+    XLA returns the result replicated (it may, and did), every process's
+    "local block" aliases the TOP of the global array — rank r>0 silently
+    reads rank 0's rows. Caught by the GAME parity tests once their
+    random-effect features became non-trivial: every rank's residual offsets
+    paired other ranks' fixed-effect scores with its own labels."""
+    X = game_input.shard(shard)
+    w = np.asarray(coeffs, dtype=np.float64)
+    return np.asarray(X @ w).ravel()
 
 
 def _gathered_auc(scores, labels, weights) -> float:

@@ -290,6 +290,14 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
         level=args.log_level,
     )
     emitter.send_event(Event("PhotonSetupEvent", {"applicationName": args.application_name}))
+    if rank == 0:
+        # printForCommandLine parity (ScoptParser.scala:40): the run's exact
+        # re-launchable command line, recorded next to its outputs
+        from photon_ml_tpu.cli.parsers import write_command_line_artifact
+
+        write_command_line_artifact(
+            os.path.join(root, "command-line.txt"), args, build_arg_parser()
+        )
 
     try:
         task = TaskType(args.training_task)

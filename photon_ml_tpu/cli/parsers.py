@@ -293,6 +293,53 @@ def parse_evaluator_spec(spec: str):
     return base
 
 
+def args_to_command_line(namespace, parser) -> list[str]:
+    """EXACT command-line round trip (ScoptParser.printForCommandLine,
+    io/scopt/ScoptParser.scala:40): render a parsed namespace back to argv
+    tokens such that ``parser.parse_args(tokens)`` reproduces the namespace
+    verbatim. The reference prints its ParamMap this way so any run can be
+    re-launched from its own recorded output; drivers write the result as a
+    ``command-line.txt`` artifact."""
+    import argparse
+
+    tokens: list[str] = []
+    for action in parser._actions:
+        if isinstance(
+            action,
+            (argparse._HelpAction, argparse._VersionAction, argparse._SubParsersAction),
+        ):
+            continue
+        if not action.option_strings:
+            continue
+        long_opts = [o for o in action.option_strings if o.startswith("--")]
+        opt = long_opts[0] if long_opts else action.option_strings[0]
+        value = getattr(namespace, action.dest, None)
+        if isinstance(action, argparse._StoreTrueAction):
+            if value is True:
+                tokens.append(opt)
+            continue
+        if isinstance(action, argparse._StoreFalseAction):
+            if value is False:
+                tokens.append(opt)
+            continue
+        if value is None:
+            continue
+        if isinstance(action, argparse._AppendAction):
+            for v in value:
+                tokens += [opt, str(v)]
+            continue
+        tokens += [opt, str(value)]
+    return tokens
+
+
+def write_command_line_artifact(path: str, namespace, parser) -> None:
+    """One shell-quoted re-launchable line (the reproducibility affordance)."""
+    import shlex
+
+    with open(path, "w") as f:
+        f.write(shlex.join(args_to_command_line(namespace, parser)) + "\n")
+
+
 def add_version_argument(p):
     """Uniform --version flag for every driver."""
     from photon_ml_tpu import __version__

@@ -16,6 +16,11 @@ import html as _html
 from typing import Optional, Sequence
 
 
+# shared series palette for every chart mark (one definition: a palette
+# tweak must not desynchronize colors across chart types in one report)
+_SERIES_COLORS = ("#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd", "#8c564b")
+
+
 @dataclasses.dataclass(frozen=True)
 class SimpleText:
     text: str
@@ -62,7 +67,7 @@ class LineChart:
         def sy(y):
             return height - pad - (y - y0) / (y1 - y0) * (height - 2 * pad)
 
-        colors = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd", "#8c564b"]
+        colors = _SERIES_COLORS
         parts = [
             f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">',
             f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'
@@ -128,7 +133,7 @@ class BarChart:
         def sy(y):
             return height - pad - (y - y0) / (y1 - y0) * (height - 2 * pad)
 
-        colors = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd", "#8c564b"]
+        colors = _SERIES_COLORS
         parts = [
             f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">',
             f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'
@@ -194,7 +199,7 @@ class ScatterChart:
         def sy(y):
             return height - pad - (y - y0) / (y1 - y0) * (height - 2 * pad)
 
-        colors = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd", "#8c564b"]
+        colors = _SERIES_COLORS
         parts = [
             f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">',
             f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'

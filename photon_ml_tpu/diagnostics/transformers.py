@@ -438,8 +438,10 @@ def summary_section(metrics_by_lambda: dict, best_is_max: dict = None) -> Sectio
                 title=name,
                 x_label="lambda",
                 y_label=name,
-                series=[(f"Lambda = {lam:g}", [float(i)], [values[lam]])
-                        for i, lam in enumerate(lams)],
+                # group x = the actual lambda (ticks label real values;
+                # BarChart positions groups by order, so uneven spacing is fine)
+                series=[(f"Lambda = {lam:g}", [float(lam)], [values[lam]])
+                        for lam in lams],
             )
         )
     # the reference nests a "Summary" section inside the "Summary" chapter;

@@ -1,0 +1,120 @@
+"""Compile-time HLO guards for the multi-chip pass.
+
+Two classes of SPMD regression compile and run bit-identically to the healthy
+program and only betray themselves in the per-device module:
+
+- **replication** (the closure-capture trap): every device computes the full
+  pass — caught by the block-shape guard (tests/test_parallel.py and
+  ``__graft_entry__.dryrun_multichip`` assert ``[N/m]``-row operand blocks);
+- **comm blow-up**: a resharding change that starts gathering per-sample or
+  per-entity-block tensors across the mesh — the pass still partitions, but
+  the wire carries the dataset instead of gradient-sized reductions. The
+  guards here catch that the way the shape guard catches replication.
+
+The healthy GLMix pass's collective profile (SURVEY §2.7: samples shard for
+the fixed-effect solve — treeAggregate == psum of value+gradient;
+entity-sharded random-effect solves are comm-free inside, with only the
+padded per-entity coefficient tables and the per-sample score vector
+exchanged between coordinates):
+
+- all-reduce payloads are at most gradient-sized ([D] + scalars),
+  convergence predicates, or a padded entity coefficient table ([E_pad, K] —
+  per-device scatter updates of entity-sharded solves combine by psum);
+- all-gathers materialize only entity coefficient tables ([E_pad, K]) and
+  per-sample score vectors ([N]) — never the design matrix or RE bucket
+  blocks;
+- no all-to-all / reduce-scatter / collective-permute at all today, so any
+  appearance is a deliberate-change signal, not noise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+_COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+# `%name = <shape-or-tuple> <kind>(`  — shape may be a tuple like
+# `(f32[], f32[24]{0})`; layout suffixes `{1,0}` are part of the token.
+_OP_RE = re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+(" + "|".join(_COLLECTIVE_KINDS) + r")(?:-start)?\("
+)
+_SHAPE_RE = re.compile(r"[a-z0-9]+\[([0-9,]*)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    kind: str
+    shape: str  # raw result-shape text
+    elements: int  # total elements across the (possibly tuple) result
+
+    @staticmethod
+    def parse_all(compiled_text: str) -> list:
+        out = []
+        for line in compiled_text.splitlines():
+            m = _OP_RE.search(line)
+            if not m:
+                continue
+            shape_text, kind = m.group(1), m.group(2)
+            elements = 0
+            for dims in _SHAPE_RE.findall(shape_text):
+                count = 1
+                for d in dims.split(","):
+                    if d:
+                        count *= int(d)
+                elements += count
+            out.append(Collective(kind=kind, shape=shape_text, elements=elements))
+        return out
+
+
+def assert_collective_profile(
+    compiled_text: str,
+    *,
+    grad_elements: int,
+    table_elements: int,
+    n_samples: int,
+    max_collectives: int = 48,
+) -> list:
+    """Fail if the compiled module's collectives exceed the healthy GLMix
+    profile. Returns the parsed collectives for reporting.
+
+    grad_elements: fixed-effect gradient size D.
+    table_elements: largest padded per-entity coefficient table (E_pad * K).
+    Legal all-reduce: value+gradient tuple and/or a coefficient-table
+    scatter-combine (XLA may fuse them into one tuple-shaped op). Legal
+    all-gather: entity tables and [n_samples] score vectors.
+    """
+    collectives = Collective.parse_all(compiled_text)
+    biggest_gather = max(table_elements, n_samples)
+    biggest_reduce = grad_elements + 1 + table_elements
+    for c in collectives:
+        if c.kind == "all-reduce":
+            assert c.elements <= biggest_reduce, (
+                f"all-reduce payload {c.shape} ({c.elements} elements) exceeds "
+                f"the gradient+entity-table bound {biggest_reduce} — a data- "
+                f"or bucket-block-sized reduction rides the wire every solver "
+                f"iteration"
+            )
+        elif c.kind == "all-gather":
+            assert c.elements <= biggest_gather, (
+                f"all-gather result {c.shape} ({c.elements} elements) exceeds "
+                f"the entity-table/score bound {biggest_gather} — the mesh is "
+                f"gathering dataset-sized tensors"
+            )
+        else:
+            raise AssertionError(
+                f"unexpected {c.kind} in the compiled pass ({c.shape}): the "
+                f"healthy profile has none; if this is a deliberate sharding "
+                f"change, extend assert_collective_profile"
+            )
+    assert len(collectives) <= max_collectives, (
+        f"{len(collectives)} collectives in one pass (cap {max_collectives}): "
+        f"collective count must scale with solver program count, not entities"
+    )
+    return collectives

@@ -2,7 +2,9 @@
 per-user random effect, entity exchange + per-pass score exchanges over the
 shared filesystem.
 
-Run as: python mp_game_worker.py <pid> <nproc> <port> <workdir>
+Run as: python mp_game_worker.py <pid> <nproc> <port> <workdir> [extra args...]
+(extra argv tokens are appended to the driver command line — e.g.
+``--validation-data-directories <dir>`` for the per-update-selection test).
 """
 
 import os
@@ -13,6 +15,7 @@ def main():
     pid, nproc, port, workdir = (
         int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
     )
+    extra = sys.argv[5:]
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
@@ -24,7 +27,9 @@ def main():
         "--input-data-directories", os.path.join(workdir, "in"),
         "--root-output-directory", os.path.join(workdir, "out"),
         "--feature-shard-configurations", "name=global,feature.bags=features",
-        "--feature-shard-configurations", "name=re,feature.bags=reFeatures",
+        # the re shard reads the same "features" bag; its index map scopes which
+        # features land in it (TRAINING_EXAMPLE_SCHEMA has no other bag)
+        "--feature-shard-configurations", "name=re,feature.bags=features",
         "--off-heap-index-map-directory", os.path.join(workdir, "index-maps"),
         "--training-task", "LOGISTIC_REGRESSION",
         "--coordinate-update-sequence", "global,per-user",
@@ -38,6 +43,7 @@ def main():
         "--distributed-coordinator", f"localhost:{port}",
         "--distributed-num-processes", str(nproc),
         "--distributed-process-id", str(pid),
+        *extra,
     ])
     run(args)
 

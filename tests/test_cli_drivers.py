@@ -280,6 +280,62 @@ class TestTrainScorePipeline:
             ])
 
 
+class TestCommandLineRoundTrip:
+    def test_args_to_command_line_exact_roundtrip(self):
+        """printForCommandLine parity (ScoptParser.scala:40): parse -> print
+        -> parse reproduces the namespace EXACTLY, including composite
+        configurations, append args, flag pairs, and numeric types."""
+        from photon_ml_tpu.cli.game_training_driver import build_arg_parser
+        from photon_ml_tpu.cli.parsers import args_to_command_line
+
+        parser = build_arg_parser()
+        argv = [
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", "/data/train",
+            "--validation-data-directories", "/data/val",
+            "--root-output-directory", "/out",
+            "--feature-shard-configurations",
+            "name=global,feature.bags=features|extra",
+            "--feature-shard-configurations",
+            "name=per-user,feature.bags=userFeatures,intercept=false",
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=50,"
+            "tolerance=1e-08,regularization=L2,reg.weights=0.1|1.0|10.0",
+            "--coordinate-update-sequence", "global",
+            "--coordinate-descent-iterations", "3",
+            "--override-output-directory",
+        ]
+        ns1 = parser.parse_args(argv)
+        tokens = args_to_command_line(ns1, parser)
+        ns2 = parser.parse_args(tokens)
+        assert vars(ns1) == vars(ns2)
+        # idempotent: printing the re-parsed namespace gives identical tokens
+        assert args_to_command_line(ns2, parser) == tokens
+
+    def test_command_line_artifact_written_and_relaunchable(self, tmp_path):
+        import shlex
+
+        from photon_ml_tpu.cli.game_training_driver import build_arg_parser
+
+        rng = np.random.default_rng(9)
+        write_glmix_avro(str(tmp_path / "train.avro"), rng, n=80, d=4)
+        out = tmp_path / "out"
+        rc = game_training_driver.main([
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--input-data-directories", str(tmp_path / "train.avro"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--coordinate-configurations", FE_COORD,
+            "--coordinate-update-sequence", "global",
+        ])
+        assert rc == 0
+        line = (out / "command-line.txt").read_text().strip()
+        ns = build_arg_parser().parse_args(shlex.split(line))
+        assert ns.training_task == "LOGISTIC_REGRESSION"
+        assert ns.root_output_directory == str(out)
+        assert ns.coordinate_configurations == [FE_COORD]
+
+
 class TestIndexingDrivers:
     def test_feature_indexing_driver(self, tmp_path):
         rng = np.random.default_rng(1)

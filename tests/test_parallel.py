@@ -335,3 +335,65 @@ def test_game_step_partitions_data_not_replicates():
     part = f"{n // 8},{d}"     # correctly partitioned per-device block
     assert txt.count(full) == 0, "fixed-effect matrix is replicated per device"
     assert txt.count(part) > 0
+
+    # Comm-volume guard on the same compiled module (the shape guard's
+    # companion): all-reduces stay gradient-sized, all-gathers stay
+    # entity-table/score-sized, nothing dataset-shaped rides the wire.
+    from photon_ml_tpu.parallel.hlo_guards import assert_collective_profile
+
+    table_elements = max((rc.n_entities + 1 + 8) * rc.max_k for rc in data.re)
+    collectives = assert_collective_profile(
+        txt, grad_elements=d, table_elements=table_elements, n_samples=n
+    )
+    assert any(c.kind == "all-reduce" for c in collectives)  # psum is present
+
+
+def test_collective_profile_guard_rejects_bad_profiles():
+    """assert_collective_profile parses real HLO shapes and fails on each
+    regression class: dataset-sized reduction, dataset-sized gather,
+    unexpected collective kinds, and collective-count blow-up."""
+    import pytest
+
+    from photon_ml_tpu.parallel.hlo_guards import (
+        Collective,
+        assert_collective_profile,
+    )
+
+    healthy = """
+  %all-reduce.42 = (f32[], f32[24]{0}) all-reduce(%a, %b), channel_id=1
+  ROOT %all-reduce.36 = pred[] all-reduce(%c), channel_id=5
+  %all-gather = f32[24,4]{1,0} all-gather(%p), channel_id=14, dimensions={0}
+  %all-gather.2 = f32[64]{0} all-gather(%q), channel_id=27, dimensions={0}
+"""
+    parsed = assert_collective_profile(
+        healthy, grad_elements=24, table_elements=96, n_samples=64
+    )
+    assert [c.kind for c in parsed].count("all-reduce") == 2
+    assert parsed[0].elements == 25  # tuple (f32[], f32[24])
+
+    with pytest.raises(AssertionError, match="all-reduce payload"):
+        assert_collective_profile(
+            healthy + "  %all-reduce.9 = f32[1024,24]{1,0} all-reduce(%x)\n",
+            grad_elements=24, table_elements=96, n_samples=64,
+        )
+    with pytest.raises(AssertionError, match="all-gather result"):
+        assert_collective_profile(
+            healthy + "  %all-gather.9 = f32[1024,24]{1,0} all-gather(%x)\n",
+            grad_elements=24, table_elements=96, n_samples=64,
+        )
+    with pytest.raises(AssertionError, match="unexpected all-to-all"):
+        assert_collective_profile(
+            healthy + "  %all-to-all.1 = f32[8]{0} all-to-all(%x)\n",
+            grad_elements=24, table_elements=96, n_samples=64,
+        )
+    many = healthy + "".join(
+        f"  %all-reduce.x{i} = pred[] all-reduce(%c)\n" for i in range(60)
+    )
+    with pytest.raises(AssertionError, match="collectives in one pass"):
+        assert_collective_profile(
+            many, grad_elements=24, table_elements=96, n_samples=64
+        )
+    # async -start form parses too
+    assert Collective.parse_all(
+        "  %ar = (f32[24]{0}) all-reduce-start(%x)\n"
+    )[0].elements == 24
