@@ -397,10 +397,15 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
         if not isinstance(dc, RandomEffectDataConfiguration):
             reasons.append(f"coordinate {cid!r}: only [fixed, random...] sequences")
             continue
-        if dc.projector is not None:
-            reasons.append(f"coordinate {cid!r}: random projection")
-        if coord_configs[cid].per_entity_reg_weights:
-            reasons.append(f"coordinate {cid!r}: per-entity regularization weights")
+        pw = coord_configs[cid].per_entity_reg_weights
+        if pw is not None and not isinstance(pw, dict):
+            # the array form binds to a dataset's entity ORDER; owners hold
+            # arbitrary entity subsets, so no global order exists to align to
+            reasons.append(
+                f"coordinate {cid!r}: per-entity reg weights must be a "
+                "{entity_id: weight} dict for multi-process training "
+                "(the [E]-array form has no global entity order to bind to)"
+            )
     for cid, cfg in coord_configs.items():
         if 0.0 < cfg.down_sampling_rate < 1.0:
             reasons.append(f"coordinate {cid!r}: down-sampling")
@@ -601,6 +606,14 @@ def run_multiprocess_game(
             spill, f"{cid}-ingest", index_maps[c.shard].size, rank, nproc
         )
         dc = coord_configs[cid].data_config
+        # shared random projection: the matrix is a pure function of
+        # (config seed, dim), so every process builds the identical
+        # projector with no cross-process state (game_estimator._projector_for)
+        from photon_ml_tpu.data.projector import make_projector
+
+        c.projector = make_projector(
+            dc.projector, index_maps[c.shard].size
+        ) if dc.projector is not None else None
         with Timed(f"build RE dataset {cid} ({len(own_ids)} rows)", logger):
             c.ds = build_random_effect_dataset(
                 X_own,
@@ -613,6 +626,7 @@ def run_multiprocess_game(
                 labels=own["label"],
                 weights=own["weight"],
                 dtype=jnp.float32,
+                projector=c.projector,
             )
         c.home_of_own = c.gids_own // per_process
 
@@ -700,6 +714,8 @@ def run_multiprocess_game(
     re_models = {cid: None for cid in re_cids}
     re_scores_home = {cid: np.zeros(n_local) for cid in re_cids}
 
+    _origin_cache: dict = {}
+
     def _validation_auc_now(tagbase):
         """Full-model validation AUC with the CURRENT coefficients: fixed
         effect scored locally on each process's validation block, random
@@ -709,9 +725,19 @@ def run_multiprocess_game(
         total = val_base_off + fe_val_home
         for vcid in re_cids:
             vc = val_coords[vcid]
+            vmodel = re_models[vcid]
+            if vmodel is not None and vmodel.projector is not None:
+                # _re_score_rows scatters per-entity coefficients by GLOBAL
+                # column id; a projected model's slots index the projected
+                # space, so score via its exact back-projection — computed
+                # once per trained model, not once per tracked update
+                cached = _origin_cache.get(vcid)
+                if cached is None or cached[0] is not vmodel:
+                    _origin_cache[vcid] = (vmodel, vmodel.to_original_space())
+                vmodel = _origin_cache[vcid][1]
             own_scores = (
-                _re_score_rows(re_models[vcid], vc.X_own, vc.ids_own)
-                if re_models[vcid] is not None
+                _re_score_rows(vmodel, vc.X_own, vc.ids_own)
+                if vmodel is not None
                 else np.zeros(len(vc.gids_own))
             )
             total = total + send_scores(
@@ -773,6 +799,9 @@ def run_multiprocess_game(
                     model, _tracker = train_random_effect(
                         c.ds, task, opt_configs[cid], jnp.asarray(off_own, jnp.float32),
                         initial_model=re_models[cid], dtype=jnp.float32,
+                        # dict entries resolve against the owner's own entity
+                        # set; absent entities keep the config weight
+                        per_entity_reg_weights=coord_configs[cid].per_entity_reg_weights,
                     )
                 re_models[cid] = model
                 own_scores = np.asarray(model.score_dataset(c.ds))
@@ -869,6 +898,8 @@ def run_multiprocess_game(
                 proj_indices=jnp.asarray(
                     np.concatenate(proj_rows) if ids_all else np.full((0, 1), -1, np.int32)
                 ),
+                # the ONE projector instance training used (built at ingest)
+                projector=coords[cid].projector,
             )
         game_model = GameModel(models={c: models[c] for c in coord_ids})
         result = GameResult(

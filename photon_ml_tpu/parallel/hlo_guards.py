@@ -43,7 +43,9 @@ _COLLECTIVE_KINDS = (
 # `%name = <shape-or-tuple> <kind>(`  — shape may be a tuple like
 # `(f32[], f32[24]{0})`; layout suffixes `{1,0}` are part of the token.
 _OP_RE = re.compile(
-    r"=\s*(\([^)]*\)|\S+)\s+(" + "|".join(_COLLECTIVE_KINDS) + r")(?:-start)?\("
+    r"=\s*(\([^)]*\)|\S+)\s+("
+    + "|".join(_COLLECTIVE_KINDS)
+    + r")(-start)?\("
 )
 _SHAPE_RE = re.compile(r"[a-z0-9]+\[([0-9,]*)\]")
 
@@ -61,9 +63,15 @@ class Collective:
             m = _OP_RE.search(line)
             if not m:
                 continue
-            shape_text, kind = m.group(1), m.group(2)
+            shape_text, kind, is_start = m.group(1), m.group(2), bool(m.group(3))
+            shapes = _SHAPE_RE.findall(shape_text)
+            if is_start and len(shapes) > 1:
+                # async form: the result tuple carries (operand, result) —
+                # counting both would double the payload and fail a legal
+                # full-size gather; only the RESULT half rides the wire
+                shapes = shapes[-1:]
             elements = 0
-            for dims in _SHAPE_RE.findall(shape_text):
+            for dims in shapes:
                 count = 1
                 for d in dims.split(","):
                     if d:

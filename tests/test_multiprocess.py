@@ -834,6 +834,15 @@ def test_two_process_game_training_single_entity(tmp_path):
         atol=2e-4,
     )
 
+def _entity_coeff_map(model, eid):
+    """{global column id: coefficient} for one entity — column-faithful
+    comparison (a value-multiset match would hide a permuted exchange)."""
+    row = model.row_for_entity(eid)
+    proj = np.asarray(model.proj_indices)[row]
+    coef = np.asarray(model.coeffs)[row]
+    return {int(c): float(v) for c, v in zip(proj, coef) if c >= 0}
+
+
 def test_two_process_game_training_wide_sparse_re_shard(tmp_path):
     """Random-effect shards wider than the old 4096 dense cap: exchange rows
     travel as COO triples (O(nnz) volume, width-independent), owners
@@ -961,10 +970,11 @@ def test_two_process_game_training_wide_sparse_re_shard(tmp_path):
     re_ref, re_got = ref.get_model("per-user"), got.get_model("per-user")
     assert set(re_got.entity_ids) == set(re_ref.entity_ids)
     for eid in re_ref.entity_ids:
-        a = re_ref.coefficients_for_entity(eid)
-        b = re_got.coefficients_for_entity(eid)
-        assert a.shape == b.shape
-        np.testing.assert_allclose(np.sort(b), np.sort(a), atol=5e-4, err_msg=str(eid))
+        a = _entity_coeff_map(re_ref, eid)
+        b = _entity_coeff_map(re_got, eid)
+        assert set(a) == set(b), eid  # same feature columns per entity
+        for col in a:
+            assert abs(a[col] - b[col]) < 5e-4, (eid, col, a[col], b[col])
 
 
 def test_two_process_game_validation_selects_best_lambda(tmp_path):
@@ -1108,3 +1118,141 @@ def test_two_process_game_validation_selects_best_lambda(tmp_path):
         "regularization_weight"]["per-user"]
     assert best_lam == 1.0
     assert best_lam == single_lam
+
+
+def test_two_process_game_training_random_projection(tmp_path):
+    """Random-projection coordinates train multi-process: the projection
+    matrix is a pure function of (config seed, dim), so every owner builds
+    the identical projector with no cross-process state; saved models export
+    through the exact back-projection and must match the single-process
+    driver (RandomEffectModelInProjectedSpace.scala:151 semantics)."""
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(53)
+    d, n_users, n_wide = 3, 6, 600
+    w_true = rng.normal(size=d)
+    u_eff = 1.5 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(
+        ["bias\x01"] + [f"w{j}\x01" for j in range(n_wide - 1)], add_intercept=False
+    )
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            wide = r.integers(1, n_wide - 1, size=3)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}]
+                + [
+                    {"name": f"w{int(j)}", "term": "", "value": float(r.normal())}
+                    for j in wide
+                ],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(120, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(100, seed=2),
+    )
+
+    re_coord = (
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,"
+        "reg.weights=1.0,projected.dim=4,projection.seed=17"
+    )
+    common = [
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations", re_coord,
+        "--coordinate-descent-iterations", "2",
+    ]
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+
+    run(build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        *common,
+    ]))
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_game_worker.py")
+    logs = [open(tmp_path / f"proj{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+             "--coordinate-configurations", re_coord],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (
+                f"proj {i} failed:\n" + (tmp_path / f"proj{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    def load(root):
+        return load_game_model(
+            str(root / "best"), {"global": fe_imap, "per-user": re_imap}
+        )
+
+    ref, got = load(tmp_path / "out-single"), load(tmp_path / "out")
+    np.testing.assert_allclose(
+        np.asarray(got.get_model("global").model.coefficients.means),
+        np.asarray(ref.get_model("global").model.coefficients.means),
+        atol=2e-3,
+    )
+    re_ref, re_got = ref.get_model("per-user"), got.get_model("per-user")
+    assert set(re_got.entity_ids) == set(re_ref.entity_ids)
+    any_nonzero = False
+    for eid in re_ref.entity_ids:
+        a = _entity_coeff_map(re_ref, eid)
+        b = _entity_coeff_map(re_got, eid)
+        assert set(a) == set(b), eid  # same original-space columns per entity
+        for col in a:
+            assert abs(a[col] - b[col]) < 2e-3, (eid, col, a[col], b[col])
+        any_nonzero = any_nonzero or (a and max(abs(v) for v in a.values()) > 1e-3)
+    assert any_nonzero
