@@ -77,22 +77,15 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
     if getattr(args, "data_summary_directory", None):
         reasons.append("--data-summary-directory")
     evaluators = getattr(args, "evaluators", None)
-    if evaluators and evaluators.strip().upper() != "AUC":
-        reasons.append(
-            "evaluators other than AUC (multi-process model selection "
-            "currently computes the gathered weighted AUC only)"
-        )
-    if (
-        getattr(args, "validation_data_directories", None)
-        and not TaskType(args.training_task).is_classification
-    ):
-        # the single-process path would select by the task's default metric
-        # (e.g. min RMSE); silently ranking by AUC over continuous labels
-        # would save a different, wrong model
-        reasons.append(
-            "validation-based selection for non-classification tasks "
-            "(multi-process selection computes AUC only)"
-        )
+    if evaluators:
+        from photon_ml_tpu.estimators.game_estimator import default_evaluator_type
+
+        default_name = default_evaluator_type(TaskType(args.training_task)).value
+        if evaluators.strip().upper() != default_name:
+            reasons.append(
+                "custom evaluators (multi-process selection computes the "
+                f"task's default evaluator only: {default_name})"
+            )
     return reasons
 
 
@@ -200,20 +193,28 @@ def run_multiprocess_fixed_effect(
                 train_data, task, opt_cfg, mesh, initial_coefficients=warm
             )
         warm = coeffs
-        auc = None
+        metric_value = None
+        metric_name = larger = None
         if val is not None:
-            auc = _validation_auc(val, shard, coeffs)
-            logger.info(
-                "lambda=%s validation AUC=%.6f",
-                opt_cfg.regularization_weight, auc,
+            scores = _host_scores(val, shard, coeffs) + np.asarray(
+                val.offsets, dtype=np.float64
             )
-        results.append((opt_cfg, np.asarray(coeffs), auc))
+            metric_name, metric_value, larger = _gathered_selection_metric(
+                task, scores,
+                np.asarray(val.labels, dtype=np.float64),
+                np.asarray(val.weights, dtype=np.float64),
+            )
+            logger.info(
+                "lambda=%s validation %s=%.6f",
+                opt_cfg.regularization_weight, metric_name, metric_value,
+            )
+        results.append((opt_cfg, np.asarray(coeffs), metric_value))
 
-    best_i = (
-        int(np.argmax([r[2] for r in results]))
-        if val is not None
-        else len(results) - 1
-    )
+    if val is not None:
+        values = [r[2] for r in results]
+        best_i = int(np.argmax(values) if larger else np.argmin(values))
+    else:
+        best_i = len(results) - 1
     logger.info("selected model %d of %d", best_i, len(results))
 
     # NOTE: the multi-process summary carries plain dicts (JSON-serializable,
@@ -222,7 +223,12 @@ def run_multiprocess_fixed_effect(
     summary = {
         "multiprocess": True,
         "results": [
-            {"regularization_weight": c.regularization_weight, "auc": a}
+            {
+                "regularization_weight": c.regularization_weight,
+                "auc": a if metric_name in (None, "AUC") else None,
+                "metric": metric_name,
+                "value": a,
+            }
             for c, _, a in results
         ],
         "best_index": best_i,
@@ -241,7 +247,7 @@ def run_multiprocess_fixed_effect(
             model=model,
             best_model=model,
             configuration={cid: best_cfg},
-            evaluations={"AUC": best_auc} if best_auc is not None else None,
+            evaluations={metric_name: best_auc} if best_auc is not None else None,
             best_metric=best_auc,
             descent=None,
         )
@@ -354,21 +360,6 @@ def _assemble_global(data, shard: str, mesh, logger):
             weights=assemble_vec(data.weights),
         ),
         (n_local, pad),
-    )
-
-
-def _validation_auc(val_slice, shard: str, coeffs) -> float:
-    """Weighted AUC over the global validation set: every process scores its
-    own HOST-SIDE file slice (see _host_scores for why the distributed
-    array's addressable shards must not be sliced for this) and the blocks
-    meet in a host allgather."""
-    scores = _host_scores(val_slice, shard, coeffs) + np.asarray(
-        val_slice.offsets, dtype=np.float64
-    )
-    return _gathered_auc(
-        scores,
-        np.asarray(val_slice.labels, dtype=np.float64),
-        np.asarray(val_slice.weights, dtype=np.float64),
     )
 
 
@@ -716,11 +707,12 @@ def run_multiprocess_game(
 
     _origin_cache: dict = {}
 
-    def _validation_auc_now(tagbase):
-        """Full-model validation AUC with the CURRENT coefficients: fixed
-        effect scored locally on each process's validation block, random
-        effects scored on their entity owners and sent home (unseen entities
-        score 0 — the reference's behavior)."""
+    def _validation_metric_now(tagbase):
+        """Full-model validation selection metric (the task's own —
+        _gathered_selection_metric, direction-aware) with the CURRENT
+        coefficients: fixed effect scored locally on each process's
+        validation block, random effects scored on their entity owners and
+        sent home (unseen entities score 0 — the reference's behavior)."""
         fe_val_home = _host_scores(val, fe_shard, fe_coeffs)
         total = val_base_off + fe_val_home
         for vcid in re_cids:
@@ -744,7 +736,7 @@ def run_multiprocess_game(
                 f"{tagbase}{vcid}-vs", vc.gids_own, own_scores,
                 vc.home_of_own, n_val_local, vgid_base,
             )
-        return _gathered_auc(total, val_labels, val_weights)
+        return _gathered_selection_metric(task, total, val_labels, val_weights)
 
     per_config = []
     for i, opt_configs in enumerate(sweep):
@@ -752,7 +744,7 @@ def run_multiprocess_game(
         # single-process CoordinateDescent's selection semantics
         # (CoordinateDescent.scala:256-289): every coordinate update is a
         # selection candidate, not just the configuration's final state
-        track = {"auc": None, "fe": None, "re": None}
+        track = {"value": None, "metric": None, "fe": None, "re": None}
 
         def _track(tagbase):
             if not has_val:
@@ -762,11 +754,16 @@ def run_multiprocess_game(
                 # a saveable GAME model; candidates start at the first update
                 # that completes the coordinate set
                 return
-            auc_now = _validation_auc_now(tagbase)
-            logger.debug("update %s validation AUC=%.6f", tagbase, auc_now)
-            if track["auc"] is None or auc_now > track["auc"]:
+            name, value, larger = _validation_metric_now(tagbase)
+            logger.debug("update %s validation %s=%.6f", tagbase, name, value)
+            better = (
+                track["value"] is None
+                or (value > track["value"] if larger else value < track["value"])
+            )
+            if better:
                 track.update(
-                    auc=auc_now,
+                    value=value,
+                    metric=name,
                     fe=np.asarray(fe_coeffs).copy(),
                     re={c_: re_models[c_] for c_ in re_cids},
                 )
@@ -812,24 +809,36 @@ def run_multiprocess_game(
                 _track(f"c{i}p{p}{cid}-")
         if has_val:
             logger.info(
-                "cfg%d best per-update validation AUC=%.6f", i, track["auc"]
+                "cfg%d best per-update validation %s=%.6f",
+                i, track["metric"], track["value"],
             )
             per_config.append({
                 "configs": opt_configs,
                 "fe": track["fe"],
                 "re": track["re"],
-                "auc": track["auc"],
+                "metric": track["metric"],
+                "value": track["value"],
+                "auc": track["value"] if track["metric"] == "AUC" else None,
             })
         else:
             per_config.append({
                 "configs": opt_configs,
                 "fe": np.asarray(fe_coeffs),
                 "re": {cid: re_models[cid] for cid in re_cids},
+                "metric": None,
+                "value": None,
                 "auc": None,
             })
 
     if has_val:
-        best_i = int(np.argmax([r["auc"] for r in per_config]))
+        from photon_ml_tpu.estimators.game_estimator import default_evaluator_type
+        from photon_ml_tpu.evaluation.evaluators import evaluator_for_type
+
+        values = [r["value"] for r in per_config]
+        larger = evaluator_for_type(
+            default_evaluator_type(TaskType(task))
+        ).larger_is_better
+        best_i = int(np.argmax(values) if larger else np.argmin(values))
     else:
         best_i = len(per_config) - 1  # no validation: last (weakest-reg) config
     logger.info("selected model %d of %d", best_i, len(per_config))
@@ -841,6 +850,8 @@ def run_multiprocess_game(
                     cid: r["configs"][cid].regularization_weight for cid in coord_ids
                 },
                 "auc": r["auc"],
+                "metric": r["metric"],
+                "value": r["value"],
             }
             for r in per_config
         ],
@@ -905,8 +916,9 @@ def run_multiprocess_game(
         result = GameResult(
             model=game_model, best_model=game_model,
             configuration=best["configs"],
-            evaluations={"AUC": best["auc"]} if best["auc"] is not None else None,
-            best_metric=best["auc"], descent=None,
+            evaluations={best["metric"]: best["value"]}
+            if best["value"] is not None else None,
+            best_metric=best["value"], descent=None,
         )
         imaps_by_coord = {
             c: index_maps[coord_configs[c].data_config.feature_shard_id]
@@ -952,13 +964,10 @@ def _host_scores(game_input, shard: str, coeffs) -> np.ndarray:
     return np.asarray(X @ w).ravel()
 
 
-def _gathered_auc(scores, labels, weights) -> float:
-    """Weighted AUC over host-gathered per-process blocks (ragged-safe:
-    blocks travel as object lists only when equal shapes are not guaranteed,
-    so gather each array padded with weight-0 rows)."""
+def _gather_blocks(scores, labels, weights):
+    """Host-allgather variable-length per-process blocks, padded with
+    weight-0 rows (inert in every weighted statistic)."""
     from jax.experimental import multihost_utils
-
-    from photon_ml_tpu.evaluation.evaluators import auc_roc
 
     n = np.asarray([len(scores)])
     counts = np.asarray(multihost_utils.process_allgather(n)).ravel()
@@ -969,10 +978,24 @@ def _gathered_auc(scores, labels, weights) -> float:
         out[: len(v)] = v
         return out
 
-    s, l, w = (
+    return tuple(
         np.asarray(x).reshape(-1)
         for x in multihost_utils.process_allgather(
             (pad(scores), pad(labels), pad(weights))
         )
     )
-    return float(auc_roc(s, l, weights=w))
+
+
+def _gathered_selection_metric(task, scores, labels, weights):
+    """(metric name, value, larger_is_better) for the TASK's default
+    evaluator over the gathered validation set — the same Evaluator object
+    the single-process path ranks by (GameEstimator defaultEvaluator +
+    EvaluatorFactory), so metric names and directions match across both
+    paths and a regression sweep is never ranked by AUC over continuous
+    labels."""
+    from photon_ml_tpu.estimators.game_estimator import default_evaluator_type
+    from photon_ml_tpu.evaluation.evaluators import evaluator_for_type
+
+    ev = evaluator_for_type(default_evaluator_type(TaskType(task)))
+    s, l, w = _gather_blocks(scores, labels, weights)
+    return ev.name, float(ev.evaluate(s, l, w)), ev.larger_is_better

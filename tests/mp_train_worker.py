@@ -3,9 +3,10 @@
 Each process ingests its round-robin slice of the input part files; gradient
 reductions cross processes as real collectives.
 
-Run as: python mp_train_worker.py <pid> <nproc> <port> <workdir>
+Run as: python mp_train_worker.py <pid> <nproc> <port> <workdir> [extra...]
 (<workdir> must contain in/ and val/ part files and index-maps/ written by
-the test.)
+the test; extra argv tokens append to the driver command line — later
+duplicate flags override the built-ins.)
 """
 
 import os
@@ -16,6 +17,7 @@ def main():
     pid, nproc, port, workdir = (
         int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
     )
+    extra = sys.argv[5:]
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
@@ -38,6 +40,7 @@ def main():
         "--distributed-coordinator", f"localhost:{port}",
         "--distributed-num-processes", str(nproc),
         "--distributed-process-id", str(pid),
+        *extra,
     ])
     run(args)
 

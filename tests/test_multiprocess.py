@@ -1256,3 +1256,102 @@ def test_two_process_game_training_random_projection(tmp_path):
             assert abs(a[col] - b[col]) < 2e-3, (eid, col, a[col], b[col])
         any_nonzero = any_nonzero or (a and max(abs(v) for v in a.values()) > 1e-3)
     assert any_nonzero
+
+
+def test_two_process_linear_training_selects_by_rmse(tmp_path):
+    """Regression-task validation selection in the multi-process FE path:
+    selection ranks by the task's own metric (min RMSE, ModelSelection.scala:
+    30-92) — never AUC over continuous labels. An absurd ridge weight must
+    lose to the sane one."""
+    import json as _json
+
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(61)
+    d = 5
+    w_true = rng.normal(size=d) * 2.0
+    imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": float(x @ w_true + 0.1 * r.normal()),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "val").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(160, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(140, seed=2),
+    )
+    avro_io.write_container(
+        str(tmp_path / "val" / "part-0.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(120, seed=3),
+    )
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_train_worker.py")
+    extra = [
+        "--training-task", "LINEAR_REGRESSION",
+        "--evaluators", "RMSE",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
+        "tolerance=1e-9,regularization=L2,reg.weights=0.1|100000",
+    ]
+    logs = [open(tmp_path / f"lin{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path), *extra],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (
+                f"lin {i} failed:\n" + (tmp_path / f"lin{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    summary = _json.loads((tmp_path / "out" / "summary.json").read_text())
+    rows = summary["results"]
+    assert all(r["metric"] == "RMSE" for r in rows)
+    assert all(r["auc"] is None for r in rows)  # no AUC-over-continuous lie
+    values = [r["value"] for r in rows]
+    assert summary["best_index"] == int(np.argmin(values))  # min-RMSE wins
+    best = rows[summary["best_index"]]
+    assert best["regularization_weight"] == 0.1
+    assert best["value"] < min(v for i, v in enumerate(values)
+                               if i != summary["best_index"])
