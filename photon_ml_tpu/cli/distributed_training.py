@@ -58,8 +58,6 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
                 "(--off-heap-index-map-directory; per-process maps built from "
                 "data slices would diverge)"
             )
-    if NormalizationType(args.normalization) != NormalizationType.NONE:
-        reasons.append("normalization (needs global feature statistics)")
     if args.hyper_parameter_tuning not in (None, "NONE"):
         reasons.append("hyperparameter tuning")
     if getattr(args, "model_input_directory", None):
@@ -182,6 +180,20 @@ def run_multiprocess_fixed_effect(
     mesh = make_mesh(len(jax.devices()))
     train_data, _ = _assemble_global(train, shard, mesh, logger)
 
+    norm_ctx = None
+    norm_type = NormalizationType(args.normalization)
+    if norm_type != NormalizationType.NONE:
+        # global statistics from per-process column sums (host allgather);
+        # the solve then runs in transformed space with original-space
+        # coefficients in/out, exactly the single-process contract
+        from photon_ml_tpu.normalization import NormalizationContext
+
+        with Timed("global feature statistics", logger):
+            stats = _global_feature_stats(
+                train, shard, index_maps[shard].intercept_index
+            )
+        norm_ctx = NormalizationContext.build(norm_type, stats)
+
     from photon_ml_tpu.parallel import train_glm_sharded
 
     results = []
@@ -190,7 +202,8 @@ def run_multiprocess_fixed_effect(
     for opt_cfg in sweep:
         with Timed(f"train lambda={opt_cfg.regularization_weight}", logger):
             coeffs, opt_res = train_glm_sharded(
-                train_data, task, opt_cfg, mesh, initial_coefficients=warm
+                train_data, task, opt_cfg, mesh, initial_coefficients=warm,
+                normalization=norm_ctx,
             )
         warm = coeffs
         metric_value = None
@@ -378,6 +391,10 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
     )
 
     reasons: list[str] = []
+    if NormalizationType(args.normalization) != NormalizationType.NONE:
+        # the FE-only path supports normalization (global stats allgather);
+        # folding it through the RE entity exchange is not wired yet
+        reasons.append("normalization for GAME configurations")
     ids = list(coord_configs)
     if not ids or not isinstance(
         coord_configs[ids[0]].data_config, FixedEffectDataConfiguration
@@ -947,6 +964,83 @@ def dataclasses_replace_offsets(data, offsets):
     import dataclasses as _dc
 
     return _dc.replace(data, offsets=offsets)
+
+
+def _global_feature_stats(game_input, shard: str, intercept_index):
+    """FeatureDataStatistics over the GLOBAL dataset from per-process slices:
+    each process reduces its own rows to per-column sums (sparse-safe, zeros
+    contribute implicitly) and the sums meet in a host allgather — the
+    multi-process form of MultivariateOnlineSummarizer. Matches
+    FeatureDataStatistics.compute on the concatenated data exactly (sample
+    variance, ddof=1)."""
+    import scipy.sparse as sp
+
+    from jax.experimental import multihost_utils
+    from photon_ml_tpu.normalization import FeatureDataStatistics
+
+    X = game_input.shard(shard)
+    n_local, d = X.shape
+    if sp.issparse(X):
+        Xc = X.tocsc()
+        if Xc.dtype != np.float64:
+            # squares and sums in float64: the variance cancellation
+            # s2 - n*mean^2 goes catastrophically wrong in f32 when
+            # |mean| >> std (and f32 squares already lose digits at ~1e4)
+            Xc = Xc.astype(np.float64)
+        s1 = np.asarray(Xc.sum(axis=0)).ravel()
+        s2 = np.asarray(Xc.multiply(Xc).sum(axis=0)).ravel()
+        sabs = np.asarray(abs(Xc).sum(axis=0)).ravel()
+        nnz = np.diff(Xc.indptr).astype(np.float64)
+        # vectorized per-column min/max over stored values — the same
+        # reduceat-with-empty-column-guard as FeatureDataStatistics._compute_sparse
+        mins = np.zeros(d)
+        maxs = np.zeros(d)
+        if n_local:
+            nonempty = nnz > 0
+            if Xc.nnz:
+                safe_starts = np.minimum(Xc.indptr[:-1], Xc.nnz - 1)
+                col_min = np.minimum.reduceat(Xc.data, safe_starts)
+                col_max = np.maximum.reduceat(Xc.data, safe_starts)
+                mins[nonempty] = col_min[nonempty]
+                maxs[nonempty] = col_max[nonempty]
+            has_implicit_zero = nnz < n_local
+            mins = np.where(has_implicit_zero, np.minimum(mins, 0.0), mins)
+            maxs = np.where(has_implicit_zero, np.maximum(maxs, 0.0), maxs)
+    else:
+        Xd = np.asarray(X, dtype=np.float64)
+        s1 = Xd.sum(axis=0)
+        s2 = (Xd * Xd).sum(axis=0)
+        sabs = np.abs(Xd).sum(axis=0)
+        nnz = (Xd != 0).sum(axis=0).astype(np.float64)
+        mins = Xd.min(axis=0) if n_local else np.zeros(d)
+        maxs = Xd.max(axis=0) if n_local else np.zeros(d)
+    if n_local == 0:
+        # inert aggregands; min/max use infinities so empty slices never win
+        mins = np.full(d, np.inf)
+        maxs = np.full(d, -np.inf)
+    parts = multihost_utils.process_allgather(
+        (np.asarray([float(n_local)]), s1, s2, sabs, nnz, mins, maxs)
+    )
+    counts, s1g, s2g, sabsg, nnzg, minsg, maxsg = (np.asarray(x) for x in parts)
+    n = float(counts.sum())
+    if n < 1:
+        raise ValueError("Cannot compute feature statistics over zero samples")
+    mean = s1g.sum(axis=0) / n
+    var = (
+        (s2g.sum(axis=0) - n * mean**2) / (n - 1.0)
+        if n > 1
+        else np.zeros(d)
+    )
+    return FeatureDataStatistics(
+        count=int(n),
+        mean=mean,
+        variance=np.maximum(var, 0.0),
+        min=minsg.min(axis=0),
+        max=maxsg.max(axis=0),
+        num_nonzeros=nnzg.sum(axis=0),
+        mean_abs=sabsg.sum(axis=0) / n,
+        intercept_index=intercept_index,
+    )
 
 
 def _host_scores(game_input, shard: str, coeffs) -> np.ndarray:

@@ -170,10 +170,10 @@ def sharded_glm_solver(
     use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
     use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
 
-    def solve(data, x0, l2, l1):
+    def solve(data, x0, l2, l1, norm):
         # Multi-device mesh path: GSPMD cannot partition an opaque pallas_call,
         # so the fused kernel stays off here regardless of the global switch.
-        obj = GLMObjective(loss, allow_fused=False)
+        obj = GLMObjective(loss, norm, allow_fused=False)
 
         def vg(w):
             return obj.value_and_gradient(data, w, l2)

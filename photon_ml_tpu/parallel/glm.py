@@ -75,23 +75,40 @@ def train_glm_sharded(
     mesh,
     *,
     initial_coefficients: Optional[Array] = None,
+    normalization=None,
 ) -> tuple[Array, OptResult]:
     """One fixed-effect GLM solve, samples sharded over ``mesh``.
 
     ``data`` should already be placed via :func:`shard_labeled_data` (un-placed
     arrays work too — jit will shard them to match the replicated-coefficient
     program, at the cost of an initial transfer).
+
+    ``normalization``: a NormalizationContext; same contract as
+    GLMOptimizationProblem (Optimizer.scala:175): inputs and the returned
+    coefficients live in ORIGINAL space, the solve runs in transformed space,
+    and the context's scaling folds into the objective's matvecs — sparse
+    designs are never densified by a mean shift.
     """
+    from photon_ml_tpu.normalization import NO_NORMALIZATION
+
     task = TaskType(task)
     cfg = configuration
     rep = replicated_sharding(mesh)
     dtype = data.X.dtype
+    # pad ONCE and use the padded context for every conversion: mixing the
+    # unpadded context into x0/result conversions would broadcast-fail the
+    # moment the feature axis is padded (parallel/feature_sharded.py regime)
+    norm = NO_NORMALIZATION if normalization is None else normalization
+    if not norm.is_identity:
+        norm = norm.padded_to(data.dim)
 
     x0 = (
         jnp.zeros((data.dim,), dtype=dtype)
         if initial_coefficients is None
         else jnp.asarray(initial_coefficients, dtype=dtype)
     )
+    if not norm.is_identity:
+        x0 = norm.to_transformed_space_device(x0)
     x0 = jax.device_put(x0, rep)
 
     solve = sharded_glm_solver(task, cfg.optimizer_config, bool(cfg.l1_weight), mesh)
@@ -100,5 +117,11 @@ def train_glm_sharded(
         x0,
         jnp.asarray(cfg.l2_weight, dtype=dtype),
         jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
+        norm,
     )
+    if not norm.is_identity:
+        # OptResult is a NamedTuple, not a dataclass
+        result = result._replace(
+            coefficients=norm.to_original_space_device(result.coefficients)
+        )
     return result.coefficients, result

@@ -1355,3 +1355,152 @@ def test_two_process_linear_training_selects_by_rmse(tmp_path):
     assert best["regularization_weight"] == 0.1
     assert best["value"] < min(v for i, v in enumerate(values)
                                if i != summary["best_index"])
+
+
+def test_two_process_training_with_standardization(tmp_path):
+    """Normalized multi-process fixed-effect training: global feature
+    statistics assemble from per-process column sums (host allgather), the
+    solve runs in transformed space, and the saved original-space model
+    matches the single-process driver's standardized fit."""
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(71)
+    d = 5
+    w_true = rng.normal(size=d)
+    # wildly different feature scales: normalization materially changes the fit
+    scales = np.array([1.0, 50.0, 0.02, 7.0, 300.0])
+    imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d) * scales
+            y = float((x @ (w_true / scales) + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "val").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(180, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(140, seed=2),
+    )
+    avro_io.write_container(
+        str(tmp_path / "val" / "part-0.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(100, seed=3),
+    )
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    common_extra = [
+        "--normalization", "STANDARDIZATION",
+    ]
+    run(build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--validation-data-directories", str(tmp_path / "val"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
+        "tolerance=1e-9,regularization=L2,reg.weights=0.1|10",
+        *common_extra,
+    ]))
+    ref = load_game_model(str(tmp_path / "out-single" / "best"), {"global": imap})
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_train_worker.py")
+    logs = [open(tmp_path / f"norm{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+             *common_extra],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (
+                f"norm {i} failed:\n" + (tmp_path / f"norm{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    got = load_game_model(str(tmp_path / "out" / "best"), {"global": imap})
+    fe_ref = np.asarray(ref.get_model("global").model.coefficients.means)
+    fe_got = np.asarray(got.get_model("global").model.coefficients.means)
+    assert np.abs(fe_ref).max() > 1e-3
+    # original-space coefficients: relative tolerance (feature scales span
+    # 1e4, and the two paths accumulate f32 differently in transformed space)
+    np.testing.assert_allclose(fe_got, fe_ref, rtol=5e-3, atol=1e-5)
+
+
+def test_global_feature_stats_matches_compute():
+    """_global_feature_stats (nproc=1 degenerate allgather) must equal
+    FeatureDataStatistics.compute exactly on dense AND sparse inputs — the
+    multi-process form of MultivariateOnlineSummarizer."""
+    import numpy as np
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.cli.distributed_training import _global_feature_stats
+    from photon_ml_tpu.normalization import FeatureDataStatistics
+
+    class FakeInput:
+        def __init__(self, X):
+            self._X = X
+
+        def shard(self, s):
+            return self._X
+
+    rng = np.random.default_rng(0)
+    Xd = rng.normal(size=(137, 6)) * np.array([1, 30, 0.01, 5, 100, 2.0])
+    # offset one column so |mean| >> std (the f32-cancellation regime)
+    Xd[:, 4] += 5000.0
+    Xs = sp.csr_matrix(np.where(np.abs(Xd) > 1.0, Xd, 0.0))
+    for name, X in (("dense", Xd), ("sparse", Xs.astype(np.float32))):
+        got = _global_feature_stats(FakeInput(X), "s", intercept_index=2)
+        # truth at f64: the helper upcasts sums deliberately, so for f32
+        # input it is MORE accurate than compute() on the raw f32 matrix
+        want = FeatureDataStatistics.compute(
+            X.astype(np.float64), intercept_index=2
+        )
+        for f in ("mean", "variance", "min", "max", "num_nonzeros", "mean_abs"):
+            np.testing.assert_allclose(
+                getattr(got, f), getattr(want, f), rtol=1e-6, atol=1e-9,
+                err_msg=f"{name}.{f}",
+            )
+        assert got.count == want.count
