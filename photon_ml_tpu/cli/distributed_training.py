@@ -60,8 +60,8 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
             )
     if args.hyper_parameter_tuning not in (None, "NONE"):
         reasons.append("hyperparameter tuning")
-    if getattr(args, "model_input_directory", None):
-        reasons.append("warm start / partial retrain from a model directory")
+    if getattr(args, "partial_retrain_locked_coordinates", None):
+        reasons.append("partial retrain with locked coordinates")
     if getattr(args, "checkpoint_directory", None):
         reasons.append("iteration checkpointing")
     if getattr(args, "compute_backend", "host") != "host":
@@ -198,6 +198,23 @@ def run_multiprocess_fixed_effect(
 
     results = []
     warm = None
+    if getattr(args, "model_input_directory", None):
+        # every rank loads the same model from the shared filesystem —
+        # warm start needs no exchange (GameTrainingDriver.scala:370-409)
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        with Timed("load initial model", logger):
+            init = load_game_model(
+                args.model_input_directory, {cid: index_maps[shard]}
+            )
+        fe_init = init.get_model(cid)
+        # a saved model without this coordinate cold-starts it, matching the
+        # single-process driver (game_estimator passes init=None through)
+        warm = (
+            np.asarray(fe_init.model.coefficients.means)
+            if fe_init is not None
+            else None
+        )
     sweep = cfg.expand()
     for opt_cfg in sweep:
         with Timed(f"train lambda={opt_cfg.regularization_weight}", logger):
@@ -722,6 +739,46 @@ def run_multiprocess_game(
     re_models = {cid: None for cid in re_cids}
     re_scores_home = {cid: np.zeros(n_local) for cid in re_cids}
 
+    imaps_by_coord = {
+        c: index_maps[coord_configs[c].data_config.feature_shard_id]
+        for c in coord_ids
+    }
+    if getattr(args, "model_input_directory", None):
+        # warm start (GameTrainingDriver.scala:370-409): every rank loads the
+        # same saved model; each owner keeps ONLY its own entities' rows
+        # (aligned_to its dataset — a full model on every rank would put each
+        # entity into nproc model parts at save), and the warm models' scores
+        # seed the first fixed-effect residual as in single-process descent.
+        # Coordinates absent from the saved model cold-start, matching the
+        # single-process driver.
+        from photon_ml_tpu.io.model_io import load_game_model
+
+        with Timed("load initial model", logger):
+            init_model = load_game_model(
+                args.model_input_directory, imaps_by_coord
+            )
+        fe_init = init_model.get_model(fe_cid)
+        if fe_init is not None:
+            fe_coeffs = jnp.asarray(
+                np.asarray(fe_init.model.coefficients.means), dtype=jnp.float32
+            )
+        for cid in re_cids:
+            c = coords[cid]
+            warm_re = init_model.get_model(cid)
+            if warm_re is None:
+                continue
+            if warm_re.projector is None and c.projector is not None:
+                raise ValueError(
+                    f"coordinate {cid!r}: cannot warm-start a random-"
+                    "projection coordinate from an original-space model"
+                )
+            re_models[cid] = warm_re.aligned_to(c.ds)
+            own_scores = np.asarray(re_models[cid].score_dataset(c.ds))
+            re_scores_home[cid] = send_scores(
+                f"warm{cid}-sc", c.gids_own, own_scores,
+                c.home_of_own, n_local, gid_base,
+            )
+
     _origin_cache: dict = {}
 
     def _validation_metric_now(tagbase):
@@ -937,10 +994,6 @@ def run_multiprocess_game(
             if best["value"] is not None else None,
             best_metric=best["value"], descent=None,
         )
-        imaps_by_coord = {
-            c: index_maps[coord_configs[c].data_config.feature_shard_id]
-            for c in coord_ids
-        }
         _save_result(
             os.path.join(root, "best"), result, imaps_by_coord,
             coord_configs, args.model_sparsity_threshold, logger,
