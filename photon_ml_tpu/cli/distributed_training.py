@@ -180,19 +180,11 @@ def run_multiprocess_fixed_effect(
     mesh = make_mesh(len(jax.devices()))
     train_data, _ = _assemble_global(train, shard, mesh, logger)
 
-    norm_ctx = None
-    norm_type = NormalizationType(args.normalization)
-    if norm_type != NormalizationType.NONE:
-        # global statistics from per-process column sums (host allgather);
-        # the solve then runs in transformed space with original-space
-        # coefficients in/out, exactly the single-process contract
-        from photon_ml_tpu.normalization import NormalizationContext
-
-        with Timed("global feature statistics", logger):
-            stats = _global_feature_stats(
-                train, shard, index_maps[shard].intercept_index
-            )
-        norm_ctx = NormalizationContext.build(norm_type, stats)
+    # global statistics -> transformed-space solves with original-space
+    # coefficients in/out, exactly the single-process contract
+    norm_ctx = _build_norm_contexts(
+        args, train, [shard], index_maps, logger
+    ).get(shard)
 
     from photon_ml_tpu.parallel import train_glm_sharded
 
@@ -408,10 +400,6 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
     )
 
     reasons: list[str] = []
-    if NormalizationType(args.normalization) != NormalizationType.NONE:
-        # the FE-only path supports normalization (global stats allgather);
-        # folding it through the RE entity exchange is not wired yet
-        reasons.append("normalization for GAME configurations")
     ids = list(coord_configs)
     if not ids or not isinstance(
         coord_configs[ids[0]].data_config, FixedEffectDataConfiguration
@@ -571,6 +559,7 @@ def run_multiprocess_game(
     )
     spill = os.path.join(root, "_shuffle")
 
+
     def read_slice(directories, date_range, days_range, what):
         return _read_file_slice(
             directories, date_range, days_range, what,
@@ -591,6 +580,14 @@ def run_multiprocess_game(
                 feature_shards=train.features,
                 validation_type=DataValidationType(args.data_validation),
             )
+    # one global NormalizationContext per DISTINCT shard (FE + RE): statistics
+    # reduce over each process's HOME rows, so the union covers every sample
+    # exactly once regardless of the entity exchange that follows
+    norm_ctxs = _build_norm_contexts(
+        args, train,
+        sorted({coord_configs[c].data_config.feature_shard_id for c in coord_ids}),
+        index_maps, logger,
+    )
     mesh = make_mesh(len(jax.devices()))
     fe_train, layout = _assemble_global(train, fe_shard, mesh, logger)
     n_local, _pad = layout
@@ -636,8 +633,13 @@ def run_multiprocess_game(
         # projector with no cross-process state (game_estimator._projector_for)
         from photon_ml_tpu.data.projector import make_projector
 
+        c.norm = norm_ctxs.get(c.shard)
+        # with a projector, normalization rides ON the projector so training
+        # and scoring datasets agree on the projected space (the estimator's
+        # _projector_for discipline)
         c.projector = make_projector(
-            dc.projector, index_maps[c.shard].size
+            dc.projector, index_maps[c.shard].size,
+            normalization=c.norm,
         ) if dc.projector is not None else None
         with Timed(f"build RE dataset {cid} ({len(own_ids)} rows)", logger):
             c.ds = build_random_effect_dataset(
@@ -650,6 +652,12 @@ def run_multiprocess_game(
                 features_max=dc.features_max,
                 labels=own["label"],
                 weights=own["weight"],
+                intercept_index=(
+                    c.norm.intercept_index
+                    if c.norm is not None and c.projector is None
+                    else None
+                ),
+                normalization=c.norm if c.projector is None else None,
                 dtype=jnp.float32,
                 projector=c.projector,
             )
@@ -857,6 +865,7 @@ def run_multiprocess_game(
                 fe_coeffs, _ = train_glm_sharded(
                     fe_data, task, opt_configs[fe_cid], mesh,
                     initial_coefficients=fe_coeffs,
+                    normalization=norm_ctxs.get(fe_shard),
                 )
             _track(f"c{i}p{p}fe-")
             fe_home = _host_scores(train, fe_shard, fe_coeffs)
@@ -870,6 +879,10 @@ def run_multiprocess_game(
                     model, _tracker = train_random_effect(
                         c.ds, task, opt_configs[cid], jnp.asarray(off_own, jnp.float32),
                         initial_model=re_models[cid], dtype=jnp.float32,
+                        # normalization folds per bucket; models stay in
+                        # original space (the projector carries it instead
+                        # for projected coordinates)
+                        normalization=c.norm if c.projector is None else None,
                         # dict entries resolve against the owner's own entity
                         # set; absent entities keep the config weight
                         per_entity_reg_weights=coord_configs[cid].per_entity_reg_weights,
@@ -1017,6 +1030,27 @@ def dataclasses_replace_offsets(data, offsets):
     import dataclasses as _dc
 
     return _dc.replace(data, offsets=offsets)
+
+
+def _build_norm_contexts(args, train, shard_ids, index_maps, logger) -> dict:
+    """{shard: NormalizationContext} from GLOBAL statistics for each shard —
+    the one construction both multi-process runners share. Empty when
+    normalization is off. ``shard_ids`` must be identically ordered on every
+    rank (the stats allgather is a collective)."""
+    norm_type = NormalizationType(args.normalization)
+    if norm_type == NormalizationType.NONE:
+        return {}
+    from photon_ml_tpu.normalization import NormalizationContext
+    from photon_ml_tpu.util.timed import Timed
+
+    out = {}
+    for shard_id in shard_ids:
+        with Timed(f"global feature statistics [{shard_id}]", logger):
+            stats = _global_feature_stats(
+                train, shard_id, index_maps[shard_id].intercept_index
+            )
+        out[shard_id] = NormalizationContext.build(norm_type, stats)
+    return out
 
 
 def _global_feature_stats(game_input, shard: str, intercept_index):

@@ -1684,3 +1684,141 @@ def test_two_process_game_warm_start_from_model_dir(tmp_path):
     )
     ids_v = got_v.get_model("per-user").entity_ids
     assert len(ids_v) == len(set(ids_v)) == n_users
+
+
+def test_two_process_game_training_with_standardization(tmp_path):
+    """Normalized multi-process GAME training: every shard's normalization
+    context builds from GLOBAL statistics (per-process column-sum allgather
+    over home rows), random-effect blocks fold the context per bucket with
+    models staying in original space, and the saved model matches the
+    single-process standardized run."""
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(97)
+    d, n_users = 3, 8
+    w_scales = np.array([1.0, 40.0, 0.05])
+    w_true = rng.normal(size=d)
+    u_eff = 1.3 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    # STANDARDIZATION requires an intercept in every normalized shard; the
+    # re shard's intercept column doubles as the per-entity bias
+    re_imap = IndexMap.build(["rx\x01"], add_intercept=True)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d) * w_scales
+            u = int(r.integers(0, n_users))
+            rx = r.normal() * 25.0  # wildly-scaled per-entity covariate
+            y = float(
+                (x @ (w_true / w_scales) + u_eff[u] + 0.02 * rx + 0.3 * r.normal())
+                > 0
+            )
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [
+                    {"name": "rx", "term": "", "value": float(rx)},
+                ],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(170, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=2),
+    )
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    common = [
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-descent-iterations", "2",
+        "--normalization", "STANDARDIZATION",
+    ]
+    run(build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        *common,
+    ]))
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_game_worker.py")
+    logs = [open(tmp_path / f"gnorm{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+             "--normalization", "STANDARDIZATION"],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (
+                f"gnorm {i} failed:\n" + (tmp_path / f"gnorm{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    def load(root):
+        return load_game_model(
+            str(root / "best"), {"global": fe_imap, "per-user": re_imap}
+        )
+
+    ref, got = load(tmp_path / "out-single"), load(tmp_path / "out")
+    fe_ref = np.asarray(ref.get_model("global").model.coefficients.means)
+    fe_got = np.asarray(got.get_model("global").model.coefficients.means)
+    assert np.abs(fe_ref).max() > 1e-3
+    np.testing.assert_allclose(fe_got, fe_ref, rtol=5e-3, atol=1e-5)
+    re_ref, re_got = ref.get_model("per-user"), got.get_model("per-user")
+    assert set(re_got.entity_ids) == set(re_ref.entity_ids)
+    any_nonzero = False
+    for eid in re_ref.entity_ids:
+        a = _entity_coeff_map(re_ref, eid)
+        b = _entity_coeff_map(re_got, eid)
+        assert set(a) == set(b), eid
+        for col in a:
+            assert abs(a[col] - b[col]) <= max(5e-3 * abs(a[col]), 2e-3), (
+                eid, col, a[col], b[col],
+            )
+        any_nonzero = any_nonzero or (a and max(abs(v) for v in a.values()) > 1e-3)
+    assert any_nonzero
