@@ -68,8 +68,8 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
         reasons.append("--compute-backend (the multi-process mesh is implicit)")
     if getattr(args, "coefficient_box_constraints", None):
         reasons.append("--coefficient-box-constraints")
-    if getattr(args, "output_mode", "BEST") != "BEST":
-        reasons.append("--output-mode (only the best model is written)")
+    if getattr(args, "output_mode", "BEST") == "TUNED":
+        reasons.append("--output-mode TUNED (implies hyperparameter tuning)")
     if getattr(args, "variance_computation_type", "NONE") != "NONE":
         reasons.append("coefficient variances")
     if getattr(args, "data_summary_directory", None):
@@ -258,27 +258,41 @@ def run_multiprocess_fixed_effect(
         "num_processes": nproc,
     }
     if rank == 0:
-        best_cfg, best_coeffs, best_auc = results[best_i]
-        glm = GeneralizedLinearModel(
-            Coefficients(jnp.asarray(best_coeffs)), TaskType(task)
-        )
-        model = GameModel(
-            models={cid: FixedEffectModel(model=glm, feature_shard_id=shard)}
-        )
-        result = GameResult(
-            model=model,
-            best_model=model,
-            configuration={cid: best_cfg},
-            evaluations={metric_name: best_auc} if best_auc is not None else None,
-            best_metric=best_auc,
-            descent=None,
-        )
-        _save_result(
-            os.path.join(root, "best"), result, {cid: index_maps[shard]},
-            coord_configs, args.model_sparsity_threshold, logger,
-        )
-        os.makedirs(os.path.join(root, "index-maps"), exist_ok=True)
-        index_maps[shard].save(os.path.join(root, "index-maps", f"{shard}.npz"))
+        from photon_ml_tpu.cli.parsers import ModelOutputMode
+
+        def fe_result(entry):
+            r_cfg, r_coeffs, r_value = entry
+            glm = GeneralizedLinearModel(
+                Coefficients(jnp.asarray(r_coeffs)), TaskType(task)
+            )
+            model = GameModel(
+                models={cid: FixedEffectModel(model=glm, feature_shard_id=shard)}
+            )
+            return GameResult(
+                model=model,
+                best_model=model,
+                configuration={cid: r_cfg},
+                evaluations={metric_name: r_value} if r_value is not None else None,
+                best_metric=r_value,
+                descent=None,
+            )
+
+        output_mode = ModelOutputMode(args.output_mode)
+        if output_mode != ModelOutputMode.NONE:
+            _save_result(
+                os.path.join(root, "best"), fe_result(results[best_i]),
+                {cid: index_maps[shard]},
+                coord_configs, args.model_sparsity_threshold, logger,
+            )
+            if output_mode in (ModelOutputMode.ALL, ModelOutputMode.EXPLICIT):
+                for i, entry in enumerate(results):
+                    _save_result(
+                        os.path.join(root, "models", str(i)), fe_result(entry),
+                        {cid: index_maps[shard]},
+                        coord_configs, args.model_sparsity_threshold, logger,
+                    )
+            os.makedirs(os.path.join(root, "index-maps"), exist_ok=True)
+            index_maps[shard].save(os.path.join(root, "index-maps", f"{shard}.npz"))
         with open(os.path.join(root, "summary.json"), "w") as f:
             json.dump(summary, f, indent=2)
     from jax.experimental import multihost_utils
@@ -947,30 +961,51 @@ def run_multiprocess_game(
         "num_processes": nproc,
     }
 
-    # ---- assemble + save the best model (rank 0) ------------------------------
-    best = per_config[best_i]
+    # ---- assemble + save models (rank 0) --------------------------------------
+    # ModelOutputMode (GameTrainingDriver.scala:759-826): BEST writes best/
+    # only, ALL additionally writes models/<i>/ per trained configuration,
+    # NONE writes no model (summary.json still lands). EXPLICIT/TUNED imply
+    # hyperparameter tuning, which multi-process rejects.
+    from photon_ml_tpu.cli.parsers import ModelOutputMode
+
+    output_mode = ModelOutputMode(args.output_mode)
+    save_all = output_mode in (ModelOutputMode.ALL, ModelOutputMode.EXPLICIT)
     model_dir = os.path.join(spill, "model-parts")
     os.makedirs(model_dir, exist_ok=True)
-    for cid in re_cids:
-        m = best["re"][cid]
-        np.savez(
-            os.path.join(model_dir, f"{cid}-part{rank:05d}.npz"),
-            entity_ids=np.asarray(m.entity_ids, dtype=str),
-            coeffs=np.asarray(m.coeffs),
-            proj=np.asarray(m.proj_indices),
-        )
+    # (tag, config index, output dirs): parts are written once per config
+    # tag — best/ reuses its own config's parts rather than serializing the
+    # same (possibly millions-of-entities) tables twice
+    to_save: list = []
+    if output_mode != ModelOutputMode.NONE:
+        save_indices = range(len(per_config)) if save_all else [best_i]
+        for i in save_indices:
+            dirs = []
+            if i == best_i:
+                dirs.append(os.path.join(root, "best"))
+            if save_all:
+                dirs.append(os.path.join(root, "models", str(i)))
+            to_save.append((f"cfg{i}", i, dirs))
+    for tag, idx, _ in to_save:
+        for cid in re_cids:
+            m = per_config[idx]["re"][cid]
+            np.savez(
+                os.path.join(model_dir, f"{cid}-{tag}-part{rank:05d}.npz"),
+                entity_ids=np.asarray(m.entity_ids, dtype=str),
+                coeffs=np.asarray(m.coeffs),
+                proj=np.asarray(m.proj_indices),
+            )
     shuffle_barrier("model-parts")
-    if rank == 0:
+
+    def _assemble_result(tag, entry) -> "GameResult":
         glm = GeneralizedLinearModel(
-            Coefficients(jnp.asarray(best["fe"])), TaskType(task)
+            Coefficients(jnp.asarray(entry["fe"])), TaskType(task)
         )
-        models = {cid: FixedEffectModel(model=glm, feature_shard_id=fe_shard)
-                  for cid in [fe_cid]}
+        models = {fe_cid: FixedEffectModel(model=glm, feature_shard_id=fe_shard)}
         for cid in re_cids:
             parts = []
             for r in range(nproc):
                 with np.load(
-                    os.path.join(model_dir, f"{cid}-part{r:05d}.npz")
+                    os.path.join(model_dir, f"{cid}-{tag}-part{r:05d}.npz")
                 ) as z:
                     parts.append({k: z[k] for k in z.files})
             k_max = max(int(p["coeffs"].shape[1]) if p["coeffs"].size else 1 for p in parts)
@@ -1000,20 +1035,26 @@ def run_multiprocess_game(
                 projector=coords[cid].projector,
             )
         game_model = GameModel(models={c: models[c] for c in coord_ids})
-        result = GameResult(
+        return GameResult(
             model=game_model, best_model=game_model,
-            configuration=best["configs"],
-            evaluations={best["metric"]: best["value"]}
-            if best["value"] is not None else None,
-            best_metric=best["value"], descent=None,
+            configuration=entry["configs"],
+            evaluations={entry["metric"]: entry["value"]}
+            if entry["value"] is not None else None,
+            best_metric=entry["value"], descent=None,
         )
-        _save_result(
-            os.path.join(root, "best"), result, imaps_by_coord,
-            coord_configs, args.model_sparsity_threshold, logger,
-        )
-        os.makedirs(os.path.join(root, "index-maps"), exist_ok=True)
-        for shard in {c.data_config.feature_shard_id for c in coord_configs.values()}:
-            index_maps[shard].save(os.path.join(root, "index-maps", f"{shard}.npz"))
+
+    if rank == 0:
+        for tag, idx, out_dirs in to_save:
+            result = _assemble_result(tag, per_config[idx])
+            for out_dir in out_dirs:
+                _save_result(
+                    out_dir, result, imaps_by_coord,
+                    coord_configs, args.model_sparsity_threshold, logger,
+                )
+        if to_save:
+            os.makedirs(os.path.join(root, "index-maps"), exist_ok=True)
+            for shard in {c.data_config.feature_shard_id for c in coord_configs.values()}:
+                index_maps[shard].save(os.path.join(root, "index-maps", f"{shard}.npz"))
         with open(os.path.join(root, "summary.json"), "w") as f:
             json.dump(summary, f, indent=2)
     shuffle_barrier("train-done")

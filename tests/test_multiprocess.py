@@ -1822,3 +1822,202 @@ def test_two_process_game_training_with_standardization(tmp_path):
             )
         any_nonzero = any_nonzero or (a and max(abs(v) for v in a.values()) > 1e-3)
     assert any_nonzero
+
+
+def test_multiprocess_output_mode_all_and_none(tmp_path):
+    """--output-mode ALL writes models/<i>/ per swept configuration alongside
+    best/ (GameTrainingDriver.scala:759-826); NONE writes no model but still
+    records summary.json. Exercised through the library runner at nproc=1
+    (same code path; shuffle barriers no-op)."""
+    import json as _json
+
+    import numpy as np
+
+    from photon_ml_tpu.cli.distributed_training import run_multiprocess_game
+    from photon_ml_tpu.cli.game_training_driver import (
+        _load_index_maps,
+        build_arg_parser,
+    )
+    from photon_ml_tpu.cli.parsers import (
+        parse_coordinate_configuration,
+        parse_feature_shard_configuration,
+    )
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.util import PhotonLogger
+
+    rng = np.random.default_rng(29)
+    d, n_users = 3, 5
+    w_true = rng.normal(size=d)
+    u_eff = 1.5 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=1),
+    )
+
+    def run_mode(mode, out):
+        args = build_arg_parser().parse_args([
+            "--input-data-directories", str(tmp_path / "in"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=global,feature.bags=features",
+            "--feature-shard-configurations", "name=re,feature.bags=features",
+            "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--coordinate-update-sequence", "global,per-user",
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=60,"
+            "tolerance=1e-9,regularization=L2,reg.weights=0.1|10",
+            "--coordinate-configurations",
+            "name=per-user,feature.shard=re,random.effect.type=userId,"
+            "optimizer=LBFGS,max.iter=40,tolerance=1e-9,regularization=L2,"
+            "reg.weights=1.0",
+            "--coordinate-descent-iterations", "1",
+            "--output-mode", mode,
+        ])
+        shard_configs = dict(
+            parse_feature_shard_configuration(a)
+            for a in args.feature_shard_configurations
+        )
+        coord_configs = dict(
+            parse_coordinate_configuration(a) for a in args.coordinate_configurations
+        )
+        os.makedirs(out, exist_ok=True)
+        run_multiprocess_game(
+            args, 0, 1, PhotonLogger(str(out / "log.txt")), str(out),
+            TaskType("LOGISTIC_REGRESSION"), coord_configs, shard_configs,
+            _load_index_maps(args.off_heap_index_map_directory, shard_configs),
+        )
+
+    run_mode("ALL", tmp_path / "all")
+    assert (tmp_path / "all" / "best").is_dir()
+    for i in (0, 1):
+        spec = _json.loads(
+            (tmp_path / "all" / "models" / str(i) / "model-spec.json").read_text()
+        )
+        assert "global" in spec and "per-user" in spec
+    # the two configs differ by reg weight in their recorded specs
+    s0 = (tmp_path / "all" / "models" / "0" / "model-spec.json").read_text()
+    s1 = (tmp_path / "all" / "models" / "1" / "model-spec.json").read_text()
+    assert s0 != s1
+
+    run_mode("NONE", tmp_path / "none")
+    assert not (tmp_path / "none" / "best").exists()
+    assert (tmp_path / "none" / "summary.json").exists()
+
+
+def test_multiprocess_fe_output_mode_all_and_none(tmp_path):
+    """The fixed-effect-only runner's ALL/NONE branches: models/<i>/ per
+    swept lambda, and NONE leaving only summary.json."""
+    import json as _json
+
+    import numpy as np
+
+    from photon_ml_tpu.cli.distributed_training import run_multiprocess_fixed_effect
+    from photon_ml_tpu.cli.game_training_driver import (
+        _load_index_maps,
+        build_arg_parser,
+    )
+    from photon_ml_tpu.cli.parsers import (
+        parse_coordinate_configuration,
+        parse_feature_shard_configuration,
+    )
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.util import PhotonLogger
+
+    rng = np.random.default_rng(43)
+    d = 4
+    w_true = rng.normal(size=d)
+    imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": float((x @ w_true + 0.3 * r.normal()) > 0),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(120, seed=1),
+    )
+
+    def run_mode(mode, out):
+        args = build_arg_parser().parse_args([
+            "--input-data-directories", str(tmp_path / "in"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=global,feature.bags=features",
+            "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--coordinate-update-sequence", "global",
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=60,"
+            "tolerance=1e-9,regularization=L2,reg.weights=0.1|10",
+            "--output-mode", mode,
+        ])
+        shard_configs = dict(
+            parse_feature_shard_configuration(a)
+            for a in args.feature_shard_configurations
+        )
+        coord_configs = dict(
+            parse_coordinate_configuration(a) for a in args.coordinate_configurations
+        )
+        os.makedirs(out, exist_ok=True)
+        run_multiprocess_fixed_effect(
+            args, 0, 1, PhotonLogger(str(out / "log.txt")), str(out),
+            TaskType("LOGISTIC_REGRESSION"), coord_configs, shard_configs,
+            _load_index_maps(args.off_heap_index_map_directory, shard_configs),
+        )
+
+    run_mode("ALL", tmp_path / "all")
+    assert (tmp_path / "all" / "best").is_dir()
+    specs = set()
+    for i in (0, 1):
+        spec = _json.loads(
+            (tmp_path / "all" / "models" / str(i) / "model-spec.json").read_text()
+        )
+        specs.add(spec["global"])
+    assert len(specs) == 2  # distinct reg weights recorded per config
+
+    run_mode("NONE", tmp_path / "none")
+    assert not (tmp_path / "none" / "best").exists()
+    assert (tmp_path / "none" / "summary.json").exists()
