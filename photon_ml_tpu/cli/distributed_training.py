@@ -88,6 +88,13 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
 
 
 
+def _locked_coordinates(args) -> set:
+    """Locked-coordinate names from the CLI flag (whitespace-tolerant) — the
+    ONE parse shared by eligibility and the runners."""
+    raw = getattr(args, "partial_retrain_locked_coordinates", "") or ""
+    return {c.strip() for c in raw.split(",") if c.strip()}
+
+
 def _read_file_slice(
     directories, date_range, days_range, what,
     shard_configs, index_maps, id_tags, rank, nproc, logger,
@@ -443,10 +450,29 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
                 f"shard {cfg.data_config.feature_shard_id!r}: multi-process "
                 "training requires PREBUILT index maps"
             )
+    locked = _locked_coordinates(args)
+    if locked:
+        if not getattr(args, "model_input_directory", None):
+            reasons.append(
+                "locked coordinates require --model-input-directory "
+                "(the locked models must come from somewhere)"
+            )
+        unknown = set(locked) - set(ids)
+        if unknown:
+            reasons.append(
+                f"locked coordinates not in the update sequence: {sorted(unknown)}"
+            )
+        if set(locked) >= set(ids):
+            reasons.append("every coordinate is locked: nothing to train")
     # the flag-level restrictions are identical to the fixed-effect path
+    # (minus partial retrain, which the GAME path handles)
     fe_only = {ids[0]: coord_configs[ids[0]]} if ids else {}
     for r in multiprocess_fe_ineligibilities(args, fe_only, index_maps):
-        if r not in reasons and r != MULTIPROC_DESIGN_POINTER:
+        if (
+            r not in reasons
+            and r != MULTIPROC_DESIGN_POINTER
+            and not r.startswith("partial retrain")
+        ):
             reasons.append(r)
     return reasons
 
@@ -567,6 +593,10 @@ def run_multiprocess_game(
         )
     coord_ids = list(coord_configs)
     fe_cid, re_cids = coord_ids[0], coord_ids[1:]
+    # partial retrain (CoordinateDescent.scala:45 ModelCoordinate semantics):
+    # locked coordinates contribute scores every pass, are never re-optimized,
+    # and carry their loaded models into the saved result
+    locked = _locked_coordinates(args)
     fe_shard = coord_configs[fe_cid].data_config.feature_shard_id
     id_tags = sorted(
         {coord_configs[c].data_config.random_effect_type for c in re_cids}
@@ -780,6 +810,10 @@ def run_multiprocess_game(
                 args.model_input_directory, imaps_by_coord
             )
         fe_init = init_model.get_model(fe_cid)
+        if fe_init is None and fe_cid in locked:
+            raise ValueError(
+                f"locked coordinate {fe_cid!r} is missing from the input model"
+            )
         if fe_init is not None:
             fe_coeffs = jnp.asarray(
                 np.asarray(fe_init.model.coefficients.means), dtype=jnp.float32
@@ -788,14 +822,28 @@ def run_multiprocess_game(
             c = coords[cid]
             warm_re = init_model.get_model(cid)
             if warm_re is None:
+                if cid in locked:
+                    raise ValueError(
+                        f"locked coordinate {cid!r} is missing from the input model"
+                    )
                 continue
             if warm_re.projector is None and c.projector is not None:
                 raise ValueError(
                     f"coordinate {cid!r}: cannot warm-start a random-"
                     "projection coordinate from an original-space model"
                 )
-            re_models[cid] = warm_re.aligned_to(c.ds)
-            own_scores = np.asarray(re_models[cid].score_dataset(c.ds))
+            if cid in locked:
+                # LOCKED: the model passes through VERBATIM (ModelCoordinate
+                # semantics) — entities absent from the retrain data must
+                # survive in the save. score_dataset aligns transiently.
+                re_models[cid] = warm_re
+                own_scores = np.asarray(warm_re.score_dataset(c.ds))
+            else:
+                # plain warm start: each owner keeps only ITS entities' rows
+                # (a full copy per rank would save each entity nproc times
+                # through tracked snapshots)
+                re_models[cid] = warm_re.aligned_to(c.ds)
+                own_scores = np.asarray(re_models[cid].score_dataset(c.ds))
             re_scores_home[cid] = send_scores(
                 f"warm{cid}-sc", c.gids_own, own_scores,
                 c.home_of_own, n_local, gid_base,
@@ -834,6 +882,11 @@ def run_multiprocess_game(
             )
         return _gathered_selection_metric(task, total, val_labels, val_weights)
 
+    # a locked fixed effect never changes: score its contribution once
+    fe_home_locked = (
+        _host_scores(train, fe_shard, fe_coeffs) if fe_cid in locked else None
+    )
+
     per_config = []
     for i, opt_configs in enumerate(sweep):
         # per-update best-snapshot tracking within this configuration — the
@@ -865,25 +918,33 @@ def run_multiprocess_game(
                 )
 
         for p in range(n_iter):
-            # fixed effect: residual = base + sum of RE scores
-            off_home = base_off_home + sum(re_scores_home.values())
-            off_pad = np.zeros(per_process)
-            off_pad[:n_local] = off_home
-            from photon_ml_tpu.parallel.distributed import host_local_to_global
+            if fe_cid not in locked:
+                # fixed effect: residual = base + sum of RE scores
+                off_home = base_off_home + sum(re_scores_home.values())
+                off_pad = np.zeros(per_process)
+                off_pad[:n_local] = off_home
+                from photon_ml_tpu.parallel.distributed import host_local_to_global
 
-            fe_data = dataclasses_replace_offsets(fe_train, host_local_to_global(
-                off_pad.astype(np.float32), mesh,
-                global_rows=fe_train.labels.shape[0],
-            ))
-            with Timed(f"cfg{i} pass{p} fixed-effect solve", logger):
-                fe_coeffs, _ = train_glm_sharded(
-                    fe_data, task, opt_configs[fe_cid], mesh,
-                    initial_coefficients=fe_coeffs,
-                    normalization=norm_ctxs.get(fe_shard),
-                )
-            _track(f"c{i}p{p}fe-")
-            fe_home = _host_scores(train, fe_shard, fe_coeffs)
+                fe_data = dataclasses_replace_offsets(fe_train, host_local_to_global(
+                    off_pad.astype(np.float32), mesh,
+                    global_rows=fe_train.labels.shape[0],
+                ))
+                with Timed(f"cfg{i} pass{p} fixed-effect solve", logger):
+                    fe_coeffs, _ = train_glm_sharded(
+                        fe_data, task, opt_configs[fe_cid], mesh,
+                        initial_coefficients=fe_coeffs,
+                        normalization=norm_ctxs.get(fe_shard),
+                    )
+                _track(f"c{i}p{p}fe-")
+            if fe_home_locked is None:
+                fe_home = _host_scores(train, fe_shard, fe_coeffs)
+            else:
+                fe_home = fe_home_locked
             for cid in re_cids:
+                if cid in locked:
+                    # scored (re_scores_home keeps the warm contribution),
+                    # never re-optimized
+                    continue
                 c = coords[cid]
                 partial = base_off_home + fe_home + sum(
                     s for k, s in re_scores_home.items() if k != cid
@@ -963,9 +1024,10 @@ def run_multiprocess_game(
 
     # ---- assemble + save models (rank 0) --------------------------------------
     # ModelOutputMode (GameTrainingDriver.scala:759-826): BEST writes best/
-    # only, ALL additionally writes models/<i>/ per trained configuration,
-    # NONE writes no model (summary.json still lands). EXPLICIT/TUNED imply
-    # hyperparameter tuning, which multi-process rejects.
+    # only; ALL and EXPLICIT additionally write models/<i>/ per trained
+    # configuration (EXPLICIT == ALL here because multi-process rejects
+    # tuning, so the explicit range is every result); NONE writes no model
+    # (summary.json still lands). Only TUNED is rejected.
     from photon_ml_tpu.cli.parsers import ModelOutputMode
 
     output_mode = ModelOutputMode(args.output_mode)
@@ -987,6 +1049,8 @@ def run_multiprocess_game(
             to_save.append((f"cfg{i}", i, dirs))
     for tag, idx, _ in to_save:
         for cid in re_cids:
+            if cid in locked:
+                continue  # identical verbatim model on every rank: no parts
             m = per_config[idx]["re"][cid]
             np.savez(
                 os.path.join(model_dir, f"{cid}-{tag}-part{rank:05d}.npz"),
@@ -1002,6 +1066,10 @@ def run_multiprocess_game(
         )
         models = {fe_cid: FixedEffectModel(model=glm, feature_shard_id=fe_shard)}
         for cid in re_cids:
+            if cid in locked:
+                # verbatim pass-through of the loaded locked model
+                models[cid] = entry["re"][cid]
+                continue
             parts = []
             for r in range(nproc):
                 with np.load(

@@ -2021,3 +2021,274 @@ def test_multiprocess_fe_output_mode_all_and_none(tmp_path):
     run_mode("NONE", tmp_path / "none")
     assert not (tmp_path / "none" / "best").exists()
     assert (tmp_path / "none" / "summary.json").exists()
+
+
+def test_two_process_game_partial_retrain_locked_coordinate(tmp_path):
+    """Partial retrain in multi-process GAME: the locked fixed effect keeps
+    its loaded coefficients EXACTLY (scored every pass, never re-optimized —
+    ModelCoordinate semantics, CoordinateDescent.scala:45) while the
+    random-effect coordinate retrains; parity with the single-process
+    driver's partial retrain."""
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(101)
+    d, n_users = 3, 6
+    w_true = rng.normal(size=d)
+    u_eff = 1.5 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(130, seed=2),
+    )
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    base = [
+        "--input-data-directories", str(tmp_path / "in"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-descent-iterations", "2",
+    ]
+    run(build_arg_parser().parse_args([
+        *base, "--root-output-directory", str(tmp_path / "full"),
+    ]))
+    model_dir = str(tmp_path / "full" / "best")
+
+    retrain = [
+        "--model-input-directory", model_dir,
+        "--partial-retrain-locked-coordinates", "global",
+        # retrain the random effect under a DIFFERENT reg weight
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,reg.weights=5.0",
+    ]
+    run(build_arg_parser().parse_args([
+        *base, *retrain, "--root-output-directory", str(tmp_path / "re-single"),
+    ]))
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_game_worker.py")
+    logs = [open(tmp_path / f"lock{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path), *retrain],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (
+                f"lock {i} failed:\n" + (tmp_path / f"lock{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    def load(root):
+        return load_game_model(
+            str(root / "best"), {"global": fe_imap, "per-user": re_imap}
+        )
+
+    src = load(tmp_path / "full")
+    ref = load(tmp_path / "re-single")
+    got = load(tmp_path / "out")
+    fe_src = np.asarray(src.get_model("global").model.coefficients.means)
+    fe_got = np.asarray(got.get_model("global").model.coefficients.means)
+    # the locked coordinate is byte-identical to the input model
+    np.testing.assert_array_equal(fe_got, fe_src)
+    np.testing.assert_array_equal(
+        fe_got,
+        np.asarray(ref.get_model("global").model.coefficients.means),
+    )
+    # the retrained coordinate moved (different reg weight) and matches
+    # single-process partial retrain
+    re_src, re_ref, re_got = (
+        m.get_model("per-user") for m in (src, ref, got)
+    )
+    assert set(re_got.entity_ids) == set(re_ref.entity_ids)
+    moved = False
+    for eid in re_ref.entity_ids:
+        a = _entity_coeff_map(re_ref, eid)
+        b = _entity_coeff_map(re_got, eid)
+        assert set(a) == set(b), eid
+        for col in a:
+            assert abs(a[col] - b[col]) < 2e-3, (eid, col, a[col], b[col])
+        s_ = _entity_coeff_map(re_src, eid)
+        moved = moved or any(abs(s_[c] - a[c]) > 1e-3 for c in a)
+    assert moved  # stronger reg actually changed the random effects
+
+
+def test_locked_random_effect_passes_through_verbatim(tmp_path):
+    """A LOCKED random-effect coordinate keeps entities that have NO rows in
+    the retrain data (ModelCoordinate passes the loaded model through
+    verbatim; truncating to the new data's entity set would silently lose
+    coefficients)."""
+    import numpy as np
+
+    from photon_ml_tpu.cli.distributed_training import run_multiprocess_game
+    from photon_ml_tpu.cli.game_training_driver import (
+        _load_index_maps,
+        build_arg_parser,
+        run,
+    )
+    from photon_ml_tpu.cli.parsers import (
+        parse_coordinate_configuration,
+        parse_feature_shard_configuration,
+    )
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import load_game_model
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.util import PhotonLogger
+
+    rng = np.random.default_rng(107)
+    d, n_users = 3, 6
+    w_true = rng.normal(size=d)
+    u_eff = 1.5 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed, users):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(users[int(r.integers(0, len(users)))])
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in-full").mkdir()
+    (tmp_path / "in-sub").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in-full" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(200, 1, list(range(n_users))),
+    )
+    # retrain data covers only HALF the users
+    avro_io.write_container(
+        str(tmp_path / "in-sub" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(120, 2, [0, 1, 2]),
+    )
+
+    base = [
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=60,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=40,tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-descent-iterations", "1",
+    ]
+    run(build_arg_parser().parse_args([
+        *base,
+        "--input-data-directories", str(tmp_path / "in-full"),
+        "--root-output-directory", str(tmp_path / "src"),
+    ]))
+    src = load_game_model(
+        str(tmp_path / "src" / "best"), {"global": fe_imap, "per-user": re_imap}
+    )
+    assert len(src.get_model("per-user").entity_ids) == n_users
+
+    args = build_arg_parser().parse_args([
+        *base,
+        "--input-data-directories", str(tmp_path / "in-sub"),
+        "--root-output-directory", str(tmp_path / "out"),
+        "--model-input-directory", str(tmp_path / "src" / "best"),
+        "--partial-retrain-locked-coordinates", "per-user",
+    ])
+    shard_configs = dict(
+        parse_feature_shard_configuration(a)
+        for a in args.feature_shard_configurations
+    )
+    coord_configs = dict(
+        parse_coordinate_configuration(a) for a in args.coordinate_configurations
+    )
+    os.makedirs(tmp_path / "out", exist_ok=True)
+    run_multiprocess_game(
+        args, 0, 1, PhotonLogger(str(tmp_path / "out" / "log.txt")),
+        str(tmp_path / "out"),
+        TaskType("LOGISTIC_REGRESSION"), coord_configs, shard_configs,
+        _load_index_maps(args.off_heap_index_map_directory, shard_configs),
+    )
+    got = load_game_model(
+        str(tmp_path / "out" / "best"), {"global": fe_imap, "per-user": re_imap}
+    )
+    re_src, re_got = src.get_model("per-user"), got.get_model("per-user")
+    # ALL six entities survive — including u3/u4/u5 with zero retrain rows —
+    # with coefficients exactly equal to the input model's
+    assert set(re_got.entity_ids) == set(re_src.entity_ids)
+    for eid in re_src.entity_ids:
+        np.testing.assert_array_equal(
+            re_got.coefficients_for_entity(eid),
+            re_src.coefficients_for_entity(eid),
+            err_msg=str(eid),
+        )
