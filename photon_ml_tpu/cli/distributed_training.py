@@ -70,8 +70,6 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
         reasons.append("--coefficient-box-constraints")
     if getattr(args, "output_mode", "BEST") == "TUNED":
         reasons.append("--output-mode TUNED (implies hyperparameter tuning)")
-    if getattr(args, "variance_computation_type", "NONE") != "NONE":
-        reasons.append("coefficient variances")
     if getattr(args, "data_summary_directory", None):
         reasons.append("--data-summary-directory")
     evaluators = getattr(args, "evaluators", None)
@@ -86,6 +84,70 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
             )
     return reasons
 
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _fe_variance_solver(task, vtype, mesh):
+    """Jitted variance pass with REPLICATED output shardings (like
+    sharded_glm_solver: propagation could otherwise leave the [D] result
+    sharded across processes, making the host fetch fail on every rank).
+    l2 and the normalization vectors are traced arguments, so a reg-weight
+    sweep reuses one executable."""
+    import jax
+
+    from photon_ml_tpu.function.losses import loss_for_task
+    from photon_ml_tpu.function.objective import GLMObjective
+    from photon_ml_tpu.optimization.solver_cache import compute_variances
+    from photon_ml_tpu.parallel.mesh import replicated_sharding
+
+    loss = loss_for_task(TaskType(task))
+
+    def solve(data, w_t, l2, norm):
+        obj = GLMObjective(loss, norm, allow_fused=False)
+        return compute_variances(obj, data, w_t, l2, vtype, w_t.dtype)
+
+    return jax.jit(solve, out_shardings=replicated_sharding(mesh))
+
+
+def _sharded_fe_variances(args, train_data, coeffs, opt_cfg, task, norm_ctx):
+    """Coefficient variances for one fixed-effect result over the SHARDED
+    data (DistributedOptimizationProblem.computeVariances:84-108): one jitted
+    Hessian pass whose data reductions psum across the mesh. With
+    normalization the Hessian is taken at the transformed-space optimum and
+    the diagonal scales by factor^2 (the delta method, as in
+    GLMOptimizationProblem.run). Returns None when variances are off."""
+    from photon_ml_tpu.types import VarianceComputationType
+
+    vtype = VarianceComputationType(
+        getattr(args, "variance_computation_type", "NONE")
+    )
+    if vtype == VarianceComputationType.NONE:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.normalization import NO_NORMALIZATION
+    from photon_ml_tpu.parallel import make_mesh
+
+    norm = NO_NORMALIZATION if norm_ctx is None else norm_ctx
+    w = jnp.asarray(coeffs)
+    if not norm.is_identity:
+        w = norm.to_transformed_space_device(w)
+
+    solve = _fe_variance_solver(
+        TaskType(task), vtype, make_mesh(len(jax.devices()))
+    )
+    variances = solve(
+        train_data, w, jnp.asarray(opt_cfg.l2_weight, dtype=w.dtype), norm
+    )
+    if not norm.is_identity and norm.factors is not None:
+        variances = variances * jnp.asarray(
+            np.asarray(norm.factors), dtype=variances.dtype
+        ) ** 2
+    return np.asarray(variances)
 
 
 def _locked_coordinates(args) -> set:
@@ -237,7 +299,10 @@ def run_multiprocess_fixed_effect(
                 "lambda=%s validation %s=%.6f",
                 opt_cfg.regularization_weight, metric_name, metric_value,
             )
-        results.append((opt_cfg, np.asarray(coeffs), metric_value))
+        variances = _sharded_fe_variances(
+            args, train_data, coeffs, opt_cfg, task, norm_ctx
+        )
+        results.append((opt_cfg, np.asarray(coeffs), metric_value, variances))
 
     if val is not None:
         values = [r[2] for r in results]
@@ -258,7 +323,7 @@ def run_multiprocess_fixed_effect(
                 "metric": metric_name,
                 "value": a,
             }
-            for c, _, a in results
+            for c, _, a, _v in results
         ],
         "best_index": best_i,
         "output_directory": root,
@@ -268,9 +333,13 @@ def run_multiprocess_fixed_effect(
         from photon_ml_tpu.cli.parsers import ModelOutputMode
 
         def fe_result(entry):
-            r_cfg, r_coeffs, r_value = entry
+            r_cfg, r_coeffs, r_value, r_vars = entry
             glm = GeneralizedLinearModel(
-                Coefficients(jnp.asarray(r_coeffs)), TaskType(task)
+                Coefficients(
+                    jnp.asarray(r_coeffs),
+                    None if r_vars is None else jnp.asarray(r_vars),
+                ),
+                TaskType(task),
             )
             model = GameModel(
                 models={cid: FixedEffectModel(model=glm, feature_shard_id=shard)}
@@ -450,6 +519,11 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
                 f"shard {cfg.data_config.feature_shard_id!r}: multi-process "
                 "training requires PREBUILT index maps"
             )
+    if getattr(args, "variance_computation_type", "NONE") != "NONE":
+        reasons.append(
+            "coefficient variances for GAME configurations (the fixed-effect "
+            "path computes them; per-entity variance exchange is not wired)"
+        )
     locked = _locked_coordinates(args)
     if locked:
         if not getattr(args, "model_input_directory", None):

@@ -276,13 +276,18 @@ def test_two_process_training_matches_single_process(tmp_path):
         avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=5),
     )
 
-    def best_coeffs(root):
+    def best_coefficients(root):
         from photon_ml_tpu.io.model_io import load_game_model
 
         gm = load_game_model(str(root / "best"), {"global": imap})
-        return np.asarray(gm.get_model("global").model.coefficients.means)
+        return gm.get_model("global").model.coefficients
 
-    # single-process reference through the standard driver flow
+    def best_coeffs(root):
+        return np.asarray(best_coefficients(root).means)
+
+    # single-process reference through the standard driver flow — WITH
+    # variances, so the psum'd multi-process Hessian pass is exercised and
+    # compared in a REAL 2-process run
     from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
 
     single = build_arg_parser().parse_args([
@@ -297,6 +302,7 @@ def test_two_process_training_matches_single_process(tmp_path):
         "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
         "tolerance=1e-9,regularization=L2,reg.weights=0.1|10",
         "--evaluators", "AUC",
+        "--variance-computation-type", "SIMPLE",
     ])
     run(single)
     expected = best_coeffs(tmp_path / "out-single")
@@ -313,7 +319,8 @@ def test_two_process_training_matches_single_process(tmp_path):
     logs = [open(tmp_path / f"trainer{i}.log", "w+") for i in range(2)]
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+             "--variance-computation-type", "SIMPLE"],
             env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
         )
         for i in range(2)
@@ -333,6 +340,10 @@ def test_two_process_training_matches_single_process(tmp_path):
 
     got = best_coeffs(tmp_path / "out")
     np.testing.assert_allclose(got, expected, atol=1e-4)
+    v_ref = np.asarray(best_coefficients(tmp_path / "out-single").variances)
+    v_got = np.asarray(best_coefficients(tmp_path / "out").variances)
+    assert (v_got > 0).all()
+    np.testing.assert_allclose(v_got, v_ref, rtol=5e-3)
     import json
 
     summary = json.loads((tmp_path / "out" / "summary.json").read_text())
@@ -431,7 +442,8 @@ def test_two_process_training_wide_sparse_shard(tmp_path):
     logs = [open(tmp_path / f"trainer{i}.log", "w+") for i in range(2)]
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+             "--variance-computation-type", "SIMPLE"],
             env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
         )
         for i in range(2)
@@ -717,6 +729,10 @@ def test_two_process_two_device_training(tmp_path):
 
     got = best_coeffs(tmp_path / "out")
     np.testing.assert_allclose(got, expected, atol=1e-4)
+    v_ref = np.asarray(best_coefficients(tmp_path / "out-single").variances)
+    v_got = np.asarray(best_coefficients(tmp_path / "out").variances)
+    assert (v_got > 0).all()
+    np.testing.assert_allclose(v_got, v_ref, rtol=5e-3)
 
 
 def test_two_process_game_training_single_entity(tmp_path):
@@ -2292,3 +2308,104 @@ def test_locked_random_effect_passes_through_verbatim(tmp_path):
             re_src.coefficients_for_entity(eid),
             err_msg=str(eid),
         )
+
+
+def test_multiprocess_fe_variances_match_single_process(tmp_path):
+    """SIMPLE and FULL coefficient variances through the multi-process
+    fixed-effect path (psum'd Hessian pass over the sharded data) must match
+    the single-process driver's saved variances, including the delta-method
+    scaling under STANDARDIZATION."""
+    import numpy as np
+
+    from photon_ml_tpu.cli.distributed_training import run_multiprocess_fixed_effect
+    from photon_ml_tpu.cli.game_training_driver import (
+        _load_index_maps,
+        build_arg_parser,
+        run,
+    )
+    from photon_ml_tpu.cli.parsers import (
+        parse_coordinate_configuration,
+        parse_feature_shard_configuration,
+    )
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import load_game_model
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.util import PhotonLogger
+
+    rng = np.random.default_rng(113)
+    d = 4
+    w_true = rng.normal(size=d)
+    imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d) * np.array([1.0, 20.0, 0.2, 5.0])
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": float((x @ (w_true / np.array([1.0, 20.0, 0.2, 5.0]))
+                                + 0.3 * r.normal()) > 0),
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(250, seed=1),
+    )
+
+    for vtype in ("SIMPLE", "FULL"):
+        base = [
+            "--input-data-directories", str(tmp_path / "in"),
+            "--feature-shard-configurations", "name=global,feature.bags=features",
+            "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--coordinate-update-sequence", "global",
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
+            "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+            "--normalization", "STANDARDIZATION",
+            "--variance-computation-type", vtype,
+        ]
+        run(build_arg_parser().parse_args([
+            *base, "--root-output-directory", str(tmp_path / f"single-{vtype}"),
+        ]))
+        ref = load_game_model(
+            str(tmp_path / f"single-{vtype}" / "best"), {"global": imap}
+        ).get_model("global").model.coefficients
+
+        args = build_arg_parser().parse_args([
+            *base, "--root-output-directory", str(tmp_path / f"mp-{vtype}"),
+        ])
+        shard_configs = dict(
+            parse_feature_shard_configuration(a)
+            for a in args.feature_shard_configurations
+        )
+        coord_configs = dict(
+            parse_coordinate_configuration(a) for a in args.coordinate_configurations
+        )
+        os.makedirs(tmp_path / f"mp-{vtype}", exist_ok=True)
+        run_multiprocess_fixed_effect(
+            args, 0, 1,
+            PhotonLogger(str(tmp_path / f"mp-{vtype}" / "log.txt")),
+            str(tmp_path / f"mp-{vtype}"),
+            TaskType("LOGISTIC_REGRESSION"), coord_configs, shard_configs,
+            _load_index_maps(args.off_heap_index_map_directory, shard_configs),
+        )
+        got = load_game_model(
+            str(tmp_path / f"mp-{vtype}" / "best"), {"global": imap}
+        ).get_model("global").model.coefficients
+        assert got.variances is not None and ref.variances is not None
+        v_ref = np.asarray(ref.variances)
+        v_got = np.asarray(got.variances)
+        assert (v_got > 0).all()
+        np.testing.assert_allclose(v_got, v_ref, rtol=5e-3, err_msg=vtype)
