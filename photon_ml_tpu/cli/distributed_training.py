@@ -112,7 +112,7 @@ def _fe_variance_solver(task, vtype, mesh):
     return jax.jit(solve, out_shardings=replicated_sharding(mesh))
 
 
-def _sharded_fe_variances(args, train_data, coeffs, opt_cfg, task, norm_ctx):
+def _sharded_fe_variances(args, train_data, coeffs, opt_cfg, task, norm_ctx, mesh):
     """Coefficient variances for one fixed-effect result over the SHARDED
     data (DistributedOptimizationProblem.computeVariances:84-108): one jitted
     Hessian pass whose data reductions psum across the mesh. With
@@ -126,20 +126,16 @@ def _sharded_fe_variances(args, train_data, coeffs, opt_cfg, task, norm_ctx):
     )
     if vtype == VarianceComputationType.NONE:
         return None
-    import jax
     import jax.numpy as jnp
 
     from photon_ml_tpu.normalization import NO_NORMALIZATION
-    from photon_ml_tpu.parallel import make_mesh
 
     norm = NO_NORMALIZATION if norm_ctx is None else norm_ctx
     w = jnp.asarray(coeffs)
     if not norm.is_identity:
         w = norm.to_transformed_space_device(w)
 
-    solve = _fe_variance_solver(
-        TaskType(task), vtype, make_mesh(len(jax.devices()))
-    )
+    solve = _fe_variance_solver(TaskType(task), vtype, mesh)
     variances = solve(
         train_data, w, jnp.asarray(opt_cfg.l2_weight, dtype=w.dtype), norm
     )
@@ -300,7 +296,7 @@ def run_multiprocess_fixed_effect(
                 opt_cfg.regularization_weight, metric_name, metric_value,
             )
         variances = _sharded_fe_variances(
-            args, train_data, coeffs, opt_cfg, task, norm_ctx
+            args, train_data, coeffs, opt_cfg, task, norm_ctx, mesh
         )
         results.append((opt_cfg, np.asarray(coeffs), metric_value, variances))
 
@@ -519,11 +515,6 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
                 f"shard {cfg.data_config.feature_shard_id!r}: multi-process "
                 "training requires PREBUILT index maps"
             )
-    if getattr(args, "variance_computation_type", "NONE") != "NONE":
-        reasons.append(
-            "coefficient variances for GAME configurations (the fixed-effect "
-            "path computes them; per-entity variance exchange is not wired)"
-        )
     locked = _locked_coordinates(args)
     if locked:
         if not getattr(args, "model_input_directory", None):
@@ -665,6 +656,11 @@ def run_multiprocess_game(
             "configuration not eligible for multi-process GAME training: "
             + "; ".join(sorted(set(reasons)))
         )
+    from photon_ml_tpu.types import VarianceComputationType
+
+    vtype = VarianceComputationType(
+        getattr(args, "variance_computation_type", "NONE")
+    )
     coord_ids = list(coord_configs)
     fe_cid, re_cids = coord_ids[0], coord_ids[1:]
     # partial retrain (CoordinateDescent.scala:45 ModelCoordinate semantics):
@@ -862,6 +858,8 @@ def run_multiprocess_game(
     sweep = expand_game_configurations(coord_configs)
     n_iter = args.coordinate_descent_iterations
     fe_coeffs = None
+    fe_vars = None
+    last_fe_data = None
     re_models = {cid: None for cid in re_cids}
     re_scores_home = {cid: np.zeros(n_local) for cid in re_cids}
 
@@ -892,6 +890,8 @@ def run_multiprocess_game(
             fe_coeffs = jnp.asarray(
                 np.asarray(fe_init.model.coefficients.means), dtype=jnp.float32
             )
+            if fe_init.model.coefficients.variances is not None:
+                fe_vars = np.asarray(fe_init.model.coefficients.variances)
         for cid in re_cids:
             c = coords[cid]
             warm_re = init_model.get_model(cid)
@@ -967,7 +967,10 @@ def run_multiprocess_game(
         # single-process CoordinateDescent's selection semantics
         # (CoordinateDescent.scala:256-289): every coordinate update is a
         # selection candidate, not just the configuration's final state
-        track = {"value": None, "metric": None, "fe": None, "re": None}
+        track = {
+            "value": None, "metric": None, "fe": None, "fe_vars": None,
+            "re": None,
+        }
 
         def _track(tagbase):
             if not has_val:
@@ -988,6 +991,7 @@ def run_multiprocess_game(
                     value=value,
                     metric=name,
                     fe=np.asarray(fe_coeffs).copy(),
+                    fe_vars=None if fe_vars is None else np.asarray(fe_vars).copy(),
                     re={c_: re_models[c_] for c_ in re_cids},
                 )
 
@@ -1009,7 +1013,17 @@ def run_multiprocess_game(
                         initial_coefficients=fe_coeffs,
                         normalization=norm_ctxs.get(fe_shard),
                     )
+                if has_val:
+                    # per-update variances ride the update, as in the single-
+                    # process coordinate (the saved snapshot keeps its own);
+                    # without validation only the config-final model is saved,
+                    # so per-update Hessian passes would be thrown away
+                    fe_vars = _sharded_fe_variances(
+                        args, fe_data, fe_coeffs, opt_configs[fe_cid], task,
+                        norm_ctxs.get(fe_shard), mesh,
+                    )
                 _track(f"c{i}p{p}fe-")
+                last_fe_data = fe_data
             if fe_home_locked is None:
                 fe_home = _host_scores(train, fe_shard, fe_coeffs)
             else:
@@ -1028,6 +1042,7 @@ def run_multiprocess_game(
                     model, _tracker = train_random_effect(
                         c.ds, task, opt_configs[cid], jnp.asarray(off_own, jnp.float32),
                         initial_model=re_models[cid], dtype=jnp.float32,
+                        variance_computation=vtype,
                         # normalization folds per bucket; models stay in
                         # original space (the projector carries it instead
                         # for projected coordinates)
@@ -1051,15 +1066,23 @@ def run_multiprocess_game(
             per_config.append({
                 "configs": opt_configs,
                 "fe": track["fe"],
+                "fe_vars": track["fe_vars"],
                 "re": track["re"],
                 "metric": track["metric"],
                 "value": track["value"],
                 "auc": track["value"] if track["metric"] == "AUC" else None,
             })
         else:
+            if fe_cid not in locked and last_fe_data is not None:
+                # config-final variances (the only saved model on this branch)
+                fe_vars = _sharded_fe_variances(
+                    args, last_fe_data, fe_coeffs, opt_configs[fe_cid], task,
+                    norm_ctxs.get(fe_shard), mesh,
+                )
             per_config.append({
                 "configs": opt_configs,
                 "fe": np.asarray(fe_coeffs),
+                "fe_vars": None if fe_vars is None else np.asarray(fe_vars),
                 "re": {cid: re_models[cid] for cid in re_cids},
                 "metric": None,
                 "value": None,
@@ -1131,12 +1154,20 @@ def run_multiprocess_game(
                 entity_ids=np.asarray(m.entity_ids, dtype=str),
                 coeffs=np.asarray(m.coeffs),
                 proj=np.asarray(m.proj_indices),
+                variances=np.asarray(m.variances)
+                if m.variances is not None
+                else np.zeros((0, 0)),
             )
     shuffle_barrier("model-parts")
 
     def _assemble_result(tag, entry) -> "GameResult":
         glm = GeneralizedLinearModel(
-            Coefficients(jnp.asarray(entry["fe"])), TaskType(task)
+            Coefficients(
+                jnp.asarray(entry["fe"]),
+                None if entry.get("fe_vars") is None
+                else jnp.asarray(entry["fe_vars"]),
+            ),
+            TaskType(task),
         )
         models = {fe_cid: FixedEffectModel(model=glm, feature_shard_id=fe_shard)}
         for cid in re_cids:
@@ -1151,18 +1182,23 @@ def run_multiprocess_game(
                 ) as z:
                     parts.append({k: z[k] for k in z.files})
             k_max = max(int(p["coeffs"].shape[1]) if p["coeffs"].size else 1 for p in parts)
-            ids_all, coeff_rows, proj_rows = [], [], []
+            has_vars = any(p["variances"].size for p in parts)
+            ids_all, coeff_rows, proj_rows, var_rows = [], [], [], []
             for part in parts:
                 e = len(part["entity_ids"])
                 ids_all.extend(str(x) for x in part["entity_ids"])
                 cpad = np.zeros((e, k_max), dtype=np.float32)
                 ppad = np.full((e, k_max), -1, dtype=np.int32)
+                vpad = np.zeros((e, k_max), dtype=np.float32)
                 if e:
                     k = part["coeffs"].shape[1]
                     cpad[:, :k] = part["coeffs"]
                     ppad[:, :k] = part["proj"]
+                    if part["variances"].size:
+                        vpad[:, :k] = part["variances"]
                 coeff_rows.append(cpad)
                 proj_rows.append(ppad)
+                var_rows.append(vpad)
             dc = coord_configs[cid].data_config
             models[cid] = RandomEffectModel(
                 re_type=dc.random_effect_type,
@@ -1173,6 +1209,9 @@ def run_multiprocess_game(
                 proj_indices=jnp.asarray(
                     np.concatenate(proj_rows) if ids_all else np.full((0, 1), -1, np.int32)
                 ),
+                variances=jnp.asarray(np.concatenate(var_rows))
+                if has_vars and ids_all
+                else None,
                 # the ONE projector instance training used (built at ingest)
                 projector=coords[cid].projector,
             )

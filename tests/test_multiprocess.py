@@ -689,11 +689,15 @@ def test_two_process_two_device_training(tmp_path):
         "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
         "tolerance=1e-9,regularization=L2,reg.weights=0.1|10",
         "--evaluators", "AUC",
+        "--variance-computation-type", "SIMPLE",
     ]))
 
-    def best_coeffs(root):
+    def best_coefficients(root):
         gm = load_game_model(str(root / "best"), {"global": imap})
-        return np.asarray(gm.get_model("global").model.coefficients.means)
+        return gm.get_model("global").model.coefficients
+
+    def best_coeffs(root):
+        return np.asarray(best_coefficients(root).means)
 
     expected = best_coeffs(tmp_path / "out-single")
 
@@ -709,7 +713,8 @@ def test_two_process_two_device_training(tmp_path):
     logs = [open(tmp_path / f"pod{i}.log", "w+") for i in range(2)]
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(i), "2", str(port), str(tmp_path)],
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+             "--variance-computation-type", "SIMPLE"],
             env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
         )
         for i in range(2)
@@ -2409,3 +2414,131 @@ def test_multiprocess_fe_variances_match_single_process(tmp_path):
         v_got = np.asarray(got.variances)
         assert (v_got > 0).all()
         np.testing.assert_allclose(v_got, v_ref, rtol=5e-3, err_msg=vtype)
+
+
+def test_two_process_game_variances_match_single_process(tmp_path):
+    """Per-entity (GAME) coefficient variances through the multi-process
+    path: owners compute them inside their bucket solves, parts carry them,
+    and both the fixed-effect and per-entity variances in the saved model
+    match the single-process driver."""
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(131)
+    d, n_users = 3, 7
+    w_true = rng.normal(size=d)
+    u_eff = 1.4 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(160, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(140, seed=2),
+    )
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    common = [
+        "--input-data-directories", str(tmp_path / "in"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-descent-iterations", "2",
+        "--variance-computation-type", "SIMPLE",
+    ]
+    run(build_arg_parser().parse_args([
+        *common, "--root-output-directory", str(tmp_path / "out-single"),
+    ]))
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_game_worker.py")
+    logs = [open(tmp_path / f"gvar{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+             "--variance-computation-type", "SIMPLE"],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (
+                f"gvar {i} failed:\n" + (tmp_path / f"gvar{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    def load(root):
+        return load_game_model(
+            str(root / "best"), {"global": fe_imap, "per-user": re_imap}
+        )
+
+    ref, got = load(tmp_path / "out-single"), load(tmp_path / "out")
+    c_ref = ref.get_model("global").model.coefficients
+    c_got = got.get_model("global").model.coefficients
+    assert c_got.variances is not None and c_ref.variances is not None
+    np.testing.assert_allclose(
+        np.asarray(c_got.variances), np.asarray(c_ref.variances), rtol=5e-3
+    )
+    re_ref, re_got = ref.get_model("per-user"), got.get_model("per-user")
+    assert re_got.variances is not None and re_ref.variances is not None
+    checked = 0
+    for eid in re_ref.entity_ids:
+        r_row = re_ref.row_for_entity(eid)
+        g_row = re_got.row_for_entity(eid)
+        v_ref = np.asarray(re_ref.variances)[r_row]
+        v_got = np.asarray(re_got.variances)[g_row]
+        assert (v_got[v_ref > 0] > 0).all()
+        np.testing.assert_allclose(v_got, v_ref, rtol=1e-2, err_msg=str(eid))
+        checked += 1
+    assert checked == n_users
