@@ -72,16 +72,11 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
         reasons.append("--output-mode TUNED (implies hyperparameter tuning)")
     if getattr(args, "data_summary_directory", None):
         reasons.append("--data-summary-directory")
-    evaluators = getattr(args, "evaluators", None)
-    if evaluators:
-        from photon_ml_tpu.estimators.game_estimator import default_evaluator_type
-
-        default_name = default_evaluator_type(TaskType(args.training_task)).value
-        if evaluators.strip().upper() != default_name:
-            reasons.append(
-                "custom evaluators (multi-process selection computes the "
-                f"task's default evaluator only: {default_name})"
-            )
+    if getattr(args, "evaluators", None):
+        try:
+            _resolve_validation_evaluators(args, args.training_task)
+        except Exception as e:  # unknown spec, bad @K, ...
+            reasons.append(f"unparseable --evaluators: {e}")
     return reasons
 
 
@@ -206,11 +201,22 @@ def run_multiprocess_fixed_effect(
         )
     (cid, cfg), = coord_configs.items()
     shard = cfg.data_config.feature_shard_id
+    evaluators = _resolve_validation_evaluators(args, args.training_task)
+    from photon_ml_tpu.evaluation.evaluators import MultiEvaluator
+
+    eval_tags = tuple(
+        dict.fromkeys(
+            ev.id_tag for ev in evaluators if isinstance(ev, MultiEvaluator)
+        )
+    )
 
     def read_slice(directories, date_range, days_range, what):
         return _read_file_slice(
             directories, date_range, days_range, what,
-            shard_configs, index_maps, (), rank, nproc, logger,
+            shard_configs, index_maps,
+            # per-group evaluator tags are consumed from VALIDATION rows only
+            eval_tags if what == "validation" else (),
+            rank, nproc, logger,
         )
 
     with Timed("read training data", logger):
@@ -282,23 +288,30 @@ def run_multiprocess_fixed_effect(
         warm = coeffs
         metric_value = None
         metric_name = larger = None
+        evals = None
         if val is not None:
             scores = _host_scores(val, shard, coeffs) + np.asarray(
                 val.offsets, dtype=np.float64
             )
-            metric_name, metric_value, larger = _gathered_selection_metric(
-                task, scores,
+            evals = _gathered_evaluations(
+                evaluators, scores,
                 np.asarray(val.labels, dtype=np.float64),
                 np.asarray(val.weights, dtype=np.float64),
+                val.ids,
             )
+            primary = evaluators[0]
+            metric_name = primary.name
+            metric_value = evals[metric_name]
+            larger = primary.larger_is_better
             logger.info(
-                "lambda=%s validation %s=%.6f",
-                opt_cfg.regularization_weight, metric_name, metric_value,
+                "lambda=%s validation %s",
+                opt_cfg.regularization_weight,
+                " ".join(f"{k}={v:.6f}" for k, v in evals.items()),
             )
         variances = _sharded_fe_variances(
             args, train_data, coeffs, opt_cfg, task, norm_ctx, mesh
         )
-        results.append((opt_cfg, np.asarray(coeffs), metric_value, variances))
+        results.append((opt_cfg, np.asarray(coeffs), metric_value, variances, evals))
 
     if val is not None:
         values = [r[2] for r in results]
@@ -318,8 +331,9 @@ def run_multiprocess_fixed_effect(
                 "auc": a if metric_name in (None, "AUC") else None,
                 "metric": metric_name,
                 "value": a,
+                "evaluations": _e,
             }
-            for c, _, a, _v in results
+            for c, _, a, _v, _e in results
         ],
         "best_index": best_i,
         "output_directory": root,
@@ -329,7 +343,7 @@ def run_multiprocess_fixed_effect(
         from photon_ml_tpu.cli.parsers import ModelOutputMode
 
         def fe_result(entry):
-            r_cfg, r_coeffs, r_value, r_vars = entry
+            r_cfg, r_coeffs, r_value, r_vars, r_evals = entry
             glm = GeneralizedLinearModel(
                 Coefficients(
                     jnp.asarray(r_coeffs),
@@ -344,7 +358,7 @@ def run_multiprocess_fixed_effect(
                 model=model,
                 best_model=model,
                 configuration={cid: r_cfg},
-                evaluations={metric_name: r_value} if r_value is not None else None,
+                evaluations=r_evals if r_evals else None,
                 best_metric=r_value,
                 descent=None,
             )
@@ -668,8 +682,12 @@ def run_multiprocess_game(
     # and carry their loaded models into the saved result
     locked = _locked_coordinates(args)
     fe_shard = coord_configs[fe_cid].data_config.feature_shard_id
+    evaluators = _resolve_validation_evaluators(args, args.training_task)
+    from photon_ml_tpu.evaluation.evaluators import MultiEvaluator
+
     id_tags = sorted(
         {coord_configs[c].data_config.random_effect_type for c in re_cids}
+        | {ev.id_tag for ev in evaluators if isinstance(ev, MultiEvaluator)}
     )
     spill = os.path.join(root, "_shuffle")
 
@@ -926,11 +944,11 @@ def run_multiprocess_game(
     _origin_cache: dict = {}
 
     def _validation_metric_now(tagbase):
-        """Full-model validation selection metric (the task's own —
-        _gathered_selection_metric, direction-aware) with the CURRENT
-        coefficients: fixed effect scored locally on each process's
-        validation block, random effects scored on their entity owners and
-        sent home (unseen entities score 0 — the reference's behavior)."""
+        """Full-model validation evaluations (the run's evaluator list,
+        FIRST = primary, direction-aware) with the CURRENT coefficients:
+        fixed effect scored locally on each process's validation block,
+        random effects scored on their entity owners and sent home (unseen
+        entities score 0 — the reference's behavior)."""
         fe_val_home = _host_scores(val, fe_shard, fe_coeffs)
         total = val_base_off + fe_val_home
         for vcid in re_cids:
@@ -954,7 +972,11 @@ def run_multiprocess_game(
                 f"{tagbase}{vcid}-vs", vc.gids_own, own_scores,
                 vc.home_of_own, n_val_local, vgid_base,
             )
-        return _gathered_selection_metric(task, total, val_labels, val_weights)
+        evals = _gathered_evaluations(
+            evaluators, total, val_labels, val_weights, val.ids
+        )
+        primary = evaluators[0]
+        return primary.name, evals[primary.name], primary.larger_is_better, evals
 
     # a locked fixed effect never changes: score its contribution once
     fe_home_locked = (
@@ -968,8 +990,8 @@ def run_multiprocess_game(
         # (CoordinateDescent.scala:256-289): every coordinate update is a
         # selection candidate, not just the configuration's final state
         track = {
-            "value": None, "metric": None, "fe": None, "fe_vars": None,
-            "re": None,
+            "value": None, "metric": None, "evaluations": None, "fe": None,
+            "fe_vars": None, "re": None,
         }
 
         def _track(tagbase):
@@ -980,7 +1002,7 @@ def run_multiprocess_game(
                 # a saveable GAME model; candidates start at the first update
                 # that completes the coordinate set
                 return
-            name, value, larger = _validation_metric_now(tagbase)
+            name, value, larger, evals = _validation_metric_now(tagbase)
             logger.debug("update %s validation %s=%.6f", tagbase, name, value)
             better = (
                 track["value"] is None
@@ -990,6 +1012,7 @@ def run_multiprocess_game(
                 track.update(
                     value=value,
                     metric=name,
+                    evaluations=evals,
                     fe=np.asarray(fe_coeffs).copy(),
                     fe_vars=None if fe_vars is None else np.asarray(fe_vars).copy(),
                     re={c_: re_models[c_] for c_ in re_cids},
@@ -1070,6 +1093,7 @@ def run_multiprocess_game(
                 "re": track["re"],
                 "metric": track["metric"],
                 "value": track["value"],
+                "evaluations": track["evaluations"],
                 "auc": track["value"] if track["metric"] == "AUC" else None,
             })
         else:
@@ -1086,17 +1110,13 @@ def run_multiprocess_game(
                 "re": {cid: re_models[cid] for cid in re_cids},
                 "metric": None,
                 "value": None,
+                "evaluations": None,
                 "auc": None,
             })
 
     if has_val:
-        from photon_ml_tpu.estimators.game_estimator import default_evaluator_type
-        from photon_ml_tpu.evaluation.evaluators import evaluator_for_type
-
         values = [r["value"] for r in per_config]
-        larger = evaluator_for_type(
-            default_evaluator_type(TaskType(task))
-        ).larger_is_better
+        larger = evaluators[0].larger_is_better
         best_i = int(np.argmax(values) if larger else np.argmin(values))
     else:
         best_i = len(per_config) - 1  # no validation: last (weakest-reg) config
@@ -1111,6 +1131,7 @@ def run_multiprocess_game(
                 "auc": r["auc"],
                 "metric": r["metric"],
                 "value": r["value"],
+                "evaluations": r["evaluations"],
             }
             for r in per_config
         ],
@@ -1219,8 +1240,8 @@ def run_multiprocess_game(
         return GameResult(
             model=game_model, best_model=game_model,
             configuration=entry["configs"],
-            evaluations={entry["metric"]: entry["value"]}
-            if entry["value"] is not None else None,
+            evaluations=entry.get("evaluations")
+            or ({entry["metric"]: entry["value"]} if entry["value"] is not None else None),
             best_metric=entry["value"], descent=None,
         )
 
@@ -1367,38 +1388,86 @@ def _host_scores(game_input, shard: str, coeffs) -> np.ndarray:
     return np.asarray(X @ w).ravel()
 
 
-def _gather_blocks(scores, labels, weights):
+def _gather_blocks(*arrays):
     """Host-allgather variable-length per-process blocks, padded with
-    weight-0 rows (inert in every weighted statistic)."""
+    weight-0 rows (inert in every weighted statistic). Dtypes are
+    preserved (group-key arrays ride along with the float triples)."""
     from jax.experimental import multihost_utils
 
-    n = np.asarray([len(scores)])
+    n = np.asarray([len(arrays[0])])
     counts = np.asarray(multihost_utils.process_allgather(n)).ravel()
     m = int(counts.max()) if len(counts) else 0
 
     def pad(v):
-        out = np.zeros(m)
+        v = np.asarray(v)
+        out = np.zeros(m, dtype=v.dtype if v.dtype.kind in "if" else np.float64)
         out[: len(v)] = v
         return out
 
+    # the gather pads each process block to the max length; DROP the padding
+    # rows afterwards (their positions are known exactly from the counts) —
+    # sentinel values would corrupt ranking metrics (a padding score in a
+    # PRECISION@K top-K) or weighted ones (0 * inf = NaN in RMSE)
+    keep = np.concatenate([
+        np.arange(m, dtype=np.int64) < c for c in counts
+    ]) if m else np.zeros(0, dtype=bool)
     return tuple(
-        np.asarray(x).reshape(-1)
-        for x in multihost_utils.process_allgather(
-            (pad(scores), pad(labels), pad(weights))
-        )
+        np.asarray(x).reshape(-1)[keep]
+        for x in multihost_utils.process_allgather(tuple(pad(v) for v in arrays))
     )
 
 
-def _gathered_selection_metric(task, scores, labels, weights):
-    """(metric name, value, larger_is_better) for the TASK's default
-    evaluator over the gathered validation set — the same Evaluator object
-    the single-process path ranks by (GameEstimator defaultEvaluator +
-    EvaluatorFactory), so metric names and directions match across both
-    paths and a regression sweep is never ranked by AUC over continuous
-    labels."""
+def _resolve_validation_evaluators(args, task):
+    """The validation evaluator list, FIRST = primary (the single-process
+    suite's convention): parsed --evaluators specs, or the task's default."""
+    from photon_ml_tpu.cli.parsers import parse_evaluator_spec
     from photon_ml_tpu.estimators.game_estimator import default_evaluator_type
     from photon_ml_tpu.evaluation.evaluators import evaluator_for_type
 
-    ev = evaluator_for_type(default_evaluator_type(TaskType(task)))
-    s, l, w = _gather_blocks(scores, labels, weights)
-    return ev.name, float(ev.evaluate(s, l, w)), ev.larger_is_better
+    raw = getattr(args, "evaluators", None)
+    if raw:
+        specs = [parse_evaluator_spec(e) for e in raw.split(",") if e.strip()]
+        if not specs:
+            raise ValueError(f"--evaluators {raw!r} names no evaluators")
+        return specs
+    return [evaluator_for_type(default_evaluator_type(TaskType(task)))]
+
+
+def _group_keys(ids) -> np.ndarray:
+    """Entity-id strings -> int32 group keys for the gathered per-group
+    evaluators. Only group EQUALITY matters; blake2-derived 31-bit keys make
+    collisions negligible at realistic group counts and stay exact through
+    the x64-disabled allgather."""
+    from photon_ml_tpu.parallel.shuffle import entity_owner_hash
+
+    if len(ids) == 0:
+        return np.zeros(0, dtype=np.int32)
+    return (entity_owner_hash(ids) % np.uint64(2**31)).astype(np.int32)
+
+
+def _gathered_evaluations(evaluators, scores, labels, weights, id_lookup):
+    """{evaluator name: value} over the gathered validation set. Per-group
+    evaluators (MultiEvaluator, e.g. AUC:userId / PRECISION@K:id) gather
+    their group keys alongside the score triples; padding rows carry weight
+    0 and their all-padding groups evaluate to NaN, which evaluate_grouped
+    skips."""
+    from photon_ml_tpu.evaluation.evaluators import MultiEvaluator
+
+    tags = []
+    for ev in evaluators:
+        if isinstance(ev, MultiEvaluator) and ev.id_tag not in tags:
+            tags.append(ev.id_tag)
+    arrays = [scores, labels, weights]
+    arrays += [_group_keys(id_lookup(tag)) for tag in tags]
+    gathered = _gather_blocks(*arrays)
+    sg, lg, wg = gathered[:3]
+    groups = dict(zip(tags, gathered[3:]))
+    out = {}
+    for ev in evaluators:
+        if isinstance(ev, MultiEvaluator):
+            out[ev.name] = float(
+                ev.evaluate_grouped(sg, lg, wg, groups[ev.id_tag])
+            )
+        else:
+            out[ev.name] = float(ev.evaluate(sg, lg, wg))
+    return out

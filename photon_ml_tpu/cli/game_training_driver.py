@@ -314,6 +314,20 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             raise ValueError(f"Update sequence references unknown coordinates: {sorted(unknown)}")
         # estimator trains in coordinate_configurations insertion order = sequence
         coord_configs = {c: coord_configs[c] for c in update_sequence}
+        # parse evaluator specs ONCE (reused for the suite below); per-group
+        # evaluators' id tags must be read from the VALIDATION data even for
+        # fixed-effect-only configs (AUC:userId needs the userId column) —
+        # but only there: training data doesn't need them
+        from photon_ml_tpu.evaluation.evaluators import MultiEvaluator
+
+        evaluator_specs = (
+            [parse_evaluator_spec(e) for e in args.evaluators.split(",") if e.strip()]
+            if args.evaluators
+            else []
+        )
+        evaluator_tags = sorted({
+            ev.id_tag for ev in evaluator_specs if isinstance(ev, MultiEvaluator)
+        })
         id_tags = sorted(
             {
                 cfg.data_config.random_effect_type
@@ -373,7 +387,8 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             )
             with Timed("read validation data", logger):
                 validation_input, _, _ = read_merged_avro(
-                    validation_paths, shard_configs, index_maps, id_tags
+                    validation_paths, shard_configs, index_maps,
+                    sorted(set(id_tags) | set(evaluator_tags))
                 )
 
         with Timed("data validation", logger):
@@ -443,11 +458,6 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             else []
         )
 
-        evaluator_specs = (
-            [parse_evaluator_spec(e) for e in args.evaluators.split(",") if e]
-            if args.evaluators
-            else []
-        )
 
         fe_storage_dtype = re_storage_dtype = None
         if getattr(args, "fe_storage_dtype", None) == "bf16":

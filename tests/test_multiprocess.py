@@ -2542,3 +2542,127 @@ def test_two_process_game_variances_match_single_process(tmp_path):
         np.testing.assert_allclose(v_got, v_ref, rtol=1e-2, err_msg=str(eid))
         checked += 1
     assert checked == n_users
+
+
+def test_two_process_grouped_evaluator_selection(tmp_path):
+    """Custom evaluators in multi-process selection: --evaluators AUC:userId
+    ranks the sweep by per-group AUC (MultiEvaluator gathered with hashed
+    group keys), matching the single-process driver's selection and
+    recording every evaluator's value per configuration."""
+    import json as _json
+
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(151)
+    d, n_groups = 4, 9
+    w_true = rng.normal(size=d)
+    imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            g = int(r.integers(0, n_groups))
+            y = float((x @ w_true + 0.4 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {"userId": f"u{g}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "val").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(170, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=2),
+    )
+    avro_io.write_container(
+        str(tmp_path / "val" / "part-0.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(140, seed=3),
+    )
+
+    from photon_ml_tpu.cli.game_training_driver import build_arg_parser, run
+
+    run(build_arg_parser().parse_args([
+        "--input-data-directories", str(tmp_path / "in"),
+        "--validation-data-directories", str(tmp_path / "val"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global",
+        "--coordinate-configurations",
+        # L1: the absurd weight zeroes the model entirely (constant scores,
+        # per-group AUC 0.5) so selection cannot coin-flip on shrinkage-
+        # invariant rankings
+        "name=global,feature.shard=global,optimizer=OWLQN,max.iter=100,"
+        "tolerance=1e-9,regularization=L1,reg.weights=0.1|100000",
+        "--evaluators", "AUC:userId",
+    ]))
+    import json
+
+    spec_single = json.loads(
+        (tmp_path / "out-single" / "best" / "model-spec.json").read_text()
+    )
+    from photon_ml_tpu.cli.parsers import parse_coordinate_configuration
+
+    _, cfg_single = parse_coordinate_configuration(spec_single["global"])
+    single_lam = cfg_single.optimization_config.regularization_weight
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_train_worker.py")
+    logs = [open(tmp_path / f"gsel{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+             "--evaluators", "AUC:userId",
+             "--coordinate-configurations",
+             "name=global,feature.shard=global,optimizer=OWLQN,max.iter=100,"
+             "tolerance=1e-9,regularization=L1,reg.weights=0.1|100000"],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=300)
+            assert rc == 0, (
+                f"gsel {i} failed:\n" + (tmp_path / f"gsel{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    summary = _json.loads((tmp_path / "out" / "summary.json").read_text())
+    rows = summary["results"]
+    assert all(r["metric"] == "AUC@userId" for r in rows)
+    assert all("AUC@userId" in r["evaluations"] for r in rows)
+    values = [r["value"] for r in rows]
+    assert summary["best_index"] == int(np.argmax(values))
+    best_lam = rows[summary["best_index"]]["regularization_weight"]
+    assert best_lam == 0.1 == single_lam  # absurd ridge loses per-group AUC
