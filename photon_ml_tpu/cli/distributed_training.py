@@ -63,7 +63,7 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
     if getattr(args, "partial_retrain_locked_coordinates", None):
         reasons.append("partial retrain with locked coordinates")
     if getattr(args, "checkpoint_directory", None):
-        reasons.append("iteration checkpointing")
+        reasons.append("iteration checkpointing (fixed-effect-only path)")
     if getattr(args, "compute_backend", "host") != "host":
         reasons.append("--compute-backend (the multi-process mesh is implicit)")
     if getattr(args, "coefficient_box_constraints", None):
@@ -139,6 +139,269 @@ def _sharded_fe_variances(args, train_data, coeffs, opt_cfg, task, norm_ctx, mes
             np.asarray(norm.factors), dtype=variances.dtype
         ) ** 2
     return np.asarray(variances)
+
+
+def _mp_ckpt_fingerprint(args, nproc, coord_configs) -> str:
+    """Run-configuration fingerprint: a resumed run must be the SAME run
+    (data, configs, process topology) or the checkpoint is ignored."""
+    import hashlib
+
+    from photon_ml_tpu.cli.parsers import coordinate_configuration_to_string
+
+    payload = json.dumps({
+        "inputs": args.input_data_directories,
+        "input_date_range": getattr(args, "input_data_date_range", None),
+        "input_days_range": getattr(args, "input_data_days_range", None),
+        "validation": getattr(args, "validation_data_directories", None),
+        "validation_date_range": getattr(args, "validation_data_date_range", None),
+        "validation_days_range": getattr(args, "validation_data_days_range", None),
+        "model_input": getattr(args, "model_input_directory", None),
+        "variances": getattr(args, "variance_computation_type", "NONE"),
+        "evaluators": getattr(args, "evaluators", None),
+        "task": args.training_task,
+        "nproc": nproc,
+        "n_iter": args.coordinate_descent_iterations,
+        "normalization": args.normalization,
+        "locked": sorted(_locked_coordinates(args)),
+        "configs": {
+            c: coordinate_configuration_to_string(c, cfg)
+            for c, cfg in coord_configs.items()
+        },
+    }, sort_keys=True)
+    return hashlib.blake2b(payload.encode(), digest_size=16).hexdigest()
+
+
+def _mp_ckpt_paths(directory, rank):
+    base = os.path.join(directory, f"mp-game-r{rank:05d}")
+    return base + ".npz", base + "-prev.npz"
+
+
+class _MpGameCheckpointer:
+    """Rank-local checkpoint/resume for the multi-process GAME sweep.
+
+    Every rank writes its own state atomically (tmp + os.replace) and keeps
+    ONE previous generation. Ranks can be one pass apart when a job dies
+    (the pass loop's exchanges keep them in lockstep otherwise), so resume
+    picks the LATEST cursor for which EVERY rank has a state file (current
+    or previous) — a deterministic decision every rank reaches identically
+    from the shared filesystem. A fingerprint mismatch (different data,
+    configs, nproc, ...) ignores the checkpoint and starts fresh.
+    """
+
+    def __init__(self, directory, args, rank, nproc, coord_configs, re_cids, logger):
+        self.directory = directory
+        self.rank, self.nproc = rank, nproc
+        self.re_cids = list(re_cids)
+        self.logger = logger
+        self.interval = max(1, getattr(args, "checkpoint_interval", 1) or 1)
+        # rank-independent (the rank lives in the FILENAME): every rank can
+        # validate every peer file against the same expected value
+        self.fingerprint = _mp_ckpt_fingerprint(args, nproc, coord_configs)
+        os.makedirs(directory, exist_ok=True)
+
+    # ---- serialization ----------------------------------------------------
+    def _pack_model(self, out, prefix, m):
+        out[f"{prefix}:ids"] = np.asarray(m.entity_ids, dtype=str)
+        out[f"{prefix}:coeffs"] = np.asarray(m.coeffs)
+        out[f"{prefix}:proj"] = np.asarray(m.proj_indices)
+        out[f"{prefix}:vars"] = (
+            np.asarray(m.variances) if m.variances is not None else np.zeros((0, 0))
+        )
+
+    def _unpack_model(self, z, prefix, cid, coord_configs, task, projector):
+        from photon_ml_tpu.models.game import RandomEffectModel
+
+        import jax.numpy as jnp
+
+        dc = coord_configs[cid].data_config
+        var = z[f"{prefix}:vars"]
+        return RandomEffectModel(
+            re_type=dc.random_effect_type,
+            feature_shard_id=dc.feature_shard_id,
+            task=TaskType(task),
+            entity_ids=tuple(str(x) for x in z[f"{prefix}:ids"]),
+            coeffs=jnp.asarray(z[f"{prefix}:coeffs"]),
+            proj_indices=jnp.asarray(z[f"{prefix}:proj"]),
+            variances=jnp.asarray(var) if var.size else None,
+            projector=projector,
+        )
+
+    def _cfg_path(self, j):
+        return os.path.join(
+            self.directory, f"mp-game-cfg{j:04d}-r{self.rank:05d}.npz"
+        )
+
+    def save_config(self, j, entry):
+        """One IMMUTABLE snapshot per completed configuration — completed
+        configs never change, so per-pass checkpoints need not re-serialize
+        them (checkpoint I/O stays O(live state), not O(sweep length))."""
+        out = {
+            "fingerprint": np.asarray([self.fingerprint], dtype=str),
+            "fe": np.asarray(entry["fe"]),
+            "fe_vars": (
+                np.asarray(entry["fe_vars"])
+                if entry.get("fe_vars") is not None else np.zeros(0)
+            ),
+            "meta": np.asarray([json.dumps({
+                "metric": entry["metric"],
+                "value": entry["value"],
+                "evaluations": entry["evaluations"],
+                "auc": entry["auc"],
+            })], dtype=str),
+        }
+        for cid in self.re_cids:
+            if entry["re"].get(cid) is not None:
+                self._pack_model(out, f"re:{cid}", entry["re"][cid])
+        path = self._cfg_path(j)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **out)
+        os.replace(tmp, path)
+
+    def save(self, i, p, fe_coeffs, fe_vars, re_models, re_scores_home,
+             track, n_completed_configs):
+        out = {
+            "cursor": np.asarray([i, p], dtype=np.int64),
+            "fingerprint": np.asarray([self.fingerprint], dtype=str),
+            "n_configs": np.asarray([n_completed_configs], dtype=np.int64),
+            "fe": np.asarray(fe_coeffs),
+            "fe_vars": np.asarray(fe_vars) if fe_vars is not None else np.zeros(0),
+            "meta": np.asarray([json.dumps({
+                "track": {
+                    "value": track["value"],
+                    "metric": track["metric"],
+                    "evaluations": track["evaluations"],
+                },
+            })], dtype=str),
+        }
+        for cid in self.re_cids:
+            if re_models[cid] is not None:
+                self._pack_model(out, f"re:{cid}", re_models[cid])
+            out[f"sc:{cid}"] = np.asarray(re_scores_home[cid])
+        if track["fe"] is not None:
+            out["track:fe"] = np.asarray(track["fe"])
+            out["track:fe_vars"] = (
+                np.asarray(track["fe_vars"])
+                if track["fe_vars"] is not None else np.zeros(0)
+            )
+            for cid in self.re_cids:
+                if track["re"] and track["re"].get(cid) is not None:
+                    self._pack_model(out, f"track:re:{cid}", track["re"][cid])
+        cur, prev = _mp_ckpt_paths(self.directory, self.rank)
+        tmp = cur + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **out)
+        if os.path.exists(cur):
+            os.replace(cur, prev)  # keep one older generation
+        os.replace(tmp, cur)
+        self.logger.info("checkpointed config %d pass %d", i, p)
+
+    # ---- resume -----------------------------------------------------------
+    def _cursor_of(self, path):
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                fp = str(z["fingerprint"][0])
+                i, p = (int(x) for x in z["cursor"])
+            return (i, p), fp
+        except Exception:  # torn/corrupt file: not a resume candidate
+            return None, None
+
+    def resume_cursor(self):
+        """The latest (i, p) every rank can serve, or None. Deterministic:
+        every rank scans the same shared files."""
+        per_rank = []
+        for r in range(self.nproc):
+            cur, prev = _mp_ckpt_paths(self.directory, r)
+            entries = {}
+            for path in (cur, prev):
+                if os.path.exists(path):
+                    cursor, fp = self._cursor_of(path)
+                    if cursor is not None and fp == self.fingerprint:
+                        entries[cursor] = path
+            per_rank.append(entries)
+        if not per_rank or any(not e for e in per_rank):
+            return None
+        common = set(per_rank[0])
+        for e in per_rank[1:]:
+            common &= set(e)
+        if not common:
+            return None
+        return max(common)
+
+    def load(self, cursor, coord_configs, task, coords):
+        import jax.numpy as jnp
+
+        cur, prev = _mp_ckpt_paths(self.directory, self.rank)
+        path = None
+        for cand in (cur, prev):
+            if os.path.exists(cand):
+                c, fp = self._cursor_of(cand)
+                # fingerprint re-checked here: another run sharing the
+                # directory could have rotated a same-cursor file into place
+                if c == cursor and fp == self.fingerprint:
+                    path = cand
+                    break
+        assert path is not None
+        with np.load(path, allow_pickle=False) as z:
+            keys = set(z.files)
+            meta = json.loads(str(z["meta"][0]))
+            n_configs = int(z["n_configs"][0])
+            fe_coeffs = jnp.asarray(z["fe"])
+            fe_vars = np.asarray(z["fe_vars"]) if z["fe_vars"].size else None
+            re_models = {}
+            re_scores_home = {}
+            for cid in self.re_cids:
+                projector = coords[cid].projector
+                re_models[cid] = (
+                    self._unpack_model(z, f"re:{cid}", cid, coord_configs, task, projector)
+                    if f"re:{cid}:coeffs" in keys else None
+                )
+                re_scores_home[cid] = np.asarray(z[f"sc:{cid}"])
+            track = {
+                "value": meta["track"]["value"],
+                "metric": meta["track"]["metric"],
+                "evaluations": meta["track"]["evaluations"],
+                "fe": np.asarray(z["track:fe"]) if "track:fe" in keys else None,
+                "fe_vars": (
+                    np.asarray(z["track:fe_vars"])
+                    if "track:fe_vars" in keys and z["track:fe_vars"].size
+                    else None
+                ),
+                "re": {
+                    cid: self._unpack_model(
+                        z, f"track:re:{cid}", cid, coord_configs, task,
+                        coords[cid].projector,
+                    )
+                    for cid in self.re_cids
+                    if f"track:re:{cid}:coeffs" in keys
+                } if "track:fe" in keys else None,
+            }
+        per_config = []
+        for j in range(n_configs):
+            with np.load(self._cfg_path(j), allow_pickle=False) as z:
+                assert str(z["fingerprint"][0]) == self.fingerprint
+                ckeys = set(z.files)
+                m = json.loads(str(z["meta"][0]))
+                per_config.append({
+                    "configs": None,  # re-derived by the caller from the sweep
+                    "fe": np.asarray(z["fe"]),
+                    "fe_vars": (
+                        np.asarray(z["fe_vars"]) if z["fe_vars"].size else None
+                    ),
+                    "re": {
+                        cid: self._unpack_model(
+                            z, f"re:{cid}", cid, coord_configs, task,
+                            coords[cid].projector,
+                        )
+                        for cid in self.re_cids
+                        if f"re:{cid}:coeffs" in ckeys
+                    },
+                    "metric": m["metric"],
+                    "value": m["value"],
+                    "evaluations": m["evaluations"],
+                    "auc": m["auc"],
+                })
+        return fe_coeffs, fe_vars, re_models, re_scores_home, track, per_config
 
 
 def _locked_coordinates(args) -> set:
@@ -551,6 +814,7 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
             r not in reasons
             and r != MULTIPROC_DESIGN_POINTER
             and not r.startswith("partial retrain")
+            and not r.startswith("iteration checkpointing")
         ):
             reasons.append(r)
     return reasons
@@ -885,7 +1149,22 @@ def run_multiprocess_game(
         c: index_maps[coord_configs[c].data_config.feature_shard_id]
         for c in coord_ids
     }
-    if getattr(args, "model_input_directory", None):
+    ckpt = None
+    resume_cursor = None
+    if getattr(args, "checkpoint_directory", None):
+        ckpt = _MpGameCheckpointer(
+            args.checkpoint_directory, args, rank, nproc, coord_configs,
+            re_cids, logger,
+        )
+        resume_cursor = ckpt.resume_cursor()
+        if resume_cursor is not None:
+            logger.info(
+                "resuming from checkpoint: config %d pass %d", *resume_cursor
+            )
+    # resume overwrites everything the warm-start block would compute (and
+    # its exchanges are all-rank, so the skip is rank-consistent: the resume
+    # decision is deterministic from the shared files)
+    if resume_cursor is None and getattr(args, "model_input_directory", None):
         # warm start (GameTrainingDriver.scala:370-409): every rank loads the
         # same saved model; each owner keeps ONLY its own entities' rows
         # (aligned_to its dataset — a full model on every rank would put each
@@ -978,21 +1257,35 @@ def run_multiprocess_game(
         primary = evaluators[0]
         return primary.name, evals[primary.name], primary.larger_is_better, evals
 
+    per_config = []
+    resumed_track = None
+    if resume_cursor is not None:
+        (fe_coeffs, fe_vars, re_models, re_scores_home, resumed_track,
+         per_config) = ckpt.load(resume_cursor, coord_configs, task, coords)
+        for j, entry in enumerate(per_config):
+            entry["configs"] = sweep[j]  # cheap to re-derive, heavy to store
+
     # a locked fixed effect never changes: score its contribution once
+    # (AFTER any resume load — the locked coefficients come from there when
+    # the warm-start block was skipped)
     fe_home_locked = (
         _host_scores(train, fe_shard, fe_coeffs) if fe_cid in locked else None
     )
-
-    per_config = []
     for i, opt_configs in enumerate(sweep):
+        if resume_cursor is not None and i < len(per_config):
+            continue  # config fully finished before the checkpoint
         # per-update best-snapshot tracking within this configuration — the
         # single-process CoordinateDescent's selection semantics
         # (CoordinateDescent.scala:256-289): every coordinate update is a
         # selection candidate, not just the configuration's final state
-        track = {
-            "value": None, "metric": None, "evaluations": None, "fe": None,
-            "fe_vars": None, "re": None,
-        }
+        if resumed_track is not None and resume_cursor is not None and i == resume_cursor[0]:
+            track = resumed_track
+            resumed_track = None
+        else:
+            track = {
+                "value": None, "metric": None, "evaluations": None, "fe": None,
+                "fe_vars": None, "re": None,
+            }
 
         def _track(tagbase):
             if not has_val:
@@ -1019,6 +1312,12 @@ def run_multiprocess_game(
                 )
 
         for p in range(n_iter):
+            if (
+                resume_cursor is not None
+                and i == resume_cursor[0]
+                and p <= resume_cursor[1]
+            ):
+                continue  # pass completed before the checkpoint
             if fe_cid not in locked:
                 # fixed effect: residual = base + sum of RE scores
                 off_home = base_off_home + sum(re_scores_home.values())
@@ -1081,6 +1380,26 @@ def run_multiprocess_game(
                     c.home_of_own, n_local, gid_base,
                 )
                 _track(f"c{i}p{p}{cid}-")
+            if (
+                not has_val
+                and p + 1 == n_iter
+                and fe_cid not in locked
+                and last_fe_data is not None
+            ):
+                # config-final variances (the only saved model on the no-
+                # validation branch) — computed BEFORE the config-end
+                # checkpoint so a resume lands with the right values
+                fe_vars = _sharded_fe_variances(
+                    args, last_fe_data, fe_coeffs, opt_configs[fe_cid], task,
+                    norm_ctxs.get(fe_shard), mesh,
+                )
+            if ckpt is not None and (
+                (p + 1) % ckpt.interval == 0 or p + 1 == n_iter
+            ):
+                ckpt.save(
+                    i, p, fe_coeffs, fe_vars, re_models, re_scores_home,
+                    track, len(per_config),
+                )
         if has_val:
             logger.info(
                 "cfg%d best per-update validation %s=%.6f",
@@ -1097,12 +1416,6 @@ def run_multiprocess_game(
                 "auc": track["value"] if track["metric"] == "AUC" else None,
             })
         else:
-            if fe_cid not in locked and last_fe_data is not None:
-                # config-final variances (the only saved model on this branch)
-                fe_vars = _sharded_fe_variances(
-                    args, last_fe_data, fe_coeffs, opt_configs[fe_cid], task,
-                    norm_ctxs.get(fe_shard), mesh,
-                )
             per_config.append({
                 "configs": opt_configs,
                 "fe": np.asarray(fe_coeffs),
@@ -1113,6 +1426,8 @@ def run_multiprocess_game(
                 "evaluations": None,
                 "auc": None,
             })
+        if ckpt is not None:
+            ckpt.save_config(len(per_config) - 1, per_config[-1])
 
     if has_val:
         values = [r["value"] for r in per_config]

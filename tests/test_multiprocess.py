@@ -2666,3 +2666,275 @@ def test_two_process_grouped_evaluator_selection(tmp_path):
     assert summary["best_index"] == int(np.argmax(values))
     best_lam = rows[summary["best_index"]]["regularization_weight"]
     assert best_lam == 0.1 == single_lam  # absurd ridge loses per-group AUC
+
+
+def test_multiprocess_game_checkpoint_resume_bit_identical(tmp_path):
+    """Iteration checkpoint/resume in the multi-process GAME sweep: killing
+    the job after any checkpointed pass and re-running with the same
+    directory reproduces the uninterrupted run's saved model EXACTLY.
+    Simulated by promoting each rank's previous checkpoint generation (the
+    state one pass before the end) and re-running."""
+    import shutil
+
+    import numpy as np
+
+    from photon_ml_tpu.cli.distributed_training import (
+        _mp_ckpt_paths,
+        run_multiprocess_game,
+    )
+    from photon_ml_tpu.cli.game_training_driver import (
+        _load_index_maps,
+        build_arg_parser,
+    )
+    from photon_ml_tpu.cli.parsers import (
+        parse_coordinate_configuration,
+        parse_feature_shard_configuration,
+    )
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import load_game_model
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.util import PhotonLogger
+
+    rng = np.random.default_rng(163)
+    d, n_users = 3, 6
+    w_true = rng.normal(size=d)
+    u_eff = 1.4 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(180, seed=1),
+    )
+
+    def make_args(out, ckpt):
+        return build_arg_parser().parse_args([
+            "--input-data-directories", str(tmp_path / "in"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=global,feature.bags=features",
+            "--feature-shard-configurations", "name=re,feature.bags=features",
+            "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--coordinate-update-sequence", "global,per-user",
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=60,"
+            "tolerance=1e-9,regularization=L2,reg.weights=0.3|3",
+            "--coordinate-configurations",
+            "name=per-user,feature.shard=re,random.effect.type=userId,"
+            "optimizer=LBFGS,max.iter=40,tolerance=1e-9,regularization=L2,"
+            "reg.weights=1.0",
+            "--coordinate-descent-iterations", "2",
+            "--checkpoint-directory", str(ckpt),
+        ])
+
+    def run_one(out, ckpt):
+        args = make_args(out, ckpt)
+        shard_configs = dict(
+            parse_feature_shard_configuration(a)
+            for a in args.feature_shard_configurations
+        )
+        coord_configs = dict(
+            parse_coordinate_configuration(a) for a in args.coordinate_configurations
+        )
+        os.makedirs(out, exist_ok=True)
+        run_multiprocess_game(
+            args, 0, 1, PhotonLogger(str(out / "log.txt")), str(out),
+            TaskType("LOGISTIC_REGRESSION"), coord_configs, shard_configs,
+            _load_index_maps(args.off_heap_index_map_directory, shard_configs),
+        )
+        return load_game_model(
+            str(out / "best"), {"global": fe_imap, "per-user": re_imap}
+        )
+
+    # uninterrupted run (writes checkpoints as it goes)
+    a = run_one(tmp_path / "out-a", tmp_path / "ckpt")
+    # simulate death one pass before the end: promote prev -> cur
+    cur, prev = _mp_ckpt_paths(str(tmp_path / "ckpt"), 0)
+    assert os.path.exists(prev)
+    shutil.copy(prev, cur)
+    b = run_one(tmp_path / "out-b", tmp_path / "ckpt")
+    # resumed final model == uninterrupted final model, bit for bit
+    np.testing.assert_array_equal(
+        np.asarray(a.get_model("global").model.coefficients.means),
+        np.asarray(b.get_model("global").model.coefficients.means),
+    )
+    ra, rb = a.get_model("per-user"), b.get_model("per-user")
+    assert set(ra.entity_ids) == set(rb.entity_ids)
+    for eid in ra.entity_ids:
+        np.testing.assert_array_equal(
+            ra.coefficients_for_entity(eid), rb.coefficients_for_entity(eid),
+            err_msg=str(eid),
+        )
+
+    # a full-state checkpoint resumes to a no-op retrain with the same model
+    c = run_one(tmp_path / "out-c", tmp_path / "ckpt")
+    np.testing.assert_array_equal(
+        np.asarray(a.get_model("global").model.coefficients.means),
+        np.asarray(c.get_model("global").model.coefficients.means),
+    )
+
+    # a fingerprint mismatch (different reg sweep) ignores the checkpoint
+    args = make_args(tmp_path / "out-d", tmp_path / "ckpt")
+    args.coordinate_configurations[0] = (
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=60,"
+        "tolerance=1e-9,regularization=L2,reg.weights=0.7"
+    )
+    shard_configs = dict(
+        parse_feature_shard_configuration(a)
+        for a in args.feature_shard_configurations
+    )
+    coord_configs = dict(
+        parse_coordinate_configuration(a) for a in args.coordinate_configurations
+    )
+    os.makedirs(tmp_path / "out-d", exist_ok=True)
+    run_multiprocess_game(
+        args, 0, 1, PhotonLogger(str(tmp_path / "out-d" / "log.txt")),
+        str(tmp_path / "out-d"),
+        TaskType("LOGISTIC_REGRESSION"), coord_configs, shard_configs,
+        _load_index_maps(args.off_heap_index_map_directory, shard_configs),
+    )
+    d_model = load_game_model(
+        str(tmp_path / "out-d" / "best"), {"global": fe_imap, "per-user": re_imap}
+    )
+    # trained fresh under the different weight: coefficients differ
+    assert not np.array_equal(
+        np.asarray(a.get_model("global").model.coefficients.means),
+        np.asarray(d_model.get_model("global").model.coefficients.means),
+    )
+
+
+def test_two_process_game_checkpoint_resume(tmp_path):
+    """Cross-rank checkpoint resume: ranks can die one generation apart, so
+    resume picks the latest cursor EVERY rank can serve (rank 1's previous
+    generation here) and the resumed 2-process run reproduces the
+    uninterrupted model bit for bit."""
+    import shutil
+
+    import numpy as np
+
+    from photon_ml_tpu.cli.distributed_training import _mp_ckpt_paths
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    rng = np.random.default_rng(167)
+    d, n_users = 3, 6
+    w_true = rng.normal(size=d)
+    u_eff = 1.4 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(130, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(110, seed=2),
+    )
+
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_game_worker.py")
+
+    def run2(tag):
+        port = _free_port()
+        shutil.rmtree(tmp_path / "out", ignore_errors=True)
+        logs = [open(tmp_path / f"{tag}{i}.log", "w+") for i in range(2)]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+                 "--coordinate-descent-iterations", "2",
+                 "--checkpoint-directory", str(tmp_path / "ckpt")],
+                env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+            )
+            for i in range(2)
+        ]
+        try:
+            for i, p in enumerate(procs):
+                rc = p.wait(timeout=300)
+                assert rc == 0, (
+                    f"{tag} {i} failed:\n"
+                    + (tmp_path / f"{tag}{i}.log").read_text()
+                )
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for f in logs:
+                f.close()
+        return load_game_model(
+            str(tmp_path / "out" / "best"),
+            {"global": fe_imap, "per-user": re_imap},
+        )
+
+    a = run2("ck")
+    fe_a = np.asarray(a.get_model("global").model.coefficients.means)
+    re_a = {
+        str(e): np.asarray(a.get_model("per-user").coefficients_for_entity(e))
+        for e in a.get_model("per-user").entity_ids
+    }
+    # ranks die one generation apart: rank1 loses its last checkpoint
+    cur1, prev1 = _mp_ckpt_paths(str(tmp_path / "ckpt"), 1)
+    assert os.path.exists(prev1)
+    shutil.copy(prev1, cur1)
+    b = run2("ckr")
+    assert "resuming from checkpoint" in (tmp_path / "ckr0.log").read_text()
+    np.testing.assert_array_equal(
+        fe_a, np.asarray(b.get_model("global").model.coefficients.means)
+    )
+    rb = b.get_model("per-user")
+    for eid, va in re_a.items():
+        np.testing.assert_array_equal(
+            va, np.asarray(rb.coefficients_for_entity(eid)), err_msg=eid
+        )
