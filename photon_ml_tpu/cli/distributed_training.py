@@ -62,8 +62,6 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
         reasons.append("hyperparameter tuning")
     if getattr(args, "partial_retrain_locked_coordinates", None):
         reasons.append("partial retrain with locked coordinates")
-    if getattr(args, "checkpoint_directory", None):
-        reasons.append("iteration checkpointing (fixed-effect-only path)")
     if getattr(args, "compute_backend", "host") != "host":
         reasons.append("--compute-backend (the multi-process mesh is implicit)")
     if getattr(args, "coefficient_box_constraints", None):
@@ -174,6 +172,64 @@ def _mp_ckpt_fingerprint(args, nproc, coord_configs) -> str:
 def _mp_ckpt_paths(directory, rank):
     base = os.path.join(directory, f"mp-game-r{rank:05d}")
     return base + ".npz", base + "-prev.npz"
+
+
+class _MpFeCheckpointer:
+    """Per-configuration checkpointing for the fixed-effect-only sweep: each
+    completed configuration writes ONE immutable rank-local file (atomic
+    tmp+replace); resume counts the consecutive fingerprint-matched files
+    every rank can serve and skips that many configs, warm-starting from the
+    last saved coefficients. No rotating live state is needed — the sweep's
+    only cross-config state IS the last config's coefficients."""
+
+    def __init__(self, directory, args, rank, nproc, coord_configs, logger):
+        self.directory = directory
+        self.rank, self.nproc = rank, nproc
+        self.logger = logger
+        self.fingerprint = _mp_ckpt_fingerprint(args, nproc, coord_configs)
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, j, rank=None):
+        r = self.rank if rank is None else rank
+        return os.path.join(self.directory, f"mp-fe-cfg{j:04d}-r{r:05d}.npz")
+
+    def save(self, j, coeffs, variances, evals):
+        out = {
+            "fingerprint": np.asarray([self.fingerprint], dtype=str),
+            "coeffs": np.asarray(coeffs),
+            "vars": np.asarray(variances) if variances is not None else np.zeros(0),
+            "meta": np.asarray([json.dumps(evals)], dtype=str),
+        }
+        path = self._path(j)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **out)
+        os.replace(tmp, path)
+        self.logger.info("checkpointed config %d", j)
+
+    def _valid(self, path):
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                return str(z["fingerprint"][0]) == self.fingerprint
+        except Exception:  # torn/corrupt: not a resume candidate
+            return False
+
+    def resume_count(self, n_configs) -> int:
+        """Consecutive leading configs EVERY rank has a valid file for —
+        deterministic from the shared filesystem on every rank."""
+        n = 0
+        while n < n_configs and all(
+            self._valid(self._path(n, r)) for r in range(self.nproc)
+        ):
+            n += 1
+        return n
+
+    def load(self, j):
+        with np.load(self._path(j), allow_pickle=False) as z:
+            coeffs = np.asarray(z["coeffs"])
+            variances = np.asarray(z["vars"]) if z["vars"].size else None
+            evals = json.loads(str(z["meta"][0]))
+        return coeffs, variances, evals
 
 
 class _MpGameCheckpointer:
@@ -482,43 +538,58 @@ def run_multiprocess_fixed_effect(
             rank, nproc, logger,
         )
 
-    with Timed("read training data", logger):
-        train = read_slice(
-            args.input_data_directories,
-            getattr(args, "input_data_date_range", None),
-            getattr(args, "input_data_days_range", None),
-            "training",
+    # checkpoint resume decided BEFORE ingest: a fully-resumed sweep (every
+    # config checkpointed) never reads the training data at all
+    sweep = cfg.expand()
+    ckpt = None
+    n_resumed = 0
+    if getattr(args, "checkpoint_directory", None):
+        ckpt = _MpFeCheckpointer(
+            args.checkpoint_directory, args, rank, nproc, coord_configs, logger
         )
-    from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
+        n_resumed = ckpt.resume_count(len(sweep))
+        if n_resumed:
+            logger.info("resuming from checkpoint: %d configs done", n_resumed)
+    fully_resumed = n_resumed == len(sweep)
 
-    if train.n:  # per-sample checks are slice-local: each process checks its rows
-        with Timed("data validation", logger):
-            sanity_check_data(
-                task,
-                train.labels,
-                offsets=train.offsets,
-                weights=train.weights,
-                feature_shards=train.features,
-                validation_type=DataValidationType(args.data_validation),
-            )
+    train = train_data = norm_ctx = None
     val = None
-    if args.validation_data_directories:
-        with Timed("read validation data", logger):
-            val = read_slice(
-                args.validation_data_directories,
-                getattr(args, "validation_data_date_range", None),
-                getattr(args, "validation_data_days_range", None),
-                "validation",
-            )
-
     mesh = make_mesh(len(jax.devices()))
-    train_data, _ = _assemble_global(train, shard, mesh, logger)
+    if not fully_resumed:
+        with Timed("read training data", logger):
+            train = read_slice(
+                args.input_data_directories,
+                getattr(args, "input_data_date_range", None),
+                getattr(args, "input_data_days_range", None),
+                "training",
+            )
+        from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
 
-    # global statistics -> transformed-space solves with original-space
-    # coefficients in/out, exactly the single-process contract
-    norm_ctx = _build_norm_contexts(
-        args, train, [shard], index_maps, logger
-    ).get(shard)
+        if train.n:  # per-sample checks are slice-local per process
+            with Timed("data validation", logger):
+                sanity_check_data(
+                    task,
+                    train.labels,
+                    offsets=train.offsets,
+                    weights=train.weights,
+                    feature_shards=train.features,
+                    validation_type=DataValidationType(args.data_validation),
+                )
+        if args.validation_data_directories:
+            with Timed("read validation data", logger):
+                val = read_slice(
+                    args.validation_data_directories,
+                    getattr(args, "validation_data_date_range", None),
+                    getattr(args, "validation_data_days_range", None),
+                    "validation",
+                )
+        train_data, _ = _assemble_global(train, shard, mesh, logger)
+
+        # global statistics -> transformed-space solves with original-space
+        # coefficients in/out, exactly the single-process contract
+        norm_ctx = _build_norm_contexts(
+            args, train, [shard], index_maps, logger
+        ).get(shard)
 
     from photon_ml_tpu.parallel import train_glm_sharded
 
@@ -541,8 +612,22 @@ def run_multiprocess_fixed_effect(
             if fe_init is not None
             else None
         )
-    sweep = cfg.expand()
-    for opt_cfg in sweep:
+    # selection identity comes from the evaluator list, independent of
+    # whether validation was (re-)read this run: a FULLY-resumed sweep skips
+    # the validation read but its checkpointed entries still carry values
+    metric_name = evaluators[0].name
+    larger = evaluators[0].larger_is_better
+    if ckpt is not None:
+        for j in range(n_resumed):
+            r_coeffs, r_vars, r_meta = ckpt.load(j)
+            results.append((
+                sweep[j], r_coeffs, r_meta.get("value"), r_vars,
+                r_meta.get("evaluations"),
+            ))
+            warm = r_coeffs
+    for j, opt_cfg in enumerate(sweep):
+        if j < n_resumed:
+            continue
         with Timed(f"train lambda={opt_cfg.regularization_weight}", logger):
             coeffs, opt_res = train_glm_sharded(
                 train_data, task, opt_cfg, mesh, initial_coefficients=warm,
@@ -550,7 +635,6 @@ def run_multiprocess_fixed_effect(
             )
         warm = coeffs
         metric_value = None
-        metric_name = larger = None
         evals = None
         if val is not None:
             scores = _host_scores(val, shard, coeffs) + np.asarray(
@@ -562,10 +646,7 @@ def run_multiprocess_fixed_effect(
                 np.asarray(val.weights, dtype=np.float64),
                 val.ids,
             )
-            primary = evaluators[0]
-            metric_name = primary.name
             metric_value = evals[metric_name]
-            larger = primary.larger_is_better
             logger.info(
                 "lambda=%s validation %s",
                 opt_cfg.regularization_weight,
@@ -575,12 +656,17 @@ def run_multiprocess_fixed_effect(
             args, train_data, coeffs, opt_cfg, task, norm_ctx, mesh
         )
         results.append((opt_cfg, np.asarray(coeffs), metric_value, variances, evals))
+        if ckpt is not None:
+            ckpt.save(
+                j, np.asarray(coeffs), variances,
+                {"value": metric_value, "evaluations": evals},
+            )
 
-    if val is not None:
-        values = [r[2] for r in results]
+    values = [r[2] for r in results]
+    if results and all(v is not None for v in values):
         best_i = int(np.argmax(values) if larger else np.argmin(values))
     else:
-        best_i = len(results) - 1
+        best_i = len(results) - 1  # no validation: last (weakest-reg) config
     logger.info("selected model %d of %d", best_i, len(results))
 
     # NOTE: the multi-process summary carries plain dicts (JSON-serializable,
@@ -591,8 +677,8 @@ def run_multiprocess_fixed_effect(
         "results": [
             {
                 "regularization_weight": c.regularization_weight,
-                "auc": a if metric_name in (None, "AUC") else None,
-                "metric": metric_name,
+                "auc": a if (a is not None and metric_name == "AUC") else None,
+                "metric": metric_name if a is not None else None,
                 "value": a,
                 "evaluations": _e,
             }
@@ -814,7 +900,6 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
             r not in reasons
             and r != MULTIPROC_DESIGN_POINTER
             and not r.startswith("partial retrain")
-            and not r.startswith("iteration checkpointing")
         ):
             reasons.append(r)
     return reasons
