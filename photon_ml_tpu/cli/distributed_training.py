@@ -156,6 +156,9 @@ def _mp_ckpt_fingerprint(args, nproc, coord_configs) -> str:
         "model_input": getattr(args, "model_input_directory", None),
         "variances": getattr(args, "variance_computation_type", "NONE"),
         "evaluators": getattr(args, "evaluators", None),
+        "tuning": getattr(args, "hyper_parameter_tuning", "NONE"),
+        "tuning_iterations": getattr(args, "hyper_parameter_tuning_iterations", 0),
+        "tuner": getattr(args, "hyper_parameter_tuner", None),
         "task": args.training_task,
         "nproc": nproc,
         "n_iter": args.coordinate_descent_iterations,
@@ -303,6 +306,16 @@ class _MpGameCheckpointer:
                 "value": entry["value"],
                 "evaluations": entry["evaluations"],
                 "auc": entry["auc"],
+                # enough to reconstruct the entry's optimization configs on
+                # resume (tuned candidates are NOT derivable from the sweep)
+                "weights": {
+                    c: cfg_.regularization_weight
+                    for c, cfg_ in entry["configs"].items()
+                },
+                "alphas": {
+                    c: cfg_.regularization_context.elastic_net_alpha
+                    for c, cfg_ in entry["configs"].items()
+                },
             })], dtype=str),
         }
         for cid in self.re_cids:
@@ -438,8 +451,31 @@ class _MpGameCheckpointer:
                 assert str(z["fingerprint"][0]) == self.fingerprint
                 ckeys = set(z.files)
                 m = json.loads(str(z["meta"][0]))
+                import dataclasses as _dc
+
+                if "weights" not in m:
+                    raise ValueError(
+                        f"checkpoint config snapshot {self._cfg_path(j)} "
+                        "predates per-config weight metadata; clear the "
+                        "checkpoint directory to restart this run"
+                    )
+                configs = {}
+                for c, base in coord_configs.items():
+                    oc = base.optimization_config.with_weight(
+                        float(m["weights"][c])
+                    )
+                    alpha = m.get("alphas", {}).get(c)
+                    if alpha is not None:
+                        oc = _dc.replace(
+                            oc,
+                            regularization_context=_dc.replace(
+                                oc.regularization_context,
+                                elastic_net_alpha=float(alpha),
+                            ),
+                        )
+                    configs[c] = oc
                 per_config.append({
-                    "configs": None,  # re-derived by the caller from the sweep
+                    "configs": configs,
                     "fe": np.asarray(z["fe"]),
                     "fe_vars": (
                         np.asarray(z["fe_vars"]) if z["fe_vars"].size else None
@@ -900,8 +936,15 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
             r not in reasons
             and r != MULTIPROC_DESIGN_POINTER
             and not r.startswith("partial retrain")
+            and not r.startswith("hyperparameter tuning")
+            and not r.startswith("--output-mode TUNED")
         ):
             reasons.append(r)
+    if (
+        getattr(args, "hyper_parameter_tuning", "NONE") not in (None, "NONE")
+        and not getattr(args, "validation_data_directories", None)
+    ):
+        reasons.append("hyperparameter tuning requires validation data")
     return reasons
 
 
@@ -1347,8 +1390,6 @@ def run_multiprocess_game(
     if resume_cursor is not None:
         (fe_coeffs, fe_vars, re_models, re_scores_home, resumed_track,
          per_config) = ckpt.load(resume_cursor, coord_configs, task, coords)
-        for j, entry in enumerate(per_config):
-            entry["configs"] = sweep[j]  # cheap to re-derive, heavy to store
 
     # a locked fixed effect never changes: score its contribution once
     # (AFTER any resume load — the locked coefficients come from there when
@@ -1356,21 +1397,11 @@ def run_multiprocess_game(
     fe_home_locked = (
         _host_scores(train, fe_shard, fe_coeffs) if fe_cid in locked else None
     )
-    for i, opt_configs in enumerate(sweep):
-        if resume_cursor is not None and i < len(per_config):
-            continue  # config fully finished before the checkpoint
-        # per-update best-snapshot tracking within this configuration — the
-        # single-process CoordinateDescent's selection semantics
-        # (CoordinateDescent.scala:256-289): every coordinate update is a
-        # selection candidate, not just the configuration's final state
-        if resumed_track is not None and resume_cursor is not None and i == resume_cursor[0]:
-            track = resumed_track
-            resumed_track = None
-        else:
-            track = {
-                "value": None, "metric": None, "evaluations": None, "fe": None,
-                "fe_vars": None, "re": None,
-            }
+    def _train_config(i, opt_configs, track):
+        """Train ONE configuration (all CD passes, per-update tracking,
+        checkpointing) and append its per_config entry — shared by the grid
+        sweep and the hyperparameter-tuning loop."""
+        nonlocal fe_coeffs, fe_vars, last_fe_data
 
         def _track(tagbase):
             if not has_val:
@@ -1514,6 +1545,99 @@ def run_multiprocess_game(
         if ckpt is not None:
             ckpt.save_config(len(per_config) - 1, per_config[-1])
 
+    for i, opt_configs in enumerate(sweep):
+        if resume_cursor is not None and i < len(per_config):
+            continue  # config fully finished before the checkpoint
+        # per-update best-snapshot tracking within this configuration — the
+        # single-process CoordinateDescent's selection semantics
+        # (CoordinateDescent.scala:256-289): every coordinate update is a
+        # selection candidate, not just the configuration's final state
+        if resumed_track is not None and resume_cursor is not None and i == resume_cursor[0]:
+            track = resumed_track
+            resumed_track = None
+        else:
+            track = {
+                "value": None, "metric": None, "evaluations": None, "fe": None,
+                "fe_vars": None, "re": None,
+            }
+        _train_config(i, opt_configs, track)
+
+    # -- hyperparameter tuning (GameTrainingDriver.runHyperparameterTuning) --
+    # The GP/random proposals are deterministic functions of (observations,
+    # seed), and every rank observes IDENTICAL gathered metric values, so all
+    # ranks propose and train the same candidates in lockstep — no extra
+    # coordination needed beyond the training exchanges themselves.
+    from photon_ml_tpu.types import HyperparameterTuningMode
+
+    tuned_start = len(sweep)
+    tuning_mode = HyperparameterTuningMode(
+        getattr(args, "hyper_parameter_tuning", "NONE") or "NONE"
+    )
+    if tuning_mode != HyperparameterTuningMode.NONE and has_val:
+        from photon_ml_tpu.estimators.evaluation_function import (
+            GameEstimatorEvaluationFunction,
+        )
+        from photon_ml_tpu.hyperparameter.tuner import build_tuner
+
+        is_max = evaluators[0].larger_is_better
+        fn = GameEstimatorEvaluationFunction(
+            estimator=None, data=None, validation_data=None,
+            base_configs={c: coord_configs[c].optimization_config
+                          for c in coord_ids},
+            is_opt_max=is_max,
+        )
+        observations = [
+            (
+                fn._scale_forward(fn.configuration_to_vector(e["configs"])),
+                (-e["value"] if is_max else e["value"]),
+            )
+            for e in per_config
+            if e["value"] is not None
+        ]
+
+        def mp_eval(candidate):
+            nonlocal resumed_track
+            configs = fn.vector_to_configuration(fn._scale_backward(candidate))
+            j = len(per_config)
+            if (
+                resumed_track is not None
+                and resume_cursor is not None
+                and j == resume_cursor[0]
+            ):
+                # the job died mid-tuned-config; the GP re-proposed the same
+                # candidate (identical observations), so its per-update best
+                # snapshot resumes exactly like a grid config's would
+                track_j = resumed_track
+                resumed_track = None
+            else:
+                track_j = {
+                    "value": None, "metric": None, "evaluations": None,
+                    "fe": None, "fe_vars": None, "re": None,
+                }
+            _train_config(j, configs, track_j)
+            entry = per_config[-1]
+            return (
+                (-entry["value"] if is_max else entry["value"]),
+                entry,
+            )
+
+        # a resume that restored finished tuned entries runs only the
+        # REMAINING iterations (the restored entries already feed the GP
+        # through `observations`)
+        remaining = args.hyper_parameter_tuning_iterations - max(
+            0, len(per_config) - tuned_start
+        )
+        tuner = build_tuner(getattr(args, "hyper_parameter_tuner", "ATLAS"))
+        if remaining > 0:
+            with Timed("hyperparameter tuning", logger):
+                tuner.search(
+                    remaining,
+                    fn.num_params,
+                    tuning_mode,
+                    mp_eval,
+                    observations,
+                )
+
     if has_val:
         values = [r["value"] for r in per_config]
         larger = evaluators[0].larger_is_better
@@ -1549,7 +1673,7 @@ def run_multiprocess_game(
     from photon_ml_tpu.cli.parsers import ModelOutputMode
 
     output_mode = ModelOutputMode(args.output_mode)
-    save_all = output_mode in (ModelOutputMode.ALL, ModelOutputMode.EXPLICIT)
+    save_tuned = output_mode == ModelOutputMode.TUNED
     model_dir = os.path.join(spill, "model-parts")
     os.makedirs(model_dir, exist_ok=True)
     # (tag, config index, output dirs): parts are written once per config
@@ -1557,12 +1681,25 @@ def run_multiprocess_game(
     # same (possibly millions-of-entities) tables twice
     to_save: list = []
     if output_mode != ModelOutputMode.NONE:
-        save_indices = range(len(per_config)) if save_all else [best_i]
+        if output_mode == ModelOutputMode.ALL:
+            save_indices = list(range(len(per_config)))
+        elif output_mode == ModelOutputMode.EXPLICIT:
+            # EXPLICIT deliberately EXCLUDES tuned results, as single-process
+            # (GameTrainingDriver.scala:759-826 save semantics)
+            save_indices = sorted({*range(tuned_start), best_i})
+        elif save_tuned:
+            save_indices = sorted({*range(tuned_start, len(per_config)), best_i})
+        else:
+            save_indices = [best_i]
         for i in save_indices:
             dirs = []
             if i == best_i:
                 dirs.append(os.path.join(root, "best"))
-            if save_all:
+            if (
+                output_mode == ModelOutputMode.ALL
+                or (output_mode == ModelOutputMode.EXPLICIT and i < tuned_start)
+                or (save_tuned and i >= tuned_start)
+            ):
                 dirs.append(os.path.join(root, "models", str(i)))
             to_save.append((f"cfg{i}", i, dirs))
     for tag, idx, _ in to_save:

@@ -3050,3 +3050,229 @@ def test_multiprocess_fe_checkpoint_resume(tmp_path):
     ).read_text()
     cc = c.get_model("global").model.coefficients
     np.testing.assert_array_equal(np.asarray(ca.means), np.asarray(cc.means))
+
+
+def test_two_process_game_hyperparameter_tuning(tmp_path):
+    """Bayesian hyperparameter tuning in multi-process GAME training: every
+    rank's GP proposes identical candidates (deterministic from identical
+    gathered observations), tuned configs train through the shared exchange
+    machinery, and selection picks across grid + tuned results — matching
+    the single-process driver's tuned selection on the same data."""
+    import json as _json
+
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(179)
+    d, n_users = 3, 6
+    w_true = rng.normal(size=d)
+    u_eff = 1.4 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "val").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(140, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(120, seed=2),
+    )
+    avro_io.write_container(
+        str(tmp_path / "val" / "part-0.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(110, seed=3),
+    )
+
+    tuning = [
+        "--hyper-parameter-tuning", "BAYESIAN",
+        "--hyper-parameter-tuning-iterations", "2",
+        "--coordinate-descent-iterations", "1",
+        "--output-mode", "TUNED",
+    ]
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    worker = os.path.join(REPO, "tests", "mp_game_worker.py")
+    logs = [open(tmp_path / f"tune{i}.log", "w+") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), str(tmp_path),
+             "--validation-data-directories", str(tmp_path / "val"), *tuning],
+            env=env, stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=420)
+            assert rc == 0, (
+                f"tune {i} failed:\n" + (tmp_path / f"tune{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+    summary = _json.loads((tmp_path / "out" / "summary.json").read_text())
+    rows = summary["results"]
+    assert len(rows) == 3  # 1 grid config + 2 tuned candidates
+    assert all(r["value"] is not None for r in rows)
+    # the tuned candidates explored DIFFERENT reg weights than the grid
+    weights = [r["regularization_weight"]["global"] for r in rows]
+    assert len(set(round(w, 8) for w in weights)) >= 2
+    values = [r["value"] for r in rows]
+    assert summary["best_index"] == int(np.argmax(values))
+    # TUNED output mode: tuned configs saved under models/<i>/
+    for i in (1, 2):
+        assert (tmp_path / "out" / "models" / str(i)).is_dir()
+    assert (tmp_path / "out" / "best").is_dir()
+
+
+def test_multiprocess_game_tuning_checkpoint_resume(tmp_path):
+    """Checkpoint resume THROUGH hyperparameter tuning: a job killed after a
+    tuned candidate completes resumes with only the REMAINING iterations
+    (restored tuned entries feed the GP as observations) and reproduces the
+    uninterrupted run's results exactly."""
+    import json as _json
+    import shutil
+
+    import numpy as np
+
+    from photon_ml_tpu.cli.distributed_training import run_multiprocess_game
+    from photon_ml_tpu.cli.game_training_driver import (
+        _load_index_maps,
+        build_arg_parser,
+    )
+    from photon_ml_tpu.cli.parsers import (
+        parse_coordinate_configuration,
+        parse_feature_shard_configuration,
+    )
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.util import PhotonLogger
+
+    rng = np.random.default_rng(191)
+    d, n_users = 3, 5
+    w_true = rng.normal(size=d)
+    u_eff = 1.4 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "val").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(170, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "val" / "part-0.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(100, seed=2),
+    )
+
+    def run_one(out):
+        args = build_arg_parser().parse_args([
+            "--input-data-directories", str(tmp_path / "in"),
+            "--validation-data-directories", str(tmp_path / "val"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=global,feature.bags=features",
+            "--feature-shard-configurations", "name=re,feature.bags=features",
+            "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+            "--training-task", "LOGISTIC_REGRESSION",
+            "--coordinate-update-sequence", "global,per-user",
+            "--coordinate-configurations",
+            "name=global,feature.shard=global,optimizer=LBFGS,max.iter=60,"
+            "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+            "--coordinate-configurations",
+            "name=per-user,feature.shard=re,random.effect.type=userId,"
+            "optimizer=LBFGS,max.iter=40,tolerance=1e-9,regularization=L2,"
+            "reg.weights=1.0",
+            "--coordinate-descent-iterations", "1",
+            "--hyper-parameter-tuning", "BAYESIAN",
+            "--hyper-parameter-tuning-iterations", "2",
+            "--checkpoint-directory", str(tmp_path / "ckpt"),
+        ])
+        shard_configs = dict(
+            parse_feature_shard_configuration(a)
+            for a in args.feature_shard_configurations
+        )
+        coord_configs = dict(
+            parse_coordinate_configuration(a) for a in args.coordinate_configurations
+        )
+        os.makedirs(out, exist_ok=True)
+        return run_multiprocess_game(
+            args, 0, 1, PhotonLogger(str(out / "log.txt")), str(out),
+            TaskType("LOGISTIC_REGRESSION"), coord_configs, shard_configs,
+            _load_index_maps(args.off_heap_index_map_directory, shard_configs),
+        )
+
+    a = run_one(tmp_path / "out-a")
+    rows_a = a["results"]
+    assert len(rows_a) == 3  # 1 grid + 2 tuned
+
+    # simulate death after tuned candidate 1 (config index 1) completed:
+    # delete config 2's snapshot and roll the live state back one generation
+    (tmp_path / "ckpt" / "mp-game-cfg0002-r00000.npz").unlink()
+    from photon_ml_tpu.cli.distributed_training import _mp_ckpt_paths
+
+    cur, prev = _mp_ckpt_paths(str(tmp_path / "ckpt"), 0)
+    b = run_one(tmp_path / "out-b")
+    rows_b = b["results"]
+    assert len(rows_b) == 3  # NOT 4: only the remaining iteration ran
+    for ra, rb in zip(rows_a[:2], rows_b[:2]):
+        assert ra["regularization_weight"] == rb["regularization_weight"]
+        assert ra["value"] == rb["value"]
+    assert b["best_index"] == a["best_index"]
