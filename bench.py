@@ -77,10 +77,13 @@ def _analytic_cost(data, fe_iters, re_iters, *, newton, storage_bytes):
     accepted iteration; evals == iterations is assumed, making the FLOPs
     model (and MFU) a LOWER bound there.
 
-    ``fe_iters`` is the measured iteration count from the pass diagnostics;
-    ``re_iters`` is the configured solver cap (per-bucket while_loops expose
-    no count), making the RE term an upper bound — the two biases are
-    labeled in the emitted record."""
+    ``fe_iters`` is the measured iteration count from the pass diagnostics.
+    ``re_iters`` is EITHER the measured per-coordinate, per-bucket MAX
+    iteration counts from the diagnostics (``re_iterations_max`` — a vmapped
+    bucket while_loop executes max-lane iterations for EVERY lane, so the
+    bucket's real compute is max x E·S·K) OR, as a fallback, the configured
+    solver cap (int), which makes the RE term an upper bound — whichever was
+    used is labeled in the emitted record."""
     n, d = data.fe_X.n_rows, data.fe_X.n_cols
     def solve_cost(rows, cols, iters):
         flops = iters * 4.0 * rows * cols
@@ -90,24 +93,94 @@ def _analytic_cost(data, fe_iters, re_iters, *, newton, storage_bytes):
             bytes_ += iters * rows * cols * storage_bytes
         return flops, bytes_
 
+    re_measured = not isinstance(re_iters, int)
     flops, bytes_ = solve_cost(n, d, max(int(fe_iters), 1))
-    for rc in data.re:
-        for b in rc.buckets:
+    for ci, rc in enumerate(data.re):
+        for bi, b in enumerate(rc.buckets):
             E, S, K = b.X.shape
-            f, by = solve_cost(E * S, K, re_iters)
+            it = int(re_iters[ci][bi]) if re_measured else int(re_iters)
+            f, by = solve_cost(E * S, K, max(it, 1))
             flops += f
             bytes_ += by
         # scoring gathers: one pass over the per-sample RE values per coordinate
         ns, k = rc.sample_vals.shape
         flops += 2.0 * ns * k
         bytes_ += ns * k * storage_bytes
-    return {
+    out = {
         "flops_per_pass": float(flops),
         "hbm_bytes_per_pass": float(bytes_),
-        "cost_model": "analytic (fe iters measured; re iters = config cap)",
+        "cost_model": (
+            "analytic (fe + re iters measured)"
+            if re_measured
+            else "analytic (fe iters measured; re iters = config cap)"
+        ),
         "fe_iterations_measured": int(fe_iters),
-        "re_iterations_assumed": int(re_iters),
     }
+    if re_measured:
+        out["re_iterations_measured"] = [
+            [int(x) for x in coord] for coord in re_iters
+        ]
+    else:
+        out["re_iterations_assumed"] = int(re_iters)
+    return out
+
+
+def _xla_model_check(data, task):
+    """Cross-check of the analytic cost model against XLA's static cost
+    analysis, on a NON-closure jit of ONE fixed-effect value+gradient
+    evaluation (the data ride as jit ARGUMENTS, so nothing folds into HLO
+    constants and cost analysis actually runs — the closure-form flagship
+    step skips it by design, bench.py _xla_cost). Loop trip counts divide
+    out: the analytic per-pass model is literally iterations x this per-eval
+    model, so the per-eval ratio validates the whole model. Emitted fields:
+    ``xla_cost_ratio`` (XLA flops / analytic 4nd) — the load-bearing check,
+    within ~20% of 1 for a trustworthy model (measured 1.13 on XLA:CPU at
+    the flagship shape) — and ``xla_bytes_ratio`` (XLA bytes-accessed /
+    analytic 2·n·d·storage), which runs ~2x high by construction: cost
+    analysis charges every op's operands, including [n]-vector traffic that
+    real fusion keeps on-chip, so it bounds the analytic bytes model from
+    above rather than pinning it. Fail-soft metadata."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.dataset import LabeledData
+        from photon_ml_tpu.function.losses import loss_for_task
+        from photon_ml_tpu.function.objective import GLMObjective
+        from photon_ml_tpu.types import TaskType
+
+        d = LabeledData(
+            X=data.fe_X, labels=data.labels,
+            offsets=data.offsets, weights=data.weights,
+        )
+        cdtype = data.labels.dtype
+        loss = loss_for_task(TaskType(task))
+
+        def vg(dd, w):
+            obj = GLMObjective(loss, allow_fused=False)
+            return obj.value_and_gradient(dd, w, jnp.asarray(1.0, cdtype))
+
+        w0 = jnp.zeros((data.fe_X.n_cols,), cdtype)
+        ca = jax.jit(vg).lower(d, w0).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        n, cols = data.fe_X.n_rows, data.fe_X.n_cols
+        sb = jnp.dtype(data.fe_X.dtype).itemsize
+        analytic_flops = 4.0 * n * cols
+        analytic_bytes = 2.0 * n * cols * sb
+        xla_flops = float(ca.get("flops", 0.0))
+        xla_bytes = float(ca.get("bytes accessed", 0.0))
+        out = {
+            "xla_eval_flops": xla_flops,
+            "analytic_eval_flops": analytic_flops,
+        }
+        if xla_flops and analytic_flops:
+            out["xla_cost_ratio"] = round(xla_flops / analytic_flops, 4)
+        if xla_bytes and analytic_bytes:
+            out["xla_bytes_ratio"] = round(xla_bytes / analytic_bytes, 4)
+        return out
+    except Exception as e:  # validation metadata, never a failure mode
+        return {"xla_model_check_error": f"{type(e).__name__}: {e}"[:160]}
 
 
 def _roofline(cost, samples_per_sec, n_samples):
@@ -426,17 +499,27 @@ def run_benchmark(device_data: bool = False) -> tuple:
             jnp.dtype(fe_storage_dtype).name if fe_storage_dtype else None,
             pallas_glm.pallas_enabled(),
         )
+        re_meas = diag.get("re_iterations_max")
         costs[key] = {
             **_analytic_cost(
                 data,
                 diag["fe_iterations"],
-                RE_ITERS,
+                # measured per-bucket max iteration counts from the pass just
+                # timed; the config cap only as fallback
+                tuple(tuple(int(x) for x in coord) for coord in re_meas)
+                if re_meas is not None
+                else RE_ITERS,
                 newton=opt_type.name == "NEWTON",
                 storage_bytes=jnp.dtype(fe_storage_dtype or jnp.float32).itemsize,
             ),
             **_xla_cost(step, params),
         }
         return N_SAMPLES * N_PASSES / elapsed, value
+
+    # analytic-model validation BEFORE the sweep, while the cache is empty:
+    # the f32 data built here is exactly what the anchor variant reuses (no
+    # second at-scale build/transfer)
+    model_check = _xla_model_check(get_data(None), TaskType.LOGISTIC_REGRESSION)
 
     value, info = run_variant_sweep(
         measure,
@@ -446,6 +529,7 @@ def run_benchmark(device_data: bool = False) -> tuple:
         pallas_capable=jax.default_backend() == "tpu",
         bf16=jnp.bfloat16,
     )
+    info.update(model_check)
     info.update(_winner_roofline(info, costs, value))
     if device_data:
         info["data_builder"] = "device"

@@ -7,11 +7,13 @@ every process's devices — gradient reductions then cross processes as real
 collectives, the reference's executor/treeAggregate topology with XLA
 collectives in place of Spark (ValueAndGradientAggregator.scala:240-255).
 
-Scope: single fixed-effect coordinate, NONE/L2/L1/elastic regularization
-sweep with warm starts, optional validation AUC selection. Random-effect
-coordinates need the cross-process entity exchange designed in
-docs/DISTRIBUTED.md — configurations containing them fail loudly with that
-pointer. The feature space must come from PREBUILT index maps
+Two runners live here. ``run_multiprocess_fixed_effect``: single
+fixed-effect coordinate — regularization sweeps with warm starts,
+validation selection, down-sampling, box constraints, variances,
+normalization, warm start, per-config checkpoint/resume, and
+RANDOM/BAYESIAN hyperparameter tuning. ``run_multiprocess_game``: [fixed,
+random...] coordinate sequences through the cross-process entity exchange
+of docs/DISTRIBUTED.md. Both require PREBUILT index maps
 (``--off-heap-index-map-directory`` / feature-indexing driver output):
 per-process maps built from data slices would diverge.
 
@@ -21,6 +23,7 @@ must match the single-process driver's model numerically.
 
 from __future__ import annotations
 
+import dataclasses as _dc
 import json
 import os
 from typing import Optional
@@ -47,10 +50,6 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
         if not isinstance(cfg.data_config, FixedEffectDataConfiguration):
             reasons.append(MULTIPROC_DESIGN_POINTER)
             break
-        if 0.0 < cfg.down_sampling_rate < 1.0:
-            reasons.append(f"coordinate {cid!r}: down-sampling")
-        if cfg.box_constraints is not None:
-            reasons.append(f"coordinate {cid!r}: box constraints")
         if cfg.data_config.feature_shard_id not in index_maps:
             reasons.append(
                 f"shard {cfg.data_config.feature_shard_id!r}: multi-process "
@@ -58,16 +57,10 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
                 "(--off-heap-index-map-directory; per-process maps built from "
                 "data slices would diverge)"
             )
-    if args.hyper_parameter_tuning not in (None, "NONE"):
-        reasons.append("hyperparameter tuning")
     if getattr(args, "partial_retrain_locked_coordinates", None):
         reasons.append("partial retrain with locked coordinates")
     if getattr(args, "compute_backend", "host") != "host":
         reasons.append("--compute-backend (the multi-process mesh is implicit)")
-    if getattr(args, "coefficient_box_constraints", None):
-        reasons.append("--coefficient-box-constraints")
-    if getattr(args, "output_mode", "BEST") == "TUNED":
-        reasons.append("--output-mode TUNED (implies hyperparameter tuning)")
     if getattr(args, "data_summary_directory", None):
         reasons.append("--data-summary-directory")
     if getattr(args, "evaluators", None):
@@ -163,6 +156,9 @@ def _mp_ckpt_fingerprint(args, nproc, coord_configs) -> str:
         "nproc": nproc,
         "n_iter": args.coordinate_descent_iterations,
         "normalization": args.normalization,
+        # bounds change the trained optimum: a resume across a changed
+        # constraint map must be rejected, not silently mixed
+        "box_constraints": getattr(args, "coefficient_box_constraints", None),
         "locked": sorted(_locked_coordinates(args)),
         "configs": {
             c: coordinate_configuration_to_string(c, cfg)
@@ -451,8 +447,6 @@ class _MpGameCheckpointer:
                 assert str(z["fingerprint"][0]) == self.fingerprint
                 ckeys = set(z.files)
                 m = json.loads(str(z["meta"][0]))
-                import dataclasses as _dc
-
                 if "weights" not in m:
                     raise ValueError(
                         f"checkpoint config snapshot {self._cfg_path(j)} "
@@ -503,20 +497,34 @@ def _locked_coordinates(args) -> set:
     return {c.strip() for c in raw.split(",") if c.strip()}
 
 
+def _ranked_part_files(directories, date_range, days_range, rank, nproc):
+    """THE multi-process file-assignment convention, in exactly one place:
+    sorted container part files, round-robin sliced by rank. Both ingest
+    (:func:`_read_file_slice`) and the down-sampling draw-key computation
+    (:func:`_concat_order_ids`) derive from this — they MUST agree on which
+    rows a rank holds, or the masks silently diverge from single-process.
+    Returns (all_files, this rank's indices into all_files)."""
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.util.date_range import resolve_input_paths
+
+    paths = resolve_input_paths(directories, date_range, days_range)
+    all_files = avro_io.container_files(paths)
+    return all_files, list(range(len(all_files)))[rank::nproc]
+
+
 def _read_file_slice(
     directories, date_range, days_range, what,
     shard_configs, index_maps, id_tags, rank, nproc, logger,
 ):
     """Round-robin file-slice ingest shared by the multi-process paths."""
-    from photon_ml_tpu.data import avro_io
     from photon_ml_tpu.data.game_data import GameInput
     from photon_ml_tpu.data.readers import read_merged_avro
-    from photon_ml_tpu.util.date_range import resolve_input_paths
     import scipy.sparse as sp
 
-    paths = resolve_input_paths(directories, date_range, days_range)
-    all_files = avro_io.container_files(paths)
-    mine = all_files[rank::nproc]
+    all_files, mine_idx = _ranked_part_files(
+        directories, date_range, days_range, rank, nproc
+    )
+    mine = [all_files[i] for i in mine_idx]
     logger.info(
         "process %d/%d reading %d of %d %s part files",
         rank, nproc, len(mine), len(all_files), what,
@@ -530,6 +538,93 @@ def _read_file_slice(
         )
     data, _, _ = read_merged_avro(mine, shard_configs, index_maps, id_tags)
     return data
+
+
+def _concat_order_ids(directories, date_range, days_range, rank, nproc):
+    """Each LOCAL row's position in the single-process concatenated row order
+    — the down-sampling draw key (sampling/down_sampler.per_sample_uniform).
+
+    Every rank counts every part file from the container block framing alone
+    (avro_io.container_row_count: O(blocks) seeks, no payload reads), so the
+    global offsets are computed identically everywhere with no exchange.
+    File assignment comes from :func:`_ranked_part_files` — the same
+    convention ingest uses, by construction."""
+    from photon_ml_tpu.data import avro_io
+
+    all_files, mine = _ranked_part_files(
+        directories, date_range, days_range, rank, nproc
+    )
+    counts = np.asarray(
+        [avro_io.container_row_count(f) for f in all_files], dtype=np.int64
+    )
+    offsets = np.zeros(len(all_files), dtype=np.int64)
+    if len(all_files):
+        offsets[1:] = np.cumsum(counts)[:-1]
+    if not mine:
+        return np.zeros(0, dtype=np.int64)
+    return np.concatenate(
+        [offsets[i] + np.arange(counts[i], dtype=np.int64) for i in mine]
+    )
+
+
+def _fe_down_sampler(cfg, task):
+    """The fixed-effect coordinate's down-sampler, or None — the estimator's
+    construction (game_estimator.build_coordinate) with the driver's fixed
+    seed, built fresh per swept configuration exactly as the single-process
+    sweep does."""
+    from photon_ml_tpu.sampling.down_sampler import down_sampler_for_task
+
+    if not (0.0 < cfg.down_sampling_rate < 1.0):
+        return None
+    return down_sampler_for_task(TaskType(task), cfg.down_sampling_rate, 0)
+
+
+def _downsampled_weights_global(
+    sampler, call, train, dsids_local, per_process, mesh, global_rows
+):
+    """One down-sampling pass over the HOME rows, assembled to the global
+    batch-sharded weights vector. The draws are keyed by each row's position
+    in the single-process concatenated order (``dsids_local``), so the global
+    mask equals the single-process pass's mask exactly; pad rows keep weight
+    0 (inert either way)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.parallel.distributed import host_local_to_global
+
+    n_local = train.n
+    w_new = np.zeros(per_process, dtype=np.float32)
+    if n_local:
+        w_new[:n_local] = np.asarray(
+            sampler.reweight(
+                jnp.asarray(np.asarray(train.labels), dtype=jnp.float32),
+                jnp.asarray(np.asarray(train.weights), dtype=jnp.float32),
+                jnp.asarray(dsids_local, dtype=jnp.uint32),
+                call,
+            )
+        )
+    return host_local_to_global(w_new, mesh, global_rows=global_rows)
+
+
+def _fe_box_bounds(args, cfg, index_map, norm_ctx):
+    """Per-feature (lower, upper) bound vectors for the fixed-effect solve,
+    or None: coordinate-level bounds win, else the driver-level
+    --coefficient-box-constraints map builds them against the shard's index
+    map — the single-process driver's replacement
+    (game_training_driver.py:425-436, GLMSuite.createConstraintFeatureMap).
+    Bounds + normalization is rejected exactly like the single-process
+    coordinate (Params.scala:211-214)."""
+    bounds = cfg.box_constraints
+    if bounds is None and getattr(args, "coefficient_box_constraints", None):
+        from photon_ml_tpu.optimization.constraints import build_bound_vectors
+
+        bounds = build_bound_vectors(
+            args.coefficient_box_constraints, index_map
+        )
+    if bounds is None:
+        return None
+    if norm_ctx is not None and not norm_ctx.is_identity:
+        raise ValueError("Box constraints and normalization cannot be combined")
+    return bounds
 
 
 def run_multiprocess_fixed_effect(
@@ -574,19 +669,34 @@ def run_multiprocess_fixed_effect(
             rank, nproc, logger,
         )
 
+    from photon_ml_tpu.types import HyperparameterTuningMode
+
+    tuning_mode = HyperparameterTuningMode(
+        getattr(args, "hyper_parameter_tuning", "NONE") or "NONE"
+    )
+    if tuning_mode != HyperparameterTuningMode.NONE and not getattr(
+        args, "validation_data_directories", None
+    ):
+        # the single-process driver's check, verbatim
+        raise ValueError("Hyperparameter tuning requires validation data")
+
     # checkpoint resume decided BEFORE ingest: a fully-resumed sweep (every
-    # config checkpointed) never reads the training data at all
+    # config checkpointed, including tuned ones) never reads the training
+    # data at all
     sweep = cfg.expand()
+    n_total = len(sweep)
+    if tuning_mode != HyperparameterTuningMode.NONE:
+        n_total += args.hyper_parameter_tuning_iterations
     ckpt = None
     n_resumed = 0
     if getattr(args, "checkpoint_directory", None):
         ckpt = _MpFeCheckpointer(
             args.checkpoint_directory, args, rank, nproc, coord_configs, logger
         )
-        n_resumed = ckpt.resume_count(len(sweep))
+        n_resumed = ckpt.resume_count(n_total)
         if n_resumed:
             logger.info("resuming from checkpoint: %d configs done", n_resumed)
-    fully_resumed = n_resumed == len(sweep)
+    fully_resumed = n_resumed == n_total
 
     train = train_data = norm_ctx = None
     val = None
@@ -653,50 +763,205 @@ def run_multiprocess_fixed_effect(
     # the validation read but its checkpointed entries still carry values
     metric_name = evaluators[0].name
     larger = evaluators[0].larger_is_better
+
+    def _restored_cfg(j, r_meta):
+        """The optimization config a checkpointed entry was trained with:
+        grid entries come from the sweep, tuned entries reconstruct from the
+        checkpointed weight/alpha (not derivable from the sweep)."""
+        if j < len(sweep):
+            return sweep[j]
+        if r_meta.get("weight") is None:
+            raise ValueError(
+                f"checkpoint config {j} is a tuned candidate but predates "
+                "per-config weight metadata; clear the checkpoint directory "
+                "to restart this run"
+            )
+        oc = cfg.optimization_config.with_weight(float(r_meta["weight"]))
+        if r_meta.get("alpha") is not None:
+            oc = _dc.replace(
+                oc,
+                regularization_context=_dc.replace(
+                    oc.regularization_context,
+                    elastic_net_alpha=float(r_meta["alpha"]),
+                ),
+            )
+        return oc
+
     if ckpt is not None:
         for j in range(n_resumed):
             r_coeffs, r_vars, r_meta = ckpt.load(j)
             results.append((
-                sweep[j], r_coeffs, r_meta.get("value"), r_vars,
+                _restored_cfg(j, r_meta), r_coeffs, r_meta.get("value"), r_vars,
                 r_meta.get("evaluations"),
             ))
             warm = r_coeffs
+
+    sampler_rate_active = 0.0 < cfg.down_sampling_rate < 1.0
+    n_iter = args.coordinate_descent_iterations
+    bounds = lower = upper = None
+    dsids_local = None
+    if not fully_resumed:
+        bounds = _fe_box_bounds(args, cfg, index_maps[shard], norm_ctx)
+        if bounds is not None:
+            lower, upper = bounds
+        if sampler_rate_active:
+            dsids_local = _concat_order_ids(
+                args.input_data_directories,
+                getattr(args, "input_data_date_range", None),
+                getattr(args, "input_data_days_range", None),
+                rank, nproc,
+            )
+
+    def evaluate(coeffs):
+        if val is None:
+            return None, None
+        scores = _host_scores(val, shard, coeffs) + np.asarray(
+            val.offsets, dtype=np.float64
+        )
+        evals = _gathered_evaluations(
+            evaluators, scores,
+            np.asarray(val.labels, dtype=np.float64),
+            np.asarray(val.weights, dtype=np.float64),
+            val.ids,
+        )
+        return evals[metric_name], evals
+
+    def train_one(opt_cfg, warm_coeffs):
+        """Train ONE configuration; returns (coeffs, value, variances, evals).
+
+        Without down-sampling, one converged solve equals the single-process
+        descent's n identical passes over one coordinate. With it, each CD
+        pass draws a FRESH mask (DownSampler.down_sample per update), so the
+        passes are emulated one by one — draw p's weights, warm-started
+        solve, per-update validation tracking (every update is a selection
+        candidate, CoordinateDescent.scala:256-289)."""
+        if not sampler_rate_active:
+            coeffs, _ = train_glm_sharded(
+                train_data, task, opt_cfg, mesh,
+                initial_coefficients=warm_coeffs, normalization=norm_ctx,
+                lower_bounds=lower, upper_bounds=upper,
+            )
+            value, evals = evaluate(coeffs)
+            variances = _sharded_fe_variances(
+                args, train_data, coeffs, opt_cfg, task, norm_ctx, mesh
+            )
+            return np.asarray(coeffs), value, variances, evals
+
+        sampler = _fe_down_sampler(cfg, task)
+        global_rows = train_data.labels.shape[0]
+        per_proc_rows = global_rows // nproc
+        coeffs = warm_coeffs
+        best = None  # (value, coeffs, call, evals)
+        data_p = train_data
+        for p in range(n_iter):
+            w_p = _downsampled_weights_global(
+                sampler, p, train, dsids_local, per_proc_rows, mesh, global_rows
+            )
+            data_p = _dc.replace(train_data, weights=w_p)
+            coeffs, _ = train_glm_sharded(
+                data_p, task, opt_cfg, mesh,
+                initial_coefficients=coeffs, normalization=norm_ctx,
+                lower_bounds=lower, upper_bounds=upper,
+            )
+            value, evals = evaluate(coeffs)
+            if value is not None and (
+                best is None
+                or (value > best[0] if larger else value < best[0])
+            ):
+                best = (value, np.asarray(coeffs).copy(), p, evals)
+        if best is not None:
+            value, out_coeffs, best_p, evals = best
+            if best_p != n_iter - 1:
+                # variances belong to the pass that produced the snapshot:
+                # rebuild its (deterministic) weights for the Hessian pass
+                data_p = _dc.replace(
+                    train_data,
+                    weights=_downsampled_weights_global(
+                        _fe_down_sampler(cfg, task), best_p, train,
+                        dsids_local, per_proc_rows, mesh, global_rows,
+                    ),
+                )
+        else:
+            value, out_coeffs, evals = None, np.asarray(coeffs), None
+        variances = _sharded_fe_variances(
+            args, data_p, jnp.asarray(out_coeffs), opt_cfg, task, norm_ctx, mesh
+        )
+        return out_coeffs, value, variances, evals
+
+    def _ckpt_meta(opt_cfg, value, evals):
+        return {
+            "value": value,
+            "evaluations": evals,
+            "weight": opt_cfg.regularization_weight,
+            "alpha": opt_cfg.regularization_context.elastic_net_alpha,
+        }
+
     for j, opt_cfg in enumerate(sweep):
         if j < n_resumed:
             continue
         with Timed(f"train lambda={opt_cfg.regularization_weight}", logger):
-            coeffs, opt_res = train_glm_sharded(
-                train_data, task, opt_cfg, mesh, initial_coefficients=warm,
-                normalization=norm_ctx,
-            )
+            coeffs, metric_value, variances, evals = train_one(opt_cfg, warm)
         warm = coeffs
-        metric_value = None
-        evals = None
-        if val is not None:
-            scores = _host_scores(val, shard, coeffs) + np.asarray(
-                val.offsets, dtype=np.float64
-            )
-            evals = _gathered_evaluations(
-                evaluators, scores,
-                np.asarray(val.labels, dtype=np.float64),
-                np.asarray(val.weights, dtype=np.float64),
-                val.ids,
-            )
-            metric_value = evals[metric_name]
+        if evals is not None:
             logger.info(
                 "lambda=%s validation %s",
                 opt_cfg.regularization_weight,
                 " ".join(f"{k}={v:.6f}" for k, v in evals.items()),
             )
-        variances = _sharded_fe_variances(
-            args, train_data, coeffs, opt_cfg, task, norm_ctx, mesh
-        )
-        results.append((opt_cfg, np.asarray(coeffs), metric_value, variances, evals))
+        results.append((opt_cfg, coeffs, metric_value, variances, evals))
         if ckpt is not None:
-            ckpt.save(
-                j, np.asarray(coeffs), variances,
-                {"value": metric_value, "evaluations": evals},
+            ckpt.save(j, coeffs, variances, _ckpt_meta(opt_cfg, metric_value, evals))
+
+    # -- hyperparameter tuning (GameTrainingDriver.runHyperparameterTuning):
+    # proposals are deterministic functions of the gathered observations, so
+    # every rank trains identical candidates in lockstep (the GAME runner's
+    # design); candidates COLD-start, as the single-process evaluation
+    # function's fresh fits do
+    tuned_start = len(sweep)
+    if tuning_mode != HyperparameterTuningMode.NONE:
+        from photon_ml_tpu.estimators.evaluation_function import (
+            GameEstimatorEvaluationFunction,
+        )
+        from photon_ml_tpu.hyperparameter.tuner import build_tuner
+
+        fn = GameEstimatorEvaluationFunction(
+            estimator=None, data=None, validation_data=None,
+            base_configs={cid: cfg.optimization_config},
+            is_opt_max=larger,
+        )
+        observations = [
+            (
+                fn._scale_forward(fn.configuration_to_vector({cid: r_cfg})),
+                (-v if larger else v),
             )
+            for (r_cfg, _, v, _, _) in results
+            if v is not None
+        ]
+
+        def mp_eval(candidate):
+            configs = fn.vector_to_configuration(fn._scale_backward(candidate))
+            opt_cfg = configs[cid]
+            j = len(results)
+            with Timed(f"tune lambda={opt_cfg.regularization_weight}", logger):
+                coeffs, metric_value, variances, evals = train_one(opt_cfg, None)
+            results.append((opt_cfg, coeffs, metric_value, variances, evals))
+            if ckpt is not None:
+                ckpt.save(
+                    j, coeffs, variances, _ckpt_meta(opt_cfg, metric_value, evals)
+                )
+            return ((-metric_value if larger else metric_value), results[-1])
+
+        n_restored_tuned = max(0, len(results) - tuned_start)
+        remaining = args.hyper_parameter_tuning_iterations - n_restored_tuned
+        if remaining > 0:
+            tuner = build_tuner(getattr(args, "hyper_parameter_tuner", "ATLAS"))
+            with Timed("hyperparameter tuning", logger):
+                tuner.search(
+                    remaining, fn.num_params, tuning_mode, mp_eval, observations,
+                    # checkpoint-restored tuned candidates already consumed
+                    # their Sobol draws; fast-forward past them
+                    resumed=n_restored_tuned,
+                )
 
     values = [r[2] for r in results]
     if results and all(v is not None for v in values):
@@ -755,13 +1020,23 @@ def run_multiprocess_fixed_effect(
                 {cid: index_maps[shard]},
                 coord_configs, args.model_sparsity_threshold, logger,
             )
-            if output_mode in (ModelOutputMode.ALL, ModelOutputMode.EXPLICIT):
-                for i, entry in enumerate(results):
-                    _save_result(
-                        os.path.join(root, "models", str(i)), fe_result(entry),
-                        {cid: index_maps[shard]},
-                        coord_configs, args.model_sparsity_threshold, logger,
-                    )
+            # models/<i>/ ranges follow the single-process driver
+            # (GameTrainingDriver.scala:759-826): ALL saves everything,
+            # EXPLICIT excludes tuned results, TUNED saves only them
+            if output_mode == ModelOutputMode.ALL:
+                save_range = range(len(results))
+            elif output_mode == ModelOutputMode.EXPLICIT:
+                save_range = range(tuned_start)
+            elif output_mode == ModelOutputMode.TUNED:
+                save_range = range(tuned_start, len(results))
+            else:
+                save_range = range(0)
+            for i in save_range:
+                _save_result(
+                    os.path.join(root, "models", str(i)), fe_result(results[i]),
+                    {cid: index_maps[shard]},
+                    coord_configs, args.model_sparsity_threshold, logger,
+                )
             os.makedirs(os.path.join(root, "index-maps"), exist_ok=True)
             index_maps[shard].save(os.path.join(root, "index-maps", f"{shard}.npz"))
         with open(os.path.join(root, "summary.json"), "w") as f:
@@ -905,10 +1180,6 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
                 "(the [E]-array form has no global entity order to bind to)"
             )
     for cid, cfg in coord_configs.items():
-        if 0.0 < cfg.down_sampling_rate < 1.0:
-            reasons.append(f"coordinate {cid!r}: down-sampling")
-        if cfg.box_constraints is not None:
-            reasons.append(f"coordinate {cid!r}: box constraints")
         if cfg.data_config.feature_shard_id not in index_maps:
             reasons.append(
                 f"shard {cfg.data_config.feature_shard_id!r}: multi-process "
@@ -936,8 +1207,6 @@ def multiprocess_game_ineligibilities(args, coord_configs, index_maps) -> list[s
             r not in reasons
             and r != MULTIPROC_DESIGN_POINTER
             and not r.startswith("partial retrain")
-            and not r.startswith("hyperparameter tuning")
-            and not r.startswith("--output-mode TUNED")
         ):
             reasons.append(r)
     if (
@@ -1118,6 +1387,25 @@ def run_multiprocess_game(
     per_process = fe_train.labels.shape[0] // nproc
     gid_base = rank * per_process
     gids_local = np.arange(n_local, dtype=np.int64) + gid_base
+
+    # fixed-effect down-sampling + box constraints (both FE-coordinate-only,
+    # exactly as the single-process estimator applies them)
+    fe_cfg = coord_configs[fe_cid]
+    fe_bounds = _fe_box_bounds(
+        args, fe_cfg, index_maps[fe_shard], norm_ctxs.get(fe_shard)
+    )
+    fe_lower, fe_upper = fe_bounds if fe_bounds is not None else (None, None)
+    fe_sampler = _fe_down_sampler(fe_cfg, task)
+    dsids_local = (
+        _concat_order_ids(
+            args.input_data_directories,
+            getattr(args, "input_data_date_range", None),
+            getattr(args, "input_data_days_range", None),
+            rank, nproc,
+        )
+        if fe_sampler is not None
+        else None
+    )
 
     # ---- per-coordinate entity exchange (ingest; once) ------------------------
     class RECoord:
@@ -1445,11 +1733,25 @@ def run_multiprocess_game(
                     off_pad.astype(np.float32), mesh,
                     global_rows=fe_train.labels.shape[0],
                 ))
+                if fe_sampler is not None:
+                    # fresh mask per CD pass (call index = p; the single-
+                    # process sampler is rebuilt per config, so its counter
+                    # is the pass index), keyed by concat-order sample
+                    # positions — the multi-process masks equal the single-
+                    # process run's exactly
+                    fe_data = _dc.replace(
+                        fe_data,
+                        weights=_downsampled_weights_global(
+                            fe_sampler, p, train, dsids_local, per_process,
+                            mesh, fe_train.labels.shape[0],
+                        ),
+                    )
                 with Timed(f"cfg{i} pass{p} fixed-effect solve", logger):
                     fe_coeffs, _ = train_glm_sharded(
                         fe_data, task, opt_configs[fe_cid], mesh,
                         initial_coefficients=fe_coeffs,
                         normalization=norm_ctxs.get(fe_shard),
+                        lower_bounds=fe_lower, upper_bounds=fe_upper,
                     )
                 if has_val:
                     # per-update variances ride the update, as in the single-
@@ -1596,7 +1898,7 @@ def run_multiprocess_game(
         ]
 
         def mp_eval(candidate):
-            nonlocal resumed_track
+            nonlocal resumed_track, fe_coeffs, fe_vars
             configs = fn.vector_to_configuration(fn._scale_backward(candidate))
             j = len(per_config)
             if (
@@ -1606,7 +1908,8 @@ def run_multiprocess_game(
             ):
                 # the job died mid-tuned-config; the GP re-proposed the same
                 # candidate (identical observations), so its per-update best
-                # snapshot resumes exactly like a grid config's would
+                # snapshot resumes exactly like a grid config's would (the
+                # cold-start below already happened before the checkpoint)
                 track_j = resumed_track
                 resumed_track = None
             else:
@@ -1614,6 +1917,17 @@ def run_multiprocess_game(
                     "value": None, "metric": None, "evaluations": None,
                     "fe": None, "fe_vars": None, "re": None,
                 }
+                # tuned candidates COLD-start (locked coordinates keep their
+                # loaded models): the single-process evaluation function runs
+                # a fresh fit per candidate, not a warm continuation
+                # (estimators/evaluation_function.py _fit_with)
+                if fe_cid not in locked:
+                    fe_coeffs = None
+                    fe_vars = None
+                for cid_ in re_cids:
+                    if cid_ not in locked:
+                        re_models[cid_] = None
+                        re_scores_home[cid_] = np.zeros(n_local)
             _train_config(j, configs, track_j)
             entry = per_config[-1]
             return (
@@ -1623,10 +1937,10 @@ def run_multiprocess_game(
 
         # a resume that restored finished tuned entries runs only the
         # REMAINING iterations (the restored entries already feed the GP
-        # through `observations`)
-        remaining = args.hyper_parameter_tuning_iterations - max(
-            0, len(per_config) - tuned_start
-        )
+        # through `observations`, and the tuner fast-forwards its Sobol
+        # stream past the draws they consumed)
+        n_restored_tuned = max(0, len(per_config) - tuned_start)
+        remaining = args.hyper_parameter_tuning_iterations - n_restored_tuned
         tuner = build_tuner(getattr(args, "hyper_parameter_tuner", "ATLAS"))
         if remaining > 0:
             with Timed("hyperparameter tuning", logger):
@@ -1636,6 +1950,7 @@ def run_multiprocess_game(
                     tuning_mode,
                     mp_eval,
                     observations,
+                    resumed=n_restored_tuned,
                 )
 
     if has_val:
@@ -1666,10 +1981,9 @@ def run_multiprocess_game(
 
     # ---- assemble + save models (rank 0) --------------------------------------
     # ModelOutputMode (GameTrainingDriver.scala:759-826): BEST writes best/
-    # only; ALL and EXPLICIT additionally write models/<i>/ per trained
-    # configuration (EXPLICIT == ALL here because multi-process rejects
-    # tuning, so the explicit range is every result); NONE writes no model
-    # (summary.json still lands). Only TUNED is rejected.
+    # only; ALL additionally writes models/<i>/ per trained configuration,
+    # EXPLICIT excludes tuned results, TUNED saves only them; NONE writes no
+    # model (summary.json still lands).
     from photon_ml_tpu.cli.parsers import ModelOutputMode
 
     output_mode = ModelOutputMode(args.output_mode)
@@ -1807,8 +2121,6 @@ def run_multiprocess_game(
 
 
 def dataclasses_replace_offsets(data, offsets):
-    import dataclasses as _dc
-
     return _dc.replace(data, offsets=offsets)
 
 

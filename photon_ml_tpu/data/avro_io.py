@@ -372,6 +372,39 @@ def iter_raw_blocks(path: str):
                 raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
 
 
+def container_row_count(path: str) -> int:
+    """Record count of one container file from the block FRAMING alone —
+    payloads are seeked over, never read or decompressed, so counting a file
+    costs O(blocks) seeks. Used by the multi-process drivers to compute each
+    local row's position in the single-process concatenated row order (the
+    down-sampling draw key) without exchanging counts between processes."""
+    total = 0
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        while True:  # skip the metadata map
+            count = read_long(f)
+            if count == 0:
+                break
+            if count < 0:
+                read_long(f)
+                count = -count
+            for _ in range(count):
+                f.seek(read_long(f), 1)  # key
+                f.seek(read_long(f), 1)  # value
+        f.seek(SYNC_SIZE, 1)
+        while True:
+            try:
+                n_records = read_long(f)
+            except EOFError:
+                return total
+            payload_len = read_long(f)
+            if payload_len < 0:
+                raise ValueError(f"{path}: negative block size (corrupt file)")
+            total += n_records
+            f.seek(payload_len + SYNC_SIZE, 1)
+
+
 def container_files(path) -> list:
     """All .avro part files under ``path``: a file, a directory of part files, a
     comma-separated string of either, or a list/tuple of paths (the reference's

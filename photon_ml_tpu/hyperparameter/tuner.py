@@ -20,7 +20,14 @@ from photon_ml_tpu.types import HyperparameterTuningMode
 
 
 class HyperparameterTuner:
-    """search(n, dimension, mode, evaluation_function, observations, ...) -> results."""
+    """search(n, dimension, mode, evaluation_function, observations, ...) -> results.
+
+    ``resumed``: how many of ``observations`` are tuned candidates RESTORED
+    from a checkpoint (not grid results). The searcher's quasi-random (Sobol)
+    stream position depends only on draws since construction — observations
+    never advance it — so a resumed run must fast-forward past the draws the
+    completed tuned iterations consumed, or it re-proposes already-trained
+    candidates and never reaches the uninterrupted run's later ones."""
 
     def search(
         self,
@@ -33,6 +40,7 @@ class HyperparameterTuner:
         discrete_params: Optional[dict] = None,
         seed: int = 0,
         config: Optional[HyperparameterConfig] = None,
+        resumed: int = 0,
     ) -> list:
         raise NotImplementedError
 
@@ -41,15 +49,36 @@ class DummyTuner(HyperparameterTuner):
     """No-op tuner (HyperparameterTunerFactory DUMMY): returns no results."""
 
     def search(self, n, dimension, mode, evaluation_function, observations,
-               prior_observations=(), discrete_params=None, seed=0, config=None) -> list:
+               prior_observations=(), discrete_params=None, seed=0, config=None,
+               resumed=0) -> list:
         return []
+
+
+def _sobol_draws_consumed(mode, dimension, n_initial_observations, iterations,
+                          candidate_pool_size):
+    """Sobol draws the first ``iterations`` tuned candidates consumed in the
+    uninterrupted run. RANDOM draws 1 per iteration. BAYESIAN draws 1 while
+    under-determined (GaussianProcessSearch.next falls back to uniform until
+    #observations > #params; at iteration j the observation count is
+    n_initial + j) and a full candidate pool afterwards."""
+    draws = 0
+    for j in range(iterations):
+        if (
+            mode == HyperparameterTuningMode.BAYESIAN
+            and n_initial_observations + j > dimension
+        ):
+            draws += candidate_pool_size
+        else:
+            draws += 1
+    return draws
 
 
 class AtlasTuner(HyperparameterTuner):
     """Dispatches RANDOM / BAYESIAN search (AtlasTuner.scala:41-60)."""
 
     def search(self, n, dimension, mode, evaluation_function, observations,
-               prior_observations=(), discrete_params=None, seed=0, config=None) -> list:
+               prior_observations=(), discrete_params=None, seed=0, config=None,
+               resumed=0) -> list:
         mode = HyperparameterTuningMode(mode)
         if mode == HyperparameterTuningMode.NONE or n <= 0:
             return []
@@ -59,6 +88,15 @@ class AtlasTuner(HyperparameterTuner):
             else RandomSearch
         )
         searcher = cls(dimension, evaluation_function, discrete_params=discrete_params, seed=seed)
+        if resumed:
+            # checkpoint resume: land the quasi-random stream exactly where
+            # the uninterrupted run's iteration ``resumed`` would read it
+            skip = _sobol_draws_consumed(
+                mode, dimension, max(0, len(observations) - resumed), resumed,
+                getattr(searcher, "candidate_pool_size", 1),
+            )
+            if skip:
+                searcher._sobol.fast_forward(skip)
         # Prior observations come out of prior_from_json in RAW hyperparameter
         # space; the search operates in transformed-[0,1]^d space, so prior POINTS
         # must go through the same transform+scale the observations did

@@ -158,10 +158,14 @@ def sharded_glm_solver(
     opt_config: OptimizerConfig,
     has_l1: bool,
     mesh,
+    has_lower: bool = False,
+    has_upper: bool = False,
 ):
     """glm_solver variant with replicated output shardings over ``mesh``
     (coefficients replicated, gradient reductions psum'd by XLA — the
-    treeAggregate analog of ValueAndGradientAggregator.scala:240-255)."""
+    treeAggregate analog of ValueAndGradientAggregator.scala:240-255).
+    ``solve(data, x0, l2, l1, lower, upper, norm)``: absent bounds occupy a
+    dummy argument slot, exactly like glm_solver."""
     from photon_ml_tpu.parallel.mesh import replicated_sharding
 
     task = TaskType(task)
@@ -170,7 +174,7 @@ def sharded_glm_solver(
     use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
     use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
 
-    def solve(data, x0, l2, l1, norm):
+    def solve(data, x0, l2, l1, lower, upper, norm):
         # Multi-device mesh path: GSPMD cannot partition an opaque pallas_call,
         # so the fused kernel stays off here regardless of the global switch.
         obj = GLMObjective(loss, norm, allow_fused=False)
@@ -185,6 +189,10 @@ def sharded_glm_solver(
             kwargs["hess"] = lambda w: obj.hessian_matrix(data, w, l2)
         if has_l1:
             kwargs["l1_weight"] = l1
+        if has_lower:
+            kwargs["lower_bounds"] = lower
+        if has_upper:
+            kwargs["upper_bounds"] = upper
         return minimize(vg, x0, **kwargs)
 
     return jax.jit(solve, out_shardings=replicated_sharding(mesh))

@@ -298,16 +298,18 @@ def game_train_step(
     total = fe_score + sum(re_scores) if re_scores else fe_score
 
     # ---- random-effect coordinates ----------------------------------------------
+    re_iter_maxes = []
     for i, (rc, cfg) in enumerate(zip(data.re, re_configs)):
         solve = re_bucket_solver(task, cfg.optimizer_config, bool(cfg.l1_weight), no_var)
         offsets_plus = data.offsets + (total - re_scores[i])
         coeffs = re_coeffs[i]
+        bucket_iters = []
         for b in rc.buckets:
             K = b.X.shape[2]
             off_b = jnp.take(offsets_plus, jnp.maximum(b.sample_ids, 0), axis=0)
             off_b = jnp.where(b.sample_ids >= 0, off_b, 0.0)
             w0_b = coeffs[b.entity_rows, :K]
-            w_b, _, _, _ = solve(
+            w_b, _, it_b, _ = solve(
                 b.X,
                 b.labels,
                 b.weights,
@@ -317,18 +319,24 @@ def game_train_step(
                 jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
             )
             coeffs = coeffs.at[b.entity_rows, :K].set(w_b)
+            # a vmapped while_loop runs until EVERY lane converges, so the
+            # bucket's executed iteration count is the max over entities —
+            # the measured input to bench.py's roofline cost model
+            bucket_iters.append(jnp.max(it_b))
         # junk + sharding-padding rows must stay zero: bucket padding scattered
         # garbage into row E (rows above are device_put padding)
         coeffs = coeffs.at[rc.n_entities :].set(0.0)
         re_coeffs[i] = coeffs
         re_scores[i] = _re_score(rc, coeffs)
         total = fe_score + sum(re_scores)
+        re_iter_maxes.append(tuple(bucket_iters))
 
     new_params = {"fixed": fe_coef, "re": tuple(re_coeffs)}
     diagnostics = {
         "fe_value": fe_res.value,
         "fe_iterations": fe_res.iterations,
         "total_scores": total,
+        "re_iterations_max": tuple(re_iter_maxes),
     }
     return new_params, diagnostics
 

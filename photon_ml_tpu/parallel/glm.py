@@ -76,8 +76,14 @@ def train_glm_sharded(
     *,
     initial_coefficients: Optional[Array] = None,
     normalization=None,
+    lower_bounds: Optional[Array] = None,
+    upper_bounds: Optional[Array] = None,
 ) -> tuple[Array, OptResult]:
     """One fixed-effect GLM solve, samples sharded over ``mesh``.
+
+    ``lower_bounds``/``upper_bounds``: optional per-feature box constraints
+    ([D], replicated) — enforced by the optimizer exactly as the host path
+    (LBFGS projection / LBFGSB / TRON; optimization/factory.py).
 
     ``data`` should already be placed via :func:`shard_labeled_data` (un-placed
     arrays work too — jit will shard them to match the replicated-coefficient
@@ -111,12 +117,25 @@ def train_glm_sharded(
         x0 = norm.to_transformed_space_device(x0)
     x0 = jax.device_put(x0, rep)
 
-    solve = sharded_glm_solver(task, cfg.optimizer_config, bool(cfg.l1_weight), mesh)
+    if (lower_bounds is not None or upper_bounds is not None) and not norm.is_identity:
+        # bounds live in ORIGINAL space, the solve clamps in transformed
+        # space — rejected exactly like GLMOptimizationProblem.run
+        # (Params.scala:211-214)
+        raise ValueError("Box constraints and normalization cannot be combined")
+    empty = jnp.zeros((0,), dtype=dtype)
+    solve = sharded_glm_solver(
+        task, cfg.optimizer_config, bool(cfg.l1_weight), mesh,
+        lower_bounds is not None, upper_bounds is not None,
+    )
     result = solve(
         data,
         x0,
         jnp.asarray(cfg.l2_weight, dtype=dtype),
         jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
+        empty if lower_bounds is None
+        else jax.device_put(jnp.asarray(lower_bounds, dtype=dtype), rep),
+        empty if upper_bounds is None
+        else jax.device_put(jnp.asarray(upper_bounds, dtype=dtype), rep),
         norm,
     )
     if not norm.is_identity:

@@ -3,6 +3,7 @@ from photon_ml_tpu.sampling.down_sampler import (
     DefaultDownSampler,
     DownSampler,
     down_sampler_for_task,
+    per_sample_uniform,
 )
 
 __all__ = [
@@ -10,4 +11,5 @@ __all__ = [
     "DefaultDownSampler",
     "DownSampler",
     "down_sampler_for_task",
+    "per_sample_uniform",
 ]

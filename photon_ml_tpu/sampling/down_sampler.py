@@ -9,7 +9,13 @@ the loss stays an unbiased estimate (the reference's re-weighting, :46-68).
 
 Determinism mirrors the reference's byteswap64-mixed per-partition seeds
 (BinaryClassificationDownSampler.scala:52): a fixed integer seed makes every
-down-sampled pass reproducible.
+down-sampled pass reproducible. Stronger than the reference: each sample's
+keep-draw is a pure function of (seed, call index, SAMPLE POSITION) — a
+threefry fold-in of the sample's position in the full dataset — so any
+partitioning of the rows (multi-process slices, mesh padding) reproduces the
+single-process draws exactly given the global positions, where the
+reference's per-Spark-partition seeding changes the sample with the
+partitioning.
 """
 
 from __future__ import annotations
@@ -23,6 +29,22 @@ from photon_ml_tpu.data.dataset import LabeledData
 from photon_ml_tpu.types import TaskType
 
 Array = jnp.ndarray
+
+
+def per_sample_uniform(seed: int, call: int, sample_ids: Array) -> Array:
+    """U[0,1) draw per sample, keyed by (seed, call, sample id): the draw for
+    a given sample is identical no matter which process/device holds the row
+    or where in its local block the row sits — the property multi-process
+    down-sampling parity rests on. ``sample_ids`` is any integer array; the
+    id convention is the sample's position in the single-process
+    concatenated row order."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), call)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        key, jnp.asarray(sample_ids, dtype=jnp.uint32)
+    )
+    # dtype pinned: the draw bits must not depend on the host's x64 mode
+    # (a multi-process worker and an in-process run must agree exactly)
+    return jax.vmap(lambda k: jax.random.uniform(k, (), dtype=jnp.float32))(keys)
 
 
 def is_valid_down_sampling_rate(rate: float) -> bool:
@@ -50,13 +72,29 @@ class DownSampler:
             )
         object.__setattr__(self, "_calls", 0)
 
-    def _next_key(self):
-        k = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)
-        object.__setattr__(self, "_calls", self._calls + 1)
-        return k
-
-    def down_sample(self, data: LabeledData) -> LabeledData:
+    def reweight(self, labels, weights, sample_ids, call: int) -> Array:
+        """STATELESS form of one down-sampling pass: the new weights for
+        draw index ``call`` (the per-pass counter ``down_sample`` keeps
+        internally). Multi-process runners use this directly — the call
+        index is explicit, so a checkpoint-resumed pass reproduces its
+        original draw without replaying the preceding passes."""
         raise NotImplementedError
+
+    def down_sample(self, data: LabeledData, sample_ids=None) -> LabeledData:
+        """``sample_ids``: optional per-row global positions (defaults to
+        ``arange(n)``, the single-process convention); a multi-process caller
+        passes each row's position in the full concatenated dataset so its
+        draws match the single-process run's."""
+        ids = (
+            jnp.arange(data.weights.shape[0], dtype=jnp.uint32)
+            if sample_ids is None
+            else sample_ids
+        )
+        call = self._calls
+        object.__setattr__(self, "_calls", call + 1)
+        return dataclasses.replace(
+            data, weights=self.reweight(data.labels, data.weights, ids, call)
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,12 +103,12 @@ class DefaultDownSampler(DownSampler):
     (DefaultDownSampler.scala:41). Kept weights are NOT re-scaled (matches the
     reference's plain RDD.sample)."""
 
-    def down_sample(self, data: LabeledData) -> LabeledData:
-        key = self._next_key()
-        keep = jax.random.uniform(key, data.weights.shape) < self.down_sampling_rate
-        return dataclasses.replace(
-            data, weights=jnp.where(keep, data.weights, 0.0)
+    def reweight(self, labels, weights, sample_ids, call: int) -> Array:
+        keep = (
+            per_sample_uniform(self.seed, call, sample_ids)
+            < self.down_sampling_rate
         )
+        return jnp.where(keep, weights, 0.0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,17 +117,15 @@ class BinaryClassificationDownSampler(DownSampler):
     (BinaryClassificationDownSampler.scala:46-68): positives all kept; negatives kept
     with probability rate and re-weighted by 1/rate."""
 
-    def down_sample(self, data: LabeledData) -> LabeledData:
-        key = self._next_key()
+    def reweight(self, labels, weights, sample_ids, call: int) -> Array:
         rate = self.down_sampling_rate
-        is_positive = data.labels > 0.5
-        keep_draw = jax.random.uniform(key, data.weights.shape) < rate
-        new_weights = jnp.where(
+        is_positive = labels > 0.5
+        keep_draw = per_sample_uniform(self.seed, call, sample_ids) < rate
+        return jnp.where(
             is_positive,
-            data.weights,
-            jnp.where(keep_draw, data.weights / rate, 0.0),
+            weights,
+            jnp.where(keep_draw, weights / rate, 0.0),
         )
-        return dataclasses.replace(data, weights=new_weights)
 
 
 def down_sampler_for_task(

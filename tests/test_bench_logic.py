@@ -444,3 +444,25 @@ def test_winner_roofline_lookup_decodes_variant_names():
     assert out["roofline"]["flops_per_pass"] == 1.0
     # a variant whose configuration was never measured yields no roofline
     assert bench._winner_roofline({"variant": "lbfgs_f32"}, {}, 1000.0, 100) == {}
+
+
+def test_analytic_cost_measured_re_iterations():
+    """The measured path: per-coordinate, per-bucket MAX iteration counts
+    replace the config cap (a vmapped while_loop executes max-lane iterations
+    for every lane), and the record is labeled accordingly."""
+    data = _FakeData(n=1000, d=64)
+    c = bench._analytic_cost(
+        data, fe_iters=10, re_iters=((7,),), newton=False, storage_bytes=4
+    )
+    fe_flops = 10 * 4.0 * 1000 * 64
+    re_flops = 7 * 4.0 * (10 * 16) * 8
+    score_flops = 2.0 * 1000 * 8
+    assert c["flops_per_pass"] == fe_flops + re_flops + score_flops
+    assert c["re_iterations_measured"] == [[7]]
+    assert "re_iterations_assumed" not in c
+    assert c["cost_model"] == "analytic (fe + re iters measured)"
+    # int fallback keeps the cap-labeled record
+    c2 = bench._analytic_cost(
+        data, fe_iters=10, re_iters=5, newton=False, storage_bytes=4
+    )
+    assert c2["re_iterations_assumed"] == 5

@@ -3159,6 +3159,47 @@ def test_two_process_game_hyperparameter_tuning(tmp_path):
         assert (tmp_path / "out" / "models" / str(i)).is_dir()
     assert (tmp_path / "out" / "best").is_dir()
 
+    # PER-CANDIDATE parity with the single-process driver on the same data
+    # and seeds: identical observations feed the GP, so the SAME candidates
+    # must be proposed and trained (tuned candidates cold-start in both
+    # paths), and the selected model must agree
+    _run_single_process_driver(tmp_path, "sp-tune.log", [
+        "--input-data-directories", str(tmp_path / "in"),
+        "--validation-data-directories", str(tmp_path / "val"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations",
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,"
+        "reg.weights=1.0",
+        *tuning,
+    ], timeout=420)
+    for i in (1, 2):
+        for cid in ("global", "per-user"):
+            w_sp = _spec_reg_weight(tmp_path / "out-single" / "models" / str(i), cid)
+            w_mp = _spec_reg_weight(tmp_path / "out" / "models" / str(i), cid)
+            assert w_mp == pytest.approx(w_sp, rel=1e-6), f"candidate {i} {cid}"
+    assert _spec_reg_weight(tmp_path / "out" / "best", "global") == pytest.approx(
+        _spec_reg_weight(tmp_path / "out-single" / "best", "global"), rel=1e-6
+    )
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    fe_imaps = {"global": fe_imap, "per-user": re_imap}
+    ref = load_game_model(str(tmp_path / "out-single" / "best"), fe_imaps)
+    got = load_game_model(str(tmp_path / "out" / "best"), fe_imaps)
+    np.testing.assert_allclose(
+        np.asarray(got.get_model("global").model.coefficients.means),
+        np.asarray(ref.get_model("global").model.coefficients.means),
+        atol=2e-3,
+    )
+
 
 def test_multiprocess_game_tuning_checkpoint_resume(tmp_path):
     """Checkpoint resume THROUGH hyperparameter tuning: a job killed after a
@@ -3272,7 +3313,454 @@ def test_multiprocess_game_tuning_checkpoint_resume(tmp_path):
     b = run_one(tmp_path / "out-b")
     rows_b = b["results"]
     assert len(rows_b) == 3  # NOT 4: only the remaining iteration ran
-    for ra, rb in zip(rows_a[:2], rows_b[:2]):
+    # ALL rows must match — including the RE-PROPOSED candidate 2: the tuner
+    # fast-forwards its Sobol stream past the restored candidate's draws, so
+    # the resumed run proposes the uninterrupted run's candidate 2, not a
+    # duplicate of candidate 1 (the stream position depends only on draws,
+    # never on observations)
+    for ra, rb in zip(rows_a, rows_b):
         assert ra["regularization_weight"] == rb["regularization_weight"]
         assert ra["value"] == rb["value"]
+    weights = [r["regularization_weight"]["global"] for r in rows_b]
+    assert weights[2] != weights[1]  # candidate 2 is not a re-trained candidate 1
+    assert b["best_index"] == a["best_index"]
+
+
+# --------------------------------------------------------------------------
+# round-5 additions: down-sampling, box constraints, FE-only tuning — each a
+# two-process run compared against the SINGLE-PROCESS driver run in a
+# subprocess (same f32 numeric mode as the workers; the in-process suite
+# runs x64, which would blur what is exchange drift vs dtype drift)
+
+
+def _mp_env():
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    return env
+
+
+def _run_single_process_driver(tmp_path, log_name, argv, timeout=300):
+    log_path = tmp_path / log_name
+    with open(log_path, "w+") as log:
+        p = subprocess.Popen(
+            [sys.executable, "-m", "photon_ml_tpu.cli.game_training_driver", *argv],
+            env=_mp_env(), stdout=log, stderr=subprocess.STDOUT, text=True,
+        )
+        rc = p.wait(timeout=timeout)
+    assert rc == 0, f"single-process driver failed:\n{log_path.read_text()}"
+
+
+def _run_workers(tmp_path, worker, log_prefix, extra, n=2, timeout=300):
+    port = _free_port()
+    logs = [open(tmp_path / f"{log_prefix}{i}.log", "w+") for i in range(n)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", worker),
+             str(i), str(n), str(port), str(tmp_path), *extra],
+            env=_mp_env(), stdout=logs[i], stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(n)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            rc = p.wait(timeout=timeout)
+            assert rc == 0, (
+                f"{log_prefix}{i} failed:\n"
+                + (tmp_path / f"{log_prefix}{i}.log").read_text()
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for lg in logs:
+            lg.close()
+
+
+def _spec_reg_weight(model_dir, cid):
+    """The reg weight a saved model was trained with, from model-spec.json."""
+    import json as _json
+
+    from photon_ml_tpu.cli.parsers import parse_coordinate_configuration
+
+    spec = _json.loads((model_dir / "model-spec.json").read_text())
+    _, cfg = parse_coordinate_configuration(spec[cid])
+    return (
+        cfg.reg_weights[0]
+        if cfg.reg_weights
+        else cfg.optimization_config.regularization_weight
+    )
+
+
+def _fe_classification_inputs(tmp_path, rng_seed=3, d=4, n=400):
+    """Two uneven training part files + one validation file for a logistic
+    fixed-effect run; returns the index map."""
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(rng_seed)
+    w_true = rng.normal(size=d)
+    imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    (tmp_path / "index-maps").mkdir()
+    imap.save(str(tmp_path / "index-maps" / "global.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            y = float((x @ w_true + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ],
+                "metadataMap": {},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    (tmp_path / "val").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(n // 2 + 37, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(n // 2 - 37, seed=2),
+    )
+    avro_io.write_container(
+        str(tmp_path / "val" / "part-0.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=5),
+    )
+    return imap
+
+
+def _fe_common_argv(tmp_path, out_dir, coord_config):
+    return [
+        "--input-data-directories", str(tmp_path / "in"),
+        "--validation-data-directories", str(tmp_path / "val"),
+        "--root-output-directory", str(out_dir),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global",
+        "--coordinate-configurations", coord_config,
+        "--evaluators", "AUC",
+    ]
+
+
+def _best_fe_coeffs(root, imap):
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    gm = load_game_model(str(root / "best"), {"global": imap})
+    return np.asarray(gm.get_model("global").model.coefficients.means)
+
+
+def test_two_process_fe_down_sampling_parity(tmp_path):
+    """Multi-process fixed-effect DOWN-SAMPLING (restriction lifted): the
+    keep-draws are keyed by each sample's position in the single-process
+    concatenated row order (per_sample_uniform), so a 2-process run draws
+    the SAME masks as the single-process driver — per-pass redraws, warm
+    starts and per-update validation selection included. Parity bar: the
+    saved best model matches the single-process subprocess run."""
+    imap = _fe_classification_inputs(tmp_path)
+    cc = (
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
+        "tolerance=1e-9,regularization=L2,reg.weights=0.1|10,"
+        "down.sampling.rate=0.6"
+    )
+    extra = [
+        "--coordinate-configurations", cc,
+        "--coordinate-descent-iterations", "2",
+    ]
+    _run_single_process_driver(
+        tmp_path, "sp-ds.log",
+        _fe_common_argv(tmp_path, tmp_path / "out-single", cc)
+        + ["--coordinate-descent-iterations", "2"],
+    )
+    _run_workers(tmp_path, "mp_train_worker.py", "ds", extra)
+
+    expected = _best_fe_coeffs(tmp_path / "out-single", imap)
+    got = _best_fe_coeffs(tmp_path / "out", imap)
+    # identical masks; the residual drift is f32 psum-order arithmetic on
+    # O(10) coefficients (a WRONG mask diverges by orders of magnitude)
+    np.testing.assert_allclose(got, expected, rtol=5e-4, atol=5e-4)
+    # same selected reg weight
+    assert _spec_reg_weight(tmp_path / "out" / "best", "global") == pytest.approx(
+        _spec_reg_weight(tmp_path / "out-single" / "best", "global")
+    )
+    # the masks actually did something: a no-down-sampling run differs
+    _run_workers(
+        tmp_path, "mp_train_worker.py", "nods",
+        ["--coordinate-configurations", cc.replace(",down.sampling.rate=0.6", ""),
+         "--root-output-directory", str(tmp_path / "out-nods")],
+    )
+    assert not np.allclose(
+        _best_fe_coeffs(tmp_path / "out-nods", imap), got, atol=1e-6
+    )
+
+
+def test_two_process_fe_box_constraints_parity(tmp_path):
+    """Multi-process BOX CONSTRAINTS (restriction lifted): the driver-level
+    constraint map compiles to per-feature bound vectors exactly as the
+    single-process driver (GLMSuite.createConstraintFeatureMap semantics) and
+    rides the sharded solver's native bound support. The trained model must
+    match the single-process run and respect the bounds."""
+    import json as _json
+
+    imap = _fe_classification_inputs(tmp_path, rng_seed=11)
+    constraints = _json.dumps([
+        {"name": "f0", "term": "", "lowerBound": -0.01, "upperBound": 0.01},
+        {"name": "f1", "term": "", "lowerBound": 0.0, "upperBound": 0.05},
+    ])
+    # LBFGSB: the projected-gradient active-set solver converges to the
+    # unique constrained optimum on both paths (post-step-projection LBFGS
+    # is path-dependent near active bounds)
+    cc = (
+        "name=global,feature.shard=global,optimizer=LBFGSB,max.iter=100,"
+        "tolerance=1e-9,regularization=L2,reg.weights=0.1|10"
+    )
+    _run_single_process_driver(
+        tmp_path, "sp-box.log",
+        _fe_common_argv(tmp_path, tmp_path / "out-single", cc)
+        + ["--coefficient-box-constraints", constraints],
+    )
+    _run_workers(
+        tmp_path, "mp_train_worker.py", "box",
+        ["--coordinate-configurations", cc,
+         "--coefficient-box-constraints", constraints],
+    )
+
+    expected = _best_fe_coeffs(tmp_path / "out-single", imap)
+    got = _best_fe_coeffs(tmp_path / "out", imap)
+    np.testing.assert_allclose(got, expected, atol=1e-4)
+    from photon_ml_tpu.data.index_map import feature_key
+
+    i0 = imap.get_index(feature_key("f0", ""))
+    i1 = imap.get_index(feature_key("f1", ""))
+    assert -0.01 <= got[i0] <= 0.01
+    assert 0.0 <= got[i1] <= 0.05
+    # the constraint is ACTIVE (otherwise this proves nothing); the control
+    # run drops the bounds, so it solves with plain LBFGS
+    _run_workers(
+        tmp_path, "mp_train_worker.py", "nobox",
+        ["--coordinate-configurations", cc.replace("LBFGSB", "LBFGS"),
+         "--root-output-directory", str(tmp_path / "out-nobox")],
+    )
+    free = _best_fe_coeffs(tmp_path / "out-nobox", imap)
+    assert abs(free[i0]) > 0.01 or not (0.0 <= free[i1] <= 0.05)
+
+
+def test_two_process_fe_hyperparameter_tuning_parity(tmp_path):
+    """FE-only multi-process HYPERPARAMETER TUNING (restriction lifted),
+    routed through the lockstep-GP design: every rank proposes identical
+    candidates from identical gathered observations. Per-candidate parity
+    with the single-process driver: the SAME candidate reg weights are
+    proposed and trained, and the selected model matches."""
+    imap = _fe_classification_inputs(tmp_path, rng_seed=29)
+    cc = (
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=100,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0"
+    )
+    tuning = [
+        "--hyper-parameter-tuning", "BAYESIAN",
+        "--hyper-parameter-tuning-iterations", "2",
+        "--output-mode", "ALL",
+    ]
+    _run_single_process_driver(
+        tmp_path, "sp-tune.log",
+        _fe_common_argv(tmp_path, tmp_path / "out-single", cc) + tuning,
+    )
+    _run_workers(
+        tmp_path, "mp_train_worker.py", "fetune",
+        ["--coordinate-configurations", cc, *tuning],
+    )
+
+    import json as _json
+
+    summary = _json.loads((tmp_path / "out" / "summary.json").read_text())
+    rows = summary["results"]
+    assert len(rows) == 3  # 1 grid config + 2 tuned candidates
+    assert all(r["value"] is not None for r in rows)
+    # PER-CANDIDATE parity: the tuned reg weights agree with the
+    # single-process run's (identical observations -> identical proposals)
+    for i in range(3):
+        w_sp = _spec_reg_weight(tmp_path / "out-single" / "models" / str(i), "global")
+        w_mp = _spec_reg_weight(tmp_path / "out" / "models" / str(i), "global")
+        assert w_mp == pytest.approx(w_sp, rel=1e-6), f"candidate {i}"
+    # tuned candidates actually explored beyond the grid
+    weights = [r["regularization_weight"] for r in rows]
+    assert len({round(w, 8) for w in weights}) >= 2
+    # selection parity
+    np.testing.assert_allclose(
+        _best_fe_coeffs(tmp_path / "out", imap),
+        _best_fe_coeffs(tmp_path / "out-single", imap),
+        atol=1e-4,
+    )
+
+
+def test_two_process_game_fe_down_sampling_parity(tmp_path):
+    """GAME multi-process training with fixed-effect down-sampling: the FE
+    coordinate redraws its mask per CD pass (call index = pass, sampler
+    rebuilt per config — the single-process estimator's counter), random
+    effects train on the full data, and the saved model matches the
+    single-process driver."""
+    from photon_ml_tpu.data import avro_io
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    rng = np.random.default_rng(41)
+    d, n_users = 4, 9
+    w_true = rng.normal(size=d)
+    u_eff = 1.2 * rng.normal(size=n_users)
+    fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
+    re_imap = IndexMap.build(["bias\x01"], add_intercept=False)
+    (tmp_path / "index-maps").mkdir()
+    fe_imap.save(str(tmp_path / "index-maps" / "global.npz"))
+    re_imap.save(str(tmp_path / "index-maps" / "re.npz"))
+
+    def records(n_rows, seed):
+        r = np.random.default_rng(seed)
+        for i in range(n_rows):
+            x = r.normal(size=d)
+            u = int(r.integers(0, n_users))
+            y = float((x @ w_true + u_eff[u] + 0.3 * r.normal()) > 0)
+            yield {
+                "uid": f"{seed}-{i}",
+                "label": y,
+                "features": [
+                    {"name": f"f{j}", "term": "", "value": float(x[j])}
+                    for j in range(d)
+                ] + [{"name": "bias", "term": "", "value": 1.0}],
+                "metadataMap": {"userId": f"u{u}"},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    (tmp_path / "in").mkdir()
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-a.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(190, seed=1),
+    )
+    avro_io.write_container(
+        str(tmp_path / "in" / "part-b.avro"),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=2),
+    )
+
+    ds_cc = (
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0,"
+        "down.sampling.rate=0.7"
+    )
+    _run_single_process_driver(tmp_path, "sp-gds.log", [
+        "--input-data-directories", str(tmp_path / "in"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations", ds_cc,
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,"
+        "reg.weights=1.0",
+        "--coordinate-descent-iterations", "2",
+    ])
+    # the extra --coordinate-configurations OVERRIDES the worker's built-in
+    # "global" coordinate (dict() keeps the LAST entry per name)
+    _run_workers(
+        tmp_path, "mp_game_worker.py", "gds",
+        ["--coordinate-configurations", ds_cc],
+    )
+
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    def load(root):
+        return load_game_model(
+            str(root / "best"), {"global": fe_imap, "per-user": re_imap}
+        )
+
+    ref, got = load(tmp_path / "out-single"), load(tmp_path / "out")
+    fe_ref = np.asarray(ref.get_model("global").model.coefficients.means)
+    fe_got = np.asarray(got.get_model("global").model.coefficients.means)
+    np.testing.assert_allclose(fe_got, fe_ref, atol=2e-3)
+    re_ref, re_got = ref.get_model("per-user"), got.get_model("per-user")
+    assert set(re_got.entity_ids) == set(re_ref.entity_ids)
+    for eid in re_ref.entity_ids:
+        np.testing.assert_allclose(
+            re_got.coefficients_for_entity(eid),
+            re_ref.coefficients_for_entity(eid),
+            atol=2e-3, err_msg=str(eid),
+        )
+
+
+def test_multiprocess_fe_tuning_checkpoint_resume(tmp_path):
+    """FE-only checkpoint resume THROUGH hyperparameter tuning: a job killed
+    after a tuned candidate completes resumes with only the remaining
+    iterations, reconstructs the restored tuned candidate's config from the
+    checkpoint's weight metadata (it is NOT derivable from the grid), and —
+    because the tuner fast-forwards its Sobol stream — reproduces the
+    uninterrupted run's candidates exactly."""
+    from photon_ml_tpu.cli.distributed_training import run_multiprocess_fixed_effect
+    from photon_ml_tpu.cli.game_training_driver import (
+        _load_index_maps,
+        build_arg_parser,
+    )
+    from photon_ml_tpu.cli.parsers import (
+        parse_coordinate_configuration,
+        parse_feature_shard_configuration,
+    )
+    from photon_ml_tpu.types import TaskType
+    from photon_ml_tpu.util import PhotonLogger
+
+    _fe_classification_inputs(tmp_path, rng_seed=53)
+
+    def run_one(out):
+        args = build_arg_parser().parse_args([
+            *_fe_common_argv(
+                tmp_path, out,
+                "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+                "tolerance=1e-9,regularization=L2,reg.weights=1.0",
+            ),
+            "--coordinate-descent-iterations", "1",
+            "--hyper-parameter-tuning", "BAYESIAN",
+            "--hyper-parameter-tuning-iterations", "2",
+            "--checkpoint-directory", str(tmp_path / "ckpt"),
+        ])
+        shard_configs = dict(
+            parse_feature_shard_configuration(a)
+            for a in args.feature_shard_configurations
+        )
+        coord_configs = dict(
+            parse_coordinate_configuration(a) for a in args.coordinate_configurations
+        )
+        os.makedirs(out, exist_ok=True)
+        return run_multiprocess_fixed_effect(
+            args, 0, 1, PhotonLogger(str(out / "log.txt")), str(out),
+            TaskType("LOGISTIC_REGRESSION"), coord_configs, shard_configs,
+            _load_index_maps(args.off_heap_index_map_directory, shard_configs),
+        )
+
+    a = run_one(tmp_path / "out-a")
+    rows_a = a["results"]
+    assert len(rows_a) == 3  # 1 grid + 2 tuned
+
+    # simulate death after tuned candidate 1 (config 1) completed: delete
+    # config 2's per-config checkpoint file
+    (tmp_path / "ckpt" / "mp-fe-cfg0002-r00000.npz").unlink()
+    b = run_one(tmp_path / "out-b")
+    rows_b = b["results"]
+    assert len(rows_b) == 3  # only the remaining iteration ran
+    for ra, rb in zip(rows_a, rows_b):
+        assert ra["regularization_weight"] == rb["regularization_weight"]
+        assert ra["value"] == rb["value"]
+    weights = [r["regularization_weight"] for r in rows_b]
+    assert weights[2] != weights[1]  # not a re-trained duplicate of candidate 1
     assert b["best_index"] == a["best_index"]
