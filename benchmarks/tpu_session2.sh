@@ -62,5 +62,10 @@ python benchmarks/summarize_trace.py $OUT/trace > $OUT/trace_summary.md 2>&1 || 
 # CPU at-scale denominator intentionally absent: it runs as its own
 # /tmp/cpu_bench_busy-guarded job (no tunnel needed) — see tpu_results.md.
 
+echo "=== 7. bank on-chip results into the repo tree ===" >&2
+# writes benchmarks/banked_tpu_bench.json so a driver bench capture during a
+# later tunnel outage still carries this session's on-chip evidence
+python benchmarks/bank_results.py $OUT >&2 || true
+
 echo "session2 artifacts in $OUT" >&2
 ls $OUT >&2

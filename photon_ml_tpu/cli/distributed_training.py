@@ -516,7 +516,13 @@ def _read_file_slice(
     directories, date_range, days_range, what,
     shard_configs, index_maps, id_tags, rank, nproc, logger,
 ):
-    """Round-robin file-slice ingest shared by the multi-process paths."""
+    """Round-robin file-slice ingest shared by the multi-process paths.
+
+    Returns ``(data, all_files, mine_idx)`` — the listing the ingest ACTUALLY
+    used, so the down-sampling draw-key computation (:func:`_concat_order_ids`)
+    can derive from the identical file set instead of re-listing the
+    directory (a concurrent writer between two listings would silently shift
+    every draw key)."""
     from photon_ml_tpu.data.game_data import GameInput
     from photon_ml_tpu.data.readers import read_merged_avro
     import scipy.sparse as sp
@@ -535,25 +541,23 @@ def _read_file_slice(
             features={s: sp.csr_matrix((0, index_maps[s].size)) for s in shards},
             labels=np.zeros(0),
             id_columns={t: np.zeros(0, dtype=object) for t in id_tags},
-        )
+        ), all_files, mine_idx
     data, _, _ = read_merged_avro(mine, shard_configs, index_maps, id_tags)
-    return data
+    return data, all_files, mine_idx
 
 
-def _concat_order_ids(directories, date_range, days_range, rank, nproc):
+def _concat_order_ids(all_files, mine):
     """Each LOCAL row's position in the single-process concatenated row order
     — the down-sampling draw key (sampling/down_sampler.per_sample_uniform).
 
+    ``(all_files, mine)`` is the listing the TRAINING ingest returned
+    (:func:`_read_file_slice`), so rows and draw keys agree by construction —
+    no second directory listing that a concurrent writer could shift.
     Every rank counts every part file from the container block framing alone
     (avro_io.container_row_count: O(blocks) seeks, no payload reads), so the
-    global offsets are computed identically everywhere with no exchange.
-    File assignment comes from :func:`_ranked_part_files` — the same
-    convention ingest uses, by construction."""
+    global offsets are computed identically everywhere with no exchange."""
     from photon_ml_tpu.data import avro_io
 
-    all_files, mine = _ranked_part_files(
-        directories, date_range, days_range, rank, nproc
-    )
     counts = np.asarray(
         [avro_io.container_row_count(f) for f in all_files], dtype=np.int64
     )
@@ -700,10 +704,11 @@ def run_multiprocess_fixed_effect(
 
     train = train_data = norm_ctx = None
     val = None
+    train_listing = ([], [])
     mesh = make_mesh(len(jax.devices()))
     if not fully_resumed:
         with Timed("read training data", logger):
-            train = read_slice(
+            train, *train_listing = read_slice(
                 args.input_data_directories,
                 getattr(args, "input_data_date_range", None),
                 getattr(args, "input_data_days_range", None),
@@ -723,7 +728,7 @@ def run_multiprocess_fixed_effect(
                 )
         if args.validation_data_directories:
             with Timed("read validation data", logger):
-                val = read_slice(
+                val, _, _ = read_slice(
                     args.validation_data_directories,
                     getattr(args, "validation_data_date_range", None),
                     getattr(args, "validation_data_days_range", None),
@@ -805,12 +810,8 @@ def run_multiprocess_fixed_effect(
         if bounds is not None:
             lower, upper = bounds
         if sampler_rate_active:
-            dsids_local = _concat_order_ids(
-                args.input_data_directories,
-                getattr(args, "input_data_date_range", None),
-                getattr(args, "input_data_days_range", None),
-                rank, nproc,
-            )
+            # keyed off the SAME listing the training ingest used
+            dsids_local = _concat_order_ids(*train_listing)
 
     def evaluate(coeffs):
         if val is None:
@@ -1360,7 +1361,7 @@ def run_multiprocess_game(
         )
 
     with Timed("read training data", logger):
-        train = read_slice(
+        train, *train_listing = read_slice(
             args.input_data_directories,
             getattr(args, "input_data_date_range", None),
             getattr(args, "input_data_days_range", None),
@@ -1396,15 +1397,9 @@ def run_multiprocess_game(
     )
     fe_lower, fe_upper = fe_bounds if fe_bounds is not None else (None, None)
     fe_sampler = _fe_down_sampler(fe_cfg, task)
+    # keyed off the SAME listing the training ingest used
     dsids_local = (
-        _concat_order_ids(
-            args.input_data_directories,
-            getattr(args, "input_data_date_range", None),
-            getattr(args, "input_data_days_range", None),
-            rank, nproc,
-        )
-        if fe_sampler is not None
-        else None
+        _concat_order_ids(*train_listing) if fe_sampler is not None else None
     )
 
     # ---- per-coordinate entity exchange (ingest; once) ------------------------
@@ -1509,7 +1504,7 @@ def run_multiprocess_game(
     val_coords: dict[str, RECoord] = {}
     if has_val:
         with Timed("read validation data", logger):
-            val = read_slice(
+            val, _, _ = read_slice(
                 args.validation_data_directories,
                 getattr(args, "validation_data_date_range", None),
                 getattr(args, "validation_data_days_range", None),

@@ -93,6 +93,19 @@ class RandomSearch:
     def next(self, last_candidate: np.ndarray, last_observation: float) -> np.ndarray:
         return self.draw_candidates(1)[0]
 
+    def draws_for_iterations(self, n_initial_observations: int, iterations: int) -> int:
+        """How many quasi-random draws ``iterations`` tuned candidates consume
+        given ``n_initial_observations`` at the start — the checkpoint-resume
+        fast-forward contract (tuner.py): MUST mirror ``next``'s draw policy
+        exactly, so any subclass changing the policy must override this too."""
+        return iterations
+
+    def skip_draws(self, n: int) -> None:
+        """Advance the quasi-random stream past ``n`` draws already consumed
+        by a previous (checkpointed) run."""
+        if n:
+            self._sobol.fast_forward(n)
+
     def on_observation(self, point: np.ndarray, value: float) -> None:
         pass
 
@@ -163,6 +176,17 @@ class GaussianProcessSearch(RandomSearch):
         self.last_model = estimator.fit(points, centered)
         predictions = self.last_model.predict_transformed(candidates)
         return self._select_best_candidate(candidates, predictions, transformation)
+
+    def draws_for_iterations(self, n_initial_observations: int, iterations: int) -> int:
+        # mirrors next(): 1 uniform draw while under-determined (observation
+        # count at iteration j is n_initial + j, after next()'s own
+        # on_observation), a full candidate pool afterwards
+        return sum(
+            self.candidate_pool_size
+            if n_initial_observations + j > self.num_params
+            else 1
+            for j in range(iterations)
+        )
 
     def on_observation(self, point: np.ndarray, value: float) -> None:
         self._points.append(np.asarray(point, dtype=np.float64))

@@ -54,25 +54,6 @@ class DummyTuner(HyperparameterTuner):
         return []
 
 
-def _sobol_draws_consumed(mode, dimension, n_initial_observations, iterations,
-                          candidate_pool_size):
-    """Sobol draws the first ``iterations`` tuned candidates consumed in the
-    uninterrupted run. RANDOM draws 1 per iteration. BAYESIAN draws 1 while
-    under-determined (GaussianProcessSearch.next falls back to uniform until
-    #observations > #params; at iteration j the observation count is
-    n_initial + j) and a full candidate pool afterwards."""
-    draws = 0
-    for j in range(iterations):
-        if (
-            mode == HyperparameterTuningMode.BAYESIAN
-            and n_initial_observations + j > dimension
-        ):
-            draws += candidate_pool_size
-        else:
-            draws += 1
-    return draws
-
-
 class AtlasTuner(HyperparameterTuner):
     """Dispatches RANDOM / BAYESIAN search (AtlasTuner.scala:41-60)."""
 
@@ -90,13 +71,13 @@ class AtlasTuner(HyperparameterTuner):
         searcher = cls(dimension, evaluation_function, discrete_params=discrete_params, seed=seed)
         if resumed:
             # checkpoint resume: land the quasi-random stream exactly where
-            # the uninterrupted run's iteration ``resumed`` would read it
-            skip = _sobol_draws_consumed(
-                mode, dimension, max(0, len(observations) - resumed), resumed,
-                getattr(searcher, "candidate_pool_size", 1),
+            # the uninterrupted run's iteration ``resumed`` would read it —
+            # the searcher owns its own draw-consumption policy
+            searcher.skip_draws(
+                searcher.draws_for_iterations(
+                    max(0, len(observations) - resumed), resumed
+                )
             )
-            if skip:
-                searcher._sobol.fast_forward(skip)
         # Prior observations come out of prior_from_json in RAW hyperparameter
         # space; the search operates in transformed-[0,1]^d space, so prior POINTS
         # must go through the same transform+scale the observations did

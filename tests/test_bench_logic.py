@@ -460,9 +460,58 @@ def test_analytic_cost_measured_re_iterations():
     assert c["flops_per_pass"] == fe_flops + re_flops + score_flops
     assert c["re_iterations_measured"] == [[7]]
     assert "re_iterations_assumed" not in c
-    assert c["cost_model"] == "analytic (fe + re iters measured)"
+    assert c["cost_model"] == "analytic (fe + re iters measured, mean over timed passes)"
     # int fallback keeps the cap-labeled record
     c2 = bench._analytic_cost(
         data, fe_iters=10, re_iters=5, newton=False, storage_bytes=4
     )
     assert c2["re_iterations_assumed"] == 5
+
+
+def test_bank_results_banks_only_tpu_records(tmp_path):
+    """bank_results banks flagship/at-scale records only when they actually
+    ran on TPU, stamps commit+timestamp, and computes the vs-CPU ratios
+    against the recorded denominators."""
+    import json
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bank_results",
+        os.path.join(os.path.dirname(bench.__file__), "benchmarks", "bank_results.py"),
+    )
+    bank = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bank)
+
+    out = tmp_path / "session"
+    out.mkdir()
+    (out / "bench_flagship.json").write_text(
+        json.dumps({"child_value": 1_200_000.0, "platform": "tpu",
+                    "variant": "newton_bf16"}) + "\n"
+    )
+    # a CPU-fallback at-scale record must NOT be banked
+    (out / "bench_scale200_device.json").write_text(
+        json.dumps({"child_value": 40_000.0, "platform": "cpu"}) + "\n"
+    )
+    bank_path = tmp_path / "banked.json"
+    orig = bank.BANK_PATH
+    bank.BANK_PATH = str(bank_path)
+    try:
+        assert bank.main(str(out)) == 0
+    finally:
+        bank.BANK_PATH = orig
+    rec = json.loads(bank_path.read_text())
+    assert rec["flagship"]["samples_per_sec"] == 1_200_000.0
+    assert rec["flagship"]["variant"] == "newton_bf16"
+    assert "at_scale_200" not in rec  # CPU record rejected
+    assert rec["banked_at"]
+
+    # nothing TPU at all -> nothing banked, rc 1
+    (out / "bench_flagship.json").write_text(
+        json.dumps({"child_value": 1.0, "platform": "cpu"}) + "\n"
+    )
+    bank.BANK_PATH = str(tmp_path / "b2.json")
+    try:
+        assert bank.main(str(out)) == 1
+        assert not (tmp_path / "b2.json").exists()
+    finally:
+        bank.BANK_PATH = orig
