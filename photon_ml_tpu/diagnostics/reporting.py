@@ -21,6 +21,106 @@ from typing import Optional, Sequence
 _SERIES_COLORS = ("#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd", "#8c564b")
 
 
+def _nice_ticks(lo: float, hi: float, target: int = 5) -> list:
+    """'Nice number' axis ticks covering [lo, hi] — steps of 1/2/2.5/5 x 10^k
+    (the convention xchart's axis renderer follows), at most ~target+1 of
+    them, endpoints included only when they land on the grid."""
+    import math
+
+    if not (hi > lo) or not (math.isfinite(lo) and math.isfinite(hi)):
+        return [lo]
+    raw = (hi - lo) / max(target, 1)
+    mag = 10.0 ** math.floor(math.log10(raw))
+    for mult in (1.0, 2.0, 2.5, 5.0, 10.0):
+        step = mult * mag
+        if step >= raw:
+            break
+    first = math.ceil(lo / step) * step
+    # index-based, not accumulation: a step below one ulp of the endpoints
+    # (values one ulp apart pass the hi > lo guard) would never advance an
+    # accumulating `t += step` — an infinite loop inside report rendering
+    n = int(min(math.floor((hi - first) / step + 1e-9), 2 * target + 2)) + 1
+    if n < 1 or first + step == first:
+        return [lo, hi]
+    ticks = [first + i * step for i in range(n)]
+    return [0.0 if abs(t) < 1e-12 * step else t for t in ticks]
+
+
+def _axes_and_grid(parts, width, height, pad, title, x_label, y_label,
+                   sx=None, x_ticks=(), sy=None, y_ticks=()):
+    """Shared chart furniture: title, frame, axis labels, and tick labels
+    with light gridlines (PlotUtils.scala axis-range quality, inline-SVG
+    form). Appends to ``parts`` in background order — call before marks."""
+    parts.append(
+        f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'
+        f"{_html.escape(title)}</text>"
+    )
+    parts.append(
+        f'<text x="{width/2:.0f}" y="{height-6}" text-anchor="middle" font-size="12">'
+        f"{_html.escape(x_label)}</text>"
+    )
+    parts.append(
+        f'<text x="14" y="{height/2:.0f}" text-anchor="middle" font-size="12" '
+        f'transform="rotate(-90 14 {height/2:.0f})">{_html.escape(y_label)}</text>'
+    )
+    if sy is not None:
+        for t in y_ticks:
+            y = sy(t)
+            parts.append(
+                f'<line x1="{pad}" y1="{y:.1f}" x2="{width-pad}" y2="{y:.1f}" '
+                'stroke="#ddd" stroke-width="1"/>'
+            )
+            parts.append(
+                f'<text x="{pad-6}" y="{y+3.5:.1f}" font-size="10" '
+                f'text-anchor="end">{t:.4g}</text>'
+            )
+    if sx is not None:
+        for t in x_ticks:
+            x = sx(t)
+            parts.append(
+                f'<line x1="{x:.1f}" y1="{pad}" x2="{x:.1f}" y2="{height-pad}" '
+                'stroke="#eee" stroke-width="1"/>'
+            )
+            parts.append(
+                f'<text x="{x:.1f}" y="{height-pad+14}" font-size="10" '
+                f'text-anchor="middle">{t:.4g}</text>'
+            )
+    # frame on top of the gridlines
+    parts.append(
+        f'<line x1="{pad}" y1="{height-pad}" x2="{width-pad}" y2="{height-pad}" stroke="#333"/>'
+    )
+    parts.append(
+        f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{height-pad}" stroke="#333"/>'
+    )
+
+
+def _legend(parts, series_labels, width, pad):
+    """In-plot legend, top-right: color swatch + label per series (the old
+    right-margin text rendered outside the viewport and was clipped).
+    Swatch colors key on the series' ORIGINAL index — marks are colored by
+    unfiltered position, so skipping an empty-labeled series must not shift
+    its neighbours' colors."""
+    entries = [(i, str(l)) for i, l in enumerate(series_labels) if str(l)]
+    if not entries:
+        return
+    box_w = 10 + 7 * max(len(l) for _, l in entries) + 24
+    x0 = width - pad - box_w - 4
+    y0 = pad + 4
+    parts.append(
+        f'<rect x="{x0}" y="{y0}" width="{box_w}" height="{4 + 16*len(entries)}" '
+        'fill="white" fill-opacity="0.85" stroke="#ccc"/>'
+    )
+    for row, (i, label) in enumerate(entries):
+        color = _SERIES_COLORS[i % len(_SERIES_COLORS)]
+        yy = y0 + 14 + 16 * row
+        parts.append(
+            f'<rect x="{x0+6}" y="{yy-8}" width="12" height="8" fill="{color}"/>'
+        )
+        parts.append(
+            f'<text x="{x0+24}" y="{yy}" font-size="11">{_html.escape(label)}</text>'
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class SimpleText:
     text: str
@@ -70,30 +170,18 @@ class LineChart:
         colors = _SERIES_COLORS
         parts = [
             f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">',
-            f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'
-            f"{_html.escape(self.title)}</text>",
-            f'<line x1="{pad}" y1="{height-pad}" x2="{width-pad}" y2="{height-pad}" stroke="#333"/>',
-            f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{height-pad}" stroke="#333"/>',
-            f'<text x="{width/2:.0f}" y="{height-8}" text-anchor="middle" font-size="12">'
-            f"{_html.escape(self.x_label)}</text>",
-            f'<text x="14" y="{height/2:.0f}" text-anchor="middle" font-size="12" '
-            f'transform="rotate(-90 14 {height/2:.0f})">{_html.escape(self.y_label)}</text>',
-            # axis extremes
-            f'<text x="{pad}" y="{height-pad+14}" font-size="10">{x0:.3g}</text>',
-            f'<text x="{width-pad}" y="{height-pad+14}" font-size="10" text-anchor="end">{x1:.3g}</text>',
-            f'<text x="{pad-4}" y="{height-pad}" font-size="10" text-anchor="end">{y0:.3g}</text>',
-            f'<text x="{pad-4}" y="{pad+4}" font-size="10" text-anchor="end">{y1:.3g}</text>',
         ]
+        _axes_and_grid(
+            parts, width, height, pad, self.title, self.x_label, self.y_label,
+            sx=sx, x_ticks=_nice_ticks(x0, x1), sy=sy, y_ticks=_nice_ticks(y0, y1),
+        )
         for i, (label, xs, ys) in enumerate(self.series):
             color = colors[i % len(colors)]
             pts = " ".join(f"{sx(x):.1f},{sy(y):.1f}" for x, y in zip(xs, ys))
             parts.append(
                 f'<polyline fill="none" stroke="{color}" stroke-width="2" points="{pts}"/>'
             )
-            parts.append(
-                f'<text x="{width-pad+4}" y="{pad + 16*i}" font-size="11" fill="{color}">'
-                f"{_html.escape(str(label))}</text>"
-            )
+        _legend(parts, [label for label, _, _ in self.series], width, pad)
         parts.append("</svg>")
         return "".join(parts)
 
@@ -136,17 +224,15 @@ class BarChart:
         colors = _SERIES_COLORS
         parts = [
             f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">',
-            f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'
-            f"{_html.escape(self.title)}</text>",
-            f'<line x1="{pad}" y1="{sy(0.0):.1f}" x2="{width-pad}" y2="{sy(0.0):.1f}" stroke="#333"/>',
-            f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{height-pad}" stroke="#333"/>',
-            f'<text x="{width/2:.0f}" y="{height-8}" text-anchor="middle" font-size="12">'
-            f"{_html.escape(self.x_label)}</text>",
-            f'<text x="14" y="{height/2:.0f}" text-anchor="middle" font-size="12" '
-            f'transform="rotate(-90 14 {height/2:.0f})">{_html.escape(self.y_label)}</text>',
-            f'<text x="{pad-4}" y="{sy(y0)+4:.1f}" font-size="10" text-anchor="end">{y0:.3g}</text>',
-            f'<text x="{pad-4}" y="{pad+4}" font-size="10" text-anchor="end">{y1:.3g}</text>',
         ]
+        _axes_and_grid(
+            parts, width, height, pad, self.title, self.x_label, self.y_label,
+            sy=sy, y_ticks=_nice_ticks(y0, y1),
+        )
+        # the bar baseline (y=0) sits wherever the range puts it
+        parts.append(
+            f'<line x1="{pad}" y1="{sy(0.0):.1f}" x2="{width-pad}" y2="{sy(0.0):.1f}" stroke="#333"/>'
+        )
         for gi, x in enumerate(xs_all):
             gx = pad + gi * group_w + group_w * 0.1
             parts.append(
@@ -162,10 +248,7 @@ class BarChart:
                     f'<rect x="{gx:.1f}" y="{top:.1f}" width="{bar_w:.1f}" '
                     f'height="{max(base-top, 0.5):.1f}" fill="{color}" fill-opacity="0.8"/>'
                 )
-            parts.append(
-                f'<text x="{width-pad+4}" y="{pad + 16*si}" font-size="11" fill="{color}">'
-                f"{_html.escape(str(label))}</text>"
-            )
+        _legend(parts, [label for label, _, _ in self.series], width, pad)
         parts.append("</svg>")
         return "".join(parts)
 
@@ -202,19 +285,11 @@ class ScatterChart:
         colors = _SERIES_COLORS
         parts = [
             f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" height="{height}">',
-            f'<text x="{width/2:.0f}" y="18" text-anchor="middle" font-weight="bold">'
-            f"{_html.escape(self.title)}</text>",
-            f'<line x1="{pad}" y1="{height-pad}" x2="{width-pad}" y2="{height-pad}" stroke="#333"/>',
-            f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{height-pad}" stroke="#333"/>',
-            f'<text x="{width/2:.0f}" y="{height-8}" text-anchor="middle" font-size="12">'
-            f"{_html.escape(self.x_label)}</text>",
-            f'<text x="14" y="{height/2:.0f}" text-anchor="middle" font-size="12" '
-            f'transform="rotate(-90 14 {height/2:.0f})">{_html.escape(self.y_label)}</text>',
-            f'<text x="{pad}" y="{height-pad+14}" font-size="10">{x0:.3g}</text>',
-            f'<text x="{width-pad}" y="{height-pad+14}" font-size="10" text-anchor="end">{x1:.3g}</text>',
-            f'<text x="{pad-4}" y="{height-pad}" font-size="10" text-anchor="end">{y0:.3g}</text>',
-            f'<text x="{pad-4}" y="{pad+4}" font-size="10" text-anchor="end">{y1:.3g}</text>',
         ]
+        _axes_and_grid(
+            parts, width, height, pad, self.title, self.x_label, self.y_label,
+            sx=sx, x_ticks=_nice_ticks(x0, x1), sy=sy, y_ticks=_nice_ticks(y0, y1),
+        )
         for i, (label, xs, ys) in enumerate(self.series):
             color = colors[i % len(colors)]
             for x, y in zip(xs, ys):
@@ -222,10 +297,7 @@ class ScatterChart:
                     f'<circle cx="{sx(x):.1f}" cy="{sy(y):.1f}" r="2.5" '
                     f'fill="{color}" fill-opacity="0.6"/>'
                 )
-            parts.append(
-                f'<text x="{width-pad+4}" y="{pad + 16*i}" font-size="11" fill="{color}">'
-                f"{_html.escape(str(label))}</text>"
-            )
+        _legend(parts, [label for label, _, _ in self.series], width, pad)
         parts.append("</svg>")
         return "".join(parts)
 

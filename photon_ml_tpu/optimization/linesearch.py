@@ -74,17 +74,39 @@ def strong_wolfe(
     g0: Array,
     dphi0: Array,
     init_alpha: Array,
-    max_iters: int = 30,
+    max_iters: int = 15,
 ) -> LineSearchResult:
     """Find alpha satisfying the strong Wolfe conditions.
 
     ``phi(a)`` must return (f(x+ad), grad(x+ad), dphi(a) = grad.d); ``g0`` is the
     full gradient at alpha = 0, so a total failure returns the consistent triple
     (alpha=0, f0, g0). ``dphi0`` must be negative (descent direction).
+
+    Degenerate-descent early-out: when even the bracketing phase's maximal
+    alpha expansion (2^max_iters) cannot turn ``|dphi0|`` into a decrease
+    visible at f0's float RESOLUTION (one ulp), no trial can measurably
+    satisfy Armijo — the search would thrash bracketing/zoom for the full
+    budget and report whatever the fallback holds. Such calls return
+    immediately as a SUCCESSFUL no-op (alpha=0, f0, g0): the iterate is at
+    the objective's float resolution, which the caller's convergence check
+    then reads as FUNCTION_VALUES_CONVERGED. The 2^max_iters headroom keeps
+    badly SCALED directions searchable (a collapsed quasi-Newton gamma can
+    make dphi0 sub-ulp while the gradient is large — alpha expansion
+    recovers those), while truly converged lanes sit many orders of
+    magnitude below even the scaled threshold. This matters doubly for
+    vmapped batched solves (the random-effect regime): one while_loop body
+    runs max-lane iterations, so a single already-converged lane otherwise
+    drags EVERY lane through ~max_iters wasted evaluations per outer step —
+    the measured latency floor of the flagship pass
+    (benchmarks/trace_summary_tpu.md).
     """
 
     dtype = f0.dtype
     big = jnp.asarray(jnp.inf, dtype)
+    fin = jnp.finfo(dtype)
+    searchable = dphi0 < -(
+        fin.eps * jnp.maximum(jnp.abs(f0), fin.tiny) / 2.0 ** min(max_iters, 60)
+    )
 
     def mk(stage, i, a, f_a, g_a, dphi_a, a_lo, f_lo, dphi_lo, a_hi, f_hi, dphi_hi, a_best, f_best, g_best):
         return _State(
@@ -93,12 +115,18 @@ def strong_wolfe(
             a_best, f_best, g_best,
         )
 
-    a1 = jnp.asarray(init_alpha, dtype)
-    f_a1, g_a1, dphi_a1 = phi(a1)
     zero = jnp.zeros((), dtype)
+    # unsearchable lanes trial alpha=0 (an exact no-op point) and start DONE
+    a1 = jnp.where(searchable, jnp.asarray(init_alpha, dtype), zero)
+    f_a1, g_a1, dphi_a1 = phi(a1)
+    f_a1 = jnp.where(searchable, f_a1, f0)
+    dphi_a1 = jnp.where(searchable, dphi_a1, dphi0)
+    g_a1 = jax.tree.map(
+        lambda gn, g_0: jnp.where(searchable, gn, g_0), g_a1, g0
+    )
     # best-so-far starts at alpha = 0; the first body pass folds in the a1 trial.
     st = mk(
-        _BRACKETING, 1, a1, f_a1, g_a1, dphi_a1,
+        jnp.where(searchable, _BRACKETING, _DONE), 1, a1, f_a1, g_a1, dphi_a1,
         zero, f0, dphi0,  # lo starts at 0
         big, big, big,
         zero, f0, g0,
@@ -215,7 +243,7 @@ def backtracking_armijo(
     f0: Array,
     dphi0: Array,
     init_alpha: Array,
-    max_iters: int = 30,
+    max_iters: int = 15,
     shrink: float = 0.5,
 ) -> LineSearchResult:
     """Armijo backtracking (used by OWLQN / projected LBFGSB line searches, where the
@@ -223,9 +251,19 @@ def backtracking_armijo(
 
     ``phi(a)`` returns (f, grad) at the trial point; dphi0 is the initial directional
     derivative of the (possibly pseudo-) gradient.
+
+    Shares strong_wolfe's degenerate-descent early-out: when ``|dphi0|`` is
+    below the float resolution of f0, the first trial is alpha=0 (an exact
+    no-op whose Armijo test passes trivially) so the loop never runs —
+    batched solves stop paying max-lane backtracking for converged lanes.
+    (Backtracking only SHRINKS alpha, so no expansion headroom is needed in
+    the threshold; init_alpha <= 1 for every caller.)
     """
 
-    f1, g1 = phi(init_alpha)
+    fin = jnp.finfo(f0.dtype)
+    searchable = dphi0 < -(fin.eps * jnp.maximum(jnp.abs(f0), fin.tiny))
+    a1 = jnp.where(searchable, jnp.asarray(init_alpha, f0.dtype), 0.0)
+    f1, g1 = phi(a1)
 
     def cond(st):
         a, f_a, g_a, i = st
@@ -237,6 +275,6 @@ def backtracking_armijo(
         f_n, g_n = phi(a)
         return (a, f_n, g_n, i + 1)
 
-    a, f_a, g_a, i = lax.while_loop(cond, body, (jnp.asarray(init_alpha, f0.dtype), f1, g1, jnp.asarray(1, jnp.int32)))
+    a, f_a, g_a, i = lax.while_loop(cond, body, (a1, f1, g1, jnp.asarray(1, jnp.int32)))
     success = f_a <= f0 + C1 * a * dphi0
     return LineSearchResult(alpha=jnp.where(success, a, 0.0), value=jnp.where(success, f_a, f0), grad=g_a, success=success, evals=i)

@@ -259,3 +259,48 @@ class TestReporting:
         assert "Hosmer-Lemeshow" in text
         assert "<table>" in html and "<svg" in html
         assert "Fit Analysis" in html and "Metric Plots" in html
+
+
+class TestChartFurniture:
+    """Round-5 presentation polish: nice-number axis ticks with gridlines and
+    an in-plot legend on every chart type (the old legend text rendered past
+    the right edge of the SVG viewport and was clipped)."""
+
+    def test_nice_ticks(self):
+        from photon_ml_tpu.diagnostics.reporting import _nice_ticks
+
+        t = _nice_ticks(0.0, 1.0)
+        assert t[0] >= 0.0 and t[-1] <= 1.0 + 1e-9
+        assert 3 <= len(t) <= 7
+        steps = {round(b - a, 12) for a, b in zip(t, t[1:])}
+        assert len(steps) == 1  # uniform step
+        # zero lands exactly on the grid when the range crosses it
+        t2 = _nice_ticks(-3.0, 7.0)
+        assert 0.0 in t2
+        # degenerate range does not explode
+        assert _nice_ticks(2.0, 2.0) == [2.0]
+
+    def test_line_chart_has_ticks_and_legend(self):
+        from photon_ml_tpu.diagnostics.reporting import LineChart
+
+        svg = LineChart(
+            "t", "x", "y",
+            [("series-a", [0, 1, 2], [0.0, 0.5, 1.0]),
+             ("series-b", [0, 1, 2], [1.0, 0.5, 0.0])],
+        ).to_svg()
+        assert svg.count('stroke="#ddd"') >= 3  # y gridlines
+        assert svg.count('stroke="#eee"') >= 3  # x gridlines
+        assert "series-a" in svg and "series-b" in svg
+        assert 'fill-opacity="0.85"' in svg  # legend box inside the plot
+        # legend swatches use the series palette
+        assert svg.count('fill="#1f77b4"') >= 1 and svg.count('fill="#ff7f0e"') >= 1
+
+    def test_bar_and_scatter_furniture(self):
+        from photon_ml_tpu.diagnostics.reporting import BarChart, ScatterChart
+
+        bar = BarChart("t", "x", "y", [("s", [1.0, 2.0], [3.0, -1.0])]).to_svg()
+        assert bar.count('stroke="#ddd"') >= 3
+        assert "<rect" in bar
+        sc = ScatterChart("t", "x", "y", [("s", [0.0, 5.0], [1.0, 2.0])]).to_svg()
+        assert sc.count('stroke="#ddd"') >= 3 and sc.count('stroke="#eee"') >= 3
+        assert "<circle" in sc

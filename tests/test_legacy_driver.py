@@ -130,6 +130,13 @@ class TestLegacyDriver:
         # summary chapter content: best lambda per metric + charts
         assert "best:" in html and "@ lambda" in html
         assert "<svg" in html and "<table>" in html
+        # chart furniture (round-5 presentation parity with xchart renders):
+        # nice-number tick gridlines and an in-plot legend box with swatches
+        assert 'stroke="#ddd"' in html  # y gridlines
+        assert 'fill-opacity="0.85"' in html  # legend background box
+        # more than min/max labels on an axis: at least 3 tick texts share
+        # the gridline count
+        assert html.count('stroke="#ddd"') >= 3
 
     def test_linear_task_with_constraints(self, rng, tmp_path):
         constraints = json.dumps(
