@@ -61,8 +61,6 @@ def multiprocess_fe_ineligibilities(args, coord_configs, index_maps) -> list[str
         reasons.append("partial retrain with locked coordinates")
     if getattr(args, "compute_backend", "host") != "host":
         reasons.append("--compute-backend (the multi-process mesh is implicit)")
-    if getattr(args, "data_summary_directory", None):
-        reasons.append("--data-summary-directory")
     if getattr(args, "evaluators", None):
         try:
             _resolve_validation_evaluators(args, args.training_task)
@@ -700,7 +698,12 @@ def run_multiprocess_fixed_effect(
         n_resumed = ckpt.resume_count(n_total)
         if n_resumed:
             logger.info("resuming from checkpoint: %d configs done", n_resumed)
-    fully_resumed = n_resumed == n_total
+    # the data-summary artifact is recomputed every run (single-process
+    # semantics), so a summary-writing run must ingest even when every
+    # config resumed from checkpoint
+    fully_resumed = n_resumed == n_total and not getattr(
+        args, "data_summary_directory", None
+    )
 
     train = train_data = norm_ctx = None
     val = None
@@ -737,9 +740,10 @@ def run_multiprocess_fixed_effect(
         train_data, _ = _assemble_global(train, shard, mesh, logger)
 
         # global statistics -> transformed-space solves with original-space
-        # coefficients in/out, exactly the single-process contract
+        # coefficients in/out, exactly the single-process contract (+ the
+        # --data-summary-directory artifact from the same stats pass)
         norm_ctx = _build_norm_contexts(
-            args, train, [shard], index_maps, logger
+            args, train, [shard], index_maps, logger, rank
         ).get(shard)
 
     from photon_ml_tpu.parallel import train_glm_sharded
@@ -1376,11 +1380,12 @@ def run_multiprocess_game(
             )
     # one global NormalizationContext per DISTINCT shard (FE + RE): statistics
     # reduce over each process's HOME rows, so the union covers every sample
-    # exactly once regardless of the entity exchange that follows
+    # exactly once regardless of the entity exchange that follows (+ the
+    # --data-summary-directory artifact from the same stats pass)
     norm_ctxs = _build_norm_contexts(
         args, train,
         sorted({coord_configs[c].data_config.feature_shard_id for c in coord_ids}),
-        index_maps, logger,
+        index_maps, logger, rank,
     )
     mesh = make_mesh(len(jax.devices()))
     fe_train, layout = _assemble_global(train, fe_shard, mesh, logger)
@@ -2119,24 +2124,47 @@ def dataclasses_replace_offsets(data, offsets):
     return _dc.replace(data, offsets=offsets)
 
 
-def _build_norm_contexts(args, train, shard_ids, index_maps, logger) -> dict:
+def _build_norm_contexts(args, train, shard_ids, index_maps, logger, rank=0) -> dict:
     """{shard: NormalizationContext} from GLOBAL statistics for each shard —
     the one construction both multi-process runners share. Empty when
-    normalization is off. ``shard_ids`` must be identically ordered on every
-    rank (the stats allgather is a collective)."""
+    normalization is off.
+
+    ``--data-summary-directory`` rides the same pass: each needed shard's
+    statistics are reduced ONCE (per-rank column sums meeting in a host
+    allgather) and feed both the normalization context and the per-shard
+    FeatureSummarizationResultAvro (game_training_driver.py:407-417 /
+    ModelProcessingUtils.writeBasicStatistics:516-606; rank 0 writes).
+    The shard iteration order is deterministic (sorted) — EVERY rank must
+    execute the collectives identically."""
     norm_type = NormalizationType(args.normalization)
-    if norm_type == NormalizationType.NONE:
+    summary_dir = getattr(args, "data_summary_directory", None)
+    if norm_type == NormalizationType.NONE and not summary_dir:
         return {}
     from photon_ml_tpu.normalization import NormalizationContext
     from photon_ml_tpu.util.timed import Timed
 
+    norm_shards = set(shard_ids) if norm_type != NormalizationType.NONE else set()
+    # the summary covers every configured shard, as single-process does
+    shards = sorted(norm_shards | (set(train.features) if summary_dir else set()))
     out = {}
-    for shard_id in shard_ids:
+    for shard_id in shards:
         with Timed(f"global feature statistics [{shard_id}]", logger):
             stats = _global_feature_stats(
                 train, shard_id, index_maps[shard_id].intercept_index
             )
-        out[shard_id] = NormalizationContext.build(norm_type, stats)
+        if summary_dir and rank == 0:
+            from photon_ml_tpu.cli.game_training_driver import (
+                SUMMARY_FILE,
+                _write_feature_summary,
+            )
+
+            os.makedirs(summary_dir, exist_ok=True)
+            _write_feature_summary(
+                os.path.join(summary_dir, f"{shard_id}-{SUMMARY_FILE}"),
+                shard_id, index_maps[shard_id], stats,
+            )
+        if shard_id in norm_shards:
+            out[shard_id] = NormalizationContext.build(norm_type, stats)
     return out
 
 

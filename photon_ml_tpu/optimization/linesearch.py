@@ -104,9 +104,12 @@ def strong_wolfe(
     dtype = f0.dtype
     big = jnp.asarray(jnp.inf, dtype)
     fin = jnp.finfo(dtype)
-    searchable = dphi0 < -(
-        fin.eps * jnp.maximum(jnp.abs(f0), fin.tiny) / 2.0 ** min(max_iters, 60)
-    )
+    # ~(>=), not (<): a NaN dphi0 must stay SEARCHABLE so it reaches the
+    # failure path (a no-op "success" would report convergence at a NaN
+    # gradient); a non-finite f0 likewise searches — any finite trial
+    # trivially satisfies Armijo against inf and escapes in one step
+    thresh = fin.eps * jnp.maximum(jnp.abs(f0), fin.tiny) / 2.0 ** min(max_iters, 60)
+    searchable = ~(dphi0 >= -thresh) | ~jnp.isfinite(f0)
 
     def mk(stage, i, a, f_a, g_a, dphi_a, a_lo, f_lo, dphi_lo, a_hi, f_hi, dphi_hi, a_best, f_best, g_best):
         return _State(
@@ -261,7 +264,10 @@ def backtracking_armijo(
     """
 
     fin = jnp.finfo(f0.dtype)
-    searchable = dphi0 < -(fin.eps * jnp.maximum(jnp.abs(f0), fin.tiny))
+    # same NaN/inf handling as strong_wolfe: non-finite states must search
+    searchable = ~(
+        dphi0 >= -(fin.eps * jnp.maximum(jnp.abs(f0), fin.tiny))
+    ) | ~jnp.isfinite(f0)
     a1 = jnp.where(searchable, jnp.asarray(init_alpha, f0.dtype), 0.0)
     f1, g1 = phi(a1)
 

@@ -3764,3 +3764,48 @@ def test_multiprocess_fe_tuning_checkpoint_resume(tmp_path):
     weights = [r["regularization_weight"] for r in rows_b]
     assert weights[2] != weights[1]  # not a re-trained duplicate of candidate 1
     assert b["best_index"] == a["best_index"]
+
+
+def test_multiprocess_data_summary_matches_single_process(tmp_path):
+    """--data-summary-directory in the multi-process FE runner (restriction
+    lifted): the per-shard FeatureSummarizationResultAvro is computed from
+    the GLOBAL statistics (per-rank column sums meeting in an allgather) and
+    must match the single-process driver's file feature by feature."""
+    from photon_ml_tpu.data import avro_io
+
+    _fe_classification_inputs(tmp_path, rng_seed=71)
+    cc = (
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=60,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0"
+    )
+    _run_single_process_driver(
+        tmp_path, "sp-summary.log",
+        _fe_common_argv(tmp_path, tmp_path / "out-single", cc)
+        + ["--data-summary-directory", str(tmp_path / "summary-single")],
+    )
+    _run_workers(
+        tmp_path, "mp_train_worker.py", "summ",
+        ["--coordinate-configurations", cc,
+         "--data-summary-directory", str(tmp_path / "summary-mp")],
+    )
+
+    def read_summary(d):
+        recs = {}
+        for rec in avro_io.read_container(
+            str(d / "global-feature-summary.avro")
+        ):
+            recs[(rec["featureName"], rec["featureTerm"])] = rec["metrics"]
+        return recs
+
+    sp = read_summary(tmp_path / "summary-single")
+    mp = read_summary(tmp_path / "summary-mp")
+    assert set(mp) == set(sp) and len(sp) == 5  # 4 features + intercept
+    for key, m_sp in sp.items():
+        m_mp = mp[key]
+        assert set(m_mp) == set(m_sp)
+        for metric, v in m_sp.items():
+            # bounded by f32-input summation order (the two paths reduce in
+            # different orders), not by stats correctness
+            assert m_mp[metric] == pytest.approx(v, rel=1e-5, abs=1e-9), (
+                key, metric
+            )
