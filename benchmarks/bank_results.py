@@ -30,10 +30,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BANK_PATH = os.path.join(REPO, "benchmarks", "banked_tpu_bench.json")
 
 # Same-machine CPU denominators for the at-scale shape (benchmarks/
-# tpu_results.md round-3 section): the device-builder run is the
-# apples-to-apples denominator for the --device-data TPU measurement.
-CPU_1CORE_SCALE200_DEVICE = 45905.67
-CPU_1CORE_SCALE200_HOST = 26759.40
+# tpu_results.md): the device-builder run is the apples-to-apples
+# denominator for the --device-data TPU measurement. Round-5 value,
+# re-measured at post-line-search-fix HEAD (the round-3 value was 45,906 —
+# the same code speedup nearly doubled the CPU denominator too).
+CPU_1CORE_SCALE200_DEVICE = 87853.87
 
 
 def _load_tpu_json(path):

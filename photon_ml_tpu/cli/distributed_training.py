@@ -33,9 +33,10 @@ import numpy as np
 from photon_ml_tpu.types import NormalizationType, TaskType
 
 MULTIPROC_DESIGN_POINTER = (
-    "multi-process training currently covers a single fixed-effect "
-    "coordinate; random-effect coordinates need the cross-process entity "
-    "exchange designed in docs/DISTRIBUTED.md"
+    "the fixed-effect-only multi-process runner covers exactly ONE "
+    "fixed-effect coordinate (configurations with random effects route to "
+    "the GAME runner's entity exchange; MULTIPLE fixed-effect coordinates "
+    "have no multi-process path — docs/DISTRIBUTED.md)"
 )
 
 
@@ -698,18 +699,20 @@ def run_multiprocess_fixed_effect(
         n_resumed = ckpt.resume_count(n_total)
         if n_resumed:
             logger.info("resuming from checkpoint: %d configs done", n_resumed)
+    fully_resumed = n_resumed == n_total
     # the data-summary artifact is recomputed every run (single-process
-    # semantics), so a summary-writing run must ingest even when every
-    # config resumed from checkpoint
-    fully_resumed = n_resumed == n_total and not getattr(
-        args, "data_summary_directory", None
+    # semantics): a FULLY-resumed summary-writing run still reads the
+    # training slice and runs the stats pass, but skips everything else
+    # (validation read, device assembly — zero configs will train)
+    summary_only = fully_resumed and bool(
+        getattr(args, "data_summary_directory", None)
     )
 
     train = train_data = norm_ctx = None
     val = None
     train_listing = ([], [])
     mesh = make_mesh(len(jax.devices()))
-    if not fully_resumed:
+    if not fully_resumed or summary_only:
         with Timed("read training data", logger):
             train, *train_listing = read_slice(
                 args.input_data_directories,
@@ -729,7 +732,7 @@ def run_multiprocess_fixed_effect(
                     feature_shards=train.features,
                     validation_type=DataValidationType(args.data_validation),
                 )
-        if args.validation_data_directories:
+        if args.validation_data_directories and not fully_resumed:
             with Timed("read validation data", logger):
                 val, _, _ = read_slice(
                     args.validation_data_directories,
@@ -737,7 +740,8 @@ def run_multiprocess_fixed_effect(
                     getattr(args, "validation_data_days_range", None),
                     "validation",
                 )
-        train_data, _ = _assemble_global(train, shard, mesh, logger)
+        if not fully_resumed:
+            train_data, _ = _assemble_global(train, shard, mesh, logger)
 
         # global statistics -> transformed-space solves with original-space
         # coefficients in/out, exactly the single-process contract (+ the
@@ -2158,7 +2162,6 @@ def _build_norm_contexts(args, train, shard_ids, index_maps, logger, rank=0) -> 
                 _write_feature_summary,
             )
 
-            os.makedirs(summary_dir, exist_ok=True)
             _write_feature_summary(
                 os.path.join(summary_dir, f"{shard_id}-{SUMMARY_FILE}"),
                 shard_id, index_maps[shard_id], stats,

@@ -184,7 +184,12 @@ def minimize_lbfgs(
             st.k == 0, jnp.minimum(1.0, 1.0 / jnp.where(gnorm > 0, gnorm, 1.0)), 1.0
         ).astype(dtype)
         ls = linesearch.strong_wolfe(
-            phi, st.f, st.g, dphi0, init_alpha, max_iters=max_line_search_iterations
+            phi, st.f, st.g, dphi0, init_alpha,
+            max_iters=max_line_search_iterations,
+            # a batched outer loop freezes converged lanes' carries but still
+            # computes their bodies: without this mask a converged lane's
+            # stale-state search sets the inner trip count every iteration
+            active=st.reason == ConvergenceReason.NOT_CONVERGED,
         )
 
         step = ls.alpha * direction

@@ -75,6 +75,7 @@ def strong_wolfe(
     dphi0: Array,
     init_alpha: Array,
     max_iters: int = 15,
+    active=None,
 ) -> LineSearchResult:
     """Find alpha satisfying the strong Wolfe conditions.
 
@@ -99,6 +100,14 @@ def strong_wolfe(
     drags EVERY lane through ~max_iters wasted evaluations per outer step —
     the measured latency floor of the flagship pass
     (benchmarks/trace_summary_tpu.md).
+
+    ``active`` (optional bool): the caller's own keep-iterating mask. A
+    batched outer while_loop FREEZES a converged lane's carry but still
+    computes its body — including this inner search, whose stale-state
+    thrash would otherwise set the inner loop's max-lane trip count every
+    outer iteration. Inactive lanes return the no-op immediately; their
+    results are discarded by the outer freeze anyway, so this cannot change
+    any converging lane's numerics.
     """
 
     dtype = f0.dtype
@@ -110,6 +119,8 @@ def strong_wolfe(
     # trivially satisfies Armijo against inf and escapes in one step
     thresh = fin.eps * jnp.maximum(jnp.abs(f0), fin.tiny) / 2.0 ** min(max_iters, 60)
     searchable = ~(dphi0 >= -thresh) | ~jnp.isfinite(f0)
+    if active is not None:
+        searchable = searchable & active
 
     def mk(stage, i, a, f_a, g_a, dphi_a, a_lo, f_lo, dphi_lo, a_hi, f_hi, dphi_hi, a_best, f_best, g_best):
         return _State(
@@ -248,6 +259,7 @@ def backtracking_armijo(
     init_alpha: Array,
     max_iters: int = 15,
     shrink: float = 0.5,
+    active=None,
 ) -> LineSearchResult:
     """Armijo backtracking (used by OWLQN / projected LBFGSB line searches, where the
     directional derivative of the projected path is not smooth enough for Wolfe).
@@ -268,6 +280,8 @@ def backtracking_armijo(
     searchable = ~(
         dphi0 >= -(fin.eps * jnp.maximum(jnp.abs(f0), fin.tiny))
     ) | ~jnp.isfinite(f0)
+    if active is not None:
+        searchable = searchable & active
     a1 = jnp.where(searchable, jnp.asarray(init_alpha, f0.dtype), 0.0)
     f1, g1 = phi(a1)
 

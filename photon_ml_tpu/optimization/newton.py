@@ -166,6 +166,8 @@ def minimize_newton(
         ls = linesearch.strong_wolfe(
             phi, st.f, st.g, dphi0, jnp.asarray(1.0, dtype),
             max_iters=max_line_search_iterations,
+            # frozen-lane mask, as in minimize_lbfgs
+            active=st.reason == ConvergenceReason.NOT_CONVERGED,
         )
 
         x_new = project(st.x + ls.alpha * direction)

@@ -124,7 +124,10 @@ def minimize_owlqn(
             st.k == 0, jnp.minimum(1.0, 1.0 / jnp.where(gnorm > 0, gnorm, 1.0)), 1.0
         ).astype(dtype)
         ls = linesearch.backtracking_armijo(
-            phi, st.f, dphi0, init_alpha, max_iters=max_line_search_iterations
+            phi, st.f, dphi0, init_alpha,
+            max_iters=max_line_search_iterations,
+            # frozen-lane mask, as in minimize_lbfgs
+            active=st.reason == ConvergenceReason.NOT_CONVERGED,
         )
 
         x_new = st.x + ls.alpha * direction
