@@ -3606,17 +3606,16 @@ def test_two_process_fe_hyperparameter_tuning_parity(tmp_path):
     )
 
 
-def test_two_process_game_fe_down_sampling_parity(tmp_path):
-    """GAME multi-process training with fixed-effect down-sampling: the FE
-    coordinate redraws its mask per CD pass (call index = pass, sampler
-    rebuilt per config — the single-process estimator's counter), random
-    effects train on the full data, and the saved model matches the
-    single-process driver."""
+
+def _game_classification_inputs(tmp_path, rng_seed, n_users, rows, val_rows=None,
+                                d=4):
+    """GAME (fixed + per-user) training inputs: index maps + uneven part
+    files (+ optional validation file); the shared fixture behind the
+    down-sampling GAME parity tests. Returns (fe_imap, re_imap)."""
     from photon_ml_tpu.data import avro_io
     from photon_ml_tpu.data.index_map import IndexMap
 
-    rng = np.random.default_rng(41)
-    d, n_users = 4, 9
+    rng = np.random.default_rng(rng_seed)
     w_true = rng.normal(size=d)
     u_eff = 1.2 * rng.normal(size=n_users)
     fe_imap = IndexMap.build([f"f{j}\x01" for j in range(d)], add_intercept=True)
@@ -3646,11 +3645,52 @@ def test_two_process_game_fe_down_sampling_parity(tmp_path):
     (tmp_path / "in").mkdir()
     avro_io.write_container(
         str(tmp_path / "in" / "part-a.avro"),
-        avro_io.TRAINING_EXAMPLE_SCHEMA, records(190, seed=1),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(rows[0], seed=1),
     )
     avro_io.write_container(
         str(tmp_path / "in" / "part-b.avro"),
-        avro_io.TRAINING_EXAMPLE_SCHEMA, records(150, seed=2),
+        avro_io.TRAINING_EXAMPLE_SCHEMA, records(rows[1], seed=2),
+    )
+    if val_rows:
+        (tmp_path / "val").mkdir()
+        avro_io.write_container(
+            str(tmp_path / "val" / "part-0.avro"),
+            avro_io.TRAINING_EXAMPLE_SCHEMA, records(val_rows, seed=5),
+        )
+    return fe_imap, re_imap
+
+
+def _assert_best_game_models_match(tmp_path, fe_imap, re_imap, atol=2e-3):
+    """best/ parity between out-single/ and out/: fixed-effect coefficients
+    and every per-entity random-effect row."""
+    from photon_ml_tpu.io.model_io import load_game_model
+
+    imaps = {"global": fe_imap, "per-user": re_imap}
+    ref = load_game_model(str(tmp_path / "out-single" / "best"), imaps)
+    got = load_game_model(str(tmp_path / "out" / "best"), imaps)
+    np.testing.assert_allclose(
+        np.asarray(got.get_model("global").model.coefficients.means),
+        np.asarray(ref.get_model("global").model.coefficients.means),
+        atol=atol,
+    )
+    re_ref, re_got = ref.get_model("per-user"), got.get_model("per-user")
+    assert set(re_got.entity_ids) == set(re_ref.entity_ids)
+    for eid in re_ref.entity_ids:
+        np.testing.assert_allclose(
+            re_got.coefficients_for_entity(eid),
+            re_ref.coefficients_for_entity(eid),
+            atol=atol, err_msg=str(eid),
+        )
+
+
+def test_two_process_game_fe_down_sampling_parity(tmp_path):
+    """GAME multi-process training with fixed-effect down-sampling: the FE
+    coordinate redraws its mask per CD pass (call index = pass, sampler
+    rebuilt per config — the single-process estimator's counter), random
+    effects train on the full data, and the saved model matches the
+    single-process driver."""
+    fe_imap, re_imap = _game_classification_inputs(
+        tmp_path, rng_seed=41, n_users=9, rows=(190, 150)
     )
 
     ds_cc = (
@@ -3680,25 +3720,7 @@ def test_two_process_game_fe_down_sampling_parity(tmp_path):
         ["--coordinate-configurations", ds_cc],
     )
 
-    from photon_ml_tpu.io.model_io import load_game_model
-
-    def load(root):
-        return load_game_model(
-            str(root / "best"), {"global": fe_imap, "per-user": re_imap}
-        )
-
-    ref, got = load(tmp_path / "out-single"), load(tmp_path / "out")
-    fe_ref = np.asarray(ref.get_model("global").model.coefficients.means)
-    fe_got = np.asarray(got.get_model("global").model.coefficients.means)
-    np.testing.assert_allclose(fe_got, fe_ref, atol=2e-3)
-    re_ref, re_got = ref.get_model("per-user"), got.get_model("per-user")
-    assert set(re_got.entity_ids) == set(re_ref.entity_ids)
-    for eid in re_ref.entity_ids:
-        np.testing.assert_allclose(
-            re_got.coefficients_for_entity(eid),
-            re_ref.coefficients_for_entity(eid),
-            atol=2e-3, err_msg=str(eid),
-        )
+    _assert_best_game_models_match(tmp_path, fe_imap, re_imap)
 
 
 def test_multiprocess_fe_tuning_checkpoint_resume(tmp_path):
@@ -3809,3 +3831,57 @@ def test_multiprocess_data_summary_matches_single_process(tmp_path):
             assert m_mp[metric] == pytest.approx(v, rel=1e-5, abs=1e-9), (
                 key, metric
             )
+
+
+def test_two_process_game_ds_validation_selection(tmp_path):
+    """Down-sampling + per-update validation selection in multi-process GAME
+    training: each CD pass's fixed-effect update trains on a RESAMPLED
+    objective (fresh mask per pass), every update is a selection candidate,
+    and the saved best snapshot must match the single-process driver's —
+    the masks AND the per-update tracking must agree for this to hold."""
+    fe_imap, re_imap = _game_classification_inputs(
+        tmp_path, rng_seed=83, n_users=8, rows=(170, 130), val_rows=120
+    )
+
+    ds_cc = (
+        "name=global,feature.shard=global,optimizer=LBFGS,max.iter=80,"
+        "tolerance=1e-9,regularization=L2,reg.weights=1.0,"
+        "down.sampling.rate=0.6"
+    )
+    argv_tail = [
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--feature-shard-configurations", "name=re,feature.bags=features",
+        "--off-heap-index-map-directory", str(tmp_path / "index-maps"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-configurations", ds_cc,
+        "--coordinate-configurations",
+        "name=per-user,feature.shard=re,random.effect.type=userId,"
+        "optimizer=LBFGS,max.iter=60,tolerance=1e-9,regularization=L2,"
+        "reg.weights=1.0",
+        "--coordinate-descent-iterations", "2",
+        "--evaluators", "AUC",
+    ]
+    _run_single_process_driver(tmp_path, "sp-gdsv.log", [
+        "--input-data-directories", str(tmp_path / "in"),
+        "--validation-data-directories", str(tmp_path / "val"),
+        "--root-output-directory", str(tmp_path / "out-single"),
+        *argv_tail,
+    ])
+    _run_workers(
+        tmp_path, "mp_game_worker.py", "gdsv",
+        ["--validation-data-directories", str(tmp_path / "val"),
+         "--coordinate-configurations", ds_cc, "--evaluators", "AUC"],
+    )
+
+    _assert_best_game_models_match(tmp_path, fe_imap, re_imap)
+    # the selected best metric agrees too (same update won on both paths)
+    import json as _json
+
+    meta_sp = _json.loads(
+        (tmp_path / "out-single" / "best" / "model-metadata.json").read_text()
+    )
+    meta_mp = _json.loads(
+        (tmp_path / "out" / "best" / "model-metadata.json").read_text()
+    )
+    assert meta_mp["bestMetric"] == pytest.approx(meta_sp["bestMetric"], abs=2e-4)
