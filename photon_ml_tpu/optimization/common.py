@@ -42,13 +42,15 @@ class OptimizerConfig:
     tolerance: float = DEFAULT_TOLERANCE
     # LBFGS-family knobs
     history_length: int = 10
-    # 15, not 30: Breeze's StrongWolfeLineSearch (the reference's actual
-    # line search) budgets 10 bracketing + 10 zoom; in the vmapped
-    # random-effect regime the while_loop runs max-lane iterations, so the
-    # budget bounds the whole batch's per-step cost (docs/PERFORMANCE.md
-    # round-5 section — the tail past ~15 was converged-lane thrash, and
-    # the best-Armijo fallback keeps over-budget steps monotone)
-    max_line_search_iterations: int = 15
+    # 10, not 30: Breeze's StrongWolfeLineSearch (the reference's actual
+    # line search) caps each phase at 10; in the vmapped random-effect
+    # regime the while_loop runs max-lane iterations, so with thousands of
+    # lanes SOME lane zooms near the budget almost every step — the budget
+    # directly bounds the whole batch's per-step cost (docs/PERFORMANCE.md
+    # round-5 table: 30 -> 15 -> 10 measured +42%/+35% with every quality
+    # gate green; the best-Armijo fallback keeps over-budget steps
+    # monotone)
+    max_line_search_iterations: int = 10
     # TRON knobs (TRON.scala:253-262)
     max_cg_iterations: int = 20
     max_improvement_failures: int = 5

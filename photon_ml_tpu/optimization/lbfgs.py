@@ -113,7 +113,7 @@ def minimize_lbfgs(
     max_iterations: int = 100,
     tolerance: float = 1e-7,
     history_length: int = 10,
-    max_line_search_iterations: int = 15,
+    max_line_search_iterations: int = 10,
     lower_bounds: Optional[Array] = None,
     upper_bounds: Optional[Array] = None,
     track_states: bool = False,

@@ -64,7 +64,7 @@ def minimize_lbfgsb(
     max_iterations: int = 100,
     tolerance: float = 1e-7,
     history_length: int = 10,
-    max_line_search_iterations: int = 15,
+    max_line_search_iterations: int = 10,
     track_states: bool = False,
 ) -> OptResult:
     m = history_length

@@ -74,7 +74,7 @@ def strong_wolfe(
     g0: Array,
     dphi0: Array,
     init_alpha: Array,
-    max_iters: int = 15,
+    max_iters: int = 10,
     active=None,
 ) -> LineSearchResult:
     """Find alpha satisfying the strong Wolfe conditions.
@@ -257,7 +257,7 @@ def backtracking_armijo(
     f0: Array,
     dphi0: Array,
     init_alpha: Array,
-    max_iters: int = 15,
+    max_iters: int = 10,
     shrink: float = 0.5,
     active=None,
 ) -> LineSearchResult:

@@ -109,7 +109,7 @@ def minimize_newton(
     *,
     max_iterations: int = 100,
     tolerance: float = 1e-7,
-    max_line_search_iterations: int = 15,
+    max_line_search_iterations: int = 10,
     lower_bounds: Optional[Array] = None,
     upper_bounds: Optional[Array] = None,
     track_states: bool = False,
