@@ -29,12 +29,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BANK_PATH = os.path.join(REPO, "benchmarks", "banked_tpu_bench.json")
 
-# Same-machine CPU denominators for the at-scale shape (benchmarks/
+# Same-machine CPU denominator for the at-scale shape (benchmarks/
 # tpu_results.md): the device-builder run is the apples-to-apples
-# denominator for the --device-data TPU measurement. Round-5 value,
-# re-measured at post-line-search-fix HEAD (the round-3 value was 45,906 —
-# the same code speedup nearly doubled the CPU denominator too).
-CPU_1CORE_SCALE200_DEVICE = 87853.87
+# denominator for the --device-data TPU measurement. Measured at final
+# round-5 HEAD (line-search budget 10). History: 45,906 at round-3 HEAD,
+# 87,854 at budget-15 HEAD, 62,462 at budget-10 HEAD — the shorter budget
+# wins the latency-bound toy shape but costs extra outer iterations,
+# which the bandwidth-bound CPU at-scale pass pays for; both sides of the
+# TPU ratio run the same HEAD, so the comparison stays honest.
+CPU_1CORE_SCALE200_DEVICE = 62461.70
 
 
 def _load_tpu_json(path):
