@@ -189,10 +189,13 @@ def test_sweep_emits_partials_on_accelerator(capsys):
         for l in capsys.readouterr().err.strip().splitlines()
         if "partial_value" in l
     ]
-    assert len(partials) == 3  # anchor + newton_f32 + newton_bf16
+    # anchor + newton_f32 + newton_bf16 + the winner's ls15 re-measure
+    # (which fails against the 2-arg fake and still emits its partial)
+    assert len(partials) == 4
     assert partials[0]["variant"] == "lbfgs_f32"
     assert partials[-1]["partial_value"] == 1500.0
     assert partials[-1]["variant"] == "newton_f32"
+    assert "newton_f32_ls15_error" in partials[-1]
 
     captured = capsys.readouterr()
     bench.run_variant_sweep(
@@ -430,9 +433,10 @@ def test_roofline_regime_and_utilization(monkeypatch):
 
 def test_winner_roofline_lookup_decodes_variant_names():
     costs = {
-        ("LBFGS", None, False): {"flops_per_pass": 1.0, "hbm_bytes_per_pass": 1.0},
-        ("NEWTON", "bfloat16", False): {"flops_per_pass": 2.0, "hbm_bytes_per_pass": 2.0},
-        ("NEWTON", "bfloat16", True): {"flops_per_pass": 3.0, "hbm_bytes_per_pass": 3.0},
+        ("LBFGS", None, False, None): {"flops_per_pass": 1.0, "hbm_bytes_per_pass": 1.0},
+        ("NEWTON", "bfloat16", False, None): {"flops_per_pass": 2.0, "hbm_bytes_per_pass": 2.0},
+        ("NEWTON", "bfloat16", True, None): {"flops_per_pass": 3.0, "hbm_bytes_per_pass": 3.0},
+        ("LBFGS", None, False, 15): {"flops_per_pass": 4.0, "hbm_bytes_per_pass": 4.0},
     }
     out = bench._winner_roofline(
         {"variant": "newton_bf16_pallas"}, costs, samples_per_sec=1000.0, n_samples=100
@@ -442,6 +446,10 @@ def test_winner_roofline_lookup_decodes_variant_names():
         {"variant": "lbfgs_f32"}, costs, samples_per_sec=1000.0, n_samples=100
     )
     assert out["roofline"]["flops_per_pass"] == 1.0
+    out = bench._winner_roofline(
+        {"variant": "lbfgs_f32_ls15"}, costs, samples_per_sec=1000.0, n_samples=100
+    )
+    assert out["roofline"]["flops_per_pass"] == 4.0
     # a variant whose configuration was never measured yields no roofline
     assert bench._winner_roofline({"variant": "lbfgs_f32"}, {}, 1000.0, 100) == {}
 
@@ -515,3 +523,25 @@ def test_bank_results_banks_only_tpu_records(tmp_path):
         assert not (tmp_path / "b2.json").exists()
     finally:
         bank.BANK_PATH = orig
+
+
+def test_ls15_variant_wins_when_faster_and_gated():
+    """The winner is re-measured with the Breeze combined line-search budget
+    (ls=15): shape-dependent trade, decided empirically per run."""
+    def measure(opt, storage, ls=None):
+        if ls == 15:
+            assert (OptimizerType(opt), storage) == (OptimizerType.NEWTON, None)
+            return 1800.0, 100.1  # faster AND within the 1% gate
+        table = {
+            (OptimizerType.LBFGS, None): (1000.0, 100.0),
+            (OptimizerType.NEWTON, None): (1500.0, 100.0),
+            (OptimizerType.NEWTON, BF16): (1400.0, 100.0),
+        }
+        return table[(OptimizerType(opt), storage)]
+
+    best, info = bench.run_variant_sweep(
+        measure, cpu_backend=False, pallas_capable=False, bf16=BF16
+    )
+    assert best == 1800.0
+    assert info["variant"] == "newton_f32_ls15"
+    assert info["newton_f32_ls15_quality_gate"] is True
