@@ -833,6 +833,17 @@ _CPU_CHILD_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
 
 
 def main():
+    if "--scoring" in sys.argv:
+        # serving-path benchmark (fused engine steady state): delegates to
+        # benchmarks/scoring_bench.py, which prints its own JSON line and
+        # exits nonzero when a quality/retrace gate fails
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks")
+        )
+        import scoring_bench
+
+        sys.exit(scoring_bench.main([a for a in sys.argv[1:] if a != "--scoring"]))
+
     if "--child" in sys.argv:
         _child_main()
         return

@@ -1,0 +1,197 @@
+"""Scoring-path benchmark: steady-state fused GAME serving throughput.
+
+Metric: ``game_scoring_samples_per_sec`` — scored samples / wall-clock over a
+stream of steady-state requests through the fused serving engine
+(photon_ml_tpu/serving/engine.py), measured AFTER warmup compiles the batch
+bucket's program. The workload is the flagship GLMix shape family (bench.py /
+BASELINE config #3): dense fixed effect + per-user + per-item random effects,
+request batch sizes jittered WITHIN one power-of-two bucket — the serving
+steady state the engine's compile cache is built for.
+
+Also reported, per the honest-ratio rules (docs/PERFORMANCE.md):
+
+- ``p50_ms`` / ``p99_ms`` per-request latency over the measured stream;
+- ``retraces_after_warmup`` — MUST be 0, asserting the compile-cache claim
+  (a nonzero value voids the steady-state reading and fails the run);
+- ``eager_samples_per_sec`` and ``vs_eager`` — the same request stream
+  through the eager per-coordinate GameTransformer path on the SAME backend,
+  the denominator for the engine's speedup claim;
+- ``parity_bitwise`` — quality gate: fused scores must equal the eager
+  path's bitwise (same dtype) on a probe request; a fast engine that scores
+  a different number is a bug, not a speedup.
+
+Run directly (``python benchmarks/scoring_bench.py``) or as
+``python bench.py --scoring``. Flags: ``--requests R`` (default 32),
+``--batch B`` (default 4096, the bucket ceiling), ``--scale F`` (multiplies
+entity counts and batch), ``--eager-requests K`` (default 4).
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+import scipy.sparse as sp
+
+D_FIXED = 64
+D_RE = 8  # intercept + 7 feature columns, the flagship RE shard shape
+N_USERS = 2_000
+N_ITEMS = 500
+
+
+def build_model(n_users: int, n_items: int, seed: int = 42):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+    from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+
+    def re_model(re_type, n_entities):
+        proj = np.tile(np.arange(D_RE, dtype=np.int32), (n_entities, 1))
+        return RandomEffectModel(
+            re_type=re_type,
+            feature_shard_id="re_shard",
+            task=TaskType.LOGISTIC_REGRESSION,
+            entity_ids=tuple(range(n_entities)),
+            coeffs=jnp.asarray(rng.normal(size=(n_entities, D_RE)) * 0.3),
+            proj_indices=jnp.asarray(proj),
+        )
+
+    fixed = FixedEffectModel(
+        model=LogisticRegressionModel(
+            Coefficients(means=jnp.asarray(rng.normal(size=D_FIXED) * 0.3))
+        ),
+        feature_shard_id="global",
+    )
+    return GameModel(
+        models={
+            "fixed": fixed,
+            "per-user": re_model("userId", n_users),
+            "per-item": re_model("itemId", n_items),
+        }
+    )
+
+
+def build_requests(n_requests: int, batch: int, n_users: int, n_items: int, seed: int = 7):
+    """Request stream with batch sizes jittered inside ONE pow2 bucket
+    ((batch/2, batch] all pad to ``batch``): generation happens up front so
+    the timed region contains only serving work (host prep + device program +
+    the single score transfer)."""
+    from photon_ml_tpu.data.game_data import GameInput
+
+    rng = np.random.default_rng(seed)
+    requests = []
+    for _ in range(n_requests):
+        n = int(rng.integers(batch // 2 + 1, batch + 1))
+        fe = rng.normal(size=(n, D_FIXED)).astype(np.float32)
+        re_feat = sp.csr_matrix(
+            np.concatenate([np.ones((n, 1), dtype=np.float32), fe[:, : D_RE - 1]], axis=1)
+        )
+        requests.append(
+            GameInput(
+                features={"global": fe, "re_shard": re_feat},
+                # f32 offsets keep the eager host add and the fused device add
+                # in one dtype on non-x64 runtimes (the parity gate is bitwise)
+                offsets=rng.normal(size=n).astype(np.float32),
+                id_columns={
+                    "userId": rng.integers(0, n_users, size=n),
+                    "itemId": rng.integers(0, n_items, size=n),
+                },
+            )
+        )
+    return requests
+
+
+def run(n_requests: int, batch: int, scale: float, eager_requests: int) -> dict:
+    import jax
+
+    from photon_ml_tpu.serving import get_engine
+    from photon_ml_tpu.transformers import GameTransformer
+
+    n_users = max(1, int(N_USERS * scale))
+    n_items = max(1, int(N_ITEMS * scale))
+    batch = max(8, int(batch * scale))
+    model = build_model(n_users, n_items)
+    requests = build_requests(n_requests, batch, n_users, n_items)
+    engine = get_engine(model)
+
+    # warmup: compile the bucket's program (excluded from timings, like the
+    # training bench's warm-up pass)
+    engine.score(requests[0])
+    warmup_traces = engine.trace_count
+
+    latencies = []
+    samples = 0
+    t0 = time.perf_counter()
+    for req in requests:
+        t = time.perf_counter()
+        out = engine.score(req)
+        latencies.append(time.perf_counter() - t)
+        samples += len(out)
+    elapsed = time.perf_counter() - t0
+    retraces = engine.trace_count - warmup_traces
+
+    # eager denominator: same stream prefix, per-coordinate dispatch path —
+    # warmed up with one untimed request, symmetric with the fused warmup
+    # (an honest ratio excludes compiles from BOTH sides)
+    eager = GameTransformer(model=model, engine="eager")
+    eager_stream = requests[: max(1, eager_requests)]
+    eager.score(eager_stream[0])
+    te = time.perf_counter()
+    eager_samples = sum(len(eager.score(r)) for r in eager_stream)
+    eager_elapsed = time.perf_counter() - te
+
+    # quality gate: bitwise parity on a probe request
+    probe = requests[0]
+    s_fused = engine.score(probe)
+    s_eager = eager.score(probe)
+    parity = bool(
+        s_fused.dtype == s_eager.dtype and np.array_equal(s_fused, s_eager)
+    )
+
+    lat_ms = np.asarray(latencies) * 1e3
+    value = samples / elapsed
+    eager_sps = eager_samples / eager_elapsed if eager_elapsed > 0 else None
+    result = {
+        "metric": "game_scoring_samples_per_sec",
+        "value": round(value, 2),
+        "unit": "samples/sec",
+        "requests": n_requests,
+        "batch_bucket": engine.bucket(batch),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        "retraces_after_warmup": int(retraces),
+        "warmup_traces": int(warmup_traces),
+        "parity_bitwise": parity,
+        "eager_samples_per_sec": round(eager_sps, 2) if eager_sps else None,
+        "vs_eager": round(value / eager_sps, 2) if eager_sps else None,
+        "platform": jax.default_backend(),
+    }
+    if scale != 1.0:
+        result["scale"] = scale
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--batch", type=int, default=4096)
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--eager-requests", type=int, default=4)
+    args = p.parse_args(argv)
+    result = run(args.requests, args.batch, args.scale, args.eager_requests)
+    print(json.dumps(result))
+    # both gates are load-bearing for the steady-state reading: a retrace
+    # means the compile cache failed, parity failure means the engine scores
+    # a different number than the reference path
+    return 0 if result["parity_bitwise"] and result["retraces_after_warmup"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,8 @@ _LAZY = {
     "GameEstimator": "photon_ml_tpu.estimators.game_estimator",
     "GameResult": "photon_ml_tpu.estimators.game_estimator",
     "GameTransformer": "photon_ml_tpu.transformers.game_transformer",
+    "GameServingEngine": "photon_ml_tpu.serving.engine",
+    "get_engine": "photon_ml_tpu.serving.engine",
     "GameInput": "photon_ml_tpu.data.game_data",
     "CoordinateConfiguration": "photon_ml_tpu.estimators.config",
     "FixedEffectDataConfiguration": "photon_ml_tpu.estimators.config",

@@ -218,7 +218,10 @@ def train_random_effect(
             l2_table[:E] = arr
     l2_rows = jnp.asarray(l2_table, dtype=dtype)
 
-    reasons_parts, iters_parts = [], []
+    # tracker inputs stay DEVICE arrays inside the loop: a host sync per bucket
+    # (np.asarray) would block dispatch of the next bucket's solve; everything
+    # transfers in one device_get after the last bucket is enqueued
+    reasons_parts, iters_parts, rows_parts = [], [], []
 
     for bucket in dataset.buckets:
         S, K = bucket.shape
@@ -259,9 +262,9 @@ def train_random_effect(
         coeffs_global = coeffs_global.at[bucket.entity_rows, :K].set(w_b)
         if variances_global is not None:
             variances_global = variances_global.at[bucket.entity_rows, :K].set(var_b)
-        real = np.asarray(bucket.entity_rows) < E
-        reasons_parts.append(np.asarray(reasons_b)[real])
-        iters_parts.append(np.asarray(iters_b)[real])
+        reasons_parts.append(reasons_b)
+        iters_parts.append(iters_b)
+        rows_parts.append(bucket.entity_rows)
 
     if table_rows > E:
         # bucket padding targets row E, which is in-bounds when the table height
@@ -274,10 +277,17 @@ def train_random_effect(
         if variances_global is not None:
             variances_global = jax.device_put(variances_global, coeffs_sharding)
 
-    tracker = RandomEffectTracker.from_arrays(
-        np.concatenate(reasons_parts) if reasons_parts else np.zeros(0, np.int32),
-        np.concatenate(iters_parts) if iters_parts else np.zeros(0, np.int32),
-    )
+    if reasons_parts:
+        # the one host sync for the tracker, after every bucket solve is queued
+        reasons_h, iters_h, rows_h = jax.device_get(
+            (reasons_parts, iters_parts, rows_parts)
+        )
+        real = [np.asarray(r) < E for r in rows_h]
+        reasons_all = np.concatenate([np.asarray(a)[m] for a, m in zip(reasons_h, real)])
+        iters_all = np.concatenate([np.asarray(a)[m] for a, m in zip(iters_h, real)])
+    else:
+        reasons_all = iters_all = np.zeros(0, np.int32)
+    tracker = RandomEffectTracker.from_arrays(reasons_all, iters_all)
     model = RandomEffectModel(
         re_type=dataset.re_type,
         feature_shard_id=dataset.feature_shard_id,

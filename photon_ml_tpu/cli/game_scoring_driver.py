@@ -54,6 +54,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "across processes)")
     p.add_argument("--compute-backend", default="host", choices=["host", "mesh"],
                    help="'mesh' scores with datasets sharded over the device mesh")
+    p.add_argument("--scoring-engine", default="fused", choices=["fused", "eager"],
+                   help="'fused' (default) compiles the whole scoring pipeline "
+                        "into one jit-cached XLA program per batch bucket with "
+                        "device-resident coefficient tables; 'eager' keeps the "
+                        "per-coordinate dataset-rebuild path")
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="Device count for --compute-backend=mesh (default: all)")
     from photon_ml_tpu.cli.runtime import add_distributed_arguments
@@ -177,7 +182,8 @@ def run(args: argparse.Namespace) -> dict:
 
             mesh = make_mesh(getattr(args, "mesh_devices", None))
         transformer = GameTransformer(
-            model=model, evaluators=evaluator_specs, mesh=mesh
+            model=model, evaluators=evaluator_specs, mesh=mesh,
+            engine=getattr(args, "scoring_engine", "fused"),
         )
         with Timed("score", logger):
             scores, metrics = transformer.transform(data)

@@ -27,6 +27,26 @@ from photon_ml_tpu.types import ModelType, TaskType
 Array = jnp.ndarray
 
 
+def random_effect_view_score(
+    coeffs: Array, entity_rows: Array, local_cols: Array, vals: Array
+) -> Array:
+    """Per-sample gather/dot scoring kernel over a scoring view: score[i] =
+    sum_k coeffs[entity_rows[i], local_cols[i, k]] * vals[i, k], with -1
+    entity rows (no model) and -1 column slots (padding / columns the model
+    never saw) contributing exactly 0. ONE shared implementation for the
+    eager ``RandomEffectModel.score_dataset`` and the fused serving engine
+    (serving/engine.py), so the two paths execute identical jnp ops and stay
+    numerically interchangeable."""
+    has_model = entity_rows >= 0
+    safe_rows = jnp.maximum(entity_rows, 0)
+    w = coeffs[safe_rows]  # [N, K]
+    safe_cols = jnp.maximum(local_cols, 0)
+    gathered = jnp.take_along_axis(w, safe_cols, axis=1)  # [N, nnz]
+    gathered = jnp.where(local_cols >= 0, gathered, 0.0)
+    scores = jnp.sum(gathered * vals, axis=1)
+    return jnp.where(has_model, scores, 0.0)
+
+
 def _projectors_compatible(a, b) -> bool:
     """True when two RandomProjectors define the same projected space. Full
     matrix equality is O(d*k) host work on potentially huge matrices, so after
@@ -186,14 +206,7 @@ class RandomEffectModel:
             )
         model = self.aligned_to(dataset)
         entity_rows, local_cols, vals = dataset.scoring_view(model)
-        has_model = entity_rows >= 0
-        safe_rows = jnp.maximum(entity_rows, 0)
-        w = model.coeffs[safe_rows]  # [N, K]
-        safe_cols = jnp.maximum(local_cols, 0)
-        gathered = jnp.take_along_axis(w, safe_cols, axis=1)  # [N, nnz]
-        gathered = jnp.where(local_cols >= 0, gathered, 0.0)
-        scores = jnp.sum(gathered * vals, axis=1)
-        return jnp.where(has_model, scores, 0.0)
+        return random_effect_view_score(model.coeffs, entity_rows, local_cols, vals)
 
     def update_entities(self, new_coeffs: Array, variances: Optional[Array] = None) -> "RandomEffectModel":
         return dataclasses.replace(self, coeffs=new_coeffs, variances=variances)

@@ -122,6 +122,21 @@ def place_random_effect_dataset(ds: RandomEffectDataset, mesh) -> RandomEffectDa
     )
 
 
+def place_serving_batch(batch, mesh):
+    """Batch-shard a serving request's prepared arrays over the 1-D mesh.
+
+    Every leaf of a serving batch (serving/engine.py) leads with the PADDED
+    sample axis — the engine's bucket size is already a mesh multiple — so
+    placement is a uniform axis-0 sharding; the engine's coefficient tables
+    are replicated separately at engine build. This is the scoring-side
+    analog of the training placement above, minus the padding (already done)
+    and the entity-axis sharding (serving gathers THROUGH the replicated
+    tables instead of scattering into them)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, batch_sharding(mesh, ndim=a.ndim)), batch
+    )
+
+
 def place_game_datasets(datasets: dict, mesh) -> dict:
     """Place every per-coordinate dataset of a GAME fit on the mesh."""
     out = {}

@@ -1,0 +1,13 @@
+from photon_ml_tpu.serving.engine import (
+    GameServingEngine,
+    clear_engine_cache,
+    get_engine,
+    model_fingerprint,
+)
+
+__all__ = [
+    "GameServingEngine",
+    "clear_engine_cache",
+    "get_engine",
+    "model_fingerprint",
+]

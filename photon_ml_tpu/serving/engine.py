@@ -1,0 +1,568 @@
+"""Fused, jit-cached GAME serving engine: one XLA program per scoring request.
+
+The eager scoring path (transformers/game_transformer.py) rebuilds a scoring
+dataset per coordinate per call, re-runs ``RandomEffectModel.aligned_to`` per
+call, and pays one dispatch + ``np.asarray`` host round-trip PER COORDINATE.
+Fine for a validation pass; hopeless for serving traffic. This engine is the
+Snap-ML-style answer (PAPERS.md): keep model state device-resident, fuse the
+whole per-request pipeline — fixed-effect matvec, every random-effect
+gather/dot, the offset add, optionally the link function — into ONE jitted XLA
+program, and make a single host transfer of the final ``[N]`` scores.
+
+Design (mirroring ``optimization/solver_cache.py``'s cache discipline):
+
+- **Device-resident model state, placed once.** At engine build every
+  coordinate's coefficient table moves to device (replicated over the mesh
+  when one is given) and the jitted program CLOSES OVER it — one XLA program
+  per (model fingerprint, batch-size bucket), with the tables as baked
+  constants. Engines are cached by content fingerprint (``get_engine``), so
+  repeated ``GameTransformer`` construction over the same loaded model reuses
+  one compiled family.
+- **No per-request alignment.** Instead of rebuilding a dataset and re-laying
+  the model into its slot order, the engine precomputes (host, once) a sorted
+  (entity-row, global-column) -> model-slot key table; each request's CSR
+  entries map into the MODEL's own layout with one vectorized searchsorted.
+  Unseen entities and columns the model never saw score exactly 0, matching
+  ``aligned_to``'s zero-fill semantics bit for bit.
+- **Batch-size buckets behind the jit cache.** Request batch sizes are padded
+  to the next power of two (and to a mesh multiple under SPMD); jax.jit's own
+  shape cache then keys the compiled programs, so steady-state serving never
+  retraces. ``trace_count`` exposes the retrace counter for tests and the
+  scoring benchmark's zero-retrace gate.
+- **Numerical parity with the eager path.** The random-effect kernel is the
+  SAME function the eager path runs (``models.game.random_effect_view_score``)
+  over a per-sample view built with the same dtype rules as
+  ``build_random_effect_dataset`` (values stored float32, CSR entry order
+  preserved, per-sample nnz width = the request's max row nnz), and the
+  fixed-effect matvec goes through the same ``DenseDesignMatrix.matvec``.
+  Parity is bitwise on dense-fixed-effect models (tests/test_serving.py); a
+  sparse fixed-effect shard scores through a per-sample gather/dot instead of
+  the eager segment_sum, which may differ in the last ulp.
+
+Padding discipline: padded batch rows carry entity row -1, column slot -1 and
+value 0 everywhere, so every per-row computation is inert and the trailing
+rows are sliced off after the single host transfer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameInput, as_csr
+from photon_ml_tpu.data.matrix import DenseDesignMatrix
+from photon_ml_tpu.models.game import (
+    FixedEffectModel,
+    GameModel,
+    RandomEffectModel,
+    random_effect_view_score,
+)
+
+Array = jnp.ndarray
+
+# Smallest padded batch: tiny buckets would compile a program per handful of
+# samples; production deployments pass a larger floor via get_engine.
+MIN_BATCH_PAD = 8
+
+
+# --------------------------------------------------------------------------
+# model fingerprint: the cross-process-stable part of the compile-cache key
+# --------------------------------------------------------------------------
+
+
+def _hash_array(h, a) -> None:
+    a = np.asarray(a)
+    h.update(str(a.dtype).encode())
+    h.update(repr(a.shape).encode())
+    h.update(np.ascontiguousarray(a).tobytes())
+
+
+def _hash_projector(h, p) -> None:
+    """Structural + sampled-content digest of a RandomProjector (full matrix
+    equality is O(d*k) host work; a Gaussian matrix differing anywhere differs
+    almost surely everywhere — same sampling as models.game._projectors_compatible)."""
+    mat = np.asarray(p.matrix)
+    h.update(b"|proj|")
+    h.update(repr(mat.shape).encode())
+    h.update(str(p.intercept_index).encode())
+    d, k = mat.shape
+    rows = np.unique(np.linspace(0, d - 1, num=min(d, 16), dtype=np.int64))
+    cols = np.unique(np.linspace(0, k - 1, num=min(k, 4), dtype=np.int64))
+    _hash_array(h, mat[np.ix_(rows, cols)])
+    norm = p.normalization
+    if norm is not None:
+        for vec in (norm.factors, norm.shifts):
+            if vec is not None:
+                _hash_array(h, vec)
+
+
+def model_fingerprint(model: GameModel) -> str:
+    """Content digest of a GameModel: coordinate ids/types/metadata plus the
+    coefficient bytes. Computed once at engine lookup (the tables are still
+    host-reachable right after model load); identical models — e.g. the same
+    directory loaded twice — share one engine and one compiled program family."""
+    h = hashlib.blake2b(digest_size=16)
+    for cid, m in model:
+        h.update(cid.encode())
+        if isinstance(m, FixedEffectModel):
+            h.update(b"|fe|")
+            h.update(m.feature_shard_id.encode())
+            h.update(str(m.task).encode())
+            _hash_array(h, m.model.coefficients.means)
+        elif isinstance(m, RandomEffectModel):
+            h.update(b"|re|")
+            h.update(m.re_type.encode())
+            h.update(m.feature_shard_id.encode())
+            h.update(str(m.task).encode())
+            h.update("\x1f".join(str(e) for e in m.entity_ids).encode())
+            _hash_array(h, m.coeffs)
+            _hash_array(h, m.proj_indices)
+            if m.projector is not None:
+                _hash_projector(h, m.projector)
+        else:
+            raise TypeError(f"Cannot fingerprint model of type {type(m).__name__}")
+    return h.hexdigest()
+
+
+# --------------------------------------------------------------------------
+# per-coordinate device/lookup state
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _FixedCoord:
+    cid: str
+    feature_shard_id: str
+    means: Array  # [D], device-resident
+
+
+@dataclasses.dataclass
+class _RandomCoord:
+    cid: str
+    re_type: str
+    feature_shard_id: str
+    coeffs: Array  # [max(E,1), K], device-resident
+    # entity lookup (host): parallel sorted-ids/rows arrays, or a dict when the
+    # ids are not homogeneously sortable
+    ids_sorted: Optional[np.ndarray]
+    rows_sorted: Optional[np.ndarray]
+    row_by_entity: Optional[dict]
+    # (row * col_span + global col) -> model slot, sorted for searchsorted
+    slot_keys: np.ndarray  # [M] int64, sorted
+    slot_vals: np.ndarray  # [M] int32
+    col_span: int
+    projector: Optional[object]
+
+    def entity_rows(self, ents) -> np.ndarray:
+        """[n] model row per request entity id, -1 = no model (vectorized)."""
+        ents = np.asarray(ents)
+        if self.ids_sorted is not None:
+            if len(self.ids_sorted) == 0:
+                return np.full(len(ents), -1, dtype=np.int32)
+            try:
+                pos = np.clip(
+                    np.searchsorted(self.ids_sorted, ents), 0, len(self.ids_sorted) - 1
+                )
+                hit = self.ids_sorted[pos] == ents
+                if hit is False:  # incomparable dtypes collapse == to a scalar
+                    raise TypeError("entity id comparison degenerated")
+                return np.where(hit, self.rows_sorted[pos], -1).astype(np.int32)
+            except TypeError:
+                # request ids not comparable with the model's (e.g. str vs
+                # int): fall through to the dict path, which misses like the
+                # eager RandomEffectModel.row_for_entity and scores 0
+                if self.row_by_entity is None:
+                    self.row_by_entity = {
+                        e: int(r) for e, r in zip(self.ids_sorted, self.rows_sorted)
+                    }
+        get = self.row_by_entity.get
+        return np.fromiter(
+            (get(e, -1) for e in ents.tolist()), dtype=np.int32, count=len(ents)
+        )
+
+    def local_slots(self, entity_row_per_nnz, cols) -> np.ndarray:
+        """Model-layout slot per nnz entry, -1 when the entity has no model or
+        the model never saw the column (aligned_to's zero-fill, as a mask)."""
+        cols = cols.astype(np.int64)
+        valid = (entity_row_per_nnz >= 0) & (cols >= 0) & (cols < self.col_span)
+        if len(self.slot_keys) == 0:
+            return np.full(len(cols), -1, dtype=np.int32)
+        key = np.where(
+            valid, entity_row_per_nnz.astype(np.int64) * self.col_span + cols, 0
+        )
+        pos = np.clip(np.searchsorted(self.slot_keys, key), 0, len(self.slot_keys) - 1)
+        hit = valid & (self.slot_keys[pos] == key)
+        return np.where(hit, self.slot_vals[pos], -1).astype(np.int32)
+
+
+def _build_fixed_state(cid: str, m: FixedEffectModel, put) -> _FixedCoord:
+    return _FixedCoord(
+        cid=cid,
+        feature_shard_id=m.feature_shard_id,
+        means=put(jnp.asarray(m.model.coefficients.means)),
+    )
+
+
+def _build_random_state(cid: str, m: RandomEffectModel, put) -> _RandomCoord:
+    proj = np.asarray(m.proj_indices)
+    if proj.ndim != 2:
+        proj = proj.reshape((0, 1))
+    E, K = proj.shape
+    col_span = int(proj.max()) + 1 if proj.size and int(proj.max()) >= 0 else 1
+    rows_idx, slots = np.nonzero(proj >= 0)
+    keys = rows_idx.astype(np.int64) * col_span + proj[rows_idx, slots]
+    order = np.argsort(keys, kind="stable")
+    ids_sorted = rows_sorted = row_by_entity = None
+    try:
+        ids = np.asarray(m.entity_ids)
+        if ids.dtype == object:
+            raise TypeError("heterogeneous entity ids")
+        id_order = np.argsort(ids, kind="stable")
+        ids_sorted = ids[id_order]
+        rows_sorted = id_order.astype(np.int32)
+    except TypeError:
+        row_by_entity = {e: i for i, e in enumerate(m.entity_ids)}
+    coeffs = jnp.asarray(m.coeffs)
+    if E == 0:
+        # keep the gather well-formed; every request row maps to -1 anyway
+        coeffs = jnp.zeros((1, max(K, 1)), dtype=coeffs.dtype)
+    return _RandomCoord(
+        cid=cid,
+        re_type=m.re_type,
+        feature_shard_id=m.feature_shard_id,
+        coeffs=put(coeffs),
+        ids_sorted=ids_sorted,
+        rows_sorted=rows_sorted,
+        row_by_entity=row_by_entity,
+        slot_keys=keys[order],
+        slot_vals=slots[order].astype(np.int32),
+        col_span=col_span,
+        projector=m.projector,
+    )
+
+
+# --------------------------------------------------------------------------
+# the engine
+# --------------------------------------------------------------------------
+
+
+class GameServingEngine:
+    """Compiles a loaded GameModel into one fused scoring program per
+    batch-size bucket. Build via ``get_engine`` (content-keyed cache) rather
+    than directly, so identical models share compiled programs."""
+
+    def __init__(
+        self,
+        model: GameModel,
+        mesh: Optional[object] = None,
+        min_batch_pad: int = MIN_BATCH_PAD,
+    ):
+        if mesh is not None and len(mesh.axis_names) != 1:
+            raise ValueError(
+                "GameServingEngine supports a 1-D (data) mesh; 2-D "
+                "feature-sharded meshes score through the eager path"
+            )
+        self.model = model
+        self.mesh = mesh
+        self.min_batch_pad = int(min_batch_pad)
+        self._trace_count = 0
+        put = self._place_table
+        self._coords: list[Union[_FixedCoord, _RandomCoord]] = []
+        for cid, m in model:
+            if isinstance(m, FixedEffectModel):
+                self._coords.append(_build_fixed_state(cid, m, put))
+            elif isinstance(m, RandomEffectModel):
+                self._coords.append(_build_random_state(cid, m, put))
+            else:
+                raise TypeError(f"Cannot serve model of type {type(m).__name__}")
+        self._jitted = jax.jit(
+            self._fused,
+            static_argnames=("per_coordinate", "include_offsets", "apply_link"),
+        )
+
+    # -- device state ------------------------------------------------------
+
+    def _place_table(self, arr: Array) -> Array:
+        if self.mesh is None:
+            return arr
+        from photon_ml_tpu.parallel.mesh import replicated_sharding
+
+        return jax.device_put(arr, replicated_sharding(self.mesh))
+
+    @property
+    def trace_count(self) -> int:
+        """Number of program traces so far — steady-state serving must hold
+        this constant (the scoring bench's zero-retrace gate)."""
+        return self._trace_count
+
+    def bucket(self, n: int) -> int:
+        """Padded batch size for a request of ``n`` samples: next power of two
+        >= min_batch_pad, then (under SPMD) rounded up to a mesh multiple."""
+        p = self.min_batch_pad
+        while p < n:
+            p *= 2
+        if self.mesh is not None:
+            m = self.mesh.devices.size
+            p = -(-p // m) * m
+        return p
+
+    # -- request prep (host) ----------------------------------------------
+
+    def _prepare(self, data: GameInput):
+        n = data.n
+        n_pad = self.bucket(n)
+        offsets = np.asarray(data.offsets)
+        off = np.zeros(n_pad, dtype=offsets.dtype)
+        off[:n] = offsets
+        # coordinate ids are user-controlled config strings: namespace them so
+        # a coordinate literally named "offsets" cannot collide with the
+        # reserved offsets entry
+        batch = {"offsets": off}
+        for st in self._coords:
+            if isinstance(st, _FixedCoord):
+                batch["coord:" + st.cid] = self._prepare_fixed(st, data, n, n_pad)
+            else:
+                batch["coord:" + st.cid] = self._prepare_random(st, data, n, n_pad)
+        if self.mesh is not None:
+            from photon_ml_tpu.parallel.placement import place_serving_batch
+
+            batch = place_serving_batch(batch, self.mesh)
+        return batch, n
+
+    @staticmethod
+    def _per_sample_view(X: sp.csr_matrix, n: int, n_pad: int):
+        """[n_pad, W] (global cols, vals) from a CSR matrix, entries in CSR
+        order with W = the request's max row nnz padded to a power of two
+        (min 4). Width bucketing keeps a variable-sparsity request stream from
+        retracing per distinct nnz width — compiled programs are keyed by
+        (batch bucket, width bucket), both bounded. Padding entries carry
+        col -1 / val 0, contributing exact zeros; at the standard shapes the
+        padded per-row reduction is bit-identical to the eager dataset's
+        exact-width one (narrow widths can shift XLA's lowering by one ulp —
+        tests/test_serving.py pins the parity surface)."""
+        counts = np.diff(X.indptr)
+        W = max(int(counts.max()) if n else 1, 1)
+        w_pad = 4
+        while w_pad < W:
+            w_pad *= 2
+        W = w_pad
+        cols = np.full((n_pad, W), -1, dtype=np.int32)
+        vals = np.zeros((n_pad, W), dtype=np.float64)
+        rows_per_nnz = slot_per_nnz = None
+        if n and X.nnz:
+            rows_per_nnz = np.repeat(np.arange(n), counts)
+            slot_per_nnz = np.arange(X.nnz) - np.repeat(X.indptr[:-1], counts)
+            cols[rows_per_nnz, slot_per_nnz] = X.indices
+            vals[rows_per_nnz, slot_per_nnz] = X.data
+        return cols, vals, rows_per_nnz, slot_per_nnz
+
+    def _prepare_fixed(self, st: _FixedCoord, data: GameInput, n: int, n_pad: int):
+        X = data.shard(st.feature_shard_id)
+        if sp.issparse(X):
+            Xc = X.tocsr()
+            cols, vals, _, _ = self._per_sample_view(Xc, n, n_pad)
+            # eager sparse fixed effects build at float32
+            # (SparseDesignMatrix.from_scipy default)
+            return {
+                "cols": jnp.asarray(cols),
+                "vals": jnp.asarray(vals, dtype=jnp.float32),
+            }
+        arr = np.asarray(X)
+        padded = np.zeros((n_pad, arr.shape[1]), dtype=arr.dtype)
+        padded[:n] = arr
+        # dtype follows jnp.asarray like the eager LabeledData.build(dtype=None)
+        return {"values": jnp.asarray(padded)}
+
+    def _prepare_random(self, st: _RandomCoord, data: GameInput, n: int, n_pad: int):
+        X = as_csr(data.shard(st.feature_shard_id))
+        if st.projector is not None:
+            # same per-request projection the eager scoring-dataset build runs
+            X = st.projector.project_features(X)
+        ent_rows = st.entity_rows(data.ids(st.re_type))
+        rows = np.full(n_pad, -1, dtype=np.int32)
+        rows[:n] = ent_rows
+        cols, vals, rows_per_nnz, slot_per_nnz = self._per_sample_view(X, n, n_pad)
+        if rows_per_nnz is not None:
+            cols[rows_per_nnz, slot_per_nnz] = st.local_slots(
+                ent_rows[rows_per_nnz], X.indices
+            )
+        # float32 value storage matches build_random_effect_dataset's default
+        return {
+            "rows": jnp.asarray(rows),
+            "cols": jnp.asarray(cols),
+            "vals": jnp.asarray(vals, dtype=jnp.float32),
+        }
+
+    # -- the fused program -------------------------------------------------
+
+    def _fused(self, batch, per_coordinate: bool, include_offsets: bool, apply_link: bool):
+        self._trace_count += 1  # Python side effect: runs at trace time only
+        scores = []
+        for st in self._coords:
+            b = batch["coord:" + st.cid]
+            if isinstance(st, _FixedCoord):
+                if "values" in b:
+                    s = DenseDesignMatrix(values=b["values"]).matvec(st.means)
+                else:
+                    g = jnp.take(st.means, jnp.maximum(b["cols"], 0))
+                    g = jnp.where(b["cols"] >= 0, g, 0.0)
+                    s = jnp.sum(g * b["vals"], axis=1)
+            else:
+                s = random_effect_view_score(
+                    st.coeffs, b["rows"], b["cols"], b["vals"]
+                )
+            scores.append(s)
+        if per_coordinate:
+            # a tuple, NOT a stack: stacking would promote every coordinate
+            # to a common dtype, diverging from the eager per-coordinate
+            # dtypes on mixed-precision models
+            return tuple(scores)
+        if scores:
+            # left-to-right in coordinate order: the association the eager
+            # path's np.sum-over-stack uses
+            total = functools.reduce(lambda a, c: a + c, scores)
+        else:
+            total = jnp.zeros_like(batch["offsets"])
+        if include_offsets:
+            total = total + batch["offsets"]
+        if apply_link:
+            from photon_ml_tpu.function.losses import mean_function_for_task
+
+            total = mean_function_for_task(self.model.task)(total)
+        return total
+
+    # -- public scoring API ------------------------------------------------
+
+    def score(self, data: GameInput, include_offsets: bool = True) -> np.ndarray:
+        """Total [N] score in one device program + one host transfer.
+
+        The offset add fuses on device EXCEPT when the offsets dtype would not
+        survive device conversion (float64 offsets on a non-x64 runtime): the
+        eager path adds offsets host-side in numpy, promoting the result to
+        float64, and the engine preserves that output dtype contract by adding
+        on host in exactly that case — same values, same dtype, still one
+        device program and one transfer."""
+        if not self._coords:
+            # zero-coordinate model: run the eager path's exact numpy ops so
+            # shape AND dtype match it (float64 zeros + numpy promotion)
+            total = np.zeros(data.n)
+            if include_offsets:
+                total = total + np.asarray(data.offsets)
+            return total
+        offsets = np.asarray(data.offsets)
+        # floating offsets whose dtype survives device conversion promote the
+        # same way under jnp and numpy; integer offsets do NOT (jnp f32+i64 ->
+        # f32, numpy -> f64), so they take the host add like oversized floats
+        fuse_offsets = (
+            include_offsets
+            and np.issubdtype(offsets.dtype, np.floating)
+            and jnp.asarray(offsets[:0]).dtype == offsets.dtype
+        )
+        batch, n = self._prepare(data)
+        out = self._jitted(
+            batch,
+            per_coordinate=False,
+            include_offsets=fuse_offsets,
+            apply_link=False,
+        )
+        res = np.asarray(out)[:n]
+        if include_offsets and not fuse_offsets:
+            res = res + offsets
+        return res
+
+    def predict(self, data: GameInput) -> np.ndarray:
+        """Mean response: link-inverse of (score + offsets), fused on device
+        (sigmoid / exp / identity per the model task). Same offsets-dtype
+        guard as ``score``: when the offsets dtype would not survive device
+        conversion (float64 on a non-x64 runtime), the offset add AND the
+        link run host-side at full precision instead of silently truncating."""
+        offsets = np.asarray(data.offsets)
+        if (
+            np.issubdtype(offsets.dtype, np.floating)
+            and jnp.asarray(offsets[:0]).dtype == offsets.dtype
+        ):
+            batch, n = self._prepare(data)
+            out = self._jitted(
+                batch, per_coordinate=False, include_offsets=True, apply_link=True
+            )
+            return np.asarray(out)[:n]
+        margins = self.score(data, include_offsets=True)  # host f64 add
+        task = self.model.task
+        from photon_ml_tpu.types import TaskType
+
+        if task == TaskType.LOGISTIC_REGRESSION:
+            return 1.0 / (1.0 + np.exp(-margins))
+        if task == TaskType.POISSON_REGRESSION:
+            return np.exp(margins)
+        return margins
+
+    def score_per_coordinate(self, data: GameInput) -> dict[str, np.ndarray]:
+        """Per-coordinate [N] scores: still one fused program, with all C
+        arrays fetched in one ``device_get`` (vs one dispatch + transfer per
+        coordinate eagerly). Returned as a tuple rather than a stacked [C, N]
+        array so each coordinate keeps its own dtype."""
+        if not self._coords:
+            return {}
+        batch, n = self._prepare(data)
+        out = self._jitted(
+            batch, per_coordinate=True, include_offsets=False, apply_link=False
+        )
+        parts = jax.device_get(out)
+        return {st.cid: parts[i][:n] for i, st in enumerate(self._coords)}
+
+
+# --------------------------------------------------------------------------
+# engine cache (solver_cache-style: one engine per static configuration)
+# --------------------------------------------------------------------------
+
+_engines: "OrderedDict[tuple, GameServingEngine]" = OrderedDict()
+_engines_lock = threading.Lock()
+MAX_CACHED_ENGINES = 8
+
+
+def get_engine(
+    model: GameModel,
+    mesh: Optional[object] = None,
+    min_batch_pad: int = MIN_BATCH_PAD,
+) -> GameServingEngine:
+    """Content-keyed engine lookup: the same loaded model (same coefficient
+    bytes) maps to the same engine — and therefore to jit's compiled-program
+    cache — across GameTransformer instances. LRU-bounded so a long-running
+    process cycling many models doesn't pin every table on device."""
+    key = (model_fingerprint(model), mesh, int(min_batch_pad))
+    with _engines_lock:
+        eng = _engines.get(key)
+        if eng is not None:
+            _engines.move_to_end(key)
+            return eng
+    eng = GameServingEngine(model, mesh=mesh, min_batch_pad=min_batch_pad)
+    with _engines_lock:
+        existing = _engines.get(key)
+        if existing is not None:  # lost a race: keep the first one
+            _engines.move_to_end(key)
+            return existing
+        _engines[key] = eng
+        while len(_engines) > MAX_CACHED_ENGINES:
+            _engines.popitem(last=False)
+    return eng
+
+
+def clear_engine_cache() -> None:
+    """Drop cached engines (tests / model-reload cycles)."""
+    with _engines_lock:
+        _engines.clear()
+
+
+# engines hold traced programs; drop them with the other trace caches
+from photon_ml_tpu.optimization import solver_cache as _solver_cache  # noqa: E402
+
+_solver_cache.register_cache(clear_engine_cache)

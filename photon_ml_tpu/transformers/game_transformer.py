@@ -1,9 +1,16 @@
 """GameTransformer: score GameInput with a trained GAME model.
 
 Re-designs photon-api transformers/GameTransformer.scala:39-318. The reference
-builds a GameDatum RDD and sums per-coordinate ModelDataScores via joins; here each
-coordinate's scoring dataset is built from the model's own metadata (shard id,
-random-effect type) and the total score is an elementwise sum of dense [N] arrays.
+builds a GameDatum RDD and sums per-coordinate ModelDataScores via joins; here
+scoring delegates by default to the fused serving engine (serving/engine.py):
+one jitted XLA program per (model, batch-size bucket) with device-resident
+coefficient tables and a single host transfer of the final [N] scores.
+
+``engine="eager"`` keeps the original per-coordinate path — each coordinate's
+scoring dataset built from the model's own metadata (shard id, random-effect
+type), scored with one dispatch per coordinate — used for parity testing and
+as the fallback for configurations the fused engine does not cover (2-D
+feature-sharded meshes).
 """
 
 from __future__ import annotations
@@ -36,17 +43,53 @@ class GameTransformer:
     # mirroring the reference's executor-parallel scoring
     # (GameTransformer.transform:150+, RandomEffectModel.score:83-101)
     mesh: object = None
+    # "fused": the jit-cached serving engine (default); "eager": per-coordinate
+    # dataset rebuild + dispatch (the pre-engine path, kept for parity tests)
+    engine: str = "fused"
+
+    def _serving_engine(self):
+        """The fused engine for this model, or None when configured eager /
+        on a 2-D feature-sharded mesh (eager-only territory). Memoized per
+        (model object, mesh): get_engine's content fingerprint hashes every
+        coefficient table, which must not run on each score() call."""
+        if self.engine != "fused":
+            return None
+        if self.mesh is not None and len(self.mesh.axis_names) != 1:
+            return None
+        key = (id(self.model), self.mesh)
+        cached = getattr(self, "_engine_memo", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from photon_ml_tpu.serving import get_engine
+
+        eng = get_engine(self.model, mesh=self.mesh)
+        self._engine_memo = (key, eng)
+        return eng
 
     def score(self, data: GameInput, include_offsets: bool = True) -> np.ndarray:
         """Total score per sample: sum of coordinate scores (+ offsets, matching the
         reference's scored output which folds the base offset into the score)."""
-        per_coord = self.score_per_coordinate(data)
-        total = np.sum([np.asarray(s) for s in per_coord.values()], axis=0)
+        eng = self._serving_engine()
+        if eng is not None:
+            return eng.score(data, include_offsets=include_offsets)
+        per_coord = self._score_per_coordinate_eager(data)
+        if per_coord:
+            total = np.sum([np.asarray(s) for s in per_coord.values()], axis=0)
+        else:
+            # zero-coordinate model: np.sum([], axis=0) is a 0.0 SCALAR, which
+            # silently broadcast offsets-only scoring to the wrong shape
+            total = np.zeros(data.n)
         if include_offsets:
             total = total + np.asarray(data.offsets)
         return total
 
     def score_per_coordinate(self, data: GameInput) -> dict[str, np.ndarray]:
+        eng = self._serving_engine()
+        if eng is not None:
+            return eng.score_per_coordinate(data)
+        return self._score_per_coordinate_eager(data)
+
+    def _score_per_coordinate_eager(self, data: GameInput) -> dict[str, np.ndarray]:
         scores: dict[str, np.ndarray] = {}
         n = data.n
         for cid, model in self.model:

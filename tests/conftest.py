@@ -21,7 +21,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older jax (< 0.5): XLA_FLAGS above already forces 8
+    pass
 jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: the suite is compile-dominated (hundreds of

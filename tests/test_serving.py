@@ -1,0 +1,446 @@
+"""Fused serving engine: parity with the eager GameTransformer path, batch
+bucketing / retrace behavior, engine caching, and the zero-coordinate
+regression (ISSUE 1).
+
+Parity is asserted BITWISE (np.testing.assert_array_equal + dtype equality)
+against the eager per-coordinate path on the three BASELINE workload shapes:
+fixed-effect-only logistic (config #1), fixed-effect linear/Poisson (config
+#2's scoring surface), and the 3-coordinate GLMix shape (config #3) — plus a
+RandomProjector (RANDOM_PROJECTION) random-effect coordinate and the
+mesh-placed path.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import (
+    Coefficients,
+    LinearRegressionModel,
+    LogisticRegressionModel,
+    PoissonRegressionModel,
+)
+from photon_ml_tpu.serving import (
+    GameServingEngine,
+    clear_engine_cache,
+    get_engine,
+    model_fingerprint,
+)
+from photon_ml_tpu.transformers import GameTransformer
+from photon_ml_tpu.types import TaskType
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine_cache():
+    clear_engine_cache()
+    yield
+    clear_engine_cache()
+
+
+def fixed_model(rng, d=6, cls=LogisticRegressionModel, shard="global"):
+    means = jnp.asarray(rng.normal(size=d))
+    return FixedEffectModel(model=cls(Coefficients(means=means)), feature_shard_id=shard)
+
+
+def random_model(rng, re_type, n_entities, d=5, k_max=3, shard="re_shard"):
+    """Per-entity models over random column subsets of a [*, d] shard — the
+    loaded-from-disk layout (slot order = surviving columns)."""
+    proj = np.full((n_entities, k_max), -1, dtype=np.int32)
+    coeffs = np.zeros((n_entities, k_max))
+    for i in range(n_entities):
+        k = int(rng.integers(1, k_max + 1))
+        cols = np.sort(rng.choice(d, size=k, replace=False))
+        proj[i, :k] = cols
+        coeffs[i, :k] = rng.normal(size=k)
+    return RandomEffectModel(
+        re_type=re_type,
+        feature_shard_id=shard,
+        task=TaskType.LOGISTIC_REGRESSION,
+        entity_ids=tuple(f"e{i}" for i in range(n_entities)),
+        coeffs=jnp.asarray(coeffs),
+        proj_indices=jnp.asarray(proj),
+    )
+
+
+def glmix_input(rng, n=137, d=6, d_re=5, n_users=10, n_items=4, with_items=True):
+    """The BASELINE config #3 shape: dense fixed shard + sparse RE shard, with
+    ids that include entities the models never saw and columns outside every
+    per-entity projection."""
+    users = np.asarray(
+        [f"e{i}" for i in rng.integers(0, n_users + 3, size=n)], dtype=object
+    )
+    ids = {"userId": users}
+    if with_items:
+        ids["itemId"] = np.asarray(
+            [f"e{i}" for i in rng.integers(0, n_items + 2, size=n)], dtype=object
+        )
+    re_dense = rng.normal(size=(n, d_re))
+    re_dense[rng.random(size=re_dense.shape) < 0.4] = 0.0  # genuinely sparse
+    return GameInput(
+        features={
+            "global": rng.normal(size=(n, d)),
+            "re_shard": sp.csr_matrix(re_dense),
+        },
+        labels=(rng.random(n) > 0.5).astype(np.float64),
+        offsets=rng.normal(size=n),
+        id_columns=ids,
+    )
+
+
+def assert_parity(model, data, mesh=None, exact=True):
+    """Fused engine output must match the eager path, same dtype. Host paths
+    are BITWISE; mesh paths compare at one-ulp tolerance (exact=False) because
+    differently partitioned program shapes may associate a reduction
+    differently in the last bit."""
+
+    def check(a, b):
+        if exact:
+            np.testing.assert_array_equal(a, b)
+        else:
+            np.testing.assert_allclose(a, b, rtol=5e-15, atol=1e-14)
+
+    eager = GameTransformer(model=model, engine="eager", mesh=mesh)
+    fused = GameTransformer(model=model, engine="fused", mesh=mesh)
+    for include_offsets in (True, False):
+        se = eager.score(data, include_offsets=include_offsets)
+        sf = fused.score(data, include_offsets=include_offsets)
+        assert sf.dtype == se.dtype
+        assert sf.shape == se.shape
+        check(sf, se)
+    pe = eager.score_per_coordinate(data)
+    pf = fused.score_per_coordinate(data)
+    assert list(pf) == list(pe)
+    for cid in pe:
+        assert pf[cid].dtype == pe[cid].dtype, cid
+        check(pf[cid], pe[cid])
+
+
+# --------------------------------------------------------------------------
+# parity on the BASELINE shapes
+# --------------------------------------------------------------------------
+
+
+def test_parity_fixed_effect_only_logistic(rng):
+    """BASELINE config #1: fixed-effect-only logistic regression."""
+    model = GameModel(models={"fixed": fixed_model(rng)})
+    assert_parity(model, glmix_input(rng, with_items=False))
+
+
+@pytest.mark.parametrize("cls", [LinearRegressionModel, PoissonRegressionModel])
+def test_parity_fixed_effect_linear_poisson(rng, cls):
+    """BASELINE config #2's scoring surface: linear / Poisson fixed effects
+    (raw scores are task-independent margins; predict() differs by link)."""
+    model = GameModel(models={"fixed": fixed_model(rng, cls=cls)})
+    assert_parity(model, glmix_input(rng, with_items=False))
+
+
+def test_parity_glmix_three_coordinates(rng):
+    """BASELINE config #3: fixed + per-user + per-item random effects."""
+    model = GameModel(
+        models={
+            "fixed": fixed_model(rng),
+            "per-user": random_model(rng, "userId", 10),
+            "per-item": random_model(rng, "itemId", 4),
+        }
+    )
+    assert_parity(model, glmix_input(rng))
+
+
+def test_parity_mixed_precision_coordinates(rng):
+    """f64 fixed effect + f32 random-effect table: per-coordinate dtypes must
+    survive the fused path (no stack promotion)."""
+    re = random_model(rng, "userId", 10)
+    re = RandomEffectModel(
+        re_type=re.re_type,
+        feature_shard_id=re.feature_shard_id,
+        task=re.task,
+        entity_ids=re.entity_ids,
+        coeffs=re.coeffs.astype(jnp.float32),
+        proj_indices=re.proj_indices,
+    )
+    model = GameModel(models={"fixed": fixed_model(rng), "per-user": re})
+    data = glmix_input(rng, with_items=False)
+    assert_parity(model, data)
+    per = GameTransformer(model=model).score_per_coordinate(data)
+    assert per["per-user"].dtype == np.float32
+    assert per["fixed"].dtype == np.float64
+
+
+def test_parity_integer_offsets(rng):
+    """Integer offsets promote differently under jnp (f32+i64 -> f32) than
+    numpy (-> f64): the engine must take the host add and match eager."""
+    means = jnp.asarray(rng.normal(size=6).astype(np.float32))
+    model = GameModel(
+        models={
+            "fixed": FixedEffectModel(
+                model=LogisticRegressionModel(Coefficients(means=means)),
+                feature_shard_id="global",
+            )
+        }
+    )
+    n = 21
+    data = GameInput(
+        features={"global": rng.normal(size=(n, 6)).astype(np.float32)},
+        offsets=rng.integers(-3, 3, size=n),
+    )
+    assert_parity(model, data)
+
+
+def test_parity_projected_random_effect(rng):
+    """A RANDOM_PROJECTION coordinate: the engine must run the model's own
+    projector at request time, exactly like the eager dataset build."""
+    from photon_ml_tpu.data.projector import ProjectorConfig, ProjectorType, make_projector
+
+    d_re, kp = 7, 3
+    projector = make_projector(
+        ProjectorConfig(
+            projector_type=ProjectorType.RANDOM_PROJECTION, projected_dim=kp, seed=7
+        ),
+        original_dim=d_re,
+        intercept_index=0,
+    )
+    E = 6
+    k_cols = projector.projected_dim
+    model = GameModel(
+        models={
+            "fixed": fixed_model(rng),
+            "per-user": RandomEffectModel(
+                re_type="userId",
+                feature_shard_id="re_shard",
+                task=TaskType.LOGISTIC_REGRESSION,
+                entity_ids=tuple(f"e{i}" for i in range(E)),
+                coeffs=jnp.asarray(rng.normal(size=(E, k_cols))),
+                proj_indices=jnp.asarray(
+                    np.tile(np.arange(k_cols, dtype=np.int32), (E, 1))
+                ),
+                projector=projector,
+            ),
+        }
+    )
+    assert_parity(model, glmix_input(rng, d_re=d_re, n_users=E, with_items=False))
+
+
+def test_parity_mesh_placed(rng, eight_devices):
+    """1-D mesh scoring: fused-on-mesh matches eager-on-mesh (one-ulp
+    tolerance: the partitioned programs tile the reductions differently) and
+    the host fused path; n=137 is not divisible by 8 so the padded-sample
+    trim is genuinely exercised."""
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    model = GameModel(
+        models={
+            "fixed": fixed_model(rng),
+            "per-user": random_model(rng, "userId", 10),
+        }
+    )
+    data = glmix_input(rng, with_items=False)
+    mesh = make_mesh(8)
+    assert_parity(model, data, mesh=mesh, exact=False)
+    host = GameTransformer(model=model).score(data)
+    np.testing.assert_allclose(
+        GameTransformer(model=model, mesh=mesh).score(data), host,
+        rtol=5e-15, atol=1e-14,
+    )
+
+
+def test_transform_metrics_parity(rng):
+    model = GameModel(
+        models={
+            "fixed": fixed_model(rng),
+            "per-user": random_model(rng, "userId", 10),
+        }
+    )
+    data = glmix_input(rng, with_items=False)
+    s_e, m_e = GameTransformer(model=model, engine="eager", evaluators=["AUC"]).transform(data)
+    s_f, m_f = GameTransformer(model=model, evaluators=["AUC"]).transform(data)
+    np.testing.assert_array_equal(s_f, s_e)
+    assert m_f["AUC"] == m_e["AUC"]
+
+
+def test_predict_applies_link_on_device(rng):
+    model = GameModel(models={"fixed": fixed_model(rng)})
+    data = glmix_input(rng, with_items=False)
+    eng = get_engine(model)
+    margins = eng.score(data, include_offsets=True)
+    np.testing.assert_allclose(
+        eng.predict(data), 1.0 / (1.0 + np.exp(-margins)), rtol=1e-12
+    )
+
+
+# --------------------------------------------------------------------------
+# bucketing, retraces, engine cache
+# --------------------------------------------------------------------------
+
+
+def test_batch_bucketing_no_retrace_same_bucket(rng):
+    """Second request in the same power-of-two bucket must NOT retrace; the
+    next bucket up compiles exactly one new program (trace-counter fixture)."""
+    model = GameModel(
+        models={
+            "fixed": fixed_model(rng),
+            "per-user": random_model(rng, "userId", 10),
+        }
+    )
+    eng = get_engine(model)
+    assert eng.bucket(50) == 64 and eng.bucket(60) == 64 and eng.bucket(100) == 128
+
+    def req(n):
+        # dense RE shard (no zeros): constant per-row nnz, so only the batch
+        # axis varies between requests — the serving steady state
+        return GameInput(
+            features={
+                "global": rng.normal(size=(n, 6)),
+                "re_shard": sp.csr_matrix(rng.normal(size=(n, 5)) + 10.0),
+            },
+            id_columns={
+                "userId": np.asarray([f"e{i % 10}" for i in range(n)], dtype=object)
+            },
+        )
+
+    eng.score(req(50))
+    warm = eng.trace_count
+    assert warm >= 1
+    eng.score(req(60))  # same bucket: cache hit, no retrace
+    assert eng.trace_count == warm
+    eng.score(req(100))  # next bucket: exactly one new trace
+    assert eng.trace_count == warm + 1
+    eng.score(req(128))
+    assert eng.trace_count == warm + 1
+
+
+def test_nnz_width_bucketing_no_retrace(rng):
+    """Requests whose max row nnz varies inside one pow2 width bucket must not
+    retrace; crossing the width bucket compiles exactly one new program."""
+    model = GameModel(models={"per-user": random_model(rng, "userId", 6, d=20)})
+    eng = get_engine(model)
+
+    def req(nnz_per_row):
+        n = 32
+        dense = np.zeros((n, 20))
+        for i in range(n):
+            cols = rng.choice(20, size=nnz_per_row, replace=False)
+            dense[i, cols] = rng.normal(size=nnz_per_row) + 5.0
+        return GameInput(
+            features={"re_shard": sp.csr_matrix(dense)},
+            id_columns={
+                "userId": np.asarray([f"e{i % 6}" for i in range(n)], dtype=object)
+            },
+        )
+
+    eng.score(req(5))  # W=5 -> width bucket 8
+    warm = eng.trace_count
+    eng.score(req(7))  # W=7 -> still 8: no retrace
+    eng.score(req(3))  # W=3 -> 4: narrower widths do re-bucket...
+    eng.score(req(8))  # ...and 8 again is a cache hit
+    assert eng.trace_count == warm + 1  # only the W->4 program was new
+    eng.score(req(12))  # W=12 -> 16: one new program
+    assert eng.trace_count == warm + 2
+
+
+def test_entity_id_dtype_mismatch_degrades_like_eager(rng):
+    """Integer-entity model served string ids must score those rows 0 (the
+    eager dict-lookup miss), not crash in searchsorted."""
+    E, d = 5, 4
+    model = GameModel(
+        models={
+            "per-user": RandomEffectModel(
+                re_type="userId",
+                feature_shard_id="re_shard",
+                task=TaskType.LOGISTIC_REGRESSION,
+                entity_ids=tuple(range(E)),
+                coeffs=jnp.asarray(rng.normal(size=(E, d))),
+                proj_indices=jnp.asarray(np.tile(np.arange(d, dtype=np.int32), (E, 1))),
+            )
+        }
+    )
+    n = 11
+    data = GameInput(
+        features={"re_shard": sp.csr_matrix(rng.normal(size=(n, d)))},
+        id_columns={"userId": np.asarray([f"u{i}" for i in range(n)], dtype=object)},
+    )
+    out = GameTransformer(model=model).score(data, include_offsets=False)
+    np.testing.assert_array_equal(out, np.zeros(n))
+    # matching int ids still resolve through the same engine
+    data_int = GameInput(
+        features={"re_shard": sp.csr_matrix(rng.normal(size=(n, d)))},
+        id_columns={"userId": np.arange(n) % E},
+    )
+    assert np.abs(GameTransformer(model=model).score(data_int, include_offsets=False)).max() > 0
+
+
+def test_get_engine_content_keyed_cache(rng):
+    m1 = GameModel(models={"fixed": fixed_model(rng)})
+    # same content -> same fingerprint -> same engine instance
+    m2 = GameModel(models={"fixed": m1.models["fixed"]})
+    assert get_engine(m1) is get_engine(m2)
+    assert model_fingerprint(m1) == model_fingerprint(m2)
+    # different coefficients -> different engine
+    m3 = GameModel(models={"fixed": fixed_model(rng)})
+    assert model_fingerprint(m3) != model_fingerprint(m1)
+    assert get_engine(m3) is not get_engine(m1)
+
+
+def test_engine_rejects_2d_mesh_and_transformer_falls_back(rng, eight_devices):
+    from photon_ml_tpu.parallel.feature_sharded import make_mesh2
+
+    mesh2 = make_mesh2(n_data=4, n_model=2)
+    model = GameModel(models={"fixed": fixed_model(rng)})
+    with pytest.raises(ValueError, match="1-D"):
+        GameServingEngine(model, mesh=mesh2)
+    # the transformer silently takes the eager path on a 2-D mesh
+    data = glmix_input(rng, with_items=False)
+    host = GameTransformer(model=model).score(data)
+    np.testing.assert_allclose(
+        GameTransformer(model=model, mesh=mesh2).score(data), host, atol=1e-10
+    )
+
+
+# --------------------------------------------------------------------------
+# zero-coordinate regression (ISSUE 1 satellite)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["eager", "fused"])
+def test_zero_coordinate_model_scores_offsets_shape(rng, engine):
+    """Offsets-only scoring on an empty GameModel must return a [N] array,
+    not a 0.0 scalar (np.sum([], axis=0) regression)."""
+    n = 17
+    offsets = rng.normal(size=n)
+    data = GameInput(
+        features={"global": rng.normal(size=(n, 3))},
+        offsets=offsets,
+    )
+    t = GameTransformer(model=GameModel(models={}), engine=engine)
+    scored = t.score(data)
+    assert scored.shape == (n,)
+    assert scored.dtype == np.float64  # numpy zeros + promotion, both engines
+    np.testing.assert_array_equal(scored, offsets)
+    raw = t.score(data, include_offsets=False)
+    assert raw.shape == (n,)
+    np.testing.assert_array_equal(raw, np.zeros(n))
+    assert t.score_per_coordinate(data) == {}
+
+
+def test_coordinate_named_offsets_does_not_collide(rng):
+    """Coordinate ids are user config strings; one literally named "offsets"
+    must not collide with the engine's reserved offsets batch entry."""
+    model = GameModel(models={"offsets": fixed_model(rng)})
+    assert_parity(model, glmix_input(rng, with_items=False))
+
+
+def test_unseen_entities_and_columns_score_zero(rng):
+    """Entities without a model and columns outside an entity's projection
+    contribute exactly 0 through the fused path (aligned_to semantics)."""
+    model = GameModel(models={"per-user": random_model(rng, "userId", 3, d=5)})
+    n = 9
+    data = GameInput(
+        features={"re_shard": sp.csr_matrix(rng.normal(size=(n, 5)))},
+        id_columns={"userId": np.asarray(["nobody"] * n, dtype=object)},
+    )
+    np.testing.assert_array_equal(
+        GameTransformer(model=model).score(data, include_offsets=False), np.zeros(n)
+    )
