@@ -116,9 +116,9 @@ def main(argv=None):
         # allclose at tight rtol conflates precision-mode differences with
         # kernel bugs. The honest parity gate: the fused kernel must be at
         # least as close to the f64 ground truth as the stock lowering.
-        X64 = np.asarray(X, dtype=np.float64)
-        y64, off64, w64 = (np.asarray(v, dtype=np.float64) for v in (y, off, w))
-        coef64, v64 = (np.asarray(v, dtype=np.float64) for v in (coef, v))
+        X64 = np.asarray(X, dtype=np.float64)  # jaxlint: disable=HS001 f64 host reference build, outside the timed region
+        y64, off64, w64 = (np.asarray(v, dtype=np.float64) for v in (y, off, w))  # jaxlint: disable=HS001 f64 host reference build, outside the timed region
+        coef64, v64 = (np.asarray(v, dtype=np.float64) for v in (coef, v))  # jaxlint: disable=HS001 f64 host reference build, outside the timed region
         z64 = X64 @ coef64 + off64
         ez = np.exp(-np.abs(z64))
         l64 = np.log1p(ez) + np.maximum(z64, 0.0) - y64 * z64  # logistic loss

@@ -12,7 +12,11 @@ Also reported, per the honest-ratio rules (docs/PERFORMANCE.md):
 
 - ``p50_ms`` / ``p99_ms`` per-request latency over the measured stream;
 - ``retraces_after_warmup`` — MUST be 0, asserting the compile-cache claim
-  (a nonzero value voids the steady-state reading and fails the run);
+  (a nonzero value voids the steady-state reading and fails the run); the
+  measured region additionally runs inside
+  ``photon_ml_tpu.analysis.runtime_guard.sync_discipline``, so ANY jaxpr
+  trace in the region (engine's or not) raises RetraceError immediately and
+  implicit device->host transfers raise on accelerator backends;
 - ``eager_samples_per_sec`` and ``vs_eager`` — the same request stream
   through the eager per-coordinate GameTransformer path on the SAME backend,
   the denominator for the engine's speedup claim;
@@ -126,16 +130,26 @@ def run(n_requests: int, batch: int, scale: float, eager_requests: int) -> dict:
     engine.score(requests[0])
     warmup_traces = engine.trace_count
 
+    # The measured region runs under the runtime guard: the zero-retrace
+    # steady-state claim is ASSERTED (RetraceError aborts the run), not just
+    # reported, and on accelerators any unnamed device->host transfer in the
+    # serving path raises too (CPU reads device buffers zero-copy below the
+    # transfer guard, so there the d2h half is best-effort — see
+    # photon_ml_tpu/analysis/runtime_guard.py).
+    from photon_ml_tpu.analysis.runtime_guard import sync_discipline
+
     latencies = []
     samples = 0
-    t0 = time.perf_counter()
-    for req in requests:
-        t = time.perf_counter()
-        out = engine.score(req)
-        latencies.append(time.perf_counter() - t)
-        samples += len(out)
-    elapsed = time.perf_counter() - t0
+    with sync_discipline(what="scoring_bench measured region") as region:
+        t0 = time.perf_counter()
+        for req in requests:
+            t = time.perf_counter()
+            out = engine.score(req)
+            latencies.append(time.perf_counter() - t)
+            samples += len(out)
+        elapsed = time.perf_counter() - t0
     retraces = engine.trace_count - warmup_traces
+    guard_traces = region.traces
 
     # eager denominator: same stream prefix, per-coordinate dispatch path —
     # warmed up with one untimed request, symmetric with the fused warmup
@@ -167,6 +181,9 @@ def run(n_requests: int, batch: int, scale: float, eager_requests: int) -> dict:
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
         "retraces_after_warmup": int(retraces),
+        # process-wide jaxpr traces inside the guarded region (0 = the guard
+        # held; a nonzero value would already have raised RetraceError)
+        "guard_traces": int(guard_traces),
         "warmup_traces": int(warmup_traces),
         "parity_bitwise": parity,
         "eager_samples_per_sec": round(eager_sps, 2) if eager_sps else None,

@@ -1,0 +1,63 @@
+"""Static analysis (jaxlint) + runtime guards for JAX dispatch discipline.
+
+Two halves, one hazard class (docs/PERFORMANCE.md "Static analysis & sync
+discipline"):
+
+- ``rules`` / ``visitor`` / ``linter`` / ``baseline`` — the jaxlint AST
+  engine. Pure stdlib by design: importing them must never pull in jax, so
+  the CI lint job and editor integrations can run against source alone.
+  CLI entry point: ``python tools/jaxlint.py photon_ml_tpu``.
+- ``runtime_guard`` — the runtime complement (``jax.transfer_guard`` +
+  jaxpr-trace counter). Imports jax; import it explicitly as
+  ``photon_ml_tpu.analysis.runtime_guard`` (or via the lazy names below).
+"""
+
+from photon_ml_tpu.analysis.rules import (
+    Finding,
+    Rule,
+    RuleConfig,
+    RULES,
+    Severity,
+)
+from photon_ml_tpu.analysis.linter import (
+    LintResult,
+    lint_paths,
+    lint_source,
+)
+
+# Lazy: runtime_guard needs jax; the static half must stay importable without it.
+_LAZY = {
+    "no_retrace": "photon_ml_tpu.analysis.runtime_guard",
+    "no_implicit_transfers": "photon_ml_tpu.analysis.runtime_guard",
+    "sync_discipline": "photon_ml_tpu.analysis.runtime_guard",
+    "RetraceError": "photon_ml_tpu.analysis.runtime_guard",
+    "trace_events": "photon_ml_tpu.analysis.runtime_guard",
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RuleConfig",
+    "RULES",
+    "Severity",
+    "LintResult",
+    "lint_paths",
+    "lint_source",
+    *sorted(_LAZY),
+]

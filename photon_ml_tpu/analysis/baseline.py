@@ -1,0 +1,118 @@
+"""jaxlint baseline: accept existing findings, fail only on drift.
+
+The committed baseline (``tools/jaxlint_baseline.json``) lets the lint gate
+new code without first paying down every historical finding. Two invariants
+make it a ratchet instead of a rug:
+
+- a finding NOT covered by the baseline fails the run (new hazards can't
+  land), and
+- a baseline entry with no matching finding ALSO fails the run (fixing a
+  hazard forces the shrunken baseline to be committed, so the baseline only
+  ever gets smaller).
+
+Entries are keyed ``path::rule::<stripped source line text>`` with a count,
+NOT by line number: inserting an unrelated line above a baselined finding
+must not break CI. Moving or duplicating the offending line does change the
+key/count — that is drift and should be re-reviewed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable
+
+from photon_ml_tpu.analysis.rules import Finding
+
+BASELINE_VERSION = 1
+
+
+def finding_key(f: Finding) -> str:
+    return f"{f.path}::{f.rule}::{f.line_text}"
+
+
+def to_counts(findings: Iterable[Finding]) -> dict[str, int]:
+    counts: dict[str, int] = {}
+    for f in findings:
+        k = finding_key(f)
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+@dataclasses.dataclass
+class BaselineDiff:
+    new: list  # findings beyond the baselined count for their key
+    stale: list  # baseline keys whose finding no longer exists (count deficit)
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.stale
+
+
+def diff(findings: list, baseline_counts: dict[str, int],
+         scanned_paths: set | None = None) -> BaselineDiff:
+    """``scanned_paths`` (reported-relative paths actually linted this run)
+    scopes the staleness check: a baseline entry for a file outside this
+    scan's paths is not stale, it just wasn't looked at — so a narrow scan
+    (e.g. one package dir) can run clean against a repo-wide baseline."""
+    new: list = []
+    per_key: dict[str, list] = {}
+    for f in findings:
+        per_key.setdefault(finding_key(f), []).append(f)
+    for key, fs in per_key.items():
+        allowed = baseline_counts.get(key, 0)
+        if len(fs) > allowed:
+            new.extend(fs[allowed:])
+    stale = [
+        {"key": key, "missing": count - len(per_key.get(key, []))}
+        for key, count in sorted(baseline_counts.items())
+        if len(per_key.get(key, [])) < count
+        and (scanned_paths is None or key.split("::", 1)[0] in scanned_paths)
+    ]
+    return BaselineDiff(new=new, stale=stale)
+
+
+def load(path: str) -> dict[str, int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}, "
+            f"expected {BASELINE_VERSION}"
+        )
+    entries = data.get("entries", {})
+    if not all(isinstance(v, int) and v > 0 for v in entries.values()):
+        raise ValueError(f"baseline {path} has non-positive entry counts")
+    return entries
+
+
+def save(path: str, findings: list, scanned_paths: set | None = None) -> dict:
+    """Write the baseline. Mirrors diff()'s staleness scoping: entries for
+    files OUTSIDE ``scanned_paths`` are preserved from the existing file, so
+    regenerating from a narrow scan cannot silently drop (and thereby
+    re-arm) accepted findings in files that scan never looked at."""
+    counts = to_counts(findings)
+    if scanned_paths is not None:
+        try:
+            existing = load(path)
+        except (OSError, ValueError):
+            existing = {}
+        for key, count in existing.items():
+            if key.split("::", 1)[0] not in scanned_paths:
+                counts[key] = count
+    doc = {
+        "version": BASELINE_VERSION,
+        "comment": (
+            "jaxlint accepted-findings baseline. Entries are "
+            "'path::rule::stripped-source-line' -> count. Do not add entries "
+            "by hand: fix the finding or suppress it inline with a reason. "
+            "Regenerate (only ever smaller) with: python tools/jaxlint.py "
+            "photon_ml_tpu benchmarks tests bench.py tools --update-baseline"
+        ),
+        "total": sum(counts.values()),
+        "entries": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return doc

@@ -1,0 +1,211 @@
+"""jaxlint front-end: file walking, suppression handling, output formatting.
+
+Pure stdlib (ast/json/pathlib) — importable and runnable without jax, so the
+CI lint job analyzes sources without building the runtime environment. The
+runtime complement (transfer/retrace guards) lives in ``runtime_guard.py``
+and is the only module here that imports jax.
+
+Suppression syntax, one line at a time, reason mandatory::
+
+    score = np.asarray(out)  # jaxlint: disable=HS001 boundary transfer to caller
+
+``disable=HS001,RT001 <reason>`` suppresses several rules; ``disable <reason>``
+(no ids) suppresses every rule on the line. A suppression with no reason, or
+naming an unknown rule id, is itself an error (SUP001) — and SUP001 cannot be
+suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Optional
+
+from photon_ml_tpu.analysis import baseline as baseline_mod
+from photon_ml_tpu.analysis.rules import Finding, RuleConfig, RULES, Severity
+from photon_ml_tpu.analysis.visitor import analyze_module
+
+# ids: comma-separated tokens (spaces allowed AROUND commas only) matched
+# greedily, so "disable=HS001, RT001 why" yields ids="HS001, RT001" and
+# reason="why" — a lazy ids group would stop at the first space and silently
+# narrow the suppression to HS001 with "RT001 why" as the reason.
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable"
+    r"(?:=(?P<ids>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*))?"
+    r"(?:\s+(?P<reason>\S.*))?$"
+)
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    rules: Optional[frozenset]  # None = all rules
+    reason: str
+
+    def covers(self, f: Finding) -> bool:
+        if f.rule == "SUP001":
+            return False
+        return self.rules is None or f.rule in self.rules
+
+
+def parse_suppressions(source: str, path: str) -> tuple[list, list]:
+    """Return (suppressions, sup_findings) for one file's source."""
+    sups: list = []
+    bad: list = []
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        ids_raw = m.group("ids")
+        reason = (m.group("reason") or "").strip()
+        rules = None
+        if ids_raw is not None and ids_raw.strip():
+            rules = frozenset(r.strip().upper() for r in ids_raw.split(",") if r.strip())
+            unknown = rules - set(RULES)
+            if unknown:
+                bad.append(_sup_finding(
+                    path, lineno, line,
+                    f"suppression names unknown rule id(s) {sorted(unknown)}",
+                ))
+                rules = rules & set(RULES)
+        if not reason:
+            bad.append(_sup_finding(
+                path, lineno, line,
+                "suppression has no reason; say why this hazard is intentional",
+            ))
+            continue  # a reasonless suppression does not suppress anything
+        sups.append(Suppression(line=lineno, rules=rules, reason=reason))
+    return sups, bad
+
+
+def _sup_finding(path: str, lineno: int, line: str, message: str) -> Finding:
+    return Finding(
+        rule="SUP001",
+        severity=RULES["SUP001"].default_severity,
+        path=path,
+        line=lineno,
+        col=1,
+        message=message,
+        hint=RULES["SUP001"].hint,
+        line_text=line.strip(),
+    )
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list  # active (unsuppressed) findings
+    suppressed: list
+    errors: list  # [(path, message)] files that failed to parse
+    scanned: set = dataclasses.field(default_factory=set)  # relative paths linted
+
+    def counts(self) -> dict[str, int]:
+        by_sev: dict[str, int] = {}
+        for f in self.findings:
+            by_sev[f.severity.name.lower()] = by_sev.get(f.severity.name.lower(), 0) + 1
+        return by_sev
+
+
+def lint_source(source: str, path: str, config: Optional[RuleConfig] = None) -> LintResult:
+    """Lint one file's source text. ``path`` is the reporting/baseline key."""
+    config = config or RuleConfig()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return LintResult(findings=[], suppressed=[], errors=[(path, f"syntax error: {e}")])
+    lines = source.splitlines()
+
+    def with_text(f: Finding) -> Finding:
+        text = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
+        return dataclasses.replace(f, line_text=text)
+
+    raw = [with_text(f) for f in analyze_module(tree, path, config)]
+    sups, sup_findings = parse_suppressions(source, path)
+    if not config.enabled("SUP001"):
+        sup_findings = []
+    by_line: dict[int, list] = {}
+    for s in sups:
+        by_line.setdefault(s.line, []).append(s)
+
+    active, suppressed = [], []
+    for f in raw:
+        matches = [s for s in by_line.get(f.line, []) if s.covers(f)]
+        if matches:
+            suppressed.append(dataclasses.replace(f, suppressed=True))
+        else:
+            active.append(f)
+    active.extend(sup_findings)
+    active.sort(key=lambda f: (f.line, f.col, f.rule))
+    return LintResult(findings=active, suppressed=suppressed, errors=[])
+
+
+def iter_python_files(paths: list, exclude: Optional[list] = None) -> list:
+    """``exclude``: path substrings (posix) — any file whose path contains one
+    is skipped (e.g. ``tests/fixtures/jaxlint`` for intentional violations)."""
+    exclude = [str(e).replace("\\", "/") for e in (exclude or [])]
+
+    def excluded(f: Path) -> bool:
+        s = f.as_posix()
+        return any(e in s for e in exclude)
+
+    out = []
+    for p in paths:
+        p = Path(p)
+        if p.is_file() and p.suffix == ".py" and not excluded(p):
+            out.append(p)
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                # skip-dir check applies only BELOW the scan root: a checkout
+                # living under a hidden/"build"-named ancestor must still scan
+                rel_parts = f.relative_to(p).parts
+                if any(part in _SKIP_DIRS or part.startswith(".") for part in rel_parts):
+                    continue
+                if not excluded(f):
+                    out.append(f)
+    return out
+
+
+def lint_paths(paths: list, config: Optional[RuleConfig] = None,
+               rel_root: Optional[str] = None,
+               exclude: Optional[list] = None) -> LintResult:
+    """Lint files/directories. Reported paths are made relative to
+    ``rel_root`` (default: cwd) when possible, so baseline keys are stable
+    regardless of how the target path was spelled."""
+    config = config or RuleConfig()
+    root = Path(rel_root) if rel_root else Path.cwd()
+    findings, suppressed, errors = [], [], []
+    scanned: set = set()
+    for f in iter_python_files(paths, exclude=exclude):
+        try:
+            rel = f.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        try:
+            source = f.read_text(encoding="utf-8")
+        except OSError as e:
+            errors.append((rel, f"unreadable: {e}"))
+            continue
+        r = lint_source(source, rel, config)
+        if r.errors:
+            # an unanalyzed file was not scanned: its baseline entries must
+            # not read as stale, and the caller must not exit green
+            errors.extend(r.errors)
+            continue
+        scanned.add(rel)
+        findings.extend(r.findings)
+        suppressed.extend(r.suppressed)
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+    suppressed.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+    return LintResult(findings=findings, suppressed=suppressed, errors=errors,
+                      scanned=scanned)
+
+
+def apply_baseline(result: LintResult, baseline_path: str):
+    """Compare active findings against a committed baseline; returns a
+    ``baseline.BaselineDiff``. Staleness is scoped to the files this result
+    actually scanned."""
+    counts = baseline_mod.load(baseline_path)
+    return baseline_mod.diff(result.findings, counts, scanned_paths=result.scanned)

@@ -1,0 +1,208 @@
+"""jaxlint rule registry: ids, default severities, messages, fix hints.
+
+Each rule names one JAX dispatch-discipline hazard (docs/PERFORMANCE.md
+"Static analysis & sync discipline"). The registry is data, not behavior —
+detection lives in ``visitor.py`` — so per-rule enable/severity config and the
+docs' rule catalog both read from one table.
+
+Severity semantics:
+
+- ``error``   — near-certain defect: raises under trace, or a per-iteration
+                host sync in jit-reachable code (the hazard class PR 1's
+                serving engine removed; arXiv:1612.01437 measures this
+                sync/serialization overhead dominating distributed ML time).
+- ``warning`` — likely stall: a host sync inside a Python loop on a value
+                that flows from a jax op, or a retrace-prone call pattern.
+- ``info``    — improvement opportunity (e.g. a missing ``donate_argnums``).
+
+Suppressions are inline and must carry a reason:
+``# jaxlint: disable=HS001 boundary transfer, scores leave the device here``.
+A bare ``# jaxlint: disable=HS001`` is itself an error (SUP001): the lint is
+only useful if every suppression documents why the transfer is intentional.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class Severity(enum.IntEnum):
+    INFO = 10
+    WARNING = 20
+    ERROR = 30
+
+    @classmethod
+    def parse(cls, text: str) -> "Severity":
+        try:
+            return cls[text.strip().upper()]
+        except KeyError:
+            raise ValueError(
+                f"unknown severity {text!r}; expected one of "
+                f"{[s.name.lower() for s in cls]}"
+            ) from None
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    default_severity: Severity
+    description: str
+    hint: str
+
+
+RULES: dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            id="HS001",
+            name="host-sync",
+            default_severity=Severity.WARNING,
+            description=(
+                "Host synchronization (.item()/float()/int()/bool()/"
+                "np.asarray/np.array/jax.device_get/.block_until_ready) on a "
+                "likely-traced value inside jit-reachable code or a Python loop"
+            ),
+            hint=(
+                "batch device reads into one jax.device_get after the loop, or "
+                "keep the value device-resident (jnp.where instead of Python "
+                "branching on it)"
+            ),
+        ),
+        Rule(
+            id="RT001",
+            name="retrace-hazard",
+            default_severity=Severity.WARNING,
+            description=(
+                "Retrace hazard: non-array Python argument (scalar literal, "
+                "dict, list) passed to a jitted callable without "
+                "static_argnums/static_argnames, or a jnp.array(...) literal "
+                "constructed inside a jitted body"
+            ),
+            hint=(
+                "declare config-like arguments in static_argnames (or close "
+                "over them); hoist constant arrays out of the jitted body"
+            ),
+        ),
+        Rule(
+            id="TR001",
+            name="tracer-control-flow",
+            default_severity=Severity.ERROR,
+            description=(
+                "Python control flow (if/while/assert/ternary) on a traced "
+                "value inside a jitted function — raises "
+                "ConcretizationTypeError at trace time or silently bakes one "
+                "branch into the program"
+            ),
+            hint=(
+                "use lax.cond/lax.while_loop/jnp.where, or mark the driving "
+                "argument static"
+            ),
+        ),
+        Rule(
+            id="PR001",
+            name="print-in-jit",
+            default_severity=Severity.WARNING,
+            description=(
+                "print()/logging call inside a jitted body: runs only at "
+                "trace time, so it prints tracers once and then never again"
+            ),
+            hint="use jax.debug.print(...) or hoist the logging out of the jitted body",
+        ),
+        Rule(
+            id="DN001",
+            name="missing-donate",
+            default_severity=Severity.INFO,
+            description=(
+                "Jitted function functionally updates a parameter buffer "
+                "(x.at[...] usage) without donate_argnums/donate_argnames — "
+                "XLA must keep both the input and output buffers live"
+            ),
+            hint=(
+                "add donate_argnums/donate_argnames for update-in-place "
+                "parameters the caller no longer needs"
+            ),
+        ),
+        Rule(
+            id="NP001",
+            name="numpy-inplace-on-jax",
+            default_severity=Severity.ERROR,
+            description=(
+                "In-place numpy mutation (arr[...] = v, arr += v) of a value "
+                "that flows from a jax op — jax arrays are immutable and "
+                "np.asarray views of them are read-only; this raises or "
+                "silently diverges"
+            ),
+            hint=(
+                "use arr = arr.at[...].set(v) on device, or copy explicitly "
+                "with np.array(arr) before mutating on host"
+            ),
+        ),
+        Rule(
+            id="SUP001",
+            name="suppression-missing-reason",
+            default_severity=Severity.ERROR,
+            description=(
+                "Inline suppression without a reason: every "
+                "'# jaxlint: disable=...' must say why the hazard is "
+                "intentional"
+            ),
+            hint="append a reason: '# jaxlint: disable=HS001 <why this sync is intended>'",
+        ),
+    )
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One reported hazard. ``line_text`` (the stripped source line) keys the
+    baseline so entries survive unrelated line-number drift."""
+
+    rule: str
+    severity: Severity
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str
+    line_text: str = ""
+    suppressed: bool = False
+
+    def format_human(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"{self.severity.name.lower()}: {self.message}\n"
+            f"    hint: {self.hint}"
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity.name.lower(),
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+            "suppressed": self.suppressed,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleConfig:
+    """Per-run rule configuration: which rules run and at what severity."""
+
+    disabled: frozenset[str] = frozenset()
+    severity_overrides: dict[str, Severity] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        unknown = (set(self.disabled) | set(self.severity_overrides)) - set(RULES)
+        if unknown:
+            raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+
+    def enabled(self, rule_id: str) -> bool:
+        return rule_id not in self.disabled
+
+    def severity(self, rule_id: str) -> Severity:
+        return self.severity_overrides.get(rule_id, RULES[rule_id].default_severity)

@@ -1,0 +1,758 @@
+"""AST machinery behind jaxlint: jit-context discovery, value taint, rule checks.
+
+Two passes per module, stdlib-``ast`` only (the linter must run without jax
+installed — CI's lint job analyzes source, it never imports it):
+
+**Pass A (ModuleIndex)** resolves import aliases to canonical dotted names
+(``jnp.array`` -> ``jax.numpy.array``), collects every function/lambda, and
+decides which execute under tracing: direct ``@jax.jit`` / ``jax.jit(f)``
+wrapping (including ``functools.partial(jax.jit, ...)`` decorators), bodies
+handed to traced higher-order functions (``lax.scan`` / ``while_loop`` /
+``cond`` / ``vmap`` / ``grad`` / ...), functions *called from* any of those
+(intra-module call-graph closure over simple names), and functions nested
+inside a traced function (their bodies run at trace time).
+
+**Pass B (FunctionAnalyzer)** walks each function with a "likely-traced"
+taint set: parameters of traced functions (minus ``static_argnums`` /
+``static_argnames``), names assigned from ``jax.* / jax.numpy.* / jax.lax.*``
+calls or from calls to known-jitted functions, and anything arithmetic
+derived from those. Static metadata access (``x.shape``, ``x.ndim``,
+``x.dtype``, ``len(x)``, ``isinstance(x, ...)``, ``x is None``) never taints
+a use — those are the false-positive guards the fixture suite pins.
+
+The taint pass is linear per statement with loop bodies walked twice, so
+loop-carried flows (``w = step(w)`` then ``float(loss(w))``) are seen without
+a general fixpoint. It is a heuristic, not an escape analysis: it under-reports
+flows through unannotated helper calls, and the committed baseline absorbs
+what it does find in existing code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from photon_ml_tpu.analysis.rules import Finding, RuleConfig, RULES, Severity
+
+# canonical dotted prefixes whose calls return device values
+_TRACED_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.", "jax.scipy.")
+# canonical callables that wrap a function in jit
+_JIT_WRAPPERS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+# canonical higher-order functions -> positional indices of traced callables
+# ("rest" = every argument from that index on may be a callable / list of them)
+_TRACED_HOF: dict[str, tuple] = {
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1, 2, 3, 4, 5, 6, 7),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.custom_root": (1, 2),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.jacfwd": (0,),
+    "jax.jacrev": (0,),
+    "jax.hessian": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.linearize": (0,),
+    "jax.custom_jvp": (0,),
+    "jax.custom_vjp": (0,),
+}
+# host-sync canonical function calls (argument must be likely-traced)
+_SYNC_CALLS = {"numpy.asarray", "numpy.array", "float", "int", "bool", "complex"}
+# host-sync method names (receiver must be likely-traced)
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# attribute reads that are static under tracing (never taint a use, and
+# control flow on them is fine)
+_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "nbytes", "itemsize", "sharding",
+    "aval", "weak_type", "name", "names",
+}
+# builtins whose result is host/static even on traced arguments
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr", "id", "repr", "str"}
+_LOGGER_NAMES = {"logging", "logger", "log", "LOG", "LOGGER", "_logger", "_log"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception", "critical", "log"}
+
+_TAINT_TRACED = "traced"  # value lives on device / is a tracer
+_TAINT_NPVIEW = "npview"  # np.asarray of a device value: host, but read-only
+
+
+@dataclasses.dataclass
+class JitParams:
+    static_argnums: tuple = ()
+    static_argnames: tuple = ()
+    has_donate: bool = False
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    name: str
+    parent: Optional["FuncInfo"]
+    jitted: bool = False  # directly wrapped / traced-HOF body
+    jit_params: JitParams = dataclasses.field(default_factory=JitParams)
+    callees: set = dataclasses.field(default_factory=set)
+    jit_context: bool = False  # jitted, reachable from jitted, or nested in one
+
+
+def _const_tuple(node) -> tuple:
+    """Extract a tuple of constants from Constant / Tuple / List, else ()."""
+    if isinstance(node, ast.Constant):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts if isinstance(e, ast.Constant)
+        )
+    return ()
+
+
+def _is_literal_display(node) -> bool:
+    """A Python literal a jit boundary would re-trace on / fail on: a scalar
+    constant (not None/str), or a dict/list display."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, bool, complex)) and not isinstance(
+            node.value, str
+        ) and node.value is not None
+    return isinstance(node, (ast.Dict, ast.List, ast.DictComp, ast.ListComp))
+
+
+class ModuleIndex(ast.NodeVisitor):
+    """Pass A: import aliases, function table, jit marking, call graph."""
+
+    def __init__(self):
+        self.aliases: dict[str, str] = {}
+        self.functions: dict[int, FuncInfo] = {}  # id(node) -> info
+        self.by_name: dict[str, list[FuncInfo]] = {}
+        self.jit_aliases: dict[str, JitParams] = {}  # name bound to jax.jit(f)
+        self._stack: list[FuncInfo] = []
+
+    # -- imports --------------------------------------------------------
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            self.aliases[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module and node.level == 0:
+            for a in node.names:
+                self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        self.generic_visit(node)
+
+    def canonical(self, node) -> Optional[str]:
+        """Dotted name of an expression with the first segment de-aliased, or
+        None for non-name expressions."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        parts[0] = self.aliases.get(parts[0], parts[0])
+        return ".".join(parts)
+
+    # -- functions ------------------------------------------------------
+    def _add_function(self, node, name: str):
+        info = FuncInfo(node=node, name=name, parent=self._stack[-1] if self._stack else None)
+        self.functions[id(node)] = info
+        self.by_name.setdefault(name, []).append(info)
+        return info
+
+    def _jit_params_from_call(self, call: ast.Call) -> JitParams:
+        p = JitParams()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                p.static_argnums = _const_tuple(kw.value)
+            elif kw.arg == "static_argnames":
+                p.static_argnames = _const_tuple(kw.value)
+            elif kw.arg in ("donate_argnums", "donate_argnames"):
+                p.has_donate = True
+        return p
+
+    def _decorator_jit(self, dec) -> Optional[JitParams]:
+        """JitParams if this decorator jits the function, else None."""
+        if self.canonical(dec) in _JIT_WRAPPERS:
+            return JitParams()
+        if isinstance(dec, ast.Call):
+            c = self.canonical(dec.func)
+            if c in _JIT_WRAPPERS:
+                return self._jit_params_from_call(dec)
+            if c == "functools.partial" and dec.args:
+                if self.canonical(dec.args[0]) in _JIT_WRAPPERS:
+                    return self._jit_params_from_call(dec)
+        return None
+
+    def _visit_funcdef(self, node):
+        info = self._add_function(node, node.name)
+        for dec in node.decorator_list:
+            p = self._decorator_jit(dec)
+            if p is not None:
+                info.jitted = True
+                info.jit_params = p
+            elif self.canonical(dec) in _TRACED_HOF or (
+                isinstance(dec, ast.Call) and self.canonical(dec.func) in _TRACED_HOF
+            ):
+                info.jitted = True
+        self._stack.append(info)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_funcdef
+    visit_AsyncFunctionDef = _visit_funcdef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self._add_function(node, "<lambda>")
+        info = self.functions[id(node)]
+        self._stack.append(info)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    # -- jit wrapping & traced-HOF call sites ---------------------------
+    def _mark_name_jitted(self, name: str, params: JitParams):
+        for info in self.by_name.get(name, []):
+            info.jitted = True
+            if params.static_argnums or params.static_argnames or params.has_donate:
+                info.jit_params = params
+
+    def _mark_callable_arg(self, node, params: JitParams):
+        if isinstance(node, ast.Name):
+            self._mark_name_jitted(node.id, params)
+        elif isinstance(node, ast.Lambda):
+            info = self.functions.get(id(node))
+            if info:
+                info.jitted = True
+        elif isinstance(node, (ast.List, ast.Tuple)):
+            for e in node.elts:
+                self._mark_callable_arg(e, params)
+        elif isinstance(node, ast.Attribute):
+            # self.method / obj.method: mark same-named functions in module
+            self._mark_name_jitted(node.attr, params)
+
+    def visit_Call(self, node: ast.Call):
+        c = self.canonical(node.func)
+        if c in _JIT_WRAPPERS and node.args:
+            self._mark_callable_arg(node.args[0], self._jit_params_from_call(node))
+        elif c in _TRACED_HOF:
+            for pos in _TRACED_HOF[c]:
+                if pos < len(node.args):
+                    self._mark_callable_arg(node.args[pos], JitParams())
+            for kw in node.keywords:
+                if kw.arg in ("body_fun", "cond_fun", "f", "fun", "true_fun", "false_fun"):
+                    self._mark_callable_arg(kw.value, JitParams())
+        # call graph edge: simple callee name from the innermost function
+        if self._stack:
+            if isinstance(node.func, ast.Name):
+                self._stack[-1].callees.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                self._stack[-1].callees.add(node.func.attr)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        # g = jax.jit(f, ...): f becomes jitted, g becomes a jitted alias
+        if isinstance(node.value, ast.Call):
+            c = self.canonical(node.value.func)
+            if c in _JIT_WRAPPERS:
+                params = self._jit_params_from_call(node.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.jit_aliases[t.id] = params
+                    elif isinstance(t, ast.Attribute):
+                        self.jit_aliases[t.attr] = params
+        self.generic_visit(node)
+
+    # -- closure --------------------------------------------------------
+    def close_jit_reachability(self):
+        """jit_context = jitted ∪ nested-in-jitted ∪ called-from-jit-context,
+        iterated to fixpoint over the intra-module call graph."""
+        for info in self.functions.values():
+            info.jit_context = info.jitted
+        changed = True
+        while changed:
+            changed = False
+            for info in self.functions.values():
+                if not info.jit_context:
+                    p = info.parent
+                    if p is not None and p.jit_context:
+                        info.jit_context = True
+                        changed = True
+                        continue
+                else:
+                    for callee in info.callees:
+                        for target in self.by_name.get(callee, []):
+                            if not target.jit_context:
+                                target.jit_context = True
+                                changed = True
+
+
+class FunctionAnalyzer:
+    """Pass B: walk one function, tracking taint and loop depth, emit findings."""
+
+    def __init__(self, index: ModuleIndex, info: FuncInfo, path: str,
+                 config: RuleConfig, findings: list):
+        self.index = index
+        self.info = info
+        self.path = path
+        self.config = config
+        self.findings = findings
+        self.taint: dict[str, str] = {}
+        self.loop_depth = 0
+        self._quiet = 0  # >0 during taint-only pre-passes over loop bodies
+
+    # -- reporting ------------------------------------------------------
+    def report(self, rule_id: str, node, message: str,
+               severity: Optional[Severity] = None):
+        if self._quiet or not self.config.enabled(rule_id):
+            return
+        self.findings.append(
+            Finding(
+                rule=rule_id,
+                severity=severity or self.config.severity(rule_id),
+                path=self.path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0) + 1,
+                message=message,
+                hint=RULES[rule_id].hint,
+            )
+        )
+
+    # -- taint ----------------------------------------------------------
+    def seed_params(self):
+        # Only DIRECTLY traced boundaries (jit decorator/wrap, lax body fn)
+        # guarantee tracer parameters. Functions merely reachable from jit
+        # often mix arrays with python-static config args; tainting those
+        # would flood TR001 with false positives.
+        node = self.info.node
+        if not self.info.jitted:
+            return
+        args = node.args
+        params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            params.append(args.vararg.arg)
+        static = set(self.info.jit_params.static_argnames)
+        for i in self.info.jit_params.static_argnums:
+            if isinstance(i, int) and 0 <= i < len(params):
+                static.add(params[i])
+        for p in params:
+            if p not in static and p != "self":
+                self.taint[p] = _TAINT_TRACED
+
+    def expr_taint(self, node) -> Optional[str]:
+        """Taint kind of the value this expression produces, or None."""
+        if isinstance(node, ast.Name):
+            return self.taint.get(node.id)
+        if isinstance(node, ast.Call):
+            c = self.index.canonical(node.func)
+            if c is not None:
+                if c in ("jax.device_get", "float", "int", "bool", "complex"):
+                    return None  # host result
+                if c in ("numpy.asarray",):
+                    inner = node.args and self.expr_taint(node.args[0])
+                    return _TAINT_NPVIEW if inner == _TAINT_TRACED else None
+                if c.startswith("numpy."):
+                    return None  # numpy call result: host, writable
+                if c.startswith(_TRACED_PREFIXES) or c in ("jax.device_put",):
+                    return _TAINT_TRACED
+                if c in _STATIC_CALLS:
+                    return None
+                if c in ("zip", "enumerate", "reversed", "sorted", "list", "tuple"):
+                    # transparent containers: iterating them yields their
+                    # arguments' values
+                    for a in node.args:
+                        t = self.expr_taint(a)
+                        if t:
+                            return t
+                    return None
+            # call of a known-jitted local function / alias returns device values
+            if isinstance(node.func, ast.Name):
+                if node.func.id in self.index.jit_aliases or any(
+                    f.jitted for f in self.index.by_name.get(node.func.id, [])
+                ):
+                    return _TAINT_TRACED
+            # method call on a traced receiver stays traced (x.sum(), x.astype())
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _SYNC_METHODS:
+                    return None  # host extraction
+                if self.expr_taint(node.func.value) == _TAINT_TRACED:
+                    return _TAINT_TRACED
+            return None
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return None
+            return self.expr_taint(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr_taint(node.value)
+        # Arithmetic on an NPVIEW allocates a NEW writable ndarray, so only
+        # TRACED survives these; a view stays a view only through direct
+        # aliasing (Name), slicing (Subscript) and attributes (.T) above.
+        if isinstance(node, (ast.BinOp,)):
+            t = self.expr_taint(node.left) or self.expr_taint(node.right)
+            return t if t == _TAINT_TRACED else None
+        if isinstance(node, ast.UnaryOp):
+            t = self.expr_taint(node.operand)
+            return t if t == _TAINT_TRACED else None
+        if isinstance(node, ast.Compare):
+            t = self.expr_taint(node.left)
+            for comp in node.comparators:
+                t = t or self.expr_taint(comp)
+            return t if t == _TAINT_TRACED else None
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                t = self.expr_taint(v)
+                if t:
+                    return t
+            return None
+        if isinstance(node, ast.IfExp):
+            return self.expr_taint(node.body) or self.expr_taint(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                t = self.expr_taint(e)
+                if t:
+                    return t
+            return None
+        if isinstance(node, ast.NamedExpr):
+            return self.expr_taint(node.value)
+        return None
+
+    def _assign_taint(self, target, kind: Optional[str]):
+        if isinstance(target, ast.Name):
+            if kind is None:
+                self.taint.pop(target.id, None)
+            else:
+                self.taint[target.id] = kind
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_taint(e, kind)
+        elif isinstance(target, ast.Starred):
+            self._assign_taint(target.value, kind)
+
+    # -- control-flow-on-tracer helper ----------------------------------
+    def uses_traced_value(self, node) -> bool:
+        """True if evaluating this expression's *truthiness/value* forces a
+        traced value — excluding static metadata (.shape/.ndim/len/isinstance/
+        `is None`) so those guard patterns never fire TR001."""
+        if isinstance(node, ast.Name):
+            return self.taint.get(node.id) == _TAINT_TRACED
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.uses_traced_value(node.value)
+        if isinstance(node, ast.Call):
+            c = self.index.canonical(node.func)
+            if c in _STATIC_CALLS:
+                return False
+            if isinstance(node.func, ast.Attribute) and self.uses_traced_value(node.func.value):
+                return True
+            return any(self.uses_traced_value(a) for a in node.args)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # `x is None` etc. — identity is static
+            return self.uses_traced_value(node.left) or any(
+                self.uses_traced_value(c) for c in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(self.uses_traced_value(v) for v in node.values)
+        if isinstance(node, (ast.BinOp,)):
+            return self.uses_traced_value(node.left) or self.uses_traced_value(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.uses_traced_value(node.operand)
+        if isinstance(node, ast.Subscript):
+            return self.uses_traced_value(node.value)
+        if isinstance(node, ast.IfExp):
+            return self.uses_traced_value(node.test)
+        return False
+
+    # -- statement walk --------------------------------------------------
+    def run(self):
+        self.seed_params()
+        node = self.info.node
+        body = node.body if isinstance(node.body, list) else [ast.Expr(node.body)]
+        self.walk_body(body)
+        self.check_donate()
+
+    def walk_body(self, stmts):
+        for st in stmts:
+            self.walk_stmt(st)
+
+    def walk_stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs are analyzed as their own functions
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self.visit_exprs(st.iter)
+            # iterating a traced/array iterable yields traced elements
+            self._assign_taint(st.target, self.expr_taint(st.iter))
+            self.loop_depth += 1
+            # taint-only pre-pass so the reporting pass sees loop-carried taint
+            self._quiet += 1
+            self.walk_body(st.body)
+            self._quiet -= 1
+            self.walk_body(st.body)
+            self.loop_depth -= 1
+            self.walk_body(st.orelse)
+            return
+        if isinstance(st, ast.While):
+            if self.info.jit_context and self.uses_traced_value(st.test):
+                self.report("TR001", st, "while-loop condition on a traced value inside jit-traced code")
+            self.visit_exprs(st.test)
+            self.loop_depth += 1
+            self._quiet += 1
+            self.walk_body(st.body)
+            self._quiet -= 1
+            self.walk_body(st.body)
+            self.loop_depth -= 1
+            self.walk_body(st.orelse)
+            return
+        if isinstance(st, ast.If):
+            if self.info.jit_context and self.uses_traced_value(st.test):
+                self.report("TR001", st, "if-condition on a traced value inside jit-traced code")
+            self.visit_exprs(st.test)
+            self.walk_body(st.body)
+            self.walk_body(st.orelse)
+            return
+        if isinstance(st, ast.Assert):
+            if self.info.jit_context and self.uses_traced_value(st.test):
+                self.report("TR001", st, "assert on a traced value inside jit-traced code")
+            self.visit_exprs(st.test)
+            return
+        if isinstance(st, ast.Assign):
+            self.visit_exprs(st.value)
+            kind = self.expr_taint(st.value)
+            for t in st.targets:
+                if isinstance(t, ast.Subscript):
+                    self.check_np_mutation(t, st)
+                    self.visit_exprs(t.value, t.slice)
+                else:
+                    self._assign_taint(t, kind)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self.visit_exprs(st.value)
+                if isinstance(st.target, ast.Name):
+                    self._assign_taint(st.target, self.expr_taint(st.value))
+            return
+        if isinstance(st, ast.AugAssign):
+            self.visit_exprs(st.value)
+            if isinstance(st.target, ast.Subscript):
+                self.check_np_mutation(st.target, st)
+                self.visit_exprs(st.target.value, st.target.slice)
+            elif isinstance(st.target, ast.Name):
+                if self.expr_taint(st.value) == _TAINT_TRACED:
+                    self.taint[st.target.id] = _TAINT_TRACED
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.visit_exprs(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign_taint(item.optional_vars, self.expr_taint(item.context_expr))
+            self.walk_body(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self.walk_body(st.body)
+            for h in st.handlers:
+                self.walk_body(h.body)
+            self.walk_body(st.orelse)
+            self.walk_body(st.finalbody)
+            return
+        if isinstance(st, ast.Return) and st.value is not None:
+            self.visit_exprs(st.value)
+            return
+        if isinstance(st, ast.Expr):
+            self.visit_exprs(st.value)
+            return
+        # default: visit any expression children (Raise, Delete, ...)
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self.visit_exprs(child)
+
+    def visit_exprs(self, *exprs):
+        for e in exprs:
+            for node in self._walk_skip_lambda(e):
+                if isinstance(node, ast.Call):
+                    self.check_call(node)
+                elif isinstance(node, ast.IfExp):
+                    if self.info.jit_context and self.uses_traced_value(node.test):
+                        self.report("TR001", node, "ternary condition on a traced value inside jit-traced code")
+
+    @staticmethod
+    def _walk_skip_lambda(root):
+        """ast.walk, but do not descend into nested lambdas — those are
+        analyzed as their own functions with their own jit context."""
+        stack = [root]
+        while stack:
+            n = stack.pop()
+            yield n
+            if not isinstance(n, ast.Lambda):
+                stack.extend(ast.iter_child_nodes(n))
+
+    # -- rule checks on calls --------------------------------------------
+    def check_call(self, node: ast.Call):
+        c = self.index.canonical(node.func)
+        in_jit = self.info.jit_context
+        in_loop = self.loop_depth > 0
+
+        # HS001: explicit device_get
+        if c == "jax.device_get":
+            if in_jit:
+                self.report("HS001", node,
+                            "jax.device_get inside jit-traced code forces a host sync at trace time",
+                            severity=Severity.ERROR)
+            elif in_loop:
+                self.report("HS001", node,
+                            "per-iteration jax.device_get; batch transfers into one device_get after the loop")
+        # HS001: float()/int()/bool()/np.asarray()/np.array() on a traced value
+        elif c in _SYNC_CALLS and node.args:
+            arg_t = self.expr_taint(node.args[0])
+            if arg_t == _TAINT_TRACED:
+                if in_jit:
+                    self.report("HS001", node,
+                                f"{c}() on a traced value inside jit-traced code "
+                                "(raises ConcretizationTypeError under trace)",
+                                severity=Severity.ERROR)
+                elif in_loop:
+                    self.report("HS001", node,
+                                f"per-iteration {c}() on a device value blocks dispatch pipelining")
+        # HS001: .item() / .tolist() / .block_until_ready()
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+            recv_t = self.expr_taint(node.func.value)
+            if recv_t == _TAINT_TRACED:
+                if in_jit:
+                    self.report("HS001", node,
+                                f".{node.func.attr}() on a traced value inside jit-traced code",
+                                severity=Severity.ERROR)
+                elif in_loop:
+                    self.report("HS001", node,
+                                f"per-iteration .{node.func.attr}() on a device value blocks dispatch pipelining")
+
+        # PR001: print / logging inside jitted body
+        if in_jit:
+            if isinstance(node.func, ast.Name) and node.func.id == "print":
+                self.report("PR001", node, "print() inside a jitted body runs at trace time only")
+            elif isinstance(node.func, ast.Attribute) and node.func.attr in _LOG_METHODS:
+                base = node.func.value
+                if isinstance(base, ast.Name) and base.id in _LOGGER_NAMES:
+                    self.report("PR001", node,
+                                f"{base.id}.{node.func.attr}() inside a jitted body runs at trace time only")
+
+        # RT001b: constant ARRAY literal constructed inside a jitted body.
+        # Scalar jnp.asarray(0) state inits are idiomatic, consteval'd, and
+        # free — only list/tuple displays (real embedded tables) are worth
+        # hoisting.
+        if in_jit and c in ("jax.numpy.array", "jax.numpy.asarray") and node.args:
+            a0 = node.args[0]
+            if isinstance(a0, (ast.List, ast.Tuple)) and a0.elts and all(
+                isinstance(e, (ast.Constant, ast.List, ast.Tuple)) for e in a0.elts
+            ):
+                self.report("RT001", node,
+                            f"{c}(<literal array>) inside a jitted body re-embeds the constant on every trace; hoist it")
+
+        # RT001a: literal python arg to a known-jitted callable without static marking
+        self.check_jitted_call_args(node)
+
+    def check_jitted_call_args(self, node: ast.Call):
+        params = None
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        if name is None:
+            return
+        if name in self.index.jit_aliases:
+            params = self.index.jit_aliases[name]
+        else:
+            for f in self.index.by_name.get(name, []):
+                if f.jitted:
+                    params = f.jit_params
+                    break
+        if params is None:
+            return
+        for i, a in enumerate(node.args):
+            if i in params.static_argnums:
+                continue
+            if _is_literal_display(a):
+                self.report("RT001", a,
+                            f"literal python argument #{i} to jitted {name!r} is not in "
+                            "static_argnums/static_argnames")
+        for kw in node.keywords:
+            if kw.arg and kw.arg not in params.static_argnames and _is_literal_display(kw.value):
+                self.report("RT001", kw.value,
+                            f"literal python argument {kw.arg!r} to jitted {name!r} is not in "
+                            "static_argnums/static_argnames")
+
+    # -- NP001 -----------------------------------------------------------
+    def check_np_mutation(self, target: ast.Subscript, st):
+        base = target.value
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        kind = self.expr_taint(base)
+        if kind == _TAINT_TRACED:
+            self.report("NP001", st,
+                        "in-place subscript store on a jax array (immutable; raises TypeError)")
+        elif kind == _TAINT_NPVIEW:
+            self.report("NP001", st,
+                        "in-place subscript store on np.asarray(<jax value>) — the view is "
+                        "read-only; copy with np.array(...) first")
+
+    # -- DN001 -----------------------------------------------------------
+    def check_donate(self):
+        info = self.info
+        node = info.node
+        if not info.jitted or info.jit_params.has_donate or isinstance(node, ast.Lambda):
+            return
+        args = node.args
+        params = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs} - {"self"}
+        static = set(info.jit_params.static_argnames)
+        ordered = [a.arg for a in args.posonlyargs + args.args]
+        for i in info.jit_params.static_argnums:
+            if isinstance(i, int) and 0 <= i < len(ordered):
+                static.add(ordered[i])
+        updated = set()
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Attribute)
+                and sub.attr == "at"
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id in params - static
+            ):
+                updated.add(sub.value.id)
+        if updated:
+            self.report(
+                "DN001", node,
+                f"jitted {info.name!r} updates parameter(s) {sorted(updated)} via .at[...] "
+                "without donate_argnums/donate_argnames",
+            )
+
+
+def analyze_module(tree: ast.Module, path: str, config: RuleConfig) -> list:
+    """Run both passes over a parsed module; returns raw (unsuppressed) findings."""
+    index = ModuleIndex()
+    index.visit(tree)
+    index.close_jit_reachability()
+    findings: list = []
+    # module-level statements: analyze as a pseudo-function (not jit context)
+    pseudo = ast.FunctionDef(
+        name="<module>", args=ast.arguments(
+            posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[], defaults=[]
+        ),
+        body=[s for s in tree.body], decorator_list=[], returns=None,
+    )
+    mod_info = FuncInfo(node=pseudo, name="<module>", parent=None)
+    FunctionAnalyzer(index, mod_info, path, config, findings).run()
+    for info in index.functions.values():
+        FunctionAnalyzer(index, info, path, config, findings).run()
+    seen = set()
+    unique = []
+    for f in findings:
+        key = (f.rule, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    unique.sort(key=lambda f: (f.line, f.col, f.rule))
+    return unique

@@ -2226,7 +2226,13 @@ def _global_feature_stats(game_input, shard: str, intercept_index):
     parts = multihost_utils.process_allgather(
         (np.asarray([float(n_local)]), s1, s2, sabs, nnz, mins, maxs)
     )
-    counts, s1g, s2g, sabsg, nnzg, minsg, maxsg = (np.asarray(x) for x in parts)
+    # some jax versions return single-process allgathers WITHOUT the leading
+    # process axis; normalize every part to [P, ...] so the axis-0 reductions
+    # below reduce over processes, never over features
+    counts, s1g, s2g, sabsg, nnzg, minsg, maxsg = (
+        np.asarray(x).reshape(-1, *ref.shape)
+        for x, ref in zip(parts, (np.empty(1), s1, s2, sabs, nnz, mins, maxs))
+    )
     n = float(counts.sum())
     if n < 1:
         raise ValueError("Cannot compute feature statistics over zero samples")

@@ -216,9 +216,11 @@ def run_fused_game_descent(
 
     if model is None:  # without validation only the final model materializes
         model = snapshot_model()
+    # one transfer for both tracker scalars (not two blocking reads)
+    fe_value_h, fe_iters_h = jax.device_get((diag["fe_value"], diag["fe_iterations"]))
     fe_tracker = _FusedPassTracker(
-        final_value=float(diag["fe_value"]),
-        iterations=int(diag["fe_iterations"]),
+        final_value=float(fe_value_h),
+        iterations=int(fe_iters_h),
         passes=estimator.n_iterations,
     )
     result = CoordinateDescentResult(

@@ -474,7 +474,10 @@ class GameServingEngine:
             include_offsets=fuse_offsets,
             apply_link=False,
         )
-        res = np.asarray(out)[:n]
+        # explicit device_get, not np.asarray: this is THE named boundary
+        # transfer of the serving path, and runtime_guard.sync_discipline
+        # (scoring_bench, test_serving) disallows implicit d2h in the region
+        res = jax.device_get(out)[:n]
         if include_offsets and not fuse_offsets:
             res = res + offsets
         return res
@@ -494,7 +497,7 @@ class GameServingEngine:
             out = self._jitted(
                 batch, per_coordinate=False, include_offsets=True, apply_link=True
             )
-            return np.asarray(out)[:n]
+            return jax.device_get(out)[:n]  # explicit boundary transfer, as in score
         margins = self.score(data, include_offsets=True)  # host f64 add
         task = self.model.task
         from photon_ml_tpu.types import TaskType

@@ -1,0 +1,67 @@
+"""False-positive guards: disciplined JAX code that must lint clean.
+
+Every pattern here is one a naive grep for ``float(``/``np.asarray``/``if``
+would flag; jaxlint must not.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("n", "mode"))
+def static_args_branch(x, n, mode):
+    # branching on STATIC args is the supported specialization pattern
+    if mode == "square":
+        x = x * x
+    for _ in range(n):
+        x = x + 1.0
+    return x
+
+
+@jax.jit
+def device_resident_math(x, y):
+    z = jnp.where(x > y, x, y)  # device-side branch: fine
+    return lax.cond(jnp.all(z > 0), lambda v: v, lambda v: -v, z)
+
+
+def boundary_transfer(xs):
+    """One batched transfer at a natural host boundary: the hinted pattern."""
+    acc = [jnp.dot(x, x) for x in xs]
+    host = jax.device_get(acc)  # outside any loop: fine
+    return [float(v) for v in host]
+
+
+def host_pipeline(records):
+    """Pure-host numpy code full of float()/asarray/in-place ops: no taint."""
+    arr = np.asarray(records, dtype=np.float64)
+    arr[0] = float(arr.mean())
+    arr += 1.0
+    totals = []
+    for row in arr:
+        totals.append(float(row.sum()))
+    return totals
+
+
+def metadata_driven(x):
+    x = jnp.asarray(x)
+    if x.ndim == 1:  # static metadata: fine even on device values
+        x = x[None, :]
+    n = int(x.shape[0])  # shapes are python ints: fine
+    return x, n
+
+
+class Engine:
+    def __init__(self, coeffs):
+        self._table = jnp.asarray(coeffs)
+        self._fn = jax.jit(self._score)
+
+    def _score(self, x):
+        return x @ self._table
+
+    def score(self, x):
+        out = self._fn(x)
+        return np.asarray(jax.device_get(out))  # single boundary transfer: fine

@@ -1,0 +1,31 @@
+"""DN001 fixtures: functional buffer updates without donation."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def update_without_donate(table, idx, val):  # EXPECT: DN001
+    return table.at[idx].set(val)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update_with_donate(table, idx, val):
+    return table.at[idx].add(val)  # donated: fine
+
+
+@functools.partial(jax.jit, donate_argnames=("table",))
+def update_with_donate_names(table, idx, val):
+    return table.at[idx].mul(val)  # donated: fine
+
+
+@jax.jit
+def no_update(table, idx):
+    return table[idx] * 2.0  # read-only use of the buffer: fine
+
+
+def host_helper(table, idx, val):
+    # not jitted: donation does not apply
+    return jnp.asarray(table).at[idx].set(val)

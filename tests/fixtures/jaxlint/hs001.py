@@ -1,0 +1,74 @@
+"""HS001 fixtures: host syncs on likely-traced values.
+
+``# EXPECT: RULE`` marks the line where exactly one finding of that rule is
+required; lines without a marker must produce nothing (tests/test_jaxlint.py
+compares the full (line, rule) sets).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def sync_inside_jit(x):
+    v = float(x)  # EXPECT: HS001
+    w = np.asarray(x)  # EXPECT: HS001
+    i = int(jnp.sum(x))  # EXPECT: HS001
+    jax.device_get(x)  # EXPECT: HS001
+    return x * v
+
+
+def scan_body_sync(carry, x):
+    y = jnp.dot(x, x)
+    return carry + y.item(), y  # EXPECT: HS001
+
+
+def run_scan(xs):
+    return lax.scan(scan_body_sync, 0.0, xs)
+
+
+def per_iteration_syncs(xs):
+    total = 0.0
+    for x in xs:
+        y = jnp.dot(x, x)
+        total += float(y)  # EXPECT: HS001
+        _ = np.asarray(y)  # EXPECT: HS001
+        _ = y.item()  # EXPECT: HS001
+        y.block_until_ready()  # EXPECT: HS001
+        _ = jax.device_get(y)  # EXPECT: HS001
+    return total
+
+
+def loop_carried_taint(xs, w0):
+    w = w0
+    for x in xs:
+        w = jnp.add(w, x)
+        loss = float(w[0])  # EXPECT: HS001
+    return w, loss
+
+
+def traced_iterable(scores):
+    device_scores = jnp.asarray(scores)
+    out = []
+    while device_scores.shape[0] > len(out):
+        s = device_scores[len(out)]
+        out.append(float(s))  # EXPECT: HS001
+    return out
+
+
+def batched_after_loop_is_fine(xs):
+    """The hinted fix: accumulate on device, one transfer at the end."""
+    acc = []
+    for x in xs:
+        acc.append(jnp.dot(x, x))
+    return [float(v) for v in jax.device_get(acc)]
+
+
+def host_values_are_fine(records):
+    total = 0.0
+    for r in records:
+        total += float(r)  # plain host float: no taint, no finding
+        _ = np.asarray(records)
+    return total

@@ -1,0 +1,42 @@
+"""NP001 fixtures: in-place numpy mutation of jax-derived values."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def mutate_device_array():
+    a = jnp.zeros(4)
+    a[0] = 1.0  # EXPECT: NP001
+    a[1:3] += 2.0  # EXPECT: NP001
+    return a
+
+
+def mutate_read_only_view():
+    b = np.asarray(jnp.ones(3))
+    b[1] = 2.0  # EXPECT: NP001
+    return b
+
+
+def mutate_derived():
+    c = jnp.arange(6).reshape(2, 3) * 2
+    c[0, 0] = 9  # EXPECT: NP001
+    return c
+
+
+def explicit_copy_is_fine():
+    d = np.array(jnp.ones(3))  # np.array copies: writable host buffer
+    d[1] = 2.0
+    return d
+
+
+def plain_numpy_is_fine(n):
+    e = np.zeros(n)
+    e[0] = 1.0
+    e[1:] += 3.0
+    return e
+
+
+def functional_update_is_fine():
+    f = jnp.zeros(4)
+    f = f.at[0].set(1.0)  # the jax way: fine
+    return f

@@ -1,0 +1,34 @@
+"""PR001 fixtures: print/logging inside jitted bodies."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+logger = logging.getLogger(__name__)
+
+
+@jax.jit
+def print_in_jit(x):
+    print("value is", x)  # EXPECT: PR001
+    logger.info("solving for %s", x)  # EXPECT: PR001
+    logging.warning("raw logging call %s", x)  # EXPECT: PR001
+    jax.debug.print("value is {}", x)  # the supported way: fine
+    return x * 2
+
+
+def loop_body(carry, x):
+    print("step", x)  # EXPECT: PR001
+    return carry + x, x
+
+
+def run(xs):
+    return lax.scan(loop_body, 0.0, xs)
+
+
+def host_side_logging(xs):
+    total = float(jnp.sum(jnp.stack(list(xs))))
+    print("total", total)  # host side: fine
+    logger.info("done: %s", total)  # host side: fine
+    return total

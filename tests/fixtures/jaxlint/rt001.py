@@ -1,0 +1,61 @@
+"""RT001 fixtures: retrace hazards at jit boundaries."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def literal_array_in_body(x):
+    table = jnp.array([1.0, 2.0, 3.0])  # EXPECT: RT001
+    scales = jnp.asarray((0.5, 0.25))  # EXPECT: RT001
+    return x * table[0] * scales[0]
+
+
+_HOISTED = jnp.array([1.0, 2.0, 3.0])  # module scope: fine
+
+
+@jax.jit
+def uses_hoisted(x):
+    return x * _HOISTED[0]
+
+
+@jax.jit
+def scalar_state_init_is_fine(x):
+    # scalar asarray inits are idiomatic and consteval'd — not flagged
+    i = jnp.asarray(0, jnp.int32)
+    return x + i
+
+
+def plain_fn(a, cfg):
+    return a
+
+
+jitted_alias = jax.jit(plain_fn)
+
+
+def call_sites(x):
+    jitted_alias(x, {"depth": 2})  # EXPECT: RT001
+    jitted_alias(x, 3)  # EXPECT: RT001
+    jitted_alias(x, cfg=[1, 2])  # EXPECT: RT001
+    return jitted_alias(x, x)  # array arg: fine
+
+
+def static_fn(a, cfg, n=1):
+    return a * n
+
+
+jitted_static = jax.jit(static_fn, static_argnames=("cfg", "n"))
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def decorated_static(a, mode):
+    return a
+
+
+def static_call_sites(x):
+    jitted_static(x, cfg={"depth": 2})  # declared static: fine
+    jitted_static(x, cfg={"depth": 2}, n=4)  # both static: fine
+    decorated_static(x, 7)  # static_argnums covers position 1: fine
+    return x

@@ -1,0 +1,43 @@
+"""Suppression fixtures: reasons are mandatory, unknown ids are flagged."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def intended_boundary(xs):
+    out = []
+    for x in xs:
+        y = jnp.sum(x)
+        out.append(float(y))  # jaxlint: disable=HS001 per-item scores leave the device here by contract
+    return out
+
+
+def suppress_all_on_line(xs):
+    for x in xs:
+        y = jnp.dot(x, x)
+        v = np.asarray(y)  # jaxlint: disable intentional host mirror for the debugger
+    return v
+
+
+def missing_reason(xs):
+    for x in xs:
+        y = jnp.sum(x)
+        v = float(y)  # jaxlint: disable=HS001
+        # EXPECT-SUPPRESSION-ERROR: the line above must yield SUP001 + HS001
+    return v
+
+
+def unknown_rule(xs):
+    for x in xs:
+        y = jnp.sum(x)
+        v = float(y)  # jaxlint: disable=ZZ999,HS001 wrong id plus a right one
+        # EXPECT-SUPPRESSION-ERROR: the line above must yield SUP001 (unknown id)
+    return v
+
+
+def wrong_rule_does_not_suppress(xs):
+    for x in xs:
+        y = jnp.sum(x)
+        v = float(y)  # jaxlint: disable=PR001 suppressing the wrong rule leaves HS001 active
+    return v

@@ -1,0 +1,61 @@
+"""TR001 fixtures: Python control flow on traced values inside jitted code."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def branch_on_tracer(x, threshold):
+    if x > threshold:  # EXPECT: TR001
+        return x * 2
+    return x
+
+
+@jax.jit
+def while_on_tracer(x):
+    while x < 10:  # EXPECT: TR001
+        x = x * 2
+    return x
+
+
+@jax.jit
+def assert_on_tracer(x):
+    assert x > 0  # EXPECT: TR001
+    return jnp.where(x > 0, x, -x)  # the device-side version: fine
+
+
+@jax.jit
+def ternary_on_tracer(x):
+    return x if x > 0 else -x  # EXPECT: TR001
+
+
+def while_body_branch(state):
+    x, i = state
+    y = jnp.sum(x)
+    if y > 0:  # EXPECT: TR001
+        y = -y
+    return x * y, i + 1
+
+
+def run(x):
+    return lax.while_loop(lambda s: s[1] < 3, while_body_branch, (x, 0))
+
+
+@jax.jit
+def static_guards_are_fine(x, opts=None):
+    # all of these are static under tracing — no findings
+    if opts is None:
+        opts = {}
+    if x.ndim == 2:
+        x = x.sum(axis=1)
+    if x.shape[0] > 8:
+        x = x[:8]
+    if len(opts) > 0:
+        x = x + opts.get("bias", 0.0)
+    if isinstance(opts, dict):
+        pass
+    n = x.shape[0]
+    if n > 4:
+        x = x * 2.0
+    return x

@@ -1,0 +1,324 @@
+"""jaxlint analyzer tests: fixture corpus, suppressions, baseline, CLI.
+
+The fixture files under tests/fixtures/jaxlint/ carry ``# EXPECT: RULE``
+markers on every line that must yield exactly one finding of that rule;
+every unmarked line must yield nothing. That makes each fixture a complete
+positive AND negative spec — a new false positive in the analyzer fails
+these tests even if it appears on a line nobody thought about.
+
+The analyzer is pure stdlib: these tests import it through the package but
+never need a jax runtime.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from photon_ml_tpu.analysis import baseline as baseline_mod
+from photon_ml_tpu.analysis import linter
+from photon_ml_tpu.analysis.rules import RuleConfig, RULES, Severity
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "jaxlint"
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z]{2}\d{3})")
+
+
+def expected_findings(path: Path) -> list:
+    out = []
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        for rule in _EXPECT_RE.findall(line):
+            out.append((lineno, rule))
+    return sorted(out)
+
+
+def actual_findings(path: Path, config=None) -> linter.LintResult:
+    return linter.lint_source(path.read_text(), path.name, config)
+
+
+@pytest.mark.parametrize(
+    "fixture",
+    ["hs001.py", "rt001.py", "tr001.py", "pr001.py", "dn001.py", "np001.py", "clean.py"],
+)
+def test_fixture_findings_match_expectations(fixture):
+    path = FIXTURES / fixture
+    result = actual_findings(path)
+    got = sorted((f.line, f.rule) for f in result.findings)
+    assert got == expected_findings(path), (
+        f"{fixture}: findings diverge from # EXPECT markers.\n"
+        f"got:      {got}\nexpected: {expected_findings(path)}\n"
+        + "\n".join(f.format_human() for f in result.findings)
+    )
+
+
+def test_clean_fixture_is_fully_clean():
+    result = actual_findings(FIXTURES / "clean.py")
+    assert result.findings == [] and result.suppressed == []
+
+
+def test_every_rule_has_fixture_coverage():
+    """Each non-meta rule must be exercised by at least one positive case."""
+    covered = set()
+    for f in FIXTURES.glob("*.py"):
+        covered.update(rule for _, rule in expected_findings(f))
+    assert covered >= (set(RULES) - {"SUP001"})
+
+
+# ---------------------------------------------------------------- suppression
+
+
+def test_suppression_with_reason_silences_finding():
+    result = actual_findings(FIXTURES / "suppressed.py")
+    by_func_line = {(f.line, f.rule) for f in result.findings}
+    sup = {(f.line, f.rule) for f in result.suppressed}
+    src = (FIXTURES / "suppressed.py").read_text().splitlines()
+
+    def line_of(snippet):
+        return next(i for i, l in enumerate(src, start=1) if snippet in l)
+
+    # reasoned suppressions: finding moves to .suppressed
+    assert (line_of("per-item scores leave the device"), "HS001") in sup
+    assert (line_of("intentional host mirror"), "HS001") in sup
+    # reasonless suppression: SUP001 AND the original finding stay active
+    bad = next(i for i, l in enumerate(src, start=1)
+               if l.rstrip().endswith("disable=HS001"))
+    assert (bad, "SUP001") in by_func_line and (bad, "HS001") in by_func_line
+    # unknown rule id: SUP001; the known id still suppresses (reason present)
+    unk = line_of("ZZ999")
+    assert (unk, "SUP001") in by_func_line
+    assert (unk, "HS001") in sup
+    # suppressing the wrong rule leaves the real finding active
+    wrong = line_of("suppressing the wrong rule")
+    assert (wrong, "HS001") in by_func_line
+
+
+def test_multi_rule_suppression_with_space_after_comma():
+    """'disable=HS001, RT001 <reason>' must suppress BOTH rules — a lazy ids
+    parse would treat 'RT001 <reason>' as the reason and silently narrow the
+    suppression to HS001."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(lambda a, cfg: a)\n"
+        "def g(xs):\n"
+        "    for x in xs:\n"
+        "        v = float(jnp.sum(x)); f(x, {'k': 1})  # jaxlint: disable=HS001, RT001 both intended here\n"
+        "    return v\n"
+    )
+    result = linter.lint_source(src, "t.py")
+    assert result.findings == [], [f.format_human() for f in result.findings]
+    assert {f.rule for f in result.suppressed} == {"HS001", "RT001"}
+
+
+def test_npview_arithmetic_result_is_writable():
+    """v = np.asarray(<jax>) is a read-only view, but v * 2.0 allocates a
+    fresh writable array — mutating THAT must not fire NP001."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(xs):\n"
+        "    v = np.asarray(jnp.sum(xs))\n"
+        "    w = v * 2.0\n"
+        "    w[0] = 1.0\n"
+        "    v[0] = 1.0\n"  # the view itself: still NP001
+        "    return w\n"
+    )
+    result = linter.lint_source(src, "t.py")
+    assert [(f.line, f.rule) for f in result.findings] == [(7, "NP001")]
+
+
+def test_unparseable_file_is_an_error_not_a_pass(tmp_path):
+    """A file the analyzer cannot parse must surface as an error, stay out of
+    the scanned set (no bogus staleness), and fail the CLI."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    result = linter.lint_paths([bad], rel_root=str(tmp_path))
+    assert result.errors and not result.findings
+    assert "bad.py" not in result.scanned
+    r = _run_cli(str(bad), "--no-baseline")
+    assert r.returncode == 3, (r.returncode, r.stdout, r.stderr)
+
+
+def test_scan_root_under_hidden_ancestor_still_scans(tmp_path):
+    """Skip-dir filtering applies below the scan root only: a checkout under
+    a hidden/'build'-named ancestor must not silently scan as empty."""
+    root = tmp_path / ".cache" / "build" / "pkg"
+    root.mkdir(parents=True)
+    (root / "mod.py").write_text(_LOOP_SYNC)
+    (root / "__pycache__").mkdir()
+    (root / "__pycache__" / "junk.py").write_text(_LOOP_SYNC)
+    result = linter.lint_paths([root], rel_root=str(tmp_path))
+    assert {f.rule for f in result.findings} == {"HS001"}
+    assert all("__pycache__" not in p for p in result.scanned)
+
+
+def test_sup001_cannot_be_suppressed():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def f(xs):\n"
+        "    for x in xs:\n"
+        "        v = float(jnp.sum(x))  # jaxlint: disable=HS001,SUP001\n"
+        "    return v\n"
+    )
+    result = linter.lint_source(src, "t.py")
+    assert {f.rule for f in result.findings} == {"SUP001", "HS001"}
+
+
+# ---------------------------------------------------------------- rule config
+
+
+def test_disable_rule():
+    path = FIXTURES / "hs001.py"
+    result = actual_findings(path, RuleConfig(disabled=frozenset({"HS001"})))
+    assert result.findings == []
+
+
+def test_severity_override():
+    path = FIXTURES / "np001.py"
+    result = actual_findings(
+        path, RuleConfig(severity_overrides={"NP001": Severity.WARNING})
+    )
+    assert result.findings and all(f.severity == Severity.WARNING for f in result.findings)
+
+
+def test_unknown_rule_config_rejected():
+    with pytest.raises(ValueError):
+        RuleConfig(disabled=frozenset({"XX123"}))
+
+
+# ------------------------------------------------------------------- baseline
+
+
+def _findings_for(src: str):
+    return linter.lint_source(src, "mod.py").findings
+
+
+_LOOP_SYNC = (
+    "import jax.numpy as jnp\n"
+    "def f(xs):\n"
+    "    for x in xs:\n"
+    "        v = float(jnp.sum(x))\n"
+    "    return v\n"
+)
+
+
+def test_baseline_accepts_existing_and_catches_new():
+    old = _findings_for(_LOOP_SYNC)
+    counts = baseline_mod.to_counts(old)
+    # same findings: clean
+    d = baseline_mod.diff(old, counts)
+    assert d.clean
+    # a second, new sync appears: only IT is new
+    new_src = _LOOP_SYNC.replace(
+        "    return v\n", "        w = jnp.sum(x).item()\n    return v\n"
+    )
+    d = baseline_mod.diff(_findings_for(new_src), counts)
+    assert len(d.new) == 1 and d.new[0].line_text == "w = jnp.sum(x).item()"
+    assert not d.stale
+
+
+def test_baseline_keys_survive_line_drift():
+    old = _findings_for(_LOOP_SYNC)
+    counts = baseline_mod.to_counts(old)
+    shifted = "import os\n# a new comment line\n" + _LOOP_SYNC
+    d = baseline_mod.diff(_findings_for(shifted), counts)
+    assert d.clean, "an unrelated inserted line must not break the baseline"
+
+
+def test_baseline_stale_entry_detected_and_scoped():
+    old = _findings_for(_LOOP_SYNC)
+    counts = baseline_mod.to_counts(old)
+    fixed = _LOOP_SYNC.replace("float(jnp.sum(x))", "jnp.sum(x)")
+    d = baseline_mod.diff(_findings_for(fixed), counts, scanned_paths={"mod.py"})
+    assert d.stale and not d.new
+    # same fix, but mod.py wasn't part of this scan: not stale
+    d = baseline_mod.diff(_findings_for(fixed), counts, scanned_paths={"other.py"})
+    assert not d.stale
+
+
+def test_baseline_roundtrip(tmp_path):
+    old = _findings_for(_LOOP_SYNC)
+    p = tmp_path / "baseline.json"
+    baseline_mod.save(str(p), old)
+    assert baseline_mod.load(str(p)) == baseline_mod.to_counts(old)
+
+
+def test_baseline_narrow_regenerate_preserves_unscanned_entries(tmp_path):
+    """--update-baseline from a scan of one directory must not drop (and
+    thereby re-arm as 'new') accepted findings in files that scan never
+    visited — save() mirrors diff()'s scanned-path scoping."""
+    p = tmp_path / "baseline.json"
+    old = _findings_for(_LOOP_SYNC)  # path: mod.py
+    baseline_mod.save(str(p), old)
+    # regenerate from a scan that covered only other.py and found nothing
+    baseline_mod.save(str(p), [], scanned_paths={"other.py"})
+    assert baseline_mod.load(str(p)) == baseline_mod.to_counts(old)
+    # a scan that DID cover mod.py and found nothing drops the entry
+    baseline_mod.save(str(p), [], scanned_paths={"mod.py"})
+    assert baseline_mod.load(str(p)) == {}
+
+
+def test_committed_baseline_matches_fresh_scan():
+    """The repo invariant CI enforces: a fresh scan of everything the lint
+    job covers is exactly the committed baseline — nothing new, nothing
+    stale."""
+    result = linter.lint_paths(
+        [REPO / "photon_ml_tpu", REPO / "benchmarks", REPO / "tests",
+         REPO / "bench.py", REPO / "tools"],
+        rel_root=str(REPO),
+        exclude=["tests/fixtures/jaxlint"],
+    )
+    counts = baseline_mod.load(str(REPO / "tools" / "jaxlint_baseline.json"))
+    d = baseline_mod.diff(result.findings, counts, scanned_paths=result.scanned)
+    assert not d.new, "new jaxlint findings (fix or suppress with a reason):\n" + "\n".join(
+        f.format_human() for f in d.new
+    )
+    assert not d.stale, (
+        "stale baseline entries (a finding was fixed — regenerate with "
+        "`python tools/jaxlint.py photon_ml_tpu benchmarks tests bench.py tools "
+        "--update-baseline` and commit the smaller file):\n"
+        + "\n".join(e["key"] for e in d.stale)
+    )
+
+
+# ------------------------------------------------------------------------ CLI
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "jaxlint.py"), *args],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+
+
+def test_cli_package_scan_clean_against_baseline():
+    r = _run_cli("photon_ml_tpu", "--format", "json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["summary"]["new"] == 0 and payload["summary"]["stale"] == 0
+
+
+def test_cli_detects_seeded_violation(tmp_path):
+    scratch = tmp_path / "seeded.py"
+    scratch.write_text(
+        "import jax\nimport jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return float(x)\n"
+        "    return x\n"
+    )
+    r = _run_cli(str(scratch))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "TR001" in r.stdout and "HS001" in r.stdout
+
+
+def test_cli_list_rules():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    for rule_id in RULES:
+        assert rule_id in r.stdout

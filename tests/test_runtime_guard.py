@@ -1,0 +1,114 @@
+"""Runtime guard tests: retrace counter + transfer guard wiring.
+
+The retrace counter is authoritative on every backend (it counts jaxpr
+traces, which happen or don't regardless of platform). The transfer guard is
+authoritative on accelerators; on CPU, device->host reads are zero-copy and
+invisible to it, so the wiring tests here use implicit HOST->device
+transfers (np operands mixed into device math), which jax guards on CPU too.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.analysis.runtime_guard import (
+    GuardedRegion,
+    RetraceError,
+    no_implicit_transfers,
+    no_retrace,
+    sync_discipline,
+    trace_events,
+)
+
+
+def _fresh_jit():
+    # a new wrapper each call: its first invocation always traces
+    return jax.jit(lambda a: a * 2.0 + 1.0)
+
+
+class TestNoRetrace:
+    def test_warm_calls_pass(self):
+        f = _fresh_jit()
+        x = jnp.ones(8)
+        f(x)  # warmup compile OUTSIDE the region
+        with no_retrace() as region:
+            for _ in range(3):
+                f(x)
+        assert region.traces == 0
+
+    def test_cold_call_raises(self):
+        f = _fresh_jit()
+        with pytest.raises(RetraceError, match="jaxpr trace"):
+            with no_retrace(what="cold jit"):
+                f(jnp.ones(8))
+
+    def test_shape_bust_raises(self):
+        f = _fresh_jit()
+        f(jnp.ones(8))
+        with pytest.raises(RetraceError):
+            with no_retrace():
+                f(jnp.ones(9))  # new shape: jit cache miss, retrace
+
+    def test_allowance(self):
+        f = _fresh_jit()
+        with no_retrace(allow_retraces=16) as region:
+            f(jnp.ones(8))
+        assert region.traces >= 1
+
+    def test_region_is_live_and_counter_monotonic(self):
+        f = _fresh_jit()
+        before = trace_events()
+        with no_retrace(allow_retraces=16) as region:
+            assert isinstance(region, GuardedRegion)
+            f(jnp.ones(4))
+            assert region.traces >= 1
+        assert trace_events() >= before + 1
+
+    def test_body_exception_wins_over_retrace(self):
+        f = _fresh_jit()
+        with pytest.raises(ValueError, match="body failed"):
+            with no_retrace():
+                f(jnp.ones(3))  # would be a retrace violation
+                raise ValueError("body failed")
+
+
+class TestNoImplicitTransfers:
+    def test_mixed_np_operand_raises(self):
+        x = jax.device_put(np.ones(4, dtype=np.float32))
+        with pytest.raises(Exception, match="[Dd]isallowed"):
+            with no_implicit_transfers(host_to_device="disallow"):
+                _ = x + np.ones(4, dtype=np.float32)  # implicit h2d
+
+    def test_explicit_device_put_allowed(self):
+        with no_implicit_transfers(host_to_device="disallow"):
+            y = jax.device_put(np.ones(4, dtype=np.float32))
+        assert y.shape == (4,)
+
+    def test_committed_device_math_allowed(self):
+        x = jax.device_put(np.ones(4, dtype=np.float32))
+        y = jax.device_put(np.ones(4, dtype=np.float32))
+        f = jax.jit(lambda a, b: a + b)
+        f(x, y)  # compile outside
+        with no_implicit_transfers(host_to_device="disallow"):
+            out = f(x, y)
+        np.testing.assert_allclose(jax.device_get(out), 2.0)
+
+
+class TestSyncDiscipline:
+    def test_combined_guard(self):
+        x = jax.device_put(np.ones(8, dtype=np.float32))
+        f = jax.jit(lambda a: a * 3.0)
+        f(x)
+        with sync_discipline(what="steady state") as region:
+            for _ in range(4):
+                out = f(x)
+        assert region.traces == 0
+        np.testing.assert_allclose(jax.device_get(out), 3.0)
+
+    def test_combined_guard_catches_retrace(self):
+        f = _fresh_jit()
+        f(jnp.ones(8))
+        with pytest.raises(RetraceError):
+            with sync_discipline():
+                f(jnp.ones(16))

@@ -444,3 +444,55 @@ def test_unseen_entities_and_columns_score_zero(rng):
     np.testing.assert_array_equal(
         GameTransformer(model=model).score(data, include_offsets=False), np.zeros(n)
     )
+
+
+# ----------------------------------------------------- runtime sync discipline
+# PR 1's "zero retraces after warmup" was prose + an engine-local counter;
+# these tests enforce it with the process-wide runtime guard
+# (photon_ml_tpu/analysis/runtime_guard.py): introducing a post-warmup retrace
+# ANYWHERE in the serving path — or, on accelerator backends, an implicit
+# device->host transfer — makes this file fail.
+
+
+def _guard_model_and_req(rng):
+    model = GameModel(
+        models={"fixed": fixed_model(rng), "per-user": random_model(rng, "userId", 10)}
+    )
+
+    def req(n):
+        return GameInput(
+            features={
+                "global": rng.normal(size=(n, 6)),
+                "re_shard": sp.csr_matrix(rng.normal(size=(n, 5)) + 10.0),
+            },
+            id_columns={
+                "userId": np.asarray([f"e{i % 10}" for i in range(n)], dtype=object)
+            },
+        )
+
+    return get_engine(model), req
+
+
+def test_steady_state_serving_under_sync_discipline(rng):
+    """The serving contract, enforced: a warmed engine scores a same-bucket
+    request stream with ZERO jaxpr traces and no unnamed d->h transfer."""
+    from photon_ml_tpu.analysis.runtime_guard import sync_discipline
+
+    eng, req = _guard_model_and_req(rng)
+    eng.score(req(50))  # warmup compile OUTSIDE the guard
+    with sync_discipline(what="serving steady state") as region:
+        for n in (50, 60, 64, 57):  # all pad into the 64 bucket
+            eng.score(req(n))
+    assert region.traces == 0
+
+
+def test_post_warmup_retrace_fails_the_guard(rng):
+    """A bucket-crossing request is a compile-cache miss: the guard must turn
+    it into a hard failure rather than a silently slower request."""
+    from photon_ml_tpu.analysis.runtime_guard import RetraceError, sync_discipline
+
+    eng, req = _guard_model_and_req(rng)
+    eng.score(req(50))
+    with pytest.raises(RetraceError, match="jaxpr trace"):
+        with sync_discipline(what="serving steady state"):
+            eng.score(req(100))  # 128 bucket: must compile -> guard trips

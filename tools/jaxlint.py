@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""jaxlint CLI: JAX-aware static analysis for host-sync/retrace/tracer hazards.
+
+Usage (from the repo root)::
+
+    python tools/jaxlint.py photon_ml_tpu                      # human output
+    python tools/jaxlint.py photon_ml_tpu --format json        # machine output
+    python tools/jaxlint.py photon_ml_tpu --update-baseline    # shrink/refresh
+    python tools/jaxlint.py some_file.py --no-baseline         # raw scan
+    python tools/jaxlint.py --list-rules
+
+Exit codes: 0 clean; 1 new findings (not covered by the baseline, or any
+finding with ``--no-baseline``); 2 stale baseline entries (a baselined
+finding was fixed — rerun with ``--update-baseline`` and commit the smaller
+file); 3 files that could not be read/parsed (an unanalyzed file is not a
+green gate). Rule catalog and suppression policy: docs/PERFORMANCE.md.
+
+The analyzer is pure stdlib. ``photon_ml_tpu/__init__`` imports jax, so when
+jax (or the package install) is unavailable this script loads the
+``photon_ml_tpu.analysis`` subpackage directly off the source tree through a
+namespace stub — the lint job needs sources, not a runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import types
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "jaxlint_baseline.json"
+
+
+def _load_analysis():
+    """Import photon_ml_tpu.analysis without executing photon_ml_tpu/__init__
+    (which imports jax). A parent-package stub with just ``__path__`` lets the
+    normal import machinery find the subpackage off the source tree."""
+    if "photon_ml_tpu" not in sys.modules:
+        stub = types.ModuleType("photon_ml_tpu")
+        stub.__path__ = [str(REPO_ROOT / "photon_ml_tpu")]
+        sys.modules["photon_ml_tpu"] = stub
+    import importlib
+
+    return (
+        importlib.import_module("photon_ml_tpu.analysis.linter"),
+        importlib.import_module("photon_ml_tpu.analysis.baseline"),
+        importlib.import_module("photon_ml_tpu.analysis.rules"),
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="JAX-aware static analysis: host syncs, retraces, tracer safety",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument(
+        "--baseline",
+        default=str(DEFAULT_BASELINE),
+        help=f"baseline file (default: {DEFAULT_BASELINE.name} next to this script)",
+    )
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report and fail on every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from this scan's findings and exit 0")
+    p.add_argument("--disable", action="append", default=[], metavar="RULE",
+                   help="disable a rule id (repeatable)")
+    p.add_argument("--severity", action="append", default=[], metavar="RULE=LEVEL",
+                   help="override a rule's severity, e.g. HS001=error (repeatable)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also list findings silenced by inline suppressions")
+    p.add_argument("--exclude", action="append", default=[], metavar="SUBSTR",
+                   help="skip files whose path contains SUBSTR (repeatable); "
+                        "the jaxlint fixture corpus is always excluded")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    linter, baseline_mod, rules_mod = _load_analysis()
+
+    if args.list_rules:
+        for rule in rules_mod.RULES.values():
+            print(f"{rule.id}  [{rule.default_severity.name.lower():7s}] "
+                  f"{rule.name}: {rule.description}")
+        return 0
+    if not args.paths:
+        p.error("no paths given (try: python tools/jaxlint.py photon_ml_tpu)")
+
+    overrides = {}
+    for spec in args.severity:
+        rule_id, _, level = spec.partition("=")
+        if not level:
+            p.error(f"--severity expects RULE=LEVEL, got {spec!r}")
+        overrides[rule_id.strip().upper()] = rules_mod.Severity.parse(level)
+    try:
+        config = rules_mod.RuleConfig(
+            disabled=frozenset(r.strip().upper() for r in args.disable),
+            severity_overrides=overrides,
+        )
+    except ValueError as e:
+        p.error(str(e))
+
+    # the fixture corpus is intentional violations; never lint it for real
+    exclude = list(args.exclude) + ["tests/fixtures/jaxlint"]
+    result = linter.lint_paths(args.paths, config=config,
+                               rel_root=str(REPO_ROOT), exclude=exclude)
+    for path, message in result.errors:
+        print(f"jaxlint: {path}: {message}", file=sys.stderr)
+
+    if args.update_baseline:
+        doc = baseline_mod.save(args.baseline, result.findings,
+                                scanned_paths=result.scanned)
+        print(f"jaxlint: wrote {args.baseline}: {doc['total']} baselined finding(s)")
+        return 0
+
+    new, stale = result.findings, []
+    baseline_used = None
+    if not args.no_baseline and Path(args.baseline).exists():
+        baseline_used = args.baseline
+        d = baseline_mod.diff(result.findings, baseline_mod.load(args.baseline),
+                              scanned_paths=result.scanned)
+        new, stale = d.new, d.stale
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in result.findings],
+            "new": [f.to_json() for f in new],
+            "stale_baseline_entries": stale,
+            "suppressed": [f.to_json() for f in result.suppressed]
+            if args.show_suppressed else [],
+            "summary": {
+                "files_with_errors": len(result.errors),
+                "total": len(result.findings),
+                "suppressed": len(result.suppressed),
+                "new": len(new),
+                "stale": len(stale),
+                "by_severity": result.counts(),
+                "baseline": baseline_used,
+            },
+        }, indent=2))
+    else:
+        shown = new if baseline_used else result.findings
+        for f in shown:
+            print(f.format_human())
+        if args.show_suppressed:
+            for f in result.suppressed:
+                print(f"{f.path}:{f.line}: {f.rule} suppressed: {f.message}")
+        for entry in stale:
+            print(f"stale baseline entry (finding fixed — shrink the baseline): "
+                  f"{entry['key']} (missing {entry['missing']})")
+        label = "new finding(s)" if baseline_used else "finding(s)"
+        print(
+            f"jaxlint: {len(result.findings)} finding(s) "
+            f"({len(result.suppressed)} suppressed), {len(new)} {label}, "
+            f"{len(stale)} stale baseline entr(y/ies)"
+            + (f" [baseline: {baseline_used}]" if baseline_used else "")
+        )
+        if stale:
+            print("jaxlint: regenerate with --update-baseline and commit the "
+                  "smaller baseline")
+
+    if result.errors:
+        # a file the scan could not analyze is an ungreen gate, not a pass
+        print(f"jaxlint: {len(result.errors)} file(s) could not be analyzed",
+              file=sys.stderr)
+        return 3
+    if stale:
+        return 2
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
