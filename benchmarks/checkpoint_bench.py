@@ -1,0 +1,186 @@
+"""Checkpoint-write overhead: measure it, don't guess.
+
+Quantifies what one generational checkpoint save (io/checkpoint.py: per-array
+writes + SHA-256 checksums + manifest + rename commit) costs per
+coordinate-descent iteration, at a few representative GAME model sizes, and
+separates the checksum share from the raw-write share. Feeds the
+PERFORMANCE.md "Checkpoint-write overhead" numbers.
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/checkpoint_bench.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _models(rng, fe_dim: int, n_entities: int, k: int):
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+    from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+    from photon_ml_tpu.types import TaskType
+
+    fe = FixedEffectModel(
+        model=LogisticRegressionModel(
+            Coefficients(means=jnp.asarray(rng.normal(size=fe_dim), dtype=jnp.float32))
+        ),
+        feature_shard_id="global",
+    )
+    re = RandomEffectModel(
+        re_type="userId",
+        feature_shard_id="per-user",
+        task=TaskType.LOGISTIC_REGRESSION,
+        entity_ids=tuple(f"u{i}" for i in range(n_entities)),
+        coeffs=jnp.asarray(rng.normal(size=(n_entities, k)), dtype=jnp.float32),
+        proj_indices=jnp.asarray(
+            rng.integers(0, k, size=(n_entities, k)), dtype=jnp.int32
+        ),
+    )
+    return {"fixed": fe, "per-user": re}
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for dirpath, _, files in os.walk(path):
+        total += sum(os.path.getsize(os.path.join(dirpath, f)) for f in files)
+    return total
+
+
+def bench_save(models, reps: int) -> dict:
+    from photon_ml_tpu.io.checkpoint import save_checkpoint
+
+    root = tempfile.mkdtemp(prefix="ckpt-bench-")
+    try:
+        # generation 1 is cold (makedirs); measure steady-state generations
+        save_checkpoint(root, models, 0, best_models=models, best_metric=0.5)
+        gen_bytes = _dir_bytes(root)
+        times = []
+        for i in range(reps):
+            t0 = time.perf_counter()
+            save_checkpoint(
+                root, models, i + 1, best_models=models, best_metric=0.5
+            )
+            times.append(time.perf_counter() - t0)
+        # checksum share: hash the same bytes ONE save hashed (the newest
+        # generation only — the root also retains older generations)
+        newest = os.path.join(root, sorted(
+            n for n in os.listdir(root) if n.startswith("gen-")
+        )[-1])
+        paths = []
+        for dirpath, _, files in os.walk(newest):
+            paths += [os.path.join(dirpath, f) for f in files]
+        t0 = time.perf_counter()
+        for p in paths:
+            h = hashlib.sha256()
+            with open(p, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+        sha_time = time.perf_counter() - t0
+        return {
+            "save_ms_median": 1e3 * float(np.median(times)),
+            "save_ms_p90": 1e3 * float(np.quantile(times, 0.9)),
+            "gen_mb": gen_bytes / 1e6,
+            "sha_ms": 1e3 * sha_time,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_iteration(rng) -> float:
+    """One steady-state coordinate-descent iteration (fixed + random effect,
+    the chaos problem scaled up a bit) as the denominator: seconds/iteration."""
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.algorithm import (
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+        run_coordinate_descent,
+    )
+    from photon_ml_tpu.data.dataset import FixedEffectDataset, LabeledData
+    from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+    from photon_ml_tpu.optimization.common import OptimizerConfig
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    n, d, users = 20_000, 50, 200
+    X = rng.normal(size=(n, d))
+    y = (X @ rng.normal(size=d) > 0).astype(np.float64)
+    uids = np.asarray([f"u{i % users}" for i in range(n)], dtype=object)
+    X_re = sp.csr_matrix(np.stack([np.ones(n), rng.normal(size=n)], axis=1))
+    cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(max_iterations=50, tolerance=1e-8),
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    coords = {
+        "fixed": FixedEffectCoordinate(
+            coordinate_id="fixed",
+            dataset=FixedEffectDataset(LabeledData.build(X, y)),
+            task=TaskType.LOGISTIC_REGRESSION,
+            configuration=cfg,
+        ),
+        "per-user": RandomEffectCoordinate(
+            coordinate_id="per-user",
+            dataset=build_random_effect_dataset(
+                X_re, uids, "userId", feature_shard_id="per-user", labels=y
+            ),
+            task=TaskType.LOGISTIC_REGRESSION,
+            configuration=cfg,
+            base_offsets=jnp.zeros(n),
+        ),
+    }
+    run_coordinate_descent(coords, n_iterations=1)  # compile warmup
+    t0 = time.perf_counter()
+    run_coordinate_descent(coords, n_iterations=2)
+    return (time.perf_counter() - t0) / 2
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--reps", type=int, default=7)
+    p.add_argument("--skip-iteration", action="store_true",
+                   help="only measure save costs (no training denominator)")
+    args = p.parse_args()
+    rng = np.random.default_rng(0)
+
+    shapes = [
+        ("small  (FE 1k,  RE 1k x 16)", 1_000, 1_000, 16),
+        ("medium (FE 100k, RE 10k x 32)", 100_000, 10_000, 32),
+        ("large  (FE 1M,  RE 100k x 32)", 1_000_000, 100_000, 32),
+    ]
+    print(f"{'model':32s} {'gen MB':>8s} {'save ms':>9s} {'p90 ms':>8s} {'sha ms':>8s}")
+    rows = []
+    for label, fe_dim, ents, k in shapes:
+        r = bench_save(_models(rng, fe_dim, ents, k), args.reps)
+        rows.append((label, r))
+        print(
+            f"{label:32s} {r['gen_mb']:8.1f} {r['save_ms_median']:9.2f} "
+            f"{r['save_ms_p90']:8.2f} {r['sha_ms']:8.2f}"
+        )
+    if not args.skip_iteration:
+        it = bench_iteration(rng)
+        print(f"\ncoordinate-descent iteration (n=20k, d=50, E=200): {1e3 * it:.1f} ms")
+        for label, r in rows:
+            print(
+                f"  overhead/iteration @ {label}: "
+                f"{100 * r['save_ms_median'] / 1e3 / it:.2f}%"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -287,6 +287,20 @@ class ModelCoordinate(Coordinate):
         return (model if model is not None else self.model).score_dataset(self.dataset)
 
 
+def coefficient_arrays(model) -> list:
+    """The device arrays whose finiteness defines a healthy coordinate update
+    (the divergence guard's input, algorithm/coordinate_descent.py): a solver
+    that emits NaN/Inf here has diverged and its update must be rejected.
+    Variance estimates are deliberately excluded — scoring never consumes
+    them, and a singular-Hessian variance failure should not discard an
+    otherwise-converged mean update."""
+    if isinstance(model, FixedEffectModel):
+        return [model.model.coefficients.means]
+    if isinstance(model, RandomEffectModel):
+        return [model.coeffs]
+    raise TypeError(f"Unknown model type: {type(model).__name__}")
+
+
 def score_model_on_dataset(model, dataset) -> Array:
     """Generic scoring dispatch used for validation data
     (DatumScoringModel.scoreForCoordinateDescent)."""

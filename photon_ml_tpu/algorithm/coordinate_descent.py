@@ -20,18 +20,46 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 import time
 from typing import Mapping, Optional
 
+import jax
 import jax.numpy as jnp
 
-from photon_ml_tpu.algorithm.coordinate import Coordinate, score_model_on_dataset
+from photon_ml_tpu.algorithm.coordinate import (
+    Coordinate,
+    coefficient_arrays,
+    score_model_on_dataset,
+)
 from photon_ml_tpu.evaluation.evaluators import EvaluationSuite
 from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.resilience import faultpoint, register_fault_point
+from photon_ml_tpu.resilience.incidents import Incident
 
 Array = jnp.ndarray
 
 logger = logging.getLogger(__name__)
+
+# armed as coord.update.<coordinate_id> (hierarchical match): chaos proves a
+# crash between any two coordinate updates resumes to the identical model
+FP_COORD_UPDATE = register_fault_point("coord.update")
+
+
+def _divergence_cause(model, tracker) -> Optional[str]:
+    """Why this update must be rejected, or None when it is healthy: the
+    solver's final objective value blew up, or the coefficients it emitted
+    contain NaN/Inf (TRON/L-BFGS/OWL-QN on hostile data can do either)."""
+    final_value = getattr(tracker, "final_value", None)
+    if final_value is not None and not math.isfinite(final_value):
+        return f"training objective is non-finite ({final_value})"
+    flags = [jnp.all(jnp.isfinite(a)) for a in coefficient_arrays(model)]
+    # one deliberate scalar host read per coordinate update (the guard must
+    # decide before the next coordinate trains); reductions fuse device-side
+    ok = bool(jax.device_get(jnp.stack(flags).all()))
+    if not ok:
+        return "solver emitted non-finite coefficients"
+    return None
 
 
 @dataclasses.dataclass
@@ -47,6 +75,9 @@ class CoordinateDescentResult:
     # full metrics dict of the best snapshot (survives checkpoint resume, where
     # the row that set best_metric may predate the resumed metrics_history)
     best_metrics: Optional[dict] = None
+    # survived failures (rejected divergent updates, checkpoint rollbacks) —
+    # graceful degradation is recorded, never silent (resilience/incidents.py)
+    incidents: list = dataclasses.field(default_factory=list)
 
     @property
     def has_validation(self) -> bool:
@@ -94,6 +125,7 @@ def run_coordinate_descent(
     restored_best_models = None
     restored_best_metric = None
     restored_best_metrics = None
+    incidents: list[Incident] = []
     if checkpointer is not None:
         restored = checkpointer.restore()
         if restored is not None and set(restored["models"]) != set(coordinate_ids):
@@ -103,12 +135,25 @@ def run_coordinate_descent(
                 sorted(coordinate_ids),
             )
             restored = None
+        if restored is None:
+            # a restore that ends in a fresh start (only corrupt generations,
+            # or a rejected checkpoint) must not forget the quarantines it
+            # physically performed on the way
+            incidents = [
+                Incident.from_dict(d)
+                for d in getattr(checkpointer, "restore_incidents", [])
+            ]
         if restored is not None:
             start_iteration = restored["completed_iterations"]
             initial_models = restored["models"]
             restored_best_models = restored["best_models"]
             restored_best_metric = restored["best_metric"]
             restored_best_metrics = restored.get("best_metrics")
+            # incident history survives the crash: a resumed run still knows
+            # what its predecessor absorbed (and any restore-time rollback)
+            incidents = [
+                Incident.from_dict(d) for d in restored.get("incidents") or []
+            ]
             if start_iteration > n_iterations:
                 logger.warning(
                     "Checkpoint has %d completed iterations but only %d were "
@@ -168,12 +213,30 @@ def run_coordinate_descent(
         full_train_score = sum(train_scores.values())
         for cid in updatable:
             coord = coordinates[cid]
+            faultpoint(f"{FP_COORD_UPDATE}.{cid}")
             t0 = time.perf_counter()
             # Residual trick (CoordinateDescent.scala:197-204)
             partial = full_train_score - train_scores[cid]
             model, tracker = coord.update_model(models[cid], partial)
-            models[cid] = model
             trackers[cid].append(tracker)
+            cause = _divergence_cause(model, tracker)
+            if cause is not None:
+                # Divergence guard: REJECT the update — the previous model for
+                # this coordinate is kept (scores unchanged), an incident is
+                # recorded, and the descent continues over the remaining
+                # coordinates. Graceful degradation instead of a poisoned GAME
+                # model, mirroring eager Photon's keep-best semantics.
+                incident = Incident(
+                    kind="divergence",
+                    cause=cause,
+                    action="update rejected; previous model kept",
+                    coordinate_id=cid,
+                    iteration=iteration,
+                )
+                incidents.append(incident)
+                logger.warning("iter %d %s", iteration, incident.summary())
+                continue
+            models[cid] = model
             new_score = coord.score(model)
             train_scores[cid] = new_score
             full_train_score = partial + new_score
@@ -206,6 +269,7 @@ def run_coordinate_descent(
                 best_metric,
                 best_metrics,
                 force=(iteration + 1 == n_iterations),
+                incidents=incidents,
             )
 
     final_model = GameModel(models=dict(models))
@@ -219,4 +283,5 @@ def run_coordinate_descent(
         trackers=trackers,
         training_scores=dict(train_scores),
         best_metrics=best_metrics,
+        incidents=incidents,
     )

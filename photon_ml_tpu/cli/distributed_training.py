@@ -172,6 +172,42 @@ def _mp_ckpt_paths(directory, rank):
     return base + ".npz", base + "-prev.npz"
 
 
+def _mp_ckpt_write(path, out, logger, rotate_to=None):
+    """Atomic (tmp + replace), RETRIED rank-local checkpoint write shared by
+    the multi-process checkpointers: transient shared-filesystem OSErrors get
+    bounded backoff+jitter (resilience/retry.py) instead of killing every
+    rank of the job. ``rotate_to`` keeps one older generation: an existing
+    ``path`` moves there before the new file lands (safe across retries — a
+    re-attempt after the rotation simply finds no current file)."""
+    from photon_ml_tpu.resilience import Retry
+
+    def _attempt():
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **out)
+        if rotate_to is not None and os.path.exists(path):
+            os.replace(path, rotate_to)
+        os.replace(tmp, path)
+
+    Retry(max_attempts=3, base_delay=0.1, max_delay=2.0).call(
+        _attempt, description=f"checkpoint write {os.path.basename(path)}"
+    )
+
+
+def _mp_clean_stale_tmp(directory, rank, logger):
+    """Drop this rank's leaked ``*.tmp`` staging files (a crash mid-write
+    leaves them next to the live checkpoint forever otherwise). Rank-scoped:
+    peers' staging files may be live concurrent writes."""
+    marker = f"-r{rank:05d}.npz.tmp"
+    for name in sorted(os.listdir(directory)):
+        if name.endswith(marker):
+            logger.info("removing stale checkpoint staging file %s", name)
+            try:
+                os.remove(os.path.join(directory, name))
+            except OSError:
+                pass
+
+
 class _MpFeCheckpointer:
     """Per-configuration checkpointing for the fixed-effect-only sweep: each
     completed configuration writes ONE immutable rank-local file (atomic
@@ -186,6 +222,7 @@ class _MpFeCheckpointer:
         self.logger = logger
         self.fingerprint = _mp_ckpt_fingerprint(args, nproc, coord_configs)
         os.makedirs(directory, exist_ok=True)
+        _mp_clean_stale_tmp(directory, rank, logger)
 
     def _path(self, j, rank=None):
         r = self.rank if rank is None else rank
@@ -198,11 +235,7 @@ class _MpFeCheckpointer:
             "vars": np.asarray(variances) if variances is not None else np.zeros(0),
             "meta": np.asarray([json.dumps(evals)], dtype=str),
         }
-        path = self._path(j)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **out)
-        os.replace(tmp, path)
+        _mp_ckpt_write(self._path(j), out, self.logger)
         self.logger.info("checkpointed config %d", j)
 
     def _valid(self, path):
@@ -252,6 +285,7 @@ class _MpGameCheckpointer:
         # validate every peer file against the same expected value
         self.fingerprint = _mp_ckpt_fingerprint(args, nproc, coord_configs)
         os.makedirs(directory, exist_ok=True)
+        _mp_clean_stale_tmp(directory, rank, logger)
 
     # ---- serialization ----------------------------------------------------
     def _pack_model(self, out, prefix, m):
@@ -316,11 +350,7 @@ class _MpGameCheckpointer:
         for cid in self.re_cids:
             if entry["re"].get(cid) is not None:
                 self._pack_model(out, f"re:{cid}", entry["re"][cid])
-        path = self._cfg_path(j)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **out)
-        os.replace(tmp, path)
+        _mp_ckpt_write(self._cfg_path(j), out, self.logger)
 
     def save(self, i, p, fe_coeffs, fe_vars, re_models, re_scores_home,
              track, n_completed_configs):
@@ -352,12 +382,7 @@ class _MpGameCheckpointer:
                 if track["re"] and track["re"].get(cid) is not None:
                     self._pack_model(out, f"track:re:{cid}", track["re"][cid])
         cur, prev = _mp_ckpt_paths(self.directory, self.rank)
-        tmp = cur + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **out)
-        if os.path.exists(cur):
-            os.replace(cur, prev)  # keep one older generation
-        os.replace(tmp, cur)
+        _mp_ckpt_write(cur, out, self.logger, rotate_to=prev)
         self.logger.info("checkpointed config %d pass %d", i, p)
 
     # ---- resume -----------------------------------------------------------

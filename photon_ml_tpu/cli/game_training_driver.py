@@ -141,6 +141,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "completed iteration")
     p.add_argument("--checkpoint-interval", type=int, default=1,
                    help="Save every k-th coordinate-descent iteration")
+    p.add_argument("--checkpoint-keep-generations", type=int, default=3,
+                   help="Checkpoint generations retained for integrity "
+                        "rollback: restore verifies checksums and falls back "
+                        "to the newest valid generation")
+    p.add_argument("--fault-plan", default=None,
+                   help="Deterministic fault injection plan, e.g. "
+                        "'checkpoint.write.manifest:crash:2' (also via the "
+                        "PHOTON_FAULT_PLAN env var; resilience/faultpoints.py)")
     p.add_argument("--compilation-cache-directory", default=None,
                    help="Persistent XLA compilation cache: repeated runs skip "
                         "recompiling the optimizer programs (jit warm start "
@@ -273,11 +281,14 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
     # data placement): jax.distributed.initialize after backend init either
     # errors or silently leaves the "global" mesh host-local.
     from photon_ml_tpu.cli.runtime import (
+        arm_fault_plan_from_args,
         configure_compilation_cache,
         initialize_distributed_from_args,
         prepare_output_root,
     )
 
+    # fault plan first: distributed.init is itself an injectable fault point
+    arm_fault_plan_from_args(args)
     rank, nproc = initialize_distributed_from_args(args)
     configure_compilation_cache(args)
     emitter = emitter or EventEmitter()
@@ -519,6 +530,9 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             mesh=mesh,
             checkpoint_directory=args.checkpoint_directory,
             checkpoint_interval=args.checkpoint_interval,
+            checkpoint_keep_generations=getattr(
+                args, "checkpoint_keep_generations", 3
+            ),
             fe_storage_dtype=fe_storage_dtype,
             re_storage_dtype=re_storage_dtype,
             fused_pass=backend == "fused",
@@ -604,11 +618,26 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             for shard, imap in index_maps.items():
                 imap.save(os.path.join(root, "index-maps", shard))
 
+        # -- incident report: survived failures (rejected divergent updates,
+        # checkpoint rollbacks) are an artifact, not just log lines ----------
+        incidents = [
+            inc.to_dict()
+            for r in results
+            if getattr(r, "descent", None) is not None
+            for inc in getattr(r.descent, "incidents", [])
+        ]
+        if incidents:
+            for inc in incidents:
+                logger.warning("incident: %s", inc)
+            with open(os.path.join(root, "incidents.json"), "w") as f:
+                json.dump(incidents, f, indent=2)
+
         emitter.send_event(Event("TrainingFinishEvent", {"bestIndex": best_index}))
         return {
             "results": results,
             "best_index": best_index,
             "output_directory": root,
+            "incidents": incidents,
         }
     finally:
         logger.close()

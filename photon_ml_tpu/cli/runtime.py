@@ -30,6 +30,18 @@ def add_distributed_arguments(parser, purpose: str) -> None:
     )
     parser.add_argument("--distributed-num-processes", type=int, default=None)
     parser.add_argument("--distributed-process-id", type=int, default=None)
+    parser.add_argument(
+        "--distributed-init-timeout", type=float, default=None,
+        help="Seconds each jax.distributed.initialize attempt may wait for "
+             "the coordinator (default: jax's own, 300s). See "
+             "docs/ARCHITECTURE.md 'Failure model & recovery'",
+    )
+    parser.add_argument(
+        "--distributed-init-retries", type=int, default=2,
+        help="Retries (exponential backoff + jitter) when joining the "
+             "distributed runtime fails — a coordinator that is still "
+             "starting is an incident, not a crash. 0 = fail fast",
+    )
 
 
 def prepare_output_root(root: str, override: bool, rank: int, nproc: int) -> None:
@@ -100,5 +112,18 @@ def initialize_distributed_from_args(args) -> tuple[int, int]:
         num_processes=getattr(args, "distributed_num_processes", None),
         process_id=getattr(args, "distributed_process_id", None),
         auto=coordinator == "auto",
+        initialization_timeout=getattr(args, "distributed_init_timeout", None),
+        retries=getattr(args, "distributed_init_retries", 2) or 0,
     )
     return world["process_id"], world["num_processes"]
+
+
+def arm_fault_plan_from_args(args) -> None:
+    """Arm the deterministic fault-injection plan (resilience/faultpoints.py)
+    from --fault-plan; without the flag the PHOTON_FAULT_PLAN env var still
+    applies (lazily, at the first fault point)."""
+    spec = getattr(args, "fault_plan", None)
+    if spec:
+        from photon_ml_tpu.resilience import arm
+
+        arm(spec)

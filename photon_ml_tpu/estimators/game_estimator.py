@@ -122,6 +122,9 @@ class GameEstimator:
     # leans on Spark lineage recomputation (CoordinateDescent.scala:130-160).
     checkpoint_directory: Optional[str] = None
     checkpoint_interval: int = 1
+    # how many checkpoint generations restore() can roll back through when the
+    # newest fails integrity verification (io/checkpoint.py)
+    checkpoint_keep_generations: int = 3
     # Store dense fixed-effect design matrices in a lower dtype (bfloat16):
     # matvecs read half the HBM bytes and hit the MXU natively while labels,
     # scores, coefficients and accumulation keep `dtype`
@@ -418,6 +421,7 @@ class GameEstimator:
                     interval=self.checkpoint_interval,
                     dtype=self.dtype,
                     fingerprint="|".join(fp_parts),
+                    keep_generations=self.checkpoint_keep_generations,
                 )
             descent = run_coordinate_descent(
                 coordinates,

@@ -1,26 +1,48 @@
-"""Iteration-level checkpoint/resume for coordinate descent.
+"""Generational, integrity-checked checkpoint/resume for coordinate descent.
 
 The reference delegates failure recovery to Spark (RDD lineage recomputation +
 DISK_ONLY persistence, CoordinateDescent.scala:130-160); it checkpoints models
 only at the end of a full run (ModelProcessingUtils.saveGameModelToHDFS:77-141).
-A single-controller JAX program has no lineage to replay, so recovery is explicit:
-after every completed coordinate-descent iteration the full GAME model state —
-current models, best-model snapshot, best metric — is written atomically to disk,
-and a restarted run resumes from the last completed iteration. Training scores
-are pure functions of the models, so nothing else needs saving: resume
-reinitializes from the checkpointed models and recomputes scores exactly.
+A single-controller JAX program has no lineage to replay, so recovery is
+explicit — and *verified*:
 
-Format: one ``.npz`` per coordinate (raw arrays, no pickling) plus a
-``state.json`` manifest; writes go to a temp directory renamed into place so a
-crash mid-write can never corrupt the latest checkpoint. This is the *internal*
-fast format — final model export still uses the reference-compatible
-BayesianLinearModelAvro layout (io/model_io.py).
+- After every completed coordinate-descent iteration the full GAME model state
+  (current models, best-model snapshot, best metric, incident history) is
+  written as a NEW generation ``<dir>/gen-<n>/``: one ``.npz`` per coordinate
+  (raw arrays, no pickling) plus a ``state.json`` manifest carrying a SHA-256
+  checksum of every artifact, with the manifest's own checksum in a sidecar.
+  Writes land in a ``gen-<n>.tmp`` staging dir renamed into place, so a crash
+  at any instruction never damages an existing generation.
+- ``load_checkpoint`` verifies every checksum and ROLLS BACK: a torn or
+  bit-rotted generation is quarantined (renamed ``gen-<n>.corrupt``) with a
+  logged incident, and restore proceeds from the newest generation that
+  verifies — never a crash, never a silent load of bad data. The last
+  ``keep_generations`` generations are retained for exactly this.
+- Transient I/O errors (OSError) retry with exponential backoff + jitter
+  (resilience/retry.py); the write path is instrumented with fault points
+  (``checkpoint.write.arrays`` / ``.manifest`` / ``.commit``,
+  ``checkpoint.restore``) so every failure window is replayable
+  (resilience/faultpoints.py, tests/test_chaos.py).
+
+Training scores are pure functions of the models, so nothing else needs
+saving: resume reinitializes from the checkpointed models and recomputes
+scores exactly (bit-identical resume, tests/test_checkpoint.py). This is the
+*internal* fast format — final model export still uses the
+reference-compatible BayesianLinearModelAvro layout (io/model_io.py).
+
+Legacy layout (pre-generational: ``state.json`` directly in the checkpoint
+directory, ``.old`` sibling from the old overwrite dance) is still read, with
+the same never-raise contract: an unreadable legacy checkpoint is quarantined
+and restore falls back (to ``.old``, else to a fresh start).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
+import re
 import shutil
 from typing import Optional
 
@@ -29,11 +51,40 @@ import numpy as np
 
 from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.models.glm import Coefficients, model_class_for_task
+from photon_ml_tpu.resilience import (
+    Retry,
+    corrupt_file,
+    faultpoint,
+    register_fault_point,
+)
+from photon_ml_tpu.resilience.incidents import Incident
 from photon_ml_tpu.types import TaskType
 
+logger = logging.getLogger(__name__)
+
 STATE_FILE = "state.json"
+STATE_SHA_FILE = "state.json.sha256"
 BEST_DIR = "best"
+GEN_PREFIX = "gen-"
+QUARANTINE_SUFFIX = ".corrupt"
+DEFAULT_KEEP_GENERATIONS = 3
 _TMP_SUFFIX = ".tmp"
+_GEN_RE = re.compile(r"^gen-(\d{8})$")
+_FORMAT = 2
+
+FP_WRITE_ARRAYS = register_fault_point("checkpoint.write.arrays")
+FP_WRITE_MANIFEST = register_fault_point("checkpoint.write.manifest")
+FP_WRITE_COMMIT = register_fault_point("checkpoint.write.commit")
+FP_RESTORE = register_fault_point("checkpoint.restore")
+
+# checkpoint I/O rides a shared-filesystem in production: transient OSErrors
+# get a bounded, jittered retry instead of killing the run
+_DEFAULT_RETRY = Retry(max_attempts=3, base_delay=0.05, max_delay=1.0)
+
+
+class CheckpointCorruption(Exception):
+    """A generation failed integrity verification (internal control flow:
+    load_checkpoint converts it into quarantine + rollback, never raises it)."""
 
 
 # ------------------------------------------------------------- model <-> arrays
@@ -145,14 +196,34 @@ def _model_from_arrays(meta: dict, arrays, dtype) -> object:
     )
 
 
-# ------------------------------------------------------------------ save / load
+# ---------------------------------------------------------------- plumbing
 
 
-def _write_models(directory: str, models: dict, manifest: dict) -> None:
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _write_models(directory: str, subdir: str, models: dict, manifest: dict,
+                  checksums: dict) -> None:
+    """One .npz per coordinate into <directory>/<subdir>; fills per-model meta
+    into ``manifest`` and each file's SHA-256 into ``checksums`` (keyed by
+    generation-relative path)."""
     for cid, model in models.items():
         meta, arrays = _model_to_arrays(model)
         manifest[cid] = meta
-        np.savez(os.path.join(directory, f"{cid}.npz"), **arrays)
+        rel = os.path.join(subdir, f"{cid}.npz") if subdir else f"{cid}.npz"
+        path = os.path.join(directory, rel)
+        action = faultpoint(FP_WRITE_ARRAYS)
+        np.savez(path, **arrays)
+        checksums[rel] = _sha256_file(path)
+        if action == "corrupt":
+            # simulated bit-rot: damage lands AFTER the checksum is recorded,
+            # exactly the class restore's verification must catch
+            corrupt_file(path)
 
 
 def _read_models(directory: str, manifest: dict, dtype) -> dict:
@@ -164,6 +235,51 @@ def _read_models(directory: str, manifest: dict, dtype) -> dict:
     return models
 
 
+def _generations(root: str) -> list[tuple[int, str]]:
+    """[(generation number, absolute path)] ascending; ignores staging/
+    quarantined/legacy entries."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        m = _GEN_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(root, name)))
+    return sorted(out)
+
+
+def _clean_stale_tmp(root: str) -> None:
+    """Remove staging leftovers a crash mid-write leaked: ``gen-*.tmp`` dirs
+    under the root and the legacy ``<root>.tmp`` sibling."""
+    candidates = []
+    if os.path.isdir(root):
+        candidates += [
+            os.path.join(root, n) for n in os.listdir(root) if n.endswith(_TMP_SUFFIX)
+        ]
+    legacy = root.rstrip(os.sep) + _TMP_SUFFIX
+    if os.path.exists(legacy):
+        candidates.append(legacy)
+    for path in candidates:
+        logger.info("removing stale checkpoint staging dir %s", path)
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def _quarantine(path: str) -> None:
+    """Move a failed-verification generation aside (never silently reuse it,
+    never destroy the evidence)."""
+    target = path + QUARANTINE_SUFFIX
+    try:
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        os.rename(path, target)
+        logger.warning("quarantined corrupt checkpoint generation: %s", target)
+    except OSError:  # a failed quarantine must not block the rollback
+        logger.warning("could not quarantine %s; ignoring it", path, exc_info=True)
+
+
+# ------------------------------------------------------------------ save / load
+
+
 def save_checkpoint(
     directory: str,
     models: dict,
@@ -172,88 +288,250 @@ def save_checkpoint(
     best_metric: Optional[float] = None,
     best_metrics: Optional[dict] = None,
     fingerprint: Optional[str] = None,
-) -> None:
-    """Atomically write a coordinate-descent checkpoint (tmp dir + rename).
+    incidents: Optional[list] = None,
+    keep_generations: int = DEFAULT_KEEP_GENERATIONS,
+    retry: Optional[Retry] = None,
+) -> str:
+    """Write a NEW checkpoint generation (staging dir + rename); returns its
+    path. Keeps the newest ``keep_generations`` generations, pruning older
+    ones (quarantined generations are left for inspection).
 
-    ``fingerprint`` identifies the run configuration; ``load_checkpoint`` with a
-    different fingerprint refuses the checkpoint, so a rerun with changed
-    hyperparameters/data cannot silently reuse stale trained state."""
-    parent = os.path.dirname(os.path.abspath(directory)) or "."
-    os.makedirs(parent, exist_ok=True)
-    tmp = os.path.abspath(directory) + _TMP_SUFFIX
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+    ``fingerprint`` identifies the run configuration; ``load_checkpoint`` with
+    a different fingerprint refuses the checkpoint, so a rerun with changed
+    hyperparameters/data cannot silently reuse stale trained state.
+    ``incidents`` (list of Incident or dicts) persists the run's survived-
+    failure history into the manifest. Transient OSErrors retry with backoff;
+    each attempt restages from scratch, so a failed attempt leaves nothing
+    half-written."""
+    if keep_generations < 1:
+        raise ValueError(f"keep_generations must be >= 1, got {keep_generations}")
+    root = os.path.abspath(directory)
+    incident_dicts = [
+        i.to_dict() if isinstance(i, Incident) else dict(i) for i in (incidents or [])
+    ]
 
-    state = {
-        "completed_iterations": int(completed_iterations),
-        "fingerprint": fingerprint,
-        "best_metric": None if best_metric is None else float(best_metric),
-        "best_metrics": (
-            None
-            if best_metrics is None
-            else {k: float(v) for k, v in best_metrics.items()}
-        ),
-        "models": {},
-        "best_models": None,
-    }
-    _write_models(tmp, models, state["models"])
-    if best_models is not None:
-        best_dir = os.path.join(tmp, BEST_DIR)
-        os.makedirs(best_dir)
-        state["best_models"] = {}
-        _write_models(best_dir, best_models, state["best_models"])
+    def _attempt() -> str:
+        os.makedirs(root, exist_ok=True)
+        _clean_stale_tmp(root)
+        gens = _generations(root)
+        gen_num = (gens[-1][0] + 1) if gens else 1
+        final = os.path.join(root, f"{GEN_PREFIX}{gen_num:08d}")
+        tmp = final + _TMP_SUFFIX
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
 
-    with open(os.path.join(tmp, STATE_FILE), "w") as f:
-        json.dump(state, f)
+        state = {
+            "format": _FORMAT,
+            "generation": gen_num,
+            "completed_iterations": int(completed_iterations),
+            "fingerprint": fingerprint,
+            "best_metric": None if best_metric is None else float(best_metric),
+            "best_metrics": (
+                None
+                if best_metrics is None
+                else {k: float(v) for k, v in best_metrics.items()}
+            ),
+            "models": {},
+            "best_models": None,
+            "incidents": incident_dicts,
+            "checksums": {},
+        }
+        _write_models(tmp, "", models, state["models"], state["checksums"])
+        if best_models is not None:
+            os.makedirs(os.path.join(tmp, BEST_DIR))
+            state["best_models"] = {}
+            _write_models(
+                tmp, BEST_DIR, best_models, state["best_models"], state["checksums"]
+            )
 
-    final = os.path.abspath(directory)
-    if os.path.exists(final):
-        old = final + ".old"
-        if os.path.exists(old):
-            shutil.rmtree(old)
-        os.rename(final, old)
+        action = faultpoint(FP_WRITE_MANIFEST)
+        state_path = os.path.join(tmp, STATE_FILE)
+        with open(state_path, "w") as f:
+            json.dump(state, f)
+        # the manifest's own integrity record: bit-rot inside syntactically
+        # valid JSON is still detected at restore
+        with open(os.path.join(tmp, STATE_SHA_FILE), "w") as f:
+            f.write(_sha256_file(state_path) + "\n")
+        if action == "corrupt":
+            corrupt_file(state_path)
+
+        faultpoint(FP_WRITE_COMMIT)
         os.rename(tmp, final)
-        shutil.rmtree(old)
-    else:
-        os.rename(tmp, final)
+
+        for _, old_path in _generations(root)[:-keep_generations]:
+            shutil.rmtree(old_path, ignore_errors=True)
+        return final
+
+    return (retry or _DEFAULT_RETRY).call(_attempt, description="checkpoint save")
 
 
-def load_checkpoint(
-    directory: str, dtype=jnp.float32, fingerprint: Optional[str] = None
-) -> Optional[dict]:
-    """Returns {completed_iterations, models, best_models, best_metric} or None
-    when no (complete) checkpoint exists. A leftover ``.tmp`` dir from a crash
-    mid-write is ignored; a ``.old`` dir left by a crash *between* the two
-    overwrite renames is recovered as the latest complete checkpoint. A saved
-    ``fingerprint`` differing from the requested one rejects the checkpoint."""
-    directory = os.path.abspath(directory)
-    state_path = os.path.join(directory, STATE_FILE)
-    if not os.path.exists(state_path):
-        # crash window in save_checkpoint: final was renamed to .old but .tmp
-        # was not yet promoted — the .old dir is the last complete checkpoint
-        old = directory + ".old"
-        if os.path.exists(os.path.join(old, STATE_FILE)):
-            directory, state_path = old, os.path.join(old, STATE_FILE)
-        else:
-            return None
-    with open(state_path) as f:
-        state = json.load(f)
-    if fingerprint is not None and state.get("fingerprint") not in (None, fingerprint):
-        return None
-    models = _read_models(directory, state["models"], dtype)
-    best_models = None
-    if state.get("best_models") is not None:
-        best_models = _read_models(
-            os.path.join(directory, BEST_DIR), state["best_models"], dtype
+def _verify_and_load_generation(gen_dir: str, dtype) -> dict:
+    """Full integrity pass over one generation; raises CheckpointCorruption on
+    ANY defect (missing file, checksum mismatch, unreadable manifest/arrays)."""
+    state_path = os.path.join(gen_dir, STATE_FILE)
+    sha_path = os.path.join(gen_dir, STATE_SHA_FILE)
+    try:
+        with open(sha_path) as f:
+            expected = f.read().strip()
+    except OSError as e:
+        raise CheckpointCorruption(f"missing manifest checksum: {e}") from e
+    actual = None
+    try:
+        actual = _sha256_file(state_path)
+    except OSError as e:
+        raise CheckpointCorruption(f"unreadable manifest: {e}") from e
+    if actual != expected:
+        raise CheckpointCorruption(
+            f"manifest checksum mismatch in {gen_dir} "
+            f"(expected {expected[:12]}…, got {actual[:12]}…)"
         )
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruption(f"unparseable manifest: {e}") from e
+
+    for rel, expected in state.get("checksums", {}).items():
+        path = os.path.join(gen_dir, rel)
+        try:
+            actual = _sha256_file(path)
+        except OSError as e:
+            raise CheckpointCorruption(f"missing artifact {rel}: {e}") from e
+        if actual != expected:
+            raise CheckpointCorruption(
+                f"artifact checksum mismatch: {rel} in {gen_dir}"
+            )
+
+    try:
+        models = _read_models(gen_dir, state["models"], dtype)
+        best_models = None
+        if state.get("best_models") is not None:
+            best_models = _read_models(
+                os.path.join(gen_dir, BEST_DIR), state["best_models"], dtype
+            )
+    except Exception as e:  # torn .npz, bad metadata, dtype surprises ...
+        raise CheckpointCorruption(f"unreadable model arrays: {e}") from e
+
     return {
         "completed_iterations": state["completed_iterations"],
         "best_metric": state["best_metric"],
         "best_metrics": state.get("best_metrics"),
         "models": models,
         "best_models": best_models,
+        "incidents": list(state.get("incidents") or []),
+        "generation": state.get("generation"),
+        "fingerprint": state.get("fingerprint"),
     }
+
+
+def _load_legacy(directory: str, dtype) -> Optional[dict]:
+    """Pre-generational layout: state.json directly in ``directory``. No
+    checksums existed; a read failure quarantines the manifest so the next
+    restore doesn't retry it (fresh-start fallback, never a raise)."""
+    state_path = os.path.join(directory, STATE_FILE)
+    if not os.path.exists(state_path):
+        return None
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+        models = _read_models(directory, state["models"], dtype)
+        best_models = None
+        if state.get("best_models") is not None:
+            best_models = _read_models(
+                os.path.join(directory, BEST_DIR), state["best_models"], dtype
+            )
+    except Exception as e:
+        logger.warning(
+            "legacy checkpoint %s is unreadable (%s); quarantining it",
+            directory, e,
+        )
+        try:
+            os.rename(state_path, state_path + QUARANTINE_SUFFIX)
+        except OSError:
+            pass
+        return None
+    return {
+        "completed_iterations": state["completed_iterations"],
+        "best_metric": state["best_metric"],
+        "best_metrics": state.get("best_metrics"),
+        "models": models,
+        "best_models": best_models,
+        "incidents": list(state.get("incidents") or []),
+        "generation": None,
+        "fingerprint": state.get("fingerprint"),
+    }
+
+
+def _load_from_root(directory: str, dtype, sink: list) -> Optional[dict]:
+    """Newest-valid-generation scan over one checkpoint root: verify newest
+    first; quarantine + roll back on corruption; legacy layout as a last
+    resort. Each rollback is recorded as a checkpoint-corruption incident in
+    ``sink`` (and merged into the returned state's history when something
+    loads — the sink outlives a restore that finds nothing valid). The WHOLE
+    sink merges, not just this root's entries: when the main root was all
+    corrupt and the .old fallback loads, its state must still carry the main
+    root's quarantines (they happened during THIS restore)."""
+    for gen_num, gen_dir in reversed(_generations(directory)):
+        try:
+            restored = _verify_and_load_generation(gen_dir, dtype)
+        except CheckpointCorruption as e:
+            logger.warning(
+                "checkpoint generation %d failed verification (%s); "
+                "rolling back to the previous generation", gen_num, e,
+            )
+            _quarantine(gen_dir)
+            sink.append(
+                Incident(
+                    kind="checkpoint-corruption",
+                    cause=str(e),
+                    action=f"quarantined generation {gen_num}; rolled back",
+                ).to_dict()
+            )
+            continue
+        restored["incidents"] = restored["incidents"] + list(sink)
+        return restored
+    legacy = _load_legacy(directory, dtype)
+    if legacy is not None:
+        legacy["incidents"] = legacy["incidents"] + list(sink)
+    return legacy
+
+
+def load_checkpoint(
+    directory: str,
+    dtype=jnp.float32,
+    fingerprint: Optional[str] = None,
+    incident_sink: Optional[list] = None,
+) -> Optional[dict]:
+    """Restore {completed_iterations, models, best_models, best_metric,
+    best_metrics, incidents, generation} from the newest generation that
+    passes integrity verification, or None when no valid checkpoint exists.
+
+    Never raises on damage: a torn/bit-rotted generation is quarantined and
+    restore rolls back (the rollback appears in ``incidents``). Stale staging
+    dirs from crashes mid-write are removed. A ``.old`` sibling left by the
+    legacy overwrite dance is scanned as a fallback root. A saved
+    ``fingerprint`` differing from the requested one rejects the checkpoint
+    (that is a different RUN, not corruption — no rollback past it).
+
+    ``incident_sink`` (a list) collects rollback incident dicts even when the
+    restore ends in a fresh start (every generation corrupt): the caller can
+    still record WHY there was nothing to resume from."""
+    faultpoint(FP_RESTORE)
+    directory = os.path.abspath(directory)
+    _clean_stale_tmp(directory)
+    sink = incident_sink if incident_sink is not None else []
+    restored = _load_from_root(directory, dtype, sink)
+    if restored is None:
+        old = directory + ".old"
+        if os.path.isdir(old):
+            restored = _load_from_root(old, dtype, sink)
+    if restored is None:
+        return None
+    if fingerprint is not None and restored.get("fingerprint") not in (None, fingerprint):
+        return None
+    restored.pop("fingerprint", None)
+    return restored
 
 
 class CoordinateDescentCheckpointer:
@@ -263,6 +541,11 @@ class CoordinateDescentCheckpointer:
     ``force=True`` on the final iteration so the completed state is always
     saved regardless of the interval. ``fingerprint`` (optional) ties the
     checkpoint to a run configuration: restore returns None when it differs.
+    ``keep_generations`` bounds the rollback window (and the disk footprint).
+
+    ``restore()`` never raises: any unexpected failure logs and falls back to
+    a fresh start — a bad checkpoint must never be able to kill a run that
+    could simply retrain.
     """
 
     def __init__(
@@ -271,6 +554,7 @@ class CoordinateDescentCheckpointer:
         interval: int = 1,
         dtype=jnp.float32,
         fingerprint: Optional[str] = None,
+        keep_generations: int = DEFAULT_KEEP_GENERATIONS,
     ):
         if interval < 1:
             raise ValueError(f"checkpoint interval must be >= 1, got {interval}")
@@ -278,6 +562,7 @@ class CoordinateDescentCheckpointer:
         self.interval = int(interval)
         self.dtype = dtype
         self.fingerprint = fingerprint
+        self.keep_generations = int(keep_generations)
 
     def maybe_save(
         self,
@@ -287,6 +572,7 @@ class CoordinateDescentCheckpointer:
         best_metric: Optional[float],
         best_metrics: Optional[dict] = None,
         force: bool = False,
+        incidents: Optional[list] = None,
     ) -> bool:
         if not force and completed_iterations % self.interval != 0:
             return False
@@ -298,13 +584,33 @@ class CoordinateDescentCheckpointer:
             best_metric,
             best_metrics,
             fingerprint=self.fingerprint,
+            incidents=incidents,
+            keep_generations=self.keep_generations,
         )
         return True
 
     def restore(self) -> Optional[dict]:
-        return load_checkpoint(
-            self.directory, dtype=self.dtype, fingerprint=self.fingerprint
-        )
+        """``self.restore_incidents`` afterwards holds any rollback incidents
+        this restore produced — populated even when the result is None (all
+        generations corrupt -> fresh start), so the run can still record why
+        there was nothing to resume from."""
+        self.restore_incidents: list = []
+        try:
+            return load_checkpoint(
+                self.directory,
+                dtype=self.dtype,
+                fingerprint=self.fingerprint,
+                incident_sink=self.restore_incidents,
+            )
+        except Exception:
+            # the never-raise contract: unexpected damage (including errors
+            # outside the per-generation verification) degrades to a fresh
+            # start, not a crash loop. InjectedCrash (BaseException) still
+            # propagates — a simulated process death is not recoverable.
+            logger.exception(
+                "checkpoint restore from %s failed; starting fresh", self.directory
+            )
+            return None
 
     def clear(self) -> None:
         # also drop the .old/.tmp siblings: load_checkpoint falls back to .old,

@@ -17,12 +17,16 @@ of executors reading their HDFS splits.
 
 from __future__ import annotations
 
+import inspect
 from typing import Optional
 
 import jax
 import numpy as np
 
 from photon_ml_tpu.parallel.mesh import batch_sharding
+from photon_ml_tpu.resilience import Retry, faultpoint, register_fault_point
+
+FP_DISTRIBUTED_INIT = register_fault_point("distributed.init")
 
 
 def initialize_multi_host(
@@ -30,6 +34,9 @@ def initialize_multi_host(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     auto: bool = False,
+    initialization_timeout: Optional[float] = None,
+    retries: int = 0,
+    retry_base_delay: float = 1.0,
 ) -> dict:
     """Join the JAX distributed runtime.
 
@@ -41,20 +48,47 @@ def initialize_multi_host(
     environments (TPU pod / GKE metadata autodetection). With neither, this is
     a no-op reporter for single-process runs. Returns {"process_id",
     "num_processes", "local_devices", "global_devices"} for logging.
+
+    Failure model (docs/ARCHITECTURE.md "Failure model & recovery"): a slow
+    coordinator bounds each attempt via ``initialization_timeout`` (seconds,
+    forwarded to ``jax.distributed.initialize`` where the installed jax
+    supports it), and a failed attempt (RuntimeError/OSError: coordinator not
+    yet listening, transient DNS/socket errors) retries up to ``retries``
+    times with exponential backoff + jitter starting at ``retry_base_delay``
+    seconds — a flaky startup ordering is an incident, not a crash. The
+    default of 0 retries preserves fail-fast for interactive use.
     """
     already = getattr(jax.distributed, "is_initialized", None)
     initialized = already() if callable(already) else False
     if not initialized and (
         auto or coordinator_address is not None or num_processes is not None
     ):
-        if auto and coordinator_address is None and num_processes is None:
-            jax.distributed.initialize()
-        else:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
+        kwargs = {}
+        if initialization_timeout is not None:
+            # older jax has no initialization_timeout; gate on the signature
+            # rather than crashing every multi-host launch there
+            params = inspect.signature(jax.distributed.initialize).parameters
+            if "initialization_timeout" in params:
+                kwargs["initialization_timeout"] = int(initialization_timeout)
+
+        def _attempt():
+            faultpoint(FP_DISTRIBUTED_INIT)
+            if auto and coordinator_address is None and num_processes is None:
+                jax.distributed.initialize(**kwargs)
+            else:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    **kwargs,
+                )
+
+        Retry(
+            max_attempts=max(0, int(retries)) + 1,
+            base_delay=retry_base_delay,
+            max_delay=30.0,
+            retry_on=(RuntimeError, OSError),
+        ).call(_attempt, description="jax.distributed.initialize")
     return {
         "process_id": jax.process_index(),
         "num_processes": jax.process_count(),

@@ -1,0 +1,64 @@
+"""Fault-tolerant training runtime primitives.
+
+The reference delegated every failure mode to Spark (RDD lineage + DISK_ONLY
+persistence, CoordinateDescent.scala:130-160). The single-controller JAX
+rebuild recovers explicitly, and this package holds the machinery that makes
+recovery a *tested* property:
+
+- :mod:`faultpoints` — deterministic fault injection (named crash sites, an
+  armed plan that raises / crashes / delays / corrupts on the k-th hit)
+- :mod:`retry` — bounded exponential backoff + seedable jitter
+- :mod:`incidents` — durable records of survived failures
+- :mod:`chaos` — the crash-at-every-fault-point / restart / bitwise-compare
+  harness (the recovery proof run by tests/test_chaos.py and CI)
+
+Consumers: io/checkpoint.py (generational integrity-checked checkpoints),
+algorithm/coordinate_descent.py (divergence guard), parallel/distributed.py
+(multi-host init retry). docs/ARCHITECTURE.md "Failure model & recovery"
+catalogs the fault points and the incident schema.
+"""
+
+from photon_ml_tpu.resilience.chaos import (
+    ChaosOutcome,
+    assert_trees_identical,
+    chaos_sweep,
+    run_with_crash_at,
+)
+from photon_ml_tpu.resilience.faultpoints import (
+    ENV_VAR,
+    FaultEntry,
+    FaultPlan,
+    InjectedCrash,
+    InjectedFault,
+    arm,
+    armed,
+    corrupt_file,
+    disarm,
+    faultpoint,
+    register_fault_point,
+    registered_fault_points,
+)
+from photon_ml_tpu.resilience.incidents import Incident
+from photon_ml_tpu.resilience.retry import Retry, RetryExhausted
+
+__all__ = [
+    "ChaosOutcome",
+    "ENV_VAR",
+    "FaultEntry",
+    "FaultPlan",
+    "Incident",
+    "InjectedCrash",
+    "InjectedFault",
+    "Retry",
+    "RetryExhausted",
+    "arm",
+    "armed",
+    "assert_trees_identical",
+    "chaos_sweep",
+    "corrupt_file",
+    "disarm",
+    "faultpoint",
+    "register_fault_point",
+    "registered_fault_points",
+    "run_with_crash_at",
+]

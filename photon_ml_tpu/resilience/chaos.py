@@ -1,0 +1,107 @@
+"""Chaos harness: crash at every registered fault point, restart, compare.
+
+The recovery proof for the fault-tolerant runtime (tests/test_chaos.py, the CI
+``chaos`` job): for each registered fault point, arm a crash on its first hit,
+run the training driver until it dies, then rerun it against the same
+checkpoint directory — the restarted run's exported model must be *bitwise*
+identical to an uninterrupted run's. Fault points that a given configuration
+never reaches (e.g. ``distributed.init`` in a single-process run) complete
+without crashing and must still match, which the sweep verifies for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import filecmp
+import os
+from typing import Callable, Optional
+
+from photon_ml_tpu.resilience.faultpoints import (
+    InjectedCrash,
+    armed,
+    registered_fault_points,
+)
+
+
+@dataclasses.dataclass
+class ChaosOutcome:
+    """One fault point's crash-restart result."""
+
+    point: str
+    crashed: bool  # False: the run never reached the armed point
+    restarts: int
+    crash_site: Optional[str] = None  # str(InjectedCrash) of the first death
+
+
+def run_with_crash_at(
+    run_once: Callable[[], object],
+    point: str,
+    occurrence: int = 1,
+    max_restarts: int = 8,
+) -> tuple[object, ChaosOutcome]:
+    """Arm ``point`` to crash on its ``occurrence``-th hit, run, restart.
+
+    ``run_once`` is one full driver invocation (it must be re-runnable against
+    the same checkpoint directory — that re-runnability IS the property under
+    test). The armed crash fires at most once (count=1), so the first restart
+    normally completes; ``max_restarts`` bounds pathological loops."""
+    with armed(f"{point}:crash:{occurrence}"):
+        crash_site = None
+        for restart in range(max_restarts + 1):
+            try:
+                result = run_once()
+            except InjectedCrash as e:
+                if crash_site is None:
+                    crash_site = str(e)
+                continue
+            return result, ChaosOutcome(
+                point=point,
+                crashed=crash_site is not None,
+                restarts=restart,
+                crash_site=crash_site,
+            )
+    raise AssertionError(
+        f"chaos: run did not complete after {max_restarts} restarts "
+        f"(point {point!r}, first crash: {crash_site})"
+    )
+
+
+def chaos_sweep(
+    run_once: Callable[[], object],
+    points: Optional[tuple[str, ...]] = None,
+    occurrence: int = 1,
+) -> list[tuple[object, ChaosOutcome]]:
+    """Crash-restart ``run_once`` at every registered fault point in sequence.
+    The caller resets its output/checkpoint state between points and compares
+    each completed result against an uninterrupted reference."""
+    return [
+        run_with_crash_at(run_once, p, occurrence=occurrence)
+        for p in (points if points is not None else registered_fault_points())
+    ]
+
+
+def assert_trees_identical(reference: str, candidate: str) -> None:
+    """Bitwise directory comparison (the chaos sweep's model-export check):
+    same relative file set, every file byte-equal."""
+
+    def walk(root):
+        out = {}
+        for dirpath, _, files in os.walk(root):
+            for name in files:
+                full = os.path.join(dirpath, name)
+                out[os.path.relpath(full, root)] = full
+        return out
+
+    ref, cand = walk(reference), walk(candidate)
+    if set(ref) != set(cand):
+        raise AssertionError(
+            f"exported trees differ in file sets: only-reference="
+            f"{sorted(set(ref) - set(cand))} only-candidate="
+            f"{sorted(set(cand) - set(ref))}"
+        )
+    diffs = [
+        rel for rel in sorted(ref)
+        if not filecmp.cmp(ref[rel], cand[rel], shallow=False)
+    ]
+    if diffs:
+        raise AssertionError(f"exported files differ bitwise: {diffs}")

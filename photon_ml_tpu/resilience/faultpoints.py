@@ -1,0 +1,230 @@
+"""Deterministic fault injection: named fault points + an armed plan.
+
+The reference inherits failure testing from Spark's own test matrix (lineage
+recomputation is exercised by Spark, not by photon). A single-controller JAX
+runtime has to *prove* its recovery paths instead, and proofs need replayable
+failures: every interesting crash site is a named :func:`faultpoint` call, and
+a :class:`FaultPlan` (armed from the ``PHOTON_FAULT_PLAN`` env var, the
+``--fault-plan`` CLI flag, or a test fixture) makes the k-th hit of a chosen
+point raise, crash, delay, or corrupt — the same failure, every run.
+
+Plan grammar (comma/semicolon-separated entries)::
+
+    <point>:<action>[:<k>[x<n>]]
+
+    checkpoint.write.manifest:crash:2      # simulate process death, 2nd hit
+    checkpoint.write.arrays:corrupt        # flip a byte in the 1st array file
+    distributed.init:raise:1x2            # transient OSError on hits 1 and 2
+    coord.update:delay=0.5                # sleep 0.5s on the 1st update
+
+Actions:
+
+- ``raise``   — raise :class:`InjectedFault` (an ``OSError``: the transient
+  class retry policies recover from — arming it *tests* the retry path).
+- ``crash``   — raise :class:`InjectedCrash` (a ``BaseException``: passes
+  through ``except Exception`` handlers exactly like process death does; the
+  chaos harness catches it at the top and restarts).
+- ``corrupt`` — the fault point *returns* ``"corrupt"`` and the call site
+  damages its own artifact (e.g. flips a byte in the file it just wrote);
+  points that don't support corruption ignore the request.
+- ``delay=S`` — sleep S seconds (armed slow-coordinator / slow-FS stalls).
+
+Point names are hierarchical: an armed ``coord.update`` matches the dynamic
+hits ``coord.update.<coordinate_id>``. Instrumented modules register their
+static names (or prefixes) at import time so a chaos sweep can enumerate
+every crash site without running anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "PHOTON_FAULT_PLAN"
+
+# every registered point/prefix, in registration order (chaos sweeps iterate it)
+_REGISTRY: dict[str, None] = {}
+
+# injectable for tests (delay actions under a fake clock)
+_sleep = time.sleep
+
+
+class InjectedFault(OSError):
+    """A planned *transient* failure (flaky FS, slow write): retry policies
+    treat it exactly like a real OSError and recover from it."""
+
+
+class InjectedCrash(BaseException):
+    """A planned process death. BaseException on purpose: generic ``except
+    Exception`` recovery code must not be able to swallow it — only the chaos
+    harness (or the top of the process) catches it."""
+
+
+def register_fault_point(name: str) -> str:
+    """Declare a fault point (or a dynamic-name prefix like ``coord.update``)
+    at module import so :func:`registered_fault_points` can enumerate every
+    crash site statically. Returns the name for assignment convenience."""
+    _REGISTRY[name] = None
+    return name
+
+
+def registered_fault_points() -> tuple[str, ...]:
+    return tuple(_REGISTRY)
+
+
+@dataclasses.dataclass
+class FaultEntry:
+    """One armed plan entry: fire ``action`` on hits [start, start+count)."""
+
+    point: str
+    action: str  # raise | crash | corrupt | delay
+    start: int = 1  # 1-based hit index
+    count: int = 1
+    delay_seconds: float = 0.0
+    hits: int = 0  # mutable: matching faultpoint() calls seen so far
+
+    def matches(self, name: str) -> bool:
+        return name == self.point or name.startswith(self.point + ".")
+
+
+_ENTRY_RE = re.compile(
+    r"^(?P<point>[\w.\-]+):(?P<action>raise|crash|corrupt|delay=(?P<secs>[0-9.]+))"
+    r"(?::(?P<start>\d+)(?:x(?P<count>\d+|\*))?)?$"
+)
+
+
+class FaultPlan:
+    """A parsed, armable set of :class:`FaultEntry`."""
+
+    def __init__(self, entries: list[FaultEntry]):
+        self.entries = entries
+        self.fired: list[tuple[str, str, int]] = []  # (point name, action, hit#)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        entries = []
+        for raw in re.split(r"[,;]", spec):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _ENTRY_RE.match(raw)
+            if m is None:
+                raise ValueError(
+                    f"Malformed fault-plan entry {raw!r} "
+                    "(expected <point>:<action>[:<k>[x<n>]], action one of "
+                    "raise|crash|corrupt|delay=<secs>)"
+                )
+            action = m.group("action")
+            delay = 0.0
+            if action.startswith("delay="):
+                delay = float(m.group("secs"))
+                action = "delay"
+            count_raw = m.group("count")
+            entries.append(
+                FaultEntry(
+                    point=m.group("point"),
+                    action=action,
+                    start=int(m.group("start") or 1),
+                    count=(1 << 62) if count_raw == "*" else int(count_raw or 1),
+                    delay_seconds=delay,
+                )
+            )
+        return cls(entries)
+
+
+_ACTIVE: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+
+
+def arm(plan) -> FaultPlan:
+    """Arm a plan (a :class:`FaultPlan` or a spec string). Replaces any
+    previously armed plan; hit counters start fresh."""
+    global _ACTIVE, _ENV_CHECKED
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    _ACTIVE = plan
+    _ENV_CHECKED = True  # an explicit arm overrides the env var
+    return plan
+
+
+def disarm() -> None:
+    global _ACTIVE, _ENV_CHECKED
+    _ACTIVE = None
+    _ENV_CHECKED = True
+
+
+@contextmanager
+def armed(spec: str):
+    """Test fixture: arm ``spec`` for the block, restore the prior plan after."""
+    global _ACTIVE, _ENV_CHECKED
+    prev_active, prev_checked = _ACTIVE, _ENV_CHECKED
+    plan = arm(spec)
+    try:
+        yield plan
+    finally:
+        _ACTIVE, _ENV_CHECKED = prev_active, prev_checked
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The armed plan, arming lazily from ``PHOTON_FAULT_PLAN`` on first use."""
+    global _ACTIVE, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        spec = os.environ.get(ENV_VAR)
+        if spec:
+            _ACTIVE = FaultPlan.parse(spec)
+            logger.info("fault plan armed from $%s: %s", ENV_VAR, spec)
+    return _ACTIVE
+
+
+def faultpoint(name: str) -> Optional[str]:
+    """Mark a crash site. Near-zero cost when nothing is armed.
+
+    Returns ``"corrupt"`` when a corrupt action fires (the call site damages
+    its own artifact); raise/crash/delay actions are handled here."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    result = None
+    for entry in plan.entries:
+        if not entry.matches(name):
+            continue
+        entry.hits += 1
+        k = entry.hits
+        if not (entry.start <= k < entry.start + entry.count):
+            continue
+        plan.fired.append((name, entry.action, k))
+        logger.warning("fault injected at %s: %s (hit %d)", name, entry.action, k)
+        if entry.action == "raise":
+            raise InjectedFault(f"injected fault at {name} (hit {k})")
+        if entry.action == "crash":
+            raise InjectedCrash(f"injected crash at {name} (hit {k})")
+        if entry.action == "delay":
+            _sleep(entry.delay_seconds)
+        elif entry.action == "corrupt":
+            result = "corrupt"
+    return result
+
+
+def corrupt_file(path: str, offset: int = -1) -> None:
+    """Flip one byte of ``path`` in place (the canonical 'corrupt' handler:
+    deterministic bit-rot / torn-write damage for armed fault points and
+    corruption-matrix tests). ``offset`` indexes from the end when negative."""
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            f.write(b"\xff")
+            return
+        pos = offset % size
+        f.seek(pos)
+        byte = f.read(1)
+        f.seek(pos)
+        f.write(bytes([byte[0] ^ 0xFF]))

@@ -1,0 +1,43 @@
+"""Incident records: graceful degradation made visible.
+
+When the runtime survives something (a rejected divergent coordinate update, a
+rolled-back corrupt checkpoint generation, a retried I/O failure) the event
+must outlive the log stream: incidents ride in the coordinate-descent result
+AND the checkpoint manifest, so a resumed run still knows its history and an
+operator can audit what a "successful" run actually absorbed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Incident:
+    """One survived failure. ``kind`` is a stable machine-readable class
+    (``divergence``, ``checkpoint-corruption``, ``retry``); ``action`` records
+    what the runtime did about it."""
+
+    kind: str
+    cause: str
+    action: str
+    coordinate_id: Optional[str] = None
+    iteration: Optional[int] = None
+    detail: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Incident":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def summary(self) -> str:
+        where = ""
+        if self.coordinate_id is not None:
+            where = f" coordinate={self.coordinate_id}"
+        if self.iteration is not None:
+            where += f" iteration={self.iteration}"
+        return f"[{self.kind}]{where}: {self.cause} -> {self.action}"

@@ -1,0 +1,79 @@
+"""Bounded retry with exponential backoff + deterministic jitter.
+
+Spark gave the reference free retries (task re-execution, stage re-submission,
+fetch retry — SURVEY §2.8); the single-controller runtime gets an explicit,
+*small* policy instead: transient I/O errors on checkpoint writes and a slow
+multi-host coordinator become logged incidents with bounded retries, not
+crashes. Jitter decorrelates concurrent retriers (every rank re-listing a
+shared filesystem at the same instant is its own failure mode); the jitter
+stream is seedable so tests can assert the exact backoff schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class RetryExhausted(Exception):
+    """All attempts failed; ``__cause__`` is the last underlying error."""
+
+
+@dataclasses.dataclass
+class Retry:
+    """``delay(i) = min(max_delay, base_delay * 2**i) * (1 + jitter * u_i)``
+    with ``u_i`` uniform in [0, 1). ``max_attempts`` counts the first try.
+
+    ``sleep`` and ``seed`` are injectable so tests run under a fake clock with
+    a fully deterministic schedule."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    jitter: float = 0.5
+    retry_on: tuple = (OSError,)
+    sleep: Callable[[float], None] = time.sleep
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+
+    def delays(self) -> list[float]:
+        """The full backoff schedule (max_attempts - 1 sleeps), deterministic
+        for a given seed — what tests assert against."""
+        rng = random.Random(self.seed)
+        return [
+            min(self.max_delay, self.base_delay * (2.0**i))
+            * (1.0 + self.jitter * rng.random())
+            for i in range(self.max_attempts - 1)
+        ]
+
+    def call(self, fn: Callable, *args, description: str = "", **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying on ``retry_on`` with the
+        backoff schedule. Anything outside ``retry_on`` (including
+        BaseExceptions like an injected crash) propagates immediately."""
+        schedule = self.delays()
+        what = description or getattr(fn, "__name__", "operation")
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:
+                last = e
+                if attempt == self.max_attempts - 1:
+                    break
+                delay = schedule[attempt]
+                logger.warning(
+                    "%s failed (attempt %d/%d): %s — retrying in %.3fs",
+                    what, attempt + 1, self.max_attempts, e, delay,
+                )
+                self.sleep(delay)
+        raise RetryExhausted(
+            f"{what} failed after {self.max_attempts} attempt(s): {last}"
+        ) from last

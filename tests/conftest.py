@@ -58,3 +58,12 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 simulated devices, got {len(devs)}"
     return devs[:8]
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: crash-at-every-fault-point recovery sweeps (tier-1 adjacent; "
+        "also run standalone via `pytest -m chaos`)",
+    )
+    config.addinivalue_line("markers", "slow: excluded from the tier-1 run")

@@ -1,0 +1,130 @@
+"""Chaos harness: the recovery PROOF for the fault-tolerant runtime.
+
+For every registered fault point, arm a crash on its k-th hit, run the real
+GAME training driver until it dies, restart it against the same checkpoint
+directory, and assert the final exported model is BITWISE identical to an
+uninterrupted run's — the acceptance bar of the resilience subsystem
+(resilience/chaos.py; docs/ARCHITECTURE.md "Failure model & recovery").
+
+The sweep runs on a small synthetic GLMix problem (fixed + per-user random
+effect, AUC validation so best-model tracking is on the recovery surface).
+Fault points a single-process run never reaches (``distributed.init``)
+complete uninterrupted and must still match — verified for free.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+# importing the instrumented modules populates the fault-point registry
+import photon_ml_tpu.algorithm.coordinate_descent  # noqa: F401
+import photon_ml_tpu.io.checkpoint  # noqa: F401
+import photon_ml_tpu.parallel.distributed  # noqa: F401
+from photon_ml_tpu.cli import game_training_driver
+from photon_ml_tpu.resilience import (
+    assert_trees_identical,
+    registered_fault_points,
+    run_with_crash_at,
+)
+
+from tests.test_cli_drivers import write_glmix_avro
+
+pytestmark = pytest.mark.chaos
+
+FE_COORD = (
+    "name=global,feature.shard=shardA,optimizer=LBFGS,"
+    "max.iter=30,tolerance=1e-7,regularization=L2,reg.weights=1.0"
+)
+RE_COORD = (
+    "name=per-user,random.effect.type=userId,feature.shard=shardA,"
+    "optimizer=LBFGS,max.iter=30,tolerance=1e-7,regularization=L2,reg.weights=1.0"
+)
+
+
+@pytest.fixture(scope="module")
+def chaos_data(tmp_path_factory):
+    rng = np.random.default_rng(20260803)
+    root = tmp_path_factory.mktemp("chaos-data")
+    os.makedirs(root / "train")
+    os.makedirs(root / "validate")
+    _, _, _, w, bias = write_glmix_avro(
+        str(root / "train" / "part-00000.avro"), rng, n=240, d=3, n_users=4
+    )
+    write_glmix_avro(
+        str(root / "validate" / "part-00000.avro"), rng, n=120, d=3, n_users=4,
+        w=w, bias=bias,
+    )
+    return root
+
+
+def _run_driver(data_root, out_root, ckpt_dir):
+    args = game_training_driver.build_arg_parser().parse_args([
+        "--input-data-directories", str(data_root / "train"),
+        "--validation-data-directories", str(data_root / "validate"),
+        "--root-output-directory", str(out_root),
+        "--override-output-directory",  # restarts re-prepare the output root
+        "--feature-shard-configurations", "name=shardA,feature.bags=features",
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-configurations", FE_COORD,
+        "--coordinate-configurations", RE_COORD,
+        "--coordinate-update-sequence", "global,per-user",
+        "--coordinate-descent-iterations", "2",
+        "--evaluators", "AUC",
+        "--checkpoint-directory", str(ckpt_dir),
+    ])
+    return game_training_driver.run(args)
+
+
+@pytest.fixture(scope="module")
+def reference_export(chaos_data, tmp_path_factory):
+    """The uninterrupted run every crash-restart export must match bitwise."""
+    out = tmp_path_factory.mktemp("chaos-ref")
+    _run_driver(chaos_data, out / "run", out / "ckpt")
+    return out / "run" / "best"
+
+
+def test_export_is_deterministic(chaos_data, reference_export, tmp_path):
+    # the sweep's premise: two uninterrupted runs export identical bytes
+    _run_driver(chaos_data, tmp_path / "run", tmp_path / "ckpt")
+    assert_trees_identical(str(reference_export), str(tmp_path / "run" / "best"))
+
+
+@pytest.mark.parametrize("point", registered_fault_points())
+def test_crash_restart_matches_uninterrupted_run(
+    chaos_data, reference_export, tmp_path, point
+):
+    _, outcome = run_with_crash_at(
+        lambda: _run_driver(chaos_data, tmp_path / "run", tmp_path / "ckpt"),
+        point,
+    )
+    assert_trees_identical(str(reference_export), str(tmp_path / "run" / "best"))
+    if outcome.crashed:
+        assert outcome.restarts >= 1
+
+
+@pytest.mark.parametrize(
+    "point,occurrence",
+    [
+        # 2 descent iterations x 2 coordinates: hit 3 is iteration 1's first
+        # update, AFTER iteration 0's generation committed
+        ("coord.update", 3),
+        # one commit per iteration save: hit 2 kills the final-iteration
+        # commit, so the restart resumes from the iteration-0 generation
+        ("checkpoint.write.commit", 2),
+    ],
+)
+def test_mid_run_crash_resumes_from_checkpoint(
+    chaos_data, reference_export, tmp_path, point, occurrence
+):
+    # the crash lands AFTER at least one committed generation, so the restart
+    # genuinely resumes mid-descent instead of retraining from scratch
+    _, outcome = run_with_crash_at(
+        lambda: _run_driver(chaos_data, tmp_path / "run", tmp_path / "ckpt"),
+        point,
+        occurrence=occurrence,
+    )
+    assert outcome.crashed and outcome.restarts >= 1
+    ckpt = tmp_path / "ckpt" / "config_0"
+    assert any(n.startswith("gen-") for n in os.listdir(ckpt))
+    assert_trees_identical(str(reference_export), str(tmp_path / "run" / "best"))

@@ -184,6 +184,255 @@ class TestRoundTrip:
         )
 
 
+class TestGenerations:
+    def test_each_save_is_a_new_generation(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1)
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 2)
+        gens = sorted(n for n in os.listdir(path) if n.startswith("gen-"))
+        assert gens == ["gen-00000001", "gen-00000002"]
+        restored = load_checkpoint(path)
+        assert restored["completed_iterations"] == 2
+        assert restored["generation"] == 2
+
+    def test_keep_generations_prunes_oldest(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        for i in range(1, 6):
+            save_checkpoint(path, {"fixed": _fixed_model(rng)}, i, keep_generations=3)
+        gens = sorted(n for n in os.listdir(path) if n.startswith("gen-"))
+        assert gens == ["gen-00000003", "gen-00000004", "gen-00000005"]
+        assert load_checkpoint(path)["completed_iterations"] == 5
+
+    def test_corrupt_latest_rolls_back_to_previous(self, rng, tmp_path):
+        from photon_ml_tpu.resilience import corrupt_file
+
+        path = str(tmp_path / "c")
+        second = {"fixed": _fixed_model(rng)}
+        third = {"fixed": _fixed_model(rng)}
+        save_checkpoint(path, second, 2)
+        save_checkpoint(path, third, 3)
+        corrupt_file(os.path.join(path, "gen-00000002", "fixed.npz"))
+        restored = load_checkpoint(path)
+        # newest-valid wins: generation 2 (iteration 3) is damaged -> gen 1
+        assert restored["completed_iterations"] == 2
+        np.testing.assert_allclose(
+            np.asarray(restored["models"]["fixed"].model.coefficients.means),
+            np.asarray(second["fixed"].model.coefficients.means),
+        )
+        # the damaged generation is quarantined, and the rollback is recorded
+        assert os.path.isdir(os.path.join(path, "gen-00000002.corrupt"))
+        assert any(
+            i["kind"] == "checkpoint-corruption" for i in restored["incidents"]
+        )
+        # a second restore no longer sees the quarantined generation
+        assert load_checkpoint(path)["incidents"] == []
+
+    def test_all_generations_corrupt_returns_none(self, rng, tmp_path):
+        from photon_ml_tpu.resilience import corrupt_file
+
+        path = str(tmp_path / "c")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1)
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 2)
+        for gen in ("gen-00000001", "gen-00000002"):
+            corrupt_file(os.path.join(path, gen, "state.json"))
+        assert load_checkpoint(path) is None
+
+    def test_stale_tmp_dir_cleaned_on_restore(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1)
+        # a crash mid-write leaks the staging dir (and the legacy sibling)
+        os.makedirs(os.path.join(path, "gen-00000002.tmp"))
+        os.makedirs(path + ".tmp")
+        assert load_checkpoint(path)["completed_iterations"] == 1
+        assert not os.path.exists(os.path.join(path, "gen-00000002.tmp"))
+        assert not os.path.exists(path + ".tmp")
+
+    def test_stale_tmp_dir_cleaned_on_save(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        os.makedirs(os.path.join(path, "gen-00000009.tmp"))
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1)
+        assert not os.path.exists(os.path.join(path, "gen-00000009.tmp"))
+
+    def test_fingerprint_mismatch_is_not_a_rollback(self, rng, tmp_path):
+        # a different fingerprint is a different RUN: the whole checkpoint is
+        # rejected without quarantining anything
+        path = str(tmp_path / "c")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1, fingerprint="A")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 2, fingerprint="A")
+        assert load_checkpoint(path, fingerprint="B") is None
+        assert sorted(n for n in os.listdir(path) if n.startswith("gen-")) == [
+            "gen-00000001", "gen-00000002",
+        ]
+
+    def test_fresh_start_after_total_corruption_still_records_why(self, rng, tmp_path):
+        # every generation corrupt -> restore() is None (fresh start), but the
+        # quarantines are surfaced via restore_incidents so the new run can
+        # record them (found by the verify drive: the rollback incident was
+        # silently dropped when nothing valid remained)
+        from photon_ml_tpu.resilience import corrupt_file
+
+        path = str(tmp_path / "c")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1)
+        corrupt_file(os.path.join(path, "gen-00000001", "fixed.npz"))
+        ck = CoordinateDescentCheckpointer(path)
+        assert ck.restore() is None
+        assert [i["kind"] for i in ck.restore_incidents] == ["checkpoint-corruption"]
+        assert os.path.isdir(os.path.join(path, "gen-00000001.corrupt"))
+
+    def test_old_fallback_keeps_main_root_rollback_incidents(self, rng, tmp_path):
+        # main root all corrupt, valid state only in the legacy .old sibling:
+        # the loaded state must still carry the quarantines this restore
+        # performed on the main root
+        from photon_ml_tpu.resilience import corrupt_file
+
+        path = str(tmp_path / "c")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1)
+        os.rename(path, path + ".old")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 2)
+        corrupt_file(os.path.join(path, "gen-00000001", "fixed.npz"))
+        restored = load_checkpoint(path)
+        assert restored is not None and restored["completed_iterations"] == 1
+        assert any(
+            i["kind"] == "checkpoint-corruption" for i in restored["incidents"]
+        )
+
+    def test_incidents_persist_in_manifest(self, rng, tmp_path):
+        from photon_ml_tpu.resilience import Incident
+
+        path = str(tmp_path / "c")
+        inc = Incident(kind="divergence", cause="NaN", action="rejected",
+                       coordinate_id="fixed", iteration=1)
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1, incidents=[inc])
+        restored = load_checkpoint(path)
+        assert restored["incidents"] == [inc.to_dict()]
+
+
+class TestCorruptionMatrix:
+    """Flip a byte in each artifact class: detection (checksum mismatch) and
+    recovery from the newest valid generation — never a crash, never a silent
+    load of bad data."""
+
+    ARTIFACTS = [
+        "state.json",  # manifest
+        "state.json.sha256",  # manifest integrity sidecar
+        "fixed.npz",  # coordinate arrays
+        "per-user.npz",  # random-effect coordinate arrays
+        os.path.join("best", "fixed.npz"),  # best-model snapshot
+    ]
+
+    def _save_two(self, rng, path):
+        def models():
+            return {
+                "fixed": _fixed_model(rng),
+                "per-user": _re_model(rng, ["u1", "u2"]),
+            }
+
+        first = models()
+        save_checkpoint(path, first, 1, best_models=models(), best_metric=0.8)
+        save_checkpoint(path, models(), 2, best_models=models(), best_metric=0.9)
+        return first
+
+    @pytest.mark.parametrize("artifact", ARTIFACTS)
+    def test_single_corrupt_artifact_detected_and_rolled_back(
+        self, rng, tmp_path, artifact
+    ):
+        from photon_ml_tpu.resilience import corrupt_file
+
+        path = str(tmp_path / "c")
+        first = self._save_two(rng, path)
+        target = os.path.join(path, "gen-00000002", artifact)
+        if artifact.endswith(".sha256"):
+            os.remove(target)  # a missing integrity record is equally fatal
+        else:
+            corrupt_file(target)
+        restored = load_checkpoint(path)
+        assert restored is not None
+        assert restored["completed_iterations"] == 1
+        np.testing.assert_allclose(
+            np.asarray(restored["models"]["fixed"].model.coefficients.means),
+            np.asarray(first["fixed"].model.coefficients.means),
+        )
+        assert os.path.isdir(os.path.join(path, "gen-00000002.corrupt"))
+
+    @pytest.mark.parametrize("artifact", ["state.json", "fixed.npz"])
+    def test_injected_corrupt_write_detected(self, rng, tmp_path, artifact):
+        # the fault-injection route to the same property: arm a corrupt action
+        # on the write path itself and the NEXT restore must roll back
+        from photon_ml_tpu.resilience import armed
+
+        point = (
+            "checkpoint.write.manifest"
+            if artifact == "state.json"
+            else "checkpoint.write.arrays"
+        )
+        path = str(tmp_path / "c")
+        first = {"fixed": _fixed_model(rng)}
+        save_checkpoint(path, first, 1)
+        with armed(f"{point}:corrupt:1"):
+            save_checkpoint(path, {"fixed": _fixed_model(rng)}, 2)
+        restored = load_checkpoint(path)
+        assert restored["completed_iterations"] == 1
+        np.testing.assert_allclose(
+            np.asarray(restored["models"]["fixed"].model.coefficients.means),
+            np.asarray(first["fixed"].model.coefficients.means),
+        )
+
+
+class TestLegacyAndFallback:
+    """The pre-generational single-directory layout: still readable, and an
+    unreadable one degrades to a fresh start instead of raising (the
+    non-generational bug the tentpole's rollback subsumes)."""
+
+    def _make_legacy(self, rng, path):
+        """Demote a fresh generational checkpoint to the legacy layout
+        (state.json + npz directly in the directory, no checksums)."""
+        model = _fixed_model(rng)
+        save_checkpoint(path, {"fixed": model}, 4)
+        gen = os.path.join(path, "gen-00000001")
+        for name in os.listdir(gen):
+            os.rename(os.path.join(gen, name), os.path.join(path, name))
+        os.rmdir(gen)
+        os.remove(os.path.join(path, "state.json.sha256"))
+        return model
+
+    def test_legacy_layout_still_loads(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        model = self._make_legacy(rng, path)
+        restored = load_checkpoint(path)
+        assert restored is not None and restored["completed_iterations"] == 4
+        assert restored["generation"] is None
+        np.testing.assert_allclose(
+            np.asarray(restored["models"]["fixed"].model.coefficients.means),
+            np.asarray(model.model.coefficients.means),
+        )
+
+    def test_unreadable_legacy_npz_falls_back_to_fresh_start(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        self._make_legacy(rng, path)
+        with open(os.path.join(path, "fixed.npz"), "wb") as f:
+            f.write(b"not a zip file")  # truncated/overwritten artifact
+        ck = CoordinateDescentCheckpointer(path)
+        assert ck.restore() is None  # logged + quarantined, NOT raised
+        # the bad manifest is quarantined so the next restore is quiet too
+        assert os.path.exists(os.path.join(path, "state.json.corrupt"))
+        assert ck.restore() is None
+
+    def test_malformed_legacy_state_json_falls_back(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        self._make_legacy(rng, path)
+        with open(os.path.join(path, "state.json"), "w") as f:
+            f.write("{ truncated")
+        assert CoordinateDescentCheckpointer(path).restore() is None
+
+    def test_new_generations_supersede_legacy_state(self, rng, tmp_path):
+        # a pre-upgrade directory keeps working: the first post-upgrade save
+        # adds a generation, which then wins over the legacy files
+        path = str(tmp_path / "c")
+        self._make_legacy(rng, path)
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 5)
+        assert load_checkpoint(path)["completed_iterations"] == 5
+
+
 def _game_input(rng, n=600, d=4, n_users=6):
     w = rng.normal(size=d)
     bias = rng.normal(size=n_users) * 1.5

@@ -270,3 +270,131 @@ def test_fixed_effect_coordinate_with_down_sampling(rng):
     ref = np.asarray(direct.coefficients.means)
     cos = coef @ ref / (np.linalg.norm(coef) * np.linalg.norm(ref))
     assert cos > 0.97
+
+
+# ----------------------------------------------------------- divergence guard
+
+
+class _HostileCoordinate:
+    """Wraps a real coordinate; its solver 'diverges' on chosen update calls —
+    the seeded-NaN hostile loss of the divergence-guard contract. ``poison``
+    maps 1-based update-call index -> how ("nan" coefficients, "inf"
+    objective)."""
+
+    def __init__(self, inner, poison):
+        self.inner = inner
+        self.coordinate_id = inner.coordinate_id
+        self.poison = dict(poison)
+        self.calls = 0
+
+    @property
+    def is_locked(self):
+        return False
+
+    def initialize_model(self):
+        return self.inner.initialize_model()
+
+    def prepare_initial_model(self, model):
+        return self.inner.prepare_initial_model(model)
+
+    def score(self, model):
+        return self.inner.score(model)
+
+    def update_model(self, initial_model, partial_scores):
+        model, tracker = self.inner.update_model(initial_model, partial_scores)
+        self.calls += 1
+        how = self.poison.get(self.calls)
+        if how == "nan":
+            glm = model.model
+            bad = glm.coefficients.means.at[0].set(jnp.nan)
+            model = dataclasses.replace(
+                model,
+                model=dataclasses.replace(
+                    glm,
+                    coefficients=dataclasses.replace(glm.coefficients, means=bad),
+                ),
+            )
+        elif how == "inf":
+            tracker = dataclasses.replace(tracker, final_value=float("inf"))
+        return model, tracker
+
+
+class TestDivergenceGuard:
+    def test_nan_update_rejected_remaining_coordinates_intact(self, rng):
+        X, X_re, user_ids, y = glmix_data(rng)
+        coords, _, _ = build_coordinates(X, X_re, user_ids, y)
+        hostile = _HostileCoordinate(coords["fixed"], poison={1: "nan", 2: "nan"})
+        coords = {"fixed": hostile, "per-user": coords["per-user"]}
+
+        result = run_coordinate_descent(coords, n_iterations=2)
+
+        # every hostile update was rejected: the fixed model is still the zero
+        # initialization, finite, and the random effect trained normally
+        fe = np.asarray(result.model.get_model("fixed").model.coefficients.means)
+        assert np.isfinite(fe).all()
+        np.testing.assert_array_equal(fe, np.zeros_like(fe))
+        re_coef = np.asarray(result.model.get_model("per-user").coeffs)
+        assert np.isfinite(re_coef).all() and np.abs(re_coef).sum() > 0
+
+        assert len(result.incidents) == 2
+        for inc, it in zip(result.incidents, (0, 1)):
+            assert inc.kind == "divergence"
+            assert inc.coordinate_id == "fixed"
+            assert inc.iteration == it
+            assert "non-finite" in inc.cause
+
+    def test_objective_blowup_rejected(self, rng):
+        X, X_re, user_ids, y = glmix_data(rng)
+        coords, _, _ = build_coordinates(X, X_re, user_ids, y)
+        hostile = _HostileCoordinate(coords["fixed"], poison={1: "inf"})
+        coords = {"fixed": hostile, "per-user": coords["per-user"]}
+        result = run_coordinate_descent(coords, n_iterations=1)
+        (inc,) = result.incidents
+        assert inc.kind == "divergence" and "objective" in inc.cause
+        fe = np.asarray(result.model.get_model("fixed").model.coefficients.means)
+        np.testing.assert_array_equal(fe, np.zeros_like(fe))
+
+    def test_transient_divergence_recovers_next_iteration(self, rng):
+        # poison only the FIRST update: iteration 0 is rejected, iteration 1
+        # trains normally — graceful degradation, then full recovery
+        X, X_re, user_ids, y = glmix_data(rng)
+        coords, _, _ = build_coordinates(X, X_re, user_ids, y)
+        hostile = _HostileCoordinate(coords["fixed"], poison={1: "nan"})
+        coords = {"fixed": hostile, "per-user": coords["per-user"]}
+        result = run_coordinate_descent(coords, n_iterations=2)
+        assert len(result.incidents) == 1
+        fe = np.asarray(result.model.get_model("fixed").model.coefficients.means)
+        assert np.isfinite(fe).all() and np.abs(fe).sum() > 0
+
+    def test_incidents_persist_through_checkpoint_resume(self, rng, tmp_path):
+        from photon_ml_tpu.io.checkpoint import CoordinateDescentCheckpointer
+
+        X, X_re, user_ids, y = glmix_data(rng)
+
+        def hostile_coords():
+            coords, _, _ = build_coordinates(X, X_re, user_ids, y)
+            return {
+                "fixed": _HostileCoordinate(coords["fixed"], poison={1: "nan"}),
+                "per-user": coords["per-user"],
+            }
+
+        ck_dir = str(tmp_path / "ck")
+        run_coordinate_descent(
+            hostile_coords(), n_iterations=1,
+            checkpointer=CoordinateDescentCheckpointer(ck_dir, dtype=jnp.float64),
+        )
+        # the resumed run (now healthy) still reports its predecessor's incident
+        healthy, _, _ = build_coordinates(X, X_re, user_ids, y)
+        resumed = run_coordinate_descent(
+            healthy, n_iterations=2,
+            checkpointer=CoordinateDescentCheckpointer(ck_dir, dtype=jnp.float64),
+        )
+        assert len(resumed.incidents) == 1
+        assert resumed.incidents[0].kind == "divergence"
+        assert resumed.incidents[0].iteration == 0
+
+    def test_healthy_run_has_no_incidents(self, rng):
+        X, X_re, user_ids, y = glmix_data(rng)
+        coords, _, _ = build_coordinates(X, X_re, user_ids, y)
+        result = run_coordinate_descent(coords, n_iterations=1)
+        assert result.incidents == []

@@ -1,0 +1,268 @@
+"""Resilience primitives (photon_ml_tpu/resilience/).
+
+Deterministic fault injection (plan grammar, k-th-hit semantics, hierarchical
+point matching, crash-vs-raise exception classes), retry backoff/jitter
+determinism under a fake clock, incident round trips, and the
+retry-absorbs-injected-transient-fault integration on checkpoint writes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+from photon_ml_tpu.models.game import FixedEffectModel
+from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+from photon_ml_tpu.resilience import (
+    FaultPlan,
+    Incident,
+    InjectedCrash,
+    InjectedFault,
+    Retry,
+    RetryExhausted,
+    armed,
+    corrupt_file,
+    faultpoint,
+    registered_fault_points,
+)
+from photon_ml_tpu.resilience import faultpoints as fp_mod
+
+
+def _fixed_model(rng, d=4):
+    return FixedEffectModel(
+        model=LogisticRegressionModel(
+            Coefficients(means=jnp.asarray(rng.normal(size=d)))
+        ),
+        feature_shard_id="global",
+    )
+
+
+# ------------------------------------------------------------ fault points
+
+
+class TestFaultPlanGrammar:
+    def test_parse_full_entry(self):
+        plan = FaultPlan.parse("checkpoint.write.manifest:crash:2")
+        (e,) = plan.entries
+        assert e.point == "checkpoint.write.manifest"
+        assert e.action == "crash" and e.start == 2 and e.count == 1
+
+    def test_parse_defaults_and_repeat(self):
+        plan = FaultPlan.parse("a.b:raise; c.d:raise:1x3, e.f:delay=0.25:2x*")
+        a, c, e = plan.entries
+        assert (a.start, a.count) == (1, 1)
+        assert (c.start, c.count) == (1, 3)
+        assert e.action == "delay" and e.delay_seconds == 0.25
+        assert e.start == 2 and e.count > 1_000_000
+
+    @pytest.mark.parametrize("bad", ["x", "x:explode", "x:raise:k", "x:raise:1y2"])
+    def test_malformed_entries_rejected(self, bad):
+        with pytest.raises(ValueError, match="fault-plan"):
+            FaultPlan.parse(bad)
+
+
+class TestFaultPoints:
+    def test_disarmed_is_noop(self):
+        assert faultpoint("anything.at.all") is None
+
+    def test_raise_on_kth_hit_only(self):
+        with armed("p.q:raise:3"):
+            assert faultpoint("p.q") is None
+            assert faultpoint("p.q") is None
+            with pytest.raises(InjectedFault):
+                faultpoint("p.q")
+            assert faultpoint("p.q") is None  # fired once, stays quiet after
+
+    def test_injected_fault_is_oserror_crash_is_not_exception(self):
+        assert issubclass(InjectedFault, OSError)
+        assert not issubclass(InjectedCrash, Exception)
+        with armed("p:crash"):
+            with pytest.raises(InjectedCrash):
+                try:
+                    faultpoint("p")
+                except Exception:  # a generic handler MUST NOT swallow a crash
+                    pytest.fail("InjectedCrash was caught by `except Exception`")
+
+    def test_hierarchical_match_counts_across_dynamic_names(self):
+        # armed coord.update matches coord.update.<cid>, counting hits across
+        # the dynamic suffixes (3rd coordinate update overall fires)
+        with armed("coord.update:raise:3") as plan:
+            assert faultpoint("coord.update.fixed") is None
+            assert faultpoint("coord.update.per-user") is None
+            with pytest.raises(InjectedFault):
+                faultpoint("coord.update.fixed")
+            assert plan.fired == [("coord.update.fixed", "raise", 3)]
+
+    def test_exact_name_does_not_match_sibling(self):
+        with armed("a.b:raise"):
+            assert faultpoint("a.bc") is None
+            assert faultpoint("a") is None
+
+    def test_corrupt_returned_to_call_site(self):
+        with armed("w:corrupt:2"):
+            assert faultpoint("w") is None
+            assert faultpoint("w") == "corrupt"
+
+    def test_delay_uses_injectable_sleep(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr(fp_mod, "_sleep", slept.append)
+        with armed("p:delay=1.5"):
+            faultpoint("p")
+        assert slept == [1.5]
+
+    def test_env_var_arms_lazily(self, monkeypatch):
+        monkeypatch.setenv(fp_mod.ENV_VAR, "env.point:raise:1")
+        monkeypatch.setattr(fp_mod, "_ACTIVE", None)
+        monkeypatch.setattr(fp_mod, "_ENV_CHECKED", False)
+        with pytest.raises(InjectedFault):
+            faultpoint("env.point")
+
+    def test_registry_covers_the_instrumented_sites(self):
+        # import the instrumented modules, then the catalog must be complete —
+        # the chaos sweep enumerates exactly this set
+        import photon_ml_tpu.algorithm.coordinate_descent  # noqa: F401
+        import photon_ml_tpu.io.checkpoint  # noqa: F401
+        import photon_ml_tpu.parallel.distributed  # noqa: F401
+
+        points = set(registered_fault_points())
+        assert {
+            "checkpoint.write.arrays",
+            "checkpoint.write.manifest",
+            "checkpoint.write.commit",
+            "checkpoint.restore",
+            "coord.update",
+            "distributed.init",
+        } <= points
+
+    def test_corrupt_file_flips_one_byte(self, tmp_path):
+        path = str(tmp_path / "f.bin")
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 16)
+        corrupt_file(path, offset=5)
+        with open(path, "rb") as f:
+            data = f.read()
+        assert data[5] == 0xFF and sum(data) == 0xFF
+
+
+# ------------------------------------------------------------------- retry
+
+
+class TestRetry:
+    def test_schedule_is_deterministic_for_a_seed(self):
+        a = Retry(max_attempts=5, base_delay=0.1, max_delay=1.0, seed=42)
+        b = Retry(max_attempts=5, base_delay=0.1, max_delay=1.0, seed=42)
+        assert a.delays() == b.delays()
+        assert a.delays() != Retry(
+            max_attempts=5, base_delay=0.1, max_delay=1.0, seed=43
+        ).delays()
+
+    def test_backoff_doubles_and_caps_under_fake_clock(self):
+        slept = []
+        r = Retry(
+            max_attempts=5, base_delay=0.1, max_delay=0.5, jitter=0.0,
+            sleep=slept.append, seed=0,
+        )
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise OSError("disk hiccup")
+
+        with pytest.raises(RetryExhausted) as ei:
+            r.call(flaky, description="write")
+        assert len(calls) == 5
+        np.testing.assert_allclose(slept, [0.1, 0.2, 0.4, 0.5])
+        assert isinstance(ei.value.__cause__, OSError)
+
+    def test_jitter_bounded_fraction_of_backoff(self):
+        r = Retry(max_attempts=4, base_delay=0.1, max_delay=10.0, jitter=0.5, seed=7)
+        for i, d in enumerate(r.delays()):
+            base = 0.1 * 2**i
+            assert base <= d <= base * 1.5
+
+    def test_recovers_after_transient_failures(self):
+        slept = []
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        out = Retry(max_attempts=3, sleep=slept.append, seed=0).call(flaky)
+        assert out == "ok" and len(attempts) == 3 and len(slept) == 2
+
+    def test_non_retryable_errors_propagate_immediately(self):
+        def boom():
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            Retry(max_attempts=5, sleep=lambda s: None).call(boom)
+
+    def test_injected_crash_is_never_retried(self):
+        attempts = []
+
+        def dies():
+            attempts.append(1)
+            raise InjectedCrash("process death")
+
+        with pytest.raises(InjectedCrash):
+            Retry(max_attempts=5, sleep=lambda s: None).call(dies)
+        assert len(attempts) == 1
+
+    def test_zero_attempts_rejected(self):
+        with pytest.raises(ValueError):
+            Retry(max_attempts=0)
+
+
+# --------------------------------------------------------------- incidents
+
+
+class TestIncidents:
+    def test_round_trip(self):
+        inc = Incident(
+            kind="divergence", cause="NaN", action="rejected",
+            coordinate_id="per-user", iteration=3,
+        )
+        assert Incident.from_dict(inc.to_dict()) == inc
+
+    def test_unknown_keys_ignored_on_load(self):
+        inc = Incident.from_dict({"kind": "retry", "cause": "c", "action": "a",
+                                  "future_field": 1})
+        assert inc.kind == "retry"
+
+    def test_summary_mentions_location(self):
+        s = Incident(kind="divergence", cause="NaN", action="rejected",
+                     coordinate_id="fixed", iteration=2).summary()
+        assert "fixed" in s and "2" in s and "divergence" in s
+
+
+# ------------------------------------------- integration: retry x faultpoint
+
+
+class TestCheckpointRetryIntegration:
+    def test_transient_write_fault_absorbed_by_retry(self, rng, tmp_path):
+        # an injected transient OSError on the first manifest write: the save
+        # retries, succeeds, and the checkpoint verifies clean
+        path = str(tmp_path / "ck")
+        retry = Retry(max_attempts=3, base_delay=0.0, sleep=lambda s: None, seed=0)
+        with armed("checkpoint.write.manifest:raise:1"):
+            save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1, retry=retry)
+        restored = load_checkpoint(path)
+        assert restored is not None and restored["completed_iterations"] == 1
+
+    def test_persistent_write_fault_exhausts_retry(self, rng, tmp_path):
+        path = str(tmp_path / "ck")
+        retry = Retry(max_attempts=2, base_delay=0.0, sleep=lambda s: None, seed=0)
+        with armed("checkpoint.write.manifest:raise:1x*"):
+            with pytest.raises(RetryExhausted):
+                save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1, retry=retry)
+        # nothing half-written: the failed attempts left no committed generation
+        assert load_checkpoint(path) is None
+        assert not [
+            n for n in os.listdir(path) if not n.endswith(".tmp")
+        ] or load_checkpoint(path) is None
