@@ -832,17 +832,28 @@ def _spawn_child(extra_env, timeout_s, extra_args=()):
 _CPU_CHILD_ENV = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
 
 
+def _delegate_benchmark(flag: str, module_name: str) -> None:
+    """Hand the run to a benchmarks/ module's main(): it prints its own JSON
+    line and exits nonzero when one of its quality gates fails."""
+    import importlib
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks")
+    )
+    module = importlib.import_module(module_name)
+    sys.exit(module.main([a for a in sys.argv[1:] if a != flag]))
+
+
 def main():
     if "--scoring" in sys.argv:
-        # serving-path benchmark (fused engine steady state): delegates to
-        # benchmarks/scoring_bench.py, which prints its own JSON line and
-        # exits nonzero when a quality/retrace gate fails
-        sys.path.insert(
-            0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks")
-        )
-        import scoring_bench
+        # serving-path benchmark (fused engine steady state, retrace +
+        # bitwise-parity gates)
+        _delegate_benchmark("--scoring", "scoring_bench")
 
-        sys.exit(scoring_bench.main([a for a in sys.argv[1:] if a != "--scoring"]))
+    if "--host-loop" in sys.argv:
+        # host-backend featureful CD pass: single-program random-effect
+        # updates vs the per-bucket loop (bitwise-parity + zero-retrace gates)
+        _delegate_benchmark("--host-loop", "host_loop_bench")
 
     if "--child" in sys.argv:
         _child_main()

@@ -10,13 +10,18 @@ from photon_ml_tpu.algorithm.coordinate_descent import (
     CoordinateDescentResult,
     run_coordinate_descent,
 )
-from photon_ml_tpu.algorithm.random_effect import RandomEffectTracker, train_random_effect
+from photon_ml_tpu.algorithm.random_effect import (
+    LazyRandomEffectTracker,
+    RandomEffectTracker,
+    train_random_effect,
+)
 
 __all__ = [
     "Coordinate",
     "CoordinateDescentResult",
     "FixedEffectCoordinate",
     "FixedEffectOptimizationTracker",
+    "LazyRandomEffectTracker",
     "ModelCoordinate",
     "RandomEffectCoordinate",
     "RandomEffectTracker",

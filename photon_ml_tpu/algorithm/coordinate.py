@@ -37,13 +37,32 @@ Array = jnp.ndarray
 @dataclasses.dataclass
 class FixedEffectOptimizationTracker:
     """Wraps the single OptResult of a fixed-effect solve
-    (FixedEffectOptimizationTracker.scala:31)."""
+    (FixedEffectOptimizationTracker.scala:31).
 
-    convergence_reason: str
-    iterations: int
-    final_value: float
+    Fields may initially hold DEVICE scalars: update_model no longer blocks on
+    a per-update ``device_get`` (the sync-free descent loop pipelines
+    dispatches across coordinates). ``materialize()`` — called by
+    ``summary()``, and by run_coordinate_descent on every tracker before its
+    result is returned, restoring the str/int/float field contract for
+    downstream consumers — converts them to host values in one transfer,
+    idempotently."""
+
+    convergence_reason: object  # str once materialized; device/int code before
+    iterations: object
+    final_value: object
+
+    def materialize(self) -> "FixedEffectOptimizationTracker":
+        if not isinstance(self.convergence_reason, str):
+            reason_h, iters_h, value_h = jax.device_get(
+                (self.convergence_reason, self.iterations, self.final_value)
+            )
+            self.convergence_reason = ConvergenceReason(int(reason_h)).name
+            self.iterations = int(iters_h)
+            self.final_value = float(value_h)
+        return self
 
     def summary(self) -> str:
+        self.materialize()
         return (
             f"reason={self.convergence_reason} iters={self.iterations} "
             f"value={self.final_value:.6g}"
@@ -66,6 +85,28 @@ class Coordinate:
 
     def update_model(self, initial_model, partial_scores: Array):
         raise NotImplementedError
+
+    def update_and_score(
+        self, initial_model, partial_scores: Array, prev_score: Array,
+        donate: bool = False,
+    ):
+        """Fused update protocol: train AND produce this coordinate's new [N]
+        score in one program, with the divergence guard applied DEVICE-SIDE
+        (returned model/score already hold the previous values when the update
+        diverged; the tracker's ``guard_ok`` device flag says which — the
+        flag is REQUIRED, the descent loop refuses trackers without it).
+
+        Returns ``(model, score, tracker)`` or None when this coordinate has
+        no fused path — the descent loop then falls back to
+        ``update_model`` + ``score``.
+
+        ``donate=True`` is the caller's promise that ``initial_model``'s
+        coefficient buffers and ``prev_score`` are exactly this coordinate's
+        previous outputs and nothing else aliases them: the program then
+        CONSUMES them (XLA buffer donation) and the caller must use the
+        returned model/score instead. With ``donate=False`` the inputs are
+        defensively copied and stay valid."""
+        return None
 
     def score(self, model) -> Array:
         raise NotImplementedError
@@ -168,17 +209,14 @@ class FixedEffectCoordinate(Coordinate):
             lower_bounds=lower,
             upper_bounds=upper,
         )
-        # One batched transfer for the tracker scalars. reason_name()/int()/
-        # float() each block on the device separately — three round-trips per
-        # coordinate per descent iteration in the hot loop (jaxlint HS001's
-        # hazard class; the fix is its hint verbatim).
-        reason_h, iters_h, value_h = jax.device_get(
-            (result.convergence_reason, result.iterations, result.value)
-        )
+        # Tracker scalars stay ON DEVICE: a device_get here would block the
+        # descent loop between coordinate updates (the round trip the sync-free
+        # loop removes). They materialize lazily — in the loop's once-per-
+        # iteration batched transfer, or on first summary()/field read.
         tracker = FixedEffectOptimizationTracker(
-            convergence_reason=ConvergenceReason(int(reason_h)).name,
-            iterations=int(iters_h),
-            final_value=float(value_h),
+            convergence_reason=result.convergence_reason,
+            iterations=result.iterations,
+            final_value=result.value,
         )
         return (
             FixedEffectModel(model=glm, feature_shard_id=self.dataset.feature_shard_id),
@@ -205,9 +243,22 @@ class RandomEffectCoordinate(Coordinate):
     # {entity_id: l2} or [E] array: per-entity L2 overrides (the reference's
     # envisioned per-entity regularization, RandomEffectOptimizationProblem:34-37)
     per_entity_reg_weights: Optional[object] = None
+    # Route updates through the single-program path (solver_cache.
+    # re_coordinate_update_program): one donated XLA dispatch per update
+    # instead of one program per bucket with eager glue between them. False
+    # reproduces the per-bucket loop (the parity/bench denominator). Mesh-
+    # sharded datasets always take the per-bucket path (the program does not
+    # re-place sharded tables).
+    use_update_program: bool = True
 
     def __post_init__(self):
         self.task = TaskType(self.task)
+        # donation ownership: the exact output buffers of our last update
+        # program call. Only those are fed back donated; foreign arrays
+        # (external warm starts, first iteration) are defensively copied so a
+        # caller-held model can never be invalidated by our donation.
+        self._owned: dict = {}
+        self._fused_static = None
 
     def initialize_model(self) -> RandomEffectModel:
         E, K = self.dataset.n_entities, self.dataset.max_k
@@ -248,6 +299,134 @@ class RandomEffectCoordinate(Coordinate):
             variance_computation=self.variance_computation,
             per_entity_reg_weights=self.per_entity_reg_weights,
         )
+
+    def _fused_update_static(self):
+        """Descent-iteration-invariant inputs of the update program, built
+        once per coordinate: validations, the per-entity L2 table, the
+        per-bucket normalization gathers, the bucket tuple and scoring view."""
+        if self._fused_static is None:
+            from photon_ml_tpu.algorithm.random_effect import (
+                build_l2_rows,
+                precompute_norm_tables,
+            )
+            from photon_ml_tpu.function.losses import loss_for_task
+            from photon_ml_tpu.types import OptimizerType
+
+            ds = self.dataset
+            loss = loss_for_task(self.task)
+            opt_type = OptimizerType(self.configuration.optimizer_config.optimizer_type)
+            if opt_type in (OptimizerType.TRON, OptimizerType.NEWTON) and not loss.has_hessian:
+                raise ValueError(f"{opt_type.value} requires a twice-differentiable loss")
+            dtype = ds.sample_vals.dtype
+            self._fused_static = dict(
+                dtype=dtype,
+                l2_rows=build_l2_rows(
+                    ds,
+                    self.configuration.l2_weight,
+                    self.per_entity_reg_weights,
+                    dtype,
+                    ds.n_entities,
+                ),
+                l1=jnp.asarray(self.configuration.l1_weight or 0.0, dtype=dtype),
+                norm_tables=precompute_norm_tables(ds, self.normalization, dtype),
+                buckets=tuple(ds.buckets),
+                view=(ds.sample_entity_rows, ds.sample_local_cols, ds.sample_vals),
+            )
+        return self._fused_static
+
+    def update_and_score(
+        self,
+        initial_model: Optional[RandomEffectModel],
+        partial_scores: Array,
+        prev_score: Array,
+        donate: bool = False,
+    ):
+        """One donated XLA program per update (solver_cache.
+        re_coordinate_update_program): gathers, every bucket solve, the table
+        scatter, the [N] score and the divergence guard — no host round trip.
+        Returns None (per-bucket fallback) for mesh-sharded datasets or when
+        ``use_update_program`` is off."""
+        ds = self.dataset
+        if not self.use_update_program or getattr(ds, "coeffs_sharding", None) is not None:
+            return None
+        from photon_ml_tpu.algorithm.random_effect import LazyRandomEffectTracker
+        from photon_ml_tpu.optimization.solver_cache import re_coordinate_update_program
+
+        st = self._fused_update_static()
+        dtype = st["dtype"]
+        E, K_all = ds.n_entities, ds.max_k
+
+        def owned_or_copy(key, arr):
+            # donation safety: only with the caller's donate promise AND when
+            # the buffer is identically OUR previous output is it consumed in
+            # place; anything else (external warm start, the loop's initial
+            # score, a reused coordinate across runs) is copied so the
+            # caller's array survives our donation.
+            if donate and arr is self._owned.get(key):
+                return arr
+            return jnp.array(arr, copy=True)
+
+        variance_on = (
+            VarianceComputationType(self.variance_computation)
+            != VarianceComputationType.NONE
+        )
+        if initial_model is None:
+            coeffs_prev = jnp.zeros((E, K_all), dtype=dtype)
+            var_prev = jnp.zeros((E, K_all), dtype=dtype) if variance_on else None
+        else:
+            aligned = (
+                initial_model.aligned_to(ds)
+                if hasattr(initial_model, "aligned_to")
+                else initial_model
+            )
+            coeffs_prev = aligned.coeffs
+            if coeffs_prev.dtype != dtype:
+                coeffs_prev = coeffs_prev.astype(dtype)
+            coeffs_prev = owned_or_copy("coeffs", coeffs_prev)
+            var_prev = None
+            if variance_on:
+                if aligned.variances is None:
+                    var_prev = jnp.zeros((E, K_all), dtype=dtype)
+                else:
+                    v = aligned.variances
+                    if v.dtype != dtype:
+                        v = v.astype(dtype)
+                    var_prev = owned_or_copy("var", v)
+
+        score_prev = owned_or_copy("score", prev_score)
+        offsets_plus_scores = self.base_offsets + partial_scores
+
+        program = re_coordinate_update_program(
+            self.task,
+            self.configuration.optimizer_config,
+            bool(self.configuration.l1_weight),
+            VarianceComputationType(self.variance_computation),
+            E,
+        )
+        coeffs_out, score_out, var_out, ok, reasons, iters = program(
+            coeffs_prev,
+            score_prev,
+            var_prev,
+            offsets_plus_scores,
+            st["l2_rows"],
+            st["l1"],
+            st["buckets"],
+            st["norm_tables"],
+            st["view"],
+        )
+        self._owned = {"coeffs": coeffs_out, "score": score_out, "var": var_out}
+        model = RandomEffectModel(
+            re_type=ds.re_type,
+            feature_shard_id=ds.feature_shard_id,
+            task=self.task,
+            entity_ids=ds.entity_ids,
+            coeffs=coeffs_out,
+            proj_indices=ds.proj_indices,
+            variances=var_out,
+            projector=ds.projector,
+        )
+        tracker = LazyRandomEffectTracker(reasons, iters, guard_ok=ok)
+        return model, score_out, tracker
 
     def score(self, model: RandomEffectModel) -> Array:
         return model.score_dataset(self.dataset)

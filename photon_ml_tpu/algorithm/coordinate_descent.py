@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import math
 import time
 from typing import Mapping, Optional
 
@@ -33,7 +32,7 @@ from photon_ml_tpu.algorithm.coordinate import (
     score_model_on_dataset,
 )
 from photon_ml_tpu.evaluation.evaluators import EvaluationSuite
-from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
 from photon_ml_tpu.resilience import faultpoint, register_fault_point
 from photon_ml_tpu.resilience.incidents import Incident
 
@@ -46,20 +45,159 @@ logger = logging.getLogger(__name__)
 FP_COORD_UPDATE = register_fault_point("coord.update")
 
 
-def _divergence_cause(model, tracker) -> Optional[str]:
-    """Why this update must be rejected, or None when it is healthy: the
-    solver's final objective value blew up, or the coefficients it emitted
-    contain NaN/Inf (TRON/L-BFGS/OWL-QN on hostile data can do either)."""
-    final_value = getattr(tracker, "final_value", None)
-    if final_value is not None and not math.isfinite(final_value):
-        return f"training objective is non-finite ({final_value})"
+def _device_guard(model, tracker) -> tuple:
+    """The divergence guard's inputs as DEVICE scalars — no host sync here.
+
+    Returns ``(coefs_ok, value_ok, final_value)``: all coefficient arrays
+    finite; the solver's final objective finite (None when the tracker has no
+    final value, e.g. random-effect trackers); the raw final value for the
+    incident message. The host read happens later — immediately when the run
+    validates (the reject decision gates validation), else in the
+    once-per-iteration batched flush."""
     flags = [jnp.all(jnp.isfinite(a)) for a in coefficient_arrays(model)]
-    # one deliberate scalar host read per coordinate update (the guard must
-    # decide before the next coordinate trains); reductions fuse device-side
-    ok = bool(jax.device_get(jnp.stack(flags).all()))
-    if not ok:
+    coefs_ok = flags[0] if len(flags) == 1 else jnp.stack(flags).all()
+    final_value = getattr(tracker, "final_value", None)
+    value_ok = None if final_value is None else jnp.isfinite(jnp.asarray(final_value))
+    return coefs_ok, value_ok, final_value
+
+
+def _guard_cause(coefs_ok, value_ok, final_value) -> Optional[str]:
+    """Host-side reject cause from materialized guard values (same wording
+    and check order as the original blocking guard: the solver's final
+    objective value blew up, or the coefficients it emitted contain NaN/Inf —
+    TRON/L-BFGS/OWL-QN on hostile data can do either)."""
+    if value_ok is not None and not bool(value_ok):
+        # mirror the pre-device-guard message exactly ("inf"/"nan" via float)
+        v = final_value if isinstance(final_value, float) else float(final_value)
+        return f"training objective is non-finite ({v})"
+    if not bool(coefs_ok):
         return "solver emitted non-finite coefficients"
     return None
+
+
+def _select_variances(ok, new_var, prev_var):
+    """Reject semantics for variance arrays: keep the previous ones on a
+    rejected update. Variances are excluded from the guard itself
+    (coefficient_arrays), but a diverged solve's NaN variances must not
+    survive an update the loop reports as rejected — when the previous model
+    had none (first update), the device-side reject value is zeros and the
+    host-side reject handling then strips the field back to None
+    (_strip_variances), restoring the old keep-previous-model schema."""
+    if new_var is None:
+        return None
+    if prev_var is not None:
+        return jnp.where(ok, new_var, prev_var)
+    return jnp.where(ok, new_var, jnp.zeros_like(new_var))
+
+
+def _has_variances(model) -> bool:
+    if isinstance(model, RandomEffectModel):
+        return model.variances is not None
+    if isinstance(model, FixedEffectModel):
+        return model.model.coefficients.variances is not None
+    return False
+
+
+def _strip_variances(model):
+    """Drop the variance field entirely — the reject repair for updates whose
+    PREVIOUS model carried no variances: a select can't emit 'absent', so the
+    device side substitutes zeros and this restores variances=None once the
+    reject is known host-side (zero variances would read as infinite
+    confidence in an exported model)."""
+    if isinstance(model, RandomEffectModel) and model.variances is not None:
+        return dataclasses.replace(model, variances=None)
+    if (
+        isinstance(model, FixedEffectModel)
+        and model.model.coefficients.variances is not None
+    ):
+        coef = dataclasses.replace(model.model.coefficients, variances=None)
+        return dataclasses.replace(
+            model, model=dataclasses.replace(model.model, coefficients=coef)
+        )
+    return model
+
+
+def _select_update(ok, new_model, prev_model):
+    """Device-side reject for coordinates without an in-program guard:
+    ``where(ok, new, prev)`` on the coefficient (and variance) arrays, so the
+    loop never has to read ``ok`` to keep the previous model's values
+    bit-for-bit."""
+    if isinstance(new_model, FixedEffectModel):
+        glm = new_model.model
+        prev_coef = prev_model.model.coefficients
+        coef = dataclasses.replace(
+            glm.coefficients,
+            means=jnp.where(ok, glm.coefficients.means, prev_coef.means),
+            variances=_select_variances(
+                ok, glm.coefficients.variances, prev_coef.variances
+            ),
+        )
+        return dataclasses.replace(
+            new_model, model=dataclasses.replace(glm, coefficients=coef)
+        )
+    if isinstance(new_model, RandomEffectModel):
+        coeffs = jnp.where(ok, new_model.coeffs, prev_model.coeffs)
+        variances = _select_variances(ok, new_model.variances, prev_model.variances)
+        return dataclasses.replace(new_model, coeffs=coeffs, variances=variances)
+    raise TypeError(f"Unknown model type: {type(new_model).__name__}")
+
+
+@dataclasses.dataclass
+class _PendingGuard:
+    """A deferred divergence decision: the update's guard scalars stay on
+    device until the iteration-end batched flush."""
+
+    iteration: int
+    coordinate_id: str
+    guard: tuple  # (coefs_ok, value_ok, final_value) — device scalars
+    # the pre-update model carried no variances: on a reject the stored
+    # model's device-substituted zero variances must be stripped back to None
+    prev_had_no_variances: bool = False
+
+
+def _flush_guards(pending: list, incidents: list, models: dict) -> None:
+    """ONE batched transfer for every deferred guard of the iteration, then
+    incident recording for the rejects (the state itself was already kept
+    previous device-side — this writes the paper trail and repairs the
+    variance schema of first-update rejects)."""
+    if not pending:
+        return
+    host = jax.device_get([p.guard for p in pending])
+    for p, (coefs_ok, value_ok, final_value) in zip(pending, host):
+        cause = _guard_cause(coefs_ok, value_ok, final_value)
+        if cause is None:
+            continue
+        if p.prev_had_no_variances:
+            models[p.coordinate_id] = _strip_variances(models[p.coordinate_id])
+        incident = Incident(
+            kind="divergence",
+            cause=cause,
+            action="update rejected; previous model kept",
+            coordinate_id=p.coordinate_id,
+            iteration=p.iteration,
+        )
+        incidents.append(incident)
+        logger.warning("iter %d %s", p.iteration, incident.summary())
+
+
+def _snapshot_models(models: dict, donating: set) -> dict:
+    """Copy coefficient arrays out of models owned by donating coordinates:
+    the next fused update CONSUMES its input table (donate_argnums), so a
+    best-model snapshot aliasing the live array would be invalidated
+    (fused_backend._params_to_model makes the same copy for the same
+    reason). Non-donating coordinates keep zero-copy snapshots."""
+    out = dict(models)
+    for cid in donating:
+        m = out.get(cid)
+        if isinstance(m, RandomEffectModel):
+            out[cid] = dataclasses.replace(
+                m,
+                coeffs=jnp.array(m.coeffs, copy=True),
+                variances=(
+                    None if m.variances is None else jnp.array(m.variances, copy=True)
+                ),
+            )
+    return out
 
 
 @dataclasses.dataclass
@@ -91,6 +229,7 @@ def run_coordinate_descent(
     validation_datasets: Optional[Mapping[str, object]] = None,
     evaluation_suite: Optional[EvaluationSuite] = None,
     checkpointer: Optional[object] = None,
+    defer_guard: bool = True,
 ) -> CoordinateDescentResult:
     """Run block coordinate descent (CoordinateDescent.run/descend:93-346).
 
@@ -98,6 +237,15 @@ def run_coordinate_descent(
     coordinates are scored, never updated. ``validation_datasets`` must cover every
     coordinate id when ``evaluation_suite`` is given; validation scores are summed
     across coordinates and handed to the suite after each update.
+
+    The descent loop is SYNC-FREE between coordinate updates: coordinates
+    offering the fused ``update_and_score`` protocol run as one donated XLA
+    program per update with the divergence guard applied device-side, and the
+    generic path computes its guard as device scalars with a ``where``-based
+    reject — the blocking per-update ``device_get`` of the old guard becomes
+    one batched transfer per iteration (``defer_guard=False`` restores the
+    blocking per-update read; validating runs always resolve per update, so
+    rejected updates skip validation exactly as before).
 
     ``checkpointer`` (io/checkpoint.CoordinateDescentCheckpointer) enables
     iteration-level failure recovery: after each completed iteration the models +
@@ -205,49 +353,122 @@ def run_coordinate_descent(
     if not updatable:
         raise ValueError("All coordinates are locked; nothing to train")
 
+    # guard resolution: a validating run must know the reject BEFORE scoring
+    # validation data (rejected updates skip validation); otherwise decisions
+    # defer to one batched transfer per iteration
+    sync_guard = validate or not defer_guard
+    # coordinates whose live model tables are fed back DONATED: their arrays
+    # in `models`/`train_scores` are consumed by the next update, so
+    # snapshots of them must copy (see _snapshot_models)
+    donating: set = set()
+
     for iteration in range(start_iteration, n_iterations):
         # Recompute (not accumulate) the total at each iteration boundary: the
         # state is then a pure function of the models dict, which makes a
         # checkpoint-resumed run BIT-identical to an uninterrupted one (resume
         # restores models and recomputes scores the same way).
         full_train_score = sum(train_scores.values())
+        pending: list[_PendingGuard] = []
         for cid in updatable:
             coord = coordinates[cid]
             faultpoint(f"{FP_COORD_UPDATE}.{cid}")
             t0 = time.perf_counter()
             # Residual trick (CoordinateDescent.scala:197-204)
             partial = full_train_score - train_scores[cid]
-            model, tracker = coord.update_model(models[cid], partial)
-            trackers[cid].append(tracker)
-            cause = _divergence_cause(model, tracker)
-            if cause is not None:
-                # Divergence guard: REJECT the update — the previous model for
-                # this coordinate is kept (scores unchanged), an incident is
-                # recorded, and the descent continues over the remaining
-                # coordinates. Graceful degradation instead of a poisoned GAME
-                # model, mirroring eager Photon's keep-best semantics.
-                incident = Incident(
-                    kind="divergence",
-                    cause=cause,
-                    action="update rejected; previous model kept",
-                    coordinate_id=cid,
-                    iteration=iteration,
-                )
-                incidents.append(incident)
-                logger.warning("iter %d %s", iteration, incident.summary())
-                continue
-            models[cid] = model
-            new_score = coord.score(model)
-            train_scores[cid] = new_score
-            full_train_score = partial + new_score
-            elapsed = time.perf_counter() - t0
-            logger.info(
-                "iter %d coordinate %s: %s (%.2fs)",
-                iteration,
-                cid,
-                tracker.summary(),
-                elapsed,
+            prev_model = models[cid]
+            prev_score = train_scores[cid]
+            prev_had_var = _has_variances(prev_model)
+            # duck-typed coordinates (test wrappers, external impls) may
+            # predate the fused protocol — treat a missing method as "no
+            # fused path"
+            update_and_score = getattr(coord, "update_and_score", None)
+            fused = (
+                update_and_score(prev_model, partial, prev_score, donate=cid in donating)
+                if update_and_score is not None
+                else None
             )
+            if fused is not None:
+                model, new_score, tracker = fused
+                donating.add(cid)
+                guard_ok = getattr(tracker, "guard_ok", None)
+                if guard_ok is None:
+                    # the fused protocol applies its reject IN-PROGRAM and
+                    # must surface the flag: without it the loop could store
+                    # a diverged model while recording "previous model kept"
+                    raise TypeError(
+                        f"Coordinate {cid!r}: update_and_score must return a "
+                        "tracker exposing the device-side guard_ok flag"
+                    )
+                guard = (guard_ok, None, None)
+                # the fused program applied the reject select internally (and
+                # consumed the previous buffers): state always moves to the
+                # returned arrays — on a reject they HOLD the previous values
+                models[cid] = model
+                train_scores[cid] = new_score
+            else:
+                model, tracker = coord.update_model(prev_model, partial)
+                guard = _device_guard(model, tracker)
+            trackers[cid].append(tracker)
+
+            if sync_guard:
+                # validating (or defer_guard=False) runs resolve per update
+                # on purpose: a rejected update must skip validation
+                cause = _guard_cause(*jax.device_get(guard))  # jaxlint: disable=HS001 deliberate per-update read, validation gates on the reject decision
+                if cause is not None:
+                    # Divergence guard: REJECT the update — the previous model
+                    # for this coordinate is kept (scores unchanged), an
+                    # incident is recorded, and the descent continues over the
+                    # remaining coordinates. Graceful degradation instead of a
+                    # poisoned GAME model, mirroring eager Photon's keep-best
+                    # semantics. full_train_score stays the pre-update total.
+                    incident = Incident(
+                        kind="divergence",
+                        cause=cause,
+                        action="update rejected; previous model kept",
+                        coordinate_id=cid,
+                        iteration=iteration,
+                    )
+                    incidents.append(incident)
+                    logger.warning("iter %d %s", iteration, incident.summary())
+                    if fused is not None and not prev_had_var:
+                        # the in-program reject substituted zeros for the
+                        # absent previous variances; restore variances=None
+                        models[cid] = _strip_variances(models[cid])
+                    continue
+                if fused is None:
+                    models[cid] = model
+                    new_score = coord.score(model)
+                    train_scores[cid] = new_score
+                full_train_score = partial + new_score
+            else:
+                if fused is None:
+                    # device-side reject: keep the previous values without
+                    # reading the flag (scoring the selected model reproduces
+                    # the previous score bit-for-bit on a reject)
+                    ok = guard[0] if guard[1] is None else jnp.logical_and(*guard[:2])
+                    model = _select_update(ok, model, prev_model)
+                    models[cid] = model
+                    new_score = coord.score(model)
+                    train_scores[cid] = new_score
+                # on a (not-yet-known) reject this rebuilds the total as
+                # partial + previous-score values — possibly one ulp off the
+                # pre-update total; the iteration-boundary recompute restores
+                # exactness, and healthy updates are bit-identical
+                full_train_score = partial + new_score
+                pending.append(
+                    _PendingGuard(iteration, cid, guard, prev_had_no_variances=not prev_had_var)
+                )
+
+            if logger.isEnabledFor(logging.INFO):
+                # summary() materializes device trackers: only pay the sync
+                # when the log line is actually emitted
+                logger.info(
+                    "iter %d coordinate %s: %s (%.2fs)",
+                    iteration,
+                    cid,
+                    tracker.summary(),
+                    time.perf_counter() - t0,
+                )
 
             if validate:
                 val_scores[cid] = score_model_on_dataset(model, validation_datasets[cid])
@@ -259,7 +480,11 @@ def run_coordinate_descent(
                 if primary.better_than(metric, best_metric):
                     best_metric = metric
                     best_metrics = metrics
-                    best_model = GameModel(models=dict(models))
+                    best_model = GameModel(models=_snapshot_models(models, donating))
+
+        # incident details for the whole iteration in ONE batched transfer
+        # (the reject itself already happened device-side)
+        _flush_guards(pending, incidents, models)
 
         if checkpointer is not None:
             checkpointer.maybe_save(
@@ -271,6 +496,18 @@ def run_coordinate_descent(
                 force=(iteration + 1 == n_iterations),
                 incidents=incidents,
             )
+
+    # Restore the host-value tracker contract before results escape: fixed-
+    # effect trackers buffered device scalars through the sync-free loop;
+    # materialize them now, outside the hot path. Probe the CLASS, not the
+    # instance: LazyRandomEffectTracker's __getattr__ would treat an instance
+    # probe as a field read and eagerly sync — those trackers keep their
+    # on-demand materialization (attribute access already yields host values).
+    for tracker_list in trackers.values():
+        for t in tracker_list:
+            materialize = getattr(type(t), "materialize", None)
+            if materialize is not None:
+                materialize(t)
 
     final_model = GameModel(models=dict(models))
     if best_model is None:

@@ -71,6 +71,48 @@ class RandomEffectTracker:
         )
 
 
+class LazyRandomEffectTracker:
+    """RandomEffectTracker whose per-entity stats stay ON DEVICE until first
+    read. The single-program coordinate update returns its convergence
+    reasons/iterations as device arrays; materializing them eagerly would put
+    a blocking host sync back between coordinate updates — exactly the
+    round-trip the fused update removes. Attribute access (``summary()``,
+    ``iterations_mean``...) triggers one batched ``device_get``.
+
+    ``guard_ok`` is the update's device-side divergence flag (all updated
+    coefficients finite, computed BEFORE the in-program reject select): the
+    descent loop reads it in its once-per-iteration batched transfer."""
+
+    def __init__(self, reasons_parts, iters_parts, guard_ok=None):
+        self.guard_ok = guard_ok
+        self._pending = (tuple(reasons_parts), tuple(iters_parts))
+        self._inner: Optional[RandomEffectTracker] = None
+
+    def _materialize(self) -> RandomEffectTracker:
+        if self._inner is None:
+            reasons_h, iters_h = jax.device_get(self._pending)
+            reasons = (
+                np.concatenate([np.asarray(a) for a in reasons_h])
+                if reasons_h
+                else np.zeros(0, np.int32)
+            )
+            iters = (
+                np.concatenate([np.asarray(a) for a in iters_h])
+                if iters_h
+                else np.zeros(0, np.int32)
+            )
+            self._inner = RandomEffectTracker.from_arrays(reasons, iters)
+            self._pending = None
+        return self._inner
+
+    def summary(self) -> str:
+        return self._materialize().summary()
+
+    def __getattr__(self, name):
+        # only reached for names not set in __init__ (materialized fields)
+        return getattr(self._materialize(), name)
+
+
 def _gather_norm_vectors(
     normalization: Optional[NormalizationContext], proj: Array, dtype
 ) -> tuple[Optional[Array], Optional[Array], Optional[Array]]:
@@ -131,6 +173,56 @@ def _to_original(w, factors, shifts, icpt_mask):
         dot = jnp.sum(w * shifts, axis=-1, keepdims=True)
         w = w - icpt_mask * dot
     return w
+
+
+def precompute_norm_tables(
+    dataset: RandomEffectDataset,
+    normalization: Optional[NormalizationContext],
+    dtype,
+) -> tuple:
+    """Per-bucket (factors, shifts, intercept-mask) triples for the
+    single-program coordinate update, gathered ONCE per (dataset,
+    normalization) instead of once per bucket per update — the gather (and
+    its host-side missing-intercept validation) is invariant across descent
+    iterations. Buckets get None when normalization is identity/absent."""
+    if normalization is None or normalization.is_identity:
+        return tuple(None for _ in dataset.buckets)
+    out = []
+    for bucket in dataset.buckets:
+        K = bucket.shape[1]
+        proj_b = dataset.proj_indices[bucket.entity_rows, :K]
+        out.append(_gather_norm_vectors(normalization, proj_b, dtype))
+    return tuple(out)
+
+
+def build_l2_rows(
+    dataset: RandomEffectDataset,
+    l2: float,
+    per_entity_reg_weights,
+    dtype,
+    table_rows: int,
+) -> Array:
+    """Row-aligned per-entity L2 table (shared by the per-bucket loop and the
+    single-program update so the two paths gather identical weights). Padded
+    entity rows (mesh placement) gather the base weight harmlessly."""
+    E = dataset.n_entities
+    l2_table = np.full(max(table_rows, E + 1), float(l2))
+    if per_entity_reg_weights is not None:
+        if isinstance(per_entity_reg_weights, dict):
+            row_by_entity = {e: i for i, e in enumerate(dataset.entity_ids)}
+            for e_id, w_e in per_entity_reg_weights.items():
+                row = row_by_entity.get(e_id, -1)
+                if row >= 0:
+                    l2_table[row] = float(w_e)
+        else:
+            arr = np.asarray(per_entity_reg_weights, dtype=np.float64)
+            if arr.shape[0] != E:
+                raise ValueError(
+                    f"per_entity_reg_weights has {arr.shape[0]} entries for "
+                    f"{E} entities"
+                )
+            l2_table[:E] = arr
+    return jnp.asarray(l2_table, dtype=dtype)
 
 
 def train_random_effect(
@@ -198,31 +290,18 @@ def train_random_effect(
         else None
     )
 
-    # per-entity L2 table, row-aligned with the coefficient table; padded
-    # entity rows (mesh placement) gather the base weight harmlessly
-    l2_table = np.full(max(table_rows, E + 1), float(l2))
-    if per_entity_reg_weights is not None:
-        if isinstance(per_entity_reg_weights, dict):
-            row_by_entity = {e: i for i, e in enumerate(dataset.entity_ids)}
-            for e_id, w_e in per_entity_reg_weights.items():
-                row = row_by_entity.get(e_id, -1)
-                if row >= 0:
-                    l2_table[row] = float(w_e)
-        else:
-            arr = np.asarray(per_entity_reg_weights, dtype=np.float64)
-            if arr.shape[0] != E:
-                raise ValueError(
-                    f"per_entity_reg_weights has {arr.shape[0]} entries for "
-                    f"{E} entities"
-                )
-            l2_table[:E] = arr
-    l2_rows = jnp.asarray(l2_table, dtype=dtype)
+    # per-entity L2 table, row-aligned with the coefficient table
+    l2_rows = build_l2_rows(dataset, l2, per_entity_reg_weights, dtype, table_rows)
 
     # tracker inputs stay DEVICE arrays inside the loop: a host sync per bucket
     # (np.asarray) would block dispatch of the next bucket's solve; everything
     # transfers in one device_get after the last bucket is enqueued
     reasons_parts, iters_parts, rows_parts = [], [], []
 
+    # the cached-solver probe is loop-invariant: resolve it once, not per bucket
+    solve = re_bucket_solver(
+        task, configuration.optimizer_config, bool(l1), variance_computation
+    )
     for bucket in dataset.buckets:
         S, K = bucket.shape
         proj_b = dataset.proj_indices[bucket.entity_rows, :K]
@@ -235,9 +314,6 @@ def train_random_effect(
         if normalization is not None and not normalization.is_identity:
             init_b = _to_transformed(init_b, factors, shifts, icpt_mask)
 
-        solve = re_bucket_solver(
-            task, configuration.optimizer_config, bool(l1), variance_computation
-        )
         w_b, reasons_b, iters_b, var_b = solve(
             bucket.X,
             bucket.labels,

@@ -143,6 +143,14 @@ class GameEstimator:
     # reasons otherwise); validation/best-model tracking happens per PASS,
     # not per coordinate update.
     fused_pass: bool = False
+    # Host-loop random-effect updates as ONE donated XLA program per
+    # coordinate update (optimization/solver_cache.re_coordinate_update_
+    # program) instead of one program per bucket — the featureful
+    # configurations the fused pass rejects (normalization, per-entity L2,
+    # variances, checkpointing, ...) keep their semantics but lose the
+    # per-bucket dispatch + host-sync overhead. False restores the per-bucket
+    # loop (mesh-sharded datasets always use it).
+    re_update_program: bool = True
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -334,6 +342,7 @@ class GameEstimator:
             normalization=None if norm.is_identity else norm,
             variance_computation=self.variance_computation,
             per_entity_reg_weights=cfg.per_entity_reg_weights,
+            use_update_program=self.re_update_program,
         )
 
     # ---------------------------------------------------------------- fit

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Mapping, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -27,6 +28,7 @@ from photon_ml_tpu.types import ModelType, TaskType
 Array = jnp.ndarray
 
 
+@jax.jit
 def random_effect_view_score(
     coeffs: Array, entity_rows: Array, local_cols: Array, vals: Array
 ) -> Array:
@@ -34,9 +36,18 @@ def random_effect_view_score(
     sum_k coeffs[entity_rows[i], local_cols[i, k]] * vals[i, k], with -1
     entity rows (no model) and -1 column slots (padding / columns the model
     never saw) contributing exactly 0. ONE shared implementation for the
-    eager ``RandomEffectModel.score_dataset`` and the fused serving engine
-    (serving/engine.py), so the two paths execute identical jnp ops and stay
-    numerically interchangeable."""
+    eager ``RandomEffectModel.score_dataset``, the fused serving engine
+    (serving/engine.py) and the single-program coordinate update
+    (solver_cache.re_coordinate_update_program), so every path executes
+    identical jnp ops and stays numerically interchangeable.
+
+    Jitted at module level ON PURPOSE: XLA contracts the multiply into the
+    reduction (FMA) when this subgraph sits inside one fusion, so an
+    op-by-op eager evaluation differs from any inlined/jitted one in the
+    last ulp. One compiled form everywhere keeps the fused-vs-eager bitwise
+    parity gates honest (jit-in-jit callers simply inline the same
+    subgraph, which XLA fuses the same way — asserted by the update-program
+    parity tests and the serving bench gate)."""
     has_model = entity_rows >= 0
     safe_rows = jnp.maximum(entity_rows, 0)
     w = coeffs[safe_rows]  # [N, K]
@@ -140,6 +151,17 @@ class RandomEffectModel:
         order = surviving means order) or trained on a different dataset build —
         without this, gathers through the dataset's local columns would read the
         wrong slots."""
+        # Identity fast path: a model trained ON this dataset carries the
+        # dataset's own proj_indices array and entity tuple (the warm-start
+        # case inside coordinate descent, once per coordinate per iteration).
+        # Object identity + tuple equality only — NO array materialization,
+        # which on an accelerator would be a device->host transfer in the
+        # descent hot loop.
+        if self.proj_indices is dataset.proj_indices and (
+            self.entity_ids is dataset.entity_ids
+            or self.entity_ids == tuple(dataset.entity_ids)
+        ):
+            return self
         if self.entity_ids == tuple(dataset.entity_ids) and np.array_equal(
             np.asarray(self.proj_indices), np.asarray(dataset.proj_indices)
         ):

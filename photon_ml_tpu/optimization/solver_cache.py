@@ -107,20 +107,15 @@ def glm_solver(
     return jax.jit(solve)
 
 
-@functools.lru_cache(maxsize=None)
-def re_bucket_solver(
+def _re_bucket_solve_fn(
     task: TaskType,
     opt_config: OptimizerConfig,
     has_l1: bool,
     variance: VarianceComputationType,
 ):
-    """Jitted vmapped per-entity bucket solve:
-    ``solve(X, y, w, offsets, w0, l2, l1) -> (coefs, reasons, iters, variances)``
-    with X [E, S, K], l2 a PER-ENTITY [E] vector (the reference only envisioned
-    per-entity regularization weights, RandomEffectOptimizationProblem.scala:
-    34-37 — here each entity's solve traces its own weight) and l1 broadcast —
-    the executor-local random-effect hot loop of RandomEffectCoordinate.scala:
-    109-127 as one XLA program per bucket shape class."""
+    """Unjitted vmapped bucket solve shared by ``re_bucket_solver`` (one jit
+    per bucket) and ``re_coordinate_update_program`` (every bucket chained in
+    one trace) — one body, so the two paths stay bitwise interchangeable."""
     task = TaskType(task)
     loss = loss_for_task(task)
     minimize = build_minimizer(opt_config)
@@ -149,7 +144,119 @@ def re_bucket_solver(
         var = compute_variances(obj, data, res.coefficients, l2, variance, w0.dtype)
         return res.coefficients, res.convergence_reason, res.iterations, var
 
-    return jax.jit(jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None)))
+    return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None))
+
+
+@functools.lru_cache(maxsize=None)
+def re_bucket_solver(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    variance: VarianceComputationType,
+):
+    """Jitted vmapped per-entity bucket solve:
+    ``solve(X, y, w, offsets, w0, l2, l1) -> (coefs, reasons, iters, variances)``
+    with X [E, S, K], l2 a PER-ENTITY [E] vector (the reference only envisioned
+    per-entity regularization weights, RandomEffectOptimizationProblem.scala:
+    34-37 — here each entity's solve traces its own weight) and l1 broadcast —
+    the executor-local random-effect hot loop of RandomEffectCoordinate.scala:
+    109-127 as one XLA program per bucket shape class."""
+    return jax.jit(_re_bucket_solve_fn(task, opt_config, has_l1, variance))
+
+
+@functools.lru_cache(maxsize=None)
+def re_coordinate_update_program(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    variance: VarianceComputationType,
+    n_entities: int,
+):
+    """ONE jitted, donated XLA program for a whole random-effect coordinate
+    update: offset gather, every bucket's vmapped solve chained in a single
+    trace, normalization space conversion, per-entity-L2 gather, coefficient
+    table scatter, padding-row re-zero, the coordinate's ``[N]`` score, and
+    the divergence guard's finiteness flag — the per-bucket host loop of
+    ``train_random_effect`` collapsed into one dispatch per update.
+
+    ``update(coeffs_prev, score_prev, var_prev, offsets_plus_scores, l2_rows,
+    l1, buckets, norm_tables, view) -> (coeffs, score, variances, ok,
+    reasons_per_bucket, iters_per_bucket)``
+
+    - ``coeffs_prev`` ``[E, K_max]`` / ``score_prev`` ``[N]`` / ``var_prev``
+      (``[E, K_max]`` or None) are DONATED: the hot loop stops copying the
+      coefficient table once per bucket (the old ``.at[].set`` chain), and
+      callers must never touch those buffers again — feed the outputs forward.
+    - ``ok`` is the device-side divergence flag: all updated coefficients
+      finite. When False the outputs are the donated PREVIOUS table/score/
+      variances via ``lax.select`` (``jnp.where``), preserving the host
+      guard's reject semantics bit-for-bit without a blocking host read.
+    - ``norm_tables``: per bucket, None or the per-entity (factors, shifts,
+      intercept-mask) triple from ``precompute_norm_tables`` — gathered ONCE
+      per (dataset, normalization), not per update per bucket.
+    - ``view``: the dataset's per-sample scoring view (entity rows, local
+      cols, vals) — the score uses the same ``random_effect_view_score``
+      kernel as the eager path.
+    """
+    solve = _re_bucket_solve_fn(task, opt_config, has_l1, variance)
+
+    def update(
+        coeffs_prev, score_prev, var_prev, offsets_plus_scores, l2_rows, l1,
+        buckets, norm_tables, view,
+    ):
+        from photon_ml_tpu.algorithm.random_effect import _to_original, _to_transformed
+        from photon_ml_tpu.models.game import random_effect_view_score
+
+        coeffs = coeffs_prev
+        variances = var_prev
+        reasons, iters = [], []
+        for bucket, norm_tbl in zip(buckets, norm_tables):
+            S, K = bucket.shape
+            off_b = jnp.take(
+                offsets_plus_scores, jnp.maximum(bucket.sample_ids, 0), axis=0
+            )
+            off_b = jnp.where(bucket.sample_ids >= 0, off_b, 0.0).astype(coeffs.dtype)
+            init_b = coeffs[bucket.entity_rows, :K]
+            if norm_tbl is not None:
+                factors, shifts, icpt_mask = norm_tbl
+                init_b = _to_transformed(init_b, factors, shifts, icpt_mask)
+            w_b, reasons_b, iters_b, var_b = solve(
+                bucket.X,
+                bucket.labels,
+                bucket.weights,
+                off_b,
+                init_b,
+                jnp.take(l2_rows, jnp.minimum(bucket.entity_rows, l2_rows.shape[0] - 1)),
+                l1,
+            )
+            if norm_tbl is not None:
+                w_b = _to_original(w_b, factors, shifts, icpt_mask)
+                if variances is not None and factors is not None:
+                    # Var(w) = Var(w') * factor^2, same diagonal approximation
+                    # as the per-bucket path
+                    var_b = var_b * factors**2
+            coeffs = coeffs.at[bucket.entity_rows, :K].set(w_b)
+            if variances is not None:
+                variances = variances.at[bucket.entity_rows, :K].set(var_b)
+            reasons.append(reasons_b)
+            iters.append(iters_b)
+        if coeffs.shape[0] > n_entities:
+            # padded table heights keep every padding row identically zero
+            coeffs = coeffs.at[n_entities:].set(0.0)
+            if variances is not None:
+                variances = variances.at[n_entities:].set(0.0)
+        entity_rows, local_cols, vals = view
+        score = random_effect_view_score(coeffs, entity_rows, local_cols, vals)
+        # Device-side divergence guard: variances are deliberately excluded
+        # (algorithm/coordinate.coefficient_arrays — a singular-Hessian
+        # variance failure must not discard a converged mean update).
+        ok = jnp.isfinite(coeffs).all()
+        coeffs_out = jnp.where(ok, coeffs, coeffs_prev)
+        score_out = jnp.where(ok, score, score_prev)
+        var_out = None if variances is None else jnp.where(ok, variances, var_prev)
+        return coeffs_out, score_out, var_out, ok, tuple(reasons), tuple(iters)
+
+    return jax.jit(update, donate_argnums=(0, 1, 2))
 
 
 @functools.lru_cache(maxsize=None)
@@ -301,6 +408,7 @@ def clear():
     """Drop all cached solvers (tests / long-running sweeps with many configs)."""
     glm_solver.cache_clear()
     re_bucket_solver.cache_clear()
+    re_coordinate_update_program.cache_clear()
     sharded_glm_solver.cache_clear()
     shard_mapped_glm_solver.cache_clear()
     for cache_clear in _extra_caches:

@@ -1,0 +1,469 @@
+"""Single-program random-effect coordinate update tests.
+
+The fused update (optimization/solver_cache.re_coordinate_update_program +
+RandomEffectCoordinate.update_and_score) must be a pure performance
+transformation of the per-bucket loop: bitwise-equal coefficients, variances
+and scores across normalization x per-entity-reg x variance configurations,
+donation that can never invalidate caller-held models, a device-side
+divergence guard with unchanged reject semantics, and a descent loop that
+stops retracing after the first iteration.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.algorithm import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+    run_coordinate_descent,
+    train_random_effect,
+)
+from photon_ml_tpu.analysis.runtime_guard import RetraceError, no_retrace
+from photon_ml_tpu.data.dataset import FixedEffectDataset, LabeledData
+from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+from photon_ml_tpu.normalization import FeatureDataStatistics, NormalizationContext
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.types import (
+    NormalizationType,
+    RegularizationType,
+    TaskType,
+    VarianceComputationType,
+)
+
+CFG = GLMOptimizationConfiguration(
+    optimizer_config=OptimizerConfig(max_iterations=50, tolerance=1e-9),
+    regularization_context=RegularizationContext(RegularizationType.L2),
+    regularization_weight=1.0,
+)
+
+N, D, N_USERS = 420, 3, 12
+
+
+def make_workload(rng):
+    """Deterministic shapes (same bucket classes for every test in the file)
+    with rng-driven values; entity counts vary so several shape classes
+    exist."""
+    X = rng.normal(size=(N, D))
+    # deterministic skewed assignment: entity e gets ~(e+1) shares
+    shares = np.repeat(np.arange(N_USERS), np.arange(1, N_USERS + 1))
+    users = shares[np.arange(N) % len(shares)]
+    w = rng.normal(size=D)
+    y = (X @ w + 0.7 * rng.normal(size=N_USERS)[users] > 0).astype(np.float64)
+    re_dense = np.concatenate([np.ones((N, 1)), 2.0 * X[:, :2] + 0.5], axis=1)
+    X_re = sp.csr_matrix(re_dense)
+    stats = FeatureDataStatistics.compute(re_dense, intercept_index=0)
+    norm = NormalizationContext.build(NormalizationType.STANDARDIZATION, stats)
+    return X, X_re, users, y, norm
+
+
+def build_coords(
+    workload,
+    *,
+    use_program,
+    normalization=None,
+    per_entity=None,
+    variance=VarianceComputationType.NONE,
+):
+    X, X_re, users, y, norm = workload
+    fe_ds = FixedEffectDataset(LabeledData.build(X, y), feature_shard_id="global")
+    re_ds = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", labels=y,
+        normalization=normalization,
+        intercept_index=0 if normalization is not None else None,
+    )
+    assert len(re_ds.buckets) >= 2
+    return {
+        "fixed": FixedEffectCoordinate(
+            coordinate_id="fixed", dataset=fe_ds,
+            task=TaskType.LOGISTIC_REGRESSION, configuration=CFG,
+        ),
+        "per-user": RandomEffectCoordinate(
+            coordinate_id="per-user", dataset=re_ds,
+            task=TaskType.LOGISTIC_REGRESSION, configuration=CFG,
+            base_offsets=jnp.zeros(N, dtype=re_ds.sample_vals.dtype),
+            normalization=normalization,
+            variance_computation=variance,
+            per_entity_reg_weights=per_entity,
+            use_update_program=use_program,
+        ),
+    }
+
+
+def descent_state(result):
+    out = {}
+    for cid in result.model.models:
+        m = result.model.get_model(cid)
+        if hasattr(m, "coeffs"):
+            out[f"{cid}.coeffs"] = np.asarray(m.coeffs)
+            if m.variances is not None:
+                out[f"{cid}.variances"] = np.asarray(m.variances)
+        else:
+            out[f"{cid}.means"] = np.asarray(m.model.coefficients.means)
+        out[f"{cid}.score"] = np.asarray(result.training_scores[cid])
+    return out
+
+
+# --------------------------------------------------------------- parity matrix
+
+
+@pytest.mark.parametrize("with_norm", [False, True], ids=["raw", "norm"])
+@pytest.mark.parametrize("with_per_entity", [False, True], ids=["uniform", "per-entity-l2"])
+@pytest.mark.parametrize(
+    "variance",
+    [VarianceComputationType.NONE, VarianceComputationType.SIMPLE],
+    ids=["novar", "simplevar"],
+)
+def test_update_program_parity(rng, with_norm, with_per_entity, variance):
+    """Bitwise-equal coefficients, variances and [N] scores vs the per-bucket
+    loop across the featureful configuration matrix, over multiple descent
+    iterations (score feedback would amplify any single-ulp divergence)."""
+    workload = make_workload(rng)
+    norm = workload[-1] if with_norm else None
+    per_entity = (
+        {int(e): float(v) for e, v in enumerate(rng.uniform(0.4, 2.5, size=N_USERS))}
+        if with_per_entity
+        else None
+    )
+
+    def descend(use_program):
+        coords = build_coords(
+            workload, use_program=use_program, normalization=norm,
+            per_entity=per_entity, variance=variance,
+        )
+        return run_coordinate_descent(
+            coords, n_iterations=3, defer_guard=use_program
+        )
+
+    s_new = descent_state(descend(True))
+    s_old = descent_state(descend(False))
+    assert set(s_new) == set(s_old)
+    for key in sorted(s_old):
+        assert s_new[key].dtype == s_old[key].dtype, key
+        np.testing.assert_array_equal(s_new[key], s_old[key], err_msg=key)
+
+
+# ------------------------------------------------------------- donation safety
+
+
+def _donation_supported() -> bool:
+    donated = jnp.arange(4.0)
+    jax.jit(lambda a: a + 1.0, donate_argnums=0)(donated)
+    return donated.is_deleted()
+
+
+def test_steady_state_updates_donate_and_outputs_stay_live(rng):
+    """Iteration 2..N feed the previous outputs back donated (the hot loop
+    stops copying the [E, K] table), while the final result's arrays are
+    always readable."""
+    workload = make_workload(rng)
+    coords = build_coords(workload, use_program=True)
+    c = coords["per-user"]
+    zeros = jnp.zeros(N, dtype=c.dataset.sample_vals.dtype)
+
+    m1, s1, _ = c.update_and_score(None, zeros, zeros, donate=False)
+    m2, s2, _ = c.update_and_score(m1, jnp.zeros(N), s1, donate=True)
+    if _donation_supported():
+        # the previous table and score were CONSUMED by the second update
+        assert m1.coeffs.is_deleted()
+        assert s1.is_deleted()
+    # outputs are fresh buffers, fully usable
+    assert np.isfinite(np.asarray(m2.coeffs)).all()
+    assert np.isfinite(np.asarray(s2)).all()
+
+
+def test_external_warm_start_model_survives_descent(rng):
+    """donate=False on foreign buffers: a caller-held warm-start model must
+    never be invalidated by the descent's donation (use-after-donate
+    safety)."""
+    workload = make_workload(rng)
+    X, X_re, users, y, _ = workload
+    re_ds = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", labels=y
+    )
+    warm_model, _ = train_random_effect(
+        re_ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(N)
+    )
+    warm_coeffs_before = np.asarray(warm_model.coeffs).copy()
+
+    coords = build_coords(workload, use_program=True)
+    result = run_coordinate_descent(
+        coords, n_iterations=3, initial_models={"per-user": warm_model}
+    )
+    # the warm model's buffer is alive and unchanged after 3 donated updates
+    assert not warm_model.coeffs.is_deleted()
+    np.testing.assert_array_equal(np.asarray(warm_model.coeffs), warm_coeffs_before)
+    # and every result array is readable
+    for arr in descent_state(result).values():
+        assert np.isfinite(arr).all()
+
+
+def test_best_model_snapshot_survives_later_donated_updates(rng):
+    """Validating runs snapshot the best model mid-descent; later donated
+    updates must not invalidate the snapshot's arrays."""
+    from photon_ml_tpu.evaluation import EvaluatorType, evaluator_for_type
+    from photon_ml_tpu.evaluation.evaluators import EvaluationSuite
+
+    workload = make_workload(rng)
+    X, X_re, users, y, _ = workload
+    coords = build_coords(workload, use_program=True)
+    fe_val = FixedEffectDataset(LabeledData.build(X, y), feature_shard_id="global")
+    re_val = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", scoring_only=True
+    )
+    suite = EvaluationSuite(
+        evaluators=[evaluator_for_type(EvaluatorType.AUC)],
+        labels=y, offsets=np.zeros(N), weights=np.ones(N),
+    )
+    result = run_coordinate_descent(
+        coords, n_iterations=3,
+        validation_datasets={"fixed": fe_val, "per-user": re_val},
+        evaluation_suite=suite,
+    )
+    best = result.best_model.get_model("per-user")
+    assert not best.coeffs.is_deleted()
+    assert np.isfinite(np.asarray(best.coeffs)).all()
+
+
+# -------------------------------------------------------------- retrace guard
+
+
+def test_zero_retraces_across_descent_iterations(rng):
+    """Iteration 1 compiles every program; iterations 2..N (and any
+    subsequent same-shape descent) must be pure jit-cache hits. A retrace in
+    the guarded region raises RetraceError."""
+    workload = make_workload(rng)
+    per_entity = {0: 2.0}
+    norm = workload[-1]
+    coords = build_coords(
+        workload, use_program=True, normalization=norm, per_entity=per_entity,
+        variance=VarianceComputationType.SIMPLE,
+    )
+    # warmup descent compiles the update program, scoring and guard ops
+    run_coordinate_descent(coords, n_iterations=1)
+    with no_retrace(what="descent iterations 2..N"):
+        result = run_coordinate_descent(coords, n_iterations=3)
+    assert np.isfinite(np.asarray(result.model.get_model("per-user").coeffs)).all()
+
+
+def test_retrace_guard_actually_guards(rng):
+    """Sanity: the guard used above does fire on a fresh trace (otherwise the
+    zero-retrace assertion would be vacuous)."""
+    with pytest.raises(RetraceError):
+        with no_retrace(what="seeded"):
+            jax.jit(lambda x: x * 3.0 + 1.0)(jnp.arange(7.0))
+
+
+# ---------------------------------------------------- device-side reject path
+
+
+def test_in_program_divergence_rejected_with_incident(rng):
+    """A diverging bucket solve (a NaN warm-start row propagates through its
+    entity's solve — L-BFGS line search cannot recover a NaN iterate) must:
+    keep the previous table BIT-FOR-BIT via the in-program select, keep the
+    previous score, and record a divergence incident per rejected update."""
+    workload = make_workload(rng)
+    X, X_re, users, y, _ = workload
+    coords = build_coords(workload, use_program=True)
+    re_ds = coords["per-user"].dataset
+    healthy, _ = train_random_effect(
+        re_ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(N)
+    )
+    bad = np.asarray(healthy.coeffs).copy()
+    bad[2, 0] = np.nan  # one poisoned entity row diverges its whole bucket
+    warm = dataclasses.replace(healthy, coeffs=jnp.asarray(bad))
+    warm_score = np.asarray(coords["per-user"].score(warm))
+
+    result = run_coordinate_descent(
+        coords, n_iterations=2, initial_models={"per-user": warm}
+    )
+
+    # every per-user update was rejected: the warm table (NaN row included)
+    # and its score survive bit-for-bit
+    re_model = result.model.get_model("per-user")
+    np.testing.assert_array_equal(np.asarray(re_model.coeffs), bad)
+    np.testing.assert_array_equal(
+        np.asarray(result.training_scores["per-user"]), warm_score
+    )
+    re_incidents = [i for i in result.incidents if i.coordinate_id == "per-user"]
+    assert len(re_incidents) == 2
+    for inc, it in zip(re_incidents, (0, 1)):
+        assert inc.kind == "divergence"
+        assert inc.iteration == it
+        assert "non-finite" in inc.cause
+    # the fixed effect sees NaN partial scores, so ITS guard rejects too —
+    # with the objective-value cause, like the original blocking guard
+    fe_incidents = [i for i in result.incidents if i.coordinate_id == "fixed"]
+    assert len(fe_incidents) == 2
+    assert all("objective" in i.cause for i in fe_incidents)
+    fe = np.asarray(result.model.get_model("fixed").model.coefficients.means)
+    assert np.isfinite(fe).all()
+
+
+def test_hostile_wrapper_still_rejected_in_blocking_mode(rng):
+    """defer_guard=False keeps the original per-update blocking guard
+    semantics (the bench denominator path)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_coordinate_descent import _HostileCoordinate, build_coordinates, glmix_data
+
+    X, X_re, user_ids, y = glmix_data(rng)
+    coords, _, _ = build_coordinates(X, X_re, user_ids, y)
+    hostile = _HostileCoordinate(coords["fixed"], poison={1: "nan"})
+    coords = {"fixed": hostile, "per-user": coords["per-user"]}
+    result = run_coordinate_descent(coords, n_iterations=1, defer_guard=False)
+    (inc,) = result.incidents
+    assert inc.kind == "divergence" and "non-finite" in inc.cause
+    fe = np.asarray(result.model.get_model("fixed").model.coefficients.means)
+    np.testing.assert_array_equal(fe, np.zeros_like(fe))
+
+
+# ------------------------------------------------------------- lazy trackers
+
+
+def test_lazy_random_effect_tracker_matches_eager(rng):
+    """The fused path's lazily-materialized tracker reports the same
+    convergence stats as the per-bucket path's eager tracker."""
+    workload = make_workload(rng)
+    c_new = build_coords(workload, use_program=True)["per-user"]
+    c_old = build_coords(workload, use_program=False)["per-user"]
+    zeros = jnp.zeros(N, dtype=c_new.dataset.sample_vals.dtype)
+    _, _, lazy = c_new.update_and_score(None, jnp.zeros(N), zeros)
+    _, eager = c_old.update_model(None, jnp.zeros(N))
+    assert lazy.guard_ok is not None
+    assert lazy.n_entities == eager.n_entities
+    assert lazy.convergence_reason_counts == eager.convergence_reason_counts
+    assert lazy.iterations_mean == eager.iterations_mean
+    assert lazy.iterations_max == eager.iterations_max
+    assert "entities=" in lazy.summary()
+
+
+def test_rejected_update_does_not_leak_diverged_variances(rng):
+    """The generic (non-fused) deferred reject must revert VARIANCES too: a
+    diverged solve's NaN variances surviving an update the loop reports as
+    'rejected; previous model kept' would poison the exported model."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_coordinate_descent import _HostileCoordinate, glmix_data
+
+    X, X_re, user_ids, y = glmix_data(rng)
+    fe_ds = FixedEffectDataset(LabeledData.build(X, y), feature_shard_id="global")
+    fe = FixedEffectCoordinate(
+        coordinate_id="fixed", dataset=fe_ds,
+        task=TaskType.LOGISTIC_REGRESSION, configuration=CFG,
+        variance_computation=VarianceComputationType.SIMPLE,
+    )
+    hostile = _HostileCoordinate(fe, poison={1: "nan", 2: "nan"})
+    result = run_coordinate_descent({"fixed": hostile}, n_iterations=2)
+    assert len(result.incidents) == 2
+    coef = result.model.get_model("fixed").model.coefficients
+    np.testing.assert_array_equal(np.asarray(coef.means), np.zeros_like(coef.means))
+    # the pre-update model had no variances: "previous model kept" means the
+    # field comes back ABSENT, not as a fabricated zero table
+    assert coef.variances is None
+
+
+def test_trackers_materialized_in_results(rng):
+    """result.trackers must honor the host-value field contract (str/int/
+    float) even in sync-free runs where nothing read them mid-descent."""
+    workload = make_workload(rng)
+    coords = build_coords(workload, use_program=True)
+    result = run_coordinate_descent(coords, n_iterations=1)
+    (fe_tracker,) = result.trackers["fixed"]
+    assert isinstance(fe_tracker.convergence_reason, str)
+    assert isinstance(fe_tracker.iterations, int)
+    assert isinstance(fe_tracker.final_value, float)
+
+
+def test_fused_tracker_without_guard_flag_is_refused(rng):
+    """A fused-protocol coordinate whose tracker omits guard_ok would let a
+    diverged model through while recording a reject — the loop refuses it."""
+    workload = make_workload(rng)
+    coords = build_coords(workload, use_program=True)
+    inner = coords["per-user"]
+
+    class FlaglessFused:
+        coordinate_id = "per-user"
+        is_locked = False
+
+        def initialize_model(self):
+            return inner.initialize_model()
+
+        def prepare_initial_model(self, model):
+            return inner.prepare_initial_model(model)
+
+        def score(self, model):
+            return inner.score(model)
+
+        def update_and_score(self, initial_model, partial, prev_score, donate=False):
+            model, score, tracker = inner.update_and_score(
+                initial_model, partial, prev_score, donate=donate
+            )
+            tracker.guard_ok = None
+            return model, score, tracker
+
+    coords["per-user"] = FlaglessFused()
+    with pytest.raises(TypeError, match="guard_ok"):
+        run_coordinate_descent(coords, n_iterations=1)
+
+
+def test_fixed_effect_tracker_materializes_lazily(rng):
+    workload = make_workload(rng)
+    coords = build_coords(workload, use_program=True)
+    model, tracker = coords["fixed"].update_model(None, jnp.zeros(N))
+    # device scalars until first read; summary materializes to host values
+    summary = tracker.summary()
+    assert isinstance(tracker.convergence_reason, str)
+    assert isinstance(tracker.iterations, int)
+    assert isinstance(tracker.final_value, float)
+    assert "reason=" in summary and "value=" in summary
+
+
+# ------------------------------------------------- aligned_to identity fast path
+
+
+def test_aligned_to_identity_fast_path_does_no_array_work(rng, monkeypatch):
+    """The warm-start case inside coordinate descent (model trained ON this
+    dataset) must short-circuit on object identity — no np.asarray /
+    np.array_equal over the [E, K] projection tables (a device->host
+    transfer in the hot loop on accelerators)."""
+    workload = make_workload(rng)
+    X, X_re, users, y, _ = workload
+    ds = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", labels=y
+    )
+    model, _ = train_random_effect(ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(N))
+    assert model.proj_indices is ds.proj_indices  # precondition of the fast path
+
+    def forbidden(*a, **k):  # pragma: no cover - failure path
+        raise AssertionError("aligned_to fast path did array work")
+
+    monkeypatch.setattr(np, "array_equal", forbidden)
+    monkeypatch.setattr(np, "asarray", forbidden)
+    assert model.aligned_to(ds) is model
+
+
+def test_aligned_to_slow_path_still_works(rng):
+    """Equal-valued but distinct proj arrays still re-align correctly (the
+    pre-existing value-equality path)."""
+    workload = make_workload(rng)
+    X, X_re, users, y, _ = workload
+    ds = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", labels=y
+    )
+    model, _ = train_random_effect(ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(N))
+    clone = dataclasses.replace(
+        model, proj_indices=jnp.asarray(np.asarray(model.proj_indices).copy())
+    )
+    assert clone.proj_indices is not ds.proj_indices
+    assert clone.aligned_to(ds) is clone
