@@ -855,6 +855,11 @@ def main():
         # updates vs the per-bucket loop (bitwise-parity + zero-retrace gates)
         _delegate_benchmark("--host-loop", "host_loop_bench")
 
+    if "--ingest" in sys.argv:
+        # parallel streaming Avro ingest vs the sequential path (bitwise
+        # parity + determinism + bounded-RSS gates, time-to-first-update)
+        _delegate_benchmark("--ingest", "ingest_bench")
+
     if "--child" in sys.argv:
         _child_main()
         return

@@ -1,0 +1,418 @@
+"""Ingest benchmark: parallel streaming Avro ingest vs the sequential path.
+
+Metric: ``ingest_samples_per_sec`` — samples / wall-clock through
+``read_merged_avro`` with the parallel streaming pipeline (data/pipeline.py:
+sequential block manifest, thread-pooled inflate + native decode + columnar
+extraction with a bounded in-flight window, manifest-order assembly).
+``ingest_workers=1`` is the denominator: the pre-pipeline sequential path,
+preserved verbatim behind that setting.
+
+Reported, per the honest-ratio rules (docs/PERFORMANCE.md):
+
+- ``value`` / ``ingest_mb_per_sec`` — the parallel pipeline at ``--workers``
+  (default max(4, auto)) on the bench corpus;
+- ``sequential_samples_per_sec`` / ``vs_sequential`` — the same corpus
+  through ``ingest_workers=1``, measured in its own subprocess (page cache
+  warmed symmetrically) — the speedup denominator;
+- ``parity_bitwise`` — quality gate: the parallel run's GameInput (labels,
+  offsets, weights, every shard's csr indptr/indices/data), index maps and
+  uids must hash IDENTICALLY to the sequential run's. A fast ingest that
+  assembles a different dataset is a bug, not a speedup;
+- ``determinism_repeat_ok`` — the parallel run repeated must hash the same
+  (completion-order independence);
+- ``peak_rss_ratio`` — gate: the parallel run's ingest-attributable RSS
+  (ru_maxrss minus the post-import baseline, measured in the child) must
+  stay <= --max-rss-ratio (1.5) x the sequential run's (bounded in-flight
+  window; the sequential path materializes every decoded block). Absolute
+  peaks are reported too, but they share a large interpreter+import
+  baseline that would mask a regression at small shapes;
+- ``time_to_first_update_sec`` — end-to-end: process start -> ingest ->
+  random-effect bucketization (with the fixed-effect host->device transfer
+  overlapped via BackgroundTask) -> FIRST fixed-effect coordinate update
+  complete, with XLA warm-up compilation kicked off before ingest so backend
+  init hides behind decode. ``sequential_time_to_first_update_sec`` is the
+  same pipeline with workers=1, no warm-up, no overlap — the before picture.
+
+Each measurement runs in its own subprocess so peak RSS (ru_maxrss) is
+attributable per variant. Run directly or as ``python bench.py --ingest``.
+Prints ONE JSON line; exits nonzero when a gate fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import resource
+import subprocess
+import sys
+import time
+
+N_FILES = 4
+N_RECORDS = 16_000
+N_FEATURES = 12
+FE_ITERS = 20
+
+SHARD_ID = "shardA"
+ID_TAGS = ("userId", "itemId")
+
+
+def _shard_configs():
+    from photon_ml_tpu.estimators.config import FeatureShardConfiguration
+
+    return {SHARD_ID: FeatureShardConfiguration(feature_bags=("features",))}
+
+
+def build_corpus(directory: str, n_files: int, n_records: int, n_features: int) -> None:
+    """Deterministic TrainingExampleAvro part files: dense-ish feature bags
+    (the regime where per-entry assembly dominated the sequential path) plus
+    metadataMap entity ids for the bucketization leg of time-to-first-update."""
+    import numpy as np
+
+    from photon_ml_tpu.data import avro_io
+
+    rng = np.random.default_rng(7)
+
+    def records(fi):
+        for i in range(n_records):
+            yield {
+                "uid": f"f{fi}s{i}",
+                "label": float((i + fi) % 2),
+                "features": [
+                    {
+                        "name": f"feat{j}",
+                        "term": f"t{j % 3}",
+                        "value": float(rng.normal()),
+                    }
+                    for j in range(n_features)
+                ],
+                "metadataMap": {
+                    "userId": f"u{(i * 31 + fi) % 997}",
+                    "itemId": f"i{(i * 17 + fi) % 313}",
+                },
+                "weight": 1.0 + (i % 4) * 0.5,
+                "offset": 0.25 if i % 3 else 0.0,
+            }
+
+    os.makedirs(directory, exist_ok=True)
+    for fi in range(n_files):
+        avro_io.write_container(
+            os.path.join(directory, f"part-{fi:05d}.avro"),
+            avro_io.TRAINING_EXAMPLE_SCHEMA,
+            records(fi),
+        )
+
+
+def dataset_digest(game_input, index_maps, uids) -> str:
+    """SHA-256 over every array that makes up the ingest result — the bitwise
+    parity/determinism gate compares these across worker counts and runs."""
+    import numpy as np
+
+    h = hashlib.sha256()
+
+    def arr(a):
+        a = np.asarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+
+    h.update(b"has_labels" if game_input.has_labels else b"no_labels")
+    if game_input.has_labels:
+        arr(game_input.labels)
+    arr(game_input.offsets)
+    arr(game_input.weights)
+    for shard in sorted(game_input.features):
+        m = game_input.features[shard].tocsr()
+        h.update(shard.encode())
+        arr(m.indptr)
+        arr(m.indices)
+        arr(m.data)
+        h.update(str(m.shape).encode())
+    for tag in sorted(game_input.id_columns):
+        h.update(tag.encode())
+        h.update("\x00".join(str(v) for v in game_input.id_columns[tag]).encode())
+    h.update("\x00".join(str(u) for u in uids).encode())
+    for shard in sorted(index_maps):
+        h.update(shard.encode())
+        h.update("\x00".join(index_maps[shard].keys()).encode())
+    return h.hexdigest()
+
+
+def _child_ingest(corpus: str, workers: int, reps: int) -> None:
+    """Measure read_merged_avro, best of ``reps`` passes (pass 1 also warms
+    the page cache, the native .so and the thread pool — both variants get
+    the identical treatment). Every pass's digest must agree: a worker-count-
+    or run-dependent result is a gate failure, not noise.
+
+    RSS accounting: importing the package root drags in jax (a shared
+    ~100+MB baseline that would swamp the ratio gate at small shapes), so the
+    child records ru_maxrss right AFTER imports and again after the passes —
+    the DELTA is the ingest-attributable footprint the bounded-window gate
+    compares."""
+    from photon_ml_tpu.data import native_avro
+    from photon_ml_tpu.data.readers import read_merged_avro
+
+    baseline_rss_kb = int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    elapsed = float("inf")
+    digests = set()
+    n_samples = 0
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        game_input, index_maps, uids = read_merged_avro(
+            corpus, _shard_configs(), id_tags=list(ID_TAGS), ingest_workers=workers
+        )
+        elapsed = min(elapsed, time.perf_counter() - t0)
+        digests.add(dataset_digest(game_input, index_maps, uids))
+        n_samples = int(game_input.n)
+    max_rss_kb = int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+    print(json.dumps({
+        "elapsed_sec": elapsed,
+        "n_samples": n_samples,
+        "digest": sorted(digests)[0] if len(digests) == 1 else "UNSTABLE:" + ",".join(sorted(digests)),
+        "max_rss_kb": max_rss_kb,
+        "ingest_rss_kb": max(max_rss_kb - baseline_rss_kb, 0),
+        "native_decoder": bool(native_avro.available()),
+    }))
+
+
+def _child_ttfu(corpus: str, workers: int) -> None:
+    """End-to-end time-to-first-update: ingest -> RE bucketization (FE
+    host->device transfer overlapped) -> first fixed-effect coordinate update.
+    workers >= 2 runs the full pipeline treatment (XLA warm-up before ingest,
+    transfer/bucketize overlap); workers == 1 is the serial before picture."""
+    t0 = time.perf_counter()
+    overlap = workers >= 2
+    if overlap:
+        from photon_ml_tpu.estimators.game_estimator import GameEstimator
+
+        GameEstimator.warm_up_backend()
+
+    from photon_ml_tpu.data.readers import read_merged_avro
+
+    game_input, index_maps, uids = read_merged_avro(
+        corpus, _shard_configs(), id_tags=list(ID_TAGS), ingest_workers=workers
+    )
+    ingest_sec = time.perf_counter() - t0
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.algorithm import FixedEffectCoordinate
+    from photon_ml_tpu.data.dataset import FixedEffectDataset, LabeledData
+    from photon_ml_tpu.data.game_data import as_csr
+    from photon_ml_tpu.data.pipeline import BackgroundTask
+    from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+    from photon_ml_tpu.optimization.common import OptimizerConfig
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.types import RegularizationType, TaskType
+
+    X = game_input.features[SHARD_ID]
+    labels = game_input.labels
+    imap = index_maps[SHARD_ID]
+
+    def build_fe():
+        # LabeledData.build densifies + places on device: the initial
+        # host->device transfer of the pipeline's (c) leg
+        data = LabeledData.build(
+            X, labels, offsets=game_input.offsets, weights=game_input.weights
+        )
+        jax.block_until_ready(data.labels)
+        return data
+
+    def bucketize():
+        return build_random_effect_dataset(
+            as_csr(X),
+            game_input.id_columns["userId"],
+            "userId",
+            feature_shard_id=SHARD_ID,
+            labels=labels,
+            intercept_index=imap.intercept_index,
+        )
+
+    if overlap:
+        fe_task = BackgroundTask(build_fe, name="fe-device-transfer")
+        re_ds = bucketize()
+        fe_data = fe_task.result()
+    else:
+        fe_data = build_fe()
+        re_ds = bucketize()
+
+    cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(max_iterations=FE_ITERS),
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    coord = FixedEffectCoordinate(
+        coordinate_id="fixed",
+        dataset=FixedEffectDataset(fe_data, feature_shard_id=SHARD_ID),
+        task=TaskType.LOGISTIC_REGRESSION,
+        configuration=cfg,
+    )
+    model, _tracker = coord.update_model(
+        None, jnp.zeros(game_input.n, dtype=fe_data.labels.dtype)
+    )
+    jax.block_until_ready(model.model.coefficients.means)
+    ttfu = time.perf_counter() - t0
+    print(json.dumps({
+        "ttfu_sec": ttfu,
+        "ingest_sec": ingest_sec,
+        "re_buckets": len(re_ds.buckets),
+        "n_samples": int(game_input.n),
+    }))
+
+
+def _spawn(mode: str, corpus: str, workers: int, reps: int = 1, timeout_s: int = 900) -> dict:
+    env = dict(os.environ)
+    # children run this file as a script: make the repo/install root
+    # importable regardless of how the parent found the package
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "--child", mode, "--corpus", corpus, "--workers", str(workers),
+            "--reps", str(reps),
+        ],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+    )
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        raise RuntimeError(f"{mode} child (workers={workers}) rc={proc.returncode}: {tail[0][:300]}")
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(f"{mode} child (workers={workers}) emitted no JSON line")
+
+
+def run(args) -> dict:
+    import tempfile
+
+    from photon_ml_tpu.data import pipeline
+
+    corpus = args.corpus
+    tmp = None
+    if corpus is None:
+        tmp = tempfile.TemporaryDirectory(prefix="photon-ingest-bench-")
+        corpus = tmp.name
+        build_corpus(corpus, args.files, args.records, args.features)
+    try:
+        corpus_bytes = sum(
+            os.path.getsize(os.path.join(corpus, f))
+            for f in os.listdir(corpus)
+            if f.endswith(".avro")
+        )
+        workers = args.workers or max(4, pipeline.resolve_ingest_workers(None))
+
+        # interleaved children, two per variant: the first sequential child
+        # also warms the page cache for everyone; the second parallel PROCESS
+        # doubles as the completion-order determinism gate; best-of across
+        # the pair per variant evens out per-process scheduling noise
+        seq = _spawn("ingest", corpus, 1, reps=args.reps)
+        par = _spawn("ingest", corpus, workers, reps=args.reps)
+        seq2 = _spawn("ingest", corpus, 1, reps=args.reps)
+        par2 = _spawn("ingest", corpus, workers, reps=args.reps)
+
+        parity = seq["digest"] == par["digest"] == seq2["digest"]
+        determinism = par["digest"] == par2["digest"]
+        elapsed = min(par["elapsed_sec"], par2["elapsed_sec"])
+        value = seq["n_samples"] / elapsed if elapsed > 0 else 0.0
+        seq_value = seq["n_samples"] / min(seq["elapsed_sec"], seq2["elapsed_sec"])
+        # gate on the ingest-attributable DELTA (post-import baseline
+        # subtracted in the child) — the absolute peaks share a ~100+MB
+        # interpreter+jax import baseline that would mask a bounded-window
+        # regression at small shapes. The 8MB floor keeps tiny-corpus noise
+        # from inflating the ratio.
+        rss_floor_kb = 8 * 1024
+        rss_ratio = par["ingest_rss_kb"] / max(seq["ingest_rss_kb"], rss_floor_kb)
+
+        result = {
+            "metric": "ingest_samples_per_sec",
+            "value": round(value, 2),
+            "unit": "samples/sec",
+            "ingest_mb_per_sec": round(corpus_bytes / 1e6 / elapsed, 2),
+            "sequential_samples_per_sec": round(seq_value, 2),
+            "vs_sequential": round(value / seq_value, 2) if seq_value else None,
+            "workers": workers,
+            "parity_bitwise": bool(parity),
+            "determinism_repeat_ok": bool(determinism),
+            "ingest_rss_mb": round(par["ingest_rss_kb"] / 1024, 1),
+            "sequential_ingest_rss_mb": round(seq["ingest_rss_kb"] / 1024, 1),
+            "peak_rss_mb": round(par["max_rss_kb"] / 1024, 1),
+            "sequential_peak_rss_mb": round(seq["max_rss_kb"] / 1024, 1),
+            "peak_rss_ratio": round(rss_ratio, 3),
+            "native_decoder": par.get("native_decoder"),
+            "n_samples": seq["n_samples"],
+            "corpus_mb": round(corpus_bytes / 1e6, 2),
+            "files": args.files if args.corpus is None else None,
+        }
+
+        if not args.skip_ttfu:
+            ttfu_par = _spawn("ttfu", corpus, workers)
+            ttfu_seq = _spawn("ttfu", corpus, 1)
+            result["time_to_first_update_sec"] = round(ttfu_par["ttfu_sec"], 3)
+            result["sequential_time_to_first_update_sec"] = round(
+                ttfu_seq["ttfu_sec"], 3
+            )
+            result["ttfu_ingest_sec"] = round(ttfu_par["ingest_sec"], 3)
+
+        gates_ok = (
+            parity
+            and determinism
+            and rss_ratio <= args.max_rss_ratio
+            and (value / seq_value if seq_value else 0.0) >= args.min_speedup
+        )
+        result["gates_ok"] = bool(gates_ok)
+        return result
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--child", default=None, choices=["ingest", "ttfu"],
+                   help=argparse.SUPPRESS)
+    p.add_argument("--corpus", default=None,
+                   help="Existing corpus dir (default: generate a synthetic one)")
+    p.add_argument("--workers", type=int, default=None,
+                   help="Parallel worker count (default max(4, auto))")
+    p.add_argument("--files", type=int, default=N_FILES)
+    p.add_argument("--records", type=int, default=N_RECORDS,
+                   help="Records per part file")
+    p.add_argument("--features", type=int, default=N_FEATURES)
+    p.add_argument("--reps", type=int, default=3,
+                   help="Timed passes per ingest child (best-of; pass 1 warms "
+                        "caches symmetrically for both variants)")
+    p.add_argument("--min-speedup", type=float, default=0.0,
+                   help="Fail when vs_sequential falls below this (0 = report only)")
+    p.add_argument("--max-rss-ratio", type=float, default=1.5,
+                   help="Fail when parallel peak RSS exceeds this x sequential")
+    p.add_argument("--skip-ttfu", action="store_true",
+                   help="Skip the time-to-first-update children (no jax needed)")
+    args = p.parse_args(argv)
+
+    if args.child:
+        if not args.corpus:
+            print("--child requires --corpus", file=sys.stderr)
+            return 2
+        if args.child == "ingest":
+            _child_ingest(args.corpus, args.workers or 1, args.reps)
+        else:
+            _child_ttfu(args.corpus, args.workers or 1)
+        return 0
+
+    result = run(args)
+    print(json.dumps(result))
+    return 0 if result["gates_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -167,6 +167,18 @@ def _mp_ckpt_fingerprint(args, nproc, coord_configs) -> str:
     return hashlib.blake2b(payload.encode(), digest_size=16).hexdigest()
 
 
+def _mp_ckpt_fingerprint_of(path):
+    """The fingerprint stored in one mp checkpoint file, or None when the
+    file is absent/torn (then it is simply not a resume candidate — only a
+    READABLE file with a DIFFERENT fingerprint warrants the explicit
+    'fingerprint mismatch, restarting' operator message)."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return str(z["fingerprint"][0])
+    except Exception:
+        return None
+
+
 def _mp_ckpt_paths(directory, rank):
     base = os.path.join(directory, f"mp-game-r{rank:05d}")
     return base + ".npz", base + "-prev.npz"
@@ -239,11 +251,8 @@ class _MpFeCheckpointer:
         self.logger.info("checkpointed config %d", j)
 
     def _valid(self, path):
-        try:
-            with np.load(path, allow_pickle=False) as z:
-                return str(z["fingerprint"][0]) == self.fingerprint
-        except Exception:  # torn/corrupt: not a resume candidate
-            return False
+        # torn/corrupt/absent files read as None, which never matches
+        return _mp_ckpt_fingerprint_of(path) == self.fingerprint
 
     def resume_count(self, n_configs) -> int:
         """Consecutive leading configs EVERY rank has a valid file for —
@@ -253,6 +262,21 @@ class _MpFeCheckpointer:
             self._valid(self._path(n, r)) for r in range(self.nproc)
         ):
             n += 1
+        # operators must be able to tell an INTENTIONAL invalidation (the
+        # fingerprint now covers a changed config key, e.g. box_constraints)
+        # from a lost checkpoint directory: files that exist but carry a
+        # different fingerprint get an explicit restart message
+        if n < n_configs:
+            for r in range(self.nproc):
+                path = self._path(n, r)
+                fp = _mp_ckpt_fingerprint_of(path)
+                if fp is not None and fp != self.fingerprint:
+                    self.logger.warning(
+                        "checkpoint fingerprint mismatch, restarting: %s was "
+                        "written by a different run configuration (or an older "
+                        "fingerprint schema) and is ignored", path,
+                    )
+                    break
         return n
 
     def load(self, j):
@@ -399,6 +423,7 @@ class _MpGameCheckpointer:
         """The latest (i, p) every rank can serve, or None. Deterministic:
         every rank scans the same shared files."""
         per_rank = []
+        mismatched = None
         for r in range(self.nproc):
             cur, prev = _mp_ckpt_paths(self.directory, r)
             entries = {}
@@ -407,8 +432,18 @@ class _MpGameCheckpointer:
                     cursor, fp = self._cursor_of(path)
                     if cursor is not None and fp == self.fingerprint:
                         entries[cursor] = path
+                    elif fp is not None and fp != self.fingerprint:
+                        mismatched = path
             per_rank.append(entries)
         if not per_rank or any(not e for e in per_rank):
+            if mismatched is not None:
+                # distinguish an intentional invalidation (config/data change
+                # reflected in the fingerprint) from a lost checkpoint dir
+                self.logger.warning(
+                    "checkpoint fingerprint mismatch, restarting: %s was "
+                    "written by a different run configuration (or an older "
+                    "fingerprint schema) and is ignored", mismatched,
+                )
             return None
         common = set(per_rank[0])
         for e in per_rank[1:]:
@@ -539,6 +574,7 @@ def _ranked_part_files(directories, date_range, days_range, rank, nproc):
 def _read_file_slice(
     directories, date_range, days_range, what,
     shard_configs, index_maps, id_tags, rank, nproc, logger,
+    ingest_workers=None,
 ):
     """Round-robin file-slice ingest shared by the multi-process paths.
 
@@ -566,7 +602,9 @@ def _read_file_slice(
             labels=np.zeros(0),
             id_columns={t: np.zeros(0, dtype=object) for t in id_tags},
         ), all_files, mine_idx
-    data, _, _ = read_merged_avro(mine, shard_configs, index_maps, id_tags)
+    data, _, _ = read_merged_avro(
+        mine, shard_configs, index_maps, id_tags, ingest_workers=ingest_workers
+    )
     return data, all_files, mine_idx
 
 
@@ -695,6 +733,7 @@ def run_multiprocess_fixed_effect(
             # per-group evaluator tags are consumed from VALIDATION rows only
             eval_tags if what == "validation" else (),
             rank, nproc, logger,
+            ingest_workers=getattr(args, "ingest_workers", None),
         )
 
     from photon_ml_tpu.types import HyperparameterTuningMode
@@ -1391,6 +1430,7 @@ def run_multiprocess_game(
         return _read_file_slice(
             directories, date_range, days_range, what,
             shard_configs, index_maps, id_tags, rank, nproc, logger,
+            ingest_workers=getattr(args, "ingest_workers", None),
         )
 
     with Timed("read training data", logger):

@@ -61,8 +61,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "per-coordinate dataset-rebuild path")
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="Device count for --compute-backend=mesh (default: all)")
-    from photon_ml_tpu.cli.runtime import add_distributed_arguments
+    from photon_ml_tpu.cli.runtime import add_distributed_arguments, add_ingest_arguments
 
+    add_ingest_arguments(p)
     add_distributed_arguments(
         p,
         "multi-process scoring: each process scores its round-robin slice of "
@@ -165,9 +166,14 @@ def run(args: argparse.Namespace) -> dict:
             if not input_paths:
                 logger.info("no part files for this process; nothing to score")
                 return {"scores": np.zeros(0), "metrics": {}, "output_directory": root}
+        # scoring-program compile latency hides behind ingest (pipeline.py)
+        from photon_ml_tpu.estimators.game_estimator import GameEstimator
+
+        GameEstimator.warm_up_backend()
         with Timed("read data", logger):
             data, index_maps, uids = read_merged_avro(
-                input_paths, shard_configs, index_maps, id_tags
+                input_paths, shard_configs, index_maps, id_tags,
+                ingest_workers=getattr(args, "ingest_workers", None),
             )
         logger.info("scoring %d samples", data.n)
 

@@ -125,11 +125,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-devices", type=int, default=None,
                    help="Device count for --compute-backend=mesh/fused "
                         "(default: all)")
-    from photon_ml_tpu.cli.runtime import add_distributed_arguments
+    from photon_ml_tpu.cli.runtime import add_distributed_arguments, add_ingest_arguments
 
     add_distributed_arguments(
         p, "multi-host training (jax.distributed runtime init)"
     )
+    add_ingest_arguments(p)
     p.add_argument("--mesh-model-devices", type=int, default=1,
                    help="Shard the dense fixed-effect FEATURE axis over this many "
                         "devices (2-D data x model mesh; coefficients and optimizer "
@@ -382,9 +383,15 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             getattr(args, "input_data_days_range", None),
         )
 
+        # XLA backend init + pilot compile on a background thread: that
+        # latency hides behind the host-side ingest below instead of adding
+        # to time-to-first-update (estimator warm-up hook, data/pipeline.py)
+        GameEstimator.warm_up_backend()
+        ingest_workers = getattr(args, "ingest_workers", None)
         with Timed("read training data", logger):
             train_input, index_maps, _uids = read_merged_avro(
-                train_paths, shard_configs, index_maps, id_tags
+                train_paths, shard_configs, index_maps, id_tags,
+                ingest_workers=ingest_workers,
             )
         logger.info("training data: %d samples, shards %s",
                     train_input.n, {s: m.shape[1] for s, m in train_input.features.items()})
@@ -399,7 +406,8 @@ def run(args: argparse.Namespace, emitter: Optional[EventEmitter] = None) -> dic
             with Timed("read validation data", logger):
                 validation_input, _, _ = read_merged_avro(
                     validation_paths, shard_configs, index_maps,
-                    sorted(set(id_tags) | set(evaluator_tags))
+                    sorted(set(id_tags) | set(evaluator_tags)),
+                    ingest_workers=ingest_workers,
                 )
 
         with Timed("data validation", logger):

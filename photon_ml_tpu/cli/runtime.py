@@ -21,6 +21,21 @@ def enable_compilation_cache(cache_dir: str, min_compile_secs: float = 0.1) -> N
     jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)
 
 
+def add_ingest_arguments(parser) -> None:
+    """The shared --ingest-* runtime flag of the training and scoring drivers
+    (one definition so the drivers cannot drift)."""
+    parser.add_argument(
+        "--ingest-workers", type=int, default=None,
+        help="Avro ingest decode threads: container framing stays sequential "
+             "(deterministic row order) while inflate + native block decode + "
+             "columnar extraction fan out over this many workers with a "
+             "bounded in-flight window — results are bitwise identical "
+             "across worker counts. 1 = the sequential legacy path; default "
+             "auto = min(cores, 8). See docs/PERFORMANCE.md 'Ingest & "
+             "time-to-first-update'",
+    )
+
+
 def add_distributed_arguments(parser, purpose: str) -> None:
     """The shared --distributed-* flag contract of the training and scoring
     drivers (one definition so the two cannot drift)."""

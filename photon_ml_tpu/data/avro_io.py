@@ -334,6 +334,27 @@ def iter_raw_blocks(path: str):
     """Yield (schema_json, payload: bytes, n_records) per container block with
     the codec already removed — the framing half of read_container, shared with
     the native columnar decoder (data/native_avro.py)."""
+    for schema_json, codec, payload, n_records in iter_compressed_blocks(path):
+        yield schema_json, inflate_block(payload, codec), n_records
+
+
+def inflate_block(payload: bytes, codec: str) -> bytes:
+    """Codec removal for one container block payload — split out of the
+    framing walk so the parallel ingest pipeline (data/pipeline.py) can run
+    inflate on worker threads (zlib releases the GIL) while the producer
+    thread keeps framing."""
+    if codec == "deflate":
+        return zlib.decompress(payload, -15)
+    if codec != "null":
+        raise ValueError(f"Unsupported avro codec: {codec}")
+    return payload
+
+
+def iter_compressed_blocks(path: str):
+    """Yield (schema_json, codec, payload: bytes, n_records) per container
+    block with the payload still COMPRESSED — the sequential block-manifest
+    walk of the parallel ingest pipeline. Framing errors (bad magic, negative
+    counts, truncation, sync mismatch) raise here, on the framing thread."""
     with open(path, "rb") as f:
         if f.read(4) != MAGIC:
             raise ValueError(f"{path}: not an Avro container file")
@@ -350,23 +371,23 @@ def iter_raw_blocks(path: str):
                 meta[k] = read_bytes(f)
         schema_json = json.loads(meta["avro.schema"].decode())
         codec = meta.get("avro.codec", b"null").decode()
+        if codec not in ("deflate", "null"):
+            raise ValueError(f"Unsupported avro codec: {codec}")
         sync = f.read(SYNC_SIZE)
         while True:
             try:
                 n_records = read_long(f)
             except EOFError:
                 return
+            if n_records < 0:
+                raise ValueError(f"{path}: negative record count (corrupt file)")
             payload_len = read_long(f)
             if payload_len < 0:
                 raise ValueError(f"{path}: negative block size (corrupt file)")
             payload = f.read(payload_len)
             if len(payload) != payload_len:
                 raise EOFError(f"{path}: truncated block ({len(payload)}/{payload_len} bytes)")
-            if codec == "deflate":
-                payload = zlib.decompress(payload, -15)
-            elif codec != "null":
-                raise ValueError(f"Unsupported avro codec: {codec}")
-            yield schema_json, payload, n_records
+            yield schema_json, codec, payload, n_records
             block_sync = f.read(SYNC_SIZE)
             if block_sync != sync:
                 raise ValueError(f"{path}: sync marker mismatch (corrupt block)")
@@ -398,6 +419,10 @@ def container_row_count(path: str) -> int:
                 n_records = read_long(f)
             except EOFError:
                 return total
+            if n_records < 0:
+                # a corrupt count would silently shrink this file's total and
+                # shift every later file's down-sampling draw-key offsets
+                raise ValueError(f"{path}: negative record count (corrupt file)")
             payload_len = read_long(f)
             if payload_len < 0:
                 raise ValueError(f"{path}: negative block size (corrupt file)")

@@ -31,6 +31,11 @@ F_NULLABLE_STRING = 2
 F_FEATURE_ARRAY = 3
 F_NULLABLE_MAP_STRING = 4
 
+# photon_avro_dedup `which` selectors (DecodedBlock.dedup_keys)
+DEDUP_FEATURE_KEYS = 0  # name + '\x01' + term, the feature_key() composition
+DEDUP_MAP_KEYS = 1
+DEDUP_MAP_VALUES = 2
+
 _SOURCE = os.path.join(os.path.dirname(__file__), "..", "native", "avro_block_decoder.cpp")
 # Build cache lives under the user cache dir, NOT the package tree: with a
 # pip-installed (possibly read-only) site-packages, writing next to the source
@@ -124,8 +129,16 @@ def _bind(lib):
     lib.photon_avro_map.argtypes = [
         ctypes.c_void_p, ctypes.c_int32, i64p, i64p, i64p, i64p, i64p,
     ]
+    i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+    lib.photon_avro_dedup.restype = ctypes.c_int64
+    lib.photon_avro_dedup.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, i32p,
+    ]
+    lib.photon_avro_dedup_vocab_len.restype = ctypes.c_int64
+    lib.photon_avro_dedup_vocab_len.argtypes = [ctypes.c_void_p]
     lib.photon_avro_free.argtypes = [ctypes.c_void_p]
     u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+    lib.photon_avro_dedup_vocab.argtypes = [ctypes.c_void_p, u8p, i64p]
     lib.photon_encode_scores.restype = ctypes.c_int64
     lib.photon_encode_scores.argtypes = [
         u8p, i64p, f64p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
@@ -184,7 +197,14 @@ def _is_feature_record(items) -> bool:
 
 class DecodedBlock:
     """Columnar view over one decoded block. String columns come back as
-    (offsets, lengths) into ``payload``; ``strings_at`` materializes them."""
+    (offsets, lengths) into ``payload``; ``strings_at`` materializes them.
+
+    Thread model: each block owns an independent native handle, so DIFFERENT
+    blocks may be decoded and read concurrently (the parallel ingest pipeline
+    does exactly that — the ctypes calls release the GIL). One block instance
+    is not a shared object: confine it to the thread that decoded it. After
+    ``close()`` every accessor raises instead of dereferencing a freed handle.
+    """
 
     def __init__(self, payload: bytes, handle: int, lib, n_fields: int):
         self._payload = payload
@@ -193,24 +213,33 @@ class DecodedBlock:
         self._lib = lib
         self._n_fields = n_fields
 
+    def _live_handle(self) -> int:
+        handle = self._handle
+        if not handle:
+            raise RuntimeError("DecodedBlock is closed (native buffers freed)")
+        return handle
+
     def count(self, field: int) -> int:
-        return int(self._lib.photon_avro_count(self._handle, field))
+        return int(self._lib.photon_avro_count(self._live_handle(), field))
 
     def doubles(self, field: int) -> np.ndarray:
+        handle = self._live_handle()
         n = self.count(field)
         out = np.empty(n, dtype=np.float64)
-        self._lib.photon_avro_doubles(self._handle, field, out)
+        self._lib.photon_avro_doubles(handle, field, out)
         return out
 
     def strings(self, field: int) -> tuple[np.ndarray, np.ndarray]:
+        handle = self._live_handle()
         n = self.count(field)
         offs = np.empty(n, dtype=np.int64)
         lens = np.empty(n, dtype=np.int64)
-        self._lib.photon_avro_strings(self._handle, field, offs, lens)
+        self._lib.photon_avro_strings(handle, field, offs, lens)
         return offs, lens
 
     def features(self, field: int):
         """(rows, name_offs, name_lens, term_offs, term_lens, values)."""
+        handle = self._live_handle()
         n = self.count(field)
         rows = np.empty(n, dtype=np.int64)
         no = np.empty(n, dtype=np.int64)
@@ -218,19 +247,46 @@ class DecodedBlock:
         to = np.empty(n, dtype=np.int64)
         tl = np.empty(n, dtype=np.int64)
         vals = np.empty(n, dtype=np.float64)
-        self._lib.photon_avro_features(self._handle, field, rows, no, nl, to, tl, vals)
+        self._lib.photon_avro_features(handle, field, rows, no, nl, to, tl, vals)
         return rows, no, nl, to, tl, vals
 
     def map_entries(self, field: int):
         """(rows, key_offs, key_lens, val_offs, val_lens)."""
+        handle = self._live_handle()
         n = self.count(field)
         rows = np.empty(n, dtype=np.int64)
         ko = np.empty(n, dtype=np.int64)
         kl = np.empty(n, dtype=np.int64)
         vo = np.empty(n, dtype=np.int64)
         vl = np.empty(n, dtype=np.int64)
-        self._lib.photon_avro_map(self._handle, field, rows, ko, kl, vo, vl)
+        self._lib.photon_avro_map(handle, field, rows, ko, kl, vo, vl)
         return rows, ko, kl, vo, vl
+
+    def dedup_keys(self, field: int, which: int) -> tuple[list, np.ndarray]:
+        """(vocabulary list[str], per-entry int32 vocabulary ids) for one
+        string-keyed column — the ingest pipeline's per-block key dedupe, run
+        natively (no GIL) so only the tiny VOCABULARY pays Python-level
+        decode. ``which``: DEDUP_FEATURE_KEYS composes name+DELIMITER+term
+        per FeatureAvro entry; DEDUP_MAP_KEYS / DEDUP_MAP_VALUES intern one
+        side of a map column's entries. Vocabulary order is first occurrence
+        (deterministic; consumers treat it as unordered)."""
+        handle = self._live_handle()
+        n = self.count(field)
+        ids = np.empty(n, dtype=np.int32)
+        n_vocab = self._lib.photon_avro_dedup(
+            handle, self._payload, field, which, ids
+        )
+        if n_vocab < 0:
+            raise ValueError(f"dedup unsupported for field {field} (which={which})")
+        nbytes = self._lib.photon_avro_dedup_vocab_len(handle)
+        buf = np.empty(max(int(nbytes), 1), dtype=np.uint8)
+        offs = np.empty(int(n_vocab) + 1, dtype=np.int64)
+        self._lib.photon_avro_dedup_vocab(handle, buf, offs)
+        raw = buf.tobytes()
+        vocab = [
+            raw[offs[i] : offs[i + 1]].decode() for i in range(int(n_vocab))
+        ]
+        return vocab, ids
 
     def string_at(self, off: int, length: int) -> str:
         if off < 0:
@@ -245,9 +301,11 @@ class DecodedBlock:
         ]
 
     def close(self) -> None:
-        if self._handle:
-            self._lib.photon_avro_free(self._handle)
-            self._handle = 0
+        # swap-then-free: idempotent, and safe against a close()/__del__ pair
+        # racing under the GIL (only one observer sees the live handle)
+        handle, self._handle = self._handle, 0
+        if handle:
+            self._lib.photon_avro_free(handle)
 
     def __enter__(self):
         return self

@@ -1,0 +1,250 @@
+"""Parallel streaming ingest pipeline: bounded thread-pooled block decode.
+
+The reference amortized ingest across a Spark cluster; this single-controller
+rebuild ingests on one host, where the sequential path leaves every core but
+one idle for the whole ingest+prep phase. This module supplies the three
+pieces the parallel path is built from:
+
+- ``iter_file_blocks`` — the SEQUENTIAL block manifest: container framing is
+  read file by file in listing order and every block's global row base is
+  assigned before any decode work is scheduled. Determinism rests on this:
+  whatever order workers finish in, a block's rows land at the row base the
+  manifest gave it.
+- ``map_ordered`` — a bounded, order-preserving thread-pool map: at most
+  ``window`` blocks are in flight between the framing producer and the
+  assembling consumer, so peak memory is O(window), not O(file set). The
+  producer is generator-driven — a slow consumer stalls framing instead of
+  letting raw payloads pile up. ``workers <= 1`` degenerates to a plain
+  inline map (no pool, no reordering — the sequential path).
+- ``BackgroundTask`` / ``start_xla_warmup`` — overlap for the work that
+  FOLLOWS ingest: XLA backend init + a pilot compile (and, in callers,
+  host->device transfers) run on a daemon thread while the main thread is
+  busy with host-side ingest, so that latency hides behind I/O and decode
+  instead of stacking after them.
+
+The heavy per-block work this pipeline fans out — zlib inflate, the C++
+``decode_block`` (a ctypes call), and numpy bulk ops — all release the GIL,
+so a ThreadPoolExecutor gives real core overlap without pickling payloads
+across processes.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator, Optional, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+# Auto worker count is capped: ingest has a serial assembly tail (index-map
+# application, csr construction), so returns diminish well before high core
+# counts and an unbounded pool would just hold more payload windows in RAM.
+DEFAULT_MAX_WORKERS = 8
+
+
+def resolve_ingest_workers(workers: Optional[int]) -> int:
+    """The ``ingest_workers`` contract shared by readers, CLI flags and the
+    bench: None/0/"auto" -> min(cores, 8); 1 -> the sequential legacy path;
+    N >= 2 -> N decode threads."""
+    if workers is None or workers == 0 or workers == "auto":
+        return max(1, min(os.cpu_count() or 1, DEFAULT_MAX_WORKERS))
+    w = int(workers)
+    if w < 1:
+        raise ValueError(f"ingest_workers must be >= 1 (or None for auto), got {workers}")
+    return w
+
+
+def resolve_window(window: Optional[int], workers: int) -> int:
+    """In-flight block budget: enough to keep ``workers`` busy across the
+    consumer's assembly stalls, small enough to bound peak RSS at a handful
+    of raw payloads."""
+    if window is None:
+        return max(4, 2 * workers)
+    w = int(window)
+    if w < 1:
+        raise ValueError(f"ingest window must be >= 1, got {window}")
+    return w
+
+
+@dataclass
+class RawBlock:
+    """One container block as framed by the sequential manifest pass.
+
+    ``payload`` is still compressed for deflate containers — inflate happens
+    in the worker, off the producer thread. ``row_base``/``file_row`` are the
+    block's first row in the global (concatenated, listing-order) sample axis
+    and within its own file; both are fixed at framing time.
+    """
+
+    schema_json: Any
+    codec: str
+    payload: bytes
+    n_records: int
+    row_base: int
+    file_path: str
+    file_base: str
+    file_row: int
+    meta: Any = field(default=None)  # per-file metadata attached by callers
+
+
+def iter_file_blocks(files: Iterable[str]) -> Iterator[RawBlock]:
+    """The sequential block manifest: frame every container file in listing
+    order and assign global row bases. Framing errors (bad magic, negative
+    counts, sync-marker mismatch, truncation) raise here, on the caller's
+    thread, exactly as they do on the sequential path."""
+    from photon_ml_tpu.data import avro_io
+
+    row_base = 0
+    for file_path in files:
+        file_base = os.path.basename(file_path)
+        file_row = 0
+        for schema_json, codec, payload, n_records in avro_io.iter_compressed_blocks(
+            file_path
+        ):
+            yield RawBlock(
+                schema_json=schema_json,
+                codec=codec,
+                payload=payload,
+                n_records=n_records,
+                row_base=row_base,
+                file_path=file_path,
+                file_base=file_base,
+                file_row=file_row,
+            )
+            row_base += n_records
+            file_row += n_records
+
+
+def map_ordered(
+    items: Iterable[T],
+    fn: Callable[[T], R],
+    workers: int,
+    window: Optional[int] = None,
+) -> Iterator[R]:
+    """Map ``fn`` over ``items`` on a thread pool, yielding results in ITEM
+    order with at most ``window`` items in flight.
+
+    - ``workers <= 1``: plain inline map — no pool, the sequential path.
+    - Results are yielded strictly in submission order regardless of worker
+      completion order (the determinism contract).
+    - The first worker exception propagates to the caller at the failing
+      item's position, with unstarted work cancelled — the same exception
+      type the sequential path would have raised at that item.
+    - Producer pull is demand-driven: a consumer that stops iterating stalls
+      the producer, so in-flight memory stays O(window) under any consumer.
+    """
+    workers = int(workers)
+    if workers <= 1:
+        for item in items:
+            yield fn(item)
+        return
+    window = resolve_window(window, workers)
+    pending: collections.deque = collections.deque()
+    with ThreadPoolExecutor(
+        max_workers=workers, thread_name_prefix="photon-ingest"
+    ) as pool:
+        try:
+            for item in items:
+                if len(pending) >= window:
+                    yield pending.popleft().result()
+                pending.append(pool.submit(fn, item))
+            while pending:
+                yield pending.popleft().result()
+        finally:
+            # error or early consumer exit: drop unstarted work so pool
+            # shutdown does not run the whole remaining manifest
+            for fut in pending:
+                fut.cancel()
+
+
+class BackgroundTask:
+    """A one-shot computation on a daemon thread, with fail-at-join semantics.
+
+    Used to overlap post-ingest work (XLA warm-up compilation, host->device
+    transfers) with host-side decode: start it, keep ingesting, ``result()``
+    when the value is actually needed. Exceptions are captured and re-raised
+    at ``result()`` — never swallowed, never crashing the spawning thread.
+    """
+
+    def __init__(self, fn: Callable[[], Any], name: str = "photon-background"):
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+        self._finished = threading.Event()
+
+        def _run():
+            try:
+                self._value = fn()
+            except BaseException as e:  # re-raised on the joining thread
+                self._exc = e
+            finally:
+                self._finished.set()
+
+        self._thread = threading.Thread(target=_run, name=name, daemon=True)
+        self._thread.start()
+
+    def done(self) -> bool:
+        return self._finished.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._finished.wait(timeout):
+            raise TimeoutError(f"background task {self._thread.name!r} still running")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+_warmup_lock = threading.Lock()
+_warmup_task: Optional[BackgroundTask] = None
+
+
+def start_xla_warmup() -> BackgroundTask:
+    """Kick off XLA backend init + a pilot compile on a background thread.
+
+    The first jitted program of a run pays backend/PJRT client creation and
+    compiler-stack initialization on top of its own compile; started before
+    ingest, that latency hides behind framing+decode instead of adding to
+    time-to-first-update. The pilot is a tiny matmul-in-a-loop — enough to
+    force device discovery, the lowering pipeline and the compile path; real
+    programs still compile per shape, but against a warm stack.
+
+    Idempotent per process: repeated calls return the same task. Callers may
+    ignore the handle entirely (the thread is a daemon); joining via
+    ``result()`` surfaces any backend failure.
+    """
+    global _warmup_task
+    with _warmup_lock:
+        if _warmup_task is not None:
+            return _warmup_task
+
+        def _warm():
+            import jax
+            import jax.numpy as jnp
+
+            jax.devices()  # PJRT client + device discovery
+
+            def pilot(a):
+                def body(_, c):
+                    return c + a @ a
+
+                return jax.lax.fori_loop(0, 4, body, a).sum()
+
+            out = jax.jit(pilot)(jnp.ones((8, 8), jnp.float32))
+            # deliberate sync on a background thread: the task's contract is
+            # "warm-up has COMPLETED when done() flips", and nothing on the
+            # main thread waits on this
+            out.block_until_ready()  # jaxlint: disable=HS001 warm-up runs on a daemon thread, off every hot path
+            return True
+
+        _warmup_task = BackgroundTask(_warm, name="photon-xla-warmup")
+        # A daemon thread still inside XLA's C++ at interpreter teardown
+        # aborts the whole process ("terminate called without an active
+        # exception") — a fast CLI run can finish before the pilot compile
+        # does. Draining the warm-up at exit (bounded; atexit runs before
+        # thread teardown) costs nothing when the run outlived it.
+        atexit.register(_warmup_task._finished.wait, 120.0)
+        return _warmup_task

@@ -169,6 +169,19 @@ class GameEstimator:
         if locked == set(self.coordinate_configurations) and locked:
             raise ValueError("All coordinates locked; nothing to train")
 
+    # ------------------------------------------------------------- warm-up
+
+    @staticmethod
+    def warm_up_backend():
+        """Kick off XLA backend init + a pilot compile on a background thread
+        (data/pipeline.start_xla_warmup) so that latency overlaps host-side
+        ingest instead of stacking in front of the first coordinate update.
+        Idempotent; returns the BackgroundTask for callers that want to join
+        it (the ingest bench does — time_to_first_update accounting)."""
+        from photon_ml_tpu.data import pipeline
+
+        return pipeline.start_xla_warmup()
+
     # ------------------------------------------------------------- data prep
 
     def _normalization_for(self, shard: str) -> NormalizationContext:

@@ -22,6 +22,7 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -104,6 +105,11 @@ struct Column {
 struct DecodedColumns {
   std::vector<Column> cols;
   std::string error;
+  // photon_avro_dedup scratch: the last call's vocabulary (concatenated key
+  // bytes + offsets). One handle is confined to one thread by contract
+  // (data/native_avro.DecodedBlock), so a single slot suffices.
+  std::string dedup_bytes;
+  std::vector<int64_t> dedup_offs;
 };
 
 // Avro array/map block framing: count (negative: |count| then byte size),
@@ -278,6 +284,75 @@ void photon_avro_map(DecodedColumns* h, int32_t field, int64_t* rows,
     val_offs[i] = v[i].val_off;
     val_lens[i] = v[i].val_len;
   }
+}
+
+// Vocabulary interning for one string-keyed column — the ingest pipeline's
+// per-block key dedupe, moved to C so worker threads run it without the GIL.
+// ``which``: 0 = feature keys (name + '\x01' + term, exactly the Python
+// feature_key() composition), 1 = map KEYS, 2 = map VALUES. Writes one
+// vocabulary id per entry to ``ids`` (first-occurrence order) and returns the
+// vocabulary size, or -1 when the field/which combination is unsupported.
+// The vocabulary bytes are retrieved with photon_avro_dedup_vocab_len /
+// photon_avro_dedup_vocab (valid until the next dedup call on this handle).
+int64_t photon_avro_dedup(DecodedColumns* h, const uint8_t* buf, int32_t field,
+                          int32_t which, int32_t* ids) {
+  if (field < 0 || field >= static_cast<int32_t>(h->cols.size())) return -1;
+  const Column& c = h->cols[field];
+  std::string& arena = h->dedup_bytes;
+  std::vector<int64_t>& offs = h->dedup_offs;
+  arena.clear();
+  offs.clear();
+  offs.push_back(0);
+  std::unordered_map<std::string, int32_t> seen;
+  std::string key;
+  auto intern = [&](int64_t i) {
+    auto it = seen.find(key);
+    if (it != seen.end()) {
+      ids[i] = it->second;
+      return;
+    }
+    int32_t id = static_cast<int32_t>(offs.size()) - 1;
+    arena.append(key);
+    offs.push_back(static_cast<int64_t>(arena.size()));
+    seen.emplace(key, id);
+    ids[i] = id;
+  };
+  const char* base = reinterpret_cast<const char*>(buf);
+  if (which == 0) {
+    if (c.type != F_FEATURE_ARRAY) return -1;
+    for (size_t i = 0; i < c.features.size(); ++i) {
+      const FeatureEntry& e = c.features[i];
+      key.clear();
+      key.append(base + e.name_off, static_cast<size_t>(e.name_len));
+      key.push_back('\x01');
+      key.append(base + e.term_off, static_cast<size_t>(e.term_len));
+      intern(static_cast<int64_t>(i));
+    }
+    return static_cast<int64_t>(offs.size()) - 1;
+  }
+  if (which == 1 || which == 2) {
+    if (c.type != F_NULLABLE_MAP_STRING) return -1;
+    for (size_t i = 0; i < c.map_entries.size(); ++i) {
+      const MapEntry& e = c.map_entries[i];
+      int64_t off = which == 1 ? e.key_off : e.val_off;
+      int64_t len = which == 1 ? e.key_len : e.val_len;
+      key.assign(base + off, static_cast<size_t>(len));
+      intern(static_cast<int64_t>(i));
+    }
+    return static_cast<int64_t>(offs.size()) - 1;
+  }
+  return -1;
+}
+
+int64_t photon_avro_dedup_vocab_len(DecodedColumns* h) {
+  return static_cast<int64_t>(h->dedup_bytes.size());
+}
+
+void photon_avro_dedup_vocab(DecodedColumns* h, uint8_t* bytes,
+                             int64_t* offs_out) {
+  std::memcpy(bytes, h->dedup_bytes.data(), h->dedup_bytes.size());
+  std::memcpy(offs_out, h->dedup_offs.data(),
+              h->dedup_offs.size() * sizeof(int64_t));
 }
 
 void photon_avro_free(DecodedColumns* h) { delete h; }

@@ -42,14 +42,17 @@ class OptimizerConfig:
     tolerance: float = DEFAULT_TOLERANCE
     # LBFGS-family knobs
     history_length: int = 10
-    # 10, not 30: Breeze's StrongWolfeLineSearch (the reference's actual
-    # line search) caps each phase at 10; in the vmapped random-effect
-    # regime the while_loop runs max-lane iterations, so with thousands of
-    # lanes SOME lane zooms near the budget almost every step — the budget
-    # directly bounds the whole batch's per-step cost (docs/PERFORMANCE.md
-    # round-5 table: 30 -> 15 -> 10 measured +42%/+35% with every quality
-    # gate green; the best-Armijo fallback keeps over-budget steps
-    # monotone)
+    # 10 iterations SHARED across bracketing AND zoom by the single
+    # while_loop (optimization/linesearch.py) — NOT parity with Breeze:
+    # the reference's StrongWolfeLineSearch caps EACH phase at 10 (20
+    # worst-case), so this combined budget is up to 2x tighter, relying on
+    # the best-Armijo fallback to keep over-budget steps monotone (the
+    # ls15 bench variant measures the combined-parity point). Kept at 10
+    # because in the vmapped random-effect regime the while_loop runs
+    # max-lane iterations — with thousands of lanes SOME lane zooms near
+    # the budget almost every step, so the budget directly bounds the
+    # whole batch's per-step cost (docs/PERFORMANCE.md round-5 table:
+    # 30 -> 15 -> 10 measured +42%/+35% with every quality gate green)
     max_line_search_iterations: int = 10
     # TRON knobs (TRON.scala:253-262)
     max_cg_iterations: int = 20

@@ -24,11 +24,34 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_ml_tpu.data.dataset import LabeledData
 from photon_ml_tpu.types import TaskType
 
 Array = jnp.ndarray
+
+
+def _split_id_halves(sample_ids):
+    """(hi, lo) uint32 halves of the integer sample ids. The split happens in
+    NUMPY for host inputs — positions at or beyond 2**32 arrive as int64 from
+    the multi-process drivers, and jnp cannot hold them without x64 — and on
+    device for jax arrays (whose dtype already bounds them unless x64 is on)."""
+    if isinstance(sample_ids, jax.Array):
+        ids = sample_ids
+        if jnp.dtype(ids.dtype).itemsize > 4:  # x64 runtimes only
+            wide = ids.astype(jnp.uint64)
+            return (wide >> 32).astype(jnp.uint32), wide.astype(jnp.uint32)
+        lo = ids.astype(jnp.uint32)
+        return jnp.zeros_like(lo), lo
+    arr = np.asarray(sample_ids)
+    if arr.dtype.kind not in "iu":
+        arr = arr.astype(np.int64)
+    wide = arr.astype(np.uint64)
+    return (
+        jnp.asarray((wide >> np.uint64(32)).astype(np.uint32)),
+        jnp.asarray(wide.astype(np.uint32)),
+    )
 
 
 def per_sample_uniform(seed: int, call: int, sample_ids: Array) -> Array:
@@ -37,11 +60,18 @@ def per_sample_uniform(seed: int, call: int, sample_ids: Array) -> Array:
     or where in its local block the row sits — the property multi-process
     down-sampling parity rests on. ``sample_ids`` is any integer array; the
     id convention is the sample's position in the single-process
-    concatenated row order."""
+    concatenated row order.
+
+    The id folds into the PRNG key as TWO 32-bit halves (hi, then lo): a
+    single uint32 fold would silently wrap positions at or beyond 2**32,
+    giving duplicate draw keys and breaking single-/multi-process parity at
+    that scale. Sub-2**32 ids fold as (0, id) on every input path, so host
+    (numpy int64) and device (uint32) callers agree bit for bit."""
     key = jax.random.fold_in(jax.random.PRNGKey(seed), call)
-    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
-        key, jnp.asarray(sample_ids, dtype=jnp.uint32)
-    )
+    hi, lo = _split_id_halves(sample_ids)
+    keys = jax.vmap(
+        lambda h, low: jax.random.fold_in(jax.random.fold_in(key, h), low)
+    )(hi, lo)
     # dtype pinned: the draw bits must not depend on the host's x64 mode
     # (a multi-process worker and an in-process run must agree exactly)
     return jax.vmap(lambda k: jax.random.uniform(k, (), dtype=jnp.float32))(keys)

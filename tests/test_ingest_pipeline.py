@@ -1,0 +1,406 @@
+"""Parallel streaming ingest pipeline tests (data/pipeline.py + the
+readers.py parallel paths): bitwise parity against the sequential path across
+worker counts and decode engines, manifest-order scheduling, bounded in-flight
+memory, DecodedBlock thread-safety/lifetime, worker error propagation, and the
+background-overlap primitives."""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data import avro_io, native_avro, pipeline
+from photon_ml_tpu.data.readers import read_merged_avro
+from photon_ml_tpu.estimators.config import FeatureShardConfiguration
+
+SHARDS = {"shardA": FeatureShardConfiguration(feature_bags=("features",))}
+
+
+def write_fixture(path, rng, n=300, d=6, with_nulls=True, block_count=4096):
+    def records():
+        for i in range(n):
+            yield {
+                "uid": None if (with_nulls and i % 7 == 0) else f"s{i}",
+                "label": float(i % 2),
+                "features": [
+                    {"name": f"f{j}", "term": f"t{j % 2}", "value": float(rng.normal())}
+                    for j in range(int(rng.integers(0, d)))
+                ],
+                "metadataMap": {"userId": f"u{i % 5}", "itemId": f"i{i % 3}", "x": "y"},
+                "weight": None if (with_nulls and i % 5 == 0) else 2.0,
+                "offset": None if (with_nulls and i % 3 == 0) else 0.25,
+            }
+
+    avro_io.write_container(
+        path, avro_io.TRAINING_EXAMPLE_SCHEMA, records(), block_count=block_count
+    )
+
+
+def assert_bitwise_equal(a, b):
+    """Results (GameInput, index_maps, uids) must agree array for array,
+    dtype for dtype — the determinism contract across worker counts."""
+    ga, ma, ua = a
+    gb, mb, ub = b
+    assert ga.has_labels == gb.has_labels
+    if ga.has_labels:
+        la, lb = np.asarray(ga.labels), np.asarray(gb.labels)
+        assert la.dtype == lb.dtype and np.array_equal(la, lb)
+    assert np.array_equal(ga.offsets, gb.offsets)
+    assert np.array_equal(ga.weights, gb.weights)
+    assert set(ga.features) == set(gb.features)
+    for s in ga.features:
+        xa, xb = ga.features[s].tocsr(), gb.features[s].tocsr()
+        assert xa.shape == xb.shape
+        assert np.array_equal(xa.indptr, xb.indptr)
+        assert np.array_equal(xa.indices, xb.indices)
+        assert np.array_equal(xa.data, xb.data)
+        assert xa.data.dtype == xb.data.dtype
+    assert set(ga.id_columns) == set(gb.id_columns)
+    for t in ga.id_columns:
+        assert list(ga.id_columns[t]) == list(gb.id_columns[t])
+    assert list(ua) == list(ub)
+    assert set(ma) == set(mb)
+    for s in ma:
+        assert ma[s].keys() == mb[s].keys()
+
+
+class TestParallelParity:
+    """Bitwise parity matrix: worker counts x decode engines x layouts."""
+
+    @pytest.mark.parametrize("use_native", [True, False])
+    def test_worker_counts_bitwise(self, tmp_path, rng, use_native):
+        if use_native and not native_avro.available():
+            pytest.skip("native decoder unavailable (no g++)")
+        for i in range(3):  # multi-file: row bases span file boundaries
+            write_fixture(str(tmp_path / f"part-{i}.avro"), rng, n=200)
+        reads = {
+            w: read_merged_avro(
+                str(tmp_path), SHARDS, id_tags=["userId", "itemId"],
+                use_native=use_native, ingest_workers=w,
+            )
+            for w in (1, 2, 5)
+        }
+        assert_bitwise_equal(reads[1], reads[2])
+        assert_bitwise_equal(reads[1], reads[5])
+
+    def test_multiblock_files(self, tmp_path, rng):
+        """Many small blocks per file: row bases, file-anchored uids and the
+        in-flight window all get exercised across block boundaries."""
+        for i in range(2):
+            write_fixture(str(tmp_path / f"p{i}.avro"), rng, n=500, block_count=64)
+        seq = read_merged_avro(str(tmp_path), SHARDS, id_tags=["userId"], ingest_workers=1)
+        par = read_merged_avro(
+            str(tmp_path), SHARDS, id_tags=["userId"], ingest_workers=4, ingest_window=3
+        )
+        assert_bitwise_equal(seq, par)
+
+    def test_existing_index_maps_respected(self, tmp_path, rng):
+        write_fixture(str(tmp_path / "d.avro"), rng)
+        _, maps, _ = read_merged_avro(str(tmp_path), SHARDS, ingest_workers=1)
+        seq = read_merged_avro(str(tmp_path), SHARDS, index_maps=maps, ingest_workers=1)
+        par = read_merged_avro(str(tmp_path), SHARDS, index_maps=maps, ingest_workers=3)
+        assert_bitwise_equal(seq, par)
+
+    def test_repeated_parallel_runs_identical(self, tmp_path, rng):
+        write_fixture(str(tmp_path / "d.avro"), rng, n=400, block_count=128)
+        a = read_merged_avro(str(tmp_path), SHARDS, id_tags=["userId"], ingest_workers=4)
+        b = read_merged_avro(str(tmp_path), SHARDS, id_tags=["userId"], ingest_workers=4)
+        assert_bitwise_equal(a, b)
+
+    def test_unsupported_schema_falls_back_parallel(self, tmp_path):
+        """A schema outside the native set must take the pure-Python path on
+        the parallel engine too (sequential-path fallback contract)."""
+        schema = {
+            "name": "Weird",
+            "type": "record",
+            "fields": [
+                {"name": "label", "type": "double"},
+                {"name": "features", "type": {"type": "array",
+                                              "items": avro_io.FEATURE_SCHEMA}},
+                {"name": "count", "type": "long"},
+            ],
+        }
+        path = str(tmp_path / "w.avro")
+        avro_io.write_container(path, schema, [
+            {"label": 1.0, "features": [{"name": "a", "term": "", "value": 2.0}],
+             "count": 3},
+        ])
+        seq = read_merged_avro(path, SHARDS, ingest_workers=1)
+        par = read_merged_avro(path, SHARDS, ingest_workers=4)
+        assert_bitwise_equal(seq, par)
+        assert par[0].n == 1
+
+
+class TestErrorPropagation:
+    """A corrupt block surfaces the SAME exception from the parallel paths
+    as from the sequential walk."""
+
+    def _read_both(self, path, **kw):
+        errs = []
+        for w in (1, 4):
+            with pytest.raises(Exception) as ei:
+                read_merged_avro(path, SHARDS, ingest_workers=w, **kw)
+            errs.append(ei.value)
+        return errs
+
+    def test_truncated_file(self, tmp_path, rng):
+        path = str(tmp_path / "t.avro")
+        write_fixture(path, rng, n=200, block_count=64)
+        data = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(data[: len(data) - 40])  # cut into the last block
+        seq_err, par_err = self._read_both(path)
+        assert type(seq_err) is type(par_err)
+        assert str(seq_err) == str(par_err)
+
+    def test_negative_record_count(self, tmp_path):
+        """Satellite regression: a negative block record count raises
+        ValueError from framing AND from container_row_count instead of
+        silently skewing totals."""
+        path = str(tmp_path / "neg.avro")
+        with open(path, "wb") as f:
+            avro_io._write_container_header(
+                f, avro_io.TRAINING_EXAMPLE_SCHEMA, "null"
+            )
+            head = io.BytesIO()
+            avro_io.write_long(head, -3)  # negative n_records
+            avro_io.write_long(head, 0)
+            f.write(head.getvalue())
+            f.write(avro_io.DEFAULT_SYNC)
+        with pytest.raises(ValueError, match="negative record count"):
+            list(avro_io.iter_raw_blocks(path))
+        with pytest.raises(ValueError, match="negative record count"):
+            avro_io.container_row_count(path)
+
+    def test_corrupt_payload_same_exception(self, tmp_path, rng):
+        """Garbage record bytes: the native engines reject the block and fall
+        back to pure Python, which raises the sequential path's exception."""
+        path = str(tmp_path / "c.avro")
+        write_fixture(path, rng, n=50)
+        data = bytearray(open(path, "rb").read())
+        data[-30:-20] = b"\xff" * 10  # stomp inside the (only) block payload
+        with open(path, "wb") as f:
+            f.write(bytes(data))
+        seq_err, par_err = self._read_both(path)
+        assert type(seq_err) is type(par_err)
+
+
+class TestMapOrdered:
+    def test_order_preserved_under_jitter(self):
+        rng = np.random.default_rng(0)
+        delays = rng.uniform(0, 0.01, size=40).tolist()
+
+        def fn(i):
+            time.sleep(delays[i])
+            return i * i
+
+        out = list(pipeline.map_ordered(range(40), fn, workers=6, window=4))
+        assert out == [i * i for i in range(40)]
+
+    def test_exception_propagates_with_type(self):
+        def fn(i):
+            if i == 7:
+                raise KeyError("boom-7")
+            return i
+
+        with pytest.raises(KeyError, match="boom-7"):
+            list(pipeline.map_ordered(range(20), fn, workers=3))
+
+    def test_workers_one_runs_inline(self):
+        main = threading.current_thread()
+        seen = []
+
+        def fn(i):
+            seen.append(threading.current_thread())
+            return i
+
+        assert list(pipeline.map_ordered(range(5), fn, workers=1)) == list(range(5))
+        assert all(t is main for t in seen)
+
+    def test_bounded_window_with_slow_consumer(self):
+        """The producer must never run more than window+1 items ahead of the
+        consumer — the peak-memory contract (O(window) raw payloads)."""
+        window = 3
+        produced = []
+
+        def items():
+            for i in range(30):
+                produced.append(i)
+                yield i
+
+        consumed = 0
+        max_ahead = 0
+        for r in pipeline.map_ordered(items(), lambda x: x, workers=4, window=window):
+            consumed += 1
+            time.sleep(0.002)  # slow consumer
+            max_ahead = max(max_ahead, len(produced) - consumed)
+        assert consumed == 30
+        assert max_ahead <= window + 1, max_ahead
+
+    def test_resolvers(self):
+        assert pipeline.resolve_ingest_workers(1) == 1
+        assert pipeline.resolve_ingest_workers(6) == 6
+        auto = pipeline.resolve_ingest_workers(None)
+        assert 1 <= auto <= pipeline.DEFAULT_MAX_WORKERS
+        with pytest.raises(ValueError):
+            pipeline.resolve_ingest_workers(-2)
+        assert pipeline.resolve_window(None, 4) == 8
+        with pytest.raises(ValueError):
+            pipeline.resolve_window(0, 4)
+
+
+@pytest.mark.skipif(not native_avro.available(), reason="native decoder unavailable")
+class TestDecodedBlockLifetime:
+    def _block_payload(self, n=50):
+        buf = io.BytesIO()
+        schema = avro_io.Schema(avro_io.TRAINING_EXAMPLE_SCHEMA)
+        for i in range(n):
+            avro_io.encode(buf, schema.root, {
+                "uid": f"u{i}", "label": float(i),
+                "features": [
+                    {"name": f"n{i % 4}", "term": "" if i % 3 else "t", "value": float(i)},
+                    {"name": "shared", "term": "t0", "value": 1.0},
+                ],
+                "metadataMap": {"userId": f"e{i % 5}"},
+                "weight": 1.0, "offset": 0.0,
+            })
+        ftypes = native_avro.field_types_for_schema(
+            avro_io.TRAINING_EXAMPLE_SCHEMA["fields"]
+        )
+        return buf.getvalue(), ftypes
+
+    def test_concurrent_decode_matches_sequential(self):
+        """Different blocks decoded and read concurrently (the pipeline's
+        thread model) must reproduce the single-thread extraction exactly."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        payload, ftypes = self._block_payload()
+
+        def extract():
+            with native_avro.decode_block(payload, 50, ftypes) as block:
+                labels = block.doubles(1).tolist()
+                vocab, ids = block.dedup_keys(2, native_avro.DEDUP_FEATURE_KEYS)
+                return labels, [vocab[i] for i in ids]
+
+        reference = extract()
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            results = list(pool.map(lambda _: extract(), range(32)))
+        assert all(r == reference for r in results)
+
+    def test_use_after_close_raises(self):
+        payload, ftypes = self._block_payload(n=3)
+        block = native_avro.decode_block(payload, 3, ftypes)
+        assert block.count(1) == 3
+        block.close()
+        block.close()  # idempotent
+        with pytest.raises(RuntimeError, match="closed"):
+            block.count(1)
+        with pytest.raises(RuntimeError, match="closed"):
+            block.doubles(1)
+        with pytest.raises(RuntimeError, match="closed"):
+            block.dedup_keys(2, native_avro.DEDUP_FEATURE_KEYS)
+
+    def test_dedup_keys_matches_python_composition(self):
+        """Native vocab interning must reproduce feature_key()'s name+term
+        composition and the map key/value strings exactly, per entry."""
+        from photon_ml_tpu.data.index_map import feature_key
+
+        payload, ftypes = self._block_payload()
+        with native_avro.decode_block(payload, 50, ftypes) as block:
+            _rows, no, nl, to, tl, _vals = block.features(2)
+            names = block.strings_at(no, nl)
+            terms = block.strings_at(to, tl)
+            expected = [feature_key(n, t) for n, t in zip(names, terms)]
+            vocab, ids = block.dedup_keys(2, native_avro.DEDUP_FEATURE_KEYS)
+            assert [vocab[i] for i in ids] == expected
+            assert len(vocab) == len(set(expected))  # actually deduped
+
+            _r, ko, kl, vo, vl = block.map_entries(3)
+            keys = block.strings_at(ko, kl)
+            vals = block.strings_at(vo, vl)
+            kvocab, kids = block.dedup_keys(3, native_avro.DEDUP_MAP_KEYS)
+            vvocab, vids = block.dedup_keys(3, native_avro.DEDUP_MAP_VALUES)
+            assert [kvocab[i] for i in kids] == keys
+            assert [vvocab[i] for i in vids] == vals
+
+    def test_dedup_unsupported_field_raises(self):
+        payload, ftypes = self._block_payload(n=2)
+        with native_avro.decode_block(payload, 2, ftypes) as block:
+            with pytest.raises(ValueError, match="dedup unsupported"):
+                block.dedup_keys(1, native_avro.DEDUP_FEATURE_KEYS)  # a double col
+
+
+class TestBackgroundOverlap:
+    def test_background_task_result(self):
+        task = pipeline.BackgroundTask(lambda: 41 + 1)
+        assert task.result(timeout=10) == 42
+        assert task.done()
+
+    def test_background_task_reraises(self):
+        def boom():
+            raise RuntimeError("background boom")
+
+        task = pipeline.BackgroundTask(boom)
+        with pytest.raises(RuntimeError, match="background boom"):
+            task.result(timeout=10)
+
+    def test_background_task_timeout(self):
+        gate = threading.Event()
+        task = pipeline.BackgroundTask(gate.wait)
+        with pytest.raises(TimeoutError):
+            task.result(timeout=0.01)
+        gate.set()
+        task.result(timeout=10)
+
+    def test_xla_warmup_idempotent(self):
+        a = pipeline.start_xla_warmup()
+        b = pipeline.start_xla_warmup()
+        assert a is b
+        assert a.result(timeout=300) is True
+
+    def test_estimator_hook_delegates(self):
+        from photon_ml_tpu.estimators.game_estimator import GameEstimator
+
+        assert GameEstimator.warm_up_backend() is pipeline.start_xla_warmup()
+
+
+class TestDownSamplerIdBoundary:
+    """Satellite regression: global sample positions at or beyond 2**32 must
+    keep distinct down-sampling draw keys (the old uint32 cast wrapped)."""
+
+    def test_no_wrap_at_2_32(self):
+        from photon_ml_tpu.sampling.down_sampler import per_sample_uniform
+
+        ids = np.array([0, 5, 2**32, 2**32 + 5, 2**33], dtype=np.int64)
+        draws = np.asarray(per_sample_uniform(11, 0, ids))
+        assert draws.dtype == np.float32
+        assert draws[2] != draws[0], "2**32 wrapped onto position 0"
+        assert draws[3] != draws[1], "2**32+5 wrapped onto position 5"
+        assert len(np.unique(draws)) == len(draws)
+
+    def test_host_device_parity_below_boundary(self):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.sampling.down_sampler import per_sample_uniform
+
+        ids = np.arange(64, dtype=np.int64)
+        host = np.asarray(per_sample_uniform(11, 2, ids))
+        device = np.asarray(
+            per_sample_uniform(11, 2, jnp.arange(64, dtype=jnp.uint32))
+        )
+        np.testing.assert_array_equal(host, device)
+
+    def test_down_sample_still_reproducible(self):
+        from photon_ml_tpu.data.dataset import LabeledData
+        from photon_ml_tpu.sampling.down_sampler import BinaryClassificationDownSampler
+
+        rng = np.random.default_rng(3)
+        n = 200
+        data = LabeledData.build(
+            rng.normal(size=(n, 4)), (rng.random(n) > 0.5).astype(np.float64)
+        )
+        a = BinaryClassificationDownSampler(0.3, seed=9).down_sample(data)
+        b = BinaryClassificationDownSampler(0.3, seed=9).down_sample(data)
+        np.testing.assert_array_equal(np.asarray(a.weights), np.asarray(b.weights))
