@@ -860,6 +860,12 @@ def main():
         # parity + determinism + bounded-RSS gates, time-to-first-update)
         _delegate_benchmark("--ingest", "ingest_bench")
 
+    if "--serving-load" in sys.argv:
+        # closed-loop load through the micro-batching serving frontend
+        # (p50/p99/p999 + peak sustainable QPS; bitwise-parity, zero-retrace,
+        # zero-shed-below-knee, hot-swap-no-drop and rollback gates)
+        _delegate_benchmark("--serving-load", "serving_load_bench")
+
     if "--child" in sys.argv:
         _child_main()
         return

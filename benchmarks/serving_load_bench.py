@@ -1,0 +1,418 @@
+"""Serving-load benchmark: closed-loop traffic through the resilient frontend.
+
+Metric: ``serving_peak_sustainable_qps`` — the highest request rate a
+closed-loop client ladder sustains through the micro-batching frontend
+(photon_ml_tpu/serving/frontend.py) with a ZERO shed rate and deadline-clean
+p99. Per concurrency level the bench reports p50/p99/p999 request latency,
+QPS and shed rate; the knee is wherever shedding or deadline misses begin.
+
+The run is gated, not just measured (docs/PERFORMANCE.md "Serving load"):
+
+- ``parity_bitwise`` — every served response must be BITWISE equal (values
+  and dtype) to a direct ``engine.score`` call on the same request against
+  the generation that served it: micro-batch coalescing must be a pure
+  latency/throughput transform, never a numerics transform.
+- ``retraces_steady_state == 0`` — each measured level runs under
+  ``runtime_guard.sync_discipline`` after bucket warm-up; a retrace means the
+  coalescer leaked a new shape family into steady state.
+- ``shed_rate_below_knee == 0`` — the lowest concurrency level must shed
+  nothing (admission control only engages under genuine pressure).
+- ``hotswap_zero_dropped`` / ``hotswap_parity_bitwise`` — a generational
+  hot-swap (serving/hotswap.py) performed MID-LOAD completes with every
+  in-flight and subsequent request answered, each bitwise-correct for the
+  generation that served it.
+- ``rollback_proven`` — a deliberately corrupted generation is rejected by
+  integrity verification: no swap, a ``hotswap-rollback`` incident, traffic
+  uninterrupted.
+
+Run directly (``python benchmarks/serving_load_bench.py``) or as
+``python bench.py --serving-load``. Prints ONE JSON line; exits nonzero when
+any gate fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import scipy.sparse as sp
+
+D_FIXED = 16
+D_RE = 8  # intercept + 7 features: the flagship RE shard shape
+N_USERS = 200
+N_ITEMS = 50
+
+
+def build_models(rng, n_users: int, n_items: int, scale: float = 1.0) -> dict:
+    """The checkpointable {cid: model} dict for one generation (the serving
+    side consumes PR 3 generational checkpoints, so the bench writes real
+    ones)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+    from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+    from photon_ml_tpu.types import TaskType
+
+    def re_model(re_type, n_entities):
+        proj = np.tile(np.arange(D_RE, dtype=np.int32), (n_entities, 1))
+        return RandomEffectModel(
+            re_type=re_type,
+            feature_shard_id="re_shard",
+            task=TaskType.LOGISTIC_REGRESSION,
+            entity_ids=tuple(range(n_entities)),
+            coeffs=jnp.asarray(rng.normal(size=(n_entities, D_RE)) * 0.3 * scale),
+            proj_indices=jnp.asarray(proj),
+        )
+
+    return {
+        "fixed": FixedEffectModel(
+            model=LogisticRegressionModel(
+                Coefficients(means=jnp.asarray(rng.normal(size=D_FIXED) * 0.3 * scale))
+            ),
+            feature_shard_id="global",
+        ),
+        "per-user": re_model("userId", n_users),
+        "per-item": re_model("itemId", n_items),
+    }
+
+
+def make_request(rng, n: int, n_users: int, n_items: int):
+    """One serving request. The RE shard is dense-backed (no zeros), so every
+    row's nnz equals D_RE and the whole stream shares one nnz-width bucket —
+    the steady-state signature family micro-batching coalesces."""
+    from photon_ml_tpu.data.game_data import GameInput
+
+    fe = rng.normal(size=(n, D_FIXED)).astype(np.float32)
+    re_feat = sp.csr_matrix(
+        np.concatenate(
+            [np.ones((n, 1), dtype=np.float32), fe[:, : D_RE - 1] + 3.0], axis=1
+        )
+    )
+    return GameInput(
+        features={"global": fe, "re_shard": re_feat},
+        offsets=rng.normal(size=n).astype(np.float32),
+        id_columns={
+            "userId": rng.integers(0, n_users, size=n),
+            "itemId": rng.integers(0, n_items, size=n),
+        },
+    )
+
+
+def build_request_pool(rng, pool: int, batch: int, n_users: int, n_items: int):
+    """Pre-generated requests with sizes jittered inside ONE pow2 bucket
+    ((batch/2, batch] all pad to ``batch``), so the timed regions contain only
+    serving work."""
+    return [
+        make_request(rng, int(rng.integers(batch // 2 + 1, batch + 1)), n_users, n_items)
+        for _ in range(pool)
+    ]
+
+
+def warm_buckets(engine, rng, batch: int, max_batch: int, n_users: int, n_items: int):
+    """Compile every bucket the coalescer can form from this stream: pow2
+    sizes from the single-request bucket up through max_batch."""
+    b = engine.bucket(batch)
+    ladder = []
+    while b <= engine.bucket(max_batch):
+        ladder.append(b)
+        b *= 2
+    for size in ladder:
+        engine.score(make_request(rng, size, n_users, n_items))
+    return ladder
+
+
+class ClientStats:
+    """Per-level closed-loop bookkeeping shared by the client threads."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: list[float] = []
+        self.served: list[tuple[int, np.ndarray, int]] = []  # (req idx, out, gen)
+        self.shed = 0
+        self.errors: list[str] = []
+
+
+def run_closed_loop(frontend, requests, clients: int, per_client: int,
+                    deadline_ms, offset: int = 0) -> tuple[ClientStats, float]:
+    """``clients`` threads, each submitting ``per_client`` requests
+    round-robin from the pool and blocking on the result (closed loop).
+    Returns (stats, elapsed_seconds)."""
+    from photon_ml_tpu.serving import DeadlineExceeded, Overloaded
+
+    stats = ClientStats()
+
+    def client(cid: int):
+        for i in range(per_client):
+            idx = (offset + cid * per_client + i) % len(requests)
+            t0 = time.perf_counter()
+            try:
+                fut = frontend.submit(requests[idx], deadline_ms=deadline_ms)
+                out = fut.result(timeout=60.0)
+            except (Overloaded, DeadlineExceeded):
+                with stats.lock:
+                    stats.shed += 1
+                continue
+            except BaseException as e:  # noqa: BLE001 — a dropped request is
+                # a gate failure to report, not a bench crash
+                with stats.lock:
+                    stats.errors.append(f"{type(e).__name__}: {e}"[:200])
+                continue
+            dt = time.perf_counter() - t0
+            with stats.lock:
+                stats.latencies.append(dt)
+                stats.served.append((idx, out, fut.generation))
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return stats, time.perf_counter() - t0
+
+
+def percentiles_ms(latencies) -> dict:
+    lat = np.asarray(latencies) * 1e3
+    return {
+        "p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "p999_ms": round(float(np.percentile(lat, 99.9)), 3),
+    }
+
+
+def check_parity(stats: ClientStats, requests, engines_by_gen: dict) -> bool:
+    """Every served response vs a direct engine call on the SAME request
+    against the generation that served it — bitwise, dtype included."""
+    for idx, out, gen in stats.served:
+        eng = engines_by_gen.get(gen)
+        if eng is None:
+            return False
+        direct = eng.score(requests[idx])
+        if direct.dtype != out.dtype or not np.array_equal(direct, out):
+            return False
+    return True
+
+
+def run(args) -> dict:
+    import jax
+
+    from photon_ml_tpu.analysis.runtime_guard import sync_discipline
+    from photon_ml_tpu.io.checkpoint import save_checkpoint
+    from photon_ml_tpu.resilience import corrupt_file
+    from photon_ml_tpu.serving import FrontendConfig
+    from photon_ml_tpu.serving.hotswap import serve_from_checkpoint
+
+    rng = np.random.default_rng(42)
+    n_users = max(1, int(N_USERS * args.scale))
+    n_items = max(1, int(N_ITEMS * args.scale))
+    batch = max(8, int(args.batch * args.scale))
+    args.max_batch = max(args.max_batch, batch)  # coalescing cap >= one request
+
+    ckpt_root = tempfile.mkdtemp(prefix="serving-load-ckpt-")
+    save_checkpoint(ckpt_root, build_models(rng, n_users, n_items, scale=1.0), 1,
+                    keep_generations=8)
+    config = FrontendConfig(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        max_queue_depth=args.queue_depth,
+        default_deadline_ms=None,
+    )
+    frontend, manager = serve_from_checkpoint(ckpt_root, config=config)
+    engines_by_gen = {frontend.generation: frontend.engine}
+    requests = build_request_pool(rng, args.pool, batch, n_users, n_items)
+
+    # ---- warm-up: compile every bucket this stream can coalesce into -----
+    ladder = warm_buckets(frontend.engine, rng, batch, args.max_batch, n_users, n_items)
+    # prime the frontend's live-shape registry + EWMA (and its own buckets)
+    run_closed_loop(frontend, requests, clients=2, per_client=4,
+                    deadline_ms=args.deadline_ms)
+
+    # ---- steady state: concurrency ladder under the runtime guard --------
+    levels = []
+    c = 1
+    while c <= args.clients_max:
+        levels.append(c)
+        c *= 2
+    level_results = []
+    retraces = 0
+    for clients in levels:
+        with sync_discipline(what=f"serving-load steady state x{clients}") as region:
+            stats, elapsed = run_closed_loop(
+                frontend, requests, clients, args.requests, args.deadline_ms,
+                offset=rng.integers(0, len(requests)),
+            )
+        retraces += region.traces
+        total = len(stats.latencies) + stats.shed + len(stats.errors)
+        rec = {
+            "clients": clients,
+            "qps": round(len(stats.latencies) / elapsed, 2) if elapsed > 0 else None,
+            "samples_per_sec": round(
+                sum(len(out) for _, out, _ in stats.served) / elapsed, 2
+            ),
+            "shed_rate": round(stats.shed / total, 4) if total else 0.0,
+            "errors": len(stats.errors),
+            **percentiles_ms(stats.latencies or [0.0]),
+        }
+        rec["deadline_clean"] = (
+            args.deadline_ms is None or rec["p99_ms"] <= args.deadline_ms
+        )
+        level_results.append((rec, stats))
+
+    parity = all(
+        check_parity(stats, requests, engines_by_gen) for _, stats in level_results
+    )
+    base = level_results[0][0]
+    sustainable = [
+        rec for rec, _ in level_results
+        if rec["shed_rate"] == 0.0 and rec["errors"] == 0 and rec["deadline_clean"]
+    ]
+    peak = max(sustainable, key=lambda r: r["qps"]) if sustainable else None
+
+    # ---- mid-load hot-swap: zero dropped, per-generation parity ----------
+    # (unguarded: the NEW generation's warm-up compiles by design.) Traffic
+    # runs CONTINUOUSLY until the flip has happened plus a tail window, so the
+    # request stream deterministically spans both generations.
+    save_checkpoint(ckpt_root, build_models(rng, n_users, n_items, scale=1.7), 2,
+                    keep_generations=8)
+    swap_stats = ClientStats()
+    swap_clients = min(2, args.clients_max)
+    stop = threading.Event()
+
+    def traffic_loop(cid: int):
+        from photon_ml_tpu.serving import DeadlineExceeded, Overloaded
+
+        i = 0
+        while not stop.is_set():
+            idx = (cid * 7919 + i) % len(requests)
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                fut = frontend.submit(requests[idx], deadline_ms=args.deadline_ms)
+                out = fut.result(timeout=60.0)
+            except (Overloaded, DeadlineExceeded):
+                with swap_stats.lock:
+                    swap_stats.shed += 1
+                continue
+            except BaseException as e:  # noqa: BLE001 — report, don't crash
+                with swap_stats.lock:
+                    swap_stats.errors.append(f"{type(e).__name__}: {e}"[:200])
+                continue
+            dt = time.perf_counter() - t0
+            with swap_stats.lock:
+                swap_stats.latencies.append(dt)
+                swap_stats.served.append((idx, out, fut.generation))
+
+    load = [
+        threading.Thread(target=traffic_loop, args=(c,)) for c in range(swap_clients)
+    ]
+    for t in load:
+        t.start()
+    time.sleep(0.05)  # let traffic reach steady state before the swap
+    swapped = manager.check_once()
+    # tail: at least ~10 more responses under the new generation
+    served_at_flip = len(swap_stats.served)
+    deadline = time.perf_counter() + 30.0
+    while len(swap_stats.served) < served_at_flip + 10 and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    stop.set()
+    for t in load:
+        t.join()
+    engines_by_gen[frontend.generation] = frontend.engine
+    generations_served = sorted({g for _, _, g in swap_stats.served})
+    hotswap_zero_dropped = not swap_stats.errors and swap_stats.shed == 0
+    hotswap_spans_flip = not swapped or len(generations_served) >= 2
+    hotswap_parity = check_parity(swap_stats, requests, engines_by_gen)
+
+    # ---- rollback proof: a corrupt generation must be rejected -----------
+    gen3 = save_checkpoint(
+        ckpt_root, build_models(rng, n_users, n_items, scale=0.5), 3,
+        keep_generations=8,
+    )
+    victim = sorted(f for f in os.listdir(gen3) if f.endswith(".npz"))[0]
+    corrupt_file(os.path.join(gen3, victim))
+    gen_before = frontend.generation
+    rolled_back = not manager.check_once()
+    post_rollback = frontend.score(requests[0])  # traffic survives the rollback
+    rollback_proven = (
+        rolled_back
+        and frontend.generation == gen_before
+        and any(i.kind == "hotswap-rollback" for i in frontend.incidents)
+        and np.array_equal(post_rollback, engines_by_gen[gen_before].score(requests[0]))
+    )
+    frontend.close()
+
+    result = {
+        "metric": "serving_peak_sustainable_qps",
+        "value": peak["qps"] if peak else None,
+        "unit": "requests/sec",
+        "peak_samples_per_sec": peak["samples_per_sec"] if peak else None,
+        "peak_clients": peak["clients"] if peak else None,
+        **{k: base[k] for k in ("p50_ms", "p99_ms", "p999_ms")},
+        "levels": [rec for rec, _ in level_results],
+        "request_bucket": batch,
+        "coalesce_buckets": ladder,
+        "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms,
+        "deadline_ms": args.deadline_ms,
+        "parity_bitwise": bool(parity),
+        "retraces_steady_state": int(retraces),
+        "shed_rate_below_knee": base["shed_rate"],
+        "hotswap_completed": bool(swapped),
+        "hotswap_zero_dropped": bool(hotswap_zero_dropped),
+        "hotswap_parity_bitwise": bool(hotswap_parity),
+        "hotswap_spans_flip": bool(hotswap_spans_flip),
+        "hotswap_generations_served": generations_served,
+        "rollback_proven": bool(rollback_proven),
+        "frontend_stats": frontend.stats(),
+        "platform": jax.default_backend(),
+    }
+    if args.scale != 1.0:
+        result["scale"] = args.scale
+    return result
+
+
+def gates_green(result: dict) -> bool:
+    return bool(
+        result["parity_bitwise"]
+        and result["retraces_steady_state"] == 0
+        and result["shed_rate_below_knee"] == 0.0
+        and result["hotswap_completed"]
+        and result["hotswap_zero_dropped"]
+        and result["hotswap_parity_bitwise"]
+        and result["hotswap_spans_flip"]
+        and result["rollback_proven"]
+        and result["value"] is not None
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=40,
+                   help="closed-loop requests per client per level")
+    p.add_argument("--clients-max", type=int, default=4,
+                   help="concurrency ladder top (1, 2, 4, ... up to this)")
+    p.add_argument("--batch", type=int, default=64,
+                   help="request-size bucket ceiling (sizes jitter in (b/2, b])")
+    p.add_argument("--max-batch", type=int, default=256,
+                   help="frontend coalescing cap (samples per dispatch)")
+    p.add_argument("--max-wait-ms", type=float, default=3.0)
+    p.add_argument("--deadline-ms", type=float, default=2000.0,
+                   help="per-request deadline (generous by default: CI hosts)")
+    p.add_argument("--queue-depth", type=int, default=512)
+    p.add_argument("--pool", type=int, default=24,
+                   help="distinct pre-generated requests cycled by the clients")
+    p.add_argument("--scale", type=float, default=1.0)
+    args = p.parse_args(argv)
+    result = run(args)
+    print(json.dumps(result))
+    return 0 if gates_green(result) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

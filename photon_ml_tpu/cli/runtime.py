@@ -36,6 +36,48 @@ def add_ingest_arguments(parser) -> None:
     )
 
 
+def add_serving_arguments(parser) -> None:
+    """The shared --serving-* knob block (serving driver; any future online
+    endpoint reuses the same contract — docs/ARCHITECTURE.md 'Serving
+    front-end & SLOs')."""
+    parser.add_argument(
+        "--serving-max-batch", type=int, default=4096,
+        help="Micro-batching cap: coalesced samples per engine dispatch "
+             "(align with the engine bucket you want to saturate)",
+    )
+    parser.add_argument(
+        "--serving-max-wait-ms", type=float, default=2.0,
+        help="Longest the oldest queued request waits for coalescing company "
+             "before dispatch (the latency cost of batching)",
+    )
+    parser.add_argument(
+        "--serving-queue-depth", type=int, default=256,
+        help="Bounded request queue; submissions beyond it shed with an "
+             "explicit Overloaded instead of growing a latency tail",
+    )
+    parser.add_argument(
+        "--serving-deadline-ms", type=float, default=None,
+        help="Per-request deadline: requests that cannot meet it are shed "
+             "BEFORE dispatch with an explicit DeadlineExceeded (default: "
+             "no deadline)",
+    )
+    parser.add_argument(
+        "--serving-request-batch", type=int, default=512,
+        help="Replay chunk size: input rows per request submitted through "
+             "the frontend",
+    )
+    parser.add_argument(
+        "--hot-swap-watch", action="store_true",
+        help="Poll the checkpoint root for new generations while serving and "
+             "hot-swap to them with zero downtime (integrity-verified, "
+             "warmed before the flip, automatic rollback)",
+    )
+    parser.add_argument(
+        "--hot-swap-poll-seconds", type=float, default=2.0,
+        help="Generation watcher poll interval for --hot-swap-watch",
+    )
+
+
 def add_distributed_arguments(parser, purpose: str) -> None:
     """The shared --distributed-* flag contract of the training and scoring
     drivers (one definition so the two cannot drift)."""

@@ -1,0 +1,223 @@
+"""GAME serving CLI driver: replay traffic through the resilient frontend.
+
+No Spark analog — the reference never shipped an online scorer (its GAME
+serving story ends at batch score files). This driver stands up the
+micro-batching :class:`~photon_ml_tpu.serving.ServingFrontend` over the
+newest valid generation of a training run's checkpoint directory
+(io/checkpoint.py gen-<n>/ layout) and replays Avro scoring traffic through
+it in request-sized chunks — the operational smoke test for the serving
+path: micro-batching, deadline shedding, and (with ``--hot-swap-watch``)
+zero-downtime generational hot-swap while requests are in flight.
+
+Scores land as ScoringResultAvro part files (same format as the batch
+scoring driver); a JSON stats line (QPS, p50/p99 latency, sheds, swaps,
+serving generation(s)) goes to the log and the returned dict. Shed requests
+(deadline/overload) keep their rows in the output as NaN — sheds are
+explicit, never silently missing rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from photon_ml_tpu.cli.game_scoring_driver import _write_scores
+from photon_ml_tpu.cli.game_training_driver import _load_index_maps
+from photon_ml_tpu.cli.parsers import (
+    add_version_argument,
+    parse_feature_shard_configuration,
+)
+from photon_ml_tpu.data.readers import read_merged_avro
+from photon_ml_tpu.models.game import RandomEffectModel
+from photon_ml_tpu.util import PhotonLogger, Timed
+from photon_ml_tpu.util.date_range import resolve_input_paths
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="game-serving-driver",
+        description="Serve scoring traffic through the micro-batching frontend "
+                    "from a generational checkpoint directory.",
+    )
+    add_version_argument(p)
+    p.add_argument("--checkpoint-directory", required=True,
+                   help="Generational checkpoint root (the training driver's "
+                        "<--checkpoint-directory>/config_<i>): the newest "
+                        "generation that passes SHA-256 verification serves")
+    p.add_argument("--input-data-directories", required=True)
+    p.add_argument("--input-data-date-range", default=None)
+    p.add_argument("--input-data-days-range", default=None)
+    p.add_argument("--root-output-directory", required=True)
+    p.add_argument("--override-output-directory", action="store_true")
+    p.add_argument("--feature-shard-configurations", action="append", required=True)
+    p.add_argument("--index-map-directory", default=None,
+                   help="Saved training index maps (<training-output>/index-maps): "
+                        "serving requests must map features into the SAME global "
+                        "columns the checkpointed coefficients were trained in")
+    p.add_argument("--model-id", default=None)
+    p.add_argument("--compilation-cache-directory", default=None)
+    from photon_ml_tpu.cli.runtime import add_ingest_arguments, add_serving_arguments
+
+    add_ingest_arguments(p)
+    add_serving_arguments(p)
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--application-name", default="game-serving")
+    return p
+
+
+def run(args: argparse.Namespace) -> dict:
+    from photon_ml_tpu.cli.runtime import configure_compilation_cache, prepare_output_root
+    from photon_ml_tpu.serving import FrontendConfig
+    from photon_ml_tpu.serving.hotswap import GenerationWatcher, serve_from_checkpoint
+
+    configure_compilation_cache(args)
+    root = args.root_output_directory
+    prepare_output_root(root, args.override_output_directory, 0, 1)
+    logger = PhotonLogger(os.path.join(root, "logs", "photon.log"), level=args.log_level)
+    frontend = watcher = None
+    try:
+        shard_configs = dict(
+            parse_feature_shard_configuration(a)
+            for a in args.feature_shard_configurations
+        )
+        index_maps = _load_index_maps(args.index_map_directory, shard_configs)
+        missing = sorted(s for s in shard_configs if s not in index_maps)
+        if missing:
+            raise FileNotFoundError(
+                f"No saved index maps for shard(s) {missing}; pass "
+                f"--index-map-directory pointing at the training run's "
+                f"<output>/index-maps"
+            )
+
+        config = FrontendConfig(
+            max_batch=args.serving_max_batch,
+            max_wait_ms=args.serving_max_wait_ms,
+            max_queue_depth=args.serving_queue_depth,
+            default_deadline_ms=args.serving_deadline_ms,
+        )
+        with Timed("load newest generation", logger):
+            frontend, manager = serve_from_checkpoint(
+                args.checkpoint_directory, config=config
+            )
+        logger.info("serving generation %d", frontend.generation)
+        id_tags = sorted(
+            {
+                m.re_type
+                for _, m in frontend.engine.model
+                if isinstance(m, RandomEffectModel)
+            }
+        )
+
+        input_paths = resolve_input_paths(
+            args.input_data_directories,
+            getattr(args, "input_data_date_range", None),
+            getattr(args, "input_data_days_range", None),
+        )
+        with Timed("read data", logger):
+            data, index_maps, uids = read_merged_avro(
+                input_paths, shard_configs, index_maps, id_tags,
+                ingest_workers=getattr(args, "ingest_workers", None),
+            )
+        logger.info("replaying %d samples through the serving frontend", data.n)
+
+        if args.hot_swap_watch:
+            watcher = GenerationWatcher(
+                manager, poll_interval_s=args.hot_swap_poll_seconds
+            )
+
+        scores, stats = _replay(frontend, data, args, logger)
+        with Timed("write scores", logger):
+            _write_scores(
+                os.path.join(root, "scores", "part-00000.avro"),
+                uids, scores, data, args.model_id or "",
+            )
+        stats["output_directory"] = root
+        stats["incidents"] = [i.to_dict() for i in frontend.incidents]
+        logger.info("serving stats: %s", json.dumps(stats))
+        return {"scores": scores, "stats": stats, "output_directory": root}
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        if frontend is not None:
+            frontend.close()
+        logger.close()
+
+
+def _replay(frontend, data, args, logger) -> tuple[np.ndarray, dict]:
+    """Windowed closed-loop replay: chunk the table into request-sized
+    GameInputs, keep a bounded window of futures outstanding (so the replay
+    itself cannot overload the queue it is testing), and reassemble scores in
+    row order. Shed chunks stay NaN."""
+    from photon_ml_tpu.serving import DeadlineExceeded, Overloaded
+
+    n = data.n
+    chunk = max(1, int(args.serving_request_batch))
+    scores = np.full(n, np.nan)
+    window: collections.deque = collections.deque()
+    window_cap = max(4, min(args.serving_queue_depth // 2, 64))
+    served = shed = 0
+    latencies = []
+    generations = set()
+
+    def drain_one():
+        nonlocal served, shed
+        start, stop, fut, t0 = window.popleft()
+        try:
+            out = fut.result(timeout=300.0)
+        except (Overloaded, DeadlineExceeded) as e:
+            shed += 1
+            logger.warning("request rows [%d, %d) shed: %s", start, stop, e)
+            return
+        latencies.append(time.perf_counter() - t0)
+        scores[start:stop] = out
+        generations.add(fut.generation)
+        served += 1
+
+    t_start = time.perf_counter()
+    for start in range(0, n, chunk):
+        stop = min(start + chunk, n)
+        req = data.select(np.arange(start, stop))
+        if len(window) >= window_cap:
+            drain_one()
+        try:
+            # the deadline rides on FrontendConfig.default_deadline_ms (run()
+            # wired --serving-deadline-ms there); one authoritative path
+            fut = frontend.submit(req)
+        except (Overloaded, DeadlineExceeded) as e:
+            shed += 1
+            logger.warning("request rows [%d, %d) shed at admission: %s", start, stop, e)
+            continue
+        window.append((start, stop, fut, time.perf_counter()))
+    while window:
+        drain_one()
+    elapsed = time.perf_counter() - t_start
+
+    lat_ms = np.asarray(latencies or [0.0]) * 1e3
+    stats = {
+        "requests_served": served,
+        "requests_shed": shed,
+        "qps": round(served / elapsed, 2) if elapsed > 0 else None,
+        "samples_per_sec": round(float(np.sum(~np.isnan(scores))) / elapsed, 2)
+        if elapsed > 0
+        else None,
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        "generations_served": sorted(g for g in generations if g is not None),
+        **frontend.stats(),
+    }
+    return scores, stats
+
+
+def main(argv=None) -> int:
+    run(build_arg_parser().parse_args(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,19 +166,27 @@ class BackgroundTask:
     """A one-shot computation on a daemon thread, with fail-at-join semantics.
 
     Used to overlap post-ingest work (XLA warm-up compilation, host->device
-    transfers) with host-side decode: start it, keep ingesting, ``result()``
-    when the value is actually needed. Exceptions are captured and re-raised
-    at ``result()`` — never swallowed, never crashing the spawning thread.
+    transfers) with host-side decode — and by the serving hot-swap
+    (serving/hotswap.py) to pilot-compile a new model generation's engine
+    while the live generation keeps serving. Start it, keep working,
+    ``result()`` when the value is actually needed. Exceptions are captured
+    and re-raised at ``result()`` — never swallowed, never crashing the
+    spawning thread.
+
+    Positional/keyword arguments after ``fn`` are passed through to it
+    (``name`` is reserved for the thread name), so call sites don't need a
+    closure for the common run-this-with-these-args case.
     """
 
-    def __init__(self, fn: Callable[[], Any], name: str = "photon-background"):
+    def __init__(self, fn: Callable[..., Any], *args: Any,
+                 name: str = "photon-background", **kwargs: Any):
         self._value: Any = None
         self._exc: Optional[BaseException] = None
         self._finished = threading.Event()
 
         def _run():
             try:
-                self._value = fn()
+                self._value = fn(*args, **kwargs)
             except BaseException as e:  # re-raised on the joining thread
                 self._exc = e
             finally:

@@ -277,6 +277,35 @@ def _quarantine(path: str) -> None:
         logger.warning("could not quarantine %s; ignoring it", path, exc_info=True)
 
 
+# -------------------------------------------------- read-side generation API
+# The serving hot-swap (serving/hotswap.py) is a READ-ONLY consumer of a
+# training run's checkpoint directory: it polls for new generations and loads
+# one specific generation after integrity verification. Unlike
+# ``load_checkpoint`` it must never mutate the directory — quarantine and
+# rollback are the training owner's recovery moves; a serving replica that
+# renamed gen dirs would race the trainer (and every other replica).
+
+
+def list_generations(directory: str) -> list[tuple[int, str]]:
+    """Committed generations under a checkpoint root as ``[(number, path)]``
+    ascending. Staging (``*.tmp``), quarantined (``*.corrupt``) and legacy
+    entries are ignored; a missing root is an empty list, not an error."""
+    return _generations(os.path.abspath(directory))
+
+
+def load_generation(gen_dir: str, dtype=jnp.float32) -> dict:
+    """Verify + load ONE specific generation directory (as returned by
+    :func:`list_generations`): full SHA-256 integrity pass, then
+    {completed_iterations, models, best_models, best_metric, best_metrics,
+    incidents, generation, fingerprint}.
+
+    Raises :class:`CheckpointCorruption` on any defect and touches nothing on
+    disk — the caller decides whether to fall back to an older generation
+    (the serving hot-swap rolls back to the generation it is already
+    serving)."""
+    return _verify_and_load_generation(os.path.abspath(gen_dir), dtype)
+
+
 # ------------------------------------------------------------------ save / load
 
 

@@ -29,8 +29,15 @@ class Retry:
     """``delay(i) = min(max_delay, base_delay * 2**i) * (1 + jitter * u_i)``
     with ``u_i`` uniform in [0, 1). ``max_attempts`` counts the first try.
 
-    ``sleep`` and ``seed`` are injectable so tests run under a fake clock with
-    a fully deterministic schedule."""
+    ``max_elapsed`` is a TOTAL-deadline budget in seconds across the whole
+    call — attempts plus backoff sleeps. Attempt counts alone cannot bound
+    wall-clock (a slow filesystem can burn minutes inside max_attempts=3);
+    operations living under an SLO window (the serving hot-swap) give both:
+    the policy stops retrying as soon as the budget cannot fit the next sleep,
+    and never starts an attempt past the deadline.
+
+    ``sleep``, ``clock`` and ``seed`` are injectable so tests run under a fake
+    clock with a fully deterministic schedule."""
 
     max_attempts: int = 3
     base_delay: float = 0.05
@@ -39,10 +46,14 @@ class Retry:
     retry_on: tuple = (OSError,)
     sleep: Callable[[float], None] = time.sleep
     seed: Optional[int] = None
+    max_elapsed: Optional[float] = None
+    clock: Callable[[], float] = time.monotonic
 
     def __post_init__(self):
         if self.max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.max_elapsed is not None and self.max_elapsed <= 0:
+            raise ValueError(f"max_elapsed must be > 0, got {self.max_elapsed}")
 
     def delays(self) -> list[float]:
         """The full backoff schedule (max_attempts - 1 sleeps), deterministic
@@ -60,6 +71,7 @@ class Retry:
         BaseExceptions like an injected crash) propagates immediately."""
         schedule = self.delays()
         what = description or getattr(fn, "__name__", "operation")
+        start = self.clock()
         last: Optional[BaseException] = None
         for attempt in range(self.max_attempts):
             try:
@@ -69,6 +81,15 @@ class Retry:
                 if attempt == self.max_attempts - 1:
                     break
                 delay = schedule[attempt]
+                if self.max_elapsed is not None:
+                    elapsed = self.clock() - start
+                    if elapsed + delay > self.max_elapsed:
+                        raise RetryExhausted(
+                            f"{what} failed after {attempt + 1} attempt(s); "
+                            f"deadline budget exhausted ({elapsed:.3f}s elapsed "
+                            f"+ {delay:.3f}s backoff > max_elapsed="
+                            f"{self.max_elapsed:.3f}s): {last}"
+                        ) from last
                 logger.warning(
                     "%s failed (attempt %d/%d): %s — retrying in %.3fs",
                     what, attempt + 1, self.max_attempts, e, delay,

@@ -1,13 +1,35 @@
 from photon_ml_tpu.serving.engine import (
     GameServingEngine,
     clear_engine_cache,
+    evict_engine,
     get_engine,
     model_fingerprint,
 )
+from photon_ml_tpu.serving.frontend import (
+    DeadlineExceeded,
+    FrontendConfig,
+    Overloaded,
+    ServingFrontend,
+    ServingFuture,
+)
+from photon_ml_tpu.serving.hotswap import (
+    GenerationWatcher,
+    HotSwapManager,
+    serve_from_checkpoint,
+)
 
 __all__ = [
+    "DeadlineExceeded",
+    "FrontendConfig",
     "GameServingEngine",
+    "GenerationWatcher",
+    "HotSwapManager",
+    "Overloaded",
+    "ServingFrontend",
+    "ServingFuture",
     "clear_engine_cache",
+    "evict_engine",
     "get_engine",
     "model_fingerprint",
+    "serve_from_checkpoint",
 ]

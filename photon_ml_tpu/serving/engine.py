@@ -73,6 +73,22 @@ Array = jnp.ndarray
 # samples; production deployments pass a larger floor via get_engine.
 MIN_BATCH_PAD = 8
 
+# Smallest padded per-row nnz width (see _per_sample_view).
+MIN_WIDTH_PAD = 4
+
+
+def width_bucket(max_row_nnz: int) -> int:
+    """The engine's nnz-width bucket for a request whose widest row has
+    ``max_row_nnz`` entries: next power of two >= MIN_WIDTH_PAD. THE width
+    authority — the serving frontend keys micro-batch coalescing on this same
+    function, and the two agreeing is what makes a coalesced request's padded
+    row width identical to its solo width (the bitwise-parity contract)."""
+    w = max(int(max_row_nnz), 1)
+    p = MIN_WIDTH_PAD
+    while p < w:
+        p *= 2
+    return p
+
 
 # --------------------------------------------------------------------------
 # model fingerprint: the cross-process-stable part of the compile-cache key
@@ -265,6 +281,7 @@ class GameServingEngine:
         model: GameModel,
         mesh: Optional[object] = None,
         min_batch_pad: int = MIN_BATCH_PAD,
+        fingerprint: Optional[str] = None,
     ):
         if mesh is not None and len(mesh.axis_names) != 1:
             raise ValueError(
@@ -274,7 +291,16 @@ class GameServingEngine:
         self.model = model
         self.mesh = mesh
         self.min_batch_pad = int(min_batch_pad)
+        self._fingerprint = fingerprint
         self._trace_count = 0
+        self._trace_lock = threading.Lock()
+        # once-per-bucket compile discipline: concurrent FIRST hits on one
+        # (shape, statics) bucket serialize on a per-bucket lock so exactly one
+        # caller traces while the rest wait for the cache hit; steady-state
+        # calls (bucket already compiled) never touch a lock
+        self._compile_lock = threading.Lock()
+        self._compiled: set = set()
+        self._bucket_locks: dict = {}
         put = self._place_table
         self._coords: list[Union[_FixedCoord, _RandomCoord]] = []
         for cid, m in model:
@@ -303,6 +329,76 @@ class GameServingEngine:
         """Number of program traces so far — steady-state serving must hold
         this constant (the scoring bench's zero-retrace gate)."""
         return self._trace_count
+
+    @property
+    def coalesce_safe(self) -> bool:
+        """Whether same-signature requests may be micro-batched into one
+        dispatch with bitwise parity vs solo calls. False when any
+        random-effect coordinate carries a projector: the engine pads to the
+        PROJECTED matrix's width bucket, which the frontend cannot key on
+        without projecting at admission — so such engines dispatch one request
+        per batch (serving/frontend._dispatch_batch)."""
+        return not any(
+            isinstance(st, _RandomCoord) and st.projector is not None
+            for st in self._coords
+        )
+
+    @property
+    def fingerprint(self) -> str:
+        """Content fingerprint of the served model (``model_fingerprint``).
+        ``get_engine`` hands it in (it keyed the cache lookup); a directly
+        constructed engine computes it lazily — the tables are still
+        host-reachable, hashing is a one-time cost."""
+        if self._fingerprint is None:
+            self._fingerprint = model_fingerprint(self.model)
+        return self._fingerprint
+
+    # -- compile-once-per-bucket dispatch ----------------------------------
+
+    @staticmethod
+    def _batch_signature(batch) -> tuple:
+        """Everything jax.jit shape-keys on for a prepared batch: entry names,
+        shapes and dtypes (the statics join in ``_dispatch``'s key)."""
+        parts = []
+        for name in sorted(batch):
+            v = batch[name]
+            if isinstance(v, dict):
+                parts.append(
+                    (name,)
+                    + tuple((k, tuple(v[k].shape), str(v[k].dtype)) for k in sorted(v))
+                )
+            else:
+                parts.append((name, tuple(v.shape), str(v.dtype)))
+        return tuple(parts)
+
+    def _dispatch(self, batch, *, per_coordinate, include_offsets, apply_link):
+        """Run the jitted program with once-per-bucket compile serialization.
+
+        jax.jit's cache makes steady-state calls lock-free here (set membership
+        under the GIL); an uncompiled bucket takes a per-bucket lock so
+        concurrent first requests on the SAME bucket trace once instead of
+        duplicating trace work (and tripping ``trace_count`` gates), while
+        first requests on DIFFERENT buckets still compile in parallel."""
+        key = (
+            per_coordinate,
+            include_offsets,
+            apply_link,
+            self._batch_signature(batch),
+        )
+        statics = dict(
+            per_coordinate=per_coordinate,
+            include_offsets=include_offsets,
+            apply_link=apply_link,
+        )
+        if key in self._compiled:
+            return self._jitted(batch, **statics)
+        with self._compile_lock:
+            lock = self._bucket_locks.setdefault(key, threading.Lock())
+        with lock:
+            out = self._jitted(batch, **statics)
+            with self._compile_lock:
+                self._compiled.add(key)
+        return out
 
     def bucket(self, n: int) -> int:
         """Padded batch size for a request of ``n`` samples: next power of two
@@ -350,11 +446,7 @@ class GameServingEngine:
         exact-width one (narrow widths can shift XLA's lowering by one ulp —
         tests/test_serving.py pins the parity surface)."""
         counts = np.diff(X.indptr)
-        W = max(int(counts.max()) if n else 1, 1)
-        w_pad = 4
-        while w_pad < W:
-            w_pad *= 2
-        W = w_pad
+        W = width_bucket(int(counts.max()) if n else 1)
         cols = np.full((n_pad, W), -1, dtype=np.int32)
         vals = np.zeros((n_pad, W), dtype=np.float64)
         rows_per_nnz = slot_per_nnz = None
@@ -405,7 +497,8 @@ class GameServingEngine:
     # -- the fused program -------------------------------------------------
 
     def _fused(self, batch, per_coordinate: bool, include_offsets: bool, apply_link: bool):
-        self._trace_count += 1  # Python side effect: runs at trace time only
+        with self._trace_lock:  # trace-time-only side effect; distinct buckets
+            self._trace_count += 1  # may first-hit concurrently on two threads
         scores = []
         for st in self._coords:
             b = batch["coord:" + st.cid]
@@ -468,7 +561,7 @@ class GameServingEngine:
             and jnp.asarray(offsets[:0]).dtype == offsets.dtype
         )
         batch, n = self._prepare(data)
-        out = self._jitted(
+        out = self._dispatch(
             batch,
             per_coordinate=False,
             include_offsets=fuse_offsets,
@@ -494,7 +587,7 @@ class GameServingEngine:
             and jnp.asarray(offsets[:0]).dtype == offsets.dtype
         ):
             batch, n = self._prepare(data)
-            out = self._jitted(
+            out = self._dispatch(
                 batch, per_coordinate=False, include_offsets=True, apply_link=True
             )
             return jax.device_get(out)[:n]  # explicit boundary transfer, as in score
@@ -516,7 +609,7 @@ class GameServingEngine:
         if not self._coords:
             return {}
         batch, n = self._prepare(data)
-        out = self._jitted(
+        out = self._dispatch(
             batch, per_coordinate=True, include_offsets=False, apply_link=False
         )
         parts = jax.device_get(out)
@@ -547,7 +640,9 @@ def get_engine(
         if eng is not None:
             _engines.move_to_end(key)
             return eng
-    eng = GameServingEngine(model, mesh=mesh, min_batch_pad=min_batch_pad)
+    eng = GameServingEngine(
+        model, mesh=mesh, min_batch_pad=min_batch_pad, fingerprint=key[0]
+    )
     with _engines_lock:
         existing = _engines.get(key)
         if existing is not None:  # lost a race: keep the first one
@@ -559,8 +654,28 @@ def get_engine(
     return eng
 
 
+def evict_engine(fingerprint: str) -> int:
+    """Drop every cached engine serving the given model fingerprint (all
+    meshes / batch-pad configurations). The serving hot-swap calls this after
+    flipping to a new generation so the superseded generation's device tables
+    are released as soon as the last live request drops its reference.
+
+    Safe against in-flight scoring by construction: eviction only removes the
+    cache's dict ENTRY — the engine object itself (its device tables and
+    compiled programs) is never mutated, so a request that already holds the
+    engine finishes normally and the engine is garbage-collected afterwards.
+    Returns the number of entries dropped."""
+    with _engines_lock:
+        victims = [k for k in _engines if k[0] == fingerprint]
+        for k in victims:
+            del _engines[k]
+    return len(victims)
+
+
 def clear_engine_cache() -> None:
-    """Drop cached engines (tests / model-reload cycles)."""
+    """Drop cached engines (tests / model-reload cycles). Same swap-the-entry
+    discipline as ``evict_engine``: in-flight requests holding an engine are
+    unaffected."""
     with _engines_lock:
         _engines.clear()
 

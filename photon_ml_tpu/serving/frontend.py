@@ -1,0 +1,629 @@
+"""Resilient serving front-end: deadline-aware micro-batching + overload shedding.
+
+PR 1's fused engine makes ONE request fast; this module makes a *stream* of
+concurrent requests fast and safe — the Clipper recipe (PAPERS.md, NSDI'17)
+over the engine's existing pow2 batch buckets:
+
+- **Dynamic micro-batching.** Concurrent ``score``/``predict`` submissions
+  coalesce into one engine dispatch under a max-wait / max-batch knob: the
+  dispatcher waits up to ``max_wait_ms`` from the oldest queued request for
+  more work, or dispatches immediately once ``max_batch`` samples are queued.
+  Only requests with the same *shape signature* (feature shards, dtypes,
+  sparse nnz-width bucket, offsets dtype, request kind) coalesce — the engine's
+  per-row computations are row-independent within a signature, so a coalesced
+  request's scores are BITWISE what a direct engine call would return
+  (the serving-load bench gates on exactly this).
+- **Bounded queue + deadline-aware admission control.** The queue holds at
+  most ``max_queue_depth`` requests; past that, ``submit`` sheds with an
+  explicit :class:`Overloaded` instead of building an unbounded latency tail.
+  Requests carry a deadline; one that has already expired — or that the
+  per-bucket dispatch-latency EWMA says cannot be met — is shed *before*
+  dispatch with :class:`DeadlineExceeded`. Every shed is recorded as a
+  :class:`resilience.Incident` (graceful degradation stays visible).
+- **Explicit failure, never a wrong score.** A dispatch failure (including an
+  injected crash at the ``serve.dispatch`` fault point) fails that batch's
+  futures with the original error and records an incident; no request ever
+  observes another request's bytes or a partially-written result.
+- **Zero-downtime generational hot-swap** lives in :mod:`serving.hotswap`;
+  the frontend's contribution is the atomic engine pointer
+  (:meth:`ServingFrontend.install_engine`) — in-flight batches keep the engine
+  they captured at dispatch, new batches see the new generation — and the
+  live-shape registry (:meth:`warm_requests`) the swap uses to pilot-compile
+  the incoming engine per live bucket before the flip.
+
+Fault points ``serve.enqueue`` and ``serve.dispatch`` are registered here so
+the chaos harness can sweep the serving path (tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.resilience import Incident, faultpoint, register_fault_point
+from photon_ml_tpu.serving.engine import width_bucket
+
+FP_ENQUEUE = register_fault_point("serve.enqueue")
+FP_DISPATCH = register_fault_point("serve.dispatch")
+
+
+class Overloaded(RuntimeError):
+    """Request shed at admission: the queue is at its configured depth (or the
+    frontend is closed). An explicit fast failure the client can retry against
+    a replica — the alternative is the unbounded-queue latency tail."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request shed because its deadline has passed or cannot be met by the
+    time a dispatch would complete. Shed *before* dispatch: no device work is
+    wasted on an answer nobody is still waiting for."""
+
+
+@dataclasses.dataclass
+class FrontendConfig:
+    """The latency/throughput/robustness knobs.
+
+    ``max_wait_ms`` bounds how long the oldest queued request waits for
+    coalescing company (the latency cost of batching); ``max_batch`` bounds
+    coalesced samples per dispatch (the throughput knob — align it with the
+    engine bucket you want to saturate). ``max_queue_depth`` bounds queued
+    REQUESTS; beyond it submissions shed with :class:`Overloaded`.
+    ``default_deadline_ms`` applies to submissions that don't carry their own
+    deadline (None = no deadline). ``ewma_alpha`` smooths the per-bucket
+    dispatch-latency estimate driving deadline admission."""
+
+    max_batch: int = 4096
+    max_wait_ms: float = 2.0
+    max_queue_depth: int = 256
+    default_deadline_ms: Optional[float] = None
+    ewma_alpha: float = 0.3
+    incident_log_size: int = 256
+
+
+class ServingFuture:
+    """Completion handle for one submitted request. ``result()`` returns the
+    [n] scores or raises the request's explicit failure
+    (:class:`Overloaded` / :class:`DeadlineExceeded` / the dispatch error).
+    ``generation`` is the model generation that served it (set on success)."""
+
+    __slots__ = ("_event", "_value", "_exc", "generation")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Optional[np.ndarray] = None
+        self._exc: Optional[BaseException] = None
+        self.generation: Optional[int] = None
+
+    def _set(self, value: np.ndarray, generation: Optional[int]) -> None:
+        self._value = value
+        self.generation = generation
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request still pending")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+@dataclasses.dataclass
+class _Request:
+    data: GameInput
+    kind: str  # "score" | "predict"
+    include_offsets: bool
+    signature: tuple
+    n: int
+    deadline: Optional[float]  # absolute, on the frontend clock
+    enqueued_at: float
+    future: ServingFuture
+
+
+@dataclasses.dataclass
+class _LiveShape:
+    """Warm-up recipe for one observed request signature: enough structure to
+    synthesize a same-shaped request (entity ids never reach the device, so
+    placeholder ids compile the same programs)."""
+
+    kind: str
+    include_offsets: bool
+    offsets_dtype: str
+    shards: tuple  # ((name, ("dense", n_cols, dtype) | ("sparse", n_cols, W, dtype)), ...)
+    id_tags: tuple
+    buckets: set = dataclasses.field(default_factory=set)
+
+
+def _shard_entry(m) -> tuple:
+    if sp.issparse(m):
+        X = m.tocsr()
+        counts = np.diff(X.indptr)
+        # width_bucket is the ENGINE's padding function (engine.py): sharing
+        # it is what keeps the coalescing key in lockstep with what the
+        # engine actually compiles
+        w = width_bucket(int(counts.max()) if X.shape[0] else 1)
+        return ("sparse", int(X.shape[1]), w, str(X.dtype))
+    arr = np.asarray(m)
+    return ("dense", int(arr.shape[1]), str(arr.dtype))
+
+
+def request_signature(data: GameInput, kind: str, include_offsets: bool) -> tuple:
+    """The coalescing key: requests sharing it produce bitwise-identical
+    per-row results whether dispatched solo or coalesced. Batch size is NOT
+    part of the key (per-row reductions run over the feature/width axis only);
+    the sparse nnz-width bucket IS (padding a row family to a wider bucket can
+    shift XLA's lowering by an ulp — serving/engine._per_sample_view)."""
+    return (
+        kind,
+        bool(include_offsets),
+        str(np.asarray(data.offsets).dtype),
+        tuple(sorted((name, _shard_entry(m)) for name, m in data.features.items())),
+        tuple(sorted((t, np.asarray(c).dtype.kind) for t, c in data.id_columns.items())),
+    )
+
+
+def _coalesce(datas: list[GameInput]) -> GameInput:
+    """Concatenate same-signature requests into one GameInput. CSR blocks
+    stack without canonicalization (entry order per row is preserved — the
+    engine's parity surface depends on it)."""
+    if len(datas) == 1:
+        return datas[0]
+    feats = {}
+    for name, first in datas[0].features.items():
+        mats = [d.features[name] for d in datas]
+        if sp.issparse(first):
+            feats[name] = sp.vstack([m.tocsr() for m in mats], format="csr")
+        else:
+            feats[name] = np.concatenate([np.asarray(m) for m in mats], axis=0)
+    return GameInput(
+        features=feats,
+        offsets=np.concatenate([np.asarray(d.offsets) for d in datas]),
+        id_columns={
+            t: np.concatenate([np.asarray(d.id_columns[t]) for d in datas])
+            for t in datas[0].id_columns
+        },
+    )
+
+
+class ServingFrontend:
+    """Thread-safe micro-batching front-end over a ``GameServingEngine``.
+
+    One daemon dispatcher thread owns all engine dispatch; client threads
+    ``submit`` and block on futures (or use the synchronous ``score`` /
+    ``predict`` wrappers). Construct, serve, ``close()`` (or use as a context
+    manager). The engine pointer is generational: ``install_engine`` flips it
+    atomically (serving/hotswap.py drives this), in-flight batches finish on
+    the engine they captured.
+    """
+
+    def __init__(
+        self,
+        engine,
+        config: Optional[FrontendConfig] = None,
+        generation: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.config = config or FrontendConfig()
+        if self.config.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.config.max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        self._clock = clock
+        self._engine_ref = (engine, int(generation))  # tuple swap = atomic read
+        self._cv = threading.Condition(threading.Lock())
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._closed = False
+        # own lock (not _cv): the hot-swap thread records rollbacks without
+        # touching queue state, and the snapshot reader iterates — appends
+        # on a maxlen deque also pop, so "append is atomic" is not enough
+        self._incident_lock = threading.Lock()
+        self._incidents: collections.deque = collections.deque(
+            maxlen=self.config.incident_log_size
+        )
+        self._latency_ewma: dict[tuple, float] = {}
+        self._live_shapes: dict[tuple, _LiveShape] = {}
+        self._counters = collections.Counter()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="photon-serving-dispatch", daemon=True
+        )
+        self._dispatcher.start()
+        # a daemon thread still inside XLA's C++ at interpreter teardown
+        # aborts the whole process (same failure mode start_xla_warmup drains
+        # against): bound a close at exit for frontends nobody closed.
+        # close() unregisters, so well-behaved callers don't accumulate hooks.
+        self._atexit = lambda: self.close(drain=False, timeout=10.0)
+        atexit.register(self._atexit)
+
+    # -- engine pointer ----------------------------------------------------
+
+    @property
+    def engine(self):
+        return self._engine_ref[0]
+
+    @property
+    def generation(self) -> int:
+        return self._engine_ref[1]
+
+    def install_engine(self, engine, generation: int) -> None:
+        """Atomically flip the serving pointer to a new engine generation.
+        Batches already dispatched keep the engine they captured; every batch
+        formed after this call sees the new one — zero downtime, no lock held
+        across device work."""
+        with self._cv:
+            self._engine_ref = (engine, int(generation))
+            self._counters["swaps"] += 1
+
+    # -- submission --------------------------------------------------------
+
+    def submit(
+        self,
+        data: GameInput,
+        deadline_ms: Optional[float] = None,
+        include_offsets: bool = True,
+        kind: str = "score",
+    ) -> ServingFuture:
+        """Enqueue one request; returns a :class:`ServingFuture`.
+
+        Admission control runs here: a full queue sheds with
+        :class:`Overloaded`, an already-expired deadline with
+        :class:`DeadlineExceeded` — both raised synchronously (the request is
+        never queued) and recorded as incidents."""
+        if kind not in ("score", "predict"):
+            raise ValueError(f"unknown request kind {kind!r}")
+        faultpoint(FP_ENQUEUE)
+        now = self._clock()
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        deadline = None if deadline_ms is None else now + deadline_ms / 1e3
+        sig = request_signature(data, kind, include_offsets)
+        req = _Request(
+            data=data,
+            kind=kind,
+            include_offsets=bool(include_offsets),
+            signature=sig,
+            n=int(data.n),
+            deadline=deadline,
+            enqueued_at=now,
+            future=ServingFuture(),
+        )
+        with self._cv:
+            if self._closed:
+                self._counters["shed_overload"] += 1
+                self._record(
+                    "overload", "submit after close", "shed request before enqueue"
+                )
+                raise Overloaded("serving frontend is closed")
+            if len(self._queue) >= self.config.max_queue_depth:
+                self._counters["shed_overload"] += 1
+                self._record(
+                    "overload",
+                    f"queue at max_queue_depth={self.config.max_queue_depth}",
+                    "shed request before enqueue",
+                )
+                raise Overloaded(
+                    f"serving queue full ({self.config.max_queue_depth} requests)"
+                )
+            if deadline is not None and now >= deadline:
+                self._counters["shed_deadline"] += 1
+                self._record(
+                    "deadline-shed", "deadline expired at admission", "shed at enqueue"
+                )
+                raise DeadlineExceeded("deadline expired before enqueue")
+            shape = self._live_shapes.get(sig)
+            if shape is None:
+                self._live_shapes[sig] = shape = _LiveShape(
+                    kind=kind,
+                    include_offsets=bool(include_offsets),
+                    offsets_dtype=str(np.asarray(data.offsets).dtype),
+                    shards=sig[3],
+                    id_tags=tuple(t for t, _ in sig[4]),
+                )
+            self._queue.append(req)
+            self._counters["submitted"] += 1
+            self._cv.notify_all()
+        return req.future
+
+    def score(
+        self,
+        data: GameInput,
+        deadline_ms: Optional[float] = None,
+        include_offsets: bool = True,
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        return self.submit(
+            data, deadline_ms=deadline_ms, include_offsets=include_offsets
+        ).result(timeout)
+
+    def predict(
+        self,
+        data: GameInput,
+        deadline_ms: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        return self.submit(data, deadline_ms=deadline_ms, kind="predict").result(timeout)
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def incidents(self) -> list:
+        """Snapshot of the (bounded) incident log, oldest first."""
+        with self._incident_lock:
+            return list(self._incidents)
+
+    def stats(self) -> dict:
+        with self._cv:
+            out = dict(self._counters)
+            out["queue_depth"] = len(self._queue)
+            out["generation"] = self._engine_ref[1]
+            out["live_signatures"] = len(self._live_shapes)
+        return out
+
+    def record_incident(
+        self, kind: str, cause: str, action: str, detail: Optional[str] = None
+    ) -> None:
+        """Append to the frontend's incident log (the hot-swap manager records
+        its rollbacks here so one log tells the whole serving story)."""
+        self._record(kind, cause, action, detail)
+
+    def _record(self, kind: str, cause: str, action: str, detail: Optional[str] = None):
+        # always under _incident_lock (nested inside _cv for queue-path
+        # callers; the swap thread takes it alone) so the snapshot reader
+        # never iterates a deque mid-mutation
+        with self._incident_lock:
+            self._incidents.append(
+                Incident(kind=kind, cause=cause, action=action, detail=detail)
+            )
+
+    # -- warm-up support for the hot-swap ----------------------------------
+
+    def warm_requests(self) -> list[tuple[str, bool, GameInput]]:
+        """Synthetic (kind, include_offsets, request) per live (signature,
+        bucket): scoring each through a freshly built engine compiles exactly
+        the program family live traffic needs, so a hot-swap flip never makes
+        a real request pay a compile (serving/hotswap.py)."""
+        with self._cv:
+            shapes = [
+                (dataclasses.replace(s, buckets=set(s.buckets)))
+                for s in self._live_shapes.values()
+            ]
+        out = []
+        for shape in shapes:
+            for bucket in sorted(shape.buckets):
+                out.append(
+                    (shape.kind, shape.include_offsets, self._synthesize(shape, bucket))
+                )
+        return out
+
+    @staticmethod
+    def _synthesize(shape: _LiveShape, n: int) -> GameInput:
+        feats = {}
+        for name, entry in shape.shards:
+            if entry[0] == "dense":
+                _, n_cols, dt = entry
+                feats[name] = np.zeros((n, n_cols), dtype=dt)
+            else:
+                _, n_cols, width, dt = entry
+                # row 0 carries m nnz with pow2pad(m) == the live width bucket
+                # (m > width/2 whenever width > 4: a live row achieved it, and
+                # that row had at most n_cols entries)
+                m = min(n_cols, width)
+                indices = np.arange(m, dtype=np.int32)
+                data = np.ones(m, dtype=dt)
+                indptr = np.zeros(n + 1, dtype=np.int32)
+                indptr[1:] = m
+                feats[name] = sp.csr_matrix(
+                    (data, indices, indptr), shape=(n, n_cols), dtype=dt
+                )
+        return GameInput(
+            features=feats,
+            offsets=np.zeros(n, dtype=shape.offsets_dtype),
+            id_columns={t: np.zeros(n, dtype=np.int64) for t in shape.id_tags},
+        )
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue and self._closed:
+                    return
+                batch = self._collect_batch_locked()
+            if batch:
+                self._dispatch_batch(batch)
+
+    def _collect_batch_locked(self) -> list[_Request]:
+        """Form one same-signature batch: wait (bounded by the oldest queued
+        request's max-wait window) for up to ``max_batch`` samples, then take
+        matching requests in FIFO order. Non-matching requests stay queued and
+        head the next batch. The wait is DEADLINE-AWARE: when waiting out the
+        max-wait window would jeopardize the tightest queued deadline (minus
+        the EWMA dispatch estimate when known), the batch dispatches
+        IMMEDIATELY — riding the deadline edge just converts scheduler jitter
+        into sheds, and otherwise a request with deadline < max_wait would
+        idle into its own deadline and shed at zero load."""
+        head = self._queue[0]
+        wait_barrier = head.enqueued_at + self.config.max_wait_ms / 1e3
+        while not self._closed:
+            same = [r for r in self._queue if r.signature == head.signature]
+            n_same = sum(r.n for r in same)
+            if n_same >= self.config.max_batch:
+                break
+            deadlines = [r.deadline for r in same if r.deadline is not None]
+            if deadlines:
+                est = (
+                    self._estimate_latency(
+                        head.signature, self._engine_ref[0].bucket(n_same)
+                    )
+                    or 0.0
+                )
+                if min(deadlines) - est <= wait_barrier:
+                    break  # coalescing further risks the tightest deadline
+            now = self._clock()
+            if now >= wait_barrier:
+                break
+            self._cv.wait(timeout=max(wait_barrier - now, 1e-4))
+            if not self._queue:  # a racing close() may have drained us
+                return []
+        taken: list[_Request] = []
+        rest: collections.deque[_Request] = collections.deque()
+        total = 0
+        for r in self._queue:
+            if r.signature == head.signature and (
+                not taken or total + r.n <= self.config.max_batch
+            ):
+                taken.append(r)
+                total += r.n
+            else:
+                rest.append(r)
+        self._queue = rest
+        return taken
+
+    def _estimate_latency(self, signature: tuple, bucket: int) -> Optional[float]:
+        return self._latency_ewma.get((signature, bucket))
+
+    def _shed_deadline(self, r: _Request, cause: str) -> None:
+        with self._cv:
+            self._counters["shed_deadline"] += 1
+            self._record("deadline-shed", cause, "shed before dispatch")
+        r.future._fail(DeadlineExceeded("deadline unmeetable; shed before dispatch"))
+
+    def _dispatch_batch(self, batch: list[_Request]) -> None:
+        engine, generation = self._engine_ref
+        now = self._clock()
+        # pass 1: already-expired requests shed with no estimate needed
+        alive = []
+        for r in batch:
+            if r.deadline is not None and now >= r.deadline:
+                self._shed_deadline(r, "deadline expired before dispatch")
+            else:
+                alive.append(r)
+        if not alive:
+            return
+        if not getattr(engine, "coalesce_safe", True):
+            # projector engines pad to the PROJECTED width bucket, which the
+            # coalescing signature cannot see without projecting at admission:
+            # dispatch one request per batch so parity stays trivially
+            # bitwise — and estimate per-request against the SOLO bucket,
+            # the same key each solo dispatch's EWMA write uses
+            for r in alive:
+                est = self._estimate_latency(r.signature, engine.bucket(r.n))
+                if r.deadline is not None and est is not None and now + est > r.deadline:
+                    self._shed_deadline(
+                        r,
+                        f"deadline unmeetable at dispatch "
+                        f"(estimated {est * 1e3:.2f} ms)",
+                    )
+                else:
+                    self._execute([r], engine, generation)
+            return
+        # pass 2: estimate against the bucket the SURVIVORS actually dispatch
+        # in — the same key the post-dispatch EWMA write uses
+        bucket = engine.bucket(sum(r.n for r in alive))
+        est = self._estimate_latency(alive[0].signature, bucket)
+        live: list[_Request] = []
+        for r in alive:
+            if r.deadline is not None and est is not None and now + est > r.deadline:
+                self._shed_deadline(
+                    r, f"deadline unmeetable at dispatch (estimated {est * 1e3:.2f} ms)"
+                )
+            else:
+                live.append(r)
+        if not live:
+            return
+        self._execute(live, engine, generation)
+
+    def _execute(self, live: list[_Request], engine, generation: int) -> None:
+        try:
+            faultpoint(FP_DISPATCH)
+            data = _coalesce([r.data for r in live])
+            t0 = self._clock()
+            if live[0].kind == "predict":
+                out = engine.predict(data)
+            else:
+                out = engine.score(data, include_offsets=live[0].include_offsets)
+            dt = self._clock() - t0
+        except BaseException as e:  # noqa: BLE001 — a dying dispatcher thread
+            # must fail its batch EXPLICITLY, never hang the waiting clients
+            # (this is the thread's top-level supervisor, the analog of the
+            # chaos harness catching InjectedCrash at the top of a process)
+            with self._cv:
+                self._counters["dispatch_failures"] += 1
+                self._record(
+                    "dispatch-failure",
+                    f"{type(e).__name__}: {e}",
+                    f"failed {len(live)} request(s) explicitly",
+                )
+            for r in live:
+                r.future._fail(e)
+            return
+        total = sum(r.n for r in live)
+        bucket = engine.bucket(total)
+        with self._cv:
+            key = (live[0].signature, bucket)
+            prev = self._latency_ewma.get(key)
+            alpha = self.config.ewma_alpha
+            self._latency_ewma[key] = (
+                dt if prev is None else (1 - alpha) * prev + alpha * dt
+            )
+            shape = self._live_shapes.get(live[0].signature)
+            if shape is not None:
+                shape.buckets.add(bucket)
+            self._counters["batches"] += 1
+            self._counters["served"] += len(live)
+            self._counters["served_samples"] += total
+        start = 0
+        for r in live:
+            r.future._set(out[start : start + r.n], generation)
+            start += r.n
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting work and shut the dispatcher down. ``drain=True``
+        (default) serves everything already queued first; ``drain=False``
+        fails queued requests with :class:`Overloaded` immediately."""
+        with self._cv:
+            if self._closed:
+                pending = ()
+            else:
+                self._closed = True
+                pending = tuple(self._queue) if not drain else ()
+                if not drain:
+                    self._queue.clear()
+                    if pending:  # sheds stay visible, even the shutdown ones
+                        self._counters["shed_overload"] += len(pending)
+                        self._record(
+                            "overload",
+                            f"frontend closed with {len(pending)} queued request(s)",
+                            "failed queued requests explicitly",
+                        )
+                self._cv.notify_all()
+        for r in pending:
+            r.future._fail(Overloaded("serving frontend closed"))
+        self._dispatcher.join(timeout)
+        try:
+            atexit.unregister(self._atexit)
+        except Exception:  # interpreter already tearing down
+            pass
+
+    def __enter__(self) -> "ServingFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,293 @@
+"""Zero-downtime generational hot-swap for the serving front-end.
+
+PR 3's training runtime commits every checkpoint as an immutable, SHA-256
+integrity-checked ``gen-<n>/`` directory (io/checkpoint.py). That layout is
+exactly what a live model update needs: the serving side POLLS the checkpoint
+root for a newer generation, verifies it, loads + pilot-compiles a fresh
+engine *while the current generation keeps serving*, and only then flips the
+frontend's atomic engine pointer. The swap pipeline:
+
+1. **verify** (``serve.swap.verify``): :func:`io.checkpoint.load_generation`
+   runs the full checksum pass and loads the model arrays. Read-only — a
+   serving replica never quarantines or renames inside the trainer's
+   directory (that is the trainer's recovery move; replicas would race it and
+   each other).
+2. **warm-up** (``serve.swap.warmup``): the new engine compiles one program
+   per live (signature, bucket) the frontend has observed
+   (:meth:`ServingFrontend.warm_requests`), on a
+   :class:`~photon_ml_tpu.data.pipeline.BackgroundTask` — compile latency
+   hides behind live traffic instead of stalling it, and a warm-up crash
+   surfaces at ``result()`` without touching the serving path.
+3. **flip** (``serve.swap.flip``): :meth:`ServingFrontend.install_engine`
+   swaps the pointer; in-flight batches finish on the old engine. The
+   superseded engine is then evicted from the module engine cache
+   (:func:`serving.engine.evict_engine`) so device coefficient tables don't
+   leak across generations — eviction drops the cache ENTRY only, so a
+   request still holding the old engine completes untouched.
+
+Any failure — integrity, load, warm-up, even an injected crash — **rolls
+back automatically**: the frontend never stops serving the generation it
+had, the failed generation is blacklisted (no retry storm against the same
+bad bytes), and a ``hotswap-rollback`` incident lands in the frontend's log.
+Transient I/O errors inside one attempt are retried under a
+:class:`resilience.Retry` with a total ``max_elapsed`` budget, so a flaky
+filesystem cannot stretch a swap past its SLO window.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.pipeline import BackgroundTask
+from photon_ml_tpu.io.checkpoint import (
+    CheckpointCorruption,
+    list_generations,
+    load_generation,
+)
+from photon_ml_tpu.models.game import GameModel
+from photon_ml_tpu.resilience import (
+    Retry,
+    RetryExhausted,
+    faultpoint,
+    register_fault_point,
+)
+from photon_ml_tpu.serving.engine import evict_engine, get_engine
+from photon_ml_tpu.serving.frontend import ServingFrontend
+
+logger = logging.getLogger(__name__)
+
+FP_SWAP_VERIFY = register_fault_point("serve.swap.verify")
+FP_SWAP_WARMUP = register_fault_point("serve.swap.warmup")
+FP_SWAP_FLIP = register_fault_point("serve.swap.flip")
+
+# swap I/O is retried with a TOTAL deadline: a live-update pipeline would
+# rather roll back inside its SLO window than eventually succeed after it
+_DEFAULT_RETRY = Retry(max_attempts=3, base_delay=0.05, max_delay=1.0, max_elapsed=30.0)
+
+
+def newest_valid_generation(root: str, dtype=jnp.float32) -> Optional[tuple[int, dict]]:
+    """Read-side bootstrap: (generation number, verified state) for the newest
+    generation that passes integrity, scanning backwards and SKIPPING (never
+    quarantining) damaged ones. None when nothing verifies."""
+    for gen_num, gen_dir in reversed(list_generations(root)):
+        try:
+            return gen_num, load_generation(gen_dir, dtype=dtype)
+        except CheckpointCorruption as e:
+            logger.warning(
+                "generation %d failed verification (%s); trying older", gen_num, e
+            )
+    return None
+
+
+def model_from_state(state: dict, prefer_best: bool = True) -> GameModel:
+    """The servable GameModel inside a verified checkpoint state: the
+    best-model snapshot when one was tracked (what export would ship),
+    else the current models."""
+    models = state.get("best_models") if prefer_best else None
+    return GameModel(models=models or state["models"])
+
+
+class HotSwapManager:
+    """Drives generational hot-swaps for one :class:`ServingFrontend`.
+
+    ``check_once`` is the whole state machine: poll → verify → warm → flip,
+    with automatic rollback. Call it from your own control loop, or run a
+    :class:`GenerationWatcher` thread. ``bad_generations`` remembers every
+    generation that failed DETERMINISTICALLY (corruption, warm-up crash) so a
+    corrupt commit is skipped forever instead of re-attempted each poll (a
+    LATER good generation is still picked up); transient-I/O retry exhaustion
+    rolls back without blacklisting — the generation stays eligible for the
+    next poll.
+    """
+
+    def __init__(
+        self,
+        frontend: ServingFrontend,
+        checkpoint_root: str,
+        dtype=jnp.float32,
+        prefer_best: bool = True,
+        retry: Optional[Retry] = None,
+        warmup_timeout: float = 300.0,
+    ):
+        self.frontend = frontend
+        self.checkpoint_root = checkpoint_root
+        self.dtype = dtype
+        self.prefer_best = prefer_best
+        self.retry = retry or _DEFAULT_RETRY
+        self.warmup_timeout = warmup_timeout
+        self.bad_generations: set[int] = set()
+        self.swaps_completed = 0
+        self.rollbacks = 0
+        self._swap_lock = threading.Lock()  # one swap in flight at a time
+
+    def check_once(self) -> bool:
+        """Poll the checkpoint root; swap to the newest eligible generation.
+        Returns True when a swap completed. NEVER raises on a bad generation:
+        the frontend keeps serving what it has, the failure is an incident and
+        a blacklist entry. (KeyboardInterrupt/SystemExit still propagate.)"""
+        with self._swap_lock:
+            current = self.frontend.generation
+            candidates = [
+                (g, p)
+                for g, p in list_generations(self.checkpoint_root)
+                if g > current and g not in self.bad_generations
+            ]
+            if not candidates:
+                return False
+            gen_num, gen_dir = candidates[-1]
+            try:
+                self.retry.call(
+                    self._swap_to,
+                    gen_num,
+                    gen_dir,
+                    description=f"hot-swap to generation {gen_num}",
+                )
+                return True
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — rollback is the
+                # CONTRACT here: integrity failure, warm-up crash (including
+                # an injected one surfacing from the BackgroundTask join) and
+                # retry exhaustion all degrade to "keep serving gen-N", which
+                # must be recorded, not raised into the serving control loop
+                self.rollbacks += 1
+                # blacklist only DETERMINISTIC failures (corrupt bytes, a
+                # warm-up crash): those reproduce on every attempt, so
+                # re-polling them is a retry storm. Transient-I/O exhaustion
+                # (RetryExhausted; raw OSError/TimeoutError too, for retry
+                # policies that don't cover them) is the environment's fault,
+                # not the generation's — leave it eligible so a later poll
+                # picks it up once the I/O recovers (it may be the LAST
+                # generation a finished training run will ever commit).
+                transient = isinstance(e, (RetryExhausted, OSError))
+                if not transient:
+                    self.bad_generations.add(gen_num)
+                self.frontend.record_incident(
+                    kind="hotswap-rollback",
+                    cause=f"{type(e).__name__}: {e}",
+                    action=f"kept serving generation {current}; "
+                    + (
+                        f"will retry generation {gen_num} on a later poll"
+                        if transient
+                        else f"blacklisted generation {gen_num}"
+                    ),
+                )
+                logger.warning(
+                    "hot-swap to generation %d failed (%s); still serving "
+                    "generation %d", gen_num, e, current,
+                )
+                return False
+
+    def _swap_to(self, gen_num: int, gen_dir: str) -> None:
+        faultpoint(FP_SWAP_VERIFY)
+        state = load_generation(gen_dir, dtype=self.dtype)
+        model = model_from_state(state, prefer_best=self.prefer_best)
+        old = self.frontend.engine
+        engine = get_engine(model, mesh=old.mesh, min_batch_pad=old.min_batch_pad)
+        try:
+            if engine is not old:
+                # pilot compile per live bucket on a background thread: gen-N
+                # keeps serving while XLA works; result() re-raises any
+                # warm-up failure
+                task = BackgroundTask(
+                    self._warm, engine, name=f"photon-swap-warmup-gen{gen_num}"
+                )
+                task.result(self.warmup_timeout)
+            faultpoint(FP_SWAP_FLIP)
+        except BaseException:
+            # the swap will not complete: drop the candidate engine from the
+            # cache too, or every failed generation would pin a full set of
+            # device tables for the life of the process (rollback must not
+            # leak). A retried attempt simply rebuilds it.
+            if engine is not old and engine.fingerprint != old.fingerprint:
+                evict_engine(engine.fingerprint)
+            raise
+        old_fingerprint = old.fingerprint
+        self.frontend.install_engine(engine, gen_num)
+        if engine is not old and engine.fingerprint != old_fingerprint:
+            evicted = evict_engine(old_fingerprint)
+            logger.info(
+                "hot-swapped to generation %d (evicted %d superseded engine "
+                "cache entr%s)", gen_num, evicted, "y" if evicted == 1 else "ies",
+            )
+        self.swaps_completed += 1
+
+    def _warm(self, engine) -> int:
+        faultpoint(FP_SWAP_WARMUP)
+        warmed = 0
+        for kind, include_offsets, req in self.frontend.warm_requests():
+            if kind == "predict":
+                engine.predict(req)
+            else:
+                engine.score(req, include_offsets=include_offsets)
+            warmed += 1
+        return warmed
+
+
+class GenerationWatcher:
+    """Daemon poll loop around a :class:`HotSwapManager`: check the checkpoint
+    root every ``poll_interval_s`` until stopped. ``stop()`` (or the context
+    manager exit) joins the thread; a final pending poll is harmless because
+    ``check_once`` never raises and swaps are serialized by the manager."""
+
+    def __init__(
+        self,
+        manager: HotSwapManager,
+        poll_interval_s: float = 2.0,
+        sleep_wait: Optional[Callable[[float], bool]] = None,
+    ):
+        self.manager = manager
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._wait = sleep_wait or self._stop.wait
+        self._thread = threading.Thread(
+            target=self._loop, name="photon-serving-hotswap-watch", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.manager.check_once()
+            if self._wait(self.poll_interval_s):
+                return
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "GenerationWatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def serve_from_checkpoint(
+    checkpoint_root: str,
+    config=None,
+    dtype=jnp.float32,
+    prefer_best: bool = True,
+    retry: Optional[Retry] = None,
+    clock: Callable[[], float] = time.monotonic,
+) -> tuple[ServingFrontend, HotSwapManager]:
+    """Bootstrap a frontend from the newest valid generation of a training
+    run's checkpoint directory. Returns (frontend, manager); run the manager's
+    ``check_once`` (or a :class:`GenerationWatcher`) to pick up later
+    generations."""
+    found = newest_valid_generation(checkpoint_root, dtype=dtype)
+    if found is None:
+        raise FileNotFoundError(
+            f"no valid checkpoint generation under {checkpoint_root!r}"
+        )
+    gen_num, state = found
+    engine = get_engine(model_from_state(state, prefer_best=prefer_best))
+    frontend = ServingFrontend(engine, config=config, generation=gen_num, clock=clock)
+    manager = HotSwapManager(
+        frontend, checkpoint_root, dtype=dtype, prefer_best=prefer_best, retry=retry
+    )
+    return frontend, manager

@@ -21,6 +21,8 @@ import pytest
 import photon_ml_tpu.algorithm.coordinate_descent  # noqa: F401
 import photon_ml_tpu.io.checkpoint  # noqa: F401
 import photon_ml_tpu.parallel.distributed  # noqa: F401
+import photon_ml_tpu.serving.frontend  # noqa: F401 — registers serve.enqueue/dispatch
+import photon_ml_tpu.serving.hotswap  # noqa: F401 — registers serve.swap.*
 from photon_ml_tpu.cli import game_training_driver
 from photon_ml_tpu.resilience import (
     assert_trees_identical,
@@ -31,6 +33,13 @@ from photon_ml_tpu.resilience import (
 from tests.test_cli_drivers import write_glmix_avro
 
 pytestmark = pytest.mark.chaos
+
+# the serving path has its own sweep below (a frontend has no restart-and-
+# compare semantics); the training-driver sweep covers everything else
+SERVE_POINTS = tuple(p for p in registered_fault_points() if p.startswith("serve."))
+TRAINING_POINTS = tuple(
+    p for p in registered_fault_points() if not p.startswith("serve.")
+)
 
 FE_COORD = (
     "name=global,feature.shard=shardA,optimizer=LBFGS,"
@@ -90,7 +99,7 @@ def test_export_is_deterministic(chaos_data, reference_export, tmp_path):
     assert_trees_identical(str(reference_export), str(tmp_path / "run" / "best"))
 
 
-@pytest.mark.parametrize("point", registered_fault_points())
+@pytest.mark.parametrize("point", TRAINING_POINTS)
 def test_crash_restart_matches_uninterrupted_run(
     chaos_data, reference_export, tmp_path, point
 ):
@@ -128,3 +137,78 @@ def test_mid_run_crash_resumes_from_checkpoint(
     ckpt = tmp_path / "ckpt" / "config_0"
     assert any(n.startswith("gen-") for n in os.listdir(ckpt))
     assert_trees_identical(str(reference_export), str(tmp_path / "run" / "best"))
+
+
+# --------------------------------------------------------------------------
+# serving-path sweep: crash at every serve.* fault point. The acceptance bar
+# differs from training (there is no restart-and-compare for a frontend): the
+# frontend must either serve bytes BITWISE-correct for the generation that
+# served them, or fail the request / roll the swap back EXPLICITLY (client
+# exception and/or incident) — never a wrong score, never a hang.
+# --------------------------------------------------------------------------
+
+
+def _serving_under_test(tmp_path, rng):
+    from photon_ml_tpu.io.checkpoint import save_checkpoint
+    from photon_ml_tpu.serving import FrontendConfig
+    from photon_ml_tpu.serving.hotswap import serve_from_checkpoint
+
+    from tests.test_hotswap import build_models, make_req
+
+    root = str(tmp_path / "ckpt")
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    frontend, manager = serve_from_checkpoint(
+        root, config=FrontendConfig(max_wait_ms=0.0)
+    )
+    requests = [make_req(rng) for _ in range(4)]
+    return root, frontend, manager, requests
+
+
+@pytest.mark.parametrize("point", SERVE_POINTS)
+def test_serving_crash_is_explicit_never_a_wrong_score(tmp_path, rng, point):
+    from photon_ml_tpu.io.checkpoint import save_checkpoint
+    from photon_ml_tpu.resilience import InjectedCrash, armed
+
+    from tests.test_hotswap import build_models
+
+    root, frontend, manager, requests = _serving_under_test(tmp_path, rng)
+    engines = {frontend.generation: frontend.engine}
+    served = []
+    explicit_failures = 0
+    try:
+        with armed(f"{point}:crash:1") as plan:
+            for req in requests:
+                try:
+                    fut = frontend.submit(req)
+                    served.append((req, fut.result(30), fut.generation))
+                except InjectedCrash:
+                    explicit_failures += 1  # explicit to the CLIENT
+            # drive a swap through the armed window too (serve.swap.* points
+            # only fire here); check_once rolls back rather than raising
+            save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+            manager.check_once()
+            engines[frontend.generation] = frontend.engine
+            for req in requests:
+                fut = frontend.submit(req)
+                served.append((req, fut.result(30), fut.generation))
+        fired = bool(plan.fired)
+        assert fired, f"{point} was never reached by the serving scenario"
+        # explicitness: a fired crash shows up to the client or in the log
+        rollbacks = [i for i in frontend.incidents if i.kind == "hotswap-rollback"]
+        dispatch_failures = [
+            i for i in frontend.incidents if i.kind == "dispatch-failure"
+        ]
+        assert explicit_failures or rollbacks or dispatch_failures
+        # and NEVER a wrong score: everything that was served is bitwise what
+        # a direct engine call for its generation returns
+        for req, out, gen in served:
+            direct = engines[gen].score(req)
+            assert out.dtype == direct.dtype
+            np.testing.assert_array_equal(out, direct)
+        # the frontend is still alive and correct after the chaos
+        probe = requests[0]
+        np.testing.assert_array_equal(
+            frontend.score(probe, timeout=30), frontend.engine.score(probe)
+        )
+    finally:
+        frontend.close()

@@ -22,8 +22,11 @@ from photon_ml_tpu.estimators import (
     RandomEffectDataConfiguration,
 )
 from photon_ml_tpu.io.checkpoint import (
+    CheckpointCorruption,
     CoordinateDescentCheckpointer,
+    list_generations,
     load_checkpoint,
+    load_generation,
     save_checkpoint,
 )
 from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
@@ -226,6 +229,44 @@ class TestGenerations:
         )
         # a second restore no longer sees the quarantined generation
         assert load_checkpoint(path)["incidents"] == []
+
+    # -- read-side generation API (the serving hot-swap's view) ------------
+
+    def test_list_generations_skips_staging_quarantine_legacy(self, rng, tmp_path):
+        path = str(tmp_path / "c")
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 1)
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 2)
+        os.makedirs(os.path.join(path, "gen-00000003.tmp"))
+        os.makedirs(os.path.join(path, "gen-00000004.corrupt"))
+        with open(os.path.join(path, "state.json"), "w") as f:
+            f.write("{}")  # legacy layout marker
+        gens = list_generations(path)
+        assert [g for g, _ in gens] == [1, 2]
+        assert all(os.path.isdir(p) for _, p in gens)
+        assert list_generations(str(tmp_path / "missing")) == []
+
+    def test_load_generation_verifies_without_mutating(self, rng, tmp_path):
+        from photon_ml_tpu.resilience import corrupt_file
+
+        path = str(tmp_path / "c")
+        model = {"fixed": _fixed_model(rng)}
+        save_checkpoint(path, model, 1)
+        save_checkpoint(path, {"fixed": _fixed_model(rng)}, 2)
+        gens = list_generations(path)
+        state = load_generation(gens[0][1])
+        assert state["generation"] == 1 and state["completed_iterations"] == 1
+        np.testing.assert_allclose(
+            np.asarray(state["models"]["fixed"].model.coefficients.means),
+            np.asarray(model["fixed"].model.coefficients.means),
+        )
+        # a damaged generation raises — and stays EXACTLY where it was:
+        # the read side never quarantines inside the trainer's directory
+        corrupt_file(os.path.join(gens[1][1], "fixed.npz"))
+        with pytest.raises(CheckpointCorruption, match="checksum mismatch"):
+            load_generation(gens[1][1])
+        assert os.path.isdir(gens[1][1])
+        assert not os.path.exists(gens[1][1] + ".corrupt")
+        assert [g for g, _ in list_generations(path)] == [1, 2]
 
     def test_all_generations_corrupt_returns_none(self, rng, tmp_path):
         from photon_ml_tpu.resilience import corrupt_file

@@ -216,6 +216,8 @@ class TestTrainScorePipeline:
             "--evaluators", "AUC",
             "--data-validation", "VALIDATE_FULL",
             "--output-mode", "ALL",
+            # generational checkpoints: the serving-driver test consumes them
+            "--checkpoint-directory", str(fixture_dir / "ckpt"),
         ])
         assert rc == 0
         return out
@@ -248,6 +250,71 @@ class TestTrainScorePipeline:
         pos, neg = scores[labels == 1], scores[labels == 0]
         auc = (pos[:, None] > neg[None, :]).mean()
         assert auc > 0.7
+
+    def test_serving_driver_replays_through_frontend(self, fixture_dir, trained):
+        """End-to-end serving replay: newest checkpoint generation served
+        through the micro-batching frontend, scores BITWISE equal to direct
+        per-request scoring of that generation's model, no sheds, scores avro
+        written."""
+        from photon_ml_tpu.cli import serving_driver
+        from photon_ml_tpu.data.readers import read_merged_avro
+        from photon_ml_tpu.io.checkpoint import list_generations, load_generation
+        from photon_ml_tpu.serving import clear_engine_cache
+        from photon_ml_tpu.serving.hotswap import model_from_state
+        from photon_ml_tpu.transformers import GameTransformer
+
+        clear_engine_cache()
+        ckpt_root = str(fixture_dir / "ckpt" / "config_0")
+        out = fixture_dir / "serving-out"
+        chunk = 64
+        result = serving_driver.run(serving_driver.build_arg_parser().parse_args([
+            "--checkpoint-directory", ckpt_root,
+            "--input-data-directories", str(fixture_dir / "validate.avro"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--index-map-directory", str(trained / "index-maps"),
+            "--serving-request-batch", str(chunk),
+            "--serving-max-wait-ms", "1.0",
+        ]))
+        stats = result["stats"]
+        assert stats["requests_shed"] == 0
+        assert stats["requests_served"] == -(-300 // chunk)
+        scores = result["scores"]
+        assert scores.shape == (300,) and not np.isnan(scores).any()
+
+        # reference: chunk-wise direct scoring of the served generation
+        gens = list_generations(ckpt_root)
+        assert stats["generations_served"] == [gens[-1][0]]
+        model = model_from_state(load_generation(gens[-1][1]))
+        from photon_ml_tpu.cli.game_training_driver import _load_index_maps
+
+        shard_cfg = dict([parse_feature_shard_configuration(
+            "name=shardA,feature.bags=features")])
+        index_maps = _load_index_maps(str(trained / "index-maps"), shard_cfg)
+        data, _, _ = read_merged_avro(
+            [str(fixture_dir / "validate.avro")], shard_cfg, index_maps, ["userId"]
+        )
+        transformer = GameTransformer(model=model)
+        expected = np.concatenate([
+            transformer.score(data.select(np.arange(s, min(s + chunk, data.n))))
+            for s in range(0, data.n, chunk)
+        ])
+        assert scores.dtype == expected.dtype
+        np.testing.assert_array_equal(scores, expected)
+        # scores avro landed in the batch-scoring format
+        recs = list(avro_io.read_container_dir(str(out / "scores")))
+        assert len(recs) == 300
+
+    def test_serving_driver_requires_index_maps(self, fixture_dir, trained, tmp_path):
+        from photon_ml_tpu.cli import serving_driver
+
+        with pytest.raises(FileNotFoundError, match="index maps"):
+            serving_driver.run(serving_driver.build_arg_parser().parse_args([
+                "--checkpoint-directory", str(fixture_dir / "ckpt" / "config_0"),
+                "--input-data-directories", str(fixture_dir / "validate.avro"),
+                "--root-output-directory", str(tmp_path / "serving-out"),
+                "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            ]))
 
     def test_warm_start_retrain(self, fixture_dir, trained):
         out = fixture_dir / "warm-out"

@@ -1,0 +1,422 @@
+"""Zero-downtime generational hot-swap (photon_ml_tpu/serving/hotswap.py):
+bootstrap from the newest valid generation, swap-on-new-generation with
+per-generation bitwise parity, automatic rollback on integrity failure and
+warm-up crash, transient-fault retries, blacklisting, engine-cache eviction,
+and the background watcher."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.io.checkpoint import save_checkpoint
+from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+from photon_ml_tpu.resilience import Retry, armed, corrupt_file
+from photon_ml_tpu.serving import FrontendConfig, clear_engine_cache, get_engine
+from photon_ml_tpu.serving.hotswap import (
+    GenerationWatcher,
+    HotSwapManager,
+    model_from_state,
+    newest_valid_generation,
+    serve_from_checkpoint,
+)
+from photon_ml_tpu.types import TaskType
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine_cache():
+    clear_engine_cache()
+    yield
+    clear_engine_cache()
+
+
+N_USERS, D, D_RE = 6, 5, 4
+
+
+def build_models(rng, scale=1.0):
+    proj = np.tile(np.arange(D_RE, dtype=np.int32), (N_USERS, 1))
+    return {
+        "fixed": FixedEffectModel(
+            model=LogisticRegressionModel(
+                Coefficients(means=jnp.asarray(rng.normal(size=D) * scale))
+            ),
+            feature_shard_id="global",
+        ),
+        "per-user": RandomEffectModel(
+            re_type="userId",
+            feature_shard_id="re_shard",
+            task=TaskType.LOGISTIC_REGRESSION,
+            entity_ids=tuple(range(N_USERS)),
+            coeffs=jnp.asarray(rng.normal(size=(N_USERS, D_RE)) * scale),
+            proj_indices=jnp.asarray(proj),
+        ),
+    }
+
+
+def make_req(rng, n=11):
+    return GameInput(
+        features={
+            "global": rng.normal(size=(n, D)),
+            "re_shard": sp.csr_matrix(rng.normal(size=(n, D_RE)) + 10.0),
+        },
+        offsets=rng.normal(size=n),
+        id_columns={"userId": rng.integers(0, N_USERS, size=n)},
+    )
+
+
+def corrupt_generation(gen_dir):
+    victim = sorted(f for f in os.listdir(gen_dir) if f.endswith(".npz"))[0]
+    corrupt_file(os.path.join(gen_dir, victim))
+
+
+FAST_RETRY = Retry(max_attempts=3, base_delay=0.0, sleep=lambda s: None, seed=0)
+
+
+def serve(tmp_path, rng, **kwargs):
+    root = str(tmp_path / "ckpt")
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    fe, mgr = serve_from_checkpoint(
+        root, config=FrontendConfig(max_wait_ms=0.0),
+        retry=kwargs.pop("retry", FAST_RETRY), **kwargs,
+    )
+    return root, fe, mgr
+
+
+# ------------------------------------------------------------- bootstrap
+
+
+def test_serve_from_checkpoint_newest_generation(tmp_path, rng):
+    root = str(tmp_path / "ckpt")
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+    fe, _ = serve_from_checkpoint(root)
+    try:
+        assert fe.generation == 2
+    finally:
+        fe.close()
+
+
+def test_bootstrap_skips_corrupt_newest_without_quarantine(tmp_path, rng):
+    root = str(tmp_path / "ckpt")
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    gen2 = save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+    corrupt_generation(gen2)
+    found = newest_valid_generation(root)
+    assert found is not None and found[0] == 1
+    # READ-ONLY: the damaged generation was skipped, not renamed/quarantined
+    assert os.path.isdir(gen2)
+    fe, _ = serve_from_checkpoint(root)
+    try:
+        assert fe.generation == 1
+    finally:
+        fe.close()
+
+
+def test_serve_from_empty_root_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no valid checkpoint generation"):
+        serve_from_checkpoint(str(tmp_path / "nothing"))
+
+
+# ------------------------------------------------------------------ swaps
+
+
+def test_swap_serves_new_generation_bitwise(tmp_path, rng):
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        req = make_req(rng)
+        out1 = fe.score(req, timeout=30)
+        eng1 = fe.engine
+        np.testing.assert_array_equal(out1, eng1.score(req))
+
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        assert mgr.check_once() is True
+        assert fe.generation == 2 and mgr.swaps_completed == 1
+        eng2 = fe.engine
+        assert eng2 is not eng1
+        out2 = fe.score(req, timeout=30)
+        assert out2.dtype == eng2.score(req).dtype
+        np.testing.assert_array_equal(out2, eng2.score(req))
+        assert not np.array_equal(out2, out1)  # genuinely a different model
+        # nothing new to pick up -> no-op
+        assert mgr.check_once() is False
+    finally:
+        fe.close()
+
+
+def test_swap_evicts_superseded_engine_from_cache(tmp_path, rng):
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        eng1 = fe.engine
+        model1 = eng1.model
+        assert get_engine(model1) is eng1  # cached
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        assert mgr.check_once()
+        # the superseded fingerprint was dropped: a fresh lookup rebuilds
+        assert get_engine(model1) is not eng1
+        # ... and the evicted engine still scores for anyone still holding it
+        req = make_req(rng)
+        np.testing.assert_array_equal(eng1.score(req), get_engine(model1).score(req))
+    finally:
+        fe.close()
+
+
+def test_swap_warms_live_buckets_before_flip(tmp_path, rng):
+    """After serving traffic, a swap must not make the next same-shaped
+    request pay a compile: the new engine's programs exist at flip time."""
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        req = make_req(rng, 13)
+        fe.score(req, timeout=30)
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        assert mgr.check_once()
+        eng2 = fe.engine
+        warmed = eng2.trace_count
+        assert warmed >= 1  # the pilot compiled the live bucket
+        fe.score(make_req(rng, 13), timeout=30)
+        assert eng2.trace_count == warmed  # no retrace on live traffic
+    finally:
+        fe.close()
+
+
+def test_identical_generation_flips_without_rebuild(tmp_path, rng):
+    """A new generation with byte-identical models maps to the SAME cached
+    engine: the flip happens (generation number advances), nothing recompiles
+    and nothing is evicted."""
+    rng2 = np.random.default_rng(0)
+    root = str(tmp_path / "ckpt")
+    models = build_models(rng2, 1.0)
+    save_checkpoint(root, models, 1, keep_generations=8)
+    fe, mgr = serve_from_checkpoint(root, config=FrontendConfig(max_wait_ms=0.0))
+    try:
+        eng1 = fe.engine
+        save_checkpoint(root, models, 2, keep_generations=8)
+        assert mgr.check_once()
+        assert fe.generation == 2
+        assert fe.engine is eng1
+    finally:
+        fe.close()
+
+
+# ------------------------------------------------------------- rollbacks
+
+
+def test_corrupt_generation_rolls_back_and_blacklists(tmp_path, rng):
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        req = make_req(rng)
+        before = fe.score(req, timeout=30)
+        gen2 = save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        corrupt_generation(gen2)
+        assert mgr.check_once() is False
+        assert fe.generation == 1 and mgr.rollbacks == 1
+        assert mgr.bad_generations == {2}
+        incidents = [i for i in fe.incidents if i.kind == "hotswap-rollback"]
+        assert incidents and "generation 2" in incidents[0].action
+        # serving never blinked
+        np.testing.assert_array_equal(fe.score(req, timeout=30), before)
+        # the bad generation is not re-attempted, but a LATER good one is
+        assert mgr.check_once() is False
+        save_checkpoint(root, build_models(rng, 3.0), 3, keep_generations=8)
+        assert mgr.check_once() is True
+        assert fe.generation == 3
+    finally:
+        fe.close()
+
+
+def test_warmup_crash_rolls_back(tmp_path, rng):
+    """An injected crash during the background warm-up surfaces at the
+    BackgroundTask join and degrades to a rollback — the frontend never stops
+    serving its current generation."""
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        req = make_req(rng)
+        before = fe.score(req, timeout=30)
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        with armed("serve.swap.warmup:crash:1"):
+            assert mgr.check_once() is False
+        assert fe.generation == 1
+        assert any(
+            i.kind == "hotswap-rollback" and "InjectedCrash" in i.cause
+            for i in fe.incidents
+        )
+        np.testing.assert_array_equal(fe.score(req, timeout=30), before)
+    finally:
+        fe.close()
+
+
+def test_failed_swap_does_not_leak_candidate_engine(tmp_path, rng):
+    """A rollback must also evict the CANDIDATE engine the failed attempt
+    built, or every bad generation would pin device tables for the process
+    lifetime."""
+    from photon_ml_tpu.io.checkpoint import list_generations, load_generation
+    from photon_ml_tpu.serving import evict_engine, model_fingerprint
+    from photon_ml_tpu.serving.hotswap import model_from_state
+
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        gen2_dir = list_generations(root)[-1][1]
+        fp2 = model_fingerprint(model_from_state(load_generation(gen2_dir)))
+        with armed("serve.swap.warmup:crash:1"):
+            assert mgr.check_once() is False
+        # the candidate built during the failed attempt is no longer cached...
+        assert evict_engine(fp2) == 0
+        # ...while the serving generation's engine still is
+        assert evict_engine(fe.engine.fingerprint) == 1
+    finally:
+        fe.close()
+
+
+def test_flip_crash_rolls_back_consistently(tmp_path, rng):
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        with armed("serve.swap.flip:crash:1"):
+            assert mgr.check_once() is False
+        assert fe.generation == 1  # the pointer never flipped
+        req = make_req(rng)
+        np.testing.assert_array_equal(fe.score(req, timeout=30), fe.engine.score(req))
+    finally:
+        fe.close()
+
+
+def test_transient_verify_fault_absorbed_by_retry(tmp_path, rng):
+    """serve.swap.verify raising a transient OSError once must NOT fail the
+    swap: the Retry policy absorbs it inside the same check_once."""
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        with armed("serve.swap.verify:raise:1"):
+            assert mgr.check_once() is True
+        assert fe.generation == 2 and mgr.rollbacks == 0
+    finally:
+        fe.close()
+
+
+def test_persistent_verify_fault_exhausts_budget_and_rolls_back(tmp_path, rng):
+    """Retry exhaustion on transient I/O rolls back but does NOT blacklist:
+    the generation isn't at fault, and it may be the last one a finished
+    training run ever commits — a later poll must pick it up once the
+    filesystem recovers. (Contrast with corruption/warm-up crashes, which
+    reproduce deterministically and ARE blacklisted.)"""
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        with armed("serve.swap.verify:raise:1x*"):
+            assert mgr.check_once() is False
+        assert fe.generation == 1 and mgr.rollbacks == 1
+        assert mgr.bad_generations == set()
+        rollback = [i for i in fe.incidents if i.kind == "hotswap-rollback"]
+        assert rollback and "RetryExhausted" in rollback[0].cause
+        assert "retry generation 2" in rollback[0].action
+        # the I/O recovered (fault disarmed): the very next poll swaps
+        assert mgr.check_once() is True
+        assert fe.generation == 2
+    finally:
+        fe.close()
+
+
+# -------------------------------------------------------------- watcher
+
+
+def test_generation_watcher_swaps_in_background(tmp_path, rng):
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        with GenerationWatcher(mgr, poll_interval_s=0.05):
+            save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+            deadline = time.monotonic() + 30.0
+            while fe.generation != 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert fe.generation == 2
+        req = make_req(rng)
+        np.testing.assert_array_equal(fe.score(req, timeout=30), fe.engine.score(req))
+    finally:
+        fe.close()
+
+
+def test_watcher_survives_concurrent_traffic(tmp_path, rng):
+    """Traffic + watcher concurrently: every response bitwise matches the
+    engine of the generation that served it — zero dropped across the flip."""
+    root, fe, mgr = serve(tmp_path, rng)
+    engines = {1: fe.engine}
+    served = []
+    errors = []
+    reqs = [make_req(rng) for _ in range(6)]
+    for r in reqs:
+        fe.score(r, timeout=30)  # record live shapes (swap warm-up covers them)
+    stop = threading.Event()
+
+    def client(cid):
+        i = 0
+        while not stop.is_set():
+            r = reqs[(cid + i) % len(reqs)]
+            i += 1
+            try:
+                fut = fe.submit(r)
+                out = fut.result(30)
+                served.append((r, out, fut.generation))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(2)]
+
+    def wait_until(cond, what):
+        deadline = time.monotonic() + 30.0
+        while not cond():
+            assert time.monotonic() < deadline, f"timed out waiting for {what}"
+            time.sleep(0.01)
+
+    try:
+        with GenerationWatcher(mgr, poll_interval_s=0.02):
+            for t in threads:
+                t.start()
+            # deterministic span: some traffic MUST land on gen-1 first ...
+            wait_until(lambda: len(served) >= 5, "gen-1 traffic")
+            save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+            wait_until(lambda: fe.generation == 2, "the hot swap")
+            # ... and some on gen-2 after the flip
+            wait_until(
+                lambda: any(g == 2 for _, _, g in list(served)), "gen-2 traffic"
+            )
+            stop.set()
+        for t in threads:
+            t.join(30)
+        engines[2] = fe.engine
+        assert not errors
+        assert fe.generation == 2
+        gens = {g for _, _, g in served}
+        assert 1 in gens and 2 in gens  # the stream spanned the flip
+        for r, out, g in served:
+            direct = engines[g].score(r)
+            assert out.dtype == direct.dtype
+            np.testing.assert_array_equal(out, direct)
+    finally:
+        stop.set()
+        fe.close()
+
+
+def test_model_from_state_prefers_best(tmp_path, rng):
+    root = str(tmp_path / "ckpt")
+    current = build_models(rng, 1.0)
+    best = build_models(rng, 2.0)
+    save_checkpoint(root, current, 1, best_models=best, keep_generations=8)
+    _, state = newest_valid_generation(root)
+    preferred = model_from_state(state, prefer_best=True)
+    fallback = model_from_state(state, prefer_best=False)
+    # restore casts to the serving dtype (float32 default): compare exactly
+    # against the same cast of the originals
+    np.testing.assert_array_equal(
+        np.asarray(preferred.models["fixed"].model.coefficients.means),
+        np.asarray(best["fixed"].model.coefficients.means, dtype=np.float32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fallback.models["fixed"].model.coefficients.means),
+        np.asarray(current["fixed"].model.coefficients.means, dtype=np.float32),
+    )

@@ -126,6 +126,8 @@ class TestFaultPoints:
         import photon_ml_tpu.algorithm.coordinate_descent  # noqa: F401
         import photon_ml_tpu.io.checkpoint  # noqa: F401
         import photon_ml_tpu.parallel.distributed  # noqa: F401
+        import photon_ml_tpu.serving.frontend  # noqa: F401
+        import photon_ml_tpu.serving.hotswap  # noqa: F401
 
         points = set(registered_fault_points())
         assert {
@@ -135,6 +137,11 @@ class TestFaultPoints:
             "checkpoint.restore",
             "coord.update",
             "distributed.init",
+            "serve.enqueue",
+            "serve.dispatch",
+            "serve.swap.verify",
+            "serve.swap.warmup",
+            "serve.swap.flip",
         } <= points
 
     def test_corrupt_file_flips_one_byte(self, tmp_path):
@@ -217,6 +224,58 @@ class TestRetry:
     def test_zero_attempts_rejected(self):
         with pytest.raises(ValueError):
             Retry(max_attempts=0)
+
+    def test_max_elapsed_stops_before_the_budget_is_blown(self):
+        """Total-deadline budget under a fake clock: the policy must refuse a
+        backoff sleep that would cross max_elapsed, raising RetryExhausted
+        BEFORE the budget is exceeded — attempt count alone cannot bound an
+        SLO window (the serving hot-swap's requirement)."""
+        t = {"now": 0.0}
+
+        def clock():
+            return t["now"]
+
+        def sleep(s):
+            t["now"] += s
+
+        attempts = []
+
+        def flaky():
+            attempts.append(t["now"])
+            t["now"] += 1.0  # each attempt itself costs 1s of wall clock
+            raise OSError("slow filesystem")
+
+        r = Retry(
+            max_attempts=10, base_delay=1.0, max_delay=10.0, jitter=0.0,
+            sleep=sleep, clock=clock, seed=0, max_elapsed=5.0,
+        )
+        with pytest.raises(RetryExhausted, match="deadline budget"):
+            r.call(flaky, description="swap")
+        # schedule: attempt@0 (1s) + sleep 1 + attempt@2 (1s) + sleep 2
+        # + attempt@5 (1s) -> next sleep of 4s would cross 5.0: stop there
+        assert attempts == [0.0, 2.0, 5.0]
+        assert t["now"] <= 5.0 + 1.0  # never slept past the budget
+
+    def test_max_elapsed_does_not_cut_a_fitting_schedule(self):
+        t = {"now": 0.0}
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        r = Retry(
+            max_attempts=3, base_delay=0.1, jitter=0.0,
+            sleep=lambda s: t.__setitem__("now", t["now"] + s),
+            clock=lambda: t["now"], seed=0, max_elapsed=100.0,
+        )
+        assert r.call(flaky) == "ok" and len(calls) == 3
+
+    def test_max_elapsed_validation(self):
+        with pytest.raises(ValueError, match="max_elapsed"):
+            Retry(max_elapsed=0.0)
 
 
 # --------------------------------------------------------------- incidents

@@ -496,3 +496,125 @@ def test_post_warmup_retrace_fails_the_guard(rng):
     with pytest.raises(RetraceError, match="jaxpr trace"):
         with sync_discipline(what="serving steady state"):
             eng.score(req(100))  # 128 bucket: must compile -> guard trips
+
+
+# ------------------------------------------------- concurrent serving safety
+# The serving frontend runs dispatch on its own thread while hot-swap warm-up
+# compiles on another; these tests pin the engine-level guarantees that makes
+# safe: once-per-bucket compilation under concurrency, and engine-cache
+# eviction that never touches an engine a live request holds.
+
+
+def test_concurrent_first_hits_compile_bucket_once(rng):
+    """N threads first-hitting the SAME bucket concurrently must produce ONE
+    trace (the per-engine bucket lock), identical scores, and no duplicate
+    trace work that would trip trace_count gates."""
+    import threading
+
+    eng, req = _guard_model_and_req(rng)
+    request = req(50)
+    expected_holder = {}
+    results = [None] * 8
+    errors = []
+    barrier = threading.Barrier(8)
+
+    def worker(i):
+        try:
+            barrier.wait(timeout=30)
+            results[i] = eng.score(request)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors
+    assert eng.trace_count == 1  # one program, traced exactly once
+    expected_holder["ref"] = results[0]
+    for out in results:
+        np.testing.assert_array_equal(out, expected_holder["ref"])
+    # steady state afterwards: same bucket, still no retrace, lock-free path
+    eng.score(req(60))
+    assert eng.trace_count == 1
+
+
+def test_concurrent_first_hits_on_different_buckets(rng):
+    """Different buckets first-hit concurrently: each compiles exactly once
+    (2 traces total), none serializes the other into a wrong count."""
+    import threading
+
+    eng, req = _guard_model_and_req(rng)
+    reqs = {50: req(50), 100: req(100)}  # 64 and 128 buckets
+    errors = []
+    barrier = threading.Barrier(2)
+
+    def worker(n):
+        try:
+            barrier.wait(timeout=30)
+            eng.score(reqs[n])
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(n,)) for n in (50, 100)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors
+    assert eng.trace_count == 2
+
+
+def test_eviction_mid_flight_never_breaks_a_held_engine(rng):
+    """evict_engine/clear_engine_cache drop the cache ENTRY only: a thread
+    scoring through an engine evicted mid-flight keeps getting bitwise-stable
+    answers, and the next cache lookup builds a fresh engine."""
+    import threading
+
+    from photon_ml_tpu.serving import evict_engine
+
+    model = GameModel(
+        models={"fixed": fixed_model(rng), "per-user": random_model(rng, "userId", 10)}
+    )
+    eng = get_engine(model)
+    data = glmix_input(rng, with_items=False)
+    reference = eng.score(data)
+    outputs = []
+    errors = []
+    started = threading.Event()
+
+    def scorer():
+        try:
+            for _ in range(20):
+                outputs.append(eng.score(data))
+                started.set()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=scorer)
+    t.start()
+    assert started.wait(30)
+    assert evict_engine(eng.fingerprint) == 1  # mid-flight eviction
+    clear_engine_cache()  # and the bigger hammer, same contract
+    t.join(60)
+    assert not errors and len(outputs) == 20
+    for out in outputs:
+        np.testing.assert_array_equal(out, reference)
+    # the evicted fingerprint is gone: a fresh lookup builds a new engine
+    assert get_engine(model) is not eng
+    # the held engine still works even after being fully superseded
+    np.testing.assert_array_equal(eng.score(data), reference)
+
+
+def test_evict_engine_is_fingerprint_scoped(rng):
+    from photon_ml_tpu.serving import evict_engine
+
+    m1 = GameModel(models={"fixed": fixed_model(rng)})
+    m2 = GameModel(models={"fixed": fixed_model(rng)})
+    e1, e2 = get_engine(m1), get_engine(m2)
+    assert e1 is not e2
+    assert evict_engine(e1.fingerprint) == 1
+    assert get_engine(m2) is e2  # untouched entry survives
+    assert get_engine(m1) is not e1
+    assert evict_engine("not-a-fingerprint") == 0

@@ -1,0 +1,577 @@
+"""Serving front-end (photon_ml_tpu/serving/frontend.py): micro-batch
+coalescing parity, max-wait/max-batch dispatch, bounded-queue overload
+shedding, deadline admission control, explicit dispatch failure, incident
+records, warm-request synthesis, and the serve.* fault points.
+
+The load-bearing property throughout: a response served through the frontend
+is BITWISE what a direct engine call on the same request returns — coalescing
+is a latency/throughput transform, never a numerics transform.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+from photon_ml_tpu.resilience import InjectedCrash, InjectedFault, armed
+from photon_ml_tpu.serving import (
+    DeadlineExceeded,
+    FrontendConfig,
+    Overloaded,
+    ServingFrontend,
+    clear_engine_cache,
+    get_engine,
+)
+from photon_ml_tpu.serving.engine import GameServingEngine
+from photon_ml_tpu.serving.frontend import request_signature
+from photon_ml_tpu.types import TaskType
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine_cache():
+    clear_engine_cache()
+    yield
+    clear_engine_cache()
+
+
+def make_model(rng, n_users=10, d=6, d_re=5):
+    proj = np.tile(np.arange(d_re, dtype=np.int32), (n_users, 1))
+    return GameModel(
+        models={
+            "fixed": FixedEffectModel(
+                model=LogisticRegressionModel(
+                    Coefficients(means=jnp.asarray(rng.normal(size=d)))
+                ),
+                feature_shard_id="global",
+            ),
+            "per-user": RandomEffectModel(
+                re_type="userId",
+                feature_shard_id="re_shard",
+                task=TaskType.LOGISTIC_REGRESSION,
+                entity_ids=tuple(range(n_users)),
+                coeffs=jnp.asarray(rng.normal(size=(n_users, d_re))),
+                proj_indices=jnp.asarray(proj),
+            ),
+        }
+    )
+
+
+def make_req(rng, n, n_users=10, d=6, d_re=5, nnz=None):
+    """Constant-nnz sparse RE shard (dense-backed or exact-nnz rows) so the
+    request stream shares one width bucket."""
+    if nnz is None:
+        re_dense = rng.normal(size=(n, d_re)) + 10.0  # no exact zeros
+    else:
+        re_dense = np.zeros((n, d_re))
+        for i in range(n):
+            cols = rng.choice(d_re, size=nnz, replace=False)
+            re_dense[i, cols] = rng.normal(size=nnz) + 10.0
+    return GameInput(
+        features={
+            "global": rng.normal(size=(n, d)),
+            "re_shard": sp.csr_matrix(re_dense),
+        },
+        offsets=rng.normal(size=n),
+        id_columns={"userId": rng.integers(0, n_users, size=n)},
+    )
+
+
+class GatedEngine:
+    """Duck-typed engine wrapper: optionally blocks in score() until released
+    and/or raises queued failures — the tool for making dispatch timing and
+    failure deterministic."""
+
+    def __init__(self, inner, gated=False, failures=None):
+        self.inner = inner
+        self.mesh = inner.mesh
+        self.min_batch_pad = inner.min_batch_pad
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+        self.gated = gated
+        self.failures = list(failures or [])
+        self.calls = 0
+
+    def bucket(self, n):
+        return self.inner.bucket(n)
+
+    def _maybe_block_or_fail(self):
+        self.calls += 1
+        self.entered.set()
+        if self.gated:
+            assert self.gate.wait(30.0), "test gate never released"
+        if self.failures:
+            raise self.failures.pop(0)
+
+    def score(self, data, include_offsets=True):
+        self._maybe_block_or_fail()
+        return self.inner.score(data, include_offsets=include_offsets)
+
+    def predict(self, data):
+        self._maybe_block_or_fail()
+        return self.inner.predict(data)
+
+
+# --------------------------------------------------------------- coalescing
+
+
+def test_single_request_passthrough_parity(rng):
+    model = make_model(rng)
+    eng = get_engine(model)
+    req = make_req(rng, 21)
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=0.0), generation=7) as fe:
+        fut = fe.submit(req)
+        out = fut.result(30)
+    direct = eng.score(req)
+    assert out.dtype == direct.dtype
+    np.testing.assert_array_equal(out, direct)
+    assert fut.generation == 7
+
+
+def test_coalesced_batch_bitwise_parity(rng):
+    """Requests queued inside one max-wait window coalesce into ONE dispatch,
+    and every per-request slice equals its direct solo engine call bitwise."""
+    model = make_model(rng)
+    eng = get_engine(model)
+    reqs = [make_req(rng, int(n)) for n in (13, 7, 22, 5)]
+    for r in reqs:  # warm every solo bucket AND the coalesced bucket (64 pad)
+        eng.score(r)
+    eng.score(make_req(rng, 47))
+    with ServingFrontend(
+        eng, FrontendConfig(max_wait_ms=250.0, max_batch=4096)
+    ) as fe:
+        futs = [fe.submit(r) for r in reqs]
+        outs = [f.result(30) for f in futs]
+        stats = fe.stats()
+    assert stats["batches"] == 1  # one dispatch served all four
+    assert stats["served"] == 4
+    for r, out in zip(reqs, outs):
+        direct = eng.score(r)
+        assert out.dtype == direct.dtype
+        np.testing.assert_array_equal(out, direct)
+
+
+def test_max_batch_triggers_dispatch_before_max_wait(rng):
+    model = make_model(rng)
+    eng = get_engine(model)
+    reqs = [make_req(rng, 16) for _ in range(4)]
+    with ServingFrontend(
+        eng, FrontendConfig(max_wait_ms=30_000.0, max_batch=64)
+    ) as fe:
+        futs = [fe.submit(r) for r in reqs]
+        t0 = time.perf_counter()
+        outs = [f.result(30) for f in futs]
+        waited = time.perf_counter() - t0
+    assert waited < 20.0  # did NOT sit out the 30s max-wait window
+    for r, out in zip(reqs, outs):
+        np.testing.assert_array_equal(out, eng.score(r))
+
+
+def test_mixed_signatures_split_batches(rng):
+    """Different nnz-width buckets must NOT coalesce (padding a narrow family
+    wider can move an ulp): they dispatch as separate same-signature batches,
+    each bitwise-correct."""
+    model = make_model(rng)
+    eng = get_engine(model)
+    narrow = [make_req(rng, 11, nnz=2) for _ in range(2)]  # width bucket 4
+    wide = [make_req(rng, 11, nnz=5) for _ in range(2)]  # width bucket 8
+    assert request_signature(narrow[0], "score", True) == request_signature(
+        narrow[1], "score", True
+    )
+    assert request_signature(narrow[0], "score", True) != request_signature(
+        wide[0], "score", True
+    )
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=150.0)) as fe:
+        futs = [fe.submit(r) for r in (narrow[0], wide[0], narrow[1], wide[1])]
+        outs = [f.result(30) for f in futs]
+        stats = fe.stats()
+    assert stats["batches"] == 2
+    for r, out in zip((narrow[0], wide[0], narrow[1], wide[1]), outs):
+        direct = eng.score(r)
+        assert out.dtype == direct.dtype
+        np.testing.assert_array_equal(out, direct)
+
+
+def test_predict_kind_parity(rng):
+    model = make_model(rng)
+    eng = get_engine(model)
+    reqs = [make_req(rng, 9) for _ in range(3)]
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=100.0)) as fe:
+        futs = [fe.submit(r, kind="predict") for r in reqs]
+        outs = [f.result(30) for f in futs]
+        assert fe.stats()["batches"] == 1
+    for r, out in zip(reqs, outs):
+        direct = eng.predict(r)
+        assert out.dtype == direct.dtype
+        np.testing.assert_array_equal(out, direct)
+
+
+def test_score_and_predict_never_coalesce_together(rng):
+    model = make_model(rng)
+    eng = get_engine(model)
+    r1, r2 = make_req(rng, 9), make_req(rng, 9)
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=100.0)) as fe:
+        f1 = fe.submit(r1, kind="score")
+        f2 = fe.submit(r2, kind="predict")
+        np.testing.assert_array_equal(f1.result(30), eng.score(r1))
+        np.testing.assert_array_equal(f2.result(30), eng.predict(r2))
+        assert fe.stats()["batches"] == 2
+
+
+# ------------------------------------------------------- admission control
+
+
+def test_overload_sheds_with_explicit_incident(rng):
+    model = make_model(rng)
+    gated = GatedEngine(get_engine(model), gated=True)
+    fe = ServingFrontend(
+        gated, FrontendConfig(max_wait_ms=0.0, max_queue_depth=2)
+    )
+    try:
+        first = fe.submit(make_req(rng, 5))  # dispatched, blocks in the engine
+        assert gated.entered.wait(10.0)
+        q1 = fe.submit(make_req(rng, 5))  # queued
+        q2 = fe.submit(make_req(rng, 5))  # queued (depth now 2)
+        with pytest.raises(Overloaded, match="queue full"):
+            fe.submit(make_req(rng, 5))
+        assert any(i.kind == "overload" for i in fe.incidents)
+        assert fe.stats()["shed_overload"] == 1
+        gated.gate.set()
+        for f in (first, q1, q2):  # everything admitted is still served
+            assert f.result(30).shape == (5,)
+    finally:
+        gated.gate.set()
+        fe.close()
+
+
+def test_deadline_expired_at_submit_sheds(rng):
+    model = make_model(rng)
+    with ServingFrontend(get_engine(model), FrontendConfig()) as fe:
+        with pytest.raises(DeadlineExceeded):
+            fe.submit(make_req(rng, 5), deadline_ms=0.0)
+        assert any(i.kind == "deadline-shed" for i in fe.incidents)
+
+
+def test_deadline_unmeetable_shed_before_dispatch(rng):
+    """A request whose deadline passes while an earlier batch owns the engine
+    is shed at dispatch — explicitly, before any device work."""
+    model = make_model(rng)
+    gated = GatedEngine(get_engine(model), gated=True)
+    fe = ServingFrontend(gated, FrontendConfig(max_wait_ms=0.0))
+    try:
+        first = fe.submit(make_req(rng, 5))
+        assert gated.entered.wait(10.0)
+        doomed = fe.submit(make_req(rng, 5), deadline_ms=30.0)
+        time.sleep(0.1)  # its deadline expires while the engine is held
+        gated.gate.set()
+        assert first.result(30).shape == (5,)
+        with pytest.raises(DeadlineExceeded, match="shed before dispatch"):
+            doomed.result(30)
+        assert fe.stats()["shed_deadline"] == 1
+        assert any(i.kind == "deadline-shed" for i in fe.incidents)
+        # engine never saw the doomed request's batch
+        assert gated.calls == 1
+    finally:
+        gated.gate.set()
+        fe.close()
+
+
+def test_deadline_tighter_than_max_wait_is_served(rng):
+    """Batch formation is deadline-aware: a request whose deadline lands
+    inside the max-wait window pulls the dispatch forward instead of idling
+    into its own deadline — at zero load it must be SERVED, not shed."""
+    model = make_model(rng)
+    eng = get_engine(model)
+    req = make_req(rng, 9)
+    eng.score(req)  # pre-compile so the dispatch comfortably fits 300 ms
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=10_000.0)) as fe:
+        out = fe.score(req, deadline_ms=300.0, timeout=30.0)
+        assert fe.stats().get("shed_deadline", 0) == 0
+    np.testing.assert_array_equal(out, eng.score(req))
+
+
+def test_default_deadline_from_config(rng):
+    model = make_model(rng)
+    with ServingFrontend(
+        get_engine(model), FrontendConfig(default_deadline_ms=-1.0)
+    ) as fe:
+        with pytest.raises(DeadlineExceeded):
+            fe.submit(make_req(rng, 5))
+
+
+# -------------------------------------------------- explicit failure, faults
+
+
+def test_dispatch_failure_fails_batch_explicitly_and_recovers(rng):
+    model = make_model(rng)
+    flaky = GatedEngine(get_engine(model), failures=[RuntimeError("device fell over")])
+    with ServingFrontend(flaky, FrontendConfig(max_wait_ms=0.0)) as fe:
+        bad = fe.submit(make_req(rng, 5))
+        with pytest.raises(RuntimeError, match="device fell over"):
+            bad.result(30)
+        assert any(i.kind == "dispatch-failure" for i in fe.incidents)
+        # the dispatcher survived: the next request is served normally
+        req = make_req(rng, 5)
+        np.testing.assert_array_equal(fe.score(req, timeout=30), flaky.inner.score(req))
+
+
+def test_injected_dispatch_crash_is_explicit_not_silent(rng):
+    model = make_model(rng)
+    eng = get_engine(model)
+    req = make_req(rng, 5)
+    eng.score(req)  # warm outside the armed window
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=0.0)) as fe:
+        with armed("serve.dispatch:crash:1"):
+            fut = fe.submit(req)
+            with pytest.raises(InjectedCrash):
+                fut.result(30)
+            assert any(i.kind == "dispatch-failure" for i in fe.incidents)
+            # never a wrong score: the follow-up is served, bitwise-correct
+            out = fe.score(req, timeout=30)
+        np.testing.assert_array_equal(out, eng.score(req))
+
+
+def test_injected_enqueue_fault_is_explicit(rng):
+    model = make_model(rng)
+    with ServingFrontend(get_engine(model), FrontendConfig()) as fe:
+        with armed("serve.enqueue:raise:1"):
+            with pytest.raises(InjectedFault):
+                fe.submit(make_req(rng, 5))
+        req = make_req(rng, 5)
+        np.testing.assert_array_equal(
+            fe.score(req, timeout=30), fe.engine.score(req)
+        )
+
+
+def test_incident_log_snapshot_safe_under_concurrent_recording(rng):
+    """The hot-swap thread records rollbacks via record_incident while other
+    threads snapshot fe.incidents; at maxlen the deque pops on every append,
+    so an unsynchronized reader raises 'deque mutated during iteration'.
+    Regression: hammer both sides concurrently — every snapshot must succeed
+    and contain only intact Incident records."""
+    model = make_model(rng)
+    with ServingFrontend(
+        get_engine(model), FrontendConfig(incident_log_size=4)
+    ) as fe:
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                fe.record_incident("hotswap-rollback", f"cause-{i}", "kept serving")
+                i += 1
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    snap = fe.incidents
+                    assert all(i.kind == "hotswap-rollback" for i in snap)
+            except BaseException as e:  # noqa: BLE001 — recorded for the assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors
+
+
+# ------------------------------------------------------------ lifecycle
+
+
+def test_close_drain_false_fails_queued_explicitly(rng):
+    model = make_model(rng)
+    gated = GatedEngine(get_engine(model), gated=True)
+    fe = ServingFrontend(gated, FrontendConfig(max_wait_ms=0.0))
+    first = fe.submit(make_req(rng, 5))
+    assert gated.entered.wait(10.0)
+    queued = fe.submit(make_req(rng, 5))
+    releaser = threading.Timer(0.05, gated.gate.set)
+    releaser.start()
+    fe.close(drain=False)
+    releaser.join()
+    assert first.result(30).shape == (5,)  # in-flight batch completed
+    with pytest.raises(Overloaded, match="closed"):
+        queued.result(30)
+    with pytest.raises(Overloaded, match="closed"):
+        fe.submit(make_req(rng, 5))
+    # shutdown sheds stay visible: incidents for the failed queue AND the
+    # post-close submit, counters matching
+    assert any(
+        i.kind == "overload" and "closed with 1 queued" in i.cause
+        for i in fe.incidents
+    )
+    assert any(i.cause == "submit after close" for i in fe.incidents)
+    assert fe.stats()["shed_overload"] == 2
+
+
+def test_close_drain_serves_queue(rng):
+    model = make_model(rng)
+    eng = get_engine(model)
+    fe = ServingFrontend(eng, FrontendConfig(max_wait_ms=50.0))
+    reqs = [make_req(rng, 7) for _ in range(3)]
+    futs = [fe.submit(r) for r in reqs]
+    fe.close(drain=True)
+    for r, f in zip(reqs, futs):
+        np.testing.assert_array_equal(f.result(30), eng.score(r))
+
+
+# ------------------------------------------------------ hot-swap primitives
+
+
+def test_install_engine_flips_generation_and_parity(rng):
+    m1, m2 = make_model(rng), make_model(rng)
+    e1, e2 = get_engine(m1), get_engine(m2)
+    req = make_req(rng, 9)
+    with ServingFrontend(e1, FrontendConfig(max_wait_ms=0.0), generation=1) as fe:
+        f1 = fe.submit(req)
+        np.testing.assert_array_equal(f1.result(30), e1.score(req))
+        assert f1.generation == 1
+        fe.install_engine(e2, 2)
+        f2 = fe.submit(req)
+        np.testing.assert_array_equal(f2.result(30), e2.score(req))
+        assert f2.generation == 2 and fe.generation == 2
+        assert fe.stats()["swaps"] == 1
+
+
+def test_warm_requests_precompile_live_buckets(rng):
+    """The synthetic warm set must compile exactly the program family live
+    traffic uses: scoring it through a FRESH engine, then replaying real
+    requests, triggers zero additional traces."""
+    model = make_model(rng)
+    eng = get_engine(model)
+    reqs = [make_req(rng, int(n)) for n in (13, 40)]
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=0.0)) as fe:
+        for r in reqs:
+            fe.score(r, timeout=30)
+        warm = fe.warm_requests()
+        assert warm  # live shapes + buckets were recorded
+        fresh = GameServingEngine(model)
+        for kind, include_offsets, synth in warm:
+            if kind == "predict":
+                fresh.predict(synth)
+            else:
+                fresh.score(synth, include_offsets=include_offsets)
+        warmed_traces = fresh.trace_count
+        for r in reqs:
+            fresh.score(r)
+        assert fresh.trace_count == warmed_traces  # nothing retraced
+
+
+def test_projector_engine_dispatches_solo_with_parity(rng):
+    """A RANDOM_PROJECTION coordinate pads requests to the PROJECTED width
+    bucket, which the coalescing signature cannot see — such engines must
+    dispatch one request per batch, keeping parity trivially bitwise."""
+    from photon_ml_tpu.data.projector import (
+        ProjectorConfig,
+        ProjectorType,
+        make_projector,
+    )
+
+    d_re, E = 7, 6
+    projector = make_projector(
+        ProjectorConfig(
+            projector_type=ProjectorType.RANDOM_PROJECTION, projected_dim=3, seed=7
+        ),
+        original_dim=d_re,
+        intercept_index=0,
+    )
+    k_cols = projector.projected_dim
+    model = GameModel(
+        models={
+            "per-user": RandomEffectModel(
+                re_type="userId",
+                feature_shard_id="re_shard",
+                task=TaskType.LOGISTIC_REGRESSION,
+                entity_ids=tuple(f"e{i}" for i in range(E)),
+                coeffs=jnp.asarray(rng.normal(size=(E, k_cols))),
+                proj_indices=jnp.asarray(
+                    np.tile(np.arange(k_cols, dtype=np.int32), (E, 1))
+                ),
+                projector=projector,
+            )
+        }
+    )
+    eng = get_engine(model)
+    assert eng.coalesce_safe is False
+    assert get_engine(make_model(rng)).coalesce_safe is True
+
+    def proj_req(n):
+        dense = rng.normal(size=(n, d_re))
+        dense[rng.random(size=dense.shape) < 0.5] = 0.0  # varying row sparsity
+        return GameInput(
+            features={"re_shard": sp.csr_matrix(dense)},
+            offsets=rng.normal(size=n),
+            id_columns={
+                "userId": np.asarray([f"e{i % E}" for i in range(n)], dtype=object)
+            },
+        )
+
+    reqs = [proj_req(9), proj_req(9), proj_req(9)]
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=100.0)) as fe:
+        futs = [fe.submit(r) for r in reqs]
+        outs = [f.result(30) for f in futs]
+        assert fe.stats()["batches"] == 3  # one dispatch per request, no coalesce
+    for r, out in zip(reqs, outs):
+        direct = eng.score(r)
+        assert out.dtype == direct.dtype
+        np.testing.assert_array_equal(out, direct)
+
+    # solo dispatch must read/write the deadline EWMA under the SOLO request's
+    # bucket — with the estimate keyed on the coalesced total, the unmeetable
+    # shed path would never engage for projector engines (est stays None and
+    # device work burns on requests that cannot meet their deadline)
+    req = proj_req(9)
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=100.0)) as fe:
+        fe.score(req, timeout=30)  # EWMA write lands at (sig, bucket(9))
+        key = (request_signature(req, "score", True), eng.bucket(9))
+        with fe._cv:
+            assert key in fe._latency_ewma
+            fe._latency_ewma[key] = 10.0  # "dispatch takes 10 s"
+        futs = [fe.submit(req, deadline_ms=500.0) for _ in range(2)]
+        for f in futs:
+            with pytest.raises(DeadlineExceeded):
+                f.result(30)
+        assert any(i.kind == "deadline-shed" for i in fe.incidents)
+
+
+def test_concurrent_clients_all_bitwise_correct(rng):
+    """8 client threads hammering one frontend: every response equals its
+    direct engine call — no cross-request bleed under concurrency."""
+    model = make_model(rng)
+    eng = get_engine(model)
+    reqs = [make_req(rng, int(n)) for n in rng.integers(4, 33, size=8)]
+    directs = [eng.score(r) for r in reqs]
+    eng.score(make_req(rng, 60))  # warm the coalesced buckets
+    results = [None] * len(reqs)
+    errors = []
+    with ServingFrontend(eng, FrontendConfig(max_wait_ms=5.0)) as fe:
+
+        def client(i):
+            try:
+                for _ in range(5):
+                    results[i] = fe.score(reqs[i], timeout=30)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors
+    for direct, got in zip(directs, results):
+        assert got.dtype == direct.dtype
+        np.testing.assert_array_equal(got, direct)
