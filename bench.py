@@ -866,6 +866,11 @@ def main():
         # zero-shed-below-knee, hot-swap-no-drop and rollback gates)
         _delegate_benchmark("--serving-load", "serving_load_bench")
 
+    if "--continuous" in sys.argv:
+        # continuous-training delta pass vs full retrain (active-set-fraction,
+        # delta-proportionality, quality-parity and bounded-retrace gates)
+        _delegate_benchmark("--continuous", "continuous_bench")
+
     if "--child" in sys.argv:
         _child_main()
         return

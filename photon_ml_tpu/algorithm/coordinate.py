@@ -300,6 +300,40 @@ class RandomEffectCoordinate(Coordinate):
             per_entity_reg_weights=self.per_entity_reg_weights,
         )
 
+    def update_model_active(
+        self,
+        initial_model: RandomEffectModel,
+        partial_scores: Array,
+        active_mask,
+    ) -> tuple[RandomEffectModel, RandomEffectTracker]:
+        """Active-set delta update (continuous training): re-solve ONLY the
+        entities in ``active_mask`` (host bool [E]) over their full
+        accumulated data, warm-started from ``initial_model``; every inactive
+        entity keeps its previous coefficients bit for bit
+        (algorithm/random_effect.train_random_effect_delta). The stats of the
+        last delta update land on ``self.last_active_stats``."""
+        from photon_ml_tpu.algorithm.random_effect import train_random_effect_delta
+
+        if initial_model is None:
+            raise ValueError(
+                "active-set updates need the previous generation's model to "
+                "warm-start from (initial_model is None)"
+            )
+        offsets_plus_scores = self.base_offsets + partial_scores
+        model, tracker, stats = train_random_effect_delta(
+            self.dataset,
+            self.task,
+            self.configuration,
+            offsets_plus_scores,
+            initial_model,
+            active_mask,
+            normalization=self.normalization,
+            variance_computation=self.variance_computation,
+            per_entity_reg_weights=self.per_entity_reg_weights,
+        )
+        self.last_active_stats = stats
+        return model, tracker
+
     def _fused_update_static(self):
         """Descent-iteration-invariant inputs of the update program, built
         once per coordinate: validations, the per-entity L2 table, the

@@ -230,6 +230,7 @@ def run_coordinate_descent(
     evaluation_suite: Optional[EvaluationSuite] = None,
     checkpointer: Optional[object] = None,
     defer_guard: bool = True,
+    active_sets: Optional[Mapping[str, object]] = None,
 ) -> CoordinateDescentResult:
     """Run block coordinate descent (CoordinateDescent.run/descend:93-346).
 
@@ -252,6 +253,15 @@ def run_coordinate_descent(
     best-model snapshot are saved atomically, and a rerun with the same
     checkpointer resumes from the last completed iteration (training scores are
     recomputed from the restored models — they are pure functions of them).
+
+    ``active_sets`` (continuous training, photon_ml_tpu/continuous/) switches a
+    coordinate into ACTIVE-SET delta mode: ``{coordinate_id: host bool [E]
+    mask}``. Such a coordinate must offer ``update_model_active`` and have an
+    initial model to warm-start from; only masked entities are re-solved, the
+    rest keep the previous generation's coefficients bit for bit. Coordinates
+    absent from the mapping update normally (the fixed effect refreshes over
+    whatever its coordinate was configured with, e.g. a reservoir
+    down-sampler).
     """
     if n_iterations < 1:
         raise ValueError(f"n_iterations must be >= 1, got {n_iterations}")
@@ -323,6 +333,17 @@ def run_coordinate_descent(
     val_scores: dict[str, Array] = {}
     for cid, coord in coordinates.items():
         init = None if initial_models is None else initial_models.get(cid)
+        if init is None and active_sets is not None and active_sets.get(cid) is not None:
+            # without a warm start, initialize_model() would silently supply a
+            # ZERO model and the pass would export coefficient 0 for every
+            # inactive entity — an active set only makes sense over the
+            # previous generation's coefficients
+            raise ValueError(
+                f"Coordinate {cid!r} has an active set but no initial model: "
+                "active-set delta updates keep inactive entities' previous "
+                "coefficients, so a warm-start model is required "
+                "(initial_models or a resumable checkpoint)"
+            )
         if init is not None:
             # adapt external/restored models to the coordinate's dataset:
             # RE models re-align entity rows, FE models pad + place
@@ -378,10 +399,15 @@ def run_coordinate_descent(
             prev_model = models[cid]
             prev_score = train_scores[cid]
             prev_had_var = _has_variances(prev_model)
+            active = None if active_sets is None else active_sets.get(cid)
             # duck-typed coordinates (test wrappers, external impls) may
             # predate the fused protocol — treat a missing method as "no
-            # fused path"
-            update_and_score = getattr(coord, "update_and_score", None)
+            # fused path". Active-set updates always take the generic path:
+            # the delta program gathers/scatters host-chosen lane sets, which
+            # the donated fused program cannot express.
+            update_and_score = (
+                getattr(coord, "update_and_score", None) if active is None else None
+            )
             fused = (
                 update_and_score(prev_model, partial, prev_score, donate=cid in donating)
                 if update_and_score is not None
@@ -405,6 +431,16 @@ def run_coordinate_descent(
                 # returned arrays — on a reject they HOLD the previous values
                 models[cid] = model
                 train_scores[cid] = new_score
+            elif active is not None:
+                update_active = getattr(coord, "update_model_active", None)
+                if update_active is None:
+                    raise TypeError(
+                        f"Coordinate {cid!r} has an active set but no "
+                        "update_model_active method (active-set delta updates "
+                        "are a random-effect capability)"
+                    )
+                model, tracker = update_active(prev_model, partial, active)
+                guard = _device_guard(model, tracker)
             else:
                 model, tracker = coord.update_model(prev_model, partial)
                 guard = _device_guard(model, tracker)

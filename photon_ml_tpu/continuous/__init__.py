@@ -1,0 +1,52 @@
+"""Continuous training: incremental ingest, active-set coordinate descent,
+and the closed train→serve generation loop.
+
+The subsystem's three layers (docs/ARCHITECTURE.md "Continuous training"):
+
+- :mod:`photon_ml_tpu.continuous.manifest` — the append-only corpus manifest
+  (what the model has already absorbed; the scan diff IS the delta);
+- :mod:`photon_ml_tpu.continuous.ingest` — delta-only decode with stable
+  index-map growth (old indices frozen, unseen features append at the tail);
+- :mod:`photon_ml_tpu.continuous.active_set` /
+  :mod:`photon_ml_tpu.continuous.trainer` — the working-set selection rule,
+  the fixed-effect refresh reservoir, and the ``ContinuousTrainer`` driver
+  that commits each delta pass as a PR 3 checkpoint generation for PR 6's
+  hot-swap watcher to serve.
+
+Fault points ``continuous.{scan,delta_ingest,active_select,commit}`` make
+every phase of the loop chaos-testable (tests/test_chaos.py).
+"""
+
+from photon_ml_tpu.continuous.active_set import (
+    ActiveSelection,
+    ReservoirDownSampler,
+    select_active_entities,
+)
+from photon_ml_tpu.continuous.ingest import CorpusSnapshot, DeltaInfo, ingest_delta
+from photon_ml_tpu.continuous.manifest import (
+    CorpusContractViolation,
+    CorpusManifest,
+    PartFile,
+    file_fingerprint,
+)
+from photon_ml_tpu.continuous.trainer import (
+    ContinuousTrainer,
+    ContinuousTrainerConfig,
+    GenerationResult,
+)
+
+__all__ = [
+    "ActiveSelection",
+    "ContinuousTrainer",
+    "ContinuousTrainerConfig",
+    "CorpusContractViolation",
+    "CorpusManifest",
+    "CorpusSnapshot",
+    "DeltaInfo",
+    "GenerationResult",
+    "PartFile",
+    "ReservoirDownSampler",
+    "file_fingerprint",
+    "ingest_delta",
+    "select_active_entities",
+]

@@ -1,0 +1,126 @@
+"""Active-set selection: which work a delta pass actually does.
+
+Layer 2's selection rule (the shrinking-working-set trick of the
+distributed-CD literature — arxiv 1611.02101's blockwise updates, Snap ML
+1803.06333's hierarchical local solves — recast for generational retraining):
+a random-effect entity is RE-SOLVED in the delta pass iff
+
+1. it received new rows in the delta (its subproblem changed), or
+2. it is new (no previous-generation model row to keep), or
+3. its gradient norm at the warm-start coefficients exceeds a threshold —
+   the catch-up rule for entities whose RESIDUAL moved because other
+   coordinates updated, even though their own data did not
+   (algorithm/random_effect.random_effect_gradient_norms; opt-in, one cheap
+   vmapped forward/backward pass, no solver iterations).
+
+Everything else keeps the previous generation's coefficients bit for bit
+(algorithm/random_effect.train_random_effect_delta scatters around them).
+
+The FIXED effect has no per-entity structure to shrink; its refresh cost is
+bounded by a weight-masking reservoir instead: all delta rows keep weight 1,
+old rows keep a seeded without-replacement sample re-scaled by n_old/reservoir
+(unbiased, the down_sampler re-weighting argument), and dropped rows get
+weight 0 — masking, not filtering, because dropping rows would make device
+shapes dynamic (the same design as sampling/down_sampler.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.resilience import faultpoint, register_fault_point
+
+FP_ACTIVE_SELECT = register_fault_point("continuous.active_select")
+
+
+@dataclasses.dataclass
+class ActiveSelection:
+    """One coordinate's delta-pass working set, with the why."""
+
+    mask: np.ndarray  # bool [E] over the dataset's entity rows
+    n_new_data: int
+    n_new_entities: int
+    n_gradient: int  # selected by the gradient screen alone
+
+    @property
+    def n_active(self) -> int:
+        return int(self.mask.sum())
+
+
+def select_active_entities(
+    dataset,
+    delta_entity_ids: set,
+    prev_model=None,
+    gradient_norms: Optional[np.ndarray] = None,
+    gradient_threshold: Optional[float] = None,
+) -> ActiveSelection:
+    """The selection rule over one RandomEffectDataset's entity rows.
+
+    ``delta_entity_ids``: entities with new rows (DeltaInfo.delta_entities).
+    ``prev_model``: the warm-start RandomEffectModel; entities not in its
+    ``entity_ids`` are forced active. ``gradient_norms`` (host [E], from
+    random_effect_gradient_norms) with ``gradient_threshold`` arms rule 3.
+    """
+    faultpoint(FP_ACTIVE_SELECT)
+    entity_ids = dataset.entity_ids
+    E = len(entity_ids)
+    # vectorized membership (np.isin), not per-entity Python loops: selection
+    # must stay O(E) C work, never O(E) interpreter work — it runs over the
+    # FULL entity set every poll of a pass whose claim is delta-proportional
+    ids_arr = np.asarray(entity_ids)
+    if delta_entity_ids:
+        new_data = np.isin(ids_arr, np.asarray(tuple(delta_entity_ids)))
+    else:
+        new_data = np.zeros(E, dtype=bool)
+    if prev_model is not None and len(prev_model.entity_ids):
+        new_entity = ~np.isin(ids_arr, np.asarray(prev_model.entity_ids))
+    else:
+        new_entity = np.ones(E, dtype=bool)
+    mask = new_data | new_entity
+    n_gradient = 0
+    if gradient_norms is not None and gradient_threshold is not None:
+        norms = np.asarray(gradient_norms, dtype=np.float64)
+        if norms.shape != (E,):
+            raise ValueError(f"gradient_norms shape {norms.shape} != ({E},)")
+        screened = (norms > float(gradient_threshold)) & ~mask
+        n_gradient = int(screened.sum())
+        mask = mask | screened
+    return ActiveSelection(
+        mask=mask,
+        n_new_data=int(new_data.sum()),
+        n_new_entities=int((new_entity & ~new_data).sum()),
+        n_gradient=n_gradient,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ReservoirDownSampler:
+    """Fixed-effect refresh reservoir (the ``down_sampler`` protocol of
+    FixedEffectCoordinate): rows at or beyond ``n_old`` (the delta) always
+    train at full weight; of the ``n_old`` historical rows, a seeded
+    without-replacement sample of ``reservoir_size`` keeps weight scaled by
+    n_old/reservoir_size (unbiased loss estimate), the rest are weight-0
+    masked. ``reservoir_size >= n_old`` is the identity."""
+
+    n_old: int
+    reservoir_size: int
+    seed: int = 0
+
+    def down_sample(self, data, sample_ids=None):
+        n = int(data.weights.shape[0])
+        n_old = min(self.n_old, n)
+        if self.reservoir_size >= n_old:
+            return data
+        rng = np.random.default_rng(self.seed)
+        keep = rng.choice(n_old, size=self.reservoir_size, replace=False)
+        factor = np.zeros(n, dtype=np.float64)
+        factor[keep] = n_old / self.reservoir_size
+        factor[n_old:] = 1.0
+        return dataclasses.replace(
+            data,
+            weights=data.weights * jnp.asarray(factor, dtype=data.weights.dtype),
+        )

@@ -1,0 +1,199 @@
+"""Incremental corpus ingest: decode ONLY the delta, append to the corpus.
+
+Layer 1 of the continuous-training subsystem. A delta pass must never pay
+O(corpus) decode: new part files (manifest.scan's output) go through the PR 5
+parallel streaming pipeline (data/readers.read_merged_avro) WITHOUT index
+maps, producing a self-contained delta block; the accumulated corpus then
+grows by
+
+- **stable index-map growth** — every shard's IndexMap is ``extend()``-ed
+  with the delta's unseen feature keys: existing (key -> index) pairs are
+  frozen, new keys append at the tail. The old feature matrices stay valid
+  verbatim (their column ids never move; widening a CSR matrix is a shape
+  annotation), and a previous generation's fixed-effect coefficient vector
+  aligns with the grown feature space by zero-padding at the tail — alignment
+  BY CONSTRUCTION, no remapping of old state ever;
+- **column remap of the delta** — the delta block was decoded against its own
+  (sorted, local) index maps; a permutation per shard rewrites its CSR column
+  ids into the grown map's space (O(delta nnz));
+- **row append** — labels/offsets/weights/id columns/uids concatenate; new
+  rows occupy ``[n_old, n_new)`` on the global sample axis, so "which entities
+  received data" falls out of the delta's id columns directly.
+
+Determinism contract (the chaos bar leans on it): re-ingesting the WHOLE
+manifest in order with the final frozen index maps reproduces the
+progressively accumulated corpus bit for bit — that is how a restarted
+trainer rebuilds its in-memory corpus from a checkpoint generation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.readers import read_merged_avro
+from photon_ml_tpu.resilience import faultpoint, register_fault_point
+
+FP_DELTA_INGEST = register_fault_point("continuous.delta_ingest")
+
+
+@dataclasses.dataclass
+class CorpusSnapshot:
+    """The accumulated in-memory corpus at one generation."""
+
+    data: GameInput
+    index_maps: dict[str, IndexMap]
+    uids: np.ndarray
+
+    @property
+    def n_rows(self) -> int:
+        return self.data.n
+
+
+@dataclasses.dataclass
+class DeltaInfo:
+    """What one incremental ingest added."""
+
+    n_new_rows: int
+    row_start: int  # delta rows occupy [row_start, row_start + n_new_rows)
+    # id tag -> entity ids observed in the delta rows (the new-data half of
+    # the active-set selection rule)
+    delta_entities: dict[str, set]
+    # shard -> feature count growth (tail-appended columns)
+    new_features: dict[str, int]
+    n_new_files: int
+
+
+def _widen_csr(m: sp.csr_matrix, width: int) -> sp.csr_matrix:
+    """Tail growth is a shape annotation: existing column ids stay valid."""
+    if m.shape[1] == width:
+        return m
+    return sp.csr_matrix((m.data, m.indices, m.indptr), shape=(m.shape[0], width))
+
+
+def _remap_columns(m: sp.csr_matrix, perm: np.ndarray, width: int) -> sp.csr_matrix:
+    """Rewrite a delta matrix's column ids through ``perm`` (delta-map index
+    -> grown-map index) and re-canonicalize (sorted indices per row)."""
+    out = sp.csr_matrix(
+        (m.data.copy(), perm[m.indices], m.indptr.copy()), shape=(m.shape[0], width)
+    )
+    out.sort_indices()
+    return out
+
+
+def read_corpus(
+    files: Sequence[str],
+    shard_configs: Mapping,
+    index_maps: Optional[dict],
+    id_tags: Sequence[str],
+    ingest_workers: Optional[int] = None,
+):
+    """One read_merged_avro call over an explicit ordered file list (the PR 5
+    pipeline underneath). With ``index_maps`` given the maps are FROZEN:
+    this is the corpus-rebuild path of a restarted trainer."""
+    data, maps, uids = read_merged_avro(
+        list(files),
+        shard_configs,
+        index_maps=dict(index_maps) if index_maps else None,
+        id_tags=tuple(id_tags),
+        ingest_workers=ingest_workers,
+    )
+    return data, maps, np.asarray(uids, dtype=object)
+
+
+def ingest_delta(
+    snapshot: Optional[CorpusSnapshot],
+    new_files: Sequence[str],
+    shard_configs: Mapping,
+    id_tags: Sequence[str],
+    ingest_workers: Optional[int] = None,
+) -> tuple[CorpusSnapshot, DeltaInfo]:
+    """Decode ``new_files`` only and append them to ``snapshot`` (None =
+    bootstrap: the delta IS the corpus). Returns the grown snapshot and what
+    changed. Decode and column remap are O(delta); the row append is an
+    O(corpus) host memcpy (``sp.vstack``/``np.concatenate`` rebuild the old
+    block and transiently hold ~2x the corpus) — cheap next to decode at the
+    horizons this targets, and the reason unbounded corpora need the
+    ROADMAP's manifest-compaction / corpus-eviction item."""
+    faultpoint(FP_DELTA_INGEST)
+    if not new_files:
+        raise ValueError("ingest_delta called with no new files")
+
+    delta_data, delta_maps, delta_uids = read_corpus(
+        new_files, shard_configs, None, id_tags, ingest_workers
+    )
+    if delta_data.labels is None:
+        raise ValueError(
+            f"delta part files carry no labels; a training corpus must "
+            f"(files: {list(new_files)[:3]}...)"
+        )
+
+    if snapshot is None:
+        grown = CorpusSnapshot(
+            data=delta_data, index_maps=dict(delta_maps), uids=delta_uids
+        )
+        info = DeltaInfo(
+            n_new_rows=delta_data.n,
+            row_start=0,
+            delta_entities={
+                tag: set(delta_data.ids(tag)) for tag in id_tags
+            },
+            new_features={s: m.size for s, m in delta_maps.items()},
+            n_new_files=len(new_files),
+        )
+        return grown, info
+
+    old = snapshot.data
+    if old.labels is None:
+        raise ValueError("accumulated corpus lost its labels")
+
+    grown_maps: dict[str, IndexMap] = {}
+    features: dict[str, sp.csr_matrix] = {}
+    new_features: dict[str, int] = {}
+    for shard in shard_configs:
+        old_map = snapshot.index_maps[shard]
+        delta_map = delta_maps[shard]
+        ext = old_map.extend(delta_map.keys())
+        grown_maps[shard] = ext
+        new_features[shard] = ext.size - old_map.size
+        perm = np.fromiter(
+            (ext.get_index(k) for k in delta_map.keys()),
+            dtype=np.int64,
+            count=delta_map.size,
+        )
+        if (perm < 0).any():  # cannot happen: ext covers every delta key
+            raise AssertionError(f"grown index map lost delta keys for {shard!r}")
+        old_m = _widen_csr(old.shard(shard).tocsr(), ext.size)
+        delta_m = _remap_columns(delta_data.shard(shard).tocsr(), perm, ext.size)
+        features[shard] = sp.vstack([old_m, delta_m], format="csr")
+
+    grown_data = GameInput(
+        features=features,
+        labels=np.concatenate([np.asarray(old.labels), np.asarray(delta_data.labels)]),
+        offsets=np.concatenate([np.asarray(old.offsets), np.asarray(delta_data.offsets)]),
+        weights=np.concatenate([np.asarray(old.weights), np.asarray(delta_data.weights)]),
+        id_columns={
+            tag: np.concatenate(
+                [np.asarray(old.ids(tag)), np.asarray(delta_data.ids(tag))]
+            )
+            for tag in id_tags
+        },
+    )
+    grown = CorpusSnapshot(
+        data=grown_data,
+        index_maps=grown_maps,
+        uids=np.concatenate([snapshot.uids, delta_uids]),
+    )
+    info = DeltaInfo(
+        n_new_rows=delta_data.n,
+        row_start=old.n,
+        delta_entities={tag: set(delta_data.ids(tag)) for tag in id_tags},
+        new_features=new_features,
+        n_new_files=len(new_files),
+    )
+    return grown, info

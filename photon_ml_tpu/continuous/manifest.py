@@ -1,0 +1,158 @@
+"""Append-only corpus manifest: what the continuous trainer has already seen.
+
+Production GLMix corpora grow by PART FILES: an upstream ETL drops new
+``part-*.avro`` files into the corpus directories and never rewrites old ones
+(the reference's daily-partition layout, GameDriver inputDataDateRange). The
+manifest is the trainer's durable record of that contract: an ordered list of
+every part file ingested so far with its size and content fingerprint. It is
+persisted INSIDE each committed checkpoint generation (io/checkpoint.py
+``extra_state``), so a restarted trainer knows exactly which files its
+warm-start model has already absorbed — the set difference against a fresh
+directory scan IS the delta.
+
+The append-only contract is verified, not assumed: a known file whose size
+changed, or a known file that disappeared, fails the scan loudly (a rewritten
+part file would silently corrupt the incremental corpus — rows the model
+trained on would no longer exist in any re-ingest).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from typing import Sequence
+
+from photon_ml_tpu.resilience import faultpoint, register_fault_point
+
+FP_SCAN = register_fault_point("continuous.scan")
+
+
+class CorpusContractViolation(Exception):
+    """The corpus broke the append-only contract (a known part file changed
+    size or vanished). Not recoverable by retrying: the incremental state no
+    longer describes the corpus, so the operator must retrain from scratch
+    (clear the checkpoint directory) or restore the corpus."""
+
+
+def file_fingerprint(path: str) -> str:
+    """SHA-256 of the file's content. Computed once per NEW file at ingest
+    time (O(delta) I/O per generation, never O(corpus))."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class PartFile:
+    """One ingested part file. ``path`` is stored ABSOLUTE: the persisted
+    manifest must compare equal to a fresh scan after a restart from a
+    different working directory, where the same relative corpus path spells
+    differently. Order in the manifest is ingest order — the row order of
+    the accumulated corpus."""
+
+    path: str
+    size: int
+    sha256: str
+
+
+@dataclasses.dataclass(frozen=True)
+class CorpusManifest:
+    """Immutable ordered part-file record; ``extend`` returns a grown copy."""
+
+    entries: tuple = ()
+
+    @property
+    def paths(self) -> tuple:
+        return tuple(e.path for e in self.entries)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def scan(self, corpus_paths: Sequence[str]) -> list[str]:
+        """List the corpus and return part files NOT yet in the manifest, in
+        listing order (the order they will be ingested). Known files are
+        verified cheaply (existence + size); any append-only violation raises
+        :class:`CorpusContractViolation`."""
+        from photon_ml_tpu.data import avro_io
+
+        faultpoint(FP_SCAN)
+        listed = [
+            os.path.abspath(p)
+            for p in avro_io.container_files(list(corpus_paths))
+        ]
+        listed_set = set(listed)
+        known = {e.path: e for e in self.entries}
+        for path, entry in known.items():
+            if path not in listed_set:
+                raise CorpusContractViolation(
+                    f"ingested part file disappeared from the corpus: {path}"
+                )
+            size = os.path.getsize(path)
+            if size != entry.size:
+                raise CorpusContractViolation(
+                    f"ingested part file changed size ({entry.size} -> {size}); "
+                    f"the corpus is append-only: {path}"
+                )
+        return [p for p in listed if p not in known]
+
+    def extend(self, new_files: Sequence[str]) -> "CorpusManifest":
+        """Grown manifest with ``new_files`` appended. Call BEFORE decoding
+        them and :meth:`verify_sizes` the new entries after: recording the
+        size/fingerprint first and re-checking after the decode brackets the
+        read, so a file an upstream writer was still appending to fails
+        loudly instead of persisting a record that disagrees with the rows
+        the model actually absorbed."""
+        new_entries = tuple(
+            PartFile(
+                path=os.path.abspath(p),
+                size=os.path.getsize(p),
+                sha256=file_fingerprint(p),
+            )
+            for p in new_files
+        )
+        return CorpusManifest(entries=self.entries + new_entries)
+
+    def verify_sizes(self, entries: Sequence[PartFile] = None) -> None:
+        """Loud check that ``entries`` (default: all) still match their
+        recorded on-disk sizes — the torn-write guard around a delta decode."""
+        for e in self.entries if entries is None else entries:
+            size = os.path.getsize(e.path) if os.path.exists(e.path) else -1
+            if size != e.size:
+                raise CorpusContractViolation(
+                    f"part file changed size during ingest ({e.size} -> {size}); "
+                    f"the corpus is append-only: {e.path}"
+                )
+
+    def verify_fingerprints(self) -> None:
+        """Full content verification of every recorded part file against its
+        persisted SHA-256: catches a SAME-SIZE rewrite that the cheap per-scan
+        size check cannot. O(corpus) I/O, so this runs at restart only — where
+        the trainer re-reads the whole corpus anyway — never per poll."""
+        for e in self.entries:
+            if not os.path.exists(e.path):
+                raise CorpusContractViolation(
+                    f"ingested part file disappeared from the corpus: {e.path}"
+                )
+            actual = file_fingerprint(e.path)
+            if actual != e.sha256:
+                raise CorpusContractViolation(
+                    f"part file content changed since ingest (sha256 "
+                    f"{e.sha256[:12]}… -> {actual[:12]}…); the corpus is "
+                    f"append-only: {e.path}"
+                )
+
+    # -- persistence (rides in the checkpoint manifest's extra_state) ----------
+
+    def to_dict(self) -> dict:
+        return {
+            "entries": [dataclasses.asdict(e) for e in self.entries],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "CorpusManifest":
+        return CorpusManifest(
+            entries=tuple(PartFile(**e) for e in d.get("entries", []))
+        )

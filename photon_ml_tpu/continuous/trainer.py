@@ -1,0 +1,562 @@
+"""The continuous-training generation loop: poll → delta → commit → serve.
+
+Layer 3 wires the subsystem into the full photon-ml-tpu story as ONE
+unattended process per model:
+
+1. **poll** — ``CorpusManifest.scan`` diffs the corpus directories against
+   the manifest persisted in the last committed generation; no new part
+   files, no work.
+2. **delta pass** — ``ingest.ingest_delta`` decodes only the new files and
+   grows the corpus (stable index-map growth); datasets rebuild with the
+   previous generation's entity ROW ORDER pinned
+   (``build_random_effect_dataset(entity_order=...)``) so the old coefficient
+   tables align by construction; ``active_set.select_active_entities`` picks
+   the working set (new data ∪ new entities ∪ gradient screen); coordinate
+   descent runs with ``active_sets`` — random effects re-solve only the
+   active entities via the shared vmapped solver body, the fixed effect
+   refreshes over a weight-masked reservoir of old+new rows, and the
+   divergence guard / incident machinery from PR 3/4 applies unchanged.
+3. **commit** — the new model state lands as a PR 3 generational checkpoint
+   ``gen-<n>/`` (staged + renamed, SHA-256 manifest) carrying the corpus
+   manifest and delta stats in ``extra_state`` and the frozen index maps as
+   ``aux`` artifacts — everything a restarted trainer needs to rebuild its
+   corpus and resume, and exactly the layout PR 6's ``GenerationWatcher``
+   polls, so a committed delta generation hot-swaps into live serving with
+   zero downtime.
+
+Crash safety: nothing durable mutates until the atomic checkpoint commit, so
+a crash anywhere in a delta pass (``continuous.*`` fault points) simply
+replays the pass on restart from the previous generation — bit-identically,
+because every input (manifest order, frozen index maps, entity order, seeded
+reservoir) is restored from the committed generation. The optional per-
+generation model EXPORT (reference BayesianLinearModelAvro bytes, which are
+byte-deterministic) is staged + renamed too, and re-exported idempotently on
+restart if a crash separated it from its commit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import shutil
+import time
+from typing import Mapping, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.algorithm.coordinate import FixedEffectCoordinate
+from photon_ml_tpu.algorithm.coordinate_descent import run_coordinate_descent
+from photon_ml_tpu.algorithm.random_effect import random_effect_gradient_norms
+from photon_ml_tpu.continuous.active_set import (
+    ReservoirDownSampler,
+    select_active_entities,
+)
+from photon_ml_tpu.continuous.ingest import CorpusSnapshot, ingest_delta, read_corpus
+from photon_ml_tpu.continuous.manifest import CorpusManifest
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.estimators.config import RandomEffectDataConfiguration
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.resilience import faultpoint, register_fault_point
+from photon_ml_tpu.types import TaskType
+
+logger = logging.getLogger(__name__)
+
+FP_COMMIT = register_fault_point("continuous.commit")
+
+_AUX_INDEX_MAP_PREFIX = "index-map-"
+
+
+@dataclasses.dataclass
+class ContinuousTrainerConfig:
+    """Static configuration of one continuous trainer process."""
+
+    corpus_paths: Sequence[str]
+    checkpoint_directory: str
+    task: TaskType
+    coordinate_configurations: Mapping  # {cid: CoordinateConfiguration}, ordered
+    shard_configurations: Mapping  # {shard_id: FeatureShardConfiguration}
+    delta_iterations: int = 1  # coordinate-descent iterations per delta pass
+    initial_iterations: int = 1  # iterations for the bootstrap full train
+    # rule-3 screen: re-solve entities whose subproblem gradient norm exceeds
+    # this even without new rows (None = new-data/new-entity rules only)
+    gradient_threshold: Optional[float] = None
+    # fixed-effect refresh reservoir: how many OLD rows keep weight in a
+    # delta pass (None = all of them; delta rows always train)
+    fe_reservoir: Optional[int] = None
+    export_directory: Optional[str] = None  # per-generation model export
+    ingest_workers: Optional[int] = None
+    keep_generations: int = 8
+    seed: int = 0
+    dtype: object = jnp.float32
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    """One committed generation's paper trail."""
+
+    generation: int
+    kind: str  # "bootstrap" | "delta"
+    n_rows: int
+    n_new_rows: int
+    checkpoint_path: str
+    # cid -> {n_entities, n_active, active_fraction, n_new_data,
+    #         n_new_entities, n_gradient, n_solved_lanes}
+    active: dict
+    incidents: list
+    timings: dict  # phase -> seconds
+
+    @property
+    def active_fraction(self) -> float:
+        """Aggregate re-solved fraction across random-effect coordinates."""
+        tot = sum(a["n_entities"] for a in self.active.values())
+        act = sum(a["n_active"] for a in self.active.values())
+        return act / tot if tot else 0.0
+
+
+class ContinuousTrainer:
+    """Drives the ingest → active-set train → commit loop for one model.
+
+    Construct it pointed at a checkpoint directory: an existing continuous
+    checkpoint is restored (warm state, corpus rebuilt from the persisted
+    manifest with frozen index maps), otherwise the first ``poll_once`` with
+    data bootstraps generation 1 with a full train. Call :meth:`poll_once`
+    from a control loop (or :meth:`run`)."""
+
+    def __init__(self, config: ContinuousTrainerConfig):
+        self.config = config
+        self.task = TaskType(config.task)
+        from photon_ml_tpu.estimators.config import expand_game_configurations
+
+        sweep = expand_game_configurations(config.coordinate_configurations)
+        if len(sweep) != 1:
+            raise ValueError(
+                f"continuous training drives ONE optimization configuration; "
+                f"the given coordinate configurations expand to {len(sweep)} "
+                "(drop the extra regularization weights)"
+            )
+        self.opt_configs = sweep[0]
+        self.estimator = GameEstimator(
+            task=self.task,
+            coordinate_configurations=config.coordinate_configurations,
+            n_iterations=config.delta_iterations,
+            dtype=config.dtype,
+        )
+        self.re_types = {
+            cid: cfg.data_config.random_effect_type
+            for cid, cfg in config.coordinate_configurations.items()
+            if isinstance(cfg.data_config, RandomEffectDataConfiguration)
+        }
+        if config.fe_reservoir is not None:
+            for cid, cfg in config.coordinate_configurations.items():
+                if cid in self.re_types:
+                    continue
+                if 0.0 < getattr(cfg, "down_sampling_rate", 1.0) < 1.0:
+                    # the reservoir REPLACES the coordinate's down-sampler on
+                    # delta passes: combining them would train the bootstrap
+                    # under the configured sampling weights and every delta
+                    # under reservoir weights — two different FE objectives
+                    raise ValueError(
+                        f"fe_reservoir cannot be combined with coordinate "
+                        f"{cid!r}'s down.sampling.rate="
+                        f"{cfg.down_sampling_rate}; drop one of the two"
+                    )
+        self.id_tags = sorted(set(self.re_types.values()))
+        self.manifest = CorpusManifest()
+        self.snapshot: Optional[CorpusSnapshot] = None
+        self.models: Optional[dict] = None
+        self.generation = 0
+        self.last_result: Optional[GenerationResult] = None
+        self._restore()
+
+    # ------------------------------------------------------------- restore
+
+    def _fingerprint(self) -> str:
+        parts = [f"continuous|{self.task.value}"]
+        for cid in sorted(self.config.coordinate_configurations):
+            parts.append(f"{cid}={self.opt_configs[cid]!r}")
+        return "|".join(parts)
+
+    def _restore(self) -> None:
+        restored = load_checkpoint(
+            self.config.checkpoint_directory,
+            dtype=self.config.dtype,
+            fingerprint=self._fingerprint(),
+        )
+        if restored is None:
+            return
+        extra = (restored.get("extra") or {}).get("continuous")
+        if extra is None:
+            logger.warning(
+                "checkpoint %s carries no continuous-training state; starting "
+                "a fresh corpus history on top of it",
+                self.config.checkpoint_directory,
+            )
+            return
+        index_maps = {}
+        aux = restored.get("aux") or {}
+        for shard in self.config.shard_configurations:
+            arrs = aux.get(f"{_AUX_INDEX_MAP_PREFIX}{shard}")
+            if arrs is None:
+                raise ValueError(
+                    f"continuous checkpoint is missing the frozen index map "
+                    f"for shard {shard!r}; cannot rebuild the corpus"
+                )
+            index_maps[shard] = IndexMap([str(n) for n in arrs["names"]])
+        self.manifest = CorpusManifest.from_dict(extra["corpus_manifest"])
+        # full-content check BEFORE the rebuild read: a same-size rewrite of
+        # an ingested part file (size checks pass) would otherwise rebuild a
+        # corpus that silently differs from what the warm-start model absorbed
+        self.manifest.verify_fingerprints()
+        data, _maps, uids = read_corpus(
+            self.manifest.paths,
+            self.config.shard_configurations,
+            index_maps,
+            self.id_tags,
+            self.config.ingest_workers,
+        )
+        self.snapshot = CorpusSnapshot(data=data, index_maps=index_maps, uids=uids)
+        self.models = restored["models"]
+        self.generation = int(restored.get("generation") or 0)
+        logger.info(
+            "restored continuous state: generation %d, %d corpus rows, "
+            "%d part files",
+            self.generation,
+            data.n,
+            len(self.manifest),
+        )
+        # a crash between commit and export leaves the export missing: redo
+        # it idempotently (export bytes are a pure function of the models)
+        self._maybe_export(self.generation)
+
+    # --------------------------------------------------------------- export
+
+    def _index_maps_by_coord(self) -> dict:
+        return {
+            cid: self.snapshot.index_maps[cfg.data_config.feature_shard_id]
+            for cid, cfg in self.config.coordinate_configurations.items()
+        }
+
+    def _maybe_export(self, generation: int) -> Optional[str]:
+        if self.config.export_directory is None or self.models is None:
+            return None
+        from photon_ml_tpu.io.model_io import save_game_model
+
+        target = os.path.join(
+            self.config.export_directory, f"gen-{generation:08d}"
+        )
+        if os.path.isdir(target):
+            return target
+        tmp = target + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        save_game_model(tmp, GameModel(models=self.models), self._index_maps_by_coord())
+        os.rename(tmp, target)
+        return target
+
+    # ------------------------------------------------------------ delta pass
+
+    def _pad_fixed_effect(self, model: FixedEffectModel, dim: int) -> FixedEffectModel:
+        """Stable feature growth for the fixed effect: unseen features append
+        at the index-map tail, so the previous coefficient vector aligns by
+        zero-padding at the tail — no remapping."""
+        coef = model.model.coefficients
+        means = coef.means
+        if means.shape[0] >= dim:
+            return model
+        pad = dim - means.shape[0]
+        means = jnp.concatenate([means, jnp.zeros((pad,), dtype=means.dtype)])
+        variances = coef.variances
+        if variances is not None:
+            variances = jnp.concatenate(
+                [variances, jnp.zeros((pad,), dtype=variances.dtype)]
+            )
+        new_coef = dataclasses.replace(coef, means=means, variances=variances)
+        return dataclasses.replace(
+            model, model=dataclasses.replace(model.model, coefficients=new_coef)
+        )
+
+    def _adapted_models(self, datasets: dict) -> dict:
+        """Previous-generation models adapted to the grown datasets: fixed
+        effects zero-pad to the grown feature dim, random effects re-layout
+        by entity id (tail growth makes this a cheap identity-or-append)."""
+        out = {}
+        for cid, model in self.models.items():
+            ds = datasets[cid]
+            if isinstance(model, FixedEffectModel):
+                out[cid] = self._pad_fixed_effect(model, ds.dim)
+            elif isinstance(model, RandomEffectModel):
+                out[cid] = model.aligned_to(ds)
+            else:
+                out[cid] = model
+        return out
+
+    def _select_active_sets(
+        self, datasets: dict, adapted: dict, delta_entities: dict
+    ) -> tuple[dict, dict]:
+        """Per-RE-coordinate active masks + stats. The optional gradient
+        screen evaluates each coordinate's subproblem gradient at the
+        warm-start coefficients against the OTHER coordinates' current
+        scores (one cheap vmapped pass per bucket shape)."""
+        base_offsets = jnp.asarray(
+            np.asarray(self.snapshot.data.offsets), dtype=self.config.dtype
+        )
+        scores = None
+        if self.config.gradient_threshold is not None:
+            from photon_ml_tpu.algorithm.coordinate import score_model_on_dataset
+
+            scores = {
+                cid: score_model_on_dataset(adapted[cid], datasets[cid])
+                for cid in datasets
+            }
+            total = sum(scores.values())
+        active_sets: dict = {}
+        stats: dict = {}
+        for cid, re_type in self.re_types.items():
+            ds = datasets[cid]
+            norms = None
+            if scores is not None:
+                cfg = self.opt_configs[cid]
+                norms = random_effect_gradient_norms(
+                    ds,
+                    adapted[cid],
+                    base_offsets + (total - scores[cid]),
+                    self.task,
+                    l2=cfg.l2_weight,
+                    per_entity_reg_weights=self.config.coordinate_configurations[
+                        cid
+                    ].per_entity_reg_weights,
+                    dtype=self.config.dtype,
+                )
+            sel = select_active_entities(
+                ds,
+                delta_entities.get(re_type, set()),
+                prev_model=self.models.get(cid),
+                gradient_norms=norms,
+                gradient_threshold=self.config.gradient_threshold,
+            )
+            active_sets[cid] = sel.mask
+            stats[cid] = {
+                "n_entities": ds.n_entities,
+                "n_active": sel.n_active,
+                "active_fraction": sel.n_active / ds.n_entities
+                if ds.n_entities
+                else 0.0,
+                "n_new_data": sel.n_new_data,
+                "n_new_entities": sel.n_new_entities,
+                "n_gradient": sel.n_gradient,
+            }
+        return active_sets, stats
+
+    def poll_once(self) -> Optional[GenerationResult]:
+        """One turn of the loop: scan, and if the corpus grew, run a delta
+        pass (or the bootstrap full train) and commit the next generation.
+        Returns the committed generation's record, or None when idle."""
+        timings: dict = {}
+        t0 = time.perf_counter()
+        new_files = self.manifest.scan(self.config.corpus_paths)
+        timings["scan"] = time.perf_counter() - t0
+        if not new_files:
+            return None
+        bootstrap = self.models is None
+
+        t0 = time.perf_counter()
+        # record each new file's size/fingerprint BEFORE decoding it and
+        # re-verify after: the bracket turns a file an upstream writer was
+        # still appending to into a loud CorpusContractViolation instead of
+        # a manifest record that disagrees with the rows the model absorbed
+        grown_manifest = self.manifest.extend(new_files)
+        self_snapshot, delta = ingest_delta(
+            self.snapshot,
+            new_files,
+            self.config.shard_configurations,
+            self.id_tags,
+            self.config.ingest_workers,
+        )
+        grown_manifest.verify_sizes(grown_manifest.entries[len(self.manifest):])
+        timings["ingest"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        snapshot_prev = self.snapshot
+        self.snapshot = self_snapshot  # datasets/export helpers read it
+        try:
+            entity_orders = None
+            if self.models is not None:
+                entity_orders = {
+                    cid: self.models[cid].entity_ids
+                    for cid in self.re_types
+                    if isinstance(self.models.get(cid), RandomEffectModel)
+                }
+            datasets = self.estimator.prepare_training_datasets(
+                self.snapshot.data, entity_orders=entity_orders
+            )
+            timings["datasets"] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            active_sets = None
+            active_stats: dict = {}
+            initial_models = None
+            if not bootstrap:
+                adapted = self._adapted_models(datasets)
+                active_sets, active_stats = self._select_active_sets(
+                    datasets, adapted, delta.delta_entities
+                )
+                initial_models = adapted
+            else:
+                for cid, re_type in self.re_types.items():
+                    ds = datasets[cid]
+                    active_stats[cid] = {
+                        "n_entities": ds.n_entities,
+                        "n_active": ds.n_entities,
+                        "active_fraction": 1.0,
+                        "n_new_data": ds.n_entities,
+                        "n_new_entities": ds.n_entities,
+                        "n_gradient": 0,
+                    }
+            timings["select"] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            base_offsets = jnp.asarray(
+                np.asarray(self.snapshot.data.offsets), dtype=self.config.dtype
+            )
+            coordinates = {}
+            for cid in self.config.coordinate_configurations:
+                init = None if initial_models is None else initial_models.get(cid)
+                coord = self.estimator.build_coordinate(
+                    cid, datasets[cid], self.opt_configs[cid], base_offsets,
+                    initial_model=init,
+                )
+                if (
+                    not bootstrap
+                    and isinstance(coord, FixedEffectCoordinate)
+                    and self.config.fe_reservoir is not None
+                ):
+                    # deterministic per generation: a replayed delta pass
+                    # (crash resume) redraws the identical reservoir
+                    coord.down_sampler = ReservoirDownSampler(
+                        n_old=delta.row_start,
+                        reservoir_size=self.config.fe_reservoir,
+                        seed=self.config.seed + self.generation + 1,
+                    )
+                coordinates[cid] = coord
+            descent = run_coordinate_descent(
+                coordinates,
+                n_iterations=(
+                    self.config.initial_iterations
+                    if bootstrap
+                    else self.config.delta_iterations
+                ),
+                initial_models=initial_models,
+                active_sets=active_sets,
+            )
+            for cid, coord in coordinates.items():
+                st = getattr(coord, "last_active_stats", None)
+                if st is not None and cid in active_stats:
+                    active_stats[cid]["n_solved_lanes"] = st.n_solved_lanes
+            timings["descent"] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            faultpoint(FP_COMMIT)
+            extra_state = {
+                "continuous": {
+                    "kind": "bootstrap" if bootstrap else "delta",
+                    "corpus_manifest": grown_manifest.to_dict(),
+                    "n_rows": self.snapshot.n_rows,
+                    "n_new_rows": delta.n_new_rows,
+                    "n_new_files": delta.n_new_files,
+                    "active": active_stats,
+                }
+            }
+            aux_arrays = {
+                f"{_AUX_INDEX_MAP_PREFIX}{shard}": {
+                    "names": np.asarray(imap.keys())
+                }
+                for shard, imap in self.snapshot.index_maps.items()
+            }
+            path = save_checkpoint(
+                self.config.checkpoint_directory,
+                dict(descent.model.models),
+                completed_iterations=self.generation + 1,
+                fingerprint=self._fingerprint(),
+                incidents=descent.incidents,
+                keep_generations=self.config.keep_generations,
+                extra_state=extra_state,
+                aux_arrays=aux_arrays,
+            )
+        except BaseException:
+            # the pass did not commit durably: forget the half-grown
+            # in-memory state so a caller that survives (tests, control
+            # loops catching InjectedFault) can retry the poll cleanly —
+            # the retried poll re-scans the same delta and replays the pass
+            # bit-identically against the previous generation's snapshot
+            self.snapshot = snapshot_prev
+            raise
+
+        gen_num = int(os.path.basename(path).split("-")[-1])
+        self.manifest = grown_manifest
+        self.models = dict(descent.model.models)
+        self.generation = gen_num
+        timings["commit"] = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        self._maybe_export(gen_num)
+        timings["export"] = time.perf_counter() - t0
+
+        result = GenerationResult(
+            generation=gen_num,
+            kind="bootstrap" if bootstrap else "delta",
+            n_rows=self.snapshot.n_rows,
+            n_new_rows=delta.n_new_rows,
+            checkpoint_path=path,
+            active=active_stats,
+            incidents=[i.to_dict() for i in descent.incidents],
+            timings=timings,
+        )
+        self.last_result = result
+        logger.info(
+            "committed generation %d (%s): %d rows (+%d), active fraction "
+            "%.3f, %.2fs descent",
+            gen_num,
+            result.kind,
+            result.n_rows,
+            result.n_new_rows,
+            result.active_fraction,
+            timings["descent"],
+        )
+        return result
+
+    def run(
+        self,
+        poll_interval_s: float = 10.0,
+        max_generations: Optional[int] = None,
+        max_idle_polls: Optional[int] = None,
+        sleep=time.sleep,
+        on_generation=None,
+    ) -> list[GenerationResult]:
+        """Unattended loop: poll forever (or until ``max_generations``
+        commits / ``max_idle_polls`` consecutive empty scans). With
+        ``on_generation`` given, each committed generation's record is
+        STREAMED to the callback instead of accumulated (the returned list
+        stays empty) — the run-forever mode, where an unbounded list would
+        grow for the process lifetime."""
+        results: list[GenerationResult] = []
+        committed = 0
+        idle = 0
+        while True:
+            result = self.poll_once()
+            if result is not None:
+                if on_generation is not None:
+                    on_generation(result)
+                else:
+                    results.append(result)
+                committed += 1
+                idle = 0
+                if max_generations is not None and committed >= max_generations:
+                    return results
+            else:
+                idle += 1
+                if max_idle_polls is not None and idle >= max_idle_polls:
+                    return results
+            sleep(poll_interval_s)

@@ -67,6 +67,20 @@ class IndexMap:
         distinct = sorted(set(feature_keys))
         return IndexMap(distinct, add_intercept=add_intercept)
 
+    # -- growth ----------------------------------------------------------------
+
+    def extend(self, feature_keys: Iterable[str]) -> "IndexMap":
+        """Grown copy for incremental ingest (continuous/): every existing
+        (key -> index) pair is FROZEN — previously assigned indices never move,
+        so coefficient tables and persisted matrices indexed by this map stay
+        aligned across growth by construction. Unseen keys append at the tail
+        in sorted order (deterministic regardless of observation order).
+        Returns ``self`` unchanged when nothing is new."""
+        unseen = sorted(set(feature_keys) - set(self._index))
+        if not unseen:
+            return self
+        return IndexMap(self._names + unseen)
+
     # -- persistence -----------------------------------------------------------
 
     def save(self, path: str) -> None:

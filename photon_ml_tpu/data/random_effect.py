@@ -239,6 +239,7 @@ def build_random_effect_dataset(
     bucket_merge_fraction: Optional[float] = None,
     scoring_only: bool = False,
     projector: Optional[object] = None,
+    entity_order: Optional[Sequence] = None,
 ) -> RandomEffectDataset:
     """Host-side construction of the bucketed random-effect dataset.
 
@@ -261,6 +262,13 @@ def build_random_effect_dataset(
       projector (make_projector(..., normalization=...)), not this function's
       ``normalization`` argument, so scoring datasets (which never see the
       training normalization) stay consistent.
+    - ``entity_order``: STABLE entity-row growth for incremental training
+      (continuous/): entities appearing in this sequence keep its relative
+      order (row i of the previous generation's table stays row i as long as
+      the entity still trains), unseen entities append at the tail in sorted
+      order — so a previous generation's coefficient table aligns with the
+      grown dataset by construction. Default (None) keeps the historical
+      fully sorted order.
     """
     if projector is not None:
         if normalization is not None and projector.normalization is None:
@@ -320,7 +328,15 @@ def build_random_effect_dataset(
 
     # lower-bound filter: entities below the threshold train no model
     entities = [e for e, rows in active_rows.items() if len(rows) >= active_data_lower_bound]
-    entities.sort()
+    if entity_order is not None:
+        # stable growth: known entities keep the caller's row order, unseen
+        # ones append sorted at the tail (continuous-training alignment)
+        present = set(entities)
+        known = [e for e in entity_order if e in present]
+        known_set = set(known)
+        entities = known + sorted(e for e in entities if e not in known_set)
+    else:
+        entities.sort()
     row_of_entity = {e: i for i, e in enumerate(entities)}
     n_ent = len(entities)
     labels_arr = None if labels is None else np.asarray(labels, dtype=np.float64)
@@ -389,10 +405,13 @@ def build_random_effect_dataset(
     s_ent_rows = np.full(n, -1, dtype=np.int32)
     uniq = np.asarray(entities)
     if len(uniq):
-        pos = np.searchsorted(uniq, ent)
-        pos_clipped = np.clip(pos, 0, len(uniq) - 1)
-        hit = uniq[pos_clipped] == ent
-        s_ent_rows = np.where(hit, pos_clipped, -1).astype(np.int32)
+        # entity_order may leave `uniq` unsorted: search through a sorter so
+        # the lookup stays vectorized either way (identity when sorted)
+        sorter = np.argsort(uniq, kind="mergesort")
+        pos = np.searchsorted(uniq, ent, sorter=sorter)
+        rows = sorter[np.clip(pos, 0, len(uniq) - 1)]
+        hit = uniq[rows] == ent
+        s_ent_rows = np.where(hit, rows, -1).astype(np.int32)
 
     local = np.full(X.nnz, -1, dtype=np.int32)
     if n and X.nnz:

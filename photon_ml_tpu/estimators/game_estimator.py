@@ -189,9 +189,17 @@ class GameEstimator:
             return NO_NORMALIZATION
         return self.normalization_contexts.get(shard, NO_NORMALIZATION)
 
-    def prepare_training_datasets(self, data: GameInput) -> dict[str, object]:
+    def prepare_training_datasets(
+        self, data: GameInput, entity_orders: Optional[Mapping] = None
+    ) -> dict[str, object]:
         """GameInput -> per-coordinate device datasets
-        (GameEstimator.prepareTrainingDatasets:454-557). Built once per fit."""
+        (GameEstimator.prepareTrainingDatasets:454-557). Built once per fit.
+
+        ``entity_orders`` ({coordinate_id: previous entity_ids sequence})
+        pins random-effect entity ROW order across incremental rebuilds:
+        known entities keep their previous rows, new ones append at the tail
+        — the stable-growth contract of continuous training
+        (data/random_effect.build_random_effect_dataset)."""
         if not data.has_labels:
             raise ValueError("Training data must carry labels")
         datasets: dict[str, object] = {}
@@ -236,6 +244,9 @@ class GameEstimator:
                     ),
                     dtype=self.dtype,
                     projector=projector,
+                    entity_order=(
+                        None if entity_orders is None else entity_orders.get(cid)
+                    ),
                 )
             else:
                 raise TypeError(f"Unknown data configuration {type(dc).__name__}")

@@ -65,6 +65,7 @@ logger = logging.getLogger(__name__)
 STATE_FILE = "state.json"
 STATE_SHA_FILE = "state.json.sha256"
 BEST_DIR = "best"
+AUX_DIR = "aux"
 GEN_PREFIX = "gen-"
 QUARANTINE_SUFFIX = ".corrupt"
 DEFAULT_KEEP_GENERATIONS = 3
@@ -320,6 +321,8 @@ def save_checkpoint(
     incidents: Optional[list] = None,
     keep_generations: int = DEFAULT_KEEP_GENERATIONS,
     retry: Optional[Retry] = None,
+    extra_state: Optional[dict] = None,
+    aux_arrays: Optional[dict] = None,
 ) -> str:
     """Write a NEW checkpoint generation (staging dir + rename); returns its
     path. Keeps the newest ``keep_generations`` generations, pruning older
@@ -331,7 +334,17 @@ def save_checkpoint(
     ``incidents`` (list of Incident or dicts) persists the run's survived-
     failure history into the manifest. Transient OSErrors retry with backoff;
     each attempt restages from scratch, so a failed attempt leaves nothing
-    half-written."""
+    half-written.
+
+    ``extra_state`` (JSON-serializable dict) rides inside the manifest —
+    subsystem metadata such as the continuous-training corpus manifest and
+    delta stats (photon_ml_tpu/continuous/). ``aux_arrays``
+    ({name: {array_name: ndarray}}) persists non-model array artifacts (e.g.
+    per-shard index-map name tables) as ``aux/<name>.npz`` under the same
+    SHA-256 integrity regime as the model files; arrays must be
+    pickle-free (numeric or unicode dtypes). Both round-trip through
+    ``load_generation``/``load_checkpoint`` as the ``extra`` and ``aux``
+    keys."""
     if keep_generations < 1:
         raise ValueError(f"keep_generations must be >= 1, got {keep_generations}")
     root = os.path.abspath(directory)
@@ -365,6 +378,8 @@ def save_checkpoint(
             "best_models": None,
             "incidents": incident_dicts,
             "checksums": {},
+            "extra": extra_state,
+            "aux": sorted(aux_arrays) if aux_arrays else [],
         }
         _write_models(tmp, "", models, state["models"], state["checksums"])
         if best_models is not None:
@@ -373,6 +388,18 @@ def save_checkpoint(
             _write_models(
                 tmp, BEST_DIR, best_models, state["best_models"], state["checksums"]
             )
+        if aux_arrays:
+            os.makedirs(os.path.join(tmp, AUX_DIR))
+            for name in sorted(aux_arrays):
+                if "/" in name or os.sep in name or name.startswith("."):
+                    raise ValueError(f"aux artifact name {name!r} must be a flat name")
+                rel = os.path.join(AUX_DIR, f"{name}.npz")
+                path = os.path.join(tmp, rel)
+                action = faultpoint(FP_WRITE_ARRAYS)
+                np.savez(path, **aux_arrays[name])
+                state["checksums"][rel] = _sha256_file(path)
+                if action == "corrupt":
+                    corrupt_file(path)
 
         action = faultpoint(FP_WRITE_MANIFEST)
         state_path = os.path.join(tmp, STATE_FILE)
@@ -439,6 +466,12 @@ def _verify_and_load_generation(gen_dir: str, dtype) -> dict:
             best_models = _read_models(
                 os.path.join(gen_dir, BEST_DIR), state["best_models"], dtype
             )
+        aux = {}
+        for name in state.get("aux") or []:
+            with np.load(
+                os.path.join(gen_dir, AUX_DIR, f"{name}.npz"), allow_pickle=False
+            ) as z:
+                aux[name] = {k: z[k] for k in z.files}
     except Exception as e:  # torn .npz, bad metadata, dtype surprises ...
         raise CheckpointCorruption(f"unreadable model arrays: {e}") from e
 
@@ -451,6 +484,8 @@ def _verify_and_load_generation(gen_dir: str, dtype) -> dict:
         "incidents": list(state.get("incidents") or []),
         "generation": state.get("generation"),
         "fingerprint": state.get("fingerprint"),
+        "extra": state.get("extra"),
+        "aux": aux,
     }
 
 

@@ -173,7 +173,32 @@ class RandomEffectModel:
         E, K = dst_proj.shape
         out = np.zeros((E, K), dtype=src.dtype)
         out_var = None if src_var is None else np.zeros((E, K), dtype=src_var.dtype)
-        for i, e in enumerate(dataset.entity_ids):
+        # Tail-growth fast path: continuous training pins the previous
+        # generation's entity order (build_random_effect_dataset(entity_order=))
+        # so the old table is a row PREFIX of the grown one. Rows whose slot
+        # layout is unchanged copy in one vectorized move; only entities whose
+        # new rows changed their slot set (a subset of the active set) pay the
+        # per-entity remap loop — keeping re-layout cost proportional to the
+        # delta, not the corpus.
+        n_old = len(self.entity_ids)
+        Ks = src_proj.shape[1]
+        rows_to_remap = range(E)
+        if (
+            E >= n_old
+            and K >= Ks
+            and tuple(dataset.entity_ids[:n_old]) == self.entity_ids
+        ):
+            same = (dst_proj[:n_old, :Ks] == src_proj).all(axis=1)
+            if Ks < K:
+                same &= (dst_proj[:n_old, Ks:] < 0).all(axis=1)
+            keep = np.flatnonzero(same)
+            out[keep, :Ks] = src[keep]
+            if out_var is not None:
+                out_var[keep, :Ks] = src_var[keep]
+            # tail rows (i >= n_old) are NEW entities: no source row, stay zero
+            rows_to_remap = np.flatnonzero(~same)
+        for i in rows_to_remap:
+            e = dataset.entity_ids[i]
             r = self.row_for_entity(e)
             if r < 0:
                 continue
@@ -184,11 +209,15 @@ class RandomEffectModel:
                     out[i, k] = src[r, kk]
                     if out_var is not None:
                         out_var[i, k] = src_var[r, kk]
+        # hand back the DATASET's own entity tuple and proj array (the re-laid
+        # out table matches them by construction): the next aligned_to against
+        # this dataset then short-circuits on object identity instead of
+        # re-materializing and comparing the [E, K] projection table
         return dataclasses.replace(
             self,
             entity_ids=tuple(dataset.entity_ids),
             coeffs=jnp.asarray(out),
-            proj_indices=jnp.asarray(dst_proj),
+            proj_indices=dataset.proj_indices,
             variances=None if out_var is None else jnp.asarray(out_var),
         )
 

@@ -19,6 +19,7 @@ import pytest
 
 # importing the instrumented modules populates the fault-point registry
 import photon_ml_tpu.algorithm.coordinate_descent  # noqa: F401
+import photon_ml_tpu.continuous  # noqa: F401 — registers continuous.*
 import photon_ml_tpu.io.checkpoint  # noqa: F401
 import photon_ml_tpu.parallel.distributed  # noqa: F401
 import photon_ml_tpu.serving.frontend  # noqa: F401 — registers serve.enqueue/dispatch
@@ -35,11 +36,38 @@ from tests.test_cli_drivers import write_glmix_avro
 pytestmark = pytest.mark.chaos
 
 # the serving path has its own sweep below (a frontend has no restart-and-
-# compare semantics); the training-driver sweep covers everything else
+# compare semantics) and the continuous-training loop has its own in
+# tests/test_continuous.py (its points never fire on the one-shot driver);
+# the training-driver sweep covers everything else
 SERVE_POINTS = tuple(p for p in registered_fault_points() if p.startswith("serve."))
-TRAINING_POINTS = tuple(
-    p for p in registered_fault_points() if not p.startswith("serve.")
+CONTINUOUS_POINTS = tuple(
+    p for p in registered_fault_points() if p.startswith("continuous.")
 )
+TRAINING_POINTS = tuple(
+    p
+    for p in registered_fault_points()
+    if not p.startswith(("serve.", "continuous."))
+)
+
+
+def test_registry_covers_every_chaos_sweep():
+    # TRAINING_POINTS is the registry's set complement of the other two
+    # sweeps, so their union is total by construction — the real guard is
+    # this prefix allowlist: a fault point that no sweep crashes is untested
+    # recovery code, so a NEW subsystem prefix must fail here until its
+    # points are claimed by a sweep (extend a sweep, then the allowlist)
+    assert {p.split(".", 1)[0] for p in TRAINING_POINTS} == {
+        "checkpoint",
+        "coord",
+        "distributed",
+    }
+    assert {
+        "continuous.scan",
+        "continuous.delta_ingest",
+        "continuous.active_select",
+        "continuous.commit",
+    } == set(CONTINUOUS_POINTS)
+    assert {p.split(".", 1)[0] for p in SERVE_POINTS} == {"serve"}
 
 FE_COORD = (
     "name=global,feature.shard=shardA,optimizer=LBFGS,"

@@ -1,0 +1,788 @@
+"""Continuous-training subsystem tests (photon_ml_tpu/continuous/).
+
+The three layers and the closed loop:
+
+- stable index-map growth (`IndexMap.extend`): old (key -> index) pairs are
+  bitwise-frozen across growth — the alignment-by-construction contract every
+  previous-generation coefficient table leans on;
+- the append-only corpus manifest: scan diffs ARE the delta, contract
+  violations (rewritten/vanished part files) fail loudly;
+- delta-only ingest: re-ingesting the whole manifest with the final frozen
+  maps reproduces the progressively accumulated corpus bit for bit;
+- active-set selection (new-data / new-entity / gradient-screen rules) and
+  the fixed-effect refresh reservoir;
+- the `ContinuousTrainer` generation loop end to end: bootstrap + delta
+  generations, untouched entities bitwise-stable across generations,
+  restart-resume from the committed state, and the committed delta
+  generation hot-swapping into PR 6's live serving frontend mid-traffic;
+- the `continuous.*` chaos sweep: crash at every fault point mid-delta,
+  restart, and the exported generation bytes match an uninterrupted run's.
+"""
+
+import dataclasses
+import hashlib
+import os
+import shutil
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.parsers import (
+    parse_coordinate_configuration,
+    parse_feature_shard_configuration,
+)
+from photon_ml_tpu.continuous import (
+    ContinuousTrainer,
+    ContinuousTrainerConfig,
+    CorpusContractViolation,
+    CorpusManifest,
+    ReservoirDownSampler,
+    ingest_delta,
+    select_active_entities,
+)
+from photon_ml_tpu.data import avro_io
+from photon_ml_tpu.data.index_map import IndexMap
+from photon_ml_tpu.data.readers import read_merged_avro
+from photon_ml_tpu.io.checkpoint import list_generations, load_generation
+from photon_ml_tpu.resilience import (
+    InjectedFault,
+    armed,
+    assert_trees_identical,
+    registered_fault_points,
+    run_with_crash_at,
+)
+from photon_ml_tpu.types import TaskType
+
+D = 3
+USERS = [f"u{i}" for i in range(8)]
+_rng0 = np.random.default_rng(0)
+W_TRUE = _rng0.normal(size=D)
+BIAS = dict(zip(USERS, _rng0.normal(size=len(USERS)) * 1.5))
+BIAS["a-new"] = 1.0  # sorts BEFORE u*: must still append at the entity tail
+
+FE_COORD = (
+    "name=global,feature.shard=shardA,optimizer=LBFGS,"
+    "max.iter=25,tolerance=1e-7,regularization=L2,reg.weights=1.0"
+)
+RE_COORD = (
+    "name=per-user,random.effect.type=userId,feature.shard=shardA,"
+    "optimizer=LBFGS,max.iter=25,tolerance=1e-7,regularization=L2,"
+    "reg.weights=1.0"
+)
+SHARD = "name=shardA,feature.bags=features"
+
+
+def write_part(path, rng, n, user_labels, extra_feature=None):
+    """One TrainingExampleAvro part file over the shared ground truth; rows
+    draw entities from ``user_labels`` only (the delta-targeting knob)."""
+    X = rng.normal(size=(n, D))
+    us = [user_labels[i] for i in rng.integers(0, len(user_labels), size=n)]
+    z = X @ W_TRUE + np.array([BIAS[u] for u in us])
+    y = (z + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+
+    def records():
+        base = os.path.basename(str(path))
+        for i in range(n):
+            feats = [
+                {"name": f"f{j}", "term": "", "value": float(X[i, j])}
+                for j in range(D)
+            ]
+            if extra_feature is not None:
+                feats.append({"name": extra_feature, "term": "", "value": 1.0})
+            yield {
+                "uid": f"{base}#{i}",
+                "label": float(y[i]),
+                "features": feats,
+                "metadataMap": {"userId": us[i]},
+                "weight": 1.0,
+                "offset": 0.0,
+            }
+
+    avro_io.write_container(
+        str(path), avro_io.TRAINING_EXAMPLE_SCHEMA, records()
+    )
+
+
+def shard_configs():
+    return dict([parse_feature_shard_configuration(SHARD)])
+
+
+def make_trainer(corpus, ckpt, export_dir=None, gradient_threshold=None,
+                 fe_reservoir=None, iterations=1):
+    coords = dict(
+        parse_coordinate_configuration(c) for c in (FE_COORD, RE_COORD)
+    )
+    return ContinuousTrainer(
+        ContinuousTrainerConfig(
+            corpus_paths=[str(corpus)],
+            checkpoint_directory=str(ckpt),
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configurations=coords,
+            shard_configurations=shard_configs(),
+            delta_iterations=iterations,
+            initial_iterations=iterations,
+            gradient_threshold=gradient_threshold,
+            fe_reservoir=fe_reservoir,
+            export_directory=None if export_dir is None else str(export_dir),
+        )
+    )
+
+
+# ------------------------------------------------------- stable index growth
+
+
+class TestIndexMapExtend:
+    def test_existing_pairs_are_frozen_across_growth(self):
+        base = IndexMap.build(["b", "d", "a", "c"], add_intercept=False)
+        before = {k: base.get_index(k) for k in base.keys()}
+        grown = base.extend(["z", "a", "e", "c", "x"])
+        # regression: every old name -> index pair is bitwise-stable
+        for k, i in before.items():
+            assert grown.get_index(k) == i
+        # unseen keys append at the tail in sorted order
+        assert grown.keys() == base.keys() + ["e", "x", "z"]
+        assert grown.size == base.size + 3
+
+    def test_noop_extend_returns_self(self):
+        base = IndexMap.build(["a", "b"], add_intercept=False)
+        assert base.extend(["b", "a"]) is base
+        assert base.extend([]) is base
+
+    def test_indices_never_move_across_repeated_shuffled_growth(self):
+        rng = np.random.default_rng(1)
+        m = IndexMap.build([f"k{i}" for i in range(5)], add_intercept=False)
+        assigned = {k: m.get_index(k) for k in m.keys()}
+        for round_ in range(4):
+            new = [f"g{round_}-{j}" for j in range(3)]
+            observed = list(assigned) + new
+            rng.shuffle(observed)  # observation order must not matter
+            m = m.extend(observed)
+            for k, i in assigned.items():
+                assert m.get_index(k) == i
+            for k in new:
+                assigned[k] = m.get_index(k)
+                assert assigned[k] >= 0
+
+    def test_intercept_index_survives_growth(self):
+        base = IndexMap.build(["f0", "f1"], add_intercept=True)
+        grown = base.extend(["f2"])
+        assert grown.intercept_index == base.intercept_index
+
+
+# ------------------------------------------------------------ corpus manifest
+
+
+def _touch(path, payload):
+    with open(path, "wb") as f:
+        f.write(payload)
+
+
+class TestCorpusManifest:
+    def test_scan_extend_diff_cycle(self, tmp_path):
+        a, b = str(tmp_path / "part-a.avro"), str(tmp_path / "part-b.avro")
+        _touch(a, b"aaaa")
+        _touch(b, b"bbbbbb")
+        m = CorpusManifest()
+        assert m.scan([str(tmp_path)]) == [a, b]  # listing order
+        m = m.extend([a])
+        assert m.scan([str(tmp_path)]) == [b]
+        m = m.extend([b])
+        assert m.scan([str(tmp_path)]) == []
+        assert m.paths == (a, b)
+        assert [e.size for e in m.entries] == [4, 6]
+        assert m.entries[0].sha256 == hashlib.sha256(b"aaaa").hexdigest()
+
+    def test_round_trip_through_checkpoint_dict(self, tmp_path):
+        a = str(tmp_path / "part-a.avro")
+        _touch(a, b"payload")
+        m = CorpusManifest().extend([a])
+        again = CorpusManifest.from_dict(m.to_dict())
+        assert again == m
+        assert again.scan([str(tmp_path)]) == []
+
+    def test_rewritten_part_file_violates_the_contract(self, tmp_path):
+        a = str(tmp_path / "part-a.avro")
+        _touch(a, b"original")
+        m = CorpusManifest().extend([a])
+        _touch(a, b"rewritten-longer")
+        with pytest.raises(CorpusContractViolation, match="changed size"):
+            m.scan([str(tmp_path)])
+
+    def test_vanished_part_file_violates_the_contract(self, tmp_path):
+        a = str(tmp_path / "part-a.avro")
+        _touch(a, b"here")
+        m = CorpusManifest().extend([a])
+        os.remove(a)
+        with pytest.raises(CorpusContractViolation, match="disappeared"):
+            m.scan([str(tmp_path)])
+
+    def test_same_size_rewrite_fails_fingerprint_verification(self, tmp_path):
+        # scan's per-poll check is size-only (cheap); the persisted sha256 is
+        # enforced at restart, where a same-size rewrite must fail loudly
+        a = str(tmp_path / "part-a.avro")
+        _touch(a, b"original")
+        m = CorpusManifest().extend([a])
+        m.verify_fingerprints()
+        _touch(a, b"RIGWRITE")  # same 8 bytes, different content
+        assert m.scan([str(tmp_path)]) == []  # the cheap check cannot see it
+        with pytest.raises(CorpusContractViolation, match="content changed"):
+            m.verify_fingerprints()
+
+    def test_file_grown_during_ingest_fails_verify_sizes(self, tmp_path):
+        # the torn-write bracket: extend() records the size BEFORE the decode,
+        # verify_sizes() after — a file an upstream writer was still appending
+        # to fails loudly instead of leaving a manifest record that disagrees
+        # with the rows the model absorbed
+        a = str(tmp_path / "part-a.avro")
+        _touch(a, b"prefix")
+        m = CorpusManifest().extend([a])
+        m.verify_sizes()  # quiescent corpus passes
+        with open(a, "ab") as f:
+            f.write(b"-late-append")
+        with pytest.raises(CorpusContractViolation, match="during ingest"):
+            m.verify_sizes(m.entries[-1:])
+
+
+# -------------------------------------------------------------- delta ingest
+
+
+def _csr_state(m):
+    c = m.tocsr()
+    return c.indptr, c.indices, c.data
+
+
+class TestIngestDelta:
+    @pytest.fixture()
+    def parts(self, tmp_path):
+        rng = np.random.default_rng(2)
+        p0 = tmp_path / "part-00000.avro"
+        p1 = tmp_path / "part-00001.avro"
+        write_part(p0, rng, 60, USERS)
+        # the delta brings a NEW entity and a NEW feature
+        write_part(p1, rng, 20, ["u0", "a-new"], extra_feature="f-late")
+        return str(p0), str(p1)
+
+    def test_delta_grows_without_disturbing_old_state(self, parts):
+        p0, p1 = parts
+        snap0, info0 = ingest_delta(None, [p0], shard_configs(), ("userId",))
+        assert info0.row_start == 0 and info0.n_new_rows == snap0.n_rows
+        snap1, info1 = ingest_delta(snap0, [p1], shard_configs(), ("userId",))
+
+        n0 = snap0.n_rows
+        assert info1.row_start == n0
+        assert snap1.n_rows == n0 + info1.n_new_rows
+        assert info1.delta_entities["userId"] <= {"u0", "a-new"}
+        assert "a-new" in info1.delta_entities["userId"]
+        assert info1.new_features == {"shardA": 1}  # f-late appended
+
+        # frozen map growth: the old keys are a verbatim prefix
+        keys0 = snap0.index_maps["shardA"].keys()
+        keys1 = snap1.index_maps["shardA"].keys()
+        assert keys1[: len(keys0)] == keys0
+        assert len(keys1) == len(keys0) + 1
+
+        # old rows are bitwise-untouched by the append: same csr bytes over
+        # the old row range, same labels/uids prefix
+        ptr0, idx0, dat0 = _csr_state(snap0.data.shard("shardA"))
+        grown = snap1.data.shard("shardA").tocsr()[:n0]
+        ptr1, idx1, dat1 = _csr_state(grown)
+        np.testing.assert_array_equal(ptr0, ptr1)
+        np.testing.assert_array_equal(idx0, idx1)
+        np.testing.assert_array_equal(dat0, dat1)
+        np.testing.assert_array_equal(
+            np.asarray(snap0.data.labels), np.asarray(snap1.data.labels)[:n0]
+        )
+        np.testing.assert_array_equal(snap0.uids, snap1.uids[:n0])
+
+    def test_rebuild_from_manifest_reproduces_the_accumulated_corpus(self, parts):
+        # the restart contract: one read of the WHOLE manifest against the
+        # final frozen maps == the progressively accumulated corpus, bitwise
+        p0, p1 = parts
+        snap0, _ = ingest_delta(None, [p0], shard_configs(), ("userId",))
+        snap1, _ = ingest_delta(snap0, [p1], shard_configs(), ("userId",))
+
+        data, maps, uids = read_merged_avro(
+            [p0, p1], shard_configs(),
+            index_maps=dict(snap1.index_maps), id_tags=("userId",),
+        )
+        assert maps["shardA"].keys() == snap1.index_maps["shardA"].keys()
+        for side, other in [(data, snap1.data)]:
+            np.testing.assert_array_equal(
+                np.asarray(side.labels), np.asarray(other.labels)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(side.offsets), np.asarray(other.offsets)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(side.weights), np.asarray(other.weights)
+            )
+            np.testing.assert_array_equal(side.ids("userId"), other.ids("userId"))
+            for a, b in zip(_csr_state(side.shard("shardA")),
+                            _csr_state(other.shard("shardA"))):
+                np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(np.asarray(uids, dtype=object), snap1.uids)
+
+    def test_empty_delta_is_rejected(self):
+        with pytest.raises(ValueError, match="no new files"):
+            ingest_delta(None, [], shard_configs(), ("userId",))
+
+
+# ------------------------------------------------------- active-set selection
+
+
+class _FakeDataset(SimpleNamespace):
+    pass
+
+
+class _FakeModel:
+    def __init__(self, known):
+        self.entity_ids = tuple(known)
+
+
+class TestActiveSelection:
+    def _dataset(self, entities):
+        return _FakeDataset(entity_ids=tuple(entities), n_entities=len(entities))
+
+    def test_new_data_and_new_entity_rules(self):
+        ds = self._dataset(["a", "b", "c", "d", "e"])
+        sel = select_active_entities(
+            ds, {"b"}, prev_model=_FakeModel(["a", "b", "c"])
+        )
+        np.testing.assert_array_equal(
+            sel.mask, [False, True, False, True, True]
+        )
+        assert sel.n_active == 3
+        assert sel.n_new_data == 1
+        assert sel.n_new_entities == 2
+        assert sel.n_gradient == 0
+
+    def test_no_previous_model_activates_everything(self):
+        ds = self._dataset(["a", "b"])
+        sel = select_active_entities(ds, set(), prev_model=None)
+        assert sel.n_active == 2 and sel.n_new_entities == 2
+
+    def test_gradient_screen_catches_drifted_entities(self):
+        ds = self._dataset(["a", "b", "c", "d"])
+        norms = np.array([0.5, 9.0, 0.01, 4.0])
+        sel = select_active_entities(
+            ds, {"b"}, prev_model=_FakeModel(ds.entity_ids),
+            gradient_norms=norms, gradient_threshold=1.0,
+        )
+        # b: new data; d: gradient screen; a/c below threshold stay frozen
+        np.testing.assert_array_equal(sel.mask, [False, True, False, True])
+        assert sel.n_gradient == 1  # d alone — b was already active
+
+    def test_gradient_norm_shape_mismatch_raises(self):
+        ds = self._dataset(["a", "b"])
+        with pytest.raises(ValueError, match="gradient_norms shape"):
+            select_active_entities(
+                ds, set(), prev_model=_FakeModel(ds.entity_ids),
+                gradient_norms=np.zeros(3), gradient_threshold=1.0,
+            )
+
+    def test_reservoir_masks_old_rows_and_keeps_the_delta(self):
+        import jax.numpy as jnp
+
+        @dataclasses.dataclass
+        class Rows:
+            weights: object
+
+        data = Rows(weights=jnp.ones(10))
+        out = ReservoirDownSampler(n_old=8, reservoir_size=4, seed=3).down_sample(data)
+        w = np.asarray(out.weights)
+        np.testing.assert_array_equal(w[8:], [1.0, 1.0])  # delta rows train
+        kept = w[:8][w[:8] > 0]
+        assert len(kept) == 4 and np.all(kept == 8 / 4)  # unbiased re-weight
+        # deterministic: the same seed redraws the identical reservoir
+        again = ReservoirDownSampler(n_old=8, reservoir_size=4, seed=3).down_sample(data)
+        np.testing.assert_array_equal(w, np.asarray(again.weights))
+
+    def test_reservoir_covering_all_old_rows_is_identity(self):
+        import jax.numpy as jnp
+
+        @dataclasses.dataclass
+        class Rows:
+            weights: object
+
+        data = Rows(weights=jnp.ones(6))
+        sampler = ReservoirDownSampler(n_old=4, reservoir_size=4, seed=0)
+        assert sampler.down_sample(data) is data
+
+
+# --------------------------------------------------- the generation loop e2e
+
+
+@pytest.fixture(scope="module")
+def loop_scenario(tmp_path_factory):
+    """Bootstrap gen-1 over 8 users, then a delta targeting u0 + the brand-new
+    entity a-new; capture both generations' states for the assertions."""
+    rng = np.random.default_rng(7)
+    root = tmp_path_factory.mktemp("continuous-loop")
+    corpus = root / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 200, USERS)
+
+    trainer = make_trainer(corpus, root / "ckpt", export_dir=root / "export")
+    r1 = trainer.poll_once()
+    idle = trainer.poll_once()  # nothing new: no generation
+
+    prev = trainer.models["per-user"]
+    gen1_entities = prev.entity_ids
+    gen1_coeffs = np.asarray(prev.coeffs).copy()
+    gen1_fe = np.asarray(
+        trainer.models["global"].model.coefficients.means
+    ).copy()
+
+    write_part(corpus / "part-00001.avro", rng, 40, ["u0", "a-new"])
+    r2 = trainer.poll_once()
+    return SimpleNamespace(
+        root=root, corpus=corpus, trainer=trainer, r1=r1, r2=r2, idle=idle,
+        gen1_entities=gen1_entities, gen1_coeffs=gen1_coeffs, gen1_fe=gen1_fe,
+    )
+
+
+class TestContinuousTrainer:
+    def test_bootstrap_then_delta_generations(self, loop_scenario):
+        s = loop_scenario
+        assert s.r1.kind == "bootstrap" and s.r1.generation == 1
+        assert s.idle is None
+        assert s.r2.kind == "delta" and s.r2.generation == 2
+        assert s.r2.n_new_rows == 40
+        assert s.r2.n_rows == 240
+        gens = list_generations(str(s.root / "ckpt"))
+        assert [g for g, _ in gens] == [1, 2]
+
+    def test_active_set_is_exactly_the_delta_entities(self, loop_scenario):
+        stats = loop_scenario.r2.active["per-user"]
+        # u0 (new data) + a-new (new entity); the other 7 users stay frozen
+        assert stats["n_entities"] == 9
+        assert stats["n_active"] == 2
+        # a-new has new rows too, so it attributes to the new-data rule
+        # (n_new_entities counts entities that are new WITHOUT new rows)
+        assert stats["n_new_data"] == 2 and stats["n_new_entities"] == 0
+        assert loop_scenario.r2.active_fraction == pytest.approx(2 / 9)
+
+    def test_entity_rows_grow_at_the_tail(self, loop_scenario):
+        s = loop_scenario
+        grown = s.trainer.models["per-user"].entity_ids
+        # a-new sorts before every u*, but stable growth appends it at the
+        # TAIL: gen-1's row order is a verbatim prefix
+        assert grown[: len(s.gen1_entities)] == s.gen1_entities
+        assert grown[-1] == "a-new"
+
+    def test_untouched_entities_survive_the_delta_bitwise(self, loop_scenario):
+        s = loop_scenario
+        grown_coeffs = np.asarray(s.trainer.models["per-user"].coeffs)
+        touched = {"u0", "a-new"}
+        for i, e in enumerate(s.gen1_entities):
+            if e in touched:
+                assert not np.array_equal(grown_coeffs[i], s.gen1_coeffs[i]), e
+            else:
+                np.testing.assert_array_equal(
+                    grown_coeffs[i], s.gen1_coeffs[i], err_msg=e
+                )
+        # the fixed effect DID refresh (all rows train when no reservoir set)
+        gen2_fe = np.asarray(s.trainer.models["global"].model.coefficients.means)
+        assert not np.array_equal(gen2_fe, s.gen1_fe)
+
+    def test_checkpoint_carries_the_corpus_state(self, loop_scenario):
+        s = loop_scenario
+        gens = list_generations(str(s.root / "ckpt"))
+        state = load_generation(gens[-1][1])
+        extra = state["extra"]["continuous"]
+        assert extra["kind"] == "delta"
+        assert len(extra["corpus_manifest"]["entries"]) == 2
+        assert extra["n_rows"] == 240 and extra["n_new_rows"] == 40
+        names = [
+            str(n) for n in state["aux"]["index-map-shardA"]["names"]
+        ]
+        assert names == s.trainer.snapshot.index_maps["shardA"].keys()
+
+    def test_exports_are_per_generation_directories(self, loop_scenario):
+        s = loop_scenario
+        assert sorted(os.listdir(s.root / "export")) == [
+            "gen-00000001", "gen-00000002",
+        ]
+
+    def test_restart_resumes_from_the_committed_generation(self, loop_scenario):
+        s = loop_scenario
+        resumed = make_trainer(s.corpus, s.root / "ckpt")
+        assert resumed.generation == 2
+        assert len(resumed.manifest) == 2
+        assert resumed.snapshot.n_rows == 240
+        assert resumed.poll_once() is None  # nothing new: stays idle
+        np.testing.assert_array_equal(
+            np.asarray(resumed.models["per-user"].coeffs),
+            np.asarray(s.trainer.models["per-user"].coeffs),
+        )
+        assert (
+            resumed.models["per-user"].entity_ids
+            == s.trainer.models["per-user"].entity_ids
+        )
+
+
+def test_run_streams_generations_to_the_callback(tmp_path):
+    """run(on_generation=) is the run-forever mode: records stream to the
+    callback and the returned list stays empty (nothing accumulates for the
+    process lifetime)."""
+    rng = np.random.default_rng(17)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 120, USERS)
+    t = make_trainer(corpus, tmp_path / "ckpt")
+    seen = []
+    out = t.run(
+        poll_interval_s=0.0,
+        max_generations=1,
+        sleep=lambda s: None,
+        on_generation=seen.append,
+    )
+    assert out == []
+    assert [r.generation for r in seen] == [1]
+
+
+def test_fe_reservoir_refuses_configured_down_sampling(tmp_path):
+    """The reservoir replaces the FE coordinate's down-sampler on delta
+    passes: combining it with a configured down.sampling.rate would train
+    bootstrap and delta under different loss weightings, so construction
+    must refuse."""
+    coords = dict(
+        parse_coordinate_configuration(c)
+        for c in (FE_COORD + ",down.sampling.rate=0.5", RE_COORD)
+    )
+    with pytest.raises(ValueError, match="down.sampling.rate"):
+        ContinuousTrainer(
+            ContinuousTrainerConfig(
+                corpus_paths=[str(tmp_path)],
+                checkpoint_directory=str(tmp_path / "ckpt"),
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configurations=coords,
+                shard_configurations=shard_configs(),
+                fe_reservoir=100,
+            )
+        )
+
+
+def test_commit_fault_retry_does_not_double_ingest(tmp_path):
+    """A poll that fails AT the commit fault point reverts the in-memory
+    snapshot AND manifest view: a surviving caller's retried poll_once
+    re-scans the same delta, ingests it exactly once, and commits the same
+    generation an uninterrupted run would have."""
+    rng = np.random.default_rng(11)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 160, USERS)
+    t = make_trainer(corpus, tmp_path / "ckpt")
+    t.poll_once()
+    write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+
+    with armed("continuous.commit:raise"):
+        with pytest.raises(InjectedFault):
+            t.poll_once()
+    # nothing durable or in-memory moved: the delta is still fully pending
+    assert len(t.manifest) == 1
+    assert t.snapshot.n_rows == 160
+    assert t.generation == 1
+
+    r = t.poll_once()  # in-process retry replays the delta cleanly
+    assert r is not None and r.generation == 2
+    assert r.n_rows == 190 and r.n_new_rows == 30
+    state = load_generation(list_generations(str(tmp_path / "ckpt"))[-1][1])
+    # the committed corpus state matches reality: no duplicated delta rows
+    assert state["extra"]["continuous"]["n_rows"] == 190
+    assert len(state["extra"]["continuous"]["corpus_manifest"]["entries"]) == 2
+
+
+def test_restart_refuses_a_same_size_rewritten_part_file(tmp_path):
+    """The restart rebuild verifies the persisted sha256 of every part file:
+    a same-size rewrite (invisible to scan's size check) must fail loudly
+    instead of warm-starting against a corpus the model never saw."""
+    rng = np.random.default_rng(13)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    part = corpus / "part-00000.avro"
+    write_part(part, rng, 120, USERS)
+    make_trainer(corpus, tmp_path / "ckpt").poll_once()
+
+    blob = bytearray(part.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF  # same size, different content
+    part.write_bytes(bytes(blob))
+    with pytest.raises(CorpusContractViolation, match="content changed"):
+        make_trainer(corpus, tmp_path / "ckpt")
+
+
+def test_gradient_screen_reactivates_drifted_entities(tmp_path):
+    rng = np.random.default_rng(3)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 160, USERS)
+    # a threshold below the solver tolerance: every warm-started entity's
+    # residual gradient exceeds it, so the catch-up rule re-solves them all
+    t = make_trainer(corpus, tmp_path / "ckpt", gradient_threshold=1e-12)
+    t.poll_once()
+    write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+    r = t.poll_once()
+    stats = r.active["per-user"]
+    assert stats["n_gradient"] > 0
+    assert (
+        stats["n_active"]
+        == stats["n_new_data"] + stats["n_new_entities"] + stats["n_gradient"]
+    )
+
+
+def test_fe_reservoir_rides_the_delta_pass(tmp_path):
+    rng = np.random.default_rng(4)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 160, USERS)
+    t = make_trainer(corpus, tmp_path / "ckpt", fe_reservoir=40)
+    t.poll_once()
+    fe1 = np.asarray(t.models["global"].model.coefficients.means).copy()
+    write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+    r = t.poll_once()
+    assert r is not None and r.kind == "delta"
+    # the reservoir-refreshed fixed effect still trains (and stays finite)
+    fe2 = np.asarray(t.models["global"].model.coefficients.means)
+    assert np.all(np.isfinite(fe2)) and not np.array_equal(fe1, fe2)
+
+
+# ------------------------------------------- the closed train -> serve loop
+
+
+def test_delta_generation_hot_swaps_into_live_serving(tmp_path):
+    """The full photon-ml-tpu story: ContinuousTrainer commits a delta
+    generation, PR 6's GenerationWatcher picks it up MID-TRAFFIC, and every
+    served response is bitwise the direct engine call for the generation
+    that served it."""
+    from photon_ml_tpu.serving import FrontendConfig, clear_engine_cache
+    from photon_ml_tpu.serving.hotswap import (
+        GenerationWatcher,
+        serve_from_checkpoint,
+    )
+
+    rng = np.random.default_rng(5)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 200, USERS)
+    trainer = make_trainer(corpus, tmp_path / "ckpt")
+    trainer.poll_once()  # gen-1
+
+    # a scoring request decoded against the trainer's frozen feature space
+    val = tmp_path / "val"
+    os.makedirs(val)
+    write_part(val / "part-00000.avro", rng, 16, USERS)
+    req, _, _ = read_merged_avro(
+        [str(val / "part-00000.avro")], shard_configs(),
+        index_maps=dict(trainer.snapshot.index_maps), id_tags=("userId",),
+    )
+
+    clear_engine_cache()
+    frontend, manager = serve_from_checkpoint(
+        str(tmp_path / "ckpt"), config=FrontendConfig(max_wait_ms=0.0)
+    )
+    served = []
+    engines = {frontend.generation: frontend.engine}
+    try:
+        with GenerationWatcher(manager, poll_interval_s=0.02):
+            for _ in range(3):
+                fut = frontend.submit(req)
+                served.append((fut.result(30), fut.generation))
+            # commit the delta generation while traffic is flowing
+            write_part(corpus / "part-00001.avro", rng, 40, ["u0"])
+            r2 = trainer.poll_once()
+            assert r2 is not None and r2.kind == "delta"
+            deadline = time.monotonic() + 60
+            while frontend.generation < r2.generation:
+                fut = frontend.submit(req)
+                served.append((fut.result(30), fut.generation))
+                if time.monotonic() > deadline:
+                    pytest.fail("watcher never swapped to the delta generation")
+                time.sleep(0.01)
+            engines[frontend.generation] = frontend.engine
+            for _ in range(3):
+                fut = frontend.submit(req)
+                served.append((fut.result(30), fut.generation))
+    finally:
+        frontend.close()
+
+    assert frontend.generation == r2.generation  # the swap happened
+    gens_seen = {g for _, g in served}
+    assert r2.generation in gens_seen  # and traffic was served on both sides
+    for out, gen in served:
+        np.testing.assert_array_equal(out, engines[gen].score(req))
+    # the delta pass moved u0's model: the generations score differently
+    assert not np.array_equal(engines[1].score(req), engines[r2.generation].score(req))
+    clear_engine_cache()
+
+
+# ----------------------------------------------------- continuous.* chaos bar
+
+
+CONTINUOUS_POINTS = (
+    "continuous.scan",
+    "continuous.delta_ingest",
+    "continuous.active_select",
+    "continuous.commit",
+)
+
+
+def test_registry_covers_the_continuous_points():
+    # importing photon_ml_tpu.continuous (top of this file) registers them
+    assert set(CONTINUOUS_POINTS) <= set(registered_fault_points())
+
+
+@pytest.fixture(scope="module")
+def chaos_scenario(tmp_path_factory):
+    """Gen-1 committed, a delta part pending: the sweep replays the delta
+    pass under crashes and compares exported generation bytes."""
+    rng = np.random.default_rng(20260803)
+    root = tmp_path_factory.mktemp("continuous-chaos")
+    corpus = root / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 200, USERS)
+    base_ckpt = root / "ckpt-base"
+    make_trainer(corpus, base_ckpt).poll_once()  # commit gen-1
+    write_part(corpus / "part-00001.avro", rng, 40, ["u0", "a-new"])
+
+    def run_loop(ckpt, export):
+        t = make_trainer(corpus, ckpt, export_dir=export)
+        while t.poll_once() is not None:
+            pass
+        return t
+
+    # the uninterrupted reference (restore gen-1 -> delta pass -> gen-2);
+    # a fresh export dir re-exports gen-1 idempotently at restore
+    ref_export = root / "export-ref"
+    shutil.copytree(base_ckpt, root / "ckpt-ref")
+    run_loop(root / "ckpt-ref", ref_export)
+    return SimpleNamespace(
+        base_ckpt=base_ckpt, ref_export=ref_export, run_loop=run_loop
+    )
+
+
+@pytest.mark.chaos
+class TestContinuousChaos:
+    def test_delta_export_is_deterministic(self, chaos_scenario, tmp_path):
+        # the sweep's premise: two uninterrupted delta runs export the same bytes
+        shutil.copytree(chaos_scenario.base_ckpt, tmp_path / "ckpt")
+        chaos_scenario.run_loop(tmp_path / "ckpt", tmp_path / "export")
+        assert_trees_identical(
+            str(chaos_scenario.ref_export), str(tmp_path / "export")
+        )
+
+    @pytest.mark.parametrize("point", CONTINUOUS_POINTS)
+    def test_crash_mid_delta_resumes_to_identical_generation_bytes(
+        self, chaos_scenario, tmp_path, point
+    ):
+        shutil.copytree(chaos_scenario.base_ckpt, tmp_path / "ckpt")
+        _, outcome = run_with_crash_at(
+            lambda: chaos_scenario.run_loop(tmp_path / "ckpt", tmp_path / "export"),
+            point,
+        )
+        # every continuous.* point sits ON the delta path: the crash must fire
+        assert outcome.crashed and outcome.restarts >= 1
+        assert_trees_identical(
+            str(chaos_scenario.ref_export), str(tmp_path / "export")
+        )

@@ -124,6 +124,7 @@ class TestFaultPoints:
         # import the instrumented modules, then the catalog must be complete —
         # the chaos sweep enumerates exactly this set
         import photon_ml_tpu.algorithm.coordinate_descent  # noqa: F401
+        import photon_ml_tpu.continuous  # noqa: F401
         import photon_ml_tpu.io.checkpoint  # noqa: F401
         import photon_ml_tpu.parallel.distributed  # noqa: F401
         import photon_ml_tpu.serving.frontend  # noqa: F401
@@ -142,6 +143,10 @@ class TestFaultPoints:
             "serve.swap.verify",
             "serve.swap.warmup",
             "serve.swap.flip",
+            "continuous.scan",
+            "continuous.delta_ingest",
+            "continuous.active_select",
+            "continuous.commit",
         } <= points
 
     def test_corrupt_file_flips_one_byte(self, tmp_path):

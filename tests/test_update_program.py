@@ -206,6 +206,65 @@ def test_external_warm_start_model_survives_descent(rng):
         assert np.isfinite(arr).all()
 
 
+def test_warm_start_survives_generation_growth_bitwise(rng):
+    """The continuous-training contract on top of the donation discipline:
+    train gen-N, GROW the entity set (new rows for two existing entities plus
+    two brand-new entities, previous row order pinned), run an active-set
+    delta pass warm-started from gen-N — every untouched entity's
+    coefficients are bitwise gen-N's, and the foreign gen-N table itself
+    survives the pass."""
+    workload = make_workload(rng)
+    X, X_re, users, y, _ = workload
+    coords = build_coords(workload, use_program=True)
+    gen_n = run_coordinate_descent(coords, n_iterations=2)
+    prev = gen_n.model.get_model("per-user")
+    prev_coeffs = np.asarray(prev.coeffs).copy()
+
+    n_new = 36
+    Xn = rng.normal(size=(n_new, D))
+    re_new = np.concatenate([np.ones((n_new, 1)), 2.0 * Xn[:, :2] + 0.5], axis=1)
+    new_users = np.concatenate(
+        [np.repeat([0, 1], 8), np.repeat([N_USERS, N_USERS + 1], 10)]
+    )
+    y_new = (Xn @ rng.normal(size=D) > 0).astype(np.float64)
+    grown_ds = build_random_effect_dataset(
+        sp.vstack([X_re, sp.csr_matrix(re_new)], format="csr"),
+        np.concatenate([users, new_users]),
+        "userId",
+        feature_shard_id="per-user",
+        labels=np.concatenate([y, y_new]),
+        entity_order=prev.entity_ids,
+    )
+    # stable growth: gen-N's row order is a verbatim prefix of the grown layout
+    assert tuple(grown_ds.entity_ids)[: len(prev.entity_ids)] == prev.entity_ids
+
+    coord = RandomEffectCoordinate(
+        coordinate_id="per-user", dataset=grown_ds,
+        task=TaskType.LOGISTIC_REGRESSION, configuration=CFG,
+        base_offsets=jnp.zeros(N + n_new, dtype=grown_ds.sample_vals.dtype),
+    )
+    touched = {0, 1, N_USERS, N_USERS + 1}
+    active = np.array([e in touched for e in grown_ds.entity_ids], dtype=bool)
+    result = run_coordinate_descent(
+        {"per-user": coord}, n_iterations=1,
+        initial_models={"per-user": prev},
+        active_sets={"per-user": active},
+    )
+    grown = result.model.get_model("per-user")
+    stats = coord.last_active_stats
+    assert stats.n_active == int(active.sum()) == 4
+    for i, e in enumerate(prev.entity_ids):
+        if e in touched:
+            assert not np.array_equal(np.asarray(grown.coeffs[i]), prev_coeffs[i])
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(grown.coeffs[i]), prev_coeffs[i], err_msg=str(e)
+            )
+    # donation discipline: the foreign gen-N table is alive and unchanged
+    assert not prev.coeffs.is_deleted()
+    np.testing.assert_array_equal(np.asarray(prev.coeffs), prev_coeffs)
+
+
 def test_best_model_snapshot_survives_later_donated_updates(rng):
     """Validating runs snapshot the best model mid-descent; later donated
     updates must not invalidate the snapshot's arrays."""
@@ -467,3 +526,97 @@ def test_aligned_to_slow_path_still_works(rng):
     )
     assert clone.proj_indices is not ds.proj_indices
     assert clone.aligned_to(ds) is clone
+
+
+def test_aligned_to_tail_growth_skips_the_per_entity_remap(rng, monkeypatch):
+    """Continuous training pins the previous generation's entity order, so a
+    grown dataset whose old rows keep their slot layout must re-align via the
+    vectorized prefix copy — the O(E*K) per-entity Python remap loop (visible
+    as row_for_entity calls) must not run at all."""
+    from photon_ml_tpu.models.game import RandomEffectModel
+
+    workload = make_workload(rng)
+    X, X_re, users, y, _ = workload
+    ds = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", labels=y
+    )
+    prev, _ = train_random_effect(ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(N))
+    prev_coeffs = np.asarray(prev.coeffs).copy()
+
+    n_new = 12
+    Xn = rng.normal(size=(n_new, D))
+    re_new = np.concatenate([np.ones((n_new, 1)), 2.0 * Xn[:, :2] + 0.5], axis=1)
+    new_users = np.repeat([N_USERS, N_USERS + 1], 6)
+    grown_ds = build_random_effect_dataset(
+        sp.vstack([X_re, sp.csr_matrix(re_new)], format="csr"),
+        np.concatenate([users, new_users]),
+        "userId",
+        feature_shard_id="per-user",
+        labels=np.concatenate([y, (Xn @ rng.normal(size=D) > 0).astype(np.float64)]),
+        entity_order=prev.entity_ids,
+    )
+
+    calls = []
+    orig = RandomEffectModel.row_for_entity
+    monkeypatch.setattr(
+        RandomEffectModel,
+        "row_for_entity",
+        lambda self, e: (calls.append(e), orig(self, e))[1],
+    )
+    aligned = prev.aligned_to(grown_ds)
+    assert calls == []  # pure tail growth: only the vectorized copy ran
+    n_old = len(prev.entity_ids)
+    assert aligned.entity_ids[:n_old] == prev.entity_ids
+    np.testing.assert_array_equal(np.asarray(aligned.coeffs)[:n_old], prev_coeffs)
+    assert (np.asarray(aligned.coeffs)[n_old:] == 0).all()
+
+
+def test_active_set_without_warm_start_is_refused(rng):
+    """An active set over a zero-initialized model would silently export
+    coefficient 0 for every inactive entity — the descent must refuse before
+    initialize_model() can paper over the missing warm start."""
+    workload = make_workload(rng)
+    coords = build_coords(workload, use_program=True)
+    active = np.zeros(N_USERS, dtype=bool)
+    active[0] = True
+    with pytest.raises(ValueError, match="active set but no initial model"):
+        run_coordinate_descent(
+            {"per-user": coords["per-user"]},
+            n_iterations=1,
+            active_sets={"per-user": active},
+        )
+
+
+def test_variance_delta_pass_refuses_varianceless_warm_start(rng):
+    """With variance computation on, only active entities receive solved
+    variances — a warm start that carries none would export variance 0.0
+    (infinite confidence) for every inactive entity, so the delta path must
+    refuse unless every entity is active."""
+    from photon_ml_tpu.algorithm.random_effect import train_random_effect_delta
+
+    workload = make_workload(rng)
+    X, X_re, users, y, _ = workload
+    ds = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", labels=y
+    )
+    prev, _ = train_random_effect(ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(N))
+    assert prev.variances is None
+    partial = np.zeros(ds.n_entities, dtype=bool)
+    partial[0] = True
+    with pytest.raises(ValueError, match="carries no variances"):
+        train_random_effect_delta(
+            ds, TaskType.LOGISTIC_REGRESSION, CFG,
+            jnp.zeros(N, dtype=ds.sample_vals.dtype),
+            prev, partial,
+            variance_computation=VarianceComputationType.SIMPLE,
+        )
+    # the escape hatch named in the error: an all-active pass solves a real
+    # variance for every entity, so it is allowed
+    model, _, _ = train_random_effect_delta(
+        ds, TaskType.LOGISTIC_REGRESSION, CFG,
+        jnp.zeros(N, dtype=ds.sample_vals.dtype),
+        prev, np.ones(ds.n_entities, dtype=bool),
+        variance_computation=VarianceComputationType.SIMPLE,
+    )
+    assert model.variances is not None
+    assert np.isfinite(np.asarray(model.variances)).all()
