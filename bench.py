@@ -871,6 +871,12 @@ def main():
         # delta-proportionality, quality-parity and bounded-retrace gates)
         _delegate_benchmark("--continuous", "continuous_bench")
 
+    if "--sweep" in sys.argv:
+        # batched model selection: vmapped population training vs N sequential
+        # runs (bitwise vmapped-vs-fallback parity, zero-retrace, >=3x over
+        # the native sequential baseline, per-family winner-serves gates)
+        _delegate_benchmark("--sweep", "sweep_bench")
+
     if "--child" in sys.argv:
         _child_main()
         return

@@ -8,6 +8,9 @@
   index building (FeatureIndexingDriver.scala:41-320 equivalent)
 - ``python -m photon_ml_tpu.cli.name_and_term_bags_driver`` — distinct
   (name, term) extraction per bag (NameAndTermFeatureBagsDriver equivalent)
+- ``python -m photon_ml_tpu.cli.sweep_driver`` — batched (vmapped) Bayesian
+  hyperparameter sweep; the winner commits as a generational checkpoint the
+  serving hot-swap watcher picks up (photon_ml_tpu/sweep)
 
 Flag names and composite-argument grammar mirror the reference's scopt parsers
 (io/scopt/*), so reference invocations translate 1:1:

@@ -55,6 +55,7 @@ from photon_ml_tpu.evaluation.evaluators import (
     EvaluatorType,
     MultiEvaluator,
     evaluator_for_type,
+    evaluator_spec_name,
     resolve_evaluator,
 )
 from photon_ml_tpu.models.game import GameModel
@@ -443,9 +444,12 @@ class GameEstimator:
                     str(TaskType(self.task).value),
                     str(data.n),
                     # validation identity: best_metric restored from a
-                    # checkpoint must be comparable to metrics of this run
+                    # checkpoint must be comparable to metrics of this run.
+                    # Spec NAMES, not str(): Evaluator dataclasses render
+                    # their fn field as a per-process function address, which
+                    # made a cross-PROCESS rerun reject its own checkpoint
                     f"val={validation_data.n if validation_data is not None else 0}",
-                    f"evals={[str(e) for e in self.validation_evaluators]}",
+                    f"evals={[evaluator_spec_name(e) for e in self.validation_evaluators]}",
                 ]
                 for cid in sorted(self.coordinate_configurations):
                     fp_parts.append(f"{cid}={opt_configs[cid]!r}")

@@ -193,6 +193,16 @@ class MultiEvaluator:
         return float(np.mean(vals)) if vals else float("nan")
 
 
+def evaluator_spec_name(spec) -> str:
+    """A PROCESS-STABLE identity string for an evaluator spec, for run
+    fingerprints (io/checkpoint.py). ``str()`` on Evaluator/MultiEvaluator
+    dataclasses renders their ``fn`` field as ``<function ... at 0x...>`` —
+    stable within one process (module-level functions) but different across
+    processes, which would make a resumed run reject its own checkpoint."""
+    name = getattr(spec, "name", None)
+    return name if isinstance(name, str) else str(spec)
+
+
 def resolve_evaluator(spec):
     """Accept EvaluatorType | Evaluator | MultiEvaluator | (EvaluatorType, id_tag)."""
     if isinstance(spec, (Evaluator, MultiEvaluator)):

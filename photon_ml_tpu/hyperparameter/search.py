@@ -88,6 +88,20 @@ class RandomSearch:
             last_candidate, last_observation = candidate, observation
         return results
 
+    # -- batched ask/tell protocol (photon_ml_tpu/sweep/) --------------------------
+
+    def propose_batch(self, n: int) -> np.ndarray:
+        """[n, d] candidate batch for a POPULATION evaluation round (the
+        vmapped model-selection sweep trains all n simultaneously). The base
+        search proposes quasi-random draws; the Bayesian subclass overrides
+        with GP + Expected Improvement. Feed the measured values back with
+        :meth:`on_observation` before the next ``propose_batch`` call —
+        ask/tell instead of the sequential ``find*`` protocol, same
+        deterministic draw stream."""
+        if n <= 0:
+            raise ValueError("n must be positive")
+        return np.stack([self._discretize(c) for c in self.draw_candidates(n)])
+
     # -- extension points ----------------------------------------------------------
 
     def next(self, last_candidate: np.ndarray, last_observation: float) -> np.ndarray:
@@ -154,7 +168,42 @@ class GaussianProcessSearch(RandomSearch):
         if len(self._points) <= self.num_params:
             return super().next(last_candidate, last_observation)
 
+        transformation = self._fit_posterior()
         candidates = self.draw_candidates(self.candidate_pool_size)
+        predictions = self.last_model.predict_transformed(candidates)
+        return self._select_best_candidate(candidates, predictions, transformation)
+
+    def propose_batch(self, n: int) -> np.ndarray:
+        """Batched Bayesian proposals: ONE GP fit on the accumulated
+        observations, then n Expected-Improvement argmax picks over n FRESH
+        Sobol candidate pools. Without updating the posterior between picks,
+        diversity comes from the pools (each advances the quasi-random
+        stream), which keeps the whole batch a pure deterministic function of
+        (seed, observations) — the property the sweep's crash-replay
+        determinism rests on. Under-determined searches (not more
+        observations than parameters yet) propose uniform draws, matching
+        :meth:`next`."""
+        if n <= 0:
+            raise ValueError("n must be positive")
+        if len(self._points) <= self.num_params:
+            return super().propose_batch(n)
+        transformation = self._fit_posterior()
+        out = []
+        for _ in range(n):
+            candidates = self.draw_candidates(self.candidate_pool_size)
+            predictions = self.last_model.predict_transformed(candidates)
+            out.append(
+                self._discretize(
+                    self._select_best_candidate(candidates, predictions, transformation)
+                )
+            )
+        return np.stack(out)
+
+    def _fit_posterior(self) -> ExpectedImprovement:
+        """Fit the GP to the mean-centered observations (+ priors) and store
+        it on ``last_model``; returns the EI transformation anchored at the
+        best centered evaluation. Shared by the sequential ``next`` and the
+        batched ``propose_batch``."""
         evals = np.asarray(self._evals)
         current_mean = float(np.mean(evals))
         overall_best = min(self._prior_best_eval, self._best_eval - current_mean)
@@ -174,8 +223,7 @@ class GaussianProcessSearch(RandomSearch):
             seed=self.seed,
         )
         self.last_model = estimator.fit(points, centered)
-        predictions = self.last_model.predict_transformed(candidates)
-        return self._select_best_candidate(candidates, predictions, transformation)
+        return transformation
 
     def draws_for_iterations(self, n_initial_observations: int, iterations: int) -> int:
         # mirrors next(): 1 uniform draw while under-determined (observation

@@ -164,40 +164,18 @@ def re_bucket_solver(
     return jax.jit(_re_bucket_solve_fn(task, opt_config, has_l1, variance))
 
 
-@functools.lru_cache(maxsize=None)
-def re_coordinate_update_program(
+def _re_coordinate_update_fn(
     task: TaskType,
     opt_config: OptimizerConfig,
     has_l1: bool,
     variance: VarianceComputationType,
     n_entities: int,
 ):
-    """ONE jitted, donated XLA program for a whole random-effect coordinate
-    update: offset gather, every bucket's vmapped solve chained in a single
-    trace, normalization space conversion, per-entity-L2 gather, coefficient
-    table scatter, padding-row re-zero, the coordinate's ``[N]`` score, and
-    the divergence guard's finiteness flag — the per-bucket host loop of
-    ``train_random_effect`` collapsed into one dispatch per update.
-
-    ``update(coeffs_prev, score_prev, var_prev, offsets_plus_scores, l2_rows,
-    l1, buckets, norm_tables, view) -> (coeffs, score, variances, ok,
-    reasons_per_bucket, iters_per_bucket)``
-
-    - ``coeffs_prev`` ``[E, K_max]`` / ``score_prev`` ``[N]`` / ``var_prev``
-      (``[E, K_max]`` or None) are DONATED: the hot loop stops copying the
-      coefficient table once per bucket (the old ``.at[].set`` chain), and
-      callers must never touch those buffers again — feed the outputs forward.
-    - ``ok`` is the device-side divergence flag: all updated coefficients
-      finite. When False the outputs are the donated PREVIOUS table/score/
-      variances via ``lax.select`` (``jnp.where``), preserving the host
-      guard's reject semantics bit-for-bit without a blocking host read.
-    - ``norm_tables``: per bucket, None or the per-entity (factors, shifts,
-      intercept-mask) triple from ``precompute_norm_tables`` — gathered ONCE
-      per (dataset, normalization), not per update per bucket.
-    - ``view``: the dataset's per-sample scoring view (entity rows, local
-      cols, vals) — the score uses the same ``random_effect_view_score``
-      kernel as the eager path.
-    """
+    """Unjitted whole-coordinate update body shared by
+    ``re_coordinate_update_program`` (one model) and
+    ``re_population_update_program`` (a leading population axis vmapped over
+    it) — one body, so the two programs stay semantically interchangeable
+    per lane."""
     solve = _re_bucket_solve_fn(task, opt_config, has_l1, variance)
 
     def update(
@@ -256,7 +234,175 @@ def re_coordinate_update_program(
         var_out = None if variances is None else jnp.where(ok, variances, var_prev)
         return coeffs_out, score_out, var_out, ok, tuple(reasons), tuple(iters)
 
+    return update
+
+
+@functools.lru_cache(maxsize=None)
+def re_coordinate_update_program(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    variance: VarianceComputationType,
+    n_entities: int,
+):
+    """ONE jitted, donated XLA program for a whole random-effect coordinate
+    update: offset gather, every bucket's vmapped solve chained in a single
+    trace, normalization space conversion, per-entity-L2 gather, coefficient
+    table scatter, padding-row re-zero, the coordinate's ``[N]`` score, and
+    the divergence guard's finiteness flag — the per-bucket host loop of
+    ``train_random_effect`` collapsed into one dispatch per update.
+
+    ``update(coeffs_prev, score_prev, var_prev, offsets_plus_scores, l2_rows,
+    l1, buckets, norm_tables, view) -> (coeffs, score, variances, ok,
+    reasons_per_bucket, iters_per_bucket)``
+
+    - ``coeffs_prev`` ``[E, K_max]`` / ``score_prev`` ``[N]`` / ``var_prev``
+      (``[E, K_max]`` or None) are DONATED: the hot loop stops copying the
+      coefficient table once per bucket (the old ``.at[].set`` chain), and
+      callers must never touch those buffers again — feed the outputs forward.
+    - ``ok`` is the device-side divergence flag: all updated coefficients
+      finite. When False the outputs are the donated PREVIOUS table/score/
+      variances via ``lax.select`` (``jnp.where``), preserving the host
+      guard's reject semantics bit-for-bit without a blocking host read.
+    - ``norm_tables``: per bucket, None or the per-entity (factors, shifts,
+      intercept-mask) triple from ``precompute_norm_tables`` — gathered ONCE
+      per (dataset, normalization), not per update per bucket.
+    - ``view``: the dataset's per-sample scoring view (entity rows, local
+      cols, vals) — the score uses the same ``random_effect_view_score``
+      kernel as the eager path.
+    """
+    update = _re_coordinate_update_fn(task, opt_config, has_l1, variance, n_entities)
     return jax.jit(update, donate_argnums=(0, 1, 2))
+
+
+@functools.lru_cache(maxsize=None)
+def re_population_update_program(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    variance: VarianceComputationType,
+    n_entities: int,
+):
+    """``re_coordinate_update_program`` with a LEADING POPULATION AXIS: one
+    donated XLA program trains P hyperparameter settings' random-effect
+    coordinate updates simultaneously over SHARED device-resident data
+    (photon_ml_tpu/sweep/ — the model-selection axis batched the way Snap ML
+    batches its small local solves, arxiv 1803.06333).
+
+    ``update(coeffs_prev [P,E,K], score_prev [P,N], var_prev ([P,E,K] or
+    None), offsets_plus_scores [P,N], l2_rows [P,rows], l1 [P], buckets,
+    norm_tables, view) -> (coeffs [P,E,K], score [P,N], variances, ok [P],
+    reasons, iters)``
+
+    The per-lane body is EXACTLY ``_re_coordinate_update_fn`` — bucket data,
+    normalization tables and the scoring view broadcast across the population
+    (read from HBM once per update for all P settings); coefficient tables,
+    scores, regularization rows and the L1 weight carry the population axis.
+    Population state is donated exactly like the single-model program. The
+    per-lane divergence reject applies independently per setting.
+
+    A lane's output is a bitwise-deterministic function of that lane's inputs
+    alone (no cross-lane ops exist under vmap; converged lanes' while_loop
+    carries are select-frozen) — the property the sweep's sequential fallback
+    path builds its bitwise-parity contract on (sweep/population.py)."""
+    update = _re_coordinate_update_fn(task, opt_config, has_l1, variance, n_entities)
+    return jax.jit(
+        jax.vmap(update, in_axes=(0, 0, 0, 0, 0, 0, None, None, None)),
+        donate_argnums=(0, 1, 2),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def fe_population_update_program(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    down_sampling: bool = False,
+):
+    """Population fixed-effect coordinate update: one donated XLA program
+    trains P settings' fixed-effect solves over ONE shared design matrix and
+    produces each lane's ``[N]`` training score and divergence flag, with the
+    reject applied in-program (photon_ml_tpu/sweep/).
+
+    ``update(coeffs_prev [P,D], score_prev [P,N], offsets_plus_scores [P,N],
+    l2 [P], l1 [P], rates [P], keep_u [N], data, norm) -> (coeffs [P,D],
+    score [P,N], coefs_ok [P], value_ok [P], values [P], iters [P],
+    reasons [P])``
+
+    - ``coeffs_prev`` are ORIGINAL-space warm starts (the model contract);
+      the in-program conversion to the solver's transformed space and back
+      mirrors ``GLMOptimizationProblem.run`` exactly. ``coeffs_prev`` and
+      ``score_prev`` are DONATED population state.
+    - ``down_sampling=True`` adds a per-lane down-sampling-rate axis: the
+      caller supplies ONE shared uniform draw ``keep_u [N]``
+      (sampling/down_sampler.per_sample_uniform — pure function of seed,
+      call index and sample position, so replays are deterministic) and the
+      program derives each lane's weights with the task's reweighting rule
+      (classification: positives kept, negatives kept w.p. rate at weight
+      1/rate; regression: uniform keep, no re-scaling) — the
+      ``DownSampler`` semantics expressed as a traced lane axis.
+    - the divergence guard mirrors the host loop's two checks
+      (``_guard_cause``): non-finite final objective, then non-finite
+      coefficients; either rejects the lane in-program (previous
+      coefficients/score kept bit for bit).
+    """
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.function.losses import POSITIVE_RESPONSE_THRESHOLD
+
+    task = TaskType(task)
+    loss = loss_for_task(task)
+    minimize = build_minimizer(opt_config)
+    use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
+    classification = task.is_classification
+
+    def solve_one(w_prev, s_prev, off, l2, l1, rate, keep_u, data, norm):
+        weights = data.weights
+        if down_sampling:
+            if classification:
+                pos = data.labels > POSITIVE_RESPONSE_THRESHOLD
+                weights = jnp.where(
+                    pos, weights, jnp.where(keep_u < rate, weights / rate, 0.0)
+                )
+            else:
+                weights = jnp.where(keep_u < rate, weights, 0.0)
+        d2 = LabeledData(X=data.X, labels=data.labels, offsets=off, weights=weights)
+        obj = GLMObjective(loss, norm, allow_fused=False)  # vmapped: no pallas path
+        x0 = norm.to_transformed_space_device(w_prev)
+
+        def vg(w):
+            return obj.value_and_gradient(d2, w, l2)
+
+        kwargs = {}
+        if use_hvp:
+            kwargs["hvp"] = lambda w, v: obj.hessian_vector(d2, w, v, l2)
+        if use_hess:
+            kwargs["hess"] = lambda w: obj.hessian_matrix(d2, w, l2)
+        if has_l1:
+            kwargs["l1_weight"] = l1
+        res = minimize(vg, x0, **kwargs)
+        means = norm.to_original_space_device(res.coefficients)
+        score = data.X.matvec(means)
+        # same two checks, same order, as the host loop's divergence guard
+        # (coordinate_descent._guard_cause)
+        value_ok = jnp.isfinite(res.value)
+        coefs_ok = jnp.isfinite(means).all()
+        ok = jnp.logical_and(value_ok, coefs_ok)
+        means_out = jnp.where(ok, means, w_prev)
+        score_out = jnp.where(ok, score, s_prev)
+        return (
+            means_out, score_out, coefs_ok, value_ok,
+            res.value, res.iterations, res.convergence_reason,
+        )
+
+    vmapped = jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None, None))
+
+    def update(coeffs_prev, score_prev, offsets_pop, l2, l1, rates, keep_u, data, norm):
+        return vmapped(
+            coeffs_prev, score_prev, offsets_pop, l2, l1, rates, keep_u, data, norm
+        )
+
+    return jax.jit(update, donate_argnums=(0, 1))
 
 
 @functools.lru_cache(maxsize=None)
@@ -409,6 +555,8 @@ def clear():
     glm_solver.cache_clear()
     re_bucket_solver.cache_clear()
     re_coordinate_update_program.cache_clear()
+    re_population_update_program.cache_clear()
+    fe_population_update_program.cache_clear()
     sharded_glm_solver.cache_clear()
     shard_mapped_glm_solver.cache_clear()
     for cache_clear in _extra_caches:

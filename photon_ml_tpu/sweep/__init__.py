@@ -1,0 +1,51 @@
+"""Batched model selection: vmapped hyperparameter sweeps over shared
+device-resident data, wired to the Bayesian search loop.
+
+On Spark, model selection is N sequential full training runs
+(GameEstimator.fit:344-360 chains the reg-weight grid with warm starts). The
+single-program architecture here lets N regularization settings train as ONE
+extra vmapped axis over data that is read from HBM once per update for the
+whole population — the communication-avoiding-block-solve story of arxiv
+1611.02101 and Snap ML's keep-data-resident, batch-the-small-solves design
+(arxiv 1803.06333), applied to the model-selection axis instead of the data
+axis.
+
+Pieces:
+
+- :mod:`photon_ml_tpu.sweep.spec` — ``SweepSpec``: the swept axes
+  (per-coordinate L2 / elastic-net L1 weights, fixed-effect down-sampling
+  rate) with ranges and LOG/SQRT transforms, validated against the estimator
+  configuration.
+- :mod:`photon_ml_tpu.sweep.population` — ``PopulationTrainer``: full
+  coordinate-descent passes for a whole population of settings through the
+  population programs in ``optimization/solver_cache.py``
+  (``re_population_update_program`` / ``fe_population_update_program``), with
+  a sequential shared-program fallback whose per-setting results are BITWISE
+  identical to the vmapped path's lanes.
+- :mod:`photon_ml_tpu.sweep.runner` — ``SweepRunner``: the
+  propose → train → evaluate → commit loop feeding observed metrics to
+  ``hyperparameter/search.py``'s Bayesian (GP + Expected Improvement) search,
+  exporting the winner as a generational checkpoint
+  (``io/checkpoint.save_checkpoint``) that the serving hot-swap watcher
+  (``serving/hotswap.py``) picks up directly.
+"""
+
+from photon_ml_tpu.sweep.population import PopulationResult, PopulationTrainer
+from photon_ml_tpu.sweep.runner import (
+    SweepConfig,
+    SweepResult,
+    SweepRoundRecord,
+    SweepRunner,
+)
+from photon_ml_tpu.sweep.spec import SweepAxis, SweepSpec
+
+__all__ = [
+    "PopulationResult",
+    "PopulationTrainer",
+    "SweepAxis",
+    "SweepConfig",
+    "SweepResult",
+    "SweepRoundRecord",
+    "SweepRunner",
+    "SweepSpec",
+]

@@ -1,0 +1,604 @@
+"""Population coordinate descent: P hyperparameter settings trained at once.
+
+The state of a normal descent run (one coefficient table and one [N] score per
+coordinate) grows a LEADING POPULATION AXIS: ``[P, D]`` / ``[P, E, K]`` tables
+and ``[P, N]`` scores, updated by the population programs in
+``optimization/solver_cache.py`` (``re_population_update_program`` /
+``fe_population_update_program``). Every update is ONE donated XLA dispatch
+for the whole population; the datasets (bucket blocks, design matrix,
+normalization tables, scoring views) stay device-resident and broadcast —
+read once per update for all P settings.
+
+Two execution paths, bitwise-interchangeable per setting:
+
+- **vmapped** (default): all settings ride the lane axis of one dispatch.
+- **sequential** fallback: one dispatch per setting through the SAME compiled
+  program, every lane filled with that setting (duplicate-lane padding, the
+  active-set trick) and lane 0 extracted. This exists for knobs the lane axis
+  cannot carry — per-entity-L2 DICTS resolve entity ids host-side per setting
+  — and as the parity reference. Bitwise parity holds BY CONSTRUCTION: a
+  lane's output is a function of that lane's inputs alone (no cross-lane ops
+  under vmap; converged while_loop lanes are select-frozen), and both paths
+  execute the one compiled form. Comparing against programs of OTHER batch
+  shapes (e.g. the unbatched single-model program) is NOT bitwise on real
+  backends — XLA re-vectorizes reductions per shape — which is exactly the
+  PR 4 lesson (models/game.random_effect_view_score) applied to the
+  population axis; the parity gate in bench.py --sweep pins the contract.
+
+Divergence: the per-lane reject is applied IN-PROGRAM (a diverged setting
+keeps its previous coefficients/score bit for bit, exactly like the
+single-model path) and surfaced as per-lane flags, materialized in ONE
+batched transfer per ``train`` call and recorded as incidents.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import operator
+from typing import Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.algorithm.random_effect import build_l2_rows, precompute_norm_tables
+from photon_ml_tpu.data.dataset import FixedEffectDataset
+from photon_ml_tpu.data.random_effect import RandomEffectDataset, _next_pow2
+from photon_ml_tpu.estimators.config import RandomEffectDataConfiguration
+from photon_ml_tpu.function.losses import loss_for_task
+from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients, model_class_for_task
+from photon_ml_tpu.optimization.solver_cache import (
+    fe_population_update_program,
+    re_population_update_program,
+)
+from photon_ml_tpu.resilience.incidents import Incident
+from photon_ml_tpu.sampling.down_sampler import per_sample_uniform
+from photon_ml_tpu.sweep.spec import setting_value
+from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
+
+Array = jnp.ndarray
+
+_MIN_POPULATION_PAD = 2
+
+
+@dataclasses.dataclass
+class _CoordStatic:
+    """Descent-invariant pieces of one coordinate, built once per trainer."""
+
+    cid: str
+    kind: str  # "fe" | "re"
+    dataset: object
+    opt_config: object  # the base GLMOptimizationConfiguration
+    norm: object  # NormalizationContext (FE) | Optional[NormalizationContext] (RE)
+    has_l1: bool
+    # RE only
+    buckets: Optional[tuple] = None
+    norm_tables: Optional[tuple] = None
+    view: Optional[tuple] = None
+    per_entity: Optional[object] = None  # None | [E] array | {entity_id: l2} dict
+    # FE only
+    down_sampling: bool = False
+    base_rate: float = 1.0
+
+
+@dataclasses.dataclass
+class PopulationResult:
+    """One population training run: per-setting tables, scores and rejects."""
+
+    settings: list
+    coeffs: dict  # cid -> [P, D] (FE) | [P, E, K] (RE)
+    train_scores: dict  # cid -> [P, N]
+    incidents: list  # per-lane divergence Incidents (setting index attached)
+    rejected: np.ndarray  # [P] bool: lane absorbed >= 1 rejected update
+    path: str  # "vmapped" | "sequential"
+
+    @property
+    def population(self) -> int:
+        return len(self.settings)
+
+
+class PopulationTrainer:
+    """Full coordinate-descent passes for a population of settings over ONE
+    set of shared device-resident datasets (built once by the caller via
+    ``GameEstimator.prepare_training_datasets``)."""
+
+    def __init__(
+        self,
+        estimator,
+        datasets: Mapping[str, object],
+        base_offsets: Array,
+        seed: int = 0,
+    ):
+        self.estimator = estimator
+        self.task = TaskType(estimator.task)
+        self.dtype = estimator.dtype
+        self.base_offsets = jnp.asarray(base_offsets, dtype=self.dtype)
+        self.seed = seed
+        loss = loss_for_task(self.task)
+        self._static: dict[str, _CoordStatic] = {}
+        for cid, cfg in estimator.coordinate_configurations.items():
+            ds = datasets[cid]
+            opt = cfg.optimization_config
+            opt_type = OptimizerType(opt.optimizer_config.optimizer_type)
+            if (
+                opt_type in (OptimizerType.TRON, OptimizerType.NEWTON)
+                and not loss.has_hessian
+            ):
+                raise ValueError(
+                    f"{opt_type.value} requires a twice-differentiable loss"
+                )
+            if isinstance(cfg.data_config, RandomEffectDataConfiguration):
+                if not isinstance(ds, RandomEffectDataset):
+                    raise TypeError(f"coordinate {cid!r}: expected a RandomEffectDataset")
+                if getattr(ds, "coeffs_sharding", None) is not None:
+                    raise ValueError(
+                        f"coordinate {cid!r}: mesh-sharded datasets are not "
+                        "supported by the population programs"
+                    )
+                norm = estimator._normalization_for(cfg.data_config.feature_shard_id)
+                norm = None if norm.is_identity or ds.projector is not None else norm
+                self._static[cid] = _CoordStatic(
+                    cid=cid,
+                    kind="re",
+                    dataset=ds,
+                    opt_config=opt,
+                    norm=norm,
+                    has_l1=bool(opt.l1_weight),
+                    buckets=tuple(ds.buckets),
+                    norm_tables=precompute_norm_tables(ds, norm, self.dtype),
+                    view=(ds.sample_entity_rows, ds.sample_local_cols, ds.sample_vals),
+                    per_entity=cfg.per_entity_reg_weights,
+                )
+            else:
+                if not isinstance(ds, FixedEffectDataset):
+                    raise TypeError(f"coordinate {cid!r}: expected a FixedEffectDataset")
+                rate = float(getattr(cfg, "down_sampling_rate", 1.0))
+                self._static[cid] = _CoordStatic(
+                    cid=cid,
+                    kind="fe",
+                    dataset=ds,
+                    opt_config=opt,
+                    norm=estimator._normalization_for(cfg.data_config.feature_shard_id),
+                    has_l1=bool(opt.l1_weight),
+                    down_sampling=0.0 < rate < 1.0,
+                    base_rate=rate,
+                )
+        # stable per-coordinate seed offsets for the down-sampling draws
+        self._coord_index = {cid: i for i, cid in enumerate(self._static)}
+        self.n_samples = int(self.base_offsets.shape[0])
+        # population validation-scoring caches: alignment gather maps (host,
+        # computed once per scoring dataset) and per-coordinate jitted
+        # scorers, keyed by (cid, id(scoring_ds)). The keyed datasets are
+        # RETAINED (_scoring_refs): a recycled address from a collected
+        # dataset must not alias a cache entry built for a different one
+        self._align_maps: dict = {}
+        self._pop_scorers: dict = {}
+        self._scoring_refs: dict = {}
+
+    # ------------------------------------------------------------- settings
+
+    def _lane_values(self, st: _CoordStatic, settings: Sequence[dict]) -> dict:
+        """Per-lane hyperparameter arrays for one coordinate (live lanes only;
+        the caller pads). RE l2 arrives as full per-entity rows so the lane
+        axis carries per-entity overrides uniformly."""
+        cid = st.cid
+        l2 = np.array(
+            [setting_value(s, cid, "l2", st.opt_config.l2_weight) for s in settings]
+        )
+        l1 = np.array(
+            [setting_value(s, cid, "l1", st.opt_config.l1_weight or 0.0) for s in settings]
+        )
+        out = {"l1": l1}
+        if st.kind == "re":
+            E = st.dataset.n_entities
+            per_entity = st.per_entity
+            if isinstance(per_entity, dict) and not any(
+                f"{cid}.l2" in s for s in settings
+            ):
+                # unswept dict overrides are setting-invariant: resolve once.
+                # build_l2_rows pads its table to E+1 rows; slice back to the
+                # [E] per-entity override array its own validation expects
+                per_entity = np.asarray(
+                    build_l2_rows(st.dataset, l2[0], per_entity, self.dtype, E)
+                )[:E]
+            if isinstance(per_entity, dict):
+                raise ValueError(
+                    f"coordinate {cid!r}: dict per-entity L2 overrides under a "
+                    "swept l2 axis take the sequential path (host-side "
+                    "entity-id resolution per setting)"
+                )
+            out["l2_rows"] = np.stack(
+                [
+                    np.asarray(build_l2_rows(st.dataset, v, per_entity, self.dtype, E))
+                    for v in l2
+                ]
+            )
+        else:
+            out["l2"] = l2
+            out["rates"] = np.array(
+                [
+                    setting_value(s, cid, "down_sampling_rate", st.base_rate)
+                    for s in settings
+                ]
+            )
+        return out
+
+    def _sequential_lane_values(self, st: _CoordStatic, setting: dict) -> dict:
+        """One setting's values for a sequential dispatch — the path where a
+        dict per-entity override IS expressible (resolved host-side here)."""
+        cid = st.cid
+        l2 = setting_value(setting, cid, "l2", st.opt_config.l2_weight)
+        out = {
+            "l1": np.array([setting_value(setting, cid, "l1", st.opt_config.l1_weight or 0.0)])
+        }
+        if st.kind == "re":
+            out["l2_rows"] = np.asarray(
+                build_l2_rows(
+                    st.dataset, l2, st.per_entity, self.dtype, st.dataset.n_entities
+                )
+            )[None]
+        else:
+            out["l2"] = np.array([l2])
+            out["rates"] = np.array(
+                [setting_value(setting, cid, "down_sampling_rate", st.base_rate)]
+            )
+        return out
+
+    # --------------------------------------------------------------- train
+
+    def train(
+        self,
+        settings: Sequence[dict],
+        n_iterations: int = 1,
+        vmapped: bool = True,
+    ) -> PopulationResult:
+        """Run ``n_iterations`` full coordinate-descent passes for every
+        setting, each setting solving from a zero initialization (candidates
+        are independent — model selection compares settings, it does not
+        chain them). Returns live-lane tables, scores and per-lane divergence
+        records."""
+        if n_iterations < 1:
+            raise ValueError(f"n_iterations must be >= 1, got {n_iterations}")
+        settings = list(settings)
+        if not settings:
+            raise ValueError("empty population")
+        if vmapped:
+            return self._train_vmapped(settings, n_iterations)
+        return self._train_sequential(settings, n_iterations)
+
+    def _pad(self, arr: np.ndarray, p_pad: int) -> jnp.ndarray:
+        """Pad the lane axis to ``p_pad`` with DUPLICATES of lane 0 (a twin
+        solve converges like its sibling; its output is sliced away)."""
+        live = arr.shape[0]
+        if live < p_pad:
+            arr = np.concatenate([arr, np.repeat(arr[:1], p_pad - live, axis=0)])
+        return jnp.asarray(arr, dtype=self.dtype)
+
+    def _keep_u(self, cid: str, iteration: int) -> Array:
+        """The shared down-sampling draw for (coordinate, iteration): a pure
+        function of (seed, coordinate index, iteration, sample position), so
+        the vmapped and sequential paths — and a crash-replayed sweep — see
+        the identical mask (sampling/down_sampler.per_sample_uniform)."""
+        return per_sample_uniform(
+            self.seed + self._coord_index[cid],
+            iteration,
+            jnp.arange(self.n_samples, dtype=jnp.uint32),
+        )
+
+    def _dispatch_update(
+        self, st: _CoordStatic, state: dict, lane: dict, offsets_pop: Array,
+        iteration: int,
+    ):
+        """One population update for one coordinate: returns (new coeffs,
+        new score, guard) with guard = (coefs_ok [P], value_ok [P] or None,
+        values [P] or None) device arrays."""
+        if st.kind == "re":
+            program = re_population_update_program(
+                self.task,
+                st.opt_config.optimizer_config,
+                st.has_l1,
+                VarianceComputationType.NONE,
+                st.dataset.n_entities,
+            )
+            coeffs, score, _var, ok, _reasons, _iters = program(
+                state["coeffs"],
+                state["score"],
+                None,
+                offsets_pop,
+                lane["l2_rows"],
+                lane["l1"],
+                st.buckets,
+                st.norm_tables,
+                st.view,
+            )
+            return coeffs, score, (ok, None, None)
+        program = fe_population_update_program(
+            self.task,
+            st.opt_config.optimizer_config,
+            st.has_l1,
+            st.down_sampling,
+        )
+        keep_u = (
+            self._keep_u(st.cid, iteration)
+            if st.down_sampling
+            else jnp.zeros((0,), dtype=jnp.float32)
+        )
+        coeffs, score, coefs_ok, value_ok, values, _iters, _reasons = program(
+            state["coeffs"],
+            state["score"],
+            offsets_pop,
+            lane["l2"],
+            lane["l1"],
+            lane["rates"],
+            keep_u,
+            st.dataset.data,
+            st.norm,
+        )
+        return coeffs, score, (coefs_ok, value_ok, values)
+
+    def _init_state(self, p_pad: int) -> dict:
+        states = {}
+        for cid, st in self._static.items():
+            if st.kind == "re":
+                shape = (p_pad, st.dataset.n_entities, st.dataset.max_k)
+            else:
+                shape = (p_pad, st.dataset.dim)
+            states[cid] = {
+                "coeffs": jnp.zeros(shape, dtype=self.dtype),
+                # a zero model scores exactly zero everywhere
+                "score": jnp.zeros((p_pad, self.n_samples), dtype=self.dtype),
+            }
+        return states
+
+    def _train_vmapped(self, settings: list, n_iterations: int) -> PopulationResult:
+        p_live = len(settings)
+        p_pad = _next_pow2(p_live, _MIN_POPULATION_PAD)
+        lanes = {
+            cid: {
+                k: self._pad(v, p_pad)
+                for k, v in self._lane_values(st, settings).items()
+            }
+            for cid, st in self._static.items()
+        }
+        states = self._init_state(p_pad)
+        guards: list[tuple] = []
+        for iteration in range(n_iterations):
+            # iteration-boundary recompute keeps the total a pure function of
+            # the per-coordinate scores (the descent loop's determinism rule)
+            total = functools.reduce(
+                operator.add, (s["score"] for s in states.values())
+            )
+            for cid, st in self._static.items():
+                partial = total - states[cid]["score"]
+                offsets_pop = self.base_offsets[None, :] + partial
+                coeffs, score, guard = self._dispatch_update(
+                    st, states[cid], lanes[cid], offsets_pop, iteration
+                )
+                states[cid] = {"coeffs": coeffs, "score": score}
+                total = partial + score
+                # lane index IS the setting index on the vmapped path
+                guards.append((iteration, cid, guard, None))
+        incidents, rejected = self._materialize_guards(guards, p_live)
+        return PopulationResult(
+            settings=settings,
+            coeffs={cid: s["coeffs"][:p_live] for cid, s in states.items()},
+            train_scores={cid: s["score"][:p_live] for cid, s in states.items()},
+            incidents=incidents,
+            rejected=rejected,
+            path="vmapped",
+        )
+
+    def _train_sequential(self, settings: list, n_iterations: int) -> PopulationResult:
+        """The shared-program fallback: one dispatch per setting per update,
+        every lane of the SAME compiled population program filled with that
+        setting, lane 0 extracted — bitwise-identical per setting to the
+        vmapped path (lane-content independence), at the honest cost of
+        p_pad duplicate lanes per dispatch plus per-setting dispatch
+        overhead. Expressible here and not on the lane axis: dict-keyed
+        per-entity L2 overrides (resolved host-side per setting)."""
+        p_live = len(settings)
+        p_pad = _next_pow2(p_live, _MIN_POPULATION_PAD)
+        guards: list[tuple] = []
+        final_coeffs: dict[str, list] = {cid: [] for cid in self._static}
+        final_scores: dict[str, list] = {cid: [] for cid in self._static}
+        for p, setting in enumerate(settings):
+            lanes = {}
+            for cid, st in self._static.items():
+                vals = self._sequential_lane_values(st, setting)
+                lanes[cid] = {
+                    k: jnp.asarray(
+                        np.repeat(v, p_pad, axis=0), dtype=self.dtype
+                    )
+                    for k, v in vals.items()
+                }
+            states = self._init_state(p_pad)
+            for iteration in range(n_iterations):
+                total = functools.reduce(
+                    operator.add, (s["score"] for s in states.values())
+                )
+                for cid, st in self._static.items():
+                    partial = total - states[cid]["score"]
+                    offsets_pop = self.base_offsets[None, :] + partial
+                    coeffs, score, guard = self._dispatch_update(
+                        st, states[cid], lanes[cid], offsets_pop, iteration
+                    )
+                    states[cid] = {"coeffs": coeffs, "score": score}
+                    total = partial + score
+                    # every lane is this setting; record lane 0's flags for it
+                    guards.append(
+                        (
+                            iteration,
+                            cid,
+                            tuple(None if g is None else g[:1] for g in guard),
+                            p,
+                        )
+                    )
+            for cid, s in states.items():
+                final_coeffs[cid].append(s["coeffs"][0])
+                final_scores[cid].append(s["score"][0])
+        incidents, rejected = self._materialize_guards(guards, p_live)
+        return PopulationResult(
+            settings=settings,
+            coeffs={cid: jnp.stack(v) for cid, v in final_coeffs.items()},
+            train_scores={cid: jnp.stack(v) for cid, v in final_scores.items()},
+            incidents=incidents,
+            rejected=rejected,
+            path="sequential",
+        )
+
+    def _materialize_guards(
+        self, guards: list, p_live: int
+    ) -> tuple[list, np.ndarray]:
+        """ONE batched transfer for every update's per-lane guard flags, then
+        incident records for the rejects (the reject itself already happened
+        in-program — this is the paper trail, coordinate_descent._flush_guards
+        style). Guard entries carry an explicit setting index for sequential
+        dispatches (every lane is one setting there); vmapped entries map
+        lane index -> setting index directly."""
+        incidents: list[Incident] = []
+        rejected = np.zeros(p_live, dtype=bool)
+        if not guards:
+            return incidents, rejected
+        host = jax.device_get([g for _, _, g, _ in guards])
+        for (iteration, cid, _, setting_idx), (coefs_ok, value_ok, values) in zip(
+            guards, host
+        ):
+            coefs_ok = np.atleast_1d(np.asarray(coefs_ok))
+            value_ok = None if value_ok is None else np.atleast_1d(np.asarray(value_ok))
+            for lane in range(coefs_ok.shape[0]):
+                p = setting_idx if setting_idx is not None else lane
+                if p >= p_live:
+                    continue  # padding lane: a duplicate of lane 0, not a setting
+                if value_ok is not None and not bool(value_ok[lane]):
+                    v = float(np.asarray(values)[lane])
+                    cause = f"training objective is non-finite ({v})"
+                elif not bool(coefs_ok[lane]):
+                    cause = "solver emitted non-finite coefficients"
+                else:
+                    continue
+                rejected[p] = True
+                incidents.append(
+                    Incident(
+                        kind="divergence",
+                        cause=cause,
+                        action="update rejected; previous setting state kept",
+                        coordinate_id=cid,
+                        iteration=iteration,
+                        detail=f"setting={p}",
+                    )
+                )
+        return incidents, rejected
+
+    # ---------------------------------------------------- population scoring
+
+    def _scoring_align_map(self, st: _CoordStatic, scoring_ds):
+        """Train-layout -> scoring-layout gather map, computed ONCE per
+        (coordinate, scoring dataset): the same re-layout
+        ``RandomEffectModel.aligned_to`` performs per model, but as index
+        arrays the whole POPULATION gathers through in one device op — P
+        per-lane host alignments collapse into one [P, E_val, K_val] gather."""
+        key = (st.cid, id(scoring_ds))
+        cached = self._align_maps.get(key)
+        if cached is not None:
+            return cached
+        train_ds = st.dataset
+        if (train_ds.projector is None) != (scoring_ds.projector is None):
+            # mirrors RandomEffectModel.score_dataset's refusal: coefficients
+            # in one space dotted with features in another are garbage
+            raise ValueError(
+                f"coordinate {st.cid!r}: training and scoring datasets "
+                "disagree on projection; rebuild the scoring dataset with "
+                "the training projector"
+            )
+        src_proj = np.asarray(train_ds.proj_indices)
+        dst_proj = np.asarray(scoring_ds.proj_indices)
+        row_by_entity = {e: i for i, e in enumerate(train_ds.entity_ids)}
+        E_val, K_val = dst_proj.shape
+        rows = np.zeros((E_val, K_val), dtype=np.int32)
+        cols = np.zeros((E_val, K_val), dtype=np.int32)
+        mask = np.zeros((E_val, K_val), dtype=bool)
+        for i, e in enumerate(scoring_ds.entity_ids):
+            r = row_by_entity.get(e, -1)
+            if r < 0:
+                continue  # unseen entity: scores 0, like the eager path
+            col_slot = {int(c): k for k, c in enumerate(src_proj[r]) if c >= 0}
+            for k, c in enumerate(dst_proj[i]):
+                if c < 0:
+                    continue
+                kk = col_slot.get(int(c), -1)
+                if kk >= 0:
+                    rows[i, k], cols[i, k], mask[i, k] = r, kk, True
+        out = (jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(mask))
+        self._align_maps[key] = out
+        self._scoring_refs[id(scoring_ds)] = scoring_ds
+        return out
+
+    def _population_scorer(self, st: _CoordStatic, scoring_ds):
+        """Jitted population scorer for one (coordinate, scoring dataset),
+        cached so repeated rounds reuse one compiled program."""
+        key = (st.cid, id(scoring_ds))
+        scorer = self._pop_scorers.get(key)
+        if scorer is not None:
+            return scorer
+        if st.kind == "fe":
+            X = scoring_ds.data.X
+
+            scorer = jax.jit(jax.vmap(lambda w: X.matvec(w)))
+        else:
+            from photon_ml_tpu.models.game import random_effect_view_score
+
+            rows, cols, mask = self._scoring_align_map(st, scoring_ds)
+            entity_rows, local_cols, vals = scoring_ds.scoring_view()
+
+            def score_all(tables):
+                aligned = jnp.where(mask, tables[:, rows, cols], 0.0)
+                return jax.vmap(
+                    random_effect_view_score, in_axes=(0, None, None, None)
+                )(aligned, entity_rows, local_cols, vals)
+
+            scorer = jax.jit(score_all)
+        self._pop_scorers[key] = scorer
+        self._scoring_refs[id(scoring_ds)] = scoring_ds
+        return scorer
+
+    def score_population(
+        self, result: PopulationResult, scoring_datasets: Mapping[str, object]
+    ) -> Array:
+        """Every setting's total [P, N_val] validation score in a handful of
+        batched dispatches (one per coordinate) — the per-lane equivalent of
+        summing ``score_model_on_dataset`` over coordinates, with the model
+        re-alignment hoisted into a cached gather map instead of P host-side
+        ``aligned_to`` calls per round."""
+        total = None
+        for cid, st in self._static.items():
+            s = self._population_scorer(st, scoring_datasets[cid])(result.coeffs[cid])
+            total = s if total is None else total + s
+        return total
+
+    # --------------------------------------------------------------- models
+
+    def build_models(self, result: PopulationResult, lane: int) -> dict:
+        """Materialize one setting's GAME models from the population tables
+        (the winner-export path; also validation scoring per lane)."""
+        models: dict[str, object] = {}
+        for cid, st in self._static.items():
+            table = result.coeffs[cid][lane]
+            if st.kind == "fe":
+                glm = model_class_for_task(self.task)(Coefficients(means=table))
+                models[cid] = FixedEffectModel(
+                    model=glm, feature_shard_id=st.dataset.feature_shard_id
+                )
+            else:
+                ds = st.dataset
+                models[cid] = RandomEffectModel(
+                    re_type=ds.re_type,
+                    feature_shard_id=ds.feature_shard_id,
+                    task=self.task,
+                    entity_ids=ds.entity_ids,
+                    coeffs=table,
+                    proj_indices=ds.proj_indices,
+                    projector=ds.projector,
+                )
+        return models

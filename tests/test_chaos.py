@@ -24,6 +24,7 @@ import photon_ml_tpu.io.checkpoint  # noqa: F401
 import photon_ml_tpu.parallel.distributed  # noqa: F401
 import photon_ml_tpu.serving.frontend  # noqa: F401 — registers serve.enqueue/dispatch
 import photon_ml_tpu.serving.hotswap  # noqa: F401 — registers serve.swap.*
+import photon_ml_tpu.sweep  # noqa: F401 — registers sweep.{propose,train,evaluate,commit}
 from photon_ml_tpu.cli import game_training_driver
 from photon_ml_tpu.resilience import (
     assert_trees_identical,
@@ -36,24 +37,26 @@ from tests.test_cli_drivers import write_glmix_avro
 pytestmark = pytest.mark.chaos
 
 # the serving path has its own sweep below (a frontend has no restart-and-
-# compare semantics) and the continuous-training loop has its own in
-# tests/test_continuous.py (its points never fire on the one-shot driver);
-# the training-driver sweep covers everything else
+# compare semantics), the continuous-training loop has its own in
+# tests/test_continuous.py (its points never fire on the one-shot driver),
+# and the model-selection sweep has its own below (its points never fire on
+# the training driver); the training-driver sweep covers everything else
 SERVE_POINTS = tuple(p for p in registered_fault_points() if p.startswith("serve."))
 CONTINUOUS_POINTS = tuple(
     p for p in registered_fault_points() if p.startswith("continuous.")
 )
+SWEEP_POINTS = tuple(p for p in registered_fault_points() if p.startswith("sweep."))
 TRAINING_POINTS = tuple(
     p
     for p in registered_fault_points()
-    if not p.startswith(("serve.", "continuous."))
+    if not p.startswith(("serve.", "continuous.", "sweep."))
 )
 
 
 def test_registry_covers_every_chaos_sweep():
-    # TRAINING_POINTS is the registry's set complement of the other two
-    # sweeps, so their union is total by construction — the real guard is
-    # this prefix allowlist: a fault point that no sweep crashes is untested
+    # TRAINING_POINTS is the registry's set complement of the other sweeps,
+    # so their union is total by construction — the real guard is this
+    # prefix allowlist: a fault point that no sweep crashes is untested
     # recovery code, so a NEW subsystem prefix must fail here until its
     # points are claimed by a sweep (extend a sweep, then the allowlist)
     assert {p.split(".", 1)[0] for p in TRAINING_POINTS} == {
@@ -68,6 +71,12 @@ def test_registry_covers_every_chaos_sweep():
         "continuous.commit",
     } == set(CONTINUOUS_POINTS)
     assert {p.split(".", 1)[0] for p in SERVE_POINTS} == {"serve"}
+    assert {
+        "sweep.propose",
+        "sweep.train",
+        "sweep.evaluate",
+        "sweep.commit",
+    } == set(SWEEP_POINTS)
 
 FE_COORD = (
     "name=global,feature.shard=shardA,optimizer=LBFGS,"
@@ -240,3 +249,82 @@ def test_serving_crash_is_explicit_never_a_wrong_score(tmp_path, rng, point):
         )
     finally:
         frontend.close()
+
+
+# --------------------------------------------------------------------------
+# model-selection sweep: crash at every sweep.* fault point, restart against
+# the same checkpoint directory, and assert BOTH the committed winner
+# checkpoint generation and the reference-format export are bitwise identical
+# to an uninterrupted run's. All sweep points fire BEFORE the single durable
+# write (the atomic winner commit), so a restart replays the whole seeded
+# sweep bit-identically; a crash between commit and export is healed by the
+# idempotent re-export on the restored run.
+# --------------------------------------------------------------------------
+
+SWEEP_FE = (
+    "name=global,feature.shard=shardA,optimizer=LBFGS,"
+    "max.iter=25,tolerance=1e-7,regularization=L2,reg.weights=1.0"
+)
+SWEEP_RE = (
+    "name=per-user,random.effect.type=userId,feature.shard=shardA,"
+    "optimizer=LBFGS,max.iter=25,tolerance=1e-7,regularization=L2,reg.weights=1.0"
+)
+
+
+def _run_sweep_driver(data_root, out_root, ckpt_dir):
+    from photon_ml_tpu.cli import sweep_driver
+
+    args = sweep_driver.build_arg_parser().parse_args([
+        "--input-data-directories", str(data_root / "train"),
+        "--validation-data-directories", str(data_root / "validate"),
+        "--root-output-directory", str(out_root),
+        "--override-output-directory",  # restarts re-prepare the output root
+        "--feature-shard-configurations", "name=shardA,feature.bags=features",
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--coordinate-configurations", SWEEP_FE,
+        "--coordinate-configurations", SWEEP_RE,
+        "--coordinate-update-sequence", "global,per-user",
+        "--evaluators", "AUC",
+        "--sweep-axis", "coordinate=global,parameter=l2,min=0.01,max=100,transform=LOG",
+        "--sweep-axis", "coordinate=per-user,parameter=l2,min=0.01,max=100,transform=LOG",
+        "--sweep-rounds", "2",
+        "--sweep-population", "3",
+        "--sweep-seed", "17",
+        "--checkpoint-directory", str(ckpt_dir),
+    ])
+    return sweep_driver.run(args)
+
+
+@pytest.fixture(scope="module")
+def sweep_reference(chaos_data, tmp_path_factory):
+    """The uninterrupted sweep every crash-restart run must match bitwise."""
+    out = tmp_path_factory.mktemp("sweep-ref")
+    stats = _run_sweep_driver(chaos_data, out / "run", out / "ckpt")
+    return out, stats
+
+
+def test_sweep_export_is_deterministic(chaos_data, sweep_reference, tmp_path):
+    ref_out, ref_stats = sweep_reference
+    stats = _run_sweep_driver(chaos_data, tmp_path / "run", tmp_path / "ckpt")
+    assert stats["winner"] == ref_stats["winner"]
+    assert_trees_identical(
+        str(ref_out / "run" / "export"), str(tmp_path / "run" / "export")
+    )
+    assert_trees_identical(str(ref_out / "ckpt"), str(tmp_path / "ckpt"))
+
+
+@pytest.mark.parametrize("point", SWEEP_POINTS)
+def test_sweep_crash_restart_exports_identical_winner(
+    chaos_data, sweep_reference, tmp_path, point
+):
+    ref_out, ref_stats = sweep_reference
+    stats, outcome = run_with_crash_at(
+        lambda: _run_sweep_driver(chaos_data, tmp_path / "run", tmp_path / "ckpt"),
+        point,
+    )
+    assert outcome.crashed and outcome.restarts >= 1
+    assert stats["winner"] == ref_stats["winner"]
+    assert_trees_identical(
+        str(ref_out / "run" / "export"), str(tmp_path / "run" / "export")
+    )
+    assert_trees_identical(str(ref_out / "ckpt"), str(tmp_path / "ckpt"))

@@ -640,3 +640,22 @@ def test_re_storage_dtype_rejected_outside_fused_backend(tmp_path):
     ])
     with pytest.raises(SystemExit, match="compute-backend fused"):
         d.run(args)
+
+
+# ----------------------------------------------------------- sweep driver
+
+
+def test_parse_sweep_axis_grammar():
+    from photon_ml_tpu.cli.sweep_driver import parse_sweep_axis
+
+    axis = parse_sweep_axis(
+        "coordinate=global,parameter=l2,min=0.01,max=100,transform=LOG"
+    )
+    assert (axis.coordinate_id, axis.parameter) == ("global", "l2")
+    assert (axis.min, axis.max, axis.transform) == (0.01, 100.0, "LOG")
+    with pytest.raises(ValueError, match="Duplicate key"):
+        parse_sweep_axis("coordinate=g,parameter=l2,min=0.1,max=1,min=0.5")
+    with pytest.raises(ValueError, match="Missing required key"):
+        parse_sweep_axis("coordinate=g,parameter=l2,min=0.1")
+    with pytest.raises(ValueError, match="Unknown sweep-axis keys"):
+        parse_sweep_axis("coordinate=g,parameter=l2,min=0.1,max=1,scale=LOG")

@@ -258,3 +258,87 @@ def test_re_storage_dtype_requires_fused_pass():
         fused_pass=True,
     )
     assert est.re_storage_dtype == jnp.bfloat16
+
+
+# -------------------------------------------------- GLM family matrix
+
+
+def make_family_input(rng, task, n=600, d=4, n_users=8):
+    """GLMix data whose labels follow the family's generative model."""
+    w = rng.normal(size=d) * 0.6
+    bias = rng.normal(size=n_users)
+    X = rng.normal(size=(n, d))
+    users = np.arange(n) % n_users
+    z = X @ w + bias[users]
+    task = TaskType(task)
+    if task == TaskType.LOGISTIC_REGRESSION:
+        y = (rng.random(n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float64)
+    elif task == TaskType.LINEAR_REGRESSION:
+        y = z + 0.3 * rng.normal(size=n)
+    elif task == TaskType.POISSON_REGRESSION:
+        y = rng.poisson(np.exp(np.clip(z, -3.0, 2.0))).astype(np.float64)
+    else:
+        y = (z > 0).astype(np.float64)
+    uid = np.asarray([f"u{u}" for u in users], dtype=object)
+    return GameInput(
+        features={
+            "global": X,
+            "per-user": sp.csr_matrix(np.ones((n, 1))),
+        },
+        labels=y,
+        id_columns={"userId": uid},
+    )
+
+
+@pytest.mark.parametrize(
+    "task",
+    [
+        TaskType.LOGISTIC_REGRESSION,
+        TaskType.LINEAR_REGRESSION,
+        TaskType.POISSON_REGRESSION,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+    ],
+)
+def test_family_matrix_end_to_end(rng, task):
+    """Every GLM family the reference trains (logistic, linear, Poisson,
+    smoothed hinge) goes through the FULL GAME pipeline: fixed + random
+    effect coordinate descent, the task's default validation evaluator,
+    best-model selection, and fused-engine scoring of the result."""
+    data = make_family_input(rng, task)
+    train, val = data.select(np.arange(0, 420)), data.select(np.arange(420, 600))
+    est = GameEstimator(
+        task=task, coordinate_configurations=make_configs(), n_iterations=2
+    )
+    results = est.fit(train, validation_data=val)
+    assert len(results) == 1
+    r = results[0]
+    assert r.best_metric is not None and np.isfinite(r.best_metric)
+    for cid in ("fixed", "per-user"):
+        m = r.best_model.get_model(cid)
+        arrays = (
+            [m.coeffs] if hasattr(m, "coeffs") else [m.model.coefficients.means]
+        )
+        for a in arrays:
+            assert np.isfinite(np.asarray(a)).all(), cid
+    # the trained family's model serves through the fused engine at one-ulp
+    # tolerance: trained f32 coefficients against the x64 harness's f64
+    # features promote the reduction, and eager/fused associate it
+    # differently in the last f64 bit (same budget as test_serving's
+    # mesh-path assert_parity; the same-dtype bitwise contract is pinned
+    # there by the family_matrix engine tests)
+    eager_t = GameTransformer(model=r.best_model, engine="eager")
+    fused_t = GameTransformer(model=r.best_model, engine="fused")
+    eager = eager_t.score(val, include_offsets=False)
+    fused = fused_t.score(val, include_offsets=False)
+    assert fused.dtype == eager.dtype
+    np.testing.assert_allclose(fused, eager, rtol=5e-15, atol=1e-14)
+    pc_e, pc_f = eager_t.score_per_coordinate(val), fused_t.score_per_coordinate(val)
+    for cid in pc_e:
+        np.testing.assert_allclose(
+            pc_f[cid], pc_e[cid], rtol=5e-15, atol=1e-14, err_msg=cid
+        )
+    # the family's mean prediction applies its link (prediction sanity)
+    if task == TaskType.POISSON_REGRESSION:
+        from photon_ml_tpu.serving import get_engine
+
+        assert (get_engine(r.best_model).predict(val) >= 0).all()

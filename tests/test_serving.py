@@ -618,3 +618,112 @@ def test_evict_engine_is_fingerprint_scoped(rng):
     assert get_engine(m2) is e2  # untouched entry survives
     assert get_engine(m1) is not e1
     assert evict_engine("not-a-fingerprint") == 0
+
+
+# ------------------------------------------------------------------------
+# GLM family matrix: the fused engine and the micro-batching frontend must
+# serve EVERY family the trainer produces (logistic, linear, Poisson,
+# smoothed hinge) — score parity bitwise vs eager, predict through the
+# family's link function, frontend coalescing bitwise vs direct engine calls.
+# ------------------------------------------------------------------------
+
+from photon_ml_tpu.models.glm import model_class_for_task
+
+ALL_TASKS = [
+    TaskType.LOGISTIC_REGRESSION,
+    TaskType.LINEAR_REGRESSION,
+    TaskType.POISSON_REGRESSION,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+]
+
+
+def family_glmix_model(rng, task):
+    """FE + per-user RE model pair of one family (the trainer's output shape
+    for that task)."""
+    task = TaskType(task)
+    re = random_model(rng, "userId", n_entities=10)
+    re = __import__("dataclasses").replace(re, task=task)
+    return GameModel(
+        models={
+            "fixed": fixed_model(rng, cls=model_class_for_task(task)),
+            "per-user": re,
+        }
+    )
+
+
+@pytest.mark.parametrize("task", ALL_TASKS)
+def test_family_matrix_engine_score_parity(rng, task):
+    model = family_glmix_model(rng, task)
+    assert_parity(model, glmix_input(rng, with_items=False))
+
+
+@pytest.mark.parametrize("task", ALL_TASKS)
+def test_family_matrix_predict_applies_the_link(rng, task):
+    """predict = link^-1(score + offsets) per family. Default float64 offsets
+    take the engine's host-side link branch (full precision, documented in
+    engine.predict): the family's numpy link applied to the engine's own
+    margins, compared at one-ulp tolerance — numpy's vectorized exp may
+    differ from itself in the last bit depending on buffer alignment
+    (SIMD body vs scalar tail), so exact equality would be flaky for the
+    exp-bearing links. Margin-identity families compare bitwise."""
+    from photon_ml_tpu.serving import get_engine
+
+    model = family_glmix_model(rng, task)
+    data = glmix_input(rng, with_items=False)
+    eng = get_engine(model)
+    margins = eng.score(data, include_offsets=True)
+    task = TaskType(task)
+    if task == TaskType.LOGISTIC_REGRESSION:
+        expect = 1.0 / (1.0 + np.exp(-margins))
+    elif task == TaskType.POISSON_REGRESSION:
+        expect = np.exp(margins)
+    else:  # linear and smoothed hinge predict the raw margin
+        np.testing.assert_array_equal(eng.predict(data), margins)
+        return
+    np.testing.assert_allclose(eng.predict(data), expect, rtol=1e-15, atol=0)
+
+
+@pytest.mark.parametrize("task", ALL_TASKS)
+def test_family_matrix_device_link_predict(rng, task):
+    """Device-representable (f32) offsets take the FUSED on-device link
+    branch; it must agree with the host link to float tolerance (different
+    fusion => not bitwise, the PR 1 lesson)."""
+    from photon_ml_tpu.serving import get_engine
+
+    model = family_glmix_model(rng, task)
+    data = glmix_input(rng, with_items=False)
+    data = __import__("dataclasses").replace(
+        data, offsets=data.offsets.astype(np.float32)
+    )
+    eng = get_engine(model)
+    margins = np.asarray(eng.score(data, include_offsets=True), dtype=np.float64)
+    task = TaskType(task)
+    if task == TaskType.LOGISTIC_REGRESSION:
+        expect = 1.0 / (1.0 + np.exp(-margins))
+    elif task == TaskType.POISSON_REGRESSION:
+        expect = np.exp(margins)
+    else:
+        expect = margins
+    np.testing.assert_allclose(eng.predict(data), expect, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("task", ALL_TASKS)
+def test_family_matrix_frontend_coalescing_parity(rng, task):
+    """Coalesced frontend responses must be bitwise what a direct engine call
+    returns, for every family (the per-row independence contract does not
+    care about the link/loss, but the dispatch plumbing must not either)."""
+    from photon_ml_tpu.serving import FrontendConfig, ServingFrontend, get_engine
+
+    model = family_glmix_model(rng, task)
+    eng = get_engine(model)
+    reqs = [glmix_input(rng, n=9, with_items=False) for _ in range(4)]
+    frontend = ServingFrontend(eng, FrontendConfig(max_wait_ms=5.0, max_batch=8))
+    try:
+        futures = [frontend.submit(r) for r in reqs]
+        for r, fut in zip(reqs, futures):
+            out = fut.result(30)
+            direct = eng.score(r)
+            assert out.dtype == direct.dtype
+            np.testing.assert_array_equal(out, direct)
+    finally:
+        frontend.close()

@@ -1,0 +1,587 @@
+"""Batched model selection (photon_ml_tpu/sweep): spec validation, vmapped vs
+sequential bitwise parity, population divergence rejects per GLM family, the
+Bayesian round loop, winner checkpoint/export, hot-swap servability and
+seeded determinism."""
+
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.estimators.config import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.estimators.game_estimator import GameEstimator
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.sweep import (
+    PopulationTrainer,
+    SweepAxis,
+    SweepConfig,
+    SweepRunner,
+    SweepSpec,
+)
+from photon_ml_tpu.types import (
+    HyperparameterTuningMode,
+    OptimizerType,
+    RegularizationType,
+    TaskType,
+)
+
+ALL_TASKS = [
+    TaskType.LOGISTIC_REGRESSION,
+    TaskType.LINEAR_REGRESSION,
+    TaskType.POISSON_REGRESSION,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+]
+
+
+def opt_config(
+    reg=RegularizationType.L2, weight=1.0, l1_ratio=None, max_iter=25, tol=1e-7
+):
+    return GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(
+            optimizer_type=(
+                OptimizerType.OWLQN
+                if reg in (RegularizationType.L1, RegularizationType.ELASTIC_NET)
+                else OptimizerType.LBFGS
+            ),
+            max_iterations=max_iter,
+            tolerance=tol,
+        ),
+        regularization_context=(
+            RegularizationContext(reg, elastic_net_alpha=l1_ratio)
+            if l1_ratio is not None
+            else RegularizationContext(reg)
+        ),
+        regularization_weight=weight,
+    )
+
+
+def make_inputs(rng, task=TaskType.LOGISTIC_REGRESSION, n=260, n_val=140, d=4,
+                n_users=9):
+    total = n + n_val
+    X = rng.normal(size=(total, d)).astype(np.float32)
+    users = np.arange(total) % n_users
+    w = rng.normal(size=d) * 0.6
+    z = X @ w + 0.5 * rng.normal(size=n_users)[users]
+    if task == TaskType.LOGISTIC_REGRESSION:
+        y = (rng.random(total) < 1.0 / (1.0 + np.exp(-z))).astype(np.float64)
+    elif task == TaskType.LINEAR_REGRESSION:
+        y = z + 0.3 * rng.normal(size=total)
+    elif task == TaskType.POISSON_REGRESSION:
+        y = rng.poisson(np.exp(np.clip(z, -3.0, 2.0))).astype(np.float64)
+    else:
+        y = (z > 0).astype(np.float64)
+
+    def cut(lo, hi):
+        return GameInput(
+            features={"shardA": sp.csr_matrix(X[lo:hi])},
+            labels=np.asarray(y[lo:hi], dtype=np.float64),
+            id_columns={"userId": users[lo:hi]},
+        )
+
+    return cut(0, n), cut(n, total)
+
+
+def make_estimator(task=TaskType.LOGISTIC_REGRESSION, fe_cfg=None, re_cfg=None,
+                   n_iterations=1, **kwargs):
+    coords = {
+        "global": CoordinateConfiguration(
+            FixedEffectDataConfiguration("shardA"), fe_cfg or opt_config(),
+            **({"down_sampling_rate": kwargs.pop("down_sampling_rate")}
+               if "down_sampling_rate" in kwargs else {}),
+        ),
+        "per-user": CoordinateConfiguration(
+            RandomEffectDataConfiguration("userId", "shardA"),
+            re_cfg or opt_config(),
+            **({"per_entity_reg_weights": kwargs.pop("per_entity_reg_weights")}
+               if "per_entity_reg_weights" in kwargs else {}),
+        ),
+    }
+    return GameEstimator(
+        task=task, coordinate_configurations=coords, n_iterations=n_iterations,
+        **kwargs,
+    )
+
+
+def l2_spec():
+    return SweepSpec(
+        axes=(
+            SweepAxis("global", "l2", 0.01, 100.0, "LOG"),
+            SweepAxis("per-user", "l2", 0.01, 100.0, "LOG"),
+        )
+    )
+
+
+def settings_grid():
+    return [
+        {"global.l2": 0.5, "per-user.l2": 8.0},
+        {"global.l2": 20.0, "per-user.l2": 0.05},
+        {"global.l2": 1.0, "per-user.l2": 1.0},
+    ]
+
+
+def make_trainer(estimator, train_input, seed=0):
+    datasets = estimator.prepare_training_datasets(train_input)
+    return PopulationTrainer(
+        estimator, datasets, np.asarray(train_input.offsets), seed=seed
+    )
+
+
+def assert_bitwise_tables(a, b):
+    for cid in a.coeffs:
+        ca, cb = np.asarray(a.coeffs[cid]), np.asarray(b.coeffs[cid])
+        assert ca.dtype == cb.dtype
+        np.testing.assert_array_equal(ca, cb, err_msg=cid)
+        np.testing.assert_array_equal(
+            np.asarray(a.train_scores[cid]), np.asarray(b.train_scores[cid]),
+            err_msg=cid,
+        )
+
+
+# ----------------------------------------------------------------- spec
+
+
+def test_spec_rejects_unknown_coordinate():
+    est = make_estimator()
+    spec = SweepSpec(axes=(SweepAxis("nope", "l2", 0.1, 1.0),))
+    with pytest.raises(ValueError, match="unknown coordinate"):
+        spec.validate(est)
+
+
+def test_spec_rejects_l1_axis_without_l1_base():
+    est = make_estimator()
+    spec = SweepSpec(axes=(SweepAxis("global", "l1", 0.1, 1.0),))
+    with pytest.raises(ValueError, match="no L1 term"):
+        spec.validate(est)
+
+
+def test_spec_rejects_down_sampling_on_random_effect():
+    est = make_estimator()
+    spec = SweepSpec(axes=(SweepAxis("per-user", "down_sampling_rate", 0.2, 0.8),))
+    with pytest.raises(ValueError, match="fixed-effect knob"):
+        spec.validate(est)
+
+
+def test_spec_rejects_down_sampling_axis_without_base_rate():
+    est = make_estimator()
+    spec = SweepSpec(axes=(SweepAxis("global", "down_sampling_rate", 0.2, 0.8),))
+    with pytest.raises(ValueError, match="down-sampling base configuration"):
+        spec.validate(est)
+
+
+def test_spec_rejects_reg_weight_grid():
+    coords = {
+        "global": CoordinateConfiguration(
+            FixedEffectDataConfiguration("shardA"), opt_config(),
+            reg_weights=(0.1, 1.0),
+        ),
+    }
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION, coordinate_configurations=coords
+    )
+    with pytest.raises(ValueError, match="sweep OWNS the regularization axis"):
+        l2_spec().validate(est)
+
+
+def test_spec_rejects_array_per_entity_override_under_l2_axis():
+    est = make_estimator(per_entity_reg_weights=np.full(9, 2.0))
+    with pytest.raises(ValueError, match="overrides EVERY entity"):
+        l2_spec().validate(est)
+
+
+def test_spec_axis_range_and_transform_validation():
+    with pytest.raises(ValueError, match="min"):
+        SweepAxis("a", "l2", 1.0, 1.0)
+    with pytest.raises(ValueError, match="LOG transform requires min > 0"):
+        SweepAxis("a", "l2", 0.0, 1.0, "LOG")
+    with pytest.raises(ValueError, match="strictly inside"):
+        SweepAxis("a", "down_sampling_rate", 0.0, 0.9)
+    with pytest.raises(ValueError, match="Unknown sweep parameter"):
+        SweepAxis("a", "learning_rate", 0.1, 1.0)
+    with pytest.raises(ValueError, match="Duplicate"):
+        SweepSpec(axes=(SweepAxis("a", "l2", 0.1, 1.0), SweepAxis("a", "l2", 1.0, 2.0)))
+
+
+def test_spec_decode_encode_roundtrip():
+    spec = SweepSpec(
+        axes=(
+            SweepAxis("global", "l2", 0.01, 100.0, "LOG"),
+            SweepAxis("global", "down_sampling_rate", 0.2, 0.8),
+        )
+    )
+    cand = np.array([[0.0, 0.0], [1.0, 1.0], [0.5, 0.25]])
+    settings = spec.decode(cand)
+    assert settings[0] == {"global.l2": 0.01, "global.down_sampling_rate": 0.2}
+    assert settings[1] == {"global.l2": 100.0, "global.down_sampling_rate": 0.8}
+    # LOG axis midpoint is the geometric mean
+    assert settings[2]["global.l2"] == pytest.approx(1.0)
+    back = spec.encode(settings)
+    np.testing.assert_allclose(back, cand, atol=1e-12)
+
+
+def test_spec_dict_per_entity_needs_sequential_path(rng):
+    est = make_estimator(per_entity_reg_weights={3: 5.0})
+    spec = l2_spec()
+    spec.validate(est)  # valid — just not vmappable
+    assert not spec.vmappable(est)
+    with pytest.raises(ValueError, match="sequential"):
+        SweepRunner(est, spec, SweepConfig(checkpoint_directory="/dev/null",
+                                           vmapped=True))
+
+
+# ------------------------------------------------------- population parity
+
+
+def test_vmapped_matches_sequential_bitwise(rng):
+    train_input, _ = make_inputs(rng)
+    trainer = make_trainer(make_estimator(), train_input)
+    pv = trainer.train(settings_grid(), n_iterations=2, vmapped=True)
+    ps = trainer.train(settings_grid(), n_iterations=2, vmapped=False)
+    assert pv.path == "vmapped" and ps.path == "sequential"
+    assert_bitwise_tables(pv, ps)
+
+
+def test_dict_per_entity_sequential_path_trains(rng):
+    """The fallback's reason to exist: dict per-entity L2 overrides resolve
+    host-side per setting; overridden entities keep their absolute weight,
+    the rest sweep."""
+    train_input, _ = make_inputs(rng)
+    est_dict = make_estimator(per_entity_reg_weights={0: 3.0})
+    trainer = make_trainer(est_dict, train_input)
+    settings = settings_grid()[:2]
+    pop = trainer.train(settings, vmapped=False)
+    assert pop.path == "sequential"
+    # reference: resolving each setting's dict into an explicit [E] array and
+    # training it alone must give identical rows (dict vs array parity)
+    for p, s in enumerate(settings):
+        rows = np.full(9, s["per-user.l2"])
+        rows[0] = 3.0
+        tr = make_trainer(make_estimator(per_entity_reg_weights=rows), train_input)
+        ref = tr.train([s], vmapped=False)
+        np.testing.assert_array_equal(
+            np.asarray(pop.coeffs["per-user"][p]),
+            np.asarray(ref.coeffs["per-user"][0]),
+        )
+
+
+def test_down_sampling_axis_parity_and_effect(rng):
+    train_input, _ = make_inputs(rng, n=300)
+    est = make_estimator(down_sampling_rate=0.5)
+    spec = SweepSpec(
+        axes=(
+            SweepAxis("global", "l2", 0.1, 10.0, "LOG"),
+            SweepAxis("global", "down_sampling_rate", 0.25, 0.9),
+        )
+    )
+    spec.validate(est)
+    trainer = make_trainer(est, train_input, seed=7)
+    settings = [
+        {"global.l2": 1.0, "global.down_sampling_rate": 0.3},
+        {"global.l2": 1.0, "global.down_sampling_rate": 0.85},
+    ]
+    pv = trainer.train(settings, n_iterations=2, vmapped=True)
+    ps = trainer.train(settings, n_iterations=2, vmapped=False)
+    assert_bitwise_tables(pv, ps)
+    # different rates genuinely train different fixed effects
+    assert not np.array_equal(
+        np.asarray(pv.coeffs["global"][0]), np.asarray(pv.coeffs["global"][1])
+    )
+
+
+def test_l1_axis_parity(rng):
+    train_input, _ = make_inputs(rng)
+    cfg = opt_config(RegularizationType.ELASTIC_NET, weight=1.0, l1_ratio=0.5)
+    est = make_estimator(fe_cfg=cfg, re_cfg=cfg)
+    spec = SweepSpec(
+        axes=(
+            SweepAxis("global", "l1", 0.01, 1.0, "LOG"),
+            SweepAxis("per-user", "l2", 0.1, 10.0, "LOG"),
+        )
+    )
+    spec.validate(est)
+    trainer = make_trainer(est, train_input)
+    settings = [
+        {"global.l1": 0.02, "per-user.l2": 5.0},
+        {"global.l1": 0.8, "per-user.l2": 0.2},
+    ]
+    pv = trainer.train(settings, vmapped=True)
+    ps = trainer.train(settings, vmapped=False)
+    assert_bitwise_tables(pv, ps)
+    # a strong L1 lane must actually sparsify relative to the weak one
+    strong = np.asarray(pv.coeffs["global"][1])
+    weak = np.asarray(pv.coeffs["global"][0])
+    assert (np.abs(strong) < 1e-8).sum() >= (np.abs(weak) < 1e-8).sum()
+
+
+def test_population_scoring_matches_per_lane_models(rng):
+    """The batched validation scorer (cached alignment gather + vmapped
+    view score) must agree with the eager per-lane score_model_on_dataset
+    path — different compiled shapes, so tolerance, not bitwise."""
+    from photon_ml_tpu.algorithm.coordinate import score_model_on_dataset
+
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+    trainer = make_trainer(est, train_input)
+    pop = trainer.train(settings_grid(), vmapped=True)
+    scoring = est.prepare_scoring_datasets(validation_input)
+    batched = np.asarray(trainer.score_population(pop, scoring))
+    for p in range(pop.population):
+        models = trainer.build_models(pop, p)
+        eager = sum(
+            np.asarray(score_model_on_dataset(models[cid], scoring[cid]))
+            for cid in models
+        )
+        np.testing.assert_allclose(batched[p], eager, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- divergence (per family)
+
+
+@pytest.mark.parametrize("task", ALL_TASKS)
+def test_population_divergence_guard_per_family(rng, task):
+    """Poisoned data (a non-finite sample weight — it multiplies the loss in
+    EVERY family, unlike a NaN margin, which the hinge's piecewise branches
+    swallow) makes every lane's fixed-effect objective NaN: the per-lane
+    in-program guard REJECTS the update — the lane keeps its previous
+    (zero-init) fixed-effect state bit for bit and the reject is recorded per
+    setting. The random effect trains finitely (only the poisoned entity's
+    solve sees the NaN, and a solver never accepts a NaN step, so its
+    coefficients stay at the warm start — the same semantics as the
+    single-model guard). Healthy populations on clean data train normally."""
+    train_input, _ = make_inputs(rng, task=task)
+    weights = np.ones(train_input.n)
+    weights[0] = np.nan  # poisons every lane's fixed-effect objective
+    poisoned = GameInput(
+        features=train_input.features,
+        labels=train_input.labels,
+        weights=weights,
+        id_columns=train_input.id_columns,
+    )
+    est = make_estimator(task=task)
+    datasets = est.prepare_training_datasets(poisoned)
+    trainer = PopulationTrainer(est, datasets, np.zeros(train_input.n), seed=0)
+    settings = settings_grid()[:2]
+    pop = trainer.train(settings, vmapped=True)
+    assert pop.rejected.all()
+    assert pop.incidents and all(i.kind == "divergence" for i in pop.incidents)
+    assert {i.coordinate_id for i in pop.incidents} == {"global"}
+    fe = np.asarray(pop.coeffs["global"])
+    assert np.array_equal(fe, np.zeros_like(fe)), (
+        "rejected lanes must keep the previous (zero) fixed-effect state"
+    )
+    assert np.isfinite(np.asarray(pop.coeffs["per-user"])).all()
+    # clean data: same trainer config trains finite, un-rejected models
+    clean = PopulationTrainer(
+        est, est.prepare_training_datasets(train_input),
+        np.zeros(train_input.n), seed=0,
+    )
+    pop_ok = clean.train(settings, vmapped=True)
+    assert not pop_ok.rejected.any()
+    for cid in pop_ok.coeffs:
+        assert np.isfinite(np.asarray(pop_ok.coeffs[cid])).all()
+
+
+# --------------------------------------------------------------- runner
+
+
+@pytest.mark.parametrize("task", ALL_TASKS)
+def test_runner_end_to_end_per_family(rng, task, tmp_path):
+    """Family is a STATIC axis: one program family per task, population axis
+    within — every family's sweep picks a winner and commits a generational
+    checkpoint the hot-swap bootstrap actually serves."""
+    from photon_ml_tpu.serving import FrontendConfig
+    from photon_ml_tpu.serving.hotswap import serve_from_checkpoint
+
+    train_input, validation_input = make_inputs(rng, task=task)
+    est = make_estimator(task=task)
+    config = SweepConfig(
+        checkpoint_directory=str(tmp_path / "ckpt"), rounds=2, population=3,
+        seed=4,
+    )
+    result = SweepRunner(est, l2_spec(), config).run(train_input, validation_input)
+    assert result.models_evaluated == 6
+    assert len(result.rounds) == 2
+    assert set(result.winner_settings) == {"global.l2", "per-user.l2"}
+    assert np.isfinite(result.winner_metric)
+
+    frontend, _manager = serve_from_checkpoint(
+        str(tmp_path / "ckpt"), config=FrontendConfig(max_wait_ms=0.0)
+    )
+    try:
+        probe = GameInput(
+            features={"shardA": sp.csr_matrix(rng.normal(size=(6, 4)))},
+            id_columns={"userId": np.arange(6) % 9},
+        )
+        scores = frontend.score(probe, timeout=60)
+        assert np.isfinite(np.asarray(scores)).all()
+    finally:
+        frontend.close()
+
+
+def test_runner_is_deterministic_and_restores(rng, tmp_path):
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+
+    def go(ckpt):
+        config = SweepConfig(
+            checkpoint_directory=str(ckpt), rounds=3, population=3, seed=9
+        )
+        return SweepRunner(est, l2_spec(), config).run(train_input, validation_input)
+
+    a = go(tmp_path / "a")
+    b = go(tmp_path / "b")
+    assert not a.restored and not b.restored
+    assert a.winner_settings == b.winner_settings
+    assert a.winner_metric == b.winner_metric
+    assert [r.to_dict() for r in a.rounds] == [r.to_dict() for r in b.rounds]
+    # an idempotent rerun against the committed directory restores
+    c = go(tmp_path / "a")
+    assert c.restored
+    assert c.winner_settings == a.winner_settings
+    assert c.winner_metrics == a.winner_metrics
+
+
+def test_runner_bayesian_concentrates_after_underdetermined(rng, tmp_path):
+    """Once observations exceed the dimension, proposals come from the GP+EI
+    posterior — the searcher must have consumed the observed values (the
+    wiring to hyperparameter/search.py, not a re-derivation of Sobol)."""
+    from photon_ml_tpu.hyperparameter.search import GaussianProcessSearch
+
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+    config = SweepConfig(
+        checkpoint_directory=str(tmp_path / "ckpt"), rounds=2, population=4,
+        seed=2,
+    )
+    result = SweepRunner(est, l2_spec(), config).run(train_input, validation_input)
+    # reproduce round 2's proposals through the search module directly
+    searcher = GaussianProcessSearch(2, None, seed=2)
+    spec = l2_spec()
+    r0 = result.rounds[0]
+    first = searcher.propose_batch(4)
+    assert spec.decode(first) == r0.settings
+    for point, value in zip(first, r0.values):
+        if np.isfinite(value):
+            searcher.on_observation(point, float(value))
+    second = searcher.propose_batch(4)
+    assert spec.decode(second) == result.rounds[1].settings
+    assert searcher.last_model is not None  # the GP actually fit
+
+
+def test_runner_requires_validation_data(rng, tmp_path):
+    train_input, _ = make_inputs(rng)
+    est = make_estimator()
+    config = SweepConfig(checkpoint_directory=str(tmp_path / "c"))
+    with pytest.raises(ValueError, match="validation"):
+        SweepRunner(est, l2_spec(), config).run(train_input, None)
+
+
+def test_runner_random_mode(rng, tmp_path):
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+    config = SweepConfig(
+        checkpoint_directory=str(tmp_path / "ckpt"), rounds=2, population=3,
+        seed=1, mode=HyperparameterTuningMode.RANDOM,
+    )
+    result = SweepRunner(est, l2_spec(), config).run(train_input, validation_input)
+    assert result.models_evaluated == 6
+    assert np.isfinite(result.winner_metric)
+
+
+def test_winner_export_is_idempotent(rng, tmp_path):
+    from photon_ml_tpu.data.index_map import IndexMap
+
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+    config = SweepConfig(
+        checkpoint_directory=str(tmp_path / "ckpt"), rounds=2, population=2,
+        seed=3, export_directory=str(tmp_path / "export"),
+    )
+    imap = IndexMap([f"f{j}\x01" for j in range(4)])
+    maps = {"global": imap, "per-user": imap}
+    r1 = SweepRunner(est, l2_spec(), config).run(
+        train_input, validation_input, index_maps=maps
+    )
+    assert r1.export_path and os.path.isdir(r1.export_path)
+    files = {
+        f: os.path.getmtime(os.path.join(r1.export_path, f))
+        for f in os.listdir(r1.export_path)
+    }
+    # restored rerun re-checks, never rewrites
+    r2 = SweepRunner(est, l2_spec(), config).run(
+        train_input, validation_input, index_maps=maps
+    )
+    assert r2.restored and r2.export_path == r1.export_path
+    assert {
+        f: os.path.getmtime(os.path.join(r2.export_path, f))
+        for f in os.listdir(r2.export_path)
+    } == files
+
+
+def test_fingerprint_is_process_stable(tmp_path):
+    """str(Evaluator) renders its fn field as a per-process function address;
+    a fingerprint embedding one would make a cross-PROCESS rerun reject its
+    own committed sweep and silently retrain (caught by the CLI drive)."""
+    from photon_ml_tpu.evaluation.evaluators import EvaluatorType, evaluator_for_type
+
+    est = make_estimator(
+        validation_evaluators=[evaluator_for_type(EvaluatorType.AUC)]
+    )
+    runner = SweepRunner(
+        est, l2_spec(), SweepConfig(checkpoint_directory=str(tmp_path))
+    )
+    fp = runner._fingerprint(10, 5)
+    assert " at 0x" not in fp
+    assert "AUC" in fp
+
+
+def test_dict_per_entity_unswept_l2_axis_stays_vmapped(rng, tmp_path):
+    """Dict per-entity overrides only force the sequential path when that
+    coordinate's own l2 axis is swept; an l2 axis elsewhere resolves the
+    dict ONCE and rides the vmapped path (regression: the resolved rows were
+    fed back through build_l2_rows, whose E+1-padded output failed its own
+    [E]-array validation)."""
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator(per_entity_reg_weights={0: 3.0, 4: 0.2})
+    spec = SweepSpec(axes=(SweepAxis("global", "l2", 0.01, 100.0, "LOG"),))
+    spec.validate(est)
+    assert spec.vmappable(est)
+    trainer = make_trainer(est, train_input)
+    settings = [{"global.l2": 0.5}, {"global.l2": 20.0}]
+    pv = trainer.train(settings, vmapped=True)
+    ps = trainer.train(settings, vmapped=False)
+    assert pv.path == "vmapped"
+    assert_bitwise_tables(pv, ps)
+    # and the full runner end-to-end over this configuration
+    config = SweepConfig(
+        checkpoint_directory=str(tmp_path / "ckpt"), rounds=2, population=2, seed=6
+    )
+    result = SweepRunner(est, spec, config).run(train_input, validation_input)
+    assert result.path == "vmapped"
+    assert np.isfinite(result.winner_metric)
+
+
+def test_prepare_cache_keys_on_retained_identity(rng, tmp_path):
+    """The device-state cache must compare RETAINED references, not bare
+    id()s: fresh input objects (even at a recycled address) rebuild."""
+    train_a, val_a = make_inputs(rng)
+    est = make_estimator()
+    config = SweepConfig(checkpoint_directory=str(tmp_path / "a"), rounds=1,
+                         population=2, seed=1)
+    runner = SweepRunner(est, l2_spec(), config)
+    prepared_a = runner._prepare(train_a, val_a)
+    assert runner._prepare(train_a, val_a) is prepared_a  # same objects: cached
+    train_b, val_b = make_inputs(np.random.default_rng(99), n=260, n_val=140)
+    prepared_b = runner._prepare(train_b, val_b)
+    assert prepared_b is not prepared_a  # different objects: rebuilt
