@@ -250,9 +250,37 @@ class RandomEffectCoordinate(Coordinate):
     # sharded datasets always take the per-bucket path (the program does not
     # re-place sharded tables).
     use_update_program: bool = True
+    # Inner bucket solver: "lbfgs" (the configured optimizer — bitwise status
+    # quo), "direct" (batched Gram/Cholesky Newton solves), "auto" (direct
+    # for small-K buckets). optimization/normal_equations.py.
+    re_solver: str = "lbfgs"
+    # Storage/accumulation precision for the fused update program's device
+    # tables and feature blocks (optimization/precision.py): None/"f32" is
+    # the bitwise reference; "bf16"/"f16" store tables + features reduced
+    # with f32 accumulation (tolerance-gated, requires use_update_program).
+    precision: object = None
 
     def __post_init__(self):
         self.task = TaskType(self.task)
+        from photon_ml_tpu.optimization.normal_equations import validate_re_solver
+        from photon_ml_tpu.optimization.precision import resolve_precision
+
+        self.re_solver = validate_re_solver(
+            self.re_solver, bool(self.configuration.l1_weight)
+        )
+        self.precision = resolve_precision(self.precision)
+        if not self.precision.is_reference:
+            if not self.use_update_program:
+                raise ValueError(
+                    "reduced-precision storage rides the single-program update "
+                    "path; set use_update_program=True (the per-bucket loop "
+                    "stays f32-only)"
+                )
+            if getattr(self.dataset, "coeffs_sharding", None) is not None:
+                raise ValueError(
+                    "reduced-precision storage is not supported on mesh-sharded "
+                    "datasets (they take the per-bucket path)"
+                )
         # donation ownership: the exact output buffers of our last update
         # program call. Only those are fed back donated; foreign arrays
         # (external warm starts, first iteration) are defensively copied so a
@@ -298,6 +326,7 @@ class RandomEffectCoordinate(Coordinate):
             normalization=self.normalization,
             variance_computation=self.variance_computation,
             per_entity_reg_weights=self.per_entity_reg_weights,
+            re_solver=self.re_solver,
         )
 
     def update_model_active(
@@ -330,6 +359,7 @@ class RandomEffectCoordinate(Coordinate):
             normalization=self.normalization,
             variance_computation=self.variance_computation,
             per_entity_reg_weights=self.per_entity_reg_weights,
+            re_solver=self.re_solver,
         )
         self.last_active_stats = stats
         return model, tracker
@@ -352,6 +382,19 @@ class RandomEffectCoordinate(Coordinate):
             if opt_type in (OptimizerType.TRON, OptimizerType.NEWTON) and not loss.has_hessian:
                 raise ValueError(f"{opt_type.value} requires a twice-differentiable loss")
             dtype = ds.sample_vals.dtype
+            buckets = tuple(ds.buckets)
+            view = (ds.sample_entity_rows, ds.sample_local_cols, ds.sample_vals)
+            if not self.precision.is_reference:
+                # FEATURE storage at the reduced dtype: the update program
+                # reads these arrays (bucket blocks + the scoring view's
+                # values) every iteration — storage-width bytes are the HBM
+                # traffic the policy halves. Cast once per coordinate; solves
+                # and scores upcast in-register (solver_cache).
+                buckets = tuple(
+                    dataclasses.replace(b, X=self.precision.to_storage(b.X))
+                    for b in buckets
+                )
+                view = (view[0], view[1], self.precision.to_storage(view[2]))
             self._fused_static = dict(
                 dtype=dtype,
                 l2_rows=build_l2_rows(
@@ -363,8 +406,8 @@ class RandomEffectCoordinate(Coordinate):
                 ),
                 l1=jnp.asarray(self.configuration.l1_weight or 0.0, dtype=dtype),
                 norm_tables=precompute_norm_tables(ds, self.normalization, dtype),
-                buckets=tuple(ds.buckets),
-                view=(ds.sample_entity_rows, ds.sample_local_cols, ds.sample_vals),
+                buckets=buckets,
+                view=view,
             )
         return self._fused_static
 
@@ -387,7 +430,14 @@ class RandomEffectCoordinate(Coordinate):
         from photon_ml_tpu.optimization.solver_cache import re_coordinate_update_program
 
         st = self._fused_update_static()
-        dtype = st["dtype"]
+        # the coefficient/variance TABLES live at the policy's storage dtype
+        # (the donated state the program reads and writes every update); the
+        # reference policy keeps the dataset dtype — bitwise status quo
+        dtype = (
+            st["dtype"]
+            if self.precision.is_reference
+            else self.precision.storage_dtype
+        )
         E, K_all = ds.n_entities, ds.max_k
 
         def owned_or_copy(key, arr):
@@ -436,6 +486,8 @@ class RandomEffectCoordinate(Coordinate):
             bool(self.configuration.l1_weight),
             VarianceComputationType(self.variance_computation),
             E,
+            self.re_solver,
+            self.precision,
         )
         coeffs_out, score_out, var_out, ok, reasons, iters = program(
             coeffs_prev,

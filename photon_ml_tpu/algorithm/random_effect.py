@@ -241,6 +241,7 @@ def train_random_effect(
     variance_computation: VarianceComputationType = VarianceComputationType.NONE,
     dtype=None,
     per_entity_reg_weights=None,
+    re_solver: str = "lbfgs",
 ) -> tuple[RandomEffectModel, RandomEffectTracker]:
     """Fit one GLM per entity over all buckets.
 
@@ -253,6 +254,11 @@ def train_random_effect(
     — the per-entity regularization the reference envisioned
     (RandomEffectOptimizationProblem.scala:34-37). Entities absent from a dict
     keep the configuration weight.
+
+    ``re_solver`` ("lbfgs" | "direct" | "auto") selects the inner bucket
+    solver (optimization/normal_equations.py): direct Gram/Cholesky Newton
+    solves instead of the configured quasi-Newton loop; "auto" picks direct
+    for small-K buckets only. Default keeps the bitwise status quo.
     """
     task = TaskType(task)
     loss = loss_for_task(task)
@@ -305,7 +311,8 @@ def train_random_effect(
 
     # the cached-solver probe is loop-invariant: resolve it once, not per bucket
     solve = re_bucket_solver(
-        task, configuration.optimizer_config, bool(l1), variance_computation
+        task, configuration.optimizer_config, bool(l1), variance_computation,
+        re_solver,
     )
     for bucket in dataset.buckets:
         S, K = bucket.shape
@@ -424,6 +431,7 @@ def train_random_effect_delta(
     dtype=None,
     per_entity_reg_weights=None,
     min_entities_pad: int = 8,
+    re_solver: str = "lbfgs",
 ) -> tuple[RandomEffectModel, RandomEffectTracker, ActiveSetStats]:
     """Active-set counterpart of :func:`train_random_effect`.
 
@@ -487,7 +495,8 @@ def train_random_effect_delta(
     l2_rows = build_l2_rows(dataset, l2, per_entity_reg_weights, dtype, E)
     l1_arr = jnp.asarray(l1 or 0.0, dtype=dtype)
     solve = re_bucket_solver(
-        task, configuration.optimizer_config, bool(l1), variance_computation
+        task, configuration.optimizer_config, bool(l1), variance_computation,
+        re_solver,
     )
 
     reasons_parts, iters_parts, real_counts = [], [], []

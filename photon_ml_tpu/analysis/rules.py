@@ -140,6 +140,27 @@ RULES: dict[str, Rule] = {
             ),
         ),
         Rule(
+            id="MP001",
+            name="mixed-precision-hazard",
+            default_severity=Severity.WARNING,
+            description=(
+                "Precision hazard in a jitted body: accumulation (sum/mean/"
+                "dot/matmul/einsum) directly in a reduced storage dtype "
+                "(bf16/f16) without an f32 accumulator, an explicit float64 "
+                "promotion (astype/dtype=float64 — emulated and slow on "
+                "accelerators, and it silently widens a mixed-precision "
+                "program), or a dtype-less jnp.array/zeros/ones/full/empty "
+                "in a module that works with reduced storage dtypes (the "
+                "default dtype diverges from the storage policy)"
+            ),
+            hint=(
+                "accumulate via preferred_element_type=jnp.float32 / "
+                "dtype=jnp.float32 (or upcast with .astype(jnp.float32) "
+                "before reducing); avoid float64 in jitted bodies; pass an "
+                "explicit dtype= where storage and compute dtypes differ"
+            ),
+        ),
+        Rule(
             id="SUP001",
             name="suppression-missing-reason",
             default_severity=Severity.ERROR,

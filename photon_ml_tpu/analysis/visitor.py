@@ -37,6 +37,10 @@ from photon_ml_tpu.analysis.rules import Finding, RuleConfig, RULES, Severity
 
 # canonical dotted prefixes whose calls return device values
 _TRACED_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.", "jax.scipy.")
+# jnp calls that return HOST metadata, not device values (carved out of the
+# traced prefixes): dtype introspection is static under tracing
+_STATIC_JNP_CALLS = {"jax.numpy.finfo", "jax.numpy.iinfo", "jax.numpy.dtype",
+                     "jax.numpy.issubdtype", "jax.numpy.result_type"}
 # canonical callables that wrap a function in jit
 _JIT_WRAPPERS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
 # canonical higher-order functions -> positional indices of traced callables
@@ -80,6 +84,49 @@ _LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception", "criti
 
 _TAINT_TRACED = "traced"  # value lives on device / is a tracer
 _TAINT_NPVIEW = "npview"  # np.asarray of a device value: host, but read-only
+
+# --- MP001 (mixed-precision hazards) ---------------------------------------
+_LOW_PRECISION_NAMES = {"bfloat16", "float16"}
+_F64_NAMES = {"float64", "double"}
+# whole-array reductions whose accumulator silently inherits the input dtype
+_REDUCTION_CALLS = {
+    "jax.numpy.sum", "jax.numpy.mean", "jax.numpy.dot", "jax.numpy.vdot",
+    "jax.numpy.matmul", "jax.numpy.einsum", "jax.numpy.tensordot",
+    "jax.lax.dot", "jax.lax.dot_general",
+}
+_REDUCTION_METHODS = {"sum", "mean", "dot"}
+# fresh allocations whose dtype-less default (f32) can silently diverge from
+# a module's reduced storage policy; value = first positional index at which
+# a dtype may appear (zeros(shape, dtype) / full(shape, fill, dtype))
+_DTYPELESS_ALLOCS = {
+    "jax.numpy.zeros": 1,
+    "jax.numpy.ones": 1,
+    "jax.numpy.empty": 1,
+    "jax.numpy.full": 2,
+}
+
+
+def _dtype_ref_in(node, names: set) -> bool:
+    """True when the expression names one of ``names`` as a dtype: an
+    attribute (jnp.bfloat16 / np.float64), a bare name, or a string literal."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in names
+    if isinstance(node, ast.Name):
+        return node.id in names
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str) and node.value in names
+    return False
+
+
+def module_mentions_low_precision(tree: ast.Module) -> bool:
+    """A module is a MIXED-PRECISION SCOPE when it references a reduced
+    storage dtype anywhere (jnp.bfloat16, 'float16', ...): dtype-less
+    allocations in its jitted bodies then risk diverging from the storage
+    policy, which is when MP001's allocation check arms."""
+    for node in ast.walk(tree):
+        if _dtype_ref_in(node, _LOW_PRECISION_NAMES):
+            return True
+    return False
 
 
 @dataclasses.dataclass
@@ -130,6 +177,9 @@ class ModuleIndex(ast.NodeVisitor):
         self.by_name: dict[str, list[FuncInfo]] = {}
         self.jit_aliases: dict[str, JitParams] = {}  # name bound to jax.jit(f)
         self._stack: list[FuncInfo] = []
+        # set by analyze_module (module_mentions_low_precision): arms MP001's
+        # dtype-less-allocation check for this module's jitted bodies
+        self.mixed_precision_scope = False
 
     # -- imports --------------------------------------------------------
     def visit_Import(self, node: ast.Import):
@@ -303,6 +353,10 @@ class FunctionAnalyzer:
         self.config = config
         self.findings = findings
         self.taint: dict[str, str] = {}
+        # names currently bound to a REDUCED-PRECISION (bf16/f16) array —
+        # tracked separately from `taint` so MP001 never perturbs the
+        # host-sync/tracer rules' device-value reasoning
+        self.lowp: set[str] = set()
         self.loop_depth = 0
         self._quiet = 0  # >0 during taint-only pre-passes over loop bodies
 
@@ -358,6 +412,8 @@ class FunctionAnalyzer:
                     return _TAINT_NPVIEW if inner == _TAINT_TRACED else None
                 if c.startswith("numpy."):
                     return None  # numpy call result: host, writable
+                if c in _STATIC_JNP_CALLS:
+                    return None  # dtype introspection: host metadata
                 if c.startswith(_TRACED_PREFIXES) or c in ("jax.device_put",):
                     return _TAINT_TRACED
                 if c in _STATIC_CALLS:
@@ -432,6 +488,52 @@ class FunctionAnalyzer:
                 self._assign_taint(e, kind)
         elif isinstance(target, ast.Starred):
             self._assign_taint(target.value, kind)
+
+    def _assign_lowp(self, target, is_lowp: bool):
+        if isinstance(target, ast.Name):
+            if is_lowp:
+                self.lowp.add(target.id)
+            else:
+                self.lowp.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_lowp(e, is_lowp)
+        elif isinstance(target, ast.Starred):
+            self._assign_lowp(target.value, is_lowp)
+
+    def _is_lowp_expr(self, node) -> bool:
+        """True when the expression's value is (conservatively) a reduced-
+        precision array: a name assigned from .astype(<bf16/f16>) or a
+        creation with dtype=<bf16/f16>, propagated through attributes,
+        slices and non-casting method calls. Arithmetic results are NOT
+        propagated (binary ops promote, which is exactly the repair)."""
+        if isinstance(node, ast.Name):
+            return node.id in self.lowp
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._is_lowp_expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._is_lowp_expr(node.value)
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "astype":
+                # a cast decides the dtype outright, whatever the receiver
+                # was — positional OR keyword spelling
+                if node.args:
+                    return _dtype_ref_in(node.args[0], _LOW_PRECISION_NAMES)
+                return any(
+                    kw.arg == "dtype"
+                    and _dtype_ref_in(kw.value, _LOW_PRECISION_NAMES)
+                    for kw in node.keywords
+                )
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return _dtype_ref_in(kw.value, _LOW_PRECISION_NAMES)
+            if isinstance(node.func, ast.Attribute):
+                # dtype-preserving method on a lowp receiver (.reshape, .T...)
+                return self._is_lowp_expr(node.func.value)
+            return False
+        return False
 
     # -- control-flow-on-tracer helper ----------------------------------
     def uses_traced_value(self, node) -> bool:
@@ -524,12 +626,14 @@ class FunctionAnalyzer:
         if isinstance(st, ast.Assign):
             self.visit_exprs(st.value)
             kind = self.expr_taint(st.value)
+            is_lowp = self._is_lowp_expr(st.value)
             for t in st.targets:
                 if isinstance(t, ast.Subscript):
                     self.check_np_mutation(t, st)
                     self.visit_exprs(t.value, t.slice)
                 else:
                     self._assign_taint(t, kind)
+                    self._assign_lowp(t, is_lowp)
             return
         if isinstance(st, ast.AnnAssign):
             if st.value is not None:
@@ -652,8 +756,83 @@ class FunctionAnalyzer:
                 self.report("RT001", node,
                             f"{c}(<literal array>) inside a jitted body re-embeds the constant on every trace; hoist it")
 
+        # MP001: precision hazards inside jitted bodies
+        if in_jit:
+            self.check_mixed_precision(node, c)
+
         # RT001a: literal python arg to a known-jitted callable without static marking
         self.check_jitted_call_args(node)
+
+    def check_mixed_precision(self, node: ast.Call, c: Optional[str]):
+        """MP001 (jitted bodies only): explicit f64 promotion, accumulation
+        in a reduced storage dtype, dtype-less allocation in a module that
+        works with reduced storage dtypes."""
+        # explicit f64 promotion: .astype(float64) or dtype=float64
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and _dtype_ref_in(node.args[0], _F64_NAMES)
+        ):
+            self.report(
+                "MP001", node,
+                ".astype(float64) inside a jitted body: f64 is emulated/slow "
+                "on accelerators and silently widens a mixed-precision program",
+            )
+            return
+        for kw in node.keywords:
+            if kw.arg == "dtype" and _dtype_ref_in(kw.value, _F64_NAMES):
+                self.report(
+                    "MP001", node,
+                    "dtype=float64 inside a jitted body: f64 is emulated/slow "
+                    "on accelerators and silently widens a mixed-precision program",
+                )
+                return
+
+        # accumulation in the storage dtype: a reduction over a bf16/f16
+        # value without an explicit WIDE accumulator loses mass silently —
+        # a dtype=/preferred_element_type= kwarg only counts as the repair
+        # when it does not itself name a reduced dtype
+        has_accumulator = any(
+            kw.arg in ("dtype", "preferred_element_type")
+            and not _dtype_ref_in(kw.value, _LOW_PRECISION_NAMES)
+            for kw in node.keywords
+        )
+        if not has_accumulator:
+            if c in _REDUCTION_CALLS and any(
+                self._is_lowp_expr(a) for a in node.args
+            ):
+                self.report(
+                    "MP001", node,
+                    f"{c} over a reduced-precision value accumulates in the "
+                    "storage dtype; pass preferred_element_type/dtype="
+                    "jnp.float32 or upcast the operand first",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REDUCTION_METHODS
+                and self._is_lowp_expr(node.func.value)
+            ):
+                self.report(
+                    "MP001", node,
+                    f".{node.func.attr}() on a reduced-precision value "
+                    "accumulates in the storage dtype; pass dtype=jnp.float32 "
+                    "or upcast the receiver first",
+                )
+
+        # dtype-less fresh allocation in a mixed-precision module: the f32
+        # default silently diverges from the storage policy
+        if self.index.mixed_precision_scope and c in _DTYPELESS_ALLOCS:
+            dtype_pos = _DTYPELESS_ALLOCS[c]
+            if len(node.args) <= dtype_pos and not any(
+                kw.arg == "dtype" for kw in node.keywords
+            ):
+                self.report(
+                    "MP001", node,
+                    f"dtype-less {c} in a mixed-precision program scope: the "
+                    "default dtype can diverge from the storage policy; pass "
+                    "an explicit dtype=",
+                )
 
     def check_jitted_call_args(self, node: ast.Call):
         params = None
@@ -735,6 +914,7 @@ def analyze_module(tree: ast.Module, path: str, config: RuleConfig) -> list:
     index = ModuleIndex()
     index.visit(tree)
     index.close_jit_reachability()
+    index.mixed_precision_scope = module_mentions_low_precision(tree)
     findings: list = []
     # module-level statements: analyze as a pseudo-function (not jit context)
     pseudo = ast.FunctionDef(

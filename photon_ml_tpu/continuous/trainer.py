@@ -92,6 +92,13 @@ class ContinuousTrainerConfig:
     keep_generations: int = 8
     seed: int = 0
     dtype: object = jnp.float32
+    # random-effect inner bucket solver, inherited by BOTH the bootstrap full
+    # train and every delta pass's active-set sub-bucket solves
+    # (optimization/normal_equations.py): "lbfgs" | "direct" | "auto".
+    # Direct solves fit continuous training's access pattern especially well:
+    # delta passes are always warm-started from the previous generation, the
+    # regime where the Newton loop converges in 1-2 steps.
+    re_solver: str = "lbfgs"
 
 
 @dataclasses.dataclass
@@ -144,6 +151,7 @@ class ContinuousTrainer:
             coordinate_configurations=config.coordinate_configurations,
             n_iterations=config.delta_iterations,
             dtype=config.dtype,
+            re_solver=config.re_solver,
         )
         self.re_types = {
             cid: cfg.data_config.random_effect_type

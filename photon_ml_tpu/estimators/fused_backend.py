@@ -98,7 +98,7 @@ def fused_pass_ineligibilities(estimator, opt_configs: Mapping) -> list[str]:
 
 
 @functools.lru_cache(maxsize=None)
-def _fused_step(task, fe_config, re_configs: tuple, mesh):
+def _fused_step(task, fe_config, re_configs: tuple, mesh, re_solver: str = "lbfgs"):
     """Cross-fit trace cache for the fused pass.
 
     Data is a jit ARGUMENT here (unlike bench.py's single-process
@@ -123,7 +123,7 @@ def _fused_step(task, fe_config, re_configs: tuple, mesh):
         return game_train_step(
             d, params, task, fe_config, re_configs,
             fuse_fe=fuse_fe, shard_mesh=shard_mesh,
-            fe_l2=fe_l2, re_l2=re_l2,
+            fe_l2=fe_l2, re_l2=re_l2, re_solver=re_solver,
         )
 
     return _step
@@ -176,6 +176,7 @@ def run_fused_game_descent(
         opt_configs[fe_cid].with_weight(0.0),
         tuple(opt_configs[c].with_weight(0.0) for c in re_cids),
         mesh,
+        getattr(estimator, "re_solver", "lbfgs"),
     )
     fe_l2 = jnp.asarray(opt_configs[fe_cid].l2_weight, dtype=dtype)
     re_l2 = tuple(jnp.asarray(opt_configs[c].l2_weight, dtype=dtype) for c in re_cids)

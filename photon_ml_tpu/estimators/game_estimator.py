@@ -152,10 +152,54 @@ class GameEstimator:
     # per-bucket dispatch + host-sync overhead. False restores the per-bucket
     # loop (mesh-sharded datasets always use it).
     re_update_program: bool = True
+    # Random-effect inner bucket solver (optimization/normal_equations.py):
+    # "lbfgs" runs the configured optimizer (bitwise status quo), "direct"
+    # replaces it with batched Gram/Cholesky Newton solves, "auto" picks
+    # direct for buckets with K <= DIRECT_AUTO_K_MAX and no L1 — the regime
+    # the roofline says dominates the hot loop.
+    re_solver: str = "lbfgs"
+    # Storage precision for the random-effect update program's device state
+    # (optimization/precision.py): None/"f32" is the bitwise reference;
+    # "bf16"/"f16" store coefficient tables + bucket features reduced with
+    # f32 accumulation. Tolerance-gated (bench.py --host-loop measures the
+    # held-out quality drift); requires re_update_program=True and no mesh.
+    re_precision: object = None
 
     def __post_init__(self):
         self.task = TaskType(self.task)
         self.variance_computation = VarianceComputationType(self.variance_computation)
+        from photon_ml_tpu.optimization.precision import resolve_precision
+
+        self.re_precision = resolve_precision(self.re_precision)
+        if not self.re_precision.is_reference:
+            if not self.re_update_program:
+                raise ValueError(
+                    "re_precision requires re_update_program=True (reduced "
+                    "storage rides the single-program update path)"
+                )
+            if self.fused_pass:
+                # the fused whole-pass backend has its own storage knobs
+                # (fe_storage_dtype / re_storage_dtype); accepting
+                # re_precision there would be a silent no-op
+                raise ValueError(
+                    "re_precision applies to the host loop's update program; "
+                    "the fused pass uses fe_storage_dtype/re_storage_dtype "
+                    "(set fused_pass=False or use those knobs)"
+                )
+            if self.mesh is not None:
+                raise ValueError(
+                    "re_precision is not supported with a mesh (sharded "
+                    "datasets take the per-bucket f32 path)"
+                )
+            if self.checkpoint_directory is not None:
+                # np.save round-trips bfloat16/float16 as raw void dtypes
+                # (|V2): a resumed run would silently reinterpret the table
+                # bytes. Refuse loudly instead of corrupting on restore.
+                raise ValueError(
+                    "re_precision cannot be combined with "
+                    "checkpoint_directory: numpy checkpoint artifacts do not "
+                    "round-trip reduced dtypes"
+                )
         if self.re_storage_dtype is not None and not self.fused_pass:
             # only the fused pass consumes it (build_sharded_game_data);
             # accepting it elsewhere would be a silent no-op
@@ -368,6 +412,8 @@ class GameEstimator:
             variance_computation=self.variance_computation,
             per_entity_reg_weights=cfg.per_entity_reg_weights,
             use_update_program=self.re_update_program,
+            re_solver=self.re_solver,
+            precision=self.re_precision,
         )
 
     # ---------------------------------------------------------------- fit
@@ -450,6 +496,10 @@ class GameEstimator:
                     # made a cross-PROCESS rerun reject its own checkpoint
                     f"val={validation_data.n if validation_data is not None else 0}",
                     f"evals={[evaluator_spec_name(e) for e in self.validation_evaluators]}",
+                    # solver identity: resuming an lbfgs-trained checkpoint
+                    # into a direct-solver run (or vice versa) would produce
+                    # a model that is neither path's contract
+                    f"re_solver={self.re_solver}",
                 ]
                 for cid in sorted(self.coordinate_configurations):
                     fp_parts.append(f"{cid}={opt_configs[cid]!r}")

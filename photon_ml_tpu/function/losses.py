@@ -94,10 +94,14 @@ def _smoothed_hinge_loss_and_dz(z: Array, y: Array) -> tuple[Array, Array]:
 
 def _smoothed_hinge_dzz(z: Array, y: Array) -> Array:
     # Not defined in the reference (DiffFunction only). Provide the a.e. second
-    # derivative (1 on the quadratic segment) for optional quasi-Newton use.
+    # derivative (1 on the quadratic segment) for optional quasi-Newton and the
+    # direct IRLS solves. The mask is cast to z's dtype explicitly: a
+    # jnp.where over two python scalars has no array to anchor its dtype and
+    # silently promotes to f64 under x64 (MP001's promotion hazard — this was
+    # latent until the direct solver became the first dzz consumer for hinge).
     mod_label = jnp.where(y < POSITIVE_RESPONSE_THRESHOLD, -1.0, 1.0)
     zy = mod_label * z
-    return jnp.where((zy >= 0.0) & (zy < 1.0), 1.0, 0.0)
+    return ((zy >= 0.0) & (zy < 1.0)).astype(z.dtype)
 
 
 logistic_loss = PointwiseLoss("logistic", _logistic_loss_and_dz, _logistic_dzz)

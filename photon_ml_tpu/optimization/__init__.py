@@ -4,7 +4,15 @@ from photon_ml_tpu.optimization.owlqn import minimize_owlqn
 from photon_ml_tpu.optimization.lbfgsb import minimize_lbfgsb
 from photon_ml_tpu.optimization.tron import minimize_tron
 from photon_ml_tpu.optimization.newton import minimize_newton
+from photon_ml_tpu.optimization.normal_equations import minimize_direct
 from photon_ml_tpu.optimization.factory import build_minimizer
+from photon_ml_tpu.optimization.precision import (
+    BFLOAT16,
+    FLOAT16,
+    FLOAT32,
+    PrecisionPolicy,
+    resolve_precision,
+)
 
 __all__ = [
     "OptimizerConfig",
@@ -14,5 +22,11 @@ __all__ = [
     "minimize_lbfgsb",
     "minimize_tron",
     "minimize_newton",
+    "minimize_direct",
     "build_minimizer",
+    "PrecisionPolicy",
+    "FLOAT32",
+    "BFLOAT16",
+    "FLOAT16",
+    "resolve_precision",
 ]

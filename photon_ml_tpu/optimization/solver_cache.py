@@ -25,8 +25,10 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.function.losses import loss_for_task
 from photon_ml_tpu.function.objective import GLMObjective
+from photon_ml_tpu.optimization import normal_equations
 from photon_ml_tpu.optimization.common import OptimizerConfig, OptResult
 from photon_ml_tpu.optimization.factory import build_minimizer
+from photon_ml_tpu.optimization.precision import FLOAT32, PrecisionPolicy
 from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
 
 Array = jnp.ndarray
@@ -112,16 +114,24 @@ def _re_bucket_solve_fn(
     opt_config: OptimizerConfig,
     has_l1: bool,
     variance: VarianceComputationType,
+    re_solver: str = "lbfgs",
 ):
     """Unjitted vmapped bucket solve shared by ``re_bucket_solver`` (one jit
     per bucket) and ``re_coordinate_update_program`` (every bucket chained in
-    one trace) — one body, so the two paths stay bitwise interchangeable."""
+    one trace) — one body, so the two paths stay bitwise interchangeable.
+
+    ``re_solver`` selects the inner minimizer per bucket SHAPE at trace time
+    (optimization/normal_equations.py): ``"direct"`` replaces the configured
+    quasi-Newton loop with batched Gram/Cholesky Newton solves, ``"auto"``
+    does so for the small-K buckets the roofline says dominate, ``"lbfgs"``
+    (default) keeps the configured optimizer — the bitwise status quo."""
     task = TaskType(task)
     loss = loss_for_task(task)
     minimize = build_minimizer(opt_config)
     use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
     use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
     variance = VarianceComputationType(variance)
+    re_solver = normal_equations.validate_re_solver(re_solver, has_l1)
 
     from photon_ml_tpu.data.dataset import LabeledData
     from photon_ml_tpu.data.matrix import DenseDesignMatrix
@@ -129,6 +139,27 @@ def _re_bucket_solve_fn(
     def solve_one(Xe, ye, we, oe, w0, l2, l1):
         data = LabeledData(X=DenseDesignMatrix(Xe), labels=ye, offsets=oe, weights=we)
         obj = GLMObjective(loss, allow_fused=False)  # vmapped: no pallas path
+
+        if normal_equations.use_direct(
+            re_solver, k=Xe.shape[-1], has_l1=has_l1
+        ):
+            # reduced-precision feature storage floors the convergence
+            # tolerance at the storage dtype's epsilon: objective evaluations
+            # carry storage-level noise, and Newton steps chasing an f32-grade
+            # tolerance through it just burn data reads on reverts
+            tolerance = opt_config.tolerance
+            if Xe.dtype != w0.dtype:
+                tolerance = max(tolerance, float(jnp.finfo(Xe.dtype).eps))
+            res = normal_equations.minimize_direct(
+                obj,
+                data,
+                w0,
+                l2,
+                quadratic=task == TaskType.LINEAR_REGRESSION,
+                tolerance=tolerance,
+            )
+            var = compute_variances(obj, data, res.coefficients, l2, variance, w0.dtype)
+            return res.coefficients, res.convergence_reason, res.iterations, var
 
         def vg(w):
             return obj.value_and_gradient(data, w, l2)
@@ -153,6 +184,7 @@ def re_bucket_solver(
     opt_config: OptimizerConfig,
     has_l1: bool,
     variance: VarianceComputationType,
+    re_solver: str = "lbfgs",
 ):
     """Jitted vmapped per-entity bucket solve:
     ``solve(X, y, w, offsets, w0, l2, l1) -> (coefs, reasons, iters, variances)``
@@ -161,7 +193,7 @@ def re_bucket_solver(
     34-37 — here each entity's solve traces its own weight) and l1 broadcast —
     the executor-local random-effect hot loop of RandomEffectCoordinate.scala:
     109-127 as one XLA program per bucket shape class."""
-    return jax.jit(_re_bucket_solve_fn(task, opt_config, has_l1, variance))
+    return jax.jit(_re_bucket_solve_fn(task, opt_config, has_l1, variance, re_solver))
 
 
 def _re_coordinate_update_fn(
@@ -170,13 +202,27 @@ def _re_coordinate_update_fn(
     has_l1: bool,
     variance: VarianceComputationType,
     n_entities: int,
+    re_solver: str = "lbfgs",
+    precision: PrecisionPolicy = FLOAT32,
 ):
     """Unjitted whole-coordinate update body shared by
     ``re_coordinate_update_program`` (one model) and
     ``re_population_update_program`` (a leading population axis vmapped over
     it) — one body, so the two programs stay semantically interchangeable
-    per lane."""
-    solve = _re_bucket_solve_fn(task, opt_config, has_l1, variance)
+    per lane.
+
+    ``precision`` (optimization/precision.py) splits STORAGE from
+    ACCUMULATION dtypes: under a reduced policy the donated coefficient/
+    variance tables and the bucket/view feature arrays live in bf16/f16 HBM
+    (the caller supplies them pre-cast — see
+    ``RandomEffectCoordinate._fused_update_static``) while every solve,
+    normalization conversion and score upcasts to f32 in-register (XLA fuses
+    the converts into the consuming gathers/contractions, so only
+    storage-width bytes cross HBM). The reference f32 policy makes every
+    cast an identity, preserving the bitwise parity contract with the
+    per-bucket path."""
+    solve = _re_bucket_solve_fn(task, opt_config, has_l1, variance, re_solver)
+    reduced = not precision.is_reference
 
     def update(
         coeffs_prev, score_prev, var_prev, offsets_plus_scores, l2_rows, l1,
@@ -187,14 +233,19 @@ def _re_coordinate_update_fn(
 
         coeffs = coeffs_prev
         variances = var_prev
+        # the dtype every solve runs at: the table dtype itself on the
+        # reference path (bitwise status quo), f32 under a reduced policy
+        solve_dtype = precision.accum_dtype if reduced else coeffs.dtype
         reasons, iters = [], []
         for bucket, norm_tbl in zip(buckets, norm_tables):
             S, K = bucket.shape
             off_b = jnp.take(
                 offsets_plus_scores, jnp.maximum(bucket.sample_ids, 0), axis=0
             )
-            off_b = jnp.where(bucket.sample_ids >= 0, off_b, 0.0).astype(coeffs.dtype)
+            off_b = jnp.where(bucket.sample_ids >= 0, off_b, 0.0).astype(solve_dtype)
             init_b = coeffs[bucket.entity_rows, :K]
+            if reduced:
+                init_b = init_b.astype(solve_dtype)
             if norm_tbl is not None:
                 factors, shifts, icpt_mask = norm_tbl
                 init_b = _to_transformed(init_b, factors, shifts, icpt_mask)
@@ -213,6 +264,10 @@ def _re_coordinate_update_fn(
                     # Var(w) = Var(w') * factor^2, same diagonal approximation
                     # as the per-bucket path
                     var_b = var_b * factors**2
+            if reduced:
+                w_b = w_b.astype(coeffs.dtype)
+                if variances is not None:
+                    var_b = var_b.astype(variances.dtype)
             coeffs = coeffs.at[bucket.entity_rows, :K].set(w_b)
             if variances is not None:
                 variances = variances.at[bucket.entity_rows, :K].set(var_b)
@@ -224,7 +279,16 @@ def _re_coordinate_update_fn(
             if variances is not None:
                 variances = variances.at[n_entities:].set(0.0)
         entity_rows, local_cols, vals = view
-        score = random_effect_view_score(coeffs, entity_rows, local_cols, vals)
+        if reduced:
+            # storage-width bytes cross HBM; the multiply-accumulate runs f32
+            score = random_effect_view_score(
+                coeffs.astype(solve_dtype),
+                entity_rows,
+                local_cols,
+                vals.astype(solve_dtype),
+            )
+        else:
+            score = random_effect_view_score(coeffs, entity_rows, local_cols, vals)
         # Device-side divergence guard: variances are deliberately excluded
         # (algorithm/coordinate.coefficient_arrays — a singular-Hessian
         # variance failure must not discard a converged mean update).
@@ -244,6 +308,8 @@ def re_coordinate_update_program(
     has_l1: bool,
     variance: VarianceComputationType,
     n_entities: int,
+    re_solver: str = "lbfgs",
+    precision: PrecisionPolicy = FLOAT32,
 ):
     """ONE jitted, donated XLA program for a whole random-effect coordinate
     update: offset gather, every bucket's vmapped solve chained in a single
@@ -270,8 +336,13 @@ def re_coordinate_update_program(
     - ``view``: the dataset's per-sample scoring view (entity rows, local
       cols, vals) — the score uses the same ``random_effect_view_score``
       kernel as the eager path.
+    - ``re_solver`` / ``precision``: the direct-solve and storage-precision
+      levers (normal_equations.py / precision.py); the defaults reproduce
+      the bitwise-gated status quo.
     """
-    update = _re_coordinate_update_fn(task, opt_config, has_l1, variance, n_entities)
+    update = _re_coordinate_update_fn(
+        task, opt_config, has_l1, variance, n_entities, re_solver, precision
+    )
     return jax.jit(update, donate_argnums=(0, 1, 2))
 
 
@@ -282,6 +353,8 @@ def re_population_update_program(
     has_l1: bool,
     variance: VarianceComputationType,
     n_entities: int,
+    re_solver: str = "lbfgs",
+    precision: PrecisionPolicy = FLOAT32,
 ):
     """``re_coordinate_update_program`` with a LEADING POPULATION AXIS: one
     donated XLA program trains P hyperparameter settings' random-effect
@@ -305,7 +378,9 @@ def re_population_update_program(
     alone (no cross-lane ops exist under vmap; converged lanes' while_loop
     carries are select-frozen) — the property the sweep's sequential fallback
     path builds its bitwise-parity contract on (sweep/population.py)."""
-    update = _re_coordinate_update_fn(task, opt_config, has_l1, variance, n_entities)
+    update = _re_coordinate_update_fn(
+        task, opt_config, has_l1, variance, n_entities, re_solver, precision
+    )
     return jax.jit(
         jax.vmap(update, in_axes=(0, 0, 0, 0, 0, 0, None, None, None)),
         donate_argnums=(0, 1, 2),

@@ -209,6 +209,7 @@ def game_train_step(
     shard_mesh=None,
     fe_l2=None,
     re_l2=None,
+    re_solver: str = "lbfgs",
 ) -> tuple[dict, dict]:
     """One pure (jittable) coordinate-descent pass over [fixed, re_0, re_1, ...].
 
@@ -217,6 +218,10 @@ def game_train_step(
     then reuse one compiled program across the whole sweep
     (estimators/fused_backend.py) instead of baking each weight in as a
     trace-time constant.
+
+    ``re_solver`` selects the random-effect inner bucket solver
+    (optimization/normal_equations.py — "lbfgs" | "direct" | "auto"); the
+    fixed-effect solve always runs the configured optimizer.
 
     Returns (new params, diagnostics {fe_value, fe_iterations, total_scores}).
     """
@@ -300,7 +305,9 @@ def game_train_step(
     # ---- random-effect coordinates ----------------------------------------------
     re_iter_maxes = []
     for i, (rc, cfg) in enumerate(zip(data.re, re_configs)):
-        solve = re_bucket_solver(task, cfg.optimizer_config, bool(cfg.l1_weight), no_var)
+        solve = re_bucket_solver(
+            task, cfg.optimizer_config, bool(cfg.l1_weight), no_var, re_solver
+        )
         offsets_plus = data.offsets + (total - re_scores[i])
         coeffs = re_coeffs[i]
         bucket_iters = []
@@ -347,6 +354,7 @@ def make_jitted_game_step(
     fe_config: GLMOptimizationConfiguration,
     re_configs: Sequence[GLMOptimizationConfiguration],
     mesh,
+    re_solver: str = "lbfgs",
 ):
     """jit(game_train_step) with params donated — call as
     ``step(params) -> (params, diagnostics)``. One compiled XLA program per pass.
@@ -371,7 +379,8 @@ def make_jitted_game_step(
     if shard_mesh is None:
         def step_single(params):
             return game_train_step(
-                data, params, task, fe_config, tuple(re_configs), fuse_fe=fuse_fe
+                data, params, task, fe_config, tuple(re_configs),
+                fuse_fe=fuse_fe, re_solver=re_solver,
             )
 
         step1 = jax.jit(step_single, donate_argnums=(0,))
@@ -385,7 +394,7 @@ def make_jitted_game_step(
     def _step(d, params):
         return game_train_step(
             d, params, task, fe_config, tuple(re_configs),
-            fuse_fe=fuse_fe, shard_mesh=shard_mesh,
+            fuse_fe=fuse_fe, shard_mesh=shard_mesh, re_solver=re_solver,
         )
 
     def step(params):

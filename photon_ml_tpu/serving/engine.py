@@ -282,15 +282,25 @@ class GameServingEngine:
         mesh: Optional[object] = None,
         min_batch_pad: int = MIN_BATCH_PAD,
         fingerprint: Optional[str] = None,
+        precision: Optional[object] = None,
     ):
         if mesh is not None and len(mesh.axis_names) != 1:
             raise ValueError(
                 "GameServingEngine supports a 1-D (data) mesh; 2-D "
                 "feature-sharded meshes score through the eager path"
             )
+        from photon_ml_tpu.optimization.precision import resolve_precision
+
         self.model = model
         self.mesh = mesh
         self.min_batch_pad = int(min_batch_pad)
+        # storage precision for the DEVICE-RESIDENT coefficient tables
+        # (optimization/precision.py): the reference f32 policy keeps every
+        # cast an identity (the bitwise-parity-gated path); reduced policies
+        # halve the table bytes each request's gathers read from HBM and
+        # upcast to f32 in-register inside the fused program. Tolerance-
+        # gated — never compare a reduced engine bitwise against eager.
+        self._precision = resolve_precision(precision)
         self._fingerprint = fingerprint
         self._trace_count = 0
         self._trace_lock = threading.Lock()
@@ -318,6 +328,7 @@ class GameServingEngine:
     # -- device state ------------------------------------------------------
 
     def _place_table(self, arr: Array) -> Array:
+        arr = self._precision.to_storage(arr)  # identity under the f32 policy
         if self.mesh is None:
             return arr
         from photon_ml_tpu.parallel.mesh import replicated_sharding
@@ -329,6 +340,13 @@ class GameServingEngine:
         """Number of program traces so far — steady-state serving must hold
         this constant (the scoring bench's zero-retrace gate)."""
         return self._trace_count
+
+    @property
+    def precision(self):
+        """The engine's storage PrecisionPolicy — part of its serving
+        configuration, so engine REBUILDS (generational hot-swap) must carry
+        it alongside mesh and min_batch_pad."""
+        return self._precision
 
     @property
     def coalesce_safe(self) -> bool:
@@ -499,19 +517,25 @@ class GameServingEngine:
     def _fused(self, batch, per_coordinate: bool, include_offsets: bool, apply_link: bool):
         with self._trace_lock:  # trace-time-only side effect; distinct buckets
             self._trace_count += 1  # may first-hit concurrently on two threads
+        # reduced-precision tables upcast to the accumulation dtype IN the
+        # program (XLA fuses the convert into the consuming gather/matvec:
+        # storage-width bytes cross HBM, f32 math in registers); under the
+        # reference policy `to_accum` is an identity and the ops below are
+        # bit-for-bit the pre-policy program
+        acc = self._precision.to_accum
         scores = []
         for st in self._coords:
             b = batch["coord:" + st.cid]
             if isinstance(st, _FixedCoord):
                 if "values" in b:
-                    s = DenseDesignMatrix(values=b["values"]).matvec(st.means)
+                    s = DenseDesignMatrix(values=b["values"]).matvec(acc(st.means))
                 else:
-                    g = jnp.take(st.means, jnp.maximum(b["cols"], 0))
+                    g = jnp.take(acc(st.means), jnp.maximum(b["cols"], 0))
                     g = jnp.where(b["cols"] >= 0, g, 0.0)
                     s = jnp.sum(g * b["vals"], axis=1)
             else:
                 s = random_effect_view_score(
-                    st.coeffs, b["rows"], b["cols"], b["vals"]
+                    acc(st.coeffs), b["rows"], b["cols"], b["vals"]
                 )
             scores.append(s)
         if per_coordinate:
@@ -551,15 +575,13 @@ class GameServingEngine:
             if include_offsets:
                 total = total + np.asarray(data.offsets)
             return total
+        from photon_ml_tpu.optimization.precision import offsets_fuse_on_device
+
         offsets = np.asarray(data.offsets)
-        # floating offsets whose dtype survives device conversion promote the
-        # same way under jnp and numpy; integer offsets do NOT (jnp f32+i64 ->
-        # f32, numpy -> f64), so they take the host add like oversized floats
-        fuse_offsets = (
-            include_offsets
-            and np.issubdtype(offsets.dtype, np.floating)
-            and jnp.asarray(offsets[:0]).dtype == offsets.dtype
-        )
+        # the host dtype-boundary rule has ONE owner (optimization/precision):
+        # offsets whose dtype would not survive device conversion (f64 on a
+        # non-x64 runtime, integers) add host-side at full precision
+        fuse_offsets = include_offsets and offsets_fuse_on_device(offsets)
         batch, n = self._prepare(data)
         out = self._dispatch(
             batch,
@@ -578,28 +600,24 @@ class GameServingEngine:
     def predict(self, data: GameInput) -> np.ndarray:
         """Mean response: link-inverse of (score + offsets), fused on device
         (sigmoid / exp / identity per the model task). Same offsets-dtype
-        guard as ``score``: when the offsets dtype would not survive device
-        conversion (float64 on a non-x64 runtime), the offset add AND the
-        link run host-side at full precision instead of silently truncating."""
-        offsets = np.asarray(data.offsets)
-        if (
-            np.issubdtype(offsets.dtype, np.floating)
-            and jnp.asarray(offsets[:0]).dtype == offsets.dtype
-        ):
+        guard as ``score`` (optimization/precision.offsets_fuse_on_device):
+        when the offsets dtype would not survive device conversion, the
+        offset add AND the link run host-side at full precision
+        (precision.host_link — agrees with other exp evaluations only to
+        precision.HOST_LINK_EXP_ULPS ulps) instead of silently truncating."""
+        from photon_ml_tpu.optimization.precision import (
+            host_link,
+            offsets_fuse_on_device,
+        )
+
+        if offsets_fuse_on_device(data.offsets):
             batch, n = self._prepare(data)
             out = self._dispatch(
                 batch, per_coordinate=False, include_offsets=True, apply_link=True
             )
             return jax.device_get(out)[:n]  # explicit boundary transfer, as in score
         margins = self.score(data, include_offsets=True)  # host f64 add
-        task = self.model.task
-        from photon_ml_tpu.types import TaskType
-
-        if task == TaskType.LOGISTIC_REGRESSION:
-            return 1.0 / (1.0 + np.exp(-margins))
-        if task == TaskType.POISSON_REGRESSION:
-            return np.exp(margins)
-        return margins
+        return host_link(self.model.task, margins)
 
     def score_per_coordinate(self, data: GameInput) -> dict[str, np.ndarray]:
         """Per-coordinate [N] scores: still one fused program, with all C
@@ -629,19 +647,28 @@ def get_engine(
     model: GameModel,
     mesh: Optional[object] = None,
     min_batch_pad: int = MIN_BATCH_PAD,
+    precision: Optional[object] = None,
 ) -> GameServingEngine:
     """Content-keyed engine lookup: the same loaded model (same coefficient
     bytes) maps to the same engine — and therefore to jit's compiled-program
     cache — across GameTransformer instances. LRU-bounded so a long-running
-    process cycling many models doesn't pin every table on device."""
-    key = (model_fingerprint(model), mesh, int(min_batch_pad))
+    process cycling many models doesn't pin every table on device.
+
+    ``precision`` (optimization/precision.py) keys the cache too: the same
+    model served at f32 and bf16 storage is two distinct engines with
+    different device tables."""
+    from photon_ml_tpu.optimization.precision import resolve_precision
+
+    policy = resolve_precision(precision)
+    key = (model_fingerprint(model), mesh, int(min_batch_pad), policy.name)
     with _engines_lock:
         eng = _engines.get(key)
         if eng is not None:
             _engines.move_to_end(key)
             return eng
     eng = GameServingEngine(
-        model, mesh=mesh, min_batch_pad=min_batch_pad, fingerprint=key[0]
+        model, mesh=mesh, min_batch_pad=min_batch_pad, fingerprint=key[0],
+        precision=policy,
     )
     with _engines_lock:
         existing = _engines.get(key)

@@ -188,7 +188,14 @@ class HotSwapManager:
         state = load_generation(gen_dir, dtype=self.dtype)
         model = model_from_state(state, prefer_best=self.prefer_best)
         old = self.frontend.engine
-        engine = get_engine(model, mesh=old.mesh, min_batch_pad=old.min_batch_pad)
+        engine = get_engine(
+            model,
+            mesh=old.mesh,
+            min_batch_pad=old.min_batch_pad,
+            # the storage precision is serving configuration, not model
+            # content: a bf16 deployment must stay bf16 across generations
+            precision=old.precision,
+        )
         try:
             if engine is not old:
                 # pilot compile per live bucket on a background thread: gen-N

@@ -116,6 +116,21 @@ class PopulationTrainer:
         self.dtype = estimator.dtype
         self.base_offsets = jnp.asarray(base_offsets, dtype=self.dtype)
         self.seed = seed
+        # the population programs inherit the estimator's random-effect inner
+        # solver (optimization/normal_equations.py); both the vmapped path
+        # and the sequential fallback run the SAME program, so the bitwise
+        # per-lane parity contract holds for direct solves too
+        self.re_solver = getattr(estimator, "re_solver", "lbfgs")
+        est_precision = getattr(estimator, "re_precision", None)
+        if est_precision is not None and not est_precision.is_reference:
+            # population state tables are f32-only today (ROADMAP item 4);
+            # silently training f32 lanes under a bf16 estimator would
+            # misreport what was measured
+            raise ValueError(
+                "re_precision is not supported by the population programs "
+                "(f32-only population state); sweep with the reference "
+                "precision or train reduced models outside the sweep"
+            )
         loss = loss_for_task(self.task)
         self._static: dict[str, _CoordStatic] = {}
         for cid, cfg in estimator.coordinate_configurations.items():
@@ -301,6 +316,7 @@ class PopulationTrainer:
                 st.has_l1,
                 VarianceComputationType.NONE,
                 st.dataset.n_entities,
+                self.re_solver,
             )
             coeffs, score, _var, ok, _reasons, _iters = program(
                 state["coeffs"],

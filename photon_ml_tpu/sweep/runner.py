@@ -147,6 +147,10 @@ class SweepRunner:
             f"seed={self.config.seed}",
             f"mode={self.config.mode.value}",
             f"iters={self.config.n_iterations}",
+            # the inner bucket solver changes trained coefficients: a rerun
+            # with a different re_solver must retrain, not restore the other
+            # solver's committed winner (the PR 8 stale-restore lesson)
+            f"re_solver={getattr(self.estimator, 're_solver', 'lbfgs')}",
             f"n={n_train}",
             f"val={n_val}",
             # process-stable names: str(Evaluator) renders a function address

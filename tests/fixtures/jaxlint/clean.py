@@ -54,6 +54,19 @@ def metadata_driven(x):
     return x, n
 
 
+def dtype_introspection_factory(tolerance):
+    """jnp.finfo/iinfo return HOST metadata, not device values: float()/int()
+    on them is fine even inside traced bodies (the pattern solver_cache's
+    direct-solve path uses to floor tolerances at the storage dtype's eps)."""
+
+    def solve_one(a):
+        eps = float(jnp.finfo(a.dtype).eps)  # host metadata under trace: fine
+        bound = int(jnp.iinfo(jnp.int32).max)  # fine
+        return a * max(tolerance, eps) + bound
+
+    return jax.vmap(solve_one)
+
+
 class Engine:
     def __init__(self, coeffs):
         self._table = jnp.asarray(coeffs)

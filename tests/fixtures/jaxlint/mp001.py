@@ -1,0 +1,62 @@
+"""MP001 fixtures: mixed-precision hazards in jitted bodies.
+
+This module references jnp.bfloat16, so it is a MIXED-PRECISION SCOPE: the
+dtype-less-allocation check is armed for its jitted functions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+STORAGE = jnp.bfloat16  # makes this module a mixed-precision scope
+
+
+@jax.jit
+def storage_dtype_accumulation(x, table):
+    lo = table.astype(jnp.bfloat16)
+    total = jnp.sum(lo)  # EXPECT: MP001
+    partial = lo.sum(axis=0)  # EXPECT: MP001
+    prod = jnp.dot(lo, lo)  # EXPECT: MP001
+    kw = table.astype(dtype=jnp.bfloat16)  # keyword spelling taints too
+    kw_total = jnp.sum(kw)  # EXPECT: MP001
+    narrow = jnp.sum(lo, dtype=jnp.bfloat16)  # EXPECT: MP001
+    return total + partial[0] + prod + kw_total + narrow + x
+
+
+@jax.jit
+def f32_accumulation_is_fine(x, table):
+    lo = table.astype(jnp.bfloat16)
+    total = jnp.sum(lo, dtype=jnp.float32)  # explicit accumulator: fine
+    acc = jax.lax.dot(lo, lo, preferred_element_type=jnp.float32)  # fine
+    up = jnp.sum(lo.astype(jnp.float32))  # upcast before reducing: fine
+    full = jnp.sum(table)  # full-precision input: fine
+    return total + acc + up + full + x
+
+
+@jax.jit
+def f64_promotion(x):
+    wide = x.astype(jnp.float64)  # EXPECT: MP001
+    eye = jnp.zeros((2, 2), dtype=np.float64)  # EXPECT: MP001
+    return wide.astype(jnp.float32)[0] + eye[0, 0] + x
+
+
+@jax.jit
+def dtypeless_allocation(x):
+    acc = jnp.zeros((4,))  # EXPECT: MP001
+    pad = jnp.full((4,), 0.5)  # EXPECT: MP001
+    return x + acc + pad
+
+
+@jax.jit
+def explicit_dtypes_are_fine(x):
+    acc = jnp.zeros((4,), dtype=jnp.float32)  # explicit dtype: fine
+    pos = jnp.zeros((4,), jnp.int32)  # positional dtype: fine
+    like = jnp.zeros_like(x)  # dtype-preserving: fine
+    return x + acc + pos.astype(x.dtype) + like
+
+
+def host_code_is_fine(table):
+    # not a jitted body: host-side f64 statistics are legitimate
+    wide = np.asarray(table).astype(np.float64)
+    lo = table.astype(jnp.bfloat16)
+    return float(wide.sum()), lo

@@ -42,7 +42,8 @@ def actual_findings(path: Path, config=None) -> linter.LintResult:
 
 @pytest.mark.parametrize(
     "fixture",
-    ["hs001.py", "rt001.py", "tr001.py", "pr001.py", "dn001.py", "np001.py", "clean.py"],
+    ["hs001.py", "rt001.py", "tr001.py", "pr001.py", "dn001.py", "np001.py",
+     "mp001.py", "clean.py"],
 )
 def test_fixture_findings_match_expectations(fixture):
     path = FIXTURES / fixture

@@ -1,0 +1,263 @@
+"""PrecisionPolicy (optimization/precision.py): parsing, the reference
+policy's strict-no-op contract, reduced-precision storage through the
+random-effect update program and the serving engine's device tables
+(tolerance-gated — never bitwise against f32), and the centralized host
+dtype-boundary helpers (offsets_fuse_on_device / host_link)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.algorithm.coordinate import RandomEffectCoordinate
+from photon_ml_tpu.algorithm.coordinate_descent import run_coordinate_descent
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+from photon_ml_tpu.models.game import FixedEffectModel, GameModel, RandomEffectModel
+from photon_ml_tpu.models.glm import Coefficients, LogisticRegressionModel
+from photon_ml_tpu.optimization import precision as precision_mod
+from photon_ml_tpu.optimization.common import OptimizerConfig
+from photon_ml_tpu.optimization.config import (
+    GLMOptimizationConfiguration,
+    RegularizationContext,
+)
+from photon_ml_tpu.optimization.precision import (
+    BFLOAT16,
+    FLOAT32,
+    PrecisionPolicy,
+    host_link,
+    offsets_fuse_on_device,
+    resolve_precision,
+)
+from photon_ml_tpu.serving.engine import clear_engine_cache, get_engine
+from photon_ml_tpu.types import RegularizationType, TaskType
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine_cache():
+    clear_engine_cache()
+    yield
+    clear_engine_cache()
+
+
+# ----------------------------------------------------------------- policy
+
+
+def test_policy_parsing_and_aliases():
+    assert resolve_precision(None) is FLOAT32 or resolve_precision(None).is_reference
+    assert resolve_precision("bf16") == BFLOAT16
+    assert resolve_precision("bfloat16").storage == "bfloat16"
+    assert resolve_precision("f16").storage == "float16"
+    assert resolve_precision("fp32").is_reference
+    assert resolve_precision(BFLOAT16) is BFLOAT16
+    assert BFLOAT16.name == "bf16" and FLOAT32.name == "f32"
+    with pytest.raises(ValueError, match="unknown storage precision"):
+        PrecisionPolicy(storage="int8")
+    with pytest.raises(ValueError, match="accumulation dtype"):
+        PrecisionPolicy(storage="bfloat16", accum="bfloat16")
+
+
+def test_reference_policy_is_a_strict_noop():
+    """f32 means 'leave the dtype contract alone', not 'force f32': even a
+    float64 table passes through untouched (x64 runtimes / f64 models)."""
+    for arr in (jnp.ones(3, jnp.float32), jnp.ones(3, jnp.float64),
+                jnp.ones(3, jnp.bfloat16)):
+        assert FLOAT32.to_storage(arr) is arr
+        assert FLOAT32.to_accum(arr) is arr
+    assert FLOAT32.to_storage(None) is None
+
+
+def test_reduced_policy_casts():
+    x = jnp.ones(4, jnp.float32)
+    lo = BFLOAT16.to_storage(x)
+    assert lo.dtype == jnp.bfloat16
+    assert BFLOAT16.to_accum(lo).dtype == jnp.float32
+    assert BFLOAT16.to_storage(lo) is lo  # already storage: no copy
+
+
+# ---------------------------------------------------- update-program threading
+
+
+def _coords(precision=None, re_solver="lbfgs", use_update_program=True, seed=1):
+    rng = np.random.default_rng(seed)
+    n, n_entities, d = 260, 8, 4
+    ents = rng.integers(0, n_entities, size=n)
+    X = np.concatenate([np.ones((n, 1)), rng.normal(size=(n, d - 1))], axis=1)
+    z = np.einsum("nd,nd->n", X, rng.normal(size=(n_entities, d))[ents])
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-z))).astype(float)
+    ds = build_random_effect_dataset(sp.csr_matrix(X), ents, "e", labels=y)
+    cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(max_iterations=50),
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    return {
+        "re": RandomEffectCoordinate(
+            coordinate_id="re",
+            dataset=ds,
+            task=TaskType.LOGISTIC_REGRESSION,
+            configuration=cfg,
+            base_offsets=jnp.zeros(n, dtype=jnp.float32),
+            precision=precision,
+            re_solver=re_solver,
+            use_update_program=use_update_program,
+        )
+    }
+
+
+def test_f32_policy_is_bitwise_identical_to_default():
+    """Threading the reference policy through the update program must not
+    move a single bit — the existing bitwise parity gates keep guarding it."""
+    r_default = run_coordinate_descent(_coords(), n_iterations=3)
+    r_f32 = run_coordinate_descent(_coords(precision="f32"), n_iterations=3)
+    np.testing.assert_array_equal(
+        np.asarray(r_default.model.get_model("re").coeffs),
+        np.asarray(r_f32.model.get_model("re").coeffs),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r_default.training_scores["re"]),
+        np.asarray(r_f32.training_scores["re"]),
+    )
+
+
+def test_bf16_storage_trains_close_to_f32():
+    """The reduced policy stores tables in bf16 (storage dtype visible on the
+    trained model), keeps [N] scores in f32, and lands within bf16 rounding
+    of the f32 model — a TOLERANCE comparison by design."""
+    r_f32 = run_coordinate_descent(_coords(re_solver="direct"), n_iterations=3)
+    r_bf16 = run_coordinate_descent(
+        _coords(precision="bf16", re_solver="direct"), n_iterations=3
+    )
+    m = r_bf16.model.get_model("re")
+    assert m.coeffs.dtype == jnp.bfloat16
+    assert r_bf16.training_scores["re"].dtype == jnp.float32
+    c_bf = np.asarray(m.coeffs.astype(jnp.float32))
+    c_f32 = np.asarray(r_f32.model.get_model("re").coeffs)
+    assert np.isfinite(c_bf).all()
+    scale = np.abs(c_f32).max()
+    assert np.abs(c_bf - c_f32).max() <= 0.05 * scale, (
+        np.abs(c_bf - c_f32).max(), scale
+    )
+
+
+def test_reduced_precision_requires_update_program():
+    with pytest.raises(ValueError, match="single-program update path"):
+        _coords(precision="bf16", use_update_program=False)
+
+
+def test_estimator_validates_precision_combinations():
+    from photon_ml_tpu.estimators.config import (
+        CoordinateConfiguration,
+        RandomEffectDataConfiguration,
+    )
+    from photon_ml_tpu.estimators.game_estimator import GameEstimator
+
+    cc = {
+        "re": CoordinateConfiguration(
+            data_config=RandomEffectDataConfiguration(
+                random_effect_type="e", feature_shard_id="s"
+            ),
+            optimization_config=GLMOptimizationConfiguration(),
+        )
+    }
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_configurations=cc,
+        re_precision="bf16",
+    )
+    assert est.re_precision == BFLOAT16
+    with pytest.raises(ValueError, match="re_update_program"):
+        GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configurations=cc,
+            re_precision="bf16",
+            re_update_program=False,
+        )
+
+
+# ------------------------------------------------------------ serving engine
+
+
+def _serving_model(rng, n_entities=6, d=4):
+    fe = FixedEffectModel(
+        model=LogisticRegressionModel(
+            Coefficients(means=jnp.asarray(rng.normal(size=d), jnp.float32))
+        ),
+        feature_shard_id="global",
+    )
+    proj = np.tile(np.arange(d, dtype=np.int32), (n_entities, 1))
+    re = RandomEffectModel(
+        re_type="userId",
+        feature_shard_id="re_shard",
+        task=TaskType.LOGISTIC_REGRESSION,
+        entity_ids=tuple(f"e{i}" for i in range(n_entities)),
+        coeffs=jnp.asarray(rng.normal(size=(n_entities, d)), jnp.float32),
+        proj_indices=jnp.asarray(proj),
+    )
+    return GameModel(models={"fixed": fe, "re": re})
+
+
+def _serving_input(rng, n=40, d=4, n_entities=6):
+    re_dense = rng.normal(size=(n, d))
+    return GameInput(
+        features={
+            "global": rng.normal(size=(n, d)).astype(np.float32),
+            "re_shard": sp.csr_matrix(re_dense),
+        },
+        labels=None,
+        offsets=np.zeros(n, dtype=np.float32),
+        id_columns={"userId": np.asarray([f"e{i % (n_entities + 2)}" for i in range(n)],
+                                         dtype=object)},
+    )
+
+
+def test_engine_precision_tables_and_tolerance():
+    rng = np.random.default_rng(4)
+    model = _serving_model(rng)
+    data = _serving_input(rng)
+    eng_f32 = get_engine(model)
+    eng_bf16 = get_engine(model, precision="bf16")
+    assert eng_f32 is not eng_bf16  # precision keys the engine cache
+    assert get_engine(model, precision="f32") is eng_f32  # f32 == default
+    # bf16 device tables actually stored reduced
+    re_state = [s for s in eng_bf16._coords if hasattr(s, "coeffs")][0]
+    assert re_state.coeffs.dtype == jnp.bfloat16
+    s32 = eng_f32.score(data)
+    s16 = eng_bf16.score(data)
+    assert s16.dtype == s32.dtype
+    scale = np.abs(s32).max() + 1e-6
+    assert np.abs(s16 - s32).max() <= 0.05 * scale
+
+
+def test_engine_f32_scores_unchanged_by_policy_plumbing():
+    """An explicitly-f32 engine is the SAME cached engine as the default —
+    and therefore bitwise-identical by construction."""
+    rng = np.random.default_rng(9)
+    model = _serving_model(rng)
+    data = _serving_input(rng)
+    np.testing.assert_array_equal(
+        get_engine(model).score(data), get_engine(model, precision="f32").score(data)
+    )
+
+
+# ------------------------------------------------------- host dtype boundary
+
+
+def test_offsets_fuse_on_device_rules():
+    assert offsets_fuse_on_device(np.zeros(3, np.float32))
+    # integer offsets promote differently under numpy vs jnp: host-side add
+    assert not offsets_fuse_on_device(np.zeros(3, np.int64))
+    # f64 offsets fuse only where the runtime preserves f64 (x64 mode)
+    f64_survives = jnp.asarray(np.zeros(0, np.float64)).dtype == np.float64
+    assert offsets_fuse_on_device(np.zeros(3, np.float64)) == f64_survives
+
+
+def test_host_link_matches_numpy_formulas():
+    z = np.linspace(-4, 4, 11)
+    np.testing.assert_array_equal(
+        host_link(TaskType.LOGISTIC_REGRESSION, z), 1.0 / (1.0 + np.exp(-z))
+    )
+    np.testing.assert_array_equal(host_link(TaskType.POISSON_REGRESSION, z), np.exp(z))
+    np.testing.assert_array_equal(host_link(TaskType.LINEAR_REGRESSION, z), z)
+    assert precision_mod.HOST_LINK_EXP_ULPS == 1
