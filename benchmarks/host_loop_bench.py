@@ -60,6 +60,20 @@ iteration's Gram-assembly FLOPs (~K gradient passes), a compute cost the
 bandwidth-bound TPU regime does not pay — ``direct_f32_vs_lbfgs`` is
 reported separately so that asymmetry stays visible.
 
+MESH MODE (``--mesh-devices N``): the same featureful workload through the
+SHARDED single-program coordinate update — datasets placed over an N-device
+mesh (``parallel/placement``), each RE update ONE donated SPMD module with
+entity-sharded tables/solves and sample-sharded scores. Emits
+``glmix_mesh_cd_pass_samples_per_sec`` + per-device efficiency columns and
+gates: bitwise fused-vs-per-bucket parity ON the mesh, run-to-run
+determinism, ZERO DATA collectives inside the RE solver loops (only the
+scalar convergence-predicate consensus a global batched while_loop needs,
+measured and reported) + bounded gather/scatter collectives
+(parallel/hlo_guards), held-out quality within
+``MESH_HELDOUT_LOGLOSS_TOL`` of the 1-device program (cross-layout
+comparisons are tolerance-only — XLA re-vectorizes per local shape, the
+PR 8 lesson), and zero steady-state retraces. See ``run_mesh``.
+
 Run directly (``python benchmarks/host_loop_bench.py``; needs the package
 installed, as in CI) or as ``python bench.py --host-loop``. Flags:
 ``--passes P`` (default 6), ``--samples N`` / ``--users U`` / ``--items I`` /
@@ -154,10 +168,13 @@ def build_coordinates(
     use_update_program: bool,
     re_solver: str = "lbfgs",
     precision=None,
+    mesh=None,
 ):
     """FE + per-user + per-item coordinates in the featureful (fused-pass-
     ineligible) configuration: RE normalization, per-entity L2 overrides,
-    SIMPLE variances."""
+    SIMPLE variances. ``mesh``: place every dataset (and the base offsets)
+    over the device mesh — the sharded single-program regime of
+    ``run_mesh``; None keeps the host placement."""
     import jax.numpy as jnp
 
     from photon_ml_tpu.algorithm import FixedEffectCoordinate, RandomEffectCoordinate
@@ -181,28 +198,46 @@ def build_coordinates(
         )
 
     fe_ds = FixedEffectDataset(LabeledData.build(fe_X, y), feature_shard_id="global")
+    datasets = {"fixed": fe_ds}
+    re_datasets = {}
+    for cid, ids, re_type in (
+        ("per-user", users, "userId"),
+        ("per-item", items, "itemId"),
+    ):
+        re_datasets[cid] = datasets[cid] = build_random_effect_dataset(
+            re_feat, ids, re_type, feature_shard_id="re_shard", labels=y,
+            normalization=norm, intercept_index=0,
+        )
+    if mesh is not None:
+        from photon_ml_tpu.parallel.placement import (
+            pad_and_shard_vector,
+            place_game_datasets,
+        )
+
+        datasets = place_game_datasets(datasets, mesh)
+        re_datasets = {cid: datasets[cid] for cid in re_datasets}
+        base_offsets = pad_and_shard_vector(
+            np.zeros(n), mesh, dtype=datasets["per-user"].sample_vals.dtype
+        )
+    else:
+        base_offsets = jnp.zeros(
+            n, dtype=re_datasets["per-user"].sample_vals.dtype
+        )
     coords = {
         "fixed": FixedEffectCoordinate(
             coordinate_id="fixed",
-            dataset=fe_ds,
+            dataset=datasets["fixed"],
             task=TaskType.LOGISTIC_REGRESSION,
             configuration=cfg(FE_ITERS),
         )
     }
-    for cid, ids, re_type, pe in (
-        ("per-user", users, "userId", pe_users),
-        ("per-item", items, "itemId", pe_items),
-    ):
-        ds = build_random_effect_dataset(
-            re_feat, ids, re_type, feature_shard_id="re_shard", labels=y,
-            normalization=norm, intercept_index=0,
-        )
+    for cid, pe in (("per-user", pe_users), ("per-item", pe_items)):
         coords[cid] = RandomEffectCoordinate(
             coordinate_id=cid,
-            dataset=ds,
+            dataset=datasets[cid],
             task=TaskType.LOGISTIC_REGRESSION,
             configuration=cfg(RE_ITERS),
-            base_offsets=jnp.zeros(n, dtype=ds.sample_vals.dtype),
+            base_offsets=base_offsets,
             normalization=norm,
             variance_computation=VarianceComputationType.SIMPLE,
             per_entity_reg_weights=pe,
@@ -475,6 +510,192 @@ def run(
     return result
 
 
+# Cross-LAYOUT tolerance gate for the mesh mode: the sharded program and the
+# 1-device (host-placed) program compile DIFFERENT local shapes, and XLA
+# re-vectorizes per shape (the PR 8 lesson), so their converged models agree
+# only to solver-convergence tolerance — never bitwise. The held-out log-loss
+# gap is the honest cross-layout quality gate; bitwise gates apply WITHIN a
+# layout (fused vs per-bucket on the same mesh, and run-to-run).
+MESH_HELDOUT_LOGLOSS_TOL = 0.01
+
+
+def run_mesh(
+    passes: int,
+    n: int,
+    n_users: int,
+    n_items: int,
+    d: int,
+    devices: int,
+    reps: int = 3,
+) -> dict:
+    """``--mesh-devices N``: the featureful workload through the SHARDED
+    single-program coordinate update — one donated SPMD module per RE update
+    over an N-device mesh (entity-sharded tables/solves, sample-sharded
+    scores), with no host round trips between updates.
+
+    Metric: ``glmix_mesh_cd_pass_samples_per_sec`` + per-device efficiency
+    columns vs the 1-device (host-placed) program. Gates (nonzero exit):
+
+    - BITWISE coefficient/variance/score parity between the sharded update
+      program and the per-bucket loop ON THE SAME MESH (the PR 4 parity
+      contract, lifted onto the mesh), and across two fresh sharded runs;
+    - held-out log-loss within ``MESH_HELDOUT_LOGLOSS_TOL`` of the 1-device
+      program (cross-layout comparisons are tolerance-only — PR 8 lesson);
+    - ZERO DATA collectives inside the RE solver loops
+      (``hlo_guards.assert_entity_solves_collective_free`` over each RE
+      coordinate's compiled update program; the scalar convergence-predicate
+      all-reduces a global batched while_loop needs are counted and must be
+      NONZERO — proof the scan actually sees the loops) and every remaining
+      collective within the gather/scatter payload bounds;
+    - zero steady-state retraces under ``sync_discipline``.
+
+    Scaling-efficiency columns are INFORMATIONAL under emulated host devices
+    (they share the physical cores — docs/PERFORMANCE.md "Honest measurement
+    under emulated devices"); record real scaling only from real-device
+    windows.
+    """
+    import jax
+
+    from photon_ml_tpu.algorithm import run_coordinate_descent
+    from photon_ml_tpu.analysis.runtime_guard import sync_discipline
+    from photon_ml_tpu.parallel import hlo_guards
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(devices)
+    workload = build_workload(n, n_users, n_items, d)
+
+    def block(result):
+        jax.block_until_ready(
+            [m.coeffs if hasattr(m, "coeffs") else m.model.coefficients.means
+             for m in result.model.models.values()]
+        )
+        return result
+
+    coords_mesh = build_coordinates(workload, use_update_program=True, mesh=mesh)
+    coords_pb = build_coordinates(workload, use_update_program=False, mesh=mesh)
+    coords_host = build_coordinates(workload, use_update_program=True)
+
+    # collective audit BEFORE the timed runs: the compiled update program of
+    # each RE coordinate must keep its entity-sharded bucket solves free of
+    # DATA collectives (the only tolerated in-loop op is the scalar
+    # convergence-predicate all-reduce a globally batched while_loop needs
+    # for termination consensus), with the surrounding gathers/scatters
+    # bounded. Both counts are MEASURED, and the predicate count must be
+    # nonzero — a zero would mean the scan no longer sees the solver loops
+    # (the vacuity failure mode the guard itself once had).
+    loop_data_collectives = 0
+    loop_predicate_collectives = 0
+    collective_kinds: dict = {}
+    for cid in ("per-user", "per-item"):
+        coord = coords_mesh[cid]
+        hlo = coord.compiled_update_hlo()
+        in_loop = hlo_guards.loop_collectives(hlo)
+        preds = hlo_guards.assert_entity_solves_collective_free(hlo)
+        loop_predicate_collectives += preds
+        loop_data_collectives += len(in_loop) - preds
+        ds = coord.dataset
+        table_elements = (ds.coeffs_rows + 1) * ds.max_k
+        bucket_block = max(
+            b.n_entities * b.shape[0] for b in ds.buckets
+        )
+        cols = hlo_guards.assert_collective_profile(
+            hlo,
+            grad_elements=ds.max_k,
+            table_elements=table_elements,
+            n_samples=int(ds.sample_entity_rows.shape[0]),
+            bucket_block_elements=bucket_block,
+            max_collectives=16 * len(ds.buckets),
+        )
+        for c in cols:
+            collective_kinds[c.kind] = collective_kinds.get(c.kind, 0) + 1
+
+    # warmup compiles every program of all three variants
+    block(run_coordinate_descent(coords_mesh, n_iterations=1))
+    block(run_coordinate_descent(coords_pb, n_iterations=1, defer_guard=False))
+    block(run_coordinate_descent(coords_host, n_iterations=1))
+
+    elapsed_mesh = elapsed_pb = elapsed_host = float("inf")
+    result_mesh = result_pb = result_host = None
+    retraces = 0
+    for _ in range(max(1, reps)):
+        with sync_discipline(what="mesh_cd_bench measured region") as region:
+            t0 = time.perf_counter()
+            result_mesh = block(run_coordinate_descent(coords_mesh, n_iterations=passes))
+            elapsed_mesh = min(elapsed_mesh, time.perf_counter() - t0)
+        retraces += region.traces
+
+        t0 = time.perf_counter()
+        result_pb = block(
+            run_coordinate_descent(coords_pb, n_iterations=passes, defer_guard=False)
+        )
+        elapsed_pb = min(elapsed_pb, time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        result_host = block(run_coordinate_descent(coords_host, n_iterations=passes))
+        elapsed_host = min(elapsed_host, time.perf_counter() - t0)
+
+    # --- gates ---------------------------------------------------------------
+    parity = _states_equal(
+        _coefficient_state(result_mesh), _coefficient_state(result_pb)
+    )
+    coords_det = build_coordinates(workload, use_update_program=True, mesh=mesh)
+    block(run_coordinate_descent(coords_det, n_iterations=1))
+    result_det = block(run_coordinate_descent(coords_det, n_iterations=passes))
+    deterministic = _states_equal(
+        _coefficient_state(result_mesh), _coefficient_state(result_det)
+    )
+    ll_mesh = _heldout_logloss(result_mesh, workload)
+    ll_host = _heldout_logloss(result_host, workload)
+    drift = abs(ll_mesh - ll_host)
+    drift_ok = drift <= MESH_HELDOUT_LOGLOSS_TOL
+    coeff_maxdiff = 0.0
+    for cid in ("per-user", "per-item"):
+        a = np.asarray(result_mesh.model.get_model(cid).coeffs, dtype=np.float64)
+        b = np.asarray(result_host.model.get_model(cid).coeffs, dtype=np.float64)
+        coeff_maxdiff = max(coeff_maxdiff, float(np.abs(a[: b.shape[0]] - b).max()))
+
+    value = n * passes / elapsed_mesh
+    host_sps = n * passes / elapsed_host
+    gates_ok = (
+        parity
+        and deterministic
+        and drift_ok
+        and retraces == 0
+        and loop_data_collectives == 0
+        # a 1-partition module legitimately compiles with NO collectives at
+        # all, so the scan-sees-the-loops proof only applies at devices > 1
+        and (devices == 1 or loop_predicate_collectives > 0)
+    )
+    return {
+        "metric": "glmix_mesh_cd_pass_samples_per_sec",
+        "value": round(value, 2),
+        "unit": "samples/sec",
+        "mesh_devices": devices,
+        "emulated_devices": jax.default_backend() == "cpu",
+        "samples_per_sec_per_device": round(value / devices, 2),
+        "one_device_samples_per_sec": round(host_sps, 2),
+        "scaling_efficiency_vs_1dev": round(value / devices / host_sps, 3),
+        "per_bucket_mesh_samples_per_sec": round(n * passes / elapsed_pb, 2),
+        "vs_per_bucket_mesh": round(value / (n * passes / elapsed_pb), 2),
+        "parity_bitwise_vs_per_bucket": bool(parity),
+        "deterministic_across_runs": bool(deterministic),
+        "retraces_after_warmup": int(retraces),
+        "loop_data_collectives": int(loop_data_collectives),
+        "loop_predicate_collectives": int(loop_predicate_collectives),
+        "collective_profile": collective_kinds,
+        "heldout_logloss_mesh": round(ll_mesh, 6),
+        "heldout_logloss_1dev": round(ll_host, 6),
+        "vs_1dev_heldout_drift": round(drift, 6),
+        "vs_1dev_drift_tol": MESH_HELDOUT_LOGLOSS_TOL,
+        "vs_1dev_coeff_maxdiff": float(coeff_maxdiff),
+        "passes": passes,
+        "reps": reps,
+        "n_samples": n,
+        "platform": jax.default_backend(),
+        "gates_ok": bool(gates_ok),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--passes", type=int, default=6)
@@ -497,7 +718,42 @@ def main(argv=None) -> int:
         ">=1.5x claim is checked; direct_f32_vs_lbfgs is reported "
         "separately)",
     )
+    p.add_argument(
+        "--mesh-devices", type=int, default=0,
+        help="run the SHARDED single-program coordinate update over this "
+        "many devices instead of the host-loop matrix: emits "
+        "glmix_mesh_cd_pass_samples_per_sec with per-device efficiency "
+        "columns and gates bitwise fused-vs-per-bucket parity on the mesh, "
+        "run-to-run determinism, zero RE-solve DATA collectives, bounded "
+        "gather/scatter collectives, tolerance vs the 1-device program, "
+        "and zero steady-state retraces. On a CPU backend the devices are "
+        "EMULATED via --xla_force_host_platform_device_count (set before "
+        "jax initializes); efficiency columns are then informational only",
+    )
     args = p.parse_args(argv)
+    if args.mesh_devices:
+        if args.mesh_devices < 1:
+            p.error("--mesh-devices must be >= 1")
+        # must happen before the first jax import (all jax imports in this
+        # module are function-local for exactly this reason): emulate the
+        # device count on CPU backends; real-accelerator runs (JAX_PLATFORMS
+        # set to a device plugin) use their real devices
+        import os
+
+        if os.environ.get("JAX_PLATFORMS", "cpu") in ("", "cpu"):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={args.mesh_devices}"
+                )
+        result = run_mesh(
+            args.passes, args.samples, args.users, args.items, args.features,
+            args.mesh_devices, args.reps,
+        )
+        print(json.dumps(result))
+        return 0 if result["gates_ok"] else 1
     result = run(
         args.passes, args.samples, args.users, args.items, args.features,
         args.reps, solver_matrix=args.solver_matrix,

@@ -247,8 +247,9 @@ class RandomEffectCoordinate(Coordinate):
     # re_coordinate_update_program): one donated XLA dispatch per update
     # instead of one program per bucket with eager glue between them. False
     # reproduces the per-bucket loop (the parity/bench denominator). Mesh-
-    # sharded datasets always take the per-bucket path (the program does not
-    # re-place sharded tables).
+    # sharded datasets compile the SAME program as one SPMD module: tables
+    # and bucket solves partition over the entity axis, scores over the
+    # sample axis, with donated state keeping its sharding across updates.
     use_update_program: bool = True
     # Inner bucket solver: "lbfgs" (the configured optimizer — bitwise status
     # quo), "direct" (batched Gram/Cholesky Newton solves), "auto" (direct
@@ -276,11 +277,9 @@ class RandomEffectCoordinate(Coordinate):
                     "path; set use_update_program=True (the per-bucket loop "
                     "stays f32-only)"
                 )
-            if getattr(self.dataset, "coeffs_sharding", None) is not None:
-                raise ValueError(
-                    "reduced-precision storage is not supported on mesh-sharded "
-                    "datasets (they take the per-bucket path)"
-                )
+            # storage dtype is orthogonal to placement: mesh-sharded datasets
+            # cast their (entity-sharded) tables and bucket blocks the same
+            # way the host path does — the reduced bytes just live sharded
         # donation ownership: the exact output buffers of our last update
         # program call. Only those are fed back donated; foreign arrays
         # (external warm starts, first iteration) are defensively copied so a
@@ -310,8 +309,31 @@ class RandomEffectCoordinate(Coordinate):
 
     def prepare_initial_model(self, model: RandomEffectModel) -> RandomEffectModel:
         # re-align entity rows to this dataset (warm start across rebuilt or
-        # differently ordered datasets)
-        return model.aligned_to(self.dataset) if hasattr(model, "aligned_to") else model
+        # differently ordered datasets), then adopt the dataset's TABLE
+        # layout: mesh-placed datasets pad the table height to a device
+        # multiple and shard it over the entity axis — a host-height warm
+        # start must come in padded + placed, or every downstream select/
+        # donate against the trained [coeffs_rows, K] tables shape-mismatches
+        if hasattr(model, "aligned_to"):
+            model = model.aligned_to(self.dataset)
+        if not hasattr(model, "coeffs"):  # duck-typed stand-ins: untouched
+            return model
+        from photon_ml_tpu.parallel.mesh import pad_rows_and_place
+
+        ds = self.dataset
+        sharding = getattr(ds, "coeffs_sharding", None)
+        rows = getattr(ds, "coeffs_rows", None) or ds.n_entities
+        coeffs = pad_rows_and_place(model.coeffs, rows, sharding)
+        variances = (
+            None
+            if model.variances is None
+            else pad_rows_and_place(model.variances, rows, sharding)
+        )
+        if coeffs is not model.coeffs or variances is not model.variances:
+            model = dataclasses.replace(
+                model, coeffs=coeffs, variances=variances
+            )
+        return model
 
     def update_model(
         self, initial_model: Optional[RandomEffectModel], partial_scores: Array
@@ -389,27 +411,121 @@ class RandomEffectCoordinate(Coordinate):
                 # reads these arrays (bucket blocks + the scoring view's
                 # values) every iteration — storage-width bytes are the HBM
                 # traffic the policy halves. Cast once per coordinate; solves
-                # and scores upcast in-register (solver_cache).
+                # and scores upcast in-register (solver_cache). On a mesh the
+                # casts keep the placed arrays' shardings (computation
+                # follows data) — storage width and placement are orthogonal.
                 buckets = tuple(
                     dataclasses.replace(b, X=self.precision.to_storage(b.X))
                     for b in buckets
                 )
                 view = (view[0], view[1], self.precision.to_storage(view[2]))
+            sharding = getattr(ds, "coeffs_sharding", None)
+            table_rows = getattr(ds, "coeffs_rows", None) or ds.n_entities
+            l2_rows = build_l2_rows(
+                ds,
+                self.configuration.l2_weight,
+                self.per_entity_reg_weights,
+                dtype,
+                table_rows,
+            )
+            l1 = jnp.asarray(self.configuration.l1_weight or 0.0, dtype=dtype)
+            norm_tables = precompute_norm_tables(ds, self.normalization, dtype)
+            if sharding is not None:
+                # placed to match the solves: the small L2/L1 tables REPLICATE
+                # (each entity shard gathers its own rows locally — no
+                # collective in the solve region), the per-bucket norm tables
+                # shard over the entity axis like the bucket arrays they are
+                # consumed alongside
+                from photon_ml_tpu.parallel.mesh import (
+                    batch_sharding,
+                    replicated_sharding,
+                )
+
+                mesh = sharding.mesh
+                rep = replicated_sharding(mesh)
+                ent2 = batch_sharding(mesh, ndim=2)
+                l2_rows = jax.device_put(l2_rows, rep)
+                l1 = jax.device_put(l1, rep)
+                norm_tables = tuple(
+                    None
+                    if tbl is None
+                    else tuple(
+                        None if a is None else jax.device_put(a, ent2)
+                        for a in tbl
+                    )
+                    for tbl in norm_tables
+                )
+            # mesh-placement padding lanes (entity_rows == n_entities) must
+            # not pollute the tracker's convergence stats — the per-bucket
+            # path filters rows < E, the fused tracker filters lazily with
+            # these host masks (None when no bucket carries padding)
+            tracker_masks = None
+            if sharding is not None:
+                masks = [
+                    np.asarray(jax.device_get(b.entity_rows)) < ds.n_entities
+                    for b in buckets
+                ]
+                if not all(m.all() for m in masks):
+                    tracker_masks = tuple(masks)
             self._fused_static = dict(
                 dtype=dtype,
-                l2_rows=build_l2_rows(
-                    ds,
-                    self.configuration.l2_weight,
-                    self.per_entity_reg_weights,
-                    dtype,
-                    ds.n_entities,
-                ),
-                l1=jnp.asarray(self.configuration.l1_weight or 0.0, dtype=dtype),
-                norm_tables=precompute_norm_tables(ds, self.normalization, dtype),
+                l2_rows=l2_rows,
+                l1=l1,
+                norm_tables=norm_tables,
                 buckets=buckets,
                 view=view,
+                tracker_masks=tracker_masks,
             )
         return self._fused_static
+
+    def _resolve_update_program(self):
+        """``(program, table_dtype, table_rows, table_sharding, shardings)``
+        — the cached update program at this coordinate's static
+        configuration and placement. The ONE owner of program resolution:
+        ``update_and_score`` dispatches it and ``compiled_update_hlo``
+        lowers it, so the collective audit always inspects exactly the
+        program training runs."""
+        from photon_ml_tpu.optimization.solver_cache import (
+            re_coordinate_update_program,
+        )
+
+        ds = self.dataset
+        st = self._fused_update_static()
+        # the coefficient/variance TABLES live at the policy's storage dtype
+        # (the donated state the program reads and writes every update); the
+        # reference policy keeps the dataset dtype — bitwise status quo
+        dtype = (
+            st["dtype"]
+            if self.precision.is_reference
+            else self.precision.storage_dtype
+        )
+        sharding = getattr(ds, "coeffs_sharding", None)
+        # mesh placement pads the table height to a device multiple (rows
+        # >= n_entities are always-zero padding the program re-zeroes)
+        rows = getattr(ds, "coeffs_rows", None) or ds.n_entities
+        shardings = None
+        if sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # donated state keeps these across iterations: the table (and
+            # variances) entity-sharded, the [N] score sample-sharded — the
+            # explicit out-constraints in solver_cache pin them so no
+            # resharding ever lands between updates
+            shardings = (
+                sharding,
+                NamedSharding(sharding.mesh, PartitionSpec(sharding.spec[0])),
+            )
+        program = re_coordinate_update_program(
+            self.task,
+            self.configuration.optimizer_config,
+            bool(self.configuration.l1_weight),
+            VarianceComputationType(self.variance_computation),
+            ds.n_entities,
+            self.re_solver,
+            self.precision,
+            shardings,
+        )
+        return program, dtype, rows, sharding, shardings
 
     def update_and_score(
         self,
@@ -421,31 +537,42 @@ class RandomEffectCoordinate(Coordinate):
         """One donated XLA program per update (solver_cache.
         re_coordinate_update_program): gathers, every bucket solve, the table
         scatter, the [N] score and the divergence guard — no host round trip.
-        Returns None (per-bucket fallback) for mesh-sharded datasets or when
-        ``use_update_program`` is off."""
+        Mesh-sharded datasets compile the same program as ONE SPMD module
+        (tables entity-sharded, scores sample-sharded, donated state keeping
+        its sharding across updates). Returns None (per-bucket fallback)
+        only when ``use_update_program`` is off."""
+        from photon_ml_tpu.parallel.mesh import pad_rows_and_place
+
         ds = self.dataset
-        if not self.use_update_program or getattr(ds, "coeffs_sharding", None) is not None:
+        if not self.use_update_program:
+            from photon_ml_tpu.analysis.fallbacks import log_fallback_once
+
+            log_fallback_once(
+                "re_coordinate_update_program",
+                f"coordinate {self.coordinate_id!r} "
+                f"({ds.re_type}/{ds.feature_shard_id}, "
+                f"{ds.n_samples} samples x {ds.n_entities} entities)",
+                "use_update_program=False: the per-bucket host loop runs "
+                "one program per bucket with eager glue between them",
+            )
             return None
         from photon_ml_tpu.algorithm.random_effect import LazyRandomEffectTracker
-        from photon_ml_tpu.optimization.solver_cache import re_coordinate_update_program
 
         st = self._fused_update_static()
-        # the coefficient/variance TABLES live at the policy's storage dtype
-        # (the donated state the program reads and writes every update); the
-        # reference policy keeps the dataset dtype — bitwise status quo
-        dtype = (
-            st["dtype"]
-            if self.precision.is_reference
-            else self.precision.storage_dtype
-        )
+        program, dtype, rows, sharding, _ = self._resolve_update_program()
         E, K_all = ds.n_entities, ds.max_k
+
+        def place_table(table):
+            return pad_rows_and_place(table, rows, sharding)
 
         def owned_or_copy(key, arr):
             # donation safety: only with the caller's donate promise AND when
             # the buffer is identically OUR previous output is it consumed in
             # place; anything else (external warm start, the loop's initial
             # score, a reused coordinate across runs) is copied so the
-            # caller's array survives our donation.
+            # caller's array survives our donation. jnp.array(copy=True)
+            # preserves sharding (computation follows data), so mesh state
+            # never bounces through the host here.
             if donate and arr is self._owned.get(key):
                 return arr
             return jnp.array(arr, copy=True)
@@ -455,8 +582,12 @@ class RandomEffectCoordinate(Coordinate):
             != VarianceComputationType.NONE
         )
         if initial_model is None:
-            coeffs_prev = jnp.zeros((E, K_all), dtype=dtype)
-            var_prev = jnp.zeros((E, K_all), dtype=dtype) if variance_on else None
+            coeffs_prev = place_table(jnp.zeros((E, K_all), dtype=dtype))
+            var_prev = (
+                place_table(jnp.zeros((E, K_all), dtype=dtype))
+                if variance_on
+                else None
+            )
         else:
             aligned = (
                 initial_model.aligned_to(ds)
@@ -466,29 +597,20 @@ class RandomEffectCoordinate(Coordinate):
             coeffs_prev = aligned.coeffs
             if coeffs_prev.dtype != dtype:
                 coeffs_prev = coeffs_prev.astype(dtype)
-            coeffs_prev = owned_or_copy("coeffs", coeffs_prev)
+            coeffs_prev = owned_or_copy("coeffs", place_table(coeffs_prev))
             var_prev = None
             if variance_on:
                 if aligned.variances is None:
-                    var_prev = jnp.zeros((E, K_all), dtype=dtype)
+                    var_prev = place_table(jnp.zeros((E, K_all), dtype=dtype))
                 else:
                     v = aligned.variances
                     if v.dtype != dtype:
                         v = v.astype(dtype)
-                    var_prev = owned_or_copy("var", v)
+                    var_prev = owned_or_copy("var", place_table(v))
 
         score_prev = owned_or_copy("score", prev_score)
         offsets_plus_scores = self.base_offsets + partial_scores
 
-        program = re_coordinate_update_program(
-            self.task,
-            self.configuration.optimizer_config,
-            bool(self.configuration.l1_weight),
-            VarianceComputationType(self.variance_computation),
-            E,
-            self.re_solver,
-            self.precision,
-        )
         coeffs_out, score_out, var_out, ok, reasons, iters = program(
             coeffs_prev,
             score_prev,
@@ -511,8 +633,53 @@ class RandomEffectCoordinate(Coordinate):
             variances=var_out,
             projector=ds.projector,
         )
-        tracker = LazyRandomEffectTracker(reasons, iters, guard_ok=ok)
+        tracker = LazyRandomEffectTracker(
+            reasons, iters, guard_ok=ok, real_masks=st["tracker_masks"]
+        )
         return model, score_out, tracker
+
+    def compiled_update_hlo(self) -> str:
+        """Compiled (post-SPMD-partitioning) HLO text of this coordinate's
+        update program at the dataset's placement — the collective-audit
+        hook. On a mesh, ``parallel/hlo_guards.assert_entity_solves_
+        collective_free`` runs over this text to prove the entity-sharded
+        bucket solves compile free of DATA collectives (the embarrassingly-
+        parallel contract; only the scalar convergence-predicate consensus
+        remains), and ``assert_collective_profile`` bounds the gather/scatter
+        collectives around them. Program resolution shares ONE owner with
+        ``update_and_score`` (``_resolve_update_program``), so this audit
+        always lowers exactly the program training dispatches."""
+        ds = self.dataset
+        st = self._fused_update_static()
+        program, dtype, rows, sharding, shardings = self._resolve_update_program()
+        K_all = ds.max_k
+        variance_on = (
+            VarianceComputationType(self.variance_computation)
+            != VarianceComputationType.NONE
+        )
+        coeffs = jnp.zeros((rows, K_all), dtype=dtype)
+        var = jnp.zeros((rows, K_all), dtype=dtype) if variance_on else None
+        score = jnp.zeros(
+            int(ds.sample_entity_rows.shape[0]), dtype=st["dtype"]
+        )
+        if shardings is not None:
+            table_sharding, score_sharding = shardings
+            coeffs = jax.device_put(coeffs, table_sharding)
+            if var is not None:
+                var = jax.device_put(var, table_sharding)
+            score = jax.device_put(score, score_sharding)
+        lowered = program.lower(
+            coeffs,
+            score,
+            var,
+            self.base_offsets,
+            st["l2_rows"],
+            st["l1"],
+            st["buckets"],
+            st["norm_tables"],
+            st["view"],
+        )
+        return lowered.compile().as_text()
 
     def score(self, model: RandomEffectModel) -> Array:
         return model.score_dataset(self.dataset)

@@ -86,23 +86,38 @@ class LazyRandomEffectTracker:
 
     ``guard_ok`` is the update's device-side divergence flag (all updated
     coefficients finite, computed BEFORE the in-program reject select): the
-    descent loop reads it in its once-per-iteration batched transfer."""
+    descent loop reads it in its once-per-iteration batched transfer.
 
-    def __init__(self, reasons_parts, iters_parts, guard_ok=None):
+    ``real_masks`` (host bool array per bucket, or None) excludes
+    mesh-placement padding lanes from the stats — the per-bucket path's
+    ``rows < E`` filter, applied lazily at materialization so the stats of
+    the sharded and per-bucket paths agree."""
+
+    def __init__(self, reasons_parts, iters_parts, guard_ok=None, real_masks=None):
         self.guard_ok = guard_ok
         self._pending = (tuple(reasons_parts), tuple(iters_parts))
+        self._masks = None if real_masks is None else tuple(real_masks)
         self._inner: Optional[RandomEffectTracker] = None
 
     def _materialize(self) -> RandomEffectTracker:
         if self._inner is None:
             reasons_h, iters_h = jax.device_get(self._pending)
+            masks = (
+                self._masks
+                if self._masks is not None
+                else tuple(slice(None) for _ in reasons_h)
+            )
             reasons = (
-                np.concatenate([np.asarray(a) for a in reasons_h])
+                np.concatenate(
+                    [np.asarray(a)[m] for a, m in zip(reasons_h, masks)]
+                )
                 if reasons_h
                 else np.zeros(0, np.int32)
             )
             iters = (
-                np.concatenate([np.asarray(a) for a in iters_h])
+                np.concatenate(
+                    [np.asarray(a)[m] for a, m in zip(iters_h, masks)]
+                )
                 if iters_h
                 else np.zeros(0, np.int32)
             )
@@ -442,19 +457,18 @@ def train_random_effect_delta(
     ``prev_model`` must cover the dataset's entities (align it first /
     build the dataset with ``entity_order`` so growth appends at the tail).
 
-    Mesh-sharded datasets are not supported (the delta path gathers/scatters
-    host-chosen lane sets; use the per-bucket full solve there).
+    Mesh-sharded datasets are supported: the gathered active sub-buckets are
+    re-placed under the dataset's entity sharding (lane counts padded to a
+    mesh multiple), the warm-start table is padded/placed under
+    ``coeffs_sharding``, and padding lanes scatter to the table HEIGHT (out
+    of bounds on any backend — dropped), so inactive entities keep the
+    previous generation's shard contents bit for bit.
     """
     task = TaskType(task)
     loss = loss_for_task(task)
     opt_type = OptimizerType(configuration.optimizer_config.optimizer_type)
     if opt_type in (OptimizerType.TRON, OptimizerType.NEWTON) and not loss.has_hessian:
         raise ValueError(f"{opt_type.value} requires a twice-differentiable loss")
-    if getattr(dataset, "coeffs_sharding", None) is not None:
-        raise ValueError(
-            "active-set delta updates require an unsharded dataset "
-            "(mesh backends take the full per-bucket path)"
-        )
     l2 = configuration.l2_weight
     l1 = configuration.l1_weight
     variance_computation = VarianceComputationType(variance_computation)
@@ -469,10 +483,25 @@ def train_random_effect_delta(
             f"active_mask shape {active_mask.shape} != ({E},) entities"
         )
 
+    coeffs_sharding = getattr(dataset, "coeffs_sharding", None)
+    table_rows = getattr(dataset, "coeffs_rows", None) or E
+    mesh_multiple = (
+        coeffs_sharding.mesh.devices.size if coeffs_sharding is not None else 1
+    )
+
+    def _place(table):
+        # mesh backend: pad the table height to the device multiple (rows
+        # >= E are always-zero padding) and pin the entity sharding — same
+        # discipline as train_random_effect
+        from photon_ml_tpu.parallel.mesh import pad_rows_and_place
+
+        return pad_rows_and_place(table, table_rows, coeffs_sharding)
+
     aligned = prev_model.aligned_to(dataset)
     coeffs_global = aligned.coeffs
     if coeffs_global.dtype != dtype:
         coeffs_global = coeffs_global.astype(dtype)
+    coeffs_global = _place(coeffs_global)
     if variance_on and aligned.variances is None and not active_mask.all():
         # only active entities receive solved variances; everything else
         # would export variance exactly 0.0, which reads as infinite
@@ -484,7 +513,7 @@ def train_random_effect_delta(
             "first (or disable variance computation for delta passes)."
         )
     if variance_on:
-        variances_global = (
+        variances_global = _place(
             jnp.zeros((E, K_all), dtype=dtype)
             if aligned.variances is None
             else aligned.variances.astype(dtype)
@@ -523,13 +552,25 @@ def train_random_effect_delta(
             w_b, sid_b = bucket.weights, bucket.sample_ids
         else:
             pad_to = min(_next_pow2(len(sel), min_entities_pad), Eb)
+            if mesh_multiple > 1:
+                # entity-sharded sub-buckets need a device-divisible lane
+                # count; the placed bucket's Eb is already a mesh multiple,
+                # so the cap stays valid
+                pad_to = min(-(-pad_to // mesh_multiple) * mesh_multiple, Eb)
             # pow2-pad the lane count with DUPLICATES of the first active lane
             # (a twin solve converges like its sibling — far fewer wasted
             # iterations than an artificial zero-data lane) whose scatter is
-            # dropped via an out-of-bounds row
+            # dropped via an out-of-bounds row (the table HEIGHT: row E is a
+            # real always-zero padding row on mesh-padded tables, table_rows
+            # is out of bounds everywhere)
             idx = np.concatenate([sel, np.full(pad_to - len(sel), sel[0])])
             scatter_rows = np.concatenate(
-                [rows_host[sel], np.full(pad_to - len(sel), E, dtype=rows_host.dtype)]
+                [
+                    rows_host[sel],
+                    np.full(
+                        pad_to - len(sel), table_rows, dtype=rows_host.dtype
+                    ),
+                ]
             )
             n_real = len(sel)
             rows_b = rows_host[idx]  # in-bounds rows (duplicates for padding)
@@ -538,6 +579,17 @@ def train_random_effect_delta(
             y_b = jnp.take(bucket.labels, idx_dev, axis=0)
             w_b = jnp.take(bucket.weights, idx_dev, axis=0)
             sid_b = jnp.take(bucket.sample_ids, idx_dev, axis=0)
+            if coeffs_sharding is not None:
+                # re-place the gathered sub-bucket under the entity sharding:
+                # the vmapped solve then partitions lane-parallel exactly like
+                # the full path's buckets
+                from photon_ml_tpu.parallel.mesh import batch_sharding
+
+                mesh = coeffs_sharding.mesh
+                X_b = jax.device_put(X_b, batch_sharding(mesh, ndim=3))
+                y_b = jax.device_put(y_b, batch_sharding(mesh, ndim=2))
+                w_b = jax.device_put(w_b, batch_sharding(mesh, ndim=2))
+                sid_b = jax.device_put(sid_b, batch_sharding(mesh, ndim=2))
         n_lanes += len(rows_b)
 
         proj_b = dataset.proj_indices[jnp.asarray(rows_b), :K]
@@ -601,6 +653,14 @@ def train_random_effect_delta(
             variances_global = variances_global.at[rows_dev].set(
                 _pad_blocks(var_updates)
             )
+        if coeffs_sharding is not None:
+            # pin the table sharding after the scatter so the exported model
+            # (and the next delta's warm start) stays entity-sharded
+            coeffs_global = jax.device_put(coeffs_global, coeffs_sharding)
+            if variances_global is not None:
+                variances_global = jax.device_put(
+                    variances_global, coeffs_sharding
+                )
 
     if reasons_parts:
         reasons_h, iters_h = jax.device_get((reasons_parts, iters_parts))

@@ -1,0 +1,57 @@
+"""Once-per-cause fallback telemetry: make silent slow paths loud, once.
+
+Several hot paths in the codebase carry a slower twin they can quietly drop
+to: the single-program random-effect coordinate update falls back to the
+per-bucket host loop when a coordinate opts out (``use_update_program=False``
+or a foreign coordinate type), and the serving layer falls back to eager
+per-coordinate scoring when the fused engine cannot cover a configuration.
+Historically these demotions were SILENT — a misplaced ``device_put`` (a
+mesh-sharded dataset before PR 10 lifted the restriction) demoted a whole
+training run to the slow path with no signal anywhere.
+
+``log_fallback_once(component, fingerprint, cause)`` is the one logging
+discipline for such demotions: exactly ONE structured warning per
+(component, fingerprint, cause) key per process, so a 10k-iteration descent
+run or a million-request serving process reports the demotion without
+flooding. The ``fingerprint`` identifies the demoted object (a dataset or
+model — callers pass a short stable description, not an ``id()``, so the log
+line is actionable); ``cause`` is the structured reason.
+
+Pure stdlib on purpose (this package's contract): importable without jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+_seen: set = set()
+_lock = threading.Lock()
+
+
+def log_fallback_once(component: str, fingerprint: str, cause: str) -> bool:
+    """Log one structured fallback warning per (component, fingerprint,
+    cause). Returns True when this call was the first (and logged), False for
+    every repeat — callers can branch on it for metrics if they need to."""
+    key = (component, fingerprint, cause)
+    with _lock:
+        if key in _seen:
+            return False
+        _seen.add(key)
+    logger.warning(
+        "fallback: %s dropped to its slow path for %s — %s "
+        "(logged once per cause)",
+        component,
+        fingerprint,
+        cause,
+    )
+    return True
+
+
+def reset_fallback_log() -> None:
+    """Forget every logged key (tests; long-lived processes that reload
+    models and want the next demotion reported again)."""
+    with _lock:
+        _seen.clear()

@@ -99,6 +99,12 @@ class ContinuousTrainerConfig:
     # delta passes are always warm-started from the previous generation, the
     # regime where the Newton loop converges in 1-2 steps.
     re_solver: str = "lbfgs"
+    # SPMD backend: a jax.sharding.Mesh places every generation's datasets
+    # (and the delta pass's gathered active sub-buckets) over the device
+    # mesh — bootstrap and delta passes then run as sharded programs with
+    # entity-sharded coefficient tables (parallel/placement.py). None =
+    # single-device host placement.
+    mesh: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -152,6 +158,7 @@ class ContinuousTrainer:
             n_iterations=config.delta_iterations,
             dtype=config.dtype,
             re_solver=config.re_solver,
+            mesh=config.mesh,
         )
         self.re_types = {
             cid: cfg.data_config.random_effect_type
@@ -286,6 +293,20 @@ class ContinuousTrainer:
             model, model=dataclasses.replace(model.model, coefficients=new_coef)
         )
 
+    def _base_offsets(self):
+        """The [N] base-offset vector at the backend's placement: padded and
+        sample-sharded on a mesh (placed datasets carry a padded sample axis,
+        so every score/offset array must match), plain device array on the
+        host backend."""
+        off = np.asarray(self.snapshot.data.offsets)
+        if self.config.mesh is not None:
+            from photon_ml_tpu.parallel.placement import pad_and_shard_vector
+
+            return pad_and_shard_vector(
+                off, self.config.mesh, dtype=self.config.dtype
+            )
+        return jnp.asarray(off, dtype=self.config.dtype)
+
     def _adapted_models(self, datasets: dict) -> dict:
         """Previous-generation models adapted to the grown datasets: fixed
         effects zero-pad to the grown feature dim, random effects re-layout
@@ -308,9 +329,7 @@ class ContinuousTrainer:
         screen evaluates each coordinate's subproblem gradient at the
         warm-start coefficients against the OTHER coordinates' current
         scores (one cheap vmapped pass per bucket shape)."""
-        base_offsets = jnp.asarray(
-            np.asarray(self.snapshot.data.offsets), dtype=self.config.dtype
-        )
+        base_offsets = self._base_offsets()
         scores = None
         if self.config.gradient_threshold is not None:
             from photon_ml_tpu.algorithm.coordinate import score_model_on_dataset
@@ -400,6 +419,10 @@ class ContinuousTrainer:
             datasets = self.estimator.prepare_training_datasets(
                 self.snapshot.data, entity_orders=entity_orders
             )
+            if self.config.mesh is not None:
+                from photon_ml_tpu.parallel.placement import place_game_datasets
+
+                datasets = place_game_datasets(datasets, self.config.mesh)
             timings["datasets"] = time.perf_counter() - t0
 
             t0 = time.perf_counter()
@@ -426,9 +449,7 @@ class ContinuousTrainer:
             timings["select"] = time.perf_counter() - t0
 
             t0 = time.perf_counter()
-            base_offsets = jnp.asarray(
-                np.asarray(self.snapshot.data.offsets), dtype=self.config.dtype
-            )
+            base_offsets = self._base_offsets()
             coordinates = {}
             for cid in self.config.coordinate_configurations:
                 init = None if initial_models is None else initial_models.get(cid)

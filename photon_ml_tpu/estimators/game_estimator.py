@@ -150,7 +150,8 @@ class GameEstimator:
     # configurations the fused pass rejects (normalization, per-entity L2,
     # variances, checkpointing, ...) keep their semantics but lose the
     # per-bucket dispatch + host-sync overhead. False restores the per-bucket
-    # loop (mesh-sharded datasets always use it).
+    # loop. Mesh-sharded datasets compile the same program as ONE SPMD
+    # module (entity-sharded solves, sample-sharded scores).
     re_update_program: bool = True
     # Random-effect inner bucket solver (optimization/normal_equations.py):
     # "lbfgs" runs the configured optimizer (bitwise status quo), "direct"
@@ -162,7 +163,8 @@ class GameEstimator:
     # (optimization/precision.py): None/"f32" is the bitwise reference;
     # "bf16"/"f16" store coefficient tables + bucket features reduced with
     # f32 accumulation. Tolerance-gated (bench.py --host-loop measures the
-    # held-out quality drift); requires re_update_program=True and no mesh.
+    # held-out quality drift); requires re_update_program=True. Placement-
+    # orthogonal: mesh-sharded tables store reduced the same way.
     re_precision: object = None
 
     def __post_init__(self):
@@ -186,11 +188,9 @@ class GameEstimator:
                     "the fused pass uses fe_storage_dtype/re_storage_dtype "
                     "(set fused_pass=False or use those knobs)"
                 )
-            if self.mesh is not None:
-                raise ValueError(
-                    "re_precision is not supported with a mesh (sharded "
-                    "datasets take the per-bucket f32 path)"
-                )
+            # a mesh is fine: storage dtype is orthogonal to placement — the
+            # sharded update program stores its entity-sharded tables/blocks
+            # reduced exactly like the host path does
             if self.checkpoint_directory is not None:
                 # np.save round-trips bfloat16/float16 as raw void dtypes
                 # (|V2): a resumed run would silently reinterpret the table

@@ -310,6 +310,7 @@ def re_coordinate_update_program(
     n_entities: int,
     re_solver: str = "lbfgs",
     precision: PrecisionPolicy = FLOAT32,
+    shardings: tuple = None,
 ):
     """ONE jitted, donated XLA program for a whole random-effect coordinate
     update: offset gather, every bucket's vmapped solve chained in a single
@@ -339,10 +340,33 @@ def re_coordinate_update_program(
     - ``re_solver`` / ``precision``: the direct-solve and storage-precision
       levers (normal_equations.py / precision.py); the defaults reproduce
       the bitwise-gated status quo.
+    - ``shardings``: None on the host backend; on a mesh, the
+      ``(table_sharding, score_sharding)`` NamedSharding pair
+      (hashable — part of the cache key). The update body is placement-
+      agnostic (GSPMD partitions it from the input shardings: entity-sharded
+      bucket solves stay collective-free, the offset/score gathers become
+      the [N]/[E,K]-bounded collectives parallel/hlo_guards.py audits); the
+      explicit output constraints pin the donated state's shardings so
+      iteration N+1 consumes iteration N's buffers with NO resharding
+      between updates — the whole point of donating across a descent run.
     """
     update = _re_coordinate_update_fn(
         task, opt_config, has_l1, variance, n_entities, re_solver, precision
     )
+    if shardings is not None:
+        table_sharding, score_sharding = shardings
+        inner_update = update
+
+        def update(coeffs_prev, score_prev, var_prev, *rest):
+            coeffs, score, var, ok, reasons, iters = inner_update(
+                coeffs_prev, score_prev, var_prev, *rest
+            )
+            coeffs = jax.lax.with_sharding_constraint(coeffs, table_sharding)
+            score = jax.lax.with_sharding_constraint(score, score_sharding)
+            if var is not None:
+                var = jax.lax.with_sharding_constraint(var, table_sharding)
+            return coeffs, score, var, ok, reasons, iters
+
     return jax.jit(update, donate_argnums=(0, 1, 2))
 
 

@@ -25,6 +25,21 @@ exchanged between coordinates):
   blocks;
 - no all-to-all / reduce-scatter / collective-permute at all today, so any
   appearance is a deliberate-change signal, not noise.
+
+The mesh-sharded single-program coordinate update (PR 10) adds a third,
+sharper guard: the RE bucket SOLVES — everything inside the optimizer
+``while`` loops — are embarrassingly parallel across entity shards and must
+compile with ZERO DATA collectives. A collective that lands inside a loop
+runs once per solver iteration instead of once per update; the payload
+bounds above would not catch a small-but-per-iteration regression.
+``assert_entity_solves_collective_free`` walks the compiled module's
+``while`` bodies/conditions (transitively through called computations) and
+fails on any collective there EXCEPT single-element all-reduces: a globally
+batched ``while_loop`` over sharded lanes must agree on termination, so its
+condition carries one scalar ``pred[]`` convergence-consensus all-reduce per
+iteration check — semantically unavoidable (the per-bucket mesh path's
+jitted solves have the identical op), latency-bound not bandwidth-bound,
+and already named legal by the profile above ("convergence predicates").
 """
 
 from __future__ import annotations
@@ -88,6 +103,7 @@ def assert_collective_profile(
     table_elements: int,
     n_samples: int,
     max_collectives: int = 48,
+    bucket_block_elements: int = 0,
 ) -> list:
     """Fail if the compiled module's collectives exceed the healthy GLMix
     profile. Returns the parsed collectives for reporting.
@@ -97,10 +113,21 @@ def assert_collective_profile(
     Legal all-reduce: value+gradient tuple and/or a coefficient-table
     scatter-combine (XLA may fuse them into one tuple-shaped op). Legal
     all-gather: entity tables and [n_samples] score vectors.
+
+    bucket_block_elements (the sharded RE coordinate-update program only):
+    largest per-bucket [E_pad, S] block. GSPMD lowers the once-per-update
+    offset gather (sample-sharded [N] source, entity-sharded [E, S] indices)
+    as a masked local gather plus an all-reduce of the [E, S] result — an
+    extra legal all-reduce class, bounded by the bucket's sample-id block
+    and sitting OUTSIDE the solver loops (``loop_collectives`` proves that
+    separately). 0 (the default) disables the class — the fused whole-pass
+    profile has no such op.
     """
     collectives = Collective.parse_all(compiled_text)
     biggest_gather = max(table_elements, n_samples)
-    biggest_reduce = grad_elements + 1 + table_elements
+    biggest_reduce = max(
+        grad_elements + 1 + table_elements, bucket_block_elements
+    )
     for c in collectives:
         if c.kind == "all-reduce":
             assert c.elements <= biggest_reduce, (
@@ -126,3 +153,107 @@ def assert_collective_profile(
         f"collective count must scale with solver program count, not entities"
     )
     return collectives
+
+
+# --------------------------------------------------------------------------
+# loop-body collective scan: the RE-bucket-solves-are-comm-free guard
+# --------------------------------------------------------------------------
+
+# `%name (params...) -> result {` or `ENTRY %name ... {` — one per
+# computation. The parameter list is matched GREEDILY (`\(.*\)`): real XLA
+# while bodies take a single TUPLE-typed parameter whose type nests parens
+# (`(arg_tuple.5: (s32[], f32[8])) -> ...`), which a lazy `[^)]*` would stop
+# at — silently dropping every loop body from the scan and making the
+# collective-free assertion vacuous. The header is one line, so greedy is
+# safe.
+_COMPUTATION_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w\.\-]+)\s*\(.*\)\s*->.*\{")
+# computation references an op can carry: loop bodies/conditions, fusions,
+# reducers, conditional branch LISTS (`branch_computations={%a, %b}` — every
+# member must be followed, not just the first)
+_CALLED_RE = re.compile(
+    r"(?:body|condition|to_apply|calls|branch_computations)="
+    r"(\{[^}]*\}|%?[\w\.\-]+)"
+)
+_NAME_RE = re.compile(r"[\w\.\-]+")
+_WHILE_RE = re.compile(
+    r"while\(.*?\)(?:,\s*(?:condition|body)=%?([\w\.\-]+))(?:,\s*(?:condition|body)=%?([\w\.\-]+))?"
+)
+_COLLECTIVE_LINE_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|\S+)\s+(" + "|".join(_COLLECTIVE_KINDS) + r")(?:-start)?\("
+)
+
+
+def _computations(compiled_text: str) -> dict:
+    """Split compiled HLO text into {computation name: [body lines]}."""
+    comps: dict = {}
+    current = None
+    for line in compiled_text.splitlines():
+        m = _COMPUTATION_RE.match(line)
+        if m:
+            current = m.group(1)
+            comps[current] = []
+        elif current is not None:
+            comps[current].append(line)
+    return comps
+
+
+def loop_collectives(compiled_text: str) -> list:
+    """Collectives reachable from any ``while`` op's body or condition
+    (transitively through ``to_apply``/``calls``/nested loops). Each entry is
+    ``(computation name, HLO line, result elements)``. A healthy batched
+    solve shows only single-element convergence-predicate all-reduces here
+    (see ``assert_entity_solves_collective_free``); data-sized entries mean
+    per-iteration communication."""
+    comps = _computations(compiled_text)
+    seeds: set = set()
+    for lines in comps.values():
+        for line in lines:
+            m = _WHILE_RE.search(line)
+            if m:
+                seeds.update(g for g in m.groups() if g)
+    # transitive closure over computations called from loop bodies
+    reached = set(seeds)
+    frontier = list(seeds)
+    while frontier:
+        name = frontier.pop()
+        for line in comps.get(name, ()):
+            for group in _CALLED_RE.findall(line):
+                for ref in _NAME_RE.findall(group):
+                    if ref in comps and ref not in reached:
+                        reached.add(ref)
+                        frontier.append(ref)
+    out = []
+    for name in sorted(reached):
+        for line in comps.get(name, ()):
+            if _COLLECTIVE_LINE_RE.search(line):
+                parsed = Collective.parse_all(line)
+                elements = parsed[0].elements if parsed else -1
+                out.append((name, line.strip(), elements))
+    return out
+
+
+def assert_entity_solves_collective_free(compiled_text: str) -> int:
+    """Fail if any DATA collective appears inside a ``while`` body/condition
+    of the compiled module. For the random-effect coordinate update this is
+    the embarrassingly-parallel contract: entity-sharded bucket solves need
+    no data communication — every payload-bearing collective (offset/table
+    gathers, the table scatter-combine, the finiteness all-reduce) sits
+    OUTSIDE the solver loops and runs once per update, not once per solver
+    iteration. The ONE legal in-loop collective is the single-element
+    all-reduce of the loop's convergence predicate (global termination
+    consensus over sharded lanes — present in every batched sharded
+    ``while_loop``, including the per-bucket path's). Returns the count of
+    those tolerated predicate all-reduces for reporting."""
+    found = loop_collectives(compiled_text)
+    data = [
+        (name, line, elements)
+        for name, line, elements in found
+        if elements != 1 or "all-reduce" not in line
+    ]
+    assert not data, (
+        f"{len(data)} data collective(s) inside solver while-loops — the "
+        f"entity-sharded bucket solves are no longer communication-free "
+        f"(each runs per solver ITERATION): "
+        + "; ".join(f"{name}: {line[:100]}" for name, line, _ in data[:4])
+    )
+    return len(found)

@@ -58,6 +58,30 @@ def pad_axis_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0, fill=0):
     return np.pad(arr, widths, constant_values=fill), n
 
 
+def pad_rows_and_place(table, rows: int, sharding):
+    """Adopt the entity-table layout: zero-pad a ``[R, K]`` table's height to
+    ``rows`` and pin ``sharding`` (None = host placement). No-op — same
+    object back — when already tall enough and equivalently placed, which is
+    what keeps the donation-ownership identity checks intact. THE shared
+    padding discipline of the update program's warm starts, the active-set
+    delta path and ``prepare_initial_model``: rows >= the entity count are
+    always-zero padding the solvers re-zero after every scatter."""
+    if table.shape[0] < rows:
+        table = jnp.concatenate(
+            [
+                table,
+                jnp.zeros(
+                    (rows - table.shape[0], table.shape[1]), dtype=table.dtype
+                ),
+            ]
+        )
+    if sharding is not None and not table.sharding.is_equivalent_to(
+        sharding, table.ndim
+    ):
+        table = jax.device_put(table, sharding)
+    return table
+
+
 def pad_put(arr, multiple: int, sharding, *, fill=0, to_dtype=None):
     """Pad axis 0 to a multiple and place under ``sharding``. Returns
     (placed array, n_orig).

@@ -123,12 +123,14 @@ def place_random_effect_dataset(ds: RandomEffectDataset, mesh) -> RandomEffectDa
 
 
 def place_serving_batch(batch, mesh):
-    """Batch-shard a serving request's prepared arrays over the 1-D mesh.
+    """Batch-shard a serving request's prepared arrays over the mesh's
+    FIRST (batch) axis — 1-D or 2-D: a 2-D training mesh's second axis holds
+    replicas, so serving rides its data axis unchanged.
 
     Every leaf of a serving batch (serving/engine.py) leads with the PADDED
-    sample axis — the engine's bucket size is already a mesh multiple — so
-    placement is a uniform axis-0 sharding; the engine's coefficient tables
-    are replicated separately at engine build. This is the scoring-side
+    sample axis — the engine's bucket size is already a batch-axis multiple —
+    so placement is a uniform axis-0 sharding; the engine's coefficient
+    tables are replicated separately at engine build. This is the scoring-side
     analog of the training placement above, minus the padding (already done)
     and the entity-axis sharding (serving gathers THROUGH the replicated
     tables instead of scattering into them)."""

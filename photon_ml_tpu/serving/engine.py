@@ -284,10 +284,12 @@ class GameServingEngine:
         fingerprint: Optional[str] = None,
         precision: Optional[object] = None,
     ):
-        if mesh is not None and len(mesh.axis_names) != 1:
+        if not self.mesh_capable(mesh):
             raise ValueError(
-                "GameServingEngine supports a 1-D (data) mesh; 2-D "
-                "feature-sharded meshes score through the eager path"
+                f"GameServingEngine cannot serve under mesh {mesh!r}; probe "
+                "GameServingEngine.mesh_capable(mesh) before construction "
+                "(GameTransformer and the hot-swap warm path do) and score "
+                "eagerly when it says no"
             )
         from photon_ml_tpu.optimization.precision import resolve_precision
 
@@ -324,6 +326,27 @@ class GameServingEngine:
             self._fused,
             static_argnames=("per_coordinate", "include_offsets", "apply_link"),
         )
+
+    # -- capability probe --------------------------------------------------
+
+    @staticmethod
+    def mesh_capable(mesh) -> bool:
+        """Whether the fused engine can serve under ``mesh`` — THE one owner
+        of the fused-vs-eager placement decision (``GameTransformer`` and the
+        hot-swap warm path consult it instead of try/excepting construction).
+
+        Any named device mesh works: coefficient tables replicate over all
+        its devices and request batches shard along the FIRST axis only
+        (``parallel/placement.place_serving_batch``'s batch-axis
+        ``PartitionSpec``), so a 2-D ("data", "model") training mesh serves
+        fused with its data axis carrying the batch — the feature axis simply
+        holds replicas. ``None`` (single device) is always capable. Only
+        mesh-like objects without named axes/devices are refused."""
+        if mesh is None:
+            return True
+        return bool(getattr(mesh, "axis_names", None)) and getattr(
+            mesh, "devices", None
+        ) is not None
 
     # -- device state ------------------------------------------------------
 
@@ -420,12 +443,15 @@ class GameServingEngine:
 
     def bucket(self, n: int) -> int:
         """Padded batch size for a request of ``n`` samples: next power of two
-        >= min_batch_pad, then (under SPMD) rounded up to a mesh multiple."""
+        >= min_batch_pad, then (under SPMD) rounded up to a multiple of the
+        BATCH axis — the first mesh axis, which is all the sample axis shards
+        over (a 2-D mesh's second axis holds replicas; padding to the total
+        device count would over-pad without changing the partition)."""
         p = self.min_batch_pad
         while p < n:
             p *= 2
         if self.mesh is not None:
-            m = self.mesh.devices.size
+            m = int(self.mesh.shape[self.mesh.axis_names[0]])
             p = -(-p // m) * m
         return p
 

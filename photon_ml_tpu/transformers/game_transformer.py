@@ -9,8 +9,9 @@ coefficient tables and a single host transfer of the final [N] scores.
 ``engine="eager"`` keeps the original per-coordinate path — each coordinate's
 scoring dataset built from the model's own metadata (shard id, random-effect
 type), scored with one dispatch per coordinate — used for parity testing and
-as the fallback for configurations the fused engine does not cover (2-D
-feature-sharded meshes).
+as the fallback for whatever ``GameServingEngine.mesh_capable`` (the one
+owner of the fused-vs-eager placement decision) refuses. 2-D training meshes
+serve FUSED since PR 10: tables replicate, batches shard along the data axis.
 """
 
 from __future__ import annotations
@@ -43,18 +44,38 @@ class GameTransformer:
     # mirroring the reference's executor-parallel scoring
     # (GameTransformer.transform:150+, RandomEffectModel.score:83-101)
     mesh: object = None
-    # "fused": the jit-cached serving engine (default); "eager": per-coordinate
-    # dataset rebuild + dispatch (the pre-engine path, kept for parity tests)
+    # "fused": the jit-cached serving engine (default, any mesh the
+    # capability probe accepts); "eager": per-coordinate dataset rebuild +
+    # dispatch (the pre-engine path, kept for parity tests)
     engine: str = "fused"
 
     def _serving_engine(self):
-        """The fused engine for this model, or None when configured eager /
-        on a 2-D feature-sharded mesh (eager-only territory). Memoized per
-        (model object, mesh): get_engine's content fingerprint hashes every
-        coefficient table, which must not run on each score() call."""
+        """The fused engine for this model, or None when configured eager or
+        when the engine's capability probe refuses the mesh. The probe
+        (``GameServingEngine.mesh_capable``) is THE owner of the fused-vs-
+        eager placement decision — 2-D training meshes serve fused with the
+        batch on the data axis since PR 10. Memoized per (model object,
+        mesh): get_engine's content fingerprint hashes every coefficient
+        table, which must not run on each score() call."""
         if self.engine != "fused":
             return None
-        if self.mesh is not None and len(self.mesh.axis_names) != 1:
+        from photon_ml_tpu.serving import GameServingEngine
+
+        if not GameServingEngine.mesh_capable(self.mesh):
+            from photon_ml_tpu.analysis.fallbacks import log_fallback_once
+
+            # stable, cheap description — never id()/content hashes: the
+            # once-per-cause dedup must survive model reloads (same logical
+            # model, fresh object) without hashing coefficient tables on a
+            # scoring path
+            coord_ids = ",".join(cid for cid, _ in self.model)
+            log_fallback_once(
+                "serving_engine",
+                f"model[{coord_ids}]",
+                f"mesh {self.mesh!r} refused by "
+                "GameServingEngine.mesh_capable: eager per-coordinate "
+                "scoring",
+            )
             return None
         key = (id(self.model), self.mesh)
         cached = getattr(self, "_engine_memo", None)

@@ -384,19 +384,37 @@ def test_get_engine_content_keyed_cache(rng):
     assert get_engine(m3) is not get_engine(m1)
 
 
-def test_engine_rejects_2d_mesh_and_transformer_falls_back(rng, eight_devices):
+def test_engine_serves_2d_mesh_fused_via_capability_probe(rng, eight_devices):
+    """PR 10: 2-D training meshes serve FUSED — tables replicate, batches
+    shard along the data axis; ``mesh_capable`` is the one owner of the
+    fused-vs-eager decision (no construction try/except anywhere)."""
     from photon_ml_tpu.parallel.feature_sharded import make_mesh2
+    from photon_ml_tpu.parallel.mesh import make_mesh
 
     mesh2 = make_mesh2(n_data=4, n_model=2)
+    assert GameServingEngine.mesh_capable(None)
+    assert GameServingEngine.mesh_capable(make_mesh(8))
+    assert GameServingEngine.mesh_capable(mesh2)
     model = GameModel(models={"fixed": fixed_model(rng)})
-    with pytest.raises(ValueError, match="1-D"):
-        GameServingEngine(model, mesh=mesh2)
-    # the transformer silently takes the eager path on a 2-D mesh
     data = glmix_input(rng, with_items=False)
     host = GameTransformer(model=model).score(data)
-    np.testing.assert_allclose(
-        GameTransformer(model=model, mesh=mesh2).score(data), host, atol=1e-10
-    )
+    t2 = GameTransformer(model=model, mesh=mesh2)
+    # the transformer picks the FUSED path through the probe
+    eng = t2._serving_engine()
+    assert eng is not None
+    # batch padding rounds to the BATCH axis (4), not the device count (8)
+    assert eng.bucket(5) == max(eng.min_batch_pad, 4)
+    np.testing.assert_array_equal(t2.score(data), host)
+
+    class _NotAMesh:
+        axis_names = ()
+
+    assert not GameServingEngine.mesh_capable(_NotAMesh())
+    with pytest.raises(ValueError, match="mesh_capable"):
+        GameServingEngine(model, mesh=_NotAMesh())
+    # the transformer falls back eagerly (once-logged) on an incapable mesh
+    t_bad = GameTransformer(model=model, mesh=_NotAMesh())
+    assert t_bad._serving_engine() is None
 
 
 # --------------------------------------------------------------------------

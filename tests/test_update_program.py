@@ -587,6 +587,354 @@ def test_active_set_without_warm_start_is_refused(rng):
         )
 
 
+# ------------------------------------------------- mesh-sharded update program
+#
+# PR 10: the SAME donated update program compiles as ONE SPMD module when the
+# dataset is mesh-placed — entity-sharded tables and bucket solves,
+# sample-sharded scores, donated state keeping its sharding across updates.
+# The honest parity contract (the PR 8 lesson: XLA re-vectorizes per LOCAL
+# shape, so cross-layout/cross-device-count comparisons are tolerance-only):
+# bitwise WITHIN a layout — sharded fused program vs sharded per-bucket loop,
+# and run to run — which transitively ties the mesh program to the host
+# reference through test_mesh_backend's host-vs-mesh tolerance gates.
+
+
+def build_mesh_coord(
+    workload,
+    *,
+    use_program=True,
+    normalization=None,
+    per_entity=None,
+    variance=VarianceComputationType.NONE,
+    precision=None,
+):
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.parallel.placement import (
+        pad_and_shard_vector,
+        place_random_effect_dataset,
+    )
+
+    X, X_re, users, y, _ = workload
+    re_ds = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", labels=y,
+        normalization=normalization,
+        intercept_index=0 if normalization is not None else None,
+    )
+    mesh = make_mesh(8)
+    ds_m = place_random_effect_dataset(re_ds, mesh)
+    base = pad_and_shard_vector(np.zeros(N), mesh, dtype=ds_m.sample_vals.dtype)
+    coord = RandomEffectCoordinate(
+        coordinate_id="per-user", dataset=ds_m,
+        task=TaskType.LOGISTIC_REGRESSION, configuration=CFG,
+        base_offsets=base,
+        normalization=normalization,
+        variance_computation=variance,
+        per_entity_reg_weights=per_entity,
+        use_update_program=use_program,
+        precision=precision,
+    )
+    return coord, ds_m, mesh
+
+
+def test_mesh_update_program_bitwise_parity_vs_per_bucket(rng, eight_devices):
+    """The sharded single-program update must train the SAME model as the
+    sharded per-bucket loop — bitwise coefficients, variances and scores over
+    multiple iterations, in the featureful configuration (normalization +
+    per-entity L2 + SIMPLE variances)."""
+    workload = make_workload(rng)
+    norm = workload[-1]
+    per_entity = {
+        int(e): float(v)
+        for e, v in enumerate(rng.uniform(0.4, 2.5, size=N_USERS))
+    }
+
+    def descend(use_program):
+        coord, _, _ = build_mesh_coord(
+            workload, use_program=use_program, normalization=norm,
+            per_entity=per_entity, variance=VarianceComputationType.SIMPLE,
+        )
+        return run_coordinate_descent(
+            {"per-user": coord}, n_iterations=3, defer_guard=use_program
+        )
+
+    s_new = descent_state(descend(True))
+    s_old = descent_state(descend(False))
+    assert set(s_new) == set(s_old)
+    for key in sorted(s_old):
+        assert s_new[key].dtype == s_old[key].dtype, key
+        np.testing.assert_array_equal(s_new[key], s_old[key], err_msg=key)
+
+
+def test_mesh_donated_updates_keep_sharding_and_consume_buffers(rng, eight_devices):
+    """Steady-state mesh updates donate the sharded table/score and the
+    outputs come back under the SAME shardings — no resharding between
+    updates (the with_sharding_constraint contract in solver_cache)."""
+    workload = make_workload(rng)
+    coord, ds_m, mesh = build_mesh_coord(workload)
+    n_pad = int(ds_m.sample_entity_rows.shape[0])
+    zeros = jax.device_put(
+        jnp.zeros(n_pad, dtype=ds_m.sample_vals.dtype),
+        coord.base_offsets.sharding,
+    )
+    m1, s1, _ = coord.update_and_score(None, zeros, zeros, donate=False)
+    assert m1.coeffs.sharding == ds_m.coeffs_sharding
+    assert m1.coeffs.shape == (ds_m.coeffs_rows, ds_m.max_k)
+    score_sharding = s1.sharding
+    m2, s2, _ = coord.update_and_score(
+        m1, jnp.zeros(n_pad, dtype=zeros.dtype), s1, donate=True
+    )
+    if _donation_supported():
+        assert m1.coeffs.is_deleted()
+        assert s1.is_deleted()
+    assert m2.coeffs.sharding == ds_m.coeffs_sharding
+    assert s2.sharding == score_sharding
+    # table padding rows (mesh divisibility) stay exactly zero
+    assert np.all(np.asarray(m2.coeffs)[ds_m.n_entities:] == 0.0)
+
+
+def test_mesh_external_warm_start_survives_donated_updates(rng, eight_devices):
+    """A caller-held host-layout warm-start model fed to a mesh coordinate is
+    padded + placed as a COPY: the foreign buffer survives the descent's
+    donation bit for bit."""
+    workload = make_workload(rng)
+    X, X_re, users, y, _ = workload
+    host_ds = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", labels=y
+    )
+    warm, _ = train_random_effect(
+        host_ds, TaskType.LOGISTIC_REGRESSION, CFG, jnp.zeros(N)
+    )
+    warm_bits = np.asarray(warm.coeffs).copy()
+    coord, _, _ = build_mesh_coord(workload)
+    result = run_coordinate_descent(
+        {"per-user": coord}, n_iterations=3,
+        initial_models={"per-user": warm},
+    )
+    assert not warm.coeffs.is_deleted()
+    np.testing.assert_array_equal(np.asarray(warm.coeffs), warm_bits)
+    out = result.model.get_model("per-user")
+    assert np.isfinite(np.asarray(out.coeffs)).all()
+
+
+def test_mesh_divergence_reject_keeps_sharded_table_bits(rng, eight_devices):
+    """The in-program reject on a mesh: a NaN-poisoned warm table's bits
+    (including the sharded padding rows) survive the rejected update, and the
+    incident is recorded."""
+    workload = make_workload(rng)
+    coord, ds_m, _ = build_mesh_coord(workload)
+    healthy, _ = train_random_effect(
+        ds_m, TaskType.LOGISTIC_REGRESSION, CFG, coord.base_offsets
+    )
+    bad = np.asarray(healthy.coeffs).copy()
+    bad[2, 0] = np.nan
+    warm = dataclasses.replace(healthy, coeffs=jnp.asarray(bad))
+    warm_score = np.asarray(coord.score(warm))
+
+    result = run_coordinate_descent(
+        {"per-user": coord}, n_iterations=2,
+        initial_models={"per-user": warm},
+    )
+    out = result.model.get_model("per-user")
+    np.testing.assert_array_equal(np.asarray(out.coeffs), bad)
+    np.testing.assert_array_equal(
+        np.asarray(result.training_scores["per-user"]), warm_score
+    )
+    assert out.coeffs.sharding == ds_m.coeffs_sharding
+    assert len(result.incidents) == 2
+    assert all(i.kind == "divergence" for i in result.incidents)
+
+
+def test_mesh_update_program_solves_are_data_collective_free(rng, eight_devices):
+    """The embarrassingly-parallel contract: the compiled SPMD update
+    program's solver while-loops contain ZERO data collectives — the only
+    in-loop communication is the scalar convergence-predicate all-reduce a
+    globally batched while_loop needs for termination consensus, whose count
+    must be NONZERO (a zero would mean the scan no longer sees the solver
+    loops at all — the vacuity failure mode). Everything around the loops
+    stays within the gather/scatter payload bounds."""
+    from photon_ml_tpu.parallel import hlo_guards
+
+    workload = make_workload(rng)
+    coord, ds_m, _ = build_mesh_coord(
+        workload, normalization=workload[-1],
+        variance=VarianceComputationType.SIMPLE,
+    )
+    hlo = coord.compiled_update_hlo()
+    in_loop = hlo_guards.loop_collectives(hlo)
+    predicates = hlo_guards.assert_entity_solves_collective_free(hlo)
+    assert predicates > 0  # the scan actually reached the solver loops
+    assert len(in_loop) == predicates  # every in-loop entry is a predicate
+    assert all(elements == 1 for _, _, elements in in_loop)
+    hlo_guards.assert_collective_profile(
+        hlo,
+        grad_elements=ds_m.max_k,
+        table_elements=(ds_m.coeffs_rows + 1) * ds_m.max_k,
+        n_samples=int(ds_m.sample_entity_rows.shape[0]),
+        bucket_block_elements=max(
+            b.n_entities * b.shape[0] for b in ds_m.buckets
+        ),
+        max_collectives=16 * len(ds_m.buckets),
+    )
+
+
+def test_loop_collective_scan_catches_real_in_loop_collective(eight_devices):
+    """Sanity for the guard above, against REAL compiled HLO (real while
+    bodies take a single TUPLE-typed parameter — a hand-written non-tuple
+    fixture once let the scan go vacuous): a carry-dependent reduction over
+    the sharded axis compiles a data all-reduce INSIDE the loop and must be
+    refused; the same reduction hoisted out of the loop (loop-invariant) is
+    legal."""
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from photon_ml_tpu.parallel import hlo_guards
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    x = jax.device_put(
+        jnp.arange(32.0).reshape(8, 4),
+        NamedSharding(mesh, PartitionSpec("data", None)),
+    )
+
+    def in_loop(x):
+        def body(c):
+            i, acc = c
+            # carry-dependent reduction over the SHARDED axis: the [4]
+            # all-reduce cannot be hoisted and runs per iteration
+            return i + 1, acc + jnp.sum(x * acc, axis=0)
+
+        return lax.while_loop(
+            lambda c: c[0] < 3, body, (0, jnp.ones(4, dtype=x.dtype))
+        )
+
+    hlo = jax.jit(in_loop).lower(x).compile().as_text()
+    entries = hlo_guards.loop_collectives(hlo)
+    assert any(elements > 1 for _, _, elements in entries)
+    with pytest.raises(AssertionError, match="while-loops"):
+        hlo_guards.assert_entity_solves_collective_free(hlo)
+
+    def hoisted(x):
+        s = jnp.sum(x, axis=0)  # loop-invariant: all-reduce sits outside
+
+        def body(c):
+            return c[0] + 1, c[1] + 1.0
+
+        i, acc = lax.while_loop(lambda c: c[0] < 3, body, (0, 0.0))
+        return acc + jnp.sum(s)
+
+    hlo2 = jax.jit(hoisted).lower(x).compile().as_text()
+    assert all(e == 1 for _, _, e in hlo_guards.loop_collectives(hlo2))
+    hlo_guards.assert_entity_solves_collective_free(hlo2)
+
+
+def test_mesh_active_set_delta_keeps_inactive_shards_bitwise(rng, eight_devices):
+    """Active-set delta updates on a mesh-sharded dataset (the PR 7 mesh
+    remnant): gathered sub-buckets re-place under the entity sharding, padding
+    lanes scatter out of bounds, and every inactive entity's shard content —
+    and the table's padding rows — keep the previous generation's bits."""
+    workload = make_workload(rng)
+    coord, ds_m, _ = build_mesh_coord(workload)
+    prev, _ = train_random_effect(
+        ds_m, TaskType.LOGISTIC_REGRESSION, CFG, coord.base_offsets
+    )
+    prev_bits = np.asarray(prev.coeffs).copy()
+    active = np.zeros(N_USERS, dtype=bool)
+    active[[0, 3, 7]] = True
+    result = run_coordinate_descent(
+        {"per-user": coord}, n_iterations=1,
+        initial_models={"per-user": prev},
+        active_sets={"per-user": active},
+    )
+    out = result.model.get_model("per-user")
+    new = np.asarray(out.coeffs)
+    # the deferred-guard select may normalize P('data', None) to the
+    # equivalent P('data'): compare placements, not spec spellings
+    assert out.coeffs.sharding.is_equivalent_to(
+        ds_m.coeffs_sharding, out.coeffs.ndim
+    )
+    stats = coord.last_active_stats
+    assert stats.n_active == 3
+    # sub-bucket lane counts are mesh multiples (8 devices)
+    assert stats.n_solved_lanes % 8 == 0
+    inactive = np.array([i for i in range(N_USERS) if not active[i]])
+    np.testing.assert_array_equal(new[inactive], prev_bits[inactive])
+    np.testing.assert_array_equal(new[N_USERS:], prev_bits[N_USERS:])
+    # the foreign warm table survives
+    assert not prev.coeffs.is_deleted()
+
+
+def test_mesh_lazy_tracker_excludes_padding_lanes(rng, eight_devices):
+    """Mesh-placed buckets carry padding lanes (entity_rows == E): the fused
+    path's lazily-materialized tracker must report the same per-entity stats
+    as the per-bucket mesh path, which filters rows < E."""
+    workload = make_workload(rng)
+    coord, ds_m, _ = build_mesh_coord(workload)
+    n_pad = int(ds_m.sample_entity_rows.shape[0])
+    zeros = jax.device_put(
+        jnp.zeros(n_pad, dtype=ds_m.sample_vals.dtype),
+        coord.base_offsets.sharding,
+    )
+    _, _, lazy = coord.update_and_score(None, zeros, zeros)
+    _, eager = train_random_effect(
+        ds_m, TaskType.LOGISTIC_REGRESSION, CFG, coord.base_offsets
+    )
+    # the placed buckets DO carry padding lanes at this shape
+    assert any(
+        (np.asarray(jax.device_get(b.entity_rows)) >= N_USERS).any()
+        for b in ds_m.buckets
+    )
+    assert lazy.n_entities == eager.n_entities == N_USERS
+    assert lazy.convergence_reason_counts == eager.convergence_reason_counts
+    assert lazy.iterations_mean == eager.iterations_mean
+    assert lazy.iterations_max == eager.iterations_max
+
+
+def test_mesh_reduced_precision_stores_sharded_tables(rng, eight_devices):
+    """Storage precision is orthogonal to placement: a bf16 policy on a
+    mesh-sharded dataset stores the donated table at bf16 UNDER the entity
+    sharding and still trains finite coefficients."""
+    workload = make_workload(rng)
+    coord, ds_m, _ = build_mesh_coord(workload, precision="bf16")
+    result = run_coordinate_descent({"per-user": coord}, n_iterations=2)
+    out = result.model.get_model("per-user")
+    assert out.coeffs.dtype == jnp.bfloat16
+    assert out.coeffs.sharding == ds_m.coeffs_sharding
+    assert np.isfinite(np.asarray(out.coeffs, dtype=np.float32)).all()
+
+
+def test_mesh_zero_retraces_across_descent_iterations(rng, eight_devices):
+    """Sharded steady state: after the warmup descent compiled the SPMD
+    programs, further same-shape iterations are pure jit-cache hits."""
+    workload = make_workload(rng)
+    coord, _, _ = build_mesh_coord(workload)
+    run_coordinate_descent({"per-user": coord}, n_iterations=1)
+    with no_retrace(what="mesh descent iterations 2..N"):
+        result = run_coordinate_descent({"per-user": coord}, n_iterations=3)
+    assert np.isfinite(
+        np.asarray(result.model.get_model("per-user").coeffs)
+    ).all()
+
+
+def test_per_bucket_fallback_logs_structured_reason_once(rng, caplog):
+    """use_update_program=False demotes to the per-bucket loop with ONE
+    structured warning per (dataset fingerprint, cause) — never silently,
+    never per update (analysis/fallbacks.py)."""
+    import logging
+
+    from photon_ml_tpu.analysis.fallbacks import reset_fallback_log
+
+    reset_fallback_log()
+    workload = make_workload(rng)
+    coord = build_coords(workload, use_program=False)["per-user"]
+    zeros = jnp.zeros(N, dtype=coord.dataset.sample_vals.dtype)
+    with caplog.at_level(logging.WARNING, logger="photon_ml_tpu.analysis.fallbacks"):
+        assert coord.update_and_score(None, zeros, zeros) is None
+        assert coord.update_and_score(None, zeros, zeros) is None
+    hits = [r for r in caplog.records if "slow path" in r.getMessage()]
+    assert len(hits) == 1
+    msg = hits[0].getMessage()
+    assert "use_update_program=False" in msg and "per-user" in msg
+
+
 def test_variance_delta_pass_refuses_varianceless_warm_start(rng):
     """With variance computation on, only active entities receive solved
     variances — a warm start that carries none would export variance 0.0
