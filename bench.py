@@ -866,6 +866,13 @@ def main():
         # zero-shed-below-knee, hot-swap-no-drop and rollback gates)
         _delegate_benchmark("--serving-load", "serving_load_bench")
 
+    if "--fleet" in sys.argv:
+        # OPEN-LOOP load through the multi-replica fleet tier (router +
+        # replica set + HTTP transport): fleet_sustained_qps_at_p999 with
+        # bitwise-parity, zero-retrace, rolling-rollout-no-drop,
+        # canary-reject and quota-distinctness gates
+        _delegate_benchmark("--fleet", "fleet_bench")
+
     if "--continuous" in sys.argv:
         # continuous-training delta pass vs full retrain (active-set-fraction,
         # delta-proportionality, quality-parity and bounded-retrace gates)

@@ -1,0 +1,435 @@
+"""Fleet benchmark: OPEN-LOOP load through the multi-replica serving tier.
+
+Metric: ``fleet_sustained_qps_at_p999`` — the highest fixed arrival rate the
+fleet (photon_ml_tpu/serving/fleet.py: ModelRouter + ReplicaSet) sustains
+with p999 latency inside the budget and ZERO sheds/errors, measured by an
+open-loop generator.
+
+Why open loop: a closed-loop client (benchmarks/serving_load_bench.py)
+submits its next request only after the previous one returns, so whenever the
+server stalls the client *stops offering load* — every queued-behind-a-stall
+request silently disappears from the latency sample (coordinated omission),
+and the reported p999 can look clean at rates the fleet cannot actually
+sustain. The open-loop generator fixes arrivals on a seeded schedule
+(request i is DUE at ``t0 + i/rate`` no matter what the fleet is doing) and
+measures every latency **from the intended send time**, so a stall shows up
+as tail latency in exactly the requests it delayed. The knee the closed-loop
+ladder cannot see is the point of this bench (docs/PERFORMANCE.md
+"Open-loop fleet load").
+
+The run is gated, not just measured:
+
+- ``parity_bitwise`` — every served response (all rate levels, all phases)
+  is BITWISE what a direct engine call for the generation that served it
+  returns.
+- ``retraces_steady_state == 0`` — measured rate levels run under
+  ``runtime_guard.sync_discipline`` after warm-up.
+- ``rollout_*`` — a replica-at-a-time rolling hot-swap performed MID-LOAD
+  completes with zero dropped/shed/mis-scored responses, traffic observed on
+  BOTH generations, and the fleet converged on the new one.
+- ``canary_reject_proven`` — a generation with NaN-poisoned coefficients but
+  VALID checksums (the trainer-bug class integrity verification cannot
+  catch) is rejected by the canary gate: blacklisted, fleet stays on the
+  incumbent, traffic uninterrupted.
+- ``integrity_reject_proven`` — a checksum-corrupt generation is rejected at
+  verify, before any flip.
+- ``transport_parity_bitwise`` — requests through the real HTTP endpoint
+  (serving/transport.py) decode bitwise-equal to direct engine calls.
+- ``quota_distinct`` — tenant-quota sheds raise ``QuotaExceeded`` and are
+  counted apart from overload.
+
+Run directly (``python benchmarks/fleet_bench.py``) or as
+``python bench.py --fleet``. Prints ONE JSON line; exits nonzero when any
+gate fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from serving_load_bench import build_models, build_request_pool, make_request
+
+D_RE = 8
+
+
+# ------------------------------------------------------------ open-loop core
+
+
+@dataclasses.dataclass
+class _OpenLoopRecord:
+    idx: int
+    intended: float  # the SCHEDULED send time (latency denominator)
+    fut: object = None
+    done_at: float = None
+    shed: str = None
+    error: str = None
+
+
+def run_open_loop(submit, requests, rate_qps: float, n_requests: int,
+                  result_timeout: float = 120.0):
+    """Fixed-rate arrivals: request i is due at ``t0 + i/rate``; the
+    generator sleeps until each due time and submits WITHOUT waiting for
+    completions (futures resolve on the dispatcher threads; completion
+    timestamps come from done-callbacks, so collector scheduling cannot
+    inflate latency). If submission itself falls behind schedule, the lag is
+    part of the measured latency — open-loop honesty; ``max_send_lag_ms``
+    reports it."""
+    from photon_ml_tpu.serving import DeadlineExceeded, Overloaded, QuotaExceeded
+
+    recs = [
+        _OpenLoopRecord(idx=i % len(requests), intended=0.0)
+        for i in range(n_requests)
+    ]
+    t0 = time.perf_counter() + 0.02
+    max_lag = 0.0
+    for i, rec in enumerate(recs):
+        rec.intended = t0 + i / rate_qps
+        while True:
+            now = time.perf_counter()
+            if now >= rec.intended:
+                break
+            time.sleep(min(rec.intended - now, 0.002))
+        max_lag = max(max_lag, time.perf_counter() - rec.intended)
+        try:
+            fut = submit(requests[rec.idx])
+        except (Overloaded, DeadlineExceeded, QuotaExceeded) as e:
+            rec.shed = type(e).__name__
+            continue
+        except BaseException as e:  # noqa: BLE001 — a gate failure, not a crash
+            rec.error = f"{type(e).__name__}: {e}"[:200]
+            continue
+        rec.fut = fut
+        fut.add_done_callback(
+            lambda _f, r=rec: setattr(r, "done_at", time.perf_counter())
+        )
+    served, sheds, errors, latencies = [], 0, [], []
+    for rec in recs:
+        if rec.shed is not None:
+            sheds += 1
+            continue
+        if rec.error is not None:
+            errors.append(rec.error)
+            continue
+        try:
+            out = rec.fut.result(timeout=result_timeout)
+        except (Overloaded, DeadlineExceeded, QuotaExceeded):
+            sheds += 1
+            continue
+        except BaseException as e:  # noqa: BLE001
+            errors.append(f"{type(e).__name__}: {e}"[:200])
+            continue
+        # result() can wake between the future's event set and its callbacks
+        # running (the dispatcher sets the event first); the stamp is
+        # microseconds behind at worst — wait it out, never crash on the race
+        wait_until = time.perf_counter() + 5.0
+        while rec.done_at is None and time.perf_counter() < wait_until:
+            time.sleep(0.0005)
+        if rec.done_at is None:
+            errors.append(f"request {rec.idx}: completion stamp never arrived")
+            continue
+        latencies.append(rec.done_at - rec.intended)
+        served.append((rec.idx, out, rec.fut.generation))
+    elapsed = max(time.perf_counter() - t0, 1e-9)
+    lat_ms = np.asarray(latencies or [0.0]) * 1e3
+    return {
+        "offered_qps": rate_qps,
+        "achieved_qps": round(len(served) / elapsed, 2),
+        "served": len(served),
+        "sheds": sheds,
+        "errors": errors,
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        "p999_ms": round(float(np.percentile(lat_ms, 99.9)), 3),
+        "max_send_lag_ms": round(max_lag * 1e3, 3),
+    }, served
+
+
+def check_parity(served, requests, engines_by_gen) -> bool:
+    for idx, out, gen in served:
+        eng = engines_by_gen.get(gen)
+        if eng is None:
+            return False
+        direct = eng.score(requests[idx])
+        if direct.dtype != out.dtype or not np.array_equal(direct, out):
+            return False
+    return True
+
+
+def poison_models(models: dict):
+    """NaN-poison every fixed-effect coefficient: the committed checkpoint
+    passes every SHA-256 check (the trainer really wrote these bytes) and can
+    only be caught by the canary's live-score health gate."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.models.game import FixedEffectModel
+    from photon_ml_tpu.models.glm import Coefficients
+
+    out = dict(models)
+    for cid, m in models.items():
+        if isinstance(m, FixedEffectModel):
+            glm = m.model
+            out[cid] = dc.replace(
+                m,
+                model=type(glm)(
+                    Coefficients(means=jnp.full_like(glm.coefficients.means, jnp.nan))
+                ),
+            )
+    return out
+
+
+# -------------------------------------------------------------------- bench
+
+
+def run(args) -> dict:
+    import jax
+
+    from photon_ml_tpu.analysis.runtime_guard import sync_discipline
+    from photon_ml_tpu.io.checkpoint import save_checkpoint
+    from photon_ml_tpu.resilience import corrupt_file
+    from photon_ml_tpu.serving import (
+        FleetClient,
+        FleetHTTPServer,
+        FrontendConfig,
+        ModelRouter,
+        QuotaExceeded,
+        ReplicaSet,
+        TenantQuota,
+    )
+
+    rng = np.random.default_rng(20260804)
+    n_users = max(1, int(200 * args.scale))
+    n_items = max(1, int(50 * args.scale))
+    batch = max(8, int(args.batch * args.scale))
+
+    ckpt_root = tempfile.mkdtemp(prefix="fleet-bench-ckpt-")
+    save_checkpoint(ckpt_root, build_models(rng, n_users, n_items, scale=1.0), 1,
+                    keep_generations=8)
+    config = FrontendConfig(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        max_queue_depth=args.queue_depth,
+        default_deadline_ms=None,
+    )
+    replica_set = ReplicaSet.from_checkpoint(
+        ckpt_root, n_replicas=args.replicas, name="main", config=config
+    )
+    router = ModelRouter()
+    router.add_model("main", replica_set)
+    engines_by_gen = {1: replica_set.replicas[0].engine}
+    requests = build_request_pool(rng, args.pool, batch, n_users, n_items)
+    submit = lambda req: router.submit("main", req, deadline_ms=args.deadline_ms)  # noqa: E731
+
+    # ---- warm-up: compile every coalescible bucket, prime live shapes ----
+    engine = replica_set.replicas[0].engine
+    b = engine.bucket(batch)
+    ladder = []
+    while b <= engine.bucket(args.max_batch):
+        ladder.append(b)
+        engine.score(make_request(rng, b, n_users, n_items))
+        b *= 2
+    warm_stats, warm_served = run_open_loop(
+        submit, requests, rate_qps=max(args.rate_base / 2, 1.0),
+        n_requests=4 * args.replicas,
+    )
+
+    # ---- open-loop rate ladder under the runtime guard -------------------
+    level_results = []
+    retraces = 0
+    all_served = list(warm_served)
+    rate = float(args.rate_base)
+    for _ in range(args.rate_levels):
+        with sync_discipline(what=f"fleet open loop @{rate:g} qps") as region:
+            stats, served = run_open_loop(
+                submit, requests, rate_qps=rate, n_requests=args.requests_per_level
+            )
+        retraces += region.traces
+        level_results.append(stats)
+        all_served.extend(served)
+        rate *= 2.0
+    sustained = [
+        lv for lv in level_results
+        if lv["sheds"] == 0 and not lv["errors"] and lv["p999_ms"] <= args.p999_budget_ms
+    ]
+    peak = max(sustained, key=lambda lv: lv["achieved_qps"]) if sustained else None
+
+    # ---- mid-load rolling rollout: canary -> remainder, zero dropped -----
+    save_checkpoint(ckpt_root, build_models(rng, n_users, n_items, scale=1.7), 2,
+                    keep_generations=8)
+    rollout_served = []
+    rollout_stats_box = {}
+    stop = threading.Event()
+
+    def rollout_traffic():
+        stats, served = run_open_loop(
+            submit, requests, rate_qps=max(args.rate_base, 4.0),
+            n_requests=args.rollout_requests,
+        )
+        rollout_stats_box.update(stats)
+        rollout_served.extend(served)
+        stop.set()
+
+    loader = threading.Thread(target=rollout_traffic)
+    loader.start()
+    time.sleep(0.05)  # traffic first, so the stream spans the roll
+    rolled = replica_set.check_once()
+    loader.join(180.0)
+    engines_by_gen[2] = replica_set.replicas[0].engine
+    all_served.extend(rollout_served)
+    rollout_generations = sorted({g for _, _, g in rollout_served})
+    rollout_zero_dropped = (
+        not rollout_stats_box.get("errors") and rollout_stats_box.get("sheds") == 0
+    )
+    rollout_parity = check_parity(rollout_served, requests, engines_by_gen)
+
+    # ---- canary rejection: NaN-poisoned generation with VALID checksums --
+    save_checkpoint(
+        ckpt_root, poison_models(build_models(rng, n_users, n_items, scale=0.5)), 3,
+        keep_generations=8,
+    )
+    canary_rejected = not replica_set.check_once()
+    post = router.score("main", requests[0], timeout=60.0)
+    canary_reject_proven = (
+        canary_rejected
+        and replica_set.bad_generations >= {3}
+        and replica_set.generations == [2] * args.replicas
+        and any(i.kind == "canary-reject" for i in replica_set.incidents)
+        and np.array_equal(post, engines_by_gen[2].score(requests[0]))
+    )
+
+    # ---- integrity rejection: checksum-corrupt generation ----------------
+    import os
+
+    gen4 = save_checkpoint(
+        ckpt_root, build_models(rng, n_users, n_items, scale=0.25), 4,
+        keep_generations=8,
+    )
+    victim = sorted(f for f in os.listdir(gen4) if f.endswith(".npz"))[0]
+    corrupt_file(os.path.join(gen4, victim))
+    integrity_rejected = not replica_set.check_once()
+    integrity_reject_proven = (
+        integrity_rejected
+        and replica_set.generations == [2] * args.replicas
+        and any(i.kind == "fleet-rollback" for i in replica_set.incidents)
+    )
+
+    # ---- HTTP transport smoke: bitwise through the real wire -------------
+    router.add_model(
+        "metered",
+        replica_set,
+        tenant_quotas={"capped": TenantQuota(rate=0.0, burst=2.0)},
+    )
+    transport_parity = True
+    quota_sheds_http = 0
+    with FleetHTTPServer(router, port=0) as srv:
+        client = FleetClient(srv.host, srv.port)
+        for idx in (0, 1, 2):
+            out, gen = client.score("main", requests[idx])
+            direct = engines_by_gen[gen].score(requests[idx])
+            if out.dtype != direct.dtype or not np.array_equal(out, direct):
+                transport_parity = False
+        for _ in range(4):  # burst 2, rate 0: exactly 2 admit, 2 shed as 429
+            try:
+                client.score("metered", requests[0], tenant="capped")
+            except QuotaExceeded:
+                quota_sheds_http += 1
+    router_stats = router.stats()
+    quota_distinct = (
+        quota_sheds_http == 2
+        and router_stats.get("shed_quota", 0) == 2
+        and sum(1 for i in router.incidents if i.kind == "quota-shed") == 2
+        and not any(i.kind == "overload" for i in router.incidents)
+    )
+
+    parity = check_parity(all_served, requests, engines_by_gen)
+    router.close()
+
+    result = {
+        "metric": "fleet_sustained_qps_at_p999",
+        "value": peak["achieved_qps"] if peak else None,
+        "unit": "requests/sec",
+        "sustained_offered_qps": peak["offered_qps"] if peak else None,
+        "p999_budget_ms": args.p999_budget_ms,
+        "replicas": args.replicas,
+        "levels": level_results,
+        "request_bucket": batch,
+        "coalesce_buckets": ladder,
+        "parity_bitwise": bool(parity),
+        "retraces_steady_state": int(retraces),
+        "rollout_completed": bool(rolled),
+        "rollout_zero_dropped": bool(rollout_zero_dropped),
+        "rollout_parity_bitwise": bool(rollout_parity),
+        "rollout_generations_served": rollout_generations,
+        "rollout_spans_generations": (not rolled) or len(rollout_generations) >= 2,
+        "fleet_converged_on": replica_set.generations,
+        "canary_reject_proven": bool(canary_reject_proven),
+        "integrity_reject_proven": bool(integrity_reject_proven),
+        "transport_parity_bitwise": bool(transport_parity),
+        "quota_distinct": bool(quota_distinct),
+        "fleet_stats": {
+            k: v for k, v in replica_set.stats().items() if k != "replicas"
+        },
+        "platform": jax.default_backend(),
+    }
+    if args.scale != 1.0:
+        result["scale"] = args.scale
+    return result
+
+
+def gates_green(result: dict) -> bool:
+    return bool(
+        result["value"] is not None
+        and result["parity_bitwise"]
+        and result["retraces_steady_state"] == 0
+        and result["rollout_completed"]
+        and result["rollout_zero_dropped"]
+        and result["rollout_parity_bitwise"]
+        and result["rollout_spans_generations"]
+        and result["canary_reject_proven"]
+        and result["integrity_reject_proven"]
+        and result["transport_parity_bitwise"]
+        and result["quota_distinct"]
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--replicas", type=int, default=2,
+                   help="replica count behind the router")
+    p.add_argument("--rate-base", type=float, default=20.0,
+                   help="open-loop ladder base arrival rate (doubles per level)")
+    p.add_argument("--rate-levels", type=int, default=4)
+    p.add_argument("--requests-per-level", type=int, default=80)
+    p.add_argument("--rollout-requests", type=int, default=60,
+                   help="open-loop requests spanning the mid-load rolling swap")
+    p.add_argument("--p999-budget-ms", type=float, default=1500.0,
+                   help="a rate level is sustained only when its open-loop "
+                        "p999 (from INTENDED send time) fits this budget")
+    p.add_argument("--batch", type=int, default=32,
+                   help="request-size bucket ceiling (sizes jitter in (b/2, b])")
+    p.add_argument("--max-batch", type=int, default=256)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--deadline-ms", type=float, default=None)
+    p.add_argument("--queue-depth", type=int, default=512)
+    p.add_argument("--pool", type=int, default=16,
+                   help="distinct pre-generated requests cycled by the schedule")
+    p.add_argument("--scale", type=float, default=1.0)
+    args = p.parse_args(argv)
+    if args.rate_levels < 1 or args.requests_per_level < 1:
+        p.error("--rate-levels and --requests-per-level must be >= 1")
+    result = run(args)
+    print(json.dumps(result))
+    return 0 if gates_green(result) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

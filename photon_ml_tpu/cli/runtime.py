@@ -76,6 +76,20 @@ def add_serving_arguments(parser) -> None:
         "--hot-swap-poll-seconds", type=float, default=2.0,
         help="Generation watcher poll interval for --hot-swap-watch",
     )
+    parser.add_argument(
+        "--fleet-replicas", type=int, default=0,
+        help="Serve through a ReplicaSet of this many replicas behind the "
+             "ModelRouter instead of one frontend (serving/fleet.py): "
+             "round-robin routing with overload failover, and hot-swap "
+             "becomes replica-at-a-time with a canary gate (0 = single-"
+             "frontend mode, the default)",
+    )
+    parser.add_argument(
+        "--fleet-http-port", type=int, default=None,
+        help="With --fleet-replicas: also expose the fleet over HTTP on this "
+             "port while replaying (serving/transport.py; 0 = an ephemeral "
+             "port, reported in the stats JSON as http_endpoint)",
+    )
 
 
 def add_distributed_arguments(parser, purpose: str) -> None:

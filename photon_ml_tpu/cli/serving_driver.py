@@ -9,11 +9,18 @@ it in request-sized chunks — the operational smoke test for the serving
 path: micro-batching, deadline shedding, and (with ``--hot-swap-watch``)
 zero-downtime generational hot-swap while requests are in flight.
 
+With ``--fleet-replicas N`` the replay runs through the serving FLEET tier
+instead (serving/fleet.py): N replicas behind the ModelRouter with
+round-robin + overload failover, hot-swap upgraded to replica-at-a-time
+rolling rollout with a canary gate, and (``--fleet-http-port``) the HTTP
+transport (serving/transport.py) listening while the replay runs.
+
 Scores land as ScoringResultAvro part files (same format as the batch
-scoring driver); a JSON stats line (QPS, p50/p99 latency, sheds, swaps,
-serving generation(s)) goes to the log and the returned dict. Shed requests
-(deadline/overload) keep their rows in the output as NaN — sheds are
-explicit, never silently missing rows.
+scoring driver); a JSON stats line (QPS, p50/p99 latency, sheds broken out
+by cause — overload vs deadline vs quota vs shutdown — per-generation
+served-request counts, swaps, serving generation(s)) goes to the log and the
+returned dict. Shed requests (deadline/overload/quota) keep their rows in
+the output as NaN — sheds are explicit, never silently missing rows.
 """
 
 from __future__ import annotations
@@ -80,7 +87,8 @@ def run(args: argparse.Namespace) -> dict:
     root = args.root_output_directory
     prepare_output_root(root, args.override_output_directory, 0, 1)
     logger = PhotonLogger(os.path.join(root, "logs", "photon.log"), level=args.log_level)
-    frontend = watcher = None
+    frontend = watcher = router = http_server = None
+    fleet_mode = int(getattr(args, "fleet_replicas", 0) or 0) > 0
     try:
         shard_configs = dict(
             parse_feature_shard_configuration(a)
@@ -101,15 +109,36 @@ def run(args: argparse.Namespace) -> dict:
             max_queue_depth=args.serving_queue_depth,
             default_deadline_ms=args.serving_deadline_ms,
         )
-        with Timed("load newest generation", logger):
-            frontend, manager = serve_from_checkpoint(
-                args.checkpoint_directory, config=config
+        model_name = args.model_id or "default"
+        if fleet_mode:
+            from photon_ml_tpu.serving import ModelRouter, ReplicaSet
+
+            with Timed("load newest generation", logger):
+                replica_set = ReplicaSet.from_checkpoint(
+                    args.checkpoint_directory,
+                    n_replicas=args.fleet_replicas,
+                    name=model_name,
+                    config=config,
+                )
+            router = ModelRouter()
+            router.add_model(model_name, replica_set)
+            manager = replica_set  # GenerationWatcher duck type (check_once)
+            engine = replica_set.replicas[0].engine
+            logger.info(
+                "serving generations %s across %d replicas",
+                replica_set.generations, args.fleet_replicas,
             )
-        logger.info("serving generation %d", frontend.generation)
+        else:
+            with Timed("load newest generation", logger):
+                frontend, manager = serve_from_checkpoint(
+                    args.checkpoint_directory, config=config
+                )
+            engine = frontend.engine
+            logger.info("serving generation %d", frontend.generation)
         id_tags = sorted(
             {
                 m.re_type
-                for _, m in frontend.engine.model
+                for _, m in engine.model
                 if isinstance(m, RandomEffectModel)
             }
         )
@@ -131,30 +160,94 @@ def run(args: argparse.Namespace) -> dict:
                 manager, poll_interval_s=args.hot_swap_poll_seconds
             )
 
-        scores, stats = _replay(frontend, data, args, logger)
+        if fleet_mode:
+            if getattr(args, "fleet_http_port", None) is not None:
+                from photon_ml_tpu.serving import FleetHTTPServer
+
+                http_server = FleetHTTPServer(
+                    router, port=args.fleet_http_port
+                ).start()
+                logger.info(
+                    "fleet HTTP endpoint listening on %s:%d",
+                    http_server.host, http_server.port,
+                )
+            submit = lambda req: router.submit(model_name, req)  # noqa: E731
+            stats_fn = router.stats
+            incidents = lambda: (  # noqa: E731
+                router.incidents
+                + router.replica_set(model_name).incidents
+                + [
+                    i
+                    for r in router.replica_set(model_name).replicas
+                    for i in r.frontend.incidents
+                ]
+            )
+        else:
+            submit = frontend.submit
+            stats_fn = frontend.stats
+            incidents = lambda: frontend.incidents  # noqa: E731
+
+        scores, stats = _replay(submit, stats_fn, data, args, logger)
+        if http_server is not None:
+            stats["http_endpoint"] = f"{http_server.host}:{http_server.port}"
+        stats["output_directory"] = root
+        stats["incidents"] = [i.to_dict() for i in incidents()]
         with Timed("write scores", logger):
             _write_scores(
                 os.path.join(root, "scores", "part-00000.avro"),
                 uids, scores, data, args.model_id or "",
             )
-        stats["output_directory"] = root
-        stats["incidents"] = [i.to_dict() for i in frontend.incidents]
         logger.info("serving stats: %s", json.dumps(stats))
         return {"scores": scores, "stats": stats, "output_directory": root}
     finally:
         if watcher is not None:
             watcher.stop()
+        if http_server is not None:
+            http_server.close()
         if frontend is not None:
             frontend.close()
+        if router is not None:
+            router.close()
         logger.close()
 
 
-def _replay(frontend, data, args, logger) -> tuple[np.ndarray, dict]:
+def _sheds_by_cause(stats: dict) -> dict:
+    """The dashboard breakout: shed counts by CAUSE (overload vs deadline vs
+    quota vs shutdown) summed over the frontend — or, in fleet mode, the
+    router level plus every model's replica-set aggregate (whose shed_* keys
+    already sum their replicas, so the nested per-replica dicts are not
+    walked again)."""
+    causes = {"overload": 0, "deadline": 0, "quota": 0, "shutdown": 0}
+
+    def add(d: dict) -> None:
+        causes["overload"] += int(d.get("shed_overload", 0))
+        causes["deadline"] += int(d.get("shed_deadline", 0))
+        causes["quota"] += int(d.get("shed_quota", 0))
+        causes["shutdown"] += int(d.get("shed_shutdown", 0))
+
+    add(stats)
+    for model_stats in (stats.get("models") or {}).values():
+        add(model_stats)
+    return causes
+
+
+def _served_by_generation(stats: dict) -> dict:
+    """Merged per-generation served-request counts across the frontend (or
+    every model's replica-set aggregate in fleet mode)."""
+    out: collections.Counter = collections.Counter()
+    for d in [stats, *list((stats.get("models") or {}).values())]:
+        for g, c in (d.get("served_by_generation") or {}).items():
+            out[int(g)] += int(c)
+    return {g: int(c) for g, c in sorted(out.items())}
+
+
+def _replay(submit, stats_fn, data, args, logger) -> tuple[np.ndarray, dict]:
     """Windowed closed-loop replay: chunk the table into request-sized
     GameInputs, keep a bounded window of futures outstanding (so the replay
     itself cannot overload the queue it is testing), and reassemble scores in
-    row order. Shed chunks stay NaN."""
-    from photon_ml_tpu.serving import DeadlineExceeded, Overloaded
+    row order. Shed chunks stay NaN. ``submit`` is either a frontend's or the
+    fleet router's; ``stats_fn`` the matching stats provider."""
+    from photon_ml_tpu.serving import DeadlineExceeded, Overloaded, QuotaExceeded
 
     n = data.n
     chunk = max(1, int(args.serving_request_batch))
@@ -170,7 +263,7 @@ def _replay(frontend, data, args, logger) -> tuple[np.ndarray, dict]:
         start, stop, fut, t0 = window.popleft()
         try:
             out = fut.result(timeout=300.0)
-        except (Overloaded, DeadlineExceeded) as e:
+        except (Overloaded, DeadlineExceeded, QuotaExceeded) as e:
             shed += 1
             logger.warning("request rows [%d, %d) shed: %s", start, stop, e)
             return
@@ -188,8 +281,8 @@ def _replay(frontend, data, args, logger) -> tuple[np.ndarray, dict]:
         try:
             # the deadline rides on FrontendConfig.default_deadline_ms (run()
             # wired --serving-deadline-ms there); one authoritative path
-            fut = frontend.submit(req)
-        except (Overloaded, DeadlineExceeded) as e:
+            fut = submit(req)
+        except (Overloaded, DeadlineExceeded, QuotaExceeded) as e:
             shed += 1
             logger.warning("request rows [%d, %d) shed at admission: %s", start, stop, e)
             continue
@@ -209,8 +302,10 @@ def _replay(frontend, data, args, logger) -> tuple[np.ndarray, dict]:
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
         "generations_served": sorted(g for g in generations if g is not None),
-        **frontend.stats(),
+        **stats_fn(),
     }
+    stats["sheds_by_cause"] = _sheds_by_cause(stats)
+    stats["served_by_generation"] = _served_by_generation(stats)
     return scores, stats
 
 

@@ -190,16 +190,10 @@ class GameEstimator:
                 )
             # a mesh is fine: storage dtype is orthogonal to placement — the
             # sharded update program stores its entity-sharded tables/blocks
-            # reduced exactly like the host path does
-            if self.checkpoint_directory is not None:
-                # np.save round-trips bfloat16/float16 as raw void dtypes
-                # (|V2): a resumed run would silently reinterpret the table
-                # bytes. Refuse loudly instead of corrupting on restore.
-                raise ValueError(
-                    "re_precision cannot be combined with "
-                    "checkpoint_directory: numpy checkpoint artifacts do not "
-                    "round-trip reduced dtypes"
-                )
+            # reduced exactly like the host path does. Checkpointing is fine
+            # too: io/checkpoint.py encodes reduced dtypes as uint16 bit
+            # patterns with self-describing markers, so a bf16 deployment's
+            # generations round-trip bit-exactly across restart.
         if self.re_storage_dtype is not None and not self.fused_pass:
             # only the fused pass consumes it (build_sharded_game_data);
             # accepting it elsewhere would be a silent no-op
@@ -500,6 +494,10 @@ class GameEstimator:
                     # into a direct-solver run (or vice versa) would produce
                     # a model that is neither path's contract
                     f"re_solver={self.re_solver}",
+                    # storage-precision identity, same stale-restore class: a
+                    # bf16-trained checkpoint must not warm-start an f32 run
+                    # (or vice versa) pretending nothing changed
+                    f"re_precision={self.re_precision.name}",
                 ]
                 for cid in sorted(self.coordinate_configurations):
                     fp_parts.append(f"{cid}={opt_configs[cid]!r}")

@@ -88,6 +88,48 @@ class CheckpointCorruption(Exception):
     load_checkpoint converts it into quarantine + rollback, never raises it)."""
 
 
+# ---------------------------------------------------- reduced-dtype encoding
+# np.save writes ml_dtypes arrays (bfloat16) as raw |V2 void: loading one back
+# silently reinterprets the table bytes. Every .npz this module writes goes
+# through _encode_arrays, which stores such arrays as their uint16 bit
+# patterns next to a self-describing "__dtype__<name>" marker, so a bf16
+# deployment's generational checkpoints round-trip BIT-EXACTLY and fleet
+# replicas can load them. Native dtypes (incl. float16) pass through
+# untouched — the marker only exists where np.save would lie.
+
+_DTYPE_MARKER = "__dtype__"
+_BITS_ENCODED_DTYPES = ("bfloat16",)
+
+
+def _encode_arrays(arrays: dict) -> dict:
+    out = {}
+    for name, arr in arrays.items():
+        arr = np.asarray(arr)
+        if str(arr.dtype) in _BITS_ENCODED_DTYPES:
+            out[name] = arr.view(np.uint16)
+            out[_DTYPE_MARKER + name] = np.asarray(str(arr.dtype))
+        else:
+            out[name] = arr
+    return out
+
+
+def _decode_arrays(arrays: dict) -> dict:
+    out = {k: v for k, v in arrays.items() if not k.startswith(_DTYPE_MARKER)}
+    for key, marker in arrays.items():
+        if not key.startswith(_DTYPE_MARKER):
+            continue
+        name, dt = key[len(_DTYPE_MARKER):], str(marker)
+        if dt not in _BITS_ENCODED_DTYPES:
+            raise ValueError(f"unknown encoded dtype {dt!r} for artifact array {name!r}")
+        out[name] = out[name].view(np.dtype(dt))  # ml_dtypes registers the name
+    return out
+
+
+def _load_npz(path: str) -> dict:
+    with np.load(path, allow_pickle=False) as z:
+        return _decode_arrays({k: z[k] for k in z.files})
+
+
 # ------------------------------------------------------------- model <-> arrays
 
 
@@ -219,7 +261,7 @@ def _write_models(directory: str, subdir: str, models: dict, manifest: dict,
         rel = os.path.join(subdir, f"{cid}.npz") if subdir else f"{cid}.npz"
         path = os.path.join(directory, rel)
         action = faultpoint(FP_WRITE_ARRAYS)
-        np.savez(path, **arrays)
+        np.savez(path, **_encode_arrays(arrays))
         checksums[rel] = _sha256_file(path)
         if action == "corrupt":
             # simulated bit-rot: damage lands AFTER the checksum is recorded,
@@ -230,8 +272,7 @@ def _write_models(directory: str, subdir: str, models: dict, manifest: dict,
 def _read_models(directory: str, manifest: dict, dtype) -> dict:
     models = {}
     for cid, meta in manifest.items():
-        with np.load(os.path.join(directory, f"{cid}.npz"), allow_pickle=False) as z:
-            arrays = {k: z[k] for k in z.files}
+        arrays = _load_npz(os.path.join(directory, f"{cid}.npz"))
         models[cid] = _model_from_arrays(meta, arrays, dtype)
     return models
 
@@ -298,7 +339,9 @@ def load_generation(gen_dir: str, dtype=jnp.float32) -> dict:
     """Verify + load ONE specific generation directory (as returned by
     :func:`list_generations`): full SHA-256 integrity pass, then
     {completed_iterations, models, best_models, best_metric, best_metrics,
-    incidents, generation, fingerprint}.
+    incidents, generation, fingerprint}. ``dtype=None`` keeps every stored
+    coefficient dtype (a bf16 deployment's tables load back as bf16,
+    bit-exact); the default casts to float32 as before.
 
     Raises :class:`CheckpointCorruption` on any defect and touches nothing on
     disk — the caller decides whether to fall back to an older generation
@@ -396,7 +439,7 @@ def save_checkpoint(
                 rel = os.path.join(AUX_DIR, f"{name}.npz")
                 path = os.path.join(tmp, rel)
                 action = faultpoint(FP_WRITE_ARRAYS)
-                np.savez(path, **aux_arrays[name])
+                np.savez(path, **_encode_arrays(aux_arrays[name]))
                 state["checksums"][rel] = _sha256_file(path)
                 if action == "corrupt":
                     corrupt_file(path)
@@ -468,10 +511,7 @@ def _verify_and_load_generation(gen_dir: str, dtype) -> dict:
             )
         aux = {}
         for name in state.get("aux") or []:
-            with np.load(
-                os.path.join(gen_dir, AUX_DIR, f"{name}.npz"), allow_pickle=False
-            ) as z:
-                aux[name] = {k: z[k] for k in z.files}
+            aux[name] = _load_npz(os.path.join(gen_dir, AUX_DIR, f"{name}.npz"))
     except Exception as e:  # torn .npz, bad metadata, dtype surprises ...
         raise CheckpointCorruption(f"unreadable model arrays: {e}") from e
 

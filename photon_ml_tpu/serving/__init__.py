@@ -5,6 +5,15 @@ from photon_ml_tpu.serving.engine import (
     get_engine,
     model_fingerprint,
 )
+from photon_ml_tpu.serving.fleet import (
+    CanaryMismatch,
+    ModelRouter,
+    QuotaExceeded,
+    Replica,
+    ReplicaSet,
+    TenantQuota,
+    TokenBucket,
+)
 from photon_ml_tpu.serving.frontend import (
     DeadlineExceeded,
     FrontendConfig,
@@ -17,17 +26,34 @@ from photon_ml_tpu.serving.hotswap import (
     HotSwapManager,
     serve_from_checkpoint,
 )
+from photon_ml_tpu.serving.transport import (
+    FleetClient,
+    FleetHTTPServer,
+    decode_game_input,
+    encode_game_input,
+)
 
 __all__ = [
+    "CanaryMismatch",
     "DeadlineExceeded",
+    "FleetClient",
+    "FleetHTTPServer",
     "FrontendConfig",
     "GameServingEngine",
     "GenerationWatcher",
     "HotSwapManager",
+    "ModelRouter",
     "Overloaded",
+    "QuotaExceeded",
+    "Replica",
+    "ReplicaSet",
     "ServingFrontend",
     "ServingFuture",
+    "TenantQuota",
+    "TokenBucket",
     "clear_engine_cache",
+    "decode_game_input",
+    "encode_game_input",
     "evict_engine",
     "get_engine",
     "model_fingerprint",

@@ -1,0 +1,805 @@
+"""Serving fleet: multi-model routing, per-tenant admission, rolling hot-swap.
+
+PR 6's :class:`~photon_ml_tpu.serving.ServingFrontend` is ONE resilient
+in-process queue in front of ONE model. Production traffic needs the tier
+around it, and this module is that tier:
+
+- **ModelRouter** — several frontends (one replica set per model) behind one
+  submission surface, all sharing the content-keyed ``get_engine`` cache (two
+  models built from the same coefficient bytes share device tables and
+  compiled programs). Admission is layered, every shed an explicit
+  :class:`~photon_ml_tpu.resilience.Incident`:
+
+  1. *Per-tenant token buckets* — each (model, tenant) pair drains a seeded
+     refill bucket; an empty bucket sheds with :class:`QuotaExceeded`,
+     deliberately DISTINCT from :class:`~serving.frontend.Overloaded`: quota
+     is a policy verdict the tenant must back off from, overload is capacity
+     pressure a retry against another replica may clear.
+  2. *Per-model admission budgets* — a cap on the model's in-flight requests
+     (router-side accounting via future done-callbacks), so one model cannot
+     queue the shared engine tier solid.
+  3. *Priority classes* — under a fleet-wide in-flight budget, lower classes
+     shed earlier: a class admits only while fleet in-flight is below
+     ``fleet_budget * PRIORITY_ADMISSION_FRACTION[class]`` ("batch" loses
+     admission at 50% pressure, "interactive" rides to the full budget).
+
+- **ReplicaSet** — N serving replicas (each its own ``ServingFrontend`` with
+  its own dispatcher worker) sharing ONE generational checkpoint store and the
+  engine cache; the router round-robins across them (overload fails over to
+  the next replica). Hot-swap (serving/hotswap.py's verify→warm→flip) becomes
+  REPLICA-AT-A-TIME here (:meth:`ReplicaSet.check_once`):
+
+  1. verify + load the candidate generation (full SHA-256 pass, read-only);
+  2. warm the candidate engine over every replica's live shapes while the
+     incumbent keeps serving;
+  3. flip ONE canary replica and evaluate it on mirrored requests (a bounded
+     pool of recent real traffic): every canary response served through the
+     live micro-batching path must be BITWISE what a direct candidate-engine
+     call returns (the flip machinery must not perturb a single bit), and the
+     canary's scores must be finite wherever the incumbent generation's
+     engine scores the same mirrored request finite (the health reference —
+     a trainer that committed NaN-poisoned coefficients passes every
+     checksum, and this is the gate that still catches it);
+  4. only then roll the remaining replicas one at a time; on canary mismatch
+     the canary flips BACK to the incumbent engine, the generation joins the
+     shared blacklist (no replica will ever attempt it), and a
+     ``canary-reject`` incident is recorded — the fleet never leaves the
+     incumbent.
+
+  A crash mid-remainder-roll leaves a mixed-generation fleet in which every
+  response is still bitwise-correct for the generation that served it; the
+  next ``check_once`` converges the stragglers (the candidate is NOT
+  blacklisted once it has passed canary).
+
+Fault points ``serve.fleet.route`` / ``serve.fleet.canary`` /
+``serve.fleet.roll`` instrument the three irreversible moments for the chaos
+sweep (tests/test_chaos.py): a crash at any of them must never produce a
+wrong score, always an explicit failure or incident, and the fleet must
+converge afterwards.
+
+The open-loop load generator that measures this tier lives in
+benchmarks/fleet_bench.py (``bench.py --fleet``); the HTTP transport in
+serving/transport.py.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.pipeline import BackgroundTask
+from photon_ml_tpu.io.checkpoint import list_generations, load_generation
+from photon_ml_tpu.resilience import (
+    Incident,
+    Retry,
+    RetryExhausted,
+    faultpoint,
+    register_fault_point,
+)
+from photon_ml_tpu.serving.engine import evict_engine, get_engine
+from photon_ml_tpu.serving.frontend import (
+    DeadlineExceeded,
+    FrontendConfig,
+    Overloaded,
+    ServingFrontend,
+    ServingFuture,
+)
+from photon_ml_tpu.serving.hotswap import (
+    _DEFAULT_RETRY,
+    model_from_state,
+    newest_valid_generation,
+)
+
+logger = logging.getLogger(__name__)
+
+FP_ROUTE = register_fault_point("serve.fleet.route")
+FP_CANARY = register_fault_point("serve.fleet.canary")
+FP_ROLL = register_fault_point("serve.fleet.roll")
+
+# fraction of the fleet-wide in-flight budget each priority class may use:
+# under pressure the batch tier loses admission first, interactive last
+PRIORITY_ADMISSION_FRACTION = {
+    "interactive": 1.0,
+    "standard": 0.75,
+    "batch": 0.5,
+}
+
+
+class QuotaExceeded(RuntimeError):
+    """Request shed because the (model, tenant) token bucket is empty.
+    Deliberately NOT an :class:`Overloaded`: quota is an admission-policy
+    verdict (the tenant exceeded its contract — back off), overload is
+    capacity pressure (a retry against another replica may succeed). The two
+    are counted and incident-recorded apart so a dashboard can tell an abusive
+    tenant from an undersized fleet."""
+
+
+class CanaryMismatch(RuntimeError):
+    """The canary replica's live scores failed validation against the
+    candidate/incumbent engines on mirrored requests. Deterministic for a
+    given generation (the mirror comparisons are pure functions of committed
+    bytes), so the generation is blacklisted fleet-wide."""
+
+
+class TokenBucket:
+    """Deterministic token bucket: ``burst`` capacity refilled at ``rate``
+    tokens/second on the injected clock (tests and the seeded bench drive it
+    with fake clocks). Thread-safe; ``try_take`` never blocks."""
+
+    def __init__(self, rate: float, burst: float, clock: Callable[[], float]):
+        if burst <= 0:
+            raise ValueError(f"token bucket burst must be > 0, got {burst}")
+        if rate < 0:
+            raise ValueError(f"token bucket rate must be >= 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._t = clock()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst, self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant admission contract for one model: ``rate`` requests/second
+    sustained, ``burst`` extra requests of headroom."""
+
+    rate: float
+    burst: float
+
+
+@dataclasses.dataclass
+class Replica:
+    """One serving replica: a named ``ServingFrontend`` whose dispatcher
+    thread is the replica's worker. Replicas in one :class:`ReplicaSet` share
+    the engine cache (same coefficient bytes → same device tables) and the
+    generational checkpoint store; process-per-replica deployments stack the
+    HTTP transport (serving/transport.py) in front of one replica each and
+    run this same rollout protocol against the shared store."""
+
+    name: str
+    frontend: ServingFrontend
+
+    @property
+    def generation(self) -> int:
+        return self.frontend.generation
+
+    @property
+    def engine(self):
+        return self.frontend.engine
+
+
+class ReplicaSet:
+    """N replicas serving one model from one generational checkpoint store,
+    with replica-at-a-time rolling hot-swap (see the module docstring's state
+    machine). ``check_once`` is duck-type compatible with
+    :class:`~serving.hotswap.HotSwapManager`, so a
+    :class:`~serving.hotswap.GenerationWatcher` drives fleet rollouts
+    unchanged."""
+
+    def __init__(
+        self,
+        name: str,
+        checkpoint_root: str,
+        replicas: list[Replica],
+        dtype=jnp.float32,
+        prefer_best: bool = True,
+        retry: Optional[Retry] = None,
+        warmup_timeout: float = 300.0,
+        canary_timeout: float = 60.0,
+        mirror_size: int = 16,
+        incident_log_size: int = 256,
+    ):
+        if not replicas:
+            raise ValueError("a ReplicaSet needs at least one replica")
+        self.name = name
+        self.checkpoint_root = checkpoint_root
+        self.replicas = list(replicas)
+        self.dtype = dtype
+        self.prefer_best = prefer_best
+        self.retry = retry or _DEFAULT_RETRY
+        self.warmup_timeout = warmup_timeout
+        self.canary_timeout = canary_timeout
+        self.bad_generations: set[int] = set()
+        self.rollouts_completed = 0
+        self.rollbacks = 0
+        self._swap_lock = threading.Lock()  # one rollout in flight at a time
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        # bounded pool of recent REAL requests: the canary's mirrored traffic.
+        # References only (requests are immutable post-submit); recorded by
+        # submit(), snapshotted by the rollout thread.
+        self._mirror: collections.deque = collections.deque(maxlen=mirror_size)
+        self._incident_lock = threading.Lock()
+        self._incidents: collections.deque = collections.deque(
+            maxlen=incident_log_size
+        )
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint_root: str,
+        n_replicas: int,
+        name: str = "default",
+        config: Optional[FrontendConfig] = None,
+        dtype=jnp.float32,
+        prefer_best: bool = True,
+        retry: Optional[Retry] = None,
+        clock: Callable[[], float] = time.monotonic,
+        **kwargs,
+    ) -> "ReplicaSet":
+        """Bootstrap N replicas from the newest valid generation of a
+        training run's checkpoint store. All replicas start on one engine
+        object (content-keyed cache): N replicas cost N dispatcher threads,
+        ONE set of device tables."""
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        found = newest_valid_generation(checkpoint_root, dtype=dtype)
+        if found is None:
+            raise FileNotFoundError(
+                f"no valid checkpoint generation under {checkpoint_root!r}"
+            )
+        gen_num, state = found
+        engine = get_engine(model_from_state(state, prefer_best=prefer_best))
+        replicas = [
+            Replica(
+                name=f"{name}/replica-{i}",
+                frontend=ServingFrontend(
+                    engine, config=config, generation=gen_num, clock=clock
+                ),
+            )
+            for i in range(n_replicas)
+        ]
+        return cls(
+            name,
+            checkpoint_root,
+            replicas,
+            dtype=dtype,
+            prefer_best=prefer_best,
+            retry=retry,
+            **kwargs,
+        )
+
+    # -- submission --------------------------------------------------------
+
+    def submit(
+        self,
+        data,
+        deadline_ms: Optional[float] = None,
+        include_offsets: bool = True,
+        kind: str = "score",
+    ) -> tuple[ServingFuture, Replica]:
+        """Round-robin submit with overload failover: an ``Overloaded``
+        replica passes the request to the next one (each shed stays recorded
+        in that replica's own incident log); only when EVERY replica sheds
+        does the overload propagate. Also records the request in the mirror
+        pool — live traffic is what canary evaluation replays."""
+        self._mirror.append((kind, bool(include_offsets), data))
+        with self._rr_lock:
+            start = self._rr
+            self._rr += 1
+        last: Optional[BaseException] = None
+        for i in range(len(self.replicas)):
+            replica = self.replicas[(start + i) % len(self.replicas)]
+            try:
+                fut = replica.frontend.submit(
+                    data,
+                    deadline_ms=deadline_ms,
+                    include_offsets=include_offsets,
+                    kind=kind,
+                )
+                return fut, replica
+            except Overloaded as e:
+                last = e
+        raise last if last is not None else Overloaded("no replicas available")
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def incidents(self) -> list:
+        with self._incident_lock:
+            return list(self._incidents)
+
+    def record_incident(
+        self, kind: str, cause: str, action: str, detail: Optional[str] = None
+    ) -> None:
+        with self._incident_lock:
+            self._incidents.append(
+                Incident(kind=kind, cause=cause, action=action, detail=detail)
+            )
+
+    @property
+    def generations(self) -> list[int]:
+        return [r.generation for r in self.replicas]
+
+    @property
+    def converged(self) -> bool:
+        return len(set(self.generations)) == 1
+
+    def stats(self) -> dict:
+        per_replica = {r.name: r.frontend.stats() for r in self.replicas}
+        served_by_gen = collections.Counter()
+        sheds = collections.Counter()
+        for st in per_replica.values():
+            for g, c in st.get("served_by_generation", {}).items():
+                served_by_gen[int(g)] += c
+            for k in ("shed_overload", "shed_deadline", "shed_shutdown"):
+                sheds[k] += st.get(k, 0)
+        return {
+            "generations": self.generations,
+            "converged": self.converged,
+            "bad_generations": sorted(self.bad_generations),
+            "rollouts_completed": self.rollouts_completed,
+            "rollbacks": self.rollbacks,
+            "served_by_generation": {g: int(c) for g, c in sorted(served_by_gen.items())},
+            **dict(sheds),
+            "replicas": per_replica,
+        }
+
+    def close(self, drain: bool = True) -> None:
+        for r in self.replicas:
+            r.frontend.close(drain=drain)
+
+    # -- rolling hot-swap --------------------------------------------------
+
+    def check_once(self) -> bool:
+        """Poll the store; roll the fleet to the newest eligible generation
+        replica-at-a-time (canary first). Returns True when the whole fleet
+        converged on a new generation. NEVER raises on a bad generation — the
+        contract of :meth:`HotSwapManager.check_once`, fleet-wide."""
+        with self._swap_lock:
+            fleet_gen = min(r.generation for r in self.replicas)
+            candidates = [
+                (g, p)
+                for g, p in list_generations(self.checkpoint_root)
+                if g > fleet_gen and g not in self.bad_generations
+            ]
+            if not candidates:
+                return False
+            gen_num, gen_dir = candidates[-1]
+            # progress survives retry attempts: once the remainder roll has
+            # begun the generation has PASSED canary and must not be
+            # blacklisted by a later crash mid-roll
+            progress = {"rolling": False}
+            try:
+                self.retry.call(
+                    self._roll_to,
+                    gen_num,
+                    gen_dir,
+                    progress,
+                    description=f"rolling swap of {self.name} to generation {gen_num}",
+                )
+                return True
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — rollback is the
+                # contract: corruption, canary mismatch, warm-up crash and
+                # retry exhaustion all degrade to "keep serving what we have"
+                self.rollbacks += 1
+                # transient = not the generation's fault: flaky I/O
+                # (RetryExhausted/OSError), or LOAD — a canary evaluation shed
+                # (Overloaded / DeadlineExceeded from the canary's live queue
+                # under real traffic) says the fleet was busy, not that the
+                # bytes are bad; only deterministic failures blacklist
+                transient = isinstance(
+                    e, (RetryExhausted, OSError, Overloaded, DeadlineExceeded)
+                )
+                blacklist = not transient and not progress["rolling"]
+                if blacklist:
+                    self.bad_generations.add(gen_num)
+                kind = (
+                    "canary-reject" if isinstance(e, CanaryMismatch) else "fleet-rollback"
+                )
+                action = f"fleet stays on generations {self.generations}; " + (
+                    f"blacklisted generation {gen_num}"
+                    if blacklist
+                    else f"will retry generation {gen_num} on a later poll"
+                )
+                # ONE record, in the fleet-level log (the frontends' logs keep
+                # per-replica request-path incidents): the driver's stats
+                # concatenate every log, so mirroring here would double-count
+                # each rollback
+                self.record_incident(
+                    kind=kind, cause=f"{type(e).__name__}: {e}", action=action
+                )
+                logger.warning(
+                    "rolling swap of %s to generation %d failed (%s); replicas "
+                    "on %s", self.name, gen_num, e, self.generations,
+                )
+                return False
+
+    def _roll_to(self, gen_num: int, gen_dir: str, progress: dict) -> None:
+        state = load_generation(gen_dir, dtype=self.dtype)
+        model = model_from_state(state, prefer_best=self.prefer_best)
+        # replicas still behind (a crashed earlier roll may have left some
+        # already flipped); the first of them is this rollout's canary
+        behind = [r for r in self.replicas if r.generation < gen_num]
+        if not behind:
+            return
+        canary = behind[0]
+        incumbent_engine = canary.engine
+        incumbent_gen = canary.generation
+        candidate = get_engine(
+            model,
+            mesh=incumbent_engine.mesh,
+            min_batch_pad=incumbent_engine.min_batch_pad,
+            # serving configuration, not model content: a bf16 fleet stays
+            # bf16 across generations (serving/hotswap.py learned this)
+            precision=incumbent_engine.precision,
+        )
+        try:
+            if candidate is not incumbent_engine:
+                # pilot-compile over the UNION of live shapes across replicas
+                # (one shared engine: warming once covers every later flip);
+                # background thread so the incumbent keeps serving meanwhile
+                task = BackgroundTask(
+                    self._warm, candidate, name=f"photon-fleet-warmup-gen{gen_num}"
+                )
+                task.result(self.warmup_timeout)
+            faultpoint(FP_CANARY)
+            canary.frontend.install_engine(candidate, gen_num)
+            try:
+                self._evaluate_canary(canary, candidate, incumbent_engine)
+            except BaseException:
+                # ANY canary-phase failure (mismatch, crash, transient fault
+                # mid-evaluation) flips the canary back before the error
+                # propagates: a retry or rollback always starts from a fleet
+                # uniformly on the incumbent
+                canary.frontend.install_engine(incumbent_engine, incumbent_gen)
+                raise
+        except BaseException:
+            # the roll will not complete from here: drop the candidate engine
+            # from the cache so a bad generation doesn't pin device tables
+            # (a retried attempt rebuilds it)
+            if (
+                candidate is not incumbent_engine
+                and candidate.fingerprint != incumbent_engine.fingerprint
+            ):
+                evict_engine(candidate.fingerprint)
+            raise
+        # canary PASSED: roll the remainder one replica at a time. From the
+        # first flip on, a crash leaves a mixed fleet (every response still
+        # bitwise-correct for its generation) that the next poll converges —
+        # the generation is no longer blacklist-eligible.
+        progress["rolling"] = True
+        for replica in self.replicas:
+            if replica.generation >= gen_num:
+                continue
+            faultpoint(FP_ROLL)
+            replica.frontend.install_engine(candidate, gen_num)
+        if candidate.fingerprint != incumbent_engine.fingerprint:
+            evicted = evict_engine(incumbent_engine.fingerprint)
+            logger.info(
+                "rolled %s to generation %d across %d replicas (evicted %d "
+                "superseded engine cache entr%s)",
+                self.name, gen_num, len(self.replicas), evicted,
+                "y" if evicted == 1 else "ies",
+            )
+        self.rollouts_completed += 1
+
+    def _warm(self, engine) -> int:
+        from photon_ml_tpu.serving.frontend import request_signature
+
+        warmed = 0
+        seen = set()
+        for replica in self.replicas:
+            for kind, include_offsets, req in replica.frontend.warm_requests():
+                # dedupe across replicas by (full coalescing signature, bucket):
+                # one shared engine means one pilot compile covers every flip
+                key = (request_signature(req, kind, include_offsets), req.n)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if kind == "predict":
+                    engine.predict(req)
+                else:
+                    engine.score(req, include_offsets=include_offsets)
+                warmed += 1
+        return warmed
+
+    def _evaluate_canary(self, canary: Replica, candidate, incumbent_engine) -> None:
+        """Mirror recent real traffic through the freshly flipped canary and
+        validate (module docstring, step 3). An empty mirror pool (a fleet
+        that has never taken traffic) passes vacuously — there is nothing to
+        validate a generation against; the serving-path bitwise gate still
+        protects the first real request via the bench/tests."""
+        mirrors = list(self._mirror)
+        failures = []
+        for kind, include_offsets, req in mirrors:
+            if kind == "predict":
+                live = canary.frontend.predict(req, timeout=self.canary_timeout)
+                direct = candidate.predict(req)
+                ref = incumbent_engine.predict(req)
+            else:
+                live = canary.frontend.score(
+                    req, include_offsets=include_offsets, timeout=self.canary_timeout
+                )
+                direct = candidate.score(req, include_offsets=include_offsets)
+                ref = incumbent_engine.score(req, include_offsets=include_offsets)
+            # 1) serving-path parity, BITWISE: the canary's live (coalesced,
+            # flipped-mid-traffic) response must be exactly the candidate
+            # engine's direct answer. equal_nan: positionally identical NaNs
+            # are a faithful serving path — health is judged next, so a
+            # poisoned generation is attributed to the MODEL, not the path.
+            if live.dtype != direct.dtype or not np.array_equal(
+                live, direct, equal_nan=True
+            ):
+                failures.append("serving-path parity vs candidate engine not bitwise")
+            # 2) health vs the incumbent generation's engine on the same
+            # mirrored request: anywhere the incumbent scores finite, the
+            # candidate must too — the NaN/Inf-poisoned-commit class that
+            # passes every checksum
+            ref_finite = np.isfinite(np.asarray(ref, dtype=np.float64))
+            live_finite = np.isfinite(np.asarray(live, dtype=np.float64))
+            if not bool(np.all(live_finite[ref_finite])):
+                failures.append("non-finite scores where the incumbent is finite")
+        if failures:
+            raise CanaryMismatch(
+                f"canary {canary.name} failed on {len(failures)} of "
+                f"{len(mirrors)} mirrored request(s): {sorted(set(failures))}"
+            )
+
+
+# --------------------------------------------------------------------------
+# the router
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _ModelEntry:
+    name: str
+    replica_set: ReplicaSet
+    priority: str
+    admission_budget: Optional[int]
+    default_quota: Optional[TenantQuota]
+    tenant_quotas: dict
+    buckets: dict = dataclasses.field(default_factory=dict)
+    inflight: int = 0
+
+
+class ModelRouter:
+    """The fleet's submission surface: named models, layered admission,
+    shared in-flight accounting. One router per process; the HTTP transport
+    (serving/transport.py) and the CLI replay core both speak to it.
+
+    ``fleet_budget`` caps TOTAL in-flight requests across models; priority
+    classes partition it (module docstring). ``None`` disables the fleet cap
+    (per-model budgets and quotas still apply)."""
+
+    def __init__(
+        self,
+        fleet_budget: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+        incident_log_size: int = 256,
+    ):
+        self.fleet_budget = fleet_budget
+        self._clock = clock
+        self._models: dict[str, _ModelEntry] = {}
+        self._lock = threading.Lock()
+        self._inflight_total = 0
+        self._counters = collections.Counter()
+        self._incident_lock = threading.Lock()
+        self._incidents: collections.deque = collections.deque(
+            maxlen=incident_log_size
+        )
+
+    def add_model(
+        self,
+        name: str,
+        replica_set: ReplicaSet,
+        priority: str = "interactive",
+        admission_budget: Optional[int] = None,
+        tenant_quota: Optional[TenantQuota] = None,
+        tenant_quotas: Optional[dict] = None,
+    ) -> None:
+        """Register a model. ``tenant_quota`` is the default per-tenant
+        contract (None = unmetered); ``tenant_quotas`` overrides it for named
+        tenants. ``admission_budget`` caps this model's in-flight requests."""
+        if priority not in PRIORITY_ADMISSION_FRACTION:
+            raise ValueError(
+                f"unknown priority class {priority!r}; "
+                f"have {sorted(PRIORITY_ADMISSION_FRACTION)}"
+            )
+        if admission_budget is not None and admission_budget < 1:
+            raise ValueError(f"admission_budget must be >= 1, got {admission_budget}")
+        with self._lock:
+            if name in self._models:
+                raise ValueError(f"model {name!r} already registered")
+            self._models[name] = _ModelEntry(
+                name=name,
+                replica_set=replica_set,
+                priority=priority,
+                admission_budget=admission_budget,
+                default_quota=tenant_quota,
+                tenant_quotas=dict(tenant_quotas or {}),
+            )
+
+    @property
+    def models(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def replica_set(self, name: str) -> ReplicaSet:
+        return self._entry(name).replica_set
+
+    def _entry(self, name: str) -> _ModelEntry:
+        with self._lock:
+            entry = self._models.get(name)
+        if entry is None:
+            raise KeyError(f"unknown model {name!r}; have {self.models}")
+        return entry
+
+    def _record(self, kind, cause, action, detail=None):
+        with self._incident_lock:
+            self._incidents.append(
+                Incident(kind=kind, cause=cause, action=action, detail=detail)
+            )
+
+    @property
+    def incidents(self) -> list:
+        with self._incident_lock:
+            return list(self._incidents)
+
+    # -- admission + routing ----------------------------------------------
+
+    def submit(
+        self,
+        model: str,
+        data,
+        tenant: str = "default",
+        deadline_ms: Optional[float] = None,
+        include_offsets: bool = True,
+        kind: str = "score",
+    ) -> ServingFuture:
+        faultpoint(FP_ROUTE)
+        entry = self._entry(model)
+        quota = entry.tenant_quotas.get(tenant, entry.default_quota)
+        if quota is not None:
+            with self._lock:
+                bucket = entry.buckets.get(tenant)
+                if bucket is None:
+                    bucket = entry.buckets[tenant] = TokenBucket(
+                        quota.rate, quota.burst, self._clock
+                    )
+            if not bucket.try_take():
+                with self._lock:
+                    self._counters["shed_quota"] += 1
+                self._record(
+                    "quota-shed",
+                    f"tenant {tenant!r} over quota on model {model!r} "
+                    f"(rate={quota.rate}/s, burst={quota.burst})",
+                    "shed request at admission",
+                )
+                raise QuotaExceeded(
+                    f"tenant {tenant!r} exceeded its quota on model {model!r}"
+                )
+        with self._lock:
+            if (
+                entry.admission_budget is not None
+                and entry.inflight >= entry.admission_budget
+            ):
+                self._counters["shed_overload"] += 1
+                self._record(
+                    "overload",
+                    f"model {model!r} at admission budget "
+                    f"{entry.admission_budget}",
+                    "shed request at admission",
+                )
+                raise Overloaded(
+                    f"model {model!r} at its admission budget "
+                    f"({entry.admission_budget} in flight)"
+                )
+            if self.fleet_budget is not None:
+                allowed = int(
+                    self.fleet_budget * PRIORITY_ADMISSION_FRACTION[entry.priority]
+                )
+                if self._inflight_total >= allowed:
+                    self._counters["shed_overload"] += 1
+                    self._record(
+                        "overload",
+                        f"fleet budget pressure: {self._inflight_total} in "
+                        f"flight >= {allowed} admissible for priority "
+                        f"{entry.priority!r}",
+                        "shed request at admission",
+                    )
+                    raise Overloaded(
+                        f"fleet under pressure; priority {entry.priority!r} "
+                        f"admits below {allowed} in-flight"
+                    )
+            entry.inflight += 1
+            self._inflight_total += 1
+        try:
+            fut, _replica = entry.replica_set.submit(
+                data,
+                deadline_ms=deadline_ms,
+                include_offsets=include_offsets,
+                kind=kind,
+            )
+        except BaseException:
+            with self._lock:
+                entry.inflight -= 1
+                self._inflight_total -= 1
+            raise
+        fut.add_done_callback(lambda _f: self._release(entry))
+        with self._lock:
+            self._counters["routed"] += 1
+        return fut
+
+    def _release(self, entry: _ModelEntry) -> None:
+        with self._lock:
+            entry.inflight -= 1
+            self._inflight_total -= 1
+
+    def score(
+        self,
+        model: str,
+        data,
+        tenant: str = "default",
+        deadline_ms: Optional[float] = None,
+        include_offsets: bool = True,
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        return self.submit(
+            model, data, tenant=tenant, deadline_ms=deadline_ms,
+            include_offsets=include_offsets,
+        ).result(timeout)
+
+    def predict(
+        self,
+        model: str,
+        data,
+        tenant: str = "default",
+        deadline_ms: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        return self.submit(
+            model, data, tenant=tenant, deadline_ms=deadline_ms, kind="predict"
+        ).result(timeout)
+
+    # -- fleet lifecycle ---------------------------------------------------
+
+    def check_once(self) -> bool:
+        """Poll every model's checkpoint store once (GenerationWatcher's
+        manager duck type). True when ANY replica set rolled."""
+        rolled = False
+        for name in self.models:
+            rolled = self._entry(name).replica_set.check_once() or rolled
+        return rolled
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["inflight"] = self._inflight_total
+            entries = list(self._models.values())
+        out["models"] = {
+            e.name: {
+                "priority": e.priority,
+                "admission_budget": e.admission_budget,
+                "inflight": e.inflight,
+                **e.replica_set.stats(),
+            }
+            for e in entries
+        }
+        return out
+
+    def close(self, drain: bool = True) -> None:
+        for name in self.models:
+            self._entry(name).replica_set.close(drain=drain)

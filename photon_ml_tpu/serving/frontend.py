@@ -94,22 +94,47 @@ class ServingFuture:
     (:class:`Overloaded` / :class:`DeadlineExceeded` / the dispatch error).
     ``generation`` is the model generation that served it (set on success)."""
 
-    __slots__ = ("_event", "_value", "_exc", "generation")
+    __slots__ = ("_event", "_value", "_exc", "_callbacks", "_cb_lock", "generation")
 
     def __init__(self):
         self._event = threading.Event()
         self._value: Optional[np.ndarray] = None
         self._exc: Optional[BaseException] = None
+        self._callbacks: list = []
+        self._cb_lock = threading.Lock()
         self.generation: Optional[int] = None
 
     def _set(self, value: np.ndarray, generation: Optional[int]) -> None:
         self._value = value
         self.generation = generation
         self._event.set()
+        self._run_callbacks()
 
     def _fail(self, exc: BaseException) -> None:
         self._exc = exc
         self._event.set()
+        self._run_callbacks()
+
+    def _run_callbacks(self) -> None:
+        with self._cb_lock:
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:  # a broken observer must not fail the request
+                pass
+
+    def add_done_callback(self, fn) -> None:
+        """Invoke ``fn(future)`` once the request completes (success OR
+        failure); immediately when it already has. The fleet router's
+        in-flight accounting and the open-loop load generator's completion
+        timestamps ride on this — callbacks must be cheap and non-blocking
+        (they run on the dispatcher thread)."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -237,6 +262,7 @@ class ServingFrontend:
         self._latency_ewma: dict[tuple, float] = {}
         self._live_shapes: dict[tuple, _LiveShape] = {}
         self._counters = collections.Counter()
+        self._served_by_gen = collections.Counter()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="photon-serving-dispatch", daemon=True
         )
@@ -302,9 +328,12 @@ class ServingFrontend:
         )
         with self._cv:
             if self._closed:
-                self._counters["shed_overload"] += 1
+                # a SHUTDOWN shed, not capacity pressure: counted apart so a
+                # fleet dashboard can tell a draining replica from an
+                # overloaded one (cli/serving_driver.py stats breakout)
+                self._counters["shed_shutdown"] += 1
                 self._record(
-                    "overload", "submit after close", "shed request before enqueue"
+                    "shutdown-shed", "submit after close", "shed request before enqueue"
                 )
                 raise Overloaded("serving frontend is closed")
             if len(self._queue) >= self.config.max_queue_depth:
@@ -370,6 +399,12 @@ class ServingFrontend:
             out["queue_depth"] = len(self._queue)
             out["generation"] = self._engine_ref[1]
             out["live_signatures"] = len(self._live_shapes)
+            # per-generation served-request counts: a rolling hot-swap's
+            # dashboard reads which generations actually took traffic straight
+            # from stats instead of parsing the incident log
+            out["served_by_generation"] = {
+                int(g): int(c) for g, c in sorted(self._served_by_gen.items())
+            }
         return out
 
     def record_incident(
@@ -587,6 +622,7 @@ class ServingFrontend:
             self._counters["batches"] += 1
             self._counters["served"] += len(live)
             self._counters["served_samples"] += total
+            self._served_by_gen[generation] += len(live)
         start = 0
         for r in live:
             r.future._set(out[start : start + r.n], generation)
@@ -607,9 +643,9 @@ class ServingFrontend:
                 if not drain:
                     self._queue.clear()
                     if pending:  # sheds stay visible, even the shutdown ones
-                        self._counters["shed_overload"] += len(pending)
+                        self._counters["shed_shutdown"] += len(pending)
                         self._record(
-                            "overload",
+                            "shutdown-shed",
                             f"frontend closed with {len(pending)} queued request(s)",
                             "failed queued requests explicitly",
                         )

@@ -1,0 +1,375 @@
+"""HTTP transport for the serving fleet: the wire in front of ModelRouter.
+
+The reference's serving story ends at Spark batch score files; the fleet's
+traffic tier needs a real transport. This one is deliberately stdlib-only
+(``http.server.ThreadingHTTPServer`` — no new dependencies in the container)
+and BITWISE-exact: every array crosses the wire as its raw little-endian
+bytes, base64-inside-JSON, so a scored response decodes to exactly the bytes
+a direct in-process engine call returns (the fleet bench gates on this; a
+float-as-decimal-text protocol could not make that promise for every dtype).
+
+Endpoints (all JSON):
+
+- ``POST /v1/models/<name>/score`` and ``/v1/models/<name>/predict`` — body
+  is an encoded :class:`~photon_ml_tpu.data.game_data.GameInput`
+  (:func:`encode_game_input`); tenant and deadline ride the
+  ``X-Photon-Tenant`` / ``X-Photon-Deadline-Ms`` headers. Response:
+  ``{"scores": <array>, "generation": <int>, "n": <int>}``.
+- ``GET /v1/models`` — registered models and their replica generations.
+- ``GET /stats`` — the router's full stats tree (sheds by cause, per-
+  generation served counts, per-replica counters).
+- ``GET /healthz`` — liveness.
+
+Admission verdicts map to status codes so HTTP clients see the same
+taxonomy in-process callers do: quota 429 (``quota_exceeded``), overload 503
+(``overloaded``), deadline 504 (``deadline_exceeded``), unknown model 404,
+malformed body 400. :class:`FleetClient` reverses the mapping, raising the
+same exception types the router raises.
+
+One process per replica is the production shape: each replica process runs
+this server in front of its own router and shares the generational
+checkpoint store; the rolling-swap protocol (serving/fleet.py) needs no
+cross-replica channel beyond that store.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.serving.fleet import ModelRouter, QuotaExceeded
+from photon_ml_tpu.serving.frontend import DeadlineExceeded, Overloaded
+
+# ------------------------------------------------------------------- codec
+
+
+def encode_array(arr: np.ndarray) -> dict:
+    """{'dtype', 'shape', 'b64'} carrying the array's exact bytes. String
+    entity-id columns arrive from the Avro readers as object-of-str arrays —
+    those convert to their '<U*' unicode form (same ids, engine lookup
+    unchanged); any other object array is refused (no pickling on the
+    wire)."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype == object:
+        if all(isinstance(x, str) for x in arr.ravel().tolist()):
+            arr = np.ascontiguousarray(arr.astype(np.str_))
+        else:
+            raise TypeError("object arrays cannot cross the fleet transport")
+    return {
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+    }
+
+
+def decode_array(d: dict) -> np.ndarray:
+    arr = np.frombuffer(base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"]))
+    return arr.reshape(d["shape"]).copy()  # frombuffer is read-only; GameInput isn't
+
+
+def encode_game_input(data: GameInput, include_offsets: bool = True) -> dict:
+    feats = {}
+    for name, m in data.features.items():
+        if sp.issparse(m):
+            X = m.tocsr()
+            feats[name] = {
+                "kind": "csr",
+                "data": encode_array(X.data),
+                "indices": encode_array(X.indices),
+                "indptr": encode_array(X.indptr),
+                "shape": list(X.shape),
+            }
+        else:
+            feats[name] = {"kind": "dense", "values": encode_array(np.asarray(m))}
+    return {
+        "features": feats,
+        "offsets": encode_array(np.asarray(data.offsets)),
+        "id_columns": {
+            t: encode_array(np.asarray(c)) for t, c in data.id_columns.items()
+        },
+        "include_offsets": bool(include_offsets),
+    }
+
+
+def decode_game_input(body: dict) -> tuple[GameInput, bool]:
+    feats = {}
+    for name, f in body.get("features", {}).items():
+        if f.get("kind") == "csr":
+            feats[name] = sp.csr_matrix(
+                (
+                    decode_array(f["data"]),
+                    decode_array(f["indices"]),
+                    decode_array(f["indptr"]),
+                ),
+                shape=tuple(f["shape"]),
+            )
+        elif f.get("kind") == "dense":
+            feats[name] = decode_array(f["values"])
+        else:
+            raise ValueError(f"feature shard {name!r}: unknown kind {f.get('kind')!r}")
+    data = GameInput(
+        features=feats,
+        offsets=decode_array(body["offsets"]) if "offsets" in body else None,
+        id_columns={
+            t: decode_array(c) for t, c in body.get("id_columns", {}).items()
+        },
+    )
+    return data, bool(body.get("include_offsets", True))
+
+
+# ------------------------------------------------------------------- server
+
+_ERROR_STATUS = {
+    QuotaExceeded: (429, "quota_exceeded"),
+    DeadlineExceeded: (504, "deadline_exceeded"),
+    Overloaded: (503, "overloaded"),
+}
+
+
+def _make_handler(router: ModelRouter, request_timeout: float):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # stderr-per-request is not a log
+            pass
+
+        def _reply(self, status: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok"})
+            elif self.path == "/stats":
+                self._reply(200, router.stats())
+            elif self.path == "/v1/models":
+                self._reply(
+                    200,
+                    {
+                        "models": {
+                            name: {
+                                "generations": router.replica_set(name).generations
+                            }
+                            for name in router.models
+                        }
+                    },
+                )
+            else:
+                self._reply(404, {"error": "not_found", "detail": self.path})
+
+        def do_POST(self):
+            parts = self.path.strip("/").split("/")
+            if len(parts) != 4 or parts[:2] != ["v1", "models"] or parts[3] not in (
+                "score",
+                "predict",
+            ):
+                self._reply(404, {"error": "not_found", "detail": self.path})
+                return
+            model, kind = parts[2], parts[3]
+            tenant = self.headers.get("X-Photon-Tenant", "default")
+            deadline_hdr = self.headers.get("X-Photon-Deadline-Ms")
+            try:
+                deadline_ms = None if deadline_hdr is None else float(deadline_hdr)
+            except ValueError:
+                self._reply(400, {"error": "bad_request", "detail": "bad deadline"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                data, include_offsets = decode_game_input(
+                    json.loads(self.rfile.read(length))
+                )
+            except Exception as e:  # malformed body is the client's problem
+                self._reply(400, {"error": "bad_request", "detail": str(e)[:300]})
+                return
+            try:
+                fut = router.submit(
+                    model,
+                    data,
+                    tenant=tenant,
+                    deadline_ms=deadline_ms,
+                    include_offsets=include_offsets,
+                    kind=kind,
+                )
+                out = fut.result(timeout=request_timeout)
+            except KeyError as e:
+                self._reply(404, {"error": "unknown_model", "detail": str(e)[:300]})
+                return
+            except (QuotaExceeded, DeadlineExceeded, Overloaded) as e:
+                status, code = next(
+                    v for t, v in _ERROR_STATUS.items() if isinstance(e, t)
+                )
+                self._reply(status, {"error": code, "detail": str(e)[:300]})
+                return
+            except BaseException as e:  # noqa: BLE001 — dispatch failures are
+                # explicit to the HTTP client too, never a hung connection
+                self._reply(
+                    500, {"error": type(e).__name__, "detail": str(e)[:300]}
+                )
+                return
+            self._reply(
+                200,
+                {
+                    "model": model,
+                    "kind": kind,
+                    "n": int(len(out)),
+                    "generation": fut.generation,
+                    "scores": encode_array(np.asarray(out)),
+                },
+            )
+
+    return Handler
+
+
+class FleetHTTPServer:
+    """Threaded HTTP server over a :class:`ModelRouter`. ``port=0`` binds an
+    ephemeral port (read it back from ``.port``); ``start()`` returns once
+    the socket is listening. Closing the server does NOT close the router —
+    lifecycle of the fleet belongs to its owner."""
+
+    def __init__(
+        self,
+        router: ModelRouter,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        request_timeout: float = 60.0,
+    ):
+        self._server = ThreadingHTTPServer(
+            (host, port), _make_handler(router, request_timeout)
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="photon-fleet-http",
+            daemon=True,
+        )
+        self._started = False
+
+    @property
+    def host(self) -> str:
+        return self._server.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "FleetHTTPServer":
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._started:
+            self._thread.join(10.0)
+
+    def __enter__(self) -> "FleetHTTPServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ------------------------------------------------------------------- client
+
+
+class FleetClient:
+    """Minimal HTTP client for the fleet endpoint (stdlib ``http.client``;
+    one connection per call, so instances are thread-safe). Admission
+    verdicts come back as the same exception types the in-process router
+    raises."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body=None, headers=None):
+        conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
+        try:
+            conn.request(
+                method,
+                path,
+                body=None if body is None else json.dumps(body),
+                headers={"Content-Type": "application/json", **(headers or {})},
+            )
+            resp = conn.getresponse()
+            payload = json.loads(resp.read() or b"{}")
+            return resp.status, payload
+        finally:
+            conn.close()
+
+    def _score_or_predict(
+        self,
+        kind: str,
+        model: str,
+        data: GameInput,
+        tenant: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        include_offsets: bool = True,
+    ) -> tuple[np.ndarray, Optional[int]]:
+        headers = {}
+        if tenant is not None:
+            headers["X-Photon-Tenant"] = tenant
+        if deadline_ms is not None:
+            headers["X-Photon-Deadline-Ms"] = repr(float(deadline_ms))
+        status, payload = self._request(
+            "POST",
+            f"/v1/models/{model}/{kind}",
+            body=encode_game_input(data, include_offsets=include_offsets),
+            headers=headers,
+        )
+        if status == 200:
+            return decode_array(payload["scores"]), payload.get("generation")
+        error = payload.get("error", "")
+        detail = payload.get("detail", "")
+        if error == "quota_exceeded":
+            raise QuotaExceeded(detail)
+        if error == "deadline_exceeded":
+            raise DeadlineExceeded(detail)
+        if error == "overloaded":
+            raise Overloaded(detail)
+        if status == 404:
+            raise KeyError(detail or error)
+        raise RuntimeError(f"fleet endpoint returned {status}: {error} {detail}")
+
+    def score(self, model: str, data: GameInput, **kwargs):
+        """(scores, generation) for one request; bitwise what the serving
+        replica returned."""
+        return self._score_or_predict("score", model, data, **kwargs)
+
+    def predict(self, model: str, data: GameInput, **kwargs):
+        kwargs.pop("include_offsets", None)
+        return self._score_or_predict("predict", model, data, **kwargs)
+
+    def models(self) -> dict:
+        status, payload = self._request("GET", "/v1/models")
+        if status != 200:
+            raise RuntimeError(f"fleet endpoint returned {status}")
+        return payload["models"]
+
+    def stats(self) -> dict:
+        status, payload = self._request("GET", "/stats")
+        if status != 200:
+            raise RuntimeError(f"fleet endpoint returned {status}")
+        return payload
+
+    def healthy(self) -> bool:
+        try:
+            status, _ = self._request("GET", "/healthz")
+            return status == 200
+        except OSError:
+            return False

@@ -22,6 +22,7 @@ import photon_ml_tpu.algorithm.coordinate_descent  # noqa: F401
 import photon_ml_tpu.continuous  # noqa: F401 — registers continuous.*
 import photon_ml_tpu.io.checkpoint  # noqa: F401
 import photon_ml_tpu.parallel.distributed  # noqa: F401
+import photon_ml_tpu.serving.fleet  # noqa: F401 — registers serve.fleet.*
 import photon_ml_tpu.serving.frontend  # noqa: F401 — registers serve.enqueue/dispatch
 import photon_ml_tpu.serving.hotswap  # noqa: F401 — registers serve.swap.*
 import photon_ml_tpu.sweep  # noqa: F401 — registers sweep.{propose,train,evaluate,commit}
@@ -37,11 +38,20 @@ from tests.test_cli_drivers import write_glmix_avro
 pytestmark = pytest.mark.chaos
 
 # the serving path has its own sweep below (a frontend has no restart-and-
-# compare semantics), the continuous-training loop has its own in
+# compare semantics), the serving FLEET tier its own (multi-replica rollout
+# semantics: crash -> explicit incident, never a wrong score, fleet
+# converges), the continuous-training loop has its own in
 # tests/test_continuous.py (its points never fire on the one-shot driver),
 # and the model-selection sweep has its own below (its points never fire on
 # the training driver); the training-driver sweep covers everything else
-SERVE_POINTS = tuple(p for p in registered_fault_points() if p.startswith("serve."))
+FLEET_POINTS = tuple(
+    p for p in registered_fault_points() if p.startswith("serve.fleet.")
+)
+SERVE_POINTS = tuple(
+    p
+    for p in registered_fault_points()
+    if p.startswith("serve.") and not p.startswith("serve.fleet.")
+)
 CONTINUOUS_POINTS = tuple(
     p for p in registered_fault_points() if p.startswith("continuous.")
 )
@@ -71,6 +81,11 @@ def test_registry_covers_every_chaos_sweep():
         "continuous.commit",
     } == set(CONTINUOUS_POINTS)
     assert {p.split(".", 1)[0] for p in SERVE_POINTS} == {"serve"}
+    assert {
+        "serve.fleet.route",
+        "serve.fleet.canary",
+        "serve.fleet.roll",
+    } == set(FLEET_POINTS)
     assert {
         "sweep.propose",
         "sweep.train",
@@ -249,6 +264,83 @@ def test_serving_crash_is_explicit_never_a_wrong_score(tmp_path, rng, point):
         )
     finally:
         frontend.close()
+
+
+# --------------------------------------------------------------------------
+# serving-FLEET sweep: crash at every serve.fleet.* fault point. Acceptance
+# bar (there is no restart-and-compare for a live fleet): every response that
+# WAS served is bitwise-correct for the generation that served it, the crash
+# is explicit (client exception and/or incident), and after the armed window
+# the fleet CONVERGES — all replicas on one generation, still serving
+# bitwise-correct scores (re-polling a later good generation when the crash
+# blacklisted the candidate).
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", FLEET_POINTS)
+def test_fleet_crash_is_explicit_and_fleet_converges(tmp_path, rng, point):
+    from photon_ml_tpu.io.checkpoint import save_checkpoint
+    from photon_ml_tpu.resilience import InjectedCrash, armed
+    from photon_ml_tpu.serving import FrontendConfig, ModelRouter, ReplicaSet
+
+    from tests.test_hotswap import build_models, make_req
+
+    root = str(tmp_path / "ckpt")
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    replica_set = ReplicaSet.from_checkpoint(
+        root, 2, name="m", config=FrontendConfig(max_wait_ms=0.0)
+    )
+    router = ModelRouter()
+    router.add_model("m", replica_set)
+    requests = [make_req(rng) for _ in range(4)]
+    engines = {1: replica_set.replicas[0].engine}
+    served = []
+    explicit_failures = 0
+    try:
+        with armed(f"{point}:crash:1") as plan:
+            for req in requests:
+                try:
+                    fut = router.submit("m", req)
+                    served.append((req, fut.result(30), fut.generation))
+                except InjectedCrash:
+                    explicit_failures += 1  # explicit to the CLIENT
+            # drive a rolling swap through the armed window (the canary/roll
+            # points only fire here); check_once records + rolls back rather
+            # than raising
+            save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+            replica_set.check_once()
+            for r in replica_set.replicas:
+                engines.setdefault(r.generation, r.engine)
+            for req in requests:
+                fut = router.submit("m", req)
+                served.append((req, fut.result(30), fut.generation))
+        assert plan.fired, f"{point} was never reached by the fleet scenario"
+        # explicitness: a fired crash shows up to the client or as an incident
+        incident_kinds = {i.kind for i in replica_set.incidents}
+        assert explicit_failures or incident_kinds & {
+            "canary-reject", "fleet-rollback", "dispatch-failure",
+        }
+        # NEVER a wrong score: everything served is bitwise what a direct
+        # engine call for its generation returns
+        for req, out, gen in served:
+            direct = engines[gen].score(req)
+            assert out.dtype == direct.dtype
+            np.testing.assert_array_equal(out, direct)
+        # convergence: with the plan disarmed, polling reaches ONE generation
+        # fleet-wide — on the candidate, or (if the crash blacklisted it) on
+        # a later good generation
+        replica_set.check_once()
+        if not replica_set.converged or 2 in replica_set.bad_generations:
+            save_checkpoint(root, build_models(rng, 3.0), 3, keep_generations=8)
+            assert replica_set.check_once() is True
+        assert replica_set.converged, replica_set.generations
+        final_gen = replica_set.generations[0]
+        engines.setdefault(final_gen, replica_set.replicas[0].engine)
+        probe = requests[0]
+        out = router.score("m", probe, timeout=30)
+        np.testing.assert_array_equal(out, engines[final_gen].score(probe))
+    finally:
+        router.close()
 
 
 # --------------------------------------------------------------------------

@@ -172,6 +172,91 @@ class TestRoundTrip:
         ck.clear()
         assert ck.restore() is None  # ...but clear() must not resurrect it
 
+    @pytest.mark.parametrize("storage", ["bfloat16", "float16"])
+    def test_reduced_dtype_round_trip_bitwise(self, rng, tmp_path, storage):
+        """ROADMAP item 5 / the fleet's bf16-deployment contract: reduced
+        dtypes survive the generational format BIT-EXACTLY (np.save writes
+        bfloat16 as raw |V2 void — the format encodes the bit patterns with a
+        self-describing marker instead). dtype=None preserves the stored
+        dtype; the default f32 restore is the exact upcast."""
+        dt = jnp.bfloat16 if storage == "bfloat16" else jnp.float16
+        E, k = 4, 3
+        means = jnp.asarray(rng.normal(size=5), dtype=dt)
+        variances = jnp.asarray(np.abs(rng.normal(size=5)), dtype=dt)
+        coeffs = jnp.asarray(rng.normal(size=(E, k)), dtype=dt)
+        models = {
+            "fixed": FixedEffectModel(
+                model=LogisticRegressionModel(
+                    Coefficients(means=means, variances=variances)
+                ),
+                feature_shard_id="global",
+            ),
+            "per-user": RandomEffectModel(
+                re_type="userId",
+                feature_shard_id="per-user",
+                task=TaskType.LOGISTIC_REGRESSION,
+                entity_ids=tuple(range(E)),
+                coeffs=coeffs,
+                proj_indices=jnp.asarray(
+                    rng.integers(-1, 10, size=(E, k)), dtype=jnp.int32
+                ),
+            ),
+        }
+        path = str(tmp_path / "c")
+        save_checkpoint(
+            path, models, 1,
+            aux_arrays={"tables": {"w": np.asarray(coeffs)}},
+        )
+        gen_dir = list_generations(path)[-1][1]
+
+        def bits(a):
+            return np.asarray(a).view(np.uint16)
+
+        # dtype=None: stored dtypes preserved, bit patterns identical
+        kept = load_generation(gen_dir, dtype=None)
+        re_kept = kept["models"]["per-user"]
+        fe_kept = kept["models"]["fixed"].model.coefficients
+        assert str(re_kept.coeffs.dtype) == storage
+        assert str(fe_kept.means.dtype) == storage
+        np.testing.assert_array_equal(bits(re_kept.coeffs), bits(coeffs))
+        np.testing.assert_array_equal(bits(fe_kept.means), bits(means))
+        np.testing.assert_array_equal(bits(fe_kept.variances), bits(variances))
+        assert str(kept["aux"]["tables"]["w"].dtype) == storage
+        np.testing.assert_array_equal(bits(kept["aux"]["tables"]["w"]), bits(coeffs))
+
+        # the default restore is the exact f32 upcast (reduced -> f32 is
+        # lossless), through the rollback-capable load path too
+        restored = load_checkpoint(path)
+        np.testing.assert_array_equal(
+            np.asarray(restored["models"]["per-user"].coeffs),
+            np.asarray(coeffs, dtype=np.float32),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored["models"]["fixed"].model.coefficients.means),
+            np.asarray(means, dtype=np.float32),
+        )
+
+    def test_reduced_dtype_artifacts_still_integrity_checked(self, rng, tmp_path):
+        from photon_ml_tpu.resilience import corrupt_file
+
+        coeffs = jnp.asarray(rng.normal(size=(3, 2)), dtype=jnp.bfloat16)
+        models = {
+            "re": RandomEffectModel(
+                re_type="userId",
+                feature_shard_id="per-user",
+                task=TaskType.LOGISTIC_REGRESSION,
+                entity_ids=(0, 1, 2),
+                coeffs=coeffs,
+                proj_indices=jnp.asarray(np.zeros((3, 2)), dtype=jnp.int32),
+            )
+        }
+        path = str(tmp_path / "c")
+        save_checkpoint(path, models, 1)
+        gen_dir = list_generations(path)[-1][1]
+        corrupt_file(os.path.join(gen_dir, "re.npz"))
+        with pytest.raises(CheckpointCorruption):
+            load_generation(gen_dir)
+
     def test_old_dir_recovered_after_crash_between_renames(self, rng, tmp_path):
         # simulate a crash between rename(final, old) and rename(tmp, final):
         # only the .old directory exists
@@ -536,6 +621,38 @@ class TestResume:
             np.asarray(resumed.model.get_model("per-user").coeffs),
             np.asarray(full.model.get_model("per-user").coeffs),
         )
+        assert resumed.best_metric == full.best_metric
+
+    def test_bf16_storage_run_resumes_to_identical_result(self, rng, tmp_path):
+        """The lifted refusal, end to end: re_precision='bf16' combined with
+        checkpoint_directory (refused before the reduced-dtype encoding)
+        trains, checkpoints, and RESUMES to bitwise-identical coefficients —
+        the bf16-deployment-survives-restart contract of ROADMAP item 5."""
+        import dataclasses as dc
+
+        data = _game_input(rng)
+        train = data.select(np.arange(0, 450))
+        val = data.select(np.arange(450, 600))
+
+        def bf16_estimator(n_iterations, ckpt_dir=None):
+            est = _estimator(n_iterations, ckpt_dir=ckpt_dir)
+            return dc.replace(est, re_precision="bf16")
+
+        full = bf16_estimator(3).fit(train, validation_data=val)[0]
+        ckpt = str(tmp_path / "ck")
+        bf16_estimator(2, ckpt_dir=ckpt).fit(train, validation_data=val)
+        restored = load_checkpoint(os.path.join(ckpt, "config_0"), dtype=None)
+        # the checkpointed table is genuinely reduced on disk
+        assert str(restored["models"]["per-user"].coeffs.dtype) == "bfloat16"
+        resumed = bf16_estimator(3, ckpt_dir=ckpt).fit(train, validation_data=val)[0]
+        np.testing.assert_array_equal(
+            np.asarray(resumed.model.get_model("fixed").model.coefficients.means),
+            np.asarray(full.model.get_model("fixed").model.coefficients.means),
+        )
+        re_full = full.model.get_model("per-user").coeffs
+        re_resumed = resumed.model.get_model("per-user").coeffs
+        assert re_full.dtype == re_resumed.dtype
+        np.testing.assert_array_equal(np.asarray(re_resumed), np.asarray(re_full))
         assert resumed.best_metric == full.best_metric
 
     def test_completed_checkpoint_short_circuits(self, rng, tmp_path):

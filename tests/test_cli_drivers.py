@@ -305,6 +305,56 @@ class TestTrainScorePipeline:
         recs = list(avro_io.read_container_dir(str(out / "scores")))
         assert len(recs) == 300
 
+    def test_serving_driver_fleet_mode_replays_bitwise(self, fixture_dir, trained):
+        """--fleet-replicas 2 --fleet-http-port 0: the replay runs through the
+        ModelRouter's replica set with the HTTP endpoint live; scores are
+        BITWISE identical to the single-frontend replay of the same
+        generation, and the stats JSON carries the sheds-by-cause breakout,
+        per-generation served counts, and the HTTP endpoint address."""
+        from photon_ml_tpu.cli import serving_driver
+        from photon_ml_tpu.serving import clear_engine_cache
+
+        clear_engine_cache()
+        ckpt_root = str(fixture_dir / "ckpt" / "config_0")
+        out = fixture_dir / "serving-fleet-out"
+        chunk = 64
+        result = serving_driver.run(serving_driver.build_arg_parser().parse_args([
+            "--checkpoint-directory", ckpt_root,
+            "--input-data-directories", str(fixture_dir / "validate.avro"),
+            "--root-output-directory", str(out),
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--index-map-directory", str(trained / "index-maps"),
+            "--serving-request-batch", str(chunk),
+            "--serving-max-wait-ms", "1.0",
+            "--fleet-replicas", "2",
+            "--fleet-http-port", "0",
+        ]))
+        stats = result["stats"]
+        assert stats["requests_shed"] == 0
+        assert stats["requests_served"] == -(-300 // chunk)
+        assert stats["sheds_by_cause"] == {
+            "overload": 0, "deadline": 0, "quota": 0, "shutdown": 0,
+        }
+        gen = stats["generations_served"][-1]
+        assert stats["served_by_generation"].get(gen) == stats["requests_served"]
+        assert ":" in stats["http_endpoint"]
+        scores = result["scores"]
+        assert not np.isnan(scores).any()
+
+        # bitwise vs the single-frontend replay of the same generation
+        clear_engine_cache()
+        ref = serving_driver.run(serving_driver.build_arg_parser().parse_args([
+            "--checkpoint-directory", ckpt_root,
+            "--input-data-directories", str(fixture_dir / "validate.avro"),
+            "--root-output-directory", str(out) + "-ref",
+            "--feature-shard-configurations", "name=shardA,feature.bags=features",
+            "--index-map-directory", str(trained / "index-maps"),
+            "--serving-request-batch", str(chunk),
+            "--serving-max-wait-ms", "1.0",
+        ]))
+        assert scores.dtype == ref["scores"].dtype
+        np.testing.assert_array_equal(scores, ref["scores"])
+
     def test_serving_driver_requires_index_maps(self, fixture_dir, trained, tmp_path):
         from photon_ml_tpu.cli import serving_driver
 

@@ -1,0 +1,748 @@
+"""Serving fleet (photon_ml_tpu/serving/fleet.py + transport.py): multi-model
+routing with layered admission (per-tenant token buckets, per-model budgets,
+priority classes), replica round-robin with overload failover, replica-at-a-
+time rolling hot-swap with canary gating + blacklist, and the HTTP transport.
+
+The load-bearing property throughout, inherited from the frontend tests: a
+response served through ANY fleet layer is BITWISE what a direct engine call
+on the same request against the serving generation returns.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.io.checkpoint import save_checkpoint
+from photon_ml_tpu.models.glm import Coefficients
+from photon_ml_tpu.resilience import Retry, armed, corrupt_file
+from photon_ml_tpu.serving import (
+    FleetClient,
+    FleetHTTPServer,
+    FrontendConfig,
+    GenerationWatcher,
+    ModelRouter,
+    Overloaded,
+    QuotaExceeded,
+    ReplicaSet,
+    TenantQuota,
+    TokenBucket,
+    clear_engine_cache,
+    decode_game_input,
+    encode_game_input,
+)
+
+from tests.test_hotswap import build_models, corrupt_generation, make_req
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine_cache():
+    clear_engine_cache()
+    yield
+    clear_engine_cache()
+
+
+FAST_RETRY = Retry(max_attempts=3, base_delay=0.0, sleep=lambda s: None, seed=0)
+
+
+def build_fleet(tmp_path, rng, n_replicas=2, name="m", subdir="ckpt", **kwargs):
+    root = str(tmp_path / subdir)
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    rs = ReplicaSet.from_checkpoint(
+        root, n_replicas, name=name, config=FrontendConfig(max_wait_ms=0.0),
+        retry=kwargs.pop("retry", FAST_RETRY), **kwargs,
+    )
+    return root, rs
+
+
+def poison_models(models):
+    """Valid-checksum NaN poisoning: the trainer-bug class only the canary's
+    live-score health gate can catch."""
+    out = dict(models)
+    fe = models["fixed"]
+    glm = fe.model
+    out["fixed"] = dataclasses.replace(
+        fe,
+        model=type(glm)(
+            Coefficients(means=jnp.full_like(glm.coefficients.means, jnp.nan))
+        ),
+    )
+    return out
+
+
+# ------------------------------------------------------------- token bucket
+
+
+def test_token_bucket_deterministic_refill():
+    t = [0.0]
+    b = TokenBucket(rate=2.0, burst=3.0, clock=lambda: t[0])
+    assert [b.try_take() for _ in range(4)] == [True, True, True, False]
+    t[0] = 1.0  # 2 tokens refilled
+    assert b.try_take() and b.try_take() and not b.try_take()
+    t[0] = 100.0  # refill clamps at burst
+    assert [b.try_take() for _ in range(4)] == [True, True, True, False]
+
+
+def test_token_bucket_validates():
+    with pytest.raises(ValueError, match="burst"):
+        TokenBucket(rate=1.0, burst=0.0, clock=time.monotonic)
+    with pytest.raises(ValueError, match="rate"):
+        TokenBucket(rate=-1.0, burst=1.0, clock=time.monotonic)
+
+
+# ------------------------------------------------------------------ routing
+
+
+def test_router_parity_and_round_robin(tmp_path, rng):
+    _, rs = build_fleet(tmp_path, rng, n_replicas=3)
+    router = ModelRouter()
+    router.add_model("m", rs)
+    try:
+        reqs = [make_req(rng) for _ in range(6)]
+        for req in reqs:
+            out = router.score("m", req, timeout=30)
+            direct = rs.replicas[0].engine.score(req)
+            assert out.dtype == direct.dtype
+            np.testing.assert_array_equal(out, direct)
+        # round-robin spread the requests across every replica
+        counts = [r.frontend.stats()["served"] for r in rs.replicas]
+        assert counts == [2, 2, 2]
+    finally:
+        router.close()
+
+
+def test_router_unknown_model_and_duplicate_registration(tmp_path, rng):
+    _, rs = build_fleet(tmp_path, rng, n_replicas=1)
+    router = ModelRouter()
+    router.add_model("m", rs)
+    try:
+        with pytest.raises(KeyError, match="unknown model"):
+            router.submit("nope", make_req(rng))
+        with pytest.raises(ValueError, match="already registered"):
+            router.add_model("m", rs)
+        with pytest.raises(ValueError, match="priority"):
+            router.add_model("m2", rs, priority="urgentest")
+    finally:
+        router.close()
+
+
+def test_multi_model_share_one_engine_cache(tmp_path, rng):
+    """Two models registered from the same committed bytes resolve to the
+    SAME engine object (content-keyed get_engine cache): one set of device
+    tables, one compiled program family."""
+    _, rs_a = build_fleet(tmp_path, rng, n_replicas=1, name="a", subdir="ckpt-a")
+    root_b = str(tmp_path / "ckpt-b")
+    # a different RANDOM model would differ; same seed reproduces the bytes
+    save_checkpoint(
+        root_b, build_models(np.random.default_rng(12345), 1.0), 1,
+        keep_generations=8,
+    )
+    save_checkpoint(
+        str(tmp_path / "ckpt-c"), build_models(np.random.default_rng(12345), 1.0), 1,
+        keep_generations=8,
+    )
+    rs_b = ReplicaSet.from_checkpoint(
+        root_b, 1, name="b", config=FrontendConfig(max_wait_ms=0.0))
+    rs_c = ReplicaSet.from_checkpoint(
+        str(tmp_path / "ckpt-c"), 1, name="c", config=FrontendConfig(max_wait_ms=0.0))
+    try:
+        assert rs_b.replicas[0].engine is rs_c.replicas[0].engine
+        assert rs_a.replicas[0].engine is not rs_b.replicas[0].engine
+    finally:
+        rs_a.close()
+        rs_b.close()
+        rs_c.close()
+
+
+def test_tenant_quota_sheds_distinct_from_overload(tmp_path, rng):
+    _, rs = build_fleet(tmp_path, rng, n_replicas=1)
+    router = ModelRouter()
+    router.add_model(
+        "m", rs,
+        tenant_quota=TenantQuota(rate=0.0, burst=2.0),
+        tenant_quotas={"vip": TenantQuota(rate=0.0, burst=100.0)},
+    )
+    try:
+        req = make_req(rng)
+        # default-quota tenant: burst 2 admits, third sheds as QUOTA
+        router.score("m", req, tenant="t1", timeout=30)
+        router.score("m", req, tenant="t1", timeout=30)
+        with pytest.raises(QuotaExceeded, match="exceeded its quota"):
+            router.submit("m", req, tenant="t1")
+        # buckets are per tenant: t2 and the vip override still admit
+        router.score("m", req, tenant="t2", timeout=30)
+        for _ in range(5):
+            router.score("m", req, tenant="vip", timeout=30)
+        stats = router.stats()
+        assert stats["shed_quota"] == 1
+        assert stats.get("shed_overload", 0) == 0
+        kinds = [i.kind for i in router.incidents]
+        assert kinds.count("quota-shed") == 1
+        assert "overload" not in kinds
+    finally:
+        router.close()
+
+
+def test_admission_budget_sheds_as_overload(tmp_path, rng):
+    from tests.test_serving_frontend import GatedEngine
+
+    _, rs = build_fleet(tmp_path, rng, n_replicas=1)
+    # gate the replica's engine so in-flight requests accumulate
+    fe = rs.replicas[0].frontend
+    gated = GatedEngine(fe.engine, gated=True)
+    fe.install_engine(gated, fe.generation)
+    router = ModelRouter()
+    router.add_model("m", rs, admission_budget=2)
+    try:
+        req = make_req(rng)
+        futs = [router.submit("m", req) for _ in range(2)]
+        with pytest.raises(Overloaded, match="admission budget"):
+            router.submit("m", req)
+        assert router.stats()["shed_overload"] == 1
+        assert any(i.kind == "overload" for i in router.incidents)
+        gated.gate.set()
+        for f in futs:  # everything admitted is served
+            assert f.result(30) is not None
+        # in-flight accounting drains via done-callbacks: admission reopens
+        deadline = time.monotonic() + 10.0
+        while router.stats()["inflight"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        out = router.score("m", req, timeout=30)
+        np.testing.assert_array_equal(out, gated.inner.score(req))
+    finally:
+        gated.gate.set()
+        router.close()
+
+
+def test_priority_classes_partition_fleet_budget(tmp_path, rng):
+    from tests.test_serving_frontend import GatedEngine
+
+    _, rs = build_fleet(tmp_path, rng, n_replicas=1)
+    fe = rs.replicas[0].frontend
+    gated = GatedEngine(fe.engine, gated=True)
+    fe.install_engine(gated, fe.generation)
+    router = ModelRouter(fleet_budget=4)
+    router.add_model("interactive", rs, priority="interactive")
+    router.add_model("batch", rs, priority="batch")
+    try:
+        req = make_req(rng)
+        futs = [router.submit("interactive", req) for _ in range(2)]
+        # fleet at 2/4 in flight = the batch class's 50% admission cutoff:
+        # batch sheds while interactive still admits
+        with pytest.raises(Overloaded, match="priority 'batch'"):
+            router.submit("batch", req)
+        futs += [router.submit("interactive", req) for _ in range(2)]
+        # ... until the full budget is gone for everyone
+        with pytest.raises(Overloaded, match="priority 'interactive'"):
+            router.submit("interactive", req)
+        gated.gate.set()
+        for f in futs:
+            assert f.result(30) is not None
+    finally:
+        gated.gate.set()
+        router.close()
+
+
+def test_replica_overload_fails_over_to_next(tmp_path, rng):
+    """One replica at queue depth must not shed the fleet: the router's
+    round-robin retries the other replica before propagating Overloaded."""
+    from tests.test_serving_frontend import GatedEngine
+
+    root = str(tmp_path / "ckpt")
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    rs = ReplicaSet.from_checkpoint(
+        root, 2, name="m",
+        config=FrontendConfig(max_wait_ms=0.0, max_queue_depth=1),
+    )
+    router = ModelRouter()
+    router.add_model("m", rs)
+    try:
+        req = make_req(rng)
+        # wedge replica 0: one in-flight + one queued = at depth
+        fe0 = rs.replicas[0].frontend
+        gated = GatedEngine(fe0.engine, gated=True)
+        fe0.install_engine(gated, fe0.generation)
+        wedged = fe0.submit(req)
+        assert gated.entered.wait(10.0)
+        queued = fe0.submit(req)
+        # router submissions starting at replica 0 fail over to replica 1
+        outs = [router.score("m", req, timeout=30) for _ in range(3)]
+        direct = rs.replicas[1].engine.score(req)
+        for out in outs:
+            np.testing.assert_array_equal(out, direct)
+        # the failed-over sheds are still visible in replica 0's log
+        assert rs.replicas[0].frontend.stats()["shed_overload"] >= 1
+        gated.gate.set()
+        assert wedged.result(30) is not None and queued.result(30) is not None
+    finally:
+        gated.gate.set()
+        router.close()
+
+
+# --------------------------------------------------------- rolling hot-swap
+
+
+def test_rolling_swap_converges_all_replicas_bitwise(tmp_path, rng):
+    root, rs = build_fleet(tmp_path, rng, n_replicas=3)
+    router = ModelRouter()
+    router.add_model("m", rs)
+    try:
+        reqs = [make_req(rng) for _ in range(4)]
+        for req in reqs:  # live shapes + mirror pool
+            router.score("m", req, timeout=30)
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        assert rs.check_once() is True
+        assert rs.generations == [2, 2, 2] and rs.converged
+        assert rs.rollouts_completed == 1
+        eng2 = rs.replicas[0].engine
+        for req in reqs:
+            out = router.score("m", req, timeout=30)
+            assert out.dtype == eng2.score(req).dtype
+            np.testing.assert_array_equal(out, eng2.score(req))
+        # nothing new -> no-op
+        assert rs.check_once() is False
+    finally:
+        router.close()
+
+
+def test_rolling_swap_spans_generations_under_traffic(tmp_path, rng):
+    """Concurrent traffic across the roll: every response bitwise matches the
+    engine of the generation that served it; zero drops."""
+    root, rs = build_fleet(tmp_path, rng, n_replicas=2)
+    router = ModelRouter()
+    router.add_model("m", rs)
+    engines = {1: rs.replicas[0].engine}
+    reqs = [make_req(rng) for _ in range(4)]
+    served, errors = [], []
+    stop = threading.Event()
+
+    def client(cid):
+        i = 0
+        while not stop.is_set():
+            req = reqs[(cid + i) % len(reqs)]
+            i += 1
+            try:
+                fut = router.submit("m", req)
+                served.append((req, fut.result(30), fut.generation))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(2)]
+    try:
+        for req in reqs:
+            router.score("m", req, timeout=30)
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0
+        while len(served) < 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        assert rs.check_once() is True
+        deadline = time.monotonic() + 30.0
+        while not any(g == 2 for _, _, g in list(served)) and (
+            time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        engines[2] = rs.replicas[0].engine
+        assert not errors
+        gens = {g for _, _, g in served}
+        assert 1 in gens and 2 in gens  # the stream spanned the roll
+        for req, out, g in served:
+            direct = engines[g].score(req)
+            assert out.dtype == direct.dtype
+            np.testing.assert_array_equal(out, direct)
+    finally:
+        stop.set()
+        router.close()
+
+
+def test_canary_rejects_poisoned_generation_and_blacklists(tmp_path, rng):
+    """A NaN-poisoned commit passes every checksum; the canary's live-score
+    health gate catches it, flips the canary BACK, blacklists fleet-wide."""
+    root, rs = build_fleet(tmp_path, rng, n_replicas=3)
+    router = ModelRouter()
+    router.add_model("m", rs)
+    try:
+        reqs = [make_req(rng) for _ in range(3)]
+        for req in reqs:
+            router.score("m", req, timeout=30)
+        before = router.score("m", reqs[0], timeout=30)
+        save_checkpoint(root, poison_models(build_models(rng, 2.0)), 2,
+                        keep_generations=8)
+        assert rs.check_once() is False
+        assert rs.generations == [1, 1, 1]  # canary flipped back
+        assert rs.bad_generations == {2}
+        assert rs.rollbacks == 1
+        assert any(i.kind == "canary-reject" for i in rs.incidents)
+        # serving never blinked, and the bad generation is never re-tried
+        np.testing.assert_array_equal(router.score("m", reqs[0], timeout=30), before)
+        assert rs.check_once() is False
+        # a LATER good generation still rolls
+        save_checkpoint(root, build_models(rng, 3.0), 3, keep_generations=8)
+        assert rs.check_once() is True
+        assert rs.generations == [3, 3, 3]
+    finally:
+        router.close()
+
+
+def test_canary_serving_path_parity_is_gated(tmp_path, rng):
+    """The canary gate's OTHER clause: live scores through the flipped canary
+    must be bitwise the candidate engine's direct answer. Sabotage the
+    candidate's serving path (an engine wrapper that perturbs one ulp) and
+    the rollout must reject."""
+    from photon_ml_tpu.serving import fleet as fleet_mod
+
+    root, rs = build_fleet(tmp_path, rng, n_replicas=2)
+    try:
+        req = make_req(rng)
+        rs.replicas[0].frontend.score(req, timeout=30)
+        rs._mirror.append(("score", True, req))
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+
+        real_get_engine = fleet_mod.get_engine
+
+        class SkewedEngine:
+            """Engine whose FRONTEND-visible scores differ from its direct
+            scores by one ulp — a broken serving path in miniature."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.mesh = inner.mesh
+                self.min_batch_pad = inner.min_batch_pad
+                self.precision = inner.precision
+                self.fingerprint = inner.fingerprint + "-skewed"
+                self._direct = True
+
+            def bucket(self, n):
+                return self.inner.bucket(n)
+
+            def score(self, data, include_offsets=True):
+                out = self.inner.score(data, include_offsets=include_offsets)
+                import threading as _t
+
+                if _t.current_thread().name.startswith("photon-serving-dispatch"):
+                    return np.nextafter(out, np.inf)  # live path perturbed
+                return out
+
+            def predict(self, data):
+                return self.inner.predict(data)
+
+        def skewing_get_engine(model, **kwargs):
+            return SkewedEngine(real_get_engine(model, **kwargs))
+
+        fleet_mod.get_engine = skewing_get_engine
+        try:
+            assert rs.check_once() is False
+        finally:
+            fleet_mod.get_engine = real_get_engine
+        assert rs.generations == [1, 1]
+        assert rs.bad_generations == {2}
+        rejects = [i for i in rs.incidents if i.kind == "canary-reject"]
+        assert rejects and "serving-path parity" in rejects[0].cause
+    finally:
+        rs.close()
+
+
+def test_integrity_failure_rolls_back_and_blacklists(tmp_path, rng):
+    root, rs = build_fleet(tmp_path, rng, n_replicas=2)
+    try:
+        req = make_req(rng)
+        rs.replicas[0].frontend.score(req, timeout=30)
+        gen2 = save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        corrupt_generation(gen2)
+        assert rs.check_once() is False
+        assert rs.generations == [1, 1]
+        assert rs.bad_generations == {2}
+        assert any(i.kind == "fleet-rollback" for i in rs.incidents)
+    finally:
+        rs.close()
+
+
+def test_transient_fault_retries_without_blacklist(tmp_path, rng):
+    """A transient I/O fault exhausting the retry budget rolls back WITHOUT
+    blacklisting (the environment failed, not the generation); the next poll
+    rolls."""
+    root, rs = build_fleet(tmp_path, rng, n_replicas=2)
+    try:
+        req = make_req(rng)
+        rs.replicas[0].frontend.score(req, timeout=30)
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        with armed("serve.fleet.canary:raise:1x*"):
+            assert rs.check_once() is False
+        assert rs.bad_generations == set()
+        assert rs.generations == [1, 1]
+        assert rs.check_once() is True  # I/O recovered -> rolls
+        assert rs.generations == [2, 2]
+        # a transient absorbed WITHIN the budget doesn't even roll back
+        save_checkpoint(root, build_models(rng, 3.0), 3, keep_generations=8)
+        with armed("serve.fleet.canary:raise:1"):
+            assert rs.check_once() is True
+        assert rs.generations == [3, 3]
+    finally:
+        rs.close()
+
+
+def test_canary_shed_under_load_rolls_back_without_blacklist(tmp_path, rng):
+    """A canary evaluation shed (Overloaded/DeadlineExceeded from the
+    canary's live queue) is LOAD, not bad bytes: roll back, do NOT
+    blacklist — the next poll (queue drained) must still roll the
+    generation. (Review finding: these RuntimeErrors used to blacklist a
+    healthy generation forever.)"""
+    from photon_ml_tpu.serving import Replica, ServingFrontend, get_engine
+    from photon_ml_tpu.serving.hotswap import (
+        model_from_state,
+        newest_valid_generation,
+    )
+
+    root = str(tmp_path / "ckpt")
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    _, state = newest_valid_generation(root)
+    engine = get_engine(model_from_state(state))
+    # the canary's config sheds EVERY submission at admission (expired
+    # deadline) — the shape of a queue under crushing load
+    canary_fe = ServingFrontend(
+        engine, FrontendConfig(max_wait_ms=0.0, default_deadline_ms=-1.0),
+        generation=1,
+    )
+    other_fe = ServingFrontend(engine, FrontendConfig(max_wait_ms=0.0), generation=1)
+    rs = ReplicaSet(
+        "m", root,
+        [Replica("m/r0", canary_fe), Replica("m/r1", other_fe)],
+        retry=FAST_RETRY,
+    )
+    try:
+        rs._mirror.append(("score", True, make_req(rng)))
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        assert rs.check_once() is False
+        assert rs.bad_generations == set()  # the load was at fault, not gen 2
+        assert rs.generations == [1, 1]  # canary flipped back
+        rollback = [i for i in rs.incidents if i.kind == "fleet-rollback"]
+        assert rollback and "will retry generation 2" in rollback[0].action
+        # load clears -> the very next poll rolls the same generation
+        canary_fe.config.default_deadline_ms = None
+        assert rs.check_once() is True
+        assert rs.generations == [2, 2]
+    finally:
+        rs.close()
+
+
+def test_crash_mid_roll_leaves_consistent_fleet_then_converges(tmp_path, rng):
+    """A crash between replica flips (serve.fleet.roll) leaves a MIXED fleet
+    in which each replica serves its own generation bitwise-correctly, does
+    NOT blacklist (the generation passed canary), and the next poll
+    converges the stragglers."""
+    from photon_ml_tpu.resilience import InjectedCrash
+
+    root, rs = build_fleet(tmp_path, rng, n_replicas=3)
+    try:
+        reqs = [make_req(rng) for _ in range(3)]
+        for i, req in enumerate(reqs):
+            rs.replicas[i % 3].frontend.score(req, timeout=30)
+            rs._mirror.append(("score", True, req))
+        eng1 = rs.replicas[0].engine
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        with armed("serve.fleet.roll:crash:1"):
+            assert rs.check_once() is False
+        # canary flipped, the rest did not: mixed but CONSISTENT
+        assert sorted(rs.generations) == [1, 1, 2]
+        assert rs.bad_generations == set()
+        eng2 = next(r.engine for r in rs.replicas if r.generation == 2)
+        for r in rs.replicas:
+            out = r.frontend.score(reqs[0], timeout=30)
+            expected = (eng2 if r.generation == 2 else eng1).score(reqs[0])
+            np.testing.assert_array_equal(out, expected)
+        assert any(i.kind == "fleet-rollback" for i in rs.incidents)
+        # next poll converges the stragglers
+        assert rs.check_once() is True
+        assert rs.generations == [2, 2, 2]
+    finally:
+        rs.close()
+
+
+def test_generation_watcher_drives_fleet_rollouts(tmp_path, rng):
+    """GenerationWatcher's manager duck type: a ReplicaSet (and the router)
+    plug in unchanged."""
+    root, rs = build_fleet(tmp_path, rng, n_replicas=2)
+    router = ModelRouter()
+    router.add_model("m", rs)
+    try:
+        req = make_req(rng)
+        router.score("m", req, timeout=30)
+        with GenerationWatcher(router, poll_interval_s=0.05):
+            save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+            deadline = time.monotonic() + 30.0
+            while not (rs.converged and rs.generations[0] == 2) and (
+                time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+        assert rs.generations == [2, 2]
+        out = router.score("m", req, timeout=30)
+        np.testing.assert_array_equal(out, rs.replicas[0].engine.score(req))
+    finally:
+        router.close()
+
+
+def test_replica_set_validates(tmp_path, rng):
+    root = str(tmp_path / "ckpt")
+    with pytest.raises(FileNotFoundError, match="no valid checkpoint"):
+        ReplicaSet.from_checkpoint(root, 2)
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    with pytest.raises(ValueError, match="n_replicas"):
+        ReplicaSet.from_checkpoint(root, 0)
+    with pytest.raises(ValueError, match="at least one replica"):
+        ReplicaSet("m", root, [])
+
+
+# ---------------------------------------------------------------- transport
+
+
+def test_codec_round_trips_bitwise(rng):
+    req = make_req(rng, 9)
+    body = encode_game_input(req, include_offsets=False)
+    # JSON round trip: exactly what crosses the wire
+    import json as _json
+
+    decoded, include_offsets = decode_game_input(_json.loads(_json.dumps(body)))
+    assert include_offsets is False
+    assert sorted(decoded.features) == sorted(req.features)
+    np.testing.assert_array_equal(
+        decoded.features["global"], np.asarray(req.features["global"])
+    )
+    got = decoded.features["re_shard"]
+    want = req.features["re_shard"].tocsr()
+    assert got.dtype == want.dtype
+    np.testing.assert_array_equal(got.data, want.data)
+    np.testing.assert_array_equal(got.indices, want.indices)
+    np.testing.assert_array_equal(got.indptr, want.indptr)
+    assert decoded.offsets.dtype == np.asarray(req.offsets).dtype
+    np.testing.assert_array_equal(decoded.offsets, req.offsets)
+    np.testing.assert_array_equal(decoded.id_columns["userId"], req.id_columns["userId"])
+
+
+def test_codec_object_str_ids_convert_and_mixed_refused(rng):
+    from photon_ml_tpu.serving.transport import decode_array, encode_array
+
+    # Avro readers hand string entity ids back as object-of-str arrays:
+    # those must cross the wire (as their '<U*' form, same ids)
+    ids = np.asarray(["u1", "u22", "u3"], dtype=object)
+    got = decode_array(encode_array(ids))
+    assert got.dtype.kind == "U"
+    assert got.tolist() == ["u1", "u22", "u3"]
+    # anything else object-typed stays refused — no pickling on the wire
+    with pytest.raises(TypeError, match="object arrays"):
+        encode_array(np.asarray(["a", 1], dtype=object))
+
+
+def test_http_score_predict_bitwise_and_error_mapping(tmp_path, rng):
+    from photon_ml_tpu.serving import DeadlineExceeded
+
+    _, rs = build_fleet(tmp_path, rng, n_replicas=2)
+    router = ModelRouter()
+    router.add_model(
+        "m", rs, tenant_quotas={"capped": TenantQuota(rate=0.0, burst=1.0)}
+    )
+    try:
+        with FleetHTTPServer(router, port=0) as srv:
+            client = FleetClient(srv.host, srv.port)
+            assert client.healthy()
+            req = make_req(rng)
+            eng = rs.replicas[0].engine
+            out, gen = client.score("m", req)
+            direct = eng.score(req)
+            assert gen == 1
+            assert out.dtype == direct.dtype
+            np.testing.assert_array_equal(out, direct)
+            pred, _ = client.predict("m", req)
+            dpred = eng.predict(req)
+            assert pred.dtype == dpred.dtype
+            np.testing.assert_array_equal(pred, dpred)
+            # include_offsets rides the body
+            out_no_off, _ = client.score("m", req, include_offsets=False)
+            np.testing.assert_array_equal(
+                out_no_off, eng.score(req, include_offsets=False)
+            )
+            # error taxonomy over the wire
+            with pytest.raises(KeyError):
+                client.score("nope", req)
+            client.score("m", req, tenant="capped")
+            with pytest.raises(QuotaExceeded):
+                client.score("m", req, tenant="capped")
+            with pytest.raises(DeadlineExceeded):
+                client.score("m", req, deadline_ms=0.0)
+            assert client.models() == {"m": {"generations": [1, 1]}}
+            stats = client.stats()
+            assert stats["shed_quota"] == 1
+            assert stats["models"]["m"]["generations"] == [1, 1]
+    finally:
+        router.close()
+
+
+def test_http_serves_across_rolling_swap(tmp_path, rng):
+    root, rs = build_fleet(tmp_path, rng, n_replicas=2)
+    router = ModelRouter()
+    router.add_model("m", rs)
+    try:
+        with FleetHTTPServer(router, port=0) as srv:
+            client = FleetClient(srv.host, srv.port)
+            req = make_req(rng)
+            out1, gen1 = client.score("m", req)
+            assert gen1 == 1
+            save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+            assert rs.check_once() is True
+            out2, gen2 = client.score("m", req)
+            assert gen2 == 2
+            direct = rs.replicas[0].engine.score(req)
+            assert out2.dtype == direct.dtype
+            np.testing.assert_array_equal(out2, direct)
+            assert not np.array_equal(out1, out2)
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------------- fleet CLI mode
+
+
+def test_serving_driver_fleet_flags_parse(tmp_path):
+    """The shared --fleet-* flag block rides add_serving_arguments (the
+    end-to-end fleet replay lives in tests/test_cli_drivers.py, on the
+    trained fixture)."""
+    from photon_ml_tpu.cli import serving_driver
+
+    args = serving_driver.build_arg_parser().parse_args([
+        "--checkpoint-directory", str(tmp_path / "ckpt"),
+        "--input-data-directories", str(tmp_path / "in"),
+        "--root-output-directory", str(tmp_path / "out"),
+        "--feature-shard-configurations", "name=global,feature.bags=features",
+        "--fleet-replicas", "2",
+        "--fleet-http-port", "0",
+    ])
+    assert args.fleet_replicas == 2 and args.fleet_http_port == 0
+
+
+def test_sheds_by_cause_breakout_shapes():
+    from photon_ml_tpu.cli.serving_driver import _served_by_generation, _sheds_by_cause
+
+    stats = {
+        "shed_overload": 1,
+        "shed_deadline": 2,
+        "served_by_generation": {1: 5},
+        "models": {
+            "m": {
+                "shed_overload": 3,
+                "shed_shutdown": 4,
+                "served_by_generation": {"1": 2, "2": 7},
+            }
+        },
+        "shed_quota": 6,
+    }
+    assert _sheds_by_cause(stats) == {
+        "overload": 4, "deadline": 2, "quota": 6, "shutdown": 4,
+    }
+    assert _served_by_generation(stats) == {1: 7, 2: 7}
