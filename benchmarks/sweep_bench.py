@@ -40,6 +40,20 @@ Reported, per the honest-ratio rules (docs/PERFORMANCE.md):
   one program family each, population axis within) must pick a winner and
   commit a generational checkpoint that ``serving/hotswap.
   serve_from_checkpoint`` actually serves (one scored probe per family).
+- ``early_exit`` — per-lane early exit ON vs OFF through the SAME compiled
+  fused program at a heterogeneous-convergence shape: winner unchanged,
+  surviving lanes bitwise, frozen lanes' solver iterations strictly reduced
+  (all hard gates); the wall-clock ratio is gated ``>= 1.0`` at the default
+  shape and informational under ``--smoke``, always reported NEXT TO the
+  freeze fraction (docs/PERFORMANCE.md early-exit rules).
+- ``warm_start`` — glmnet-style warm paths across Bayesian rounds vs a
+  cold sweep of the same shape: total solver iterations must drop (a
+  deterministic counter, not wall-clock).
+
+``--mesh-devices N`` switches to the population x mesh gate set instead
+(``run_mesh``): settings axis sharded over N (emulated) devices —
+zero-data-collective compile audit, run-to-run bitwise determinism,
+cross-layout metric tolerance, zero steady retraces.
 
 Run directly (``python benchmarks/sweep_bench.py``) or as
 ``python bench.py --sweep``. ``--smoke`` shrinks everything for the CI gate
@@ -228,6 +242,129 @@ def _native_sequential(estimator, train_input, validation_input, history, cd_ite
     return time.perf_counter() - t0, metrics
 
 
+def _heterogeneous_settings(population: int) -> list:
+    """Lanes spanning the full LOG l2 range in opposite directions: huge-l2
+    lanes converge in a pass or two, tiny-l2 lanes keep descending — the
+    convergence-heterogeneous regime early exit exists for."""
+    l2s = np.logspace(np.log10(0.01), np.log10(100.0), population)
+    return [
+        {"global.l2": float(a), "per-user.l2": float(b)}
+        for a, b in zip(l2s, l2s[::-1])
+    ]
+
+
+def _early_exit_block(estimator, train_input, validation_input, population,
+                      ee_iterations, reps, freeze_tol) -> dict:
+    """Early exit ON vs OFF through the SAME compiled fused program
+    (freeze_tol is traced): timed after warmup, winner-unchanged and
+    iteration-reduction gated, wall-clock ratio reported (it is the
+    models_evaluated_per_sec multiplier at this shape — the denominator
+    work (rounds x population) is identical on both sides)."""
+    from photon_ml_tpu.sweep import EarlyExitConfig
+    from photon_ml_tpu.sweep.population import PopulationTrainer
+
+    datasets = estimator.prepare_training_datasets(train_input)
+    trainer = PopulationTrainer(
+        estimator, datasets, np.asarray(train_input.offsets), seed=5
+    )
+    scoring = estimator.prepare_scoring_datasets(validation_input)
+    suite = estimator.prepare_evaluation_suite(validation_input)
+    settings = _heterogeneous_settings(population)
+    off = EarlyExitConfig(freeze_tol=-1.0)
+    on = EarlyExitConfig(freeze_tol=freeze_tol)
+
+    def drive(cfg):
+        pop = trainer.train(
+            settings, n_iterations=ee_iterations, fused=True, early_exit=cfg
+        )
+        totals = np.asarray(trainer.score_population(pop, scoring))
+        metrics = [
+            suite.evaluate(totals[p])[suite.primary.name]
+            for p in range(pop.population)
+        ]
+        winner = int(np.argmax(metrics)) if suite.primary.larger_is_better \
+            else int(np.argmin(metrics))
+        return pop, winner
+
+    drive(off), drive(on)  # warmup: one compile covers both (traced tol)
+
+    def timed(cfg):
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            pop, winner = drive(cfg)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return pop, winner, best
+
+    pop_off, winner_off, t_off = timed(off)
+    pop_on, winner_on, t_on = timed(on)
+    frozen = pop_on.frozen_at >= 0
+    return {
+        "population": population,
+        "cd_iterations": ee_iterations,
+        "freeze_fraction": round(pop_on.freeze_fraction, 4),
+        "winner_unchanged": bool(winner_off == winner_on),
+        "solver_iterations_off": int(pop_off.lane_iterations.sum()),
+        "solver_iterations_on": int(pop_on.lane_iterations.sum()),
+        "survivors_bitwise": all(
+            np.array_equal(
+                np.asarray(pop_on.coeffs[cid])[~frozen],
+                np.asarray(pop_off.coeffs[cid])[~frozen],
+            )
+            for cid in pop_on.coeffs
+        ),
+        "models_per_sec_off": round(population / t_off, 3),
+        "models_per_sec_on": round(population / t_on, 3),
+        "early_exit_speedup": round(t_off / t_on, 3),
+    }
+
+
+def _warm_start_block(estimator, spec, workdir, train_input, validation_input,
+                      rounds, population, seed) -> dict:
+    """Warm-started (glmnet-paths-across-rounds) vs cold-started sweep at
+    the SAME shape: total solver iterations recorded for both; the reduction
+    gate is deterministic (iteration counts are not wall-clock). Runs at
+    >= 5 rounds regardless of the headline shape: nearest-prior seeding
+    only pays once the GP's proposals CONCENTRATE (early rounds' priors sit
+    too far away and are distance-gated to cold starts —
+    SweepConfig.warm_start_max_distance), which takes a few rounds."""
+    from photon_ml_tpu.sweep import SweepConfig, SweepRunner
+
+    ws_rounds = max(rounds, 5)
+
+    def sweep(tag, warm):
+        runner = SweepRunner(
+            estimator, spec,
+            SweepConfig(
+                checkpoint_directory=os.path.join(workdir, f"ws-{tag}"),
+                rounds=ws_rounds, population=population, seed=seed,
+                n_iterations=1, warm_start=warm, fused=True,
+            ),
+        )
+        return runner.run(train_input, validation_input)
+
+    cold = sweep("cold", False)
+    warm = sweep("warm", True)
+    return {
+        "rounds": ws_rounds,
+        "population": population,
+        "cold_total_solver_iterations": cold.total_solver_iterations,
+        "warm_total_solver_iterations": warm.total_solver_iterations,
+        "iteration_reduction": (
+            round(
+                1.0
+                - warm.total_solver_iterations / cold.total_solver_iterations,
+                4,
+            )
+            if cold.total_solver_iterations
+            else None
+        ),
+        "cold_winner_metric": cold.winner_metric,
+        "warm_winner_metric": warm.winner_metric,
+    }
+
+
 def _family_sweeps(workdir: str, smoke: bool) -> dict:
     """Tiny end-to-end sweep per GLM family: winner committed as a
     generational checkpoint, then ACTUALLY served through the hot-swap
@@ -356,11 +493,37 @@ def run(args) -> dict:
 
         families = _family_sweeps(workdir, smoke=args.smoke)
 
+        # early exit at a heterogeneous-convergence shape (same compiled
+        # program both sides; wall-clock gated only at the non-smoke shape)
+        early_exit = _early_exit_block(
+            estimator, train_input, validation_input,
+            population=args.population, ee_iterations=args.ee_iterations,
+            reps=args.ee_reps, freeze_tol=args.ee_freeze_tol,
+        )
+        # warm-started regularization paths across rounds vs a cold-started
+        # sweep of the same shape (iteration counts are deterministic, so
+        # the reduction is a hard gate)
+        warm = _warm_start_block(
+            estimator, spec, workdir, train_input, validation_input,
+            args.rounds, args.population, args.seed,
+        )
+
         gates = {
             "parity_bitwise": bool(parity),
             "retraces_after_warmup": int(retraces),
             "native_metric_max_delta": round(metric_delta, 8),
             "families_served": all(f["served"] for f in families.values()),
+            "early_exit_winner_unchanged": early_exit["winner_unchanged"],
+            "early_exit_survivors_bitwise": early_exit["survivors_bitwise"],
+            "early_exit_freeze_fraction": early_exit["freeze_fraction"],
+            "early_exit_iters_reduced": bool(
+                early_exit["solver_iterations_on"]
+                < early_exit["solver_iterations_off"]
+            ),
+            "warm_start_iters_reduced": bool(
+                warm["warm_total_solver_iterations"]
+                < warm["cold_total_solver_iterations"]
+            ),
         }
         return {
             "metric": "models_evaluated_per_sec",
@@ -379,11 +542,118 @@ def run(args) -> dict:
             "winner": result.winner_settings,
             "winner_metric": result.winner_metric,
             "families": families,
+            "early_exit": early_exit,
+            "warm_start": warm,
             **gates,
             "platform": jax.default_backend(),
         }
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
+
+
+def run_mesh(args) -> dict:
+    """``--mesh-devices N``: the population x mesh gates. The fused sweep
+    program with the SETTINGS axis sharded over N devices (emulated on CPU
+    backends) must (a) compile with ZERO data collectives — lanes are
+    independent by construction, so the compiled module must show it
+    (``hlo_guards.assert_settings_axis_collective_free``; the batched
+    while_loops' single-element convergence-predicate all-reduces are the
+    one tolerated op); (b) be run-to-run BITWISE deterministic within the
+    mesh layout; (c) agree with the host (1-device) layout's per-lane
+    metrics within tolerance — cross-layout comparisons are never bitwise
+    (the PR 10 contract: XLA re-vectorizes per lane-block width); and (d)
+    dispatch with zero steady-state retraces. Throughput columns are
+    informational on emulated devices; the gates are the point."""
+    import jax
+
+    from photon_ml_tpu.analysis.runtime_guard import sync_discipline
+    from photon_ml_tpu.parallel import hlo_guards
+    from photon_ml_tpu.parallel.mesh import make_mesh
+    from photon_ml_tpu.sweep.population import PopulationTrainer
+
+    train_input, validation_input = build_inputs(
+        "LOGISTIC_REGRESSION", args.samples, args.validation, args.users,
+        args.features,
+    )
+    estimator = build_estimator("LOGISTIC_REGRESSION", args.cd_iterations)
+    mesh = make_mesh(args.mesh_devices, axis_name="settings")
+    datasets = estimator.prepare_training_datasets(train_input)
+    tr_mesh = PopulationTrainer(
+        estimator, datasets, np.asarray(train_input.offsets), seed=args.seed,
+        mesh=mesh,
+    )
+    tr_host = PopulationTrainer(
+        estimator, estimator.prepare_training_datasets(train_input),
+        np.asarray(train_input.offsets), seed=args.seed,
+    )
+    scoring = estimator.prepare_scoring_datasets(validation_input)
+    suite = estimator.prepare_evaluation_suite(validation_input)
+    settings = _heterogeneous_settings(args.population)
+    iterations = max(args.cd_iterations, 2)
+
+    # collective audit BEFORE the timed runs, on EXACTLY the dispatched
+    # program (lower_fused_sweep shares the dispatch's argument builder)
+    hlo = tr_mesh.lower_fused_sweep(settings, n_iterations=iterations)
+    pred_allreduces = hlo_guards.assert_settings_axis_collective_free(hlo)
+
+    def metrics_of(trainer, pop):
+        totals = np.asarray(trainer.score_population(pop, scoring))
+        return np.asarray(
+            [
+                suite.evaluate(totals[p])[suite.primary.name]
+                for p in range(pop.population)
+            ]
+        )
+
+    # warmup both layouts, then: determinism (mesh vs mesh, bitwise) and
+    # cross-layout quality (mesh vs host, tolerance)
+    pm = tr_mesh.train(settings, n_iterations=iterations, fused=True)
+    ph = tr_host.train(settings, n_iterations=iterations, fused=True)
+    with sync_discipline(what="sweep mesh bench measured region") as region:
+        t0 = time.perf_counter()
+        pm2 = tr_mesh.train(settings, n_iterations=iterations, fused=True)
+        elapsed = time.perf_counter() - t0
+    # region.traces is LIVE (it keeps counting after exit): snapshot before
+    # the scoring/parity work below compiles its own programs
+    retraces = int(region.traces)
+    deterministic = all(
+        np.array_equal(np.asarray(pm.coeffs[cid]), np.asarray(pm2.coeffs[cid]))
+        and np.array_equal(
+            np.asarray(pm.train_scores[cid]), np.asarray(pm2.train_scores[cid])
+        )
+        for cid in pm.coeffs
+    )
+    m_mesh, m_host = metrics_of(tr_mesh, pm), metrics_of(tr_host, ph)
+    metric_delta = float(np.max(np.abs(m_mesh - m_host)))
+    gates = {
+        "population_collective_free": True,  # the assert above already held
+        "tolerated_predicate_allreduces": int(pred_allreduces),
+        "mesh_deterministic_bitwise": bool(deterministic),
+        "mesh_vs_host_metric_max_delta": round(metric_delta, 8),
+        "retraces_after_warmup": retraces,
+    }
+    return {
+        "metric": "mesh_population_models_per_sec",
+        "value": round(args.population / elapsed, 3),
+        "unit": "models/sec",
+        "mesh_devices": args.mesh_devices,
+        "population": args.population,
+        "cd_iterations": iterations,
+        "winner_lane_mesh": int(np.argmax(m_mesh)),
+        "winner_lane_host": int(np.argmax(m_host)),
+        **gates,
+        "gates_ok": bool(
+            deterministic
+            and metric_delta <= MESH_METRIC_TOL
+            and retraces == 0
+        ),
+        "platform": jax.default_backend(),
+    }
+
+
+# cross-layout per-lane primary-metric tolerance (mesh vs host layouts of
+# the SAME fused program family; never bitwise — the PR 10 contract)
+MESH_METRIC_TOL = 5e-3
 
 
 def main(argv=None) -> int:
@@ -399,6 +669,27 @@ def main(argv=None) -> int:
     p.add_argument("--min-speedup", type=float, default=3.0,
                    help="vmapped-over-native gate at the bench shape "
                         "(informational at other shapes; <=0 disables)")
+    p.add_argument("--ee-iterations", type=int, default=6,
+                   help="coordinate-descent passes for the early-exit "
+                        "heterogeneous-convergence block")
+    p.add_argument("--ee-reps", type=int, default=3,
+                   help="timing reps (min taken) for the early-exit block")
+    p.add_argument("--ee-freeze-tol", type=float, default=1e-3,
+                   help="freeze tolerance for the early-exit block (the "
+                        "heterogeneous shape's fast lanes freeze by pass "
+                        "2-3 at the default)")
+    p.add_argument("--min-early-exit-speedup", type=float, default=1.0,
+                   help="early-exit-on over early-exit-off wall-clock gate "
+                        "at the heterogeneous shape (<=0 disables; --smoke "
+                        "disables, the iteration-reduction gate still holds)")
+    p.add_argument("--mesh-devices", type=int, default=0,
+                   help="run the population x mesh gate set instead of the "
+                        "full bench: the fused sweep with the SETTINGS axis "
+                        "sharded over this many devices (EMULATED via "
+                        "--xla_force_host_platform_device_count on CPU "
+                        "backends, set before jax initializes) — "
+                        "collective-free + bitwise-determinism + "
+                        "cross-layout-tolerance + zero-retrace gates")
     p.add_argument("--smoke", action="store_true",
                    help="CI smoke shape: tiny workload, parity + retrace "
                         "gates load-bearing, speedup informational")
@@ -408,6 +699,24 @@ def main(argv=None) -> int:
         args.users, args.features = 24, 5
         args.rounds, args.population, args.cd_iterations = 2, 8, 1
         args.min_speedup = 0.0
+        args.ee_iterations, args.ee_reps = 4, 1
+        args.min_early_exit_speedup = 0.0
+    if args.mesh_devices:
+        if args.mesh_devices < 1:
+            p.error("--mesh-devices must be >= 1")
+        # must happen before the first jax import (jax imports in this
+        # module are function-local for exactly this reason)
+        if os.environ.get("JAX_PLATFORMS", "cpu") in ("", "cpu"):
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={args.mesh_devices}"
+                )
+        result = run_mesh(args)
+        print(json.dumps(result))
+        return 0 if result["gates_ok"] else 1
     result = run(args)
     print(json.dumps(result))
     ok = (
@@ -415,6 +724,15 @@ def main(argv=None) -> int:
         and result["retraces_after_warmup"] == 0
         and result["native_metric_max_delta"] <= 1e-3
         and result["families_served"]
+        and result["early_exit_winner_unchanged"]
+        and result["early_exit_survivors_bitwise"]
+        and result["early_exit_iters_reduced"]
+        and result["warm_start_iters_reduced"]
+        and (
+            args.min_early_exit_speedup <= 0.0
+            or result["early_exit"]["early_exit_speedup"]
+            >= args.min_early_exit_speedup
+        )
         and (
             args.min_speedup <= 0.0
             or result["vs_sequential_native"] >= args.min_speedup

@@ -96,9 +96,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--sweep-iterations", type=int, default=1,
                    help="Coordinate-descent passes per candidate")
     p.add_argument("--sweep-path", default="auto",
-                   choices=["auto", "vmapped", "sequential"],
+                   choices=["auto", "vmapped", "sequential", "fused"],
                    help="Population execution path (auto follows the spec: "
-                        "dict per-entity L2 overrides need sequential)")
+                        "dict per-entity L2 overrides need sequential; "
+                        "fused = one jit per train call covering all "
+                        "settings x coordinates x iterations)")
+    p.add_argument("--sweep-warm-start", action="store_true",
+                   help="Seed each round's lanes from the committed table "
+                        "of the nearest previous-round setting (glmnet-style "
+                        "paths across Bayesian rounds; implies the fused "
+                        "path)")
+    p.add_argument("--sweep-freeze-tol", type=float, default=None,
+                   help="Per-lane early exit: freeze a lane whose total "
+                        "training score moved at most tol*(1+max|score|) "
+                        "across a pass (implies the fused path; frozen "
+                        "lanes carry their committed state bitwise)")
+    p.add_argument("--sweep-freeze-min-iterations", type=int, default=1,
+                   help="Completed passes before any lane may freeze")
+    p.add_argument("--sweep-domination-bound", type=float, default=None,
+                   help="Freeze lanes whose training loss exceeds this "
+                        "bound (requires --sweep-freeze-tol to arm early "
+                        "exit; use a negative --sweep-freeze-tol for "
+                        "domination-only freezing)")
     p.add_argument("--checkpoint-directory", required=True,
                    help="Winner commits here as a generational checkpoint "
                         "(the layout serving/hotswap.GenerationWatcher polls)")
@@ -195,8 +214,26 @@ def run(args: argparse.Namespace) -> dict:
         )
         spec = SweepSpec(axes=tuple(parse_sweep_axis(a) for a in args.sweep_axis))
         vmapped: object = "auto"
-        if args.sweep_path != "auto":
+        fused: object = "auto"
+        if args.sweep_path == "fused":
+            fused = True
+        elif args.sweep_path != "auto":
             vmapped = args.sweep_path == "vmapped"
+            fused = False
+        early_exit = None
+        if args.sweep_freeze_tol is not None:
+            from photon_ml_tpu.sweep import EarlyExitConfig
+
+            early_exit = EarlyExitConfig(
+                freeze_tol=args.sweep_freeze_tol,
+                min_iterations=args.sweep_freeze_min_iterations,
+                domination_bound=args.sweep_domination_bound,
+            )
+        elif args.sweep_domination_bound is not None:
+            raise ValueError(
+                "--sweep-domination-bound needs --sweep-freeze-tol to arm "
+                "early exit (use a negative tol for domination-only)"
+            )
         config = SweepConfig(
             checkpoint_directory=args.checkpoint_directory,
             rounds=args.sweep_rounds,
@@ -205,6 +242,9 @@ def run(args: argparse.Namespace) -> dict:
             seed=args.sweep_seed,
             n_iterations=args.sweep_iterations,
             vmapped=vmapped,
+            fused=fused,
+            early_exit=early_exit,
+            warm_start=args.sweep_warm_start,
             export_directory=os.path.join(root, EXPORT_DIR),
             keep_generations=args.checkpoint_keep_generations,
         )
@@ -226,6 +266,16 @@ def run(args: argparse.Namespace) -> dict:
             "population": config.population,
             "seed": config.seed,
             "path": result.path,
+            "warm_start": config.warm_start,
+            "early_exit": (
+                None
+                if early_exit is None
+                else {
+                    "freeze_tol": early_exit.freeze_tol,
+                    "min_iterations": early_exit.min_iterations,
+                    "domination_bound": early_exit.domination_bound,
+                }
+            ),
             "restored": result.restored,
             "models_evaluated": result.models_evaluated,
             "winner": {
@@ -239,6 +289,12 @@ def run(args: argparse.Namespace) -> dict:
             "incidents": result.incidents,
             "checkpoint_path": result.checkpoint_path,
             "export_path": result.export_path,
+            # per-lane observability: the history rows above carry each
+            # round's lane_iterations / frozen_at / freeze_fraction; these
+            # are the sweep-level rollups + per-round acquisition seconds
+            "total_solver_iterations": result.total_solver_iterations,
+            "freeze_fraction": result.freeze_fraction,
+            "timings": result.timings,
         }
         with open(os.path.join(root, STATS_FILE), "w") as f:
             json.dump(stats, f, indent=2)

@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Mapping, Optional, Sequence
 
 import numpy as np
-from scipy.stats import qmc
+from scipy.stats import norm as _norm, qmc
 
 from photon_ml_tpu.hyperparameter.criteria import ExpectedImprovement, PredictionTransformation
 from photon_ml_tpu.hyperparameter.estimators import GaussianProcessEstimator, GaussianProcessModel
@@ -174,30 +174,93 @@ class GaussianProcessSearch(RandomSearch):
         return self._select_best_candidate(candidates, predictions, transformation)
 
     def propose_batch(self, n: int) -> np.ndarray:
-        """Batched Bayesian proposals: ONE GP fit on the accumulated
-        observations, then n Expected-Improvement argmax picks over n FRESH
-        Sobol candidate pools. Without updating the posterior between picks,
-        diversity comes from the pools (each advances the quasi-random
-        stream), which keeps the whole batch a pure deterministic function of
-        (seed, observations) — the property the sweep's crash-replay
-        determinism rests on. Under-determined searches (not more
-        observations than parameters yet) propose uniform draws, matching
-        :meth:`next`."""
+        """COORDINATED batched Bayesian proposals (qEI via local
+        penalization, González et al. 2016-style): ONE GP fit on the
+        accumulated observations, ONE Sobol candidate pool, then n greedy
+        Expected-Improvement picks where each pick multiplicatively
+        penalizes the acquisition around itself before the next argmax.
+        Independent per-pick argmaxes (the previous protocol) re-derive
+        nearly the same optimum n times once the posterior concentrates —
+        a round's population then wastes lanes on duplicates; the penalizer
+        spreads the batch over distinct plausible optima instead.
+
+        The penalizer is the standard 'hammer': around a chosen point x_j
+        with posterior mean mu_j / std s_j, candidates inside the ball of
+        radius (mu_j - best)/L — the region x_j's value says cannot contain
+        the optimum of an L-Lipschitz function — are suppressed by
+        ``Phi((L*||x - x_j|| - (mu_j - best)) / (sqrt(2)*s_j))``. L is the
+        max observed finite-difference slope (deterministic, O(obs^2));
+        chosen pool points are additionally hard-excluded so a batch never
+        duplicates a candidate. Everything is a pure deterministic function
+        of (seed, observations) — the property the sweep's crash-replay
+        determinism rests on (two fresh processes propose identical
+        batches). Under-determined searches (not more observations than
+        parameters yet) propose uniform draws, matching :meth:`next`."""
         if n <= 0:
             raise ValueError("n must be positive")
         if len(self._points) <= self.num_params:
             return super().propose_batch(n)
         transformation = self._fit_posterior()
+        pool = max(self.candidate_pool_size, n)
+        candidates = self.draw_candidates(pool)
+        means, variances = self.last_model.predict(candidates)
+        acquisition = np.asarray(
+            transformation(means, variances), dtype=np.float64
+        )
+        lipschitz = self._lipschitz_estimate()
+        best = float(transformation.best_evaluation)
+        penalty = np.ones(pool, dtype=np.float64)
+        excluded = np.zeros(pool, dtype=bool)
         out = []
         for _ in range(n):
-            candidates = self.draw_candidates(self.candidate_pool_size)
-            predictions = self.last_model.predict_transformed(candidates)
-            out.append(
-                self._discretize(
-                    self._select_best_candidate(candidates, predictions, transformation)
-                )
+            score = acquisition * penalty
+            # hard exclusion must survive an all-zero acquisition row (EI
+            # underflows to exactly 0.0 pool-wide once the posterior is
+            # confident and far above the incumbent): a multiplicative 0
+            # cannot break a tie among zeros — argmax would return index 0
+            # n times — so chosen points are masked out of the argmax
+            score[excluded] = -np.inf
+            idx = int(np.argmax(score))
+            chosen = self._discretize(candidates[idx])
+            out.append(chosen)
+            excluded[idx] = True
+            penalty *= self._local_penalization(
+                candidates, chosen, float(means[idx]), float(variances[idx]),
+                lipschitz, best,
             )
         return np.stack(out)
+
+    def _lipschitz_estimate(self) -> float:
+        """Max finite-difference slope over all observation pairs — the
+        deterministic Lipschitz proxy the penalization radius divides by.
+        Centering cancels in differences, so raw evaluations serve."""
+        points = np.vstack(self._points)
+        evals = np.asarray(self._evals, dtype=np.float64)
+        dv = np.abs(evals[:, None] - evals[None, :])
+        dx = np.linalg.norm(points[:, None, :] - points[None, :, :], axis=-1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            slopes = np.where(dx > 0, dv / np.where(dx > 0, dx, 1.0), 0.0)
+        return float(max(np.max(slopes), 1e-8))
+
+    @staticmethod
+    def _local_penalization(
+        candidates: np.ndarray,
+        center: np.ndarray,
+        mean: float,
+        variance: float,
+        lipschitz: float,
+        best: float,
+    ) -> np.ndarray:
+        """Per-candidate multiplicative penalty in [0, 1] around ``center``
+        (see :meth:`propose_batch`). Values are on the GP's centered scale;
+        lower is better, so the exclusion radius is (mean - best)/L."""
+        distance = np.linalg.norm(
+            candidates - np.asarray(center)[None, :], axis=-1
+        )
+        radius = max(mean - best, 0.0) / lipschitz
+        scale = np.sqrt(max(variance, 0.0)) / lipschitz
+        z = (distance - radius) / (np.sqrt(2.0) * scale + 1e-12)
+        return _norm.cdf(z)
 
     def _fit_posterior(self) -> ExpectedImprovement:
         """Fit the GP to the mean-centered observations (+ priors) and store

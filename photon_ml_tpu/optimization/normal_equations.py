@@ -142,6 +142,7 @@ def minimize_direct(
     quadratic: bool,
     max_iterations: int = DIRECT_MAX_NEWTON_ITERATIONS,
     tolerance: float = 1e-7,
+    active=None,
 ) -> OptResult:
     """Direct Newton/IRLS solve of one GLM subproblem (vmap-compatible).
 
@@ -151,6 +152,14 @@ def minimize_direct(
     Newton loop. Returns the same OptResult surface as the iterative
     minimizers so trackers, variances and the divergence guard are oblivious
     to which solver ran.
+
+    ``active`` (traced scalar bool, usually a vmapped lane flag) is the
+    population early-exit lever: an inactive lane's initial state is masked
+    to read exactly stationary (f0=0, g0=0), so the Newton loop converges it
+    in ZERO iterations — under vmap the batched while_loop's trip count then
+    tracks the slowest ACTIVE lane, not the slowest lane. The masked lane's
+    coefficients come back as its warm start; callers select-freeze the full
+    previous state around the solve anyway.
     """
     from jax import lax
 
@@ -164,6 +173,9 @@ def minimize_direct(
         return -_posdef_solve(H, g)
 
     f0, g0 = vg(x0)
+    if active is not None:
+        f0 = jnp.where(active, f0, jnp.zeros((), f0.dtype))
+        g0 = jnp.where(active, g0, jnp.zeros_like(g0))
 
     if quadratic:
         # one Newton step from anywhere IS the optimum of a quadratic: the

@@ -109,12 +109,35 @@ def glm_solver(
     return jax.jit(solve)
 
 
+def _masked_value_and_grad(vg, active):
+    """The population early-exit lever: wrap a value-and-gradient so an
+    INACTIVE lane's objective reads exactly stationary (f=0, g=0). Every
+    minimizer's zero-gradient init check (``reason0`` in lbfgs/owlqn/tron/
+    newton/lbfgsb) then converges the lane in ZERO iterations, so a vmapped
+    while_loop's trip count tracks the slowest ACTIVE lane — frozen lanes
+    still ride the batched body (vmap computes all lanes every trip) but no
+    longer extend it. Callers must select-freeze the lane's outputs to its
+    previous state; the masked solve's job is only to stop burning trips.
+    OWLQN needs the L1 weight masked too (the pseudo-gradient of a zero
+    smooth gradient is still ``l1*sign(x)``) — see the call sites."""
+
+    def masked(w):
+        f, g = vg(w)
+        return (
+            jnp.where(active, f, jnp.zeros((), f.dtype)),
+            jnp.where(active, g, jnp.zeros_like(g)),
+        )
+
+    return masked
+
+
 def _re_bucket_solve_fn(
     task: TaskType,
     opt_config: OptimizerConfig,
     has_l1: bool,
     variance: VarianceComputationType,
     re_solver: str = "lbfgs",
+    with_active: bool = False,
 ):
     """Unjitted vmapped bucket solve shared by ``re_bucket_solver`` (one jit
     per bucket) and ``re_coordinate_update_program`` (every bucket chained in
@@ -124,7 +147,13 @@ def _re_bucket_solve_fn(
     (optimization/normal_equations.py): ``"direct"`` replaces the configured
     quasi-Newton loop with batched Gram/Cholesky Newton solves, ``"auto"``
     does so for the small-K buckets the roofline says dominate, ``"lbfgs"``
-    (default) keeps the configured optimizer — the bitwise status quo."""
+    (default) keeps the configured optimizer — the bitwise status quo.
+
+    ``with_active=True`` appends a broadcast per-lane ``active`` flag to the
+    solve signature (the population early-exit path): inactive lanes see a
+    masked stationary objective and solve in zero iterations, and report
+    zero iterations. Default False keeps the existing program signatures
+    untouched."""
     task = TaskType(task)
     loss = loss_for_task(task)
     minimize = build_minimizer(opt_config)
@@ -136,7 +165,7 @@ def _re_bucket_solve_fn(
     from photon_ml_tpu.data.dataset import LabeledData
     from photon_ml_tpu.data.matrix import DenseDesignMatrix
 
-    def solve_one(Xe, ye, we, oe, w0, l2, l1):
+    def solve_one(Xe, ye, we, oe, w0, l2, l1, active=None):
         data = LabeledData(X=DenseDesignMatrix(Xe), labels=ye, offsets=oe, weights=we)
         obj = GLMObjective(loss, allow_fused=False)  # vmapped: no pallas path
 
@@ -157,12 +186,19 @@ def _re_bucket_solve_fn(
                 l2,
                 quadratic=task == TaskType.LINEAR_REGRESSION,
                 tolerance=tolerance,
+                active=active,
             )
             var = compute_variances(obj, data, res.coefficients, l2, variance, w0.dtype)
-            return res.coefficients, res.convergence_reason, res.iterations, var
+            iters = res.iterations
+            if active is not None:
+                iters = jnp.where(active, iters, jnp.zeros_like(iters))
+            return res.coefficients, res.convergence_reason, iters, var
 
         def vg(w):
             return obj.value_and_gradient(data, w, l2)
+
+        if active is not None:
+            vg = _masked_value_and_grad(vg, active)
 
         kwargs = {}
         if use_hvp:
@@ -170,11 +206,20 @@ def _re_bucket_solve_fn(
         if use_hess:
             kwargs["hess"] = lambda w: obj.hessian_matrix(data, w, l2)
         if has_l1:
-            kwargs["l1_weight"] = l1
+            # the OWLQN pseudo-gradient of a masked (zero) smooth gradient is
+            # l1*sign(x) — a frozen lane would still iterate; zero its L1 too
+            kwargs["l1_weight"] = (
+                l1 if active is None else jnp.where(active, l1, jnp.zeros_like(l1))
+            )
         res = minimize(vg, w0, **kwargs)
         var = compute_variances(obj, data, res.coefficients, l2, variance, w0.dtype)
-        return res.coefficients, res.convergence_reason, res.iterations, var
+        iters = res.iterations
+        if active is not None:
+            iters = jnp.where(active, iters, jnp.zeros_like(iters))
+        return res.coefficients, res.convergence_reason, iters, var
 
+    if with_active:
+        return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None))
     return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None))
 
 
@@ -204,6 +249,7 @@ def _re_coordinate_update_fn(
     n_entities: int,
     re_solver: str = "lbfgs",
     precision: PrecisionPolicy = FLOAT32,
+    with_active: bool = False,
 ):
     """Unjitted whole-coordinate update body shared by
     ``re_coordinate_update_program`` (one model) and
@@ -220,13 +266,26 @@ def _re_coordinate_update_fn(
     the converts into the consuming gathers/contractions, so only
     storage-width bytes cross HBM). The reference f32 policy makes every
     cast an identity, preserving the bitwise parity contract with the
-    per-bucket path."""
-    solve = _re_bucket_solve_fn(task, opt_config, has_l1, variance, re_solver)
+    per-bucket path.
+
+    ``with_active=True`` (the population early-exit form) appends a scalar
+    ``active`` argument after ``l1``: a frozen (inactive) lane's bucket
+    solves run zero iterations (masked stationary objective — see
+    ``_masked_value_and_grad``) and the lane's outputs are select-frozen to
+    the PREVIOUS table/score/variances bit for bit. The explicit select
+    matters: a zero-iteration solve alone would round-trip the warm start
+    through the normalization space conversion, which is not a bitwise
+    identity. The returned per-lane ``ok`` flag reports True for frozen
+    lanes (carrying committed state is not a reject), and the returned
+    iteration counts are zero there."""
+    solve = _re_bucket_solve_fn(
+        task, opt_config, has_l1, variance, re_solver, with_active
+    )
     reduced = not precision.is_reference
 
-    def update(
+    def update_core(
         coeffs_prev, score_prev, var_prev, offsets_plus_scores, l2_rows, l1,
-        buckets, norm_tables, view,
+        buckets, norm_tables, view, active=None,
     ):
         from photon_ml_tpu.algorithm.random_effect import _to_original, _to_transformed
         from photon_ml_tpu.models.game import random_effect_view_score
@@ -249,7 +308,7 @@ def _re_coordinate_update_fn(
             if norm_tbl is not None:
                 factors, shifts, icpt_mask = norm_tbl
                 init_b = _to_transformed(init_b, factors, shifts, icpt_mask)
-            w_b, reasons_b, iters_b, var_b = solve(
+            solve_args = (
                 bucket.X,
                 bucket.labels,
                 bucket.weights,
@@ -258,6 +317,9 @@ def _re_coordinate_update_fn(
                 jnp.take(l2_rows, jnp.minimum(bucket.entity_rows, l2_rows.shape[0] - 1)),
                 l1,
             )
+            if with_active:
+                solve_args = solve_args + (active,)
+            w_b, reasons_b, iters_b, var_b = solve(*solve_args)
             if norm_tbl is not None:
                 w_b = _to_original(w_b, factors, shifts, icpt_mask)
                 if variances is not None and factors is not None:
@@ -293,10 +355,36 @@ def _re_coordinate_update_fn(
         # (algorithm/coordinate.coefficient_arrays — a singular-Hessian
         # variance failure must not discard a converged mean update).
         ok = jnp.isfinite(coeffs).all()
-        coeffs_out = jnp.where(ok, coeffs, coeffs_prev)
-        score_out = jnp.where(ok, score, score_prev)
-        var_out = None if variances is None else jnp.where(ok, variances, var_prev)
+        keep = ok if active is None else jnp.logical_and(ok, active)
+        coeffs_out = jnp.where(keep, coeffs, coeffs_prev)
+        score_out = jnp.where(keep, score, score_prev)
+        var_out = None if variances is None else jnp.where(keep, variances, var_prev)
+        if active is not None:
+            # a frozen lane carrying its committed state is not a reject
+            ok = jnp.logical_or(ok, jnp.logical_not(active))
         return coeffs_out, score_out, var_out, ok, tuple(reasons), tuple(iters)
+
+    if with_active:
+
+        def update(
+            coeffs_prev, score_prev, var_prev, offsets_plus_scores, l2_rows,
+            l1, active, buckets, norm_tables, view,
+        ):
+            return update_core(
+                coeffs_prev, score_prev, var_prev, offsets_plus_scores,
+                l2_rows, l1, buckets, norm_tables, view, active,
+            )
+
+        return update
+
+    def update(
+        coeffs_prev, score_prev, var_prev, offsets_plus_scores, l2_rows, l1,
+        buckets, norm_tables, view,
+    ):
+        return update_core(
+            coeffs_prev, score_prev, var_prev, offsets_plus_scores, l2_rows,
+            l1, buckets, norm_tables, view,
+        )
 
     return update
 
@@ -379,6 +467,7 @@ def re_population_update_program(
     n_entities: int,
     re_solver: str = "lbfgs",
     precision: PrecisionPolicy = FLOAT32,
+    with_active: bool = False,
 ):
     """``re_coordinate_update_program`` with a LEADING POPULATION AXIS: one
     donated XLA program trains P hyperparameter settings' random-effect
@@ -401,14 +490,134 @@ def re_population_update_program(
     A lane's output is a bitwise-deterministic function of that lane's inputs
     alone (no cross-lane ops exist under vmap; converged lanes' while_loop
     carries are select-frozen) — the property the sweep's sequential fallback
-    path builds its bitwise-parity contract on (sweep/population.py)."""
+    path builds its bitwise-parity contract on (sweep/population.py).
+
+    ``with_active=True`` adds a per-lane ``[P]`` bool ``active`` argument
+    after ``l1`` (the early-exit program family): inactive lanes solve in
+    zero iterations and carry their previous state bitwise — see
+    ``_re_coordinate_update_fn``."""
     update = _re_coordinate_update_fn(
-        task, opt_config, has_l1, variance, n_entities, re_solver, precision
+        task, opt_config, has_l1, variance, n_entities, re_solver, precision,
+        with_active,
+    )
+    in_axes = (
+        (0, 0, 0, 0, 0, 0, 0, None, None, None)
+        if with_active
+        else (0, 0, 0, 0, 0, 0, None, None, None)
     )
     return jax.jit(
-        jax.vmap(update, in_axes=(0, 0, 0, 0, 0, 0, None, None, None)),
+        jax.vmap(update, in_axes=in_axes),
         donate_argnums=(0, 1, 2),
     )
+
+
+def _fe_population_update_fn(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    down_sampling: bool = False,
+    with_active: bool = False,
+):
+    """Unjitted vmapped fixed-effect population update body, shared by
+    ``fe_population_update_program`` (one donated jit per update) and the
+    fused whole-sweep pass (``parallel/game.population_sweep_fn`` — every
+    iteration's update chained in one trace). One body, two drivers, so the
+    per-update and fused paths stay semantically interchangeable per lane.
+    See ``fe_population_update_program`` for the update contract;
+    ``with_active=True`` inserts a per-lane ``active [P]`` argument after
+    ``keep_u`` (inactive lanes: zero-iteration masked solve, outputs
+    select-frozen to the previous state bitwise, flags report no reject,
+    iterations report zero)."""
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.function.losses import POSITIVE_RESPONSE_THRESHOLD
+
+    task = TaskType(task)
+    loss = loss_for_task(task)
+    minimize = build_minimizer(opt_config)
+    use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
+    classification = task.is_classification
+
+    def solve_one(w_prev, s_prev, off, l2, l1, rate, keep_u, active, data, norm):
+        weights = data.weights
+        if down_sampling:
+            if classification:
+                pos = data.labels > POSITIVE_RESPONSE_THRESHOLD
+                weights = jnp.where(
+                    pos, weights, jnp.where(keep_u < rate, weights / rate, 0.0)
+                )
+            else:
+                weights = jnp.where(keep_u < rate, weights, 0.0)
+        d2 = LabeledData(X=data.X, labels=data.labels, offsets=off, weights=weights)
+        obj = GLMObjective(loss, norm, allow_fused=False)  # vmapped: no pallas path
+        x0 = norm.to_transformed_space_device(w_prev)
+
+        def vg(w):
+            return obj.value_and_gradient(d2, w, l2)
+
+        if active is not None:
+            vg = _masked_value_and_grad(vg, active)
+
+        kwargs = {}
+        if use_hvp:
+            kwargs["hvp"] = lambda w, v: obj.hessian_vector(d2, w, v, l2)
+        if use_hess:
+            kwargs["hess"] = lambda w: obj.hessian_matrix(d2, w, l2)
+        if has_l1:
+            kwargs["l1_weight"] = (
+                l1 if active is None else jnp.where(active, l1, jnp.zeros_like(l1))
+            )
+        res = minimize(vg, x0, **kwargs)
+        means = norm.to_original_space_device(res.coefficients)
+        score = data.X.matvec(means)
+        # same two checks, same order, as the host loop's divergence guard
+        # (coordinate_descent._guard_cause)
+        value_ok = jnp.isfinite(res.value)
+        coefs_ok = jnp.isfinite(means).all()
+        ok = jnp.logical_and(value_ok, coefs_ok)
+        iters = res.iterations
+        if active is not None:
+            # a frozen lane carries its state bitwise (the norm-space
+            # round-trip is not an identity, so the select is load-bearing),
+            # reports no reject and no iterations
+            ok = jnp.logical_and(ok, active)
+            value_ok = jnp.logical_or(value_ok, jnp.logical_not(active))
+            coefs_ok = jnp.logical_or(coefs_ok, jnp.logical_not(active))
+            iters = jnp.where(active, iters, jnp.zeros_like(iters))
+        means_out = jnp.where(ok, means, w_prev)
+        score_out = jnp.where(ok, score, s_prev)
+        return (
+            means_out, score_out, coefs_ok, value_ok,
+            res.value, iters, res.convergence_reason,
+        )
+
+    if with_active:
+        vmapped = jax.vmap(
+            solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, 0, None, None)
+        )
+
+        def update(
+            coeffs_prev, score_prev, offsets_pop, l2, l1, rates, keep_u,
+            active, data, norm,
+        ):
+            return vmapped(
+                coeffs_prev, score_prev, offsets_pop, l2, l1, rates, keep_u,
+                active, data, norm,
+            )
+
+        return update
+
+    vmapped = jax.vmap(
+        solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None, None, None)
+    )
+
+    def update(coeffs_prev, score_prev, offsets_pop, l2, l1, rates, keep_u, data, norm):
+        return vmapped(
+            coeffs_prev, score_prev, offsets_pop, l2, l1, rates, keep_u, None,
+            data, norm,
+        )
+
+    return update
 
 
 @functools.lru_cache(maxsize=None)
@@ -417,6 +626,7 @@ def fe_population_update_program(
     opt_config: OptimizerConfig,
     has_l1: bool,
     down_sampling: bool = False,
+    with_active: bool = False,
 ):
     """Population fixed-effect coordinate update: one donated XLA program
     trains P settings' fixed-effect solves over ONE shared design matrix and
@@ -426,7 +636,8 @@ def fe_population_update_program(
     ``update(coeffs_prev [P,D], score_prev [P,N], offsets_plus_scores [P,N],
     l2 [P], l1 [P], rates [P], keep_u [N], data, norm) -> (coeffs [P,D],
     score [P,N], coefs_ok [P], value_ok [P], values [P], iters [P],
-    reasons [P])``
+    reasons [P])`` — ``with_active=True`` inserts a per-lane ``active [P]``
+    bool argument after ``keep_u`` (the early-exit program family).
 
     - ``coeffs_prev`` are ORIGINAL-space warm starts (the model contract);
       the in-program conversion to the solver's transformed space and back
@@ -445,62 +656,9 @@ def fe_population_update_program(
       coefficients; either rejects the lane in-program (previous
       coefficients/score kept bit for bit).
     """
-    from photon_ml_tpu.data.dataset import LabeledData
-    from photon_ml_tpu.function.losses import POSITIVE_RESPONSE_THRESHOLD
-
-    task = TaskType(task)
-    loss = loss_for_task(task)
-    minimize = build_minimizer(opt_config)
-    use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
-    use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
-    classification = task.is_classification
-
-    def solve_one(w_prev, s_prev, off, l2, l1, rate, keep_u, data, norm):
-        weights = data.weights
-        if down_sampling:
-            if classification:
-                pos = data.labels > POSITIVE_RESPONSE_THRESHOLD
-                weights = jnp.where(
-                    pos, weights, jnp.where(keep_u < rate, weights / rate, 0.0)
-                )
-            else:
-                weights = jnp.where(keep_u < rate, weights, 0.0)
-        d2 = LabeledData(X=data.X, labels=data.labels, offsets=off, weights=weights)
-        obj = GLMObjective(loss, norm, allow_fused=False)  # vmapped: no pallas path
-        x0 = norm.to_transformed_space_device(w_prev)
-
-        def vg(w):
-            return obj.value_and_gradient(d2, w, l2)
-
-        kwargs = {}
-        if use_hvp:
-            kwargs["hvp"] = lambda w, v: obj.hessian_vector(d2, w, v, l2)
-        if use_hess:
-            kwargs["hess"] = lambda w: obj.hessian_matrix(d2, w, l2)
-        if has_l1:
-            kwargs["l1_weight"] = l1
-        res = minimize(vg, x0, **kwargs)
-        means = norm.to_original_space_device(res.coefficients)
-        score = data.X.matvec(means)
-        # same two checks, same order, as the host loop's divergence guard
-        # (coordinate_descent._guard_cause)
-        value_ok = jnp.isfinite(res.value)
-        coefs_ok = jnp.isfinite(means).all()
-        ok = jnp.logical_and(value_ok, coefs_ok)
-        means_out = jnp.where(ok, means, w_prev)
-        score_out = jnp.where(ok, score, s_prev)
-        return (
-            means_out, score_out, coefs_ok, value_ok,
-            res.value, res.iterations, res.convergence_reason,
-        )
-
-    vmapped = jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None, None))
-
-    def update(coeffs_prev, score_prev, offsets_pop, l2, l1, rates, keep_u, data, norm):
-        return vmapped(
-            coeffs_prev, score_prev, offsets_pop, l2, l1, rates, keep_u, data, norm
-        )
-
+    update = _fe_population_update_fn(
+        task, opt_config, has_l1, down_sampling, with_active
+    )
     return jax.jit(update, donate_argnums=(0, 1))
 
 

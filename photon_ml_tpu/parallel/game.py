@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import operator
 from typing import Optional, Sequence
 
 import jax
@@ -346,6 +347,323 @@ def game_train_step(
         "re_iterations_max": tuple(re_iter_maxes),
     }
     return new_params, diagnostics
+
+
+@dataclasses.dataclass(frozen=True)
+class PopulationCoordinateSpec:
+    """Static description of one coordinate inside the fused population
+    sweep program (hashable — part of the program-builder key). The traced
+    data rides separately (``population_sweep_fn``'s ``datas`` argument)."""
+
+    cid: str
+    kind: str  # "fe" | "re"
+    opt_config: object  # OptimizerConfig (frozen dataclass, hashable)
+    has_l1: bool
+    n_entities: int = 0  # RE only
+    down_sampling: bool = False  # FE only
+
+
+def population_sweep_fn(
+    task: TaskType,
+    coord_specs: tuple,
+    n_iterations: int,
+    *,
+    re_solver: str = "lbfgs",
+    precision=None,
+    min_freeze_iterations: int = 1,
+    with_domination: bool = False,
+    warm_start: bool = False,
+    capture_pass_states: bool = False,
+    lane_constraint=None,
+):
+    """The settings axis on the fused GAME pass: ONE trace covers ALL
+    settings x ALL coordinates x ALL descent iterations — model selection
+    collapsed into a single program the way ``game_train_step`` collapsed the
+    per-coordinate Spark jobs of one pass. The per-lane per-coordinate bodies
+    are EXACTLY the population update bodies
+    (``optimization/solver_cache._re_coordinate_update_fn`` /
+    ``_fe_population_update_fn`` with ``with_active=True``), so a fused lane
+    and a per-update-dispatch lane run the same update logic.
+
+    The settings axis is embarrassingly parallel BY CONSTRUCTION: a lane's
+    offsets come from its own coordinates' scores only, so no cross-lane op
+    exists anywhere in the trace — which is what lets a mesh shard the lane
+    axis (``P(settings, None, ...)`` tables, data replicated) with ZERO data
+    collectives in the compiled module
+    (``parallel/hlo_guards.assert_settings_axis_collective_free``; the one
+    tolerated op is the batched while_loops' single-element
+    convergence-consensus all-reduce).
+
+    Per-lane EARLY EXIT runs at pass boundaries, inside the trace:
+
+    - **convergence**: a lane whose total training score moved at most
+      ``freeze_tol * (1 + max|score|)`` since the previous pass freezes —
+      its remaining solves run ZERO iterations (masked stationary objective,
+      ``solver_cache._masked_value_and_grad``), so the batched while_loops'
+      trip counts track the slowest SURVIVING lane and the population's
+      wall-clock tracks the median lane, not the slowest. ``freeze_tol`` is
+      a TRACED scalar: a negative value never freezes, so the same compiled
+      program measures early-exit on vs off (the bench's winner-unchanged
+      gate compares within one program).
+    - **domination** (``with_domination=True``): a lane whose per-lane
+      weighted mean training loss exceeds the TRACED ``domination_bound``
+      (a host-derived scalar, e.g. from the previous round's best — never a
+      cross-lane reduction, which would put a collective on the settings
+      axis) freezes the same way. ``+inf`` disables it per dispatch.
+
+    Frozen lanes carry their committed state bitwise (the update bodies
+    select-freeze outputs to the previous tables/scores), report no rejects,
+    and contribute zero solver iterations; ``frozen_at`` records the number
+    of completed passes at freeze time (-1 = ran every pass).
+
+    ``sweep(coeffs0, lanes, active0, base_offsets, keep_us, freeze_tol,
+    domination_bound, labels, weights, datas) ->
+    (states, stats, guards, snapshots)`` where
+
+    - ``coeffs0``: dict cid -> ``[P, ...]`` initial tables. With the static
+      ``warm_start=False`` (the cold-start family) initial scores are literal
+      zeros — bitwise the per-update path's init; with ``warm_start=True``
+      they are computed in-trace from ``coeffs0`` with the same scoring
+      kernels the updates use (glmnet-style path seeding,
+      ``SweepRunner``'s cross-round warm starts).
+    - ``lanes``: dict cid -> per-lane hyperparameter arrays (``l2_rows``/
+      ``l1`` for RE, ``l2``/``l1``/``rates`` for FE).
+    - ``keep_us``: dict cid -> ``[n_iterations, N]`` shared down-sampling
+      draws (down-sampling FE coordinates only), indexed statically per
+      unrolled pass.
+    - ``labels``/``weights``: ``[N]`` training labels/weights, read only
+      under ``with_domination`` (pass empty arrays otherwise).
+    - ``datas``: dict cid -> the coordinate's broadcast device data
+      (RE: ``{"buckets", "norm_tables", "view"}``; FE: ``{"data", "norm"}``).
+    - ``states``: dict cid -> ``{"coeffs", "score"}`` final per-lane state;
+      ``stats``: ``{"active", "frozen_at", "lane_iterations"}`` (all [P]);
+      ``guards``: one ``(coefs_ok, value_ok, values)`` triple per update in
+      (iteration, coordinate) order — the caller holds the static labels;
+      ``snapshots``: per-pass state copies when ``capture_pass_states``
+      (the freeze-contract tests' reference), else ``()``.
+    """
+    from photon_ml_tpu.function.losses import loss_for_task
+    from photon_ml_tpu.models.game import random_effect_view_score
+    from photon_ml_tpu.optimization.precision import FLOAT32
+    from photon_ml_tpu.optimization.solver_cache import (
+        _fe_population_update_fn,
+        _re_coordinate_update_fn,
+    )
+    from photon_ml_tpu.types import VarianceComputationType
+
+    task = TaskType(task)
+    precision = FLOAT32 if precision is None else precision
+    reduced = not precision.is_reference
+    loss = loss_for_task(task) if with_domination else None
+
+    # ``lane_constraint`` (mesh runs): pin every per-lane intermediate the
+    # pass hands forward — updated states and the freeze flags — to the
+    # settings sharding. Output constraints alone leave GSPMD free to
+    # REPLICATE small per-lane chains mid-trace (observed: [P]-sized
+    # all-gathers around the freeze selects at some shapes), which violates
+    # the zero-data-collective contract the sharded program exists for.
+    pin = lane_constraint if lane_constraint is not None else (lambda t: t)
+
+    bodies = {}
+    for spec in coord_specs:
+        if spec.kind == "re":
+            update = _re_coordinate_update_fn(
+                task,
+                spec.opt_config,
+                spec.has_l1,
+                VarianceComputationType.NONE,
+                spec.n_entities,
+                re_solver,
+                precision,
+                with_active=True,
+            )
+            bodies[spec.cid] = jax.vmap(
+                update, in_axes=(0, 0, 0, 0, 0, 0, 0, None, None, None)
+            )
+        else:
+            bodies[spec.cid] = _fe_population_update_fn(
+                task, spec.opt_config, spec.has_l1, spec.down_sampling,
+                with_active=True,
+            )
+
+    def _initial_score(spec, coeffs, data):
+        if not warm_start:
+            # cold start: a zero model scores EXACTLY zero — keep the literal
+            # (hostile NaN features must not poison the init, matching the
+            # per-update path's zeros init bitwise)
+            n = (
+                data["view"][0].shape[0]
+                if spec.kind == "re"
+                else data["data"].labels.shape[0]
+            )
+            return jnp.zeros((coeffs.shape[0], n), dtype=jnp.result_type(coeffs, jnp.float32))
+        if spec.kind == "re":
+            entity_rows, local_cols, vals = data["view"]
+            if reduced:
+                score_fn = lambda w: random_effect_view_score(
+                    w.astype(precision.accum_dtype),
+                    entity_rows,
+                    local_cols,
+                    vals.astype(precision.accum_dtype),
+                )
+            else:
+                score_fn = lambda w: random_effect_view_score(
+                    w, entity_rows, local_cols, vals
+                )
+            return jax.vmap(score_fn)(coeffs)
+        return jax.vmap(data["data"].X.matvec)(coeffs)
+
+    def sweep(
+        coeffs0, lanes, active0, base_offsets, keep_us, freeze_tol,
+        domination_bound, labels, weights, datas,
+    ):
+        specs = {s.cid: s for s in coord_specs}
+        states = {}
+        for cid, spec in specs.items():
+            states[cid] = {
+                "coeffs": coeffs0[cid],
+                "score": _initial_score(spec, coeffs0[cid], datas[cid]),
+            }
+        active = active0
+        p = active.shape[0]
+        frozen_at = jnp.full((p,), -1, dtype=jnp.int32)
+        lane_iters = jnp.zeros((p,), dtype=jnp.int32)
+        guards = []
+        snapshots = []
+        prev_total = functools.reduce(
+            operator.add, (s["score"] for s in states.values())
+        )
+        for it in range(n_iterations):
+            total = functools.reduce(
+                operator.add, (s["score"] for s in states.values())
+            )
+            for cid, spec in specs.items():
+                st, lane, data = states[cid], lanes[cid], datas[cid]
+                partial = total - st["score"]
+                offsets_pop = base_offsets[None, :] + partial
+                if spec.kind == "re":
+                    coeffs, score, _var, ok, _reasons, iters = bodies[cid](
+                        st["coeffs"], st["score"], None, offsets_pop,
+                        lane["l2_rows"], lane["l1"], active,
+                        data["buckets"], data["norm_tables"], data["view"],
+                    )
+                    lane_iters = lane_iters + functools.reduce(
+                        operator.add,
+                        (jnp.sum(b, axis=-1).astype(jnp.int32) for b in iters),
+                    )
+                    guards.append((ok, None, None))
+                else:
+                    keep_u = (
+                        keep_us[cid][it]
+                        if spec.down_sampling
+                        else jnp.zeros((0,), dtype=jnp.float32)
+                    )
+                    coeffs, score, coefs_ok, value_ok, values, iters, _r = bodies[
+                        cid
+                    ](
+                        st["coeffs"], st["score"], offsets_pop, lane["l2"],
+                        lane["l1"], lane["rates"], keep_u, active,
+                        data["data"], data["norm"],
+                    )
+                    lane_iters = lane_iters + iters.astype(jnp.int32)
+                    guards.append((coefs_ok, value_ok, values))
+                states[cid] = pin({"coeffs": coeffs, "score": score})
+                total = partial + states[cid]["score"]
+            if capture_pass_states:
+                snapshots.append(
+                    {cid: dict(s) for cid, s in states.items()}
+                )
+            if it < n_iterations - 1:
+                # pass-boundary freeze check (skipped after the final pass:
+                # a lane converging there skipped no work, and counting it
+                # would overstate the early-exit win)
+                delta = jnp.max(jnp.abs(total - prev_total), axis=-1)
+                scale = 1.0 + jnp.max(jnp.abs(total), axis=-1)
+                finished = delta <= freeze_tol * scale
+                if with_domination:
+                    margins = base_offsets[None, :] + total
+                    per_sample = loss.loss(margins, labels[None, :])
+                    lane_loss = jnp.sum(
+                        per_sample * weights[None, :], axis=-1
+                    ) / jnp.sum(weights)
+                    finished = jnp.logical_or(
+                        finished, lane_loss > domination_bound
+                    )
+                if (it + 1) >= min_freeze_iterations:
+                    newly = jnp.logical_and(active, finished)
+                    frozen_at = pin(jnp.where(
+                        newly, jnp.int32(it + 1), frozen_at
+                    ))
+                    active = pin(
+                        jnp.logical_and(active, jnp.logical_not(newly))
+                    )
+            prev_total = total
+        stats = {
+            "active": active,
+            "frozen_at": frozen_at,
+            "lane_iterations": lane_iters,
+        }
+        return states, stats, tuple(guards), tuple(snapshots)
+
+    return sweep
+
+
+def make_population_sweep_program(
+    task: TaskType,
+    coord_specs: tuple,
+    n_iterations: int,
+    *,
+    re_solver: str = "lbfgs",
+    precision=None,
+    min_freeze_iterations: int = 1,
+    with_domination: bool = False,
+    warm_start: bool = False,
+    capture_pass_states: bool = False,
+    mesh=None,
+):
+    """jit(population_sweep_fn) with the initial tables donated. On a
+    ``mesh`` every output leaf (all lead with the population axis) is pinned
+    to ``P(settings, None, ...)`` via sharding constraints, so the program
+    never gathers lane-axis tensors: the caller places the population state
+    and lane arrays settings-sharded and the broadcast data replicated, and
+    the compiled module stays free of data collectives
+    (``hlo_guards.assert_settings_axis_collective_free`` audits exactly
+    this). Callers cache the returned function per static key; jit adds its
+    shape cache underneath."""
+    lane_constraint = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        axis = mesh.axis_names[0]
+
+        def lane_constraint(tree):
+            def pin(a):
+                spec = PartitionSpec(axis, *([None] * (a.ndim - 1)))
+                return jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, spec)
+                )
+
+            return jax.tree_util.tree_map(pin, tree)
+
+    fn = population_sweep_fn(
+        task,
+        coord_specs,
+        n_iterations,
+        re_solver=re_solver,
+        precision=precision,
+        min_freeze_iterations=min_freeze_iterations,
+        with_domination=with_domination,
+        warm_start=warm_start,
+        capture_pass_states=capture_pass_states,
+        lane_constraint=lane_constraint,
+    )
+    if mesh is None:
+        return jax.jit(fn, donate_argnums=(0,))
+
+    def constrained(*args):
+        return lane_constraint(fn(*args))
+
+    return jax.jit(constrained, donate_argnums=(0,))
 
 
 def make_jitted_game_step(

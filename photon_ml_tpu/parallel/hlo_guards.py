@@ -232,6 +232,102 @@ def loop_collectives(compiled_text: str) -> list:
     return out
 
 
+# ops a constant can hide behind without changing its literal-ness
+_CONST_PASSTHROUGH = ("bitcast(", "broadcast(", "reshape(", "copy(")
+_AG_OPERAND_RE = re.compile(r"all-gather(?:-start)?\(\S+\s+%([\w\.\-]+)\)")
+_DEF_NAME_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w\.\-]+)\s*=")
+_OPERAND_REF_RE = re.compile(r"%([\w\.\-]+)")
+
+
+def _is_constant_gather(line: str, defs: dict) -> bool:
+    """True when an all-gather's operand chains back (through bitcast/
+    broadcast/reshape/copy) to a compile-time ``constant``: GSPMD sometimes
+    materializes a replicated literal by sharding the constant and gathering
+    it back. Every device already holds the literal — nothing lane-private
+    crosses the wire — so the settings-axis guard tolerates exactly this
+    (outside loops; the loop scan separately rejects ANY in-loop gather)."""
+    m = _AG_OPERAND_RE.search(line)
+    if not m:
+        return False
+    name = m.group(1)
+    for _ in range(4):  # bounded chain walk
+        d = defs.get(name)
+        if d is None:
+            return False
+        if "constant(" in d:
+            return True
+        rhs = d.split("=", 1)[1]
+        if not any(op in rhs for op in _CONST_PASSTHROUGH):
+            return False
+        refs = _OPERAND_REF_RE.findall(rhs)
+        if not refs:
+            return False
+        name = refs[0]
+    return False
+
+
+def assert_settings_axis_collective_free(compiled_text: str) -> int:
+    """The mesh x population contract (the fused sweep program of
+    ``parallel/game.population_sweep_fn`` with the SETTINGS axis sharded over
+    the mesh): lanes are independent by construction — a lane's offsets come
+    only from its own coordinates' scores, the shared datasets replicate,
+    and no cross-lane reduction exists anywhere in the trace — so the
+    compiled module must carry ZERO data collectives ANYWHERE, not merely
+    outside solver loops. Stricter than ``assert_collective_profile`` (which
+    budgets the entity-sharded pass's legal gather/scatter exchange): here
+    there is nothing to exchange at all. Two op classes are tolerated:
+
+    - the single-element all-reduce — the batched ``while_loop``'s
+      termination consensus over lane shards (and the freeze flags' scalar
+      combines), latency-bound and payload-free;
+    - an all-gather whose operand is a COMPILE-TIME CONSTANT
+      (``_is_constant_gather``): GSPMD occasionally lowers a replicated
+      zero literal (the early-exit masking's ``where(active, f, 0)``) as
+      shard-the-constant-then-gather. The literal is identical on every
+      device, so no lane data moves — and the in-loop scan below proves
+      none of these (or anything else) runs per solver iteration.
+
+    Any collective of any kind INSIDE a solver while-loop body/condition
+    other than the scalar predicate consensus is fatal regardless of
+    operand. Returns the count of tolerated ops for reporting."""
+    defs: dict = {}
+    for line in compiled_text.splitlines():
+        m = _DEF_NAME_RE.match(line)
+        if m:
+            defs[m.group(1)] = line
+    collectives = []
+    tolerated = 0
+    for line in compiled_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        parsed = Collective.parse_all(line)[0]
+        if parsed.kind == "all-reduce" and parsed.elements == 1:
+            tolerated += 1
+            continue
+        if parsed.kind == "all-gather" and _is_constant_gather(line, defs):
+            tolerated += 1
+            continue
+        collectives.append(parsed)
+    assert not collectives, (
+        f"{len(collectives)} data collective(s) in the population sweep "
+        f"module — the settings axis is no longer embarrassingly parallel "
+        f"(a cross-lane op or a resharding snuck into the fused program): "
+        + "; ".join(f"{c.kind} {c.shape}" for c in collectives[:4])
+    )
+    in_loop = [
+        (name, line, elements)
+        for name, line, elements in loop_collectives(compiled_text)
+        if elements != 1 or "all-reduce" not in line
+    ]
+    assert not in_loop, (
+        f"{len(in_loop)} collective(s) inside the population solver loops "
+        f"(they run per solver ITERATION): "
+        + "; ".join(f"{n}: {l[:80]}" for n, l, _ in in_loop[:4])
+    )
+    return tolerated
+
+
 def assert_entity_solves_collective_free(compiled_text: str) -> int:
     """Fail if any DATA collective appears inside a ``while`` body/condition
     of the compiled module. For the random-effect coordinate update this is

@@ -30,7 +30,11 @@ Pieces:
   (``serving/hotswap.py``) picks up directly.
 """
 
-from photon_ml_tpu.sweep.population import PopulationResult, PopulationTrainer
+from photon_ml_tpu.sweep.population import (
+    EarlyExitConfig,
+    PopulationResult,
+    PopulationTrainer,
+)
 from photon_ml_tpu.sweep.runner import (
     SweepConfig,
     SweepResult,
@@ -40,6 +44,7 @@ from photon_ml_tpu.sweep.runner import (
 from photon_ml_tpu.sweep.spec import SweepAxis, SweepSpec
 
 __all__ = [
+    "EarlyExitConfig",
     "PopulationResult",
     "PopulationTrainer",
     "SweepAxis",

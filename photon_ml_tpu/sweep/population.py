@@ -25,10 +25,28 @@ Two execution paths, bitwise-interchangeable per setting:
   PR 4 lesson (models/game.random_effect_view_score) applied to the
   population axis; the parity gate in bench.py --sweep pins the contract.
 
+A third path, ``fused``, collapses the whole train() call — all settings x
+all coordinates x all iterations — into ONE jit
+(``parallel/game.population_sweep_fn``), with per-lane EARLY EXIT
+(convergence/domination freezing mid-descent), optional warm-started initial
+tables, and an optional device MESH that shards the settings axis
+(``P(settings, None, None)`` tables, broadcast data replicated — the
+embarrassingly parallel axis crossing zero data collectives, audited by
+``parallel/hlo_guards.assert_settings_axis_collective_free``).
+
 Divergence: the per-lane reject is applied IN-PROGRAM (a diverged setting
 keeps its previous coefficients/score bit for bit, exactly like the
 single-model path) and surfaced as per-lane flags, materialized in ONE
 batched transfer per ``train`` call and recorded as incidents.
+
+Reduced-precision population tables: a ``re_precision`` policy on the
+estimator (optimization/precision.py) stores the ``[P, E, K]`` random-effect
+tables and their bucket/view feature arrays in bf16/f16 with f32
+accumulation — the same storage/accumulation split the single-model update
+program runs, inherited here because the population programs share its body.
+The f32 reference policy keeps every cast an identity (the bitwise-gated
+status quo); reduced sweeps are tolerance-gated on the winner's held-out
+metric, never compared bitwise against f32.
 """
 
 from __future__ import annotations
@@ -49,9 +67,14 @@ from photon_ml_tpu.estimators.config import RandomEffectDataConfiguration
 from photon_ml_tpu.function.losses import loss_for_task
 from photon_ml_tpu.models.game import FixedEffectModel, RandomEffectModel
 from photon_ml_tpu.models.glm import Coefficients, model_class_for_task
+from photon_ml_tpu.optimization.precision import resolve_precision
 from photon_ml_tpu.optimization.solver_cache import (
     fe_population_update_program,
     re_population_update_program,
+)
+from photon_ml_tpu.parallel.game import (
+    PopulationCoordinateSpec,
+    make_population_sweep_program,
 )
 from photon_ml_tpu.resilience.incidents import Incident
 from photon_ml_tpu.sampling.down_sampler import per_sample_uniform
@@ -61,6 +84,29 @@ from photon_ml_tpu.types import OptimizerType, TaskType, VarianceComputationType
 Array = jnp.ndarray
 
 _MIN_POPULATION_PAD = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class EarlyExitConfig:
+    """Per-lane early-exit policy for the FUSED population path.
+
+    - ``freeze_tol``: a lane whose total training score moved at most
+      ``freeze_tol * (1 + max|score|)`` across a full coordinate-descent pass
+      is select-frozen for the remaining passes (its committed state carried
+      bitwise; its remaining solves run zero iterations). Negative disables
+      convergence freezing while keeping the same compiled program.
+    - ``min_iterations``: completed passes before any lane may freeze
+      (STATIC — part of the program key).
+    - ``domination_bound``: optional host-derived training-loss bound; a lane
+      whose per-lane weighted mean training loss exceeds it freezes as
+      dominated. Per-lane vs a broadcast scalar — deliberately never a
+      cross-lane reduction, which would put a collective on the settings
+      axis. None disables (and keeps labels/weights out of the program).
+    """
+
+    freeze_tol: float = 1e-6
+    min_iterations: int = 1
+    domination_bound: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -92,11 +138,27 @@ class PopulationResult:
     train_scores: dict  # cid -> [P, N]
     incidents: list  # per-lane divergence Incidents (setting index attached)
     rejected: np.ndarray  # [P] bool: lane absorbed >= 1 rejected update
-    path: str  # "vmapped" | "sequential"
+    path: str  # "vmapped" | "sequential" | "fused"
+    # per-lane observability (every path): total solver iterations the lane's
+    # updates actually executed (RE: summed over entities and buckets)
+    lane_iterations: Optional[np.ndarray] = None  # [P] int
+    # fused path with early exit: completed CD passes at freeze time, -1 =
+    # the lane ran every pass
+    frozen_at: Optional[np.ndarray] = None  # [P] int
+    # fused path with capture_pass_states: per-pass state snapshots (tests)
+    pass_states: Optional[list] = None
 
     @property
     def population(self) -> int:
         return len(self.settings)
+
+    @property
+    def freeze_fraction(self) -> float:
+        """Fraction of lanes frozen before the final pass (0.0 when early
+        exit is off or on the per-update paths)."""
+        if self.frozen_at is None or self.frozen_at.size == 0:
+            return 0.0
+        return float(np.mean(self.frozen_at >= 0))
 
 
 class PopulationTrainer:
@@ -110,6 +172,7 @@ class PopulationTrainer:
         datasets: Mapping[str, object],
         base_offsets: Array,
         seed: int = 0,
+        mesh=None,
     ):
         self.estimator = estimator
         self.task = TaskType(estimator.task)
@@ -121,15 +184,18 @@ class PopulationTrainer:
         # and the sequential fallback run the SAME program, so the bitwise
         # per-lane parity contract holds for direct solves too
         self.re_solver = getattr(estimator, "re_solver", "lbfgs")
-        est_precision = getattr(estimator, "re_precision", None)
-        if est_precision is not None and not est_precision.is_reference:
-            # population state tables are f32-only today (ROADMAP item 4);
-            # silently training f32 lanes under a bf16 estimator would
-            # misreport what was measured
+        # storage/accumulation precision for the [P, E, K] random-effect
+        # population tables and their feature arrays — the estimator's
+        # re_precision, inherited the way the single-model update program
+        # inherits it (the population bodies ARE that program's body)
+        self.precision = resolve_precision(getattr(estimator, "re_precision", None))
+        # optional 1-D device mesh the FUSED path shards the SETTINGS axis
+        # over: population state P(settings, ...), broadcast data replicated
+        self.mesh = mesh
+        if mesh is not None and len(mesh.axis_names) != 1:
             raise ValueError(
-                "re_precision is not supported by the population programs "
-                "(f32-only population state); sweep with the reference "
-                "precision or train reduced models outside the sweep"
+                f"population mesh must be 1-D (settings axis); got axes "
+                f"{mesh.axis_names}"
             )
         loss = loss_for_task(self.task)
         self._static: dict[str, _CoordStatic] = {}
@@ -154,6 +220,19 @@ class PopulationTrainer:
                     )
                 norm = estimator._normalization_for(cfg.data_config.feature_shard_id)
                 norm = None if norm.is_identity or ds.projector is not None else norm
+                buckets = tuple(ds.buckets)
+                view = (ds.sample_entity_rows, ds.sample_local_cols, ds.sample_vals)
+                if not self.precision.is_reference:
+                    # feature storage at the reduced dtype, cast once per
+                    # trainer (the update bodies read these arrays every
+                    # solver iteration — storage-width bytes are the HBM
+                    # traffic the policy halves; solves and scores upcast
+                    # in-register, solver_cache)
+                    buckets = tuple(
+                        dataclasses.replace(b, X=self.precision.to_storage(b.X))
+                        for b in buckets
+                    )
+                    view = (view[0], view[1], self.precision.to_storage(view[2]))
                 self._static[cid] = _CoordStatic(
                     cid=cid,
                     kind="re",
@@ -161,9 +240,9 @@ class PopulationTrainer:
                     opt_config=opt,
                     norm=norm,
                     has_l1=bool(opt.l1_weight),
-                    buckets=tuple(ds.buckets),
+                    buckets=buckets,
                     norm_tables=precompute_norm_tables(ds, norm, self.dtype),
-                    view=(ds.sample_entity_rows, ds.sample_local_cols, ds.sample_vals),
+                    view=view,
                     per_entity=cfg.per_entity_reg_weights,
                 )
             else:
@@ -268,20 +347,48 @@ class PopulationTrainer:
         settings: Sequence[dict],
         n_iterations: int = 1,
         vmapped: bool = True,
+        *,
+        fused: bool = False,
+        early_exit: Optional[EarlyExitConfig] = None,
+        warm_start: Optional[Mapping[str, Array]] = None,
+        capture_pass_states: bool = False,
     ) -> PopulationResult:
         """Run ``n_iterations`` full coordinate-descent passes for every
-        setting, each setting solving from a zero initialization (candidates
-        are independent — model selection compares settings, it does not
-        chain them). Returns live-lane tables, scores and per-lane divergence
-        records."""
+        setting. By default each setting solves from a zero initialization
+        (candidates are independent — model selection compares settings, it
+        does not chain them); ``warm_start`` (cid -> ``[P, ...]``
+        original-space tables, the FUSED path only) seeds each lane instead —
+        the runner's cross-round glmnet-style paths. Returns live-lane
+        tables, scores and per-lane divergence/iteration records.
+
+        ``fused=True`` takes the one-jit whole-sweep path
+        (``parallel/game.population_sweep_fn``): required for ``early_exit``,
+        ``warm_start`` and a trainer ``mesh``; ``vmapped`` is ignored there.
+        """
         if n_iterations < 1:
             raise ValueError(f"n_iterations must be >= 1, got {n_iterations}")
         settings = list(settings)
         if not settings:
             raise ValueError("empty population")
-        if vmapped:
-            return self._train_vmapped(settings, n_iterations)
-        return self._train_sequential(settings, n_iterations)
+        if not fused:
+            for name, value in (
+                ("early_exit", early_exit),
+                ("warm_start", warm_start),
+                ("capture_pass_states", capture_pass_states or None),
+            ):
+                if value is not None:
+                    raise ValueError(f"{name} requires the fused path (fused=True)")
+            if self.mesh is not None:
+                raise ValueError(
+                    "a population mesh shards the settings axis of the FUSED "
+                    "program; call train(..., fused=True)"
+                )
+            if vmapped:
+                return self._train_vmapped(settings, n_iterations)
+            return self._train_sequential(settings, n_iterations)
+        return self._train_fused(
+            settings, n_iterations, early_exit, warm_start, capture_pass_states
+        )
 
     def _pad(self, arr: np.ndarray, p_pad: int) -> jnp.ndarray:
         """Pad the lane axis to ``p_pad`` with DUPLICATES of lane 0 (a twin
@@ -307,8 +414,9 @@ class PopulationTrainer:
         iteration: int,
     ):
         """One population update for one coordinate: returns (new coeffs,
-        new score, guard) with guard = (coefs_ok [P], value_ok [P] or None,
-        values [P] or None) device arrays."""
+        new score, guard, lane_iters) with guard = (coefs_ok [P], value_ok
+        [P] or None, values [P] or None) and lane_iters [P] (total solver
+        iterations per lane, RE summed over entities) device arrays."""
         if st.kind == "re":
             program = re_population_update_program(
                 self.task,
@@ -317,8 +425,9 @@ class PopulationTrainer:
                 VarianceComputationType.NONE,
                 st.dataset.n_entities,
                 self.re_solver,
+                self.precision,
             )
-            coeffs, score, _var, ok, _reasons, _iters = program(
+            coeffs, score, _var, ok, _reasons, iters = program(
                 state["coeffs"],
                 state["score"],
                 None,
@@ -329,7 +438,11 @@ class PopulationTrainer:
                 st.norm_tables,
                 st.view,
             )
-            return coeffs, score, (ok, None, None)
+            lane_iters = functools.reduce(
+                operator.add,
+                (jnp.sum(b, axis=-1).astype(jnp.int32) for b in iters),
+            )
+            return coeffs, score, (ok, None, None), lane_iters
         program = fe_population_update_program(
             self.task,
             st.opt_config.optimizer_config,
@@ -341,7 +454,7 @@ class PopulationTrainer:
             if st.down_sampling
             else jnp.zeros((0,), dtype=jnp.float32)
         )
-        coeffs, score, coefs_ok, value_ok, values, _iters, _reasons = program(
+        coeffs, score, coefs_ok, value_ok, values, iters, _reasons = program(
             state["coeffs"],
             state["score"],
             offsets_pop,
@@ -352,7 +465,23 @@ class PopulationTrainer:
             st.dataset.data,
             st.norm,
         )
-        return coeffs, score, (coefs_ok, value_ok, values)
+        return (
+            coeffs, score, (coefs_ok, value_ok, values),
+            iters.astype(jnp.int32),
+        )
+
+    def _table_dtype(self, st: _CoordStatic):
+        """Random-effect population tables live at the precision policy's
+        storage dtype; fixed-effect tables (and the reference policy) keep
+        the compute dtype — mirroring the single-model update program."""
+        if st.kind == "re" and not self.precision.is_reference:
+            return self.precision.storage_dtype
+        return self.dtype
+
+    def _score_dtype(self, st: _CoordStatic):
+        if st.kind == "re" and not self.precision.is_reference:
+            return self.precision.accum_dtype
+        return self.dtype
 
     def _init_state(self, p_pad: int) -> dict:
         states = {}
@@ -362,9 +491,11 @@ class PopulationTrainer:
             else:
                 shape = (p_pad, st.dataset.dim)
             states[cid] = {
-                "coeffs": jnp.zeros(shape, dtype=self.dtype),
+                "coeffs": jnp.zeros(shape, dtype=self._table_dtype(st)),
                 # a zero model scores exactly zero everywhere
-                "score": jnp.zeros((p_pad, self.n_samples), dtype=self.dtype),
+                "score": jnp.zeros(
+                    (p_pad, self.n_samples), dtype=self._score_dtype(st)
+                ),
             }
         return states
 
@@ -389,14 +520,14 @@ class PopulationTrainer:
             for cid, st in self._static.items():
                 partial = total - states[cid]["score"]
                 offsets_pop = self.base_offsets[None, :] + partial
-                coeffs, score, guard = self._dispatch_update(
+                coeffs, score, guard, iters = self._dispatch_update(
                     st, states[cid], lanes[cid], offsets_pop, iteration
                 )
                 states[cid] = {"coeffs": coeffs, "score": score}
                 total = partial + score
                 # lane index IS the setting index on the vmapped path
-                guards.append((iteration, cid, guard, None))
-        incidents, rejected = self._materialize_guards(guards, p_live)
+                guards.append((iteration, cid, guard, iters, None))
+        incidents, rejected, lane_iters = self._materialize_guards(guards, p_live)
         return PopulationResult(
             settings=settings,
             coeffs={cid: s["coeffs"][:p_live] for cid, s in states.items()},
@@ -404,6 +535,7 @@ class PopulationTrainer:
             incidents=incidents,
             rejected=rejected,
             path="vmapped",
+            lane_iterations=lane_iters,
         )
 
     def _train_sequential(self, settings: list, n_iterations: int) -> PopulationResult:
@@ -437,7 +569,7 @@ class PopulationTrainer:
                 for cid, st in self._static.items():
                     partial = total - states[cid]["score"]
                     offsets_pop = self.base_offsets[None, :] + partial
-                    coeffs, score, guard = self._dispatch_update(
+                    coeffs, score, guard, iters = self._dispatch_update(
                         st, states[cid], lanes[cid], offsets_pop, iteration
                     )
                     states[cid] = {"coeffs": coeffs, "score": score}
@@ -448,13 +580,14 @@ class PopulationTrainer:
                             iteration,
                             cid,
                             tuple(None if g is None else g[:1] for g in guard),
+                            iters[:1],
                             p,
                         )
                     )
             for cid, s in states.items():
                 final_coeffs[cid].append(s["coeffs"][0])
                 final_scores[cid].append(s["score"][0])
-        incidents, rejected = self._materialize_guards(guards, p_live)
+        incidents, rejected, lane_iters = self._materialize_guards(guards, p_live)
         return PopulationResult(
             settings=settings,
             coeffs={cid: jnp.stack(v) for cid, v in final_coeffs.items()},
@@ -462,25 +595,287 @@ class PopulationTrainer:
             incidents=incidents,
             rejected=rejected,
             path="sequential",
+            lane_iterations=lane_iters,
         )
+
+    # ---------------------------------------------------------- fused path
+
+    def _settings_sharding(self, ndim: int):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        axis = self.mesh.axis_names[0]
+        return NamedSharding(
+            self.mesh, PartitionSpec(axis, *([None] * (ndim - 1)))
+        )
+
+    def _replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _fused_coord_data(self) -> dict:
+        """The fused program's broadcast per-coordinate data pytrees. Under a
+        mesh, device_put REPLICATED once and cached (every device reads its
+        own copy of the shared datasets — the settings axis exchanges
+        nothing)."""
+        cached = getattr(self, "_fused_data_cache", None)
+        if cached is not None:
+            return cached
+        datas = {}
+        for cid, st in self._static.items():
+            if st.kind == "re":
+                datas[cid] = {
+                    "buckets": st.buckets,
+                    "norm_tables": st.norm_tables,
+                    "view": st.view,
+                }
+            else:
+                datas[cid] = {"data": st.dataset.data, "norm": st.norm}
+        if self.mesh is not None:
+            rep = self._replicated()
+            datas = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, rep), datas
+            )
+            self._fused_offsets = jax.device_put(self.base_offsets, rep)
+        else:
+            self._fused_offsets = self.base_offsets
+        self._fused_data_cache = datas
+        return datas
+
+    def _fused_program(
+        self, n_iterations: int, min_freeze_iterations: int,
+        with_domination: bool, warm: bool, capture: bool,
+    ):
+        key = (
+            n_iterations, min_freeze_iterations, with_domination, warm, capture,
+        )
+        cache = getattr(self, "_fused_programs", None)
+        if cache is None:
+            cache = self._fused_programs = {}
+        program = cache.get(key)
+        if program is None:
+            specs = []
+            for cid, st in self._static.items():
+                specs.append(
+                    PopulationCoordinateSpec(
+                        cid=cid,
+                        kind=st.kind,
+                        opt_config=st.opt_config.optimizer_config,
+                        has_l1=st.has_l1,
+                        n_entities=(
+                            st.dataset.n_entities if st.kind == "re" else 0
+                        ),
+                        down_sampling=st.down_sampling,
+                    )
+                )
+            program = make_population_sweep_program(
+                self.task,
+                tuple(specs),
+                n_iterations,
+                re_solver=self.re_solver,
+                precision=self.precision,
+                min_freeze_iterations=min_freeze_iterations,
+                with_domination=with_domination,
+                warm_start=warm,
+                capture_pass_states=capture,
+                mesh=self.mesh,
+            )
+            cache[key] = program
+        return program
+
+    def _domination_data(self):
+        """[N] labels/weights for the per-lane training-loss domination
+        check, from a fixed-effect coordinate's LabeledData (every
+        coordinate scores the same samples)."""
+        for st in self._static.values():
+            if st.kind == "fe":
+                return st.dataset.data.labels, st.dataset.data.weights
+        raise ValueError(
+            "domination_bound needs training labels; this estimator has no "
+            "fixed-effect coordinate to take them from"
+        )
+
+    def _fused_args(
+        self, settings: list, n_iterations: int,
+        early_exit: Optional[EarlyExitConfig],
+        warm_start: Optional[Mapping[str, Array]],
+        capture_pass_states: bool,
+    ):
+        """(program, args, guard_labels, p_live): everything a fused dispatch
+        — or a compile-only lowering of the identical program on identical
+        arguments (``lower_fused_sweep``) — needs."""
+        p_live = len(settings)
+        m = self.mesh.devices.size if self.mesh is not None else 1
+        p_pad = _next_pow2(p_live, _MIN_POPULATION_PAD)
+        if p_pad % m:
+            p_pad = ((p_pad + m - 1) // m) * m
+        lanes = {
+            cid: {
+                k: self._pad(v, p_pad)
+                for k, v in self._lane_values(st, settings).items()
+            }
+            for cid, st in self._static.items()
+        }
+        coeffs0 = {}
+        for cid, st in self._static.items():
+            dtype = self._table_dtype(st)
+            if warm_start is not None:
+                if cid not in warm_start:
+                    raise ValueError(f"warm_start is missing coordinate {cid!r}")
+                warm = jnp.asarray(warm_start[cid], dtype=dtype)
+                if warm.shape[0] != p_live:
+                    raise ValueError(
+                        f"warm_start[{cid!r}] has {warm.shape[0]} lanes, "
+                        f"population has {p_live}"
+                    )
+                if p_pad > p_live:
+                    warm = jnp.concatenate(
+                        [warm, jnp.repeat(warm[:1], p_pad - p_live, axis=0)]
+                    )
+                coeffs0[cid] = warm
+            elif st.kind == "re":
+                coeffs0[cid] = jnp.zeros(
+                    (p_pad, st.dataset.n_entities, st.dataset.max_k), dtype=dtype
+                )
+            else:
+                coeffs0[cid] = jnp.zeros((p_pad, st.dataset.dim), dtype=dtype)
+        active0 = jnp.ones((p_pad,), dtype=bool)
+        keep_us = {
+            cid: jnp.stack(
+                [self._keep_u(cid, it) for it in range(n_iterations)]
+            )
+            for cid, st in self._static.items()
+            if st.kind == "fe" and st.down_sampling
+        }
+        with_domination = (
+            early_exit is not None and early_exit.domination_bound is not None
+        )
+        if with_domination:
+            labels, weights = self._domination_data()
+            domination_bound = float(early_exit.domination_bound)
+        else:
+            labels = weights = jnp.zeros((0,), dtype=self.dtype)
+            domination_bound = float("inf")
+        freeze_tol = float(early_exit.freeze_tol) if early_exit is not None else -1.0
+        min_iters = early_exit.min_iterations if early_exit is not None else 1
+        datas = self._fused_coord_data()
+        if self.mesh is not None:
+            coeffs0 = {
+                cid: jax.device_put(a, self._settings_sharding(a.ndim))
+                for cid, a in coeffs0.items()
+            }
+            lanes = {
+                cid: {
+                    k: jax.device_put(a, self._settings_sharding(a.ndim))
+                    for k, a in lane.items()
+                }
+                for cid, lane in lanes.items()
+            }
+            active0 = jax.device_put(active0, self._settings_sharding(1))
+            rep = self._replicated()
+            keep_us = {k: jax.device_put(v, rep) for k, v in keep_us.items()}
+            if with_domination:
+                labels = jax.device_put(labels, rep)
+                weights = jax.device_put(weights, rep)
+        program = self._fused_program(
+            n_iterations, min_iters, with_domination,
+            warm_start is not None, capture_pass_states,
+        )
+        guard_labels = [
+            (it, cid)
+            for it in range(n_iterations)
+            for cid in self._static
+        ]
+        args = (
+            coeffs0, lanes, active0, self._fused_offsets, keep_us,
+            freeze_tol, domination_bound, labels, weights, datas,
+        )
+        return program, args, guard_labels, p_live
+
+    def _train_fused(
+        self, settings: list, n_iterations: int,
+        early_exit: Optional[EarlyExitConfig],
+        warm_start: Optional[Mapping[str, Array]],
+        capture_pass_states: bool,
+    ) -> PopulationResult:
+        program, args, guard_labels, p_live = self._fused_args(
+            settings, n_iterations, early_exit, warm_start, capture_pass_states
+        )
+        states, stats, guards_dev, snapshots = program(*args)
+        guards = [
+            (it, cid, guard, None, None)
+            for (it, cid), guard in zip(guard_labels, guards_dev)
+        ]
+        incidents, rejected, _ = self._materialize_guards(guards, p_live)
+        host_stats = jax.device_get(stats)
+        lane_iterations = np.asarray(host_stats["lane_iterations"][:p_live])
+        frozen_at = np.asarray(host_stats["frozen_at"][:p_live])
+        return PopulationResult(
+            settings=settings,
+            coeffs={cid: s["coeffs"][:p_live] for cid, s in states.items()},
+            train_scores={cid: s["score"][:p_live] for cid, s in states.items()},
+            incidents=incidents,
+            rejected=rejected,
+            path="fused",
+            lane_iterations=lane_iterations,
+            frozen_at=frozen_at,
+            pass_states=(
+                [
+                    {
+                        cid: {k: v[:p_live] for k, v in s.items()}
+                        for cid, s in snap.items()
+                    }
+                    for snap in snapshots
+                ]
+                if capture_pass_states
+                else None
+            ),
+        )
+
+    def lower_fused_sweep(
+        self,
+        settings: Sequence[dict],
+        n_iterations: int = 1,
+        early_exit: Optional[EarlyExitConfig] = None,
+        warm_start: Optional[Mapping[str, Array]] = None,
+    ) -> str:
+        """Compiled-module text of EXACTLY the fused program a
+        ``train(..., fused=True)`` call with these arguments dispatches —
+        the input ``hlo_guards.assert_settings_axis_collective_free``
+        audits (the mesh x population zero-data-collective contract)."""
+        program, args, _, _ = self._fused_args(
+            list(settings), n_iterations, early_exit, warm_start, False
+        )
+        return program.lower(*args).compile().as_text()
 
     def _materialize_guards(
         self, guards: list, p_live: int
-    ) -> tuple[list, np.ndarray]:
-        """ONE batched transfer for every update's per-lane guard flags, then
-        incident records for the rejects (the reject itself already happened
-        in-program — this is the paper trail, coordinate_descent._flush_guards
-        style). Guard entries carry an explicit setting index for sequential
-        dispatches (every lane is one setting there); vmapped entries map
-        lane index -> setting index directly."""
+    ) -> tuple[list, np.ndarray, np.ndarray]:
+        """ONE batched transfer for every update's per-lane guard flags AND
+        per-lane solver iteration counts, then incident records for the
+        rejects (the reject itself already happened in-program — this is the
+        paper trail, coordinate_descent._flush_guards style). Guard entries
+        carry an explicit setting index for sequential dispatches (every lane
+        is one setting there); vmapped entries map lane index -> setting
+        index directly. Returns (incidents, rejected [P], lane_iterations
+        [P])."""
         incidents: list[Incident] = []
         rejected = np.zeros(p_live, dtype=bool)
+        lane_iterations = np.zeros(p_live, dtype=np.int64)
         if not guards:
-            return incidents, rejected
-        host = jax.device_get([g for _, _, g, _ in guards])
-        for (iteration, cid, _, setting_idx), (coefs_ok, value_ok, values) in zip(
-            guards, host
-        ):
+            return incidents, rejected, lane_iterations
+        host = jax.device_get([(g, it) for _, _, g, it, _ in guards])
+        for (iteration, cid, _, _, setting_idx), (
+            (coefs_ok, value_ok, values), iters
+        ) in zip(guards, host):
+            if iters is not None:
+                # the fused path's iteration counts arrive via its stats
+                # output instead; per-update entries accumulate here
+                iters = np.atleast_1d(np.asarray(iters))
+                if setting_idx is not None:
+                    lane_iterations[setting_idx] += int(iters[0])
+                else:
+                    lane_iterations += iters[:p_live].astype(np.int64)
             coefs_ok = np.atleast_1d(np.asarray(coefs_ok))
             value_ok = None if value_ok is None else np.atleast_1d(np.asarray(value_ok))
             for lane in range(coefs_ok.shape[0]):
@@ -505,7 +900,7 @@ class PopulationTrainer:
                         detail=f"setting={p}",
                     )
                 )
-        return incidents, rejected
+        return incidents, rejected, lane_iterations
 
     # ---------------------------------------------------- population scoring
 

@@ -60,14 +60,36 @@ class SweepConfig:
     population: int = 8
     mode: HyperparameterTuningMode = HyperparameterTuningMode.BAYESIAN
     seed: int = 0
-    # coordinate-descent passes per candidate training (candidates are
-    # independent — no warm chaining across settings or rounds)
+    # coordinate-descent passes per candidate training
     n_iterations: int = 1
     # "auto" follows SweepSpec.vmappable; True forces the population path
     # (error when inexpressible); False forces the sequential fallback
     vmapped: object = "auto"
     export_directory: Optional[str] = None
     keep_generations: int = 4
+    # --- the fused (one-jit whole-sweep) execution family ----------------
+    # "auto": fused exactly when a fused-only feature below is requested;
+    # True forces the fused program even bare; False forbids it
+    fused: object = "auto"
+    # per-lane early exit mid-descent (EarlyExitConfig): finished/dominated
+    # lanes select-freeze, wall-clock tracks the surviving lanes
+    early_exit: object = None
+    # glmnet-style regularization paths ACROSS Bayesian rounds: each round's
+    # lanes seed from the committed table of the nearest previous-round
+    # setting (SweepSpec.nearest_prior) instead of cold-starting. Off by
+    # default: warm starts change the trained trajectory (results are
+    # tolerance-comparable, not bitwise, to cold runs), so the bitwise-gated
+    # status quo stays the default and the bench measures the delta.
+    warm_start: bool = False
+    # warm-seed a lane only when its nearest prior is within this Euclidean
+    # distance in the transformed-[0,1]^d search space; farther lanes cold
+    # start. A far prior's optimum is a WORSE start than zero (measured: it
+    # can cost more solver iterations than it saves — the glmnet lesson is
+    # that paths work because steps are small), so proximity gates the seed.
+    warm_start_max_distance: float = 0.25
+    # optional 1-D device mesh sharding the SETTINGS axis of the fused
+    # program (population x mesh; data replicated, zero data collectives)
+    mesh: object = None
 
     def __post_init__(self):
         self.mode = HyperparameterTuningMode(self.mode)
@@ -77,6 +99,22 @@ class SweepConfig:
             raise ValueError("population must be >= 1")
         if self.mode == HyperparameterTuningMode.NONE:
             raise ValueError("mode NONE proposes nothing; use RANDOM or BAYESIAN")
+        from photon_ml_tpu.sweep.population import EarlyExitConfig
+
+        if self.early_exit is not None and not isinstance(
+            self.early_exit, EarlyExitConfig
+        ):
+            raise TypeError(
+                f"early_exit must be an EarlyExitConfig, got {self.early_exit!r}"
+            )
+
+    @property
+    def wants_fused(self) -> bool:
+        return (
+            self.early_exit is not None
+            or self.warm_start
+            or self.mesh is not None
+        )
 
 
 @dataclasses.dataclass
@@ -88,6 +126,16 @@ class SweepRoundRecord:
     values: list  # P search values (lower better; NaN = unusable metric)
     metrics: list  # P full metric dicts
     rejected: list  # P bools: lane absorbed a rejected (divergent) update
+    # per-lane observability (defaults keep restores of pre-existing
+    # checkpoints loadable): solver iterations each lane actually executed,
+    # the CD pass it froze at (-1 = ran every pass), and the round's freeze
+    # fraction. Deliberately NO wall-clock here: round records are the
+    # DETERMINISTIC paper trail (replayed sweeps compare them for equality);
+    # per-round acquisition seconds live in SweepResult.timings
+    # ("propose_rounds") with the other measurements.
+    lane_iterations: Optional[list] = None
+    frozen_at: Optional[list] = None
+    freeze_fraction: Optional[float] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -107,7 +155,7 @@ class SweepResult:
     checkpoint_path: str
     export_path: Optional[str]
     incidents: list
-    path: str  # "vmapped" | "sequential"
+    path: str  # "vmapped" | "sequential" | "fused"
     restored: bool = False  # True when an already-committed sweep was reused
     # wall-clock per phase across all rounds: propose / train / evaluate /
     # commit (empty on a restored result). train+evaluate is the part the
@@ -115,6 +163,11 @@ class SweepResult:
     # identically by ANY execution path (benchmarks/sweep_bench.py reports
     # both separately).
     timings: dict = dataclasses.field(default_factory=dict)
+    # early-exit / warm-start observability across the whole sweep: total
+    # solver iterations all lanes executed, and the mean per-round freeze
+    # fraction (None on restored results and pre-observability checkpoints)
+    total_solver_iterations: Optional[int] = None
+    freeze_fraction: Optional[float] = None
 
 
 class SweepRunner:
@@ -135,6 +188,26 @@ class SweepRunner:
                     "vmapped=True but the spec needs the sequential path "
                     "(dict per-entity L2 overrides resolve host-side)"
                 )
+        if config.fused == "auto":
+            self._fused = config.wants_fused
+        else:
+            self._fused = bool(config.fused)
+            if not self._fused and config.wants_fused:
+                raise ValueError(
+                    "early_exit / warm_start / mesh are fused-path features; "
+                    "drop fused=False or the feature"
+                )
+        if self._fused and not spec.vmappable(estimator):
+            raise ValueError(
+                "the fused sweep needs lane-expressible settings; dict "
+                "per-entity L2 overrides under a swept l2 axis resolve "
+                "host-side (sequential path only)"
+            )
+        self._path_name = (
+            "fused"
+            if self._fused
+            else ("vmapped" if self._vmapped else "sequential")
+        )
 
     # ---------------------------------------------------------- fingerprint
 
@@ -151,11 +224,31 @@ class SweepRunner:
             # with a different re_solver must retrain, not restore the other
             # solver's committed winner (the PR 8 stale-restore lesson)
             f"re_solver={getattr(self.estimator, 're_solver', 'lbfgs')}",
+            # reduced-precision population tables change trained bytes the
+            # same way (the PR 11 lesson: the fingerprint carries the policy)
+            f"re_precision={getattr(getattr(self.estimator, 're_precision', None), 'name', 'f32')}",
             f"n={n_train}",
             f"val={n_val}",
             # process-stable names: str(Evaluator) renders a function address
             f"evals={[evaluator_spec_name(e) for e in self.estimator.validation_evaluators]}",
         ]
+        if self.config.mode == HyperparameterTuningMode.BAYESIAN:
+            # the batched acquisition algorithm shapes every round's
+            # proposals: a committed sweep proposed under a different
+            # algorithm must retrain, not restore
+            parts.append("acq=qei-lp1")
+        if self.config.warm_start:
+            parts.append(
+                f"warm=nearest1|{self.config.warm_start_max_distance}"
+            )
+        if self.config.early_exit is not None:
+            ee = self.config.early_exit
+            parts.append(
+                f"freeze={ee.freeze_tol}|{ee.min_iterations}|{ee.domination_bound}"
+            )
+        # the mesh is deliberately ABSENT: layouts are tolerance-equivalent
+        # (the PR 10 cross-layout contract), so a committed winner restores
+        # across placements the way checkpoints do
         for cid in sorted(self.estimator.coordinate_configurations):
             cfg = self.estimator.coordinate_configurations[cid]
             parts.append(f"{cid}={cfg.optimization_config!r}")
@@ -282,7 +375,8 @@ class SweepRunner:
             np.asarray(data.offsets), dtype=estimator.dtype
         )
         trainer = PopulationTrainer(
-            estimator, datasets, base_offsets, seed=self.config.seed
+            estimator, datasets, base_offsets, seed=self.config.seed,
+            mesh=self.config.mesh,
         )
         validation_datasets = estimator.prepare_scoring_datasets(validation_data)
         suite = estimator.prepare_evaluation_suite(validation_data)
@@ -319,26 +413,82 @@ class SweepRunner:
             config.rounds,
             config.population,
             config.mode.value,
-            "vmapped" if self._vmapped else "sequential",
+            self._path_name,
             self.spec.dimension,
         )
 
         history: list[SweepRoundRecord] = []
         incidents: list = []
-        timings = {"propose": 0.0, "train": 0.0, "evaluate": 0.0, "commit": 0.0}
+        timings = {
+            "propose": 0.0, "train": 0.0, "evaluate": 0.0, "commit": 0.0,
+            # per-round acquisition (propose) seconds — the observability the
+            # qEI penalization's extra host work is measured by
+            "propose_rounds": [],
+        }
         best = None  # (value, round, lane, settings, metrics, models)
+        prev_round = None  # (settings, coeffs tables) for warm seeding
+        total_solver_iterations = 0
+        freeze_fractions: list[float] = []
         for r in range(config.rounds):
             faultpoint(FP_PROPOSE)
             t1 = time.perf_counter()
             candidates = searcher.propose_batch(config.population)
             settings = self.spec.decode(candidates)
-            timings["propose"] += time.perf_counter() - t1
+            acquisition_sec = time.perf_counter() - t1
+            timings["propose"] += acquisition_sec
+            timings["propose_rounds"].append(round(acquisition_sec, 6))
             faultpoint(FP_TRAIN)
             t1 = time.perf_counter()
-            pop = trainer.train(
-                settings, n_iterations=config.n_iterations, vmapped=self._vmapped
-            )
+            if self._fused:
+                warm = None
+                if prev_round is not None:
+                    # glmnet-style paths across rounds: seed each lane from
+                    # the committed table of its nearest previous-round
+                    # setting (distances in the transformed search space),
+                    # but ONLY when that prior is actually near
+                    # (warm_start_max_distance) — a far optimum is a worse
+                    # start than zero. jnp.take builds fresh buffers, so the
+                    # fused program's donation never invalidates the held
+                    # previous result.
+                    prev_settings, prev_coeffs = prev_round
+                    idx = self.spec.nearest_prior(settings, prev_settings)
+                    enc_new = self.spec.encode(settings)
+                    enc_prev = self.spec.encode(prev_settings)
+                    near = (
+                        np.linalg.norm(enc_new - enc_prev[idx], axis=1)
+                        <= config.warm_start_max_distance
+                    )
+                    if near.any():
+                        mask = jnp.asarray(near)
+                        warm = {
+                            cid: jnp.where(
+                                mask.reshape((-1,) + (1,) * (table.ndim - 1)),
+                                jnp.take(table, jnp.asarray(idx), axis=0),
+                                jnp.zeros((), dtype=table.dtype),
+                            )
+                            for cid, table in prev_coeffs.items()
+                        }
+                pop = trainer.train(
+                    settings,
+                    n_iterations=config.n_iterations,
+                    fused=True,
+                    early_exit=config.early_exit,
+                    warm_start=warm,
+                )
+            else:
+                pop = trainer.train(
+                    settings, n_iterations=config.n_iterations,
+                    vmapped=self._vmapped,
+                )
+            if self._fused and config.warm_start:
+                # only the tables are consulted next round; retaining the
+                # whole PopulationResult would pin every round's [P, N]
+                # score buffers on device for nothing
+                prev_round = (settings, pop.coeffs)
             incidents.extend(pop.incidents)
+            if pop.lane_iterations is not None:
+                total_solver_iterations += int(np.sum(pop.lane_iterations))
+            freeze_fractions.append(pop.freeze_fraction)
             timings["train"] += time.perf_counter() - t1
             faultpoint(FP_EVALUATE)
             t1 = time.perf_counter()
@@ -366,6 +516,17 @@ class SweepRunner:
                     values=[float(v) for v in values],
                     metrics=metrics_by_lane,
                     rejected=[bool(b) for b in pop.rejected],
+                    lane_iterations=(
+                        None
+                        if pop.lane_iterations is None
+                        else [int(v) for v in pop.lane_iterations]
+                    ),
+                    frozen_at=(
+                        None
+                        if pop.frozen_at is None
+                        else [int(v) for v in pop.frozen_at]
+                    ),
+                    freeze_fraction=round(pop.freeze_fraction, 6),
                 )
             )
             logger.info(
@@ -396,7 +557,7 @@ class SweepRunner:
                 "seed": config.seed,
                 "mode": config.mode.value,
                 "n_iterations": config.n_iterations,
-                "path": "vmapped" if self._vmapped else "sequential",
+                "path": self._path_name,
                 "winner": winner,
                 "history": [h.to_dict() for h in history],
                 "models_evaluated": config.rounds * config.population,
@@ -437,6 +598,15 @@ class SweepRunner:
             checkpoint_path=config.checkpoint_directory,
             export_path=export_path,
             incidents=[i.to_dict() for i in incidents],
-            path="vmapped" if self._vmapped else "sequential",
-            timings={k: round(v, 6) for k, v in timings.items()},
+            path=self._path_name,
+            timings={
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in timings.items()
+            },
+            total_solver_iterations=total_solver_iterations,
+            freeze_fraction=(
+                round(float(np.mean(freeze_fractions)), 6)
+                if freeze_fractions
+                else None
+            ),
         )

@@ -233,6 +233,23 @@ class SweepSpec:
             rows.append(scale_forward(transform_forward(raw, tmap), ranges_t))
         return np.stack(rows)
 
+    def nearest_prior(
+        self, settings: Sequence[dict], prior_settings: Sequence[dict]
+    ) -> np.ndarray:
+        """Index of each setting's nearest neighbor among ``prior_settings``,
+        by Euclidean distance in the transformed-[0,1]^d search space — the
+        warm-start seeding rule (SweepRunner's glmnet-style regularization
+        paths across Bayesian rounds): 'nearest on the swept axes' is
+        measured where those axes are commensurate, i.e. after the LOG/SQRT
+        transforms and range scaling. np.argmin ties break to the lowest
+        index, so the mapping is deterministic."""
+        if not prior_settings:
+            raise ValueError("nearest_prior needs at least one prior setting")
+        a = self.encode(settings)
+        b = self.encode(prior_settings)
+        d = np.linalg.norm(a[:, None, :] - b[None, :, :], axis=-1)
+        return np.argmin(d, axis=1)
+
     def describe(self) -> list[dict]:
         """JSON-friendly axis description (driver stats / checkpoint extra)."""
         return [

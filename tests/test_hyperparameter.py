@@ -400,6 +400,7 @@ obs = [
 for p, v in obs:
     search.on_observation(np.asarray(p), v)
 out["gp_batch"] = search.propose_batch(3).tolist()
+out["gp_batch_penalized"] = search.propose_batch(6).tolist()
 out["gp_next"] = search.next(np.asarray(obs[-1][0]), obs[-1][1]).tolist()
 
 print(json.dumps(out))
@@ -472,3 +473,64 @@ def test_random_search_propose_batch_advances_the_stream():
     np.testing.assert_array_equal(s2.propose_batch(3), a)
     with pytest.raises(ValueError):
         s.propose_batch(0)
+
+
+def test_propose_batch_penalization_spreads_the_batch():
+    """The qEI local-penalization contract: once the posterior concentrates,
+    independent per-pick argmaxes re-derive (nearly) the same optimum; the
+    penalized batch spreads over distinct candidates instead. Gated on (a)
+    no duplicate proposals, (b) a minimum pairwise spread several times the
+    pool's typical nearest-neighbor spacing."""
+
+    def observed(seed=11):
+        s = GaussianProcessSearch(2, None, seed=seed)
+        pts = [[0.2, 0.3], [0.6, 0.1], [0.9, 0.5], [0.4, 0.7],
+               [0.5, 0.45], [0.52, 0.48]]
+        vals = [1.0, 0.5, 0.8, 0.3, 0.28, 0.29]
+        for p, v in zip(pts, vals):
+            s.on_observation(np.asarray(p, dtype=np.float64), v)
+        return s
+
+    batch = observed().propose_batch(4)
+    assert batch.shape == (4, 2)
+    assert (batch >= 0).all() and (batch <= 1).all()
+    d = np.linalg.norm(batch[:, None, :] - batch[None, :, :], axis=-1)
+    pairwise = d[np.triu_indices(4, 1)]
+    assert (pairwise > 0).all(), "hard exclusion: no duplicate proposals"
+    assert pairwise.min() > 0.05, (
+        f"penalized batch must spread (min pairwise {pairwise.min():.4f})"
+    )
+    # deterministic: same seed + observations -> identical batch
+    np.testing.assert_array_equal(batch, observed().propose_batch(4))
+    # the greedy first pick IS the plain EI argmax (penalties start at 1)
+    s = observed()
+    t = s._fit_posterior()
+    pool = s.draw_candidates(max(s.candidate_pool_size, 4))
+    ei = t(*s.last_model.predict(pool))
+    np.testing.assert_array_equal(
+        batch[0], s._discretize(pool[int(np.argmax(ei))])
+    )
+
+
+def test_propose_batch_handles_batches_larger_than_pool():
+    s = GaussianProcessSearch(1, None, candidate_pool_size=8, seed=2)
+    for i in range(4):
+        s.on_observation(np.asarray([i / 4.0]), float((i - 1.5) ** 2))
+    batch = s.propose_batch(12)  # pool grows to n when n > pool size
+    assert batch.shape == (12, 1)
+    assert len({float(x) for x in batch[:, 0]}) == 12
+
+
+def test_propose_batch_stays_distinct_when_ei_underflows():
+    """A confident posterior far above the incumbent drives EI to exactly
+    0.0 across the whole pool (gamma < ~-38 underflows norm.cdf/pdf); the
+    hard exclusion must be an argmax MASK, not a multiplicative zero — a
+    zero cannot break a tie among zeros, and the batch would collapse to n
+    copies of pool index 0."""
+    s = GaussianProcessSearch(1, None, seed=3)
+    pts = [[0.1], [0.3], [0.5], [0.7], [0.9]]
+    vals = [-1e6, 1.0, 1.1, 0.9, 1.2]  # incumbent 1e6 below every candidate
+    for p, v in zip(pts, vals):
+        s.on_observation(np.asarray(p, dtype=np.float64), v)
+    batch = s.propose_batch(5)
+    assert len({float(x) for x in batch.ravel()}) == 5

@@ -585,3 +585,329 @@ def test_prepare_cache_keys_on_retained_identity(rng, tmp_path):
     train_b, val_b = make_inputs(np.random.default_rng(99), n=260, n_val=140)
     prepared_b = runner._prepare(train_b, val_b)
     assert prepared_b is not prepared_a  # different objects: rebuilt
+
+
+# ------------------------------------- fused path, early exit, mesh, bf16
+
+
+def hetero_settings():
+    """Heterogeneous convergence speeds: huge-l2 lanes converge almost
+    immediately, tiny-l2 lanes keep descending — the early-exit regime."""
+    return [
+        {"global.l2": 200.0, "per-user.l2": 500.0},
+        {"global.l2": 0.02, "per-user.l2": 0.01},
+        {"global.l2": 1.0, "per-user.l2": 1.0},
+    ]
+
+
+def lane_primary_metrics(est, trainer, pop, validation_input):
+    """Per-lane primary validation metric, the runner's selection rule."""
+    scoring = est.prepare_scoring_datasets(validation_input)
+    suite = est.prepare_evaluation_suite(validation_input)
+    totals = np.asarray(trainer.score_population(pop, scoring))
+    return [suite.evaluate(totals[p])[suite.primary.name] for p in range(pop.population)]
+
+
+def test_fused_matches_per_update_path_and_reports_iterations(rng):
+    """One jit covering all settings x coordinates x iterations vs the
+    per-update dispatch loop: same bodies, same inputs, same lane axis — on
+    the CPU test harness the tables come out bitwise equal, and the per-lane
+    solver iteration counts agree exactly."""
+    train_input, _ = make_inputs(rng)
+    trainer = make_trainer(make_estimator(), train_input)
+    pv = trainer.train(settings_grid(), n_iterations=2, vmapped=True)
+    pf = trainer.train(settings_grid(), n_iterations=2, fused=True)
+    assert pf.path == "fused"
+    assert_bitwise_tables(pv, pf)
+    np.testing.assert_array_equal(pf.lane_iterations, pv.lane_iterations)
+    # no early exit requested: nothing froze
+    assert (pf.frozen_at == -1).all() and pf.freeze_fraction == 0.0
+
+
+def test_fused_only_features_refused_on_per_update_paths(rng):
+    from photon_ml_tpu.sweep import EarlyExitConfig
+
+    train_input, _ = make_inputs(rng)
+    trainer = make_trainer(make_estimator(), train_input)
+    with pytest.raises(ValueError, match="fused"):
+        trainer.train(
+            settings_grid(), early_exit=EarlyExitConfig(freeze_tol=1e-6)
+        )
+    with pytest.raises(ValueError, match="fused"):
+        trainer.train(settings_grid(), warm_start={})
+
+
+def test_early_exit_freeze_contract(rng):
+    """THE freeze contract, proven within ONE compiled program
+    (``freeze_tol`` is traced, so tol=-1 'never freeze' and a real tolerance
+    dispatch the same module): (a) surviving lanes are bitwise identical to
+    the no-freeze run; (b) a frozen lane's final state is bit-for-bit its
+    committed state — the no-freeze run's snapshot at the pass it froze;
+    (c) frozen lanes stop consuming solver iterations; (d) the winner (the
+    per-lane held-out primary metric argbest) is unchanged."""
+    from photon_ml_tpu.sweep import EarlyExitConfig
+
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+    trainer = make_trainer(est, train_input)
+    settings = hetero_settings()
+    base = trainer.train(
+        settings, n_iterations=6, fused=True,
+        early_exit=EarlyExitConfig(freeze_tol=-1.0),
+        capture_pass_states=True,
+    )
+    ee = trainer.train(
+        settings, n_iterations=6, fused=True,
+        early_exit=EarlyExitConfig(freeze_tol=1e-4),
+        capture_pass_states=True,
+    )
+    assert (base.frozen_at == -1).all()
+    frozen = ee.frozen_at >= 0
+    assert frozen.any(), "the heterogeneous shape must actually freeze a lane"
+    assert not frozen.all(), "the slow lanes must survive"
+    for p in range(len(settings)):
+        for cid in ee.coeffs:
+            got = np.asarray(ee.coeffs[cid][p])
+            if frozen[p]:
+                committed = np.asarray(
+                    base.pass_states[ee.frozen_at[p] - 1][cid]["coeffs"][p]
+                )
+                np.testing.assert_array_equal(got, committed, err_msg=f"{cid}[{p}]")
+            else:
+                np.testing.assert_array_equal(
+                    got, np.asarray(base.coeffs[cid][p]), err_msg=f"{cid}[{p}]"
+                )
+    assert (
+        ee.lane_iterations[frozen] < base.lane_iterations[frozen]
+    ).all(), "freezing must stop the lane's solver work"
+    np.testing.assert_array_equal(
+        ee.lane_iterations[~frozen], base.lane_iterations[~frozen]
+    )
+    m_base = lane_primary_metrics(est, trainer, base, validation_input)
+    m_ee = lane_primary_metrics(est, trainer, ee, validation_input)
+    assert int(np.argmax(m_base)) == int(np.argmax(m_ee))
+
+
+def test_early_exit_domination_bound_freezes_bad_lanes(rng):
+    """A lane whose training loss exceeds the host-provided bound freezes as
+    dominated mid-descent; the winner (never the dominated lane) is
+    unchanged."""
+    from photon_ml_tpu.function.losses import loss_for_task
+    from photon_ml_tpu.sweep import EarlyExitConfig
+
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+    trainer = make_trainer(est, train_input)
+    settings = hetero_settings()
+    base = trainer.train(
+        settings, n_iterations=4, fused=True,
+        early_exit=EarlyExitConfig(freeze_tol=-1.0),
+    )
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    y = np.asarray(train_input.labels)
+    totals = sum(np.asarray(base.train_scores[cid]) for cid in base.train_scores)
+    lane_losses = np.asarray(
+        [float(np.mean(np.asarray(loss.loss(totals[p], y)))) for p in range(3)]
+    )
+    worst = int(np.argmax(lane_losses))
+    bound = float(np.sort(lane_losses)[-2]) + 1e-9  # only the worst exceeds it
+    dom = trainer.train(
+        settings, n_iterations=4, fused=True,
+        early_exit=EarlyExitConfig(freeze_tol=-1.0, domination_bound=bound),
+    )
+    assert dom.frozen_at[worst] >= 0
+    assert dom.lane_iterations[worst] < base.lane_iterations[worst]
+    m_base = lane_primary_metrics(est, trainer, base, validation_input)
+    m_dom = lane_primary_metrics(est, trainer, dom, validation_input)
+    assert int(np.argmax(m_base)) == int(np.argmax(m_dom))
+
+
+def test_warm_start_seeds_lanes_and_reduces_iterations(rng):
+    """Warm-starting a nearby setting from a prior committed table converges
+    in fewer solver iterations than a cold start (the glmnet-paths claim at
+    the mechanism level; the runner-level delta is bench-gated)."""
+    import jax.numpy as jnp
+
+    train_input, _ = make_inputs(rng)
+    trainer = make_trainer(make_estimator(), train_input)
+    s1 = [{"global.l2": 1.0, "per-user.l2": 2.0},
+          {"global.l2": 5.0, "per-user.l2": 8.0}]
+    p1 = trainer.train(s1, n_iterations=1, fused=True)
+    s2 = [{"global.l2": 1.3, "per-user.l2": 2.2},
+          {"global.l2": 4.1, "per-user.l2": 7.0}]
+    cold = trainer.train(s2, n_iterations=1, fused=True)
+    warm_tables = {
+        cid: jnp.take(t, jnp.asarray([0, 1]), axis=0)
+        for cid, t in p1.coeffs.items()
+    }
+    warm = trainer.train(s2, n_iterations=1, fused=True, warm_start=warm_tables)
+    assert int(warm.lane_iterations.sum()) < int(cold.lane_iterations.sum())
+    # wrong lane count is a loud error, not a silent broadcast
+    with pytest.raises(ValueError, match="lanes"):
+        trainer.train(
+            s2, fused=True,
+            warm_start={cid: t[:1] for cid, t in p1.coeffs.items()},
+        )
+
+
+def test_spec_nearest_prior_is_transform_space_and_deterministic():
+    spec = l2_spec()
+    prior = [
+        {"global.l2": 0.1, "per-user.l2": 0.1},
+        {"global.l2": 10.0, "per-user.l2": 10.0},
+    ]
+    # LOG axes: 20.0 is nearest 10.0 in log space, 0.05 nearest 0.1
+    idx = spec.nearest_prior(
+        [{"global.l2": 20.0, "per-user.l2": 20.0},
+         {"global.l2": 0.05, "per-user.l2": 0.05}],
+        prior,
+    )
+    assert idx.tolist() == [1, 0]
+    with pytest.raises(ValueError, match="prior"):
+        spec.nearest_prior(prior, [])
+
+
+def test_population_bf16_tables_all_paths(rng):
+    """The lifted re_precision refusal: bf16 [P,E,K] population tables train
+    finitely on every path, the three families agree bitwise per lane, and
+    the held-out scores drift only tolerance-level from the f32 reference."""
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator(re_precision="bf16")
+    trainer = make_trainer(est, train_input)
+    pv = trainer.train(settings_grid(), n_iterations=2, vmapped=True)
+    ps = trainer.train(settings_grid(), n_iterations=2, vmapped=False)
+    pf = trainer.train(settings_grid(), n_iterations=2, fused=True)
+    assert str(np.asarray(pv.coeffs["per-user"]).dtype) == "bfloat16"
+    assert np.asarray(pv.coeffs["global"]).dtype == np.asarray(
+        trainer.base_offsets
+    ).dtype  # FE tables keep the compute dtype
+    assert_bitwise_tables(pv, ps)
+    assert_bitwise_tables(pv, pf)
+    ref = make_trainer(make_estimator(), train_input)
+    pr = ref.train(settings_grid(), n_iterations=2, vmapped=True)
+    m_bf16 = lane_primary_metrics(est, trainer, pf, validation_input)
+    m_f32 = lane_primary_metrics(
+        make_estimator(), ref, pr, validation_input
+    )
+    np.testing.assert_allclose(m_bf16, m_f32, atol=0.05)
+
+
+def test_runner_bf16_sweep_commits_and_restores(rng, tmp_path):
+    """End-to-end bf16 sweep: winner commits as a generational checkpoint
+    (PR 11's reduced-dtype encoding) and an idempotent rerun restores it."""
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator(re_precision="bf16")
+    config = SweepConfig(
+        checkpoint_directory=str(tmp_path / "ckpt"), rounds=2, population=2,
+        seed=3,
+    )
+    r1 = SweepRunner(est, l2_spec(), config).run(train_input, validation_input)
+    assert np.isfinite(r1.winner_metric)
+    r2 = SweepRunner(est, l2_spec(), config).run(train_input, validation_input)
+    assert r2.restored
+    assert r2.winner_metrics == r1.winner_metrics
+    # a precision change retrains rather than restoring the bf16 winner
+    est_f32 = make_estimator()
+    r3 = SweepRunner(
+        est_f32, l2_spec(),
+        SweepConfig(checkpoint_directory=str(tmp_path / "ckpt"), rounds=2,
+                    population=2, seed=3),
+    ).run(train_input, validation_input)
+    assert not r3.restored
+
+
+def test_runner_early_exit_observability_and_determinism(rng, tmp_path):
+    from photon_ml_tpu.sweep import EarlyExitConfig
+
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+
+    def go(ckpt):
+        config = SweepConfig(
+            checkpoint_directory=str(ckpt), rounds=2, population=3, seed=9,
+            n_iterations=5, early_exit=EarlyExitConfig(freeze_tol=1e-4),
+        )
+        return SweepRunner(est, l2_spec(), config).run(
+            train_input, validation_input
+        )
+
+    a = go(tmp_path / "a")
+    assert a.path == "fused"
+    assert a.total_solver_iterations and a.total_solver_iterations > 0
+    assert a.freeze_fraction is not None
+    for rec in a.rounds:
+        assert len(rec.lane_iterations) == 3
+        assert len(rec.frozen_at) == 3
+        assert rec.freeze_fraction is not None
+    assert len(a.timings["propose_rounds"]) == 2
+    # early exit preserves seeded determinism (records compare equal)
+    b = go(tmp_path / "b")
+    assert [r.to_dict() for r in a.rounds] == [r.to_dict() for r in b.rounds]
+    # and the committed sweep restores with the observability intact
+    c = go(tmp_path / "a")
+    assert c.restored
+    assert [r.to_dict() for r in c.rounds] == [r.to_dict() for r in a.rounds]
+
+
+def test_mesh_population_deterministic_tolerant_and_collective_free(
+    rng, eight_devices
+):
+    """Mesh x population: the settings axis sharded over 8 emulated devices
+    is run-to-run BITWISE deterministic, tolerance-equivalent to the host
+    layout (the PR 10 cross-layout contract), and its compiled module
+    carries zero data collectives (lanes are independent by construction —
+    the guard proves the compiled form shows it)."""
+    from photon_ml_tpu.parallel import hlo_guards
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+    mesh = make_mesh(8, axis_name="settings")
+    datasets = est.prepare_training_datasets(train_input)
+    tr_mesh = PopulationTrainer(
+        est, datasets, np.asarray(train_input.offsets), seed=0, mesh=mesh
+    )
+    tr_host = make_trainer(est, train_input)
+    settings = settings_grid()
+    pm = tr_mesh.train(settings, n_iterations=2, fused=True)
+    pm2 = tr_mesh.train(settings, n_iterations=2, fused=True)
+    ph = tr_host.train(settings, n_iterations=2, fused=True)
+    assert_bitwise_tables(pm, pm2)
+    for cid in pm.coeffs:
+        np.testing.assert_allclose(
+            np.asarray(pm.coeffs[cid], dtype=np.float64),
+            np.asarray(ph.coeffs[cid], dtype=np.float64),
+            rtol=1e-2, atol=1e-2, err_msg=cid,
+        )
+    hlo = tr_mesh.lower_fused_sweep(settings, n_iterations=2)
+    preds = hlo_guards.assert_settings_axis_collective_free(hlo)
+    assert preds >= 0
+    # negative control: a data-sized gather must trip the guard
+    poisoned = hlo + (
+        "\n  %ag = f32[128,4]{1,0} all-gather(f32[16,4]{1,0} %x), dimensions={0}\n"
+    )
+    with pytest.raises(AssertionError, match="settings axis"):
+        hlo_guards.assert_settings_axis_collective_free(poisoned)
+
+
+def test_mesh_requires_fused_and_runner_wires_it(rng, tmp_path, eight_devices):
+    from photon_ml_tpu.parallel.mesh import make_mesh
+
+    train_input, validation_input = make_inputs(rng)
+    est = make_estimator()
+    mesh = make_mesh(8, axis_name="settings")
+    datasets = est.prepare_training_datasets(train_input)
+    trainer = PopulationTrainer(
+        est, datasets, np.asarray(train_input.offsets), mesh=mesh
+    )
+    with pytest.raises(ValueError, match="fused"):
+        trainer.train(settings_grid(), vmapped=True)
+    config = SweepConfig(
+        checkpoint_directory=str(tmp_path / "ckpt"), rounds=2, population=3,
+        seed=4, mesh=mesh,
+    )
+    result = SweepRunner(est, l2_spec(), config).run(
+        train_input, validation_input
+    )
+    assert result.path == "fused"
+    assert np.isfinite(result.winner_metric)

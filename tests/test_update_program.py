@@ -968,3 +968,153 @@ def test_variance_delta_pass_refuses_varianceless_warm_start(rng):
     )
     assert model.variances is not None
     assert np.isfinite(np.asarray(model.variances)).all()
+
+
+# ------------------------------- population programs: per-lane active flags
+
+
+def _population_re_inputs(rng, P=4):
+    from photon_ml_tpu.algorithm.random_effect import (
+        build_l2_rows,
+        precompute_norm_tables,
+    )
+
+    X, X_re, users, y, _ = make_workload(rng)
+    ds = build_random_effect_dataset(
+        X_re, users, "userId", feature_shard_id="per-user", labels=y
+    )
+    dtype = ds.sample_vals.dtype
+    E, K = ds.n_entities, ds.max_k
+    l2_rows = jnp.stack(
+        [
+            jnp.asarray(build_l2_rows(ds, float(p + 1), None, dtype, E))
+            for p in range(P)
+        ]
+    )
+    coeffs = jnp.asarray(rng.normal(size=(P, E, K)) * 0.01, dtype)
+    score = jnp.asarray(rng.normal(size=(P, N)) * 0.01, dtype)
+    offsets = jnp.zeros((P, N), dtype)
+    norm_tables = precompute_norm_tables(ds, None, dtype)
+    view = (ds.sample_entity_rows, ds.sample_local_cols, ds.sample_vals)
+    return ds, dtype, l2_rows, coeffs, score, offsets, norm_tables, view
+
+
+def test_re_population_with_active_freezes_lanes_bitwise(rng):
+    """The early-exit lever at the program level: an inactive lane's bucket
+    solves run ZERO iterations and the lane's donated table/score come back
+    bit-for-bit (the select is load-bearing — a zero-iteration solve alone
+    would round-trip the warm start through dtype/space conversions);
+    active lanes train normally and the frozen lane reports no reject."""
+    from photon_ml_tpu.optimization.solver_cache import (
+        re_population_update_program,
+    )
+
+    ds, dtype, l2_rows, coeffs, score, offsets, norm_tables, view = (
+        _population_re_inputs(rng)
+    )
+    # EXPLICIT copies: np.asarray on a CPU jax array may be zero-copy, and
+    # the program DONATES these buffers — a view would silently alias the
+    # outputs written into the reused buffer
+    coeffs_host, score_host = np.array(coeffs), np.array(score)
+    program = re_population_update_program(
+        TaskType.LOGISTIC_REGRESSION,
+        CFG.optimizer_config,
+        False,
+        VarianceComputationType.NONE,
+        ds.n_entities,
+        "lbfgs",
+        with_active=True,
+    )
+    active = jnp.asarray([True, False, True, False])
+    out_c, out_s, _var, ok, _reasons, iters = program(
+        coeffs, score, None, offsets, l2_rows,
+        jnp.zeros((4,), dtype), active,
+        tuple(ds.buckets), norm_tables, view,
+    )
+    out_c, out_s, ok = np.asarray(out_c), np.asarray(out_s), np.asarray(ok)
+    per_lane_iters = sum(np.asarray(b).sum(axis=-1) for b in iters)
+    for p, is_active in enumerate([True, False, True, False]):
+        if is_active:
+            assert per_lane_iters[p] > 0
+            assert not np.array_equal(out_c[p], coeffs_host[p])
+        else:
+            assert per_lane_iters[p] == 0
+            np.testing.assert_array_equal(out_c[p], coeffs_host[p])
+            np.testing.assert_array_equal(out_s[p], score_host[p])
+        assert bool(ok[p])
+
+
+def test_re_population_all_active_matches_flagless_program(rng):
+    """active=all-true is the semantic identity: the with_active program
+    family trains the same tables as the flagless family (same body, the
+    masking selects reduce to pass-throughs)."""
+    from photon_ml_tpu.optimization.solver_cache import (
+        re_population_update_program,
+    )
+
+    ds, dtype, l2_rows, coeffs, score, offsets, norm_tables, view = (
+        _population_re_inputs(rng)
+    )
+    args = (offsets, l2_rows, jnp.zeros((4,), dtype))
+    flagless = re_population_update_program(
+        TaskType.LOGISTIC_REGRESSION, CFG.optimizer_config, False,
+        VarianceComputationType.NONE, ds.n_entities, "lbfgs",
+    )
+    c1, s1, _, ok1, _, _ = flagless(
+        jnp.array(coeffs), jnp.array(score), None, *args,
+        tuple(ds.buckets), norm_tables, view,
+    )
+    with_active = re_population_update_program(
+        TaskType.LOGISTIC_REGRESSION, CFG.optimizer_config, False,
+        VarianceComputationType.NONE, ds.n_entities, "lbfgs",
+        with_active=True,
+    )
+    c2, s2, _, ok2, _, _ = with_active(
+        jnp.array(coeffs), jnp.array(score), None, *args,
+        jnp.ones((4,), dtype=bool),
+        tuple(ds.buckets), norm_tables, view,
+    )
+    np.testing.assert_allclose(
+        np.asarray(c1), np.asarray(c2), rtol=1e-12, atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=1e-12, atol=1e-12
+    )
+    assert np.asarray(ok1).all() and np.asarray(ok2).all()
+
+
+def test_fe_population_with_active_freezes_lanes_bitwise(rng):
+    from photon_ml_tpu.data.dataset import LabeledData
+    from photon_ml_tpu.normalization import NO_NORMALIZATION
+    from photon_ml_tpu.optimization.solver_cache import (
+        fe_population_update_program,
+    )
+
+    X, _, _, y, _ = make_workload(rng)
+    data = LabeledData.build(X, y)
+    dtype = data.labels.dtype
+    P = 4
+    coeffs = jnp.asarray(rng.normal(size=(P, D)) * 0.1, dtype)
+    score = jnp.asarray(rng.normal(size=(P, N)) * 0.1, dtype)
+    coeffs_host, score_host = np.array(coeffs), np.array(score)  # copies: donated buffers
+    program = fe_population_update_program(
+        TaskType.LOGISTIC_REGRESSION, CFG.optimizer_config, False,
+        with_active=True,
+    )
+    active = jnp.asarray([False, True, False, True])
+    out_c, out_s, coefs_ok, value_ok, _values, iters, _r = program(
+        coeffs, score, jnp.zeros((P, N), dtype),
+        jnp.ones((P,), dtype), jnp.zeros((P,), dtype), jnp.ones((P,), dtype),
+        jnp.zeros((0,), jnp.float32), active, data, NO_NORMALIZATION,
+    )
+    out_c, out_s = np.asarray(out_c), np.asarray(out_s)
+    iters = np.asarray(iters)
+    for p, is_active in enumerate([False, True, False, True]):
+        if is_active:
+            assert iters[p] > 0
+            assert not np.array_equal(out_c[p], coeffs_host[p])
+        else:
+            assert iters[p] == 0
+            np.testing.assert_array_equal(out_c[p], coeffs_host[p])
+            np.testing.assert_array_equal(out_s[p], score_host[p])
+        assert bool(np.asarray(coefs_ok)[p]) and bool(np.asarray(value_ok)[p])
