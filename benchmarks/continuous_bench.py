@@ -144,6 +144,264 @@ def _quality(models, val_input, labels):
     return {"logloss": float(logloss), "auc": float(auc_roc(z, y))}
 
 
+def _rss_kb() -> int:
+    """Current resident set (VmRSS, kB) of THIS process — sampled, not the
+    high-watermark, so growth between samples is visible. Hosts without
+    /proc (macOS) fall back to ru_maxrss, which is the MONOTONE lifetime
+    watermark (and platform-dependent units): the RSS ratio gate then only
+    bounds growth past the earliest peak — run the gate on Linux for the
+    documented sampled semantics (CI does); the precise bounded-memory gate
+    (resident_corpus_bytes) is platform-independent either way."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    import resource
+
+    return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+
+
+def _dir_trees_identical(a: str, b: str) -> bool:
+    import filecmp
+
+    for root, _dirs, files in os.walk(a):
+        rel = os.path.relpath(root, a)
+        other = os.path.join(b, rel)
+        for name in files:
+            if not filecmp.cmp(
+                os.path.join(root, name), os.path.join(other, name), shallow=False
+            ):
+                return False
+    na = sum(len(fs) for _, _, fs in os.walk(a))
+    nb = sum(len(fs) for _, _, fs in os.walk(b))
+    return na == nb
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total
+
+
+def run_compact_smoke(args) -> int:
+    """``bench.py --continuous --compact``: the bounded-memory gates of the
+    out-of-core corpus store (docs/PERFORMANCE.md "Corpus store &
+    compaction" metric definitions).
+
+    Gates (exit nonzero on failure):
+
+    - **bootstrap equivalence, bitwise** — after N generations with
+      compaction + sliding window + eviction enabled, a FRESH trainer
+      restored from the compacted store (cold blocks re-materialized
+      blockwise, no Avro re-decode of folded files) processes the next delta
+      to a byte-identical checkpoint generation and model export as the
+      long-running in-memory trainer;
+    - **bounded memory** — ``resident_corpus_bytes`` (the store's exact
+      accounting of materialized view bytes) at delta N must stay <=
+      --max-resident-ratio x its value when the window first filled, and the
+      sampled process RSS at delta N <= --max-rss-ratio x the single-delta
+      footprint (RSS after delta 1). The tracked-bytes gate is the precise
+      one; the RSS gate bounds egregious leaks (see the honest-measurement
+      rules: allocator slack makes small absolute RSS deltas noise);
+    - **zero steady-state retraces after a compaction** — a replayed
+      compaction pass (restore from the pre-compaction checkpoint copy, same
+      delta) traces NOTHING: the window keeps shapes constant, so every
+      program must hit the solver cache; compaction must not perturb them.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from photon_ml_tpu.analysis import runtime_guard
+    from photon_ml_tpu.cli.parsers import (
+        parse_coordinate_configuration,
+        parse_feature_shard_configuration,
+    )
+    from photon_ml_tpu.continuous import ContinuousTrainer, ContinuousTrainerConfig
+    from photon_ml_tpu.types import TaskType
+
+    work = args.keep_dir or tempfile.mkdtemp(prefix="photon-compact-bench-")
+    os.makedirs(work, exist_ok=True)
+    corpus = os.path.join(work, "corpus")
+    os.makedirs(corpus, exist_ok=True)
+    rng = np.random.default_rng(20260804)
+    d, U = args.features, args.users
+    w = rng.normal(size=d)
+    bias = rng.normal(size=U) * 1.5
+
+    shard = dict(
+        [parse_feature_shard_configuration("name=shardA,feature.bags=features")]
+    )
+    coords = dict(
+        parse_coordinate_configuration(c)
+        for c in [RE_COORD.format(mi=args.max_iter)]
+    )
+
+    def make_trainer(ckpt):
+        return ContinuousTrainer(
+            ContinuousTrainerConfig(
+                corpus_paths=[corpus],
+                checkpoint_directory=os.path.join(work, ckpt),
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configurations=coords,
+                shard_configurations=shard,
+                delta_iterations=args.iterations,
+                initial_iterations=args.iterations,
+                compact_every=args.compact_every,
+                evict_idle_generations=args.evict_idle,
+                window_mode="sliding",
+                window_generations=args.window,
+                cold_block_rows=args.cold_block_rows,
+            )
+        )
+
+    # --- bootstrap + N same-shaped deltas ------------------------------------
+    _write_part(
+        os.path.join(corpus, "part-00000.avro"), args.delta_rows, d,
+        list(range(U)), w, bias, seed=11,
+    )
+    trainer = make_trainer("ckpt")
+    trainer.poll_once()
+    rss_single_delta = None
+    resident_window_full = None
+    rss_samples = []
+    resident_samples = []
+    compactions = 0
+    steady_retraces = None
+    # the single-delta footprint baseline: the FIRST steady-state delta —
+    # window full AND one compaction behind us, so the per-shape-family
+    # compile cache has its steady population (docs/PERFORMANCE.md: RSS
+    # before that point measures XLA warm-up, not corpus retention)
+    baseline_k = max(args.window, args.compact_every) + 1
+    last_compact_k = max(
+        k
+        for k in range(1, args.compact_deltas + 1)
+        if (k + 1) % args.compact_every == 0
+    )
+    for k in range(1, args.compact_deltas + 1):
+        is_compact_pass = (k + 1) % args.compact_every == 0
+        if is_compact_pass:
+            # freeze the pre-compaction checkpoint (manifest paths are
+            # absolute, so the replay shares the live corpus — it must run
+            # BEFORE any later delta file lands, i.e. inline below)
+            replay_src = os.path.join(work, "ckpt-precompact")
+            shutil.rmtree(replay_src, ignore_errors=True)
+            shutil.copytree(os.path.join(work, "ckpt"), replay_src)
+        _write_part(
+            os.path.join(corpus, f"part-{k:05d}.avro"), args.delta_rows, d,
+            list(range(U)), w, bias, seed=100 + k,
+        )
+        r = trainer.poll_once()
+        compactions += int(r.compacted)
+        rss_samples.append(_rss_kb())
+        resident_samples.append(trainer.store.resident_corpus_bytes)
+        if rss_single_delta is None and k >= baseline_k:
+            rss_single_delta = rss_samples[-1]
+        if resident_window_full is None and k >= args.window:
+            resident_window_full = resident_samples[-1]
+        if k == last_compact_k:
+            # --- zero retraces through a replayed compaction pass ----------
+            # the in-process pass above just compiled every shape this exact
+            # pass needs (the sliding window keeps view shapes constant once
+            # full), so the restore-from-cold + delta + compaction replay
+            # must trace NOTHING — compaction must not perturb the caches
+            replay_dst = os.path.join(work, "ckpt-replay")
+            shutil.rmtree(replay_dst, ignore_errors=True)
+            shutil.copytree(replay_src, replay_dst)
+            t_replay = ContinuousTrainer(
+                dataclasses.replace(
+                    trainer.config, checkpoint_directory=replay_dst
+                )
+            )
+            with runtime_guard.no_retrace(allow_retraces=1 << 30) as region:
+                r_replay = t_replay.poll_once()
+            steady_retraces = region.traces
+            assert r_replay is not None and r_replay.compacted
+            del t_replay
+    if compactions == 0:
+        raise SystemExit("--compact smoke never compacted; check --compact-every")
+
+    rss_ratio = rss_samples[-1] / max(rss_single_delta, 1)
+    resident_ratio = resident_samples[-1] / max(resident_window_full, 1)
+
+    # --- bootstrap equivalence, bitwise --------------------------------------
+    # trainer B = a fresh process's restore from the compacted store; both
+    # absorb the SAME next delta; the committed generation and the export
+    # must be byte-for-byte identical
+    ckpt_b = os.path.join(work, "ckpt-b")
+    shutil.copytree(os.path.join(work, "ckpt"), ckpt_b)
+    final = args.compact_deltas + 1
+    _write_part(
+        os.path.join(corpus, f"part-{final:05d}.avro"), args.delta_rows, d,
+        list(range(U)), w, bias, seed=100 + final,
+    )
+    export_a = os.path.join(work, "export-a")
+    export_b = os.path.join(work, "export-b")
+    trainer.config.export_directory = export_a
+    r_a = trainer.poll_once()
+    t_fresh = ContinuousTrainer(
+        dataclasses.replace(
+            trainer.config, checkpoint_directory=ckpt_b,
+            export_directory=export_b,
+        )
+    )
+    r_b = t_fresh.poll_once()
+    gen_a = os.path.join(work, "ckpt", f"gen-{r_a.generation:08d}")
+    gen_b = os.path.join(ckpt_b, f"gen-{r_b.generation:08d}")
+    equivalent = (
+        r_a.generation == r_b.generation
+        and _dir_trees_identical(gen_a, gen_b)
+        and _dir_trees_identical(
+            os.path.join(export_a, f"gen-{r_a.generation:08d}"),
+            os.path.join(export_b, f"gen-{r_b.generation:08d}"),
+        )
+    )
+
+    raw_bytes = sum(
+        os.path.getsize(os.path.join(corpus, n)) for n in os.listdir(corpus)
+    )
+    cold_bytes = _dir_bytes(os.path.join(work, "ckpt", "corpus-store"))
+
+    gates = {
+        "bootstrap_equivalence_bitwise_ok": bool(equivalent),
+        "resident_bytes_bounded_ok": resident_ratio <= args.max_resident_ratio,
+        "peak_rss_vs_history_ok": rss_ratio <= args.max_rss_ratio,
+        "zero_retrace_after_compaction_ok": steady_retraces == 0,
+    }
+    result = {
+        "metric": "compaction_smoke",
+        "deltas": args.compact_deltas,
+        "compactions": compactions,
+        "total_rows": r_a.n_rows,
+        "view_rows": r_a.view_rows,
+        "resident_corpus_bytes": resident_samples[-1],
+        "resident_window_full_bytes": resident_window_full,
+        "resident_ratio": round(resident_ratio, 4),
+        "rss_single_delta_kb": rss_single_delta,
+        "rss_final_kb": rss_samples[-1],
+        "peak_rss_vs_history": round(rss_ratio, 4),
+        "steady_retraces_after_compaction": steady_retraces,
+        "compaction_ratio": round(cold_bytes / max(raw_bytes, 1), 4),
+        "cold_store_bytes": cold_bytes,
+        "raw_corpus_bytes": raw_bytes,
+        "n_evicted_total": sum(
+            len(v) for v in trainer.evicted.values()
+        ),
+        "gates": gates,
+    }
+    print(json.dumps(result))
+    if args.keep_dir is None:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0 if all(gates.values()) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--samples", type=int, default=N_SAMPLES)
@@ -170,11 +428,32 @@ def main(argv=None) -> int:
     ap.add_argument("--max-steady-retraces", type=int, default=0)
     ap.add_argument("--keep-dir", default=None,
                     help="Work under this directory and keep it (debugging)")
+    # --- the out-of-core corpus-store smoke (bench.py --continuous --compact)
+    ap.add_argument("--compact", action="store_true",
+                    help="Run the compaction/bounded-memory smoke instead of "
+                    "the delta-pass bench: bootstrap-equivalence (bitwise), "
+                    "peak-RSS and resident-bytes bounds at --compact-deltas "
+                    "accumulated deltas, zero retraces through a replayed "
+                    "compaction pass")
+    ap.add_argument("--compact-deltas", type=int, default=20)
+    ap.add_argument("--compact-every", type=int, default=5)
+    ap.add_argument("--window", type=int, default=4)
+    ap.add_argument("--evict-idle", type=int, default=None,
+                    help="evict_idle_generations for the smoke (default: off;"
+                    " the dedicated eviction contract lives in the tests)")
+    ap.add_argument("--cold-block-rows", type=int, default=1024)
+    ap.add_argument("--max-rss-ratio", type=float, default=1.5)
+    ap.add_argument("--max-resident-ratio", type=float, default=1.5)
     args = ap.parse_args(argv)
     if args.deltas < 1:
         ap.error("--deltas must be >= 1 (the bench measures a delta pass)")
     if args.reps < 1:
         ap.error("--reps must be >= 1")
+    if args.compact:
+        if args.compact_deltas < max(args.compact_every, args.window) + 1:
+            ap.error("--compact-deltas must cover at least one compaction "
+                     "and a full window")
+        return run_compact_smoke(args)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np

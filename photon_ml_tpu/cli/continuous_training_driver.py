@@ -69,6 +69,33 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="Fixed-effect refresh reservoir: old rows keeping "
                         "nonzero weight per delta pass (seeded, unbiased "
                         "re-weighting; default: all old rows)")
+    p.add_argument("--compact-every", type=int, default=None,
+                   help="Fold the corpus into a new cold-tier generation and "
+                        "truncate the manifest's per-file history every N "
+                        "committed generations (continuous/store.py; default: "
+                        "never — RAM and restart cost then grow with history)")
+    p.add_argument("--evict-idle-generations", type=int, default=None,
+                   help="Archive random-effect entities with no rows in the "
+                        "last G generations and drop them from the device "
+                        "tables; serving degrades to the missing-entity "
+                        "score-0 contract, reappearance re-admits warm from "
+                        "the archive (default: never evict)")
+    p.add_argument("--window-mode", default="full",
+                   choices=["full", "sliding", "decay"],
+                   help="Row aging: 'full' trains every accumulated row; "
+                        "'sliding' drops rows older than --window-generations "
+                        "from the training view (bounded RAM, steady shapes); "
+                        "'decay' also down-weights in-view rows by "
+                        "2^(-age/half-life), derived in-trace from row-age "
+                        "metadata so crash-replay stays bit-identical")
+    p.add_argument("--window-generations", type=int, default=None,
+                   help="Sliding-window width in generations (required for "
+                        "--window-mode sliding; optional RAM bound for decay)")
+    p.add_argument("--decay-half-life", type=float, default=None,
+                   help="Age (in generations) at which a row's weight halves "
+                        "(required for --window-mode decay)")
+    p.add_argument("--cold-block-rows", type=int, default=8192,
+                   help="Rows per cold-tier block (power of two)")
     p.add_argument("--poll-interval-seconds", type=float, default=10.0)
     p.add_argument("--max-generations", type=int, default=None,
                    help="Exit after committing this many generations (tests/"
@@ -123,6 +150,12 @@ def trainer_from_args(args: argparse.Namespace):
         ingest_workers=getattr(args, "ingest_workers", None),
         keep_generations=args.checkpoint_keep_generations,
         seed=args.seed,
+        compact_every=args.compact_every,
+        evict_idle_generations=args.evict_idle_generations,
+        window_mode=args.window_mode,
+        window_generations=args.window_generations,
+        decay_half_life=args.decay_half_life,
+        cold_block_rows=args.cold_block_rows,
     )
     return ContinuousTrainer(config)
 
@@ -167,6 +200,8 @@ def run(args: argparse.Namespace) -> dict:
                 "generation": r.generation,
                 "kind": r.kind,
                 "n_rows": r.n_rows,
+                "view_rows": r.view_rows,
+                "compacted": r.compacted,
                 "n_new_rows": r.n_new_rows,
                 "active_fraction": r.active_fraction,
                 "active": r.active,

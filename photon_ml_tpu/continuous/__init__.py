@@ -1,20 +1,34 @@
 """Continuous training: incremental ingest, active-set coordinate descent,
-and the closed train→serve generation loop.
+the tiered out-of-core corpus store, and the closed train→serve generation
+loop.
 
-The subsystem's three layers (docs/ARCHITECTURE.md "Continuous training"):
+The subsystem's layers (docs/ARCHITECTURE.md "Continuous training" and
+"Corpus store & compaction"):
 
 - :mod:`photon_ml_tpu.continuous.manifest` — the append-only corpus manifest
-  (what the model has already absorbed; the scan diff IS the delta);
+  (what the model has already absorbed; the scan diff IS the delta), with
+  the compacted-history fold that truncates per-file records once the cold
+  tier owns their rows;
 - :mod:`photon_ml_tpu.continuous.ingest` — delta-only decode with stable
-  index-map growth (old indices frozen, unseen features append at the tail);
+  index-map growth (old indices frozen, unseen features append at the tail)
+  and per-row generation stamps (the row-age metadata);
+- :mod:`photon_ml_tpu.continuous.store` — the tiered :class:`CorpusStore`:
+  hot deltas in RAM, cold checksummed pow2-row blocks on disk,
+  re-materialized blockwise; sliding-window view trimming; time-decay
+  weighting; the evicted-entity coefficient archive;
+- :mod:`photon_ml_tpu.continuous.compaction` — manifest compaction and
+  entity-level eviction/re-admission (long-idle random effects leave the
+  device tables; serving degrades to the missing-entity score-0 contract;
+  reappearing entities warm-start from the archive);
 - :mod:`photon_ml_tpu.continuous.active_set` /
   :mod:`photon_ml_tpu.continuous.trainer` — the working-set selection rule,
   the fixed-effect refresh reservoir, and the ``ContinuousTrainer`` driver
   that commits each delta pass as a PR 3 checkpoint generation for PR 6's
   hot-swap watcher to serve.
 
-Fault points ``continuous.{scan,delta_ingest,active_select,commit}`` make
-every phase of the loop chaos-testable (tests/test_chaos.py).
+Fault points ``continuous.{scan,delta_ingest,active_select,commit,compact,
+evict,cold_write}`` make every phase of the loop chaos-testable
+(tests/test_chaos.py, tests/test_continuous.py).
 """
 
 from photon_ml_tpu.continuous.active_set import (
@@ -22,12 +36,26 @@ from photon_ml_tpu.continuous.active_set import (
     ReservoirDownSampler,
     select_active_entities,
 )
+from photon_ml_tpu.continuous.compaction import (
+    EvictionPlan,
+    drop_entities,
+    inject_archived_rows,
+    merge_carried_entities,
+    plan_eviction,
+)
 from photon_ml_tpu.continuous.ingest import CorpusSnapshot, DeltaInfo, ingest_delta
 from photon_ml_tpu.continuous.manifest import (
+    CompactedHistory,
     CorpusContractViolation,
     CorpusManifest,
     PartFile,
     file_fingerprint,
+)
+from photon_ml_tpu.continuous.store import (
+    ColdStoreCorruption,
+    CorpusStore,
+    LiveSegment,
+    decay_weights,
 )
 from photon_ml_tpu.continuous.trainer import (
     ContinuousTrainer,
@@ -37,16 +65,26 @@ from photon_ml_tpu.continuous.trainer import (
 
 __all__ = [
     "ActiveSelection",
+    "ColdStoreCorruption",
+    "CompactedHistory",
     "ContinuousTrainer",
     "ContinuousTrainerConfig",
     "CorpusContractViolation",
     "CorpusManifest",
     "CorpusSnapshot",
+    "CorpusStore",
     "DeltaInfo",
+    "EvictionPlan",
     "GenerationResult",
+    "LiveSegment",
     "PartFile",
     "ReservoirDownSampler",
+    "decay_weights",
+    "drop_entities",
     "file_fingerprint",
     "ingest_delta",
+    "inject_archived_rows",
+    "merge_carried_entities",
+    "plan_eviction",
     "select_active_entities",
 ]

@@ -1,0 +1,224 @@
+"""Manifest compaction and entity eviction: bounding the unbounded horizon.
+
+Two independent growth axes of a run-forever trainer get folded here:
+
+- **corpus history** — ``run_compaction`` folds the previous cold generation
+  plus every hot delta into a NEW cold generation (continuous/store.py) and
+  truncates the corpus manifest's per-file history
+  (:meth:`~continuous.manifest.CorpusManifest.compact`). The only observable
+  state change is the checkpoint commit that carries the folded manifest +
+  the cold pointer — the cold write itself lands staged+renamed beforehand
+  and stays an unreferenced orphan if the commit never happens (crash-replay
+  rewrites it deterministically).
+- **entity tables** — ``plan_eviction`` picks random-effect entities with no
+  rows in the last ``idle_generations`` generations; ``drop_entities`` shrinks
+  the model's device tables around them; their coefficients are parked in the
+  store archive. Serving degrades to the engine's existing missing-entity
+  contract (an evicted entity scores EXACTLY like one never seen — 0 from the
+  random-effect coordinate), and ``inject_archived_rows`` warm-starts a
+  reappearing entity's re-solve from the archived coefficients instead of
+  zero.
+
+``merge_carried_entities`` is the sliding-window companion: an entity whose
+rows all aged out of the training view (but which is NOT evicted yet) simply
+has no dataset rows that pass, so the descent output cannot carry it — the
+merge re-attaches its previous-generation coefficients verbatim, keeping
+"out of the window" and "evicted" two distinct states (frozen-and-serving vs
+archived-and-score-0).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.continuous.store import pad_columns
+from photon_ml_tpu.models.game import RandomEffectModel
+from photon_ml_tpu.resilience import faultpoint, register_fault_point
+
+FP_COMPACT = register_fault_point("continuous.compact")
+FP_EVICT = register_fault_point("continuous.evict")
+
+
+@dataclasses.dataclass
+class EvictionPlan:
+    """One coordinate's eviction verdict for a pass."""
+
+    evict: list  # entity ids leaving the table this pass
+    readmit: list  # previously evicted ids reappearing in the delta
+
+
+def plan_eviction(
+    model: Optional[RandomEffectModel],
+    last_active: Mapping,
+    delta_entities: set,
+    evicted: set,
+    current_gen: int,
+    idle_generations: int,
+) -> EvictionPlan:
+    """The eviction rule: an entity leaves the device tables iff its last
+    data arrived at or before generation ``current_gen - 1 - idle_generations``
+    (no rows in the last ``idle_generations`` committed generations) and it
+    has no rows in the CURRENT delta. Entities in ``evicted`` that reappear
+    in the delta re-admit. Deterministic: a pure function of the bookkeeping
+    a crash-replayed pass restores from the previous checkpoint."""
+    faultpoint(FP_EVICT)
+    readmit = sorted(e for e in evicted if e in delta_entities)
+    if model is None or idle_generations < 1:
+        return EvictionPlan(evict=[], readmit=readmit)
+    cutoff = int(current_gen) - 1 - int(idle_generations)
+    evict = sorted(
+        e
+        for e in model.entity_ids
+        if e not in delta_entities
+        and int(last_active.get(e, current_gen)) <= cutoff
+    )
+    return EvictionPlan(evict=evict, readmit=readmit)
+
+
+def drop_entities(model: RandomEffectModel, entity_ids: Sequence) -> RandomEffectModel:
+    """Shrink the model's entity rows: everything except ``entity_ids``
+    survives IN ORDER (surviving rows keep their relative positions, so the
+    next dataset build's ``entity_order`` pin still aligns by construction)."""
+    drop = set(entity_ids)
+    if not drop:
+        return model
+    keep = [i for i, e in enumerate(model.entity_ids) if e not in drop]
+    kept_ids = tuple(model.entity_ids[i] for i in keep)
+    idx = np.asarray(keep, dtype=np.int64)
+    return dataclasses.replace(
+        model,
+        entity_ids=kept_ids,
+        coeffs=jnp.asarray(np.asarray(model.coeffs)[idx]),
+        proj_indices=jnp.asarray(np.asarray(model.proj_indices)[idx]),
+        variances=(
+            None
+            if model.variances is None
+            else jnp.asarray(np.asarray(model.variances)[idx])
+        ),
+    )
+
+
+def archived_rows_for(model: RandomEffectModel, entity_ids: Sequence) -> dict:
+    """The archive payload for entities about to be dropped: coefficient and
+    projection rows in the model's CURRENT layout (re-admission remaps by
+    global column id, so the layout is self-describing)."""
+    rows = [model.row_for_entity(e) for e in entity_ids]
+    if any(r < 0 for r in rows):
+        missing = [e for e, r in zip(entity_ids, rows) if r < 0]
+        raise ValueError(f"cannot archive entities without model rows: {missing}")
+    idx = np.asarray(rows, dtype=np.int64)
+    return {
+        "entity_ids": list(entity_ids),
+        "coeffs": np.asarray(model.coeffs)[idx],
+        "proj": np.asarray(model.proj_indices)[idx],
+        "variances": (
+            None if model.variances is None else np.asarray(model.variances)[idx]
+        ),
+    }
+
+
+def inject_archived_rows(
+    model: RandomEffectModel,
+    archive: Optional[dict],
+    entity_ids: Sequence,
+) -> tuple[RandomEffectModel, int]:
+    """Warm-start re-admitted entities from their archived coefficients: for
+    each entity in ``entity_ids`` with an archive row, remap archived slots
+    into the model's CURRENT projection layout by global column id (the
+    ``aligned_to`` slot-matching rule applied to one row) and overwrite the
+    zero row ``aligned_to`` gave the "new" entity. Returns (model, n_injected);
+    entities without an archive row stay zero-initialized."""
+    if archive is None or not len(entity_ids):
+        return model, 0
+    arch_row = {e: i for i, e in enumerate(archive["entity_ids"].tolist())}
+    coeffs = np.asarray(model.coeffs).copy()
+    variances = (
+        None if model.variances is None else np.asarray(model.variances).copy()
+    )
+    dst_proj = np.asarray(model.proj_indices)
+    arch_var = archive.get("variances")
+    injected = 0
+    for e in entity_ids:
+        src = arch_row.get(e)
+        dst = model.row_for_entity(e)
+        if src is None or dst < 0:
+            continue
+        src_cols = np.asarray(archive["proj"][src])
+        src_vals = np.asarray(archive["coeffs"][src])
+        slot_of = {int(c): k for k, c in enumerate(src_cols) if c >= 0}
+        row = np.zeros_like(coeffs[dst])
+        var_row = None if variances is None else np.zeros_like(variances[dst])
+        for k, c in enumerate(dst_proj[dst]):
+            s = slot_of.get(int(c)) if c >= 0 else None
+            if s is not None:
+                row[k] = src_vals[s]
+                if var_row is not None and arch_var is not None:
+                    var_row[k] = arch_var[src][s]
+        coeffs[dst] = row
+        if var_row is not None:
+            variances[dst] = var_row
+        injected += 1
+    if not injected:
+        return model, 0
+    return (
+        dataclasses.replace(
+            model,
+            coeffs=jnp.asarray(coeffs),
+            variances=None if variances is None else jnp.asarray(variances),
+        ),
+        injected,
+    )
+
+
+def merge_carried_entities(
+    prev_model: RandomEffectModel,
+    trained_model: RandomEffectModel,
+    evicted: set,
+) -> RandomEffectModel:
+    """Re-attach entities the training dataset no longer carries (their rows
+    aged out of the sliding window) but which are NOT evicted: their previous-
+    generation coefficient rows append verbatim at the tail — frozen, still
+    served, still eligible for eviction later. Both sides' slot widths pad to
+    the wider K (padding slots are proj -1 / coeff 0: inert by construction)."""
+    carried = [
+        e
+        for e in prev_model.entity_ids
+        if e not in evicted and trained_model.row_for_entity(e) < 0
+    ]
+    if not carried:
+        return trained_model
+    idx = np.asarray(
+        [prev_model.row_for_entity(e) for e in carried], dtype=np.int64
+    )
+    t_coeffs = np.asarray(trained_model.coeffs)
+    t_proj = np.asarray(trained_model.proj_indices)
+    p_coeffs = np.asarray(prev_model.coeffs)[idx]
+    p_proj = np.asarray(prev_model.proj_indices)[idx]
+    k = max(t_coeffs.shape[1], p_coeffs.shape[1])
+
+    coeffs = np.concatenate(
+        [pad_columns(t_coeffs, k, 0), pad_columns(p_coeffs, k, 0).astype(t_coeffs.dtype)]
+    )
+    proj = np.concatenate([pad_columns(t_proj, k, -1), pad_columns(p_proj, k, -1)])
+    variances = None
+    if trained_model.variances is not None:
+        t_var = np.asarray(trained_model.variances)
+        p_var = (
+            np.asarray(prev_model.variances)[idx]
+            if prev_model.variances is not None
+            else np.zeros_like(p_coeffs)
+        )
+        variances = np.concatenate(
+            [pad_columns(t_var, k, 0), pad_columns(p_var, k, 0).astype(t_var.dtype)]
+        )
+    return dataclasses.replace(
+        trained_model,
+        entity_ids=tuple(trained_model.entity_ids) + tuple(carried),
+        coeffs=jnp.asarray(coeffs),
+        proj_indices=jnp.asarray(proj.astype(np.int32)),
+        variances=None if variances is None else jnp.asarray(variances),
+    )

@@ -44,15 +44,44 @@ FP_DELTA_INGEST = register_fault_point("continuous.delta_ingest")
 
 @dataclasses.dataclass
 class CorpusSnapshot:
-    """The accumulated in-memory corpus at one generation."""
+    """The accumulated in-memory corpus at one generation.
+
+    With the tiered store (continuous/store.py) this is the MATERIALIZED
+    TRAINING VIEW: ``row_gens`` stamps each row with the generation that
+    ingested it (the sliding-window / time-decay weighting input), and
+    ``start_row`` is the view's first row on the GLOBAL accumulated sample
+    axis (0 unless a sliding window dropped aged-out head rows)."""
 
     data: GameInput
     index_maps: dict[str, IndexMap]
     uids: np.ndarray
+    row_gens: Optional[np.ndarray] = None  # [N] int64, generation per row
+    start_row: int = 0
 
     @property
     def n_rows(self) -> int:
         return self.data.n
+
+    @property
+    def nbytes(self) -> int:
+        """Resident host bytes of the materialized arrays (the hot-tier
+        memory-accounting input; sparse shards count their CSR triplets)."""
+        total = 0
+        for m in self.data.features.values():
+            c = m.tocsr() if sp.issparse(m) else None
+            if c is not None:
+                total += c.data.nbytes + c.indices.nbytes + c.indptr.nbytes
+            else:
+                total += np.asarray(m).nbytes
+        for arr in (
+            self.data.labels, self.data.offsets, self.data.weights,
+            self.row_gens, self.uids,
+        ):
+            if arr is not None:
+                total += np.asarray(arr).nbytes
+        for col in self.data.id_columns.values():
+            total += np.asarray(col).nbytes
+        return total
 
 
 @dataclasses.dataclass
@@ -112,14 +141,18 @@ def ingest_delta(
     shard_configs: Mapping,
     id_tags: Sequence[str],
     ingest_workers: Optional[int] = None,
+    generation: Optional[int] = None,
 ) -> tuple[CorpusSnapshot, DeltaInfo]:
     """Decode ``new_files`` only and append them to ``snapshot`` (None =
     bootstrap: the delta IS the corpus). Returns the grown snapshot and what
     changed. Decode and column remap are O(delta); the row append is an
-    O(corpus) host memcpy (``sp.vstack``/``np.concatenate`` rebuild the old
-    block and transiently hold ~2x the corpus) — cheap next to decode at the
-    horizons this targets, and the reason unbounded corpora need the
-    ROADMAP's manifest-compaction / corpus-eviction item."""
+    O(view) host memcpy (``sp.vstack``/``np.concatenate`` rebuild the old
+    block and transiently hold ~2x the view) — bounded by the sliding window
+    when one is configured (continuous/store.py), O(corpus) otherwise.
+
+    ``generation`` (when given) stamps the delta's rows with the generation
+    that ingested them (``row_gens``) — the row-age metadata the sliding-
+    window / time-decay weighting modes derive their weights from."""
     faultpoint(FP_DELTA_INGEST)
     if not new_files:
         raise ValueError("ingest_delta called with no new files")
@@ -133,9 +166,17 @@ def ingest_delta(
             f"(files: {list(new_files)[:3]}...)"
         )
 
+    def _gens(n: int) -> Optional[np.ndarray]:
+        if generation is None:
+            return None
+        return np.full(n, int(generation), dtype=np.int64)
+
     if snapshot is None:
         grown = CorpusSnapshot(
-            data=delta_data, index_maps=dict(delta_maps), uids=delta_uids
+            data=delta_data,
+            index_maps=dict(delta_maps),
+            uids=delta_uids,
+            row_gens=_gens(delta_data.n),
         )
         info = DeltaInfo(
             n_new_rows=delta_data.n,
@@ -172,6 +213,15 @@ def ingest_delta(
         delta_m = _remap_columns(delta_data.shard(shard).tocsr(), perm, ext.size)
         features[shard] = sp.vstack([old_m, delta_m], format="csr")
 
+    row_gens = None
+    if generation is not None:
+        old_gens = snapshot.row_gens
+        if old_gens is None:
+            # an un-stamped snapshot's rows all predate this delta: stamp them
+            # one generation older so age-based weighting stays well-defined
+            old_gens = np.full(old.n, int(generation) - 1, dtype=np.int64)
+        row_gens = np.concatenate([old_gens, _gens(delta_data.n)])
+
     grown_data = GameInput(
         features=features,
         labels=np.concatenate([np.asarray(old.labels), np.asarray(delta_data.labels)]),
@@ -188,6 +238,8 @@ def ingest_delta(
         data=grown_data,
         index_maps=grown_maps,
         uids=np.concatenate([snapshot.uids, delta_uids]),
+        row_gens=row_gens,
+        start_row=snapshot.start_row,
     )
     info = DeltaInfo(
         n_new_rows=delta_data.n,

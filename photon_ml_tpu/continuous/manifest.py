@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import os
-from typing import Sequence
+from typing import Optional, Sequence
 
 from photon_ml_tpu.resilience import faultpoint, register_fault_point
 
@@ -59,17 +59,58 @@ class PartFile:
 
 
 @dataclasses.dataclass(frozen=True)
-class CorpusManifest:
-    """Immutable ordered part-file record; ``extend`` returns a grown copy."""
+class CompactedHistory:
+    """The folded prefix of the manifest after a ``continuous.compact`` step.
 
-    entries: tuple = ()
+    Once a compaction has re-materialized the accumulated corpus into the
+    cold tier (continuous/store.py), the original part files are no longer
+    the corpus of record — the checksummed cold blocks are. The per-file
+    history truncates to this record: the ordered ``(path, size)`` pairs
+    (still needed so a scan can tell already-ingested files from genuinely
+    new ones, and so a same-path rewrite with a different size still fails
+    the append-only contract), the folded row count, and ONE rolled-up
+    SHA-256 over the per-file fingerprints for audit. Compacted part files
+    MAY disappear from the corpus directories (the upstream ETL is free to
+    archive them) — the cold tier owns those bytes now — and restart no
+    longer re-reads or re-verifies them.
+    """
+
+    n_files: int
+    n_rows: int
+    rollup_sha256: str
+    files: tuple = ()  # ordered (path, size) pairs
 
     @property
     def paths(self) -> tuple:
+        return tuple(p for p, _ in self.files)
+
+
+@dataclasses.dataclass(frozen=True)
+class CorpusManifest:
+    """Immutable ordered part-file record; ``extend`` returns a grown copy.
+
+    ``compacted`` (when set) is the folded prefix: files already
+    re-materialized into the cold tier. ``entries`` are the LIVE suffix —
+    files ingested since the last compaction, still verified against the
+    corpus directories and still needed to rebuild the hot tier on restart.
+    """
+
+    entries: tuple = ()
+    compacted: Optional[CompactedHistory] = None
+
+    @property
+    def paths(self) -> tuple:
+        head = self.compacted.paths if self.compacted is not None else ()
+        return head + tuple(e.path for e in self.entries)
+
+    @property
+    def live_paths(self) -> tuple:
+        """Paths NOT yet folded into the cold tier (the restart re-decode set)."""
         return tuple(e.path for e in self.entries)
 
     def __len__(self) -> int:
-        return len(self.entries)
+        n = self.compacted.n_files if self.compacted is not None else 0
+        return n + len(self.entries)
 
     def scan(self, corpus_paths: Sequence[str]) -> list[str]:
         """List the corpus and return part files NOT yet in the manifest, in
@@ -96,7 +137,21 @@ class CorpusManifest:
                     f"ingested part file changed size ({entry.size} -> {size}); "
                     f"the corpus is append-only: {path}"
                 )
-        return [p for p in listed if p not in known]
+        compacted: dict = (
+            dict(self.compacted.files) if self.compacted is not None else {}
+        )
+        for path, size in compacted.items():
+            # a compacted file MAY vanish (the cold tier owns its bytes), but
+            # a PRESENT one whose size changed is still a path reuse / rewrite
+            # the append-only contract must refuse — silently treating it as
+            # "already ingested" would drop the new rows forever
+            if path in listed_set and os.path.getsize(path) != size:
+                raise CorpusContractViolation(
+                    f"compacted part file changed size ({size} -> "
+                    f"{os.path.getsize(path)}); the corpus is append-only "
+                    f"(a new file must use a new path): {path}"
+                )
+        return [p for p in listed if p not in known and p not in compacted]
 
     def extend(self, new_files: Sequence[str]) -> "CorpusManifest":
         """Grown manifest with ``new_files`` appended. Call BEFORE decoding
@@ -113,7 +168,9 @@ class CorpusManifest:
             )
             for p in new_files
         )
-        return CorpusManifest(entries=self.entries + new_entries)
+        return CorpusManifest(
+            entries=self.entries + new_entries, compacted=self.compacted
+        )
 
     def verify_sizes(self, entries: Sequence[PartFile] = None) -> None:
         """Loud check that ``entries`` (default: all) still match their
@@ -127,10 +184,12 @@ class CorpusManifest:
                 )
 
     def verify_fingerprints(self) -> None:
-        """Full content verification of every recorded part file against its
+        """Full content verification of every LIVE part file against its
         persisted SHA-256: catches a SAME-SIZE rewrite that the cheap per-scan
-        size check cannot. O(corpus) I/O, so this runs at restart only — where
-        the trainer re-reads the whole corpus anyway — never per poll."""
+        size check cannot. O(live corpus) I/O, so this runs at restart only —
+        where the trainer re-reads the live files anyway — never per poll.
+        Compacted files are NOT verified (they may be archived away; their
+        rows live in the cold tier under its own per-block checksums)."""
         for e in self.entries:
             if not os.path.exists(e.path):
                 raise CorpusContractViolation(
@@ -144,15 +203,61 @@ class CorpusManifest:
                     f"append-only: {e.path}"
                 )
 
+    # -- compaction ------------------------------------------------------------
+
+    def compact(self, n_rows: int) -> "CorpusManifest":
+        """Fold EVERY entry (and any previously compacted prefix) into one
+        :class:`CompactedHistory` covering ``n_rows`` accumulated rows. The
+        rollup SHA-256 chains the previous rollup with each folded entry's
+        fingerprint, so the digest is a pure function of the ingest history.
+        Call only after the cold tier durably holds those rows
+        (continuous/store.py writes the cold generation FIRST; the checkpoint
+        commit carrying this manifest is the atomic cut-over)."""
+        h = hashlib.sha256()
+        if self.compacted is not None:
+            h.update(self.compacted.rollup_sha256.encode())
+        for e in self.entries:
+            h.update(e.sha256.encode())
+        files = (
+            self.compacted.files if self.compacted is not None else ()
+        ) + tuple((e.path, e.size) for e in self.entries)
+        return CorpusManifest(
+            entries=(),
+            compacted=CompactedHistory(
+                n_files=len(self),
+                n_rows=int(n_rows),
+                rollup_sha256=h.hexdigest(),
+                files=files,
+            ),
+        )
+
     # -- persistence (rides in the checkpoint manifest's extra_state) ----------
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "entries": [dataclasses.asdict(e) for e in self.entries],
         }
+        if self.compacted is not None:
+            out["compacted"] = {
+                "n_files": self.compacted.n_files,
+                "n_rows": self.compacted.n_rows,
+                "rollup_sha256": self.compacted.rollup_sha256,
+                "files": [list(f) for f in self.compacted.files],
+            }
+        return out
 
     @staticmethod
     def from_dict(d: dict) -> "CorpusManifest":
+        compacted = None
+        if d.get("compacted") is not None:
+            c = d["compacted"]
+            compacted = CompactedHistory(
+                n_files=int(c["n_files"]),
+                n_rows=int(c["n_rows"]),
+                rollup_sha256=c["rollup_sha256"],
+                files=tuple((str(p), int(s)) for p, s in c.get("files", [])),
+            )
         return CorpusManifest(
-            entries=tuple(PartFile(**e) for e in d.get("entries", []))
+            entries=tuple(PartFile(**e) for e in d.get("entries", [])),
+            compacted=compacted,
         )

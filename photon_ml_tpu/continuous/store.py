@@ -1,0 +1,952 @@
+"""Tiered out-of-core corpus store: hot deltas in RAM, cold blocks on disk.
+
+The PR 7 continuous-training loop held the whole accumulated corpus as one
+in-memory snapshot and rebuilt it on restart by re-decoding every part file
+the manifest ever recorded — O(history) RAM and O(history) Avro decode, which
+falls over exactly at the unbounded horizon the subsystem exists for. The
+:class:`CorpusStore` is the hierarchical-storage fix (Snap ML, arXiv
+1803.06333: hot working set in fast memory, cold corpus one tier down,
+re-materialized blockwise):
+
+- **hot tier** — the deltas ingested since the last compaction, decoded and
+  index-remapped, tracked as :class:`LiveSegment` records (generation, the
+  manifest entries that fed it, row count). Only the rows inside the training
+  window stay materialized in the view.
+- **cold tier** — ``cold-<n>/`` directories of decoded, index-remapped,
+  FIXED-ROW-COUNT blocks (``block-<k>.npz``, pow2 rows, PR 5's framing
+  discipline applied to our own storage): no Avro decode and no index-map
+  application ever again for compacted rows. Each block carries a SHA-256 in
+  the cold manifest, the manifest its own checksum sidecar, and the whole
+  generation lands by staged-write + atomic rename (the PR 3 commit
+  pattern) — a crash mid-write leaves only a ``.tmp`` staging dir.
+- **view** — the materialized :class:`~continuous.ingest.CorpusSnapshot` the
+  trainer actually trains on: cold blocks intersecting the window are read
+  back blockwise through the PR 5 pipeline (``map_ordered``: bounded,
+  order-preserving, parallel), in-window live segments re-decode through the
+  normal reader with FROZEN index maps, and each row carries its ingest
+  generation (``row_gens``) — the row-age metadata the sliding-window /
+  time-decay weighting modes consume.
+
+Determinism contract (the chaos bar leans on it): materializing the view from
+(cold blocks + live segments) reproduces the progressively accumulated view
+bit for bit — cold blocks store exactly the decoded+remapped arrays, and CSR
+row slicing/stacking is content-preserving. The only durable writes are the
+staged+renamed cold generation and archive files, both UNREFERENCED until the
+checkpoint generation that points at them commits atomically — so a crash
+anywhere leaves at worst an orphaned cold dir that the next compaction
+replaces.
+
+The **archive** (``archive/<coordinate>.npz``) is the eviction parking lot:
+long-idle random-effect entities dropped from the device tables keep their
+coefficients here (checksummed, staged+renamed, merged on rewrite) so a
+reappearing entity re-admits WARM instead of re-learning from zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import shutil
+from typing import Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from photon_ml_tpu.continuous.ingest import CorpusSnapshot, ingest_delta, read_corpus
+from photon_ml_tpu.data.game_data import GameInput
+from photon_ml_tpu.data.pipeline import map_ordered
+from photon_ml_tpu.resilience import corrupt_file, faultpoint, register_fault_point
+
+logger = logging.getLogger(__name__)
+
+FP_COLD_WRITE = register_fault_point("continuous.cold_write")
+
+COLD_PREFIX = "cold-"
+BLOCK_PREFIX = "block-"
+ARCHIVE_DIR = "archive"
+MANIFEST_FILE = "manifest.json"
+MANIFEST_SHA_FILE = "manifest.json.sha256"
+_TMP_SUFFIX = ".tmp"
+DEFAULT_BLOCK_ROWS = 8192  # pow2: a few MB per block at production widths
+DEFAULT_KEEP_COLD = 2  # the referenced cold gen + one rollback step
+_FORMAT = 1
+
+
+class ColdStoreCorruption(Exception):
+    """A cold block or archive failed integrity verification. Loud by design:
+    the cold tier is the corpus of record for compacted rows, so silently
+    skipping damage would train against a corpus the model never saw."""
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# ------------------------------------------------------------ array encoding
+# np.savez(allow_pickle=False) refuses object arrays, but Avro-decoded entity
+# id / uid columns arrive as object-of-str. Store their '<U*' form next to a
+# marker and restore the object dtype on load, so a materialized view is
+# indistinguishable from the progressively accumulated one.
+
+_OBJ_MARKER = "__objstr__"
+_DIGEST_KEY = "__sha256__"
+
+
+def _arrays_digest(arrays: Mapping) -> str:
+    """Content digest over a dict of arrays (name + dtype + shape + bytes,
+    name-sorted): integrity that can ride INSIDE the npz it protects, so the
+    file commits with one atomic rename instead of a content/sidecar pair."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        arr = np.ascontiguousarray(np.asarray(arrays[name]))
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def id_array(ids) -> np.ndarray:
+    """Entity ids as a pickle-free array: int64 when every id is integral,
+    else their string form — the ONE encoding rule for persisting entity ids
+    (archive rows here, eviction bookkeeping aux arrays in the trainer), so
+    both sides round-trip identically and re-admission matching never
+    diverges from the bookkeeping."""
+    ids = list(ids)
+    if not ids:
+        return np.asarray([], dtype="<U1")
+    if all(isinstance(e, (int, np.integer)) and not isinstance(e, bool) for e in ids):
+        return np.asarray([int(e) for e in ids], dtype=np.int64)
+    return np.asarray([str(e) for e in ids])
+
+
+def _encode_column(name: str, arr: np.ndarray, out: dict) -> None:
+    arr = np.asarray(arr)
+    if arr.dtype == object:
+        out[name] = arr.astype(str)
+        out[_OBJ_MARKER + name] = np.asarray(True)
+    else:
+        out[name] = arr
+
+
+def _decode_column(name: str, z: Mapping) -> np.ndarray:
+    arr = z[name]
+    if _OBJ_MARKER + name in z:
+        arr = arr.astype(object)
+    return arr
+
+
+@dataclasses.dataclass(frozen=True)
+class LiveSegment:
+    """One hot-tier delta: which generation ingested it, which manifest
+    entries (by position in the live-entry list at that time — persisted as a
+    count so paths stay single-sourced in the corpus manifest), how many rows."""
+
+    generation: int
+    n_files: int
+    n_rows: int
+
+    def to_list(self) -> list:
+        return [int(self.generation), int(self.n_files), int(self.n_rows)]
+
+    @staticmethod
+    def from_list(v: Sequence) -> "LiveSegment":
+        return LiveSegment(int(v[0]), int(v[1]), int(v[2]))
+
+
+# ------------------------------------------------------- in-trace row aging
+
+
+@jax.jit
+def _decay_factors(row_gens, current_gen, half_life):
+    """Per-row exponential age decay, derived IN-TRACE from row-age metadata:
+    ``current_gen`` and ``half_life`` are traced scalars, so every generation
+    of a steady-state loop hits the ONE compiled program per view shape (no
+    per-generation retrace), and crash-replay of a pass recomputes the exact
+    same bits from the same (row_gens, generation) inputs."""
+    age = (current_gen - row_gens).astype(jnp.float32)
+    return jnp.exp2(-age / half_life)
+
+
+def decay_weights(
+    weights: np.ndarray,
+    row_gens: np.ndarray,
+    current_gen: int,
+    half_life: float,
+) -> np.ndarray:
+    """Host wrapper: base weights x 2^(-age/half_life). The factors compute on
+    device in float32 (the dtype every training program consumes weights at);
+    the multiply returns float64 so GameInput's weight schema is unchanged."""
+    f = _decay_factors(
+        jnp.asarray(np.asarray(row_gens, dtype=np.int32)),
+        jnp.asarray(np.int32(current_gen)),
+        jnp.asarray(np.float32(half_life)),
+    )
+    return np.asarray(weights, dtype=np.float64) * np.asarray(f, dtype=np.float64)
+
+
+# ----------------------------------------------------------------- the store
+
+
+class CorpusStore:
+    """Owns the tiers under ``<directory>/`` (conventionally
+    ``<checkpoint_directory>/corpus-store``). All mutating entry points are
+    crash-safe: staged writes + atomic rename, nothing referenced until the
+    caller's checkpoint commit lands."""
+
+    def __init__(
+        self,
+        directory: str,
+        shard_configs: Mapping,
+        id_tags: Sequence[str],
+        block_rows: int = DEFAULT_BLOCK_ROWS,
+        ingest_workers: Optional[int] = None,
+        keep_cold: int = DEFAULT_KEEP_COLD,
+    ):
+        if block_rows < 1 or (block_rows & (block_rows - 1)):
+            raise ValueError(f"block_rows must be a power of two, got {block_rows}")
+        if keep_cold < 1:
+            raise ValueError(f"keep_cold must be >= 1, got {keep_cold}")
+        self.directory = os.path.abspath(directory)
+        self.shard_configs = dict(shard_configs)
+        self.id_tags = tuple(id_tags)
+        self.block_rows = int(block_rows)
+        self.ingest_workers = ingest_workers
+        self.keep_cold = int(keep_cold)
+        # runtime state
+        self.cold: Optional[dict] = None  # verified cold manifest, or None
+        self.segments: list[LiveSegment] = []
+        self.view: Optional[CorpusSnapshot] = None
+        self.min_gen: int = 0  # oldest generation materialized in the view
+        self._staged: Optional[tuple] = None  # (prev segments, prev min_gen)
+
+    # ------------------------------------------------------------- accounting
+
+    @property
+    def cold_rows(self) -> int:
+        return int(self.cold["n_rows"]) if self.cold is not None else 0
+
+    @property
+    def total_rows(self) -> int:
+        """Accumulated corpus rows across BOTH tiers (the unbounded axis;
+        includes a staged-but-uncommitted delta, mirroring the staged view)."""
+        return self.cold_rows + sum(s.n_rows for s in self.segments)
+
+    @property
+    def resident_corpus_bytes(self) -> int:
+        """Host bytes the store currently keeps materialized — the bounded-
+        memory claim's measured quantity (O(view), never O(history))."""
+        return 0 if self.view is None else self.view.nbytes
+
+    def to_state(self, compacted_as: Optional[tuple] = None) -> dict:
+        """JSON state for the checkpoint's ``extra_state`` (paths stay
+        single-sourced in the corpus manifest; this is tier bookkeeping).
+        ``compacted_as=(cold_id, n_rows)`` renders the POST-compaction state
+        for the commit that carries a freshly written cold generation —
+        before :meth:`install_cold` has adopted it — so both commit branches
+        share one schema."""
+        if compacted_as is not None:
+            cold_id, n_rows = compacted_as
+            return {
+                "cold_id": int(cold_id),
+                "cold_rows": int(n_rows),
+                "segments": [],
+                "block_rows": self.block_rows,
+            }
+        return {
+            "cold_id": None if self.cold is None else int(self.cold["cold_id"]),
+            "cold_rows": self.cold_rows,
+            "segments": [s.to_list() for s in self.segments],
+            "block_rows": self.block_rows,
+        }
+
+    # -------------------------------------------------------------- cold tier
+
+    def _cold_dir(self, cold_id: int) -> str:
+        return os.path.join(self.directory, f"{COLD_PREFIX}{cold_id:08d}")
+
+    def _load_cold_manifest(self, cold_id: int) -> dict:
+        cold_dir = self._cold_dir(cold_id)
+        man_path = os.path.join(cold_dir, MANIFEST_FILE)
+        sha_path = os.path.join(cold_dir, MANIFEST_SHA_FILE)
+        try:
+            with open(sha_path) as f:
+                expected = f.read().strip()
+            actual = _sha256_file(man_path)
+        except OSError as e:
+            raise ColdStoreCorruption(
+                f"cold generation {cold_id} is unreadable: {e}"
+            ) from e
+        if actual != expected:
+            raise ColdStoreCorruption(
+                f"cold manifest checksum mismatch in {cold_dir}"
+            )
+        with open(man_path) as f:
+            meta = json.load(f)
+        meta["path"] = cold_dir
+        return meta
+
+    def _read_block(self, cold_dir: str, block: dict, widths: Mapping) -> dict:
+        """Verify + load one cold block back into (csr shards, columns)."""
+        path = os.path.join(cold_dir, block["name"])
+        try:
+            actual = _sha256_file(path)
+        except OSError as e:
+            raise ColdStoreCorruption(f"missing cold block {path}: {e}") from e
+        if actual != block["sha256"]:
+            raise ColdStoreCorruption(f"cold block checksum mismatch: {path}")
+        with np.load(path, allow_pickle=False) as z:
+            arrs = {k: z[k] for k in z.files}
+        shards = {}
+        for shard, width in widths.items():
+            m = sp.csr_matrix(
+                (
+                    arrs[f"feat__{shard}__data"],
+                    arrs[f"feat__{shard}__indices"],
+                    arrs[f"feat__{shard}__indptr"],
+                ),
+                # widen to the CURRENT map width: tail growth is a shape
+                # annotation, stored column ids never move (index_map.extend)
+                shape=(len(arrs["labels"]), int(width)),
+            )
+            shards[shard] = m
+        cols = {
+            name: _decode_column(name, arrs)
+            for name in ("labels", "offsets", "weights", "row_gens", "uids")
+        }
+        cols["ids"] = {
+            tag: _decode_column(f"id__{tag}", arrs) for tag in self.id_tags
+        }
+        cols["features"] = shards
+        return cols
+
+    def _iter_cold_chunks(self, min_gen: int, widths: Mapping, workers=None):
+        """Yield decoded cold chunks (oldest first) whose rows can reach the
+        window ``gen >= min_gen`` — blocks entirely below it are skipped
+        WITHOUT touching their bytes; the seam block is row-sliced. Reads go
+        through the PR 5 bounded order-preserving pool."""
+        if self.cold is None:
+            return
+        cold_dir = self.cold["path"]
+        blocks = [
+            b for b in self.cold["blocks"] if int(b["gen_hi"]) >= int(min_gen)
+        ]
+        n_workers = workers if workers is not None else (self.ingest_workers or 1)
+        for chunk in map_ordered(
+            blocks,
+            lambda b: self._read_block(cold_dir, b, widths),
+            workers=n_workers,
+            window=max(2, n_workers * 2),
+        ):
+            keep = np.asarray(chunk["row_gens"]) >= int(min_gen)
+            if not keep.all():
+                idx = np.flatnonzero(keep)
+                chunk = _slice_chunk(chunk, idx)
+            if len(chunk["labels"]):
+                yield chunk
+
+    # --------------------------------------------------------- materialization
+
+    def materialize(
+        self,
+        index_maps: Mapping,
+        manifest,
+        min_gen: int = 0,
+        segments: Optional[list] = None,
+    ) -> CorpusSnapshot:
+        """Rebuild the training view from the tiers: cold blocks (blockwise,
+        verified) + in-window live segments re-decoded with the FROZEN index
+        maps — bitwise the progressively accumulated view. ``manifest`` is the
+        corpus manifest whose live entries feed the segments, in order."""
+        segments = self.segments if segments is None else segments
+        widths = {s: m.size for s, m in index_maps.items()}
+        chunks = list(self._iter_cold_chunks(min_gen, widths))
+        chunks.extend(
+            self._iter_live_chunks(manifest, segments, index_maps, widths, min_gen)
+        )
+        if chunks:
+            view = _chunks_to_snapshot(chunks, dict(index_maps), widths)
+        else:
+            # a window that excluded every accumulated row (e.g.
+            # window_generations=1 between passes) is a legitimate state —
+            # the next delta appends onto the empty view; raising here would
+            # wedge abort_delta/restore behind a masked ValueError
+            view = _empty_snapshot(dict(index_maps), widths, self.id_tags)
+        # global start row: everything accumulated minus what the view holds
+        total = self.cold_rows + sum(s.n_rows for s in segments)
+        view.start_row = total - view.n_rows
+        self.view = view
+        self.min_gen = int(min_gen)
+        return view
+
+    def _iter_live_chunks(
+        self, manifest, segments, index_maps: Mapping, widths: Mapping,
+        min_gen: int,
+    ):
+        """Re-decode live segments (generation >= ``min_gen``) with the
+        frozen maps, one chunk per segment, with the row-count check — the
+        ONE decode path both materialization and the compaction fold share,
+        so neither can silently fold rows the bookkeeping never recorded."""
+        live_paths = list(manifest.live_paths)
+        if sum(s.n_files for s in segments) != len(live_paths):
+            raise ValueError(
+                f"store segments cover {sum(s.n_files for s in segments)} live "
+                f"files but the manifest records {len(live_paths)}"
+            )
+        offset = 0
+        for seg in segments:
+            paths = live_paths[offset : offset + seg.n_files]
+            offset += seg.n_files
+            if seg.generation < int(min_gen):
+                continue  # aged out of the window: never re-decoded
+            data, _maps, uids = read_corpus(
+                paths, self.shard_configs, index_maps, self.id_tags,
+                self.ingest_workers,
+            )
+            if data.n != seg.n_rows:
+                raise ColdStoreCorruption(
+                    f"live segment for generation {seg.generation} re-decoded "
+                    f"to {data.n} rows, recorded {seg.n_rows}"
+                )
+            yield {
+                "features": {s: data.shard(s).tocsr() for s in widths},
+                "labels": np.asarray(data.labels),
+                "offsets": np.asarray(data.offsets),
+                "weights": np.asarray(data.weights),
+                "row_gens": np.full(data.n, seg.generation, dtype=np.int64),
+                "uids": np.asarray(uids, dtype=object),
+                "ids": {tag: np.asarray(data.ids(tag)) for tag in self.id_tags},
+            }
+
+    # ------------------------------------------------------------ window/delta
+
+    def trim_view(self, min_gen: int) -> CorpusSnapshot:
+        """Advance the sliding window: drop view head rows whose generation
+        aged below ``min_gen``. Rows append in generation order, so the drop
+        is a contiguous head slice — O(view) memcpy, no decode."""
+        if self.view is None:
+            raise ValueError("no materialized view to trim")
+        if int(min_gen) <= self.min_gen:
+            return self.view
+        gens = self.view.row_gens
+        if gens is None:
+            raise ValueError("view carries no row_gens; window modes need them")
+        start = int(np.searchsorted(gens, int(min_gen), side="left"))
+        if start:
+            self.view = _slice_snapshot(self.view, start)
+        self.min_gen = int(min_gen)
+        return self.view
+
+    def stage_delta(self, new_files: Sequence[str], generation: int):
+        """Decode + append a delta to the view for pass ``generation``.
+        Nothing durable moves; call :meth:`commit_delta` after the checkpoint
+        commit lands or :meth:`abort_delta` (which re-materializes the
+        previous view from the tiers) on failure. The PREVIOUS view's arrays
+        are released eagerly — the store never holds two generations' views
+        beyond the concat itself."""
+        if self._staged is not None:
+            raise RuntimeError("a staged delta is already pending")
+        prev_segments = list(self.segments)
+        prev_min_gen = self.min_gen
+        grown, info = ingest_delta(
+            self.view,
+            new_files,
+            self.shard_configs,
+            self.id_tags,
+            self.ingest_workers,
+            generation=int(generation),
+        )
+        # eager drop: the pre-delta view is re-creatable from (cold, live
+        # segments); keeping it alive across the whole pass would double the
+        # hot tier for no benefit (satellite: no step holds more than the
+        # hot tier + one block of cold reads)
+        self.view = grown
+        self.segments = prev_segments + [
+            LiveSegment(
+                generation=int(generation),
+                n_files=len(new_files),
+                n_rows=info.n_new_rows,
+            )
+        ]
+        self._staged = (prev_segments, prev_min_gen)
+        return grown, info
+
+    def commit_delta(self) -> None:
+        self._staged = None
+
+    def abort_delta(self, index_maps: Mapping, manifest) -> Optional[CorpusSnapshot]:
+        """Roll the staged delta back: restore segment bookkeeping and
+        re-materialize the previous view (deterministic re-read — the price
+        of releasing it eagerly on stage). A failed BOOTSTRAP ingest rolls
+        back to the empty store (no view)."""
+        if self._staged is None:
+            raise RuntimeError("no staged delta to abort")
+        prev_segments, prev_min_gen = self._staged
+        self.segments = prev_segments
+        self._staged = None
+        self.view = None  # release the staged view before rebuilding
+        self.min_gen = prev_min_gen
+        if not prev_segments and self.cold is None:
+            return None
+        return self.materialize(
+            index_maps, manifest, min_gen=prev_min_gen, segments=prev_segments
+        )
+
+    # -------------------------------------------------------------- compaction
+
+    def write_cold_generation(self, cold_id: int, index_maps: Mapping, manifest) -> dict:
+        """Fold the previous cold generation plus EVERY live segment into
+        ``cold-<cold_id>/`` — streamed blockwise (cold reads one block at a
+        time; live segments re-decode per segment with frozen maps), peak RAM
+        O(block + largest segment), never O(history). Staged + atomic rename;
+        the caller's checkpoint commit is what makes it authoritative.
+        Returns the new cold manifest; call :meth:`install_cold` with it
+        AFTER that commit lands to adopt it as the current cold generation."""
+        # compaction permanently EXEMPTS the folded files from every future
+        # verification (the cold tier becomes their corpus of record), so
+        # this is the last chance to catch a same-size rewrite: full-content
+        # fingerprint check of every live entry about to fold — O(live) I/O,
+        # paid only at compaction cadence
+        manifest.verify_fingerprints()
+        widths = {s: m.size for s, m in index_maps.items()}
+        final = self._cold_dir(cold_id)
+        tmp = final + _TMP_SUFFIX
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+
+        writer = _BlockWriter(
+            tmp, self.block_rows, widths, self.id_tags
+        )
+        for chunk in self._iter_cold_chunks(min_gen=0, widths=widths):
+            writer.push(chunk)
+        for chunk in self._iter_live_chunks(
+            manifest, self.segments, index_maps, widths, min_gen=0
+        ):
+            writer.push(chunk)
+        blocks, n_rows = writer.finish()
+
+        meta = {
+            "format": _FORMAT,
+            "cold_id": int(cold_id),
+            "n_rows": int(n_rows),
+            "block_rows": self.block_rows,
+            "shards": {s: int(w) for s, w in widths.items()},
+            "id_tags": list(self.id_tags),
+            "blocks": blocks,
+        }
+        man_path = os.path.join(tmp, MANIFEST_FILE)
+        with open(man_path, "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(tmp, MANIFEST_SHA_FILE), "w") as f:
+            f.write(_sha256_file(man_path) + "\n")
+
+        # an orphaned final dir from a crashed earlier attempt (written but
+        # never referenced by a committed checkpoint) is replaced wholesale
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        meta["path"] = final
+        return meta
+
+    def install_cold(self, meta: dict, clear_segments: bool = True) -> None:
+        """Adopt a written cold generation as current (call alongside folding
+        the manifest): live segments are now cold rows."""
+        self.cold = meta
+        if clear_segments:
+            self.segments = []
+        self.prune_cold(referenced=int(meta["cold_id"]))
+
+    def prune_cold(self, referenced: Optional[int] = None) -> None:
+        """Drop cold generations the retention policy no longer needs, and
+        sweep staging leftovers a real crash mid-write leaked (cold ``*.tmp``
+        dirs and archive ``*.tmp-<pid>.npz`` files — a store whose point is
+        bounded growth must not accumulate dead bytes).
+
+        ``referenced`` is the cold id the NEWEST committed checkpoint points
+        at. Anything NEWER is a crash orphan a replayed compaction will
+        rewrite — deleted, and never counted toward retention: an orphan that
+        displaced a referenced generation from the keep window would make
+        rollback (or with ``keep_cold=1`` the normal restart) unrecoverable
+        once the original part files are archived away. Of the
+        referenced-and-older generations, the newest ``keep_cold`` are kept
+        (the referenced one plus rollback steps). With ``referenced=None``
+        (nothing is known to reference any cold generation) NO cold dirs are
+        deleted — only staging leftovers sweep."""
+        if not os.path.isdir(self.directory):
+            return
+        if referenced is not None:
+            gens = sorted(
+                n
+                for n in os.listdir(self.directory)
+                if n.startswith(COLD_PREFIX) and not n.endswith(_TMP_SUFFIX)
+            )
+            orphans = [
+                n for n in gens if int(n[len(COLD_PREFIX):]) > int(referenced)
+            ]
+            gens = [n for n in gens if n not in set(orphans)]
+            for name in orphans:
+                logger.info("removing orphaned cold generation %s", name)
+                shutil.rmtree(
+                    os.path.join(self.directory, name), ignore_errors=True
+                )
+            for name in gens[: -self.keep_cold]:
+                shutil.rmtree(
+                    os.path.join(self.directory, name), ignore_errors=True
+                )
+        for name in os.listdir(self.directory):
+            if name.endswith(_TMP_SUFFIX):
+                shutil.rmtree(
+                    os.path.join(self.directory, name), ignore_errors=True
+                )
+        archive_dir = os.path.join(self.directory, ARCHIVE_DIR)
+        if os.path.isdir(archive_dir):
+            for name in os.listdir(archive_dir):
+                if f"{_TMP_SUFFIX}-" in name:
+                    try:
+                        os.remove(os.path.join(archive_dir, name))
+                    except OSError:
+                        pass
+
+    def adopt_state(self, state: Optional[dict]) -> None:
+        """Restore tier bookkeeping from a checkpoint's ``extra_state`` blob
+        (``to_state``'s output). Verifies the referenced cold generation's
+        manifest; the blocks verify lazily as they are read."""
+        if not state:
+            self.cold = None
+            self.segments = []
+            self.prune_cold()  # sweep staging leftovers; keep dirs untouched
+            return
+        cold_id = state.get("cold_id")
+        self.cold = None if cold_id is None else self._load_cold_manifest(int(cold_id))
+        if self.cold is not None and self.cold_rows != int(state.get("cold_rows", -1)):
+            raise ColdStoreCorruption(
+                f"cold generation {cold_id} rows ({self.cold_rows}) disagree "
+                f"with the checkpoint record ({state.get('cold_rows')})"
+            )
+        self.segments = [LiveSegment.from_list(v) for v in state.get("segments", [])]
+        # prune AFTER the referenced manifest verified: crash orphans (newer
+        # than the reference) go, retention counts only real generations
+        if cold_id is not None:
+            self.prune_cold(referenced=int(cold_id))
+        else:
+            self.prune_cold()
+
+    # ---------------------------------------------------------------- archive
+
+    def _archive_path(self, cid: str) -> str:
+        safe = cid.replace(os.sep, "_").replace("/", "_")
+        return os.path.join(self.directory, ARCHIVE_DIR, f"{safe}.npz")
+
+    def archive_load(self, cid: str) -> Optional[dict]:
+        """Verified archive for one coordinate: {entity_ids, coeffs, proj,
+        variances?, evicted_at} or None when nothing was ever evicted. Raises
+        :class:`ColdStoreCorruption` on damage — a silently dropped archive
+        would re-admit entities cold and break replay determinism.
+
+        Integrity is SELF-CONTAINED: the digest of the arrays rides inside
+        the npz (``__sha256__``), so the archive commits as ONE atomic
+        rename — there is no content/sidecar pair whose torn update could
+        brick every later pass."""
+        path = self._archive_path(cid)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                arrs = {k: z[k] for k in z.files}
+        except Exception as e:  # torn zip, bad header — bit-rot, not a bug
+            raise ColdStoreCorruption(
+                f"archive for {cid!r} is unreadable: {e}"
+            ) from e
+        expected = str(arrs.pop(_DIGEST_KEY, ""))
+        actual = _arrays_digest(arrs)
+        if actual != expected:
+            raise ColdStoreCorruption(f"archive checksum mismatch: {path}")
+        out = {
+            "entity_ids": _decode_column("entity_ids", arrs),
+            "coeffs": arrs["coeffs"],
+            "proj": arrs["proj"],
+            "evicted_at": arrs["evicted_at"],
+        }
+        if "variances" in arrs:
+            out["variances"] = arrs["variances"]
+        return out
+
+    def archive_write(
+        self,
+        cid: str,
+        entity_ids: Sequence,
+        coeffs: np.ndarray,
+        proj: np.ndarray,
+        variances: Optional[np.ndarray],
+        evicted_at: int,
+    ) -> str:
+        """Merge newly evicted entities into the coordinate's archive
+        (staged + renamed + checksummed). Re-evicting an entity overwrites its
+        archived row — the archive always holds the LATEST pre-eviction
+        coefficients. Idempotent: a crash-replayed pass rewrites identical
+        bytes."""
+        prev = self.archive_load(cid)
+        ids_new = list(entity_ids)
+        ids_new_set = set(ids_new)
+        k_new = coeffs.shape[1] if len(ids_new) else 0
+        if prev is not None:
+            keep = [
+                i
+                for i, e in enumerate(prev["entity_ids"].tolist())
+                if e not in ids_new_set
+            ]
+            k = max(int(prev["coeffs"].shape[1]), k_new)
+            ids_all = [prev["entity_ids"][i] for i in keep] + ids_new
+            coeffs_all = np.concatenate(
+                [
+                    pad_columns(prev["coeffs"][keep], k, 0),
+                    pad_columns(np.asarray(coeffs), k, 0),
+                ]
+            )
+            proj_all = np.concatenate(
+                [
+                    pad_columns(prev["proj"][keep], k, -1),
+                    pad_columns(np.asarray(proj), k, -1),
+                ]
+            )
+            gens_all = np.concatenate(
+                [
+                    np.asarray(prev["evicted_at"])[keep],
+                    np.full(len(ids_new), int(evicted_at), dtype=np.int64),
+                ]
+            )
+            var_all = None
+            if variances is not None or "variances" in prev:
+                pv = prev.get("variances")
+                pv = (
+                    np.zeros_like(prev["coeffs"]) if pv is None else pv
+                )
+                nv = (
+                    np.zeros_like(np.asarray(coeffs))
+                    if variances is None
+                    else np.asarray(variances)
+                )
+                var_all = np.concatenate(
+                    [pad_columns(pv[keep], k, 0), pad_columns(nv, k, 0)]
+                )
+        else:
+            ids_all = ids_new
+            coeffs_all = np.asarray(coeffs)
+            proj_all = np.asarray(proj)
+            gens_all = np.full(len(ids_new), int(evicted_at), dtype=np.int64)
+            var_all = None if variances is None else np.asarray(variances)
+
+        path = self._archive_path(cid)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        arrays: dict = {
+            "coeffs": coeffs_all,
+            "proj": proj_all,
+            "evicted_at": gens_all,
+        }
+        _encode_column("entity_ids", id_array(ids_all), arrays)
+        if var_all is not None:
+            arrays["variances"] = var_all
+        arrays[_DIGEST_KEY] = np.asarray(_arrays_digest(arrays))
+        # np.savez appends ".npz" to names lacking it: stage under one too;
+        # the embedded digest makes the single os.replace the WHOLE commit
+        # (a content+sidecar pair would have a torn window between renames
+        # that no replay could repair)
+        tmp = path + f"{_TMP_SUFFIX}-{os.getpid()}.npz"
+        action = faultpoint(FP_COLD_WRITE)
+        np.savez(tmp, **arrays)
+        if action == "corrupt":
+            corrupt_file(tmp)  # detectable bit-rot: damage lands post-digest
+        os.replace(tmp, path)
+        return path
+
+
+# --------------------------------------------------------------- chunk plumbing
+
+
+def pad_columns(m: np.ndarray, k: int, fill) -> np.ndarray:
+    """Widen a 2-D table to ``k`` columns with ``fill`` (dtype-preserving);
+    shared by the archive merge and the carried-entity merge
+    (continuous/compaction.py)."""
+    m = np.asarray(m)
+    if m.ndim != 2 or m.shape[1] == k:
+        return m
+    out = np.full((m.shape[0], k), fill, dtype=m.dtype)
+    out[:, : m.shape[1]] = m
+    return out
+
+
+def _slice_chunk(chunk: dict, idx: np.ndarray) -> dict:
+    out = {
+        "features": {s: m.tocsr()[idx] for s, m in chunk["features"].items()},
+        "ids": {t: c[idx] for t, c in chunk["ids"].items()},
+    }
+    for name in ("labels", "offsets", "weights", "row_gens", "uids"):
+        out[name] = chunk[name][idx]
+    return out
+
+
+def _slice_snapshot(view: CorpusSnapshot, start: int) -> CorpusSnapshot:
+    data = view.data
+    return CorpusSnapshot(
+        data=GameInput(
+            features={s: m.tocsr()[start:] for s, m in data.features.items()},
+            labels=np.asarray(data.labels)[start:],
+            offsets=np.asarray(data.offsets)[start:],
+            weights=np.asarray(data.weights)[start:],
+            id_columns={t: np.asarray(c)[start:] for t, c in data.id_columns.items()},
+        ),
+        index_maps=view.index_maps,
+        uids=view.uids[start:],
+        row_gens=None if view.row_gens is None else view.row_gens[start:],
+        start_row=view.start_row + start,
+    )
+
+
+def _empty_snapshot(index_maps: dict, widths: dict, id_tags) -> CorpusSnapshot:
+    return CorpusSnapshot(
+        data=GameInput(
+            features={
+                s: sp.csr_matrix((0, int(w)), dtype=np.float64)
+                for s, w in widths.items()
+            },
+            labels=np.zeros(0, dtype=np.float64),
+            offsets=np.zeros(0, dtype=np.float64),
+            weights=np.zeros(0, dtype=np.float64),
+            id_columns={t: np.zeros(0, dtype=object) for t in id_tags},
+        ),
+        index_maps=index_maps,
+        uids=np.zeros(0, dtype=object),
+        row_gens=np.zeros(0, dtype=np.int64),
+    )
+
+
+def _chunks_to_snapshot(
+    chunks: list, index_maps: dict, widths: dict
+) -> CorpusSnapshot:
+    if not chunks:
+        raise ValueError("cannot materialize an empty view")
+    features = {
+        s: sp.vstack([c["features"][s].tocsr() for c in chunks], format="csr")
+        if len(chunks) > 1
+        else chunks[0]["features"][s].tocsr()
+        for s in widths
+    }
+    cat = (
+        lambda name: np.concatenate([c[name] for c in chunks])
+        if len(chunks) > 1
+        else chunks[0][name]
+    )
+    data = GameInput(
+        features=features,
+        labels=cat("labels"),
+        offsets=cat("offsets"),
+        weights=cat("weights"),
+        id_columns={
+            tag: np.concatenate([c["ids"][tag] for c in chunks])
+            if len(chunks) > 1
+            else chunks[0]["ids"][tag]
+            for tag in chunks[0]["ids"]
+        },
+    )
+    return CorpusSnapshot(
+        data=data,
+        index_maps=index_maps,
+        uids=cat("uids"),
+        row_gens=cat("row_gens"),
+    )
+
+
+class _BlockWriter:
+    """Re-blocking accumulator: takes arbitrarily sized row chunks, emits
+    fixed ``block_rows`` blocks (the last one partial), each written as one
+    checksummed npz. Holds at most ~2 blocks of rows at a time."""
+
+    def __init__(self, directory: str, block_rows: int, widths: dict, id_tags):
+        self.directory = directory
+        self.block_rows = block_rows
+        self.widths = widths
+        self.id_tags = tuple(id_tags)
+        self.pending: list[dict] = []
+        self.pending_rows = 0
+        self.blocks: list[dict] = []
+        self.n_rows = 0
+
+    def push(self, chunk: dict) -> None:
+        self.pending.append(chunk)
+        self.pending_rows += len(chunk["labels"])
+        while self.pending_rows >= self.block_rows:
+            self._emit(self.block_rows)
+
+    def finish(self) -> tuple[list, int]:
+        while self.pending_rows > 0:
+            self._emit(min(self.block_rows, self.pending_rows))
+        return self.blocks, self.n_rows
+
+    def _emit(self, rows: int) -> None:
+        take: list[dict] = []
+        remaining = rows
+        while remaining > 0:
+            head = self.pending[0]
+            n = len(head["labels"])
+            if n <= remaining:
+                take.append(self.pending.pop(0))
+                remaining -= n
+            else:
+                idx = np.arange(remaining)
+                take.append(_slice_chunk(head, idx))
+                self.pending[0] = _slice_chunk(head, np.arange(remaining, n))
+                remaining = 0
+        self.pending_rows -= rows
+
+        merged = take[0] if len(take) == 1 else {
+            "features": {
+                s: sp.vstack([c["features"][s] for c in take], format="csr")
+                for s in self.widths
+            },
+            "ids": {
+                t: np.concatenate([c["ids"][t] for c in take])
+                for t in self.id_tags
+            },
+            **{
+                name: np.concatenate([c[name] for c in take])
+                for name in ("labels", "offsets", "weights", "row_gens", "uids")
+            },
+        }
+        arrays: dict = {}
+        for name in ("labels", "offsets", "weights", "row_gens"):
+            arrays[name] = np.asarray(merged[name])
+        _encode_column("uids", merged["uids"], arrays)
+        for tag in self.id_tags:
+            _encode_column(f"id__{tag}", merged["ids"][tag], arrays)
+        for shard in self.widths:
+            m = merged["features"][shard].tocsr()
+            arrays[f"feat__{shard}__data"] = m.data
+            arrays[f"feat__{shard}__indices"] = m.indices
+            arrays[f"feat__{shard}__indptr"] = m.indptr
+        name = f"{BLOCK_PREFIX}{len(self.blocks):06d}.npz"
+        path = os.path.join(self.directory, name)
+        action = faultpoint(FP_COLD_WRITE)
+        np.savez(path, **arrays)
+        sha = _sha256_file(path)
+        if action == "corrupt":
+            corrupt_file(path)  # post-checksum: exactly what reads must catch
+        gens = np.asarray(merged["row_gens"])
+        self.blocks.append(
+            {
+                "name": name,
+                "rows": [self.n_rows, self.n_rows + rows],
+                "gen_lo": int(gens.min()),
+                "gen_hi": int(gens.max()),
+                "sha256": sha,
+            }
+        )
+        self.n_rows += rows

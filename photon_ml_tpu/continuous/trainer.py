@@ -53,8 +53,23 @@ from photon_ml_tpu.continuous.active_set import (
     ReservoirDownSampler,
     select_active_entities,
 )
-from photon_ml_tpu.continuous.ingest import CorpusSnapshot, ingest_delta, read_corpus
+from photon_ml_tpu.continuous.compaction import (
+    FP_COMPACT,
+    archived_rows_for,
+    drop_entities,
+    inject_archived_rows,
+    merge_carried_entities,
+    plan_eviction,
+)
+from photon_ml_tpu.continuous.ingest import CorpusSnapshot
 from photon_ml_tpu.continuous.manifest import CorpusManifest
+from photon_ml_tpu.continuous.store import (
+    DEFAULT_BLOCK_ROWS,
+    CorpusStore,
+    LiveSegment,
+    decay_weights,
+    id_array,
+)
 from photon_ml_tpu.data.index_map import IndexMap
 from photon_ml_tpu.estimators.config import RandomEffectDataConfiguration
 from photon_ml_tpu.estimators.game_estimator import GameEstimator
@@ -67,7 +82,21 @@ logger = logging.getLogger(__name__)
 
 FP_COMMIT = register_fault_point("continuous.commit")
 
+
+def _native_id(e):
+    """npz round-trip for entity ids: numpy scalars back to the native
+    int/str the model's entity tuples carry."""
+    if isinstance(e, (np.integer, int)) and not isinstance(e, bool):
+        return int(e)
+    return str(e)
+
+
+
 _AUX_INDEX_MAP_PREFIX = "index-map-"
+_AUX_LAST_ACTIVE_PREFIX = "last-active-"
+_AUX_EVICTED_PREFIX = "evicted-"
+
+WINDOW_MODES = ("full", "sliding", "decay")
 
 
 @dataclasses.dataclass
@@ -105,6 +134,25 @@ class ContinuousTrainerConfig:
     # entity-sharded coefficient tables (parallel/placement.py). None =
     # single-device host placement.
     mesh: Optional[object] = None
+    # ---- unbounded-horizon knobs (continuous/store.py, compaction.py) ----
+    # fold the corpus into a new cold generation + truncate the manifest's
+    # per-file history every N committed generations (None = never compact;
+    # RAM/restart cost then grows with the live history)
+    compact_every: Optional[int] = None
+    # drop random-effect entities with no rows in the last G generations from
+    # the device tables (archived; serving degrades to the missing-entity
+    # score-0 contract; re-admission warm-starts from the archive)
+    evict_idle_generations: Optional[int] = None
+    # row aging: "full" trains on every accumulated row (PR 7 behavior);
+    # "sliding" drops rows older than window_generations from the training
+    # view (RAM O(window), shapes constant in steady state); "decay" also
+    # down-weights rows in-view by 2^(-age/decay_half_life) — weights derived
+    # in-trace from row-age metadata, so crash-replay stays bit-identical and
+    # generation advance never retraces
+    window_mode: str = "full"
+    window_generations: Optional[int] = None
+    decay_half_life: Optional[float] = None
+    cold_block_rows: int = DEFAULT_BLOCK_ROWS
 
 
 @dataclasses.dataclass
@@ -113,14 +161,17 @@ class GenerationResult:
 
     generation: int
     kind: str  # "bootstrap" | "delta"
-    n_rows: int
+    n_rows: int  # TOTAL accumulated rows across both tiers
     n_new_rows: int
     checkpoint_path: str
     # cid -> {n_entities, n_active, active_fraction, n_new_data,
-    #         n_new_entities, n_gradient, n_solved_lanes}
+    #         n_new_entities, n_gradient, n_solved_lanes, n_evicted,
+    #         n_readmitted, n_carried}
     active: dict
     incidents: list
     timings: dict  # phase -> seconds
+    view_rows: int = 0  # rows materialized in the training view (the window)
+    compacted: bool = False  # this commit folded the corpus into a cold gen
 
     @property
     def active_fraction(self) -> float:
@@ -180,12 +231,69 @@ class ContinuousTrainer:
                         f"{cfg.down_sampling_rate}; drop one of the two"
                     )
         self.id_tags = sorted(set(self.re_types.values()))
+        self._validate_window_config()
         self.manifest = CorpusManifest()
-        self.snapshot: Optional[CorpusSnapshot] = None
+        self.store = CorpusStore(
+            os.path.join(config.checkpoint_directory, "corpus-store"),
+            config.shard_configurations,
+            self.id_tags,
+            block_rows=config.cold_block_rows,
+            ingest_workers=config.ingest_workers,
+        )
         self.models: Optional[dict] = None
         self.generation = 0
         self.last_result: Optional[GenerationResult] = None
+        # eviction bookkeeping (persisted as aux arrays in every commit):
+        # cid -> {entity_id: last generation with data} and cid -> evicted ids
+        self.last_active: dict = {cid: {} for cid in self.re_types}
+        self.evicted: dict = {cid: set() for cid in self.re_types}
         self._restore()
+
+    def _validate_window_config(self) -> None:
+        cfg = self.config
+        if cfg.window_mode not in WINDOW_MODES:
+            raise ValueError(
+                f"window_mode must be one of {WINDOW_MODES}, got {cfg.window_mode!r}"
+            )
+        if cfg.window_mode == "sliding" and not cfg.window_generations:
+            raise ValueError("window_mode='sliding' requires window_generations")
+        if cfg.window_mode == "decay" and not cfg.decay_half_life:
+            raise ValueError("window_mode='decay' requires decay_half_life")
+        if cfg.window_mode == "full" and cfg.window_generations:
+            raise ValueError(
+                "window_generations has no effect with window_mode='full'; "
+                "pick 'sliding' or 'decay'"
+            )
+        if cfg.window_mode != "decay" and cfg.decay_half_life is not None:
+            raise ValueError(
+                f"decay_half_life has no effect with window_mode="
+                f"{cfg.window_mode!r}; pass window_mode='decay' (a silently "
+                "ignored half-life would train a different model than asked)"
+            )
+        for knob in ("window_generations", "evict_idle_generations", "compact_every"):
+            v = getattr(cfg, knob)
+            if v is not None and v < 1:
+                raise ValueError(f"{knob} must be >= 1, got {v}")
+        if cfg.evict_idle_generations and not self.re_types:
+            raise ValueError(
+                "evict_idle_generations needs at least one random-effect "
+                "coordinate (the fixed effect has no entities to evict)"
+            )
+
+    @property
+    def snapshot(self) -> Optional[CorpusSnapshot]:
+        """The materialized training view (the store's hot surface). In
+        ``full`` window mode this is the whole accumulated corpus — the PR 7
+        snapshot, unchanged; with a sliding window it is the in-window tail."""
+        return self.store.view
+
+    def _window_min_gen(self, generation: int) -> int:
+        """Oldest generation whose rows the view for pass ``generation``
+        keeps (0 = everything)."""
+        w = self.config.window_generations
+        if self.config.window_mode == "full" or not w:
+            return 0
+        return max(0, int(generation) - int(w) + 1)
 
     # ------------------------------------------------------------- restore
 
@@ -193,6 +301,19 @@ class ContinuousTrainer:
         parts = [f"continuous|{self.task.value}"]
         for cid in sorted(self.config.coordinate_configurations):
             parts.append(f"{cid}={self.opt_configs[cid]!r}")
+        # window/eviction change the TRAINING MATH (which rows carry weight,
+        # which entities keep tables): a rerun with different settings must
+        # retrain, not silently adopt the other regime's state (the stale-
+        # restore lesson). Compaction cadence and block size do NOT — they
+        # only move bytes between tiers bit-preservingly — so they stay out.
+        cfg = self.config
+        if cfg.window_mode != "full":
+            parts.append(
+                f"window={cfg.window_mode}:{cfg.window_generations}"
+                f":{cfg.decay_half_life}"
+            )
+        if cfg.evict_idle_generations:
+            parts.append(f"evict={cfg.evict_idle_generations}")
         return "|".join(parts)
 
     def _restore(self) -> None:
@@ -223,29 +344,59 @@ class ContinuousTrainer:
             index_maps[shard] = IndexMap([str(n) for n in arrs["names"]])
         self.manifest = CorpusManifest.from_dict(extra["corpus_manifest"])
         # full-content check BEFORE the rebuild read: a same-size rewrite of
-        # an ingested part file (size checks pass) would otherwise rebuild a
-        # corpus that silently differs from what the warm-start model absorbed
+        # a LIVE part file (size checks pass) would otherwise rebuild a
+        # corpus that silently differs from what the warm-start model
+        # absorbed. Compacted files are exempt: the cold tier owns their
+        # bytes under its own per-block checksums.
         self.manifest.verify_fingerprints()
-        data, _maps, uids = read_corpus(
-            self.manifest.paths,
-            self.config.shard_configurations,
-            index_maps,
-            self.id_tags,
-            self.config.ingest_workers,
-        )
-        self.snapshot = CorpusSnapshot(data=data, index_maps=index_maps, uids=uids)
         self.models = restored["models"]
         self.generation = int(restored.get("generation") or 0)
+        self._restore_eviction_state(aux)
+
+        store_state = extra.get("store")
+        if store_state is None:
+            # pre-store checkpoint layout: the whole manifest is one live
+            # segment stamped with the committed generation (row ages are
+            # only consumed by window modes, which always persist store state)
+            self.store.adopt_state(None)
+            self.store.segments = [
+                LiveSegment(
+                    generation=self.generation,
+                    n_files=len(self.manifest.entries),
+                    n_rows=int(extra["n_rows"]),
+                )
+            ]
+        else:
+            self.store.adopt_state(store_state)
+        self.store.materialize(
+            index_maps,
+            self.manifest,
+            min_gen=self._window_min_gen(self.generation),
+        )
         logger.info(
-            "restored continuous state: generation %d, %d corpus rows, "
-            "%d part files",
+            "restored continuous state: generation %d, %d corpus rows "
+            "(%d materialized in the view, %d cold), %d part files",
             self.generation,
-            data.n,
+            self.store.total_rows,
+            self.store.view.n_rows,
+            self.store.cold_rows,
             len(self.manifest),
         )
         # a crash between commit and export leaves the export missing: redo
         # it idempotently (export bytes are a pure function of the models)
         self._maybe_export(self.generation)
+
+    def _restore_eviction_state(self, aux: dict) -> None:
+        for cid in self.re_types:
+            la = aux.get(f"{_AUX_LAST_ACTIVE_PREFIX}{cid}")
+            if la is not None:
+                ids = [_native_id(e) for e in la["ids"]]
+                self.last_active[cid] = dict(
+                    zip(ids, (int(g) for g in la["gens"]))
+                )
+            ev = aux.get(f"{_AUX_EVICTED_PREFIX}{cid}")
+            if ev is not None:
+                self.evicted[cid] = {_native_id(e) for e in ev["ids"]}
 
     # --------------------------------------------------------------- export
 
@@ -307,12 +458,12 @@ class ContinuousTrainer:
             )
         return jnp.asarray(off, dtype=self.config.dtype)
 
-    def _adapted_models(self, datasets: dict) -> dict:
+    def _adapted_models(self, datasets: dict, prev_models: dict) -> dict:
         """Previous-generation models adapted to the grown datasets: fixed
         effects zero-pad to the grown feature dim, random effects re-layout
         by entity id (tail growth makes this a cheap identity-or-append)."""
         out = {}
-        for cid, model in self.models.items():
+        for cid, model in prev_models.items():
             ds = datasets[cid]
             if isinstance(model, FixedEffectModel):
                 out[cid] = self._pad_fixed_effect(model, ds.dim)
@@ -323,7 +474,8 @@ class ContinuousTrainer:
         return out
 
     def _select_active_sets(
-        self, datasets: dict, adapted: dict, delta_entities: dict
+        self, datasets: dict, adapted: dict, delta_entities: dict,
+        prev_models: dict,
     ) -> tuple[dict, dict]:
         """Per-RE-coordinate active masks + stats. The optional gradient
         screen evaluates each coordinate's subproblem gradient at the
@@ -360,7 +512,7 @@ class ContinuousTrainer:
             sel = select_active_entities(
                 ds,
                 delta_entities.get(re_type, set()),
-                prev_model=self.models.get(cid),
+                prev_model=prev_models.get(cid),
                 gradient_norms=norms,
                 gradient_threshold=self.config.gradient_threshold,
             )
@@ -377,6 +529,102 @@ class ContinuousTrainer:
             }
         return active_sets, stats
 
+    # ----------------------------------------------------- eviction plumbing
+
+    def _plan_evictions(
+        self, prev_models: dict, delta_entities: dict, generation: int
+    ) -> tuple[dict, dict, dict]:
+        """Eviction/re-admission verdicts for one pass. Returns
+        (pruned previous models, plans per cid, updated evicted sets).
+        Without ``evict_idle_generations`` this is an identity pass (no
+        fault point fires, no bookkeeping is consulted)."""
+        if not self.config.evict_idle_generations:
+            return prev_models, {}, {
+                cid: set(s) for cid, s in self.evicted.items()
+            }
+        pruned = dict(prev_models)
+        plans: dict = {}
+        evicted_next: dict = {}
+        for cid, re_type in self.re_types.items():
+            model = prev_models.get(cid)
+            plan = plan_eviction(
+                model if isinstance(model, RandomEffectModel) else None,
+                self.last_active.get(cid, {}),
+                delta_entities.get(re_type, set()),
+                self.evicted.get(cid, set()),
+                generation,
+                self.config.evict_idle_generations,
+            )
+            plans[cid] = plan
+            evicted_next[cid] = (
+                set(self.evicted.get(cid, set())) - set(plan.readmit)
+            ) | set(plan.evict)
+            if plan.evict and isinstance(model, RandomEffectModel):
+                # park the coefficients BEFORE dropping the rows; the write is
+                # staged+renamed and idempotent (a crash-replayed pass rewrites
+                # identical bytes), so it may land ahead of the commit
+                payload = archived_rows_for(model, plan.evict)
+                self.store.archive_write(
+                    cid,
+                    payload["entity_ids"],
+                    payload["coeffs"],
+                    payload["proj"],
+                    payload["variances"],
+                    evicted_at=generation,
+                )
+                pruned[cid] = drop_entities(model, plan.evict)
+        return pruned, plans, evicted_next
+
+    def _updated_last_active(self, datasets: dict, delta_entities: dict,
+                             generation: int) -> dict:
+        """Next generation's last-data bookkeeping: entities with delta rows
+        stamp ``generation``; entities seen for the first time (bootstrap or
+        re-admitted) stamp too; everyone else keeps their stamp."""
+        out = {}
+        for cid, re_type in self.re_types.items():
+            la = dict(self.last_active.get(cid, {}))
+            fresh = delta_entities.get(re_type, set())
+            for e in fresh:
+                la[e] = generation
+            for e in datasets[cid].entity_ids:
+                la.setdefault(e, generation)
+            out[cid] = la
+        return out
+
+    def _eviction_aux_arrays(self, last_active: dict, evicted: dict) -> dict:
+        aux: dict = {}
+        if not self.config.evict_idle_generations:
+            return aux
+        for cid in self.re_types:
+            la = last_active.get(cid, {})
+            ids = list(la)
+            aux[f"{_AUX_LAST_ACTIVE_PREFIX}{cid}"] = {
+                "ids": id_array(ids),
+                "gens": np.asarray([la[e] for e in ids], dtype=np.int64),
+            }
+            aux[f"{_AUX_EVICTED_PREFIX}{cid}"] = {
+                "ids": id_array(sorted(evicted.get(cid, set()))),
+            }
+        return aux
+
+    def _train_data(self, view: CorpusSnapshot, generation: int):
+        """The pass's training GameInput: the view verbatim, or the view with
+        time-decayed weights (``decay`` mode — one device program per view
+        shape, generation as a traced scalar, bit-identical on replay)."""
+        if self.config.window_mode != "decay":
+            return view.data
+        if view.row_gens is None:
+            raise ValueError("decay weighting needs row_gens on the view")
+        return dataclasses.replace(
+            view.data,
+            weights=decay_weights(
+                view.data.weights,
+                view.row_gens,
+                generation,
+                self.config.decay_half_life,
+            ),
+        )
+
     def poll_once(self) -> Optional[GenerationResult]:
         """One turn of the loop: scan, and if the corpus grew, run a delta
         pass (or the bootstrap full train) and commit the next generation.
@@ -388,6 +636,7 @@ class ContinuousTrainer:
         if not new_files:
             return None
         bootstrap = self.models is None
+        gen_next = self.generation + 1
 
         t0 = time.perf_counter()
         # record each new file's size/fingerprint BEFORE decoding it and
@@ -395,29 +644,42 @@ class ContinuousTrainer:
         # still appending to into a loud CorpusContractViolation instead of
         # a manifest record that disagrees with the rows the model absorbed
         grown_manifest = self.manifest.extend(new_files)
-        self_snapshot, delta = ingest_delta(
-            self.snapshot,
-            new_files,
-            self.config.shard_configurations,
-            self.id_tags,
-            self.config.ingest_workers,
-        )
-        grown_manifest.verify_sizes(grown_manifest.entries[len(self.manifest):])
-        timings["ingest"] = time.perf_counter() - t0
-
-        t0 = time.perf_counter()
-        snapshot_prev = self.snapshot
-        self.snapshot = self_snapshot  # datasets/export helpers read it
+        prev_maps = None if self.snapshot is None else self.snapshot.index_maps
+        if not bootstrap:
+            # advance the sliding window BEFORE the append: rows aged out of
+            # the pass's view drop as one contiguous head slice
+            self.store.trim_view(self._window_min_gen(gen_next))
+        view, delta = self.store.stage_delta(new_files, gen_next)
         try:
+            # from here on the delta is STAGED: every exit path that is not
+            # the commit must run abort_delta (the except below), or the next
+            # poll would refuse with a pending stage — including a torn-write
+            # CorpusContractViolation from this verify
+            grown_manifest.verify_sizes(
+                grown_manifest.entries[len(self.manifest.entries):]
+            )
+            timings["ingest"] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            prev_models = dict(self.models or {})
+            prev_models, eviction_plans, evicted_next = self._plan_evictions(
+                prev_models, delta.delta_entities, gen_next
+            )
             entity_orders = None
-            if self.models is not None:
+            if not bootstrap:
                 entity_orders = {
-                    cid: self.models[cid].entity_ids
+                    cid: prev_models[cid].entity_ids
                     for cid in self.re_types
-                    if isinstance(self.models.get(cid), RandomEffectModel)
+                    if isinstance(prev_models.get(cid), RandomEffectModel)
                 }
             datasets = self.estimator.prepare_training_datasets(
-                self.snapshot.data, entity_orders=entity_orders
+                self._train_data(view, gen_next),
+                entity_orders=entity_orders,
+                exclude_entities={
+                    cid: evicted_next[cid]
+                    for cid in self.re_types
+                    if evicted_next.get(cid)
+                },
             )
             if self.config.mesh is not None:
                 from photon_ml_tpu.parallel.placement import place_game_datasets
@@ -430,10 +692,37 @@ class ContinuousTrainer:
             active_stats: dict = {}
             initial_models = None
             if not bootstrap:
-                adapted = self._adapted_models(datasets)
+                adapted = self._adapted_models(datasets, prev_models)
+                # re-admission: a previously evicted entity reappearing in the
+                # delta warm-starts from its archived coefficients instead of
+                # the zero row aligned_to gave the "new" entity
+                readmitted: dict = {}
+                for cid, plan in eviction_plans.items():
+                    back = [
+                        e
+                        for e in plan.readmit
+                        if isinstance(adapted.get(cid), RandomEffectModel)
+                        and adapted[cid].row_for_entity(e) >= 0
+                    ]
+                    if back:
+                        adapted[cid], n = inject_archived_rows(
+                            adapted[cid], self.store.archive_load(cid), back
+                        )
+                        readmitted[cid] = n
+                    # a reappearing entity that got NO model row (its delta
+                    # rows fell below active_data_lower_bound) stays evicted:
+                    # dropping it from the set here would orphan its archived
+                    # coefficients — the next reappearance would zero-init
+                    not_back = set(plan.readmit) - set(back)
+                    if not_back:
+                        evicted_next[cid] = evicted_next[cid] | not_back
                 active_sets, active_stats = self._select_active_sets(
-                    datasets, adapted, delta.delta_entities
+                    datasets, adapted, delta.delta_entities, prev_models
                 )
+                for cid, plan in eviction_plans.items():
+                    if cid in active_stats:
+                        active_stats[cid]["n_evicted"] = len(plan.evict)
+                        active_stats[cid]["n_readmitted"] = readmitted.get(cid, 0)
                 initial_models = adapted
             else:
                 for cid, re_type in self.re_types.items():
@@ -487,27 +776,86 @@ class ContinuousTrainer:
             timings["descent"] = time.perf_counter() - t0
 
             t0 = time.perf_counter()
+            final_models = dict(descent.model.models)
+            if self.config.window_mode != "full" and not bootstrap:
+                # entities whose rows all aged out of the window carry their
+                # previous-generation coefficients verbatim (frozen, still
+                # served); only EVICTION removes an entity from the tables
+                for cid in self.re_types:
+                    prev = prev_models.get(cid)
+                    cur = final_models.get(cid)
+                    if isinstance(prev, RandomEffectModel) and isinstance(
+                        cur, RandomEffectModel
+                    ):
+                        merged = merge_carried_entities(
+                            prev, cur, evicted_next.get(cid, set())
+                        )
+                        if merged is not cur and cid in active_stats:
+                            active_stats[cid]["n_carried"] = len(
+                                merged.entity_ids
+                            ) - len(cur.entity_ids)
+                        final_models[cid] = merged
+
+            # compaction: fold (previous cold generation + every live
+            # segment) into cold-<gen> BEFORE the commit that references it —
+            # the staged+renamed cold dir is unreferenced garbage until this
+            # pass's checkpoint lands atomically
+            do_compact = bool(
+                self.config.compact_every
+                and gen_next % self.config.compact_every == 0
+            )
+            cold_meta = None
+            manifest_to_commit = grown_manifest
+            if do_compact:
+                faultpoint(FP_COMPACT)
+                cold_meta = self.store.write_cold_generation(
+                    gen_next, view.index_maps, grown_manifest
+                )
+                manifest_to_commit = grown_manifest.compact(
+                    n_rows=cold_meta["n_rows"]
+                )
+                store_state = self.store.to_state(
+                    compacted_as=(gen_next, cold_meta["n_rows"])
+                )
+            else:
+                store_state = self.store.to_state()
+            timings["compact"] = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            last_active_next = self._updated_last_active(
+                datasets, delta.delta_entities, gen_next
+            )
             faultpoint(FP_COMMIT)
             extra_state = {
                 "continuous": {
                     "kind": "bootstrap" if bootstrap else "delta",
-                    "corpus_manifest": grown_manifest.to_dict(),
-                    "n_rows": self.snapshot.n_rows,
+                    "corpus_manifest": manifest_to_commit.to_dict(),
+                    "n_rows": self.store.total_rows,
+                    "view_rows": view.n_rows,
                     "n_new_rows": delta.n_new_rows,
                     "n_new_files": delta.n_new_files,
                     "active": active_stats,
+                    "store": store_state,
+                    "window": {
+                        "mode": self.config.window_mode,
+                        "generations": self.config.window_generations,
+                        "decay_half_life": self.config.decay_half_life,
+                    },
                 }
             }
             aux_arrays = {
                 f"{_AUX_INDEX_MAP_PREFIX}{shard}": {
                     "names": np.asarray(imap.keys())
                 }
-                for shard, imap in self.snapshot.index_maps.items()
+                for shard, imap in view.index_maps.items()
             }
+            aux_arrays.update(
+                self._eviction_aux_arrays(last_active_next, evicted_next)
+            )
             path = save_checkpoint(
                 self.config.checkpoint_directory,
-                dict(descent.model.models),
-                completed_iterations=self.generation + 1,
+                final_models,
+                completed_iterations=gen_next,
                 fingerprint=self._fingerprint(),
                 incidents=descent.incidents,
                 keep_generations=self.config.keep_generations,
@@ -519,14 +867,20 @@ class ContinuousTrainer:
             # in-memory state so a caller that survives (tests, control
             # loops catching InjectedFault) can retry the poll cleanly —
             # the retried poll re-scans the same delta and replays the pass
-            # bit-identically against the previous generation's snapshot
-            self.snapshot = snapshot_prev
+            # bit-identically against the previous generation's tiers (the
+            # staged view was released eagerly, so the rollback re-reads it)
+            self.store.abort_delta(prev_maps or view.index_maps, self.manifest)
             raise
 
         gen_num = int(os.path.basename(path).split("-")[-1])
-        self.manifest = grown_manifest
-        self.models = dict(descent.model.models)
+        self.manifest = manifest_to_commit
+        self.models = final_models
         self.generation = gen_num
+        self.last_active = last_active_next
+        self.evicted = evicted_next
+        self.store.commit_delta()
+        if cold_meta is not None:
+            self.store.install_cold(cold_meta)
         timings["commit"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -536,21 +890,25 @@ class ContinuousTrainer:
         result = GenerationResult(
             generation=gen_num,
             kind="bootstrap" if bootstrap else "delta",
-            n_rows=self.snapshot.n_rows,
+            n_rows=self.store.total_rows,
             n_new_rows=delta.n_new_rows,
             checkpoint_path=path,
             active=active_stats,
             incidents=[i.to_dict() for i in descent.incidents],
             timings=timings,
+            view_rows=view.n_rows,
+            compacted=do_compact,
         )
         self.last_result = result
         logger.info(
-            "committed generation %d (%s): %d rows (+%d), active fraction "
-            "%.3f, %.2fs descent",
+            "committed generation %d (%s): %d rows (+%d, %d in view%s), "
+            "active fraction %.3f, %.2fs descent",
             gen_num,
             result.kind,
             result.n_rows,
             result.n_new_rows,
+            result.view_rows,
+            ", compacted" if do_compact else "",
             result.active_fraction,
             timings["descent"],
         )

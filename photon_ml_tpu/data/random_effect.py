@@ -240,6 +240,7 @@ def build_random_effect_dataset(
     scoring_only: bool = False,
     projector: Optional[object] = None,
     entity_order: Optional[Sequence] = None,
+    exclude_entities: Optional[set] = None,
 ) -> RandomEffectDataset:
     """Host-side construction of the bucketed random-effect dataset.
 
@@ -269,6 +270,11 @@ def build_random_effect_dataset(
       order — so a previous generation's coefficient table aligns with the
       grown dataset by construction. Default (None) keeps the historical
       fully sorted order.
+    - ``exclude_entities``: entity-row SHRINK for continuous training's
+      eviction (continuous/compaction.py): listed entities get no training
+      bucket and no model row — their samples' scoring-view entity row is -1,
+      i.e. they score exactly like entities that never had a model (the
+      serving engine's missing-entity contract, now on the training side too).
     """
     if projector is not None:
         if normalization is not None and projector.normalization is None:
@@ -328,6 +334,8 @@ def build_random_effect_dataset(
 
     # lower-bound filter: entities below the threshold train no model
     entities = [e for e, rows in active_rows.items() if len(rows) >= active_data_lower_bound]
+    if exclude_entities:
+        entities = [e for e in entities if e not in exclude_entities]
     if entity_order is not None:
         # stable growth: known entities keep the caller's row order, unseen
         # ones append sorted at the tail (continuous-training alignment)

@@ -229,7 +229,10 @@ class GameEstimator:
         return self.normalization_contexts.get(shard, NO_NORMALIZATION)
 
     def prepare_training_datasets(
-        self, data: GameInput, entity_orders: Optional[Mapping] = None
+        self,
+        data: GameInput,
+        entity_orders: Optional[Mapping] = None,
+        exclude_entities: Optional[Mapping] = None,
     ) -> dict[str, object]:
         """GameInput -> per-coordinate device datasets
         (GameEstimator.prepareTrainingDatasets:454-557). Built once per fit.
@@ -238,7 +241,13 @@ class GameEstimator:
         pins random-effect entity ROW order across incremental rebuilds:
         known entities keep their previous rows, new ones append at the tail
         — the stable-growth contract of continuous training
-        (data/random_effect.build_random_effect_dataset)."""
+        (data/random_effect.build_random_effect_dataset).
+
+        ``exclude_entities`` ({coordinate_id: set of entity ids}) drops the
+        listed entities' training buckets and model rows entirely — the
+        entity-eviction surface of continuous training: an evicted entity's
+        samples score 0 from that coordinate, exactly the missing-entity
+        contract."""
         if not data.has_labels:
             raise ValueError("Training data must carry labels")
         datasets: dict[str, object] = {}
@@ -285,6 +294,9 @@ class GameEstimator:
                     projector=projector,
                     entity_order=(
                         None if entity_orders is None else entity_orders.get(cid)
+                    ),
+                    exclude_entities=(
+                        None if exclude_entities is None else exclude_entities.get(cid)
                     ),
                 )
             else:

@@ -350,6 +350,119 @@ def load_generation(gen_dir: str, dtype=jnp.float32) -> dict:
     return _verify_and_load_generation(os.path.abspath(gen_dir), dtype)
 
 
+# ----------------------------------------------------- durable blacklist
+# The serving fleet's canary verdict, made durable IN the generational store:
+# when a generation fails deterministically (corrupt bytes, canary mismatch,
+# warm-up crash), the rejecting process records a per-generation blacklist
+# file under <root>/blacklist/. Every ReplicaSet / HotSwapManager reads the
+# directory at bootstrap (and before each poll), so INDEPENDENT serving
+# processes agree on rejected generations with no channel between them — one
+# replica's canary spares the whole fleet, across restarts. Files are
+# staged + atomically renamed with a SHA-256 sidecar (the store's integrity
+# discipline); a damaged entry is ignored (the worst case is one redundant
+# canary evaluation, never a wrong verdict adopted from bit-rot). Writes are
+# best-effort: a read-only store degrades to in-memory blacklisting.
+
+BLACKLIST_DIR = "blacklist"
+
+
+def _blacklist_digest(generation: int, cause: str) -> str:
+    return hashlib.sha256(f"{int(generation)}\x00{cause}".encode()).hexdigest()
+
+
+def record_generation_blacklist(
+    directory: str, generation: int, cause: str
+) -> Optional[str]:
+    """Durably record that ``generation`` under checkpoint root ``directory``
+    was rejected deterministically. Returns the file path, or None when the
+    store is unwritable (logged, never raised — a full disk must not take
+    down serving).
+
+    The integrity digest rides INSIDE the JSON, so one ``os.replace`` is the
+    whole commit — a content/sidecar pair would have a torn window between
+    its two renames that silently drops the verdict (the archive learned the
+    same lesson in continuous/store.py)."""
+    root = os.path.join(os.path.abspath(directory), BLACKLIST_DIR)
+    final = os.path.join(root, f"{GEN_PREFIX}{int(generation):08d}.json")
+    tmp = f"{final}{_TMP_SUFFIX}-{os.getpid()}"
+    try:
+        os.makedirs(root, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "generation": int(generation),
+                    "cause": str(cause),
+                    "sha256": _blacklist_digest(generation, str(cause)),
+                },
+                f,
+            )
+        os.replace(tmp, final)
+        return final
+    except OSError as e:
+        logger.warning(
+            "could not record blacklist verdict for generation %d under %s "
+            "(%s); the verdict stays process-local", generation, directory, e,
+        )
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _prune_blacklist(root: str) -> None:
+    """Drop verdicts for generations older than the oldest RETAINED one:
+    pruned generations can never become swap candidates again, so their
+    verdict files would otherwise accumulate (and cost every poll's
+    directory re-read) for the life of the store."""
+    gens = _generations(root)
+    if not gens:
+        return
+    oldest = gens[0][0]
+    bl_root = os.path.join(root, BLACKLIST_DIR)
+    if not os.path.isdir(bl_root):
+        return
+    for name in os.listdir(bl_root):
+        m = re.match(rf"^{GEN_PREFIX}(\d{{8}})\.json$", name)
+        if m and int(m.group(1)) < oldest:
+            try:
+                os.remove(os.path.join(bl_root, name))
+            except OSError:
+                pass
+
+
+def load_generation_blacklist(directory: str) -> dict[int, str]:
+    """{generation: cause} for every VERIFIED blacklist entry under the
+    checkpoint root. Damaged or torn entries are skipped with a warning
+    (treated as absent); a missing directory is an empty verdict set."""
+    root = os.path.join(os.path.abspath(directory), BLACKLIST_DIR)
+    out: dict[int, str] = {}
+    if not os.path.isdir(root):
+        return out
+    for name in sorted(os.listdir(root)):
+        m = re.match(rf"^{GEN_PREFIX}(\d{{8}})\.json$", name)
+        if not m:
+            continue
+        path = os.path.join(root, name)
+        try:
+            with open(path) as f:
+                record = json.load(f)
+            gen = int(record["generation"])
+            cause = str(record.get("cause", ""))
+            if record.get("sha256") != _blacklist_digest(gen, cause):
+                raise ValueError("checksum mismatch")
+            if gen != int(m.group(1)):
+                raise ValueError(
+                    f"generation {gen} does not match file name {name}"
+                )
+            out[gen] = cause
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning(
+                "ignoring damaged blacklist entry %s (%s)", path, e
+            )
+    return out
+
+
 # ------------------------------------------------------------------ save / load
 
 
@@ -460,6 +573,7 @@ def save_checkpoint(
 
         for _, old_path in _generations(root)[:-keep_generations]:
             shutil.rmtree(old_path, ignore_errors=True)
+        _prune_blacklist(root)
         return final
 
     return (retry or _DEFAULT_RETRY).call(_attempt, description="checkpoint save")

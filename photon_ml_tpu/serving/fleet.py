@@ -76,7 +76,13 @@ import numpy as np
 import jax.numpy as jnp
 
 from photon_ml_tpu.data.pipeline import BackgroundTask
-from photon_ml_tpu.io.checkpoint import list_generations, load_generation
+from photon_ml_tpu.io.checkpoint import (
+    CheckpointCorruption,
+    list_generations,
+    load_generation,
+    load_generation_blacklist,
+    record_generation_blacklist,
+)
 from photon_ml_tpu.resilience import (
     Incident,
     Retry,
@@ -207,6 +213,7 @@ class ReplicaSet:
         canary_timeout: float = 60.0,
         mirror_size: int = 16,
         incident_log_size: int = 256,
+        durable_blacklist: bool = True,
     ):
         if not replicas:
             raise ValueError("a ReplicaSet needs at least one replica")
@@ -218,7 +225,13 @@ class ReplicaSet:
         self.retry = retry or _DEFAULT_RETRY
         self.warmup_timeout = warmup_timeout
         self.canary_timeout = canary_timeout
+        self.durable_blacklist = durable_blacklist
+        # canary verdicts are durable IN the generational store (per-gen
+        # checksummed blacklist files): independent fleets/replicas booted on
+        # the same store skip a rejected generation WITHOUT their own canary
         self.bad_generations: set[int] = set()
+        if durable_blacklist:
+            self.bad_generations.update(load_generation_blacklist(checkpoint_root))
         self.rollouts_completed = 0
         self.rollbacks = 0
         self._swap_lock = threading.Lock()  # one rollout in flight at a time
@@ -252,7 +265,13 @@ class ReplicaSet:
         ONE set of device tables."""
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
-        found = newest_valid_generation(checkpoint_root, dtype=dtype)
+        found = newest_valid_generation(
+            checkpoint_root,
+            dtype=dtype,
+            # an explicit opt-out of shared verdicts covers bootstrap too
+            # (e.g. deliberately serving a generation someone blacklisted)
+            respect_blacklist=kwargs.get("durable_blacklist", True),
+        )
         if found is None:
             raise FileNotFoundError(
                 f"no valid checkpoint generation under {checkpoint_root!r}"
@@ -367,6 +386,11 @@ class ReplicaSet:
         contract of :meth:`HotSwapManager.check_once`, fleet-wide."""
         with self._swap_lock:
             fleet_gen = min(r.generation for r in self.replicas)
+            if self.durable_blacklist:
+                # adopt verdicts other processes recorded since the last poll
+                self.bad_generations.update(
+                    load_generation_blacklist(self.checkpoint_root)
+                )
             candidates = [
                 (g, p)
                 for g, p in list_generations(self.checkpoint_root)
@@ -405,6 +429,19 @@ class ReplicaSet:
                 blacklist = not transient and not progress["rolling"]
                 if blacklist:
                     self.bad_generations.add(gen_num)
+                    # DURABLE verdicts are reserved for failures that are a
+                    # pure function of the committed bytes — canary mismatch
+                    # and integrity corruption. A process-local accident
+                    # (device OOM during warm-up, an unexpected runtime
+                    # error) stays in-memory: it must not poison the shared
+                    # store for healthy fleets and future restarts.
+                    if self.durable_blacklist and isinstance(
+                        e, (CanaryMismatch, CheckpointCorruption)
+                    ):
+                        record_generation_blacklist(
+                            self.checkpoint_root, gen_num,
+                            f"{type(e).__name__}: {e}",
+                        )
                 kind = (
                     "canary-reject" if isinstance(e, CanaryMismatch) else "fleet-rollback"
                 )

@@ -48,6 +48,8 @@ from photon_ml_tpu.io.checkpoint import (
     CheckpointCorruption,
     list_generations,
     load_generation,
+    load_generation_blacklist,
+    record_generation_blacklist,
 )
 from photon_ml_tpu.models.game import GameModel
 from photon_ml_tpu.resilience import (
@@ -70,11 +72,26 @@ FP_SWAP_FLIP = register_fault_point("serve.swap.flip")
 _DEFAULT_RETRY = Retry(max_attempts=3, base_delay=0.05, max_delay=1.0, max_elapsed=30.0)
 
 
-def newest_valid_generation(root: str, dtype=jnp.float32) -> Optional[tuple[int, dict]]:
+def newest_valid_generation(
+    root: str, dtype=jnp.float32, respect_blacklist: bool = True
+) -> Optional[tuple[int, dict]]:
     """Read-side bootstrap: (generation number, verified state) for the newest
     generation that passes integrity, scanning backwards and SKIPPING (never
-    quarantining) damaged ones. None when nothing verifies."""
+    quarantining) damaged ones. None when nothing verifies.
+
+    ``respect_blacklist`` (default) also skips generations with a durable
+    blacklist verdict in the store: a NaN-poisoned commit passes every
+    checksum, so without this a freshly booted replica would happily serve
+    the generation another fleet's canary already rejected."""
+    skip = (
+        set(load_generation_blacklist(root)) if respect_blacklist else set()
+    )
     for gen_num, gen_dir in reversed(list_generations(root)):
+        if gen_num in skip:
+            logger.warning(
+                "generation %d is blacklisted in the store; skipping", gen_num
+            )
+            continue
         try:
             return gen_num, load_generation(gen_dir, dtype=dtype)
         except CheckpointCorruption as e:
@@ -103,6 +120,12 @@ class HotSwapManager:
     LATER good generation is still picked up); transient-I/O retry exhaustion
     rolls back without blacklisting — the generation stays eligible for the
     next poll.
+
+    Deterministic verdicts are DURABLE (``durable_blacklist``, default on):
+    they land as checksummed per-generation files in the checkpoint store
+    (io/checkpoint.record_generation_blacklist), read back at bootstrap and
+    before every poll — independent serving processes agree on rejected
+    generations without a channel, across restarts.
     """
 
     def __init__(
@@ -113,6 +136,7 @@ class HotSwapManager:
         prefer_best: bool = True,
         retry: Optional[Retry] = None,
         warmup_timeout: float = 300.0,
+        durable_blacklist: bool = True,
     ):
         self.frontend = frontend
         self.checkpoint_root = checkpoint_root
@@ -120,7 +144,10 @@ class HotSwapManager:
         self.prefer_best = prefer_best
         self.retry = retry or _DEFAULT_RETRY
         self.warmup_timeout = warmup_timeout
+        self.durable_blacklist = durable_blacklist
         self.bad_generations: set[int] = set()
+        if durable_blacklist:
+            self.bad_generations.update(load_generation_blacklist(checkpoint_root))
         self.swaps_completed = 0
         self.rollbacks = 0
         self._swap_lock = threading.Lock()  # one swap in flight at a time
@@ -132,6 +159,11 @@ class HotSwapManager:
         a blacklist entry. (KeyboardInterrupt/SystemExit still propagate.)"""
         with self._swap_lock:
             current = self.frontend.generation
+            if self.durable_blacklist:
+                # adopt verdicts OTHER processes recorded since the last poll
+                self.bad_generations.update(
+                    load_generation_blacklist(self.checkpoint_root)
+                )
             candidates = [
                 (g, p)
                 for g, p in list_generations(self.checkpoint_root)
@@ -167,6 +199,20 @@ class HotSwapManager:
                 transient = isinstance(e, (RetryExhausted, OSError))
                 if not transient:
                     self.bad_generations.add(gen_num)
+                    # DURABLE verdicts are reserved for failures that are a
+                    # pure function of the committed bytes (corruption): a
+                    # process-local accident (device OOM mid-warm-up, an
+                    # unexpected runtime error) must not poison the shared
+                    # store for every other process and every restart — the
+                    # in-memory blacklist above already stops this process's
+                    # retry storm, and a restart retries the generation
+                    if self.durable_blacklist and isinstance(
+                        e, CheckpointCorruption
+                    ):
+                        record_generation_blacklist(
+                            self.checkpoint_root, gen_num,
+                            f"{type(e).__name__}: {e}",
+                        )
                 self.frontend.record_incident(
                     kind="hotswap-rollback",
                     cause=f"{type(e).__name__}: {e}",
@@ -281,12 +327,16 @@ def serve_from_checkpoint(
     prefer_best: bool = True,
     retry: Optional[Retry] = None,
     clock: Callable[[], float] = time.monotonic,
+    durable_blacklist: bool = True,
 ) -> tuple[ServingFrontend, HotSwapManager]:
     """Bootstrap a frontend from the newest valid generation of a training
     run's checkpoint directory. Returns (frontend, manager); run the manager's
     ``check_once`` (or a :class:`GenerationWatcher`) to pick up later
-    generations."""
-    found = newest_valid_generation(checkpoint_root, dtype=dtype)
+    generations. ``durable_blacklist=False`` opts out of the store's shared
+    verdicts for BOTH the bootstrap pick and the manager's polls."""
+    found = newest_valid_generation(
+        checkpoint_root, dtype=dtype, respect_blacklist=durable_blacklist
+    )
     if found is None:
         raise FileNotFoundError(
             f"no valid checkpoint generation under {checkpoint_root!r}"
@@ -295,6 +345,7 @@ def serve_from_checkpoint(
     engine = get_engine(model_from_state(state, prefer_best=prefer_best))
     frontend = ServingFrontend(engine, config=config, generation=gen_num, clock=clock)
     manager = HotSwapManager(
-        frontend, checkpoint_root, dtype=dtype, prefer_best=prefer_best, retry=retry
+        frontend, checkpoint_root, dtype=dtype, prefer_best=prefer_best,
+        retry=retry, durable_blacklist=durable_blacklist,
     )
     return frontend, manager

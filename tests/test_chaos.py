@@ -79,6 +79,11 @@ def test_registry_covers_every_chaos_sweep():
         "continuous.delta_ingest",
         "continuous.active_select",
         "continuous.commit",
+        # the out-of-core store (PR 14): swept by tests/test_continuous.py's
+        # compaction scenario (eviction + cold-tier fold on the crashed pass)
+        "continuous.compact",
+        "continuous.evict",
+        "continuous.cold_write",
     } == set(CONTINUOUS_POINTS)
     assert {p.split(".", 1)[0] for p in SERVE_POINTS} == {"serve"}
     assert {

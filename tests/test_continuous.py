@@ -110,7 +110,7 @@ def shard_configs():
 
 
 def make_trainer(corpus, ckpt, export_dir=None, gradient_threshold=None,
-                 fe_reservoir=None, iterations=1, mesh=None):
+                 fe_reservoir=None, iterations=1, mesh=None, **kwargs):
     coords = dict(
         parse_coordinate_configuration(c) for c in (FE_COORD, RE_COORD)
     )
@@ -127,6 +127,7 @@ def make_trainer(corpus, ckpt, export_dir=None, gradient_threshold=None,
             fe_reservoir=fe_reservoir,
             export_directory=None if export_dir is None else str(export_dir),
             mesh=mesh,
+            **kwargs,
         )
     )
 
@@ -766,11 +767,18 @@ CONTINUOUS_POINTS = (
     "continuous.active_select",
     "continuous.commit",
 )
+# the out-of-core store's points only fire on a compaction/eviction-enabled
+# pass: they get their own sweep over a scenario that exercises all of them
+STORE_POINTS = (
+    "continuous.compact",
+    "continuous.evict",
+    "continuous.cold_write",
+)
 
 
 def test_registry_covers_the_continuous_points():
     # importing photon_ml_tpu.continuous (top of this file) registers them
-    assert set(CONTINUOUS_POINTS) <= set(registered_fault_points())
+    assert set(CONTINUOUS_POINTS + STORE_POINTS) <= set(registered_fault_points())
 
 
 @pytest.fixture(scope="module")
@@ -826,3 +834,790 @@ class TestContinuousChaos:
         assert_trees_identical(
             str(chaos_scenario.ref_export), str(tmp_path / "export")
         )
+
+
+# ==========================================================================
+# Out-of-core corpus store: manifest compaction, cold tier, sliding window,
+# entity eviction (continuous/store.py, compaction.py)
+# ==========================================================================
+
+
+class TestManifestCompaction:
+    def test_compact_folds_entries_and_scan_still_diffs(self, tmp_path):
+        a, b = str(tmp_path / "part-a.avro"), str(tmp_path / "part-b.avro")
+        _touch(a, b"aaaa")
+        _touch(b, b"bbbbbb")
+        m = CorpusManifest().extend([a, b])
+        folded = m.compact(n_rows=100)
+        assert folded.entries == ()
+        assert len(folded) == 2  # total files ever, across the fold
+        assert folded.paths == (a, b)
+        assert folded.live_paths == ()
+        assert folded.compacted.n_rows == 100
+        # already-ingested files stay known to the scan
+        assert folded.scan([str(tmp_path)]) == []
+        c = str(tmp_path / "part-c.avro")
+        _touch(c, b"cc")
+        assert folded.scan([str(tmp_path)]) == [c]
+        # extend CARRIES the fold (the regression that double-ingested
+        # compacted files after the next delta)
+        grown = folded.extend([c])
+        assert grown.compacted == folded.compacted
+        assert grown.scan([str(tmp_path)]) == []
+        assert len(grown) == 3
+
+    def test_compacted_file_may_vanish_but_not_change_size(self, tmp_path):
+        a = str(tmp_path / "part-a.avro")
+        _touch(a, b"payload")
+        folded = CorpusManifest().extend([a]).compact(n_rows=10)
+        os.remove(a)  # the upstream archived it: the cold tier owns the rows
+        assert folded.scan([str(tmp_path)]) == []
+        folded.verify_fingerprints()  # compacted files are never re-read
+        # but a REUSED path with different content must still fail loudly
+        _touch(a, b"a-brand-new-file!")
+        with pytest.raises(CorpusContractViolation, match="append-only"):
+            folded.scan([str(tmp_path)])
+
+    def test_rollup_digest_chains_across_folds(self, tmp_path):
+        a, b = str(tmp_path / "a.avro"), str(tmp_path / "b.avro")
+        _touch(a, b"aaaa")
+        _touch(b, b"bb")
+        once = CorpusManifest().extend([a]).compact(n_rows=1)
+        twice = once.extend([b]).compact(n_rows=2)
+        assert twice.compacted.n_files == 2
+        assert twice.compacted.rollup_sha256 != once.compacted.rollup_sha256
+        # pure function of the ingest history: same folds, same digest
+        again = CorpusManifest().extend([a]).compact(1).extend([b]).compact(2)
+        assert again.compacted.rollup_sha256 == twice.compacted.rollup_sha256
+
+    def test_round_trip_with_compacted_history(self, tmp_path):
+        a = str(tmp_path / "a.avro")
+        _touch(a, b"aaaa")
+        m = CorpusManifest().extend([a]).compact(n_rows=7)
+        again = CorpusManifest.from_dict(m.to_dict())
+        assert again == m
+
+
+def _trees_identical(a, b):
+    import filecmp
+
+    files_a = sorted(
+        os.path.relpath(os.path.join(r, f), a)
+        for r, _, fs in os.walk(a) for f in fs
+    )
+    files_b = sorted(
+        os.path.relpath(os.path.join(r, f), b)
+        for r, _, fs in os.walk(b) for f in fs
+    )
+    assert files_a == files_b
+    for rel in files_a:
+        assert filecmp.cmp(os.path.join(a, rel), os.path.join(b, rel),
+                           shallow=False), rel
+
+
+class TestCorpusStoreTiers:
+    def test_compacted_corpus_reproduces_the_accumulated_corpus_bitwise(
+        self, tmp_path
+    ):
+        """The restart contract through the cold tier: after a compaction,
+        materializing from (cold blocks + live files) is bitwise the corpus
+        a plain re-read of every original part file produces."""
+        rng = np.random.default_rng(31)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 150, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", compact_every=2,
+                         cold_block_rows=64)
+        t.poll_once()
+        write_part(corpus / "part-00001.avro", rng, 40, ["u0", "a-new"])
+        r = t.poll_once()
+        assert r.compacted and len(t.manifest.entries) == 0
+        write_part(corpus / "part-00002.avro", rng, 30, ["u1"])
+        t.poll_once()  # gen 3: one live segment on top of the cold tier
+
+        # fresh trainer: cold blocks + one live re-decode, no full re-read
+        t2 = make_trainer(corpus, tmp_path / "ckpt", compact_every=2,
+                          cold_block_rows=64)
+        view, ref = t2.snapshot, t.snapshot
+        np.testing.assert_array_equal(
+            np.asarray(view.data.labels), np.asarray(ref.data.labels)
+        )
+        np.testing.assert_array_equal(view.uids, ref.uids)
+        np.testing.assert_array_equal(view.row_gens, ref.row_gens)
+        np.testing.assert_array_equal(
+            view.data.ids("userId"), ref.data.ids("userId")
+        )
+        for x, y in zip(_csr_state(view.data.shard("shardA")),
+                        _csr_state(ref.data.shard("shardA"))):
+            np.testing.assert_array_equal(x, y)
+        # and equally bitwise vs a cold-free re-read of EVERY original file
+        data, _, uids = read_merged_avro(
+            list(t.manifest.paths), shard_configs(),
+            index_maps=dict(ref.index_maps), id_tags=("userId",),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(data.labels), np.asarray(ref.data.labels)
+        )
+        for x, y in zip(_csr_state(data.shard("shardA")),
+                        _csr_state(ref.data.shard("shardA"))):
+            np.testing.assert_array_equal(x, y)
+        np.testing.assert_array_equal(
+            np.asarray(uids, dtype=object), ref.uids
+        )
+
+    def test_restart_survives_archived_away_part_files(self, tmp_path):
+        """Once compacted, the original part files may be deleted upstream:
+        restart reads the cold tier instead, and the next delta still
+        commits (the out-of-core story: disk tier owns the history)."""
+        rng = np.random.default_rng(33)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 120, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", compact_every=2)
+        t.poll_once()
+        write_part(corpus / "part-00001.avro", rng, 40, ["u0"])
+        r = t.poll_once()
+        assert r.compacted
+        before = np.asarray(t.models["per-user"].coeffs).copy()
+        os.remove(corpus / "part-00000.avro")
+        os.remove(corpus / "part-00001.avro")
+
+        t2 = make_trainer(corpus, tmp_path / "ckpt", compact_every=2)
+        assert t2.generation == 2
+        assert t2.snapshot.n_rows == 160
+        np.testing.assert_array_equal(
+            np.asarray(t2.models["per-user"].coeffs), before
+        )
+        write_part(corpus / "part-00002.avro", rng, 30, ["u1"])
+        r3 = t2.poll_once()
+        assert r3 is not None and r3.generation == 3 and r3.n_rows == 190
+
+    def test_corrupt_cold_block_fails_restart_loudly(self, tmp_path):
+        from photon_ml_tpu.continuous import ColdStoreCorruption
+        from photon_ml_tpu.resilience import corrupt_file
+
+        rng = np.random.default_rng(35)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 100, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
+                         cold_block_rows=32)
+        t.poll_once()
+        cold = tmp_path / "ckpt" / "corpus-store" / "cold-00000001"
+        victim = sorted(f for f in os.listdir(cold) if f.startswith("block-"))[0]
+        corrupt_file(str(cold / victim))
+        with pytest.raises(ColdStoreCorruption, match="checksum mismatch"):
+            make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
+                         cold_block_rows=32)
+
+    def test_cold_generations_are_pruned(self, tmp_path):
+        rng = np.random.default_rng(37)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 60, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", compact_every=1)
+        t.poll_once()
+        for k in range(1, 4):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 20, ["u0"])
+            t.poll_once()
+        store_dir = tmp_path / "ckpt" / "corpus-store"
+        colds = sorted(n for n in os.listdir(store_dir) if n.startswith("cold-"))
+        # keep_cold=2: the referenced cold gen + one rollback step
+        assert colds == ["cold-00000003", "cold-00000004"]
+
+
+class TestSlidingWindow:
+    def test_view_is_bounded_and_old_rows_age_out(self, tmp_path):
+        rng = np.random.default_rng(41)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 100, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", window_mode="sliding",
+                         window_generations=2)
+        t.poll_once()
+        views = []
+        for k in range(1, 5):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 30, USERS)
+            r = t.poll_once()
+            views.append((r.generation, r.view_rows, r.n_rows))
+        # window 2: from gen 3 on the view is exactly the last two deltas
+        assert views[-1] == (5, 60, 220)
+        assert views[-2] == (4, 60, 190)
+        gens = np.unique(t.snapshot.row_gens)
+        np.testing.assert_array_equal(gens, [4, 5])
+        assert t.snapshot.start_row == 160
+
+    def test_out_of_window_entities_carry_coefficients_bitwise(self, tmp_path):
+        """An entity whose rows all aged out of the window is NOT evicted:
+        its previous-generation coefficients ride along verbatim (frozen,
+        still servable) until eviction says otherwise."""
+        rng = np.random.default_rng(43)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 120, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", window_mode="sliding",
+                         window_generations=2)
+        t.poll_once()
+        # u7 never appears again; after 2 generations its rows age out
+        frozen = None
+        for k in range(1, 4):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 30,
+                       ["u0", "u1", "u2"])
+            t.poll_once()
+            m = t.models["per-user"]
+            row = m.row_for_entity("u7")
+            assert row >= 0, "u7 must stay in the tables (not evicted)"
+            coeffs = np.asarray(m.coeffs)[row]
+            if frozen is None:
+                frozen = coeffs.copy()
+            else:
+                k_shared = min(len(frozen), len(coeffs))
+                np.testing.assert_array_equal(coeffs[:k_shared],
+                                              frozen[:k_shared])
+        stats = t.last_result.active["per-user"]
+        assert stats.get("n_carried", 0) > 0  # u3..u7 rode along
+        # restart reproduces the carried rows bitwise
+        t2 = make_trainer(corpus, tmp_path / "ckpt", window_mode="sliding",
+                          window_generations=2)
+        assert t2.models["per-user"].entity_ids == t.models["per-user"].entity_ids
+        np.testing.assert_array_equal(
+            np.asarray(t2.models["per-user"].coeffs),
+            np.asarray(t.models["per-user"].coeffs),
+        )
+
+    def test_decay_mode_weights_are_age_derived_and_deterministic(self):
+        from photon_ml_tpu.continuous import decay_weights
+
+        weights = np.asarray([1.0, 2.0, 1.0, 0.5])
+        gens = np.asarray([5, 4, 3, 5])
+        out = decay_weights(weights, gens, current_gen=5, half_life=1.0)
+        np.testing.assert_allclose(out, [1.0, 1.0, 0.25, 0.5], rtol=1e-6)
+        again = decay_weights(weights, gens, current_gen=5, half_life=1.0)
+        np.testing.assert_array_equal(out, again)  # bit-identical on replay
+
+    def test_decay_mode_trains_and_replays_bitwise(self, tmp_path):
+        rng = np.random.default_rng(47)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 120, USERS)
+        kw = dict(window_mode="decay", decay_half_life=1.0,
+                  window_generations=3)
+        t = make_trainer(corpus, tmp_path / "ckpt", **kw)
+        t.poll_once()
+        write_part(corpus / "part-00001.avro", rng, 40, ["u0"])
+        r = t.poll_once()
+        assert r is not None and r.kind == "delta"
+        # a fresh restore replays to the same coefficients bitwise (the
+        # decay weights recompute from persisted row ages)
+        shutil.copytree(tmp_path / "ckpt", tmp_path / "ckpt2",
+                        ignore=shutil.ignore_patterns("gen-00000002*"))
+        t2 = make_trainer(corpus, tmp_path / "ckpt2", **kw)
+        assert t2.generation == 1
+        r2 = t2.poll_once()
+        assert r2 is not None and r2.generation == 2
+        np.testing.assert_array_equal(
+            np.asarray(t2.models["per-user"].coeffs),
+            np.asarray(t.models["per-user"].coeffs),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(t2.models["global"].model.coefficients.means),
+            np.asarray(t.models["global"].model.coefficients.means),
+        )
+
+    def test_window_config_is_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="window_generations"):
+            make_trainer(tmp_path, tmp_path / "c", window_mode="sliding")
+        with pytest.raises(ValueError, match="decay_half_life"):
+            make_trainer(tmp_path, tmp_path / "c", window_mode="decay")
+        with pytest.raises(ValueError, match="no effect"):
+            make_trainer(tmp_path, tmp_path / "c", window_generations=3)
+        with pytest.raises(ValueError, match="window_mode"):
+            make_trainer(tmp_path, tmp_path / "c", window_mode="bogus")
+        with pytest.raises(ValueError, match="decay_half_life has no effect"):
+            make_trainer(tmp_path, tmp_path / "c", window_mode="sliding",
+                         window_generations=2, decay_half_life=1.0)
+        with pytest.raises(ValueError, match="compact_every"):
+            make_trainer(tmp_path, tmp_path / "c", compact_every=0)
+
+
+# ---------------------------------------------------------- entity eviction
+
+
+def _eviction_scenario(tmp_path, rng_seed=51, **extra):
+    """Bootstrap all USERS, then two deltas targeting only u0: with
+    evict_idle_generations=2 every other user evicts at generation 4."""
+    rng = np.random.default_rng(rng_seed)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 160, USERS)
+    kw = dict(window_mode="sliding", window_generations=2,
+              evict_idle_generations=2, **extra)
+    t = make_trainer(corpus, tmp_path / "ckpt", **kw)
+    t.poll_once()
+    for k in (1, 2, 3):
+        write_part(corpus / f"part-{k:05d}.avro", rng, 30, ["u0"])
+        r = t.poll_once()
+    return corpus, t, r, rng, kw
+
+
+class TestEntityEviction:
+    def test_idle_entities_evict_and_archive(self, tmp_path):
+        corpus, t, r, rng, kw = _eviction_scenario(tmp_path)
+        stats = r.active["per-user"]
+        assert stats["n_evicted"] == 7  # u1..u7; u0 kept its data flowing
+        assert t.models["per-user"].entity_ids == ("u0",)
+        assert t.evicted["per-user"] == {f"u{i}" for i in range(1, 8)}
+        archive = t.store.archive_load("per-user")
+        assert set(archive["entity_ids"].tolist()) == t.evicted["per-user"]
+        # the archived coefficients are the last pre-eviction rows, bitwise
+        gens = list_generations(str(tmp_path / "ckpt"))
+        prev = load_generation(dict(gens)[r.generation - 1])["models"]["per-user"]
+        for e in sorted(t.evicted["per-user"]):
+            src = prev.row_for_entity(e)
+            dst = archive["entity_ids"].tolist().index(e)
+            np.testing.assert_array_equal(
+                archive["coeffs"][dst], np.asarray(prev.coeffs)[src]
+            )
+        # bookkeeping survives restart
+        t2 = make_trainer(corpus, tmp_path / "ckpt", **kw)
+        assert t2.evicted["per-user"] == t.evicted["per-user"]
+        assert t2.models["per-user"].entity_ids == ("u0",)
+
+    def test_evicted_entity_scores_like_never_seen_through_every_layer(
+        self, tmp_path
+    ):
+        """The serving degradation contract (bitwise, three layers deep):
+        an EVICTED entity's request scores exactly like a request whose
+        entity never existed — engine, frontend, and HTTP transport."""
+        from photon_ml_tpu.data.game_data import GameInput
+        from photon_ml_tpu.serving import (
+            FleetHTTPServer,
+            FrontendConfig,
+            ModelRouter,
+            ReplicaSet,
+            clear_engine_cache,
+        )
+        from photon_ml_tpu.serving.hotswap import serve_from_checkpoint
+        from photon_ml_tpu.serving.transport import FleetClient
+        import scipy.sparse as sp
+
+        corpus, t, r, rng, kw = _eviction_scenario(tmp_path)
+        assert "u3" in t.evicted["per-user"]
+        dim = t.snapshot.index_maps["shardA"].size
+        X = sp.csr_matrix(rng.normal(size=(6, dim)))
+
+        def req(entity):
+            return GameInput(
+                features={"shardA": X.copy()},
+                id_columns={"userId": np.asarray([entity] * 6, dtype=object)},
+            )
+
+        clear_engine_cache()
+        try:
+            frontend, _mgr = serve_from_checkpoint(
+                str(tmp_path / "ckpt"),
+                config=FrontendConfig(max_wait_ms=0.0),
+            )
+            assert frontend.generation == r.generation
+            engine = frontend.engine
+            evicted = engine.score(req("u3"))
+            ghost = engine.score(req("zz-never-seen"))
+            trained = engine.score(req("u0"))
+            np.testing.assert_array_equal(evicted, ghost)  # the contract
+            assert not np.array_equal(evicted, trained)  # u0 still personal
+            # frontend coalescing path
+            np.testing.assert_array_equal(
+                frontend.score(req("u3"), timeout=30),
+                frontend.score(req("zz-never-seen"), timeout=30),
+            )
+            frontend.close()
+
+            # HTTP transport, byte-for-byte across the wire
+            rs = ReplicaSet.from_checkpoint(
+                str(tmp_path / "ckpt"), 1, name="m",
+                config=FrontendConfig(max_wait_ms=0.0),
+            )
+            router = ModelRouter()
+            router.add_model("m", rs)
+            try:
+                with FleetHTTPServer(router, port=0) as srv:
+                    client = FleetClient(srv.host, srv.port)
+                    out_evicted, gen_a = client.score("m", req("u3"))
+                    out_ghost, gen_b = client.score("m", req("zz-never-seen"))
+                    assert gen_a == gen_b == r.generation
+                    assert out_evicted.dtype == out_ghost.dtype
+                    np.testing.assert_array_equal(out_evicted, out_ghost)
+                    np.testing.assert_array_equal(out_evicted, evicted)
+            finally:
+                router.close()
+        finally:
+            clear_engine_cache()
+
+    def test_readmission_warm_starts_from_the_archive(self, tmp_path):
+        corpus, t, r, rng, kw = _eviction_scenario(tmp_path)
+        archived = t.store.archive_load("per-user")
+        u1_row = archived["entity_ids"].tolist().index("u1")
+        u1_coeffs = archived["coeffs"][u1_row].copy()
+        assert np.any(u1_coeffs != 0)
+
+        write_part(corpus / "part-00004.avro", rng, 30, ["u0", "u1"])
+        r5 = t.poll_once()
+        stats = r5.active["per-user"]
+        assert stats["n_readmitted"] == 1
+        assert "u1" not in t.evicted["per-user"]
+        m = t.models["per-user"]
+        assert m.row_for_entity("u1") >= 0  # back in the tables
+        # and solved again (active): coefficients moved off the archive point
+        assert stats["n_active"] >= 2
+
+    def test_inject_archived_rows_remaps_by_global_column(self):
+        """Unit proof of the warm-start injection: archived slots remap into
+        the new layout by GLOBAL column id, unmatched columns zero-fill."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.continuous import inject_archived_rows
+        from photon_ml_tpu.models.game import RandomEffectModel
+
+        model = RandomEffectModel(
+            re_type="userId", feature_shard_id="s",
+            task=TaskType.LOGISTIC_REGRESSION,
+            entity_ids=("a", "b"),
+            coeffs=jnp.zeros((2, 3)),
+            proj_indices=jnp.asarray([[10, 20, 30], [10, 40, -1]]),
+        )
+        archive = {
+            # archived layout for "b": columns (40, 10, 99) in ITS slot order
+            "entity_ids": np.asarray(["b"]),
+            "coeffs": np.asarray([[7.0, 5.0, 3.0]]),
+            "proj": np.asarray([[40, 10, 99]]),
+            "evicted_at": np.asarray([3]),
+        }
+        out, n = inject_archived_rows(model, archive, ["b"])
+        assert n == 1
+        np.testing.assert_array_equal(np.asarray(out.coeffs)[0], [0, 0, 0])
+        # b's new layout is (10, 40, pad): 10 -> 5.0, 40 -> 7.0, pad -> 0
+        np.testing.assert_array_equal(np.asarray(out.coeffs)[1], [5.0, 7.0, 0.0])
+        # entities without an archive row stay zero (and don't count)
+        same, n0 = inject_archived_rows(model, archive, ["a"])
+        assert n0 == 0 and same is model
+
+
+# -------------------------------------------- bounded-memory discipline
+
+
+class TestBoundedMemory:
+    def test_previous_view_is_dropped_eagerly(self, tmp_path):
+        """Satellite regression: the trainer must not retain the previous
+        generation's decoded snapshot once a pass completes — the old view's
+        arrays become garbage the moment the grown view exists."""
+        import gc
+        import weakref
+
+        rng = np.random.default_rng(61)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 100, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt")
+        t.poll_once()
+        old_labels = t.snapshot.data.labels
+        ref = weakref.ref(old_labels)
+        del old_labels
+        write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+        t.poll_once()
+        gc.collect()
+        assert ref() is None, (
+            "the pre-delta view's arrays are still referenced after commit"
+        )
+
+    def test_window_keeps_resident_bytes_flat(self, tmp_path):
+        """With a sliding window and equal-sized deltas, the store's resident
+        corpus bytes are IDENTICAL across steady-state generations — O(hot
+        tier), not O(history)."""
+        rng = np.random.default_rng(63)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 80, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", window_mode="sliding",
+                         window_generations=2, compact_every=3)
+        t.poll_once()
+        resident = []
+        for k in range(1, 7):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 40, USERS)
+            t.poll_once()
+            resident.append(t.store.resident_corpus_bytes)
+        # steady state from generation 3 on: the view is exactly two deltas
+        steady = resident[2:]
+        assert max(steady) <= max(1, min(steady)) * 1.05
+        # sanity: the unbounded trainer's resident bytes DO grow
+        t_full = make_trainer(corpus, tmp_path / "ckpt-full")
+        t_full.poll_once()
+        assert t_full.store.resident_corpus_bytes > max(steady)
+
+    def test_steady_pass_peak_memory_does_not_grow_with_history(self, tmp_path):
+        """tracemalloc bound: a late windowed pass allocates no more than an
+        early one (plus slack) — no step holds more than the hot tier plus
+        block-sized cold reads."""
+        import gc
+        import tracemalloc
+
+        rng = np.random.default_rng(65)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 80, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", window_mode="sliding",
+                         window_generations=2, compact_every=3,
+                         cold_block_rows=64)
+
+        def measured_pass(k):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 40, USERS)
+            gc.collect()
+            tracemalloc.start()
+            t.poll_once()
+            _, peak = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
+            return peak
+
+        t.poll_once()
+        peaks = [measured_pass(k) for k in range(1, 9)]
+        early = max(peaks[2:4])  # steady state begins at generation 3
+        late = max(peaks[-2:])
+        assert late <= early * 1.5 + (1 << 20), (peaks, early, late)
+
+
+# -------------------------------------------- store fault-point chaos sweep
+
+
+@pytest.fixture(scope="module")
+def compact_chaos_scenario(tmp_path_factory):
+    """Two generations committed under sliding window + eviction + a
+    compaction cadence that makes the PENDING delta a compaction pass: the
+    swept generation 3 evicts idle entities (continuous.evict +
+    archive continuous.cold_write), folds the corpus into a cold generation
+    (continuous.compact + block continuous.cold_write), and commits — so
+    every store fault point sits ON the replayed path."""
+    rng = np.random.default_rng(20260804)
+    root = tmp_path_factory.mktemp("compact-chaos")
+    corpus = root / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 160, USERS)
+    kw = dict(window_mode="sliding", window_generations=2,
+              evict_idle_generations=1, compact_every=3, cold_block_rows=64)
+    base_ckpt = root / "ckpt-base"
+    t = make_trainer(corpus, base_ckpt, **kw)
+    t.poll_once()  # gen-1 bootstrap
+    write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+    t.poll_once()  # gen-2 delta
+    write_part(corpus / "part-00002.avro", rng, 30, ["u0"])  # pending gen-3
+
+    def run_loop(ckpt, export):
+        t = make_trainer(corpus, ckpt, export_dir=export, **kw)
+        while t.poll_once() is not None:
+            pass
+        return t
+
+    ref_export = root / "export-ref"
+    shutil.copytree(base_ckpt, root / "ckpt-ref")
+    ref_trainer = run_loop(root / "ckpt-ref", ref_export)
+    # the scenario genuinely exercises the machinery under sweep
+    assert ref_trainer.last_result.compacted
+    assert ref_trainer.last_result.active["per-user"]["n_evicted"] > 0
+    return SimpleNamespace(
+        base_ckpt=base_ckpt, ref_export=ref_export, run_loop=run_loop,
+        ref_ckpt=root / "ckpt-ref",
+    )
+
+
+@pytest.mark.chaos
+class TestStoreChaos:
+    def test_compaction_pass_is_deterministic(self, compact_chaos_scenario, tmp_path):
+        s = compact_chaos_scenario
+        shutil.copytree(s.base_ckpt, tmp_path / "ckpt")
+        s.run_loop(tmp_path / "ckpt", tmp_path / "export")
+        assert_trees_identical(str(s.ref_export), str(tmp_path / "export"))
+        # the durable store converges too: checkpoint generations AND the
+        # cold tier/archive bytes are identical across runs
+        assert_trees_identical(str(s.ref_ckpt), str(tmp_path / "ckpt"))
+
+    @pytest.mark.parametrize("point", CONTINUOUS_POINTS + STORE_POINTS)
+    def test_crash_anywhere_resumes_to_identical_generation_bytes(
+        self, compact_chaos_scenario, tmp_path, point
+    ):
+        """Crash at EVERY continuous.* point during an evicting, compacting
+        delta pass; restart; the exported generation, the committed
+        checkpoints, the cold tier and the archive must all be bitwise an
+        uninterrupted run's — compaction's only OBSERVABLE durable write is
+        the atomic checkpoint commit."""
+        s = compact_chaos_scenario
+        shutil.copytree(s.base_ckpt, tmp_path / "ckpt")
+        _, outcome = run_with_crash_at(
+            lambda: s.run_loop(tmp_path / "ckpt", tmp_path / "export"),
+            point,
+        )
+        assert outcome.crashed and outcome.restarts >= 1
+        assert_trees_identical(str(s.ref_export), str(tmp_path / "export"))
+        assert_trees_identical(str(s.ref_ckpt), str(tmp_path / "ckpt"))
+
+
+class TestArchiveIntegrity:
+    def test_archive_commits_as_one_atomic_file(self, tmp_path):
+        """The archive's digest rides INSIDE the npz (one os.replace = the
+        whole commit): no sidecar exists whose torn pairing with the content
+        could brick every later pass (review finding on the two-rename
+        window)."""
+        corpus, t, r, rng, kw = _eviction_scenario(tmp_path)
+        archive_dir = tmp_path / "ckpt" / "corpus-store" / "archive"
+        files = sorted(os.listdir(archive_dir))
+        assert files == ["per-user.npz"]  # no .sha256 sidecar, no stale tmp
+        loaded = t.store.archive_load("per-user")
+        assert set(loaded["entity_ids"].tolist()) == t.evicted["per-user"]
+
+    def test_damaged_archive_fails_loudly(self, tmp_path):
+        """Integrity is content-level (the digest covers array bytes, so rot
+        in zip padding is benign by design): damage the ARRAYS and damage the
+        CONTAINER, both must raise instead of re-admitting entities from
+        garbage."""
+        from photon_ml_tpu.continuous import ColdStoreCorruption
+
+        corpus, t, r, rng, kw = _eviction_scenario(tmp_path)
+        path = tmp_path / "ckpt" / "corpus-store" / "archive" / "per-user.npz"
+        blob = bytearray(path.read_bytes())
+        # dense flip: a tiny npz is mostly zip structure/padding, so hit
+        # every 16th byte — array data cannot escape
+        for i in range(0, len(blob), 16):
+            blob[i] ^= 0xFF
+        path.write_bytes(bytes(blob))
+        with pytest.raises(ColdStoreCorruption):
+            t.store.archive_load("per-user")
+        # torn container (truncated mid-write by a crash on a non-atomic fs)
+        path.write_bytes(bytes(blob[: len(blob) // 2]))
+        with pytest.raises(ColdStoreCorruption, match="unreadable"):
+            t.store.archive_load("per-user")
+
+
+def test_contract_violation_mid_stage_leaves_a_retryable_trainer(tmp_path, monkeypatch):
+    """A CorpusContractViolation AFTER the delta is staged (the torn-write
+    verify bracket) must roll the stage back: the next poll retries cleanly
+    instead of refusing with a pending stage."""
+    rng = np.random.default_rng(67)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 120, USERS)
+    t = make_trainer(corpus, tmp_path / "ckpt")
+    t.poll_once()
+    write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+
+    def explode(self, entries=None):
+        raise CorpusContractViolation("file grew during ingest (simulated)")
+
+    monkeypatch.setattr(CorpusManifest, "verify_sizes", explode)
+    with pytest.raises(CorpusContractViolation):
+        t.poll_once()
+    assert t.snapshot.n_rows == 120  # the stage rolled back
+    monkeypatch.undo()
+    r = t.poll_once()  # and the retry commits normally
+    assert r is not None and r.generation == 2 and r.n_rows == 150
+
+
+def test_crash_orphaned_cold_generation_never_displaces_the_referenced_one(
+    tmp_path,
+):
+    """An orphaned cold dir (renamed but never referenced because the commit
+    crashed) is deleted at restore and NEVER counts toward keep_cold — it
+    must not push the referenced generation (or its rollback step) out of
+    retention."""
+    rng = np.random.default_rng(69)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 100, USERS)
+    t = make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
+                     cold_block_rows=64)
+    t.poll_once()
+    write_part(corpus / "part-00001.avro", rng, 20, ["u0"])
+    t.poll_once()  # cold-1 (rollback step) + cold-2 (referenced) on disk
+    store_dir = tmp_path / "ckpt" / "corpus-store"
+    assert sorted(n for n in os.listdir(store_dir) if n.startswith("cold-")) \
+        == ["cold-00000001", "cold-00000002"]
+    # fake a crashed future compaction: a cold dir no checkpoint references
+    shutil.copytree(store_dir / "cold-00000002", store_dir / "cold-00000009")
+
+    t2 = make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
+                      cold_block_rows=64)
+    colds = sorted(n for n in os.listdir(store_dir) if n.startswith("cold-"))
+    # orphan gone; the referenced generation AND its rollback step survive
+    assert colds == ["cold-00000001", "cold-00000002"]
+    assert t2.generation == 2 and t2.snapshot.n_rows == 120
+
+
+def test_single_generation_window_survives_commit_fault(tmp_path):
+    """window_generations=1 legally empties the view between passes: an
+    in-pass failure must still roll back to the (empty) previous view and
+    retry cleanly — not wedge behind a masked empty-materialize error."""
+    rng = np.random.default_rng(71)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 100, USERS)
+    t = make_trainer(corpus, tmp_path / "ckpt", window_mode="sliding",
+                     window_generations=1)
+    t.poll_once()
+    write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+    with armed("continuous.commit:raise"):
+        with pytest.raises(InjectedFault):
+            t.poll_once()
+    assert t.snapshot.n_rows == 0  # gen-1 rows aged out; stage rolled back
+    r = t.poll_once()
+    assert r is not None and r.generation == 2
+    assert r.view_rows == 30 and r.n_rows == 130
+    # and a restart materializes the same single-generation view
+    t2 = make_trainer(corpus, tmp_path / "ckpt", window_mode="sliding",
+                      window_generations=1)
+    assert t2.snapshot.n_rows == 30
+
+
+def test_readmission_below_lower_bound_keeps_the_archive(tmp_path):
+    """A reappearing entity whose delta rows fall below
+    active_data_lower_bound gets NO model row that pass: it must STAY
+    evicted (archive intact) so a later, sufficient reappearance still
+    warm-starts — dropping it from the evicted set would orphan the
+    archived coefficients and zero-init it forever after."""
+    coords = dict(
+        parse_coordinate_configuration(c)
+        for c in (FE_COORD, RE_COORD + ",active.data.lower.bound=3")
+    )
+    rng = np.random.default_rng(73)
+    corpus = tmp_path / "corpus"
+    os.makedirs(corpus)
+    write_part(corpus / "part-00000.avro", rng, 160, USERS)
+
+    def trainer():
+        return ContinuousTrainer(
+            ContinuousTrainerConfig(
+                corpus_paths=[str(corpus)],
+                checkpoint_directory=str(tmp_path / "ckpt"),
+                task=TaskType.LOGISTIC_REGRESSION,
+                coordinate_configurations=coords,
+                shard_configurations=shard_configs(),
+                window_mode="sliding", window_generations=2,
+                evict_idle_generations=2,
+            )
+        )
+
+    t = trainer()
+    t.poll_once()
+    for k in (1, 2, 3):
+        write_part(corpus / f"part-{k:05d}.avro", rng, 30, ["u0"])
+        r = t.poll_once()
+    assert "u1" in t.evicted["per-user"]  # evicted at gen 4
+
+    # u1 reappears with TWO rows: below the lower bound, no model row
+    write_part(corpus / "part-00004.avro", rng, 2, ["u1"])
+    r5 = t.poll_once()
+    assert r5.active["per-user"]["n_readmitted"] == 0
+    assert "u1" in t.evicted["per-user"]  # still evicted, archive intact
+    assert t.models["per-user"].row_for_entity("u1") < 0
+
+    # a sufficient reappearance later still warm-starts from the archive
+    write_part(corpus / "part-00005.avro", rng, 12, ["u1"])
+    r6 = t.poll_once()
+    assert r6.active["per-user"]["n_readmitted"] == 1
+    assert "u1" not in t.evicted["per-user"]
+    assert t.models["per-user"].row_for_entity("u1") >= 0

@@ -9,6 +9,7 @@ on the same request against the serving generation returns.
 """
 
 import dataclasses
+import os
 import threading
 import time
 
@@ -746,3 +747,176 @@ def test_sheds_by_cause_breakout_shapes():
         "overload": 4, "deadline": 2, "quota": 6, "shutdown": 4,
     }
     assert _served_by_generation(stats) == {1: 7, 2: 7}
+
+
+# --------------------------------------------------------------------------
+# durable canary blacklist: the verdict lives IN the generational store
+# (io/checkpoint.record_generation_blacklist), so independent serving
+# processes booted on the same store agree on rejected generations without
+# any channel between them — one fleet's canary spares every other.
+# --------------------------------------------------------------------------
+
+
+def test_blacklist_record_and_load_round_trip(tmp_path):
+    from photon_ml_tpu.io.checkpoint import (
+        load_generation_blacklist,
+        record_generation_blacklist,
+    )
+
+    root = str(tmp_path / "store")
+    assert load_generation_blacklist(root) == {}  # missing dir = empty
+    path = record_generation_blacklist(root, 7, "CanaryMismatch: poisoned")
+    assert path is not None and os.path.exists(path)
+    # ONE file is the whole commit (digest embedded): no sidecar whose torn
+    # pairing with the content could drop a verdict
+    assert sorted(os.listdir(os.path.dirname(path))) == ["gen-00000007.json"]
+    record_generation_blacklist(root, 9, "corrupt")
+    assert load_generation_blacklist(root) == {
+        7: "CanaryMismatch: poisoned", 9: "corrupt",
+    }
+    # re-recording the same generation is idempotent (last verdict wins)
+    record_generation_blacklist(root, 7, "CanaryMismatch: again")
+    assert load_generation_blacklist(root)[7] == "CanaryMismatch: again"
+
+
+def test_blacklist_damaged_entry_is_ignored_not_adopted(tmp_path):
+    from photon_ml_tpu.io.checkpoint import (
+        load_generation_blacklist,
+        record_generation_blacklist,
+    )
+
+    root = str(tmp_path / "store")
+    p7 = record_generation_blacklist(root, 7, "bad")
+    p8 = record_generation_blacklist(root, 8, "also bad")
+    corrupt_file(p7)  # bit-rot the entry AFTER its digest was embedded
+    verdicts = load_generation_blacklist(root)
+    assert 7 not in verdicts  # damaged entry treated as absent, loudly logged
+    assert verdicts == {8: "also bad"}
+    # a torn (truncated) entry is also ignored
+    blob = open(p8, "rb").read()
+    with open(p8, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert load_generation_blacklist(root) == {}
+
+
+def test_canary_verdict_is_durable_across_independent_fleets(tmp_path, rng):
+    """Fleet A's canary rejects a NaN-poisoned generation; fleet B, a fresh
+    set of replicas booted LATER on the same store (a different process in
+    production), must skip it at bootstrap without its own canary attempt."""
+    from photon_ml_tpu.io.checkpoint import load_generation_blacklist
+
+    root, rs_a = build_fleet(tmp_path, rng, n_replicas=2)
+    router = ModelRouter()
+    router.add_model("m", rs_a)
+    try:
+        for _ in range(3):
+            router.score("m", make_req(rng), timeout=30)
+        save_checkpoint(root, poison_models(build_models(rng, 2.0)), 2,
+                        keep_generations=8)
+        assert rs_a.check_once() is False
+        assert rs_a.bad_generations == {2}
+        # the verdict is on disk, in the store
+        assert 2 in load_generation_blacklist(root)
+    finally:
+        router.close()
+
+    # an INDEPENDENT fleet adopts the verdict at bootstrap: no canary run,
+    # no attempt ever made on the poisoned generation
+    rs_b = ReplicaSet.from_checkpoint(
+        root, 2, name="b", config=FrontendConfig(max_wait_ms=0.0),
+        retry=FAST_RETRY,
+    )
+    try:
+        assert 2 in rs_b.bad_generations
+        assert rs_b.check_once() is False  # nothing eligible
+        assert rs_b.generations == [1, 1]
+        assert rs_b.rollbacks == 0  # the verdict cost B nothing
+        # and a verdict recorded by ANOTHER process AFTER B booted is adopted
+        # at the next poll (check_once re-reads the store)
+        from photon_ml_tpu.io.checkpoint import record_generation_blacklist
+
+        save_checkpoint(root, build_models(rng, 3.0), 3, keep_generations=8)
+        record_generation_blacklist(root, 3, "rejected elsewhere")
+        assert rs_b.check_once() is False
+        assert 3 in rs_b.bad_generations
+    finally:
+        rs_b.close()
+
+
+def test_hotswap_manager_reads_durable_blacklist_at_bootstrap(tmp_path, rng):
+    from photon_ml_tpu.io.checkpoint import record_generation_blacklist
+    from photon_ml_tpu.serving.hotswap import serve_from_checkpoint
+
+    root = str(tmp_path / "ckpt")
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+    record_generation_blacklist(root, 2, "rejected by a fleet canary")
+    frontend, manager = serve_from_checkpoint(
+        root, config=FrontendConfig(max_wait_ms=0.0)
+    )
+    try:
+        assert 2 in manager.bad_generations
+        assert manager.check_once() is False  # gen-2 is never attempted
+        assert frontend.generation == 1
+        # a later good generation still swaps in
+        save_checkpoint(root, build_models(rng, 3.0), 3, keep_generations=8)
+        assert manager.check_once() is True
+        assert frontend.generation == 3
+    finally:
+        frontend.close()
+
+
+def test_durable_blacklist_can_be_opted_out(tmp_path, rng):
+    """durable_blacklist=False keeps the verdict process-local (e.g. a
+    read-only mirror of someone else's store)."""
+    from photon_ml_tpu.io.checkpoint import load_generation_blacklist
+
+    root, rs = build_fleet(tmp_path, rng, n_replicas=2, durable_blacklist=False)
+    router = ModelRouter()
+    router.add_model("m", rs)
+    try:
+        for _ in range(3):
+            router.score("m", make_req(rng), timeout=30)
+        save_checkpoint(root, poison_models(build_models(rng, 2.0)), 2,
+                        keep_generations=8)
+        assert rs.check_once() is False
+        assert rs.bad_generations == {2}  # in-memory verdict still works
+        assert load_generation_blacklist(root) == {}  # nothing written
+    finally:
+        router.close()
+
+
+def test_blacklist_opt_out_covers_bootstrap_too(tmp_path, rng):
+    """durable_blacklist=False must also skip the verdict at the BOOT
+    generation pick: an operator debugging a rejected generation can serve
+    it deliberately."""
+    from photon_ml_tpu.io.checkpoint import record_generation_blacklist
+    from photon_ml_tpu.serving.hotswap import serve_from_checkpoint
+
+    root = str(tmp_path / "ckpt")
+    save_checkpoint(root, build_models(rng, 1.0), 1, keep_generations=8)
+    save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+    record_generation_blacklist(root, 2, "rejected elsewhere")
+    # default: the verdict holds at bootstrap
+    fe, _ = serve_from_checkpoint(root, config=FrontendConfig(max_wait_ms=0.0))
+    try:
+        assert fe.generation == 1
+    finally:
+        fe.close()
+    # explicit opt-out: the newest generation serves despite the verdict
+    fe2, mgr2 = serve_from_checkpoint(
+        root, config=FrontendConfig(max_wait_ms=0.0), durable_blacklist=False
+    )
+    try:
+        assert fe2.generation == 2
+        assert mgr2.bad_generations == set()
+    finally:
+        fe2.close()
+    rs = ReplicaSet.from_checkpoint(
+        root, 1, name="opt-out", config=FrontendConfig(max_wait_ms=0.0),
+        retry=FAST_RETRY, durable_blacklist=False,
+    )
+    try:
+        assert rs.generations == [2]
+    finally:
+        rs.close()

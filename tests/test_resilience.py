@@ -147,6 +147,9 @@ class TestFaultPoints:
             "continuous.delta_ingest",
             "continuous.active_select",
             "continuous.commit",
+            "continuous.compact",
+            "continuous.evict",
+            "continuous.cold_write",
         } <= points
 
     def test_corrupt_file_flips_one_byte(self, tmp_path):
