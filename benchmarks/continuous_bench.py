@@ -214,7 +214,20 @@ def run_compact_smoke(args) -> int:
     - **zero steady-state retraces after a compaction** — a replayed
       compaction pass (restore from the pre-compaction checkpoint copy, same
       delta) traces NOTHING: the window keeps shapes constant, so every
-      program must hit the solver cache; compaction must not perturb them.
+      program must hit the solver cache; compaction must not perturb them;
+    - **O(delta) incremental compaction** — a cadence-1 compaction after ONE
+      small delta on the accumulated store reuses >= --min-reuse-ratio of
+      its cold bytes by reference (content-addressed pool) and rewrites at
+      most ceil(2*delta_rows/block_rows) + 1 blocks (the live segment + the
+      delta + the partial tail; 2 at the CI shape);
+    - **retention deletion** — the same single delta under
+      max_row_age_gens=window drops every cold row older than the training
+      window (rows_dropped > 0; whole-block drops, no read);
+    - **streamed bootstrap** — a FRESH trainer drains the whole backlog at
+      max_files_per_pass=1: the committed checkpoint tree (generations +
+      corpus store) is byte-identical to the long-running trainer's, and
+      peak resident corpus bytes stay O(window + delta)
+      (`bootstrap_peak_resident_bytes`), never O(corpus).
     """
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np
@@ -274,6 +287,8 @@ def run_compact_smoke(args) -> int:
     rss_samples = []
     resident_samples = []
     compactions = 0
+    compact_stats = []  # cold-tier io per compaction (reuse paper trail)
+    compact_walls = []
     steady_retraces = None
     # the single-delta footprint baseline: the FIRST steady-state delta —
     # window full AND one compaction behind us, so the per-shape-family
@@ -300,6 +315,9 @@ def run_compact_smoke(args) -> int:
         )
         r = trainer.poll_once()
         compactions += int(r.compacted)
+        if r.compacted:
+            compact_stats.append(r.cold_stats)
+            compact_walls.append(r.timings["compact"])
         rss_samples.append(_rss_kb())
         resident_samples.append(trainer.store.resident_corpus_bytes)
         if rss_single_delta is None and k >= baseline_k:
@@ -331,17 +349,63 @@ def run_compact_smoke(args) -> int:
     rss_ratio = rss_samples[-1] / max(rss_single_delta, 1)
     resident_ratio = resident_samples[-1] / max(resident_window_full, 1)
 
-    # --- bootstrap equivalence, bitwise --------------------------------------
-    # trainer B = a fresh process's restore from the compacted store; both
-    # absorb the SAME next delta; the committed generation and the export
-    # must be byte-for-byte identical
+    # freeze the accumulated state for the single-delta phases below, then
+    # land ONE more small delta that every phase shares
     ckpt_b = os.path.join(work, "ckpt-b")
-    shutil.copytree(os.path.join(work, "ckpt"), ckpt_b)
+    ckpt_d = os.path.join(work, "ckpt-d")
+    ckpt_e = os.path.join(work, "ckpt-e")
+    for dst in (ckpt_b, ckpt_d, ckpt_e):
+        shutil.copytree(os.path.join(work, "ckpt"), dst)
     final = args.compact_deltas + 1
     _write_part(
         os.path.join(corpus, f"part-{final:05d}.avro"), args.delta_rows, d,
         list(range(U)), w, bias, seed=100 + final,
     )
+
+    # --- O(delta) incremental compaction after a single small delta ----------
+    # cadence-1 on the frozen store: the fold reuses every unchanged cold
+    # block by reference and re-encodes only the tail + delta. GATES: reuse
+    # ratio >= --min-reuse-ratio, <= 2 blocks rewritten.
+    t_d = ContinuousTrainer(
+        dataclasses.replace(
+            trainer.config, checkpoint_directory=ckpt_d, compact_every=1
+        )
+    )
+    t0 = time.perf_counter()
+    r_d = t_d.poll_once()
+    single_delta_wall = time.perf_counter() - t0
+    assert r_d is not None and r_d.compacted
+    stats_d = r_d.cold_stats
+    reuse_ratio = stats_d["bytes_reused"] / max(
+        stats_d["bytes_reused"] + stats_d["bytes_written"], 1
+    )
+    # the O(delta + tail) write bound, derived from the shape rather than
+    # hard-coded: the fold re-encodes the previous partial tail block plus
+    # the live segment and the new delta (2 x delta_rows) — at the CI shape
+    # (delta_rows == cold_block_rows, aligned history) this works out to 2
+    max_delta_blocks = -(-2 * args.delta_rows // args.cold_block_rows) + 1
+    del t_d
+
+    # --- retention deletion (informational + sanity gate) --------------------
+    # the same single delta under max_row_age_gens=window: the compaction
+    # DROPS every cold row older than the training window (whole blocks, no
+    # read) and the tier shrinks to O(window)
+    t_e = ContinuousTrainer(
+        dataclasses.replace(
+            trainer.config, checkpoint_directory=ckpt_e, compact_every=1,
+            max_row_age_gens=args.window,
+        )
+    )
+    r_e = t_e.poll_once()
+    assert r_e is not None and r_e.compacted
+    retention_stats = dict(r_e.cold_stats)
+    retention_stats["cold_rows_after"] = t_e.store.cold_rows
+    del t_e
+
+    # --- bootstrap equivalence, bitwise --------------------------------------
+    # trainer B = a fresh process's restore from the compacted store; both
+    # absorb the SAME next delta; the committed generation and the export
+    # must be byte-for-byte identical
     export_a = os.path.join(work, "export-a")
     export_b = os.path.join(work, "export-b")
     trainer.config.export_directory = export_a
@@ -364,6 +428,31 @@ def run_compact_smoke(args) -> int:
         )
     )
 
+    # --- streamed bootstrap: a fresh start against the whole backlog ---------
+    # max_files_per_pass=1 drains the accumulated corpus through the same
+    # windowed delta passes trainer A ran as the files arrived. GATES: the
+    # WHOLE committed checkpoint tree (generations + corpus store) is
+    # byte-identical to A's, and peak resident corpus bytes stay O(window +
+    # delta) — never one O(corpus) bootstrap materialization.
+    ckpt_s = os.path.join(work, "ckpt-stream")
+    t_s = ContinuousTrainer(
+        dataclasses.replace(
+            trainer.config, checkpoint_directory=ckpt_s,
+            export_directory=None, max_files_per_pass=1,
+        )
+    )
+    stream_passes = 0
+    stream_peak_resident = 0
+    while t_s.poll_once() is not None:
+        stream_passes += 1
+        stream_peak_resident = max(
+            stream_peak_resident, t_s.store.resident_corpus_bytes
+        )
+    stream_equal = t_s.generation == r_a.generation and _dir_trees_identical(
+        os.path.join(work, "ckpt"), ckpt_s
+    )
+    del t_s
+
     raw_bytes = sum(
         os.path.getsize(os.path.join(corpus, n)) for n in os.listdir(corpus)
     )
@@ -374,6 +463,13 @@ def run_compact_smoke(args) -> int:
         "resident_bytes_bounded_ok": resident_ratio <= args.max_resident_ratio,
         "peak_rss_vs_history_ok": rss_ratio <= args.max_rss_ratio,
         "zero_retrace_after_compaction_ok": steady_retraces == 0,
+        "cold_reuse_ratio_ok": reuse_ratio >= args.min_reuse_ratio,
+        "cold_small_delta_blocks_ok": stats_d["blocks_written"]
+        <= max_delta_blocks,
+        "retention_deletes_ok": retention_stats["rows_dropped"] > 0,
+        "streamed_bootstrap_bitwise_ok": bool(stream_equal),
+        "bootstrap_peak_resident_ok": stream_peak_resident
+        <= resident_window_full * args.max_resident_ratio,
     }
     result = {
         "metric": "compaction_smoke",
@@ -391,6 +487,20 @@ def run_compact_smoke(args) -> int:
         "compaction_ratio": round(cold_bytes / max(raw_bytes, 1), 4),
         "cold_store_bytes": cold_bytes,
         "raw_corpus_bytes": raw_bytes,
+        # the block-reuse / retention trajectory columns
+        "cold_bytes_written_per_compaction": [
+            s["bytes_written"] for s in compact_stats
+        ],
+        "cold_bytes_reused": [s["bytes_reused"] for s in compact_stats],
+        "compaction_wall_s": [round(s, 4) for s in compact_walls],
+        "single_delta_compaction": {
+            **stats_d,
+            "reuse_ratio": round(reuse_ratio, 4),
+            "wall_s": round(single_delta_wall, 4),
+        },
+        "retention": retention_stats,
+        "bootstrap_peak_resident_bytes": stream_peak_resident,
+        "bootstrap_stream_passes": stream_passes,
         "n_evicted_total": sum(
             len(v) for v in trainer.evicted.values()
         ),
@@ -444,6 +554,10 @@ def main(argv=None) -> int:
     ap.add_argument("--cold-block-rows", type=int, default=1024)
     ap.add_argument("--max-rss-ratio", type=float, default=1.5)
     ap.add_argument("--max-resident-ratio", type=float, default=1.5)
+    ap.add_argument("--min-reuse-ratio", type=float, default=0.8,
+                    help="Gate: cold bytes reused / (reused + written) at a "
+                    "compaction following a single small delta — the O(delta) "
+                    "incremental-compaction claim")
     args = ap.parse_args(argv)
     if args.deltas < 1:
         ap.error("--deltas must be >= 1 (the bench measures a delta pass)")

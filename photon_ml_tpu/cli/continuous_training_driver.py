@@ -96,6 +96,29 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(required for --window-mode decay)")
     p.add_argument("--cold-block-rows", type=int, default=8192,
                    help="Rows per cold-tier block (power of two)")
+    p.add_argument("--max-row-age-generations", type=int, default=None,
+                   help="Cold-tier retention: at each compaction DELETE rows "
+                        "older than this many generations (must cover "
+                        "--window-generations, so deletion only reaches rows "
+                        "whose training weight is already zero; expired "
+                        "blocks drop whole, the seam block rewrites sliced, "
+                        "the rest reuse; default: preserve full history)")
+    p.add_argument("--max-cold-rows", type=int, default=None,
+                   help="Best-effort cap on cold-tier rows, enforced at "
+                        "block granularity at each compaction (oldest blocks "
+                        "drop first; in-window blocks never drop)")
+    p.add_argument("--archive-max-age-generations", type=int, default=None,
+                   help="Age out evicted-coefficient archive entries older "
+                        "than this many generations at each compaction (a "
+                        "that-old reappearing entity re-solves from zero; "
+                        "default: archive forever)")
+    p.add_argument("--max-files-per-pass", type=int, default=None,
+                   help="Ingest at most this many part files per pass: a "
+                        "fresh start against a deep corpus streams the "
+                        "backlog through bounded windowed delta passes "
+                        "(resident bytes O(window + delta)) instead of one "
+                        "O(corpus) bootstrap (default: ingest everything "
+                        "the scan finds)")
     p.add_argument("--poll-interval-seconds", type=float, default=10.0)
     p.add_argument("--max-generations", type=int, default=None,
                    help="Exit after committing this many generations (tests/"
@@ -156,6 +179,10 @@ def trainer_from_args(args: argparse.Namespace):
         window_generations=args.window_generations,
         decay_half_life=args.decay_half_life,
         cold_block_rows=args.cold_block_rows,
+        max_row_age_gens=args.max_row_age_generations,
+        max_cold_rows=args.max_cold_rows,
+        archive_max_age_gens=args.archive_max_age_generations,
+        max_files_per_pass=args.max_files_per_pass,
     )
     return ContinuousTrainer(config)
 
@@ -207,6 +234,7 @@ def run(args: argparse.Namespace) -> dict:
                 "active": r.active,
                 "incidents": r.incidents,
                 "timings": r.timings,
+                "cold_stats": r.cold_stats,
             }
             with open(os.path.join(out_root, GENERATIONS_LOG), "a") as f:
                 f.write(json.dumps(last_record) + "\n")

@@ -13,9 +13,12 @@ The subsystem's layers (docs/ARCHITECTURE.md "Continuous training" and
   index-map growth (old indices frozen, unseen features append at the tail)
   and per-row generation stamps (the row-age metadata);
 - :mod:`photon_ml_tpu.continuous.store` — the tiered :class:`CorpusStore`:
-  hot deltas in RAM, cold checksummed pow2-row blocks on disk,
-  re-materialized blockwise; sliding-window view trimming; time-decay
-  weighting; the evicted-entity coefficient archive;
+  hot deltas in RAM, a cold tier of checksummed pow2-row blocks in a
+  content-addressed pool (incremental compaction reuses unchanged blocks by
+  reference — O(delta) bytes written — and the manifests double as the pool
+  refcount), re-materialized blockwise; sliding-window view trimming;
+  time-decay weighting; retention deletion of aged-out cold rows; the
+  evicted-entity coefficient archive with age-out compaction;
 - :mod:`photon_ml_tpu.continuous.compaction` — manifest compaction and
   entity-level eviction/re-admission (long-idle random effects leave the
   device tables; serving degrades to the missing-entity score-0 contract;
@@ -27,8 +30,8 @@ The subsystem's layers (docs/ARCHITECTURE.md "Continuous training" and
   hot-swap watcher to serve.
 
 Fault points ``continuous.{scan,delta_ingest,active_select,commit,compact,
-evict,cold_write}`` make every phase of the loop chaos-testable
-(tests/test_chaos.py, tests/test_continuous.py).
+evict,cold_write,cold_link,cold_delete}`` make every phase of the loop
+chaos-testable (tests/test_chaos.py, tests/test_continuous.py).
 """
 
 from photon_ml_tpu.continuous.active_set import (

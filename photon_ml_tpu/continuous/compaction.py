@@ -125,16 +125,31 @@ def inject_archived_rows(
     model: RandomEffectModel,
     archive: Optional[dict],
     entity_ids: Sequence,
+    min_evicted_at: Optional[int] = None,
 ) -> tuple[RandomEffectModel, int]:
     """Warm-start re-admitted entities from their archived coefficients: for
     each entity in ``entity_ids`` with an archive row, remap archived slots
     into the model's CURRENT projection layout by global column id (the
     ``aligned_to`` slot-matching rule applied to one row) and overwrite the
     zero row ``aligned_to`` gave the "new" entity. Returns (model, n_injected);
-    entities without an archive row stay zero-initialized."""
+    entities without an archive row stay zero-initialized.
+
+    ``min_evicted_at`` is the archive age-out horizon applied AT INJECTION
+    TIME: rows evicted before it never warm-start, whether or not
+    ``archive_compact`` has physically deleted them yet. The horizon is a
+    pure function of the pass generation, so a crash-replayed pass makes the
+    same warm/cold decision as the original attempt even when the crash
+    landed between the archive rewrite and the checkpoint commit — physical
+    deletion is lazy bookkeeping, never training math."""
     if archive is None or not len(entity_ids):
         return model, 0
     arch_row = {e: i for i, e in enumerate(archive["entity_ids"].tolist())}
+    if min_evicted_at is not None:
+        gens = np.asarray(archive["evicted_at"])
+        arch_row = {
+            e: i for e, i in arch_row.items()
+            if int(gens[i]) >= int(min_evicted_at)
+        }
     coeffs = np.asarray(model.coeffs).copy()
     variances = (
         None if model.variances is None else np.asarray(model.variances).copy()

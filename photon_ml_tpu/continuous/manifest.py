@@ -23,6 +23,7 @@ import hashlib
 import os
 from typing import Optional, Sequence
 
+from photon_ml_tpu.io.checkpoint import sha256_file
 from photon_ml_tpu.resilience import faultpoint, register_fault_point
 
 FP_SCAN = register_fault_point("continuous.scan")
@@ -36,13 +37,10 @@ class CorpusContractViolation(Exception):
 
 
 def file_fingerprint(path: str) -> str:
-    """SHA-256 of the file's content. Computed once per NEW file at ingest
-    time (O(delta) I/O per generation, never O(corpus))."""
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
+    """SHA-256 of the file's content (the shared store fingerprint primitive,
+    io/checkpoint.py). Computed once per NEW file at ingest time (O(delta)
+    I/O per generation, never O(corpus))."""
+    return sha256_file(path)
 
 
 @dataclasses.dataclass(frozen=True)

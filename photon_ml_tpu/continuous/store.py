@@ -12,13 +12,27 @@ re-materialized blockwise):
   index-remapped, tracked as :class:`LiveSegment` records (generation, the
   manifest entries that fed it, row count). Only the rows inside the training
   window stay materialized in the view.
-- **cold tier** — ``cold-<n>/`` directories of decoded, index-remapped,
-  FIXED-ROW-COUNT blocks (``block-<k>.npz``, pow2 rows, PR 5's framing
-  discipline applied to our own storage): no Avro decode and no index-map
-  application ever again for compacted rows. Each block carries a SHA-256 in
-  the cold manifest, the manifest its own checksum sidecar, and the whole
-  generation lands by staged-write + atomic rename (the PR 3 commit
-  pattern) — a crash mid-write leaves only a ``.tmp`` staging dir.
+- **cold tier** — a content-addressed block POOL (``blocks/<sha256>.npz``:
+  decoded, index-remapped row blocks, up to ``block_rows`` pow2 rows each —
+  PR 5's framing discipline applied to our own storage) plus ``cold-<n>/``
+  COLD GENERATIONS, each just a checksummed manifest ordering pool blocks
+  into the accumulated corpus: no Avro decode and no index-map application
+  ever again for compacted rows. Because the pool is content-addressed, a
+  new cold generation REUSES every unchanged block of the previous one by
+  reference — zero bytes re-encoded, O(delta + tail block) written per
+  compaction, never O(history) — and the manifests ARE the block refcount:
+  :meth:`CorpusStore.prune_cold` deletes a pool block only when no surviving
+  generation's manifest references it. Legacy (format-1) cold generations
+  kept their blocks inside the generation directory; they still read, and
+  the next compaction adopts their blocks into the pool by hard link
+  (fallback: copy) instead of re-encoding. Retention policies
+  (``retain_min_gen`` row age / ``max_cold_rows``) DELETE expired rows at
+  compaction time: whole-block drops for fully expired blocks, a row-sliced
+  rewrite for the one seam block, block reuse for everything else. Each
+  manifest carries its own checksum sidecar and lands by staged-write +
+  atomic rename (the PR 3 commit pattern); pool writes are idempotent
+  (content-addressed ``os.replace``) — a crash mid-compaction leaves only
+  unreferenced pool blocks and a ``.tmp`` staging dir, both swept.
 - **view** — the materialized :class:`~continuous.ingest.CorpusSnapshot` the
   trainer actually trains on: cold blocks intersecting the window are read
   back blockwise through the PR 5 pipeline (``map_ordered``: bounded,
@@ -49,6 +63,7 @@ import hashlib
 import json
 import logging
 import os
+import re
 import shutil
 from typing import Mapping, Optional, Sequence
 
@@ -60,35 +75,45 @@ import scipy.sparse as sp
 from photon_ml_tpu.continuous.ingest import CorpusSnapshot, ingest_delta, read_corpus
 from photon_ml_tpu.data.game_data import GameInput
 from photon_ml_tpu.data.pipeline import map_ordered
+from photon_ml_tpu.io.checkpoint import sha256_file as _sha256_file
 from photon_ml_tpu.resilience import corrupt_file, faultpoint, register_fault_point
 
 logger = logging.getLogger(__name__)
 
 FP_COLD_WRITE = register_fault_point("continuous.cold_write")
+# fires before a compaction ADOPTS an unchanged block by reference (pool
+# dedup, or the hard-link/copy migration of a legacy in-dir block) instead of
+# re-encoding it. Corrupt actions are ignored on purpose: a reused block's
+# bytes are shared with the generation that wrote them, so damaging the link
+# target would damage the corpus of record itself — that failure class is the
+# read-side checksum's job, not a recoverable write fault.
+FP_COLD_LINK = register_fault_point("continuous.cold_link")
+# fires before a retention/refcount DELETE: a fully expired block dropped
+# from the fold, an unreferenced pool block garbage-collected by prune_cold,
+# or an archive age-out rewrite/remove.
+FP_COLD_DELETE = register_fault_point("continuous.cold_delete")
 
 COLD_PREFIX = "cold-"
-BLOCK_PREFIX = "block-"
+BLOCK_PREFIX = "block-"  # legacy (format-1) in-dir block file prefix
+POOL_DIR = "blocks"
 ARCHIVE_DIR = "archive"
 MANIFEST_FILE = "manifest.json"
 MANIFEST_SHA_FILE = "manifest.json.sha256"
 _TMP_SUFFIX = ".tmp"
 DEFAULT_BLOCK_ROWS = 8192  # pow2: a few MB per block at production widths
 DEFAULT_KEEP_COLD = 2  # the referenced cold gen + one rollback step
-_FORMAT = 1
+# cold-manifest schema: 1 = blocks live inside the generation directory
+# (``block-<k>.npz``), 2 = blocks live in the shared content-addressed pool
+# (``blocks/<sha256>.npz``) and the manifest references them by digest. Both
+# read; only 2 is written.
+_FORMAT = 2
+_POOL_RE = re.compile(r"^([0-9a-f]{64})\.npz$")
 
 
 class ColdStoreCorruption(Exception):
     """A cold block or archive failed integrity verification. Loud by design:
     the cold tier is the corpus of record for compacted rows, so silently
     skipping damage would train against a corpus the model never saw."""
-
-
-def _sha256_file(path: str) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
 
 
 # ------------------------------------------------------------ array encoding
@@ -274,6 +299,20 @@ class CorpusStore:
     def _cold_dir(self, cold_id: int) -> str:
         return os.path.join(self.directory, f"{COLD_PREFIX}{cold_id:08d}")
 
+    def _pool_dir(self) -> str:
+        return os.path.join(self.directory, POOL_DIR)
+
+    def _pool_path(self, sha256: str) -> str:
+        return os.path.join(self._pool_dir(), f"{sha256}.npz")
+
+    def _block_path(self, cold_dir: str, block: dict) -> str:
+        """Where a manifest block's bytes live: inside the generation
+        directory for legacy (format-1) manifests, in the content-addressed
+        pool for format-2 (the block's NAME is its digest)."""
+        if "name" in block:
+            return os.path.join(cold_dir, block["name"])
+        return self._pool_path(block["sha256"])
+
     def _load_cold_manifest(self, cold_id: int) -> dict:
         cold_dir = self._cold_dir(cold_id)
         man_path = os.path.join(cold_dir, MANIFEST_FILE)
@@ -292,12 +331,18 @@ class CorpusStore:
             )
         with open(man_path) as f:
             meta = json.load(f)
+        fmt = int(meta.get("format", 1))
+        if fmt not in (1, _FORMAT):
+            raise ColdStoreCorruption(
+                f"cold generation {cold_id} has unknown manifest format {fmt} "
+                f"(this build reads formats 1 and {_FORMAT})"
+            )
         meta["path"] = cold_dir
         return meta
 
     def _read_block(self, cold_dir: str, block: dict, widths: Mapping) -> dict:
         """Verify + load one cold block back into (csr shards, columns)."""
-        path = os.path.join(cold_dir, block["name"])
+        path = self._block_path(cold_dir, block)
         try:
             actual = _sha256_file(path)
         except OSError as e:
@@ -503,14 +548,40 @@ class CorpusStore:
 
     # -------------------------------------------------------------- compaction
 
-    def write_cold_generation(self, cold_id: int, index_maps: Mapping, manifest) -> dict:
+    def write_cold_generation(
+        self,
+        cold_id: int,
+        index_maps: Mapping,
+        manifest,
+        retain_min_gen: int = 0,
+        max_cold_rows: Optional[int] = None,
+        protect_min_gen: int = 0,
+    ) -> dict:
         """Fold the previous cold generation plus EVERY live segment into
-        ``cold-<cold_id>/`` — streamed blockwise (cold reads one block at a
-        time; live segments re-decode per segment with frozen maps), peak RAM
-        O(block + largest segment), never O(history). Staged + atomic rename;
-        the caller's checkpoint commit is what makes it authoritative.
-        Returns the new cold manifest; call :meth:`install_cold` with it
-        AFTER that commit lands to adopt it as the current cold generation."""
+        ``cold-<cold_id>/`` — INCREMENTALLY. Unchanged previous blocks are
+        adopted by reference into the content-addressed pool (zero re-encode,
+        zero re-read; legacy in-dir blocks enter the pool by hard link,
+        fallback copy), only the partial tail block and the live segments
+        re-encode, so bytes written per compaction are O(delta + tail block)
+        and cold-tier read I/O is O(seam blocks), never O(history). Peak RAM
+        stays O(block + largest segment).
+
+        Retention: rows with generation below ``retain_min_gen`` are DELETED
+        from the fold — fully expired blocks drop whole (no read), the one
+        seam block rewrites row-sliced, everything younger reuses. With
+        ``max_cold_rows`` set, oldest surviving blocks additionally drop at
+        BLOCK granularity until the fold fits the cap — but never a block
+        that still reaches generation ``protect_min_gen`` (the training
+        window), so retention can only delete rows whose training weight is
+        already zero and the training math is untouched by construction.
+
+        Staged + atomic rename; the caller's checkpoint commit is what makes
+        it authoritative (pool writes are content-addressed and idempotent —
+        unreferenced until then, garbage-collected if the commit never
+        lands). Returns the new cold manifest with an ``io`` stats dict
+        (bytes/blocks written, reused, dropped — the honest-ratio inputs;
+        not persisted in the manifest file); call :meth:`install_cold` with
+        it AFTER the commit lands."""
         # compaction permanently EXEMPTS the folded files from every future
         # verification (the cold tier becomes their corpus of record), so
         # this is the last chance to catch a same-size rewrite: full-content
@@ -522,12 +593,72 @@ class CorpusStore:
         tmp = final + _TMP_SUFFIX
         shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp)
+        os.makedirs(self._pool_dir(), exist_ok=True)
 
         writer = _BlockWriter(
-            tmp, self.block_rows, widths, self.id_tags
+            self._pool_dir(), self.block_rows, widths, self.id_tags
         )
-        for chunk in self._iter_cold_chunks(min_gen=0, widths=widths):
-            writer.push(chunk)
+        prev_blocks = list(self.cold["blocks"]) if self.cold is not None else []
+        prev_dir = self.cold["path"] if self.cold is not None else None
+        retain_min = int(retain_min_gen)
+        rows_dropped = 0
+        blocks_dropped = 0
+
+        def _n(b):
+            return int(b["rows"][1]) - int(b["rows"][0])
+
+        def _expired(b):
+            return int(b["gen_hi"]) < retain_min
+
+        # block-granular row cap: drop oldest surviving blocks until the fold
+        # fits, stopping at the first block that reaches the protected window.
+        # The estimate counts the retention seam block WHOLE (its expired
+        # prefix is sliced later without a read here), so the cap can drop up
+        # to one block more than strictly needed — best-effort at block
+        # granularity in both directions, and only ever below-window rows.
+        # With protect_min_gen <= 0 the window still needs EVERY generation,
+        # so the cap waits — it can only ever delete zero-weight rows.
+        cap_drop: set = set()
+        if max_cold_rows is not None and int(protect_min_gen) > 0:
+            total = sum(s.n_rows for s in self.segments) + sum(
+                _n(b) for b in prev_blocks if not _expired(b)
+            )
+            for i, b in enumerate(prev_blocks):
+                if total <= int(max_cold_rows):
+                    break
+                if _expired(b):
+                    continue
+                if int(b["gen_hi"]) >= int(protect_min_gen):
+                    break
+                cap_drop.add(i)
+                total -= _n(b)
+
+        keep_idx = [
+            i
+            for i, b in enumerate(prev_blocks)
+            if not _expired(b) and i not in cap_drop
+        ]
+        last_keep = keep_idx[-1] if keep_idx else -1
+        for i, b in enumerate(prev_blocks):
+            if _expired(b) or i in cap_drop:
+                faultpoint(FP_COLD_DELETE)
+                rows_dropped += _n(b)
+                blocks_dropped += 1
+                continue
+            seam = int(b["gen_lo"]) < retain_min
+            tail_partial = i == last_keep and _n(b) < self.block_rows
+            if seam or tail_partial:
+                # the only cold reads of the fold: the retention seam block
+                # and the partial tail block (merged with the delta)
+                chunk = self._read_block(prev_dir, b, widths)
+                if seam:
+                    keep = np.asarray(chunk["row_gens"]) >= retain_min
+                    rows_dropped += int((~keep).sum())
+                    chunk = _slice_chunk(chunk, np.flatnonzero(keep))
+                if len(chunk["labels"]):
+                    writer.push(chunk)
+            else:
+                writer.reuse(b, prev_dir)
         for chunk in self._iter_live_chunks(
             manifest, self.segments, index_maps, widths, min_gen=0
         ):
@@ -555,6 +686,13 @@ class CorpusStore:
             shutil.rmtree(final)
         os.rename(tmp, final)
         meta["path"] = final
+        # io stats ride on the RETURNED meta only (never in manifest.json):
+        # the manifest must stay a pure function of the folded rows
+        meta["io"] = {
+            **writer.io_stats(),
+            "rows_dropped": int(rows_dropped),
+            "blocks_dropped": int(blocks_dropped),
+        }
         return meta
 
     def install_cold(self, meta: dict, clear_segments: bool = True) -> None:
@@ -615,6 +753,53 @@ class CorpusStore:
                         os.remove(os.path.join(archive_dir, name))
                     except OSError:
                         pass
+        self._gc_pool()
+
+    def _gc_pool(self) -> None:
+        """Refcount sweep of the content-addressed block pool: a pool block
+        survives iff SOME surviving cold generation's manifest references its
+        digest — the manifests ARE the refcount, recomputed from disk so it
+        can never go stale. Everything else (a crashed compaction's published
+        blocks, blocks whose last referencing generation aged out of
+        ``keep_cold``, stale staging files) deletes. Conservative on damage:
+        an unreadable manifest makes the reference set unknowable, so the
+        sweep SKIPS deleting rather than risk a block a generation still
+        needs (the damage itself fails loudly on the next read)."""
+        pool = self._pool_dir()
+        if not os.path.isdir(pool):
+            return
+        referenced: set = set()
+        for name in sorted(os.listdir(self.directory)):
+            if not name.startswith(COLD_PREFIX) or name.endswith(_TMP_SUFFIX):
+                continue
+            try:
+                meta = self._load_cold_manifest(int(name[len(COLD_PREFIX):]))
+            except (ColdStoreCorruption, ValueError) as e:
+                logger.warning(
+                    "skipping pool garbage collection: cold manifest %s is "
+                    "unreadable (%s)", name, e,
+                )
+                return
+            referenced |= {
+                b["sha256"] for b in meta["blocks"] if "name" not in b
+            }
+        for fname in sorted(os.listdir(pool)):
+            path = os.path.join(pool, fname)
+            m = _POOL_RE.match(fname)
+            if m is None:
+                if _TMP_SUFFIX in fname:  # staging leftovers from a crash
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                continue
+            if m.group(1) in referenced:
+                continue
+            faultpoint(FP_COLD_DELETE)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     def adopt_state(self, state: Optional[dict]) -> None:
         """Restore tier bookkeeping from a checkpoint's ``extra_state`` blob
@@ -768,6 +953,49 @@ class CorpusStore:
         os.replace(tmp, path)
         return path
 
+    def archive_compact(self, cid: str, min_evicted_at: int) -> int:
+        """Age out one coordinate's archive: drop entries whose eviction
+        generation predates ``min_evicted_at`` (their coefficients are past
+        the re-admission horizon — a reappearing entity that old re-solves
+        from zero like a brand-new one). Surviving entries rewrite in place
+        (staged + renamed, digest inside); an emptied archive removes its
+        file. Idempotent: a crash-replayed pass recomputes the same cutoff
+        and finds nothing left to drop, so the bytes converge. Returns the
+        number of entries dropped."""
+        prev = self.archive_load(cid)
+        if prev is None:
+            return 0
+        gens = np.asarray(prev["evicted_at"])
+        keep = np.flatnonzero(gens >= int(min_evicted_at))
+        dropped = int(len(gens) - len(keep))
+        if not dropped:
+            return 0
+        path = self._archive_path(cid)
+        faultpoint(FP_COLD_DELETE)
+        if not len(keep):
+            os.remove(path)
+            return dropped
+        arrays: dict = {
+            "coeffs": np.asarray(prev["coeffs"])[keep],
+            "proj": np.asarray(prev["proj"])[keep],
+            "evicted_at": gens[keep],
+        }
+        _encode_column(
+            "entity_ids",
+            id_array([prev["entity_ids"][i] for i in keep]),
+            arrays,
+        )
+        if "variances" in prev:
+            arrays["variances"] = np.asarray(prev["variances"])[keep]
+        arrays[_DIGEST_KEY] = np.asarray(_arrays_digest(arrays))
+        tmp = path + f"{_TMP_SUFFIX}-{os.getpid()}.npz"
+        action = faultpoint(FP_COLD_WRITE)
+        np.savez(tmp, **arrays)
+        if action == "corrupt":
+            corrupt_file(tmp)
+        os.replace(tmp, path)
+        return dropped
+
 
 # --------------------------------------------------------------- chunk plumbing
 
@@ -867,11 +1095,15 @@ def _chunks_to_snapshot(
 
 class _BlockWriter:
     """Re-blocking accumulator: takes arbitrarily sized row chunks, emits
-    fixed ``block_rows`` blocks (the last one partial), each written as one
-    checksummed npz. Holds at most ~2 blocks of rows at a time."""
+    ``block_rows``-row blocks (the last one partial) into the content-
+    addressed pool, each written staged + ``os.replace``-committed under its
+    own SHA-256 name (idempotent: a crash-replayed fold rewrites identical
+    bytes to identical names). Holds at most ~2 blocks of rows at a time.
+    :meth:`reuse` adopts an unchanged previous block by reference instead —
+    the zero-copy fast path of an incremental compaction."""
 
-    def __init__(self, directory: str, block_rows: int, widths: dict, id_tags):
-        self.directory = directory
+    def __init__(self, pool_dir: str, block_rows: int, widths: dict, id_tags):
+        self.pool_dir = pool_dir
         self.block_rows = block_rows
         self.widths = widths
         self.id_tags = tuple(id_tags)
@@ -879,6 +1111,9 @@ class _BlockWriter:
         self.pending_rows = 0
         self.blocks: list[dict] = []
         self.n_rows = 0
+        self.bytes_written = 0
+        self.bytes_reused = 0
+        self.blocks_reused = 0
 
     def push(self, chunk: dict) -> None:
         self.pending.append(chunk)
@@ -886,10 +1121,67 @@ class _BlockWriter:
         while self.pending_rows >= self.block_rows:
             self._emit(self.block_rows)
 
+    def reuse(self, block: dict, src_dir: Optional[str]) -> None:
+        """Adopt one unchanged previous block by reference: pool blocks cost
+        nothing (the digest IS the address); a legacy in-dir block enters the
+        pool by hard link (fallback: copy — then its bytes honestly count as
+        written, docs/PERFORMANCE.md). Pending partial rows flush first so
+        row order is preserved — reuse never reorders the corpus."""
+        if self.pending_rows:
+            self._emit(self.pending_rows)
+        faultpoint(FP_COLD_LINK)
+        sha = block["sha256"]
+        final = os.path.join(self.pool_dir, f"{sha}.npz")
+        copied = 0
+        if not os.path.exists(final):
+            if "name" not in block:
+                raise ColdStoreCorruption(
+                    f"cold block {sha} vanished from the pool"
+                )
+            src = os.path.join(src_dir, block["name"])
+            try:
+                os.link(src, final)
+            except FileExistsError:
+                pass  # a crash-replayed fold already linked it
+            except OSError:
+                tmp = final + f"{_TMP_SUFFIX}-{os.getpid()}"
+                shutil.copyfile(src, tmp)
+                os.replace(tmp, final)
+                copied = os.path.getsize(final)
+        n = int(block["rows"][1]) - int(block["rows"][0])
+        nbytes = int(block.get("nbytes") or os.path.getsize(final))
+        self.blocks.append(
+            {
+                "sha256": sha,
+                "rows": [self.n_rows, self.n_rows + n],
+                "gen_lo": int(block["gen_lo"]),
+                "gen_hi": int(block["gen_hi"]),
+                "nbytes": nbytes,
+            }
+        )
+        self.n_rows += n
+        if copied:
+            # a copy is real write I/O at BOTH granularities: counting the
+            # block as reused would show an O(delta) block profile on a fold
+            # that physically wrote O(history) (honest-ratio rules,
+            # docs/PERFORMANCE.md)
+            self.bytes_written += copied
+        else:
+            self.bytes_reused += nbytes
+            self.blocks_reused += 1
+
     def finish(self) -> tuple[list, int]:
         while self.pending_rows > 0:
             self._emit(min(self.block_rows, self.pending_rows))
         return self.blocks, self.n_rows
+
+    def io_stats(self) -> dict:
+        return {
+            "bytes_written": int(self.bytes_written),
+            "bytes_reused": int(self.bytes_reused),
+            "blocks_written": len(self.blocks) - self.blocks_reused,
+            "blocks_reused": int(self.blocks_reused),
+        }
 
     def _emit(self, rows: int) -> None:
         take: list[dict] = []
@@ -932,21 +1224,29 @@ class _BlockWriter:
             arrays[f"feat__{shard}__data"] = m.data
             arrays[f"feat__{shard}__indices"] = m.indices
             arrays[f"feat__{shard}__indptr"] = m.indptr
-        name = f"{BLOCK_PREFIX}{len(self.blocks):06d}.npz"
-        path = os.path.join(self.directory, name)
+        tmp = os.path.join(
+            self.pool_dir,
+            f"{_TMP_SUFFIX}-{os.getpid()}-{len(self.blocks):06d}.npz",
+        )
         action = faultpoint(FP_COLD_WRITE)
-        np.savez(path, **arrays)
-        sha = _sha256_file(path)
+        np.savez(tmp, **arrays)
+        sha = _sha256_file(tmp)
+        # content-addressed commit: the digest IS the file name, so a
+        # crash-replayed fold re-lands identical bytes on identical names
+        # (os.replace over an already-published block is a no-op by content)
+        path = os.path.join(self.pool_dir, f"{sha}.npz")
+        os.replace(tmp, path)
         if action == "corrupt":
             corrupt_file(path)  # post-checksum: exactly what reads must catch
         gens = np.asarray(merged["row_gens"])
         self.blocks.append(
             {
-                "name": name,
+                "sha256": sha,
                 "rows": [self.n_rows, self.n_rows + rows],
                 "gen_lo": int(gens.min()),
                 "gen_hi": int(gens.max()),
-                "sha256": sha,
+                "nbytes": os.path.getsize(path),
             }
         )
         self.n_rows += rows
+        self.bytes_written += os.path.getsize(path)

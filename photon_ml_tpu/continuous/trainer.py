@@ -153,6 +153,32 @@ class ContinuousTrainerConfig:
     window_generations: Optional[int] = None
     decay_half_life: Optional[float] = None
     cold_block_rows: int = DEFAULT_BLOCK_ROWS
+    # ---- retention & streaming knobs (the O(delta) cold tier, PR 15) ----
+    # cold-tier row retention: at each compaction, DELETE rows older than
+    # this many generations (must cover the training window, so deletion can
+    # only touch rows whose training weight is already zero — the training
+    # math is untouched by construction and the knob stays out of the
+    # fingerprint). None = the cold tier preserves full history.
+    max_row_age_gens: Optional[int] = None
+    # best-effort cap on cold-tier rows, enforced at BLOCK granularity at
+    # each compaction (oldest blocks drop first; blocks still reaching the
+    # training window are never dropped, so the cap may be overshot while
+    # the window needs the rows)
+    max_cold_rows: Optional[int] = None
+    # archive age-out: at each compaction, drop evicted-coefficient archive
+    # entries whose eviction is older than this many generations (a that-old
+    # reappearing entity re-solves from zero like a brand-new one)
+    archive_max_age_gens: Optional[int] = None
+    # streaming bootstrap / backlog pacing: ingest at most this many part
+    # files per pass. A fresh trainer pointed at a DEEP pre-existing corpus
+    # then replays the backlog incrementally through the same windowed delta
+    # passes a live trainer runs — resident corpus bytes stay
+    # O(window + delta) instead of one O(corpus) bootstrap materialization,
+    # and the committed generations are byte-identical to a trainer that
+    # lived through the history at the same file-per-pass pacing. Grouping
+    # mirrors arrival pacing (external to the model), so it stays out of the
+    # fingerprint. None = ingest everything the scan finds (PR 7 behavior).
+    max_files_per_pass: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -172,6 +198,10 @@ class GenerationResult:
     timings: dict  # phase -> seconds
     view_rows: int = 0  # rows materialized in the training view (the window)
     compacted: bool = False  # this commit folded the corpus into a cold gen
+    # compaction I/O: {bytes_written, bytes_reused, blocks_written,
+    # blocks_reused, blocks_dropped, rows_dropped} — the block-reuse /
+    # retention paper trail (None on non-compacting passes)
+    cold_stats: Optional[dict] = None
 
     @property
     def active_fraction(self) -> float:
@@ -270,7 +300,11 @@ class ContinuousTrainer:
                 f"{cfg.window_mode!r}; pass window_mode='decay' (a silently "
                 "ignored half-life would train a different model than asked)"
             )
-        for knob in ("window_generations", "evict_idle_generations", "compact_every"):
+        for knob in (
+            "window_generations", "evict_idle_generations", "compact_every",
+            "max_row_age_gens", "max_cold_rows", "archive_max_age_gens",
+            "max_files_per_pass",
+        ):
             v = getattr(cfg, knob)
             if v is not None and v < 1:
                 raise ValueError(f"{knob} must be >= 1, got {v}")
@@ -279,6 +313,46 @@ class ContinuousTrainer:
                 "evict_idle_generations needs at least one random-effect "
                 "coordinate (the fixed effect has no entities to evict)"
             )
+        # retention may only delete rows the training window already weighs
+        # zero — anything else would silently train a different model
+        for knob in ("max_row_age_gens", "max_cold_rows"):
+            if getattr(cfg, knob) is None:
+                continue
+            if cfg.window_mode == "full" or not cfg.window_generations:
+                raise ValueError(
+                    f"{knob} requires a bounded training window "
+                    "(window_mode='sliding' or 'decay' with "
+                    "window_generations): with an unbounded window every "
+                    "accumulated row still trains, so retention would "
+                    "delete rows the model needs"
+                )
+            if not cfg.compact_every:
+                raise ValueError(
+                    f"{knob} acts at compaction time; set compact_every "
+                    "or the knob silently never fires"
+                )
+        if (
+            cfg.max_row_age_gens is not None
+            and cfg.window_generations
+            and cfg.max_row_age_gens < cfg.window_generations
+        ):
+            raise ValueError(
+                f"max_row_age_gens ({cfg.max_row_age_gens}) must cover the "
+                f"training window ({cfg.window_generations} generations): "
+                "retention inside the window would delete rows that still "
+                "carry training weight"
+            )
+        if cfg.archive_max_age_gens is not None:
+            if not cfg.evict_idle_generations:
+                raise ValueError(
+                    "archive_max_age_gens ages out the EVICTION archive; "
+                    "it needs evict_idle_generations"
+                )
+            if not cfg.compact_every:
+                raise ValueError(
+                    "archive_max_age_gens acts at compaction time; set "
+                    "compact_every or the knob silently never fires"
+                )
 
     @property
     def snapshot(self) -> Optional[CorpusSnapshot]:
@@ -294,6 +368,24 @@ class ContinuousTrainer:
         if self.config.window_mode == "full" or not w:
             return 0
         return max(0, int(generation) - int(w) + 1)
+
+    def _retention_min_gen(self, generation: int) -> int:
+        """Oldest generation the cold tier RETAINS at pass ``generation``'s
+        compaction (0 = keep everything). Validation pins this at or below
+        the window floor, so deletion only ever reaches zero-weight rows."""
+        r = self.config.max_row_age_gens
+        if not r:
+            return 0
+        return max(0, int(generation) - int(r) + 1)
+
+    def _archive_min_evicted_at(self, generation: int) -> Optional[int]:
+        """Archive age-out horizon at pass ``generation``: entries evicted
+        before this never warm re-admit, and ``archive_compact`` physically
+        drops them at compaction cadence. None when age-out is off."""
+        a = self.config.archive_max_age_gens
+        if not a:
+            return None
+        return int(generation) - int(a)
 
     # ------------------------------------------------------------- restore
 
@@ -314,6 +406,11 @@ class ContinuousTrainer:
             )
         if cfg.evict_idle_generations:
             parts.append(f"evict={cfg.evict_idle_generations}")
+        # the archive horizon decides which re-admissions warm-start — that
+        # IS training math, unlike max_row_age_gens/max_cold_rows, which
+        # only delete rows the window already weighs zero
+        if cfg.archive_max_age_gens:
+            parts.append(f"archive_age={cfg.archive_max_age_gens}")
         return "|".join(parts)
 
     def _restore(self) -> None:
@@ -635,6 +732,12 @@ class ContinuousTrainer:
         timings["scan"] = time.perf_counter() - t0
         if not new_files:
             return None
+        cap = self.config.max_files_per_pass
+        if cap is not None and len(new_files) > cap:
+            # streaming bootstrap / backlog pacing: drain a deep corpus in
+            # bounded per-pass bites (oldest first — listing order IS ingest
+            # order); the next poll picks up where this one stopped
+            new_files = new_files[:cap]
         bootstrap = self.models is None
         gen_next = self.generation + 1
 
@@ -706,7 +809,12 @@ class ContinuousTrainer:
                     ]
                     if back:
                         adapted[cid], n = inject_archived_rows(
-                            adapted[cid], self.store.archive_load(cid), back
+                            adapted[cid],
+                            self.store.archive_load(cid),
+                            back,
+                            min_evicted_at=self._archive_min_evicted_at(
+                                gen_next
+                            ),
                         )
                         readmitted[cid] = n
                     # a reappearing entity that got NO model row (its delta
@@ -809,8 +917,18 @@ class ContinuousTrainer:
             if do_compact:
                 faultpoint(FP_COMPACT)
                 cold_meta = self.store.write_cold_generation(
-                    gen_next, view.index_maps, grown_manifest
+                    gen_next,
+                    view.index_maps,
+                    grown_manifest,
+                    retain_min_gen=self._retention_min_gen(gen_next),
+                    max_cold_rows=self.config.max_cold_rows,
+                    protect_min_gen=self._window_min_gen(gen_next),
                 )
+                if self.config.archive_max_age_gens:
+                    for cid in self.re_types:
+                        self.store.archive_compact(
+                            cid, self._archive_min_evicted_at(gen_next)
+                        )
                 manifest_to_commit = grown_manifest.compact(
                     n_rows=cold_meta["n_rows"]
                 )
@@ -830,7 +948,14 @@ class ContinuousTrainer:
                 "continuous": {
                     "kind": "bootstrap" if bootstrap else "delta",
                     "corpus_manifest": manifest_to_commit.to_dict(),
-                    "n_rows": self.store.total_rows,
+                    # the total THIS COMMIT's store state holds: a retention
+                    # compaction deletes rows at the fold, so the pre-install
+                    # in-memory total would overstate the committed tier
+                    "n_rows": (
+                        int(cold_meta["n_rows"])
+                        if do_compact
+                        else self.store.total_rows
+                    ),
                     "view_rows": view.n_rows,
                     "n_new_rows": delta.n_new_rows,
                     "n_new_files": delta.n_new_files,
@@ -898,6 +1023,7 @@ class ContinuousTrainer:
             timings=timings,
             view_rows=view.n_rows,
             compacted=do_compact,
+            cold_stats=None if cold_meta is None else dict(cold_meta["io"]),
         )
         self.last_result = result
         logger.info(

@@ -242,12 +242,20 @@ def _model_from_arrays(meta: dict, arrays, dtype) -> object:
 # ---------------------------------------------------------------- plumbing
 
 
-def _sha256_file(path: str) -> str:
+def sha256_file(path: str) -> str:
+    """Streaming SHA-256 of a file's bytes — the ONE content-fingerprint
+    primitive every durable artifact in the store shares (checkpoint
+    manifests/arrays here, corpus part files in continuous/manifest.py, and
+    the content-addressed cold block pool in continuous/store.py, whose pool
+    file NAMES are these digests)."""
     h = hashlib.sha256()
     with open(path, "rb") as f:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+_sha256_file = sha256_file
 
 
 def _write_models(directory: str, subdir: str, models: dict, manifest: dict,

@@ -84,6 +84,11 @@ def test_registry_covers_every_chaos_sweep():
         "continuous.compact",
         "continuous.evict",
         "continuous.cold_write",
+        # the incremental cold tier (PR 15): block reuse adoption and
+        # retention/refcount deletion, swept by the same scenario (its
+        # crashed pass reuses, drops and ages out on the replayed path)
+        "continuous.cold_link",
+        "continuous.cold_delete",
     } == set(CONTINUOUS_POINTS)
     assert {p.split(".", 1)[0] for p in SERVE_POINTS} == {"serve"}
     assert {

@@ -769,10 +769,15 @@ CONTINUOUS_POINTS = (
 )
 # the out-of-core store's points only fire on a compaction/eviction-enabled
 # pass: they get their own sweep over a scenario that exercises all of them
+# (cold_link needs an INCREMENTAL compaction — a previous cold generation
+# whose blocks the fold reuses; cold_delete needs retention expiry or an
+# archive age-out on the swept pass)
 STORE_POINTS = (
     "continuous.compact",
     "continuous.evict",
     "continuous.cold_write",
+    "continuous.cold_link",
+    "continuous.cold_delete",
 )
 
 
@@ -1003,9 +1008,9 @@ class TestCorpusStoreTiers:
         t = make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
                          cold_block_rows=32)
         t.poll_once()
-        cold = tmp_path / "ckpt" / "corpus-store" / "cold-00000001"
-        victim = sorted(f for f in os.listdir(cold) if f.startswith("block-"))[0]
-        corrupt_file(str(cold / victim))
+        pool = tmp_path / "ckpt" / "corpus-store" / "blocks"
+        victim = sorted(f for f in os.listdir(pool) if f.endswith(".npz"))[0]
+        corrupt_file(str(pool / victim))
         with pytest.raises(ColdStoreCorruption, match="checksum mismatch"):
             make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
                          cold_block_rows=32)
@@ -1024,6 +1029,459 @@ class TestCorpusStoreTiers:
         colds = sorted(n for n in os.listdir(store_dir) if n.startswith("cold-"))
         # keep_cold=2: the referenced cold gen + one rollback step
         assert colds == ["cold-00000003", "cold-00000004"]
+
+
+def _cold_manifest(ckpt, cold_id):
+    import json
+
+    path = os.path.join(
+        str(ckpt), "corpus-store", f"cold-{cold_id:08d}", "manifest.json"
+    )
+    with open(path) as f:
+        return json.load(f)
+
+
+def _pool_shas(ckpt):
+    pool = os.path.join(str(ckpt), "corpus-store", "blocks")
+    return {
+        n[: -len(".npz")]
+        for n in os.listdir(pool)
+        if n.endswith(".npz") and ".tmp" not in n
+    }
+
+
+class TestColdBlockReuse:
+    """The O(delta) cold tier: incremental compactions adopt unchanged
+    blocks by reference into the content-addressed pool instead of
+    re-encoding O(history)."""
+
+    def test_second_compaction_reuses_blocks_and_writes_only_the_delta(
+        self, tmp_path
+    ):
+        rng = np.random.default_rng(81)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        # 128 bootstrap rows = exactly 2 blocks of 64: the first fold's full
+        # blocks must ride into the second fold untouched
+        write_part(corpus / "part-00000.avro", rng, 128, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", compact_every=2,
+                         cold_block_rows=64)
+        t.poll_once()
+        write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+        r2 = t.poll_once()
+        assert r2.compacted
+        assert r2.cold_stats["blocks_reused"] == 0  # nothing cold to reuse yet
+        first_blocks = {
+            b["sha256"] for b in _cold_manifest(tmp_path / "ckpt", 2)["blocks"]
+        }
+        write_part(corpus / "part-00002.avro", rng, 30, ["u0"])
+        t.poll_once()
+        write_part(corpus / "part-00003.avro", rng, 30, ["u0"])
+        r4 = t.poll_once()
+        assert r4.compacted
+        stats = r4.cold_stats
+        # the 2 full bootstrap blocks reuse by reference; only the partial
+        # tail + the two live deltas re-encode — O(delta + tail block)
+        assert stats["blocks_reused"] == 2
+        assert stats["bytes_reused"] > 0
+        assert stats["blocks_written"] <= 2
+        assert stats["bytes_written"] < stats["bytes_reused"]
+        second = _cold_manifest(tmp_path / "ckpt", 4)
+        reused = {b["sha256"] for b in second["blocks"]} & first_blocks
+        assert len(reused) == 2  # same digests, same bytes, never rewritten
+        # the restart contract still holds bitwise through the reused blocks
+        t2 = make_trainer(corpus, tmp_path / "ckpt", compact_every=2,
+                          cold_block_rows=64)
+        np.testing.assert_array_equal(
+            np.asarray(t2.snapshot.data.labels),
+            np.asarray(t.snapshot.data.labels),
+        )
+        np.testing.assert_array_equal(t2.snapshot.uids, t.snapshot.uids)
+
+    def test_prune_never_deletes_a_block_the_surviving_generation_references(
+        self, tmp_path
+    ):
+        """The refcount contract: the manifests of the kept cold generations
+        ARE the pool's reference set — prune_cold garbage-collects exactly
+        the unreferenced blocks, never a referenced one."""
+        rng = np.random.default_rng(83)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 128, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
+                         cold_block_rows=64)
+        t.poll_once()
+        for k in (1, 2, 3):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 20, ["u0"])
+            t.poll_once()
+        # keep_cold=2 kept cold-3 and cold-4; every sha they reference must
+        # exist in the pool, and nothing else may remain
+        referenced = {
+            b["sha256"]
+            for cid in (3, 4)
+            for b in _cold_manifest(tmp_path / "ckpt", cid)["blocks"]
+        }
+        assert _pool_shas(tmp_path / "ckpt") == referenced
+        # an orphan pool block (crashed compaction leftovers) sweeps; the
+        # referenced blocks survive the same prune
+        pool = tmp_path / "ckpt" / "corpus-store" / "blocks"
+        orphan = pool / ("ab" * 32 + ".npz")
+        orphan.write_bytes(b"orphaned by a crash")
+        t.store.prune_cold(referenced=4)
+        assert not orphan.exists()
+        assert _pool_shas(tmp_path / "ckpt") == referenced
+
+    def test_unreadable_cold_manifest_skips_pool_gc_conservatively(
+        self, tmp_path
+    ):
+        """A damaged manifest makes the reference set unknowable: the GC
+        must refuse to delete ANY pool block (the damage itself fails loudly
+        at the next read) rather than drop one a generation still needs."""
+        rng = np.random.default_rng(84)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 64, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
+                         cold_block_rows=64)
+        t.poll_once()
+        before = _pool_shas(tmp_path / "ckpt")
+        man = (tmp_path / "ckpt" / "corpus-store" / "cold-00000001"
+               / "manifest.json")
+        man.write_text(man.read_text() + " ")  # checksum now mismatches
+        pool = tmp_path / "ckpt" / "corpus-store" / "blocks"
+        orphan = pool / ("cd" * 32 + ".npz")
+        orphan.write_bytes(b"would be garbage")
+        t.store.prune_cold(referenced=1)
+        assert orphan.exists()  # GC skipped: nothing deleted
+        assert before <= _pool_shas(tmp_path / "ckpt")
+
+    def test_legacy_in_dir_cold_generation_reads_and_links_into_the_pool(
+        self, tmp_path
+    ):
+        """Backward compat for format-1 cold manifests (blocks inside the
+        generation directory): restart reads them verbatim, and the next
+        compaction adopts their blocks into the pool by hard link (fallback
+        copy) instead of re-encoding."""
+        import json
+
+        rng = np.random.default_rng(85)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 128, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
+                         cold_block_rows=64)
+        t.poll_once()
+        ref_labels = np.asarray(t.snapshot.data.labels).copy()
+        del t
+        # rewrite cold-1 in the legacy layout: blocks move INTO the dir
+        # under block-<k>.npz names, the manifest gains "name" per block
+        store_dir = tmp_path / "ckpt" / "corpus-store"
+        cold = store_dir / "cold-00000001"
+        meta = _cold_manifest(tmp_path / "ckpt", 1)
+        meta["format"] = 1
+        for k, b in enumerate(meta["blocks"]):
+            b["name"] = f"block-{k:06d}.npz"
+            b.pop("nbytes", None)
+            shutil.copy(
+                store_dir / "blocks" / f"{b['sha256']}.npz", cold / b["name"]
+            )
+        man = cold / "manifest.json"
+        man.write_text(json.dumps(meta))
+        (cold / "manifest.json.sha256").write_text(
+            hashlib.sha256(man.read_bytes()).hexdigest() + "\n"
+        )
+        shutil.rmtree(store_dir / "blocks")  # pure v1 store on disk
+
+        t2 = make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
+                          cold_block_rows=64)
+        np.testing.assert_array_equal(
+            np.asarray(t2.snapshot.data.labels), ref_labels
+        )
+        write_part(corpus / "part-00001.avro", rng, 20, ["u0"])
+        r2 = t2.poll_once()
+        assert r2.compacted
+        # the 2 full legacy blocks were adopted without re-encoding
+        assert r2.cold_stats["blocks_reused"] == 2
+        meta2 = _cold_manifest(tmp_path / "ckpt", 2)
+        assert int(meta2["format"]) == 2
+        assert all("name" not in b for b in meta2["blocks"])
+        # and the linked bytes still verify + materialize bitwise
+        t3 = make_trainer(corpus, tmp_path / "ckpt", compact_every=1,
+                          cold_block_rows=64)
+        np.testing.assert_array_equal(
+            np.asarray(t3.snapshot.data.labels)[: len(ref_labels)], ref_labels
+        )
+
+    def test_crash_between_link_and_manifest_publish_replays_clean(
+        self, tmp_path
+    ):
+        """Kill the fold between block adoption and manifest publish: the
+        replay must converge to zero duplicate/orphan pool blocks and a
+        bitwise-identical materialization vs an uninterrupted run."""
+        rng = np.random.default_rng(87)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 128, USERS)
+        kw = dict(compact_every=2, cold_block_rows=64)
+        t = make_trainer(corpus, tmp_path / "ckpt", **kw)
+        t.poll_once()
+        write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+        t.poll_once()  # cold-2 on disk
+        write_part(corpus / "part-00002.avro", rng, 30, ["u0"])
+        t.poll_once()
+        del t
+        shutil.copytree(tmp_path / "ckpt", tmp_path / "ckpt-ref")
+        write_part(corpus / "part-00003.avro", rng, 30, ["u0"])  # pending gen 4
+
+        def run_loop(ckpt):
+            t = make_trainer(corpus, ckpt, **kw)
+            while t.poll_once() is not None:
+                pass
+            return t
+
+        ref = run_loop(tmp_path / "ckpt-ref")
+        assert ref.last_result.compacted
+        _, outcome = run_with_crash_at(
+            lambda: run_loop(tmp_path / "ckpt"), "continuous.cold_link"
+        )
+        assert outcome.crashed and outcome.restarts >= 1
+        assert_trees_identical(
+            str(tmp_path / "ckpt-ref"), str(tmp_path / "ckpt")
+        )
+        # zero duplicates: the pool is exactly the union of the surviving
+        # manifests' references
+        referenced = {
+            b["sha256"]
+            for cid in (2, 4)
+            for b in _cold_manifest(tmp_path / "ckpt", cid)["blocks"]
+        }
+        assert _pool_shas(tmp_path / "ckpt") == referenced
+
+
+class TestRetention:
+    """Cold-tier row deletion: sliding-window/time-decay aging can now DROP
+    rows at compaction — only ever rows whose training weight is already
+    zero, so the trained model is bitwise unaffected."""
+
+    def test_retention_deletes_history_without_changing_the_model(
+        self, tmp_path
+    ):
+        rng = np.random.default_rng(91)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        base = dict(window_mode="sliding", window_generations=2,
+                    compact_every=2, cold_block_rows=64)
+        write_part(corpus / "part-00000.avro", rng, 128, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", max_row_age_gens=2,
+                         **base)
+        tw = make_trainer(corpus, tmp_path / "ckpt-tw", **base)  # full history
+        t.poll_once()
+        tw.poll_once()
+        dropped = 0
+        for k in range(1, 7):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 30, USERS)
+            r = t.poll_once()
+            tw.poll_once()
+            if r.compacted:
+                dropped += r.cold_stats["rows_dropped"]
+        assert dropped > 0
+        # the retained tier holds only the window's generations ...
+        assert t.store.total_rows < tw.store.total_rows
+        assert t.store.cold_rows <= 2 * 30 + 30  # last 2 gens + block slack
+        # ... and the models are bitwise the full-history trainer's
+        np.testing.assert_array_equal(
+            np.asarray(t.models["per-user"].coeffs),
+            np.asarray(tw.models["per-user"].coeffs),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(t.models["global"].model.coefficients.means),
+            np.asarray(tw.models["global"].model.coefficients.means),
+        )
+        # restart from the retained store replays cleanly
+        t2 = make_trainer(corpus, tmp_path / "ckpt", max_row_age_gens=2,
+                          **base)
+        np.testing.assert_array_equal(
+            np.asarray(t2.snapshot.data.labels),
+            np.asarray(t.snapshot.data.labels),
+        )
+
+    def test_max_cold_rows_caps_the_tier_at_block_granularity(self, tmp_path):
+        rng = np.random.default_rng(93)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 128, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", window_mode="sliding",
+                         window_generations=2, compact_every=2,
+                         cold_block_rows=32, max_cold_rows=96)
+        t.poll_once()
+        for k in range(1, 6):  # gens 2..6: the last pass compacts
+            write_part(corpus / f"part-{k:05d}.avro", rng, 30, USERS)
+            r = t.poll_once()
+        assert r.compacted
+        # the cap is best-effort at block granularity: at most one extra
+        # block beyond the cap, and never an in-window row
+        assert t.store.cold_rows <= 96 + 32
+        assert t.snapshot.n_rows == 60  # the window is intact
+        assert r.cold_stats["blocks_dropped"] > 0
+
+    def test_retention_config_is_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="bounded training window"):
+            make_trainer(tmp_path, tmp_path / "c", max_row_age_gens=4,
+                         compact_every=2)
+        with pytest.raises(ValueError, match="cover the training window"):
+            make_trainer(tmp_path, tmp_path / "c", window_mode="sliding",
+                         window_generations=4, compact_every=2,
+                         max_row_age_gens=2)
+        with pytest.raises(ValueError, match="compaction time"):
+            make_trainer(tmp_path, tmp_path / "c", window_mode="sliding",
+                         window_generations=2, max_row_age_gens=4)
+        with pytest.raises(ValueError, match="bounded training window"):
+            make_trainer(tmp_path, tmp_path / "c", max_cold_rows=100,
+                         compact_every=2)
+        with pytest.raises(ValueError, match="evict_idle_generations"):
+            make_trainer(tmp_path, tmp_path / "c", compact_every=2,
+                         archive_max_age_gens=3)
+
+
+class TestStreamedBootstrap:
+    def test_fresh_start_against_a_backlog_matches_the_live_trainer_bitwise(
+        self, tmp_path
+    ):
+        """max_files_per_pass=1 drains a pre-existing deep corpus through
+        the same windowed delta passes a live trainer ran as the files
+        arrived: every committed generation — the WHOLE checkpoint tree,
+        corpus store included — is byte-identical, while resident corpus
+        bytes stay O(window + delta) instead of O(corpus)."""
+        rng = np.random.default_rng(95)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        base = dict(window_mode="sliding", window_generations=2,
+                    compact_every=2, cold_block_rows=64)
+        # the live trainer polls after each file lands
+        live = make_trainer(corpus, tmp_path / "ckpt-live", **base)
+        for k in range(7):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 30, USERS)
+            live.poll_once()
+        # the streamed bootstrap starts fresh against the full backlog
+        stream = make_trainer(corpus, tmp_path / "ckpt-stream",
+                              max_files_per_pass=1, **base)
+        peaks = []
+        while stream.poll_once() is not None:
+            peaks.append(stream.store.resident_corpus_bytes)
+        assert stream.generation == live.generation == 7
+        assert_trees_identical(
+            str(tmp_path / "ckpt-live"), str(tmp_path / "ckpt-stream")
+        )
+        # bounded resident bytes: the O(corpus) one-shot bootstrap's view
+        # dwarfs the streamed peak
+        onebig = make_trainer(corpus, tmp_path / "ckpt-big", **base)
+        onebig.poll_once()
+        assert max(peaks) < onebig.store.resident_corpus_bytes
+
+    def test_capped_pass_ingests_oldest_files_first(self, tmp_path):
+        rng = np.random.default_rng(97)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        for k in range(3):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 20, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", max_files_per_pass=2)
+        r1 = t.poll_once()
+        assert r1.n_new_rows == 40  # parts 0 and 1
+        assert len(t.manifest.entries) == 2
+        assert t.manifest.entries[0].path.endswith("part-00000.avro")
+        r2 = t.poll_once()
+        assert r2.n_new_rows == 20  # the backlog tail
+        assert t.poll_once() is None
+
+
+class TestArchiveAgeOut:
+    def test_archive_ages_out_old_entries_but_keeps_warm_readmission(
+        self, tmp_path
+    ):
+        """Two eviction waves; the age-out horizon drops the first wave's
+        archive entries at a later compaction while the second wave's
+        survive — a surviving entity still re-admits WARM from its archived
+        coefficients, an aged-out one re-solves from zero like a brand-new
+        entity."""
+        rng = np.random.default_rng(99)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        kw = dict(window_mode="sliding", window_generations=2,
+                  evict_idle_generations=2, compact_every=3,
+                  archive_max_age_gens=3, cold_block_rows=64)
+        write_part(corpus / "part-00000.avro", rng, 160, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", **kw)
+        t.poll_once()
+        # wave 1: u1..u7 idle -> evicted at gen 4 (evicted_at=4)
+        for k in (1, 2, 3):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 30, ["u0"])
+            t.poll_once()
+        assert "u1" in t.evicted["per-user"]
+        # u1 re-admits at gen 5, idles again -> re-evicted (evicted_at=8)
+        write_part(corpus / "part-00004.avro", rng, 30, ["u0", "u1"])
+        t.poll_once()
+        for k in (5, 6, 7):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 30, ["u0"])
+            t.poll_once()
+        assert "u1" in t.evicted["per-user"]
+        archive = t.store.archive_load("per-user")
+        gens_by_id = dict(
+            zip(archive["entity_ids"].tolist(), archive["evicted_at"].tolist())
+        )
+        assert gens_by_id["u1"] > gens_by_id["u2"]
+        # gen 9 compacts: cutoff 9-3=6 drops wave 1 (evicted_at=4), keeps u1
+        write_part(corpus / "part-00008.avro", rng, 30, ["u0"])
+        r9 = t.poll_once()
+        assert r9.compacted
+        archive = t.store.archive_load("per-user")
+        assert set(archive["entity_ids"].tolist()) == {"u1"}
+        assert "u2" in t.evicted["per-user"]  # still evicted, archive gone
+        u1_archived = archive["coeffs"][0].copy()
+        assert np.any(u1_archived != 0)
+
+        # surviving entry: warm re-admission still works
+        write_part(corpus / "part-00009.avro", rng, 12, ["u0", "u1"])
+        r10 = t.poll_once()
+        assert r10.active["per-user"]["n_readmitted"] == 1
+        assert "u1" not in t.evicted["per-user"]
+        # aged-out entry: re-admits cold (no archive row to inject)
+        write_part(corpus / "part-00010.avro", rng, 12, ["u0", "u2"])
+        r11 = t.poll_once()
+        assert r11.active["per-user"]["n_readmitted"] == 0
+        assert "u2" not in t.evicted["per-user"]
+        assert t.models["per-user"].row_for_entity("u2") >= 0
+
+    def test_past_horizon_entry_never_warm_starts_even_before_deletion(
+        self, tmp_path
+    ):
+        """The horizon applies AT INJECTION TIME, not at deletion time: an
+        archive entry past it never warm-starts even while physically
+        present (physical deletion is lazy, at compaction cadence). This is
+        the crash-replay symmetry — a crash between the archive rewrite and
+        the commit cannot make a replayed pass warm-start an entity the
+        uninterrupted run re-solved from zero."""
+        rng = np.random.default_rng(101)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        kw = dict(window_mode="sliding", window_generations=2,
+                  evict_idle_generations=2, compact_every=50,  # no compaction
+                  archive_max_age_gens=2, cold_block_rows=64)
+        write_part(corpus / "part-00000.avro", rng, 160, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", **kw)
+        t.poll_once()
+        for k in (1, 2, 3, 4, 5):
+            write_part(corpus / f"part-{k:05d}.avro", rng, 30, ["u0"])
+            t.poll_once()
+        assert "u1" in t.evicted["per-user"]  # evicted at gen 4
+        archive = t.store.archive_load("per-user")
+        assert "u1" in archive["entity_ids"].tolist()  # physically present
+        # gen 7: u1 reappears, but its entry (evicted_at=4) is past the
+        # horizon (7 - 2 = 5) -> cold re-admission despite the bytes on disk
+        write_part(corpus / "part-00006.avro", rng, 30, ["u0", "u1"])
+        r7 = t.poll_once()
+        assert r7.active["per-user"]["n_readmitted"] == 0
+        assert "u1" not in t.evicted["per-user"]
+        assert t.models["per-user"].row_for_entity("u1") >= 0
 
 
 class TestSlidingWindow:
@@ -1389,25 +1847,34 @@ class TestBoundedMemory:
 
 @pytest.fixture(scope="module")
 def compact_chaos_scenario(tmp_path_factory):
-    """Two generations committed under sliding window + eviction + a
-    compaction cadence that makes the PENDING delta a compaction pass: the
-    swept generation 3 evicts idle entities (continuous.evict +
-    archive continuous.cold_write), folds the corpus into a cold generation
-    (continuous.compact + block continuous.cold_write), and commits — so
-    every store fault point sits ON the replayed path."""
+    """Five generations committed under sliding window + eviction + row
+    retention + archive age-out, with a compaction cadence that makes the
+    PENDING delta an INCREMENTAL compaction pass: the swept generation 6
+    plans evictions (continuous.evict), drops fully expired cold blocks and
+    ages out the archive (continuous.cold_delete), reuses the surviving
+    full blocks of the previous cold generation (continuous.cold_link),
+    re-encodes only the seam/tail/delta (continuous.cold_write), folds
+    (continuous.compact) and commits — so every store fault point sits ON
+    the replayed path."""
     rng = np.random.default_rng(20260804)
     root = tmp_path_factory.mktemp("compact-chaos")
     corpus = root / "corpus"
     os.makedirs(corpus)
+    # 160 bootstrap rows = exactly 10 pow2 blocks of 16: at the swept
+    # compaction the retention cutoff (max_row_age_gens=5 at gen 6 -> keep
+    # gens >= 2) drops them WHOLE, reuses the full gen-2..4 blocks of the
+    # previous cold generation, and rewrites only its partial tail + delta
     write_part(corpus / "part-00000.avro", rng, 160, USERS)
     kw = dict(window_mode="sliding", window_generations=2,
-              evict_idle_generations=1, compact_every=3, cold_block_rows=64)
+              evict_idle_generations=1, compact_every=2, cold_block_rows=16,
+              max_row_age_gens=5, archive_max_age_gens=2)
     base_ckpt = root / "ckpt-base"
     t = make_trainer(corpus, base_ckpt, **kw)
     t.poll_once()  # gen-1 bootstrap
-    write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
-    t.poll_once()  # gen-2 delta
-    write_part(corpus / "part-00002.avro", rng, 30, ["u0"])  # pending gen-3
+    for k in (1, 2, 3, 4):
+        write_part(corpus / f"part-{k:05d}.avro", rng, 30, ["u0"])
+        t.poll_once()  # gens 2-5; compactions at 2 and 4 (4 reuses 2)
+    write_part(corpus / "part-00005.avro", rng, 30, ["u0"])  # pending gen-6
 
     def run_loop(ckpt, export):
         t = make_trainer(corpus, ckpt, export_dir=export, **kw)
@@ -1418,9 +1885,16 @@ def compact_chaos_scenario(tmp_path_factory):
     ref_export = root / "export-ref"
     shutil.copytree(base_ckpt, root / "ckpt-ref")
     ref_trainer = run_loop(root / "ckpt-ref", ref_export)
-    # the scenario genuinely exercises the machinery under sweep
-    assert ref_trainer.last_result.compacted
-    assert ref_trainer.last_result.active["per-user"]["n_evicted"] > 0
+    # the scenario genuinely exercises the machinery under sweep: an
+    # incremental fold with reuse AND retention drops AND archive age-out
+    r = ref_trainer.last_result
+    assert r.compacted
+    assert r.cold_stats["blocks_reused"] > 0
+    assert r.cold_stats["blocks_dropped"] > 0
+    assert r.cold_stats["rows_dropped"] > 0
+    assert ref_trainer.evicted["per-user"]  # evictions happened (gen 3)
+    # ... and their archive entries aged out on the swept pass
+    assert ref_trainer.store.archive_load("per-user") is None
     return SimpleNamespace(
         base_ckpt=base_ckpt, ref_export=ref_export, run_loop=run_loop,
         ref_ckpt=root / "ckpt-ref",
