@@ -1,0 +1,684 @@
+"""CC rule family: lock-ownership inference and thread-shared-state checks.
+
+The hazard classes here were all found by hand in review before this pass
+existed: the incident-log append race (a deque shared with the dispatcher
+thread mutated outside its lock), the daemon-dispatcher-at-teardown abort
+(a daemon thread still driving jax dispatch while the runtime tears down),
+and the drain-vs-install shape. Like the rest of jaxlint this is stdlib
+``ast`` only and runs per module; the whole-program context (when present)
+only sharpens CC004's "does this thread touch jax" reachability.
+
+Model, per class (plus one pseudo-scope for module-level globals):
+
+- **Locks** are attributes assigned ``threading.Lock/RLock/Condition/...``
+  (usually in ``__init__``); module-level ``_lock = threading.Lock()``
+  forms the module scope's lock set.
+- **Lock ownership** is inferred, not declared: an attribute whose
+  mutations consistently happen under ``with self._lock`` is owned by that
+  lock. Mutations in ``__init__``-like methods are construction, not
+  sharing, and never count.
+- **Held-lock context propagates** through PRIVATE intra-class calls: a
+  ``_locked``-suffix helper called only from inside ``with self._cv``
+  blocks analyzes as holding ``_cv`` — including when the method is passed
+  by REFERENCE inside the lock block (``self.retry.call(self._swap_to,
+  ...)``). Public methods are externally callable and inherit nothing.
+- **Thread entries** are methods handed to ``threading.Thread(target=...)``
+  or a known daemon-runner (``BackgroundTask``), plus ``run`` on
+  ``threading.Thread`` subclasses; reachability closes over intra-class
+  calls.
+
+Rules:
+
+- CC001 — write to a lock-owned attribute outside its owning lock.
+- CC002 — two locks acquired in both nesting orders (deadlock shape); the
+  rarer direction's sites are flagged.
+- CC003 — collection mutation (append/add/pop/update/subscript-store...)
+  on owned shared state outside its owning lock — including module-global
+  registries — or on a never-locked collection mutated both from a
+  thread-entry-reachable method and from ordinary callers.
+- CC004 — a daemon thread whose target (transitively) drives jax, in a
+  scope with neither an ``atexit.register`` teardown hook nor a bounded
+  ``join(timeout)`` stop path: interpreter teardown can kill the thread
+  mid-dispatch and abort the process.
+
+Deliberate non-findings (the serving stack's idioms, pinned by fixtures):
+unlocked READS of owned attributes (the atomic tuple-swap engine pointer
+is read unlocked by design), never-locked attributes written only from
+one side (Event-synchronized ``BackgroundTask._value``), and never-locked
+collections mutated only by ordinary callers (the fleet's reference-only
+mirror deque).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from photon_ml_tpu.analysis.rules import Finding, RuleConfig, RULES
+from photon_ml_tpu.analysis.visitor import ModuleIndex
+
+_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+}
+# helpers that run their callable on a daemon thread (data/pipeline.py)
+_DAEMON_RUNNERS = {"BackgroundTask"}
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "sort", "reverse",
+}
+_COLLECTION_CTORS = {
+    "dict", "set", "list",
+    "collections.deque", "collections.OrderedDict",
+    "collections.defaultdict", "collections.Counter", "deque",
+    "defaultdict", "OrderedDict", "Counter",
+}
+# construction-phase methods: the object is not shared yet, so unguarded
+# writes here are initialization, not races
+_EXEMPT_METHODS = {"__init__", "__new__", "__post_init__", "__del__", "__set_name__"}
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    node: ast.AST
+    held: frozenset
+    method: str
+    kind: str  # "write" | "colmut"
+
+
+@dataclasses.dataclass
+class _Scope:
+    """One analysis scope: a class body, or the module's global namespace."""
+
+    name: str
+    is_module: bool
+    locks: set = dataclasses.field(default_factory=set)
+    collections: set = dataclasses.field(default_factory=set)
+    attrs_assigned: set = dataclasses.field(default_factory=set)
+    methods: dict = dataclasses.field(default_factory=dict)  # name -> node
+    accesses: list = dataclasses.field(default_factory=list)  # [_Access]
+    acquisitions: dict = dataclasses.field(default_factory=dict)  # (outer, inner) -> [node]
+    call_edges: list = dataclasses.field(default_factory=list)  # (caller, callee, held)
+    thread_entries: dict = dataclasses.field(default_factory=dict)  # method -> [(node, daemon)]
+    has_atexit: bool = False
+    has_bounded_join: bool = False
+    jax_methods: set = dataclasses.field(default_factory=set)
+
+    def base_of(self, node) -> Optional[str]:
+        """Scope-shared storage this expression names: ``self.X`` for class
+        scopes, a known module-global name for the module scope."""
+        if self.is_module:
+            if isinstance(node, ast.Name) and (
+                node.id in self.attrs_assigned or node.id in self.locks
+            ):
+                return node.id
+            return None
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+
+class _MethodWalker:
+    """Walk one method/function body tracking the held-lock set."""
+
+    def __init__(self, scope: _Scope, index: ModuleIndex, method: str,
+                 params: set, local_rebinds: set):
+        self.scope = scope
+        self.index = index
+        self.method = method
+        self.params = params
+        self.local_rebinds = local_rebinds  # plain locals shadowing globals
+        self.globals_declared: set = set()
+
+    # -- helpers ---------------------------------------------------------
+    def _lock_token(self, expr) -> Optional[str]:
+        base = self.scope.base_of(expr)
+        if base is not None and base in self.scope.locks:
+            return base
+        return None
+
+    def _shared_base(self, expr) -> Optional[str]:
+        base = self.scope.base_of(expr)
+        if base is None or base in self.scope.locks:
+            return None
+        if self.scope.is_module:
+            # a plain local shadowing the global name is not shared state
+            if base in self.params:
+                return None
+            if base in self.local_rebinds and base not in self.globals_declared:
+                return None
+        return base
+
+    def _record(self, attr: str, node, held: frozenset, kind: str):
+        self.scope.accesses.append(
+            _Access(attr=attr, node=node, held=held, method=self.method, kind=kind)
+        )
+
+    def _method_refs(self, expr):
+        """Intra-scope method references inside an expression (call edges
+        for lock-held propagation: passing self._m while holding a lock)."""
+        for sub in ast.walk(expr):
+            if self.scope.is_module:
+                if isinstance(sub, ast.Name) and sub.id in self.scope.methods:
+                    yield sub.id
+            elif (
+                isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and sub.attr in self.scope.methods
+            ):
+                yield sub.attr
+
+    # -- walk ------------------------------------------------------------
+    def walk(self, stmts, held: frozenset):
+        for st in stmts:
+            self._stmt(st, held)
+
+    def _stmt(self, st, held: frozenset):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested scopes analyzed separately
+        if isinstance(st, ast.Global):
+            self.globals_declared.update(st.names)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            new_held = set(held)
+            for item in st.items:
+                tok = self._lock_token(item.context_expr)
+                self._exprs(item.context_expr, held)
+                if tok is not None:
+                    for h in new_held:
+                        self.scope.acquisitions.setdefault((h, tok), []).append(
+                            item.context_expr
+                        )
+                    new_held.add(tok)
+            self.walk(st.body, frozenset(new_held))
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._exprs(st.iter, held)
+            self.walk(st.body, held)
+            self.walk(st.orelse, held)
+            return
+        if isinstance(st, ast.While):
+            self._exprs(st.test, held)
+            self.walk(st.body, held)
+            self.walk(st.orelse, held)
+            return
+        if isinstance(st, ast.If):
+            self._exprs(st.test, held)
+            self.walk(st.body, held)
+            self.walk(st.orelse, held)
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body, held)
+            for h in st.handlers:
+                self.walk(h.body, held)
+            self.walk(st.orelse, held)
+            self.walk(st.finalbody, held)
+            return
+        if isinstance(st, ast.Assign):
+            self._exprs(st.value, held)
+            for t in st.targets:
+                self._target(t, held)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._exprs(st.value, held)
+            self._target(st.target, held)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._exprs(st.value, held)
+            self._target(st.target, held)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Subscript):
+                    base = self._subscript_base(t)
+                    if base is not None:
+                        self._record(base, st, held, "colmut")
+                self._exprs(t, held)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._exprs(child, held)
+
+    def _subscript_base(self, sub: ast.Subscript) -> Optional[str]:
+        node = sub.value
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        return self._shared_base(node)
+
+    def _target(self, t, held: frozenset):
+        if isinstance(t, ast.Subscript):
+            base = self._subscript_base(t)
+            if base is not None:
+                self._record(base, t, held, "colmut")
+            self._exprs(t.value, held)
+            self._exprs(t.slice, held)
+            return
+        base = self._shared_base(t)
+        if base is not None:
+            if self.scope.is_module and isinstance(t, ast.Name) and (
+                t.id not in self.globals_declared
+            ):
+                return  # plain local assignment, not the global
+            self._record(base, t, held, "write")
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e, held)
+
+    def _exprs(self, expr, held: frozenset):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._call(node, held)
+
+    def _call(self, node: ast.Call, held: frozenset):
+        c = self.index.canonical(node.func)
+        if c is not None and (c == "jax" or c.startswith("jax.")):
+            self.scope.jax_methods.add(self.method)
+        # mutator method on shared collection-ish storage
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATOR_METHODS:
+            base = self._shared_base(node.func.value)
+            if base is not None:
+                self._record(base, node, held, "colmut")
+        # thread entry points and daemon runners
+        daemon = any(
+            kw.arg == "daemon"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in node.keywords
+        )
+        target = None
+        if c == "threading.Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = self._entry_name(kw.value)
+        elif c is not None and c.rsplit(".", 1)[-1] in _DAEMON_RUNNERS:
+            if node.args:
+                target = self._entry_name(node.args[0])
+            daemon = True  # BackgroundTask threads are daemonic by design
+        if target is not None:
+            self.scope.thread_entries.setdefault(target, []).append((node, daemon))
+        # teardown mitigations: join(timeout) on a thread, or result(timeout)
+        # on a future/BackgroundTask — both bound how long the daemon outlives
+        # the spawning call (an argument-less wait is NOT bounded)
+        if c == "atexit.register":
+            self.scope.has_atexit = True
+        if isinstance(node.func, ast.Attribute) and node.func.attr in ("join", "result"):
+            if node.args or any(kw.arg == "timeout" for kw in node.keywords):
+                self.scope.has_bounded_join = True
+        # intra-scope call edges: direct calls and method references
+        if self.scope.is_module:
+            if isinstance(node.func, ast.Name) and node.func.id in self.scope.methods:
+                self.scope.call_edges.append((self.method, node.func.id, held))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+            and node.func.attr in self.scope.methods
+        ):
+            self.scope.call_edges.append((self.method, node.func.attr, held))
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for m in self._method_refs(arg):
+                self.scope.call_edges.append((self.method, m, held))
+
+    def _entry_name(self, expr) -> Optional[str]:
+        if self.scope.is_module:
+            if isinstance(expr, ast.Name) and expr.id in self.scope.methods:
+                return expr.id
+            return None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            return expr.attr
+        return None
+
+
+def _collect_scopes(tree: ast.Module, index: ModuleIndex) -> list:
+    scopes = []
+
+    mod = _Scope(name="<module>", is_module=True)
+    for st in tree.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.methods[st.name] = st
+            continue
+        targets, value = [], None
+        if isinstance(st, ast.Assign):
+            targets, value = st.targets, st.value
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            targets, value = [st.target], st.value
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if _is_lock_ctor(value, index):
+                    mod.locks.add(t.id)
+                else:
+                    mod.attrs_assigned.add(t.id)
+                    if _is_collection_init(value, index):
+                        mod.collections.add(t.id)
+    scopes.append(mod)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        sc = _Scope(name=node.name, is_module=False)
+        for base in node.bases:
+            if index.canonical(base) == "threading.Thread":
+                sc.thread_entries.setdefault("run", []).append((node, False))
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sc.methods[item.name] = item
+        # lock / collection discovery: self.X = threading.Lock() / deque() ...
+        for m in sc.methods.values():
+            for sub in ast.walk(m):
+                targets, value = [], None
+                if isinstance(sub, ast.Assign):
+                    targets, value = sub.targets, sub.value
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    targets, value = [sub.target], sub.value
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        if _is_lock_ctor(value, index):
+                            sc.locks.add(t.attr)
+                        else:
+                            sc.attrs_assigned.add(t.attr)
+                            if m.name in _EXEMPT_METHODS and _is_collection_init(
+                                value, index
+                            ):
+                                sc.collections.add(t.attr)
+        scopes.append(sc)
+    return scopes
+
+
+def _is_lock_ctor(expr, index: ModuleIndex) -> bool:
+    return (
+        isinstance(expr, ast.Call)
+        and index.canonical(expr.func) in _LOCK_CTORS
+    )
+
+
+def _is_collection_init(expr, index: ModuleIndex) -> bool:
+    if isinstance(expr, (ast.Dict, ast.Set, ast.List, ast.DictComp, ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        c = index.canonical(expr.func)
+        return c in _COLLECTION_CTORS
+    return False
+
+
+def _method_params(node) -> set:
+    args = node.args
+    out = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    return out
+
+
+def _local_rebinds(node) -> set:
+    """Names plainly assigned inside the function (possible global shadows)."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign):
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+class _ScopeAnalysis:
+    def __init__(self, scope: _Scope):
+        self.scope = scope
+        self.inherited = self._inherited_locks()
+        self.reachable = self._thread_reachable()
+        self.owners = self._infer_owners()
+
+    # effective held locks at an access
+    def eff_held(self, acc: _Access) -> frozenset:
+        return acc.held | self.inherited.get(acc.method, frozenset())
+
+    def _inherited_locks(self) -> dict:
+        """Held-lock sets inherited through private intra-scope call sites:
+        the intersection over every observed call site's effective held set.
+        Public and thread-entry methods are externally invocable with
+        nothing held, so they inherit nothing."""
+        sc = self.scope
+        edges: dict[str, list] = {}
+        for caller, callee, held in sc.call_edges:
+            edges.setdefault(callee, []).append((caller, held))
+        universe = frozenset(sc.locks)
+        inherited = {}
+        for m in sc.methods:
+            private = m.startswith("_") and not m.startswith("__")
+            if private and m in edges and m not in sc.thread_entries:
+                inherited[m] = universe
+            else:
+                inherited[m] = frozenset()
+        for _ in range(len(sc.methods) + 2):
+            changed = False
+            for m, sites in edges.items():
+                if inherited.get(m) == frozenset() and (
+                    not m.startswith("_") or m.startswith("__") or m in sc.thread_entries
+                ):
+                    continue
+                eff = None
+                for caller, held in sites:
+                    site_held = held | inherited.get(caller, frozenset())
+                    eff = site_held if eff is None else (eff & site_held)
+                eff = eff if eff is not None else frozenset()
+                if eff != inherited.get(m):
+                    inherited[m] = eff
+                    changed = True
+            if not changed:
+                break
+        return inherited
+
+    def _thread_reachable(self) -> set:
+        sc = self.scope
+        out_edges: dict[str, set] = {}
+        for caller, callee, _ in sc.call_edges:
+            out_edges.setdefault(caller, set()).add(callee)
+        seen = set(sc.thread_entries)
+        frontier = list(seen)
+        while frontier:
+            m = frontier.pop()
+            for n in out_edges.get(m, ()):
+                if n not in seen:
+                    seen.add(n)
+                    frontier.append(n)
+        return seen
+
+    def _infer_owners(self) -> dict:
+        """attr -> owning lock. Owned = at least as many mutations under one
+        lock as outside any lock, with that lock the most frequent guard."""
+        tallies: dict[str, dict] = {}
+        unguarded: dict[str, int] = {}
+        for acc in self.scope.accesses:
+            if acc.method in _EXEMPT_METHODS:
+                continue
+            eff = self.eff_held(acc)
+            if eff:
+                for lock in eff:
+                    tallies.setdefault(acc.attr, {}).setdefault(lock, 0)
+                    tallies[acc.attr][lock] += 1
+            else:
+                unguarded[acc.attr] = unguarded.get(acc.attr, 0) + 1
+        owners = {}
+        for attr, by_lock in tallies.items():
+            lock, count = max(by_lock.items(), key=lambda kv: kv[1])
+            if count >= unguarded.get(attr, 0):
+                owners[attr] = lock
+        return owners
+
+
+def analyze_concurrency(tree: ast.Module, path: str, config: RuleConfig,
+                        cross=None) -> list:
+    """Run the CC rule family over one module; returns raw findings."""
+    index = ModuleIndex()
+    index.visit(tree)
+    findings: list = []
+
+    def report(rule_id, node, message):
+        if not config.enabled(rule_id):
+            return
+        findings.append(
+            Finding(
+                rule=rule_id,
+                severity=config.severity(rule_id),
+                path=path,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0) + 1,
+                message=message,
+                hint=RULES[rule_id].hint,
+            )
+        )
+
+    scopes = _collect_scopes(tree, index)
+    for scope in scopes:
+        for mname, mnode in scope.methods.items():
+            walker = _MethodWalker(
+                scope, index, mname,
+                params=_method_params(mnode),
+                local_rebinds=_local_rebinds(mnode),
+            )
+            walker.walk(mnode.body, frozenset())
+        _check_scope(scope, path, report, cross)
+    return findings
+
+
+def _lock_label(scope: _Scope, lock: str) -> str:
+    return lock if scope.is_module else f"self.{lock}"
+
+
+def _attr_label(scope: _Scope, attr: str) -> str:
+    return attr if scope.is_module else f"self.{attr}"
+
+
+def _check_scope(scope: _Scope, path: str, report, cross):
+    sa = _ScopeAnalysis(scope)
+    shared_scope = bool(scope.thread_entries) or bool(scope.locks)
+
+    # CC001 / CC003(a,b): mutation of owned state outside the owning lock
+    if shared_scope:
+        for acc in scope.accesses:
+            if acc.method in _EXEMPT_METHODS:
+                continue
+            owner = sa.owners.get(acc.attr)
+            if owner is None or owner in sa.eff_held(acc):
+                continue
+            lock_l = _lock_label(scope, owner)
+            attr_l = _attr_label(scope, acc.attr)
+            if acc.kind == "write":
+                report(
+                    "CC001", acc.node,
+                    f"write to {attr_l} outside its owning lock {lock_l} "
+                    f"(every other mutation of it holds {lock_l})",
+                )
+            else:
+                report(
+                    "CC003", acc.node,
+                    f"collection mutation on {attr_l} outside its owning lock "
+                    f"{lock_l} — racing mutators corrupt shared state silently",
+                )
+
+    # CC003(c): never-locked collection mutated from a thread-reachable
+    # method AND from ordinary callers — no lock anywhere to blame, but two
+    # sides race (the incident-log class before its lock existed)
+    if scope.thread_entries:
+        by_attr: dict[str, list] = {}
+        for acc in scope.accesses:
+            if acc.kind != "colmut" or acc.method in _EXEMPT_METHODS:
+                continue
+            if acc.attr in sa.owners or sa.eff_held(acc):
+                continue
+            if acc.attr in scope.collections:
+                by_attr.setdefault(acc.attr, []).append(acc)
+        for attr, accs in by_attr.items():
+            methods_thread = {a.method for a in accs if a.method in sa.reachable}
+            methods_other = {a.method for a in accs if a.method not in sa.reachable}
+            if methods_thread and methods_other:
+                for a in accs:
+                    if a.method in sa.reachable:
+                        report(
+                            "CC003", a.node,
+                            f"{_attr_label(scope, attr)} is mutated here on a "
+                            f"thread-entry path and also from "
+                            f"{sorted(methods_other)} with no lock guarding "
+                            "either side",
+                        )
+
+    # CC002: both nesting orders observed for one lock pair
+    seen_pairs = set(scope.acquisitions)
+    for (a, b), sites in scope.acquisitions.items():
+        if (b, a) not in seen_pairs or a >= b:
+            continue
+        rev = scope.acquisitions[(b, a)]
+        flag = sites if len(sites) <= len(rev) else rev
+        outer, inner = (a, b) if flag is sites else (b, a)
+        for node in flag:
+            report(
+                "CC002", node,
+                f"lock {_lock_label(scope, inner)} acquired while holding "
+                f"{_lock_label(scope, outer)}, but the opposite order also "
+                "occurs in this scope (deadlock shape) — pick one order",
+            )
+
+    # CC004: daemon thread driving jax with no bounded teardown
+    if scope.has_atexit or scope.has_bounded_join:
+        return
+    for target, sites in scope.thread_entries.items():
+        daemon_sites = [node for node, daemon in sites if daemon]
+        if not daemon_sites:
+            continue
+        if not _touches_jax(scope, sa, target, path, cross):
+            continue
+        for node in daemon_sites:
+            report(
+                "CC004", node,
+                f"daemon thread target {target!r} reaches jax-dispatching "
+                "code, and this scope registers no atexit hook or bounded "
+                "join(timeout) stop path — interpreter teardown can abort "
+                "mid-dispatch",
+            )
+
+
+def _touches_jax(scope: _Scope, sa: _ScopeAnalysis, target: str,
+                 path: str, cross) -> bool:
+    """Does ``target`` (transitively) call into jax? Prefer the whole-program
+    summaries; fall back to the intra-scope call closure."""
+    if cross is not None:
+        node = scope.methods.get(target)
+        if node is not None:
+            s = cross.lookup(path, node.lineno)
+            if s is not None:
+                return s.touches_jax
+    out_edges: dict[str, set] = {}
+    for caller, callee, _ in scope.call_edges:
+        out_edges.setdefault(caller, set()).add(callee)
+    seen = {target}
+    frontier = [target]
+    while frontier:
+        m = frontier.pop()
+        if m in scope.jax_methods:
+            return True
+        for n in out_edges.get(m, ()):
+            if n not in seen:
+                seen.add(n)
+                frontier.append(n)
+    return False

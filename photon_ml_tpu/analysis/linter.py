@@ -24,6 +24,8 @@ from pathlib import Path
 from typing import Optional
 
 from photon_ml_tpu.analysis import baseline as baseline_mod
+from photon_ml_tpu.analysis.concurrency import analyze_concurrency
+from photon_ml_tpu.analysis.project import ProjectContext
 from photon_ml_tpu.analysis.rules import Finding, RuleConfig, RULES, Severity
 from photon_ml_tpu.analysis.visitor import analyze_module
 
@@ -109,8 +111,12 @@ class LintResult:
         return by_sev
 
 
-def lint_source(source: str, path: str, config: Optional[RuleConfig] = None) -> LintResult:
-    """Lint one file's source text. ``path`` is the reporting/baseline key."""
+def lint_source(source: str, path: str, config: Optional[RuleConfig] = None,
+                cross: Optional[ProjectContext] = None) -> LintResult:
+    """Lint one file's source text. ``path`` is the reporting/baseline key.
+    ``cross`` is a whole-program context (``lint_paths`` builds one over
+    every scanned file) enabling the cross-module rules; without it the
+    module-local (v1) semantics apply."""
     config = config or RuleConfig()
     try:
         tree = ast.parse(source, filename=path)
@@ -122,7 +128,16 @@ def lint_source(source: str, path: str, config: Optional[RuleConfig] = None) -> 
         text = lines[f.line - 1].strip() if 0 < f.line <= len(lines) else ""
         return dataclasses.replace(f, line_text=text)
 
-    raw = [with_text(f) for f in analyze_module(tree, path, config)]
+    raw = analyze_module(tree, path, config, cross=cross)
+    raw += analyze_concurrency(tree, path, config, cross=cross)
+    seen = set()
+    deduped = []
+    for f in raw:
+        key = (f.rule, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            deduped.append(f)
+    raw = [with_text(f) for f in deduped]
     sups, sup_findings = parse_suppressions(source, path)
     if not config.enabled("SUP001"):
         sup_findings = []
@@ -168,27 +183,54 @@ def iter_python_files(paths: list, exclude: Optional[list] = None) -> list:
     return out
 
 
+def _lint_chunk(chunk: list, config: RuleConfig,
+                cross: Optional[ProjectContext]) -> list:
+    """Worker body for --jobs fan-out: lint a chunk of (rel, source) pairs.
+    Top-level so ProcessPoolExecutor can pickle it; the shared whole-program
+    context ships to each worker once per chunk."""
+    return [(rel, lint_source(source, rel, config, cross=cross)) for rel, source in chunk]
+
+
 def lint_paths(paths: list, config: Optional[RuleConfig] = None,
                rel_root: Optional[str] = None,
-               exclude: Optional[list] = None) -> LintResult:
+               exclude: Optional[list] = None,
+               project: bool = True,
+               jobs: int = 1) -> LintResult:
     """Lint files/directories. Reported paths are made relative to
     ``rel_root`` (default: cwd) when possible, so baseline keys are stable
-    regardless of how the target path was spelled."""
+    regardless of how the target path was spelled.
+
+    ``project=True`` (the default — jaxlint v2) builds ONE whole-program
+    context over every scanned file, enabling the cross-module taint and
+    CC checks; ``project=False`` restores v1's module-local semantics.
+    ``jobs > 1`` fans the per-file rule passes out to a process pool (the
+    graph is built once, up front); any pool failure falls back to the
+    serial path so a restricted environment still lints."""
     config = config or RuleConfig()
     root = Path(rel_root) if rel_root else Path.cwd()
     findings, suppressed, errors = [], [], []
     scanned: set = set()
+    entries: list = []  # (rel, source) for every readable file
     for f in iter_python_files(paths, exclude=exclude):
         try:
             rel = f.resolve().relative_to(root.resolve()).as_posix()
         except ValueError:
             rel = f.as_posix()
         try:
-            source = f.read_text(encoding="utf-8")
+            entries.append((rel, f.read_text(encoding="utf-8")))
         except OSError as e:
             errors.append((rel, f"unreadable: {e}"))
-            continue
-        r = lint_source(source, rel, config)
+
+    cross = ProjectContext.build(entries) if project else None
+
+    results: list = []
+    if jobs > 1 and len(entries) > 1:
+        results = _lint_parallel(entries, config, cross, jobs)
+    if not results:
+        results = [(rel, lint_source(source, rel, config, cross=cross))
+                   for rel, source in entries]
+
+    for rel, r in results:
         if r.errors:
             # an unanalyzed file was not scanned: its baseline entries must
             # not read as stale, and the caller must not exit green
@@ -201,6 +243,26 @@ def lint_paths(paths: list, config: Optional[RuleConfig] = None,
     suppressed.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
     return LintResult(findings=findings, suppressed=suppressed, errors=errors,
                       scanned=scanned)
+
+
+def _lint_parallel(entries: list, config: RuleConfig,
+                   cross: Optional[ProjectContext], jobs: int) -> list:
+    """Fan per-file linting out over processes; [] on any pool failure (the
+    caller then runs the serial path — correctness never depends on the
+    pool being available)."""
+    try:
+        import concurrent.futures as cf
+
+        n = max(1, min(jobs, len(entries)))
+        chunks = [entries[i::n] for i in range(n)]
+        out: list = []
+        with cf.ProcessPoolExecutor(max_workers=n) as pool:
+            for part in pool.map(_lint_chunk, chunks,
+                                 [config] * len(chunks), [cross] * len(chunks)):
+                out.extend(part)
+        return out
+    except Exception:
+        return []
 
 
 def apply_baseline(result: LintResult, baseline_path: str):

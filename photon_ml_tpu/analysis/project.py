@@ -1,0 +1,578 @@
+"""Whole-program (cross-module) analysis context for jaxlint v2.
+
+v1's taint pass is module-local: a traced value handed to a helper in
+ANOTHER module vanishes at the call boundary, so a `float(v)` inside the
+helper — per-iteration, in the caller's descent loop — goes unreported
+(docs/PERFORMANCE.md documented exactly this under-report; the PR 2
+tracker-sync hazard was this shape). This module closes that hole with
+per-function *summaries* and a bounded fixed point over the project call
+graph, still pure stdlib ``ast`` (the lint job never imports the code it
+scans).
+
+Per function we summarize, without keeping the AST alive (summaries are
+plain picklable data so ``--jobs`` workers can receive them):
+
+- ``sync_params``     — parameters whose VALUE is host-synced inside the
+                        function (``float(p)``, ``np.asarray(p)``,
+                        ``p.item()``, ``jax.device_get(p)``), directly or
+                        transitively through callees. Static-metadata reads
+                        (``p.shape``, ``len(p)``) never count — same guards
+                        as v1.
+- ``traced_params``   — parameters observed RECEIVING a likely-traced
+                        argument at some resolved call site (fixed point).
+- ``returns_traced``  — the function unconditionally returns a device
+                        value (a ``jnp.*``/``jax.*`` call result, a traced
+                        local, a jitted function's result, or the result of
+                        an internal callee that itself returns traced).
+- ``returns_lowp``    — returns a reduced-precision (bf16/f16) array.
+- ``jit_context``     — jitted / reachable from jitted code, closed over
+                        the PROJECT call graph (v1 closed per-module only).
+- ``touches_jax``     — calls into ``jax.*`` directly or transitively
+                        (feeds CC004's daemon-teardown reachability).
+
+Call resolution is deliberately bounded: an internal dotted name
+(``module.fn`` through import aliases), ``self.method`` within the same
+class, or a bare/attribute name that is UNIQUE project-wide. Anything
+else stays unresolved — the fixed point under-approximates rather than
+guesses.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from photon_ml_tpu.analysis.visitor import (
+    _STATIC_ATTRS,
+    _STATIC_CALLS,
+    _SYNC_CALLS,
+    _SYNC_METHODS,
+    _TRACED_PREFIXES,
+    ModuleIndex,
+    _dtype_ref_in,
+    _LOW_PRECISION_NAMES,
+)
+
+# fixed-point iteration bound: summaries propagate at most this many call
+# edges deep, which comfortably covers the repo's real call chains while
+# keeping the pass linear in practice
+MAX_PASSES = 8
+
+# bare names too generic to resolve by project-wide uniqueness (method
+# names like these appear on stdlib/third-party objects constantly; a
+# unique same-named local function would be a coincidence, not a target)
+_GENERIC_NAMES = {
+    "get", "put", "set", "add", "pop", "run", "call", "close", "open",
+    "read", "write", "update", "append", "send", "start", "stop", "copy",
+    "items", "keys", "values", "join", "split", "main", "build", "make",
+    # ndarray/tracer method names: obj.sum() is almost always an array
+    # reduction, never a coincidentally same-named project function
+    "sum", "mean", "max", "min", "any", "all", "astype", "reshape",
+    "ravel", "flatten", "transpose", "squeeze", "result", "wait",
+}
+
+
+@dataclasses.dataclass
+class CallArg:
+    """One argument at a recorded call site: which callee slot it lands in
+    (positional index or keyword name), whether it is unconditionally
+    traced per the light local taint, and which caller parameters its
+    expression reads (for the transitive-sync fixed point)."""
+
+    slot: object  # int (positional) | str (keyword)
+    traced: bool
+    param_deps: frozenset
+
+
+@dataclasses.dataclass
+class CallRecord:
+    kind: str  # "qual" | "self" | "name"
+    target: str  # dotted qualname, method name, or bare name
+    args: tuple  # tuple[CallArg, ...]
+    via_attribute: bool  # spelled obj.m(...) — callee's `self` slot is bound
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    qualname: str
+    module: str
+    name: str
+    cls: Optional[str]
+    path: str
+    lineno: int
+    params: tuple
+    is_method: bool
+    jitted: bool
+    jit_context: bool
+    sync_params: set = dataclasses.field(default_factory=set)
+    traced_params: set = dataclasses.field(default_factory=set)
+    returns_traced: bool = False
+    returns_lowp: bool = False
+    touches_jax: bool = False
+    calls: list = dataclasses.field(default_factory=list)
+    # internal callees whose RESULT this function returns (returns_traced /
+    # returns_lowp propagate through these edges at the fixed point)
+    returns_calls: list = dataclasses.field(default_factory=list)
+    # parameters returned DIRECTLY (``return v`` / tuple element): if a call
+    # site is observed passing a traced value into one, the function returns
+    # traced too — the `_psum`-style passthrough the local scan can't see
+    returns_params: set = dataclasses.field(default_factory=set)
+
+
+def _param_names(node) -> tuple:
+    args = node.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return tuple(names)
+
+
+def _base_param(node, params: set) -> Optional[str]:
+    """The parameter whose VALUE this expression reads, or None. Attribute
+    chains through static metadata (``p.shape[0]``) do not count."""
+    while True:
+        if isinstance(node, ast.Name):
+            return node.id if node.id in params else None
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return None
+            node = node.value
+            continue
+        if isinstance(node, ast.Subscript):
+            node = node.value
+            continue
+        return None
+
+
+def _name_deps(node, params: set) -> frozenset:
+    """Caller parameters an expression's value depends on (value reads
+    only — static-metadata chains are excluded like everywhere else)."""
+    deps = set()
+    for sub in ast.walk(node):
+        p = _base_param(sub, params)
+        if p:
+            deps.add(p)
+    return frozenset(deps)
+
+
+class _FunctionScanner:
+    """Light, linear, per-function scan producing one FunctionSummary.
+
+    The taint here is a cheap subset of visitor.FunctionAnalyzer's: names
+    assigned from ``jnp.*``/``jax.*`` calls (or from already-traced names)
+    become traced; loop bodies are walked once (summaries feed a fixed
+    point anyway, so the double-walk precision is not needed here)."""
+
+    def __init__(self, index: ModuleIndex, summary: FunctionSummary):
+        self.index = index
+        self.s = summary
+        self.params = set(summary.params)
+        self.traced: set = set()
+        if summary.jitted:
+            self.traced |= self.params - {"self"}
+
+    # -- expression classification --------------------------------------
+    def _expr_traced(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._expr_traced(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._expr_traced(node.value)
+        if isinstance(node, ast.Call):
+            c = self.index.canonical(node.func)
+            if c is not None:
+                if c in _STATIC_CALLS or c.startswith("numpy."):
+                    return False
+                if c.startswith(_TRACED_PREFIXES) or c == "jax.device_put":
+                    return True
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _SYNC_METHODS:
+                    return False
+                return self._expr_traced(node.func.value)
+            return False
+        if isinstance(node, ast.BinOp):
+            return self._expr_traced(node.left) or self._expr_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._expr_traced(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._expr_traced(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self._expr_traced(node.body) or self._expr_traced(node.orelse)
+        if isinstance(node, ast.Compare):
+            return self._expr_traced(node.left) or any(
+                self._expr_traced(cmp) for cmp in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(self._expr_traced(v) for v in node.values)
+        return False
+
+    def _expr_lowp(self, node) -> bool:
+        if isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and node.args
+                and _dtype_ref_in(node.args[0], _LOW_PRECISION_NAMES)
+            ):
+                return True
+            return any(
+                kw.arg == "dtype" and _dtype_ref_in(kw.value, _LOW_PRECISION_NAMES)
+                for kw in node.keywords
+            )
+        return False
+
+    # -- walk ------------------------------------------------------------
+    def scan(self, node):
+        body = node.body if isinstance(node.body, list) else [ast.Expr(node.body)]
+        for st in body:
+            self._stmt(st)
+
+    def _stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs are summarized separately
+        if isinstance(st, ast.Assign):
+            self._exprs(st.value)
+            if self._expr_traced(st.value):
+                for t in st.targets:
+                    self._bind(t)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._exprs(st.value)
+            if self._expr_traced(st.value):
+                self._bind(st.target)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._exprs(st.iter)
+            if self._expr_traced(st.iter):
+                self._bind(st.target)
+            for s in st.body + st.orelse:
+                self._stmt(s)
+            return
+        if isinstance(st, (ast.While, ast.If)):
+            self._exprs(st.test)
+            for s in st.body + st.orelse:
+                self._stmt(s)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._exprs(item.context_expr)
+            for s in st.body:
+                self._stmt(s)
+            return
+        if isinstance(st, ast.Try):
+            for s in st.body:
+                self._stmt(s)
+            for h in st.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            for s in st.orelse + st.finalbody:
+                self._stmt(s)
+            return
+        if isinstance(st, ast.Return):
+            if st.value is not None:
+                self._exprs(st.value)
+                self._return_expr(st.value)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._exprs(child)
+
+    def _bind(self, target):
+        if isinstance(target, ast.Name):
+            self.traced.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value)
+
+    def _return_expr(self, node):
+        if self._expr_traced(node):
+            self.s.returns_traced = True
+        if self._expr_lowp(node):
+            self.s.returns_lowp = True
+        p = _base_param(node, self.params)
+        if p:
+            self.s.returns_params.add(p)
+        if isinstance(node, ast.Call):
+            rec = self._call_record(node)
+            if rec is not None:
+                self.s.returns_calls.append(rec)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                self._return_expr(e)
+
+    def _exprs(self, *exprs):
+        for e in exprs:
+            for node in ast.walk(e):
+                if isinstance(node, ast.Call):
+                    self._call(node)
+
+    # -- call handling ---------------------------------------------------
+    def _call(self, node: ast.Call):
+        c = self.index.canonical(node.func)
+        # direct host sync of a parameter's value
+        if c in _SYNC_CALLS and node.args:
+            p = _base_param(node.args[0], self.params)
+            if p:
+                self.s.sync_params.add(p)
+        elif c == "jax.device_get" and node.args:
+            p = _base_param(node.args[0], self.params)
+            if p:
+                self.s.sync_params.add(p)
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+            p = _base_param(node.func.value, self.params)
+            if p:
+                self.s.sync_params.add(p)
+        if c is not None and (c == "jax" or c.startswith("jax.")):
+            self.s.touches_jax = True
+        rec = self._call_record(node)
+        if rec is not None:
+            self.s.calls.append(rec)
+
+    def _call_record(self, node: ast.Call) -> Optional[CallRecord]:
+        func = node.func
+        kind = target = None
+        via_attribute = False
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            kind, target, via_attribute = "self", func.attr, True
+        else:
+            c = self.index.canonical(func)
+            if c is not None and "." in c:
+                kind, target = "qual", c
+                via_attribute = isinstance(func, ast.Attribute)
+            elif isinstance(func, ast.Name):
+                kind, target = "name", func.id
+            elif isinstance(func, ast.Attribute):
+                kind, target, via_attribute = "name", func.attr, True
+        if kind is None:
+            return None
+        args = []
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred):
+                continue
+            args.append(CallArg(i, self._expr_traced(a), _name_deps(a, self.params)))
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            args.append(
+                CallArg(kw.arg, self._expr_traced(kw.value), _name_deps(kw.value, self.params))
+            )
+        return CallRecord(kind=kind, target=target, args=tuple(args), via_attribute=via_attribute)
+
+
+class ProjectContext:
+    """Project-wide function summaries + resolution, built once per scan
+    and handed (picklable) into each module's analysis."""
+
+    def __init__(self):
+        self.by_qual: dict[str, FunctionSummary] = {}
+        self.by_name: dict[str, list] = {}
+        self.by_site: dict[tuple, FunctionSummary] = {}  # (path, lineno)
+        self.modules: set = set()
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def build(cls, sources: list) -> "ProjectContext":
+        """``sources``: [(rel_path, source_text)]. Module dotted names come
+        from the relative paths, matching the absolute imports the project
+        uses internally."""
+        ctx = cls()
+        scanned = []
+        for rel, source in sources:
+            try:
+                tree = ast.parse(source, filename=rel)
+            except SyntaxError:
+                continue
+            module = _module_name(rel)
+            ctx.modules.add(module)
+            scanned.append((rel, module, tree))
+        for rel, module, tree in scanned:
+            ctx._scan_module(rel, module, tree)
+        ctx._fixed_point()
+        return ctx
+
+    def _scan_module(self, rel: str, module: str, tree: ast.Module):
+        index = ModuleIndex()
+        index.visit(tree)
+        index.close_jit_reachability()
+        _resolve_relative_imports(index, module, tree)
+        # map each function node to its enclosing class (one level: methods)
+        cls_of: dict[int, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        cls_of[id(item)] = node.name
+        for info in index.functions.values():
+            node = info.node
+            if isinstance(node, ast.Lambda):
+                continue
+            cname = cls_of.get(id(node))
+            qual = f"{module}.{cname}.{info.name}" if cname else f"{module}.{info.name}"
+            params = _param_names(node)
+            s = FunctionSummary(
+                qualname=qual,
+                module=module,
+                name=info.name,
+                cls=cname,
+                path=rel,
+                lineno=node.lineno,
+                params=params,
+                is_method=bool(params) and params[0] == "self",
+                jitted=info.jitted,
+                jit_context=info.jit_context,
+            )
+            _FunctionScanner(index, s).scan(node)
+            # last-definition-wins for duplicate quals (overloads via if/else
+            # are rare; either branch's summary is a fair approximation)
+            self.by_qual[qual] = s
+            self.by_name.setdefault(info.name, []).append(s)
+            self.by_site[(rel, node.lineno)] = s
+
+    # -- resolution ------------------------------------------------------
+    def lookup(self, path: str, lineno: int) -> Optional[FunctionSummary]:
+        return self.by_site.get((path, lineno))
+
+    def resolve(self, caller: Optional[FunctionSummary], rec: CallRecord) -> Optional[FunctionSummary]:
+        if rec.kind == "qual":
+            s = self.by_qual.get(rec.target)
+            if s is not None:
+                return s
+            # module.Class(...) constructor or unresolvable dotted name:
+            # fall through to unique-name resolution on the last segment
+            tail = rec.target.rsplit(".", 1)[-1]
+            return self._unique(tail)
+        if rec.kind == "self":
+            if caller is not None and caller.cls is not None:
+                s = self.by_qual.get(f"{caller.module}.{caller.cls}.{rec.target}")
+                if s is not None:
+                    return s
+            return self._unique(rec.target)
+        return self._unique(rec.target)
+
+    def _unique(self, name: str) -> Optional[FunctionSummary]:
+        if name in _GENERIC_NAMES or name.startswith("__"):
+            return None
+        cands = self.by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def resolve_call_node(self, caller_path: str, caller_lineno: int,
+                          node: ast.Call, canonical: Optional[str]) -> Optional[FunctionSummary]:
+        """Resolution entry point for visitor.FunctionAnalyzer: the analyzer
+        already computed the canonical dotted name through ITS module's
+        aliases, so reuse it instead of re-deriving."""
+        caller = self.lookup(caller_path, caller_lineno)
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            rec = CallRecord("self", func.attr, (), True)
+        elif canonical is not None and "." in canonical:
+            rec = CallRecord("qual", canonical, (), isinstance(func, ast.Attribute))
+        elif isinstance(func, ast.Name):
+            rec = CallRecord("name", func.id, (), False)
+        elif isinstance(func, ast.Attribute):
+            rec = CallRecord("name", func.attr, (), True)
+        else:
+            return None
+        return self.resolve(caller, rec)
+
+    @staticmethod
+    def map_args(callee: FunctionSummary, rec_args, via_attribute: bool):
+        """Yield (param_name, arg) pairs for a call's recorded args. A
+        bound-method spelling (obj.m(...)) skips the callee's `self`."""
+        offset = 1 if (via_attribute and callee.is_method) else 0
+        for a in rec_args:
+            if isinstance(a.slot, int):
+                idx = a.slot + offset
+                if idx < len(callee.params):
+                    yield callee.params[idx], a
+            elif a.slot in callee.params:
+                yield a.slot, a
+
+    # -- fixed point -----------------------------------------------------
+    def _fixed_point(self):
+        summaries = list(self.by_qual.values())
+        for _ in range(MAX_PASSES):
+            changed = False
+            for s in summaries:
+                if not s.returns_traced and s.returns_params & s.traced_params:
+                    s.returns_traced = True
+                    changed = True
+                for rec in s.calls:
+                    t = self.resolve(s, rec)
+                    if t is None:
+                        continue
+                    # cross-boundary jit reachability
+                    if s.jit_context and not t.jit_context:
+                        t.jit_context = True
+                        changed = True
+                    # transitive jax reachability (CC004)
+                    if t.touches_jax and not s.touches_jax:
+                        s.touches_jax = True
+                        changed = True
+                    for pname, arg in self.map_args(t, rec.args, rec.via_attribute):
+                        # traced values observed entering the callee
+                        traced = arg.traced or bool(
+                            arg.param_deps & (s.traced_params | (set(s.params) - {"self"} if s.jitted else set()))
+                        )
+                        if traced and pname not in t.traced_params and pname != "self":
+                            t.traced_params.add(pname)
+                            changed = True
+                        # a callee that syncs this param syncs the caller's
+                        # feeding params transitively
+                        if pname in t.sync_params:
+                            for dep in arg.param_deps:
+                                if dep not in s.sync_params:
+                                    s.sync_params.add(dep)
+                                    changed = True
+                for rec in s.returns_calls:
+                    t = self.resolve(s, rec)
+                    if t is None:
+                        continue
+                    if (t.returns_traced or t.jitted) and not s.returns_traced:
+                        s.returns_traced = True
+                        changed = True
+                    if t.returns_lowp and not s.returns_lowp:
+                        s.returns_lowp = True
+                        changed = True
+            if not changed:
+                break
+
+
+def _module_name(rel: str) -> str:
+    rel = rel.replace("\\", "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.replace("/", ".")
+
+
+def _resolve_relative_imports(index: ModuleIndex, module: str, tree: ast.Module):
+    """ModuleIndex skips relative imports (it has no module identity); with
+    one, `from . import x` / `from .sib import f` resolve like absolutes."""
+    pkg_parts = module.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.level > 0:
+            base = pkg_parts[: len(pkg_parts) - node.level]
+            if node.module:
+                base = base + node.module.split(".")
+            if not base:
+                continue
+            prefix = ".".join(base)
+            for a in node.names:
+                index.aliases[a.asname or a.name] = f"{prefix}.{a.name}"

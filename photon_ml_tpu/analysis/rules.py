@@ -161,6 +161,70 @@ RULES: dict[str, Rule] = {
             ),
         ),
         Rule(
+            id="CC001",
+            name="unguarded-write",
+            default_severity=Severity.WARNING,
+            description=(
+                "Write to a lock-owned attribute outside its owning lock: "
+                "the attribute's other mutations consistently hold a "
+                "specific lock (inferred ownership), and this site does "
+                "not. Unlocked READS of owned attributes are not flagged — "
+                "snapshot/atomic-pointer read idioms are intentional"
+            ),
+            hint=(
+                "take the owning lock around the write, or document the "
+                "attribute as single-writer with an inline suppression"
+            ),
+        ),
+        Rule(
+            id="CC002",
+            name="lock-order-inversion",
+            default_severity=Severity.WARNING,
+            description=(
+                "Two locks acquired in both nesting orders within one "
+                "class/module — the classic deadlock shape once two "
+                "threads interleave the two paths"
+            ),
+            hint=(
+                "pick one acquisition order for the pair and refactor the "
+                "rarer path to match it"
+            ),
+        ),
+        Rule(
+            id="CC003",
+            name="unlocked-collection-mutation",
+            default_severity=Severity.ERROR,
+            description=(
+                "Collection mutation (append/add/pop/update/subscript "
+                "store, deque/list/dict/set) on thread-shared state "
+                "outside its owning lock — including module-global "
+                "registries — or on a never-locked collection mutated both "
+                "from a thread-entry path and from ordinary callers"
+            ),
+            hint=(
+                "hold the collection's owning lock around every mutation; "
+                "for a lock-free design, say why it is safe in an inline "
+                "suppression (e.g. bounded deque, references only)"
+            ),
+        ),
+        Rule(
+            id="CC004",
+            name="daemon-jax-teardown",
+            default_severity=Severity.WARNING,
+            description=(
+                "A daemon thread's target (transitively) drives jax "
+                "dispatch, and its scope registers neither an atexit hook "
+                "nor a bounded join(timeout)/result(timeout) stop path — "
+                "interpreter teardown can kill the thread mid-dispatch and "
+                "abort the process"
+            ),
+            hint=(
+                "register an atexit hook that waits (bounded) for the "
+                "thread, add a stop()/close() that joins with a timeout, or "
+                "bound the wait on the task's result(timeout)"
+            ),
+        ),
+        Rule(
             id="SUP001",
             name="suppression-missing-reason",
             default_severity=Severity.ERROR,

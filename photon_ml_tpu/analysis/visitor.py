@@ -76,6 +76,8 @@ _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _STATIC_ATTRS = {
     "shape", "ndim", "dtype", "size", "nbytes", "itemsize", "sharding",
     "aval", "weak_type", "name", "names",
+    # project design-matrix metadata: shape-derived host ints (data/matrix.py)
+    "n_cols", "n_rows",
 }
 # builtins whose result is host/static even on traced arguments
 _STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr", "id", "repr", "str"}
@@ -319,11 +321,14 @@ class ModuleIndex(ast.NodeVisitor):
         self.generic_visit(node)
 
     # -- closure --------------------------------------------------------
-    def close_jit_reachability(self):
+    def close_jit_reachability(self, reset: bool = True):
         """jit_context = jitted ∪ nested-in-jitted ∪ called-from-jit-context,
-        iterated to fixpoint over the intra-module call graph."""
-        for info in self.functions.values():
-            info.jit_context = info.jitted
+        iterated to fixpoint over the intra-module call graph. With
+        ``reset=False`` existing jit_context marks (e.g. applied from a
+        whole-program context) seed the closure instead of being cleared."""
+        if reset:
+            for info in self.functions.values():
+                info.jit_context = info.jitted
         changed = True
         while changed:
             changed = False
@@ -346,13 +351,22 @@ class FunctionAnalyzer:
     """Pass B: walk one function, tracking taint and loop depth, emit findings."""
 
     def __init__(self, index: ModuleIndex, info: FuncInfo, path: str,
-                 config: RuleConfig, findings: list):
+                 config: RuleConfig, findings: list, cross=None):
         self.index = index
         self.info = info
         self.path = path
         self.config = config
         self.findings = findings
+        # whole-program context (analysis.project.ProjectContext) or None:
+        # adds cross-module resolution to taint and the call checks below
+        self.cross = cross
+        self._lineno = getattr(info.node, "lineno", 0)
         self.taint: dict[str, str] = {}
+        # names bound to a genuine PYTHON container (list()/dict()/display):
+        # subscript stores into these are host mutations of the container,
+        # not of an array, however traced the elements are — NP001 exempts
+        # them (re_coeffs = list(params[...]); re_coeffs[i] = w is legal)
+        self.containers: set[str] = set()
         # names currently bound to a REDUCED-PRECISION (bf16/f16) array —
         # tracked separately from `taint` so MP001 never perturbs the
         # host-sync/tracer rules' device-value reasoning
@@ -398,6 +412,24 @@ class FunctionAnalyzer:
             if p not in static and p != "self":
                 self.taint[p] = _TAINT_TRACED
 
+    def seed_cross_params(self):
+        """Parameters some resolved call site was OBSERVED passing a traced
+        value into (project fixed point) are traced here too — the cross-
+        module half of seed_params, precise per-parameter rather than
+        all-or-nothing."""
+        if self.cross is None:
+            return
+        s = self.cross.lookup(self.path, self._lineno)
+        if s is None:
+            return
+        for p in s.traced_params:
+            self.taint.setdefault(p, _TAINT_TRACED)
+
+    def _cross_resolve(self, node: ast.Call, canonical):
+        if self.cross is None:
+            return None
+        return self.cross.resolve_call_node(self.path, self._lineno, node, canonical)
+
     def expr_taint(self, node) -> Optional[str]:
         """Taint kind of the value this expression produces, or None."""
         if isinstance(node, ast.Name):
@@ -438,6 +470,11 @@ class FunctionAnalyzer:
                     return None  # host extraction
                 if self.expr_taint(node.func.value) == _TAINT_TRACED:
                     return _TAINT_TRACED
+            # cross-module: an internal function that returns a device value
+            # (or is jitted in ITS module) taints this call's result
+            s = self._cross_resolve(node, c)
+            if s is not None and (s.returns_traced or s.jitted):
+                return _TAINT_TRACED
             return None
         if isinstance(node, ast.Attribute):
             if node.attr in _STATIC_ATTRS:
@@ -477,6 +514,38 @@ class FunctionAnalyzer:
             return self.expr_taint(node.value)
         return None
 
+    def _taint_loop_target(self, target, iter_node):
+        """Positional taint through transparent iterator wrappers: ``zip``
+        pairs each target element with the matching argument and
+        ``enumerate`` prepends a host int, so
+        ``for i, (rc, cfg) in enumerate(zip(traced_parts, configs))`` taints
+        ``rc`` but neither ``i`` nor ``cfg``. Anything else falls back to
+        whole-target element taint."""
+        if isinstance(iter_node, ast.Call):
+            c = self.index.canonical(iter_node.func)
+            args = iter_node.args
+            if (
+                c == "enumerate" and args
+                and isinstance(target, (ast.Tuple, ast.List))
+                and len(target.elts) == 2
+            ):
+                self._assign_taint(target.elts[0], None)
+                self._taint_loop_target(target.elts[1], args[0])
+                return
+            if (
+                c == "zip"
+                and isinstance(target, (ast.Tuple, ast.List))
+                and len(args) == len(target.elts)
+                and not any(isinstance(a, ast.Starred) for a in args)
+            ):
+                for t, a in zip(target.elts, args):
+                    self._taint_loop_target(t, a)
+                return
+            if c in ("reversed", "sorted", "list", "tuple") and len(args) == 1:
+                self._taint_loop_target(target, args[0])
+                return
+        self._assign_taint(target, self.expr_taint(iter_node))
+
     def _assign_taint(self, target, kind: Optional[str]):
         if isinstance(target, ast.Name):
             if kind is None:
@@ -500,6 +569,32 @@ class FunctionAnalyzer:
                 self._assign_lowp(e, is_lowp)
         elif isinstance(target, ast.Starred):
             self._assign_lowp(target.value, is_lowp)
+
+    def _is_container_expr(self, node) -> bool:
+        """A display or constructor that yields a real Python container."""
+        if isinstance(node, (ast.List, ast.Dict, ast.Set,
+                             ast.ListComp, ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            c = self.index.canonical(node.func)
+            return c in (
+                "list", "dict", "set",
+                "collections.deque", "collections.defaultdict",
+                "collections.OrderedDict", "deque", "defaultdict",
+                "OrderedDict",
+            )
+        return False
+
+    def _mark_container(self, target, is_container: bool):
+        if isinstance(target, ast.Name):
+            if is_container:
+                self.containers.add(target.id)
+            else:
+                self.containers.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # tuple unpacking never binds the RHS container itself
+            for e in target.elts:
+                self._mark_container(e, False)
 
     def _is_lowp_expr(self, node) -> bool:
         """True when the expression's value is (conservatively) a reduced-
@@ -529,6 +624,12 @@ class FunctionAnalyzer:
             for kw in node.keywords:
                 if kw.arg == "dtype":
                     return _dtype_ref_in(kw.value, _LOW_PRECISION_NAMES)
+            # cross-module: internal call returning a reduced-precision array
+            # (resolved BEFORE receiver propagation — module.helper(x) has a
+            # module name as its receiver, which is never lowp)
+            s = self._cross_resolve(node, self.index.canonical(node.func))
+            if s is not None:
+                return s.returns_lowp
             if isinstance(node.func, ast.Attribute):
                 # dtype-preserving method on a lowp receiver (.reshape, .T...)
                 return self._is_lowp_expr(node.func.value)
@@ -552,6 +653,12 @@ class FunctionAnalyzer:
                 return False
             if isinstance(node.func, ast.Attribute) and self.uses_traced_value(node.func.value):
                 return True
+            s = self._cross_resolve(node, c)
+            if s is not None:
+                # a resolved project summary decides outright: a helper that
+                # returns host/static metadata (shape gating, eligibility
+                # booleans) never forces a tracer, whatever its arguments are
+                return bool(s.returns_traced or s.jitted)
             return any(self.uses_traced_value(a) for a in node.args)
         if isinstance(node, ast.Compare):
             if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
@@ -574,6 +681,7 @@ class FunctionAnalyzer:
     # -- statement walk --------------------------------------------------
     def run(self):
         self.seed_params()
+        self.seed_cross_params()
         node = self.info.node
         body = node.body if isinstance(node.body, list) else [ast.Expr(node.body)]
         self.walk_body(body)
@@ -589,7 +697,7 @@ class FunctionAnalyzer:
         if isinstance(st, (ast.For, ast.AsyncFor)):
             self.visit_exprs(st.iter)
             # iterating a traced/array iterable yields traced elements
-            self._assign_taint(st.target, self.expr_taint(st.iter))
+            self._taint_loop_target(st.target, st.iter)
             self.loop_depth += 1
             # taint-only pre-pass so the reporting pass sees loop-carried taint
             self._quiet += 1
@@ -627,6 +735,7 @@ class FunctionAnalyzer:
             self.visit_exprs(st.value)
             kind = self.expr_taint(st.value)
             is_lowp = self._is_lowp_expr(st.value)
+            is_container = self._is_container_expr(st.value)
             for t in st.targets:
                 if isinstance(t, ast.Subscript):
                     self.check_np_mutation(t, st)
@@ -634,6 +743,7 @@ class FunctionAnalyzer:
                 else:
                     self._assign_taint(t, kind)
                     self._assign_lowp(t, is_lowp)
+                    self._mark_container(t, is_container)
             return
         if isinstance(st, ast.AnnAssign):
             if st.value is not None:
@@ -760,8 +870,45 @@ class FunctionAnalyzer:
         if in_jit:
             self.check_mixed_precision(node, c)
 
+        # HS001 (cross-module): a traced value handed to an internal function
+        # that host-syncs that parameter — the flow v1's module-local taint
+        # could not see (the PR 2 tracker-sync class)
+        if self.cross is not None and (in_jit or in_loop):
+            self.check_cross_sync(node, c, in_jit)
+
         # RT001a: literal python arg to a known-jitted callable without static marking
         self.check_jitted_call_args(node)
+
+    def check_cross_sync(self, node: ast.Call, c: Optional[str], in_jit: bool):
+        s = self._cross_resolve(node, c)
+        if s is None or not s.sync_params:
+            return
+        via_attr = isinstance(node.func, ast.Attribute)
+        offset = 1 if (via_attr and s.is_method) else 0
+        synced = []
+        for i, a in enumerate(node.args):
+            idx = i + offset
+            if idx < len(s.params) and s.params[idx] in s.sync_params:
+                if self.expr_taint(a) == _TAINT_TRACED:
+                    synced.append(s.params[idx])
+        for kw in node.keywords:
+            if kw.arg in s.sync_params and self.expr_taint(kw.value) == _TAINT_TRACED:
+                synced.append(kw.arg)
+        if not synced:
+            return
+        where = f"{s.qualname} (parameter(s) {sorted(set(synced))})"
+        if in_jit:
+            self.report(
+                "HS001", node,
+                f"traced value host-synced inside {where}, called from jit-traced code",
+                severity=Severity.ERROR,
+            )
+        else:
+            self.report(
+                "HS001", node,
+                f"per-iteration host sync: this loop passes a device value into {where}, "
+                "which synchronizes it every call",
+            )
 
     def check_mixed_precision(self, node: ast.Call, c: Optional[str]):
         """MP001 (jitted bodies only): explicit f64 promotion, accumulation
@@ -870,6 +1017,8 @@ class FunctionAnalyzer:
         base = target.value
         while isinstance(base, ast.Subscript):
             base = base.value
+        if isinstance(base, ast.Name) and base.id in self.containers:
+            return  # store into a host list/dict, not an array
         kind = self.expr_taint(base)
         if kind == _TAINT_TRACED:
             self.report("NP001", st,
@@ -909,11 +1058,23 @@ class FunctionAnalyzer:
             )
 
 
-def analyze_module(tree: ast.Module, path: str, config: RuleConfig) -> list:
-    """Run both passes over a parsed module; returns raw (unsuppressed) findings."""
+def analyze_module(tree: ast.Module, path: str, config: RuleConfig, cross=None) -> list:
+    """Run both passes over a parsed module; returns raw (unsuppressed)
+    findings. ``cross`` (analysis.project.ProjectContext) adds whole-program
+    resolution: project-closed jit reachability, traced-parameter seeds and
+    cross-module sync/taint checks."""
     index = ModuleIndex()
     index.visit(tree)
     index.close_jit_reachability()
+    if cross is not None:
+        # jit reachability closed over the PROJECT call graph: a function
+        # jit-reachable only through another module's call chain arms the
+        # in-jit rules here too
+        for info in index.functions.values():
+            s = cross.lookup(path, getattr(info.node, "lineno", -1))
+            if s is not None and s.jit_context:
+                info.jit_context = True
+        index.close_jit_reachability(reset=False)
     index.mixed_precision_scope = module_mentions_low_precision(tree)
     findings: list = []
     # module-level statements: analyze as a pseudo-function (not jit context)
@@ -926,7 +1087,7 @@ def analyze_module(tree: ast.Module, path: str, config: RuleConfig) -> list:
     mod_info = FuncInfo(node=pseudo, name="<module>", parent=None)
     FunctionAnalyzer(index, mod_info, path, config, findings).run()
     for info in index.functions.values():
-        FunctionAnalyzer(index, info, path, config, findings).run()
+        FunctionAnalyzer(index, info, path, config, findings, cross=cross).run()
     seen = set()
     unique = []
     for f in findings:

@@ -1,0 +1,70 @@
+"""CC001 fixture: writes to a lock-owned attribute outside its owning lock.
+
+Ownership is inferred, never declared: an attribute whose mutations
+consistently hold one lock is owned by it, and the stray unlocked write is
+the finding. The guard cases pin the deliberate non-findings: construction
+writes in __init__, unlocked READS of owned attributes (snapshot idiom),
+and never-locked single-writer attributes (Event-synchronized handoff).
+"""
+
+import threading
+
+
+class SwapManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._generation = 0  # construction write: never counts
+        self._engine_ref = ("engine-0", 0)
+
+    def install(self, engine, gen):
+        with self._lock:
+            self._generation = gen
+            self._engine_ref = (engine, gen)
+
+    def rollback(self, engine, gen):
+        with self._lock:
+            self._generation = gen
+            self._engine_ref = (engine, gen)
+
+    def force(self, gen):
+        self._generation = gen  # EXPECT: CC001
+
+    def snapshot(self):
+        # unlocked READ of an owned attribute: the atomic tuple-swap idiom —
+        # readers take the reference without the lock by design
+        engine, gen = self._engine_ref
+        return engine, gen
+
+
+class SuppressedForce:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._epoch = 0
+
+    def bump(self):
+        with self._lock:
+            self._epoch += 1
+
+    def sync(self, epoch):
+        with self._lock:
+            self._epoch = epoch
+
+    def reset(self):
+        self._epoch = 0  # jaxlint: disable=CC001 single writer during recovery, readers tolerate one stale epoch
+
+
+class SingleWriterHandoff:
+    """Never-locked attribute written from one side and published through an
+    Event — no inferred owner, so no CC001 however many threads read it."""
+
+    def __init__(self):
+        self._value = None
+        self._done = threading.Event()
+
+    def run_task(self, fn):
+        self._value = fn()
+        self._done.set()
+
+    def result(self):
+        self._done.wait(5.0)
+        return self._value

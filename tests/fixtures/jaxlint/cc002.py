@@ -1,0 +1,70 @@
+"""CC002 fixture: two locks acquired in both nesting orders (deadlock shape).
+
+The dominant order is treated as the convention; the rarer direction's
+acquisition sites are flagged. Consistent nesting — however deep — is clean.
+"""
+
+import threading
+
+
+class TransferPlanner:
+    def __init__(self):
+        self._alloc = threading.Lock()
+        self._stats = threading.Lock()
+        self._bytes_in_flight = 0
+
+    def plan(self, n):
+        with self._alloc:
+            with self._stats:  # dominant order: alloc -> stats
+                self._bytes_in_flight += n
+
+    def account(self, n):
+        with self._alloc:
+            with self._stats:
+                self._bytes_in_flight -= n
+
+    def report(self):
+        with self._stats:
+            with self._alloc:  # EXPECT: CC002
+                return self._bytes_in_flight
+
+
+class SuppressedInversion:
+    def __init__(self):
+        self._head = threading.Lock()
+        self._tail = threading.Lock()
+        self.moves = 0
+
+    def forward(self):
+        with self._head:
+            with self._tail:
+                self.moves += 1
+
+    def forward_bulk(self, n):
+        with self._head:
+            with self._tail:
+                self.moves += n
+
+    def backward(self):
+        with self._tail:
+            with self._head:  # jaxlint: disable=CC002 backward runs only under the global drain barrier, never concurrent with forward
+                self.moves -= 1
+
+
+class ConsistentNesting:
+    """Same pair, always the same order — clean."""
+
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+        self.state = 0
+
+    def a(self):
+        with self._outer:
+            with self._inner:
+                self.state += 1
+
+    def b(self):
+        with self._outer:
+            with self._inner:
+                self.state -= 1

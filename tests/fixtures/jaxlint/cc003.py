@@ -1,0 +1,85 @@
+"""CC003 fixture: collection mutation on thread-shared state outside its lock.
+
+Three shapes: (a) an owned collection mutated outside its owning lock,
+(b) a module-global registry with the same defect (the log-once-dedup
+class), and (c) a never-locked collection mutated from a thread-entry path
+AND from ordinary callers. Guard cases: the reference-only mirror deque
+(one-sided, never locked) and suppressed lock-free designs.
+"""
+
+import collections
+import threading
+
+# -- (b) module-global registry: one function guards, one forgets ------------
+_registry_lock = threading.Lock()
+_registry = set()
+
+
+def log_once(key):
+    if key in _registry:
+        return False
+    _registry.add(key)  # EXPECT: CC003
+    return True
+
+
+def log_once_locked(key):
+    with _registry_lock:
+        if key in _registry:
+            return False
+        _registry.add(key)
+        return True
+
+
+class IncidentLog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._incidents = collections.deque(maxlen=64)
+
+    def record(self, incident):
+        with self._lock:
+            self._incidents.append(incident)
+
+    def merge(self, incidents):
+        with self._lock:
+            self._incidents.extend(incidents)
+
+    def record_fast(self, incident):
+        self._incidents.append(incident)  # EXPECT: CC003
+
+
+class Dispatcher:
+    """(c): never-locked queue mutated on the drain thread and by callers."""
+
+    def __init__(self):
+        self._queue = []
+        self._mirror = collections.deque(maxlen=16)
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while self._queue:
+            self._queue.pop()  # EXPECT: CC003
+
+    def submit(self, item):
+        self._queue.append(item)
+
+    def observe(self, item):
+        # the mirror is mutated ONLY from ordinary callers — one-sided,
+        # reference-only, no thread entry touches it: clean by design
+        self._mirror.append(item)
+
+
+class LockFreeByDesign:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = collections.deque(maxlen=32)
+
+    def push(self, e):
+        with self._lock:
+            self._events.append(e)
+
+    def push_hot(self, e):
+        self._events.append(e)  # jaxlint: disable=CC003 bounded deque of immutable tuples; CPython append is atomic and readers only snapshot

@@ -1,0 +1,75 @@
+"""CC004 fixture: daemon threads that drive jax with no bounded teardown.
+
+A daemon thread still dispatching when the interpreter tears down aborts
+the process mid-collective. Mitigations that make the scope clean: an
+atexit hook, a bounded join(timeout) stop path, or a bounded result(timeout)
+wait on the spawning side (the serving warm-up shape).
+"""
+
+import atexit
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.pipeline import BackgroundTask
+
+
+class UnboundedWarmer:
+    def start(self):
+        t = threading.Thread(target=self._warm, daemon=True)  # EXPECT: CC004
+        t.start()
+        return t
+
+    def _warm(self):
+        return jnp.zeros((8,)) + 1.0
+
+
+class BoundedWarmer:
+    """Same shape, but the spawn site waits with a timeout: clean."""
+
+    def start(self, timeout):
+        task = BackgroundTask(self._warm, name="warm")
+        return task.result(timeout)
+
+    def _warm(self):
+        return jnp.sum(jnp.ones((4,)))
+
+
+class AtexitPoller:
+    """Daemon poll loop, but teardown is registered: clean."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        atexit.register(self.shutdown)
+
+    def shutdown(self):
+        self._stop.set()
+
+    def start(self):
+        t = threading.Thread(target=self._spin, daemon=True)
+        t.start()
+
+    def _spin(self):
+        while not self._stop.is_set():
+            jax.device_put(1.0)
+
+
+class HostOnlyTicker:
+    """Daemon thread that never reaches jax: nothing to abort, clean."""
+
+    def start(self):
+        t = threading.Thread(target=self._tick, daemon=True)
+        t.start()
+
+    def _tick(self):
+        return 1 + 1
+
+
+class AcceptedPoller:
+    def start(self):
+        t = threading.Thread(target=self._poll, daemon=True)  # jaxlint: disable=CC004 process-lifetime poller; a teardown abort is acceptable in this tool
+        t.start()
+
+    def _poll(self):
+        return jnp.ones(())

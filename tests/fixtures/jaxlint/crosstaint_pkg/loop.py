@@ -1,0 +1,47 @@
+"""Cross-module taint callers: every finding here needs the project context.
+
+Module-local (v1) analysis resolves none of the tracker.* calls, so this
+file scans clean without it — the regression test pins exactly that. With
+the whole-program context (v2): the descent loop's per-iteration sync into
+``tracker.ProgressTracker.observe`` fires HS001 (the PR 2 tracker-sync
+class), a jitted body syncing through ``tracker.to_host`` fires HS001 at
+error severity, control flow on ``tracker.norm``'s traced return fires
+TR001, and reducing over ``tracker.half``'s bf16 return fires MP001.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import tracker
+
+
+@jax.jit
+def step(w, x):
+    g = jnp.dot(x, w)
+    return w - 0.01 * g, jnp.mean(g * g)
+
+
+def descent(w0, xs):
+    tr = tracker.ProgressTracker()
+    w = w0
+    for x in xs:
+        w, loss = step(w, x)
+        tr.observe(loss)  # EXPECT: HS001
+    return w, tr.history
+
+
+@jax.jit
+def bad_step(w):
+    scale = tracker.to_host(jnp.sum(w))  # EXPECT: HS001
+    return w * scale
+
+
+@jax.jit
+def guarded_step(w):
+    # v1's taint dies at the assignment: tracker.norm is an unresolvable
+    # call module-locally, so `n` reads as host data and the branch scans
+    # clean. The project context knows norm returns a device value.
+    n = tracker.norm(w)
+    if n > 1.0:  # EXPECT: TR001
+        w = w / 2.0
+    return jnp.sum(tracker.half(w))  # EXPECT: MP001

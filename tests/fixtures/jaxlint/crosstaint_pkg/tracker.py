@@ -1,0 +1,35 @@
+"""Cross-module sync sinks: the tracker-metrics shape jaxlint v1 missed.
+
+Nothing in THIS module is a hazard under module-local analysis: there is no
+jit and no loop here, so v1 scans it clean. The hazards only exist at the
+whole-program level — callers in loop.py hand traced values into these
+functions from jit-traced code and descent loops. The EXPECT marker below
+holds only under the project context (v2); the regression test asserts v1
+reports nothing for this package.
+"""
+
+import jax.numpy as jnp
+
+
+class ProgressTracker:
+    def __init__(self):
+        self.history = []
+
+    def observe(self, loss):
+        # host-syncs its argument — the finding lands at the per-iteration
+        # CALL SITE in loop.py, not here (this body has no loop and no jit)
+        self.history.append(float(loss))
+
+
+def to_host(value):
+    # jit-reachable only through loop.py's bad_step: the project context
+    # marks this jit-traced and its parameter traced, arming HS001 here
+    return float(value)  # EXPECT: HS001
+
+
+def norm(w):
+    return jnp.sqrt(jnp.sum(w * w))
+
+
+def half(x):
+    return x.astype(jnp.bfloat16)

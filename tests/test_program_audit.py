@@ -1,0 +1,238 @@
+"""Compiled-program inventory ratchet (tools/program_audit.py): HLO fact
+extraction, the ratchet diff, the seeded self-check, and the CLI exit codes.
+
+The fast tests drive the pure text/record layer on canned HLO so the gate's
+semantics are pinned without compiling anything; one slow test lowers a real
+program family end to end. The committed inventory itself is enforced by the
+CI ``program-audit`` job (``--check`` + ``--self-check``), not here — a unit
+suite should not depend on compiler-version-stable collective counts.
+"""
+
+import copy
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "program_audit", REPO / "tools" / "program_audit.py"
+)
+pa = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(pa)
+
+
+# canned module in real post-SPMD HLO shape: donated params in the header,
+# one data all-reduce + one predicate all-reduce inside a while loop, one
+# data all-gather outside it
+CANNED = """\
+HloModule jit_update, input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, must-alias) }, entry_computation_layout={...}
+
+%add (a: f64[], b: f64[]) -> f64[] {
+  ROOT %r = f64[] add(%a, %b)
+}
+
+%body (arg_tuple.1: (s32[], f64[8])) -> (s32[], f64[8]) {
+  %ar = f64[8]{0} all-reduce(%x), channel_id=1, to_apply=%add
+}
+
+%cond (arg_tuple.2: (s32[], f64[8])) -> pred[] {
+  %p = pred[] all-reduce(%q), channel_id=2, to_apply=%or
+}
+
+ENTRY %main (p0: f64[8], p1: s32[], p2: f64[8]) -> (f64[8], f64[8]) {
+  %w = (s32[], f64[8]) while(%init), condition=%cond, body=%body
+  %ag = f32[64]{0} all-gather(%p0), channel_id=3, dimensions={0}
+}
+"""
+
+
+def canned_record():
+    return pa.summarize(CANNED)
+
+
+# ------------------------------------------------------------ fact extraction
+
+
+def test_parse_aliases_reads_donated_buffers():
+    assert pa.parse_aliases(CANNED) == ["out{0}<-arg0", "out{1}<-arg2"]
+
+
+def test_parse_aliases_handles_tuple_output_indices_and_absence():
+    hlo = "HloModule m, input_output_alias={ {1, 0}: (3, {}, may-alias) }, x={y}\n"
+    assert pa.parse_aliases(hlo) == ["out{1, 0}<-arg3"]
+    assert pa.parse_aliases("HloModule m, entry_computation_layout={...}\n") == []
+
+
+def test_widest_float():
+    assert pa.widest_float(CANNED) == "f64"
+    assert pa.widest_float("x = f32[4] add(bf16[2] %a)") == "f32"
+    assert pa.widest_float("x = bf16[4]{0} dot(...)") == "bf16"
+    assert pa.widest_float("x = s32[4] add(...)") == "none"
+
+
+def test_summarize_splits_data_pred_and_loop_collectives():
+    rec = canned_record()
+    assert rec["donated"] == ["out{0}<-arg0", "out{1}<-arg2"]
+    assert rec["data_collectives"] == {"all-gather": 1, "all-reduce": 1}
+    assert rec["pred_all_reduce"] == 1
+    # the data all-reduce sits in %body, the predicate consensus in %cond
+    assert rec["in_loop_data"] == 1
+    assert rec["in_loop_pred"] == 1
+    assert rec["widest_float"] == "f64"
+
+
+# -------------------------------------------------------------- ratchet diff
+
+
+def _pair():
+    rec = canned_record()
+    return {"prog": copy.deepcopy(rec)}, {"prog": copy.deepcopy(rec)}
+
+
+def test_diff_clean_on_identical_records():
+    current, committed = _pair()
+    assert pa.diff_inventories(current, committed) == ([], [])
+
+
+def test_diff_flags_dropped_donation_and_stale_gain():
+    current, committed = _pair()
+    current["prog"]["donated"] = ["out{0}<-arg0"]
+    regs, stale = pa.diff_inventories(current, committed)
+    assert any("donation dropped" in r and "out{1}<-arg2" in r for r in regs)
+    current, committed = _pair()
+    committed["prog"]["donated"] = ["out{0}<-arg0"]
+    regs, stale = pa.diff_inventories(current, committed)
+    assert not regs and any("newly donated" in s for s in stale)
+
+
+def test_diff_flags_new_in_loop_data_collective():
+    current, committed = _pair()
+    current["prog"]["in_loop_data"] += 1
+    regs, _ = pa.diff_inventories(current, committed)
+    assert any("inside solver while-loops" in r for r in regs)
+
+
+def test_diff_flags_float_widening_both_directions():
+    current, committed = _pair()
+    committed["prog"]["widest_float"] = "f32"
+    regs, _ = pa.diff_inventories(current, committed)
+    assert any("widest float widened f32 -> f64" in r for r in regs)
+    current, committed = _pair()
+    current["prog"]["widest_float"] = "f32"
+    regs, stale = pa.diff_inventories(current, committed)
+    assert not regs and any("narrowed" in s for s in stale)
+
+
+def test_diff_flags_collective_count_growth_and_new_kind():
+    current, committed = _pair()
+    current["prog"]["data_collectives"]["all-gather"] = 2
+    regs, _ = pa.diff_inventories(current, committed)
+    assert any("all-gather count grew 1 -> 2" in r for r in regs)
+    current, committed = _pair()
+    current["prog"]["data_collectives"]["all-to-all"] = 1
+    regs, _ = pa.diff_inventories(current, committed)
+    assert any("all-to-all" in r and "new collective kind" in r for r in regs)
+
+
+def test_diff_flags_missing_program_and_notes_new_one():
+    current, committed = _pair()
+    committed["gone"] = copy.deepcopy(committed["prog"])
+    regs, _ = pa.diff_inventories(current, committed)
+    assert any(r.startswith("gone: program family missing") for r in regs)
+    current, committed = _pair()
+    current["extra"] = copy.deepcopy(current["prog"])
+    regs, stale = pa.diff_inventories(current, committed)
+    assert not regs and any("new program family" in s for s in stale)
+
+
+def test_self_check_catches_all_seeded_classes():
+    assert pa.self_check({"prog": canned_record()}) == []
+
+
+def test_self_check_reports_a_broken_gate():
+    """If the donation gate had nothing to protect, self-check must say so
+    rather than vacuously pass."""
+    rec = canned_record()
+    rec["donated"] = []
+    failures = pa.self_check({"prog": rec})
+    assert any("nothing to protect" in f for f in failures)
+
+
+# ----------------------------------------------------------------------- CLI
+
+
+@pytest.fixture()
+def patched_builders(monkeypatch):
+    """CLI runs against the canned module — no compiles, real exit paths."""
+    monkeypatch.setattr(pa, "PROGRAM_BUILDERS", {"prog": lambda: CANNED})
+    monkeypatch.setattr(pa, "_setup_env", lambda: None)
+
+
+def test_cli_update_then_check_roundtrip(tmp_path, patched_builders, capsys):
+    inv = tmp_path / "inv.json"
+    assert pa.main(["--update", "--inventory", str(inv)]) == 0
+    doc = json.loads(inv.read_text())
+    assert doc["programs"]["prog"] == canned_record()
+    assert pa.main(["--check", "--inventory", str(inv)]) == 0
+
+
+def test_cli_check_exit_codes(tmp_path, patched_builders, monkeypatch, capsys):
+    inv = tmp_path / "inv.json"
+    pa.main(["--update", "--inventory", str(inv)])
+    # regression: the fresh build lost its donation header
+    monkeypatch.setattr(
+        pa, "PROGRAM_BUILDERS",
+        {"prog": lambda: CANNED.replace(
+            "input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, must-alias) }, ",
+            "")},
+    )
+    assert pa.main(["--check", "--inventory", str(inv)]) == 1
+    assert "donation dropped" in capsys.readouterr().out
+    # improvement: the data all-gather disappeared -> stale inventory
+    monkeypatch.setattr(
+        pa, "PROGRAM_BUILDERS",
+        {"prog": lambda: "\n".join(
+            l for l in CANNED.splitlines() if "all-gather" not in l)},
+    )
+    assert pa.main(["--check", "--inventory", str(inv)]) == 2
+    assert "regenerate with --update" in capsys.readouterr().out
+
+
+def test_cli_build_failure_is_exit_3(tmp_path, patched_builders, monkeypatch, capsys):
+    inv = tmp_path / "inv.json"
+    pa.main(["--update", "--inventory", str(inv)])
+
+    def boom():
+        raise RuntimeError("lowering exploded")
+
+    monkeypatch.setattr(pa, "PROGRAM_BUILDERS", {"prog": boom})
+    assert pa.main(["--check", "--inventory", str(inv)]) == 3
+    err = capsys.readouterr().err
+    assert "BUILD FAILED" in err and "lowering exploded" in err
+
+
+def test_cli_missing_inventory_fails(tmp_path, patched_builders, capsys):
+    assert pa.main(["--check", "--inventory", str(tmp_path / "none.json")]) == 1
+
+
+def test_cli_self_check(patched_builders, capsys):
+    assert pa.main(["--self-check"]) == 0
+    assert "self-check OK" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------- real programs
+
+
+@pytest.mark.slow
+def test_real_serving_program_record(eight_devices):
+    """One real family end to end: the serving engine's total-score bucket
+    lowers, summarizes, and carries the structural facts the committed
+    inventory records for it (no donation, no collectives on one host)."""
+    rec = pa.summarize(pa.build_serving_score())
+    committed = json.loads(
+        (REPO / "tools" / "program_inventory.json").read_text()
+    )["programs"]["serving_score"]
+    assert rec == committed

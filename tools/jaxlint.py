@@ -56,7 +56,14 @@ def main(argv=None) -> int:
         description="JAX-aware static analysis: host syncs, retraces, tracer safety",
     )
     p.add_argument("paths", nargs="*", help="files or directories to lint")
-    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--format", choices=("human", "json", "github"), default="human",
+                   help="'github' emits ::error/::warning workflow annotations")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="fan per-file rule passes out to N worker processes "
+                        "(the whole-program context is built once, up front)")
+    p.add_argument("--no-project", action="store_true",
+                   help="disable the whole-program (cross-module) context: "
+                        "v1 module-local semantics")
     p.add_argument(
         "--baseline",
         default=str(DEFAULT_BASELINE),
@@ -105,7 +112,9 @@ def main(argv=None) -> int:
     # the fixture corpus is intentional violations; never lint it for real
     exclude = list(args.exclude) + ["tests/fixtures/jaxlint"]
     result = linter.lint_paths(args.paths, config=config,
-                               rel_root=str(REPO_ROOT), exclude=exclude)
+                               rel_root=str(REPO_ROOT), exclude=exclude,
+                               project=not args.no_project,
+                               jobs=max(1, args.jobs))
     for path, message in result.errors:
         print(f"jaxlint: {path}: {message}", file=sys.stderr)
 
@@ -140,6 +149,24 @@ def main(argv=None) -> int:
                 "baseline": baseline_used,
             },
         }, indent=2))
+    elif args.format == "github":
+        # workflow-command annotations: the Actions runner attaches these to
+        # the PR diff at the exact file/line (data: %/CR/LF must be escaped)
+        def esc(s: str) -> str:
+            return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+        kinds = {"ERROR": "error", "WARNING": "warning", "INFO": "notice"}
+        shown = new if baseline_used else result.findings
+        for f in shown:
+            print(f"::{kinds[f.severity.name]} file={esc(f.path)},"
+                  f"line={f.line},col={f.col},title=jaxlint {f.rule}::"
+                  f"{esc(f.message)} (hint: {esc(f.hint)})")
+        for entry in stale:
+            print(f"::warning title=jaxlint stale baseline::{esc(entry['key'])} "
+                  "is baselined but no longer found; regenerate with "
+                  "--update-baseline")
+        print(f"jaxlint: {len(shown)} annotation(s), {len(stale)} stale "
+              "baseline entr(y/ies)")
     else:
         shown = new if baseline_used else result.findings
         for f in shown:
