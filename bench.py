@@ -873,6 +873,14 @@ def main():
         # canary-reject and quota-distinctness gates
         _delegate_benchmark("--fleet", "fleet_bench")
 
+    if "--fleet-proc" in sys.argv:
+        # CROSS-PROCESS fleet: N replica processes behind the front router
+        # (serving/router.py), SIGKILLed mid-load and restarted:
+        # fleet_proc_sustained_qps_at_p999 with bitwise-parity,
+        # zero-silent-drop, reconverge-within-probe-budget and
+        # readmitted-replica-serves gates
+        _delegate_benchmark("--fleet-proc", "fleet_proc_bench")
+
     if "--continuous" in sys.argv:
         # continuous-training delta pass vs full retrain (active-set-fraction,
         # delta-proportionality, quality-parity and bounded-retrace gates)

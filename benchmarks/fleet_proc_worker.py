@@ -1,0 +1,110 @@
+"""One replica PROCESS for the cross-process fleet bench.
+
+benchmarks/fleet_proc_bench.py spawns N of these (and SIGKILLs them
+mid-load); each stands up the full single-replica serving stack — engine,
+micro-batching frontend, ModelRouter, HTTP transport — on ``--port`` and
+then just serves until killed.
+
+Determinism contract with the bench: models are built from ``--seed`` via
+the SAME generator the bench uses for its reference engine, so every worker
+(including a restarted one) serves bitwise-identical coefficients and the
+bench can hold every routed response to bitwise parity against a direct
+local engine call.
+
+Readiness contract with the front router: the worker WARMS its engine
+(compiles the coalescible bucket ladder) BEFORE binding the HTTP port, and
+prints its one-line JSON banner only after the server is listening — so
+``/readyz`` answers 200 from the first probe and a restarted replica is
+never re-admitted before its compiled programs are live. The banner line
+(``{"ready": true, "port": ..., "pid": ...}``) is the parent's spawn
+synchronization point.
+
+SIGTERM exits cleanly (router drained); SIGKILL is the chaos path and
+deliberately cleans up nothing — that is what the bench is testing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+
+import numpy as np
+
+# spawned as a bare script: python puts benchmarks/ on sys.path (this file's
+# dir) but not the repo root the photon_ml_tpu package lives in
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from serving_load_bench import build_models, warm_buckets
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--seed", type=int, default=20260807,
+                   help="model-build seed; MUST match the bench's reference "
+                        "engine for the bitwise-parity gate to be meaningful")
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--max-batch", type=int, default=128)
+    p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--queue-depth", type=int, default=512)
+    args = p.parse_args(argv)
+
+    from photon_ml_tpu.io.checkpoint import save_checkpoint
+    from photon_ml_tpu.serving import (
+        FleetHTTPServer,
+        FrontendConfig,
+        ModelRouter,
+        ReplicaSet,
+    )
+
+    n_users = max(1, int(200 * args.scale))
+    n_items = max(1, int(50 * args.scale))
+    rng = np.random.default_rng(args.seed)
+    models = build_models(rng, n_users, n_items, scale=1.0)
+    ckpt_root = tempfile.mkdtemp(prefix=f"fleet-proc-{args.port}-")
+    save_checkpoint(ckpt_root, models, 1, keep_generations=2)
+
+    config = FrontendConfig(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        max_queue_depth=args.queue_depth,
+        default_deadline_ms=None,
+    )
+    replica_set = ReplicaSet.from_checkpoint(
+        ckpt_root, n_replicas=1, name="main", config=config
+    )
+    router = ModelRouter()
+    router.add_model("main", replica_set)
+
+    # warm BEFORE listening: /readyz must never say yes first
+    warm_rng = np.random.default_rng(args.seed + 1)
+    warm_buckets(
+        replica_set.replicas[0].engine, warm_rng,
+        args.batch, args.max_batch, n_users, n_items,
+    )
+
+    server = FleetHTTPServer(router, port=args.port).start()
+    print(
+        json.dumps({"ready": True, "port": server.port, "pid": os.getpid()}),
+        flush=True,
+    )
+
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    try:
+        done.wait()
+    except KeyboardInterrupt:
+        pass
+    server.close()
+    router.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

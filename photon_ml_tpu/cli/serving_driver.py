@@ -164,12 +164,24 @@ def run(args: argparse.Namespace) -> dict:
             if getattr(args, "fleet_http_port", None) is not None:
                 from photon_ml_tpu.serving import FleetHTTPServer
 
+                # warm every replica BEFORE the endpoint exists: /readyz
+                # (liveness vs readiness — engine.warmed) must answer 200
+                # from the first probe a front router sends, or a restarted
+                # replica sits in an evicted/unready limbo for a probe cycle
+                # it didn't need
+                warm_req = data.select(
+                    np.arange(min(data.n, int(args.serving_request_batch)))
+                )
+                with Timed("warm replicas (compile first bucket)", logger):
+                    for replica in replica_set.replicas:
+                        replica.engine.score(warm_req)
                 http_server = FleetHTTPServer(
                     router, port=args.fleet_http_port
                 ).start()
                 logger.info(
-                    "fleet HTTP endpoint listening on %s:%d",
+                    "fleet HTTP endpoint listening on %s:%d (readiness: %s)",
                     http_server.host, http_server.port,
+                    json.dumps(router.readiness()),
                 )
             submit = lambda req: router.submit(model_name, req)  # noqa: E731
             stats_fn = router.stats

@@ -26,6 +26,11 @@ from photon_ml_tpu.resilience.chaos import (
 )
 from photon_ml_tpu.resilience.faultpoints import (
     ENV_VAR,
+    FP_ROUTER_EVICT,
+    FP_ROUTER_PROBE,
+    FP_ROUTER_READMIT,
+    FP_ROUTER_RETRY,
+    FP_ROUTER_SHED,
     FaultEntry,
     FaultPlan,
     InjectedCrash,
@@ -39,17 +44,23 @@ from photon_ml_tpu.resilience.faultpoints import (
     registered_fault_points,
 )
 from photon_ml_tpu.resilience.incidents import Incident
-from photon_ml_tpu.resilience.retry import Retry, RetryExhausted
+from photon_ml_tpu.resilience.retry import Retry, RetryBudget, RetryExhausted
 
 __all__ = [
     "ChaosOutcome",
     "ENV_VAR",
+    "FP_ROUTER_EVICT",
+    "FP_ROUTER_PROBE",
+    "FP_ROUTER_READMIT",
+    "FP_ROUTER_RETRY",
+    "FP_ROUTER_SHED",
     "FaultEntry",
     "FaultPlan",
     "Incident",
     "InjectedCrash",
     "InjectedFault",
     "Retry",
+    "RetryBudget",
     "RetryExhausted",
     "arm",
     "armed",

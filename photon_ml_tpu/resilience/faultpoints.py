@@ -79,6 +79,19 @@ def registered_fault_points() -> tuple[str, ...]:
     return tuple(_REGISTRY)
 
 
+# The front-router points are registered HERE rather than in
+# serving/router.py: the router is the one subsystem whose failure domain is
+# another PROCESS, so its crash sites must be enumerable (for the chaos
+# registry-coverage gate) without importing the serving stack — the
+# cross-process bench arms them in the router process while the replica
+# processes run none of this instrumentation.
+FP_ROUTER_PROBE = register_fault_point("serve.router.probe")
+FP_ROUTER_EVICT = register_fault_point("serve.router.evict")
+FP_ROUTER_READMIT = register_fault_point("serve.router.readmit")
+FP_ROUTER_RETRY = register_fault_point("serve.router.retry")
+FP_ROUTER_SHED = register_fault_point("serve.router.shed")
+
+
 @dataclasses.dataclass
 class FaultEntry:
     """One armed plan entry: fire ``action`` on hits [start, start+count)."""

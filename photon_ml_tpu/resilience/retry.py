@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import random
+import threading
 import time
 from typing import Callable, Optional
 
@@ -22,6 +23,66 @@ logger = logging.getLogger(__name__)
 
 class RetryExhausted(Exception):
     """All attempts failed; ``__cause__`` is the last underlying error."""
+
+
+class RetryBudget:
+    """Fleet-wide retry *budget*: a token bucket that caps how many retries
+    the whole process may issue per second, regardless of how many requests
+    want one.
+
+    Per-request retry caps bound the damage ONE request can do; they do not
+    bound the fleet. When a replica dies, every in-flight request against it
+    fails at once, and if each is allowed even a single retry the surviving
+    replicas absorb a synchronized wave of duplicate traffic exactly when
+    capacity is lowest — the retry storm. A shared budget converts that wave
+    into a bounded trickle: retries spend from one bucket refilled at
+    ``rate``/s with ``burst`` of headroom, and a request that cannot get a
+    token degrades to its original failure (an explicit, typed error the
+    caller can shed on) instead of amplifying load.
+
+    ``try_spend`` never blocks; ``denied`` counts the retries the budget
+    refused (the router reports it in stats so a storm that WAS clamped is
+    still visible). Thread-safe; the clock is injectable for tests."""
+
+    def __init__(
+        self,
+        rate: float = 10.0,
+        burst: float = 20.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if rate < 0:
+            raise ValueError(f"retry budget rate must be >= 0, got {rate}")
+        if burst <= 0:
+            raise ValueError(f"retry budget burst must be > 0, got {burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._t = clock()
+        self._lock = threading.Lock()
+        self.spent = 0
+        self.denied = 0
+
+    def try_spend(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst, self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= n:
+                self._tokens -= n
+                self.spent += 1
+                return True
+            self.denied += 1
+            return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rate": self.rate,
+                "burst": self.burst,
+                "spent": self.spent,
+                "denied": self.denied,
+            }
 
 
 @dataclasses.dataclass

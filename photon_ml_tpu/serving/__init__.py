@@ -26,18 +26,27 @@ from photon_ml_tpu.serving.hotswap import (
     HotSwapManager,
     serve_from_checkpoint,
 )
+from photon_ml_tpu.serving.router import (
+    BackendReplica,
+    FrontRouter,
+    RouterConfig,
+    RouterHTTPServer,
+)
 from photon_ml_tpu.serving.transport import (
     FleetClient,
     FleetHTTPServer,
+    ReplicaUnavailable,
     decode_game_input,
     encode_game_input,
 )
 
 __all__ = [
+    "BackendReplica",
     "CanaryMismatch",
     "DeadlineExceeded",
     "FleetClient",
     "FleetHTTPServer",
+    "FrontRouter",
     "FrontendConfig",
     "GameServingEngine",
     "GenerationWatcher",
@@ -47,6 +56,9 @@ __all__ = [
     "QuotaExceeded",
     "Replica",
     "ReplicaSet",
+    "ReplicaUnavailable",
+    "RouterConfig",
+    "RouterHTTPServer",
     "ServingFrontend",
     "ServingFuture",
     "TenantQuota",

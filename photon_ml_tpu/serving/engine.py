@@ -365,6 +365,18 @@ class GameServingEngine:
         return self._trace_count
 
     @property
+    def warmed(self) -> bool:
+        """True once at least one scoring program has been traced through this
+        engine — the readiness signal behind ``/readyz`` (serving/transport.py).
+        Liveness ("the process answers") and warmth ("a compiled program is
+        live") are different states: a replica that just restarted answers
+        ``/healthz`` immediately but would make its first real request pay a
+        full XLA compile, so the front router (serving/router.py) keeps it out
+        of rotation until this flips true (the worker's startup warm-up or the
+        rolling swap's pilot compile flips it)."""
+        return self._trace_count > 0
+
+    @property
     def precision(self):
         """The engine's storage PrecisionPolicy — part of its serving
         configuration, so engine REBUILDS (generational hot-swap) must carry

@@ -350,6 +350,17 @@ class ReplicaSet:
         return [r.generation for r in self.replicas]
 
     @property
+    def ready(self) -> bool:
+        """Every replica's engine has at least one compiled program live
+        (``GameServingEngine.warmed``) — the "engine warmed" half of the
+        liveness-vs-readiness split ``/readyz`` reports. A freshly restarted
+        replica process is alive the moment its socket binds but NOT ready
+        until its startup warm-up (or the rolling swap's pilot compile) has
+        traced a scoring program; the front router admits traffic only on
+        ready."""
+        return all(r.engine.warmed for r in self.replicas)
+
+    @property
     def converged(self) -> bool:
         return len(set(self.generations)) == 1
 
@@ -820,6 +831,19 @@ class ModelRouter:
         for name in self.models:
             rolled = self._entry(name).replica_set.check_once() or rolled
         return rolled
+
+    def readiness(self) -> dict:
+        """The ``/readyz`` verdict: ready iff at least one model is registered
+        AND every model's replica set reports warmed engines. Per-model detail
+        rides along so an operator (or the front router's probe log) can see
+        WHICH model is still compiling."""
+        with self._lock:
+            entries = list(self._models.values())
+        models = {e.name: e.replica_set.ready for e in entries}
+        return {
+            "ready": bool(models) and all(models.values()),
+            "models": models,
+        }
 
     def stats(self) -> dict:
         with self._lock:

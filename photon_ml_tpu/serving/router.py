@@ -1,0 +1,918 @@
+"""Front router: the fleet leaves the process.
+
+Every tier below this one lives inside ONE process: ModelRouter fails over
+between replica *threads* (serving/fleet.py), and a replica process death was
+— until now — an outage. This module is the missing failure domain: a front
+router process that load-balances the existing HTTP transport
+(serving/transport.py) across N independent replica *processes* and makes a
+SIGKILLed replica a routine, typed, gated event.
+
+Design (the state machine docs/ARCHITECTURE.md "Process topology & failure
+domains" draws):
+
+- **Health & membership.** A probe thread issues periodic ``GET /readyz``
+  probes (readiness, not liveness: a restarting replica answers ``/healthz``
+  long before its first compiled program is live, and must not take traffic
+  in between — serving/engine.py ``warmed``). ``evict_after_failures``
+  consecutive probe failures — or the same count of consecutive *passive*
+  transport failures on the request path — evict the replica from rotation;
+  ``readmit_after_successes`` consecutive successful probes re-admit it.
+  Eviction is never an error: it is membership bookkeeping, recorded as a
+  typed :class:`~photon_ml_tpu.resilience.Incident`.
+
+- **Retry / timeout / backoff.** Per-request deadlines propagate to replicas
+  via the existing ``X-Photon-Deadline-Ms`` header, shrunk by time already
+  spent, and bound each attempt's read timeout. Retries are allowed ONLY for
+  failures where no response byte arrived (connect refused, send died,
+  response never started — :class:`~serving.transport.ReplicaUnavailable`'s
+  classification): scoring is idempotent and the router admitted + quota-
+  counted the request ONCE before any attempt, so a pre-response retry cannot
+  double-count anything; a mid-response failure is never retried (a second,
+  possibly different-generation answer must not race a half-delivered one).
+  Each retry costs a token from a FLEET-WIDE
+  :class:`~photon_ml_tpu.resilience.RetryBudget` — a dead replica fails all
+  its in-flight requests at once, and without a shared budget each would
+  retry into the survivors exactly when capacity is lowest (the retry
+  storm). Backoff is full-jitter exponential (seeded, injectable clock).
+
+- **Circuit breakers.** Per-replica closed -> open -> half-open: request-path
+  failures open the breaker (requests skip the replica without waiting for
+  the next probe cycle), one trial request is admitted after
+  ``breaker_reset_s``, and its outcome closes or re-opens the breaker.
+  Breakers are the fast request-path reflex; probe-driven membership is the
+  authoritative slow path — both must agree before traffic flows.
+
+- **Graceful degradation.** Admission runs at the router, BEFORE any
+  network attempt: per-(model, tenant) token buckets (one tenant's burst
+  cannot starve another across replicas — the bucket is enforced where the
+  fan-out happens), and a fleet in-flight budget of
+  ``fleet_budget_per_replica x (replicas in rotation)`` partitioned by the
+  fleet tier's priority classes (``PRIORITY_ADMISSION_FRACTION``). When a
+  kill shrinks the rotation the budget shrinks with it, so "batch" loses
+  admission first and "interactive" last — every shed a typed exception
+  (:class:`QuotaExceeded` / :class:`Overloaded` / :class:`DeadlineExceeded`)
+  plus an incident, never a raw 500.
+
+The ``serve.router.{probe,evict,readmit,retry,shed}`` fault points are
+registered in resilience/faultpoints.py (the registry must enumerate the
+router's crash sites without importing the serving stack — the replica
+processes never run this code) and swept by tests/test_chaos.py; the
+cross-process chaos-kill bench lives in benchmarks/fleet_proc_bench.py
+(``bench.py --fleet-proc``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import dataclasses
+import json
+import logging
+import random
+import threading
+import time
+from typing import Callable, Optional, Union
+
+import numpy as np
+
+from photon_ml_tpu.resilience import Incident, RetryBudget, faultpoint
+from photon_ml_tpu.resilience.faultpoints import (
+    FP_ROUTER_EVICT,
+    FP_ROUTER_PROBE,
+    FP_ROUTER_READMIT,
+    FP_ROUTER_RETRY,
+    FP_ROUTER_SHED,
+)
+from photon_ml_tpu.serving.fleet import (
+    PRIORITY_ADMISSION_FRACTION,
+    QuotaExceeded,
+    TenantQuota,
+    TokenBucket,
+)
+from photon_ml_tpu.serving.frontend import DeadlineExceeded, Overloaded
+from photon_ml_tpu.serving.transport import (
+    FleetClient,
+    ReplicaUnavailable,
+    decode_array,
+    encode_game_input,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """The fault-tolerance knobs, grouped by the mechanism they drive.
+
+    Membership: ``probe_interval_s`` between probe cycles;
+    ``evict_after_failures`` consecutive failures (active probe OR passive
+    request-path) evict; ``readmit_after_successes`` consecutive successful
+    ``/readyz`` probes re-admit. The probe budget — the bound the chaos gate
+    holds re-convergence to — is
+    ``probe_interval_s * readmit_after_successes`` plus one cycle of slack.
+
+    Transport: ``connect_timeout_s`` bounds TCP establishment per attempt
+    (kept tight: dead processes refuse fast, dead hosts black-hole);
+    ``read_timeout_s`` bounds the scoring work; a request deadline shrinks
+    both.
+
+    Retry: ``max_attempts`` total tries per request; ``backoff_base_s`` /
+    ``backoff_cap_s`` shape the full-jitter schedule (attempt i sleeps
+    uniform(0, min(cap, base * 2**i))); ``retry_budget_rate`` /
+    ``retry_budget_burst`` feed the fleet-wide
+    :class:`~photon_ml_tpu.resilience.RetryBudget`.
+
+    Breaker: ``breaker_open_after`` consecutive request failures open it;
+    ``breaker_reset_s`` later one half-open trial is admitted.
+
+    Admission: ``fleet_budget_per_replica`` in-flight requests per replica
+    IN ROTATION (None disables the budget); ``default_deadline_ms`` applies
+    to requests that carry none."""
+
+    probe_interval_s: float = 0.25
+    evict_after_failures: int = 2
+    readmit_after_successes: int = 2
+    probe_timeout_s: float = 1.0
+    connect_timeout_s: float = 1.0
+    read_timeout_s: float = 60.0
+    max_attempts: int = 3
+    backoff_base_s: float = 0.02
+    backoff_cap_s: float = 0.5
+    retry_budget_rate: float = 10.0
+    retry_budget_burst: float = 20.0
+    breaker_open_after: int = 2
+    breaker_reset_s: float = 1.0
+    fleet_budget_per_replica: Optional[int] = None
+    default_deadline_ms: Optional[float] = None
+    incident_log_size: int = 512
+
+
+class BackendReplica:
+    """Router-side state for one replica process: membership, probe
+    counters, and the circuit breaker. All mutable state is owned by
+    ``self._lock`` (probe thread and request threads both touch it)."""
+
+    def __init__(self, name: str, client: FleetClient, clock: Callable[[], float]):
+        self.name = name
+        self.client = client
+        self._clock = clock
+        self._lock = threading.Lock()
+        # membership (authoritative, probe-driven + passive accounting)
+        self._in_rotation = True
+        self._probe_failures = 0
+        self._probe_successes = 0
+        # circuit breaker (fast request-path reflex)
+        self._breaker = "closed"  # closed | open | half-open
+        self._breaker_failures = 0
+        self._opened_at = 0.0
+        self._trial_inflight = False
+        self._counters = collections.Counter()
+
+    # -- read-side ---------------------------------------------------------
+
+    @property
+    def in_rotation(self) -> bool:
+        with self._lock:
+            return self._in_rotation
+
+    @property
+    def breaker_state(self) -> str:
+        with self._lock:
+            return self._breaker
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "in_rotation": self._in_rotation,
+                "breaker": self._breaker,
+                "probe_failures": self._probe_failures,
+                "probe_successes": self._probe_successes,
+                **{k: int(v) for k, v in self._counters.items()},
+            }
+
+    # -- request path ------------------------------------------------------
+
+    def try_acquire(self) -> bool:
+        """May this replica take a request right now? True when in rotation
+        with a closed breaker — or when an open breaker's reset window has
+        elapsed and no half-open trial is already in flight (this call
+        CLAIMS the trial slot)."""
+        now = self._clock()
+        with self._lock:
+            if not self._in_rotation:
+                return False
+            if self._breaker == "closed":
+                return True
+            if self._trial_inflight:
+                return False
+            if self._breaker == "open" and now - self._opened_at < self._breaker_reset_s:
+                return False
+            # open past its window, or already half-open: admit ONE trial
+            self._breaker = "half-open"
+            self._trial_inflight = True
+            return True
+
+    def on_request_success(self) -> None:
+        with self._lock:
+            self._breaker = "closed"
+            self._breaker_failures = 0
+            self._trial_inflight = False
+            self._probe_failures = 0  # passive evidence of health
+            self._counters["requests_ok"] += 1
+
+    def on_request_failure(self, open_after: int) -> bool:
+        """Record a transport failure; open the breaker at the threshold (or
+        instantly when a half-open trial fails). Returns True when passive
+        accounting says the replica should be EVICTED (the caller records
+        the incident and fires the fault point — state changes stay here,
+        narration stays with the router)."""
+        with self._lock:
+            self._counters["requests_failed"] += 1
+            self._trial_inflight = False
+            self._breaker_failures += 1
+            if self._breaker == "half-open" or self._breaker_failures >= open_after:
+                self._breaker = "open"
+                self._opened_at = self._clock()
+            self._probe_failures += 1
+            return self._in_rotation and self._probe_failures >= self._evict_after
+
+    # -- probe path --------------------------------------------------------
+
+    def on_probe(self, ok: bool) -> Optional[str]:
+        """Fold one active probe result into membership. Returns ``"evict"``
+        or ``"readmit"`` when this probe crosses a threshold (the router
+        fires the fault point and records the incident), else None."""
+        with self._lock:
+            self._counters["probes"] += 1
+            if ok:
+                self._probe_failures = 0
+                if self._in_rotation:
+                    return None
+                self._probe_successes += 1
+                if self._probe_successes >= self._readmit_after:
+                    return "readmit"
+                return None
+            self._counters["probe_failures"] += 1
+            self._probe_successes = 0
+            if not self._in_rotation:
+                return None
+            self._probe_failures += 1
+            if self._probe_failures >= self._evict_after:
+                return "evict"
+            return None
+
+    def evict(self) -> None:
+        with self._lock:
+            self._in_rotation = False
+            self._probe_successes = 0
+            self._counters["evictions"] += 1
+
+    def readmit(self) -> None:
+        with self._lock:
+            self._in_rotation = True
+            self._probe_failures = 0
+            self._probe_successes = 0
+            self._breaker = "closed"
+            self._breaker_failures = 0
+            self._trial_inflight = False
+            self._counters["readmissions"] += 1
+
+    # wired by FrontRouter (config lives there; the replica only needs the
+    # thresholds, not the whole config object)
+    _evict_after = 2
+    _readmit_after = 2
+    _breaker_reset_s = 1.0
+
+
+@dataclasses.dataclass
+class _ModelPolicy:
+    """Router-side admission contract for one model name."""
+
+    name: str
+    priority: str
+    default_quota: Optional[TenantQuota]
+    tenant_quotas: dict
+    buckets: dict = dataclasses.field(default_factory=dict)
+
+
+class FrontRouter:
+    """Load-balancing, fault-tolerant front tier over N replica-process
+    endpoints. Synchronous call surface (``score`` / ``predict`` /
+    ``forward``); the HTTP front (:class:`RouterHTTPServer`) and the
+    cross-process bench drive it from their own threads — the router itself
+    adds no queueing, so its admission verdicts are immediate."""
+
+    def __init__(
+        self,
+        backends: list,
+        config: Optional[RouterConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        seed: Optional[int] = None,
+        start_probes: bool = True,
+    ):
+        """``backends``: (host, port) pairs or ready :class:`FleetClient`
+        instances (tests inject fakes). ``start_probes=False`` leaves the
+        probe thread unstarted — membership then moves only via passive
+        accounting and explicit :meth:`probe_once` calls (deterministic
+        tests)."""
+        if not backends:
+            raise ValueError("a FrontRouter needs at least one backend")
+        self.config = config or RouterConfig()
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self.replicas: list[BackendReplica] = []
+        for i, b in enumerate(backends):
+            if isinstance(b, FleetClient):
+                client = b
+                name = f"replica-{i}@{b.host}:{b.port}"
+            else:
+                host, port = b
+                client = FleetClient(
+                    host,
+                    port,
+                    timeout=self.config.read_timeout_s,
+                    connect_timeout=self.config.connect_timeout_s,
+                )
+                name = f"replica-{i}@{host}:{port}"
+            replica = BackendReplica(name, client, clock)
+            replica._evict_after = self.config.evict_after_failures
+            replica._readmit_after = self.config.readmit_after_successes
+            replica._breaker_reset_s = self.config.breaker_reset_s
+            self.replicas.append(replica)
+        self.retry_budget = RetryBudget(
+            rate=self.config.retry_budget_rate,
+            burst=self.config.retry_budget_burst,
+            clock=clock,
+        )
+        self._lock = threading.Lock()  # owns: _policies, _inflight, _counters, _rr
+        self._policies: dict[str, _ModelPolicy] = {}
+        self._inflight = 0
+        self._counters = collections.Counter()
+        self._rr = 0
+        self._incident_lock = threading.Lock()
+        self._incidents: collections.deque = collections.deque(
+            maxlen=self.config.incident_log_size
+        )
+        self._stop = threading.Event()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="photon-router-probe", daemon=True
+        )
+        if start_probes:
+            self._probe_thread.start()
+        # a probe thread blocked inside a connect at interpreter teardown is
+        # harmless (stdlib sockets, no jax), but close at exit anyway so a
+        # driver that never calls close() doesn't leak probing against dead
+        # fleets; close() unregisters.
+        self._atexit = lambda: self.close(timeout=5.0)
+        atexit.register(self._atexit)
+
+    # -- admission policy --------------------------------------------------
+
+    def register_model(
+        self,
+        name: str,
+        priority: str = "interactive",
+        tenant_quota: Optional[TenantQuota] = None,
+        tenant_quotas: Optional[dict] = None,
+    ) -> None:
+        """Admission contract for one model name (the models themselves live
+        in the replica processes; the router only needs the policy). An
+        unregistered model routes under the default policy: priority
+        ``standard``, unmetered."""
+        if priority not in PRIORITY_ADMISSION_FRACTION:
+            raise ValueError(
+                f"unknown priority class {priority!r}; "
+                f"have {sorted(PRIORITY_ADMISSION_FRACTION)}"
+            )
+        with self._lock:
+            self._policies[name] = _ModelPolicy(
+                name=name,
+                priority=priority,
+                default_quota=tenant_quota,
+                tenant_quotas=dict(tenant_quotas or {}),
+            )
+
+    def _policy(self, model: str) -> _ModelPolicy:
+        with self._lock:
+            policy = self._policies.get(model)
+            if policy is None:
+                policy = self._policies[model] = _ModelPolicy(
+                    name=model, priority="standard",
+                    default_quota=None, tenant_quotas={},
+                )
+            return policy
+
+    # -- observability -----------------------------------------------------
+
+    def _record(self, kind: str, cause: str, action: str, detail=None) -> None:
+        with self._incident_lock:
+            self._incidents.append(
+                Incident(kind=kind, cause=cause, action=action, detail=detail)
+            )
+
+    @property
+    def incidents(self) -> list:
+        with self._incident_lock:
+            return list(self._incidents)
+
+    def rotation(self) -> list[str]:
+        return [r.name for r in self.replicas if r.in_rotation]
+
+    @property
+    def converged(self) -> bool:
+        """Every backend back in rotation with a closed breaker — the
+        re-convergence condition the chaos gates hold the fleet to."""
+        return all(
+            r.in_rotation and r.breaker_state == "closed" for r in self.replicas
+        )
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["inflight"] = self._inflight
+        for key in ("routed", "retries", "failed_unavailable",
+                    "shed_quota", "shed_overload", "shed_deadline"):
+            out.setdefault(key, 0)
+        out["replicas"] = {r.name: r.snapshot() for r in self.replicas}
+        out["in_rotation"] = len(self.rotation())
+        out["retry_budget"] = self.retry_budget.stats()
+        out["sheds_by_cause"] = {
+            "quota": int(out.get("shed_quota", 0)),
+            "overload": int(out.get("shed_overload", 0)),
+            "deadline": int(out.get("shed_deadline", 0)),
+            "unavailable": int(out.get("failed_unavailable", 0)),
+        }
+        return out
+
+    # -- membership --------------------------------------------------------
+
+    def _apply_transition(self, replica: BackendReplica, verdict: str, cause: str):
+        if verdict == "evict":
+            faultpoint(FP_ROUTER_EVICT)
+            replica.evict()
+            self._record(
+                "replica-evict", cause,
+                f"evicted {replica.name} from rotation "
+                f"({len(self.rotation())} remain)",
+            )
+            logger.warning("evicted %s from rotation: %s", replica.name, cause)
+        elif verdict == "readmit":
+            faultpoint(FP_ROUTER_READMIT)
+            replica.readmit()
+            self._record(
+                "replica-readmit", cause,
+                f"re-admitted {replica.name} to rotation "
+                f"({len(self.rotation())} serving)",
+            )
+            logger.info("re-admitted %s to rotation: %s", replica.name, cause)
+
+    def probe_once(self) -> None:
+        """One active probe cycle over every backend (the probe thread calls
+        this on its interval; deterministic tests call it directly)."""
+        for replica in self.replicas:
+            faultpoint(FP_ROUTER_PROBE)
+            try:
+                status, _ = replica.client.raw_request(
+                    "GET", "/readyz", read_timeout=self.config.probe_timeout_s
+                )
+                ok = status == 200
+                cause = f"/readyz -> {status}"
+            except ReplicaUnavailable as e:
+                ok = False
+                cause = f"probe failed in {e.phase}: {e}"
+            verdict = replica.on_probe(ok)
+            if verdict is not None:
+                self._apply_transition(
+                    replica, verdict,
+                    cause if verdict == "evict"
+                    else f"{replica._readmit_after} consecutive ready probes",
+                )
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.config.probe_interval_s):
+            try:
+                self.probe_once()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — the probe thread is
+                # its own supervisor (the dispatcher-thread discipline): an
+                # injected crash or a transport bug must surface as an
+                # incident and a living probe loop, never a silently dead
+                # membership mechanism
+                self._record(
+                    "probe-crash",
+                    f"{type(e).__name__}: {e}",
+                    "probe cycle abandoned; next interval retries",
+                )
+                logger.warning("probe cycle crashed: %s", e)
+
+    # -- routing core ------------------------------------------------------
+
+    def _shed(self, kind: str, cause: str, counter: str, exc: BaseException):
+        faultpoint(FP_ROUTER_SHED)
+        with self._lock:
+            self._counters[counter] += 1
+        self._record(kind, cause, "shed request at router admission")
+        raise exc
+
+    def _admit(self, policy: _ModelPolicy, tenant: str) -> None:
+        """Layered admission, all before any network attempt. Raises the
+        typed shed; on return the caller owns one in-flight slot."""
+        quota = policy.tenant_quotas.get(tenant, policy.default_quota)
+        if quota is not None:
+            with self._lock:
+                bucket = policy.buckets.get(tenant)
+                if bucket is None:
+                    bucket = policy.buckets[tenant] = TokenBucket(
+                        quota.rate, quota.burst, self._clock
+                    )
+            if not bucket.try_take():
+                self._shed(
+                    "quota-shed",
+                    f"tenant {tenant!r} over quota on model {policy.name!r} "
+                    f"(rate={quota.rate}/s, burst={quota.burst})",
+                    "shed_quota",
+                    QuotaExceeded(
+                        f"tenant {tenant!r} exceeded its quota on model "
+                        f"{policy.name!r}"
+                    ),
+                )
+        n_rotation = len(self.rotation())
+        if n_rotation == 0:
+            self._shed(
+                "no-capacity",
+                "no replicas in rotation",
+                "shed_overload",
+                Overloaded("no replicas in rotation"),
+            )
+        if self.config.fleet_budget_per_replica is not None:
+            budget = self.config.fleet_budget_per_replica * n_rotation
+            allowed = int(budget * PRIORITY_ADMISSION_FRACTION[policy.priority])
+            with self._lock:
+                over = self._inflight >= allowed
+            if over:
+                self._shed(
+                    "overload",
+                    f"fleet budget pressure: {budget} total across "
+                    f"{n_rotation} replica(s), priority {policy.priority!r} "
+                    f"admits below {allowed} in-flight",
+                    "shed_overload",
+                    Overloaded(
+                        f"fleet under pressure; priority {policy.priority!r} "
+                        f"admits below {allowed} in-flight"
+                    ),
+                )
+        with self._lock:
+            self._inflight += 1
+
+    def _pick(self, exclude: set) -> Optional[BackendReplica]:
+        """Round-robin over backends that may take a request now, skipping
+        replicas this request already failed against."""
+        with self._lock:
+            start = self._rr
+            self._rr += 1
+        n = len(self.replicas)
+        for i in range(n):
+            replica = self.replicas[(start + i) % n]
+            if replica.name in exclude:
+                continue
+            if replica.try_acquire():
+                return replica
+        return None
+
+    def forward(
+        self,
+        path: str,
+        body: Optional[bytes],
+        model: str,
+        tenant: str = "default",
+        deadline_ms: Optional[float] = None,
+        method: str = "POST",
+        extra_headers: Optional[dict] = None,
+    ) -> tuple[int, bytes]:
+        """Admit, route, retry: the raw-bytes core every caller shares. The
+        body is forwarded VERBATIM (the bitwise wire contract survives the
+        extra hop); the response bytes come back verbatim too. Raises the
+        typed sheds; transport failures that exhaust retry policy surface as
+        :class:`~serving.transport.ReplicaUnavailable`."""
+        policy = self._policy(model)
+        self._admit(policy, tenant)
+        try:
+            return self._attempt_loop(
+                path, body, tenant, deadline_ms, method, extra_headers
+            )
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._counters["routed"] += 1
+
+    def _attempt_loop(
+        self, path, body, tenant, deadline_ms, method, extra_headers
+    ) -> tuple[int, bytes]:
+        now = self._clock()
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        deadline = None if deadline_ms is None else now + deadline_ms / 1e3
+        tried: set = set()
+        last: Optional[ReplicaUnavailable] = None
+        for attempt in range(self.config.max_attempts):
+            now = self._clock()
+            remaining = None if deadline is None else deadline - now
+            if remaining is not None and remaining <= 0:
+                self._shed(
+                    "deadline-shed",
+                    f"deadline expired at the router after {attempt} attempt(s)",
+                    "shed_deadline",
+                    DeadlineExceeded("deadline expired at the router"),
+                )
+            replica = self._pick(tried)
+            if replica is None:
+                if last is not None:
+                    break  # every eligible replica already failed this request
+                self._shed(
+                    "no-capacity",
+                    "no replica may take a request "
+                    "(rotation empty or breakers open)",
+                    "shed_overload",
+                    Overloaded("no replicas available"),
+                )
+            headers = dict(extra_headers or {})
+            headers["X-Photon-Tenant"] = tenant
+            read_timeout = self.config.read_timeout_s
+            if remaining is not None:
+                headers["X-Photon-Deadline-Ms"] = repr(remaining * 1e3)
+                read_timeout = min(read_timeout, remaining)
+            try:
+                status, raw = replica.client.raw_request(
+                    method, path, body=body, headers=headers,
+                    read_timeout=read_timeout,
+                )
+            except ReplicaUnavailable as e:
+                last = e
+                tried.add(replica.name)
+                should_evict = replica.on_request_failure(
+                    self.config.breaker_open_after
+                )
+                self._record(
+                    "replica-unavailable",
+                    f"{replica.name} failed in {e.phase}: {e}",
+                    "breaker/membership accounting updated",
+                )
+                if should_evict:
+                    self._apply_transition(
+                        replica, "evict",
+                        f"passive: {self.config.evict_after_failures} "
+                        f"consecutive request failures ({e.phase})",
+                    )
+                if e.response_started:
+                    break  # never retried (module docstring)
+                if attempt + 1 >= self.config.max_attempts:
+                    break
+                if not self.retry_budget.try_spend():
+                    self._record(
+                        "retry-denied",
+                        "fleet retry budget empty",
+                        "request degrades to its original failure",
+                    )
+                    break
+                faultpoint(FP_ROUTER_RETRY)
+                with self._lock:
+                    self._counters["retries"] += 1
+                backoff = self._rng.uniform(
+                    0.0,
+                    min(
+                        self.config.backoff_cap_s,
+                        self.config.backoff_base_s * (2.0**attempt),
+                    ),
+                )
+                if remaining is not None:
+                    backoff = min(backoff, max(remaining - 1e-3, 0.0))
+                if backoff > 0:
+                    self._sleep(backoff)
+                continue
+            replica.on_request_success()
+            return status, raw
+        with self._lock:
+            self._counters["failed_unavailable"] += 1
+        self._record(
+            "request-unavailable",
+            f"no replica could complete the request: {last}",
+            f"failed explicitly after {len(tried)} replica(s) tried",
+        )
+        raise last if last is not None else ReplicaUnavailable(
+            "no replica could complete the request", phase="route",
+            request_sent=False,
+        )
+
+    # -- typed scoring surface --------------------------------------------
+
+    def score(
+        self,
+        model: str,
+        data,
+        tenant: str = "default",
+        deadline_ms: Optional[float] = None,
+        include_offsets: bool = True,
+    ) -> tuple[np.ndarray, Optional[int]]:
+        """(scores, generation): bitwise what the serving replica returned
+        (the body crosses both hops base64-exact)."""
+        return self._score_or_predict(
+            "score", model, data, tenant, deadline_ms, include_offsets
+        )
+
+    def predict(
+        self,
+        model: str,
+        data,
+        tenant: str = "default",
+        deadline_ms: Optional[float] = None,
+    ) -> tuple[np.ndarray, Optional[int]]:
+        return self._score_or_predict("predict", model, data, tenant, deadline_ms, True)
+
+    def _score_or_predict(
+        self, kind, model, data, tenant, deadline_ms, include_offsets
+    ):
+        # encode ONCE; retries re-send the same bytes
+        body = json.dumps(
+            encode_game_input(data, include_offsets=include_offsets)
+        ).encode()
+        status, raw = self.forward(
+            f"/v1/models/{model}/{kind}", body, model,
+            tenant=tenant, deadline_ms=deadline_ms,
+        )
+        payload = json.loads(raw or b"{}")
+        if status == 200:
+            return decode_array(payload["scores"]), payload.get("generation")
+        error = payload.get("error", "")
+        detail = payload.get("detail", "")
+        if error == "quota_exceeded":
+            raise QuotaExceeded(detail)
+        if error == "deadline_exceeded":
+            raise DeadlineExceeded(detail)
+        if error == "overloaded":
+            raise Overloaded(detail)
+        if status == 404:
+            raise KeyError(detail or error)
+        raise RuntimeError(f"replica returned {status}: {error} {detail}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._probe_thread.is_alive():
+            self._probe_thread.join(timeout)
+        try:
+            atexit.unregister(self._atexit)
+        except Exception:  # interpreter already tearing down
+            pass
+
+    def __enter__(self) -> "FrontRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# --------------------------------------------------------------------------
+# the HTTP front
+# --------------------------------------------------------------------------
+
+_TYPED_STATUS = {
+    QuotaExceeded: (429, "quota_exceeded"),
+    DeadlineExceeded: (504, "deadline_exceeded"),
+    Overloaded: (503, "overloaded"),
+    ReplicaUnavailable: (503, "replica_unavailable"),
+}
+
+
+def _make_front_handler(router: FrontRouter):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _reply(self, status: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self._reply_raw(status, body)
+
+        def _reply_raw(self, status: int, body: bytes) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok"})
+            elif self.path == "/readyz":
+                # the FRONT tier is ready when it can route: >= 1 backend in
+                # rotation (backends police their own engine warmth)
+                n = len(router.rotation())
+                self._reply(
+                    200 if n > 0 else 503,
+                    {"ready": n > 0, "replicas_in_rotation": n},
+                )
+            elif self.path == "/stats":
+                self._reply(200, router.stats())
+            elif self.path == "/v1/models":
+                # pass through to any routable backend
+                try:
+                    status, raw = router.forward(
+                        "/v1/models", None, model="__catalog__", method="GET"
+                    )
+                    self._reply_raw(status, raw)
+                except tuple(_TYPED_STATUS) as e:
+                    status, code = next(
+                        v for t, v in _TYPED_STATUS.items() if isinstance(e, t)
+                    )
+                    self._reply(status, {"error": code, "detail": str(e)[:300]})
+            else:
+                self._reply(404, {"error": "not_found", "detail": self.path})
+
+        def do_POST(self):
+            parts = self.path.strip("/").split("/")
+            if len(parts) != 4 or parts[:2] != ["v1", "models"] or parts[3] not in (
+                "score",
+                "predict",
+            ):
+                self._reply(404, {"error": "not_found", "detail": self.path})
+                return
+            model = parts[2]
+            tenant = self.headers.get("X-Photon-Tenant", "default")
+            deadline_hdr = self.headers.get("X-Photon-Deadline-Ms")
+            try:
+                deadline_ms = None if deadline_hdr is None else float(deadline_hdr)
+            except ValueError:
+                self._reply(400, {"error": "bad_request", "detail": "bad deadline"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                status, raw = router.forward(
+                    self.path, body, model, tenant=tenant, deadline_ms=deadline_ms
+                )
+            except tuple(_TYPED_STATUS) as e:
+                status, code = next(
+                    v for t, v in _TYPED_STATUS.items() if isinstance(e, t)
+                )
+                self._reply(status, {"error": code, "detail": str(e)[:300]})
+                return
+            except BaseException as e:  # noqa: BLE001 — explicit to the
+                # client, never a hung connection (transport.py discipline)
+                self._reply(500, {"error": type(e).__name__, "detail": str(e)[:300]})
+                return
+            self._reply_raw(status, raw)
+
+    return Handler
+
+
+class RouterHTTPServer:
+    """Threaded HTTP server in front of a :class:`FrontRouter` — the process
+    boundary clients actually talk to. Same endpoint surface as the replica
+    servers (a client cannot tell one tier from N), plus the router's own
+    ``/readyz`` (can it route?) and ``/stats`` (membership, breakers, retry
+    budget, sheds by cause)."""
+
+    def __init__(self, router: FrontRouter, host: str = "127.0.0.1", port: int = 0):
+        from http.server import ThreadingHTTPServer
+
+        self._server = ThreadingHTTPServer((host, port), _make_front_handler(router))
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="photon-router-http",
+            daemon=True,
+        )
+        self._started = False
+
+    @property
+    def host(self) -> str:
+        return self._server.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "RouterHTTPServer":
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._started:
+            self._thread.join(10.0)
+
+    def __enter__(self) -> "RouterHTTPServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -18,7 +18,13 @@ Endpoints (all JSON):
 - ``GET /v1/models`` — registered models and their replica generations.
 - ``GET /stats`` — the router's full stats tree (sheds by cause, per-
   generation served counts, per-replica counters).
-- ``GET /healthz`` — liveness.
+- ``GET /healthz`` — liveness: the process is up and answering.
+- ``GET /readyz`` — readiness: liveness AND every registered model's replica
+  engines hold at least one compiled program (``ModelRouter.readiness``). The
+  two are deliberately distinct states: a replica that just restarted binds
+  its socket (healthy) long before its first XLA compile finishes (ready),
+  and the front router (serving/router.py) must not send it traffic in
+  between — the first real request would pay the whole compile as latency.
 
 Admission verdicts map to status codes so HTTP clients see the same
 taxonomy in-process callers do: quota 429 (``quota_exceeded``), overload 503
@@ -37,7 +43,7 @@ from __future__ import annotations
 import base64
 import json
 import threading
-from http.client import HTTPConnection
+from http.client import HTTPConnection, HTTPException
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -152,6 +158,9 @@ def _make_handler(router: ModelRouter, request_timeout: float):
         def do_GET(self):
             if self.path == "/healthz":
                 self._reply(200, {"status": "ok"})
+            elif self.path == "/readyz":
+                verdict = router.readiness()
+                self._reply(200 if verdict["ready"] else 503, verdict)
             elif self.path == "/stats":
                 self._reply(200, router.stats())
             elif self.path == "/v1/models":
@@ -286,31 +295,143 @@ class FleetHTTPServer:
 # ------------------------------------------------------------------- client
 
 
+class ReplicaUnavailable(RuntimeError):
+    """A fleet request failed at the TRANSPORT layer (the replica process is
+    down, the socket died, the read timed out) — typed, never a leaked raw
+    ``OSError``, and carrying exactly the classification a router needs to
+    decide whether a retry is safe:
+
+    - ``request_sent=False`` — the connection was never established (or the
+      request never left this process). Nothing reached the replica: always
+      safe to retry against another one.
+    - ``request_sent=True, response_started=False`` — the request (possibly
+      partially) reached the wire but NO response byte came back. Scoring is
+      idempotent, so a caller with its own admission accounting (the front
+      router admits and quota-counts ONCE, before any attempt) may retry;
+      a bare client without that accounting must not, or a replica that
+      scored-then-died double-counts served work.
+    - ``response_started=True`` — the response was mid-flight when the
+      connection died. Never retried: the failure must surface as a typed
+      incident, not as a second (possibly divergent-generation) answer.
+
+    ``phase`` names where it died (``connect``/``send``/``response-wait``/
+    ``response-read``) for incident records."""
+
+    def __init__(
+        self,
+        detail: str,
+        phase: str,
+        request_sent: bool,
+        response_started: bool = False,
+    ):
+        super().__init__(detail)
+        self.phase = phase
+        self.request_sent = bool(request_sent)
+        self.response_started = bool(response_started)
+
+    @property
+    def safe_to_retry(self) -> bool:
+        """Safe for a caller WITHOUT its own admission accounting (the plain
+        client): only a request that provably never left this process."""
+        return not self.request_sent
+
+
 class FleetClient:
     """Minimal HTTP client for the fleet endpoint (stdlib ``http.client``;
     one connection per call, so instances are thread-safe). Admission
     verdicts come back as the same exception types the in-process router
-    raises."""
+    raises; transport failures come back as :class:`ReplicaUnavailable` with
+    the sent/response-started classification the front router's retry policy
+    keys on.
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
+    ``connect_timeout`` bounds TCP establishment (a dead process refuses in
+    microseconds, a dead HOST black-holes — the connect budget is what keeps
+    probing a black hole cheap); ``timeout`` is the read budget for the
+    scoring work itself. The two differ by orders of magnitude in a healthy
+    fleet, which is why they are separate knobs."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 60.0,
+        connect_timeout: Optional[float] = None,
+    ):
         self.host = host
         self.port = int(port)
         self.timeout = timeout
+        self.connect_timeout = connect_timeout if connect_timeout is not None else timeout
 
-    def _request(self, method: str, path: str, body=None, headers=None):
-        conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
+    def raw_request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[bytes] = None,
+        headers: Optional[dict] = None,
+        read_timeout: Optional[float] = None,
+    ) -> tuple[int, bytes]:
+        """One HTTP exchange at the BYTES level — the front router's forward
+        path (it proxies encoded bodies verbatim, so the bitwise-wire contract
+        survives the extra hop untouched). Raises :class:`ReplicaUnavailable`
+        on any transport failure, with the phase classification."""
+        conn = HTTPConnection(self.host, self.port, timeout=self.connect_timeout)
         try:
-            conn.request(
-                method,
-                path,
-                body=None if body is None else json.dumps(body),
-                headers={"Content-Type": "application/json", **(headers or {})},
+            try:
+                conn.connect()
+            except OSError as e:
+                raise ReplicaUnavailable(
+                    f"{self.host}:{self.port} unreachable: {e}",
+                    phase="connect",
+                    request_sent=False,
+                ) from e
+            # connect succeeded on the connect budget; the read budget governs
+            # everything after (conn.sock is live here by construction)
+            conn.sock.settimeout(
+                read_timeout if read_timeout is not None else self.timeout
             )
-            resp = conn.getresponse()
-            payload = json.loads(resp.read() or b"{}")
-            return resp.status, payload
+            try:
+                conn.request(
+                    method,
+                    path,
+                    body=body,
+                    headers={"Content-Type": "application/json", **(headers or {})},
+                )
+            except OSError as e:
+                # bytes may or may not have reached the replica — conservative
+                raise ReplicaUnavailable(
+                    f"{self.host}:{self.port} died mid-send: {e}",
+                    phase="send",
+                    request_sent=True,
+                ) from e
+            try:
+                resp = conn.getresponse()
+            except (OSError, HTTPException) as e:
+                raise ReplicaUnavailable(
+                    f"{self.host}:{self.port} sent no response: {e}",
+                    phase="response-wait",
+                    request_sent=True,
+                    response_started=False,
+                ) from e
+            try:
+                return resp.status, resp.read()
+            except (OSError, HTTPException) as e:
+                raise ReplicaUnavailable(
+                    f"{self.host}:{self.port} died mid-response: {e}",
+                    phase="response-read",
+                    request_sent=True,
+                    response_started=True,
+                ) from e
         finally:
             conn.close()
+
+    def _request(self, method: str, path: str, body=None, headers=None):
+        status, raw = self.raw_request(
+            method,
+            path,
+            body=None if body is None else json.dumps(body).encode(),
+            headers=headers,
+        )
+        return status, json.loads(raw or b"{}")
 
     def _score_or_predict(
         self,
@@ -368,8 +489,25 @@ class FleetClient:
         return payload
 
     def healthy(self) -> bool:
+        """Liveness only: the process answers ``/healthz``. A freshly
+        restarted replica is healthy long before it is :meth:`ready`."""
         try:
             status, _ = self._request("GET", "/healthz")
             return status == 200
-        except OSError:
+        except (ReplicaUnavailable, OSError):
             return False
+
+    def ready(self) -> bool:
+        """Readiness: liveness AND every model's engines warmed (``/readyz``).
+        The state the front router gates rotation membership on."""
+        try:
+            status, _ = self._request("GET", "/readyz")
+            return status == 200
+        except (ReplicaUnavailable, OSError):
+            return False
+
+    def readiness(self) -> dict:
+        """The full ``/readyz`` verdict body (per-model warmth detail)."""
+        status, payload = self._request("GET", "/readyz")
+        payload["ready"] = bool(payload.get("ready")) and status == 200
+        return payload

@@ -47,10 +47,14 @@ pytestmark = pytest.mark.chaos
 FLEET_POINTS = tuple(
     p for p in registered_fault_points() if p.startswith("serve.fleet.")
 )
+ROUTER_POINTS = tuple(
+    p for p in registered_fault_points() if p.startswith("serve.router.")
+)
 SERVE_POINTS = tuple(
     p
     for p in registered_fault_points()
-    if p.startswith("serve.") and not p.startswith("serve.fleet.")
+    if p.startswith("serve.")
+    and not p.startswith(("serve.fleet.", "serve.router."))
 )
 CONTINUOUS_POINTS = tuple(
     p for p in registered_fault_points() if p.startswith("continuous.")
@@ -96,6 +100,15 @@ def test_registry_covers_every_chaos_sweep():
         "serve.fleet.canary",
         "serve.fleet.roll",
     } == set(FLEET_POINTS)
+    assert {
+        # the front-router tier (PR 18): swept by the router scenario below
+        # (membership, retry and shed paths all crossed under an armed crash)
+        "serve.router.probe",
+        "serve.router.evict",
+        "serve.router.readmit",
+        "serve.router.retry",
+        "serve.router.shed",
+    } == set(ROUTER_POINTS)
     assert {
         "sweep.propose",
         "sweep.train",
@@ -349,6 +362,93 @@ def test_fleet_crash_is_explicit_and_fleet_converges(tmp_path, rng, point):
         probe = requests[0]
         out = router.score("m", probe, timeout=30)
         np.testing.assert_array_equal(out, engines[final_gen].score(probe))
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------------------
+# front-ROUTER sweep: crash at every serve.router.* fault point while the
+# scenario crosses the router's whole surface — retry onto a survivor, a
+# quota shed, probe-driven eviction of a refusing backend, and re-admission
+# after it heals. Acceptance bar (the router holds no model state, so there
+# is no bitwise-restart comparison): the crash is explicit (client exception
+# and/or incident — never a silent drop), every response that WAS forwarded
+# is the healthy backend's bytes, and once the plan disarms membership
+# CONVERGES (every backend back in rotation, breakers closed, requests
+# routing). Backends are scripted fakes (tests/test_router.py); the real
+# process boundary is benchmarks/fleet_proc_bench.py's job.
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", ROUTER_POINTS)
+def test_router_crash_is_explicit_and_membership_converges(point):
+    from photon_ml_tpu.resilience import InjectedCrash, armed
+    from photon_ml_tpu.serving.fleet import QuotaExceeded, TenantQuota
+    from photon_ml_tpu.serving.frontend import DeadlineExceeded, Overloaded
+    from photon_ml_tpu.serving.router import FrontRouter, RouterConfig
+    from photon_ml_tpu.serving.transport import ReplicaUnavailable
+
+    from tests.test_router import FakeReplicaClient, served_by
+
+    clients = [FakeReplicaClient("r0", "connect"), FakeReplicaClient("r1", "ok")]
+    router = FrontRouter(
+        clients,
+        RouterConfig(
+            evict_after_failures=2, readmit_after_successes=2, max_attempts=3,
+            backoff_base_s=0.0, backoff_cap_s=0.0,
+        ),
+        sleep=lambda s: None, seed=11, start_probes=False,
+    )
+    router.register_model(
+        "capped", tenant_quotas={"t": TenantQuota(rate=0.0, burst=1.0)}
+    )
+    typed = (Overloaded, DeadlineExceeded, QuotaExceeded, ReplicaUnavailable)
+    served = []
+    explicit_failures = 0
+    try:
+        with armed(f"{point}:crash:1") as plan:
+            # request path: r0 refuses connections, so retries (and passive
+            # eviction accounting) fire; forwarded responses must be r1's
+            for _ in range(3):
+                try:
+                    served.append(router.forward("/v1/models/m/score", b"{}", "m"))
+                except InjectedCrash:
+                    explicit_failures += 1  # explicit to the CLIENT
+                except typed:
+                    pass  # typed degradation is explicit by construction
+            # shed path: the capped tenant admits once, sheds after
+            for _ in range(3):
+                try:
+                    router.forward(
+                        "/v1/models/capped/score", b"{}", "capped", tenant="t"
+                    )
+                except InjectedCrash:
+                    explicit_failures += 1
+                except typed:
+                    pass
+            # membership: active probes evict the refusing backend ...
+            for _ in range(4):
+                try:
+                    router.probe_once()
+                except InjectedCrash:
+                    explicit_failures += 1
+            # ... then it heals and consecutive ready probes re-admit it
+            clients[0].mode = "ok"
+            for _ in range(6):
+                try:
+                    router.probe_once()
+                except InjectedCrash:
+                    explicit_failures += 1
+        assert plan.fired, f"{point} was never reached by the router scenario"
+        assert explicit_failures or router.incidents
+        for status, raw in served:
+            assert status == 200 and served_by(raw) in {"r0", "r1"}
+        # with the plan disarmed, membership converges and traffic routes
+        for _ in range(4):
+            router.probe_once()
+        assert router.converged, router.stats()["replicas"]
+        status, raw = router.forward("/v1/models/m/score", b"{}", "m")
+        assert status == 200 and served_by(raw) in {"r0", "r1"}
     finally:
         router.close()
 

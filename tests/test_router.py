@@ -1,0 +1,388 @@
+"""Front-router tests: membership, retry safety, breakers, admission.
+
+The router's whole job is what happens when a replica PROCESS misbehaves, so
+these tests drive it with fake :class:`~serving.transport.FleetClient`
+backends whose failure mode is scripted per call (connect refused /
+pre-response death / mid-response death / unready ``/readyz``) and an
+injected clock — every membership transition, retry decision and shed is
+deterministic. The real process boundary (spawn, SIGKILL, restart) is
+exercised by benchmarks/fleet_proc_bench.py; the chaos sweep over the
+``serve.router.*`` fault points lives in tests/test_chaos.py.
+"""
+
+import json
+import threading
+
+import pytest
+
+from photon_ml_tpu.serving.fleet import QuotaExceeded, TenantQuota
+from photon_ml_tpu.serving.frontend import DeadlineExceeded, Overloaded
+from photon_ml_tpu.serving.router import FrontRouter, RouterConfig
+from photon_ml_tpu.serving.transport import FleetClient, ReplicaUnavailable
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeReplicaClient(FleetClient):
+    """Scripted replica endpoint. ``mode`` decides each request's fate:
+    ok | connect | send | response-wait | response-read | unready (readyz
+    503, scoring fine). ``calls`` records (method, path, headers)."""
+
+    def __init__(self, name: str, mode: str = "ok"):
+        super().__init__("127.0.0.1", 1)
+        self.name = name
+        self.mode = mode
+        self.calls: list = []
+        self._lock = threading.Lock()
+
+    def raw_request(self, method, path, body=None, headers=None, read_timeout=None):
+        with self._lock:
+            self.calls.append((method, path, dict(headers or {})))
+            mode = self.mode
+        if path == "/readyz":
+            if mode == "connect":
+                raise ReplicaUnavailable(
+                    f"{self.name} refused", phase="connect", request_sent=False
+                )
+            return (503, b'{"ready": false}') if mode == "unready" else (
+                200, b'{"ready": true}'
+            )
+        if mode == "connect":
+            raise ReplicaUnavailable(
+                f"{self.name} refused", phase="connect", request_sent=False
+            )
+        if mode == "send":
+            raise ReplicaUnavailable(
+                f"{self.name} died mid-send", phase="send", request_sent=True
+            )
+        if mode == "response-wait":
+            raise ReplicaUnavailable(
+                f"{self.name} sent no response", phase="response-wait",
+                request_sent=True, response_started=False,
+            )
+        if mode == "response-read":
+            raise ReplicaUnavailable(
+                f"{self.name} died mid-response", phase="response-read",
+                request_sent=True, response_started=True,
+            )
+        return 200, json.dumps({"served_by": self.name}).encode()
+
+    def scoring_calls(self):
+        with self._lock:
+            return [c for c in self.calls if c[1] != "/readyz"]
+
+
+def make_router(modes, clock=None, **config_kwargs):
+    clock = clock or FakeClock()
+    clients = [FakeReplicaClient(f"r{i}", mode) for i, mode in enumerate(modes)]
+    defaults = dict(
+        evict_after_failures=2, readmit_after_successes=2, max_attempts=3,
+        backoff_base_s=0.0, backoff_cap_s=0.0,
+    )
+    defaults.update(config_kwargs)
+    router = FrontRouter(
+        clients, RouterConfig(**defaults), clock=clock,
+        sleep=lambda s: None, seed=7, start_probes=False,
+    )
+    return router, clients, clock
+
+
+def served_by(raw: bytes) -> str:
+    return json.loads(raw)["served_by"]
+
+
+# ---------------------------------------------------------------- routing
+
+
+def test_round_robin_spreads_and_forwards_backend_bytes():
+    router, clients, _ = make_router(["ok", "ok"])
+    names = set()
+    for _ in range(4):
+        status, raw = router.forward("/v1/models/m/score", b"{}", "m")
+        assert status == 200
+        names.add(served_by(raw))
+    assert names == {"r0", "r1"}
+    router.close()
+
+
+def test_connect_failure_retries_transparently_onto_survivor():
+    # r0 refuses connections; round-robin picks it first — the client must
+    # still get r1's answer, with the retry visible in stats and incidents
+    router, clients, _ = make_router(["connect", "ok"])
+    status, raw = router.forward("/v1/models/m/score", b"{}", "m")
+    assert status == 200 and served_by(raw) == "r1"
+    stats = router.stats()
+    assert stats["retries"] == 1
+    assert any(i.kind == "replica-unavailable" for i in router.incidents)
+    router.close()
+
+
+def test_pre_response_failure_is_retried_but_mid_response_never():
+    # "sent, no response byte" is safe under router-side admission accounting
+    router, _, _ = make_router(["response-wait", "ok"])
+    status, raw = router.forward("/v1/models/m/score", b"{}", "m")
+    assert status == 200 and served_by(raw) == "r1"
+
+    # a response already underway must never race a second answer
+    router2, clients2, _ = make_router(["response-read", "ok"])
+    with pytest.raises(ReplicaUnavailable) as e:
+        router2.forward("/v1/models/m/score", b"{}", "m")
+    assert e.value.response_started
+    assert router2.stats()["retries"] == 0
+    assert not clients2[1].scoring_calls()  # the survivor was never asked
+    router.close()
+    router2.close()
+
+
+def test_retry_budget_exhaustion_degrades_to_original_failure():
+    router, _, _ = make_router(
+        ["connect", "ok"],
+        retry_budget_rate=0.0, retry_budget_burst=1.0,  # ONE retry, ever
+    )
+    status, _ = router.forward("/v1/models/m/score", b"{}", "m")  # spends it
+    assert status == 200
+    assert router.stats()["retries"] == 1
+    # round-robin lands on the dead replica again, but the budget is empty:
+    # the request degrades to its ORIGINAL failure instead of retrying — a
+    # dead replica must not amplify load onto the survivors
+    with pytest.raises(ReplicaUnavailable):
+        router.forward("/v1/models/m/score", b"{}", "m")
+    assert any(i.kind == "retry-denied" for i in router.incidents)
+    assert router.retry_budget.stats()["denied"] >= 1
+    router.close()
+
+
+def test_deadline_propagates_shrunk_and_expires_typed():
+    clock = FakeClock()
+    router, clients, clock = make_router(["ok"], clock=clock)
+    status, _ = router.forward("/v1/models/m/score", b"{}", "m", deadline_ms=500.0)
+    assert status == 200
+    hdr = float(clients[0].scoring_calls()[0][2]["X-Photon-Deadline-Ms"])
+    assert 0.0 < hdr <= 500.0
+
+    # an already-expired deadline sheds typed BEFORE any network attempt
+    with pytest.raises(DeadlineExceeded):
+        router.forward("/v1/models/m/score", b"{}", "m", deadline_ms=0.0)
+    assert any(i.kind == "deadline-shed" for i in router.incidents)
+    router.close()
+
+
+# ------------------------------------------------- membership & breakers
+
+
+def test_passive_failures_evict_and_probes_readmit():
+    router, clients, _ = make_router(["connect", "ok"])
+    for _ in range(2):  # evict_after_failures=2
+        status, _ = router.forward("/v1/models/m/score", b"{}", "m")
+        assert status == 200  # every request still lands on the survivor
+    assert router.rotation() == ["replica-1@127.0.0.1:1"]
+    assert any(i.kind == "replica-evict" for i in router.incidents)
+
+    clients[0].mode = "ok"  # the process came back, warm
+    router.probe_once()
+    assert len(router.rotation()) == 1  # one ready probe is not enough
+    router.probe_once()  # readmit_after_successes=2
+    assert len(router.rotation()) == 2
+    assert any(i.kind == "replica-readmit" for i in router.incidents)
+    assert router.converged
+    router.close()
+
+
+def test_readyz_gates_membership_not_just_liveness():
+    # a replica that answers HTTP but is NOT warmed (readyz 503) must leave
+    # the rotation and stay out until readiness flips — process-up is not
+    # engine-ready
+    router, clients, _ = make_router(["unready", "ok"])
+    for _ in range(2):
+        router.probe_once()
+    assert router.rotation() == ["replica-1@127.0.0.1:1"]
+    clients[0].mode = "ok"
+    for _ in range(2):
+        router.probe_once()
+    assert len(router.rotation()) == 2
+    router.close()
+
+
+def test_breaker_opens_then_half_open_trial_closes_it():
+    clock = FakeClock()
+    router, clients, clock = make_router(
+        ["connect"], clock=clock,
+        evict_after_failures=100,  # isolate the breaker from eviction
+        max_attempts=1, breaker_open_after=2, breaker_reset_s=1.0,
+    )
+    for _ in range(2):
+        with pytest.raises(ReplicaUnavailable):
+            router.forward("/v1/models/m/score", b"{}", "m")
+    assert router.replicas[0].breaker_state == "open"
+    # open: requests shed without touching the replica
+    n_before = len(clients[0].scoring_calls())
+    with pytest.raises(Overloaded):
+        router.forward("/v1/models/m/score", b"{}", "m")
+    assert len(clients[0].scoring_calls()) == n_before
+
+    clock.advance(1.5)  # past breaker_reset_s: ONE half-open trial
+    clients[0].mode = "ok"
+    status, _ = router.forward("/v1/models/m/score", b"{}", "m")
+    assert status == 200
+    assert router.replicas[0].breaker_state == "closed"
+    router.close()
+
+
+def test_failed_half_open_trial_reopens():
+    clock = FakeClock()
+    router, clients, clock = make_router(
+        ["connect"], clock=clock, evict_after_failures=100,
+        max_attempts=1, breaker_open_after=2, breaker_reset_s=1.0,
+    )
+    for _ in range(2):
+        with pytest.raises(ReplicaUnavailable):
+            router.forward("/v1/models/m/score", b"{}", "m")
+    clock.advance(1.5)
+    with pytest.raises(ReplicaUnavailable):  # the trial itself fails
+        router.forward("/v1/models/m/score", b"{}", "m")
+    assert router.replicas[0].breaker_state == "open"
+    router.close()
+
+
+def test_probe_thread_supervises_itself_through_injected_crash():
+    from photon_ml_tpu.resilience import armed
+    from photon_ml_tpu.resilience.faultpoints import FP_ROUTER_PROBE
+
+    clients = [FakeReplicaClient("r0", "ok")]
+    router = FrontRouter(
+        clients, RouterConfig(probe_interval_s=0.01), seed=3, start_probes=True
+    )
+    try:
+        import time
+
+        with armed(f"{FP_ROUTER_PROBE}:crash:1"):
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if any(i.kind == "probe-crash" for i in router.incidents):
+                    break
+                time.sleep(0.01)
+        assert any(i.kind == "probe-crash" for i in router.incidents)
+        # the loop survived its own crash: probes keep landing afterwards
+        n = len(clients[0].calls)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(clients[0].calls) <= n:
+            time.sleep(0.01)
+        assert len(clients[0].calls) > n
+        assert router.converged
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------- admission
+
+
+def test_tenant_buckets_isolate_tenants_at_the_router():
+    router, _, _ = make_router(["ok", "ok"])
+    router.register_model(
+        "m", priority="interactive",
+        tenant_quotas={"capped": TenantQuota(rate=0.0, burst=2.0)},
+    )
+    for _ in range(2):
+        assert router.forward("/v1/models/m/score", b"{}", "m", tenant="capped")[0] == 200
+    with pytest.raises(QuotaExceeded):
+        router.forward("/v1/models/m/score", b"{}", "m", tenant="capped")
+    # the capped tenant's burst cannot starve anyone else
+    assert router.forward("/v1/models/m/score", b"{}", "m", tenant="other")[0] == 200
+    assert any(i.kind == "quota-shed" for i in router.incidents)
+    assert router.stats()["sheds_by_cause"]["quota"] == 1
+    router.close()
+
+
+def test_capacity_loss_sheds_low_priority_first():
+    # fleet budget 1/replica x 2 replicas: batch (fraction 0.5) admits below
+    # int(2*0.5)=1 in flight — fine at zero in-flight. Evict one replica and
+    # the budget halves: batch's allowance floors to 0 and sheds, while
+    # interactive (fraction 1.0) still admits — graceful degradation orders
+    # by priority class, and every shed is typed.
+    router, clients, _ = make_router(["ok", "connect"], fleet_budget_per_replica=1)
+    router.register_model("batchy", priority="batch")
+    router.register_model("chatty", priority="interactive")
+    assert router.forward("/v1/models/batchy/score", b"{}", "batchy")[0] == 200
+
+    for _ in range(2):  # passive-evict r1
+        router.forward("/v1/models/chatty/score", b"{}", "chatty")
+    assert len(router.rotation()) == 1
+
+    with pytest.raises(Overloaded):
+        router.forward("/v1/models/batchy/score", b"{}", "batchy")
+    assert router.forward("/v1/models/chatty/score", b"{}", "chatty")[0] == 200
+    assert any(i.kind == "overload" for i in router.incidents)
+    router.close()
+
+
+def test_empty_rotation_sheds_typed_never_raw():
+    router, clients, _ = make_router(["unready"])
+    for _ in range(2):
+        router.probe_once()
+    assert router.rotation() == []
+    with pytest.raises(Overloaded):
+        router.forward("/v1/models/m/score", b"{}", "m")
+    assert any(i.kind == "no-capacity" for i in router.incidents)
+    router.close()
+
+
+def test_unknown_priority_rejected():
+    router, _, _ = make_router(["ok"])
+    with pytest.raises(ValueError):
+        router.register_model("m", priority="urgent")
+    router.close()
+
+
+# ------------------------------------------------------------- HTTP front
+
+
+def test_router_http_server_same_surface_and_typed_errors():
+    from photon_ml_tpu.serving.router import RouterHTTPServer
+
+    router, clients, _ = make_router(["ok", "ok"])
+    router.register_model(
+        "metered", tenant_quotas={"capped": TenantQuota(rate=0.0, burst=1.0)}
+    )
+    with RouterHTTPServer(router, port=0) as srv:
+        front = FleetClient(srv.host, srv.port, timeout=10.0)
+        assert front.healthy()
+        assert front.ready()
+        status, raw = front.raw_request(
+            "POST", "/v1/models/metered/score", body=b"{}",
+            headers={"X-Photon-Tenant": "capped"},
+        )
+        assert status == 200 and served_by(raw) in {"r0", "r1"}
+        status, raw = front.raw_request(
+            "POST", "/v1/models/metered/score", body=b"{}",
+            headers={"X-Photon-Tenant": "capped"},
+        )
+        assert status == 429
+        assert json.loads(raw)["error"] == "quota_exceeded"
+        status, raw = front.raw_request("GET", "/stats")
+        assert status == 200 and json.loads(raw)["in_rotation"] == 2
+        status, _ = front.raw_request("GET", "/nope")
+        assert status == 404
+    router.close()
+
+
+def test_router_http_readyz_tracks_rotation():
+    from photon_ml_tpu.serving.router import RouterHTTPServer
+
+    router, clients, _ = make_router(["unready"])
+    with RouterHTTPServer(router, port=0) as srv:
+        front = FleetClient(srv.host, srv.port, timeout=10.0)
+        assert front.ready()  # one backend still assumed in rotation
+        for _ in range(2):
+            router.probe_once()
+        assert not front.ready()  # can route nothing: NOT ready, still live
+        assert front.healthy()
+    router.close()

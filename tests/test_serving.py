@@ -745,3 +745,111 @@ def test_family_matrix_frontend_coalescing_parity(rng, task):
             np.testing.assert_array_equal(out, direct)
     finally:
         frontend.close()
+
+
+# --------------------------------------------------------------------------
+# tenant isolation across the PROCESS boundary: the front router
+# (serving/router.py) keeps per-tenant buckets and priority-class admission
+# honest while a replica endpoint dies out from under it. Backends here are
+# real HTTP servers over real sockets; abruptly closing one gives the router
+# the same wire signal a SIGKILLed replica process does (connect refused) —
+# the full process lifecycle is benchmarks/fleet_proc_bench.py's job.
+# --------------------------------------------------------------------------
+
+
+def test_tenant_isolation_survives_replica_death(tmp_path, rng):
+    from photon_ml_tpu.serving import (
+        FleetHTTPServer,
+        FrontendConfig,
+        FrontRouter,
+        ModelRouter,
+        Overloaded,
+        QuotaExceeded,
+        ReplicaSet,
+        RouterConfig,
+        TenantQuota,
+    )
+
+    from tests.test_fleet import build_fleet
+    from tests.test_hotswap import make_req
+
+    # two single-replica "processes" sharing one checkpoint store: separate
+    # ModelRouters on separate sockets, bitwise-identical coefficients
+    root, rs0 = build_fleet(tmp_path, rng, n_replicas=1)
+    rs1 = ReplicaSet.from_checkpoint(
+        root, 1, name="m", config=FrontendConfig(max_wait_ms=0.0)
+    )
+    backends, servers = [], []
+    for rs in (rs0, rs1):
+        mr = ModelRouter()
+        mr.add_model("m", rs)
+        backends.append(mr)
+        servers.append(FleetHTTPServer(mr, port=0).start())
+    front = FrontRouter(
+        [(s.host, s.port) for s in servers],
+        RouterConfig(
+            evict_after_failures=1, readmit_after_successes=1, max_attempts=2,
+            connect_timeout_s=0.5, read_timeout_s=30.0,
+            backoff_base_s=0.0, backoff_cap_s=0.0,
+            fleet_budget_per_replica=1,
+        ),
+        seed=13, start_probes=False,
+    )
+    # router admission is per-model, so the priority-ordering check serves
+    # the SAME replica sets under a second backend model name ("m-batch")
+    # registered at the router under the batch class
+    for mr, rs in zip(backends, (rs0, rs1)):
+        mr.add_model("m-batch", rs)
+    front.register_model(
+        "m", priority="interactive",
+        tenant_quotas={"capped": TenantQuota(rate=0.0, burst=3.0)},
+    )
+    front.register_model("m-batch", priority="batch")
+    req = make_req(rng)
+    direct = rs0.replicas[0].engine.score(req)
+    try:
+        # healthy fleet: both classes admit, responses bitwise across 2 hops
+        out, gen = front.score("m", req)
+        assert gen == 1 and out.dtype == direct.dtype
+        np.testing.assert_array_equal(out, direct)
+        out, _ = front.score("m-batch", req)
+        np.testing.assert_array_equal(out, direct)
+
+        # kill one replica endpoint: connect refused, exactly what a
+        # SIGKILLed replica process looks like from the router
+        servers[1].close()
+
+        # the capped tenant gets its full burst and NOT ONE request more —
+        # admitted requests may retry onto the survivor internally, but the
+        # bucket is taken once per request, never per attempt
+        ok = quota_shed = 0
+        for _ in range(6):
+            try:
+                out, _ = front.score("m", req, tenant="capped")
+            except QuotaExceeded:
+                quota_shed += 1
+                continue
+            np.testing.assert_array_equal(out, direct)
+            ok += 1
+        assert (ok, quota_shed) == (3, 3)
+        # ... and its exhaustion starves nobody else
+        out, _ = front.score("m", req, tenant="someone-else")
+        np.testing.assert_array_equal(out, direct)
+
+        # capacity halved -> the batch class sheds FIRST (typed), while the
+        # interactive class keeps serving from the survivor
+        assert len(front.rotation()) == 1  # passive accounting evicted it
+        with pytest.raises(Overloaded):
+            front.score("m-batch", req)
+        out, _ = front.score("m", req, tenant="someone-else")
+        np.testing.assert_array_equal(out, direct)
+
+        kinds = {i.kind for i in front.incidents}
+        assert {"replica-evict", "quota-shed", "overload"} <= kinds
+        sheds = front.stats()["sheds_by_cause"]
+        assert sheds["quota"] == 3 and sheds["overload"] >= 1
+    finally:
+        front.close()
+        servers[0].close()
+        for mr in backends:
+            mr.close()
