@@ -892,6 +892,12 @@ def main():
         # the native sequential baseline, per-family winner-serves gates)
         _delegate_benchmark("--sweep", "sweep_bench")
 
+    if "--working-set" in sys.argv:
+        # hierarchical entity-table training: streamed working-set CD pass vs
+        # all-resident across an oversubscription ladder (bitwise-parity,
+        # bounded measured device-table-bytes, zero-retrace and overlap gates)
+        _delegate_benchmark("--working-set", "working_set_bench")
+
     if "--child" in sys.argv:
         _child_main()
         return

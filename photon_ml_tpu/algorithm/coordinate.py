@@ -260,6 +260,17 @@ class RandomEffectCoordinate(Coordinate):
     # the bitwise reference; "bf16"/"f16" store tables + features reduced
     # with f32 accumulation (tolerance-gated, requires use_update_program).
     precision: object = None
+    # Device-resident working set (data/working_set.py): None = all-resident
+    # (status quo); an int bounds the device-resident table ROWS — hot
+    # entities stay resident across passes, cold chunks stream
+    # host -> device -> host through re_chunk_update_program; "auto" =
+    # all-resident whenever the tables fit the backend's memory limit.
+    # Demotions back to all-resident are logged (analysis/fallbacks).
+    working_set_rows: object = None
+    # Optional [E] admission priorities (the continuous trainer feeds the
+    # random_effect_gradient_norms screen / recency here); None ranks by
+    # per-entity data mass.
+    working_set_priorities: Optional[object] = None
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -280,16 +291,56 @@ class RandomEffectCoordinate(Coordinate):
             # storage dtype is orthogonal to placement: mesh-sharded datasets
             # cast their (entity-sharded) tables and bucket blocks the same
             # way the host path does — the reduced bytes just live sharded
+        if self.working_set_rows is not None:
+            if isinstance(self.working_set_rows, str):
+                if self.working_set_rows != "auto":
+                    raise ValueError(
+                        f"working_set_rows={self.working_set_rows!r}: expected "
+                        'None, a positive row budget, or "auto"'
+                    )
+            elif int(self.working_set_rows) < 1:
+                raise ValueError(
+                    f"working_set_rows={self.working_set_rows!r} must be a "
+                    "positive row budget"
+                )
+            if not self.use_update_program:
+                raise ValueError(
+                    "the working set streams chunks through the update-program "
+                    "machinery; working_set_rows requires use_update_program="
+                    "True (the per-bucket loop has no streamed form)"
+                )
+            if not self.precision.is_reference:
+                raise ValueError(
+                    "working_set_rows keeps the host-authoritative tables at "
+                    "reference precision; reduced storage precision is not "
+                    "supported on the streamed path"
+                )
         # donation ownership: the exact output buffers of our last update
         # program call. Only those are fed back donated; foreign arrays
         # (external warm starts, first iteration) are defensively copied so a
         # caller-held model can never be invalidated by our donation.
         self._owned: dict = {}
         self._fused_static = None
+        self._ws = None
+        self._ws_resolved = False
+        self._ws_l1 = None
 
     def initialize_model(self) -> RandomEffectModel:
         E, K = self.dataset.n_entities, self.dataset.max_k
         dtype = self.dataset.sample_vals.dtype
+        if self._working_set() is not None:
+            # a working-set coordinate never materializes the [E, K] table on
+            # device — the initial model's zeros live on the host tier
+            coeffs = np.zeros((E, K), dtype=np.dtype(dtype))
+            return RandomEffectModel(
+                re_type=self.dataset.re_type,
+                feature_shard_id=self.dataset.feature_shard_id,
+                task=self.task,
+                entity_ids=self.dataset.entity_ids,
+                coeffs=coeffs,
+                proj_indices=self.dataset.proj_indices,
+                projector=self.dataset.projector,
+            )
         rows = getattr(self.dataset, "coeffs_rows", None) or E
         coeffs = jnp.zeros((rows, K), dtype=dtype)
         sharding = getattr(self.dataset, "coeffs_sharding", None)
@@ -385,6 +436,151 @@ class RandomEffectCoordinate(Coordinate):
         )
         self.last_active_stats = stats
         return model, tracker
+
+    def _working_set(self):
+        """Resolve ONCE whether this coordinate streams through a device-
+        resident working set (data/working_set.py), building the host tier on
+        first engagement. Every demotion back to the all-resident path goes
+        through ``log_fallback_once`` — a silent demotion could fake the
+        bounded-device-memory claim."""
+        if self._ws_resolved:
+            return self._ws
+        self._ws_resolved = True
+        knob = self.working_set_rows
+        if knob is None:
+            return None
+        from photon_ml_tpu.analysis.fallbacks import log_fallback_once
+        from photon_ml_tpu.data.working_set import MIN_CHUNK_LANES, WorkingSet
+
+        ds = self.dataset
+        fingerprint = (
+            f"coordinate {self.coordinate_id!r} ({ds.re_type}/"
+            f"{ds.feature_shard_id}, {ds.n_entities} entities, "
+            f"working_set_rows={knob!r})"
+        )
+
+        def demote(cause):
+            log_fallback_once("re_working_set", fingerprint, cause)
+            return None
+
+        if getattr(ds, "coeffs_sharding", None) is not None:
+            return demote(
+                "mesh-sharded dataset: the entity axis is already partitioned "
+                "across devices and the donated state must keep its placement "
+                "— staying all-resident (sharded)"
+            )
+        if ds.projector is not None:
+            return demote(
+                "projector-bearing coordinate: projected scoring addresses "
+                "the full table on device — staying all-resident"
+            )
+        if getattr(ds, "n_passive_samples", 0) > 0:
+            return demote(
+                "the active-data cap left passive samples outside the "
+                "training buckets; the streamed score covers bucket samples "
+                "only — staying all-resident"
+            )
+        variance_on = (
+            VarianceComputationType(self.variance_computation)
+            != VarianceComputationType.NONE
+        )
+        dtype = ds.sample_vals.dtype
+        if knob == "auto":
+            stats = getattr(
+                jax.local_devices()[0], "memory_stats", lambda: None
+            )() or {}
+            limit = stats.get("bytes_limit")
+            if limit is None:
+                return demote(
+                    "auto: the backend exposes no memory limit; assuming the "
+                    "tables fit — staying all-resident"
+                )
+            itemsize = np.dtype(dtype).itemsize
+            tables = 2 if variance_on else 1
+            resident_bytes = ds.n_entities * ds.max_k * itemsize * tables
+            for b in ds.buckets:
+                resident_bytes += int(np.prod(b.X.shape)) * itemsize
+            if resident_bytes <= 0.5 * limit:
+                return demote(
+                    "auto: tables + bucket blocks fit device memory — "
+                    "staying all-resident"
+                )
+            row_bytes = max(ds.max_k * itemsize * tables, 1)
+            budget = max(int(0.25 * limit) // row_bytes, 2 * MIN_CHUNK_LANES)
+        else:
+            budget = int(knob)
+        if budget >= ds.n_entities:
+            return demote(
+                f"tables fit: the configured working set ({budget} rows) "
+                f"covers every entity ({ds.n_entities}) — staying all-resident"
+            )
+        if not WorkingSet.schedule_feasible(budget, len(ds.buckets)):
+            return demote(
+                f"budget {budget} rows is below the minimal double-buffered "
+                f"schedule (2 x {MIN_CHUNK_LANES} lanes) — staying "
+                "all-resident"
+            )
+        from photon_ml_tpu.algorithm.random_effect import (
+            build_l2_rows,
+            precompute_norm_tables,
+        )
+
+        l2_host = np.asarray(
+            jax.device_get(
+                build_l2_rows(
+                    ds,
+                    self.configuration.l2_weight,
+                    self.per_entity_reg_weights,
+                    dtype,
+                    ds.n_entities,
+                )
+            )
+        )
+        norm_host = tuple(
+            None
+            if tbl is None
+            else tuple(
+                None if a is None else np.asarray(jax.device_get(a))
+                for a in tbl
+            )
+            for tbl in precompute_norm_tables(ds, self.normalization, dtype)
+        )
+        ws = WorkingSet(
+            ds,
+            budget,
+            dtype,
+            variance_on=variance_on,
+            l2_host=l2_host,
+            norm_host=norm_host,
+            priorities=self.working_set_priorities,
+        )
+        # the host tier takes ownership of the bucket blocks: re-pointing the
+        # dataset at the host copies releases the device ones
+        ds.buckets = list(ws.host_buckets)
+        self._ws_l1 = jnp.asarray(
+            self.configuration.l1_weight or 0.0, dtype=dtype
+        )
+        self._ws = ws
+        return ws
+
+    def reselect_working_set(self, priorities=None) -> bool:
+        """Admission/eviction churn between descent runs: re-rank residency
+        with fresh priorities (the continuous trainer's gradient-norm screen
+        / recency). Host tables carry all state, so churn moves no
+        coefficients. Returns False when the working set is off/demoted."""
+        ws = self._working_set()
+        if ws is None:
+            return False
+        self.working_set_priorities = priorities
+        ws.reselect(priorities)
+        return True
+
+    def working_set_stats(self):
+        """Live working-set counters (data/working_set.py stats()): measured
+        peak device table bytes, H2D/stall seconds, overlap efficiency.
+        None when the coordinate is all-resident (knob off or demoted)."""
+        ws = self._working_set()
+        return None if ws is None else ws.stats()
 
     def _fused_update_static(self):
         """Descent-iteration-invariant inputs of the update program, built
@@ -556,6 +752,11 @@ class RandomEffectCoordinate(Coordinate):
                 "one program per bucket with eager glue between them",
             )
             return None
+        ws = self._working_set()
+        if ws is not None:
+            return self._update_and_score_streamed(
+                ws, initial_model, partial_scores, prev_score
+            )
         from photon_ml_tpu.algorithm.random_effect import LazyRandomEffectTracker
 
         st = self._fused_update_static()
@@ -638,6 +839,97 @@ class RandomEffectCoordinate(Coordinate):
         )
         return model, score_out, tracker
 
+    def _update_and_score_streamed(
+        self, ws, initial_model, partial_scores, prev_score
+    ):
+        """Streamed working-set update: the host tier stays authoritative,
+        the device never holds more table rows than the configured budget,
+        and every chunk runs through ``re_chunk_update_program`` — the same
+        vmapped bucket solve and view-score kernel as the all-resident
+        program, so lbfgs-family results are bitwise identical
+        (tests/test_working_set.py; the direct solver's Gram accumulation is
+        batch-shape-sensitive at the last ulp and is tolerance-gated).
+
+        The fused protocol is preserved: a divergence reject returns the
+        PREVIOUS model/score (the staged host commit is discarded) and the
+        tracker carries the device ``guard_ok`` flag the descent loop
+        requires. The caller's ``donate`` promise is a no-op here — streamed
+        updates never consume caller-held buffers."""
+        from photon_ml_tpu.algorithm.random_effect import LazyRandomEffectTracker
+        from photon_ml_tpu.optimization.solver_cache import re_chunk_update_program
+
+        ds = self.dataset
+        dtype = ds.sample_vals.dtype
+        # foreign warm starts (checkpoint restore, an external model) seed
+        # the host tier; our own committed tables round-trip untouched
+        if initial_model is not None and hasattr(initial_model, "coeffs"):
+            aligned = (
+                initial_model.aligned_to(ds)
+                if hasattr(initial_model, "aligned_to")
+                else initial_model
+            )
+            if not ws.owns(aligned.coeffs):
+                ws.seed_tables(
+                    np.asarray(aligned.coeffs),
+                    None
+                    if aligned.variances is None
+                    else np.asarray(aligned.variances),
+                )
+        program = re_chunk_update_program(
+            self.task,
+            self.configuration.optimizer_config,
+            bool(self.configuration.l1_weight),
+            VarianceComputationType(self.variance_computation),
+            ds.max_k,
+            self.re_solver,
+        )
+        offsets_plus_scores = self.base_offsets + partial_scores
+        view_cols, view_vals = ds.sample_local_cols, ds.sample_vals
+        l1 = self._ws_l1
+
+        def solve_chunk(chunk, staged, score_partial):
+            return program(
+                staged["init"],
+                score_partial,
+                *staged["data"],
+                staged["l2"],
+                l1,
+                staged["norm"],
+                offsets_plus_scores,
+                view_cols,
+                view_vals,
+            )
+
+        score0 = jnp.zeros((ds.n_samples,), dtype=dtype)
+        score_new, ok_dev, reasons, iters, masks = ws.stream_pass(
+            solve_chunk, score0
+        )
+        if not ws.tail_ok:
+            # the all-resident guard sees the WHOLE table, including tail
+            # columns the chunks never rewrite — a non-finite warm start
+            # there must reject here too
+            ok_dev = jnp.logical_and(ok_dev, False)
+        # the commit decision needs the flag host-side regardless (swap or
+        # drop the staged host tables); the per-chunk harvests already
+        # synchronized, so this read adds no stall
+        ok_host = bool(jax.device_get(ok_dev))
+        ws.commit_pass(ok_host)
+        score_out = score_new if ok_host else prev_score
+        model = RandomEffectModel(
+            re_type=ds.re_type,
+            feature_shard_id=ds.feature_shard_id,
+            task=self.task,
+            entity_ids=ds.entity_ids,
+            coeffs=ws.host_coeffs,
+            proj_indices=ds.proj_indices,
+            variances=ws.host_vars,
+            projector=ds.projector,
+        )
+        tracker = LazyRandomEffectTracker(
+            reasons, iters, guard_ok=ok_dev, real_masks=masks
+        )
+        return model, score_out, tracker
+
     def compiled_update_hlo(self) -> str:
         """Compiled (post-SPMD-partitioning) HLO text of this coordinate's
         update program at the dataset's placement — the collective-audit
@@ -682,7 +974,25 @@ class RandomEffectCoordinate(Coordinate):
         return lowered.compile().as_text()
 
     def score(self, model: RandomEffectModel) -> Array:
-        return model.score_dataset(self.dataset)
+        ws = self._working_set()
+        if ws is None:
+            return model.score_dataset(self.dataset)
+        from photon_ml_tpu.optimization.solver_cache import re_chunk_score_program
+
+        ds = self.dataset
+        coeffs = np.asarray(model.coeffs)
+        if not coeffs.any():
+            # an all-zero table scores zero everywhere (the descent loop's
+            # initial score) — bitwise-equal to the full-table kernel,
+            # without streaming a pass
+            return jnp.zeros((ds.n_samples,), dtype=ds.sample_vals.dtype)
+        return ws.score_streamed(
+            re_chunk_score_program(),
+            coeffs,
+            ds.n_samples,
+            ds.sample_local_cols,
+            ds.sample_vals,
+        )
 
 
 @dataclasses.dataclass

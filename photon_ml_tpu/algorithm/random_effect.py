@@ -574,11 +574,23 @@ def train_random_effect_delta(
             )
             n_real = len(sel)
             rows_b = rows_host[idx]  # in-bounds rows (duplicates for padding)
-            idx_dev = jnp.asarray(idx.astype(np.int32))
-            X_b = jnp.take(bucket.X, idx_dev, axis=0)
-            y_b = jnp.take(bucket.labels, idx_dev, axis=0)
-            w_b = jnp.take(bucket.weights, idx_dev, axis=0)
-            sid_b = jnp.take(bucket.sample_ids, idx_dev, axis=0)
+            if isinstance(bucket.X, np.ndarray):
+                # host-backed bucket (the working-set tier re-points
+                # dataset.buckets at host arrays): gather ON HOST and move
+                # only the active sub-bucket — jnp.take would transfer the
+                # whole bucket to device first
+                X_b = jnp.asarray(np.ascontiguousarray(bucket.X[idx]))
+                y_b = jnp.asarray(np.ascontiguousarray(bucket.labels[idx]))
+                w_b = jnp.asarray(np.ascontiguousarray(bucket.weights[idx]))
+                sid_b = jnp.asarray(
+                    np.ascontiguousarray(bucket.sample_ids[idx])
+                )
+            else:
+                idx_dev = jnp.asarray(idx.astype(np.int32))
+                X_b = jnp.take(bucket.X, idx_dev, axis=0)
+                y_b = jnp.take(bucket.labels, idx_dev, axis=0)
+                w_b = jnp.take(bucket.weights, idx_dev, axis=0)
+                sid_b = jnp.take(bucket.sample_ids, idx_dev, axis=0)
             if coeffs_sharding is not None:
                 # re-place the gathered sub-bucket under the entity sharding:
                 # the vmapped solve then partitions lane-parallel exactly like
@@ -598,7 +610,12 @@ def train_random_effect_delta(
         off_b = jnp.take(offsets_plus_scores, jnp.maximum(sid_b, 0), axis=0)
         off_b = jnp.where(sid_b >= 0, off_b, 0.0).astype(dtype)
 
-        init_b = coeffs_global[jnp.asarray(rows_b), :K]
+        if isinstance(coeffs_global, np.ndarray):
+            # host-authoritative table (working-set model): gather the warm
+            # rows on host, move only the [L, K] slice
+            init_b = jnp.asarray(np.ascontiguousarray(coeffs_global[rows_b, :K]))
+        else:
+            init_b = coeffs_global[jnp.asarray(rows_b), :K]
         if normalization is not None and not normalization.is_identity:
             init_b = _to_transformed(init_b, factors, shifts, icpt_mask)
 
@@ -648,11 +665,31 @@ def train_random_effect_delta(
                 axis=0,
             )
 
-        coeffs_global = coeffs_global.at[rows_dev].set(_pad_blocks(coef_updates))
-        if variances_global is not None:
-            variances_global = variances_global.at[rows_dev].set(
-                _pad_blocks(var_updates)
+        if isinstance(coeffs_global, np.ndarray):
+            # host-authoritative table (working-set model): D2H the solved
+            # blocks and scatter on host — the full table never goes up.
+            # Padding lanes carry out-of-bounds rows; filter instead of drop.
+            rows_np = np.concatenate(scatter_rows_parts).astype(np.int64)
+            keep = rows_np < coeffs_global.shape[0]
+            blocks = np.asarray(jax.device_get(_pad_blocks(coef_updates)))
+            coeffs_global = np.array(coeffs_global, copy=True)
+            coeffs_global[rows_np[keep]] = blocks[keep].astype(
+                coeffs_global.dtype
             )
+            if variances_global is not None:
+                vblocks = np.asarray(jax.device_get(_pad_blocks(var_updates)))
+                variances_global = np.array(variances_global, copy=True)
+                variances_global[rows_np[keep]] = vblocks[keep].astype(
+                    variances_global.dtype
+                )
+        else:
+            coeffs_global = coeffs_global.at[rows_dev].set(
+                _pad_blocks(coef_updates)
+            )
+            if variances_global is not None:
+                variances_global = variances_global.at[rows_dev].set(
+                    _pad_blocks(var_updates)
+                )
         if coeffs_sharding is not None:
             # pin the table sharding after the scatter so the exported model
             # (and the next delta's warm start) stays entity-sharded

@@ -128,6 +128,15 @@ class ContinuousTrainerConfig:
     # delta passes are always warm-started from the previous generation, the
     # regime where the Newton loop converges in 1-2 steps.
     re_solver: str = "lbfgs"
+    # device-resident working set for random-effect tables (data/
+    # working_set.py), inherited by the bootstrap full train and every delta
+    # pass: None = all-resident (status quo); an int bounds device-resident
+    # table rows per coordinate; "auto" = all-resident when tables fit. The
+    # streamed backlog bootstrap (max_files_per_pass) already feeds host
+    # tables, so corpus -> host -> device becomes one pipeline. When the
+    # gradient screen runs, its norms become the admission priorities.
+    # Execution strategy, bitwise-neutral: stays out of the fingerprint.
+    re_working_set_rows: object = None
     # SPMD backend: a jax.sharding.Mesh places every generation's datasets
     # (and the delta pass's gathered active sub-buckets) over the device
     # mesh — bootstrap and delta passes then run as sharded programs with
@@ -240,6 +249,7 @@ class ContinuousTrainer:
             dtype=config.dtype,
             re_solver=config.re_solver,
             mesh=config.mesh,
+            re_working_set_rows=config.re_working_set_rows,
         )
         self.re_types = {
             cid: cfg.data_config.random_effect_type
@@ -606,6 +616,16 @@ class ContinuousTrainer:
                     ].per_entity_reg_weights,
                     dtype=self.config.dtype,
                 )
+            if norms is not None and self.config.re_working_set_rows is not None:
+                # the gradient screen doubles as the working set's admission
+                # priority: the hottest entities (by subproblem gradient at
+                # the warm start) claim device residency on the coordinates
+                # the NEXT build constructs
+                import jax
+
+                priorities = dict(self.estimator.re_working_set_priorities or {})
+                priorities[cid] = np.asarray(jax.device_get(norms))  # jaxlint: disable=HS001 once-per-coordinate boundary read, admission priorities live host-side
+                self.estimator.re_working_set_priorities = priorities
             sel = select_active_entities(
                 ds,
                 delta_entities.get(re_type, set()),

@@ -166,6 +166,22 @@ class GameEstimator:
     # held-out quality drift); requires re_update_program=True. Placement-
     # orthogonal: mesh-sharded tables store reduced the same way.
     re_precision: object = None
+    # Device-resident working set for random-effect tables (data/
+    # working_set.py): None = all-resident (status quo); an int bounds the
+    # device-resident table ROWS per coordinate — hot entities stay resident
+    # across CD passes, cold chunks stream host -> device -> host; "auto" =
+    # all-resident whenever the tables fit the backend's memory limit.
+    # Coordinates that can't stream (mesh-sharded, projector-bearing,
+    # passive samples, tables that fit) demote to all-resident with a
+    # logged fallback (analysis/fallbacks). Requires re_update_program.
+    # Deliberately NOT part of the checkpoint fingerprint: like
+    # max_files_per_pass, it is an execution strategy, bitwise-neutral on
+    # the lbfgs-family solve.
+    re_working_set_rows: object = None
+    # Optional {coordinate_id: [E] priorities} admission ranking for the
+    # working set (the continuous trainer feeds gradient norms / recency);
+    # unlisted coordinates rank by per-entity data mass.
+    re_working_set_priorities: Optional[Mapping] = None
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -194,6 +210,24 @@ class GameEstimator:
             # too: io/checkpoint.py encodes reduced dtypes as uint16 bit
             # patterns with self-describing markers, so a bf16 deployment's
             # generations round-trip bit-exactly across restart.
+        if self.re_working_set_rows is not None:
+            if self.fused_pass:
+                raise ValueError(
+                    "re_working_set_rows streams through the host loop's "
+                    "update program; the fused whole-pass backend assumes "
+                    "fully device-resident tables (set fused_pass=False)"
+                )
+            if not self.re_update_program:
+                raise ValueError(
+                    "re_working_set_rows requires re_update_program=True "
+                    "(the per-bucket loop has no streamed form)"
+                )
+            if not self.re_precision.is_reference:
+                raise ValueError(
+                    "re_working_set_rows keeps host-authoritative tables at "
+                    "reference precision; combine with re_precision is not "
+                    "supported"
+                )
         if self.re_storage_dtype is not None and not self.fused_pass:
             # only the fused pass consumes it (build_sharded_game_data);
             # accepting it elsewhere would be a silent no-op
@@ -420,6 +454,12 @@ class GameEstimator:
             use_update_program=self.re_update_program,
             re_solver=self.re_solver,
             precision=self.re_precision,
+            working_set_rows=self.re_working_set_rows,
+            working_set_priorities=(
+                None
+                if self.re_working_set_priorities is None
+                else self.re_working_set_priorities.get(cid)
+            ),
         )
 
     # ---------------------------------------------------------------- fit

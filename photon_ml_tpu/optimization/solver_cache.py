@@ -459,6 +459,144 @@ def re_coordinate_update_program(
 
 
 @functools.lru_cache(maxsize=None)
+def re_chunk_update_program(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    variance: VarianceComputationType,
+    k_all: int,
+    re_solver: str = "lbfgs",
+):
+    """One jitted, donated update for a STREAMED working-set chunk
+    (data/working_set.py): ``[C, S, K]`` entity lanes solved with the same
+    vmapped bucket solve as the all-resident program, their ``[N]`` score
+    contribution scattered into a running partial, and the chunk's own
+    divergence-guard flag returned for the host-side commit decision.
+
+    ``update(init_chunk, score_partial, X, y, w, sample_ids, l2, l1,
+    norm_rows, offsets_plus_scores, view_cols, view_vals) ->
+    (w_out, var_out, score_partial, ok, reasons, iters)``
+
+    - ``init_chunk`` ``[C, K]`` and ``score_partial`` ``[N]`` are DONATED:
+      the chunk's warm-start rows are consumed by the solve (hot chunks feed
+      the previous pass's output straight back in) and the score partial is
+      threaded through the whole pass without a copy per chunk.
+    - The score contribution routes the chunk's samples through the SAME
+      ``random_effect_view_score`` kernel as the all-resident path, with the
+      chunk's lanes standing in as a C-row table — per-sample gather/
+      multiply/add order is identical, so per-chunk scatter assembly is
+      bitwise-equal to the full-table score. Padding lanes carry
+      ``sample_ids = -1`` and their scatter drops (out-of-range row ``N``).
+    - ``k_all`` pads the lane table to the full view width so the sample
+      view's local columns (always < the owning bucket's K) index safely.
+    - The bitwise cross-path contract rides the lbfgs-family solve (the
+      repo's bitwise status quo): probe-confirmed lane-count-stable for
+      batches >= 2, while the batch-1 lowering differs by an ulp — so the
+      working-set scheduler gives single-chunk buckets their exact
+      all-resident batch shape. Two tolerance-scoped exceptions, both from
+      batch-count-sensitive batched-GEMM lowerings: the direct solver's
+      Gram accumulation (streamed-vs-resident parity for
+      ``re_solver="direct"`` is tolerance-gated), and the FULL-variance
+      Hessian build ``A.T @ (A*d)`` when a bucket is SPLIT across chunks
+      (coefficients stay bitwise; the variance drifts ~1 ulp on a few
+      lanes at some shapes — tests/test_working_set.py documents the
+      bounds).
+    """
+    solve = _re_bucket_solve_fn(task, opt_config, has_l1, variance, re_solver)
+    variance_on = VarianceComputationType(variance) != VarianceComputationType.NONE
+
+    def update(
+        init_chunk, score_partial, X, y, w, sample_ids, l2, l1, norm_rows,
+        offsets_plus_scores, view_cols, view_vals,
+    ):
+        from photon_ml_tpu.algorithm.random_effect import _to_original, _to_transformed
+        from photon_ml_tpu.models.game import random_effect_view_score
+
+        C, S, K = X.shape
+        off = jnp.take(offsets_plus_scores, jnp.maximum(sample_ids, 0), axis=0)
+        off = jnp.where(sample_ids >= 0, off, 0.0).astype(init_chunk.dtype)
+        init = init_chunk
+        if norm_rows is not None:
+            factors, shifts, icpt_mask = norm_rows
+            init = _to_transformed(init, factors, shifts, icpt_mask)
+        w_out, reasons, iters, var_out = solve(X, y, w, off, init, l2, l1)
+        if norm_rows is not None:
+            w_out = _to_original(w_out, factors, shifts, icpt_mask)
+            if variance_on and factors is not None:
+                var_out = var_out * factors**2
+        ok = jnp.isfinite(w_out).all()
+        # the chunk's lanes as a C-row table through the full-table kernel;
+        # tail columns >= K are never gathered (view cols < the bucket's K)
+        w_table = jnp.zeros((C, k_all), dtype=w_out.dtype).at[:, :K].set(w_out)
+        lane_rows = jnp.where(
+            sample_ids >= 0,
+            jnp.arange(C, dtype=jnp.int32)[:, None],
+            jnp.int32(-1),
+        ).reshape(-1)
+        sid_flat = sample_ids.reshape(-1)
+        safe = jnp.maximum(sid_flat, 0)
+        contrib = random_effect_view_score(
+            w_table,
+            lane_rows,
+            jnp.take(view_cols, safe, axis=0),
+            jnp.take(view_vals, safe, axis=0),
+        )
+        n = score_partial.shape[0]
+        idx = jnp.where(sid_flat >= 0, sid_flat, n)
+        score_out = score_partial.at[idx].set(
+            contrib.astype(score_partial.dtype), mode="drop"
+        )
+        return (
+            w_out,
+            var_out if variance_on else None,
+            score_out,
+            ok,
+            reasons,
+            iters,
+        )
+
+    return jax.jit(update, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
+def re_chunk_score_program():
+    """Chunked scoring for an arbitrary host-resident table (the working
+    set's initial-score path): one chunk's FULL-WIDTH coefficient rows come
+    up as a C-row lane table and its samples route through
+    ``random_effect_view_score`` exactly as the all-resident score does —
+    scatter-assembling the partials is bitwise-equal to the full-table call.
+
+    ``score(score_partial, w_rows, sample_ids, view_cols, view_vals) ->
+    score_partial`` with ``score_partial`` ``[N]`` DONATED (threaded through
+    every chunk of the pass)."""
+
+    def score_chunk(score_partial, w_rows, sample_ids, view_cols, view_vals):
+        from photon_ml_tpu.models.game import random_effect_view_score
+
+        C = w_rows.shape[0]
+        lane_rows = jnp.where(
+            sample_ids >= 0,
+            jnp.arange(C, dtype=jnp.int32)[:, None],
+            jnp.int32(-1),
+        ).reshape(-1)
+        sid_flat = sample_ids.reshape(-1)
+        safe = jnp.maximum(sid_flat, 0)
+        contrib = random_effect_view_score(
+            w_rows,
+            lane_rows,
+            jnp.take(view_cols, safe, axis=0),
+            jnp.take(view_vals, safe, axis=0),
+        )
+        n = score_partial.shape[0]
+        idx = jnp.where(sid_flat >= 0, sid_flat, n)
+        return score_partial.at[idx].set(
+            contrib.astype(score_partial.dtype), mode="drop"
+        )
+
+    return jax.jit(score_chunk, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
 def re_population_update_program(
     task: TaskType,
     opt_config: OptimizerConfig,
@@ -812,6 +950,8 @@ def clear():
     glm_solver.cache_clear()
     re_bucket_solver.cache_clear()
     re_coordinate_update_program.cache_clear()
+    re_chunk_update_program.cache_clear()
+    re_chunk_score_program.cache_clear()
     re_population_update_program.cache_clear()
     fe_population_update_program.cache_clear()
     sharded_glm_solver.cache_clear()

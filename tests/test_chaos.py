@@ -20,6 +20,7 @@ import pytest
 # importing the instrumented modules populates the fault-point registry
 import photon_ml_tpu.algorithm.coordinate_descent  # noqa: F401
 import photon_ml_tpu.continuous  # noqa: F401 — registers continuous.*
+import photon_ml_tpu.data.working_set  # noqa: F401 — registers workingset.*
 import photon_ml_tpu.io.checkpoint  # noqa: F401
 import photon_ml_tpu.parallel.distributed  # noqa: F401
 import photon_ml_tpu.serving.fleet  # noqa: F401 — registers serve.fleet.*
@@ -60,10 +61,15 @@ CONTINUOUS_POINTS = tuple(
     p for p in registered_fault_points() if p.startswith("continuous.")
 )
 SWEEP_POINTS = tuple(p for p in registered_fault_points() if p.startswith("sweep."))
+# the device-resident working set (PR 16): swept by tests/test_working_set.py's
+# mid-stream crash scenario (admit/evict/h2d/scatter on a checkpointed fit)
+WORKINGSET_POINTS = tuple(
+    p for p in registered_fault_points() if p.startswith("workingset.")
+)
 TRAINING_POINTS = tuple(
     p
     for p in registered_fault_points()
-    if not p.startswith(("serve.", "continuous.", "sweep."))
+    if not p.startswith(("serve.", "continuous.", "sweep.", "workingset."))
 )
 
 
@@ -115,6 +121,12 @@ def test_registry_covers_every_chaos_sweep():
         "sweep.evaluate",
         "sweep.commit",
     } == set(SWEEP_POINTS)
+    assert {
+        "workingset.admit",
+        "workingset.evict",
+        "workingset.h2d",
+        "workingset.scatter",
+    } == set(WORKINGSET_POINTS)
 
 FE_COORD = (
     "name=global,feature.shard=shardA,optimizer=LBFGS,"
