@@ -81,7 +81,13 @@ installed, as in CI) or as ``python bench.py --host-loop``. Flags:
 samples with power-law counts: per-entity data is SPARSE, each coordinate
 spans ~10 bucket shape classes, and the per-bucket loop's dispatch + host
 syncs dominate its solves — the many-small-entities regime random effects
-live in). Prints ONE JSON line; exits nonzero when a gate fails.
+live in). ``--working-set`` adds the streamed-vs-resident column: the same
+featureful workload with each RE coordinate's tables tiered at 50% residency
+through the device-resident working set (data/working_set.py) —
+``working_set_vs_resident`` is informational (benchmarks/working_set_bench.py
+owns the enforced residency ladder), while its bitwise coefficient/score
+parity, measured peak-within-budget and zero-retrace gates are hard. Prints
+ONE JSON line; exits nonzero when a gate fails.
 """
 
 from __future__ import annotations
@@ -169,12 +175,16 @@ def build_coordinates(
     re_solver: str = "lbfgs",
     precision=None,
     mesh=None,
+    working_set: bool = False,
 ):
     """FE + per-user + per-item coordinates in the featureful (fused-pass-
     ineligible) configuration: RE normalization, per-entity L2 overrides,
     SIMPLE variances. ``mesh``: place every dataset (and the base offsets)
     over the device mesh — the sharded single-program regime of
-    ``run_mesh``; None keeps the host placement."""
+    ``run_mesh``; None keeps the host placement. ``working_set``: engage the
+    device-resident working set on each RE coordinate at 50%% residency
+    (``working_set_rows`` = half its entity count) — the ``--working-set``
+    column's streamed variant."""
     import jax.numpy as jnp
 
     from photon_ml_tpu.algorithm import FixedEffectCoordinate, RandomEffectCoordinate
@@ -244,6 +254,9 @@ def build_coordinates(
             use_update_program=use_update_program,
             re_solver=re_solver,
             precision=precision,
+            working_set_rows=(
+                max(datasets[cid].n_entities // 2, 1) if working_set else None
+            ),
         )
     return coords
 
@@ -376,6 +389,7 @@ def run(
     reps: int = 3,
     solver_matrix: bool = True,
     min_direct_speedup: float = 0.0,
+    working_set: bool = False,
 ) -> dict:
     import jax
 
@@ -454,6 +468,99 @@ def run(
         "platform": jax.default_backend(),
     }
     gates_ok = parity and retraces == 0
+
+    # --- working-set column (--working-set) ----------------------------------
+    # the SAME featureful workload with each RE coordinate's tables tiered at
+    # 50% residency: throughput ratio vs the all-resident headline, bitwise
+    # coefficient/score parity (variances allclose — the split-bucket batched-
+    # GEMM scope, see benchmarks/working_set_bench.py), measured peak device
+    # table bytes within budget, zero steady-state retraces. The ratio itself
+    # is informational here (working_set_bench owns the enforced ladder); the
+    # parity/peak/retrace gates are hard.
+    if working_set:
+        from photon_ml_tpu.analysis.runtime_guard import no_retrace
+
+        coords_ws = build_coordinates(
+            workload, use_update_program=True, working_set=True
+        )
+        for cid in ("per-user", "per-item"):
+            assert coords_ws[cid]._working_set() is not None, (
+                f"{cid}: working set demoted — the --working-set column would "
+                "silently re-measure the all-resident path"
+            )
+        block(run_coordinate_descent(coords_ws, n_iterations=1))
+        elapsed_ws = float("inf")
+        result_ws = None
+        retraces_ws = 0
+        for _ in range(max(1, reps)):
+            # counter-only region: the per-chunk D2H harvests are real,
+            # intended transfers, so sync_discipline does not apply
+            with no_retrace(allow_retraces=10**6,
+                            what="host_loop_bench --working-set") as region:
+                t0 = time.perf_counter()
+                result_ws = block(
+                    run_coordinate_descent(coords_ws, n_iterations=passes)
+                )
+                elapsed_ws = min(elapsed_ws, time.perf_counter() - t0)
+            retraces_ws += region.traces
+        sps_ws = n * passes / elapsed_ws
+
+        ws_parity = True
+        ws_var_ok = True
+        ws_var_maxdiff = 0.0
+        for cid in sorted(result_new.model.models):
+            ma = result_ws.model.get_model(cid)
+            mb = result_new.model.get_model(cid)
+            if hasattr(mb, "coeffs"):
+                ca, cb = np.asarray(ma.coeffs), np.asarray(mb.coeffs)
+                ws_parity = ws_parity and ca.dtype == cb.dtype and np.array_equal(ca, cb)
+                if mb.variances is not None:
+                    va = np.asarray(ma.variances)
+                    vb = np.asarray(mb.variances)
+                    ws_var_maxdiff = max(ws_var_maxdiff, float(np.abs(va - vb).max()))
+                    ws_var_ok = ws_var_ok and np.allclose(va, vb, rtol=1e-5, atol=1e-7)
+            else:
+                ws_parity = ws_parity and np.array_equal(
+                    np.asarray(ma.model.coefficients.means),
+                    np.asarray(mb.model.coefficients.means),
+                )
+            ws_parity = ws_parity and np.array_equal(
+                np.asarray(result_ws.training_scores[cid]),
+                np.asarray(result_new.training_scores[cid]),
+            )
+        ws_stats = {
+            cid: coords_ws[cid].working_set_stats()
+            for cid in ("per-user", "per-item")
+        }
+        ws_peak_ok = all(
+            st["peak_device_table_bytes"] <= st["budget_bytes"]
+            for st in ws_stats.values()
+        )
+        result["working_set"] = {
+            "samples_per_sec": round(sps_ws, 2),
+            "vs_resident": round(sps_ws / value, 4),
+            "residency": 0.5,
+            "parity_bitwise": bool(ws_parity),
+            "variance_parity": bool(ws_var_ok),
+            "variance_max_diff": ws_var_maxdiff,
+            "peak_device_table_bytes": {
+                cid: st["peak_device_table_bytes"] for cid, st in ws_stats.items()
+            },
+            "budget_bytes": {
+                cid: st["budget_bytes"] for cid, st in ws_stats.items()
+            },
+            "peak_within_budget": bool(ws_peak_ok),
+            "overlap_efficiency": {
+                cid: st["overlap_efficiency"] for cid, st in ws_stats.items()
+            },
+            "retraces_after_warmup": int(retraces_ws),
+        }
+        result["working_set_vs_resident"] = round(sps_ws / value, 4)
+        gates_ok = (
+            gates_ok and ws_parity and ws_var_ok and ws_peak_ok
+            and retraces_ws == 0
+        )
+
     if not solver_matrix:
         result["gates_ok"] = bool(gates_ok)
         return result
@@ -748,6 +855,15 @@ def main(argv=None) -> int:
         "separately)",
     )
     p.add_argument(
+        "--working-set", dest="working_set", action="store_true",
+        help="add the working_set column: the same featureful workload with "
+        "each RE coordinate's tables tiered at 50%% residency "
+        "(working_set_rows = half its entity count). Reports streamed-vs-"
+        "resident throughput (working_set_vs_resident, informational) and "
+        "hard-gates bitwise coefficient/score parity, peak device table "
+        "bytes within budget, and zero steady-state retraces",
+    )
+    p.add_argument(
         "--mesh-devices", type=int, default=0,
         help="run the SHARDED single-program coordinate update over this "
         "many devices instead of the host-loop matrix: emits "
@@ -787,6 +903,7 @@ def main(argv=None) -> int:
         args.passes, args.samples, args.users, args.items, args.features,
         args.reps, solver_matrix=args.solver_matrix,
         min_direct_speedup=args.min_direct_speedup,
+        working_set=args.working_set,
     )
     print(json.dumps(result))
     # every gate is load-bearing: a retrace voids the steady-state reading, a

@@ -271,6 +271,10 @@ class RandomEffectCoordinate(Coordinate):
     # random_effect_gradient_norms screen / recency here); None ranks by
     # per-entity data mass.
     working_set_priorities: Optional[object] = None
+    # False serializes chunk staging onto the training thread instead of the
+    # double-buffered prefetch — the bench's unoverlapped denominator for the
+    # overlap-speedup gate; an execution-strategy knob, bitwise-neutral.
+    working_set_overlap: bool = True
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -324,6 +328,12 @@ class RandomEffectCoordinate(Coordinate):
         self._ws = None
         self._ws_resolved = False
         self._ws_l1 = None
+        # re_solver="auto": the measured per-bucket-shape record
+        # (optimization/normal_equations.AutoSolverDecision), filled by the
+        # first update's probe — or seeded from a restored checkpoint's
+        # extra_state so a crash replay never re-measures against warm
+        # tables (a re-probe could flip a choice and break bitwise replay)
+        self._auto_decision = None
 
     def initialize_model(self) -> RandomEffectModel:
         E, K = self.dataset.n_entities, self.dataset.max_k
@@ -386,6 +396,69 @@ class RandomEffectCoordinate(Coordinate):
             )
         return model
 
+    def _solver_plan(self, offsets_plus_scores=None, initial_model=None):
+        """Resolve ``re_solver`` for this update. Explicit strings pass
+        through untouched (bitwise status quo). ``"auto"`` resolves to a
+        MEASURED per-bucket plan: the first update probes BOTH solvers per
+        bucket shape on its actual inputs
+        (algorithm/random_effect.measure_auto_solvers) and every later
+        update replays the recorded choice — the plan tuple keys new cached
+        programs (solver_cache), never a retrace of an old one. With no
+        offsets in hand (the compiled-HLO audit path) the probe runs
+        against the base offsets alone, which then IS the run's decision —
+        one measurement per coordinate lifetime, restorable via
+        ``seed_solver_decision``."""
+        if self.re_solver != "auto":
+            return self.re_solver
+        from photon_ml_tpu.algorithm.random_effect import (
+            _bucket_shape,
+            measure_auto_solvers,
+        )
+
+        if self._auto_decision is None:
+            ops = (
+                offsets_plus_scores
+                if offsets_plus_scores is not None
+                else self.base_offsets
+            )
+            self._auto_decision = measure_auto_solvers(
+                self.dataset,
+                self.task,
+                self.configuration,
+                ops,
+                initial_model=initial_model,
+                normalization=self.normalization,
+                per_entity_reg_weights=self.per_entity_reg_weights,
+            )
+        return tuple(
+            self._auto_decision.choice_for(*_bucket_shape(b))
+            for b in self.dataset.buckets
+        )
+
+    def re_solver_stats(self):
+        """The measured ``"auto"`` record (dict form) — None until the first
+        update measured (or a restore seeded) it. Rides the checkpoint
+        manifest's ``extra_state`` (fingerprint-ADJACENT: the estimator
+        fingerprint pins ``re_solver="auto"`` the string, never the measured
+        outcome)."""
+        return (
+            None
+            if self._auto_decision is None
+            else self._auto_decision.to_dict()
+        )
+
+    def seed_solver_decision(self, d) -> None:
+        """Restore a measured ``"auto"`` record (``re_solver_stats`` form)
+        so a resumed run replays the original run's per-bucket choices
+        bitwise instead of re-measuring against restored warm tables."""
+        if d is None:
+            return
+        from photon_ml_tpu.optimization.normal_equations import (
+            AutoSolverDecision,
+        )
+
+        self._auto_decision = AutoSolverDecision.from_dict(d)
+
     def update_model(
         self, initial_model: Optional[RandomEffectModel], partial_scores: Array
     ) -> tuple[RandomEffectModel, RandomEffectTracker]:
@@ -399,7 +472,7 @@ class RandomEffectCoordinate(Coordinate):
             normalization=self.normalization,
             variance_computation=self.variance_computation,
             per_entity_reg_weights=self.per_entity_reg_weights,
-            re_solver=self.re_solver,
+            re_solver=self._solver_plan(offsets_plus_scores, initial_model),
         )
 
     def update_model_active(
@@ -432,7 +505,7 @@ class RandomEffectCoordinate(Coordinate):
             normalization=self.normalization,
             variance_computation=self.variance_computation,
             per_entity_reg_weights=self.per_entity_reg_weights,
-            re_solver=self.re_solver,
+            re_solver=self._solver_plan(offsets_plus_scores, initial_model),
         )
         self.last_active_stats = stats
         return model, tracker
@@ -553,6 +626,7 @@ class RandomEffectCoordinate(Coordinate):
             l2_host=l2_host,
             norm_host=norm_host,
             priorities=self.working_set_priorities,
+            overlap=self.working_set_overlap,
         )
         # the host tier takes ownership of the bucket blocks: re-pointing the
         # dataset at the host copies releases the device ones
@@ -717,7 +791,7 @@ class RandomEffectCoordinate(Coordinate):
             bool(self.configuration.l1_weight),
             VarianceComputationType(self.variance_computation),
             ds.n_entities,
-            self.re_solver,
+            self._solver_plan(),
             self.precision,
             shardings,
         )
@@ -760,6 +834,12 @@ class RandomEffectCoordinate(Coordinate):
         from photon_ml_tpu.algorithm.random_effect import LazyRandomEffectTracker
 
         st = self._fused_update_static()
+        if self.re_solver == "auto" and self._auto_decision is None:
+            # measure against THIS update's actual inputs (not the audit
+            # path's base-offsets fallback) before program resolution
+            self._solver_plan(
+                self.base_offsets + partial_scores, initial_model
+            )
         program, dtype, rows, sharding, _ = self._resolve_update_program()
         E, K_all = ds.n_entities, ds.max_k
 
@@ -875,20 +955,33 @@ class RandomEffectCoordinate(Coordinate):
                     if aligned.variances is None
                     else np.asarray(aligned.variances),
                 )
-        program = re_chunk_update_program(
-            self.task,
-            self.configuration.optimizer_config,
-            bool(self.configuration.l1_weight),
-            VarianceComputationType(self.variance_computation),
-            ds.max_k,
-            self.re_solver,
-        )
         offsets_plus_scores = self.base_offsets + partial_scores
+        # a measured-"auto" plan assigns each BUCKET a solver; every chunk
+        # of a bucket solves with its bucket's program (one cached program
+        # per distinct solver — the chunk program's key includes the solver
+        # string, so a changed plan resolves new programs, never a retrace)
+        from photon_ml_tpu.algorithm.random_effect import _bucket_solver_plan
+
+        plan = _bucket_solver_plan(
+            self._solver_plan(offsets_plus_scores, initial_model),
+            len(ds.buckets),
+        )
+        programs = {
+            solver: re_chunk_update_program(
+                self.task,
+                self.configuration.optimizer_config,
+                bool(self.configuration.l1_weight),
+                VarianceComputationType(self.variance_computation),
+                ds.max_k,
+                solver,
+            )
+            for solver in sorted(set(plan))
+        }
         view_cols, view_vals = ds.sample_local_cols, ds.sample_vals
         l1 = self._ws_l1
 
         def solve_chunk(chunk, staged, score_partial):
-            return program(
+            return programs[plan[chunk.bucket]](
                 staged["init"],
                 score_partial,
                 *staged["data"],

@@ -245,6 +245,133 @@ def build_l2_rows(
     return jnp.asarray(l2_table, dtype=dtype)
 
 
+def _bucket_solver_plan(re_solver, n_buckets: int) -> tuple:
+    """Normalize ``re_solver`` to one solver string per bucket: a tuple/list
+    is a measured per-bucket plan (``measure_auto_solvers``), a plain string
+    applies to every bucket."""
+    if isinstance(re_solver, (tuple, list)):
+        if len(re_solver) != n_buckets:
+            raise ValueError(
+                f"per-bucket re_solver plan covers {len(re_solver)} buckets, "
+                f"dataset has {n_buckets}"
+            )
+        return tuple(re_solver)
+    return (re_solver,) * n_buckets
+
+
+def _bucket_shape(bucket) -> tuple:
+    """A bucket's (S, K) shape class — robust to host-backed (numpy) and
+    device-backed bucket arrays alike."""
+    X = bucket.X
+    return (int(X.shape[1]), int(X.shape[2]))
+
+
+_AUTO_CLEAN_REASONS = (
+    int(ConvergenceReason.FUNCTION_VALUES_CONVERGED),
+    int(ConvergenceReason.GRADIENT_CONVERGED),
+)
+
+
+def measure_auto_solvers(
+    dataset: RandomEffectDataset,
+    task: TaskType,
+    configuration: GLMOptimizationConfiguration,
+    offsets_plus_scores: Array,
+    *,
+    initial_model: Optional[RandomEffectModel] = None,
+    normalization: Optional[NormalizationContext] = None,
+    per_entity_reg_weights=None,
+    dtype=None,
+):
+    """One-shot measurement probe behind ``re_solver="auto"``: run BOTH
+    bucket solvers per bucket SHAPE on the actual first-pass inputs (warm
+    start, offsets-plus-scores, per-entity L2, normalization space) and
+    record each solver's mean iteration count over real lanes — the
+    measured record the per-bucket pick is keyed on
+    (optimization/normal_equations.AutoSolverDecision).
+
+    One probe per (S, K) shape class covers every bucket and every streamed
+    working-set chunk of that class (the solver choice is a trace-time
+    property of the shape, so this is exactly jit's own granularity). The
+    probe solves with variance computation OFF — variances are computed
+    after convergence and cannot change iteration counts — and its outputs
+    are discarded: the first real pass re-runs under the chosen plan, so
+    the descent's numerics never depend on probe state. L1 configurations
+    return an empty record (every shape resolves to the quasi-Newton
+    solver): the normal equations cannot express the L1 subgradient, so
+    there is nothing to measure.
+    """
+    from photon_ml_tpu.optimization.normal_equations import AutoSolverDecision
+
+    task = TaskType(task)
+    decision = AutoSolverDecision()
+    l1 = configuration.l1_weight
+    if l1:
+        return decision
+    E, K_all = dataset.n_entities, dataset.max_k
+    if dtype is None:
+        dtype = dataset.sample_vals.dtype
+    coeffs = None
+    if initial_model is not None:
+        coeffs = np.asarray(
+            jax.device_get(initial_model.aligned_to(dataset).coeffs)
+        ).astype(dtype)
+    l2_rows = build_l2_rows(
+        dataset, configuration.l2_weight, per_entity_reg_weights, dtype, E
+    )
+    l1_arr = jnp.asarray(0.0, dtype=dtype)
+    seen: set = set()
+    for bucket in dataset.buckets:
+        S, K = _bucket_shape(bucket)
+        if (S, K) in seen:
+            continue
+        seen.add((S, K))
+        rows = np.asarray(bucket.entity_rows, dtype=np.int64)
+        real = rows < E
+        if not real.any():
+            continue
+        X_b = jnp.asarray(bucket.X)
+        y_b = jnp.asarray(bucket.labels)
+        w_b = jnp.asarray(bucket.weights)
+        sid = jnp.asarray(bucket.sample_ids)
+        off_b = jnp.take(offsets_plus_scores, jnp.maximum(sid, 0), axis=0)
+        off_b = jnp.where(sid >= 0, off_b, 0.0).astype(dtype)
+        if coeffs is None:
+            init_b = jnp.zeros((len(rows), K), dtype=dtype)
+        else:
+            init_b = jnp.asarray(
+                np.ascontiguousarray(coeffs[np.minimum(rows, E - 1), :K])
+            )
+        proj_b = dataset.proj_indices[jnp.minimum(jnp.asarray(rows), E - 1), :K]
+        factors, shifts, icpt_mask = _gather_norm_vectors(
+            normalization, proj_b, dtype
+        )
+        if normalization is not None and not normalization.is_identity:
+            init_b = _to_transformed(init_b, factors, shifts, icpt_mask)
+        l2_b = jnp.take(l2_rows, jnp.minimum(jnp.asarray(rows), E - 1))
+        measured = {}
+        for solver in ("lbfgs", "direct"):
+            solve = re_bucket_solver(
+                task, configuration.optimizer_config, False,
+                VarianceComputationType.NONE, solver,
+            )
+            _, reasons_b, iters_b, _ = solve(
+                X_b, y_b, w_b, off_b, init_b, l2_b, l1_arr
+            )
+            reasons_h, iters_h = jax.device_get((reasons_b, iters_b))  # jaxlint: disable=HS001 once-per-shape measurement probe, first pass only — the read IS the product
+            measured[solver] = (
+                float(np.asarray(iters_h)[real].mean()),
+                bool(np.isin(np.asarray(reasons_h)[real], _AUTO_CLEAN_REASONS).all()),
+            )
+        decision.record(
+            S, K,
+            lbfgs_iters=measured["lbfgs"][0],
+            direct_iters=measured["direct"][0],
+            direct_clean=measured["direct"][1],
+        )
+    return decision
+
+
 def train_random_effect(
     dataset: RandomEffectDataset,
     task: TaskType,
@@ -270,10 +397,12 @@ def train_random_effect(
     (RandomEffectOptimizationProblem.scala:34-37). Entities absent from a dict
     keep the configuration weight.
 
-    ``re_solver`` ("lbfgs" | "direct" | "auto") selects the inner bucket
-    solver (optimization/normal_equations.py): direct Gram/Cholesky Newton
-    solves instead of the configured quasi-Newton loop; "auto" picks direct
-    for small-K buckets only. Default keeps the bitwise status quo.
+    ``re_solver`` ("lbfgs" | "direct" | "auto", or a per-bucket tuple of
+    "lbfgs"/"direct" — the measured-"auto" plan from
+    :func:`measure_auto_solvers`) selects the inner bucket solver
+    (optimization/normal_equations.py): direct Gram/Cholesky Newton solves
+    instead of the configured quasi-Newton loop. Default keeps the bitwise
+    status quo.
     """
     task = TaskType(task)
     loss = loss_for_task(task)
@@ -324,12 +453,15 @@ def train_random_effect(
     # transfers in one device_get after the last bucket is enqueued
     reasons_parts, iters_parts, rows_parts = [], [], []
 
-    # the cached-solver probe is loop-invariant: resolve it once, not per bucket
-    solve = re_bucket_solver(
-        task, configuration.optimizer_config, bool(l1), variance_computation,
-        re_solver,
-    )
-    for bucket in dataset.buckets:
+    # re_bucket_solver is lru-cached, so per-bucket resolution costs a dict
+    # hit; a tuple plan (measured "auto" — measure_auto_solvers) picks the
+    # solver per bucket, a plain string keeps one solver for all buckets
+    solver_plan = _bucket_solver_plan(re_solver, len(dataset.buckets))
+    for bucket, bucket_solver in zip(dataset.buckets, solver_plan):
+        solve = re_bucket_solver(
+            task, configuration.optimizer_config, bool(l1), variance_computation,
+            bucket_solver,
+        )
         S, K = bucket.shape
         proj_b = dataset.proj_indices[bucket.entity_rows, :K]
         factors, shifts, icpt_mask = _gather_norm_vectors(normalization, proj_b, dtype)
@@ -523,23 +655,24 @@ def train_random_effect_delta(
 
     l2_rows = build_l2_rows(dataset, l2, per_entity_reg_weights, dtype, E)
     l1_arr = jnp.asarray(l1 or 0.0, dtype=dtype)
-    solve = re_bucket_solver(
-        task, configuration.optimizer_config, bool(l1), variance_computation,
-        re_solver,
-    )
+    solver_plan = _bucket_solver_plan(re_solver, len(dataset.buckets))
 
     reasons_parts, iters_parts, real_counts = [], [], []
     scatter_rows_parts, coef_updates, var_updates = [], [], []
     n_active = int(active_mask.sum())
     n_lanes = 0
     buckets_touched = 0
-    for bucket in dataset.buckets:
+    for bucket, bucket_solver in zip(dataset.buckets, solver_plan):
         rows_host = np.asarray(bucket.entity_rows)
         real = rows_host < E  # mesh-padding rows never appear here, but be safe
         sel = np.flatnonzero(real & active_mask[np.minimum(rows_host, E - 1)])
         if len(sel) == 0:
             continue
         buckets_touched += 1
+        solve = re_bucket_solver(
+            task, configuration.optimizer_config, bool(l1), variance_computation,
+            bucket_solver,
+        )
         S, K = bucket.shape
         Eb = bucket.n_entities
         if len(sel) == Eb:

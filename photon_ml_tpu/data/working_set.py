@@ -1,0 +1,632 @@
+"""Device-resident working set for random-effect tables larger than device memory.
+
+At ads/recsys scale the reference's random-effect tables do not fit in
+accelerator memory, and every other path in this repo assumes fully
+addressable ``[E, K]`` tables on device. This module supplies the missing
+tier of the memory hierarchy (the Snap ML shape, arxiv 1803.06333: disk ->
+host RAM -> accelerator, with importance-based selection of what occupies
+the fast tier):
+
+- **Host tier (authoritative).** :class:`WorkingSet` owns the full
+  coefficient/variance tables and every entity bucket's design blocks as
+  host numpy arrays. Commits are staged per pass and swapped atomically, so
+  streamed device state is NEVER the only copy of a committed row — a crash
+  mid-stream loses at most the in-flight pass (the chaos sweep in
+  tests/test_working_set.py proves bitwise recovery through the
+  ``workingset.*`` fault points below).
+- **Device tier (the working set).** A row budget (``working_set_rows``)
+  bounds what lives on device: RESIDENT chunks — the hottest entities,
+  whose design blocks stay device-cached and whose coefficient rows stay
+  device-resident across coordinate-descent passes — plus at most two
+  in-flight STREAMED chunks (double buffering). Everything is accounted in
+  whole chunks, so the budget check is exact:
+  ``resident_rows + 2 * max_chunk_lanes <= budget_rows``.
+- **Chunk scheduler.** A bucket that fits in one chunk keeps its EXACT
+  entity count as the lane count — the streamed solve then runs the same
+  batch shape the all-resident program gives that bucket, which is what
+  carries the bitwise coefficient contract (XLA's batch-1 lowering of the
+  vmapped LBFGS solve differs from batch-n by an ulp; batches >= 2 are
+  probe-confirmed lane-count-stable). Buckets larger than the cap stream
+  pow2-capped chunks (one lane count per bucket), so the program family is
+  CLOSED: steady-state chunk rotation compiles nothing
+  (``no_retrace``-gated). Padding lanes duplicate the chunk's first real
+  lane (the delta path's twin-solve trick) and carry ``sample_ids = -1``
+  so their score scatter drops. Coefficients and scores are bitwise-equal
+  to the all-resident path; FULL variances are tolerance-bounded when a
+  bucket is split (the Hessian build ``A.T @ (A*d)`` is a batched GEMM
+  whose lowering is batch-count-sensitive at the last bit — see
+  solver_cache.re_chunk_update_program).
+- **Admission/eviction policy.** :func:`select_resident_chunks` ranks
+  chunks by the max priority of their lanes — priority defaults to data
+  mass (per-entity active sample count) and is overridden by the
+  ``random_effect_gradient_norms`` screen and/or recency when the caller
+  supplies them (continuous/active_set.py feeds both). The admission
+  quantum is one chunk: residency changes rebuild device caches, never
+  host state (hot rows are mirrored to the host tier every pass).
+- **Overlap.** Host slicing + H2D of chunk i+1's DESIGN blocks (the large
+  ``C x S x K`` transfers) runs on a
+  :class:`~photon_ml_tpu.data.pipeline.BackgroundTask` while chunk i's
+  solve executes — the PR 5 discipline. The small table-row transfers stay
+  on the training thread, ordered harvest(i-1) -> stage-init(i) -> solve(i),
+  so at most TWO chunk tables are ever live and the admission bound above is
+  the true peak (chunk solves are already serialized by the score-partial
+  chain, so this ordering costs no solve overlap). ``stall_seconds`` vs
+  ``h2d_seconds`` quantify how much copy latency the solves actually hid
+  (the bench's overlap-efficiency metric).
+
+``peak_device_table_bytes`` is MEASURED from the live buffers this module
+holds (resident rows + staged inits + pending outputs), sampled at every
+chunk boundary — not modeled from the schedule. ``backend_peak_bytes``
+additionally reports the backend allocator's peak where the platform
+exposes ``memory_stats()`` (TPU/GPU; the CPU backend returns None).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.pipeline import BackgroundTask
+from photon_ml_tpu.data.random_effect import RandomEffectDataset, _next_pow2
+from photon_ml_tpu.resilience import faultpoint, register_fault_point
+
+# Chaos-sweep fault points (tests/test_chaos.py allowlist + the dedicated
+# sweep in tests/test_working_set.py): admission/eviction churn, per-chunk
+# H2D staging, and the host scatter commit.
+FP_ADMIT = register_fault_point("workingset.admit")
+FP_EVICT = register_fault_point("workingset.evict")
+FP_H2D = register_fault_point("workingset.h2d")
+FP_SCATTER = register_fault_point("workingset.scatter")
+
+# Smallest streamed lane count — the dataset builder's min entity pad, so
+# chunk shapes stay inside the pow2 family the solver cache already compiles.
+MIN_CHUNK_LANES = 8
+
+
+def _prev_pow2(n: int, minimum: int) -> int:
+    """Largest power of two <= max(n, minimum), floored at ``minimum``."""
+    p = minimum
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def backend_peak_bytes() -> Optional[int]:
+    """Peak bytes in use reported by the live backend allocator, maxed over
+    local devices; None when the platform exposes no memory stats (the CPU
+    backend). This is the honest-measurement primitive the benches report
+    alongside the live-buffer accounting — never a modeled byte count."""
+    peak = None
+    for device in jax.local_devices():
+        stats = getattr(device, "memory_stats", lambda: None)()
+        if not stats:
+            continue
+        value = stats.get("peak_bytes_in_use")
+        if value is not None:
+            peak = value if peak is None else max(peak, value)
+    return peak
+
+
+def select_resident_chunks(
+    chunk_priorities: np.ndarray,
+    chunk_lanes: np.ndarray,
+    hot_budget: int,
+) -> np.ndarray:
+    """Greedy chunk-granular admission: admit chunks hottest-first while the
+    admitted lane count stays within ``hot_budget``. Ties break on chunk id
+    (deterministic). Returns a bool mask over chunks."""
+    admitted = np.zeros(len(chunk_priorities), dtype=bool)
+    if hot_budget <= 0:
+        return admitted
+    order = np.lexsort((np.arange(len(chunk_priorities)), -chunk_priorities))
+    used = 0
+    for c in order:
+        lanes = int(chunk_lanes[c])
+        if used + lanes <= hot_budget:
+            admitted[c] = True
+            used += lanes
+    return admitted
+
+
+class _DeferredStage:
+    """BackgroundTask-shaped handle that runs the stage call synchronously at
+    ``result()`` time — the ``overlap=False`` schedule, where every H2D copy
+    sits on the training thread's critical path."""
+
+    def __init__(self, fn, chunk):
+        self._fn = fn
+        self._chunk = chunk
+
+    def result(self, timeout=None):
+        return self._fn(self._chunk)
+
+
+@dataclasses.dataclass
+class StreamChunk:
+    """One schedulable unit: a pow2-lane slice of one bucket's entities."""
+
+    bucket: int  # index into the dataset's bucket list
+    rows: np.ndarray  # [C] int64 entity rows (padding duplicates lane 0)
+    lanes: np.ndarray  # [C] int64 lane index into the bucket arrays
+    real: np.ndarray  # [C] bool — False on pow2 padding lanes
+    sid: np.ndarray  # [C, S] int32 sample ids; -1 on every padding lane
+    priority: float  # max lane priority (admission rank)
+    hot: bool = False
+    # hot-tier device caches (built at admission, dropped at eviction):
+    data_dev: Optional[tuple] = None  # (X, y, w, sid) device arrays
+    l2_dev: Optional[object] = None
+    norm_dev: Optional[tuple] = None
+    # device-resident coefficient rows carried ACROSS passes (hot only);
+    # None forces a re-seed from the committed host rows (first pass,
+    # post-eviction readmission, or a rejected pass)
+    init_dev: Optional[object] = None
+
+
+class WorkingSet:
+    """Host-pinned table owner + chunk scheduler + streaming pass driver.
+
+    The coordinate (algorithm/coordinate.py) owns program resolution and the
+    divergence-guard/commit decision; this class owns the tiers: which rows
+    are resident, what streams when, and the authoritative host tables."""
+
+    def __init__(
+        self,
+        dataset: RandomEffectDataset,
+        budget_rows: int,
+        dtype,
+        *,
+        variance_on: bool,
+        l2_host: np.ndarray,
+        norm_host: tuple,
+        priorities=None,
+        overlap: bool = True,
+    ):
+        E, K_all = dataset.n_entities, dataset.max_k
+        self.n_entities = E
+        self.k_all = K_all
+        self.budget_rows = int(budget_rows)
+        self.dtype = np.dtype(dtype)
+        self.variance_on = bool(variance_on)
+        # False serializes staging onto the training thread (stage -> solve
+        # -> stage ...): the bench's unoverlapped denominator for the
+        # double-buffering speedup gate. Staging is pure data movement, so
+        # the toggle cannot change a single output bit.
+        self.overlap = bool(overlap)
+        # --- host (pinned, authoritative) tier -------------------------------
+        self.host_coeffs = np.zeros((E, K_all), dtype=self.dtype)
+        self.host_vars = (
+            np.zeros((E, K_all), dtype=self.dtype) if variance_on else None
+        )
+        # one D2H per bucket moves the design blocks to the host tier; the
+        # caller re-points dataset.buckets at these so the device copies free
+        self.host_buckets = [jax.device_get(b) for b in dataset.buckets]
+        self.l2_host = np.asarray(l2_host)
+        self.norm_host = tuple(
+            None
+            if tbl is None
+            else tuple(None if a is None else np.asarray(a) for a in tbl)
+            for tbl in norm_host
+        )
+        # non-finite coefficients in the table tail (columns a bucket never
+        # rewrites) poison the all-resident guard forever; mirror that here
+        self._tail_ok = True
+        # --- staging (in-flight pass) ----------------------------------------
+        self._staging_coeffs: Optional[np.ndarray] = None
+        self._staging_vars: Optional[np.ndarray] = None
+        # --- stats -----------------------------------------------------------
+        self.peak_device_table_bytes = 0
+        self.h2d_seconds = 0.0
+        self.stall_seconds = 0.0
+        self.h2d_bytes = 0
+        self.passes = 0
+        self.chunks: list[StreamChunk] = []
+        self.max_chunk_lanes = 0
+        self._build_schedule(priorities)
+
+    # ------------------------------------------------------------------ policy
+    def _default_priorities(self) -> np.ndarray:
+        """Data mass: per-entity active sample counts (free — the host tier
+        already holds every bucket's sample ids)."""
+        mass = np.zeros(self.n_entities, dtype=np.float64)
+        for hb in self.host_buckets:
+            rows = np.asarray(hb.entity_rows, dtype=np.int64)
+            counts = (np.asarray(hb.sample_ids) >= 0).sum(axis=1)
+            valid = rows < self.n_entities
+            mass[rows[valid]] = counts[valid]
+        return mass
+
+    def _resolve_priorities(self, priorities) -> np.ndarray:
+        if priorities is None:
+            return self._default_priorities()
+        arr = np.asarray(priorities, dtype=np.float64).reshape(-1)
+        if arr.shape[0] != self.n_entities:
+            raise ValueError(
+                f"working-set priorities cover {arr.shape[0]} entities, "
+                f"dataset has {self.n_entities}"
+            )
+        return arr
+
+    @staticmethod
+    def schedule_feasible(budget_rows: int, n_buckets: int) -> bool:
+        """Can a double-buffered stream run inside ``budget_rows`` at all?
+        The minimal schedule needs two in-flight chunks of the smallest pow2
+        lane count. Callers demote (with a logged fallback) when this fails."""
+        return n_buckets == 0 or budget_rows >= 2 * MIN_CHUNK_LANES
+
+    # --------------------------------------------------------------- scheduler
+    def _build_schedule(self, priorities) -> None:
+        prio = self._resolve_priorities(priorities)
+        chunks: list[StreamChunk] = []
+        # one chunk lane count per bucket: pow2, capped so two in-flight
+        # streamed chunks leave the budget's resident share intact
+        cap = _prev_pow2(max(self.budget_rows // 4, MIN_CHUNK_LANES), MIN_CHUNK_LANES)
+        for b, hb in enumerate(self.host_buckets):
+            rows_b = np.asarray(hb.entity_rows, dtype=np.int64)
+            real_rows = np.flatnonzero(rows_b < self.n_entities)
+            if not len(real_rows):
+                continue
+            # a bucket that fits in ONE chunk keeps its exact entity count:
+            # the streamed solve then runs the same batch shape the
+            # all-resident program gives this bucket, which is what carries
+            # the bitwise contract — XLA's batch-1 lowering of the vmapped
+            # LBFGS solve differs from batch-n by an ulp, so padding a
+            # 1-entity bucket to MIN_CHUNK_LANES would break parity. Split
+            # buckets stream pow2-capped chunks (batch >= 2 lane-count
+            # stability is probe-confirmed, tests/test_working_set.py).
+            if len(real_rows) <= cap:
+                c_lanes = len(real_rows)
+            else:
+                c_lanes = cap
+            # hottest lanes first, row order breaking ties (deterministic) —
+            # chunk 0 of each bucket holds the bucket's hottest entities
+            order = real_rows[
+                np.lexsort((real_rows, -prio[rows_b[real_rows]]))
+            ]
+            sid_b = np.asarray(hb.sample_ids)
+            for start in range(0, len(order), c_lanes):
+                sel = order[start : start + c_lanes]
+                pad = c_lanes - len(sel)
+                lanes = np.concatenate([sel, np.full(pad, sel[0])]) if pad else sel
+                real = np.zeros(c_lanes, dtype=bool)
+                real[: len(sel)] = True
+                sid = sid_b[lanes].astype(np.int32)
+                sid[~real] = -1  # padding lanes never score
+                chunks.append(
+                    StreamChunk(
+                        bucket=b,
+                        rows=rows_b[lanes],
+                        lanes=lanes,
+                        real=real,
+                        sid=sid,
+                        priority=float(prio[rows_b[sel]].max()),
+                    )
+                )
+        self.max_chunk_lanes = max((len(c.rows) for c in chunks), default=0)
+        hot_budget = self.budget_rows - 2 * self.max_chunk_lanes
+        admitted = select_resident_chunks(
+            np.asarray([c.priority for c in chunks]),
+            np.asarray([len(c.rows) for c in chunks]),
+            hot_budget,
+        )
+        for c, hot in zip(chunks, admitted):
+            c.hot = bool(hot)
+        # streamed (cold) chunks run first, hottest-resident last: the tail of
+        # the pipeline is the cheap device-cached work, so the final D2H
+        # harvests overlap it instead of trailing the pass
+        chunks.sort(key=lambda c: (c.hot, -c.priority))
+        self.chunks = chunks
+        self._warm_hot_tier()
+
+    def _warm_hot_tier(self) -> None:
+        """Upload admitted chunks' design blocks once (the device cache that
+        makes them resident). Fires ``workingset.admit`` per admission."""
+        for chunk in self.chunks:
+            if not chunk.hot or chunk.data_dev is not None:
+                continue
+            faultpoint(FP_ADMIT)
+            hb = self.host_buckets[chunk.bucket]
+            chunk.data_dev = (
+                jnp.asarray(np.ascontiguousarray(np.asarray(hb.X)[chunk.lanes])),
+                jnp.asarray(np.ascontiguousarray(np.asarray(hb.labels)[chunk.lanes])),
+                jnp.asarray(np.ascontiguousarray(np.asarray(hb.weights)[chunk.lanes])),
+                jnp.asarray(chunk.sid),
+            )
+            chunk.l2_dev = jnp.asarray(self._l2_rows(chunk))
+            chunk.norm_dev = self._norm_rows(chunk, device=True)
+
+    def reselect(self, priorities) -> None:
+        """Admission/eviction churn between passes: re-rank with fresh
+        priorities (recency / gradient-norm screen) and rebuild the schedule.
+        Hot rows were mirrored to the host tier at every commit, so eviction
+        only drops device caches — no state moves."""
+        for chunk in self.chunks:
+            if chunk.hot:
+                faultpoint(FP_EVICT)
+            chunk.data_dev = chunk.l2_dev = chunk.norm_dev = None
+            chunk.init_dev = None
+        self._build_schedule(priorities)
+
+    # ----------------------------------------------------------------- seeding
+    def owns(self, coeffs) -> bool:
+        return coeffs is self.host_coeffs
+
+    def seed_tables(self, coeffs: np.ndarray, variances=None) -> None:
+        """Adopt a foreign warm start (checkpoint restore, external model)
+        into the host tier; hot device rows are invalidated so the next pass
+        re-seeds from these values."""
+        arr = np.asarray(coeffs, dtype=self.dtype)
+        if arr.shape != self.host_coeffs.shape:
+            fresh = np.zeros_like(self.host_coeffs)
+            fresh[: arr.shape[0], : arr.shape[1]] = arr[
+                : fresh.shape[0], : fresh.shape[1]
+            ]
+            arr = fresh
+        self.host_coeffs = np.array(arr, copy=True)
+        if self.host_vars is not None:
+            if variances is None:
+                self.host_vars = np.zeros_like(self.host_vars)
+            else:
+                v = np.asarray(variances, dtype=self.dtype)
+                if v.shape != self.host_vars.shape:
+                    fresh = np.zeros_like(self.host_vars)
+                    fresh[: v.shape[0], : v.shape[1]] = v[
+                        : fresh.shape[0], : fresh.shape[1]
+                    ]
+                    v = fresh
+                self.host_vars = np.array(v, copy=True)
+        for chunk in self.chunks:
+            chunk.init_dev = None
+        self._check_tail()
+
+    def _check_tail(self) -> None:
+        """The all-resident guard checks the WHOLE table, including columns
+        beyond each bucket's K that no update ever rewrites; a non-finite
+        seed there must poison the streamed guard the same way."""
+        ok = True
+        for hb in self.host_buckets:
+            K = np.asarray(hb.X).shape[2]
+            if K >= self.k_all:
+                continue
+            rows = np.asarray(hb.entity_rows, dtype=np.int64)
+            rows = rows[rows < self.n_entities]
+            if not np.isfinite(self.host_coeffs[rows, K:]).all():
+                ok = False
+        self._tail_ok = ok
+
+    @property
+    def tail_ok(self) -> bool:
+        return self._tail_ok
+
+    # ---------------------------------------------------------------- staging
+    def _l2_rows(self, chunk: StreamChunk) -> np.ndarray:
+        idx = np.minimum(chunk.rows, len(self.l2_host) - 1)
+        return np.ascontiguousarray(self.l2_host[idx]).astype(self.dtype)
+
+    def _norm_rows(self, chunk: StreamChunk, device: bool = False):
+        tbl = self.norm_host[chunk.bucket]
+        if tbl is None:
+            return None
+        rows = tuple(
+            None if a is None else np.ascontiguousarray(a[chunk.lanes])
+            for a in tbl
+        )
+        if device:
+            return tuple(None if a is None else jnp.asarray(a) for a in rows)
+        return rows
+
+    def _stage(self, chunk: StreamChunk) -> tuple[dict, float, int]:
+        """Slice + H2D a chunk's DESIGN blocks (X/y/w/l2/norm); runs on the
+        prefetch thread. Deliberately excludes the coefficient init rows:
+        table rows are the budgeted resource, and staging them here would put
+        a third in-flight chunk table on device (the prefetched init, the
+        solving chunk's init and its output) — the init H2D is tiny (C x K vs
+        the C x S x K blocks) and stays on the training thread instead
+        (:meth:`_stage_init`), so at most TWO chunk tables are ever live."""
+        t0 = time.perf_counter()
+        faultpoint(FP_H2D)
+        hb = self.host_buckets[chunk.bucket]
+        moved = 0
+        if chunk.hot and chunk.data_dev is not None:
+            data, l2, norm = chunk.data_dev, chunk.l2_dev, chunk.norm_dev
+        else:
+            data = (
+                jnp.asarray(np.ascontiguousarray(np.asarray(hb.X)[chunk.lanes])),
+                jnp.asarray(np.ascontiguousarray(np.asarray(hb.labels)[chunk.lanes])),
+                jnp.asarray(np.ascontiguousarray(np.asarray(hb.weights)[chunk.lanes])),
+                jnp.asarray(chunk.sid),
+            )
+            l2 = jnp.asarray(self._l2_rows(chunk))
+            norm = self._norm_rows(chunk, device=True)
+            moved += sum(int(a.nbytes) for a in data) + int(l2.nbytes)
+            if norm is not None:
+                moved += sum(int(a.nbytes) for a in norm if a is not None)
+        staged = {"data": data, "l2": l2, "norm": norm}
+        return staged, time.perf_counter() - t0, moved
+
+    def _stage_init(self, chunk: StreamChunk):
+        """H2D one chunk's coefficient init rows on the training thread —
+        AFTER the previous chunk's harvest freed its output, so the table
+        tier holds at most two in-flight chunk tables (this init + the
+        solve's output). Hot chunks reuse their device-resident rows."""
+        if chunk.init_dev is not None:
+            return chunk.init_dev
+        t0 = time.perf_counter()
+        hb = self.host_buckets[chunk.bucket]
+        K = np.asarray(hb.X).shape[2]
+        # jnp.array(copy=True), NOT jnp.asarray: this buffer is DONATED to
+        # the chunk program (arg 0), and asarray may zero-copy alias the
+        # host temp — donating an aliased buffer lets XLA scribble its
+        # output into memory numpy can recycle mid-execution.
+        init = jnp.array(self.host_coeffs[chunk.rows, :K], copy=True)
+        self.h2d_seconds += time.perf_counter() - t0
+        self.h2d_bytes += int(init.nbytes)
+        return init
+
+    # ------------------------------------------------------------- pass driver
+    def _prefetch(self, chunk: StreamChunk):
+        """Next chunk's staging handle: a :class:`BackgroundTask` when double
+        buffering (H2D hides behind the current solve), or a deferred call
+        that runs on the training thread at ``result()`` time when
+        ``overlap=False`` — staging then serializes stage(i) -> solve(i) ->
+        stage(i+1), and the whole copy lands in ``stall_seconds``."""
+        if self.overlap:
+            return BackgroundTask(self._stage, chunk, name="photon-ws-h2d")
+        return _DeferredStage(self._stage, chunk)
+
+    def stream_pass(self, solve_chunk: Callable, score_partial):
+        """Drive one coordinate-descent pass over the chunk schedule.
+
+        ``solve_chunk(chunk, staged, score_partial)`` dispatches the caller's
+        jitted chunk program and returns ``(w_out, var_out, score_partial,
+        ok, reasons, iters)``. Returns ``(score, ok_device_flag,
+        reasons_parts, iters_parts, real_masks)``; the caller decides the
+        commit with :meth:`commit_pass`."""
+        if not self.chunks:
+            raise RuntimeError("working set has no chunks to stream")
+        self._staging_coeffs = np.array(self.host_coeffs, copy=True)
+        self._staging_vars = (
+            None if self.host_vars is None else np.array(self.host_vars, copy=True)
+        )
+        ok_dev = None
+        reasons_parts: list = []
+        iters_parts: list = []
+        masks: list = []
+        pending = None  # (chunk, w_out, var_out) awaiting D2H + host scatter
+        prefetch = self._prefetch(self.chunks[0])
+        for i, chunk in enumerate(self.chunks):
+            t0 = time.perf_counter()
+            # bounded join: a wedged H2D thread surfaces as a TimeoutError on
+            # the training thread instead of hanging the pass forever (and
+            # interpreter teardown never aborts an unbounded wait mid-dispatch)
+            staged, h2d_s, moved = prefetch.result(timeout=600.0)
+            self.stall_seconds += time.perf_counter() - t0
+            self.h2d_seconds += h2d_s
+            self.h2d_bytes += moved
+            if i + 1 < len(self.chunks):
+                prefetch = self._prefetch(self.chunks[i + 1])
+            if pending is not None:
+                # harvest BEFORE staging this chunk's init: the previous
+                # output's D2H frees its rows first, so the table tier never
+                # holds more than two in-flight chunk tables — the bound the
+                # admission check (resident + 2 * max_chunk_lanes <= budget)
+                # promises. Its solve was dispatched a full prefetch ago, so
+                # this read rarely stalls.
+                self._harvest(*pending)
+                pending = None
+            staged["init"] = self._stage_init(chunk)
+            w_out, var_out, score_partial, ok, reasons, iters = solve_chunk(
+                chunk, staged, score_partial
+            )
+            ok_dev = ok if ok_dev is None else jnp.logical_and(ok_dev, ok)
+            if chunk.hot:
+                # the resident tier's cross-pass warm start; a rejected pass
+                # clears it back to the committed host rows (commit_pass)
+                chunk.init_dev = w_out
+            self._note_table_bytes(staged["init"], w_out, var_out)
+            pending = (chunk, w_out, var_out)
+            reasons_parts.append(reasons)
+            iters_parts.append(iters)
+            masks.append(chunk.real)
+        self._harvest(*pending)
+        self.passes += 1
+        return (
+            score_partial,
+            ok_dev,
+            tuple(reasons_parts),
+            tuple(iters_parts),
+            tuple(masks),
+        )
+
+    def _harvest(self, chunk: StreamChunk, w_out, var_out) -> None:
+        """D2H one chunk's solved rows and scatter them into the staging
+        tables (blocks on that chunk's solve — by construction the chunk
+        AFTER it is already dispatched)."""
+        faultpoint(FP_SCATTER)
+        K = w_out.shape[1]
+        real = chunk.real
+        rows = chunk.rows[real]
+        self._staging_coeffs[rows, :K] = np.asarray(jax.device_get(w_out))[real]
+        if var_out is not None and self._staging_vars is not None:
+            self._staging_vars[rows, :K] = np.asarray(jax.device_get(var_out))[real]
+
+    def commit_pass(self, ok: bool) -> None:
+        """Atomic host-tier commit: swap the staged tables in on a healthy
+        pass; on a divergence reject, discard them and drop the hot tier's
+        device rows so the next pass warm-starts from the committed values
+        (the all-resident donated-``where`` reject, replayed host-side)."""
+        if self._staging_coeffs is None:
+            raise RuntimeError("commit_pass without a streamed pass in flight")
+        if ok:
+            self.host_coeffs = self._staging_coeffs
+            if self._staging_vars is not None:
+                self.host_vars = self._staging_vars
+        else:
+            for chunk in self.chunks:
+                chunk.init_dev = None
+        self._staging_coeffs = None
+        self._staging_vars = None
+
+    # ------------------------------------------------------------- scoring
+    def score_streamed(self, score_program, coeffs: np.ndarray, n_samples: int,
+                       view_cols, view_vals):
+        """Chunked scoring for an arbitrary host table (the descent loop's
+        initial score): each chunk's full-width rows go up as a C-row lane
+        table through the same view kernel the all-resident score uses."""
+        arr = np.asarray(coeffs, dtype=self.dtype)
+        score = jnp.zeros((n_samples,), dtype=self.dtype)
+        for chunk in self.chunks:
+            w_rows = jnp.asarray(np.ascontiguousarray(arr[chunk.rows]))
+            score = score_program(
+                score, w_rows, jnp.asarray(chunk.sid), view_cols, view_vals
+            )
+        return score
+
+    # ---------------------------------------------------------------- metrics
+    def _note_table_bytes(self, init, w_out, var_out) -> None:
+        """Sample the live table-tier buffers (measured, not modeled): the
+        resident rows and the in-flight chunk's init + outputs. The previous
+        chunk was harvested (and its rows freed) before this chunk's init
+        staged, so these ARE the only live chunk tables."""
+        live = 0
+        for chunk in self.chunks:
+            if chunk.init_dev is not None:
+                live += int(chunk.init_dev.nbytes)
+        for a in (init, w_out, var_out):
+            if a is not None:
+                live += int(a.nbytes)
+        self.peak_device_table_bytes = max(self.peak_device_table_bytes, live)
+
+    @property
+    def budget_bytes(self) -> int:
+        tables = 2 if self.variance_on else 1
+        return self.budget_rows * self.k_all * self.dtype.itemsize * tables
+
+    def overlap_efficiency(self) -> float:
+        """Fraction of H2D staging time hidden behind solves: 1.0 = every
+        copy fully overlapped, 0.0 = fully serialized H2D -> solve."""
+        if self.h2d_seconds <= 0.0:
+            return 1.0
+        return max(0.0, 1.0 - self.stall_seconds / self.h2d_seconds)
+
+    def stats(self) -> dict:
+        hot_rows = sum(len(c.rows) for c in self.chunks if c.hot)
+        return {
+            "budget_rows": self.budget_rows,
+            "budget_bytes": self.budget_bytes,
+            "resident_rows": hot_rows,
+            "n_chunks": len(self.chunks),
+            "n_resident_chunks": sum(1 for c in self.chunks if c.hot),
+            "max_chunk_lanes": self.max_chunk_lanes,
+            "passes": self.passes,
+            "peak_device_table_bytes": self.peak_device_table_bytes,
+            "h2d_seconds": self.h2d_seconds,
+            "stall_seconds": self.stall_seconds,
+            "h2d_bytes": self.h2d_bytes,
+            "overlap": self.overlap,
+            "overlap_efficiency": self.overlap_efficiency(),
+        }

@@ -772,6 +772,14 @@ class CoordinateDescentCheckpointer:
     ``restore()`` never raises: any unexpected failure logs and falls back to
     a fresh start — a bad checkpoint must never be able to kill a run that
     could simply retrain.
+
+    ``extra_state_provider`` (optional zero-arg callable returning a
+    JSON-serializable dict or None) is polled at every save and rides the
+    manifest's ``extra`` key — fingerprint-ADJACENT run state (e.g. the
+    measured ``re_solver="auto"`` decisions) that a resume needs to replay
+    bitwise but that must NOT invalidate the checkpoint the way a
+    fingerprint mismatch does. ``restore()`` surfaces it back on the
+    returned dict's ``"extra"`` key.
     """
 
     def __init__(
@@ -781,6 +789,7 @@ class CoordinateDescentCheckpointer:
         dtype=jnp.float32,
         fingerprint: Optional[str] = None,
         keep_generations: int = DEFAULT_KEEP_GENERATIONS,
+        extra_state_provider=None,
     ):
         if interval < 1:
             raise ValueError(f"checkpoint interval must be >= 1, got {interval}")
@@ -789,6 +798,7 @@ class CoordinateDescentCheckpointer:
         self.dtype = dtype
         self.fingerprint = fingerprint
         self.keep_generations = int(keep_generations)
+        self.extra_state_provider = extra_state_provider
 
     def maybe_save(
         self,
@@ -802,6 +812,11 @@ class CoordinateDescentCheckpointer:
     ) -> bool:
         if not force and completed_iterations % self.interval != 0:
             return False
+        extra = (
+            self.extra_state_provider()
+            if self.extra_state_provider is not None
+            else None
+        )
         save_checkpoint(
             self.directory,
             models,
@@ -812,6 +827,7 @@ class CoordinateDescentCheckpointer:
             fingerprint=self.fingerprint,
             incidents=incidents,
             keep_generations=self.keep_generations,
+            extra_state=extra,
         )
         return True
 

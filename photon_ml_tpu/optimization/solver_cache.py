@@ -278,9 +278,21 @@ def _re_coordinate_update_fn(
     identity. The returned per-lane ``ok`` flag reports True for frozen
     lanes (carrying committed state is not a reject), and the returned
     iteration counts are zero there."""
-    solve = _re_bucket_solve_fn(
-        task, opt_config, has_l1, variance, re_solver, with_active
-    )
+    # a per-bucket tuple plan (measured re_solver="auto") builds one solve
+    # body per DISTINCT solver and indexes it per bucket at trace time — the
+    # whole plan is part of the lru_cache key, so a changed plan is a new
+    # program, never a silent retrace of an old one
+    if isinstance(re_solver, tuple):
+        solve_bodies = {
+            s: _re_bucket_solve_fn(task, opt_config, has_l1, variance, s, with_active)
+            for s in sorted(set(re_solver))
+        }
+        solve_plan = tuple(solve_bodies[s] for s in re_solver)
+    else:
+        solve_plan = None
+        solve = _re_bucket_solve_fn(
+            task, opt_config, has_l1, variance, re_solver, with_active
+        )
     reduced = not precision.is_reference
 
     def update_core(
@@ -295,8 +307,14 @@ def _re_coordinate_update_fn(
         # the dtype every solve runs at: the table dtype itself on the
         # reference path (bitwise status quo), f32 under a reduced policy
         solve_dtype = precision.accum_dtype if reduced else coeffs.dtype
+        if solve_plan is not None and len(solve_plan) != len(buckets):
+            raise ValueError(
+                f"per-bucket re_solver plan covers {len(solve_plan)} buckets, "
+                f"update traces {len(buckets)}"
+            )
         reasons, iters = [], []
-        for bucket, norm_tbl in zip(buckets, norm_tables):
+        for b_i, (bucket, norm_tbl) in enumerate(zip(buckets, norm_tables)):
+            solve_b = solve_plan[b_i] if solve_plan is not None else solve
             S, K = bucket.shape
             off_b = jnp.take(
                 offsets_plus_scores, jnp.maximum(bucket.sample_ids, 0), axis=0
@@ -319,7 +337,7 @@ def _re_coordinate_update_fn(
             )
             if with_active:
                 solve_args = solve_args + (active,)
-            w_b, reasons_b, iters_b, var_b = solve(*solve_args)
+            w_b, reasons_b, iters_b, var_b = solve_b(*solve_args)
             if norm_tbl is not None:
                 w_b = _to_original(w_b, factors, shifts, icpt_mask)
                 if variances is not None and factors is not None:
@@ -427,7 +445,11 @@ def re_coordinate_update_program(
       kernel as the eager path.
     - ``re_solver`` / ``precision``: the direct-solve and storage-precision
       levers (normal_equations.py / precision.py); the defaults reproduce
-      the bitwise-gated status quo.
+      the bitwise-gated status quo. ``re_solver`` also accepts a per-bucket
+      tuple of "lbfgs"/"direct" — the measured-"auto" plan
+      (algorithm/random_effect.measure_auto_solvers); the tuple is part of
+      this cache's key, so a changed plan resolves a NEW program rather
+      than retracing an old one.
     - ``shardings``: None on the host backend; on a mesh, the
       ``(table_sharding, score_sharding)`` NamedSharding pair
       (hashable — part of the cache key). The update body is placement-

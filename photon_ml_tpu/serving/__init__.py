@@ -26,6 +26,12 @@ from photon_ml_tpu.serving.hotswap import (
     HotSwapManager,
     serve_from_checkpoint,
 )
+from photon_ml_tpu.serving.quality_gate import (
+    SERVE_PRECISION_DRIFT_TOL,
+    PrecisionDriftError,
+    check_precision_drift,
+    precision_drift,
+)
 from photon_ml_tpu.serving.router import (
     BackendReplica,
     FrontRouter,
@@ -53,21 +59,25 @@ __all__ = [
     "HotSwapManager",
     "ModelRouter",
     "Overloaded",
+    "PrecisionDriftError",
     "QuotaExceeded",
     "Replica",
     "ReplicaSet",
     "ReplicaUnavailable",
     "RouterConfig",
+    "SERVE_PRECISION_DRIFT_TOL",
     "RouterHTTPServer",
     "ServingFrontend",
     "ServingFuture",
     "TenantQuota",
     "TokenBucket",
+    "check_precision_drift",
     "clear_engine_cache",
     "decode_game_input",
     "encode_game_input",
     "evict_engine",
     "get_engine",
     "model_fingerprint",
+    "precision_drift",
     "serve_from_checkpoint",
 ]

@@ -443,13 +443,48 @@ class ServingFrontend:
                 )
         return out
 
+    def mirror_requests(self) -> list[tuple[str, bool, GameInput]]:
+        """The reduced-precision quality gate's held-out probe set
+        (serving/quality_gate.py): the same live (signature, bucket)
+        enumeration as :meth:`warm_requests` but with DETERMINISTIC non-zero
+        feature values — a zeros batch scores intercepts only and would wave
+        through a candidate whose coefficient tables are garbage. Values are
+        seeded per (signature, bucket), so the f32 reference and the reduced
+        candidate score byte-identical inputs."""
+        with self._cv:
+            shapes = [
+                (dataclasses.replace(s, buckets=set(s.buckets)))
+                for s in self._live_shapes.values()
+            ]
+        out = []
+        for si, shape in enumerate(shapes):
+            for bucket in sorted(shape.buckets):
+                out.append(
+                    (
+                        shape.kind,
+                        shape.include_offsets,
+                        self._synthesize(shape, bucket, fill_seed=si * 1009 + bucket),
+                    )
+                )
+        return out
+
     @staticmethod
-    def _synthesize(shape: _LiveShape, n: int) -> GameInput:
+    def _synthesize(
+        shape: _LiveShape, n: int, fill_seed: Optional[int] = None
+    ) -> GameInput:
+        # fill_seed None -> zeros (warm-up: values are irrelevant to compile);
+        # an int -> deterministic standard-normal fills (the quality gate's
+        # mirror batch, which must actually exercise the coefficient tables)
+        rng = None if fill_seed is None else np.random.default_rng(fill_seed)
         feats = {}
         for name, entry in shape.shards:
             if entry[0] == "dense":
                 _, n_cols, dt = entry
-                feats[name] = np.zeros((n, n_cols), dtype=dt)
+                feats[name] = (
+                    np.zeros((n, n_cols), dtype=dt)
+                    if rng is None
+                    else rng.standard_normal((n, n_cols)).astype(dt)
+                )
             else:
                 _, n_cols, width, dt = entry
                 # row 0 carries m nnz with pow2pad(m) == the live width bucket
@@ -457,7 +492,11 @@ class ServingFrontend:
                 # that row had at most n_cols entries)
                 m = min(n_cols, width)
                 indices = np.arange(m, dtype=np.int32)
-                data = np.ones(m, dtype=dt)
+                data = (
+                    np.ones(m, dtype=dt)
+                    if rng is None
+                    else rng.standard_normal(m).astype(dt)
+                )
                 indptr = np.zeros(n + 1, dtype=np.int32)
                 indptr[1:] = m
                 feats[name] = sp.csr_matrix(

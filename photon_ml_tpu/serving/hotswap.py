@@ -60,6 +60,10 @@ from photon_ml_tpu.resilience import (
 )
 from photon_ml_tpu.serving.engine import evict_engine, get_engine
 from photon_ml_tpu.serving.frontend import ServingFrontend
+from photon_ml_tpu.serving.quality_gate import (
+    PrecisionDriftError,
+    check_precision_drift,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -137,7 +141,10 @@ class HotSwapManager:
         retry: Optional[Retry] = None,
         warmup_timeout: float = 300.0,
         durable_blacklist: bool = True,
+        precision_drift_tolerance: Optional[float] = None,
     ):
+        from photon_ml_tpu.serving.quality_gate import SERVE_PRECISION_DRIFT_TOL
+
         self.frontend = frontend
         self.checkpoint_root = checkpoint_root
         self.dtype = dtype
@@ -145,6 +152,14 @@ class HotSwapManager:
         self.retry = retry or _DEFAULT_RETRY
         self.warmup_timeout = warmup_timeout
         self.durable_blacklist = durable_blacklist
+        # reduced-precision deployments gate every flip on mirror-batch
+        # drift vs a throwaway f32 engine (serving/quality_gate.py); None
+        # takes the module default, and float("inf") effectively disables
+        self.precision_drift_tolerance = (
+            SERVE_PRECISION_DRIFT_TOL
+            if precision_drift_tolerance is None
+            else float(precision_drift_tolerance)
+        )
         self.bad_generations: set[int] = set()
         if durable_blacklist:
             self.bad_generations.update(load_generation_blacklist(checkpoint_root))
@@ -251,6 +266,28 @@ class HotSwapManager:
                     self._warm, engine, name=f"photon-swap-warmup-gen{gen_num}"
                 )
                 task.result(self.warmup_timeout)
+                if not engine.precision.is_reference:
+                    # reduced-precision quality gate: the candidate must
+                    # agree with a throwaway f32 engine over the held-out
+                    # mirror batch before it may take traffic. A typed
+                    # PrecisionDriftError refuses the flip — recorded as its
+                    # own incident here (check_once adds the generic
+                    # hotswap-rollback + blacklist on top).
+                    try:
+                        check_precision_drift(
+                            engine,
+                            self.frontend.mirror_requests(),
+                            self.precision_drift_tolerance,
+                        )
+                    except PrecisionDriftError as e:
+                        self.frontend.record_incident(
+                            kind="precision-drift",
+                            cause=str(e),
+                            action=f"refused flip to generation {gen_num}; "
+                            f"kept serving generation "
+                            f"{self.frontend.generation}",
+                        )
+                        raise
             faultpoint(FP_SWAP_FLIP)
         except BaseException:
             # the swap will not complete: drop the candidate engine from the
@@ -328,12 +365,20 @@ def serve_from_checkpoint(
     retry: Optional[Retry] = None,
     clock: Callable[[], float] = time.monotonic,
     durable_blacklist: bool = True,
+    precision: Optional[object] = None,
+    precision_drift_tolerance: Optional[float] = None,
 ) -> tuple[ServingFrontend, HotSwapManager]:
     """Bootstrap a frontend from the newest valid generation of a training
     run's checkpoint directory. Returns (frontend, manager); run the manager's
     ``check_once`` (or a :class:`GenerationWatcher`) to pick up later
     generations. ``durable_blacklist=False`` opts out of the store's shared
-    verdicts for BOTH the bootstrap pick and the manager's polls."""
+    verdicts for BOTH the bootstrap pick and the manager's polls.
+
+    ``precision`` (optimization/precision.py) serves the deployment at
+    reduced table storage; every later hot-swap then gates its flip on
+    mirror-batch drift vs an f32 reference (serving/quality_gate.py,
+    tolerance ``precision_drift_tolerance``). The bootstrap engine itself is
+    un-gated only because no live request shapes exist yet to mirror."""
     found = newest_valid_generation(
         checkpoint_root, dtype=dtype, respect_blacklist=durable_blacklist
     )
@@ -342,10 +387,13 @@ def serve_from_checkpoint(
             f"no valid checkpoint generation under {checkpoint_root!r}"
         )
     gen_num, state = found
-    engine = get_engine(model_from_state(state, prefer_best=prefer_best))
+    engine = get_engine(
+        model_from_state(state, prefer_best=prefer_best), precision=precision
+    )
     frontend = ServingFrontend(engine, config=config, generation=gen_num, clock=clock)
     manager = HotSwapManager(
         frontend, checkpoint_root, dtype=dtype, prefer_best=prefer_best,
         retry=retry, durable_blacklist=durable_blacklist,
+        precision_drift_tolerance=precision_drift_tolerance,
     )
     return frontend, manager

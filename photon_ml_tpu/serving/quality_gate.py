@@ -1,0 +1,125 @@
+"""Serving-side reduced-precision quality gate.
+
+A ``get_engine(precision=...)`` deployment stores its device coefficient
+tables reduced (optimization/precision.py) — a TOLERANCE-gated configuration
+by contract, never bitwise. Training enforces its half of that contract with
+the held-out log-loss gate in ``bench.py --host-loop``; this module is the
+SERVING half: before a reduced-precision engine is allowed to take traffic at
+install/hot-swap time, its scores on a held-out mirror batch are compared
+against a freshly built f32 reference engine over the SAME model bytes, and
+a drift past tolerance refuses the flip with a typed
+:class:`PrecisionDriftError` (the hot-swap manager converts it into a
+``precision-drift`` incident and rolls back — the frontend keeps serving the
+generation it had).
+
+Mechanics:
+
+- The mirror batch is :meth:`ServingFrontend.mirror_requests`: one request
+  per live (signature, batch-bucket), same shapes the warm-up compiles, but
+  filled with DETERMINISTIC non-zero features — a zeros batch would score
+  intercepts only and wave through a candidate whose coefficient tables are
+  garbage. An empty mirror (no live traffic yet, e.g. process bootstrap)
+  waves the gate: there is nothing representative to score, and the first
+  real requests are covered by the next swap's gate.
+- The f32 reference is built DIRECTLY (not through ``get_engine``) so the
+  probe never pollutes the LRU engine cache: it lives for the gate call and
+  its device tables are released with it. ``evict_engine`` drops cache keys
+  by model fingerprint across ALL precisions, so parking a probe engine in
+  the cache would make the rollback eviction's behavior depend on gate
+  history.
+- Drift is ``max |candidate - reference| / (1 + |reference|)`` over every
+  mirror request — scale-aware (raw scores are unbounded margins) without
+  going to zero on small outputs. The default tolerance leaves ~2.5x
+  headroom over bf16's worst-case relative step (2^-8) so an honest bf16
+  table passes while a wrong-bytes table cannot.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# max scale-aware score drift a reduced-precision engine may show against
+# the f32 reference before the flip is refused. bf16 storage carries a
+# 2^-8 ~ 3.9e-3 relative quantization step; honest tables land well inside
+# 1e-2 while a mis-sliced or stale table shows O(1) drift.
+SERVE_PRECISION_DRIFT_TOL = 1e-2
+
+
+class PrecisionDriftError(RuntimeError):
+    """Typed gate verdict: the reduced-precision candidate's mirror-batch
+    scores drifted past tolerance from the f32 reference. Deterministic for
+    fixed model bytes + policy, so the hot-swap manager blacklists the
+    generation for this process instead of retrying it every poll."""
+
+    def __init__(self, drift: float, tolerance: float, n_requests: int):
+        self.drift = float(drift)
+        self.tolerance = float(tolerance)
+        self.n_requests = int(n_requests)
+        super().__init__(
+            f"reduced-precision serving gate: max score drift {drift:.3e} "
+            f"exceeds tolerance {tolerance:.3e} over {n_requests} mirror "
+            "request(s) against the f32 reference engine"
+        )
+
+
+def precision_drift(candidate, reference, requests: Iterable) -> tuple[float, int]:
+    """Worst scale-aware drift of ``candidate`` vs ``reference`` over
+    ``requests`` (``(kind, include_offsets, GameInput)`` triples, the
+    ``warm_requests``/``mirror_requests`` shape). Returns ``(drift, n)``."""
+    worst = 0.0
+    n = 0
+    for kind, include_offsets, req in requests:
+        if kind == "predict":
+            a = candidate.predict(req)
+            b = reference.predict(req)
+        else:
+            a = candidate.score(req, include_offsets=include_offsets)
+            b = reference.score(req, include_offsets=include_offsets)
+        a = np.asarray(a, dtype=np.float64)
+        b = np.asarray(b, dtype=np.float64)
+        if a.size:
+            worst = max(worst, float(np.max(np.abs(a - b) / (1.0 + np.abs(b)))))
+        n += 1
+    return worst, n
+
+
+def check_precision_drift(
+    candidate,
+    requests: Iterable,
+    tolerance: float = SERVE_PRECISION_DRIFT_TOL,
+) -> Optional[float]:
+    """The gate: no-op (returns None) for reference-precision candidates and
+    for empty mirrors; otherwise measures the candidate against a throwaway
+    f32 engine over the same model and raises :class:`PrecisionDriftError`
+    past ``tolerance``. Returns the measured drift on pass."""
+    if candidate.precision.is_reference:
+        return None
+    requests = list(requests)
+    if not requests:
+        logger.info(
+            "reduced-precision serving gate: no live mirror requests yet; "
+            "waving the candidate through (nothing representative to score)"
+        )
+        return None
+    from photon_ml_tpu.serving.engine import GameServingEngine
+
+    reference = GameServingEngine(
+        candidate.model,
+        mesh=candidate.mesh,
+        min_batch_pad=candidate.min_batch_pad,
+        fingerprint=candidate.fingerprint,
+        precision=None,
+    )
+    drift, n = precision_drift(candidate, reference, requests)
+    if drift > tolerance:
+        raise PrecisionDriftError(drift, tolerance, n)
+    logger.info(
+        "reduced-precision serving gate passed: max drift %.3e <= %.3e "
+        "over %d mirror request(s)", drift, tolerance, n,
+    )
+    return drift

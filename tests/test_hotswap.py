@@ -420,3 +420,109 @@ def test_model_from_state_prefers_best(tmp_path, rng):
         np.asarray(fallback.models["fixed"].model.coefficients.means),
         np.asarray(current["fixed"].model.coefficients.means, dtype=np.float32),
     )
+
+
+# ------------------------------------------- reduced-precision quality gate
+
+
+def test_bf16_swap_gate_passes_honest_tables(tmp_path, rng):
+    """A bf16 deployment's hot-swap scores the held-out mirror batch against
+    a throwaway f32 engine and flips when the drift is inside tolerance —
+    the happy path stays a plain swap, still served at bf16."""
+    root, fe, mgr = serve(
+        tmp_path, rng, precision="bf16", precision_drift_tolerance=5e-2
+    )
+    try:
+        fe.score(make_req(rng), timeout=30)  # record a live shape to mirror
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        assert mgr.check_once() is True
+        assert fe.generation == 2
+        assert not fe.engine.precision.is_reference
+        assert not any(i.kind == "precision-drift" for i in fe.incidents)
+    finally:
+        fe.close()
+
+
+def test_bf16_swap_gate_refuses_drift_with_typed_incident(tmp_path, rng):
+    """Past tolerance the flip is REFUSED: the frontend keeps serving its
+    generation, a typed precision-drift incident lands next to the generic
+    hotswap-rollback, and the generation is blacklisted for this process
+    (the verdict is deterministic for fixed bytes + policy)."""
+    from photon_ml_tpu.serving.quality_gate import PrecisionDriftError
+
+    # tolerance 0: ANY bf16-vs-f32 difference on the non-zero mirror refuses
+    root, fe, mgr = serve(
+        tmp_path, rng, precision="bf16", precision_drift_tolerance=0.0
+    )
+    try:
+        fe.score(make_req(rng), timeout=30)
+        eng1 = fe.engine
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        assert mgr.check_once() is False
+        assert fe.generation == 1 and fe.engine is eng1  # never flipped
+        kinds = [i.kind for i in fe.incidents]
+        assert "precision-drift" in kinds and "hotswap-rollback" in kinds
+        drift_inc = next(i for i in fe.incidents if i.kind == "precision-drift")
+        assert PrecisionDriftError.__name__ not in drift_inc.kind  # typed via kind
+        assert "drift" in drift_inc.cause
+        assert 2 in mgr.bad_generations  # no retry storm against the same bytes
+        assert mgr.check_once() is False  # stays refused
+    finally:
+        fe.close()
+
+
+def test_f32_swap_never_builds_gate(tmp_path, rng):
+    """The reference deployment is exempt by construction: even a zero
+    tolerance cannot refuse an f32->f32 swap (the gate only exists for
+    reduced-precision candidates)."""
+    root, fe, mgr = serve(tmp_path, rng, precision_drift_tolerance=0.0)
+    try:
+        fe.score(make_req(rng), timeout=30)
+        save_checkpoint(root, build_models(rng, 2.0), 2, keep_generations=8)
+        assert mgr.check_once() is True
+        assert fe.generation == 2
+        assert not any(i.kind == "precision-drift" for i in fe.incidents)
+    finally:
+        fe.close()
+
+
+def test_mirror_requests_are_nonzero_and_shape_matched(tmp_path, rng):
+    """The gate's probe batch must exercise the coefficient tables: same
+    (kind, bucket) enumeration as warm_requests, but deterministic non-zero
+    features (a zeros mirror scores intercepts only and gates nothing)."""
+    root, fe, mgr = serve(tmp_path, rng)
+    try:
+        fe.score(make_req(rng, 9), timeout=30)
+        warm = fe.warm_requests()
+        mirror = fe.mirror_requests()
+        assert len(mirror) == len(warm) >= 1
+        for (wk, wo, wreq), (mk, mo, mreq) in zip(warm, mirror):
+            assert (wk, wo) == (mk, mo)
+            for name, feat in mreq.features.items():
+                wfeat = wreq.features[name]
+                dense_m = feat.toarray() if sp.issparse(feat) else np.asarray(feat)
+                dense_w = wfeat.toarray() if sp.issparse(wfeat) else np.asarray(wfeat)
+                assert dense_m.shape == dense_w.shape
+                assert np.any(dense_m != 0.0)
+        # deterministic: a second snapshot mirrors byte-identically
+        again = fe.mirror_requests()
+        for (_, _, a), (_, _, b) in zip(mirror, again):
+            for name in a.features:
+                fa, fb = a.features[name], b.features[name]
+                da = fa.toarray() if sp.issparse(fa) else np.asarray(fa)
+                db = fb.toarray() if sp.issparse(fb) else np.asarray(fb)
+                np.testing.assert_array_equal(da, db)
+    finally:
+        fe.close()
+
+
+def test_gate_waves_through_empty_mirror(rng):
+    """No live shapes (bootstrap) -> nothing representative to score: the
+    gate returns None instead of inventing a verdict."""
+    from photon_ml_tpu.serving.quality_gate import check_precision_drift
+
+    eng = get_engine(
+        model_from_state({"models": build_models(rng, 1.0)}, prefer_best=False),
+        precision="bf16",
+    )
+    assert check_precision_drift(eng, [], tolerance=0.0) is None

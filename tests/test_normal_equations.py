@@ -405,3 +405,164 @@ def test_active_set_delta_inherits_direct_solver():
     np.testing.assert_array_equal(
         np.asarray(partial.coeffs)[~mask], np.asarray(warm.coeffs)[~mask]
     )
+
+
+# ----------------------------------------------------------- measured auto
+
+
+def _auto_coordinate(re_solver="auto", seed=5, **kw):
+    X, ents, labels, _ = make_problem(seed=seed)
+    ds = build_random_effect_dataset(
+        X, ents, "e", labels=labels[TaskType.LOGISTIC_REGRESSION]
+    )
+    return RandomEffectCoordinate(
+        coordinate_id="re",
+        dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION,
+        configuration=l2_config(),
+        base_offsets=jnp.zeros(N, dtype=ds.sample_vals.dtype),
+        re_solver=re_solver,
+        **kw,
+    )
+
+
+def _one_update(coord):
+    model = coord.initialize_model()
+    score = coord.score(model)
+    zeros = jnp.zeros(coord.dataset.n_samples, dtype=coord.dataset.sample_vals.dtype)
+    return coord.update_and_score(model, zeros, score)
+
+
+def test_measured_auto_records_per_bucket_iteration_counts():
+    """re_solver='auto' on the coordinate MEASURES: the first update probes
+    both solvers per bucket shape and records each one's iteration count;
+    the recorded choice follows the measurement (fewer direct iterations
+    with clean convergence -> direct), not a static K threshold."""
+    coord = _auto_coordinate()
+    assert coord.re_solver_stats() is None  # nothing measured yet
+    _one_update(coord)
+    stats = coord.re_solver_stats()
+    assert stats and stats["per_shape"], stats
+    for shape, rec in stats["per_shape"].items():
+        assert set(rec) == {"choice", "lbfgs_iters", "direct_iters", "direct_clean"}
+        expect = (
+            "direct"
+            if rec["direct_clean"] and rec["direct_iters"] <= rec["lbfgs_iters"]
+            else "lbfgs"
+        )
+        assert rec["choice"] == expect, (shape, rec)
+
+
+def test_measured_auto_seeded_decision_is_honored_bitwise():
+    """A seeded decision REPLACES measurement: force-seeding an all-lbfgs
+    record makes the auto coordinate bitwise-identical to an explicit
+    lbfgs coordinate — proof a restored run replays recorded choices
+    instead of re-probing (a re-probe against warm tables could flip)."""
+    probe = _auto_coordinate()
+    _one_update(probe)
+    stats = probe.re_solver_stats()
+    assert any(r["choice"] == "direct" for r in stats["per_shape"].values())
+    forced = {
+        "per_shape": {k: dict(v, choice="lbfgs") for k, v in stats["per_shape"].items()}
+    }
+    seeded = _auto_coordinate()
+    seeded.seed_solver_decision(forced)
+    m_seeded, s_seeded, _ = _one_update(seeded)
+    ref = _auto_coordinate(re_solver="lbfgs")
+    m_ref, s_ref, _ = _one_update(ref)
+    np.testing.assert_array_equal(np.asarray(m_seeded.coeffs), np.asarray(m_ref.coeffs))
+    np.testing.assert_array_equal(np.asarray(s_seeded), np.asarray(s_ref))
+
+
+def test_measured_auto_decision_roundtrips_checkpoint_extra_state():
+    """The measured record rides the checkpoint manifest's fingerprint-
+    ADJACENT extra_state and a resumed descent seeds its coordinates from
+    it. The resumed run honors the STORED record even when it disagrees
+    with what a fresh probe would measure (the stored extra is rewritten
+    to all-lbfgs between the runs)."""
+    import glob
+    import json
+    import os
+    import tempfile
+
+    from photon_ml_tpu.io.checkpoint import CoordinateDescentCheckpointer
+
+    ckdir = os.path.join(tempfile.mkdtemp(), "ck")
+    cp = CoordinateDescentCheckpointer(ckdir, interval=1, fingerprint="fp")
+    run_coordinate_descent({"re": _auto_coordinate()}, n_iterations=1, checkpointer=cp)
+    manifests = sorted(glob.glob(os.path.join(ckdir, "gen-*", "state.json")))
+    assert manifests
+    state = json.loads(open(manifests[-1]).read())
+    rec = state["extra"]["re_solver_auto"]["re"]
+    assert rec["per_shape"]
+    # rewrite the stored decision (and its integrity sidecar) to all-lbfgs
+    import hashlib
+
+    state["extra"]["re_solver_auto"]["re"] = {
+        "per_shape": {k: dict(v, choice="lbfgs") for k, v in rec["per_shape"].items()}
+    }
+    blob = json.dumps(state, indent=2, sort_keys=True)
+    with open(manifests[-1], "w") as f:
+        f.write(blob)
+    with open(manifests[-1] + ".sha256", "w") as f:
+        f.write(hashlib.sha256(blob.encode()).hexdigest())
+    resumed = _auto_coordinate()
+    cp2 = CoordinateDescentCheckpointer(ckdir, interval=1, fingerprint="fp")
+    run_coordinate_descent({"re": resumed}, n_iterations=2, checkpointer=cp2)
+    stats = resumed.re_solver_stats()
+    assert all(r["choice"] == "lbfgs" for r in stats["per_shape"].values()), stats
+
+
+def test_measured_auto_l1_measures_nothing_and_stays_lbfgs():
+    """L1 configurations have nothing to measure (the normal equations
+    cannot express the subgradient): the record is empty and every bucket
+    resolves to the configured optimizer, bitwise."""
+    X, ents, labels, _ = make_problem(seed=2)
+    y = labels[TaskType.LOGISTIC_REGRESSION]
+    l1_cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(optimizer_type="OWLQN", max_iterations=40),
+        regularization_context=RegularizationContext(RegularizationType.L1),
+        regularization_weight=0.1,
+    )
+
+    def build(solver):
+        ds = build_random_effect_dataset(X, ents, "e", labels=y)
+        return RandomEffectCoordinate(
+            coordinate_id="re",
+            dataset=ds,
+            task=TaskType.LOGISTIC_REGRESSION,
+            configuration=l1_cfg,
+            base_offsets=jnp.zeros(N, dtype=ds.sample_vals.dtype),
+            re_solver=solver,
+        )
+
+    auto = build("auto")
+    m_a, s_a, _ = _one_update(auto)
+    assert auto.re_solver_stats() == {"per_shape": {}}
+    m_l, s_l, _ = _one_update(build("lbfgs"))
+    np.testing.assert_array_equal(np.asarray(m_a.coeffs), np.asarray(m_l.coeffs))
+
+
+def test_bucket_solver_plan_validates_length():
+    from photon_ml_tpu.algorithm.random_effect import _bucket_solver_plan
+
+    assert _bucket_solver_plan("lbfgs", 3) == ("lbfgs",) * 3
+    assert _bucket_solver_plan(("direct", "lbfgs"), 2) == ("direct", "lbfgs")
+    with pytest.raises(ValueError, match="covers 2 buckets"):
+        _bucket_solver_plan(("direct", "lbfgs"), 3)
+
+
+def test_measured_auto_per_bucket_plan_reaches_update_program():
+    """A mixed per-bucket tuple plan is honored by the fused update
+    program: pinning each bucket to its measured choice reproduces the
+    auto coordinate's update bitwise."""
+    coord = _auto_coordinate()
+    m_auto, s_auto, _ = _one_update(coord)
+    plan = coord._solver_plan()
+    assert isinstance(plan, tuple) and set(plan) <= {"lbfgs", "direct"}
+    pinned = _auto_coordinate(re_solver="lbfgs")  # placeholder, plan seeded below
+    pinned.seed_solver_decision(coord.re_solver_stats())
+    pinned.re_solver = "auto"
+    m_pin, s_pin, _ = _one_update(pinned)
+    np.testing.assert_array_equal(np.asarray(m_pin.coeffs), np.asarray(m_auto.coeffs))
+    np.testing.assert_array_equal(np.asarray(s_pin), np.asarray(s_auto))

@@ -34,10 +34,13 @@ One-command regenerate workflow (after a deliberate program change)::
 
 Program families audited (same smoke shapes as the tier-1 suites, so the
 persistent XLA cache makes repeat runs cheap): the mesh-sharded random-effect
-coordinate update (``RandomEffectCoordinate.compiled_update_hlo``), the fused
-population/game step (``parallel.make_jitted_game_step``), the one-program
-population sweep (``PopulationTrainer.lower_fused_sweep`` on a settings
-mesh), and the serving engine's fused program at its two static buckets.
+coordinate update (``RandomEffectCoordinate.compiled_update_hlo``), the
+streamed working-set chunk update (``solver_cache.re_chunk_update_program``
+lowered on a real staged chunk — its donated init/score-partial pair is the
+two-tables-in-flight memory contract), the fused population/game step
+(``parallel.make_jitted_game_step``), the one-program population sweep
+(``PopulationTrainer.lower_fused_sweep`` on a settings mesh), and the
+serving engine's fused program at its two static buckets.
 
 jax is imported lazily INSIDE the builders: importing this module stays
 cheap and env setup (8 emulated CPU devices, x64) can happen first.
@@ -266,6 +269,63 @@ def build_re_update() -> str:
     return coord.compiled_update_hlo()
 
 
+def build_re_chunk_update() -> str:
+    """Streamed working-set chunk update (the per-chunk program
+    ``_update_and_score_streamed`` dispatches) lowered on a REAL staged cold
+    chunk at the tests/test_working_set.py skewed smoke shape (N=420, 20
+    entities, budget 17). The donated pair — the chunk's init rows (arg0)
+    and the running score partial (arg1) — IS the at-most-two-chunk-tables
+    device-memory contract; dropping either silently doubles the streamed
+    footprint."""
+    import numpy as np
+    import scipy.sparse as sp
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.algorithm import RandomEffectCoordinate
+    from photon_ml_tpu.data.random_effect import build_random_effect_dataset
+    from photon_ml_tpu.optimization.solver_cache import re_chunk_update_program
+    from photon_ml_tpu.types import TaskType, VarianceComputationType
+
+    rng = np.random.default_rng(0)
+    n, n_users = 420, 20
+    X = rng.normal(size=(n, 3))
+    shares = np.repeat(np.arange(n_users), np.arange(1, n_users + 1))
+    users = shares[np.arange(n) % len(shares)]
+    w = rng.normal(size=3)
+    y = (X @ w + 0.7 * rng.normal(size=n_users)[users] > 0).astype(np.float64)
+    re_dense = np.concatenate([np.ones((n, 1)), 2.0 * X[:, :2] + 0.5], axis=1)
+    ds = build_random_effect_dataset(
+        sp.csr_matrix(re_dense), users, "userId",
+        feature_shard_id="per-user", labels=y,
+    )
+    coord = RandomEffectCoordinate(
+        coordinate_id="per-user", dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION, configuration=_glm_config(),
+        base_offsets=jnp.zeros(n, dtype=ds.sample_vals.dtype),
+        working_set_rows=17,
+    )
+    ws = coord._working_set()
+    if ws is None:
+        raise RuntimeError("working set demoted at the audit smoke shape")
+    chunk = next(c for c in ws.chunks if not c.hot)
+    staged, _, _ = ws._stage(chunk)
+    init = ws._stage_init(chunk)
+    program = re_chunk_update_program(
+        coord.task,
+        coord.configuration.optimizer_config,
+        bool(coord.configuration.l1_weight),
+        VarianceComputationType(coord.variance_computation),
+        ds.max_k,
+        "lbfgs",
+    )
+    score0 = jnp.zeros((ds.n_samples,), dtype=ds.sample_vals.dtype)
+    return program.lower(
+        init, score0, *staged["data"], staged["l2"], coord._ws_l1,
+        staged["norm"], coord.base_offsets, ds.sample_local_cols,
+        ds.sample_vals,
+    ).compile().as_text()
+
+
 def build_population_update() -> str:
     """Fused population/game step (one jitted program per descent pass) on an
     8-device mesh at a reduced smoke shape — the donated params carrier."""
@@ -446,6 +506,7 @@ def build_serving_per_coordinate() -> str:
 
 PROGRAM_BUILDERS = {
     "re_update": build_re_update,
+    "re_chunk_update": build_re_chunk_update,
     "population_update": build_population_update,
     "fused_sweep": build_fused_sweep,
     "serving_score": build_serving_score,
