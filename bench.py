@@ -892,6 +892,13 @@ def main():
         # the native sequential baseline, per-family winner-serves gates)
         _delegate_benchmark("--sweep", "sweep_bench")
 
+    if "--wide-fe" in sys.argv:
+        # wide fixed-effect training: sparse-aware fused FE update at
+        # k-scale x the feature count at fixed nnz/row vs the dense column
+        # (bitwise sparse-vs-dense parity, zero-retrace, throughput-holds
+        # and 2-D feature-axis collective-profile gates)
+        _delegate_benchmark("--wide-fe", "wide_fe_bench")
+
     if "--working-set" in sys.argv:
         # hierarchical entity-table training: streamed working-set CD pass vs
         # all-resident across an oversubscription ladder (bitwise-parity,

@@ -1,0 +1,465 @@
+"""Wide fixed-effect benchmark: CD-pass throughput as the feature axis grows.
+
+Metric: ``glmix_wide_fe_cd_pass_samples_per_sec`` — samples x passes /
+wall-clock through ``FixedEffectCoordinate.update_and_score`` (the fused
+fixed-effect coordinate-update program, optimization/solver_cache.
+fe_coordinate_update_program) with SPARSE (padded-COO) feature storage at
+K = ``--k-scale`` x the base feature count, at FIXED nnz/row. The regime
+under test is the reference's billion-feature story (PalDBIndexMap.scala:
+43-278): the feature space grows 100x but each sample still touches a
+handful of features, so a storage-aware kernel's per-pass cost follows nnz,
+not N x K — while the dense kernels it replaces scale with K. The dense
+lanes at both shapes are measured and reported as the comparison column
+(the crossover table in docs/PERFORMANCE.md "The feature axis").
+
+Gates (exit nonzero on failure; per docs/PERFORMANCE.md honest-measurement
+rules):
+
+- ``parity_bitwise`` — at the small-K shape (where BOTH storage classes
+  fit comfortably), each storage class's fused-program lane must produce
+  bitwise-equal coefficients AND training scores vs the legacy
+  ``update_model`` host path after the identical pass sequence: the new
+  fused ``fe_coordinate_update_program`` and its storage-class dispatch
+  are an execution-strategy change, never a numerics change;
+- ``storage_parity`` — sparse vs dense lanes at the same both-fit shape.
+  The sparse kernels accumulate in exact IEEE entry order (bitwise equal
+  to a sequential host reference — tests/test_sparse_matrix_contract.py),
+  but XLA's dense dot-general/reduce lowerings contract with FMA and
+  vectorized partial sums (probe: ``X @ w`` differs from the sequential
+  sum at the last bit on ~10%% of rows at EVERY both-fit shape on
+  XLA:CPU), so CROSS-STORAGE bitwise equality cannot hold against a
+  reordering dense lowering. The bench probes the live backend
+  (``dense_lowering_order_exact``): where the dense matvec/rmatvec match
+  entry-order accumulation bitwise, the storage gate escalates to
+  bitwise; elsewhere it gates at few-ulp (both lanes converge the same
+  strictly convex objective under the same tolerance) and reports the
+  measured max diffs — tolerance tiers per docs/PERFORMANCE.md
+  honest-measurement rules, same pattern as working_set_bench's
+  ``variance_parity``;
+- ``retraces_after_warmup == 0`` — every timed pass must hit the compiled
+  update program (``runtime_guard.no_retrace`` counters): storage-class
+  dispatch rides the LabeledData pytree structure in the jit cache key,
+  so lane rotation must not retrace;
+- ``wide_vs_small >= --min-wide-ratio`` — sparse throughput at K-scaled
+  (default 100x) K must hold at least this fraction (default 0.5) of the
+  small-K sparse throughput. This is the "holds throughput as K grows
+  100x" claim: nnz is constant across the ladder, so a storage-aware
+  pass should be near-flat while the dense column falls ~K-fold;
+- ``collective_profile_ok`` (with ``--mesh-devices M``) — the 2-D
+  (data x model) feature-sharded lowering of the SAME update program is
+  audited by ``hlo_guards.assert_feature_axis_profile``: only all-reduce /
+  all-gather, every payload bounded by max([D], [N]), and the solver loop's
+  payload-bearing collectives bounded (the per-iteration margin/gradient
+  exchange — 1411.6520's one legal data collective per half-iteration —
+  plus the sparse path's coefficient rebuild gathers). A real
+  ``update_and_score`` then executes on the mesh and must pass its guard.
+
+Run directly (``python benchmarks/wide_fe_bench.py``) or as
+``python bench.py --wide-fe``. Flags: ``--passes P`` (default 2),
+``--reps R`` (default 2), ``--samples N`` / ``--features K0`` /
+``--k-scale S`` / ``--nnz-per-row Z`` (default 4096 / 48 / 100 / 8),
+``--min-wide-ratio``, ``--mesh-devices M`` (emulated-OK 2-D step),
+``--skip-wide-dense`` (skip the [N, S*K0] dense lane where it would not
+fit). Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import scipy.sparse as sp
+
+# runnable as a bare script (python benchmarks/wide_fe_bench.py): python puts
+# benchmarks/ on sys.path, not the repo root the package imports need
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+N_SAMPLES = 4_096
+K_BASE = 48
+K_SCALE = 100
+NNZ_PER_ROW = 8
+FE_ITERS = 30
+FE_TOL = 1e-10
+
+
+def _ensure_devices(m: int) -> bool:
+    """Best-effort: M visible devices for the 2-D mesh step. Must run before
+    jax initializes — emulated CPU devices only exist if XLA_FLAGS carries
+    the host-platform count at backend init (tools/program_audit._setup_env
+    uses the same mechanism)."""
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={m}"
+            ).strip()
+    import jax
+
+    return len(jax.devices()) >= m
+
+
+def build_workload(n: int, k: int, nnz_row: int):
+    """Fixed-nnz/row sparse logistic workload. Column draws may collide
+    within a row (duplicates SUM under scipy's COO->CSR conversion, matching
+    SparseDesignMatrix's accumulation contract), so nnz/row is an upper
+    bound with collision probability ~ Z^2/2K — negligible at wide K, which
+    is the regime under test."""
+    rng = np.random.default_rng(42)
+    rows = np.repeat(np.arange(n), nnz_row)
+    cols = rng.integers(0, k, size=n * nnz_row)
+    vals = rng.normal(size=n * nnz_row)
+    X = sp.csr_matrix((vals, (rows, cols)), shape=(n, k))
+    X.sum_duplicates()
+    w = np.zeros(k)
+    hot = rng.choice(k, size=min(k, 64), replace=False)
+    w[hot] = rng.normal(size=hot.size) * 0.5
+    z = np.asarray(X @ w)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float64)
+    return X, y
+
+
+def build_coordinate(X, y, storage: str, dtype):
+    """One FixedEffectCoordinate over the given storage class, with the
+    fused update program forced ON (single-device auto only engages it for
+    feature-sharded datasets)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.algorithm.coordinate import FixedEffectCoordinate
+    from photon_ml_tpu.data.dataset import FixedEffectDataset, LabeledData
+    from photon_ml_tpu.data.matrix import SparseDesignMatrix
+    from photon_ml_tpu.optimization.common import OptimizerConfig
+    from photon_ml_tpu.optimization.config import (
+        GLMOptimizationConfiguration,
+        RegularizationContext,
+    )
+    from photon_ml_tpu.types import OptimizerType, RegularizationType, TaskType
+
+    if storage == "sparse":
+        mat = SparseDesignMatrix.from_scipy(X, dtype=dtype)
+    else:
+        mat = X.toarray()
+    data = LabeledData.build(mat, y, dtype=dtype)
+    cfg = GLMOptimizationConfiguration(
+        optimizer_config=OptimizerConfig(
+            optimizer_type=OptimizerType.LBFGS,
+            tolerance=FE_TOL,
+            max_iterations=FE_ITERS,
+        ),
+        regularization_context=RegularizationContext(RegularizationType.L2),
+        regularization_weight=1.0,
+    )
+    return FixedEffectCoordinate(
+        coordinate_id="fe",
+        dataset=FixedEffectDataset(data=data),
+        task=TaskType.LOGISTIC_REGRESSION,
+        configuration=cfg,
+        use_update_program=True,
+    )
+
+
+class _Lane:
+    """One (storage, K)-shape's live training chain: model/score carried
+    across interleaved reps exactly like a real descent run warm-starts
+    passes, so dense and sparse lanes at the same K execute the identical
+    pass sequence (the bitwise contract compares their end states)."""
+
+    def __init__(self, name, coord):
+        import jax.numpy as jnp
+
+        self.name = name
+        self.coord = coord
+        self.model = coord.initialize_model()
+        self.score = coord.score(self.model)
+        self.partial = jnp.zeros(coord.dataset.n, self.score.dtype)
+        self.elapsed = float("inf")
+        self.retraces = 0
+        self.iterations = 0
+
+    def run_passes(self, passes: int) -> None:
+        for _ in range(passes):
+            self.model, self.score, tracker = self.coord.update_and_score(
+                self.model, self.partial, self.score, donate=True
+            )
+        self.tracker = tracker
+
+    def state(self):
+        import jax
+
+        return [
+            np.asarray(jax.device_get(self.model.model.coefficients.means)),
+            np.asarray(jax.device_get(self.score)),
+        ]
+
+
+def run_mesh_step(n: int, k: int, nnz_row: int, mesh_devices: int, dtype) -> dict:
+    """The 2-D feature-sharded step: audit the compiled update program's
+    collectives against the feature-axis profile and execute one real
+    sharded update for each storage class."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.dataset import FixedEffectDataset, LabeledData
+    from photon_ml_tpu.parallel.feature_sharded import make_mesh2
+    from photon_ml_tpu.parallel.hlo_guards import assert_feature_axis_profile
+    from photon_ml_tpu.parallel.placement import place_fixed_effect_dataset
+
+    X, y = build_workload(n, k, nnz_row)
+    mesh = make_mesh2(mesh_devices // 2, 2)
+    out = {"mesh_shape": dict(zip(mesh.axis_names, mesh.devices.shape))}
+    ok = True
+    for storage in ("dense", "sparse"):
+        coord = build_coordinate(X, y, storage, dtype)
+        ds = place_fixed_effect_dataset(coord.dataset, mesh)
+        coord = type(coord)(
+            coordinate_id="fe",
+            dataset=ds,
+            task=coord.task,
+            configuration=coord.configuration,
+        )
+        entry = {}
+        try:
+            profile = assert_feature_axis_profile(
+                coord.compiled_update_hlo(),
+                grad_elements=ds.dim,
+                n_samples=ds.n,
+            )
+            entry.update(profile)
+        except AssertionError as e:
+            entry["profile_violation"] = str(e)[:300]
+            ok = False
+        zeros = jnp.zeros((ds.n,), ds.data.labels.dtype)
+        model0 = coord.initialize_model()
+        res = coord.update_and_score(model0, zeros, coord.score(model0))
+        assert res is not None, "2-D placement must engage the update program"
+        _, _, tracker = res
+        entry["guard_ok"] = bool(jax.device_get(tracker.guard_ok))  # jaxlint: disable=HS001 once-per-storage boundary read outside any timed region, the verdict IS the product
+        ok = ok and entry["guard_ok"]
+        out[storage] = entry
+    out["collective_profile_ok"] = bool(ok)
+    return out
+
+
+def run(passes: int, reps: int, n: int, k0: int, k_scale: int, nnz_row: int,
+        min_wide_ratio: float, mesh_devices: int, skip_wide_dense: bool,
+        dtype_name: str) -> dict:
+    if mesh_devices:
+        if not _ensure_devices(mesh_devices):
+            print(
+                f"--mesh-devices {mesh_devices}: backend initialized with "
+                "fewer devices; set XLA_FLAGS before any jax import",
+                file=sys.stderr,
+            )
+    import jax
+
+    if dtype_name == "f64":
+        jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.analysis.runtime_guard import no_retrace
+
+    dtype = jnp.float64 if dtype_name == "f64" else jnp.float32
+    k1 = k0 * k_scale
+
+    small_X, small_y = build_workload(n, k0, nnz_row)
+    wide_X, wide_y = build_workload(n, k1, nnz_row)
+    lanes = [
+        _Lane("sparse_small", build_coordinate(small_X, small_y, "sparse", dtype)),
+        _Lane("dense_small", build_coordinate(small_X, small_y, "dense", dtype)),
+        _Lane("sparse_wide", build_coordinate(wide_X, wide_y, "sparse", dtype)),
+    ]
+    if not skip_wide_dense:
+        lanes.append(
+            _Lane("dense_wide", build_coordinate(wide_X, wide_y, "dense", dtype))
+        )
+
+    # warmup: one pass per lane compiles each (storage, shape) program
+    for lane in lanes:
+        lane.run_passes(1)
+        jax.block_until_ready(lane.score)
+
+    # interleaved best-of-k: every lane sees the same machine-noise profile.
+    # Counter-only retrace region (huge allowance): a retrace must FAIL THE
+    # GATE in the JSON line, not abort the bench with a traceback.
+    for _ in range(max(1, reps)):
+        for lane in lanes:
+            with no_retrace(allow_retraces=10**6,
+                            what=f"wide_fe_bench {lane.name}") as region:
+                t0 = time.perf_counter()
+                lane.run_passes(passes)
+                jax.block_until_ready(lane.score)
+                lane.elapsed = min(lane.elapsed, time.perf_counter() - t0)
+            lane.retraces += region.traces
+    # one batched boundary read after all timed reps: final counters only
+    iter_counts = jax.device_get([lane.tracker.iterations for lane in lanes])
+    for lane, iters in zip(lanes, iter_counts):
+        lane.iterations = int(iters)
+
+    # --- gates ---------------------------------------------------------------
+    import dataclasses as dc
+
+    by_name = {lane.name: lane for lane in lanes}
+    sparse_small, dense_small = by_name["sparse_small"], by_name["dense_small"]
+    ss, ds_ = sparse_small.state(), dense_small.state()
+    total_passes = 1 + max(1, reps) * passes
+
+    def legacy_state(storage):
+        # the pre-existing unfused host path, identical pass sequence
+        coord = dc.replace(
+            build_coordinate(small_X, small_y, storage, dtype),
+            use_update_program=False,
+        )
+        model = coord.initialize_model()
+        zeros = jnp.zeros((coord.dataset.n,), dtype)
+        for _ in range(total_passes):
+            model, _ = coord.update_model(model, zeros)
+        return [
+            np.asarray(jax.device_get(model.model.coefficients.means)),
+            np.asarray(jax.device_get(coord.score(model))),
+        ]
+
+    def bitwise(a, b):
+        return (
+            a[0].dtype == b[0].dtype and np.array_equal(a[0], b[0])
+            and a[1].dtype == b[1].dtype and np.array_equal(a[1], b[1])
+        )
+
+    # fused program vs legacy path, per storage class: the new machinery's
+    # bitwise contract
+    parity = bitwise(ss, legacy_state("sparse")) and bitwise(
+        ds_, legacy_state("dense")
+    )
+
+    # cross-storage parity: bitwise where the backend's dense lowering is
+    # order-exact (probed live), few-ulp otherwise (module docstring)
+    from photon_ml_tpu.data.matrix import SparseDesignMatrix
+
+    probe_sm = SparseDesignMatrix.from_scipy(small_X, dtype=dtype)
+    probe_D = jnp.asarray(small_X.toarray(), dtype)
+    probe_w = jnp.asarray(np.random.default_rng(3).normal(size=k0), dtype)
+    order_exact = bool(
+        np.array_equal(
+            np.asarray(probe_sm.matvec(probe_w)), np.asarray(probe_D @ probe_w)
+        )
+    )
+    storage_bitwise = bitwise(ss, ds_)
+    # both lanes satisfy the same gradient-norm stop (FE_TOL) on the same
+    # strictly convex (L2 weight 1.0) objective, so coefficient agreement is
+    # bounded by ~2*FE_TOL/mu — the gate allows 100x that, far below any
+    # storage-dispatch bug and far above last-bit lowering drift
+    tol = max(1e2 * FE_TOL, 1e2 * float(jnp.finfo(dtype).eps))
+    storage_close = bool(
+        np.allclose(ss[0], ds_[0], rtol=tol, atol=tol)
+        and np.allclose(ss[1], ds_[1], rtol=tol, atol=tol)
+    )
+    storage_ok = storage_bitwise if order_exact else storage_close
+    storage_parity = {
+        "dense_lowering_order_exact": order_exact,
+        "bitwise": storage_bitwise,
+        "tier": "bitwise" if order_exact else "ulp",
+        "max_coef_diff": float(np.abs(ss[0] - ds_[0]).max()),
+        "max_score_diff": float(np.abs(ss[1] - ds_[1]).max()),
+        "gate": bool(storage_ok),
+    }
+
+    retraces = sum(lane.retraces for lane in lanes)
+    report = {
+        lane.name: {
+            "samples_per_sec": round(n * passes / lane.elapsed, 2),
+            "solver_iterations_last_pass": lane.iterations,
+            "retraces_after_warmup": int(lane.retraces),
+        }
+        for lane in lanes
+    }
+    tp = {name: entry["samples_per_sec"] for name, entry in report.items()}
+    wide_ratio = tp["sparse_wide"] / tp["sparse_small"]
+    ratio_ok = wide_ratio >= min_wide_ratio
+    # the dense comparison column: how far the dense kernels fall over the
+    # same K growth (crossover table, docs/PERFORMANCE.md)
+    if "dense_wide" in tp:
+        report["dense_wide_vs_small"] = round(tp["dense_wide"] / tp["dense_small"], 4)
+        report["sparse_vs_dense_at_wide"] = round(
+            tp["sparse_wide"] / tp["dense_wide"], 4
+        )
+    report["sparse_vs_dense_at_small"] = round(
+        tp["sparse_small"] / tp["dense_small"], 4
+    )
+
+    mesh_step = None
+    mesh_ok = True
+    if mesh_devices:
+        mesh_step = run_mesh_step(
+            min(n, 512), min(k1, 4 * k0), nnz_row, mesh_devices, dtype
+        )
+        mesh_ok = mesh_step["collective_profile_ok"]
+
+    gates_ok = parity and storage_ok and retraces == 0 and ratio_ok and mesh_ok
+    result = {
+        "metric": "glmix_wide_fe_cd_pass_samples_per_sec",
+        "value": tp["sparse_wide"],
+        "unit": "samples/sec",
+        "k_small": k0,
+        "k_wide": k1,
+        "nnz_per_row": nnz_row,
+        "dtype": dtype_name,
+        "wide_vs_small": round(wide_ratio, 4),
+        "min_wide_ratio": min_wide_ratio,
+        "wide_ratio_gate": bool(ratio_ok),
+        "parity_bitwise": bool(parity),
+        "storage_parity": storage_parity,
+        "retraces_after_warmup": int(retraces),
+        "lanes": report,
+        "passes": passes,
+        "reps": reps,
+        "n_samples": n,
+        "platform": jax.default_backend(),
+        "gates_ok": bool(gates_ok),
+    }
+    if mesh_step is not None:
+        result["mesh_step"] = mesh_step
+    return result
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--passes", type=int, default=2)
+    parser.add_argument("--reps", type=int, default=2)
+    parser.add_argument("--samples", type=int, default=N_SAMPLES)
+    parser.add_argument("--features", type=int, default=K_BASE)
+    parser.add_argument("--k-scale", type=int, default=K_SCALE)
+    parser.add_argument("--nnz-per-row", type=int, default=NNZ_PER_ROW)
+    parser.add_argument(
+        "--min-wide-ratio", type=float, default=0.5,
+        help="gate: sparse throughput at k-scale x K / small-K must be >= "
+        "this (the holds-throughput-as-K-grows claim; nnz is constant "
+        "across the ladder)",
+    )
+    parser.add_argument(
+        "--mesh-devices", type=int, default=0,
+        help="run the 2-D (data x model) feature-sharded step on this many "
+        "devices (emulated host devices are forced when the backend has "
+        "not initialized yet) and audit its collective profile",
+    )
+    parser.add_argument(
+        "--skip-wide-dense", action="store_true",
+        help="skip the dense [N, k_scale*K] comparison lane (the wide dense "
+        "placement may not fit where the sparse one trivially does — that "
+        "asymmetry is the point of the sparse path)",
+    )
+    parser.add_argument("--dtype", choices=("f32", "f64"), default="f64")
+    args = parser.parse_args(argv)
+
+    result = run(
+        args.passes, args.reps, args.samples, args.features, args.k_scale,
+        args.nnz_per_row, args.min_wide_ratio, args.mesh_devices,
+        args.skip_wide_dense, args.dtype,
+    )
+    print(json.dumps(result))
+    return 0 if result["gates_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

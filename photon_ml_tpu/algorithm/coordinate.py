@@ -50,15 +50,26 @@ class FixedEffectOptimizationTracker:
     convergence_reason: object  # str once materialized; device/int code before
     iterations: object
     final_value: object
+    # device bool on the fused update-program path (the descent loop's fused
+    # protocol reads it for the in-program divergence select); None on the
+    # update_model path, whose guard the loop computes itself
+    guard_ok: object = None
 
     def materialize(self) -> "FixedEffectOptimizationTracker":
         if not isinstance(self.convergence_reason, str):
-            reason_h, iters_h, value_h = jax.device_get(
-                (self.convergence_reason, self.iterations, self.final_value)
+            reason_h, iters_h, value_h, ok_h = jax.device_get(
+                (
+                    self.convergence_reason,
+                    self.iterations,
+                    self.final_value,
+                    self.guard_ok,
+                )
             )
             self.convergence_reason = ConvergenceReason(int(reason_h)).name
             self.iterations = int(iters_h)
             self.final_value = float(value_h)
+            if ok_h is not None:
+                self.guard_ok = bool(ok_h)
         return self
 
     def summary(self) -> str:
@@ -162,6 +173,16 @@ class FixedEffectCoordinate(Coordinate):
     # (lower[D], upper[D]) per-feature box bounds (constraint maps); enforced
     # natively by the optimizers (LBFGS projection / LBFGSB / TRON)
     box_constraints: Optional[tuple] = None
+    # Route updates through the single-program fused path (solver_cache.
+    # fe_coordinate_update_program): solve + [N] score + divergence select in
+    # ONE donated XLA dispatch per update. None = auto: on for feature-sharded
+    # datasets (coef_sharding stamped by the 2-D mesh backend — the fused
+    # program is what pins the donated P("model") coefficient state across
+    # iterations), off on host/1-D datasets (bitwise status quo: update_model
+    # + score). Explicit True/False overrides; True is rejected at
+    # construction when a knob the program cannot express is set
+    # (down-sampling, box constraints, variance computation).
+    use_update_program: object = None
 
     def __post_init__(self):
         self.task = TaskType(self.task)
@@ -172,6 +193,30 @@ class FixedEffectCoordinate(Coordinate):
             raise ValueError(
                 "Box constraints and normalization cannot be combined"
             )
+        if self.use_update_program:
+            blockers = [
+                name
+                for name, bad in (
+                    ("down_sampler", self.down_sampler is not None),
+                    ("box_constraints", self.box_constraints is not None),
+                    (
+                        "variance_computation",
+                        VarianceComputationType(self.variance_computation)
+                        != VarianceComputationType.NONE,
+                    ),
+                )
+                if bad
+            ]
+            if blockers:
+                raise ValueError(
+                    "use_update_program=True: the fused fixed-effect update "
+                    "program cannot express " + ", ".join(blockers)
+                    + "; leave use_update_program unset (auto) or False"
+                )
+        # donation ownership: the exact output buffers of our last update
+        # program call — only those are fed back donated (see
+        # RandomEffectCoordinate.__post_init__)
+        self._owned: dict = {}
         self._problem = GLMOptimizationProblem(
             task=self.task,
             configuration=self.configuration,
@@ -222,6 +267,164 @@ class FixedEffectCoordinate(Coordinate):
             FixedEffectModel(model=glm, feature_shard_id=self.dataset.feature_shard_id),
             tracker,
         )
+
+    def _update_program_enabled(self) -> bool:
+        if self.use_update_program is not None:
+            return bool(self.use_update_program)
+        # auto: the fused program is how feature-sharded (2-D mesh) datasets
+        # keep donated P("model") state across iterations; host datasets keep
+        # update_model + score (bitwise status quo). Knobs the program cannot
+        # express demote auto back to the generic path silently.
+        if getattr(self.dataset, "coef_sharding", None) is None:
+            return False
+        return (
+            self.down_sampler is None
+            and self.box_constraints is None
+            and VarianceComputationType(self.variance_computation)
+            == VarianceComputationType.NONE
+        )
+
+    def _resolve_update_program(self):
+        """``(program, shardings)`` — the cached fused update program at this
+        coordinate's static configuration and placement. The ONE owner of
+        program resolution: ``update_and_score`` dispatches it and
+        ``compiled_update_hlo`` lowers it, so the collective audit always
+        inspects exactly the program training runs."""
+        from photon_ml_tpu.optimization.solver_cache import (
+            fe_coordinate_update_program,
+        )
+
+        sharding = getattr(self.dataset, "coef_sharding", None)
+        shardings = None
+        allow_fused = True
+        if sharding is not None:
+            from photon_ml_tpu.parallel.feature_sharded import sample_sharding
+
+            # donated state keeps these across iterations: coefficients (and
+            # every [D] optimizer-state vector) P("model"), the [N] score
+            # P("data") — the explicit out-constraints in solver_cache pin
+            # them so no resharding ever lands between updates
+            shardings = (sharding, sample_sharding(sharding.mesh))
+            # GSPMD cannot partition an opaque pallas_call
+            allow_fused = False
+        program = fe_coordinate_update_program(
+            self.task,
+            self.configuration.optimizer_config,
+            bool(self.configuration.l1_weight),
+            shardings,
+            allow_fused,
+        )
+        return program, shardings
+
+    def update_and_score(
+        self,
+        initial_model: Optional[FixedEffectModel],
+        partial_scores: Array,
+        prev_score: Array,
+        donate: bool = False,
+    ):
+        """One donated XLA program per update (solver_cache.
+        fe_coordinate_update_program): the GLM solve, the original-space
+        conversion, this coordinate's [N] score and the divergence guard's
+        select — no host round trip between them. On a feature-sharded
+        dataset the same program compiles as ONE SPMD module over the 2-D
+        ("data", "model") mesh, dense or sparse (the design matrix's storage
+        class dispatches through the LabeledData pytree structure). Returns
+        None (update_model + score fallback) when the program path is off or
+        the warm start carries state the program does not thread."""
+        if not self._update_program_enabled() or initial_model is None:
+            return None
+        if initial_model.model.coefficients.variances is not None:
+            # the program threads coefficients only; a variance-carrying warm
+            # start must keep the generic path, or an in-program reject would
+            # silently drop the previous model's variances
+            from photon_ml_tpu.analysis.fallbacks import log_fallback_once
+
+            log_fallback_once(
+                "fe_coordinate_update_program",
+                f"coordinate {self.coordinate_id!r} "
+                f"({self.dataset.feature_shard_id}, "
+                f"{self.dataset.n} samples x {self.dataset.dim} features)",
+                "warm-start model carries variances the fused program does "
+                "not thread; using update_model + score",
+            )
+            return None
+        from photon_ml_tpu.models.glm import Coefficients
+
+        program, _ = self._resolve_update_program()
+        data = self.dataset.data
+        dtype = data.labels.dtype
+
+        def owned_or_copy(key, arr):
+            # donation safety: only with the caller's donate promise AND when
+            # the buffer is identically OUR previous output is it consumed in
+            # place; anything else (external warm start, the loop's initial
+            # score) is copied so the caller's array survives our donation
+            # (see RandomEffectCoordinate.update_and_score)
+            if donate and arr is self._owned.get(key):
+                return arr
+            return jnp.array(arr, copy=True)
+
+        means = self.prepare_initial_model(initial_model).model.coefficients.means
+        if means.dtype != dtype:
+            means = means.astype(dtype)
+        cfg = self.configuration
+        coeffs_out, score_out, ok, value, iters, reason = program(
+            owned_or_copy("coeffs", means),
+            owned_or_copy("score", prev_score),
+            data.offsets + partial_scores,
+            jnp.asarray(cfg.l2_weight, dtype=dtype),
+            jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
+            data,
+            self.normalization,
+        )
+        self._owned = {"coeffs": coeffs_out, "score": score_out}
+        model = FixedEffectModel(
+            model=self._problem.create_model(Coefficients(means=coeffs_out)),
+            feature_shard_id=self.dataset.feature_shard_id,
+        )
+        tracker = FixedEffectOptimizationTracker(
+            convergence_reason=reason,
+            iterations=iters,
+            final_value=value,
+            guard_ok=ok,
+        )
+        return model, score_out, tracker
+
+    def compiled_update_hlo(self) -> str:
+        """Compiled (post-SPMD-partitioning) HLO text of this coordinate's
+        fused update program at the dataset's placement — the collective-
+        audit hook. On a 2-D mesh, ``parallel/hlo_guards.
+        assert_feature_axis_profile`` runs over this text to audit exactly
+        which collectives cross the feature axis: the per-iteration margin
+        all-reduce is the one legal payload-bearing loop collective
+        (1411.6520's communication pattern), bounded in count and payload.
+        Program resolution shares ONE owner with ``update_and_score``
+        (``_resolve_update_program``), so the audit always lowers exactly
+        the program training dispatches."""
+        program, shardings = self._resolve_update_program()
+        ds = self.dataset
+        data = ds.data
+        dtype = data.labels.dtype
+        coeffs = jnp.zeros((ds.dim,), dtype=dtype)
+        score = jnp.zeros((ds.n,), dtype=dtype)
+        offs = jnp.zeros((ds.n,), dtype=dtype)
+        if shardings is not None:
+            coef_sharding, score_sharding = shardings
+            coeffs = jax.device_put(coeffs, coef_sharding)
+            score = jax.device_put(score, score_sharding)
+            offs = jax.device_put(offs, score_sharding)
+        cfg = self.configuration
+        lowered = program.lower(
+            coeffs,
+            score,
+            offs,
+            jnp.asarray(cfg.l2_weight, dtype=dtype),
+            jnp.asarray(cfg.l1_weight or 0.0, dtype=dtype),
+            data,
+            self.normalization,
+        )
+        return lowered.compile().as_text()
 
     def score(self, model: FixedEffectModel) -> Array:
         return model.score_dataset(self.dataset)

@@ -197,6 +197,20 @@ def _snapshot_models(models: dict, donating: set) -> dict:
                     None if m.variances is None else jnp.array(m.variances, copy=True)
                 ),
             )
+        elif isinstance(m, FixedEffectModel):
+            coef = m.model.coefficients
+            coef = dataclasses.replace(
+                coef,
+                means=jnp.array(coef.means, copy=True),
+                variances=(
+                    None
+                    if coef.variances is None
+                    else jnp.array(coef.variances, copy=True)
+                ),
+            )
+            out[cid] = dataclasses.replace(
+                m, model=dataclasses.replace(m.model, coefficients=coef)
+            )
     return out
 
 

@@ -14,7 +14,14 @@ re-materialized blockwise):
   window stay materialized in the view.
 - **cold tier** — a content-addressed block POOL (``blocks/<sha256>.npz``:
   decoded, index-remapped row blocks, up to ``block_rows`` pow2 rows each —
-  PR 5's framing discipline applied to our own storage) plus ``cold-<n>/``
+  PR 5's framing discipline applied to our own storage). Each block's feature
+  shards are COLUMN RE-ENCODED at block level: the block persists its own
+  sorted column-id vocabulary (``feat__<shard>__colids`` — global ids under
+  the frozen ``IndexMap``) plus indices local to it, remapped back to global
+  at read time. Block bytes thus depend only on the columns the block's rows
+  touch, so the feature axis growing 100x (``IndexMap.extend``) rewrites
+  ZERO existing blocks — width growth is purely a read-time shape annotation.
+  Alongside the pool sit ``cold-<n>/``
   COLD GENERATIONS, each just a checksummed manifest ordering pool blocks
   into the accumulated corpus: no Avro decode and no index-map application
   ever again for compacted rows. Because the pool is content-addressed, a
@@ -43,8 +50,10 @@ re-materialized blockwise):
 
 Determinism contract (the chaos bar leans on it): materializing the view from
 (cold blocks + live segments) reproduces the progressively accumulated view
-bit for bit — cold blocks store exactly the decoded+remapped arrays, and CSR
-row slicing/stacking is content-preserving. The only durable writes are the
+bit for bit — cold blocks store exactly the decoded+remapped arrays (the
+vocabulary round-trip ``colids[searchsorted(colids, indices)]`` restores the
+global column indices bit-exactly, dtype included), and CSR row
+slicing/stacking is content-preserving. The only durable writes are the
 staged+renamed cold generation and archive files, both UNREFERENCED until the
 checkpoint generation that points at them commits atomically — so a crash
 anywhere leaves at worst an orphaned cold dir that the next compaction
@@ -353,10 +362,20 @@ class CorpusStore:
             arrs = {k: z[k] for k in z.files}
         shards = {}
         for shard, width in widths.items():
+            indices = arrs[f"feat__{shard}__indices"]
+            colids_key = f"feat__{shard}__colids"
+            if colids_key in arrs:
+                # colids encoding: stored indices are positions in the
+                # block's own sorted column-id vocabulary; remap local ->
+                # global through the frozen-map ids the vocabulary recorded
+                # at write time (IndexMap.extend never moves them). Blocks
+                # without the key predate the encoding and stored global ids
+                # directly — both read.
+                indices = arrs[colids_key][indices]
             m = sp.csr_matrix(
                 (
                     arrs[f"feat__{shard}__data"],
-                    arrs[f"feat__{shard}__indices"],
+                    indices,
                     arrs[f"feat__{shard}__indptr"],
                 ),
                 # widen to the CURRENT map width: tail growth is a shape
@@ -1098,7 +1117,11 @@ class _BlockWriter:
     ``block_rows``-row blocks (the last one partial) into the content-
     addressed pool, each written staged + ``os.replace``-committed under its
     own SHA-256 name (idempotent: a crash-replayed fold rewrites identical
-    bytes to identical names). Holds at most ~2 blocks of rows at a time.
+    bytes to identical names). Feature columns are re-encoded per block
+    against the block's own column-id vocabulary (see :meth:`_emit`), so a
+    block's digest is invariant to later index-map growth and the reuse fast
+    path survives the feature axis widening. Holds at most ~2 blocks of rows
+    at a time.
     :meth:`reuse` adopts an unchanged previous block by reference instead —
     the zero-copy fast path of an incremental compaction."""
 
@@ -1222,7 +1245,20 @@ class _BlockWriter:
         for shard in self.widths:
             m = merged["features"][shard].tocsr()
             arrays[f"feat__{shard}__data"] = m.data
-            arrays[f"feat__{shard}__indices"] = m.indices
+            # block-level column re-encoding: persist the block's OWN sorted
+            # column-id vocabulary (``colids`` — global frozen-IndexMap ids,
+            # original index dtype) plus indices LOCAL to it, in the smallest
+            # unsigned dtype that spans the vocabulary. Block bytes therefore
+            # depend only on the columns the block's rows actually touch —
+            # the feature axis can grow 100x (IndexMap.extend) without a
+            # single existing block changing content or digest, and a block
+            # over a K-wide corpus costs O(distinct cols) not O(K) per index.
+            colids = np.unique(np.asarray(m.indices))
+            local = np.searchsorted(colids, m.indices).astype(
+                np.min_scalar_type(max(len(colids) - 1, 0))
+            )
+            arrays[f"feat__{shard}__colids"] = colids
+            arrays[f"feat__{shard}__indices"] = local
             arrays[f"feat__{shard}__indptr"] = m.indptr
         tmp = os.path.join(
             self.pool_dir,

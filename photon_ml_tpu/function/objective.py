@@ -217,16 +217,23 @@ class GLMObjective:
 
     def hessian_matrix(self, data: LabeledData, coef: Array, l2_weight=0.0) -> Array:
         """Full d x d Hessian for FULL variance (HessianMatrixAggregator.scala:31-129)
-        and the NEWTON solver's per-iteration build.
+        and the NEWTON/direct-IRLS solvers' per-iteration build.
 
-        Materializes the dense design matrix — only sensible for modest feature dims,
-        same restriction as the reference's FULL variance option.
+        Dispatches on the design matrix's storage class: dense materializes
+        the normalized design (modest feature dims — the reference's FULL
+        variance restriction); sparse accumulates the weighted Gram
+        block-of-columns at a time (SparseDesignMatrix.gram) and applies the
+        shift/factor normalization algebraically, so ``re_solver="auto"``-
+        style direct selection is no longer dense-only on the FE side.
         """
         fused = self._fused_hessian_matrix(data, coef, l2_weight)
         if fused is not None:
             return fused
         z = self._margins(data, coef)
         d = self._weighted(data.weights, self.loss.dzz(z, data.labels))
+        sparse = self._sparse_hessian_matrix(data.X, d, l2_weight)
+        if sparse is not None:
+            return sparse
         A = data.X.to_dense()
         if A.dtype == jnp.bfloat16:
             # variance math runs at the reduction dtype: applying shifts/factors
@@ -238,6 +245,40 @@ class GLMObjective:
         if norm.factors is not None:
             A = A * jnp.asarray(norm.factors, dtype=A.dtype)[None, :]
         H = self._psum(A.T @ (A * d[:, None]))
+        return H + l2_weight * jnp.eye(H.shape[0], dtype=H.dtype)
+
+    def _sparse_hessian_matrix(self, X, d: Array, l2_weight):
+        """Sparse-storage Hessian: G = X^T diag(d) X accumulated without a
+        dense [N, D] (SparseDesignMatrix.gram), then the dense branch's
+        normalized-design algebra applied as rank-one corrections —
+        with F = diag(factors) and shift vector s,
+
+          H = F (G - lin s^T - s lin^T + (sum d) s s^T) F,   lin = X^T d
+
+        which is exactly (X - 1 s^T)^T D (X - 1 s^T) scaled by F on both
+        sides. Returns None for dense storage (the caller's stock path)."""
+        from photon_ml_tpu.data.matrix import SparseDesignMatrix
+
+        if not isinstance(X, SparseDesignMatrix):
+            return None
+        G = X.gram(d)
+        if G.dtype != d.dtype:
+            # variance math runs at the reduction dtype (cf. the dense branch)
+            G = G.astype(d.dtype)
+        norm = self.normalization
+        if norm.shifts is not None:
+            s = jnp.asarray(norm.shifts, dtype=G.dtype)
+            lin = X.rmatvec(d)
+            G = (
+                G
+                - lin[:, None] * s[None, :]
+                - s[:, None] * lin[None, :]
+                + jnp.sum(d) * (s[:, None] * s[None, :])
+            )
+        if norm.factors is not None:
+            f = jnp.asarray(norm.factors, dtype=G.dtype)
+            G = G * (f[:, None] * f[None, :])
+        H = self._psum(G)
         return H + l2_weight * jnp.eye(H.shape[0], dtype=H.dtype)
 
     def _fused_hessian_matrix(self, data: LabeledData, coef, l2_weight):

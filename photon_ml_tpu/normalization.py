@@ -196,14 +196,17 @@ class NormalizationContext:
     def to_original_space_device(self, w: Array) -> Array:
         """``model_to_original_space`` for device arrays, batched over leading
         axes ([D] or [K, D]); traced jnp ops, so no device->host sync and safe
-        under jit/vmap. Single source for every batched conversion site
-        (problem.run, parallel/sweep.py)."""
+        under jit/vmap — including when the CONTEXT ITSELF is a traced jit
+        argument (the fused coordinate-update programs pass it as a pytree, so
+        factors/shifts may be tracers that a ``np.asarray`` round-trip would
+        reject). Single source for every batched conversion site
+        (problem.run, parallel/sweep.py, solver_cache update programs)."""
         if self.is_identity:
             return w
         if self.factors is not None:
-            w = w * jnp.asarray(np.asarray(self.factors), dtype=w.dtype)
+            w = w * jnp.asarray(self.factors, dtype=w.dtype)
         if self.shifts is not None:
-            s = jnp.asarray(np.asarray(self.shifts), dtype=w.dtype)
+            s = jnp.asarray(self.shifts, dtype=w.dtype)
             w = w.at[..., self.intercept_index].add(-(w @ s))
         return w
 
@@ -213,10 +216,10 @@ class NormalizationContext:
         if self.is_identity:
             return w
         if self.shifts is not None:
-            s = jnp.asarray(np.asarray(self.shifts), dtype=w.dtype)
+            s = jnp.asarray(self.shifts, dtype=w.dtype)
             w = w.at[..., self.intercept_index].add(w @ s)
         if self.factors is not None:
-            w = w / jnp.asarray(np.asarray(self.factors), dtype=w.dtype)
+            w = w / jnp.asarray(self.factors, dtype=w.dtype)
         return w
 
     # -- device-side effective-coefficient algebra ----------------------------------

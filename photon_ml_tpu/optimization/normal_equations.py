@@ -234,6 +234,14 @@ def minimize_direct(
     minimizers so trackers, variances and the divergence guard are oblivious
     to which solver ran.
 
+    Storage-agnostic on the FE side too: the Gram/Hessian assembly routes
+    through ``obj.hessian_matrix``, which dispatches on the design matrix's
+    storage class — dense blocks take the stock ``A^T diag(d) A`` MXU path,
+    sparse (padded COO) designs accumulate ``SparseDesignMatrix.gram``
+    column-slab-wise without ever materializing the dense [N, D] (the Snap ML
+    sparse-aware kernel hierarchy, 1803.06333) — so direct/IRLS selection is
+    no longer dense-only for wide sparse fixed effects.
+
     ``active`` (traced scalar bool, usually a vmapped lane flag) is the
     population early-exit lever: an inactive lane's initial state is masked
     to read exactly stationary (f0=0, g0=0), so the Newton loop converges it

@@ -823,6 +823,91 @@ def fe_population_update_program(
 
 
 @functools.lru_cache(maxsize=None)
+def fe_coordinate_update_program(
+    task: TaskType,
+    opt_config: OptimizerConfig,
+    has_l1: bool,
+    shardings: tuple = None,
+    allow_fused: bool = True,
+):
+    """ONE jitted, donated XLA program for a fixed-effect coordinate update:
+    the GLM solve, the original-space conversion, this coordinate's ``[N]``
+    score and the divergence guard's select — the fused-protocol analog of
+    ``re_coordinate_update_program`` for the single global GLM
+    (algorithm/coordinate.FixedEffectCoordinate.update_and_score).
+
+    ``update(coeffs_prev, score_prev, offsets_plus_scores, l2, l1, data,
+    norm) -> (coeffs, score, ok, value, iters, reason)``
+
+    - ``coeffs_prev`` ``[D]`` (ORIGINAL-space warm start — the model
+      contract; converted in-program like ``GLMOptimizationProblem.run``)
+      and ``score_prev`` ``[N]`` are DONATED: feed the outputs forward.
+    - the divergence guard mirrors the host loop's two checks
+      (``coordinate_descent._guard_cause``): non-finite final objective,
+      non-finite coefficients — either rejects IN-PROGRAM, returning the
+      previous coefficients/score bit for bit; ``ok`` is the combined
+      device flag the descent loop's fused protocol requires
+      (tracker.guard_ok).
+    - ``data`` is a traced LabeledData pytree whose design matrix may be
+      DENSE or SPARSE — the pytree structure is part of jit's cache key, so
+      the program family dispatches on storage class with no code fork: the
+      objective's matvec/rmatvec/Gram calls lower to the storage's kernels
+      (segment-sum / scatter for padded COO, MXU dots for dense).
+    - ``shardings``: None on the host backend; on a 2-D ("data", "model")
+      mesh the ``(coef_sharding, score_sharding)`` pair — coefficients (and
+      every [D] optimizer-state vector) ``P(model)``, the matrix
+      ``P(data, model)``, scores ``P(data)``. The explicit out-constraints
+      pin the donated state's placement so iteration N+1 consumes iteration
+      N's buffers with no resharding; ``parallel/hlo_guards.
+      assert_feature_axis_profile`` audits the compiled module's
+      feature/data-axis collectives (1411.6520's margin-exchange pattern).
+    - ``allow_fused``: the Pallas fast-path switch; mesh callers pass False
+      (GSPMD cannot partition an opaque pallas_call), and sparse storage is
+      never Pallas-eligible regardless.
+    """
+    task = TaskType(task)
+    loss = loss_for_task(task)
+    minimize = build_minimizer(opt_config)
+    use_hvp = OptimizerType(opt_config.optimizer_type) == OptimizerType.TRON
+    use_hess = OptimizerType(opt_config.optimizer_type) == OptimizerType.NEWTON
+
+    def update(coeffs_prev, score_prev, offsets_plus_scores, l2, l1, data, norm):
+        d2 = data.with_offsets(offsets_plus_scores)
+        obj = GLMObjective(loss, norm, allow_fused=allow_fused)
+        x0 = norm.to_transformed_space_device(coeffs_prev)
+
+        def vg(w):
+            return obj.value_and_gradient(d2, w, l2)
+
+        kwargs = {}
+        if use_hvp:
+            kwargs["hvp"] = lambda w, v: obj.hessian_vector(d2, w, v, l2)
+        if use_hess:
+            kwargs["hess"] = lambda w: obj.hessian_matrix(d2, w, l2)
+        if has_l1:
+            kwargs["l1_weight"] = l1
+        res = minimize(vg, x0, **kwargs)
+        means = norm.to_original_space_device(res.coefficients)
+        score = data.X.matvec(means)
+        # same two checks, same order, as the host loop's divergence guard
+        value_ok = jnp.isfinite(res.value)
+        coefs_ok = jnp.isfinite(means).all()
+        ok = jnp.logical_and(value_ok, coefs_ok)
+        coeffs_out = jnp.where(ok, means, coeffs_prev)
+        score_out = jnp.where(ok, score, score_prev)
+        if shardings is not None:
+            coef_sharding, score_sharding = shardings
+            coeffs_out = jax.lax.with_sharding_constraint(coeffs_out, coef_sharding)
+            score_out = jax.lax.with_sharding_constraint(score_out, score_sharding)
+        return (
+            coeffs_out, score_out, ok,
+            res.value, res.iterations, res.convergence_reason,
+        )
+
+    return jax.jit(update, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
 def sharded_glm_solver(
     task: TaskType,
     opt_config: OptimizerConfig,
@@ -976,6 +1061,7 @@ def clear():
     re_chunk_score_program.cache_clear()
     re_population_update_program.cache_clear()
     fe_population_update_program.cache_clear()
+    fe_coordinate_update_program.cache_clear()
     sharded_glm_solver.cache_clear()
     shard_mapped_glm_solver.cache_clear()
     for cache_clear in _extra_caches:

@@ -28,7 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from photon_ml_tpu.data.dataset import LabeledData
-from photon_ml_tpu.data.matrix import DenseDesignMatrix
+from photon_ml_tpu.data.matrix import DenseDesignMatrix, SparseDesignMatrix
 from photon_ml_tpu.parallel.mesh import DATA_AXIS, pad_axis_to_multiple
 
 MODEL_AXIS = "model"
@@ -73,22 +73,33 @@ def sample_sharding(mesh: Mesh) -> NamedSharding:
 def shard_labeled_data_2d(
     data: LabeledData, mesh: Mesh, sample_multiple: Optional[int] = None
 ) -> tuple[LabeledData, int, int]:
-    """Place a dense LabeledData on the 2-D mesh: samples padded (weight-0) to
+    """Place a LabeledData on the 2-D mesh: samples padded (weight-0) to
     the data-axis multiple (or ``sample_multiple`` when the global sample axis
     must line up with other coordinates' padding), features padded (all-zero
     columns, inert: their gradient is exactly the L2 term so their coefficients
     stay 0) to the model-axis multiple. Returns (sharded data, n_samples,
-    n_features)."""
-    if not isinstance(data.X, DenseDesignMatrix):
-        raise TypeError(
-            "feature-axis sharding currently covers dense design matrices; "
-            "sparse COO shards its nnz axis on the 1-D mesh (parallel/glm.py)"
-        )
+    n_features).
+
+    DENSE matrices block-shard [N, D] over (data, model). SPARSE (padded COO)
+    matrices shard the flat nnz axis over BOTH mesh axes — every device owns a
+    contiguous nnz slice; n_rows/n_cols are static metadata padded the same way
+    the dense axes are, so coefficients still live P("model") and scores
+    P("data"), and GSPMD inserts the margin/gradient all-reduces over the nnz
+    partial sums (the 1411.6520 communication pattern the 2-D FE program audit
+    gates). The sorted-column layout (col_order/cols_sorted) is dropped: a
+    global column sort would gather across the sharded nnz axis."""
     n_data, n_model = (mesh.shape[DATA_AXIS], mesh.shape[MODEL_AXIS])
     sm = sample_multiple or n_data
     if sm % n_data:
         raise ValueError(
             f"sample_multiple={sm} must be a multiple of the data axis ({n_data})"
+        )
+    if isinstance(data.X, SparseDesignMatrix):
+        return _shard_sparse_labeled_data_2d(data, mesh, sm, n_model)
+    if not isinstance(data.X, DenseDesignMatrix):
+        raise TypeError(
+            f"feature-axis sharding covers DenseDesignMatrix and "
+            f"SparseDesignMatrix; got {type(data.X).__name__}"
         )
 
     vals = np.asarray(data.X.values)
@@ -102,6 +113,62 @@ def shard_labeled_data_2d(
     sharded = LabeledData(
         X=DenseDesignMatrix(
             jax.device_put(jnp.asarray(vals, dtype=data.X.dtype), matrix_sharding(mesh))
+        ),
+        labels=jax.device_put(jnp.asarray(labels, dtype=data.labels.dtype), ss),
+        offsets=jax.device_put(jnp.asarray(offsets, dtype=data.offsets.dtype), ss),
+        weights=jax.device_put(jnp.asarray(weights, dtype=data.weights.dtype), ss),
+    )
+    return sharded, n, d
+
+
+def _shard_sparse_labeled_data_2d(
+    data: LabeledData, mesh: Mesh, sm: int, n_model: int
+) -> tuple[LabeledData, int, int]:
+    """Sparse arm of shard_labeled_data_2d: pad the flat nnz axis to the total
+    device count (padding entries carry the LAST row id at value 0 — inert,
+    and the nondecreasing-rows invariant survives) and shard it over both mesh
+    axes; pad the static row/col counts like the dense axes. Refuses matrices
+    without row-major entry order: appended nnz padding must extend, not
+    break, the sorted-rows invariant the sharded segment-sum matvec asserts
+    (indices_are_sorted)."""
+    X = data.X
+    if not X.rows_sorted:
+        raise ValueError(
+            "feature-axis sharding requires row-major (sorted-rows) sparse "
+            "entry order: nnz padding appends entries at the last row id, and "
+            "the sharded matvec's segment_sum asserts sorted row indices. "
+            "Build via SparseDesignMatrix.from_scipy (CSR/COO row-major)."
+        )
+    total = mesh.devices.size
+    rows = np.asarray(X.rows)
+    cols = np.asarray(X.cols)
+    vals = np.asarray(X.vals)
+    nnz = rows.shape[0]
+    nnz_pad = -(-max(nnz, 1) // total) * total
+    if nnz_pad > nnz:
+        last_row = rows[nnz - 1] if nnz else 0
+        rows = np.concatenate(
+            [rows, np.full(nnz_pad - nnz, last_row, dtype=rows.dtype)]
+        )
+        cols = np.concatenate([cols, np.zeros(nnz_pad - nnz, dtype=cols.dtype)])
+        vals = np.concatenate([vals, np.zeros(nnz_pad - nnz, dtype=vals.dtype)])
+    n, d = X.n_rows, X.n_cols
+    n_pad = -(-max(n, 1) // sm) * sm
+    d_pad = -(-max(d, 1) // n_model) * n_model
+    labels, _ = pad_axis_to_multiple(np.asarray(data.labels), sm)
+    offsets, _ = pad_axis_to_multiple(np.asarray(data.offsets), sm)
+    weights, _ = pad_axis_to_multiple(np.asarray(data.weights), sm)
+
+    nnz_sharding = NamedSharding(mesh, P((DATA_AXIS, MODEL_AXIS)))
+    ss = sample_sharding(mesh)
+    sharded = LabeledData(
+        X=SparseDesignMatrix(
+            rows=jax.device_put(jnp.asarray(rows), nnz_sharding),
+            cols=jax.device_put(jnp.asarray(cols), nnz_sharding),
+            vals=jax.device_put(jnp.asarray(vals, dtype=X.dtype), nnz_sharding),
+            n_rows=n_pad,
+            n_cols=d_pad,
+            rows_sorted=True,
         ),
         labels=jax.device_put(jnp.asarray(labels, dtype=data.labels.dtype), ss),
         offsets=jax.device_put(jnp.asarray(offsets, dtype=data.offsets.dtype), ss),

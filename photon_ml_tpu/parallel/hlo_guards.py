@@ -328,6 +328,92 @@ def assert_settings_axis_collective_free(compiled_text: str) -> int:
     return tolerated
 
 
+def assert_feature_axis_profile(
+    compiled_text: str,
+    *,
+    grad_elements: int,
+    n_samples: int,
+    max_loop_data_collectives: int = 12,
+    max_collectives: int = 64,
+) -> dict:
+    """The 2-D (data x model) fixed-effect update program's collective
+    contract — feature-partitioned distributed CD (1411.6520): each model
+    shard owns a coefficient block, and the ONE thing devices must exchange
+    per solver iteration is margin partial sums (an all-reduce of at most
+    [n_samples]) plus the gradient-block exchange (at most [grad_elements]).
+    Audits ``FixedEffectCoordinate.compiled_update_hlo`` — exactly the
+    program training dispatches.
+
+    What the compiled module may carry (calibrated against the real lowered
+    program on an emulated 8-device 4x2 mesh, dense AND sparse storage):
+
+    - **all-reduce**: margin partials (GSPMD emits them shard-local,
+      [n_samples / n_data], for dense block layouts and global [n_samples]
+      for the sparse flat-nnz layout), gradient blocks (<= [grad_elements]),
+      and the scalar convergence predicates of batched while-loops;
+    - **all-gather**: the sparse layout's coefficient rebuild for
+      ``take(w, cols)`` (<= [grad_elements]) and margin re-distribution
+      (<= [n_samples]). Dense lowers with no gathers at all;
+    - **nothing else**: no reduce-scatter / all-to-all / collective-permute,
+      and no payload above ``max(grad_elements, n_samples)`` anywhere — a
+      larger payload means the design matrix (or its nnz arrays) is riding
+      the wire, i.e. the mesh is densifying or resharding the data instead
+      of exchanging margins.
+
+    Inside solver while-loops, payload-bearing collectives run once per
+    ITERATION, so they are additionally gated by COUNT
+    (``max_loop_data_collectives``; the calibration lowering shows 4 for
+    dense, 8 for sparse): a count blow-up is how an accidentally unrolled
+    or per-column loop manifests while each individual payload still looks
+    legal. Single-element all-reduce predicates are free — they are the
+    loop-termination consensus every sharded ``while_loop`` carries.
+
+    ``grad_elements``/``n_samples`` are the PADDED global counts (the model-
+    and data-axis multiples placement padded to). Returns a profile dict
+    ``{total, loop_data, loop_predicates}`` for reporting."""
+    collectives = Collective.parse_all(compiled_text)
+    bound = max(grad_elements, n_samples)
+    for c in collectives:
+        if c.kind not in ("all-reduce", "all-gather"):
+            raise AssertionError(
+                f"unexpected {c.kind} in the 2-D fixed-effect update "
+                f"({c.shape}): the feature-axis profile is all-reduce/"
+                f"all-gather only (1411.6520's margin-exchange pattern); a "
+                f"{c.kind} means the partitioner is resharding data mid-solve"
+            )
+        assert c.elements <= bound, (
+            f"{c.kind} payload {c.shape} ({c.elements} elements) exceeds the "
+            f"margin/gradient bound max({grad_elements}, {n_samples}) = "
+            f"{bound} — a matrix- or nnz-sized tensor rides the wire instead "
+            f"of margin partial sums"
+        )
+    assert len(collectives) <= max_collectives, (
+        f"{len(collectives)} collectives in the 2-D fixed-effect update "
+        f"(cap {max_collectives}): count must stay O(solver program "
+        f"structure), not O(features)"
+    )
+    loop = loop_collectives(compiled_text)
+    predicates = [e for e in loop if e[2] == 1 and "all-reduce" in e[1]]
+    data = [e for e in loop if not (e[2] == 1 and "all-reduce" in e[1])]
+    for name, line, elements in data:
+        assert 0 < elements <= bound, (
+            f"in-loop collective in {name} with payload {elements} exceeds "
+            f"the margin/gradient bound {bound} (runs per solver iteration): "
+            f"{line[:100]}"
+        )
+    assert len(data) <= max_loop_data_collectives, (
+        f"{len(data)} payload-bearing collectives inside solver while-loops "
+        f"(cap {max_loop_data_collectives}) — each runs per solver "
+        f"ITERATION; a count blow-up here is an unrolled or per-column "
+        f"communication pattern even when every payload looks legal"
+    )
+    return {
+        "total": len(collectives),
+        "loop_data": len(data),
+        "loop_predicates": len(predicates),
+    }
+
+
 def assert_entity_solves_collective_free(compiled_text: str) -> int:
     """Fail if any DATA collective appears inside a ``while`` body/condition
     of the compiled module. For the random-effect coordinate update this is

@@ -52,17 +52,18 @@ def place_fixed_effect_dataset(ds: FixedEffectDataset, mesh) -> FixedEffectDatas
     (billion-feature regime — the PalDBIndexMap.scala:43-278 scale story rides
     the sparse path + offheap_index).
 
-    On a 2-D ("data", "model") mesh a DENSE design matrix additionally shards
-    its FEATURE axis over "model" and stamps ``coef_sharding`` so coefficient
-    vectors and optimizer state live distributed (parallel/feature_sharded.py);
-    sparse matrices keep 1-D nnz sharding over the data axis."""
-    from photon_ml_tpu.data.matrix import DenseDesignMatrix
+    On a 2-D ("data", "model") mesh the FEATURE axis additionally shards over
+    "model" and placement stamps ``coef_sharding`` so coefficient vectors and
+    optimizer state live distributed (parallel/feature_sharded.py) — dense
+    matrices block-shard [N, D], sparse matrices shard their flat nnz axis
+    over both mesh axes (the wide-FE regime: K padded to the model axis,
+    coefficients P("model"), scores P("data"))."""
     from photon_ml_tpu.parallel.feature_sharded import (
         feature_sharding,
         shard_labeled_data_2d,
     )
 
-    if len(mesh.axis_names) == 2 and isinstance(ds.data.X, DenseDesignMatrix):
+    if len(mesh.axis_names) == 2:
         # sample padding to the TOTAL device count keeps the global score axis
         # consistent with the 1-D-placed random-effect coordinates
         sharded2, _, _ = shard_labeled_data_2d(

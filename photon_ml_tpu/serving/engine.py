@@ -37,7 +37,13 @@ Design (mirroring ``optimization/solver_cache.py``'s cache discipline):
   fixed-effect matvec goes through the same ``DenseDesignMatrix.matvec``.
   Parity is bitwise on dense-fixed-effect models (tests/test_serving.py); a
   sparse fixed-effect shard scores through a per-sample gather/dot instead of
-  the eager segment_sum, which may differ in the last ulp.
+  the eager segment_sum, which may differ in the last ulp. Dense-container
+  requests at wide K (>= ``FE_SPARSE_MIN_COLS`` columns) route through that
+  same per-sample branch rather than padding a ``[B, K]`` buffer — bitwise
+  identical to the CSR-container path by construction, and within the f32
+  value-storage tolerance of the small-K dense matvec (the two kernels'
+  reductions associate differently; docs/PERFORMANCE.md, honest-measurement
+  rules).
 
 Padding discipline: padded batch rows carry entity row -1, column slot -1 and
 value 0 everywhere, so every per-row computation is inert and the trailing
@@ -75,6 +81,15 @@ MIN_BATCH_PAD = 8
 
 # Smallest padded per-row nnz width (see _per_sample_view).
 MIN_WIDTH_PAD = 4
+
+# Dense-ndarray fixed-effect requests with at least this many columns route
+# through the per-sample sparse view instead of padding a [B, K] device
+# buffer: a wide-FE trained model (the 100x feature axis, bench.py --wide-fe)
+# serves dense-container requests at O(B * nnz-width bucket) device bytes,
+# identically to the CSR-container path — container choice never changes the
+# scored bits. Below the cutoff the dense matvec stays (it is the
+# bitwise-parity-gated path against the eager scorer and cheaper at small K).
+FE_SPARSE_MIN_COLS = 1024
 
 
 def width_bucket(max_row_nnz: int) -> int:
@@ -515,20 +530,27 @@ class GameServingEngine:
 
     def _prepare_fixed(self, st: _FixedCoord, data: GameInput, n: int, n_pad: int):
         X = data.shard(st.feature_shard_id)
-        if sp.issparse(X):
-            Xc = X.tocsr()
-            cols, vals, _, _ = self._per_sample_view(Xc, n, n_pad)
-            # eager sparse fixed effects build at float32
-            # (SparseDesignMatrix.from_scipy default)
-            return {
-                "cols": jnp.asarray(cols),
-                "vals": jnp.asarray(vals, dtype=jnp.float32),
-            }
-        arr = np.asarray(X)
-        padded = np.zeros((n_pad, arr.shape[1]), dtype=arr.dtype)
-        padded[:n] = arr
-        # dtype follows jnp.asarray like the eager LabeledData.build(dtype=None)
-        return {"values": jnp.asarray(padded)}
+        if not sp.issparse(X):
+            arr = np.asarray(X)
+            if arr.shape[1] < FE_SPARSE_MIN_COLS:
+                padded = np.zeros((n_pad, arr.shape[1]), dtype=arr.dtype)
+                padded[:n] = arr
+                # dtype follows jnp.asarray like the eager LabeledData.build(dtype=None)
+                return {"values": jnp.asarray(padded)}
+            # wide-K routing: never materialize [B, K] on device for a wide
+            # fixed effect — convert to CSR and fall through to the SAME
+            # per-sample branch a sparse-container request takes, so the
+            # scored bits are identical whichever container the caller used
+            # (tests/test_serving.py pins that equality bitwise)
+            X = sp.csr_matrix(arr)
+        Xc = X.tocsr()
+        cols, vals, _, _ = self._per_sample_view(Xc, n, n_pad)
+        # eager sparse fixed effects build at float32
+        # (SparseDesignMatrix.from_scipy default)
+        return {
+            "cols": jnp.asarray(cols),
+            "vals": jnp.asarray(vals, dtype=jnp.float32),
+        }
 
     def _prepare_random(self, st: _RandomCoord, data: GameInput, n: int, n_pad: int):
         X = as_csr(data.shard(st.feature_shard_id))

@@ -1098,6 +1098,78 @@ class TestColdBlockReuse:
         )
         np.testing.assert_array_equal(t2.snapshot.uids, t.snapshot.uids)
 
+    def test_index_map_growth_never_rewrites_cold_blocks(self, tmp_path):
+        """Block-level column re-encoding: each cold block persists its OWN
+        sorted column-id vocabulary (global frozen-``IndexMap`` ids) plus
+        block-local indices, remapped back to global at read time. A later
+        ``IndexMap.extend`` — the feature axis growing — therefore changes
+        no existing block's bytes: the next compaction adopts every full
+        pre-growth block by reference (zero rewrites), and the wider-width
+        corpus still materializes bitwise against a frozen-map re-read of
+        every original part file."""
+        rng = np.random.default_rng(85)
+        corpus = tmp_path / "corpus"
+        os.makedirs(corpus)
+        write_part(corpus / "part-00000.avro", rng, 128, USERS)
+        t = make_trainer(corpus, tmp_path / "ckpt", compact_every=2,
+                         cold_block_rows=64)
+        t.poll_once()
+        write_part(corpus / "part-00001.avro", rng, 30, ["u0"])
+        r2 = t.poll_once()
+        assert r2.compacted
+        width0 = t.snapshot.index_maps["shardA"].size
+        first_blocks = {
+            b["sha256"] for b in _cold_manifest(tmp_path / "ckpt", 2)["blocks"]
+        }
+        # the written blocks carry the vocabulary encoding: sorted global
+        # column ids + local indices that never reach past the vocabulary
+        pool = os.path.join(str(tmp_path / "ckpt"), "corpus-store", "blocks")
+        block_file = os.path.join(pool, sorted(first_blocks)[0] + ".npz")
+        with np.load(block_file, allow_pickle=False) as z:
+            colids = z["feat__shardA__colids"]
+            local = z["feat__shardA__indices"]
+        assert np.all(np.diff(colids) > 0) and int(colids.max()) < width0
+        assert local.size == 0 or int(local.max()) < len(colids)
+
+        # grow the feature axis: this delta's new feature extends the map
+        write_part(corpus / "part-00002.avro", rng, 30, ["u1"],
+                   extra_feature="f_wide")
+        t.poll_once()
+        write_part(corpus / "part-00003.avro", rng, 30, ["u1"])
+        r4 = t.poll_once()
+        assert r4.compacted
+        assert t.snapshot.index_maps["shardA"].size == width0 + 1
+        assert t.snapshot.data.shard("shardA").shape[1] == width0 + 1
+        # zero pre-existing blocks rewritten: both full pre-growth blocks
+        # ride into the post-growth generation by digest reference
+        assert r4.cold_stats["blocks_reused"] == 2
+        second = _cold_manifest(tmp_path / "ckpt", 4)
+        assert len({b["sha256"] for b in second["blocks"]} & first_blocks) == 2
+
+        # bitwise corpus through the mixed-width cold tier: a fresh restart
+        # (cold blocks + live re-decode) vs a cold-free re-read of EVERY
+        # original part file under the final frozen maps
+        t2 = make_trainer(corpus, tmp_path / "ckpt", compact_every=2,
+                          cold_block_rows=64)
+        view, ref = t2.snapshot, t.snapshot
+        np.testing.assert_array_equal(
+            np.asarray(view.data.labels), np.asarray(ref.data.labels)
+        )
+        np.testing.assert_array_equal(view.uids, ref.uids)
+        for x, y in zip(_csr_state(view.data.shard("shardA")),
+                        _csr_state(ref.data.shard("shardA"))):
+            np.testing.assert_array_equal(x, y)
+        data, _, uids = read_merged_avro(
+            list(t.manifest.paths), shard_configs(),
+            index_maps=dict(ref.index_maps), id_tags=("userId",),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(data.labels), np.asarray(ref.data.labels)
+        )
+        for x, y in zip(_csr_state(data.shard("shardA")),
+                        _csr_state(ref.data.shard("shardA"))):
+            np.testing.assert_array_equal(x, y)
+
     def test_prune_never_deletes_a_block_the_surviving_generation_references(
         self, tmp_path
     ):

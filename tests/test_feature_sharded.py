@@ -138,11 +138,77 @@ def test_warm_start_round_trip(rng, eight_devices):
     )
 
 
-def test_sparse_rejected(rng, eight_devices):
+def test_sparse_2d_matches_single_device(rng, eight_devices):
+    """The wide-FE path: sparse COO shards its flat nnz axis over BOTH mesh
+    axes (coefficients P("model"), scores P("data")) and solves to the same
+    optimum as the single-device dense reference."""
     import scipy.sparse as sp
 
-    X = sp.random(64, 16, density=0.2, random_state=np.random.RandomState(0)).tocsr()
+    X, y = _problem(rng, n=256, d=24)
+    X = np.where(rng.random(X.shape) < 0.3, X, 0.0)
+    cfg = _cfg()
+    mesh = make_mesh2(2, 4)
+    sharded, n0, d0 = shard_labeled_data_2d(
+        LabeledData.build(sp.csr_matrix(X), y, dtype=jnp.float64), mesh
+    )
+    assert (n0, d0) == (256, 24)
+    res, _ = train_glm_feature_sharded(sharded, TaskType.LOGISTIC_REGRESSION, cfg, mesh)
+    w2d = np.asarray(res.coefficients)
+    ref = _single_device_reference(X, y, cfg)
+    np.testing.assert_allclose(w2d[:24], ref, atol=1e-8)
+    # padded (never-referenced) feature columns see only the L2 term -> 0
+    assert np.all(w2d[24:] == 0.0)
+
+
+def test_sparse_2d_nnz_sharded(rng, eight_devices):
+    """nnz arrays shard over the flattened 2-D mesh; the sorted-column layout
+    is dropped (a global column sort would gather across shards)."""
+    import scipy.sparse as sp
+
+    X = sp.random(
+        64, 16, density=0.2, random_state=np.random.RandomState(0)
+    ).tocsr()
     y = np.zeros(64)
     mesh = make_mesh2(2, 4)
-    with pytest.raises(TypeError, match="dense"):
-        shard_labeled_data_2d(LabeledData.build(X, y, dtype=jnp.float64), mesh)
+    sharded, _, _ = shard_labeled_data_2d(
+        LabeledData.build(X, y, dtype=jnp.float64), mesh
+    )
+    Xs = sharded.X
+    nnz_pad = Xs.vals.shape[0]
+    assert nnz_pad % 8 == 0
+    assert {s.data.shape[0] for s in Xs.vals.addressable_shards} == {nnz_pad // 8}
+    assert Xs.col_order is None and Xs.cols_sorted is None
+    assert Xs.rows_sorted
+    # padding entries are inert: dense reconstruction matches scipy
+    np.testing.assert_array_equal(
+        np.asarray(Xs.to_dense())[:64, :16], X.toarray()
+    )
+
+
+def test_sparse_2d_unsorted_rows_refused(rng, eight_devices):
+    """Feature-axis sharding refuses non-row-major sparse entry order: nnz
+    padding appends at the last row id, which only preserves the sorted-rows
+    invariant the sharded matvec asserts when rows already arrive sorted."""
+    import dataclasses as dc
+
+    import scipy.sparse as sp
+
+    from photon_ml_tpu.data.matrix import SparseDesignMatrix
+
+    X = sp.random(
+        32, 8, density=0.3, random_state=np.random.RandomState(1)
+    ).tocsr()
+    sm = SparseDesignMatrix.from_scipy(X, dtype=jnp.float64)
+    shuffled = dc.replace(
+        sm,
+        rows=sm.rows[::-1],
+        cols=sm.cols[::-1],
+        vals=sm.vals[::-1],
+        rows_sorted=False,
+    )
+    data = LabeledData.build(
+        shuffled, np.zeros(32), dtype=jnp.float64
+    )
+    mesh = make_mesh2(2, 4)
+    with pytest.raises(ValueError, match="row-major"):
+        shard_labeled_data_2d(data, mesh)

@@ -341,6 +341,69 @@ def test_nnz_width_bucketing_no_retrace(rng):
     assert eng.trace_count == warm + 2
 
 
+def test_wide_fe_dense_request_routes_through_sparse_path(rng, monkeypatch):
+    """Wide-K fixed-effect routing: a dense-container request at
+    K >= FE_SPARSE_MIN_COLS scores through the per-sample (cols, vals) view —
+    BITWISE the CSR-container path (same prepared batch, same program) — and
+    agrees with the small-K dense matvec to the f32 value-storage tolerance
+    (the two kernels' reductions associate differently: FMA-contracted
+    [B, K] matvec vs the width-bucketed row reduce)."""
+    from photon_ml_tpu.serving import engine as engine_mod
+
+    d, n, nnz = 32, 40, 5
+    model = GameModel(models={"fixed": fixed_model(rng, d=d)})
+    dense = np.zeros((n, d))
+    for i in range(n):
+        cols = rng.choice(d, size=nnz, replace=False)
+        dense[i, cols] = rng.normal(size=nnz)
+    req_dense = GameInput(features={"global": dense})
+    req_csr = GameInput(features={"global": sp.csr_matrix(dense)})
+
+    # both-fit shape, default cutoff: the dense [B, K] kernel serves this K
+    eng = GameServingEngine(model)
+    assert "values" in eng._prepare(req_dense)[0]["coord:fixed"]
+    s_dense = eng.score(req_dense, include_offsets=False)
+    s_csr = eng.score(req_csr, include_offsets=False)
+
+    # force the routing cutoff under K: the dense container now prepares the
+    # SAME batch the CSR container does — width = the nnz bucket, no [B, K]
+    monkeypatch.setattr(engine_mod, "FE_SPARSE_MIN_COLS", 8)
+    eng_routed = GameServingEngine(model)
+    fe = eng_routed._prepare(req_dense)[0]["coord:fixed"]
+    assert "values" not in fe
+    assert fe["cols"].shape[1] == 8  # width_bucket(5), not K=32
+    s_routed = eng_routed.score(req_dense, include_offsets=False)
+
+    # container invariance is BITWISE: routed-dense == sparse-CSR exactly
+    assert s_routed.dtype == s_csr.dtype
+    np.testing.assert_array_equal(s_routed, s_csr)
+    # vs the dense kernel: f32 value storage + reduction order, not bitwise
+    # (a few f32 ulps accumulated over the row's nnz entries)
+    assert s_routed.dtype == s_dense.dtype
+    np.testing.assert_allclose(s_routed, s_dense, rtol=1e-5, atol=1e-8)
+
+
+def test_wide_fe_dense_request_routes_by_default_at_wide_k(rng):
+    """At K past the default cutoff no monkeypatching is needed: the routing
+    engages on its own and the device batch never holds a [B, K] buffer."""
+    from photon_ml_tpu.serving.engine import FE_SPARSE_MIN_COLS
+
+    d, n, nnz = FE_SPARSE_MIN_COLS, 16, 6
+    model = GameModel(models={"fixed": fixed_model(rng, d=d)})
+    dense = np.zeros((n, d))
+    for i in range(n):
+        cols = rng.choice(d, size=nnz, replace=False)
+        dense[i, cols] = rng.normal(size=nnz)
+    eng = GameServingEngine(model)
+    fe = eng._prepare(GameInput(features={"global": dense}))[0]["coord:fixed"]
+    assert "values" not in fe and fe["cols"].shape[1] == 8  # nnz bucket, not K
+    s_routed = eng.score(GameInput(features={"global": dense}), include_offsets=False)
+    s_csr = eng.score(
+        GameInput(features={"global": sp.csr_matrix(dense)}), include_offsets=False
+    )
+    np.testing.assert_array_equal(s_routed, s_csr)
+
+
 def test_entity_id_dtype_mismatch_degrades_like_eager(rng):
     """Integer-entity model served string ids must score those rows 0 (the
     eager dict-lookup miss), not crash in searchsorted."""

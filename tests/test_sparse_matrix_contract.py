@@ -1,0 +1,205 @@
+"""SparseDesignMatrix contract corners (data/matrix.py).
+
+The padded-COO layout's kernels (segment-sum matvec, scatter/sorted rmatvec,
+and the new column-slab Gram for the direct/IRLS solvers) each carry implicit
+contracts the wide-FE program family now leans on: duplicate COO entries
+ACCUMULATE (matching scipy's ``tocsr`` semantics at the kernel level),
+row-major entry order is detected and required where padding extends it, the
+``COL_REDUCE_MODE`` toggle is a pure execution-strategy knob, and empty rows
+or an all-padding matrix are inert, not errors.
+"""
+
+import dataclasses as dc
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from photon_ml_tpu.data import matrix as matrix_mod
+from photon_ml_tpu.data.matrix import SparseDesignMatrix
+
+
+def _coo(rows, cols, vals, n_rows, n_cols, **kw):
+    return SparseDesignMatrix(
+        rows=jnp.asarray(np.asarray(rows, np.int32)),
+        cols=jnp.asarray(np.asarray(cols, np.int32)),
+        vals=jnp.asarray(np.asarray(vals, np.float64)),
+        n_rows=n_rows,
+        n_cols=n_cols,
+        **kw,
+    )
+
+
+class TestDuplicateIndexAccumulation:
+    """COO semantics: repeated (row, col) entries sum — every kernel, not
+    just to_dense."""
+
+    def _dup(self):
+        # (0,1) appears twice, (2,0) twice with cancelling values
+        m = _coo(
+            rows=[0, 0, 1, 2, 2],
+            cols=[1, 1, 0, 0, 0],
+            vals=[2.0, 3.0, 4.0, 1.5, -1.5],
+            n_rows=3,
+            n_cols=2,
+            rows_sorted=True,
+        )
+        dense = np.zeros((3, 2))
+        dense[0, 1] = 5.0
+        dense[1, 0] = 4.0
+        return m, dense
+
+    def test_matvec_rmatvec(self, rng):
+        m, dense = self._dup()
+        w = rng.normal(size=2)
+        v = rng.normal(size=3)
+        np.testing.assert_allclose(np.asarray(m.matvec(jnp.asarray(w))), dense @ w)
+        np.testing.assert_allclose(np.asarray(m.rmatvec(jnp.asarray(v))), dense.T @ v)
+
+    def test_to_dense_gram_rmatmat(self, rng):
+        m, dense = self._dup()
+        np.testing.assert_allclose(np.asarray(m.to_dense()), dense)
+        d = np.abs(rng.normal(size=3)) + 0.1
+        np.testing.assert_allclose(
+            np.asarray(m.gram(jnp.asarray(d))), dense.T @ np.diag(d) @ dense
+        )
+        M = rng.normal(size=(3, 4))
+        np.testing.assert_allclose(
+            np.asarray(m.rmatmat(jnp.asarray(M))), dense.T @ M
+        )
+
+
+class TestRowOrder:
+    def test_from_scipy_detects_sorted_rows(self):
+        X = sp.random(40, 10, density=0.2, random_state=np.random.RandomState(0))
+        m = SparseDesignMatrix.from_scipy(X.tocsr(), dtype=jnp.float64)
+        assert m.rows_sorted  # CSR iterates row-major
+        rows = np.asarray(m.rows)
+        assert np.all(np.diff(rows) >= 0)
+
+    def test_unsorted_rows_flagged_and_refused_by_2d_shard(self, eight_devices):
+        """Feature-axis (2-D mesh) sharding appends nnz padding at the last
+        row id, which only preserves the sorted-rows invariant the sharded
+        segment-sum matvec asserts when entries already arrive row-major —
+        non-row-major layouts are refused loudly, not silently miscomputed."""
+        from photon_ml_tpu.data.dataset import LabeledData
+        from photon_ml_tpu.parallel.feature_sharded import (
+            make_mesh2,
+            shard_labeled_data_2d,
+        )
+
+        X = sp.random(32, 8, density=0.3, random_state=np.random.RandomState(1))
+        m = SparseDesignMatrix.from_scipy(X.tocsr(), dtype=jnp.float64)
+        shuffled = dc.replace(
+            m,
+            rows=m.rows[::-1],
+            cols=m.cols[::-1],
+            vals=m.vals[::-1],
+            rows_sorted=False,
+        )
+        # kernels themselves stay CORRECT on unsorted entries (the flag only
+        # gates the indices_are_sorted fast path)...
+        w = np.arange(8, dtype=np.float64)
+        np.testing.assert_allclose(
+            np.asarray(shuffled.matvec(jnp.asarray(w))),
+            np.asarray(m.matvec(jnp.asarray(w))),
+        )
+        # ...but the 2-D placement refuses them
+        data = LabeledData.build(shuffled, np.zeros(32), dtype=jnp.float64)
+        with pytest.raises(ValueError, match="row-major"):
+            shard_labeled_data_2d(data, make_mesh2(2, 4))
+
+
+class TestColReduceToggle:
+    """COL_REDUCE_MODE is an execution-strategy knob: sorted segment_sum and
+    scatter-add column reductions agree on rmatvec, rmatmat AND the Gram —
+    the three consumers of _col_reduce policy."""
+
+    def test_toggle_parity(self, rng, monkeypatch):
+        X = sp.random(200, 40, density=0.15, random_state=np.random.RandomState(2))
+        monkeypatch.setattr(matrix_mod, "COL_REDUCE_MODE", "sorted")
+        m = SparseDesignMatrix.from_scipy(X.tocsr(), dtype=jnp.float64)
+        assert m.col_order is not None and matrix_mod._use_sorted_col_reduce()
+        v = jnp.asarray(rng.normal(size=200))
+        d = jnp.asarray(np.abs(rng.normal(size=200)) + 0.1)
+        M = jnp.asarray(rng.normal(size=(200, 5)))
+        sorted_out = (
+            np.asarray(m.rmatvec(v)),
+            np.asarray(m.rmatmat(M)),
+            np.asarray(m.gram(d)),
+        )
+        monkeypatch.setattr(matrix_mod, "COL_REDUCE_MODE", "scatter")
+        assert not matrix_mod._use_sorted_col_reduce()
+        scatter_out = (
+            np.asarray(m.rmatvec(v)),
+            np.asarray(m.rmatmat(M)),
+            np.asarray(m.gram(d)),
+        )
+        for s, c in zip(sorted_out, scatter_out):
+            np.testing.assert_allclose(s, c, rtol=1e-12)
+        dense = X.toarray()
+        np.testing.assert_allclose(scatter_out[0], dense.T @ np.asarray(v), rtol=1e-9)
+        np.testing.assert_allclose(
+            scatter_out[2],
+            dense.T @ np.diag(np.asarray(d)) @ dense,
+            rtol=1e-9,
+            atol=1e-12,
+        )
+
+
+class TestEmptyAndPadding:
+    def test_empty_rows_score_zero(self, rng):
+        # rows 1 and 3 carry no entries
+        m = _coo(
+            rows=[0, 2, 2, 4],
+            cols=[0, 1, 2, 0],
+            vals=[1.0, 2.0, 3.0, -1.0],
+            n_rows=5,
+            n_cols=3,
+            rows_sorted=True,
+        )
+        w = rng.normal(size=3)
+        out = np.asarray(m.matvec(jnp.asarray(w)))
+        assert out[1] == 0.0 and out[3] == 0.0
+        np.testing.assert_allclose(out, np.asarray(m.to_dense()) @ w)
+
+    def test_all_padding_matrix(self, rng):
+        """nnz == 0 padded to a bucket: every kernel is inert zeros."""
+        empty = sp.csr_matrix((6, 4))
+        m = SparseDesignMatrix.from_scipy(empty, dtype=jnp.float64, pad_nnz=8)
+        assert m.vals.shape == (8,)
+        w = jnp.asarray(rng.normal(size=4))
+        v = jnp.asarray(rng.normal(size=6))
+        assert not np.asarray(m.matvec(w)).any()
+        assert not np.asarray(m.rmatvec(v)).any()
+        assert not np.asarray(m.gram(jnp.abs(v))).any()
+        assert not np.asarray(m.to_dense()).any()
+
+    def test_padding_entries_inert_under_gram(self, rng):
+        """from_scipy's tail padding (last row id, val 0) contributes nothing
+        to the column-slab Gram accumulation."""
+        X = sp.random(50, 20, density=0.2, random_state=np.random.RandomState(4))
+        tight = SparseDesignMatrix.from_scipy(X.tocsr(), dtype=jnp.float64)
+        padded = SparseDesignMatrix.from_scipy(
+            X.tocsr(), dtype=jnp.float64, pad_nnz=X.nnz + 37
+        )
+        d = jnp.asarray(np.abs(rng.normal(size=50)) + 0.1)
+        np.testing.assert_array_equal(
+            np.asarray(tight.gram(d)), np.asarray(padded.gram(d))
+        )
+
+    def test_gram_spans_multiple_column_blocks(self, rng, monkeypatch):
+        """The block-of-columns loop concatenates slabs correctly when
+        n_cols > GRAM_BLOCK_COLS (shrunk here so the test stays small)."""
+        monkeypatch.setattr(matrix_mod, "GRAM_BLOCK_COLS", 7)
+        X = sp.random(60, 23, density=0.2, random_state=np.random.RandomState(5))
+        m = SparseDesignMatrix.from_scipy(X.tocsr(), dtype=jnp.float64)
+        d = np.abs(rng.normal(size=60)) + 0.1
+        dense = X.toarray()
+        np.testing.assert_allclose(
+            np.asarray(m.gram(jnp.asarray(d))),
+            dense.T @ np.diag(d) @ dense,
+            rtol=1e-9,
+            atol=1e-12,
+        )

@@ -37,7 +37,11 @@ persistent XLA cache makes repeat runs cheap): the mesh-sharded random-effect
 coordinate update (``RandomEffectCoordinate.compiled_update_hlo``), the
 streamed working-set chunk update (``solver_cache.re_chunk_update_program``
 lowered on a real staged chunk — its donated init/score-partial pair is the
-two-tables-in-flight memory contract), the fused population/game step
+two-tables-in-flight memory contract), the 2-D feature-sharded fixed-effect
+update in both storage classes (``FixedEffectCoordinate.compiled_update_hlo``
+— ``fe_sparse_update`` lowers from a real CSR batch and ratchets the donation
+pair plus the feature-axis collective counts; ``fe_update_2d`` is the dense
+baseline profile), the fused population/game step
 (``parallel.make_jitted_game_step``), the one-program population sweep
 (``PopulationTrainer.lower_fused_sweep`` on a settings mesh), and the
 serving engine's fused program at its two static buckets.
@@ -417,6 +421,60 @@ def build_fused_sweep() -> str:
     return trainer.lower_fused_sweep(settings, n_iterations=1)
 
 
+def _fe_coordinate_2d(storage: str):
+    """Feature-sharded (2-D data x model mesh) fixed-effect coordinate at the
+    tests/test_feature_sharded.py smoke shape, with the requested storage
+    class — the fused ``fe_coordinate_update_program`` engages because
+    placement stamps ``coef_sharding``."""
+    import numpy as np
+    import scipy.sparse as sp
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.algorithm.coordinate import FixedEffectCoordinate
+    from photon_ml_tpu.data.dataset import FixedEffectDataset, LabeledData
+    from photon_ml_tpu.data.matrix import SparseDesignMatrix
+    from photon_ml_tpu.parallel.feature_sharded import make_mesh2
+    from photon_ml_tpu.parallel.placement import place_fixed_effect_dataset
+    from photon_ml_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    n, d = 256, 24
+    dense = (rng.random((n, d)) < 0.3) * rng.standard_normal((n, d))
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    if storage == "sparse":
+        mat = SparseDesignMatrix.from_scipy(sp.csr_matrix(dense), dtype=jnp.float64)
+    else:
+        mat = dense
+    ds = place_fixed_effect_dataset(
+        FixedEffectDataset(data=LabeledData.build(mat, y, dtype=jnp.float64)),
+        make_mesh2(4, 2),
+    )
+    return FixedEffectCoordinate(
+        coordinate_id="fe", dataset=ds,
+        task=TaskType.LOGISTIC_REGRESSION, configuration=_glm_config(),
+    )
+
+
+def build_fe_sparse_update() -> str:
+    """Fused fixed-effect update, SPARSE (padded-COO from a real CSR batch)
+    storage on the 2-D feature-sharded mesh — the wide-FE program. The
+    ratchet pins its donation pair (coeffs_prev/score_prev, the steady-state
+    one-copy contract) and its feature-axis collective counts: the sparse
+    path's in-loop data collectives are the per-iteration margin/gradient
+    all-reduces plus the [D] coefficient-rebuild / [N] margin all-gathers
+    that ``hlo_guards.assert_feature_axis_profile`` bounds — one more
+    in-loop data collective means a new per-iteration cross-device exchange
+    crossing the feature axis."""
+    return _fe_coordinate_2d("sparse").compiled_update_hlo()
+
+
+def build_fe_update_2d() -> str:
+    """Fused fixed-effect update, DENSE block-sharded storage on the same
+    2-D mesh — the feature-axis baseline profile (in-loop data collectives =
+    the margin/gradient all-reduce pair only, 1411.6520's pattern)."""
+    return _fe_coordinate_2d("dense").compiled_update_hlo()
+
+
 def _serving_engine_and_batch():
     import numpy as np
     import scipy.sparse as sp
@@ -507,6 +565,8 @@ def build_serving_per_coordinate() -> str:
 PROGRAM_BUILDERS = {
     "re_update": build_re_update,
     "re_chunk_update": build_re_chunk_update,
+    "fe_sparse_update": build_fe_sparse_update,
+    "fe_update_2d": build_fe_update_2d,
     "population_update": build_population_update,
     "fused_sweep": build_fused_sweep,
     "serving_score": build_serving_score,
